@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is unavailable in CI; all sharding/collective tests run on
+a virtual 8-device CPU platform (xla_force_host_platform_device_count), per the
+same strategy the reference uses for multi-node tests without a real cluster
+(yt/python/yt/environment/yt_env.py local-mode clusters).
+
+This must run before any JAX backend initializes.  The environment may have a
+TPU plugin pre-registered by sitecustomize, so we switch platforms via
+jax.config (which takes effect lazily at first backend use) rather than env.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
+    return Mesh(np.array(devices[:8]).reshape(8), ("shard",))

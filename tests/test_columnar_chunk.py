@@ -1,0 +1,125 @@
+"""Columnar chunk tests (ref model: ytlib/columnar_chunk_format)."""
+
+import numpy as np
+
+from ytsaurus_tpu import EValueType, TableSchema
+from ytsaurus_tpu.chunks import ColumnarChunk, concat_chunks, pad_capacity
+
+
+def test_pad_capacity_buckets():
+    assert pad_capacity(1) == 128
+    assert pad_capacity(128) == 128
+    assert pad_capacity(129) == 256
+    assert pad_capacity(1000) == 1024
+
+
+SCHEMA = TableSchema.make([
+    ("k", "int64", "ascending"),
+    ("v", "double"),
+    ("s", "string"),
+    ("b", "boolean"),
+])
+
+
+def test_from_rows_roundtrip():
+    rows = [
+        {"k": 1, "v": 1.5, "s": "foo", "b": True},
+        {"k": 2, "v": None, "s": "bar", "b": False},
+        {"k": 3, "v": -2.25, "s": None, "b": None},
+    ]
+    chunk = ColumnarChunk.from_rows(SCHEMA, rows)
+    assert chunk.row_count == 3
+    assert chunk.capacity == 128
+    out = chunk.to_rows()
+    assert out[0] == {"k": 1, "v": 1.5, "s": b"foo", "b": True}
+    assert out[1]["v"] is None and out[1]["s"] == b"bar"
+    assert out[2]["s"] is None and out[2]["b"] is None
+
+
+def test_string_dictionary_order_preserving():
+    rows = [{"k": i, "v": None, "s": s, "b": None}
+            for i, s in enumerate(["zeta", "alpha", "midway", "alpha"])]
+    chunk = ColumnarChunk.from_rows(SCHEMA, rows)
+    col = chunk.column("s")
+    codes = np.asarray(col.data[:4])
+    # alpha < midway < zeta; equal strings share a code
+    assert codes[1] == codes[3]
+    assert codes[1] < codes[2] < codes[0]
+    assert list(col.dictionary) == [b"alpha", b"midway", b"zeta"]
+
+
+def test_tuple_rows_and_uint64():
+    schema = TableSchema.make([("u", "uint64"), ("i", "int64")])
+    big = 2**63 + 5
+    chunk = ColumnarChunk.from_rows(schema, [(big, -7), (0, None)])
+    rows = chunk.to_rows()
+    assert rows[0]["u"] == big
+    assert rows[0]["i"] == -7
+    assert rows[1]["i"] is None
+
+
+def test_concat_chunks_unifies_dictionaries():
+    a = ColumnarChunk.from_rows(SCHEMA, [
+        {"k": 1, "v": 1.0, "s": "bb", "b": True}])
+    b = ColumnarChunk.from_rows(SCHEMA, [
+        {"k": 2, "v": 2.0, "s": "aa", "b": False},
+        {"k": 3, "v": 3.0, "s": "bb", "b": True}])
+    merged = concat_chunks([a, b])
+    assert merged.row_count == 3
+    rows = merged.to_rows()
+    assert [r["s"] for r in rows] == [b"bb", b"aa", b"bb"]
+    col = merged.column("s")
+    codes = np.asarray(col.data[:3])
+    assert codes[0] == codes[2] and codes[1] < codes[0]
+
+
+def test_slice_rows():
+    rows = [{"k": i, "v": float(i), "s": str(i), "b": i % 2 == 0}
+            for i in range(10)]
+    chunk = ColumnarChunk.from_rows(SCHEMA, rows)
+    part = chunk.slice_rows(3, 7)
+    assert part.row_count == 4
+    assert [r["k"] for r in part.to_rows()] == [3, 4, 5, 6]
+
+
+def test_from_arrays_fast_path():
+    schema = TableSchema.make([("x", "int64"), ("y", "double")])
+    n = 1000
+    chunk = ColumnarChunk.from_arrays(
+        schema,
+        {"x": np.arange(n), "y": np.linspace(0, 1, n)})
+    assert chunk.row_count == n
+    assert chunk.capacity == 1024
+    assert np.asarray(chunk.column("x").data[:5]).tolist() == [0, 1, 2, 3, 4]
+
+
+def test_any_column_roundtrip():
+    schema = TableSchema.make([("k", "int64"), ("a", "any")])
+    rows = [{"k": 1, "a": {"x": 1}}, {"k": 2, "a": [1, 2, 3]}, {"k": 3, "a": None}]
+    chunk = ColumnarChunk.from_rows(schema, rows)
+    out = chunk.to_rows()
+    assert out[0]["a"] == {"x": 1}
+    assert out[1]["a"] == [1, 2, 3]
+    assert out[2]["a"] is None
+    merged = concat_chunks([chunk, ColumnarChunk.from_rows(schema, [{"k": 4, "a": "s"}])])
+    assert merged.to_rows()[3]["a"] == "s"
+
+
+def test_concat_schema_mismatch_rejected():
+    import pytest
+    from ytsaurus_tpu import YtError
+    a = ColumnarChunk.from_rows(TableSchema.make([("k", "int64")]), [(1,)])
+    b = ColumnarChunk.from_rows(TableSchema.make([("k", "double")]), [(1.5,)])
+    with pytest.raises(YtError):
+        concat_chunks([a, b])
+
+
+def test_strict_schema_rejects_unknown_columns():
+    import pytest
+    from ytsaurus_tpu import YtError
+    schema = TableSchema.make([("k", "int64")])
+    with pytest.raises(YtError):
+        ColumnarChunk.from_rows(schema, [{"k": 1, "junk": 2}])
+    loose = TableSchema.make([("k", "int64")], strict=False)
+    chunk = ColumnarChunk.from_rows(loose, [{"k": 1, "junk": 2}])
+    assert chunk.to_rows() == [{"k": 1}]

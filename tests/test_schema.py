@@ -1,0 +1,56 @@
+"""Schema / logical-type tests (ref model: client/table_client/schema.h)."""
+
+import pytest
+
+from ytsaurus_tpu import ColumnSchema, EValueType, SortOrder, TableSchema, YtError
+
+
+def test_make_and_lookup():
+    schema = TableSchema.make([
+        ("k", "int64", "ascending"),
+        ("v", "double"),
+        ("s", "string"),
+    ])
+    assert schema.column_names == ["k", "v", "s"]
+    assert schema.get("k").sort_order is SortOrder.ascending
+    assert schema.get("v").type is EValueType.double
+    assert schema.is_sorted
+    assert schema.key_column_names == ["k"]
+    assert "v" in schema and "missing" not in schema
+
+
+def test_key_prefix_enforced():
+    with pytest.raises(YtError):
+        TableSchema.make([("a", "int64"), ("k", "int64", "ascending")])
+
+
+def test_duplicate_column_rejected():
+    with pytest.raises(YtError):
+        TableSchema.make([("a", "int64"), ("a", "double")])
+
+
+def test_roundtrip_dict():
+    schema = TableSchema.make(
+        [("k", "uint64", "descending"), ("v", "boolean")], unique_keys=True)
+    d = schema.to_dict()
+    back = TableSchema.from_dict(d)
+    assert back == schema
+    assert back.unique_keys
+
+
+def test_to_unsorted_and_select():
+    schema = TableSchema.make([("k", "int64", "ascending"), ("v", "double")])
+    unsorted = schema.to_unsorted()
+    assert not unsorted.is_sorted
+    sub = schema.select(["v"])
+    assert sub.column_names == ["v"]
+
+
+def test_select_reorder_clears_sort_order():
+    schema = TableSchema.make([("k", "int64", "ascending"), ("v", "double")])
+    sub = schema.select(["v", "k"])
+    assert sub.column_names == ["v", "k"]
+    assert not sub.is_sorted
+    # prefix-preserving projection keeps the key
+    sub2 = schema.select(["k", "v"])
+    assert sub2.key_column_names == ["k"]

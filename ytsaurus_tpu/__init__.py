@@ -1,0 +1,37 @@
+"""ytsaurus_tpu — a TPU-native distributed table store + query/compute framework.
+
+A ground-up rebuild of the capabilities of ytsaurus/ytsaurus (reference layout in
+SURVEY.md) designed for TPU hardware: columnar chunks staged into HBM, query plans
+lowered to XLA (with Pallas kernels for the hash/sort hot loops), distribution via
+jax.sharding meshes with ICI collectives (psum / all_to_all) instead of a TCP bus.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+  - schema / rows / yson        — data model (ref: yt/yt/client/table_client)
+  - chunks                      — columnar chunk format + store + HBM staging
+                                  (ref: yt/yt/ytlib/columnar_chunk_format)
+  - query                       — QL front end, plan IR, XLA lowering, evaluator
+                                  (ref: yt/yt/library/query)
+  - parallel                    — mesh / collectives / shuffle (ref: core/bus + rpc)
+  - operations                  — MapReduce-style operations incl. Sort
+                                  (ref: yt/yt/server/controller_agent/controllers)
+  - tablet                      — dynamic tables: MVCC dynamic stores, lookup
+                                  (ref: yt/yt/server/node/tablet_node)
+  - cypress                     — metadata tree + transactions (ref: server/master)
+"""
+
+import jax as _jax
+
+# Exact 64-bit integer and double semantics are load-bearing for a database
+# engine (ref row model: client/table_client/unversioned_row.h uses i64/ui64/
+# double).  JAX defaults to 32-bit; opt the whole framework into x64.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from ytsaurus_tpu.errors import YtError, YtResponseError  # noqa: E402,F401
+from ytsaurus_tpu.schema import (  # noqa: E402,F401
+    ColumnSchema,
+    EValueType,
+    SortOrder,
+    TableSchema,
+)

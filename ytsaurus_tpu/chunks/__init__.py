@@ -1,0 +1,7 @@
+from ytsaurus_tpu.chunks.columnar import (
+    Column,
+    ColumnarChunk,
+    concat_chunks,
+    pad_capacity,
+    unify_dictionaries,
+)

@@ -1,0 +1,363 @@
+"""Columnar chunks: the device-resident unit of table data.
+
+TPU-native analog of the reference's columnar chunk format
+(yt/yt/ytlib/columnar_chunk_format — "format version 3" scan-oriented reader,
+segment_readers.h) re-designed for XLA rather than translated:
+
+  * A chunk is a struct-of-arrays: one fixed-width device plane per column plus
+    a validity plane, padded to a static capacity (multiple of 128 lanes) so
+    every kernel sees static shapes.  `row_count` may be smaller than capacity;
+    rows beyond it are masked out by `row_valid`.
+  * Strings are order-preserving dictionary-encoded per chunk: the device plane
+    holds int32 ranks into a host-side sorted vocabulary.  Rank order == byte
+    order, so ORDER BY / range predicates / GROUP BY on strings are pure integer
+    ops on device.  Cross-chunk operations unify vocabularies host-side and
+    remap codes with one device gather (see `unify_dictionaries`).
+  * `any`-typed payloads stay host-side (list of YSON values); they ride along
+    for projection but are opaque to device compute, like the reference's
+    "any" columns are opaque blobs to its codegen.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Iterable, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.schema import EValueType, TableSchema, device_dtype
+
+LANE = 128  # last-dim tiling unit on TPU; capacities are multiples of this
+
+
+def pad_capacity(n: int) -> int:
+    """Round a row count up to a static capacity bucket.
+
+    Buckets are powers of two (times LANE) so distinct data sizes collapse onto
+    few compiled shapes — the XLA analog of the reference's LLVM code cache
+    keyed by query fingerprint only (engine_api/cg_cache.h): we additionally
+    key by capacity bucket, so bucketing bounds the number of recompiles.
+    """
+    cap = LANE
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _encode_strings(values: Sequence[Optional[bytes]]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Order-preserving dictionary encode. Returns (codes, valid, vocab)."""
+    valid = np.array([v is not None for v in values], dtype=bool)
+    present = [v for v in values if v is not None]
+    vocab = np.array(sorted(set(present)), dtype=object)
+    if len(vocab):
+        lookup = {v: i for i, v in enumerate(vocab)}
+        codes = np.array([lookup[v] if v is not None else 0 for v in values],
+                         dtype=np.int32)
+    else:
+        codes = np.zeros(len(values), dtype=np.int32)
+    return codes, valid, vocab
+
+
+def _to_bytes(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    raise YtError(f"Expected string value, got {type(v).__name__}")
+
+
+@dataclass(frozen=True)
+class Column:
+    """One column plane: device data + validity + optional host vocabulary."""
+
+    type: EValueType
+    data: jax.Array                      # (capacity,) device_dtype(type)
+    valid: jax.Array                     # (capacity,) bool
+    dictionary: Optional[np.ndarray] = None   # host vocab for string columns
+    host_values: Optional[list] = None        # payloads for `any` columns
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def decode(self, row_count: int) -> list:
+        """Materialize host values for the first `row_count` rows."""
+        data = np.asarray(self.data[:row_count])
+        valid = np.asarray(self.valid[:row_count])
+        out: list = []
+        for i in range(row_count):
+            if not valid[i]:
+                out.append(None)
+            elif self.type is EValueType.string:
+                out.append(bytes(self.dictionary[int(data[i])]))
+            elif self.type is EValueType.any:
+                out.append(self.host_values[i])
+            elif self.type is EValueType.boolean:
+                out.append(bool(data[i]))
+            elif self.type is EValueType.double:
+                out.append(float(data[i]))
+            elif self.type is EValueType.null:
+                out.append(None)
+            else:
+                out.append(int(data[i]))
+        return out
+
+
+@dataclass(frozen=True)
+class ColumnarChunk:
+    """An immutable columnar rowset with static device capacity."""
+
+    schema: TableSchema
+    row_count: int
+    columns: dict[str, Column]
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return pad_capacity(max(self.row_count, 1))
+        return next(iter(self.columns.values())).capacity
+
+    @property
+    def row_valid(self) -> jax.Array:
+        cap = self.capacity
+        return jnp.arange(cap) < self.row_count
+
+    def column(self, name: str) -> Column:
+        col = self.columns.get(name)
+        if col is None:
+            raise YtError(f"No such column {name!r} in chunk",
+                          code=EErrorCode.QueryTypeError)
+        return col
+
+    # --- construction ---------------------------------------------------------
+
+    @staticmethod
+    def from_rows(schema: TableSchema, rows: Sequence[Mapping[str, Any] | Sequence[Any]],
+                  capacity: Optional[int] = None) -> "ColumnarChunk":
+        n = len(rows)
+        cap = capacity or pad_capacity(max(n, 1))
+        if cap < n:
+            raise YtError(f"Capacity {cap} < row count {n}")
+        names = schema.column_names
+        # Normalize to per-column host lists.
+        name_set = set(names)
+        per_col: dict[str, list] = {name: [] for name in names}
+        for row in rows:
+            if isinstance(row, Mapping):
+                if schema.strict:
+                    unknown = set(row) - name_set
+                    if unknown:
+                        raise YtError(
+                            f"Unknown columns {sorted(unknown)} for strict schema",
+                            code=EErrorCode.QueryTypeError)
+                for name in names:
+                    per_col[name].append(row.get(name))
+            else:
+                if len(row) != len(names):
+                    raise YtError(
+                        f"Row width {len(row)} != schema width {len(names)}")
+                for name, v in zip(names, row):
+                    per_col[name].append(v)
+        columns: dict[str, Column] = {}
+        for col_schema in schema:
+            name = col_schema.name
+            ty = col_schema.type
+            values = per_col[name]
+            columns[name] = _build_column(ty, values, cap)
+        return ColumnarChunk(schema=schema, row_count=n, columns=columns)
+
+    @staticmethod
+    def from_arrays(schema: TableSchema, arrays: Mapping[str, np.ndarray],
+                    row_count: Optional[int] = None,
+                    valids: Optional[Mapping[str, np.ndarray]] = None,
+                    dictionaries: Optional[Mapping[str, np.ndarray]] = None,
+                    capacity: Optional[int] = None) -> "ColumnarChunk":
+        """Fast path from numpy arrays (no per-value python loop)."""
+        names = schema.column_names
+        n = row_count if row_count is not None else len(next(iter(arrays.values())))
+        cap = capacity or pad_capacity(max(n, 1))
+        columns: dict[str, Column] = {}
+        for col_schema in schema:
+            name = col_schema.name
+            ty = col_schema.type
+            if ty is EValueType.any:
+                raise YtError("from_arrays does not support `any` columns; "
+                              "use from_rows", code=EErrorCode.QueryUnsupported)
+            arr = np.asarray(arrays[name])
+            if len(arr) != n:
+                raise YtError(f"Column {name!r} length {len(arr)} != {n}")
+            dt = device_dtype(ty)
+            data = np.zeros(cap, dtype=dt)
+            data[:n] = arr.astype(dt)
+            valid = np.zeros(cap, dtype=bool)
+            if valids is not None and name in valids:
+                valid[:n] = np.asarray(valids[name], dtype=bool)
+            else:
+                valid[:n] = True
+            vocab = None
+            if ty is EValueType.string:
+                if dictionaries is None or name not in dictionaries:
+                    raise YtError(f"String column {name!r} needs a dictionary")
+                vocab = np.asarray(dictionaries[name], dtype=object)
+            columns[name] = Column(type=ty, data=jnp.asarray(data),
+                                   valid=jnp.asarray(valid), dictionary=vocab)
+        return ColumnarChunk(schema=schema, row_count=n, columns=columns)
+
+    # --- materialization ------------------------------------------------------
+
+    def to_rows(self) -> list[dict[str, Any]]:
+        decoded = {name: col.decode(self.row_count)
+                   for name, col in self.columns.items()}
+        names = self.schema.column_names
+        return [
+            {name: decoded[name][i] for name in names}
+            for i in range(self.row_count)
+        ]
+
+    def to_tuples(self) -> list[tuple]:
+        decoded = [self.columns[name].decode(self.row_count)
+                   for name in self.schema.column_names]
+        return [tuple(col[i] for col in decoded) for i in range(self.row_count)]
+
+    # --- transforms -----------------------------------------------------------
+
+    def with_capacity(self, capacity: int) -> "ColumnarChunk":
+        """Repad all planes to a new (>= row_count) capacity."""
+        if capacity == self.capacity:
+            return self
+        if capacity < self.row_count:
+            raise YtError("Cannot shrink chunk below its row count")
+        columns = {}
+        m = min(capacity, self.capacity)
+        for name, col in self.columns.items():
+            data = jnp.zeros(capacity, dtype=col.data.dtype).at[:m].set(col.data[:m])
+            valid = jnp.zeros(capacity, dtype=bool).at[:m].set(col.valid[:m])
+            columns[name] = replace(col, data=data, valid=valid)
+        return ColumnarChunk(schema=self.schema, row_count=self.row_count,
+                             columns=columns)
+
+    def slice_rows(self, start: int, end: int) -> "ColumnarChunk":
+        start = max(0, start)
+        end = min(self.row_count, end)
+        n = max(0, end - start)
+        cap = pad_capacity(max(n, 1))
+        columns = {}
+        for name, col in self.columns.items():
+            data = jnp.zeros(cap, dtype=col.data.dtype).at[:n].set(
+                jax.lax.dynamic_slice_in_dim(col.data, start, n) if n else
+                jnp.zeros(0, dtype=col.data.dtype))
+            valid = jnp.zeros(cap, dtype=bool).at[:n].set(
+                jax.lax.dynamic_slice_in_dim(col.valid, start, n) if n else
+                jnp.zeros(0, dtype=bool))
+            host_values = None
+            if col.host_values is not None:
+                host_values = col.host_values[start:end]
+            columns[name] = replace(col, data=data, valid=valid,
+                                    host_values=host_values)
+        return ColumnarChunk(schema=self.schema, row_count=n, columns=columns)
+
+
+def _plane_dtype(ty: EValueType) -> np.dtype:
+    # `any` columns carry host payloads; their device plane is a placeholder.
+    if ty is EValueType.any:
+        return np.dtype(np.int8)
+    return device_dtype(ty)
+
+
+def _build_column(ty: EValueType, values: Sequence[Any], cap: int) -> Column:
+    n = len(values)
+    dt = _plane_dtype(ty)
+    valid_np = np.zeros(cap, dtype=bool)
+    data_np = np.zeros(cap, dtype=dt)
+    vocab = None
+    host_values = None
+    if ty is EValueType.string:
+        encoded = [None if v is None else _to_bytes(v) for v in values]
+        codes, valid, vocab = _encode_strings(encoded)
+        data_np[:n] = codes
+        valid_np[:n] = valid
+    elif ty is EValueType.any:
+        host_values = list(values) + [None] * (cap - n)
+        valid_np[:n] = [v is not None for v in values]
+    elif ty is EValueType.null:
+        pass
+    else:
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            valid_np[i] = True
+            if ty is EValueType.boolean:
+                data_np[i] = bool(v)
+            elif ty is EValueType.double:
+                data_np[i] = float(v)
+            elif ty is EValueType.uint64:
+                data_np[i] = np.uint64(v)
+            else:
+                data_np[i] = np.int64(v)
+    return Column(type=ty, data=jnp.asarray(data_np), valid=jnp.asarray(valid_np),
+                  dictionary=vocab, host_values=host_values)
+
+
+def unify_dictionaries(columns: Sequence[Column]) -> tuple[list[Column], np.ndarray]:
+    """Re-encode string columns onto a shared sorted vocabulary.
+
+    Returns the remapped columns and the unified vocab.  The remap is a single
+    device gather per column (codes -> new codes), keeping order preservation.
+    """
+    vocabs = [c.dictionary for c in columns if c.dictionary is not None]
+    merged = np.array(sorted({v for vocab in vocabs for v in vocab}), dtype=object)
+    lookup = {v: i for i, v in enumerate(merged)}
+    out = []
+    for col in columns:
+        if col.type is not EValueType.string:
+            out.append(col)
+            continue
+        old_vocab = col.dictionary if col.dictionary is not None else np.array([], dtype=object)
+        remap_np = np.array([lookup[v] for v in old_vocab], dtype=np.int32)
+        if len(remap_np) == 0:
+            remap_np = np.zeros(1, dtype=np.int32)
+        remap = jnp.asarray(remap_np)
+        new_codes = remap[jnp.clip(col.data, 0, len(remap_np) - 1)]
+        out.append(replace(col, data=new_codes.astype(jnp.int32), dictionary=merged))
+    return out, merged
+
+
+def concat_chunks(chunks: Sequence[ColumnarChunk]) -> ColumnarChunk:
+    """Concatenate chunks of identical schema into one (device concat + repad)."""
+    if not chunks:
+        raise YtError("concat_chunks: empty input")
+    if len(chunks) == 1:
+        return chunks[0]
+    schema = chunks[0].schema
+    for c in chunks[1:]:
+        if c.schema != schema:
+            raise YtError("concat_chunks: schema mismatch",
+                          code=EErrorCode.ChunkFormatError)
+    total = sum(c.row_count for c in chunks)
+    cap = pad_capacity(max(total, 1))
+    columns: dict[str, Column] = {}
+    for col_schema in schema:
+        name = col_schema.name
+        cols = [c.column(name) for c in chunks]
+        vocab = None
+        if col_schema.type is EValueType.string:
+            cols, vocab = unify_dictionaries(cols)
+        data_parts, valid_parts = [], []
+        for chunk, col in zip(chunks, cols):
+            data_parts.append(col.data[: chunk.row_count])
+            valid_parts.append(col.valid[: chunk.row_count])
+        dt = _plane_dtype(col_schema.type)
+        data = jnp.zeros(cap, dtype=dt).at[:total].set(jnp.concatenate(data_parts))
+        valid = jnp.zeros(cap, dtype=bool).at[:total].set(jnp.concatenate(valid_parts))
+        host_values = None
+        if col_schema.type is EValueType.any:
+            host_values = []
+            for chunk, col in zip(chunks, cols):
+                host_values.extend((col.host_values or [])[: chunk.row_count])
+            host_values += [None] * (cap - total)
+        columns[name] = Column(type=col_schema.type, data=data, valid=valid,
+                               dictionary=vocab, host_values=host_values)
+    return ColumnarChunk(schema=schema, row_count=total, columns=columns)
