@@ -1,0 +1,59 @@
+"""Query-evaluation test harness.
+
+Port of the reference's TQueryEvaluateTest harness pattern
+(library/query/unittests/evaluate/test_evaluate.h:61): evaluate(query, tables,
+expected) runs parse → build → lower → execute against in-memory chunks and
+compares materialized rows.  Comparison is order-insensitive unless the query
+has ORDER BY (then prefix order matters).
+"""
+
+from ytsaurus_tpu.chunks import ColumnarChunk
+from ytsaurus_tpu.query import select_rows
+from ytsaurus_tpu.schema import TableSchema
+
+
+def _canon(v):
+    # Sortable, type-tagged canonical form (None must order against values).
+    if v is None:
+        return (0, 0)
+    if isinstance(v, bool):
+        return (1, int(v))
+    if isinstance(v, float):
+        return (2, round(v, 9))
+    if isinstance(v, int):
+        return (2, v)
+    if isinstance(v, bytes):
+        return (3, v)
+    if isinstance(v, str):
+        return (3, v.encode())
+    return (4, repr(v))
+
+
+def _canon_row(row: dict) -> tuple:
+    return tuple((k, _canon(v)) for k, v in sorted(row.items()))
+
+
+def evaluate(query, tables, expected=None, ordered=False, schemas=None):
+    """tables: {path: (schema_spec, rows)} or {path: ColumnarChunk}.
+    expected: list of dicts (or None to just return results)."""
+    chunks = {}
+    built_schemas = dict(schemas or {})
+    for path, spec in tables.items():
+        if isinstance(spec, ColumnarChunk):
+            chunks[path] = spec
+        else:
+            schema_spec, rows = spec
+            schema = (schema_spec if isinstance(schema_spec, TableSchema)
+                      else TableSchema.make(schema_spec))
+            chunks[path] = ColumnarChunk.from_rows(schema, rows)
+    result = select_rows(query, chunks, schemas=built_schemas)
+    rows = result.to_rows()
+    if expected is not None:
+        got = [_canon_row(r) for r in rows]
+        want = [_canon_row(r) for r in expected]
+        if ordered:
+            assert got == want, f"\nquery: {query}\n got: {rows}\nwant: {expected}"
+        else:
+            assert sorted(got) == sorted(want), \
+                f"\nquery: {query}\n got: {sorted(got)}\nwant: {sorted(want)}"
+    return rows

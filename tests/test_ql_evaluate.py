@@ -1,0 +1,403 @@
+"""QL end-to-end evaluation tests — the correctness oracle.
+
+Modeled on the reference's ql_query_ut.cpp / ql_expressions_ut.cpp suites
+(library/query/unittests): each case runs the full parse → typed IR → XLA
+lowering → execute pipeline over in-memory columnar chunks.
+"""
+
+import pytest
+
+from tests.harness import evaluate
+
+T = "//t"
+
+
+def _kv(rows):
+    return {T: ([("k", "int64", "ascending"), ("v", "int64")], rows)}
+
+
+KV6 = _kv([(i, i * 10) for i in range(6)])
+
+
+# --- projection & arithmetic --------------------------------------------------
+
+def test_select_star():
+    evaluate(f"* FROM [{T}]", _kv([(1, 10), (2, 20)]),
+             [{"k": 1, "v": 10}, {"k": 2, "v": 20}])
+
+
+def test_project_arithmetic():
+    evaluate(f"k + v AS s, k * 2 AS d FROM [{T}]", _kv([(1, 10), (2, 20)]),
+             [{"s": 11, "d": 2}, {"s": 22, "d": 4}])
+
+
+def test_integer_division_truncates():
+    evaluate(f"k / 2 AS q, k % 3 AS r FROM [{T}]", _kv([(7, 0), (-7, 0)]),
+             [{"q": 3, "r": 1}, {"q": -3, "r": -1}])
+
+
+def test_division_by_zero_is_null():
+    evaluate(f"k / v AS q FROM [{T}]", _kv([(6, 2), (5, 0)]),
+             [{"q": 3}, {"q": None}])
+
+
+def test_double_arithmetic_promotion():
+    evaluate(f"k + 0.5 AS x FROM [{T}]", _kv([(1, 0)]), [{"x": 1.5}])
+
+
+def test_unary_and_bitwise():
+    evaluate(f"-k AS n, ~k AS b, k << 2 AS s FROM [{T}]", _kv([(5, 0)]),
+             [{"n": -5, "b": -6, "s": 20}])
+
+
+# --- filtering ----------------------------------------------------------------
+
+def test_where_simple():
+    evaluate(f"k FROM [{T}] WHERE k > 3", KV6,
+             [{"k": 4}, {"k": 5}])
+
+
+def test_where_and_or():
+    evaluate(f"k FROM [{T}] WHERE k > 1 AND k < 4 OR k = 5", KV6,
+             [{"k": 2}, {"k": 3}, {"k": 5}])
+
+
+def test_where_in():
+    evaluate(f"k FROM [{T}] WHERE k IN (1, 3, 5)", KV6,
+             [{"k": 1}, {"k": 3}, {"k": 5}])
+
+
+def test_where_between():
+    evaluate(f"k FROM [{T}] WHERE k BETWEEN 2 AND 4", KV6,
+             [{"k": 2}, {"k": 3}, {"k": 4}])
+
+
+def test_where_not_between():
+    evaluate(f"k FROM [{T}] WHERE k NOT BETWEEN 1 AND 4", KV6,
+             [{"k": 0}, {"k": 5}])
+
+
+def test_null_comparison_filters_out():
+    rows = [(1, 10), (2, None), (3, 30)]
+    evaluate(f"k FROM [{T}] WHERE v > 5", _kv(rows),
+             [{"k": 1}, {"k": 3}])
+
+
+def test_is_null_function():
+    rows = [(1, 10), (2, None)]
+    evaluate(f"k FROM [{T}] WHERE is_null(v)", _kv(rows), [{"k": 2}])
+    evaluate(f"if_null(v, -1) AS w FROM [{T}]", _kv(rows),
+             [{"w": 10}, {"w": -1}])
+
+
+# --- group by / aggregates ----------------------------------------------------
+
+GROUPED = {T: ([("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")],
+               [(0, 0, 1), (1, 1, 2), (2, 0, 3), (3, 1, 4), (4, 0, 5)])}
+
+
+def test_group_by_sum_count():
+    evaluate(f"g, sum(v) AS s, count(v) AS c FROM [{T}] GROUP BY g", GROUPED,
+             [{"g": 0, "s": 9, "c": 3}, {"g": 1, "s": 6, "c": 2}])
+
+
+def test_group_by_min_max_avg():
+    evaluate(f"g, min(v) AS lo, max(v) AS hi, avg(v) AS a FROM [{T}] GROUP BY g",
+             GROUPED,
+             [{"g": 0, "lo": 1, "hi": 5, "a": 3.0},
+              {"g": 1, "lo": 2, "hi": 4, "a": 3.0}])
+
+
+def test_group_by_expression_key():
+    evaluate(f"k % 2 AS p, sum(v) AS s FROM [{T}] GROUP BY k % 2 AS p", GROUPED,
+             [{"p": 0, "s": 9}, {"p": 1, "s": 6}])
+
+
+def test_group_by_having():
+    evaluate(f"g, sum(v) AS s FROM [{T}] GROUP BY g HAVING sum(v) > 8", GROUPED,
+             [{"g": 0, "s": 9}])
+
+
+def test_group_by_null_key_is_a_group():
+    rows = [(1, 0, 5), (2, None, 7), (3, None, 1), (4, 0, 2)]
+    evaluate(f"g, sum(v) AS s FROM [{T}] GROUP BY g",
+             {T: ([("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")],
+                  rows)},
+             [{"g": 0, "s": 7}, {"g": None, "s": 8}])
+
+
+def test_aggregate_nulls_skipped():
+    rows = [(1, 0, None), (2, 0, 4), (3, 1, None)]
+    evaluate(f"g, sum(v) AS s, count(v) AS c FROM [{T}] GROUP BY g",
+             {T: ([("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")],
+                  rows)},
+             [{"g": 0, "s": 4, "c": 1}, {"g": 1, "s": None, "c": 0}])
+
+
+def test_total_aggregation_via_constant_key():
+    evaluate(f"sum(v) AS s FROM [{T}] GROUP BY 1 AS one", GROUPED,
+             [{"s": 15}])
+
+
+def test_count_star():
+    evaluate(f"g, count(*) AS c FROM [{T}] GROUP BY g", GROUPED,
+             [{"g": 0, "c": 3}, {"g": 1, "c": 2}])
+
+
+# --- order by / limit / offset ------------------------------------------------
+
+def test_order_by_limit():
+    evaluate(f"k FROM [{T}] ORDER BY k DESC LIMIT 3", KV6,
+             [{"k": 5}, {"k": 4}, {"k": 3}], ordered=True)
+
+
+def test_order_by_expression():
+    evaluate(f"k FROM [{T}] ORDER BY v - 2 * k LIMIT 6",
+             _kv([(0, 5), (1, 0), (2, 9)]),
+             [{"k": 1}, {"k": 0}, {"k": 2}], ordered=True)
+
+
+def test_order_by_nulls_first_asc():
+    rows = [(1, 10), (2, None), (3, 5)]
+    evaluate(f"v FROM [{T}] ORDER BY v LIMIT 10", _kv(rows),
+             [{"v": None}, {"v": 5}, {"v": 10}], ordered=True)
+
+
+def test_limit_without_order():
+    out = evaluate(f"k FROM [{T}] LIMIT 2", KV6)
+    assert len(out) == 2
+
+
+def test_offset_limit():
+    evaluate(f"k FROM [{T}] ORDER BY k LIMIT 2 OFFSET 2".replace(
+        "LIMIT 2 OFFSET 2", "OFFSET 2 LIMIT 2"), KV6,
+        [{"k": 2}, {"k": 3}], ordered=True)
+
+
+# --- strings ------------------------------------------------------------------
+
+STR_T = {T: ([("k", "int64", "ascending"), ("s", "string")],
+             [(1, "apple"), (2, "banana"), (3, "cherry"), (4, None),
+              (5, "apricot")])}
+
+
+def test_string_equality_literal():
+    evaluate(f"k FROM [{T}] WHERE s = 'banana'", STR_T, [{"k": 2}])
+
+
+def test_string_inequality_range():
+    evaluate(f"k FROM [{T}] WHERE s >= 'apple' AND s < 'b'", STR_T,
+             [{"k": 1}, {"k": 5}])
+
+
+def test_string_in():
+    evaluate(f"k FROM [{T}] WHERE s IN ('apple', 'cherry', 'missing')", STR_T,
+             [{"k": 1}, {"k": 3}])
+
+
+def test_like():
+    evaluate(f"k FROM [{T}] WHERE s LIKE 'ap%'", STR_T,
+             [{"k": 1}, {"k": 5}])
+    evaluate(f"k FROM [{T}] WHERE s LIKE '%an%'", STR_T, [{"k": 2}])
+    evaluate(f"k FROM [{T}] WHERE s NOT LIKE 'ap%'", STR_T,
+             [{"k": 2}, {"k": 3}])
+
+
+def test_is_prefix_is_substr():
+    evaluate(f"k FROM [{T}] WHERE is_prefix('ap', s)", STR_T,
+             [{"k": 1}, {"k": 5}])
+    evaluate(f"k FROM [{T}] WHERE is_substr('err', s)", STR_T, [{"k": 3}])
+
+
+def test_lower_upper_length():
+    evaluate("upper(s) AS u, length(s) AS l FROM [//t] WHERE k = 1", STR_T,
+             [{"u": "APPLE", "l": 5}])
+
+
+def test_string_projection_and_group():
+    rows = [(1, "a"), (2, "b"), (3, "a"), (4, "b"), (5, "a")]
+    evaluate(f"s, count(*) AS c FROM [{T}] GROUP BY s",
+             {T: ([("k", "int64", "ascending"), ("s", "string")], rows)},
+             [{"s": "a", "c": 3}, {"s": "b", "c": 2}])
+
+
+def test_order_by_string():
+    evaluate(f"s FROM [{T}] ORDER BY s DESC LIMIT 2", STR_T,
+             [{"s": "cherry"}, {"s": "banana"}], ordered=True)
+
+
+def test_min_max_string():
+    evaluate(f"min(s) AS lo, max(s) AS hi FROM [{T}] GROUP BY 1 AS one", STR_T,
+             [{"lo": "apple", "hi": "cherry"}])
+
+
+# --- case / transform / if ----------------------------------------------------
+
+def test_if_function():
+    evaluate(f"if(k > 2, 'big', 'small') AS c FROM [{T}]", _kv([(1, 0), (5, 0)]),
+             [{"c": "small"}, {"c": "big"}])
+
+
+def test_case_expression():
+    q = (f"CASE WHEN k < 2 THEN 'low' WHEN k < 4 THEN 'mid' ELSE 'high' END "
+         f"AS c FROM [{T}]")
+    evaluate(q, _kv([(1, 0), (3, 0), (5, 0)]),
+             [{"c": "low"}, {"c": "mid"}, {"c": "high"}])
+
+
+def test_case_with_operand():
+    q = f"CASE k WHEN 1 THEN 10 WHEN 2 THEN 20 ELSE 0 END AS c FROM [{T}]"
+    evaluate(q, _kv([(1, 0), (2, 0), (3, 0)]),
+             [{"c": 10}, {"c": 20}, {"c": 0}])
+
+
+def test_transform():
+    q = f"transform(k, (1, 2), (10, 20), -1) AS t FROM [{T}]"
+    evaluate(q, _kv([(1, 0), (2, 0), (9, 0)]),
+             [{"t": 10}, {"t": 20}, {"t": -1}])
+
+
+def test_transform_strings():
+    q = f"transform(s, ('a', 'b'), ('x', 'y')) AS t FROM [{T}]"
+    evaluate(q, {T: ([("k", "int64", "ascending"), ("s", "string")],
+                     [(1, "a"), (2, "b"), (3, "c")])},
+             [{"t": "x"}, {"t": "y"}, {"t": None}])
+
+
+# --- joins --------------------------------------------------------------------
+
+JOIN_TABLES = {
+    T: ([("k", "int64", "ascending"), ("g", "int64")],
+        [(1, 100), (2, 200), (3, 100), (4, 300)]),
+    "//d": ([("g", "int64", "ascending"), ("name", "string")],
+            [(100, "alpha"), (200, "beta"), (400, "gamma")]),
+}
+
+
+def test_inner_join_using():
+    evaluate(f"k, name FROM [{T}] JOIN [//d] USING g", JOIN_TABLES,
+             [{"k": 1, "name": "alpha"}, {"k": 2, "name": "beta"},
+              {"k": 3, "name": "alpha"}])
+
+
+def test_left_join_using():
+    evaluate(f"k, name FROM [{T}] LEFT JOIN [//d] USING g", JOIN_TABLES,
+             [{"k": 1, "name": "alpha"}, {"k": 2, "name": "beta"},
+              {"k": 3, "name": "alpha"}, {"k": 4, "name": None}])
+
+
+def test_join_on_expressions():
+    evaluate(f"k, d.name AS n FROM [{T}] JOIN [//d] AS d ON g = d.g",
+             JOIN_TABLES,
+             [{"k": 1, "n": "alpha"}, {"k": 2, "n": "beta"},
+              {"k": 3, "n": "alpha"}])
+
+
+def test_join_then_group():
+    evaluate(f"name, count(*) AS c FROM [{T}] JOIN [//d] USING g GROUP BY name",
+             JOIN_TABLES,
+             [{"name": "alpha", "c": 2}, {"name": "beta", "c": 1}])
+
+
+def test_join_duplicate_foreign_rows():
+    tables = {
+        T: ([("k", "int64", "ascending"), ("g", "int64")], [(1, 7)]),
+        "//d": ([("g", "int64", "ascending"), ("x", "int64")],
+                [(7, 1), (7, 2)]),
+    }
+    # Non-unique foreign keys fan out.
+    evaluate(f"k, x FROM [{T}] JOIN [//d] USING g", tables,
+             [{"k": 1, "x": 1}, {"k": 1, "x": 2}])
+
+
+# --- uint64 / double / boolean ------------------------------------------------
+
+def test_uint64_literals_and_sum():
+    rows = [(1, 2**63 + 1), (2, 2**63 + 2)]
+    evaluate(f"sum(u) AS s FROM [{T}] GROUP BY 1 AS one",
+             {T: ([("k", "int64", "ascending"), ("u", "uint64")], rows)},
+             [{"s": 2**64 + 3 - 2**64}])  # wraps mod 2^64: (2^63+1)+(2^63+2)=2^64+3 → 3
+
+
+def test_boolean_column_filter():
+    rows = [(1, True), (2, False), (3, True)]
+    evaluate(f"k FROM [{T}] WHERE b",
+             {T: ([("k", "int64", "ascending"), ("b", "boolean")], rows)},
+             [{"k": 1}, {"k": 3}])
+
+
+def test_double_compare():
+    rows = [(1, 0.5), (2, 1.5)]
+    evaluate(f"k FROM [{T}] WHERE d > 1.0",
+             {T: ([("k", "int64", "ascending"), ("d", "double")], rows)},
+             [{"k": 2}])
+
+
+# --- errors -------------------------------------------------------------------
+
+def test_unknown_column_raises():
+    from ytsaurus_tpu import YtError
+    with pytest.raises(YtError):
+        evaluate(f"zzz FROM [{T}]", KV6)
+
+
+def test_type_mismatch_raises():
+    from ytsaurus_tpu import YtError
+    with pytest.raises(YtError):
+        evaluate(f"k + s FROM [{T}]",
+                 {T: ([("k", "int64", "ascending"), ("s", "string")],
+                      [(1, "x")])})
+
+
+def test_non_grouped_column_raises():
+    from ytsaurus_tpu import YtError
+    with pytest.raises(YtError):
+        evaluate(f"v, sum(v) AS s FROM [{T}] GROUP BY g", GROUPED)
+
+
+def test_parse_error():
+    from ytsaurus_tpu import YtError
+    with pytest.raises(YtError):
+        evaluate(f"k FROM [{T}] WHERE ((", KV6)
+
+
+# --- regression: review findings ---------------------------------------------
+
+def test_multi_key_join():
+    tables = {
+        T: ([("a", "int64", "ascending"), ("b", "int64"), ("x", "int64")],
+            [(1, 2, 10), (2, 1, 20), (1, 1, 30), (2, 2, 40), (3, 0, 50)]),
+        "//d": ([("a", "int64", "ascending"), ("b", "int64"), ("y", "int64")],
+                [(1, 1, 100), (1, 2, 200), (2, 1, 300), (2, 2, 400),
+                 (3, 0, 500)]),
+    }
+    evaluate(f"x, y FROM [{T}] JOIN [//d] USING a, b", tables,
+             [{"x": 10, "y": 200}, {"x": 20, "y": 300}, {"x": 30, "y": 100},
+              {"x": 40, "y": 400}, {"x": 50, "y": 500}])
+
+
+def test_predicate_suffix_precedence():
+    # (k = 2 AND k IN (3)) OR v = 1 — OR must not be swallowed by IN's AND.
+    evaluate(f"k FROM [{T}] WHERE k = 2 AND k IN (3) OR v = 1",
+             _kv([(1, 1), (2, 10)]), [{"k": 1}])
+
+
+def test_having_without_group_raises():
+    from ytsaurus_tpu import YtError
+    with pytest.raises(YtError):
+        evaluate(f"k FROM [{T}] HAVING k > 1", KV6)
+
+
+def test_with_totals_unsupported():
+    from ytsaurus_tpu import YtError
+    with pytest.raises(YtError):
+        evaluate(f"g, sum(v) AS s FROM [{T}] GROUP BY g WITH TOTALS", GROUPED)
+
+
+def test_multi_key_order_by():
+    rows = [(1, 2, 10), (2, 1, 20), (3, 1, 5), (4, 2, 1)]
+    evaluate("a, b FROM [//t] ORDER BY a, b DESC LIMIT 4",
+             {T: ([("k", "int64", "ascending"), ("a", "int64"), ("b", "int64")],
+                  [(k, a, b) for k, a, b in rows])},
+             [{"a": 1, "b": 20}, {"a": 1, "b": 5}, {"a": 2, "b": 10},
+              {"a": 2, "b": 1}], ordered=True)

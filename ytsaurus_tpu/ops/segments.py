@@ -1,0 +1,116 @@
+"""Segmented reductions and sort-key helpers used by GROUP BY / ORDER BY.
+
+These are the XLA analogs of the reference's cg_routines hot loops
+(library/query/engine/cg_routines/registry.cpp: GroupOpHelper, OrderOpHelper):
+instead of a per-row JIT'd hash-table loop, grouping is lex-sort + segment
+reduction over static-capacity planes — batch-friendly for the VPU/MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ytsaurus_tpu.schema import EValueType
+
+
+def sort_key_planes(data: jax.Array, valid: jax.Array,
+                    descending: bool = False) -> list[jax.Array]:
+    """Produce ascending-order integer/float planes encoding (null, value).
+
+    YT comparison semantics: null sorts before any value.  For descending
+    order the value plane is complemented so a single ascending lexsort works.
+    Returns [value_plane, null_plane] ordered minor→major for jnp.lexsort.
+    """
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int8)
+    if descending:
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            value = ~data   # order-reversing for signed and unsigned alike
+        else:
+            value = -data
+        # Nulls sort before any value; descending reverses that → nulls last:
+        # key 0 for valid rows, 1 for nulls.
+        null_key = (~valid).astype(jnp.int8)
+    else:
+        value = data
+        # Ascending: nulls first → key 0 for null, 1 for valid.
+        null_key = valid.astype(jnp.int8)
+    value = jnp.where(valid, value, jnp.zeros_like(value))
+    return [value, null_key]
+
+
+def lexsort_indices(key_planes: list[jax.Array]) -> jax.Array:
+    """Stable ascending argsort over multiple key planes (major key LAST)."""
+    return jnp.lexsort(key_planes)
+
+
+def segment_boundaries(sorted_keys: list[tuple[jax.Array, jax.Array]],
+                       in_mask: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Given key (data, valid) planes already in sorted order plus the row
+    mask (also sorted so that masked-out rows are at the end), return
+    (segment_ids, num_segments).  Masked-out rows get segment id
+    == num_real_segments (they land in trailing garbage segments)."""
+    cap = in_mask.shape[0]
+    change = jnp.zeros(cap, dtype=bool)
+    for data, valid in sorted_keys:
+        prev_data = jnp.roll(data, 1)
+        prev_valid = jnp.roll(valid, 1)
+        differs = (data != prev_data) | (valid != prev_valid)
+        change = change | differs
+    change = change.at[0].set(False)
+    # New segment whenever keys change, restricted to in-mask rows.
+    boundary = change & in_mask
+    seg = jnp.cumsum(boundary.astype(jnp.int64))
+    num_segments = jnp.where(jnp.any(in_mask), seg[-1] + 1, 0)
+    # Rows outside the mask go to a trailing segment.
+    seg = jnp.where(in_mask, seg, num_segments)
+    return seg, num_segments
+
+
+def segment_aggregate(function: str, data: jax.Array, valid: jax.Array,
+                      seg_ids: jax.Array, num_segments: int,
+                      value_type: EValueType) -> tuple[jax.Array, jax.Array]:
+    """Aggregate `data` per segment, skipping nulls. Returns (out, out_valid)
+    planes of length num_segments (static capacity)."""
+    contributes = valid
+    count = jax.ops.segment_sum(contributes.astype(jnp.int64), seg_ids,
+                                num_segments=num_segments)
+    any_valid = count > 0
+    if function == "count":
+        return count, jnp.ones_like(any_valid)
+    if function == "sum":
+        masked = jnp.where(contributes, data, jnp.zeros_like(data))
+        out = jax.ops.segment_sum(masked, seg_ids, num_segments=num_segments)
+        return out, any_valid
+    if function == "min" or function == "max":
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int8)
+        neutral = _reduce_neutral(data.dtype, function)
+        masked = jnp.where(contributes, data, neutral)
+        op = jax.ops.segment_min if function == "min" else jax.ops.segment_max
+        out = op(masked, seg_ids, num_segments=num_segments)
+        if value_type is EValueType.boolean:
+            out = out.astype(jnp.bool_)
+        return out, any_valid
+    if function == "first":
+        cap = data.shape[0]
+        idx = jnp.where(contributes, jnp.arange(cap), cap - 1)
+        first_idx = jax.ops.segment_min(idx, seg_ids, num_segments=num_segments)
+        first_idx = jnp.clip(first_idx, 0, cap - 1)
+        return data[first_idx], any_valid
+    raise ValueError(f"Unknown segment aggregate {function!r}")
+
+
+def _reduce_neutral(dtype, function: str):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(np.inf if function == "min" else -np.inf, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if function == "min" else info.min, dtype=dtype)
+
+
+def compact_mask(mask: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Indices that move in-mask rows to the front (stable); plus count."""
+    order = jnp.argsort(~mask, stable=True)
+    return order, jnp.sum(mask.astype(jnp.int64))

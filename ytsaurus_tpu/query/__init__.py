@@ -1,0 +1,10 @@
+"""QL query engine: front end (lexer/parser/builder), typed IR, XLA engine.
+
+Re-architecture of the reference query library (yt/yt/library/query): the
+LLVM-JIT backend behind EExecutionBackend (codegen_api/execution_backend.h)
+becomes an XLA lowering over columnar planes.
+"""
+
+from ytsaurus_tpu.query.parser import parse_expression, parse_query
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.engine.evaluator import Evaluator, select_rows

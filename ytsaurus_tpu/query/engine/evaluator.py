@@ -1,0 +1,145 @@
+"""The query evaluator: plan + chunks → result chunk, with a compile cache.
+
+Analog of TEvaluator::Run (library/query/engine/evaluator.cpp:40-120): looks
+up / populates a compiled-program cache keyed by (plan fingerprint, capacity
+bucket, binding shapes) — the XLA counterpart of the reference's LLVM image
+cache keyed by llvm::FoldingSet fingerprint (engine_api/cg_cache.h) — then
+runs the program over the chunk's planes.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ytsaurus_tpu.chunks.columnar import Column, ColumnarChunk
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.query import ir
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.engine.joins import execute_join
+from ytsaurus_tpu.query.engine.lowering import prepare
+from ytsaurus_tpu.schema import EValueType, TableSchema
+
+
+class Evaluator:
+    """Caches compiled query programs and executes plans over chunks."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+    # -- plan execution --------------------------------------------------------
+
+    def run_plan(self, plan: "ir.Query | ir.FrontQuery",
+                 chunk: ColumnarChunk,
+                 foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None
+                 ) -> ColumnarChunk:
+        """Execute a plan over one input chunk (plus join tables)."""
+        if isinstance(plan, ir.Query) and plan.joins:
+            foreign_chunks = foreign_chunks or {}
+            # Materialize joins left-to-right, widening the namespace.
+            namespace = list(_initial_namespace(plan))
+            current = _project_chunk(chunk, TableSchema.make(namespace))
+            for join in plan.joins:
+                if join.foreign_table not in foreign_chunks:
+                    raise YtError(
+                        f"No data provided for join table {join.foreign_table!r}",
+                        code=EErrorCode.QueryExecutionError)
+                namespace = _extend_namespace(namespace, join)
+                current = execute_join(
+                    current, TableSchema.make(namespace), join,
+                    foreign_chunks[join.foreign_table])
+            chunk = current
+        elif isinstance(plan, ir.Query):
+            chunk = _project_chunk(chunk, plan.schema)
+
+        prepared = prepare(plan, chunk)
+        key = (ir.fingerprint(plan), chunk.capacity, prepared.binding_shapes())
+        jitted = self._cache.get(key)
+        if jitted is None:
+            jitted = jax.jit(prepared.run)
+            self._cache[key] = jitted
+        columns = {c.name: (chunk.columns[c.name].data,
+                            chunk.columns[c.name].valid)
+                   for c in plan.schema}
+        planes, count = jitted(columns, chunk.row_valid,
+                               tuple(prepared.bindings))
+        n = int(count)
+
+        out_columns: dict[str, Column] = {}
+        out_schema_cols = []
+        for out_col, (data, valid) in zip(prepared.output, planes):
+            out_schema_cols.append((out_col.name, out_col.type.value))
+            out_columns[out_col.name] = Column(
+                type=out_col.type, data=data, valid=valid,
+                dictionary=out_col.vocab)
+        out_schema = TableSchema.make(out_schema_cols)
+        return ColumnarChunk(schema=out_schema, row_count=n,
+                             columns=out_columns)
+
+
+def _initial_namespace(plan: ir.Query) -> list[tuple[str, str]]:
+    """Self-table columns = plan.schema minus columns contributed by joins."""
+    joined = set()
+    for join in plan.joins:
+        for fname in join.foreign_columns:
+            joined.add(f"{join.alias}.{fname}" if join.alias else fname)
+    return [(c.name, c.type.value) for c in plan.schema if c.name not in joined]
+
+
+def _extend_namespace(namespace: list[tuple[str, str]],
+                      join: ir.JoinClause) -> list[tuple[str, str]]:
+    out = list(namespace)
+    for fname in join.foreign_columns:
+        flat = f"{join.alias}.{fname}" if join.alias else fname
+        out.append((flat, join.foreign_schema.get(fname).type.value))
+    return out
+
+
+def _project_chunk(chunk: ColumnarChunk, schema: TableSchema) -> ColumnarChunk:
+    """View of `chunk` under `schema` (subset/reorder of columns)."""
+    columns = {}
+    for col_schema in schema:
+        col = chunk.columns.get(col_schema.name)
+        if col is None:
+            raise YtError(f"Chunk is missing column {col_schema.name!r}",
+                          code=EErrorCode.QueryExecutionError)
+        columns[col_schema.name] = col
+    return ColumnarChunk(schema=schema, row_count=chunk.row_count,
+                         columns=columns)
+
+
+# -- convenience API -----------------------------------------------------------
+
+
+_global_evaluator = Evaluator()
+
+
+def select_rows(query: str,
+                tables: Mapping[str, "ColumnarChunk | Sequence"],
+                schemas: Optional[Mapping[str, TableSchema]] = None,
+                evaluator: Optional[Evaluator] = None) -> ColumnarChunk:
+    """One-shot: parse, plan, and execute a query over in-memory tables.
+
+    `tables` maps table path → ColumnarChunk (or row list, requiring `schemas`
+    to carry that table's schema).
+    """
+    evaluator = evaluator or _global_evaluator
+    chunks: dict[str, ColumnarChunk] = {}
+    schemas = dict(schemas or {})
+    for path, data in tables.items():
+        if isinstance(data, ColumnarChunk):
+            chunks[path] = data
+            schemas.setdefault(path, data.schema)
+        else:
+            if path not in schemas:
+                raise YtError(f"Row-list table {path!r} requires a schema")
+            chunks[path] = ColumnarChunk.from_rows(schemas[path], data)
+    plan = build_query(query, schemas)
+    source_chunk = chunks[plan.source]
+    foreign = {p: c for p, c in chunks.items() if p != plan.source}
+    return evaluator.run_plan(plan, source_chunk, foreign)

@@ -1,0 +1,239 @@
+"""Plan lowering: typed IR → a staged XLA pipeline over columnar planes.
+
+The reference JIT-compiles a per-row push pipeline (scan→filter→group→order→
+project, cg_fragment_compiler.cpp).  Here each clause becomes a batch
+transformation over static-capacity planes:
+
+  filter   = predicate mask (no data movement)
+  group    = lexsort by key planes → segment boundaries → segment reductions
+  order    = lexsort by order keys → gather
+  project  = elementwise expression evaluation
+  limit    = compaction (stable sort by ~mask) + static slice
+
+`prepare()` runs per chunk on the host (binding vocabularies etc. — see
+expr.py); the returned `run` callable is pure and jit-traceable, and is cached
+by (plan fingerprint, capacity, binding shapes) in the evaluator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.ops.segments import (
+    compact_mask,
+    lexsort_indices,
+    segment_aggregate,
+    segment_boundaries,
+    sort_key_planes,
+)
+from ytsaurus_tpu.query import ir
+from ytsaurus_tpu.query.engine.expr import (
+    BindContext,
+    BoundExpr,
+    ColumnBinding,
+    EmitContext,
+    ExprBinder,
+)
+from ytsaurus_tpu.schema import EValueType, TableSchema
+
+
+@dataclass
+class OutputColumn:
+    name: str
+    type: EValueType
+    vocab: Optional[np.ndarray]
+
+
+@dataclass
+class PreparedQuery:
+    """Host-bound execution plan for one chunk shape."""
+    run: callable                  # (columns, row_valid, bindings) -> (planes, count)
+    bindings: list
+    output: list[OutputColumn]
+    capacity: int
+
+    def binding_shapes(self) -> tuple:
+        return tuple((tuple(b.shape), str(b.dtype)) for b in self.bindings)
+
+
+def _column_bindings(schema: TableSchema, chunk) -> dict[str, ColumnBinding]:
+    out = {}
+    for col_schema in schema:
+        col = chunk.columns.get(col_schema.name)
+        if col is None:
+            raise YtError(f"Chunk is missing column {col_schema.name!r}",
+                          code=EErrorCode.QueryExecutionError)
+        out[col_schema.name] = ColumnBinding(type=col_schema.type,
+                                             vocab=col.dictionary)
+    return out
+
+
+def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
+    """Bind a plan against one chunk's vocabularies/capacity."""
+    capacity = chunk.capacity
+    bind_ctx = BindContext(columns=_column_bindings(plan.schema, chunk))
+    binder = ExprBinder(bind_ctx)
+
+    where_b: Optional[BoundExpr] = None
+    if isinstance(plan, ir.Query) and plan.where is not None:
+        where_b = binder.bind(plan.where)
+
+    group = plan.group
+    group_key_b: list[tuple[str, BoundExpr]] = []
+    agg_arg_b: list[tuple[ir.AggregateItem, Optional[BoundExpr]]] = []
+    post_binder: Optional[ExprBinder] = None
+    having_b = None
+    if group is not None:
+        for item in group.group_items:
+            group_key_b.append((item.name, binder.bind(item.expr)))
+        for agg in group.aggregate_items:
+            arg = binder.bind(agg.argument) if agg.argument is not None else None
+            agg_arg_b.append((agg, arg))
+        # Post-group namespace: keys + aggregate slots.
+        post_columns: dict[str, ColumnBinding] = {}
+        for (name, bound), item in zip(group_key_b, group.group_items):
+            post_columns[name] = ColumnBinding(type=bound.type, vocab=bound.vocab)
+        for agg, arg in agg_arg_b:
+            vocab = arg.vocab if (arg is not None and
+                                  agg.type is EValueType.string) else None
+            post_columns[agg.name] = ColumnBinding(type=agg.type, vocab=vocab)
+        post_binder = ExprBinder(BindContext(columns=post_columns,
+                                             bindings=bind_ctx.bindings))
+        if plan.having is not None:
+            having_b = post_binder.bind(plan.having)
+    final_binder = post_binder if post_binder is not None else binder
+
+    order_b: list[tuple[BoundExpr, bool]] = []
+    if plan.order is not None:
+        for item in plan.order.items:
+            order_b.append((final_binder.bind(item.expr), item.descending))
+
+    project_b: list[tuple[str, BoundExpr]] = []
+    if plan.project is not None:
+        for item in plan.project.items:
+            project_b.append((item.name, final_binder.bind(item.expr)))
+    else:
+        # Identity projection over the stage's namespace.
+        if group is not None:
+            for (name, bound) in group_key_b:
+                project_b.append((name, _post_ref(name, bound)))
+            for agg, arg in agg_arg_b:
+                vocab = arg.vocab if (arg is not None and
+                                      agg.type is EValueType.string) else None
+                project_b.append((agg.name, _post_ref_t(agg.name, agg.type, vocab)))
+        else:
+            for col_schema in plan.schema:
+                project_b.append(
+                    (col_schema.name,
+                     final_binder.bind(ir.TReference(type=col_schema.type,
+                                                     name=col_schema.name))))
+
+    output = [OutputColumn(name=name, type=b.type, vocab=b.vocab)
+              for name, b in project_b]
+    offset = plan.offset
+    limit = plan.limit
+
+    def run(columns: dict, row_valid: jax.Array, bindings: tuple):
+        ctx = EmitContext(columns=columns, bindings=bindings, capacity=capacity)
+        mask = row_valid
+        if where_b is not None:
+            d, v = where_b.emit(ctx)
+            mask = mask & v & d.astype(bool)
+
+        if group is not None:
+            key_planes = [b.emit(ctx) for _, b in group_key_b]
+            # Sort: masked-out rows last, then lexicographic by keys.
+            sort_keys: list[jax.Array] = []
+            for data, valid in key_planes:
+                sort_keys.extend(sort_key_planes(data, valid))
+            sort_keys.append((~mask).astype(jnp.int8))   # major key: mask
+            order_idx = lexsort_indices(sort_keys)
+            sorted_mask = mask[order_idx]
+            sorted_keys = [(d[order_idx], v[order_idx]) for d, v in key_planes]
+            seg_ids, num_groups = segment_boundaries(sorted_keys, sorted_mask)
+            new_columns: dict[str, tuple[jax.Array, jax.Array]] = {}
+            for (name, _), (data, valid) in zip(group_key_b, sorted_keys):
+                out_d, _ = segment_aggregate("first", data, sorted_mask,
+                                             seg_ids, capacity,
+                                             EValueType.null)
+                out_v, _ = segment_aggregate(
+                    "first", valid.astype(jnp.int8), sorted_mask, seg_ids,
+                    capacity, EValueType.null)
+                new_columns[name] = (out_d, out_v.astype(bool))
+            for agg, arg in agg_arg_b:
+                if agg.function == "avg":
+                    data, valid = arg.emit(ctx)
+                    data = data[order_idx].astype(jnp.float64)
+                    valid = valid[order_idx] & sorted_mask
+                    s, sv = segment_aggregate("sum", data, valid, seg_ids,
+                                              capacity, EValueType.double)
+                    c, _ = segment_aggregate("count", data, valid, seg_ids,
+                                             capacity, EValueType.int64)
+                    cnt = jnp.maximum(c, 1)
+                    new_columns[agg.name] = (s / cnt, sv)
+                else:
+                    data, valid = arg.emit(ctx)
+                    data = data[order_idx]
+                    valid = valid[order_idx] & sorted_mask
+                    out, out_v = segment_aggregate(
+                        agg.function, data, valid, seg_ids, capacity, agg.type)
+                    new_columns[agg.name] = (out, out_v)
+            mask = jnp.arange(capacity) < num_groups
+            ctx = EmitContext(columns=new_columns, bindings=bindings,
+                              capacity=capacity)
+            if having_b is not None:
+                d, v = having_b.emit(ctx)
+                mask = mask & v & d.astype(bool)
+
+        if order_b:
+            # lexsort: last plane is most significant → first ORDER BY item
+            # must be emitted last.
+            sort_keys = []
+            for bound, descending in reversed(order_b):
+                data, valid = bound.emit(ctx)
+                sort_keys.extend(sort_key_planes(data, valid, descending))
+            sort_keys.append((~mask).astype(jnp.int8))
+            order_idx = lexsort_indices(sort_keys)
+            ctx = EmitContext(
+                columns={name: (d[order_idx], v[order_idx])
+                         for name, (d, v) in ctx.columns.items()},
+                bindings=bindings, capacity=capacity)
+            mask = mask[order_idx]
+
+        planes = []
+        for name, bound in project_b:
+            d, v = bound.emit(ctx)
+            planes.append((d, v))
+
+        # Compact valid rows to the front (stable → preserves sort order).
+        comp_idx, total = compact_mask(mask)
+        count = total - offset
+        if limit is not None:
+            count = jnp.minimum(count, limit)
+        count = jnp.maximum(count, 0)
+        out_planes = []
+        shift = jnp.clip(jnp.arange(capacity) + offset, 0, capacity - 1)
+        for d, v in planes:
+            d = d[comp_idx][shift]
+            v = v[comp_idx][shift] & (jnp.arange(capacity) < count)
+            out_planes.append((d, v))
+        return out_planes, count
+
+    return PreparedQuery(run=run, bindings=bind_ctx.bindings, output=output,
+                         capacity=capacity)
+
+
+def _post_ref(name: str, bound: BoundExpr) -> BoundExpr:
+    return _post_ref_t(name, bound.type, bound.vocab)
+
+
+def _post_ref_t(name: str, ty: EValueType, vocab) -> BoundExpr:
+    def emit(ctx: EmitContext):
+        return ctx.columns[name]
+    return BoundExpr(type=ty, vocab=vocab, emit=emit)

@@ -1,0 +1,273 @@
+"""Typed query plan IR.
+
+Mirrors the reference plan IR (library/query/base/query.h: TExpression tree,
+TGroupClause/TJoinClause/TOrderClause/TProjectClause, TQuery with the
+bottom/front split) as immutable typed dataclasses.  CASE is desugared to
+nested IF and LIKE to vocabulary-level predicates during building, so the IR
+the lowering consumes stays small.
+
+Every node is hashable; `fingerprint(query)` produces the stable key for the
+compiled-executable cache — the analog of the reference's llvm::FoldingSet
+fingerprint (library/query/engine/folding_profiler.cpp).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ytsaurus_tpu.schema import EValueType, TableSchema
+
+
+class TExpr:
+    """Base of typed expressions; every node carries its result type."""
+    type: EValueType
+
+
+@dataclass(frozen=True)
+class TLiteral(TExpr):
+    type: EValueType
+    value: object            # python scalar; bytes for strings; None for null
+
+
+@dataclass(frozen=True)
+class TReference(TExpr):
+    type: EValueType
+    name: str                # resolved name in the stage's row namespace
+
+
+@dataclass(frozen=True)
+class TFunction(TExpr):
+    type: EValueType
+    name: str
+    args: tuple[TExpr, ...]
+
+
+@dataclass(frozen=True)
+class TUnary(TExpr):
+    type: EValueType
+    op: str
+    operand: TExpr
+
+
+@dataclass(frozen=True)
+class TBinary(TExpr):
+    type: EValueType
+    op: str
+    lhs: TExpr
+    rhs: TExpr
+
+
+@dataclass(frozen=True)
+class TIn(TExpr):
+    type: EValueType         # boolean
+    operands: tuple[TExpr, ...]
+    values: tuple[tuple, ...]
+
+
+@dataclass(frozen=True)
+class TBetween(TExpr):
+    type: EValueType         # boolean
+    operands: tuple[TExpr, ...]
+    ranges: tuple[tuple, ...]
+    negated: bool
+
+
+@dataclass(frozen=True)
+class TTransform(TExpr):
+    type: EValueType
+    operands: tuple[TExpr, ...]
+    from_values: tuple[tuple, ...]
+    to_values: tuple[object, ...]
+    default: Optional[TExpr]
+
+
+@dataclass(frozen=True)
+class TStringPredicate(TExpr):
+    """Vocabulary-level string predicate (LIKE / prefix / substring / regex).
+
+    Evaluated host-side against the chunk dictionary, then gathered on device.
+    `kind` in {like, prefix, substr, regex}; pattern is a bytes literal.
+    """
+    type: EValueType         # boolean
+    operand: TExpr           # string-typed expr
+    kind: str
+    pattern: bytes
+    case_insensitive: bool = False
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class NamedExpr:
+    name: str
+    expr: TExpr
+
+
+@dataclass(frozen=True)
+class AggregateItem:
+    """One aggregate: `name` is its slot in the post-group namespace."""
+    name: str
+    function: str            # sum | min | max | avg | count | first | argmin...
+    argument: Optional[TExpr]
+    type: EValueType         # result type
+    state_type: EValueType   # partial-state type (avg keeps (sum,count))
+
+
+@dataclass(frozen=True)
+class GroupClause:
+    group_items: tuple[NamedExpr, ...]
+    aggregate_items: tuple[AggregateItem, ...]
+    totals: bool = False
+
+
+@dataclass(frozen=True)
+class OrderItem:
+    expr: TExpr
+    descending: bool
+
+
+@dataclass(frozen=True)
+class OrderClause:
+    items: tuple[OrderItem, ...]
+
+
+@dataclass(frozen=True)
+class ProjectClause:
+    items: tuple[NamedExpr, ...]
+
+
+@dataclass(frozen=True)
+class JoinClause:
+    foreign_table: str
+    foreign_schema: TableSchema
+    alias: Optional[str]
+    self_equations: tuple[TExpr, ...]      # evaluated in self namespace
+    foreign_equations: tuple[TExpr, ...]   # evaluated in foreign namespace
+    foreign_columns: tuple[str, ...]       # columns pulled from foreign table
+    is_left: bool
+
+
+@dataclass(frozen=True)
+class Query:
+    """A single-stage query plan (ref TQuery, base/query.h:532).
+
+    Namespaces: `schema` names the input row namespace.  If `group` is set,
+    having/order/project run in the post-group namespace (group item names +
+    aggregate names); otherwise they run in the input namespace.
+    """
+    schema: TableSchema                    # input namespace (incl. join columns)
+    source: Optional[str] = None           # table path (None = provided rowset)
+    joins: tuple[JoinClause, ...] = ()
+    where: Optional[TExpr] = None
+    group: Optional[GroupClause] = None
+    having: Optional[TExpr] = None
+    order: Optional[OrderClause] = None
+    project: Optional[ProjectClause] = None
+    offset: int = 0
+    limit: Optional[int] = None
+
+    @property
+    def is_ordered_scan(self) -> bool:
+        return self.order is None and self.limit is not None
+
+    def post_group_schema(self) -> TableSchema:
+        assert self.group is not None
+        cols = [(item.name, item.expr.type.value) for item in self.group.group_items]
+        cols += [(agg.name, agg.type.value) for agg in self.group.aggregate_items]
+        return TableSchema.make(cols)
+
+    def output_schema(self) -> TableSchema:
+        if self.project is not None:
+            return TableSchema.make(
+                [(item.name, item.expr.type.value) for item in self.project.items])
+        if self.group is not None:
+            return self.post_group_schema()
+        return self.schema.to_unsorted()
+
+
+@dataclass(frozen=True)
+class FrontQuery:
+    """Coordinator-side merge query (ref TFrontQuery, base/query.h:559).
+
+    Runs over the concatenation of bottom-query outputs: re-groups partial
+    aggregate states, re-applies having/order/project/offset/limit.
+    """
+    schema: TableSchema                    # = bottom intermediate schema
+    group: Optional[GroupClause] = None    # merge-combine aggregates
+    having: Optional[TExpr] = None
+    order: Optional[OrderClause] = None
+    project: Optional[ProjectClause] = None
+    offset: int = 0
+    limit: Optional[int] = None
+
+    def output_schema(self) -> TableSchema:
+        if self.project is not None:
+            return TableSchema.make(
+                [(item.name, item.expr.type.value) for item in self.project.items])
+        if self.group is not None:
+            cols = [(i.name, i.expr.type.value) for i in self.group.group_items]
+            cols += [(a.name, a.type.value) for a in self.group.aggregate_items]
+            return TableSchema.make(cols)
+        return self.schema
+
+
+# --- fingerprinting -----------------------------------------------------------
+
+
+def _repr_expr(e) -> str:
+    # Deterministic structural serialization; literal VALUES are included
+    # (unlike InferName(omitValues) — capacity bucketing handles shape reuse,
+    # literals change generated code here because they bind vocab lookups).
+    if isinstance(e, TLiteral):
+        return f"L({e.type.value},{e.value!r})"
+    if isinstance(e, TReference):
+        return f"R({e.name})"
+    if isinstance(e, TFunction):
+        return f"F({e.name};{','.join(map(_repr_expr, e.args))})"
+    if isinstance(e, TUnary):
+        return f"U({e.op};{_repr_expr(e.operand)})"
+    if isinstance(e, TBinary):
+        return f"B({e.op};{_repr_expr(e.lhs)};{_repr_expr(e.rhs)})"
+    if isinstance(e, TIn):
+        return f"I({','.join(map(_repr_expr, e.operands))};{e.values!r})"
+    if isinstance(e, TBetween):
+        return f"W({','.join(map(_repr_expr, e.operands))};{e.ranges!r};{e.negated})"
+    if isinstance(e, TTransform):
+        return (f"T({','.join(map(_repr_expr, e.operands))};{e.from_values!r};"
+                f"{e.to_values!r};{_repr_expr(e.default) if e.default else ''})")
+    if isinstance(e, TStringPredicate):
+        return (f"S({e.kind};{_repr_expr(e.operand)};{e.pattern!r};"
+                f"{e.case_insensitive};{e.negated})")
+    if e is None:
+        return "-"
+    raise TypeError(f"Unknown expr node {type(e).__name__}")
+
+
+def fingerprint(query: "Query | FrontQuery") -> str:
+    parts: list[str] = [type(query).__name__]
+    parts.append(",".join(f"{c.name}:{c.type.value}" for c in query.schema))
+    if isinstance(query, Query):
+        parts.append(str(query.source))
+        for j in query.joins:
+            parts.append(
+                f"J({j.foreign_table};{j.alias};{j.is_left};"
+                f"{','.join(map(_repr_expr, j.self_equations))};"
+                f"{','.join(map(_repr_expr, j.foreign_equations))};"
+                f"{','.join(j.foreign_columns)})")
+        parts.append(_repr_expr(query.where))
+    if query.group:
+        parts.append("G(" + ";".join(
+            f"{i.name}={_repr_expr(i.expr)}" for i in query.group.group_items) + ")")
+        parts.append("A(" + ";".join(
+            f"{a.name}={a.function}({_repr_expr(a.argument) if a.argument else ''})"
+            for a in query.group.aggregate_items) + f";{query.group.totals})")
+    parts.append(_repr_expr(query.having))
+    if query.order:
+        parts.append("O(" + ";".join(
+            f"{_repr_expr(i.expr)}:{i.descending}" for i in query.order.items) + ")")
+    if query.project:
+        parts.append("P(" + ";".join(
+            f"{i.name}={_repr_expr(i.expr)}" for i in query.project.items) + ")")
+    parts.append(f"{query.offset}/{query.limit}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:24]
