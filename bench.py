@@ -1,0 +1,74 @@
+"""Benchmark: TPC-H Q1 rows/sec on the query engine (BASELINE.md config 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's LLVM-JIT evaluator on a modern x86 core sustains
+roughly 5e7 rows/s on Q1-shaped scan+filter+group (order-of-magnitude from
+vectorized-engine literature; the reference repo publishes no absolute
+numbers — see BASELINE.md).  vs_baseline = ours / 5e7.
+
+Usage: python bench.py [--smoke] [--rows N] [--iters K]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+BASELINE_ROWS_PER_SEC = 5.0e7
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="small row count, CPU-friendly")
+    parser.add_argument("--rows", type=int, default=None)
+    parser.add_argument("--iters", type=int, default=5)
+    args = parser.parse_args()
+
+    import jax
+
+    from ytsaurus_tpu.models import tpch
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.engine.lowering import prepare
+
+    n_rows = args.rows or (100_000 if args.smoke else 64_000_000)
+    chunk = tpch.generate_lineitem(n_rows)
+    plan = build_query(tpch.Q1, {"//tpch/lineitem": tpch.LINEITEM_SCHEMA})
+    prepared = prepare(plan, chunk)
+    columns = {c.name: (chunk.columns[c.name].data,
+                        chunk.columns[c.name].valid)
+               for c in plan.schema}
+    bindings = tuple(prepared.bindings)
+    row_valid = chunk.row_valid
+    jax.block_until_ready(row_valid)
+    fn = jax.jit(prepared.run)
+
+    # Warm-up / compile.
+    planes, count = fn(columns, row_valid, bindings)
+    jax.block_until_ready(planes)
+    n_groups = int(count)
+    assert 1 <= n_groups <= 6, f"Q1 produced {n_groups} groups"
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        planes, count = fn(columns, row_valid, bindings)
+        jax.block_until_ready(planes)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rows_per_sec = n_rows / best
+
+    print(json.dumps({
+        "metric": "tpch_q1_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+    }))
+    print(f"# n_rows={n_rows} best={best*1e3:.2f}ms groups={n_groups} "
+          f"device={jax.devices()[0].platform}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Distributed coordination tests: bottom/front split over multiple shards.
+
+Ref behavior model: ytlib/query_client/executor.cpp (fan-out + front merge)
+and library/query/unittests/ql_distributed_ut.cpp.
+"""
+
+import pytest
+
+from ytsaurus_tpu.chunks import ColumnarChunk
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.coordinator import coordinate_and_execute, split_plan
+from ytsaurus_tpu.query.engine.evaluator import Evaluator
+from ytsaurus_tpu.schema import TableSchema
+
+SCHEMA = TableSchema.make([
+    ("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")])
+T = "//t"
+
+
+def _shards(rows_per_shard):
+    return [ColumnarChunk.from_rows(SCHEMA, rows) for rows in rows_per_shard]
+
+
+def _run(query, shards, expected=None, ordered=False):
+    plan = build_query(query, {T: SCHEMA})
+    out = coordinate_and_execute(plan, shards, evaluator=Evaluator())
+    rows = out.to_rows()
+    if expected is not None:
+        key = (lambda r: tuple(
+            (v is None, v) for v in r.values()))
+        if ordered:
+            assert rows == expected, f"{rows} != {expected}"
+        else:
+            assert sorted(rows, key=key) == sorted(expected, key=key), \
+                f"{rows} != {expected}"
+    return rows
+
+
+SHARDS = _shards([
+    [(0, 0, 1), (1, 1, 2), (2, 0, 3)],
+    [(3, 1, 4), (4, 0, 5)],
+    [(5, 2, 6)],
+])
+
+
+def test_distributed_filter_project():
+    _run(f"k, v FROM [{T}] WHERE v >= 3", SHARDS,
+         [{"k": 2, "v": 3}, {"k": 3, "v": 4}, {"k": 4, "v": 5},
+          {"k": 5, "v": 6}])
+
+
+def test_distributed_group_by_sum_count():
+    _run(f"g, sum(v) AS s, count(*) AS c FROM [{T}] GROUP BY g", SHARDS,
+         [{"g": 0, "s": 9, "c": 3}, {"g": 1, "s": 6, "c": 2},
+          {"g": 2, "s": 6, "c": 1}])
+
+
+def test_distributed_avg_is_exact():
+    # avg must merge via (sum, count) states, not averaging shard averages:
+    # g=0 values 1,3 on shard A and 5 on shard B → avg 3.0 (naive merge of
+    # shard avgs would give (2.0 + 5.0)/2 = 3.5).
+    _run(f"g, avg(v) AS a FROM [{T}] GROUP BY g", SHARDS,
+         [{"g": 0, "a": 3.0}, {"g": 1, "a": 3.0}, {"g": 2, "a": 6.0}])
+
+
+def test_distributed_min_max_first_merge():
+    _run(f"g, min(v) AS lo, max(v) AS hi FROM [{T}] GROUP BY g", SHARDS,
+         [{"g": 0, "lo": 1, "hi": 5}, {"g": 1, "lo": 2, "hi": 4},
+          {"g": 2, "lo": 6, "hi": 6}])
+
+
+def test_distributed_having_applies_at_front():
+    # HAVING must see MERGED aggregates (g=0 total 9 > 8, but no single
+    # shard's partial sum exceeds 8 except none → naive per-shard having
+    # would drop g=0).
+    _run(f"g, sum(v) AS s FROM [{T}] GROUP BY g HAVING sum(v) > 8", SHARDS,
+         [{"g": 0, "s": 9}])
+
+
+def test_distributed_order_by_limit():
+    _run(f"k FROM [{T}] ORDER BY v DESC LIMIT 3", SHARDS,
+         [{"k": 5}, {"k": 4}, {"k": 3}], ordered=True)
+
+
+def test_distributed_offset_limit():
+    _run(f"k FROM [{T}] ORDER BY k OFFSET 2 LIMIT 2", SHARDS,
+         [{"k": 2}, {"k": 3}], ordered=True)
+
+
+def test_distributed_avg_in_having_and_order():
+    _run(f"g, avg(v) AS a FROM [{T}] GROUP BY g HAVING avg(v) > 2.5 "
+         f"ORDER BY avg(v) DESC, g LIMIT 10", SHARDS,
+         [{"g": 2, "a": 6.0}, {"g": 0, "a": 3.0}, {"g": 1, "a": 3.0}],
+         ordered=True)
+
+
+def test_distributed_join():
+    dim_schema = TableSchema.make([("g", "int64", "ascending"),
+                                   ("name", "string")])
+    dim = ColumnarChunk.from_rows(dim_schema, [(0, "zero"), (1, "one"),
+                                               (2, "two")])
+    plan = build_query(
+        f"name, sum(v) AS s FROM [{T}] JOIN [//dim] USING g GROUP BY name",
+        {T: SCHEMA, "//dim": dim_schema})
+    out = coordinate_and_execute(plan, SHARDS, {"//dim": dim},
+                                 evaluator=Evaluator())
+    rows = sorted(out.to_rows(), key=lambda r: r["name"])
+    assert rows == [{"name": b"one", "s": 6}, {"name": b"two", "s": 6},
+                    {"name": b"zero", "s": 9}]
+
+
+def test_split_plan_shapes():
+    plan = build_query(
+        f"g, avg(v) AS a FROM [{T}] GROUP BY g HAVING avg(v) > 0", {T: SCHEMA})
+    bottom, front = split_plan(plan)
+    # Bottom: no having/order/project, avg decomposed into sum+count states.
+    assert bottom.having is None and bottom.project is None
+    agg_names = [a.name for a in bottom.group.aggregate_items]
+    assert [n.endswith("__s") or n.endswith("__c") for n in agg_names] == \
+        [True, True]
+    # Front merges states and re-applies having.
+    assert front.having is not None
+    assert [a.function for a in front.group.aggregate_items] == ["sum", "sum"]
+
+
+def test_string_group_keys_across_shards():
+    schema = TableSchema.make([("k", "int64", "ascending"), ("s", "string")])
+    shards = [
+        ColumnarChunk.from_rows(schema, [(1, "x"), (2, "y")]),
+        ColumnarChunk.from_rows(schema, [(3, "y"), (4, "z")]),
+    ]
+    plan = build_query(f"s, count(*) AS c FROM [{T}] GROUP BY s",
+                       {T: schema})
+    out = coordinate_and_execute(plan, shards, evaluator=Evaluator())
+    rows = sorted(out.to_rows(), key=lambda r: r["s"])
+    assert rows == [{"s": b"x", "c": 1}, {"s": b"y", "c": 2},
+                    {"s": b"z", "c": 1}]

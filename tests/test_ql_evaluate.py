@@ -401,3 +401,35 @@ def test_multi_key_order_by():
                   [(k, a, b) for k, a, b in rows])},
              [{"a": 1, "b": 20}, {"a": 1, "b": 5}, {"a": 2, "b": 10},
               {"a": 2, "b": 1}], ordered=True)
+
+
+def test_fast_group_order_by_with_literal_projection():
+    # Fast-group path + ORDER BY + literal in projection (regression: stage
+    # capacity mismatch after ordering).
+    rows = [(1, "a", 2.0), (2, "b", 3.0), (3, "a", 5.0)]
+    evaluate("s, sum(v) * 2 AS d FROM [//t] GROUP BY s ORDER BY s LIMIT 5",
+             {T: ([("k", "int64", "ascending"), ("s", "string"),
+                   ("v", "double")], rows)},
+             [{"s": "a", "d": 14.0}, {"s": "b", "d": 6.0}], ordered=True)
+
+
+def test_fast_group_cache_not_reused_across_vocab_shapes():
+    # Two chunks, same plan + capacity, vocab sizes (1,2) vs (2,1): dims match
+    # so the compile cache must key on per-key sizes (regression).
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.schema import TableSchema
+    schema = TableSchema.make([("a", "string"), ("b", "string"),
+                               ("v", "int64")])
+    c1 = ColumnarChunk.from_rows(schema, [("x", "p", 1), ("x", "q", 2)])
+    c2 = ColumnarChunk.from_rows(schema, [("y", "m", 5), ("z", "m", 7)])
+    plan = build_query("a, b, sum(v) AS s FROM [//t] GROUP BY a, b",
+                       {T: schema})
+    ev = Evaluator()
+    r1 = ev.run_plan(plan, c1).to_rows()
+    r2 = ev.run_plan(plan, c2).to_rows()
+    assert sorted((r["a"], r["b"], r["s"]) for r in r1) == \
+        [(b"x", b"p", 1), (b"x", b"q", 2)]
+    assert sorted((r["a"], r["b"], r["s"]) for r in r2) == \
+        [(b"y", b"m", 5), (b"z", b"m", 7)]

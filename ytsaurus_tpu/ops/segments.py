@@ -69,35 +69,74 @@ def segment_boundaries(sorted_keys: list[tuple[jax.Array, jax.Array]],
     return seg, num_segments
 
 
+# Below this many segments, scatter-based segment ops are replaced by masked
+# broadcast-reductions: XLA fuses the (S, N) compare+select into the reduce
+# (bandwidth-bound VPU work), while TPU scatter-adds serialize badly
+# (~130 ms per 2M-row f64 plane measured on v5e vs ~1 ms for the fused form).
+_DENSE_SEGMENT_LIMIT = 256
+
+
+def _dense_segment_reduce(function: str, data: jax.Array, seg_ids: jax.Array,
+                          num_segments: int):
+    sids = jnp.arange(num_segments, dtype=seg_ids.dtype)
+
+    if function == "sum":
+        def one(s):
+            return jnp.where(seg_ids == s, data, jnp.zeros_like(data)).sum()
+    elif function == "min":
+        neutral = _reduce_neutral(data.dtype, "min")
+        def one(s):
+            return jnp.where(seg_ids == s, data, neutral).min()
+    elif function == "max":
+        neutral = _reduce_neutral(data.dtype, "max")
+        def one(s):
+            return jnp.where(seg_ids == s, data, neutral).max()
+    else:
+        raise ValueError(function)
+    return jax.vmap(one)(sids)
+
+
+def _segment_reduce(function: str, data: jax.Array, seg_ids: jax.Array,
+                    num_segments: int):
+    if num_segments <= _DENSE_SEGMENT_LIMIT:
+        return _dense_segment_reduce(function, data, seg_ids, num_segments)
+    if function == "sum":
+        return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+    if function == "min":
+        return jax.ops.segment_min(data, seg_ids, num_segments=num_segments)
+    if function == "max":
+        return jax.ops.segment_max(data, seg_ids, num_segments=num_segments)
+    raise ValueError(function)
+
+
 def segment_aggregate(function: str, data: jax.Array, valid: jax.Array,
                       seg_ids: jax.Array, num_segments: int,
                       value_type: EValueType) -> tuple[jax.Array, jax.Array]:
     """Aggregate `data` per segment, skipping nulls. Returns (out, out_valid)
     planes of length num_segments (static capacity)."""
     contributes = valid
-    count = jax.ops.segment_sum(contributes.astype(jnp.int64), seg_ids,
-                                num_segments=num_segments)
+    count = _segment_reduce(
+        "sum", contributes.astype(jnp.int64), seg_ids, num_segments)
     any_valid = count > 0
     if function == "count":
         return count, jnp.ones_like(any_valid)
     if function == "sum":
         masked = jnp.where(contributes, data, jnp.zeros_like(data))
-        out = jax.ops.segment_sum(masked, seg_ids, num_segments=num_segments)
+        out = _segment_reduce("sum", masked, seg_ids, num_segments)
         return out, any_valid
     if function == "min" or function == "max":
         if data.dtype == jnp.bool_:
             data = data.astype(jnp.int8)
         neutral = _reduce_neutral(data.dtype, function)
         masked = jnp.where(contributes, data, neutral)
-        op = jax.ops.segment_min if function == "min" else jax.ops.segment_max
-        out = op(masked, seg_ids, num_segments=num_segments)
+        out = _segment_reduce(function, masked, seg_ids, num_segments)
         if value_type is EValueType.boolean:
             out = out.astype(jnp.bool_)
         return out, any_valid
     if function == "first":
         cap = data.shape[0]
         idx = jnp.where(contributes, jnp.arange(cap), cap - 1)
-        first_idx = jax.ops.segment_min(idx, seg_ids, num_segments=num_segments)
+        first_idx = _segment_reduce("min", idx, seg_ids, num_segments)
         first_idx = jnp.clip(first_idx, 0, cap - 1)
         return data[first_idx], any_valid
     raise ValueError(f"Unknown segment aggregate {function!r}")

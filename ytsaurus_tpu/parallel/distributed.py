@@ -1,0 +1,193 @@
+"""SPMD distributed query execution over a device mesh.
+
+The host-coordinated path (query/coordinator.py) loops over shards; this
+module is the TPU-native fast path: every shard (tablet analog) lives on its
+own device, the bottom query runs as ONE shard_map program, and the front
+merge happens on-device via all_gather over ICI — no host round-trip, no bus.
+
+Ref mapping (SURVEY.md §2.8 parallelism table):
+  partition-parallel scan  → shard_map over the 'shard' mesh axis
+  two-phase aggregation    → per-shard partial states + all_gather + re-group
+  (psum applies when group keys are static; the general re-group handles
+  arbitrary key sets)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ytsaurus_tpu.chunks.columnar import (
+    Column,
+    ColumnarChunk,
+    pad_capacity,
+    unify_dictionaries,
+)
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.parallel.mesh import SHARD_AXIS
+from ytsaurus_tpu.query import ir
+from ytsaurus_tpu.query.coordinator import split_plan
+from ytsaurus_tpu.query.engine.lowering import prepare
+from ytsaurus_tpu.schema import EValueType, TableSchema
+
+
+@dataclass
+class _RepColumn:
+    """Vocabulary/type carrier used to bind plans without device planes."""
+    type: EValueType
+    dictionary: Optional[np.ndarray]
+
+
+@dataclass
+class _RepChunk:
+    capacity: int
+    columns: dict
+
+
+class ShardedTable:
+    """A table partitioned across a device mesh.
+
+    All shards share one schema, one per-shard capacity and ONE unified
+    string vocabulary per column (so dictionary codes agree across devices —
+    the HBM-staging analog of the reference's in_memory_manager keeping
+    chunks resident in a common format, tablet_node/in_memory_manager.h).
+
+    Planes are global arrays of shape (n_shards * capacity,) sharded along
+    the mesh axis; each device holds its (capacity,) slice.
+    """
+
+    def __init__(self, schema: TableSchema, mesh: Mesh, capacity: int,
+                 columns: dict[str, Column], row_counts: list[int],
+                 row_valid: jax.Array):
+        self.schema = schema
+        self.mesh = mesh
+        self.capacity = capacity            # per shard
+        self.columns = columns              # global sharded planes
+        self.row_counts = row_counts
+        self.row_valid = row_valid
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.row_counts)
+
+    @property
+    def total_rows(self) -> int:
+        return sum(self.row_counts)
+
+    @staticmethod
+    def from_chunks(mesh: Mesh, chunks: Sequence[ColumnarChunk]
+                    ) -> "ShardedTable":
+        n = mesh.devices.size
+        if len(chunks) != n:
+            raise YtError(f"Need exactly {n} shards for this mesh, "
+                          f"got {len(chunks)}",
+                          code=EErrorCode.QueryExecutionError)
+        schema = chunks[0].schema
+        for c in chunks[1:]:
+            if c.schema != schema:
+                raise YtError("Shard schema mismatch",
+                              code=EErrorCode.QueryExecutionError)
+        cap = max(c.capacity for c in chunks)
+        chunks = [c.with_capacity(cap) for c in chunks]
+        shard_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        columns: dict[str, Column] = {}
+        for col_schema in schema:
+            cols = [c.column(col_schema.name) for c in chunks]
+            vocab = None
+            if col_schema.type is EValueType.string:
+                cols, vocab = unify_dictionaries(cols)
+            data = jnp.concatenate([col.data for col in cols])
+            valid = jnp.concatenate([col.valid for col in cols])
+            data = jax.device_put(data, shard_sharding)
+            valid = jax.device_put(valid, shard_sharding)
+            columns[col_schema.name] = Column(
+                type=col_schema.type, data=data, valid=valid, dictionary=vocab)
+        row_valid = jnp.concatenate(
+            [jnp.arange(cap) < c.row_count for c in chunks])
+        row_valid = jax.device_put(row_valid, shard_sharding)
+        return ShardedTable(schema=schema, mesh=mesh, capacity=cap,
+                            columns=columns,
+                            row_counts=[c.row_count for c in chunks],
+                            row_valid=row_valid)
+
+    def rep_chunk(self) -> _RepChunk:
+        return _RepChunk(
+            capacity=self.capacity,
+            columns={name: _RepColumn(type=col.type, dictionary=col.dictionary)
+                     for name, col in self.columns.items()})
+
+
+class DistributedEvaluator:
+    """Compiles and caches SPMD (bottom ∘ all_gather ∘ front) programs."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._cache: dict = {}
+
+    def run(self, plan: ir.Query, table: ShardedTable) -> ColumnarChunk:
+        if plan.joins:
+            raise YtError(
+                "SPMD path does not execute joins yet; use "
+                "coordinate_and_execute (host-coordinated) for joined plans",
+                code=EErrorCode.QueryUnsupported)
+        n = table.n_shards
+        cap = table.capacity
+        bottom, front = split_plan(plan)
+
+        prepared_b = prepare(bottom, table.rep_chunk())
+        inter_rep = _RepChunk(
+            capacity=n * prepared_b.out_capacity,
+            columns={c.name: _RepColumn(type=c.type, dictionary=c.vocab)
+                     for c in prepared_b.output})
+        prepared_f = prepare(front, inter_rep)
+
+        key = (ir.fingerprint(bottom), ir.fingerprint(front), n, cap,
+               prepared_b.binding_shapes(), prepared_f.binding_shapes())
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(prepared_b, prepared_f, cap)
+            self._cache[key] = fn
+        columns = {c.name: (table.columns[c.name].data,
+                            table.columns[c.name].valid)
+                   for c in bottom.schema}
+        out_planes, out_count = fn(columns, table.row_valid,
+                                   tuple(prepared_b.bindings),
+                                   tuple(prepared_f.bindings))
+        out_columns: dict[str, Column] = {}
+        out_schema_cols = []
+        for out_col, (data, valid) in zip(prepared_f.output, out_planes):
+            out_schema_cols.append((out_col.name, out_col.type.value))
+            out_columns[out_col.name] = Column(
+                type=out_col.type, data=data, valid=valid,
+                dictionary=out_col.vocab)
+        return ColumnarChunk(schema=TableSchema.make(out_schema_cols),
+                             row_count=int(out_count), columns=out_columns)
+
+    def _build(self, prepared_b, prepared_f, cap: int):
+        mesh = self.mesh
+
+        def spmd(columns, row_valid, b_bindings, f_bindings):
+            planes, count = prepared_b.run(columns, row_valid, b_bindings)
+            shard_mask = jnp.arange(prepared_b.out_capacity) < count
+            gathered = {}
+            for out_col, (d, v) in zip(prepared_b.output, planes):
+                gd = jax.lax.all_gather(d, SHARD_AXIS).reshape(-1)
+                gv = jax.lax.all_gather(v, SHARD_AXIS).reshape(-1)
+                gathered[out_col.name] = (gd, gv)
+            g_mask = jax.lax.all_gather(shard_mask, SHARD_AXIS).reshape(-1)
+            return prepared_f.run(gathered, g_mask, f_bindings)
+
+        # check_vma=False: outputs ARE replicated (every device computes the
+        # same front merge over the all_gathered states), but the checker
+        # can't infer that through the gather+sort pipeline.
+        mapped = shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+            out_specs=P(), check_vma=False)
+        return jax.jit(mapped)

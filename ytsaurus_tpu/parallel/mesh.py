@@ -1,0 +1,40 @@
+"""Device mesh helpers: the framework's "cluster topology".
+
+Where the reference moves rowsets over a TCP bus between tablet nodes
+(core/bus/tcp), this framework places table shards on a jax device mesh and
+moves data with XLA collectives over ICI (psum / all_gather / all_to_all);
+DCN handles cross-slice when meshes span hosts.  SURVEY.md §5 "Distributed
+communication backend" describes the mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None,
+              axis: str = SHARD_AXIS) -> Mesh:
+    """A 1-D mesh over table shards (tablet analog)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"Need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices).reshape(len(devices)), (axis,))
+
+
+def shard_spec(mesh: Mesh, axis: str = SHARD_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,194 @@
+"""Distributed query coordination: bottom/front plan split + execution.
+
+Analog of the reference's coordinator algebra (library/query/engine_api/
+coordinator.h: GetDistributedQueryPattern, CoordinateAndExecute): a plan is
+split into a `bottom` query that runs unchanged on every shard (tablet) and a
+`front` query that merges the partial results — partial aggregate states are
+re-aggregated with merge functions (count merges by SUM, avg decomposes into
+sum+count state columns), ORDER BY re-sorts the per-shard top-K, and
+offset/limit apply only at the front.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Mapping, Optional, Sequence
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.query import ir
+from ytsaurus_tpu.query.engine.evaluator import Evaluator
+from ytsaurus_tpu.schema import EValueType
+
+# How each aggregate's partial state is merged at the front.
+_MERGE_FN = {"sum": "sum", "count": "sum", "min": "min", "max": "max",
+             "first": "first"}
+
+
+def split_plan(plan: ir.Query) -> tuple[ir.Query, ir.FrontQuery]:
+    """Split into (bottom, front) — ref GetDistributedQueryPattern."""
+    limit_for_bottom = None
+    if plan.limit is not None:
+        limit_for_bottom = plan.offset + plan.limit
+
+    if plan.group is not None:
+        bottom_aggs: list[ir.AggregateItem] = []
+        avg_map: dict[str, tuple[str, str]] = {}
+        for agg in plan.group.aggregate_items:
+            if agg.function == "avg":
+                s_name, c_name = f"{agg.name}__s", f"{agg.name}__c"
+                arg = agg.argument
+                bottom_aggs.append(ir.AggregateItem(
+                    name=s_name, function="sum",
+                    argument=_to_double(arg), type=EValueType.double,
+                    state_type=EValueType.double))
+                bottom_aggs.append(ir.AggregateItem(
+                    name=c_name, function="count", argument=arg,
+                    type=EValueType.int64, state_type=EValueType.int64))
+                avg_map[agg.name] = (s_name, c_name)
+            else:
+                bottom_aggs.append(agg)
+        bottom = replace(plan, group=ir.GroupClause(
+            group_items=plan.group.group_items,
+            aggregate_items=tuple(bottom_aggs), totals=False),
+            having=None, order=None, project=None, offset=0, limit=None)
+        inter_schema = bottom.output_schema()
+
+        front_group_items = tuple(
+            ir.NamedExpr(name=item.name,
+                         expr=ir.TReference(type=item.expr.type, name=item.name))
+            for item in plan.group.group_items)
+        front_aggs = tuple(
+            ir.AggregateItem(
+                name=agg.name, function=_MERGE_FN[agg.function],
+                argument=ir.TReference(type=agg.state_type, name=agg.name),
+                type=agg.type, state_type=agg.state_type)
+            for agg in bottom_aggs)
+
+        subst = _AvgSubstituter(avg_map)
+        front = ir.FrontQuery(
+            schema=inter_schema,
+            group=ir.GroupClause(group_items=front_group_items,
+                                 aggregate_items=front_aggs,
+                                 totals=plan.group.totals),
+            having=subst(plan.having),
+            order=_subst_order(plan.order, subst),
+            project=_subst_project(plan.project, subst,
+                                   plan) if plan.project else _default_project(plan, subst),
+            offset=plan.offset, limit=plan.limit)
+        return bottom, front
+
+    if plan.order is not None:
+        # Bottom keeps the full row set (identity projection) but can cut to
+        # the per-shard top-(offset+limit); the front re-sorts and projects.
+        bottom = replace(plan, having=None, project=None, offset=0,
+                         limit=limit_for_bottom)
+        front = ir.FrontQuery(
+            schema=plan.schema, order=plan.order, project=plan.project,
+            offset=plan.offset, limit=plan.limit)
+        return bottom, front
+
+    bottom = replace(plan, offset=0, limit=limit_for_bottom)
+    front = ir.FrontQuery(schema=bottom.output_schema(), offset=plan.offset,
+                          limit=plan.limit)
+    return bottom, front
+
+
+def _to_double(expr: ir.TExpr) -> ir.TExpr:
+    if expr.type is EValueType.double:
+        return expr
+    return ir.TFunction(type=EValueType.double, name="double", args=(expr,))
+
+
+class _AvgSubstituter:
+    """Rewrites references to an avg slot into state_sum / state_count."""
+
+    def __init__(self, avg_map: dict[str, tuple[str, str]]):
+        self.avg_map = avg_map
+
+    def __call__(self, expr: Optional[ir.TExpr]) -> Optional[ir.TExpr]:
+        if expr is None or not self.avg_map:
+            return expr
+        return self._walk(expr)
+
+    def _walk(self, e: ir.TExpr) -> ir.TExpr:
+        if isinstance(e, ir.TReference) and e.name in self.avg_map:
+            s_name, c_name = self.avg_map[e.name]
+            s_ref = ir.TReference(type=EValueType.double, name=s_name)
+            c_ref = ir.TReference(type=EValueType.int64, name=c_name)
+            return ir.TBinary(type=EValueType.double, op="/", lhs=s_ref,
+                              rhs=_to_double(c_ref))
+        if isinstance(e, ir.TFunction):
+            return replace(e, args=tuple(self._walk(a) for a in e.args))
+        if isinstance(e, ir.TUnary):
+            return replace(e, operand=self._walk(e.operand))
+        if isinstance(e, ir.TBinary):
+            return replace(e, lhs=self._walk(e.lhs), rhs=self._walk(e.rhs))
+        if isinstance(e, ir.TIn):
+            return replace(e, operands=tuple(self._walk(o) for o in e.operands))
+        if isinstance(e, ir.TBetween):
+            return replace(e, operands=tuple(self._walk(o) for o in e.operands))
+        if isinstance(e, ir.TTransform):
+            return replace(
+                e, operands=tuple(self._walk(o) for o in e.operands),
+                default=self._walk(e.default) if e.default else None)
+        if isinstance(e, ir.TStringPredicate):
+            return replace(e, operand=self._walk(e.operand))
+        return e
+
+
+def _subst_order(order: Optional[ir.OrderClause],
+                 subst: _AvgSubstituter) -> Optional[ir.OrderClause]:
+    if order is None:
+        return None
+    return ir.OrderClause(items=tuple(
+        ir.OrderItem(expr=subst(i.expr), descending=i.descending)
+        for i in order.items))
+
+
+def _subst_project(project: ir.ProjectClause, subst: _AvgSubstituter,
+                   plan: ir.Query) -> ir.ProjectClause:
+    return ir.ProjectClause(items=tuple(
+        ir.NamedExpr(name=i.name, expr=subst(i.expr)) for i in project.items))
+
+
+def _default_project(plan: ir.Query, subst: _AvgSubstituter
+                     ) -> Optional[ir.ProjectClause]:
+    """SELECT * with GROUP BY: reconstruct keys + original aggregate values
+    (avg must be divided back out of its state columns)."""
+    if not subst.avg_map:
+        return None
+    items = []
+    for item in plan.group.group_items:
+        items.append(ir.NamedExpr(
+            name=item.name,
+            expr=ir.TReference(type=item.expr.type, name=item.name)))
+    for agg in plan.group.aggregate_items:
+        items.append(ir.NamedExpr(
+            name=agg.name,
+            expr=subst(ir.TReference(type=agg.type, name=agg.name))))
+    return ir.ProjectClause(items=tuple(items))
+
+
+def coordinate_and_execute(
+        plan: ir.Query,
+        chunks: Sequence[ColumnarChunk],
+        foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None,
+        evaluator: Optional[Evaluator] = None) -> ColumnarChunk:
+    """Host-coordinated fan-out: run the bottom query per shard (tablet),
+    concatenate partial results, run the front merge.
+
+    Ref: CoordinateAndExecute (engine_api/coordinator.cpp) — here shard
+    results stay on device; only the final row count syncs to host.
+    """
+    evaluator = evaluator or Evaluator()
+    if not chunks:
+        raise YtError("coordinate_and_execute: no input shards",
+                      code=EErrorCode.QueryExecutionError)
+    if len(chunks) == 1:
+        return evaluator.run_plan(plan, chunks[0], foreign_chunks)
+    bottom, front = split_plan(plan)
+    partials = [evaluator.run_plan(bottom, chunk, foreign_chunks)
+                for chunk in chunks]
+    merged = concat_chunks([p.slice_rows(0, p.row_count) for p in partials])
+    return evaluator.run_plan(front, merged)

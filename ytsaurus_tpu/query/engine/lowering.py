@@ -56,10 +56,13 @@ class PreparedQuery:
     run: callable                  # (columns, row_valid, bindings) -> (planes, count)
     bindings: list
     output: list[OutputColumn]
-    capacity: int
+    capacity: int                  # input capacity
+    out_capacity: int = 0          # output plane length (≠ input for fast group)
+    structure_key: tuple = ()      # host decisions that shape the program
 
     def binding_shapes(self) -> tuple:
-        return tuple((tuple(b.shape), str(b.dtype)) for b in self.bindings)
+        return (tuple((tuple(b.shape), str(b.dtype)) for b in self.bindings),
+                self.structure_key)
 
 
 def _column_bindings(schema: TableSchema, chunk) -> dict[str, ColumnBinding]:
@@ -139,14 +142,98 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
     offset = plan.offset
     limit = plan.limit
 
+    # --- direct-aggregation fast path ----------------------------------------
+    # When every group key has a small known value domain (dictionary codes,
+    # booleans), segment ids are computed arithmetically — no sort.  This is
+    # the TPU answer to the reference's open hash table in GroupOpHelper
+    # (cg_routines/registry.cpp:1230): for low-cardinality keys the "hash
+    # table" becomes a dense segment_sum over dict-code strides.
+    fast_group = None
+    if group is not None:
+        sizes = []
+        for _, bound in group_key_b:
+            if bound.type is EValueType.string and bound.vocab is not None:
+                sizes.append(len(bound.vocab))
+            elif bound.type is EValueType.boolean:
+                sizes.append(2)
+            else:
+                sizes = None
+                break
+        if sizes is not None:
+            dims = 1
+            for s in sizes:
+                dims *= s + 1          # +1 slot per key for NULL
+            if 0 < dims <= 65536:
+                strides = []
+                acc = 1
+                for s in reversed(sizes):
+                    strides.append(acc)
+                    acc *= s + 1
+                strides.reverse()
+                from ytsaurus_tpu.chunks.columnar import pad_capacity
+                fast_group = (tuple(sizes), tuple(strides), dims,
+                              pad_capacity(dims + 1))
+
     def run(columns: dict, row_valid: jax.Array, bindings: tuple):
         ctx = EmitContext(columns=columns, bindings=bindings, capacity=capacity)
+        stage_cap = capacity
         mask = row_valid
         if where_b is not None:
             d, v = where_b.emit(ctx)
             mask = mask & v & d.astype(bool)
 
-        if group is not None:
+        if group is not None and fast_group is not None:
+            sizes, strides, dims, seg_cap = fast_group
+            nseg = dims + 1                    # +1 garbage slot for masked rows
+
+            def _pad(plane):
+                return jnp.zeros(seg_cap, dtype=plane.dtype).at[:nseg].set(plane)
+
+            key_planes = [b.emit(ctx) for _, b in group_key_b]
+            seg = jnp.zeros(capacity, dtype=jnp.int32)
+            for (data, valid), size, stride in zip(key_planes, sizes, strides):
+                code = jnp.where(valid, data.astype(jnp.int32), size)
+                seg = seg + code * stride
+            seg = jnp.where(mask, seg, dims)   # masked-out rows → garbage slot
+            present_counts, _ = segment_aggregate(
+                "count", mask, mask, seg, nseg, EValueType.int64)
+            present = _pad((jnp.arange(nseg) < dims) & (present_counts > 0))
+            new_columns: dict[str, tuple[jax.Array, jax.Array]] = {}
+            slot = jnp.arange(seg_cap)
+            for (name, bound), size, stride in zip(group_key_b, sizes, strides):
+                code = (slot // stride) % (size + 1)
+                key_valid = code < size
+                data = jnp.clip(code, 0, max(size - 1, 0))
+                if bound.type is EValueType.boolean:
+                    data = data.astype(jnp.bool_)
+                else:
+                    data = data.astype(jnp.int32)
+                new_columns[name] = (data, key_valid)
+            for agg, arg in agg_arg_b:
+                if agg.function == "avg":
+                    data, valid = arg.emit(ctx)
+                    data = data.astype(jnp.float64)
+                    valid = valid & mask
+                    s, sv = segment_aggregate("sum", data, valid, seg,
+                                              nseg, EValueType.double)
+                    c, _ = segment_aggregate("count", data, valid, seg,
+                                             nseg, EValueType.int64)
+                    new_columns[agg.name] = (_pad(s / jnp.maximum(c, 1)),
+                                             _pad(sv))
+                else:
+                    data, valid = arg.emit(ctx)
+                    valid = valid & mask
+                    out, out_v = segment_aggregate(
+                        agg.function, data, valid, seg, nseg, agg.type)
+                    new_columns[agg.name] = (_pad(out), _pad(out_v))
+            mask = present
+            stage_cap = seg_cap
+            ctx = EmitContext(columns=new_columns, bindings=bindings,
+                              capacity=seg_cap)
+            if having_b is not None:
+                d, v = having_b.emit(ctx)
+                mask = mask & v & d.astype(bool)
+        elif group is not None:
             key_planes = [b.emit(ctx) for _, b in group_key_b]
             # Sort: masked-out rows last, then lexicographic by keys.
             sort_keys: list[jax.Array] = []
@@ -203,7 +290,7 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             ctx = EmitContext(
                 columns={name: (d[order_idx], v[order_idx])
                          for name, (d, v) in ctx.columns.items()},
-                bindings=bindings, capacity=capacity)
+                bindings=bindings, capacity=stage_cap)
             mask = mask[order_idx]
 
         planes = []
@@ -218,15 +305,17 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             count = jnp.minimum(count, limit)
         count = jnp.maximum(count, 0)
         out_planes = []
-        shift = jnp.clip(jnp.arange(capacity) + offset, 0, capacity - 1)
+        shift = jnp.clip(jnp.arange(stage_cap) + offset, 0, stage_cap - 1)
         for d, v in planes:
             d = d[comp_idx][shift]
-            v = v[comp_idx][shift] & (jnp.arange(capacity) < count)
+            v = v[comp_idx][shift] & (jnp.arange(stage_cap) < count)
             out_planes.append((d, v))
         return out_planes, count
 
-    return PreparedQuery(run=run, bindings=bind_ctx.bindings, output=output,
-                         capacity=capacity)
+    return PreparedQuery(
+        run=run, bindings=bind_ctx.bindings, output=output, capacity=capacity,
+        out_capacity=fast_group[3] if fast_group else capacity,
+        structure_key=("fastgrp",) + fast_group[0] if fast_group else ())
 
 
 def _post_ref(name: str, bound: BoundExpr) -> BoundExpr:
