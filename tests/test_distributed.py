@@ -14,10 +14,8 @@ T = "//t"
 
 
 @pytest.fixture(scope="module")
-def table8(request):
-    import jax
+def table8():
     from ytsaurus_tpu.parallel.mesh import make_mesh
-    mesh = make_mesh(8)
     rng = np.random.default_rng(42)
     chunks = []
     for s in range(8):

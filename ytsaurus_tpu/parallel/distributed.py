@@ -15,7 +15,7 @@ Ref mapping (SURVEY.md §2.8 parallelism table):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Mapping, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +26,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ytsaurus_tpu.chunks.columnar import (
     Column,
     ColumnarChunk,
-    pad_capacity,
     unify_dictionaries,
 )
 from ytsaurus_tpu.errors import EErrorCode, YtError
