@@ -1,0 +1,206 @@
+"""Dynamic table tests: MVCC writes/reads, flush/compaction, transactions,
+lookup and select integration.
+
+Modeled on the reference integration suite
+yt/yt/tests/integration/dynamic_tables/test_sorted_dynamic_tables.py.
+"""
+
+import pytest
+
+from ytsaurus_tpu import YtError
+from ytsaurus_tpu.chunks.store import FsChunkStore
+from ytsaurus_tpu.query import select_rows
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.tablet.tablet import Tablet
+from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+from ytsaurus_tpu.tablet.transactions import TransactionManager
+
+SCHEMA = TableSchema.make([
+    ("key", "int64", "ascending"),
+    ("value", "string"),
+    ("amount", "int64"),
+], unique_keys=True)
+
+
+@pytest.fixture
+def tablet(tmp_path):
+    return Tablet(SCHEMA, FsChunkStore(str(tmp_path)))
+
+
+@pytest.fixture
+def txm():
+    return TransactionManager()
+
+
+def _insert(txm, tablet, rows):
+    tx = txm.start()
+    txm.write_rows(tx, tablet, rows)
+    return txm.commit(tx)
+
+
+def test_insert_and_lookup(tablet, txm):
+    _insert(txm, tablet, [{"key": 1, "value": "a", "amount": 10},
+                          {"key": 2, "value": "b", "amount": 20}])
+    rows = tablet.lookup_rows([(1,), (2,), (3,)])
+    assert rows[0] == {"key": 1, "value": b"a", "amount": 10}
+    assert rows[1] == {"key": 2, "value": b"b", "amount": 20}
+    assert rows[2] is None
+
+
+def test_overwrite_takes_latest(tablet, txm):
+    _insert(txm, tablet, [{"key": 1, "value": "old", "amount": 1}])
+    _insert(txm, tablet, [{"key": 1, "value": "new", "amount": 2}])
+    (row,) = tablet.lookup_rows([(1,)])
+    assert row["value"] == b"new" and row["amount"] == 2
+
+
+def test_snapshot_isolation_timestamps(tablet, txm):
+    ts1 = _insert(txm, tablet, [{"key": 1, "value": "v1", "amount": 1}])
+    ts2 = _insert(txm, tablet, [{"key": 1, "value": "v2", "amount": 2}])
+    (at_ts1,) = tablet.lookup_rows([(1,)], timestamp=ts1)
+    (at_ts2,) = tablet.lookup_rows([(1,)], timestamp=ts2)
+    (before,) = tablet.lookup_rows([(1,)], timestamp=ts1 - 1)
+    assert at_ts1["value"] == b"v1"
+    assert at_ts2["value"] == b"v2"
+    assert before is None
+
+
+def test_delete_row(tablet, txm):
+    _insert(txm, tablet, [{"key": 1, "value": "x", "amount": 1}])
+    tx = txm.start()
+    txm.delete_rows(tx, tablet, [(1,)])
+    del_ts = txm.commit(tx)
+    (row,) = tablet.lookup_rows([(1,)])
+    assert row is None
+    # But the old version is still visible before the delete.
+    (old,) = tablet.lookup_rows([(1,)], timestamp=del_ts - 1)
+    assert old["value"] == b"x"
+
+
+def test_flush_preserves_versions(tablet, txm):
+    ts1 = _insert(txm, tablet, [{"key": 1, "value": "v1", "amount": 1}])
+    ts2 = _insert(txm, tablet, [{"key": 1, "value": "v2", "amount": 2}])
+    chunk_id = tablet.flush()
+    assert chunk_id is not None
+    assert tablet.active_store.key_count == 0
+    (at_ts1,) = tablet.lookup_rows([(1,)], timestamp=ts1)
+    (latest,) = tablet.lookup_rows([(1,)])
+    assert at_ts1["value"] == b"v1"
+    assert latest["value"] == b"v2"
+
+
+def test_mixed_store_and_chunk_reads(tablet, txm):
+    _insert(txm, tablet, [{"key": 1, "value": "flushed", "amount": 1}])
+    tablet.flush()
+    _insert(txm, tablet, [{"key": 2, "value": "fresh", "amount": 2}])
+    rows = tablet.lookup_rows([(1,), (2,)])
+    assert rows[0]["value"] == b"flushed"
+    assert rows[1]["value"] == b"fresh"
+    snapshot = tablet.read_snapshot()
+    assert sorted(r["key"] for r in snapshot.to_rows()) == [1, 2]
+
+
+def test_write_after_flush_overrides_chunk(tablet, txm):
+    _insert(txm, tablet, [{"key": 1, "value": "old", "amount": 1}])
+    tablet.flush()
+    _insert(txm, tablet, [{"key": 1, "value": "new", "amount": 2}])
+    (row,) = tablet.lookup_rows([(1,)])
+    assert row["value"] == b"new"
+
+
+def test_compaction_drops_superseded(tablet, txm):
+    for i in range(3):
+        _insert(txm, tablet, [{"key": 1, "value": f"v{i}", "amount": i}])
+    tablet.flush()
+    ts_now = txm.timestamps.generate()
+    tablet.compact(retention_timestamp=ts_now)
+    assert len(tablet.chunk_ids) == 1
+    chunk = tablet.chunk_store.read_chunk(tablet.chunk_ids[0])
+    assert chunk.row_count == 1          # only the latest version survives
+    (row,) = tablet.lookup_rows([(1,)])
+    assert row["value"] == b"v2"
+
+
+def test_compaction_removes_deleted_keys(tablet, txm):
+    _insert(txm, tablet, [{"key": 1, "value": "x", "amount": 1}])
+    tx = txm.start()
+    txm.delete_rows(tx, tablet, [(1,)])
+    txm.commit(tx)
+    tablet.flush()
+    tablet.compact(retention_timestamp=txm.timestamps.generate())
+    assert tablet.chunk_ids == []
+    (row,) = tablet.lookup_rows([(1,)])
+    assert row is None
+
+
+def test_conflict_detection(tablet, txm):
+    _insert(txm, tablet, [{"key": 1, "value": "base", "amount": 0}])
+    tx1 = txm.start()
+    tx2 = txm.start()
+    txm.write_rows(tx1, tablet, [{"key": 1, "value": "a", "amount": 1}])
+    txm.write_rows(tx2, tablet, [{"key": 1, "value": "b", "amount": 2}])
+    txm.commit(tx1)
+    with pytest.raises(YtError) as err:
+        txm.commit(tx2)
+    assert err.value.code == 1700
+    assert tx2.state == "aborted"
+    (row,) = tablet.lookup_rows([(1,)])
+    assert row["value"] == b"a"
+
+
+def test_non_conflicting_keys_commit(tablet, txm):
+    tx1 = txm.start()
+    tx2 = txm.start()
+    txm.write_rows(tx1, tablet, [{"key": 1, "value": "a", "amount": 1}])
+    txm.write_rows(tx2, tablet, [{"key": 2, "value": "b", "amount": 2}])
+    txm.commit(tx1)
+    txm.commit(tx2)
+    assert len([r for r in tablet.lookup_rows([(1,), (2,)]) if r]) == 2
+
+
+def test_multi_tablet_transaction_atomic(tmp_path, txm):
+    t1 = Tablet(SCHEMA, FsChunkStore(str(tmp_path / "a")), tablet_id="a")
+    t2 = Tablet(SCHEMA, FsChunkStore(str(tmp_path / "b")), tablet_id="b")
+    tx = txm.start()
+    txm.write_rows(tx, t1, [{"key": 1, "value": "x", "amount": 1}])
+    txm.write_rows(tx, t2, [{"key": 1, "value": "y", "amount": 2}])
+    ts = txm.commit(tx)
+    # Same commit timestamp on both participants.
+    assert t1.lookup_rows([(1,)], timestamp=ts)[0]["value"] == b"x"
+    assert t2.lookup_rows([(1,)], timestamp=ts)[0]["value"] == b"y"
+    assert t1.lookup_rows([(1,)], timestamp=ts - 1)[0] is None
+    assert t2.lookup_rows([(1,)], timestamp=ts - 1)[0] is None
+
+
+def test_abort_releases_locks(tablet, txm):
+    tx1 = txm.start()
+    txm.write_rows(tx1, tablet, [{"key": 1, "value": "a", "amount": 1}])
+    txm.abort(tx1)
+    tx2 = txm.start()
+    txm.write_rows(tx2, tablet, [{"key": 1, "value": "b", "amount": 2}])
+    txm.commit(tx2)
+    (row,) = tablet.lookup_rows([(1,)])
+    assert row["value"] == b"b"
+
+
+def test_select_over_tablet_snapshot(tablet, txm):
+    for i in range(20):
+        _insert(txm, tablet, [{"key": i, "value": f"g{i % 3}",
+                               "amount": i * 10}])
+    tablet.flush()
+    _insert(txm, tablet, [{"key": 100, "value": "g0", "amount": 5}])
+    snapshot = tablet.read_snapshot()
+    out = select_rows(
+        "value, sum(amount) AS total FROM [//t] GROUP BY value",
+        {"//t": snapshot})
+    rows = {r["value"]: r["total"] for r in out.to_rows()}
+    assert rows[b"g0"] == sum(i * 10 for i in range(0, 20, 3)) + 5
+    assert rows[b"g1"] == sum(i * 10 for i in range(1, 20, 3))
+
+
+def test_write_missing_value_column_becomes_null(tablet, txm):
+    _insert(txm, tablet, [{"key": 1, "value": "full", "amount": 7}])
+    _insert(txm, tablet, [{"key": 1, "value": "partial"}])
+    (row,) = tablet.lookup_rows([(1,)])
+    # Full-row write semantics: unspecified value columns become null.
+    assert row == {"key": 1, "value": b"partial", "amount": None}

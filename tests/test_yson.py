@@ -1,0 +1,107 @@
+"""YSON round-trip tests (ref core/yson/unittests)."""
+
+import math
+
+import pytest
+
+from ytsaurus_tpu import yson
+from ytsaurus_tpu.yson import YsonEntity, YsonUint64, to_yson_type
+
+
+CASES = [
+    None,
+    True,
+    False,
+    0,
+    -1,
+    2**62,
+    -(2**63),
+    YsonUint64(2**64 - 1),
+    1.5,
+    -2.25,
+    "hello",
+    "with spaces and \"quotes\"",
+    "",
+    b"\x00\xff\x01binary" if False else "unicode ok",
+    [],
+    [1, 2, 3],
+    {"a": 1, "b": [True, None]},
+    {"nested": {"x": {"y": [1.0, "z"]}}},
+]
+
+
+@pytest.mark.parametrize("binary", [False, True])
+@pytest.mark.parametrize("value", CASES, ids=[repr(c)[:30] for c in CASES])
+def test_roundtrip(value, binary):
+    blob = yson.dumps(value, binary=binary)
+    back = yson.loads(blob)
+    assert back == value
+
+
+def test_binary_bytes_roundtrip():
+    raw = bytes(range(256))
+    blob = yson.dumps(raw, binary=True)
+    back = yson.loads(blob, encoding=None)
+    assert back == raw
+
+
+def test_text_escaped_bytes_roundtrip():
+    raw = b"\x00\xff\"quote\\slash\n"
+    blob = yson.dumps(raw, binary=False)
+    back = yson.loads(blob, encoding=None)
+    assert back == raw
+
+
+def test_attributes_roundtrip():
+    value = to_yson_type({"a": 1}, {"attr": "x", "n": 2})
+    for binary in (False, True):
+        back = yson.loads(yson.dumps(value, binary=binary))
+        assert back == {"a": 1}
+        assert back.attributes == {"attr": "x", "n": 2}
+
+
+def test_entity_with_attributes():
+    value = to_yson_type(None, {"type": "table"})
+    back = yson.loads(yson.dumps(value))
+    assert isinstance(back, YsonEntity)
+    assert back.attributes == {"type": "table"}
+
+
+def test_uint64_suffix_text():
+    assert yson.loads(b"5u") == 5
+    assert isinstance(yson.loads(b"5u"), YsonUint64)
+    assert yson.dumps(YsonUint64(5)) == b"5u"
+
+
+def test_special_doubles():
+    assert math.isnan(yson.loads(yson.dumps(float("nan"))))
+    assert yson.loads(yson.dumps(float("inf"))) == float("inf")
+    assert yson.loads(yson.dumps(float("-inf"))) == float("-inf")
+
+
+def test_text_format_examples():
+    # Hand-written text forms parse as expected.
+    assert yson.loads(b"{a=1;b=[x;y];c=#}") == \
+        {"a": 1, "b": ["x", "y"], "c": None}
+    assert yson.loads(b"<append=%true>//tmp/t").attributes == {"append": True}
+    assert yson.loads(b" { a = 1 ; } ") == {"a": 1}
+
+
+def test_list_fragment():
+    rows = yson.loads(b"{a=1};{a=2};{a=3}", yson_type="list_fragment")
+    assert rows == [{"a": 1}, {"a": 2}, {"a": 3}]
+
+
+def test_parse_error_position():
+    from ytsaurus_tpu import YtError
+    with pytest.raises(YtError):
+        yson.loads(b"{a=}")
+    with pytest.raises(YtError):
+        yson.loads(b"[1;2")
+
+
+def test_malformed_inputs_raise_yterror():
+    from ytsaurus_tpu import YtError
+    for blob in [b'"abc\\', b'\x03\x01\x02', b'1.2.3', b'{a=1', b'\x01\xff\xff']:
+        with pytest.raises(YtError):
+            yson.loads(blob)

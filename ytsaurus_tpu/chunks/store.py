@@ -1,0 +1,143 @@
+"""Chunk store: content-addressed chunk files on a filesystem + block cache.
+
+Ref mapping: data node chunk storage (server/node/data_node/blob_chunk.h,
+chunk_store.h) collapses to a host-side store whose unit is the whole
+columnar chunk (the reference's block granularity matters for its TCP data
+plane; here chunks decode straight into device planes, so the cache holds
+decoded chunks — the analog of the tablet node's in-memory mode
+(tablet_node/in_memory_manager.h) at `uncompressed` level).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from collections import OrderedDict
+from typing import Optional
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.chunks.encoding import (
+    DEFAULT_CODEC,
+    deserialize_chunk,
+    read_chunk_meta,
+    serialize_chunk,
+)
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+
+def new_chunk_id() -> str:
+    return uuid.uuid4().hex
+
+
+class FsChunkStore:
+    """Chunks as files under root/<id[:2]>/<id>.chunk."""
+
+    def __init__(self, root: str, codec: str = DEFAULT_CODEC):
+        self.root = root
+        self.codec = codec
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, chunk_id: str) -> str:
+        return os.path.join(self.root, chunk_id[:2], f"{chunk_id}.chunk")
+
+    def write_chunk(self, chunk: ColumnarChunk,
+                    chunk_id: Optional[str] = None,
+                    codec: Optional[str] = None) -> str:
+        chunk_id = chunk_id or new_chunk_id()
+        blob = serialize_chunk(chunk, codec or self.codec)
+        path = self._path(chunk_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)      # atomic publish
+        return chunk_id
+
+    def read_chunk(self, chunk_id: str) -> ColumnarChunk:
+        return deserialize_chunk(self._read_blob(chunk_id))
+
+    def read_meta(self, chunk_id: str) -> dict:
+        return read_chunk_meta(self._read_blob(chunk_id))
+
+    def _read_blob(self, chunk_id: str) -> bytes:
+        path = self._path(chunk_id)
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise YtError(f"No such chunk {chunk_id}",
+                          code=EErrorCode.NoSuchChunk)
+
+    def exists(self, chunk_id: str) -> bool:
+        return os.path.exists(self._path(chunk_id))
+
+    def remove_chunk(self, chunk_id: str) -> None:
+        try:
+            os.unlink(self._path(chunk_id))
+        except FileNotFoundError:
+            pass
+
+    def list_chunks(self) -> list[str]:
+        out = []
+        for sub in os.listdir(self.root):
+            subdir = os.path.join(self.root, sub)
+            if not os.path.isdir(subdir):
+                continue
+            for name in os.listdir(subdir):
+                if name.endswith(".chunk"):
+                    out.append(name[:-len(".chunk")])
+        return sorted(out)
+
+
+class ChunkCache:
+    """LRU cache of DECODED chunks (device-resident planes), byte-budgeted.
+
+    The HBM staging manager: holding a decoded chunk pins its planes on
+    device, so the budget bounds device memory spent on cached table data.
+    """
+
+    def __init__(self, store: FsChunkStore, capacity_bytes: int = 2 << 30):
+        self.store = store
+        self.capacity_bytes = capacity_bytes
+        self._entries: OrderedDict[str, tuple[ColumnarChunk, int]] = OrderedDict()
+        self._used = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _chunk_bytes(chunk: ColumnarChunk) -> int:
+        total = 0
+        for col in chunk.columns.values():
+            total += col.data.size * col.data.dtype.itemsize
+            total += col.valid.size
+        return total
+
+    def get(self, chunk_id: str) -> ColumnarChunk:
+        with self._lock:
+            entry = self._entries.get(chunk_id)
+            if entry is not None:
+                self._entries.move_to_end(chunk_id)
+                self.hits += 1
+                return entry[0]
+        chunk = self.store.read_chunk(chunk_id)
+        size = self._chunk_bytes(chunk)
+        with self._lock:
+            self.misses += 1
+            if chunk_id not in self._entries:
+                self._entries[chunk_id] = (chunk, size)
+                self._used += size
+                while self._used > self.capacity_bytes and len(self._entries) > 1:
+                    _, (_, evicted_size) = self._entries.popitem(last=False)
+                    self._used -= evicted_size
+        return chunk
+
+    def invalidate(self, chunk_id: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(chunk_id, None)
+            if entry is not None:
+                self._used -= entry[1]

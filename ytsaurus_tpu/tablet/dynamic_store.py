@@ -1,0 +1,151 @@
+"""Sorted dynamic store: the in-memory MVCC row store.
+
+Ref: server/node/tablet_node/sorted_dynamic_store.h — a lock-free skip list
+of rows, each holding per-column edit lists of (timestamp, value) pairs plus
+write/delete timestamp lists.  TPU-native reframing: the hot compute path
+reads COLUMNAR SNAPSHOTS (built on flush/rotation and merged on device); the
+dynamic store itself is a host-side ordered map of versioned rows — the
+mutation log before columnarization — so it optimizes for write latency and
+snapshot building, not per-row device access.
+
+Versions per key:
+  writes:  (timestamp, {column: value})   — FULL row state (a write replaces
+           the whole row; value columns absent from the write become null —
+           per-column partial-update merge à la the reference's versioned
+           values is a TODO)
+  deletes: (timestamp, None)              — tombstone
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Iterable, Optional, Sequence
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+
+
+class SortedDynamicStore:
+    def __init__(self, schema: TableSchema):
+        if not schema.is_sorted:
+            raise YtError("Dynamic store requires a sorted schema")
+        self.schema = schema
+        self.key_names = schema.key_column_names
+        self.value_names = [c.name for c in schema
+                            if c.sort_order is None]
+        self._rows: dict[tuple, list[tuple[int, Optional[dict]]]] = {}
+        self._sorted_keys: list[tuple] = []
+        self._lock = threading.Lock()
+        self.store_row_count = 0          # versions stored
+        self.min_timestamp = MAX_TIMESTAMP
+        self.max_timestamp = 0
+
+    # -- write path ------------------------------------------------------------
+
+    def key_of(self, row: dict) -> tuple:
+        try:
+            return tuple(row[name] for name in self.key_names)
+        except KeyError as e:
+            raise YtError(f"Row is missing key column {e.args[0]!r}",
+                          code=EErrorCode.QueryTypeError)
+
+    def write_row(self, row: dict, timestamp: int) -> None:
+        key = self.key_of(row)
+        values = {name: row.get(name) for name in self.value_names}
+        self._append(key, timestamp, values)
+
+    def delete_row(self, key_row: dict | tuple, timestamp: int) -> None:
+        key = key_row if isinstance(key_row, tuple) else self.key_of(key_row)
+        self._append(key, timestamp, None)
+
+    def _append(self, key: tuple, timestamp: int,
+                values: Optional[dict]) -> None:
+        with self._lock:
+            versions = self._rows.get(key)
+            if versions is None:
+                versions = []
+                self._rows[key] = versions
+                bisect.insort(self._sorted_keys, _null_safe(key))
+            versions.append((timestamp, values))
+            self.store_row_count += 1
+            self.min_timestamp = min(self.min_timestamp, timestamp)
+            self.max_timestamp = max(self.max_timestamp, timestamp)
+
+    # -- read path -------------------------------------------------------------
+
+    def last_committed_timestamp(self, key: tuple) -> Optional[int]:
+        versions = self._rows.get(key)
+        if not versions:
+            return None
+        return max(ts for ts, _ in versions)
+
+    def lookup_versions(self, key: tuple) -> list[tuple[int, Optional[dict]]]:
+        """All versions for a key, newest first."""
+        versions = self._rows.get(key, [])
+        return sorted(versions, key=lambda v: -v[0])
+
+    def iter_items(self) -> Iterable[tuple[tuple, list]]:
+        """(key, versions) in key order (nulls first)."""
+        with self._lock:
+            keys = list(self._sorted_keys)
+        for sk in keys:
+            key = _null_unsafe(sk)
+            yield key, self._rows[key]
+
+    @property
+    def key_count(self) -> int:
+        return len(self._rows)
+
+    def versioned_rows(self) -> list[dict]:
+        """Flatten to versioned row dicts (newest first per key) for
+        flushing: key columns + $timestamp + $tombstone + value columns."""
+        out = []
+        for key, versions in self.iter_items():
+            for ts, state in sorted(versions, key=lambda v: -v[0]):
+                row = {name: value for name, value in zip(self.key_names, key)}
+                row["$timestamp"] = ts
+                row["$tombstone"] = state is None
+                for name in self.value_names:
+                    row[name] = (state or {}).get(name)
+                out.append(row)
+        return out
+
+
+def _null_safe(key: tuple) -> tuple:
+    """Make keys with None sortable (null < everything, ref comparator)."""
+    return tuple((v is not None, v if v is not None else 0) for v in key)
+
+
+def _null_unsafe(sk: tuple) -> tuple:
+    return tuple(v if present else None for present, v in sk)
+
+
+class OrderedDynamicStore:
+    """Append-only store backing ordered (queue) tables.
+
+    Ref: tablet_node/ordered_dynamic_store.h."""
+
+    def __init__(self, schema: TableSchema):
+        self.schema = schema
+        self._rows: list[tuple[int, dict]] = []
+        self._lock = threading.Lock()
+
+    def append_row(self, row: dict, timestamp: int) -> int:
+        with self._lock:
+            self._rows.append((timestamp, dict(row)))
+            return len(self._rows) - 1
+
+    def read(self, start_index: int = 0,
+             limit: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            end = len(self._rows) if limit is None else start_index + limit
+            return [dict(row) | {"$row_index": i, "$timestamp": ts}
+                    for i, (ts, row) in enumerate(self._rows[start_index:end],
+                                                  start=start_index)]
+
+    @property
+    def row_count(self) -> int:
+        with self._lock:
+            return len(self._rows)

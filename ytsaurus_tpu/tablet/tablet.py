@@ -1,0 +1,413 @@
+"""Tablet: one shard of a dynamic table — stores, snapshots, MVCC reads.
+
+Ref mapping (server/node/tablet_node):
+  TTablet (tablet.h)                  → Tablet
+  store_manager write path            → Tablet.write_rows/delete_rows (locks
+                                        via the transaction manager)
+  store_flusher / rotation            → Tablet.rotate_store + flush()
+  store_compactor                     → Tablet.compact()
+  tablet_snapshot_store lock-free     → versioned snapshot chunks built per
+  reads                                 flush generation, merged on read at
+                                        the requested timestamp
+The columnar snapshot IS the TPU-native trick: MVCC version selection
+(newest version ≤ read_ts per key, tombstones drop) happens as one
+vectorized pass, not a per-row k-way heap merge (tablet_reader.cpp:651).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
+from ytsaurus_tpu.chunks.store import ChunkCache, FsChunkStore
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.schema import EValueType, SortOrder, TableSchema
+from ytsaurus_tpu.tablet.dynamic_store import SortedDynamicStore
+from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+
+
+def versioned_schema(schema: TableSchema) -> TableSchema:
+    """Schema of versioned snapshot chunks: keys + $timestamp/$tombstone +
+    values (keys keep their sort order; versions are sorted within key by
+    descending timestamp at flush time)."""
+    cols = []
+    for c in schema:
+        if c.sort_order is not None:
+            cols.append((c.name, c.type.value, c.sort_order.value))
+    cols.append(("$timestamp", "int64"))
+    cols.append(("$tombstone", "boolean"))
+    for c in schema:
+        if c.sort_order is None:
+            cols.append((c.name, c.type.value))
+    return TableSchema.make(cols)
+
+
+class Tablet:
+    def __init__(self, schema: TableSchema, chunk_store: FsChunkStore,
+                 tablet_id: str = "0", pivot_key: Optional[tuple] = None,
+                 chunk_cache: Optional[ChunkCache] = None):
+        if not schema.is_sorted:
+            raise YtError("Dynamic tables require a sorted schema",
+                          code=EErrorCode.TabletNotMounted)
+        self.schema = schema
+        self.tablet_id = tablet_id
+        self.pivot_key = pivot_key
+        self.chunk_store = chunk_store
+        self.chunk_cache = chunk_cache or ChunkCache(chunk_store)
+        self.active_store = SortedDynamicStore(schema)
+        self.passive_stores: list[SortedDynamicStore] = []
+        self.chunk_ids: list[str] = []      # versioned snapshot chunks
+        self.mounted = True
+        self.flush_generation = 0
+        self._lock = threading.RLock()
+        self._host_planes: dict[str, dict] = {}
+
+    # -- write path (called under the transaction manager) ---------------------
+
+    def normalize_row(self, row: dict) -> dict:
+        """Canonical host forms per column type (strings as bytes, matching
+        what chunk decode produces)."""
+        out = {}
+        for name, value in row.items():
+            col = self.schema.find(name)
+            if col is None:
+                raise YtError(f"Unknown column {name!r}",
+                              code=EErrorCode.QueryTypeError)
+            out[name] = _normalize_value(value, col.type)
+        return out
+
+    def normalize_key(self, key: tuple) -> tuple:
+        key_cols = self.schema.key_columns
+        if len(key) != len(key_cols):
+            raise YtError(f"Key width {len(key)} != {len(key_cols)}")
+        return tuple(_normalize_value(v, c.type)
+                     for v, c in zip(key, key_cols))
+
+    def write_row(self, row: dict, timestamp: int) -> None:
+        row = self.normalize_row(row)
+        with self._lock:       # a concurrent flush() must not drop the write
+            self._check_mounted()
+            self.active_store.write_row(row, timestamp)
+
+    def delete_row(self, key: tuple, timestamp: int) -> None:
+        key = self.normalize_key(key)
+        with self._lock:
+            self._check_mounted()
+            self.active_store.delete_row(key, timestamp)
+
+    def last_committed_timestamp(self, key: tuple) -> Optional[int]:
+        """Newest committed write/delete ts for conflict detection."""
+        with self._lock:
+            best = self.active_store.last_committed_timestamp(key)
+            for store in self.passive_stores:
+                ts = store.last_committed_timestamp(key)
+                if ts is not None and (best is None or ts > best):
+                    best = ts
+            # Chunk stores: versions are ordered newest-first per key.
+            for cid in self.chunk_ids:
+                ts = _chunk_last_timestamp(
+                    self._decode(cid), self.schema, key,
+                    self._chunk_host_planes(cid))
+                if ts is not None and (best is None or ts > best):
+                    best = ts
+            return best
+
+    def _check_mounted(self):
+        if not self.mounted:
+            raise YtError(f"Tablet {self.tablet_id} is not mounted",
+                          code=EErrorCode.TabletNotMounted)
+
+    # -- rotation / flush / compaction -----------------------------------------
+
+    def rotate_store(self) -> None:
+        """Freeze the active store (ref store_rotator)."""
+        with self._lock:
+            if self.active_store.key_count == 0:
+                return
+            self.passive_stores.append(self.active_store)
+            self.active_store = SortedDynamicStore(self.schema)
+
+    def flush(self) -> Optional[str]:
+        """Rotate + write all passive stores into one versioned chunk."""
+        with self._lock:
+            self.rotate_store()
+            if not self.passive_stores:
+                return None
+            rows: list[dict] = []
+            for store in self.passive_stores:
+                rows.extend(store.versioned_rows())
+            rows.sort(key=_versioned_sort_key(self.schema))
+            chunk = ColumnarChunk.from_rows(versioned_schema(self.schema), rows)
+            chunk_id = self.chunk_store.write_chunk(chunk)
+            self.chunk_ids.append(chunk_id)
+            self.passive_stores.clear()
+            self.flush_generation += 1
+            return chunk_id
+
+    def compact(self, retention_timestamp: int = 0) -> Optional[str]:
+        """Merge all snapshot chunks into one, dropping versions that are
+        superseded as of `retention_timestamp` (ref store_compactor +
+        lsm heuristics, majorly simplified: full major compaction)."""
+        with self._lock:
+            if len(self.chunk_ids) <= 0:
+                return None
+            chunks = [self._decode(cid) for cid in self.chunk_ids]
+            rows: list[dict] = []
+            for chunk in chunks:
+                rows.extend(chunk.to_rows())
+            rows.sort(key=_versioned_sort_key(self.schema))
+            rows = _drop_superseded(rows, self.schema, retention_timestamp)
+            old_ids = list(self.chunk_ids)
+            if rows:
+                chunk = ColumnarChunk.from_rows(versioned_schema(self.schema),
+                                                rows)
+                new_id = self.chunk_store.write_chunk(chunk)
+                self.chunk_ids = [new_id]
+            else:
+                new_id = None
+                self.chunk_ids = []
+            for cid in old_ids:
+                self.chunk_store.remove_chunk(cid)
+                self.chunk_cache.invalidate(cid)
+                self._host_planes.pop(cid, None)
+            self.flush_generation += 1
+            return new_id
+
+    # -- read path -------------------------------------------------------------
+
+    def _decode(self, chunk_id: str) -> ColumnarChunk:
+        return self.chunk_cache.get(chunk_id)
+
+    def _chunk_host_planes(self, chunk_id: str) -> dict:
+        """numpy views of a chunk's planes (device->host once per chunk)."""
+        planes = self._host_planes.get(chunk_id)
+        if planes is None:
+            chunk = self._decode(chunk_id)
+            n = chunk.row_count
+            planes = {name: (np.asarray(col.data[:n]), np.asarray(col.valid[:n]))
+                      for name, col in chunk.columns.items()}
+            self._host_planes[chunk_id] = planes
+            if len(self._host_planes) > 64:
+                self._host_planes.pop(next(iter(self._host_planes)))
+        return planes
+
+    def _decoded_chunks(self) -> list[ColumnarChunk]:
+        return [self._decode(cid) for cid in self.chunk_ids]
+
+    def versioned_rows_snapshot(self) -> list[dict]:
+        """All versions from every store (host rows; newest-first per key)."""
+        with self._lock:
+            rows: list[dict] = []
+            for chunk in self._decoded_chunks():
+                rows.extend(chunk.to_rows())
+            for store in self.passive_stores + [self.active_store]:
+                rows.extend(store.versioned_rows())
+            rows.sort(key=_versioned_sort_key(self.schema))
+            return rows
+
+    def read_snapshot(self, timestamp: int = MAX_TIMESTAMP) -> ColumnarChunk:
+        """Materialize the tablet contents as of `timestamp` into a plain
+        columnar chunk (the select_rows input)."""
+        with self._lock:
+            rows = self.versioned_rows_snapshot()
+            visible = _mvcc_select(rows, self.schema, timestamp)
+            return ColumnarChunk.from_rows(self.schema.to_unsorted(), visible)
+
+    def lookup_rows(self, keys: Sequence[tuple],
+                    timestamp: int = MAX_TIMESTAMP,
+                    column_names: Optional[Sequence[str]] = None
+                    ) -> list[Optional[dict]]:
+        """Point reads at a timestamp (ref tablet_node/lookup.cpp)."""
+        with self._lock:
+            key_names = self.schema.key_column_names
+            out: list[Optional[dict]] = []
+            keys = [self.normalize_key(tuple(k)) for k in keys]
+            for key in keys:
+                versions: list[tuple[int, Optional[dict]]] = []
+                for store in [self.active_store] + self.passive_stores:
+                    versions.extend(store.lookup_versions(key))
+                for cid in self.chunk_ids:
+                    versions.extend(_chunk_lookup_versions(
+                        self._decode(cid), self.schema, key,
+                        self._chunk_host_planes(cid)))
+                merged = _merge_versions(versions, timestamp)
+                if merged is None:
+                    out.append(None)
+                    continue
+                row = dict(zip(key_names, key))
+                row.update(merged)
+                if column_names is not None:
+                    row = {name: row.get(name) for name in column_names}
+                out.append(row)
+            return out
+
+
+def _normalize_value(value, ty: EValueType):
+    if value is None:
+        return None
+    if ty is EValueType.string:
+        return value.encode("utf-8") if isinstance(value, str) else bytes(value)
+    if ty is EValueType.boolean:
+        return bool(value)
+    if ty is EValueType.double:
+        return float(value)
+    if ty in (EValueType.int64, EValueType.uint64):
+        return int(value)
+    return value
+
+
+# -- versioned row helpers -----------------------------------------------------
+
+
+def _versioned_sort_key(schema: TableSchema):
+    key_names = schema.key_column_names
+
+    def sort_key(row: dict):
+        key_part = tuple((row[name] is not None,
+                          row[name] if row[name] is not None else 0)
+                         for name in key_names)
+        return key_part + (-row["$timestamp"],)
+    return sort_key
+
+
+def _mvcc_select(versioned_rows: list[dict], schema: TableSchema,
+                 timestamp: int) -> list[dict]:
+    """Pick the newest version ≤ timestamp per key; drop tombstones.
+    Input must be sorted by (key, -ts)."""
+    key_names = schema.key_column_names
+    value_names = [c.name for c in schema if c.sort_order is None]
+    out = []
+    prev_key = object()
+    for row in versioned_rows:
+        key = tuple(row[name] for name in key_names)
+        if row["$timestamp"] > timestamp:
+            continue
+        if key == prev_key:
+            continue
+        prev_key = key
+        if row["$tombstone"]:
+            continue
+        visible = {name: row[name] for name in key_names}
+        for name in value_names:
+            visible[name] = row.get(name)
+        out.append(visible)
+    return out
+
+
+def _drop_superseded(versioned_rows: list[dict], schema: TableSchema,
+                     retention_timestamp: int) -> list[dict]:
+    """Major-compaction retention: keep every version newer than
+    `retention_timestamp` plus the newest visible state at it (unless that
+    state is a tombstone, which can then be dropped).  Input sorted by
+    (key, -ts)."""
+    key_names = schema.key_column_names
+    out = []
+    prev_key: object = object()
+    kept_base = False
+    for row in versioned_rows:
+        key = tuple(row[name] for name in key_names)
+        if key != prev_key:
+            prev_key = key
+            kept_base = False
+        if row["$timestamp"] > retention_timestamp:
+            out.append(row)
+        elif not kept_base:
+            kept_base = True
+            if not row["$tombstone"]:
+                out.append(row)
+    return out
+
+
+def _merge_versions(versions: list[tuple[int, Optional[dict]]],
+                    timestamp: int) -> Optional[dict]:
+    """Newest visible state from (ts, full-state-or-None) pairs."""
+    best_ts = -1
+    best_state: Optional[dict] = None
+    found = False
+    for ts, state in versions:
+        if ts <= timestamp and ts > best_ts:
+            best_ts = ts
+            best_state = state
+            found = True
+    if not found or best_state is None:
+        return None
+    return dict(best_state)
+
+
+def _chunk_lookup_versions(chunk: ColumnarChunk, schema: TableSchema,
+                           key: tuple, host_planes: dict
+                           ) -> list[tuple[int, Optional[dict]]]:
+    rows = _chunk_key_rows(chunk, schema, key, host_planes)
+    out = []
+    value_names = [c.name for c in schema if c.sort_order is None]
+    for row in rows:
+        if row["$tombstone"]:
+            out.append((row["$timestamp"], None))
+        else:
+            out.append((row["$timestamp"],
+                        {name: row.get(name) for name in value_names}))
+    return out
+
+
+def _chunk_last_timestamp(chunk: ColumnarChunk, schema: TableSchema,
+                          key: tuple, host_planes: dict) -> Optional[int]:
+    rows = _chunk_key_rows(chunk, schema, key, host_planes)
+    if not rows:
+        return None
+    return max(r["$timestamp"] for r in rows)
+
+
+def _chunk_key_rows(chunk: ColumnarChunk, schema: TableSchema,
+                    key: tuple, host_planes: dict) -> list[dict]:
+    """Rows matching `key` in a versioned chunk: vectorized mask over the
+    cached host planes, then decode ONLY the matched rows."""
+    n = chunk.row_count
+    if n == 0:
+        return []
+    mask = np.ones(n, dtype=bool)
+    for name, value in zip(schema.key_column_names, key):
+        col = chunk.columns[name]
+        data, valid = host_planes[name]
+        if value is None:
+            mask &= ~valid
+        elif col.type is EValueType.string:
+            code = None
+            if col.dictionary is not None and len(col.dictionary):
+                target = value if isinstance(value, bytes) else \
+                    str(value).encode()
+                idx = np.searchsorted(col.dictionary, target)
+                if idx < len(col.dictionary) and col.dictionary[idx] == target:
+                    code = idx
+            if code is None:
+                return []
+            mask &= valid & (data == code)
+        else:
+            mask &= valid & (data == value)
+        if not mask.any():
+            return []
+    idx = np.nonzero(mask)[0]
+    # Decode only the matched rows (idx is usually tiny vs n).
+    rows = []
+    cols = {name: chunk.columns[name] for name in chunk.schema.column_names}
+    host = host_planes
+    for i in idx:
+        row = {}
+        for name, col in cols.items():
+            data, valid = host[name]
+            if not valid[i]:
+                row[name] = None
+            elif col.type is EValueType.string:
+                row[name] = bytes(col.dictionary[int(data[i])])
+            elif col.type is EValueType.any:
+                row[name] = (col.host_values or [None] * n)[i]
+            elif col.type is EValueType.boolean:
+                row[name] = bool(data[i])
+            elif col.type is EValueType.double:
+                row[name] = float(data[i])
+            else:
+                row[name] = int(data[i])
+        rows.append(row)
+    return rows

@@ -1,0 +1,45 @@
+"""Hybrid logical clock timestamps.
+
+Ref: yt/yt/server/timestamp_provider + client/transaction_client — cluster
+timestamps are (unix_time << 30) | counter, totally ordered, monotone.
+A single in-process provider stands in for the clock quorum; the interface
+matches what a distributed quorum implementation would expose.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+COUNTER_BITS = 30
+MIN_TIMESTAMP = 0
+MAX_TIMESTAMP = (1 << 62) - 1
+# Sync-read sentinel (ref NTransactionClient::SyncLastCommittedTimestamp).
+SYNC_LAST_COMMITTED = MAX_TIMESTAMP - 1
+ASYNC_LAST_COMMITTED = MAX_TIMESTAMP - 2
+
+
+class TimestampProvider:
+    """Monotone hybrid timestamps; thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = 0
+
+    def generate(self) -> int:
+        with self._lock:
+            wall = int(time.time()) << COUNTER_BITS
+            candidate = max(wall, self._last + 1)
+            self._last = candidate
+            return candidate
+
+    def last(self) -> int:
+        with self._lock:
+            return self._last
+
+
+_global_provider = TimestampProvider()
+
+
+def generate_timestamp() -> int:
+    return _global_provider.generate()

@@ -1,0 +1,143 @@
+"""Tablet transactions: snapshot-isolated writes with 2PC across tablets.
+
+Ref mapping:
+  transaction start/commit/abort       → tablet_node/transaction_manager.h
+  client-side row buffering per tablet → ytlib/api/native/transaction.cpp
+                                         (ModifyRows batching)
+  2PC prepare/commit                   → server/lib/transaction_supervisor
+Conflict model (ref sorted_dynamic_store row locks): at prepare, a write to
+key K conflicts if (a) another transaction holds a prepared lock on K, or
+(b) a commit newer than our start timestamp already touched K.  Prepare
+locks all keys on all participant tablets, then commit applies everywhere at
+one commit timestamp — the single-process stand-in for coordinator+
+participants exchanging Hive messages.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.tablet.tablet import Tablet
+from ytsaurus_tpu.tablet.timestamp import TimestampProvider
+
+
+@dataclass
+class _Modification:
+    kind: str                 # "write" | "delete"
+    row: dict | tuple
+
+
+@dataclass
+class TabletTransaction:
+    id: str
+    start_timestamp: int
+    modifications: dict[int, list[_Modification]] = field(default_factory=dict)
+    state: str = "active"     # active | committed | aborted
+
+    def _record(self, tablet_key: int, mod: _Modification):
+        if self.state != "active":
+            raise YtError(f"Transaction {self.id} is {self.state}",
+                          code=EErrorCode.NoSuchTransaction)
+        self.modifications.setdefault(tablet_key, []).append(mod)
+
+
+class TransactionManager:
+    """Coordinates transactions over a set of tablets (one per process —
+    the analog of a tablet cell's transaction manager + supervisor)."""
+
+    def __init__(self, timestamp_provider: Optional[TimestampProvider] = None):
+        self.timestamps = timestamp_provider or TimestampProvider()
+        self._tablets: dict[int, Tablet] = {}
+        self._prepared_locks: dict[tuple[int, tuple], str] = {}
+        self._lock = threading.Lock()
+        self._transactions: dict[str, TabletTransaction] = {}
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> TabletTransaction:
+        tx = TabletTransaction(id=uuid.uuid4().hex,
+                               start_timestamp=self.timestamps.generate())
+        self._transactions[tx.id] = tx
+        return tx
+
+    def write_rows(self, tx: TabletTransaction, tablet: Tablet,
+                   rows: list[dict]) -> None:
+        key = id(tablet)
+        self._tablets[key] = tablet
+        for row in rows:
+            tx._record(key, _Modification("write", dict(row)))
+
+    def delete_rows(self, tx: TabletTransaction, tablet: Tablet,
+                    keys: list[tuple]) -> None:
+        key = id(tablet)
+        self._tablets[key] = tablet
+        for k in keys:
+            tx._record(key, _Modification("delete", tuple(k)))
+
+    def abort(self, tx: TabletTransaction) -> None:
+        with self._lock:
+            self._release_locks(tx)
+            tx.state = "aborted"
+
+    # -- 2PC -------------------------------------------------------------------
+
+    def commit(self, tx: TabletTransaction) -> int:
+        """Prepare (lock + conflict check on every participant), then commit
+        at a fresh timestamp.  Raises TransactionLockConflict and aborts on
+        any conflict."""
+        if tx.state != "active":
+            raise YtError(f"Transaction {tx.id} is {tx.state}",
+                          code=EErrorCode.NoSuchTransaction)
+        touched: list[tuple[int, tuple]] = []
+        for tablet_key, mods in tx.modifications.items():
+            tablet = self._tablets[tablet_key]
+            for mod in mods:
+                row_key = (tablet.active_store.key_of(mod.row)
+                           if mod.kind == "write" else tuple(mod.row))
+                touched.append((tablet_key, tablet.normalize_key(row_key)))
+        with self._lock:
+            # Phase 1: prepare — acquire locks, detect conflicts.
+            acquired: list[tuple[int, tuple]] = []
+            try:
+                for tablet_key, row_key in touched:
+                    holder = self._prepared_locks.get((tablet_key, row_key))
+                    if holder is not None and holder != tx.id:
+                        raise YtError(
+                            f"Row lock conflict on key {row_key}",
+                            code=EErrorCode.TransactionLockConflict,
+                            attributes={"winner": holder})
+                    tablet = self._tablets[tablet_key]
+                    last = tablet.last_committed_timestamp(row_key)
+                    if last is not None and last > tx.start_timestamp:
+                        raise YtError(
+                            f"Write conflict on key {row_key}: committed at "
+                            f"{last} > start {tx.start_timestamp}",
+                            code=EErrorCode.TransactionLockConflict)
+                    self._prepared_locks[(tablet_key, row_key)] = tx.id
+                    acquired.append((tablet_key, row_key))
+            except YtError:
+                for lk in acquired:
+                    self._prepared_locks.pop(lk, None)
+                tx.state = "aborted"
+                raise
+            # Phase 2: commit at one timestamp on every participant.
+            commit_ts = self.timestamps.generate()
+            for tablet_key, mods in tx.modifications.items():
+                tablet = self._tablets[tablet_key]
+                for mod in mods:
+                    if mod.kind == "write":
+                        tablet.write_row(mod.row, commit_ts)
+                    else:
+                        tablet.delete_row(mod.row, commit_ts)
+            self._release_locks(tx)
+            tx.state = "committed"
+            return commit_ts
+
+    def _release_locks(self, tx: TabletTransaction) -> None:
+        for lk in [k for k, holder in self._prepared_locks.items()
+                   if holder == tx.id]:
+            self._prepared_locks.pop(lk, None)

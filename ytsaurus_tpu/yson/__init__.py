@@ -1,0 +1,18 @@
+"""YSON: YT's object notation (ref yt/yt/core/yson) — text + binary."""
+
+from ytsaurus_tpu.yson.parser import loads
+from ytsaurus_tpu.yson.types import (
+    YsonBoolean,
+    YsonDouble,
+    YsonEntity,
+    YsonInt64,
+    YsonList,
+    YsonMap,
+    YsonString,
+    YsonType,
+    YsonUint64,
+    YsonUnicode,
+    get_attributes,
+    to_yson_type,
+)
+from ytsaurus_tpu.yson.writer import dumps
