@@ -1,0 +1,245 @@
+"""Client facade integration tests: the local-mode cluster end to end.
+
+Ref model: yt/python/yt/wrapper usage patterns over a YTInstance local
+cluster (yt_env.py) — cypress ops, static tables, dynamic tables,
+operations, select_rows.
+"""
+
+import pytest
+
+from ytsaurus_tpu import YtError
+from ytsaurus_tpu.client import connect, infer_schema
+from ytsaurus_tpu.schema import TableSchema
+
+
+@pytest.fixture
+def client(tmp_path):
+    return connect(str(tmp_path))
+
+
+# --- cypress ------------------------------------------------------------------
+
+def test_cypress_crud(client):
+    client.create("map_node", "//home/user", recursive=True)
+    client.set("//home/user/@owner", "tester")
+    assert client.get("//home/user/@owner") == "tester"
+    assert client.exists("//home/user")
+    assert client.list("//home") == ["user"]
+    client.create("document", "//home/user/doc")
+    client.set("//home/user/doc", {"a": [1, 2]})
+    assert client.get("//home/user/doc") == {"a": [1, 2]}
+    client.remove("//home/user")
+    assert not client.exists("//home/user")
+
+
+def test_master_recovery(tmp_path):
+    client = connect(str(tmp_path))
+    client.create("map_node", "//data", recursive=True)
+    client.set("//data/@answer", 42)
+    client.write_table("//data/t", [{"x": 1}, {"x": 2}])
+    # Re-open the cluster from disk: WAL replay must restore everything.
+    reopened = connect(str(tmp_path))
+    assert reopened.get("//data/@answer") == 42
+    assert reopened.read_table("//data/t") == [{"x": 1}, {"x": 2}]
+    # Snapshot + more mutations + recovery.
+    reopened.cluster.master.build_snapshot()
+    reopened.set("//data/@post_snapshot", True)
+    third = connect(str(tmp_path))
+    assert third.get("//data/@answer") == 42
+    assert third.get("//data/@post_snapshot") is True
+
+
+# --- static tables ------------------------------------------------------------
+
+def test_write_read_table_roundtrip(client):
+    rows = [{"name": "a", "score": 1.5}, {"name": "b", "score": None}]
+    client.write_table("//tmp/t", rows)
+    assert client.read_table("//tmp/t") == \
+        [{"name": b"a", "score": 1.5}, {"name": b"b", "score": None}]
+    assert client.get("//tmp/t/@row_count") == 2
+
+
+def test_append_creates_multiple_chunks(client):
+    client.write_table("//tmp/t", [{"x": 1}])
+    client.write_table("//tmp/t", [{"x": 2}], append=True)
+    assert client.get("//tmp/t/@row_count") == 2
+    assert len(client.get("//tmp/t/@chunk_ids")) == 2
+    assert sorted(r["x"] for r in client.read_table("//tmp/t")) == [1, 2]
+
+
+def test_infer_schema():
+    schema = infer_schema([{"a": 1, "b": "x"}, {"a": 2.5, "b": None}])
+    assert schema.get("a").type.value == "double"
+    assert schema.get("b").type.value == "string"
+
+
+def test_select_over_static_table(client):
+    client.write_table("//tmp/t", [{"k": i, "v": i * 2} for i in range(10)])
+    rows = client.select_rows("sum(v) AS s FROM [//tmp/t] GROUP BY 1 AS one")
+    assert rows == [{"s": 90}]
+
+
+def test_select_multi_chunk_distributed(client):
+    for i in range(3):
+        client.write_table("//tmp/t", [{"k": j + i * 10, "g": j % 2}
+                                       for j in range(10)], append=bool(i))
+    rows = client.select_rows(
+        "g, count(*) AS c FROM [//tmp/t] GROUP BY g")
+    assert sorted((r["g"], r["c"]) for r in rows) == [(0, 15), (1, 15)]
+
+
+# --- dynamic tables -----------------------------------------------------------
+
+DYN_SCHEMA = TableSchema.make([
+    ("key", "int64", "ascending"), ("value", "string")], unique_keys=True)
+
+
+def test_dynamic_table_lifecycle(client):
+    client.create("table", "//dyn/t", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/t")
+    client.insert_rows("//dyn/t", [{"key": 1, "value": "one"},
+                                   {"key": 2, "value": "two"}])
+    assert client.lookup_rows("//dyn/t", [(1,)]) == \
+        [{"key": 1, "value": b"one"}]
+    rows = client.select_rows("key, value FROM [//dyn/t] WHERE key > 1")
+    assert rows == [{"key": 2, "value": b"two"}]
+    # Unmount persists; remount restores.
+    client.unmount_table("//dyn/t")
+    client.mount_table("//dyn/t")
+    assert client.lookup_rows("//dyn/t", [(2,)]) == \
+        [{"key": 2, "value": b"two"}]
+
+
+def test_dynamic_table_transactions(client):
+    client.create("table", "//dyn/t", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/t")
+    tx = client.start_transaction()
+    client.insert_rows("//dyn/t", [{"key": 1, "value": "tx"}], tx=tx)
+    # Not visible before commit.
+    assert client.lookup_rows("//dyn/t", [(1,)]) == [None]
+    client.commit_transaction(tx)
+    assert client.lookup_rows("//dyn/t", [(1,)])[0]["value"] == b"tx"
+
+
+def test_select_joins_static_dimension(client):
+    client.create("table", "//dyn/facts", recursive=True,
+                  attributes={"schema": TableSchema.make(
+                      [("k", "int64", "ascending"), ("g", "int64")],
+                      unique_keys=True), "dynamic": True})
+    client.mount_table("//dyn/facts")
+    client.insert_rows("//dyn/facts", [{"k": i, "g": i % 2}
+                                       for i in range(6)])
+    client.write_table("//tmp/dim", [{"g": 0, "name": "even"},
+                                     {"g": 1, "name": "odd"}])
+    rows = client.select_rows(
+        "name, count(*) AS c FROM [//dyn/facts] "
+        "JOIN [//tmp/dim] USING g GROUP BY name")
+    assert sorted((r["name"], r["c"]) for r in rows) == \
+        [(b"even", 3), (b"odd", 3)]
+
+
+# --- operations ---------------------------------------------------------------
+
+def test_sort_operation(client):
+    client.write_table("//tmp/in", [{"k": 5 - i, "v": i} for i in range(5)])
+    op = client.run_sort("//tmp/in", "//tmp/out", sort_by="k")
+    assert op.state == "completed"
+    out = client.read_table("//tmp/out")
+    assert [r["k"] for r in out] == [1, 2, 3, 4, 5]
+    assert client.get("//tmp/out/@sorted_by") == ["k"]
+    # Operation recorded in cypress.
+    ops = client.list("//sys/operations")
+    assert op.id in ops
+    assert client.get(f"//sys/operations/{op.id}/@state") == "completed"
+
+
+def test_merge_operation_sorted(client):
+    client.write_table("//tmp/a", [{"k": 1}, {"k": 3}])
+    client.write_table("//tmp/b", [{"k": 2}, {"k": 4}])
+    op = client.run_merge(["//tmp/a", "//tmp/b"], "//tmp/m", mode="sorted",
+                          merge_by=["k"])
+    assert op.state == "completed"
+    assert [r["k"] for r in client.read_table("//tmp/m")] == [1, 2, 3, 4]
+
+
+def test_map_operation(client):
+    client.write_table("//tmp/in", [{"x": i} for i in range(4)])
+
+    def mapper(rows):
+        return [{"y": r["x"] * 10} for r in rows if r["x"] % 2 == 0]
+
+    op = client.run_map(mapper, "//tmp/in", "//tmp/out")
+    assert op.state == "completed"
+    assert sorted(r["y"] for r in client.read_table("//tmp/out")) == [0, 20]
+
+
+def test_failed_operation_records_error(client):
+    client.write_table("//tmp/in", [{"x": 1}])
+
+    def bad_mapper(rows):
+        raise RuntimeError("boom")
+
+    with pytest.raises(YtError):
+        client.run_map(bad_mapper, "//tmp/in", "//tmp/out")
+    ops = client.scheduler.list_operations()
+    assert ops[-1].state == "failed"
+    assert "boom" in str(ops[-1].error)
+
+
+def test_sort_then_query_pipeline(client):
+    # The classic platform flow: ingest → sort → query.
+    client.write_table("//tmp/events",
+                       [{"user": f"u{i % 3}", "amount": i} for i in range(30)])
+    client.run_sort("//tmp/events", "//tmp/events_sorted", sort_by="user")
+    rows = client.select_rows(
+        "user, sum(amount) AS total FROM [//tmp/events_sorted] GROUP BY user")
+    assert sorted((r["user"], r["total"]) for r in rows) == \
+        [(b"u0", 135), (b"u1", 145), (b"u2", 155)]
+
+
+# --- regression: review findings ---------------------------------------------
+
+def test_torn_changelog_tail_truncated(tmp_path):
+    client = connect(str(tmp_path))
+    client.create("map_node", "//a", recursive=True)
+    # Simulate a torn tail write.
+    log = str(tmp_path) + "/master/changelog.log"
+    with open(log, "ab") as f:
+        f.write(b"\x7f\x01\x02")          # garbage partial record
+    re1 = connect(str(tmp_path))
+    re1.create("map_node", "//b", recursive=True)
+    re2 = connect(str(tmp_path))
+    assert re2.exists("//a") and re2.exists("//b")
+
+
+def test_map_to_empty_output(client):
+    client.write_table("//tmp/in", [{"x": 1}])
+    op = client.run_map(lambda rows: [], "//tmp/in", "//tmp/out")
+    assert op.state == "completed"
+    assert client.read_table("//tmp/out") == []
+
+
+def test_create_under_table_rejected(client):
+    client.write_table("//tmp/t", [{"x": 1}])
+    with pytest.raises(YtError):
+        client.create("map_node", "//tmp/t/sub/x", recursive=True)
+
+
+def test_remove_ancestor_evicts_tablets(client):
+    client.create("table", "//dyn/t", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/t")
+    client.insert_rows("//dyn/t", [{"key": 1, "value": "x"}])
+    assert len(client.cluster.tablets) == 1
+    client.remove("//dyn")
+    assert len(client.cluster.tablets) == 0
+
+
+def test_overwrite_clears_sorted_by(client):
+    client.write_table("//tmp/in", [{"k": 2}, {"k": 1}])
+    client.run_sort("//tmp/in", "//tmp/out", sort_by="k")
+    assert client.get("//tmp/out/@sorted_by") == ["k"]
+    client.write_table("//tmp/out", [{"k": 9}, {"k": 3}])
+    assert not client.exists("//tmp/out/@sorted_by")

@@ -1,0 +1,408 @@
+"""The client API: a local in-process cluster + the IClient-shaped facade.
+
+Ref mapping:
+  NApi::IClient surface (client/api/client.h)     → YtClient methods
+  yt local mode / YTInstance test clusters
+    (yt/python/yt/environment/yt_env.py)          → YtCluster(root_dir)
+  driver command registry (client/driver)         → method-per-command here
+
+Cypress commands: create/get/set/list/exists/remove.
+Static tables: write_table/read_table (columnar chunks in the chunk store,
+chunk ids recorded as table attributes).
+Dynamic tables: mount/unmount, insert/delete/lookup/select, flush/compact.
+Operations: run_sort/run_merge/run_map/run_erase via the scheduler.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
+from ytsaurus_tpu.chunks.store import ChunkCache, FsChunkStore
+from ytsaurus_tpu.cypress.master import Master
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+from ytsaurus_tpu.query.engine.evaluator import Evaluator
+from ytsaurus_tpu.schema import EValueType, TableSchema
+from ytsaurus_tpu.tablet.tablet import Tablet
+from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+from ytsaurus_tpu.tablet.transactions import TabletTransaction, TransactionManager
+
+
+class YtCluster:
+    """Everything one process needs to be a cluster (local mode)."""
+
+    def __init__(self, root_dir: str):
+        self.root_dir = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self.master = Master(os.path.join(root_dir, "master"))
+        self.chunk_store = FsChunkStore(os.path.join(root_dir, "chunks"))
+        self.chunk_cache = ChunkCache(self.chunk_store)
+        self.transactions = TransactionManager()
+        self.evaluator = Evaluator()
+        self.tablets: dict[str, list[Tablet]] = {}   # node id → tablets
+
+
+class YtClient:
+    def __init__(self, cluster: YtCluster):
+        self.cluster = cluster
+        from ytsaurus_tpu.operations.scheduler import OperationScheduler
+        self.scheduler = OperationScheduler(self)
+
+    # ------------------------------------------------------------------ cypress
+
+    def create(self, node_type: str, path: str,
+               attributes: Optional[dict] = None, recursive: bool = False,
+               ignore_existing: bool = False) -> str:
+        attributes = dict(attributes or {})
+        if node_type == "table":
+            schema = attributes.get("schema")
+            if isinstance(schema, TableSchema):
+                attributes["schema"] = schema.to_dict()
+            attributes.setdefault("dynamic", False)
+            attributes.setdefault("chunk_ids", [])
+            attributes.setdefault("row_count", 0)
+        return self.cluster.master.commit_mutation(
+            "create", path=path, type=node_type, attributes=attributes,
+            recursive=recursive, ignore_existing=ignore_existing)
+
+    def get(self, path: str) -> Any:
+        return self.cluster.master.tree.get(path)
+
+    def set(self, path: str, value: Any) -> None:
+        self.cluster.master.commit_mutation("set", path=path, value=value)
+
+    def exists(self, path: str) -> bool:
+        return self.cluster.master.tree.exists(path)
+
+    def list(self, path: str) -> list[str]:
+        return self.cluster.master.tree.list(path)
+
+    def remove(self, path: str, recursive: bool = True,
+               force: bool = False) -> None:
+        node = self.cluster.master.tree.try_resolve(path)
+        if node is not None:
+            # Evict tablets of every dynamic table in the removed subtree.
+            stack = [node]
+            while stack:
+                current = stack.pop()
+                self.cluster.tablets.pop(current.id, None)
+                stack.extend(current.children.values())
+        self.cluster.master.commit_mutation(
+            "remove", path=path, recursive=recursive, force=force)
+
+    # ------------------------------------------------------------- static tables
+
+    def write_table(self, path: str, rows: Sequence[dict],
+                    append: bool = False,
+                    schema: "TableSchema | dict | None" = None) -> None:
+        node = self._table_node(path, create=True, schema=schema)
+        if node.attributes.get("dynamic"):
+            raise YtError("write_table on a dynamic table; use insert_rows",
+                          code=EErrorCode.QueryUnsupported)
+        table_schema = self._node_schema(node)
+        if table_schema is None and rows:
+            table_schema = infer_schema(rows)
+            self.set(path + "/@schema", table_schema.to_dict())
+        chunks: list[str] = list(node.attributes.get("chunk_ids", [])) \
+            if append else []
+        row_count = int(node.attributes.get("row_count", 0)) if append else 0
+        if rows:
+            chunk = ColumnarChunk.from_rows(table_schema, list(rows))
+            chunks.append(self.cluster.chunk_store.write_chunk(chunk))
+            row_count += chunk.row_count
+        self.set(path + "/@chunk_ids", chunks)
+        self.set(path + "/@row_count", row_count)
+        # Arbitrary rows invalidate any prior sort guarantee.
+        if "sorted_by" in node.attributes:
+            self.cluster.master.commit_mutation(
+                "remove", path=path + "/@sorted_by", force=True)
+
+    def read_table(self, path: str) -> list[dict]:
+        chunks = self._read_table_chunks(path)
+        rows: list[dict] = []
+        for chunk in chunks:
+            rows.extend(chunk.to_rows())
+        return rows
+
+    # ------------------------------------------------------------ dynamic tables
+
+    def mount_table(self, path: str) -> None:
+        node = self._table_node(path)
+        schema = self._node_schema(node)
+        if schema is None or not schema.is_sorted:
+            raise YtError("mount_table requires a sorted schema",
+                          code=EErrorCode.TabletNotMounted)
+        if not node.attributes.get("dynamic"):
+            raise YtError(f"Table {path!r} is not dynamic; "
+                          "create with attributes={'dynamic': True}",
+                          code=EErrorCode.TabletNotMounted)
+        if node.id in self.cluster.tablets:
+            return
+        tablet = Tablet(schema, self.cluster.chunk_store,
+                        tablet_id=f"{node.id}-0",
+                        chunk_cache=self.cluster.chunk_cache)
+        tablet.chunk_ids = list(node.attributes.get("tablet_chunk_ids", []))
+        self.cluster.tablets[node.id] = [tablet]
+        self.set(path + "/@tablet_state", "mounted")
+
+    def unmount_table(self, path: str) -> None:
+        node = self._table_node(path)
+        tablets = self.cluster.tablets.pop(node.id, None)
+        if tablets is None:
+            return
+        chunk_ids: list[str] = []
+        for tablet in tablets:
+            tablet.flush()
+            chunk_ids.extend(tablet.chunk_ids)
+            tablet.mounted = False
+        self.set(path + "/@tablet_chunk_ids", chunk_ids)
+        self.set(path + "/@tablet_state", "unmounted")
+
+    def freeze_table(self, path: str) -> None:
+        for tablet in self._mounted_tablets(path):
+            tablet.flush()
+        self._persist_tablet_chunks(path)
+
+    def compact_table(self, path: str,
+                      retention_timestamp: Optional[int] = None) -> None:
+        ts = retention_timestamp if retention_timestamp is not None else \
+            self.cluster.transactions.timestamps.generate()
+        for tablet in self._mounted_tablets(path):
+            tablet.flush()
+            tablet.compact(retention_timestamp=ts)
+        self._persist_tablet_chunks(path)
+
+    def start_transaction(self) -> TabletTransaction:
+        return self.cluster.transactions.start()
+
+    def commit_transaction(self, tx: TabletTransaction) -> int:
+        return self.cluster.transactions.commit(tx)
+
+    def abort_transaction(self, tx: TabletTransaction) -> None:
+        self.cluster.transactions.abort(tx)
+
+    def insert_rows(self, path: str, rows: Sequence[dict],
+                    tx: Optional[TabletTransaction] = None) -> Optional[int]:
+        tablets = self._mounted_tablets(path)
+        txm = self.cluster.transactions
+        own = tx is None
+        tx = tx or txm.start()
+        txm.write_rows(tx, tablets[0], list(rows))
+        if own:
+            return txm.commit(tx)
+        return None
+
+    def delete_rows(self, path: str, keys: Sequence[tuple],
+                    tx: Optional[TabletTransaction] = None) -> Optional[int]:
+        tablets = self._mounted_tablets(path)
+        txm = self.cluster.transactions
+        own = tx is None
+        tx = tx or txm.start()
+        txm.delete_rows(tx, tablets[0], [tuple(k) for k in keys])
+        if own:
+            return txm.commit(tx)
+        return None
+
+    def lookup_rows(self, path: str, keys: Sequence[tuple],
+                    timestamp: int = MAX_TIMESTAMP,
+                    column_names: Optional[Sequence[str]] = None
+                    ) -> list[Optional[dict]]:
+        (tablet,) = self._mounted_tablets(path)
+        return tablet.lookup_rows([tuple(k) for k in keys],
+                                  timestamp=timestamp,
+                                  column_names=column_names)
+
+    # --------------------------------------------------------------------- query
+
+    def select_rows(self, query: str,
+                    timestamp: int = MAX_TIMESTAMP) -> list[dict]:
+        """Distributed QL over static and mounted dynamic tables."""
+        plan = build_query(query, _SchemaResolver(self))
+        source_chunks = self._query_shards(plan.source, timestamp)
+        foreign = {}
+        for join in plan.joins:
+            shards = self._query_shards(join.foreign_table, timestamp)
+            foreign[join.foreign_table] = (
+                concat_chunks(shards) if len(shards) > 1 else shards[0])
+        out = coordinate_and_execute(plan, source_chunks, foreign,
+                                     evaluator=self.cluster.evaluator)
+        return out.to_rows()
+
+    # ---------------------------------------------------------------- operations
+
+    def run_sort(self, input_path: str, output_path: str,
+                 sort_by: "str | Sequence[str]", **kwargs):
+        return self.scheduler.start_operation("sort", {
+            "input_table_path": input_path, "output_table_path": output_path,
+            "sort_by": list(sort_by) if not isinstance(sort_by, str)
+            else sort_by, **kwargs})
+
+    def run_merge(self, input_paths: Sequence[str], output_path: str,
+                  mode: str = "unordered", **kwargs):
+        return self.scheduler.start_operation("merge", {
+            "input_table_paths": list(input_paths),
+            "output_table_path": output_path, "mode": mode, **kwargs})
+
+    def run_map(self, mapper: Callable, input_path: str, output_path: str,
+                **kwargs):
+        return self.scheduler.start_operation("map", {
+            "mapper": mapper, "input_table_path": input_path,
+            "output_table_path": output_path, **kwargs})
+
+    def run_erase(self, table_path: str, **kwargs):
+        return self.scheduler.start_operation(
+            "erase", {"table_path": table_path, **kwargs})
+
+    # ----------------------------------------------------------------- internals
+
+    def _table_node(self, path: str, create: bool = False,
+                    schema: "TableSchema | dict | None" = None):
+        tree = self.cluster.master.tree
+        node = tree.try_resolve(path)
+        if node is None:
+            if not create:
+                raise YtError(f"No such table {path!r}",
+                              code=EErrorCode.NoSuchNode)
+            attributes = {}
+            if schema is not None:
+                attributes["schema"] = (
+                    schema.to_dict() if isinstance(schema, TableSchema)
+                    else schema)
+            self.create("table", path, attributes=attributes, recursive=True)
+            node = tree.resolve(path)
+        if node.type != "table":
+            raise YtError(f"{path!r} is not a table (type {node.type})",
+                          code=EErrorCode.ResolveError)
+        return node
+
+    def _node_schema(self, node) -> Optional[TableSchema]:
+        schema = node.attributes.get("schema")
+        if schema is None:
+            return None
+        return TableSchema.from_dict(schema)
+
+    def _mounted_tablets(self, path: str) -> list[Tablet]:
+        node = self._table_node(path)
+        tablets = self.cluster.tablets.get(node.id)
+        if tablets is None:
+            raise YtError(f"Table {path!r} is not mounted",
+                          code=EErrorCode.TabletNotMounted)
+        return tablets
+
+    def _persist_tablet_chunks(self, path: str) -> None:
+        node = self._table_node(path)
+        tablets = self.cluster.tablets.get(node.id, [])
+        chunk_ids: list[str] = []
+        for tablet in tablets:
+            chunk_ids.extend(tablet.chunk_ids)
+        self.set(path + "/@tablet_chunk_ids", chunk_ids)
+
+    def _read_table_chunks(self, path: str) -> list[ColumnarChunk]:
+        node = self._table_node(path)
+        if node.attributes.get("dynamic"):
+            return self._query_shards(path, MAX_TIMESTAMP)
+        return [self.cluster.chunk_cache.get(cid)
+                for cid in node.attributes.get("chunk_ids", [])]
+
+    def _write_table_chunks(self, path: str, chunks: list[ColumnarChunk],
+                            sorted_by: Optional[list[str]] = None,
+                            schema: Optional[TableSchema] = None) -> None:
+        node = self._table_node(path, create=True, schema=schema)
+        chunk_ids = [self.cluster.chunk_store.write_chunk(c) for c in chunks]
+        total = sum(c.row_count for c in chunks)
+        if schema is not None:
+            self.set(path + "/@schema", schema.to_dict())
+        self.set(path + "/@chunk_ids", chunk_ids)
+        self.set(path + "/@row_count", total)
+        if sorted_by:
+            self.set(path + "/@sorted_by", list(sorted_by))
+        elif "sorted_by" in node.attributes:
+            self.cluster.master.commit_mutation(
+                "remove", path=path + "/@sorted_by", force=True)
+
+    def _query_shards(self, path: str, timestamp: int) -> list[ColumnarChunk]:
+        node = self._table_node(path)
+        if node.attributes.get("dynamic"):
+            tablets = self._mounted_tablets(path)
+            return [t.read_snapshot(timestamp) for t in tablets]
+        chunks = [self.cluster.chunk_cache.get(cid)
+                  for cid in node.attributes.get("chunk_ids", [])]
+        if not chunks:
+            schema = self._node_schema(node)
+            if schema is None:
+                raise YtError(f"Empty table {path!r} has no schema",
+                              code=EErrorCode.NoSuchNode)
+            return [ColumnarChunk.from_rows(schema.to_unsorted(), [])]
+        return chunks
+
+
+class _SchemaResolver(dict):
+    """Lazy table-path → schema mapping for the query builder.
+
+    Schemas are presented unsorted: query shards are snapshot/decoded chunks
+    whose schemas carry no sort annotations."""
+
+    def __init__(self, client: YtClient):
+        super().__init__()
+        self.client = client
+
+    def __contains__(self, path) -> bool:
+        return self.client.exists(path)
+
+    def __getitem__(self, path) -> TableSchema:
+        node = self.client._table_node(path)
+        schema = self.client._node_schema(node)
+        if schema is None:
+            raise YtError(f"Table {path!r} has no schema",
+                          code=EErrorCode.QueryTypeError)
+        return schema.to_unsorted()
+
+
+def infer_schema(rows: Sequence[dict]) -> TableSchema:
+    """Infer a schema from row dicts (write_table without explicit schema)."""
+    if not rows:
+        raise YtError("Cannot infer a schema from zero rows")
+    types: dict[str, EValueType] = {}
+    order: list[str] = []
+    for row in rows:
+        for name, value in row.items():
+            if name not in types:
+                order.append(name)
+                types[name] = _value_type(value)
+            else:
+                current = types[name]
+                observed = _value_type(value)
+                if current is EValueType.null:
+                    types[name] = observed
+                elif observed is not EValueType.null and observed != current:
+                    if {observed, current} <= {EValueType.int64,
+                                               EValueType.double}:
+                        types[name] = EValueType.double
+                    else:
+                        types[name] = EValueType.any
+    return TableSchema.make(
+        [(name, (types[name] if types[name] is not EValueType.null
+                 else EValueType.int64).value) for name in order])
+
+
+def _value_type(value) -> EValueType:
+    if value is None:
+        return EValueType.null
+    if isinstance(value, bool):
+        return EValueType.boolean
+    if isinstance(value, int):
+        return EValueType.int64 if -(2**63) <= value < 2**63 \
+            else EValueType.uint64
+    if isinstance(value, float):
+        return EValueType.double
+    if isinstance(value, (str, bytes)):
+        return EValueType.string
+    return EValueType.any
+
+
+def connect(root_dir: str) -> YtClient:
+    """Open (or create) a local cluster rooted at `root_dir`."""
+    return YtClient(YtCluster(root_dir))

@@ -1,0 +1,168 @@
+"""Master: the metadata authority — WAL-then-apply mutations + snapshots.
+
+Ref: Hydra's mutation pipeline (server/lib/hydra/hydra_manager.h
+CommitMutation → decorated_automaton WAL-append-then-apply, snapshot build/
+load in composite_automaton.h).  Single-replica stand-in with the same
+durability contract: every mutation is appended (fsync'd) to the changelog
+BEFORE applying to the in-memory tree; recovery = load last snapshot +
+replay the changelog; snapshots truncate the log.
+
+A real multi-peer deployment replicates the changelog via a quorum before
+apply — the apply/recover machinery here is the automaton that would sit
+under it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+from ytsaurus_tpu import yson
+from ytsaurus_tpu.cypress.tree import CypressTree
+from ytsaurus_tpu.errors import YtError
+from ytsaurus_tpu.utils.varint import encode_varint_u, read_varint_u
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class Changelog:
+    """Length-prefixed YSON records, fsync'd on append (ref: file changelogs,
+    server/lib/hydra/changelog.h)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._file = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def append(self, record: dict) -> None:
+        blob = yson.dumps(record, binary=True)
+        with self._lock:
+            self._file.write(encode_varint_u(len(blob)) + blob)
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    def close(self) -> None:
+        self._file.close()
+
+    @staticmethod
+    def read_all(path: str) -> tuple[list[dict], int]:
+        """Returns (records, valid_byte_length).  A torn tail write stops the
+        scan; the caller MUST truncate to valid_byte_length before appending,
+        or post-recovery records land after garbage and vanish on the next
+        recovery."""
+        records = []
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return [], 0
+        pos = 0
+        valid = 0
+        while pos < len(data):
+            try:
+                length, pos = read_varint_u(data, pos)
+                blob = data[pos:pos + length]
+                if len(blob) != length:
+                    break              # torn tail write → stop at last good
+                records.append(yson.loads(blob))
+                pos += length
+                valid = pos
+            except (ValueError, YtError):
+                break
+        return records, valid
+
+
+class Master:
+    """Applies named mutations through the WAL; exposes the Cypress tree."""
+
+    SNAPSHOT = "snapshot.yson"
+    CHANGELOG = "changelog.log"
+
+    def __init__(self, root_dir: str):
+        self.root_dir = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self._lock = threading.RLock()
+        self.tree = CypressTree()
+        self._recover()
+        self.changelog = Changelog(os.path.join(root_dir, self.CHANGELOG))
+
+    # -- mutation pipeline -----------------------------------------------------
+
+    _MUTATIONS = ("create", "remove", "set")
+
+    def commit_mutation(self, op: str, **args) -> Any:
+        """Log, then apply (ref CommitMutation)."""
+        if op not in self._MUTATIONS:
+            raise YtError(f"Unknown mutation {op!r}")
+        with self._lock:
+            # Validate BEFORE logging by applying to the live tree; Hydra
+            # validates in the mutation handler too — a failed apply after a
+            # logged record would poison recovery, so log only after the
+            # apply succeeds, holding the lock (single-writer semantics).
+            result = self._apply(op, args)
+            self.changelog.append({"op": op, "args": args})
+            return result
+
+    def _apply(self, op: str, args: dict) -> Any:
+        if op == "create":
+            return self.tree.create(
+                args["path"], args["type"],
+                attributes=args.get("attributes"),
+                recursive=args.get("recursive", False),
+                ignore_existing=args.get("ignore_existing", False))
+        if op == "remove":
+            return self.tree.remove(args["path"],
+                                    recursive=args.get("recursive", True),
+                                    force=args.get("force", False))
+        if op == "set":
+            return self.tree.set(args["path"], args.get("value"))
+        raise AssertionError(op)
+
+    # -- snapshots / recovery --------------------------------------------------
+
+    def build_snapshot(self) -> None:
+        """Serialize the tree, truncate the changelog (ref snapshot build)."""
+        with self._lock:
+            blob = yson.dumps(self.tree.serialize(), binary=True)
+            snap_path = os.path.join(self.root_dir, self.SNAPSHOT)
+            tmp = snap_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, snap_path)
+            _fsync_dir(self.root_dir)      # make the rename durable first
+            self.changelog.close()
+            log_path = os.path.join(self.root_dir, self.CHANGELOG)
+            os.unlink(log_path)
+            _fsync_dir(self.root_dir)
+            self.changelog = Changelog(log_path)
+
+    def _recover(self) -> None:
+        snap_path = os.path.join(self.root_dir, self.SNAPSHOT)
+        if os.path.exists(snap_path):
+            with open(snap_path, "rb") as f:
+                self.tree = CypressTree.deserialize(yson.loads(f.read()))
+        log_path = os.path.join(self.root_dir, self.CHANGELOG)
+        records, valid_bytes = Changelog.read_all(log_path)
+        for record in records:
+            try:
+                self._apply(record["op"], dict(record["args"]))
+            except YtError:
+                # Mutations are validated before logging; a failing replay
+                # record means it raced a snapshot — skip.
+                continue
+        # Drop a torn tail so future appends stay recoverable.
+        if os.path.exists(log_path) and \
+                os.path.getsize(log_path) > valid_bytes:
+            with open(log_path, "r+b") as f:
+                f.truncate(valid_bytes)
+                f.flush()
+                os.fsync(f.fileno())

@@ -1,0 +1,228 @@
+"""Operations: scheduler + controllers for map / merge / sort / erase.
+
+Ref mapping:
+  TScheduler + StartOperation RPC      → OperationScheduler.start_operation
+    (server/scheduler/scheduler.cpp)
+  TOperationControllerBase lifecycle   → _Controller.prepare/execute/commit
+    (controller_agent/operation_controller_detail.cpp: SafePrepare /
+     SafeMaterialize / commit)
+  operation records in Cypress         → //sys/operations/<id> attributes
+Jobs here are whole-chunk device programs rather than per-slice user
+processes; the controller state machine, operation records, and failure
+propagation match the reference's shape.  Scheduling fan-out across many
+hosts arrives with the multi-host control plane (future round); operations
+run synchronously or on a worker thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+
+@dataclass
+class Operation:
+    id: str
+    type: str                      # map | merge | sort | erase
+    spec: dict
+    state: str = "pending"         # pending|running|completed|failed|aborted
+    error: Optional[dict] = None
+    result: dict = field(default_factory=dict)
+
+
+class OperationScheduler:
+    def __init__(self, client):
+        self.client = client
+        self._operations: dict[str, Operation] = {}
+        self._lock = threading.Lock()
+
+    # -- public API ------------------------------------------------------------
+
+    def start_operation(self, op_type: str, spec: dict,
+                        sync: bool = True) -> Operation:
+        op = Operation(id=uuid.uuid4().hex, type=op_type, spec=dict(spec))
+        with self._lock:
+            self._operations[op.id] = op
+        self._record(op)
+        if sync:
+            self._run(op)
+        else:
+            thread = threading.Thread(target=self._run, args=(op,),
+                                      daemon=True)
+            thread.start()
+        return op
+
+    def get_operation(self, op_id: str) -> Operation:
+        op = self._operations.get(op_id)
+        if op is None:
+            raise YtError(f"No such operation {op_id}",
+                          code=EErrorCode.NoSuchOperation)
+        return op
+
+    def list_operations(self) -> list[Operation]:
+        return list(self._operations.values())
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def _run(self, op: Operation) -> None:
+        op.state = "running"
+        self._record(op)
+        try:
+            controller = _CONTROLLERS.get(op.type)
+            if controller is None:
+                raise YtError(f"Unknown operation type {op.type!r}",
+                              code=EErrorCode.OperationFailed)
+            result = controller(self.client, op.spec)
+            op.result = result or {}
+            op.state = "completed"
+        except YtError as e:
+            op.state = "failed"
+            op.error = e.to_dict()
+        except Exception as e:                      # noqa: BLE001
+            op.state = "failed"
+            op.error = YtError(
+                f"Operation crashed: {e}",
+                code=EErrorCode.OperationFailed,
+                attributes={"traceback": traceback.format_exc()}).to_dict()
+        self._record(op)
+        if op.state == "failed" and op.spec.get("raise_on_failure", True):
+            raise YtError.from_dict(op.error)
+
+    def _record(self, op: Operation) -> None:
+        # Each client.set is one fsync'd WAL mutation; write immutable fields
+        # once at registration and only the state transition afterwards.
+        path = f"//sys/operations/{op.id}"
+        client = self.client
+        if not client.exists(path):
+            client.create("document", path, recursive=True,
+                          ignore_existing=True)
+            client.set(path + "/@operation_type", op.type)
+            client.set(path + "/@spec", _clean_spec(op.spec))
+        client.set(path + "/@state", op.state)
+        if op.error is not None:
+            client.set(path + "/@error", op.error)
+
+
+def _clean_spec(spec: dict) -> dict:
+    return {k: v for k, v in spec.items() if not callable(v)}
+
+
+# -- controllers ---------------------------------------------------------------
+
+
+def _sort_controller(client, spec: dict) -> dict:
+    """Ref: sort_controller.cpp — here: read input chunks, device sort (or
+    mesh shuffle when a mesh is attached), write output."""
+    from ytsaurus_tpu.operations.sort_op import sort_chunks
+
+    input_path = _one(spec, "input_table_path")
+    output_path = _one(spec, "output_table_path")
+    sort_by = spec["sort_by"]
+    if isinstance(sort_by, str):
+        sort_by = [sort_by]
+    chunks = client._read_table_chunks(input_path)
+    if not chunks:
+        client._write_table_chunks(output_path, [], sorted_by=sort_by)
+        return {"rows": 0}
+    out = sort_chunks(chunks, sort_by,
+                      descending=spec.get("descending", False))
+    client._write_table_chunks(output_path, [out], sorted_by=sort_by,
+                               schema=out.schema)
+    return {"rows": out.row_count}
+
+
+def _merge_controller(client, spec: dict) -> dict:
+    """Ref: ordered/sorted merge (ordered_controller.cpp,
+    sorted_controller.cpp)."""
+    from ytsaurus_tpu.chunks.columnar import concat_chunks
+    from ytsaurus_tpu.operations.sort_op import sort_chunks
+
+    input_paths = spec["input_table_paths"]
+    output_path = _one(spec, "output_table_path")
+    mode = spec.get("mode", "unordered")
+    chunks = []
+    for path in input_paths:
+        chunks.extend(client._read_table_chunks(path))
+    if not chunks:
+        client._write_table_chunks(output_path, [])
+        return {"rows": 0}
+    chunks = _align_schemas(chunks)
+    if mode == "sorted":
+        key_names = spec.get("merge_by") or \
+            chunks[0].schema.key_column_names
+        if not key_names:
+            raise YtError("sorted merge requires merge_by or sorted input")
+        out = sort_chunks(chunks, key_names)
+        client._write_table_chunks(output_path, [out], sorted_by=key_names,
+                                   schema=out.schema)
+    else:
+        out = concat_chunks(chunks) if len(chunks) > 1 else chunks[0]
+        client._write_table_chunks(output_path, [out], schema=out.schema)
+    return {"rows": out.row_count}
+
+
+def _map_controller(client, spec: dict) -> dict:
+    """Ref: unordered_controller.cpp + the user-process map job
+    (job_proxy/user_job.cpp).  The mapper is a Python callable
+    rows→rows (row-dict iterables); query-shaped mappers should use
+    select_rows instead."""
+    mapper: Callable = spec["mapper"]
+    input_path = _one(spec, "input_table_path")
+    output_path = _one(spec, "output_table_path")
+    chunks = client._read_table_chunks(input_path)
+    out_rows: list[dict] = []
+    for chunk in chunks:
+        result = mapper(chunk.to_rows())
+        out_rows.extend(result)
+    schema = spec.get("output_schema")
+    client.write_table(output_path, out_rows, schema=schema)
+    return {"rows": len(out_rows)}
+
+
+def _erase_controller(client, spec: dict) -> dict:
+    path = _one(spec, "table_path")
+    client._write_table_chunks(path, [])
+    return {"rows": 0}
+
+
+def _align_schemas(chunks):
+    """Inputs from different tables may agree on columns but differ in order
+    or sort annotations; align them onto one unsorted schema for merging."""
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    from ytsaurus_tpu.schema import TableSchema
+
+    base = {c.name: c.type for c in chunks[0].schema}
+    for chunk in chunks[1:]:
+        other = {c.name: c.type for c in chunk.schema}
+        if other != base:
+            raise YtError(
+                f"Merge inputs have incompatible schemas: {sorted(base)} vs "
+                f"{sorted(other)}", code=EErrorCode.QueryTypeError)
+    target = TableSchema.make(
+        [(c.name, c.type.value) for c in chunks[0].schema])
+    return [
+        ColumnarChunk(schema=target, row_count=chunk.row_count,
+                      columns={name: chunk.columns[name]
+                               for name in target.column_names})
+        for chunk in chunks
+    ]
+
+
+def _one(spec: dict, key: str) -> str:
+    value = spec.get(key)
+    if not value or not isinstance(value, str):
+        raise YtError(f"Operation spec requires {key!r}")
+    return value
+
+
+_CONTROLLERS = {
+    "sort": _sort_controller,
+    "merge": _merge_controller,
+    "map": _map_controller,
+    "erase": _erase_controller,
+}

@@ -1,0 +1,65 @@
+"""Sort operation kernels: single-device chunk sort + mesh-wide shuffle sort.
+
+Ref: the Sort controller family (controller_agent/controllers/
+sort_controller.cpp).  Single-chip: one device lexsort over the concatenated
+columnar input (the simple_sort job analog, job_proxy/sort_job).  Multi-chip:
+parallel/shuffle.sort_table (partition + all_to_all).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.ops.segments import lexsort_indices, sort_key_planes
+from ytsaurus_tpu.schema import SortOrder, TableSchema
+
+
+def sort_chunk(chunk: ColumnarChunk, key_columns: Sequence[str],
+               descending: bool = False) -> ColumnarChunk:
+    """Device lexsort of one chunk by the given key columns."""
+    for name in key_columns:
+        if name not in chunk.schema:
+            raise YtError(f"No such sort column {name!r}",
+                          code=EErrorCode.QueryTypeError)
+    mask = chunk.row_valid
+    sort_keys = []
+    for name in reversed(list(key_columns)):
+        col = chunk.column(name)
+        sort_keys.extend(sort_key_planes(col.data, col.valid, descending))
+    sort_keys.append((~mask).astype(jnp.int8))
+    order = lexsort_indices(sort_keys)
+    columns = {}
+    for name, col in chunk.columns.items():
+        host_values = None
+        if col.host_values is not None:
+            idx_host = [int(i) for i in order[: chunk.row_count]]
+            host_values = [col.host_values[i] for i in idx_host]
+            host_values += [None] * (chunk.capacity - len(host_values))
+        columns[name] = replace(col, data=col.data[order],
+                                valid=col.valid[order],
+                                host_values=host_values)
+    order_kind = SortOrder.descending if descending else SortOrder.ascending
+    schema = _with_key_order(chunk.schema, list(key_columns), order_kind)
+    return ColumnarChunk(schema=schema, row_count=chunk.row_count,
+                         columns=columns)
+
+
+def sort_chunks(chunks: Sequence[ColumnarChunk], key_columns: Sequence[str],
+                descending: bool = False) -> ColumnarChunk:
+    merged = concat_chunks(list(chunks)) if len(chunks) > 1 else chunks[0]
+    return sort_chunk(merged, key_columns, descending)
+
+
+def _with_key_order(schema: TableSchema, key_names: list[str],
+                    order: SortOrder) -> TableSchema:
+    reordered = [schema.get(k) for k in key_names] + \
+        [c for c in schema if c.name not in key_names]
+    cols = []
+    for i, col in enumerate(reordered):
+        cols.append(col.with_sort_order(order if i < len(key_names) else None))
+    return TableSchema(columns=tuple(cols))
