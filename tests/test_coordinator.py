@@ -135,3 +135,28 @@ def test_string_group_keys_across_shards():
     rows = sorted(out.to_rows(), key=lambda r: r["s"])
     assert rows == [{"s": b"x", "c": 1}, {"s": b"y", "c": 2},
                     {"s": b"z", "c": 1}]
+
+
+def test_distributed_cardinality_exact():
+    # Duplicates span shards: per-shard counts cannot merge; must be exact.
+    schema = TableSchema.make([("k", "int64", "ascending"), ("g", "int64"),
+                               ("v", "int64")])
+    shards = [ColumnarChunk.from_rows(schema, [(1, 0, 5), (2, 0, 7)]),
+              ColumnarChunk.from_rows(schema, [(3, 0, 5), (4, 1, 1)]),
+              ColumnarChunk.from_rows(schema, [(5, 1, 1), (6, 1, 2)])]
+    plan = build_query(f"g, cardinality(v) AS d FROM [{T}] GROUP BY g",
+                       {T: schema})
+    out = coordinate_and_execute(plan, shards, evaluator=Evaluator())
+    assert sorted((r["g"], r["d"]) for r in out.to_rows()) == \
+        [(0, 2), (1, 2)]
+
+
+def test_distributed_with_totals():
+    plan = build_query(
+        f"g, sum(v) AS s FROM [{T}] GROUP BY g WITH TOTALS", {T: SCHEMA})
+    out = coordinate_and_execute(plan, SHARDS, evaluator=Evaluator())
+    rows = out.to_rows()
+    totals = [r for r in rows if r["g"] is None]
+    assert totals == [{"g": None, "s": 21}]
+    assert sorted((r["g"], r["s"]) for r in rows if r["g"] is not None) == \
+        [(0, 9), (1, 6), (2, 6)]

@@ -388,12 +388,6 @@ def test_having_without_group_raises():
         evaluate(f"k FROM [{T}] HAVING k > 1", KV6)
 
 
-def test_with_totals_unsupported():
-    from ytsaurus_tpu import YtError
-    with pytest.raises(YtError):
-        evaluate(f"g, sum(v) AS s FROM [{T}] GROUP BY g WITH TOTALS", GROUPED)
-
-
 def test_multi_key_order_by():
     rows = [(1, 2, 10), (2, 1, 20), (3, 1, 5), (4, 2, 1)]
     evaluate("a, b FROM [//t] ORDER BY a, b DESC LIMIT 4",
@@ -433,3 +427,62 @@ def test_fast_group_cache_not_reused_across_vocab_shapes():
         [(b"x", b"p", 1), (b"x", b"q", 2)]
     assert sorted((r["a"], r["b"], r["s"]) for r in r2) == \
         [(b"y", b"m", 5), (b"z", b"m", 7)]
+
+
+def test_cardinality_exact_distinct():
+    rows = [(1, 0, 5), (2, 0, 5), (3, 0, 7), (4, 1, None), (5, 1, 9),
+            (6, 1, 9)]
+    evaluate(f"g, cardinality(v) AS d FROM [{T}] GROUP BY g",
+             {T: ([("k", "int64", "ascending"), ("g", "int64"),
+                   ("v", "int64")], rows)},
+             [{"g": 0, "d": 2}, {"g": 1, "d": 1}])
+
+
+def test_with_totals():
+    rows = evaluate(f"g, sum(v) AS s FROM [{T}] GROUP BY g WITH TOTALS",
+                    GROUPED)
+    regular = sorted((r["g"], r["s"]) for r in rows if r["g"] is not None)
+    totals = [r for r in rows if r["g"] is None]
+    assert regular == [(0, 9), (1, 6)]
+    assert totals == [{"g": None, "s": 15}]
+
+
+def test_with_totals_projected_expression():
+    rows = evaluate(
+        f"g + 100 AS gk, sum(v) * 2 AS d FROM [{T}] GROUP BY g WITH TOTALS",
+        GROUPED)
+    regular = sorted((r["gk"], r["d"]) for r in rows if r["gk"] is not None)
+    totals = [r for r in rows if r["gk"] is None]
+    assert regular == [(100, 18), (101, 12)]
+    assert totals == [{"gk": None, "d": 30}]
+
+
+def test_concat_and_float_predicates():
+    rows = [(1, "foo", 1.5), (2, "bar", float("nan")),
+            (3, None, float("inf"))]
+    tables = {T: ([("k", "int64", "ascending"), ("s", "string"),
+                   ("d", "double")], rows)}
+    evaluate(f"concat(s, '-x') AS c FROM [{T}] WHERE k = 1", tables,
+             [{"c": "foo-x"}])
+    evaluate(f"concat('p:', s) AS c FROM [{T}] WHERE k = 2", tables,
+             [{"c": "p:bar"}])
+    evaluate(f"k FROM [{T}] WHERE is_nan(d)", tables, [{"k": 2}])
+    evaluate(f"k FROM [{T}] WHERE NOT is_finite(d) AND NOT is_nan(d)",
+             tables, [{"k": 3}])
+
+
+def test_concat_two_columns():
+    rows = [(1, "a", "x"), (2, "b", "y")]
+    evaluate("concat(concat(s1, '/'), s2) AS c FROM [//t]",
+             {T: ([("k", "int64", "ascending"), ("s1", "string"),
+                   ("s2", "string")], rows)},
+             [{"c": "a/x"}, {"c": "b/y"}])
+
+
+def test_cardinality_nan_counts_once():
+    rows = [(1, 0, float("nan")), (2, 0, float("nan")), (3, 0, 1.5),
+            (4, 0, float("inf"))]
+    evaluate(f"g, cardinality(d) AS c FROM [{T}] GROUP BY g",
+             {T: ([("k", "int64", "ascending"), ("g", "int64"),
+                   ("d", "double")], rows)},
+             [{"g": 0, "c": 3}])  # nan, 1.5, inf — nans collapse

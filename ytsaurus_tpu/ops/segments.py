@@ -149,6 +149,41 @@ def _reduce_neutral(dtype, function: str):
     return jnp.array(info.max if function == "min" else info.min, dtype=dtype)
 
 
+def segment_distinct_count(data: jax.Array, valid: jax.Array,
+                           seg_ids: jax.Array, num_segments: int
+                           ) -> tuple[jax.Array, jax.Array]:
+    """Exact per-segment distinct count of `data` (nulls don't count).
+
+    One extra lexsort by (segment, value): a row is "new" when its (segment,
+    value) differs from the previous row's.  The reference's `cardinality`
+    is an HLL approximation (library/query engine UDF); exact is affordable
+    here because the sort is one fused device pass.
+    """
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int8)
+    value = jnp.where(valid, data, jnp.zeros_like(data))
+    if jnp.issubdtype(value.dtype, jnp.floating):
+        # NaN != NaN would count every NaN as distinct: canonicalize all NaNs
+        # to one bit pattern, then compare bit patterns (inf stays distinct).
+        value = jnp.where(jnp.isnan(value),
+                          jnp.full_like(value, jnp.nan), value)
+        value = jax.lax.bitcast_convert_type(
+            value.astype(jnp.float64), jnp.int64)
+    order = jnp.lexsort([value, valid.astype(jnp.int8), seg_ids])
+    seg_s = seg_ids[order]
+    val_s = value[order]
+    valid_s = valid[order]
+    prev_seg = jnp.roll(seg_s, 1)
+    prev_val = jnp.roll(val_s, 1)
+    prev_valid = jnp.roll(valid_s, 1)
+    new_value = (seg_s != prev_seg) | (val_s != prev_val) | \
+        (valid_s != prev_valid)
+    new_value = new_value.at[0].set(True)
+    flags = (new_value & valid_s).astype(jnp.int64)
+    counts = _segment_reduce("sum", flags, seg_s, num_segments)
+    return counts.astype(jnp.uint64), jnp.ones(num_segments, dtype=bool)
+
+
 def compact_mask(mask: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Indices that move in-mask rows to the front (stable); plus count."""
     order = jnp.argsort(~mask, stable=True)

@@ -325,10 +325,6 @@ class _AggregatingBuilder(_ExprBuilder):
         raise YtError(f"Cannot build post-group expression {render_expr(e)!r}")
 
     def build_aggregate(self, e: ast.FunctionCall) -> ir.TExpr:
-        if e.name == "cardinality":
-            raise YtError(
-                "cardinality() is not implemented yet (needs a distinct-count "
-                "kernel)", code=EErrorCode.QueryUnsupported)
         fn = AGGREGATE_FUNCTIONS[e.name]
         if len(e.args) != 1:
             raise YtError(f"Aggregate {e.name!r} expects exactly one argument",
@@ -473,9 +469,6 @@ def build_query(source: str | ast.QueryAst,
         order = ir.OrderClause(items=tuple(order_items))
 
     if q.group_by:
-        if q.with_totals:
-            raise YtError("WITH TOTALS is not implemented yet",
-                          code=EErrorCode.QueryUnsupported)
         agg_builder = final_builder  # type: ignore[assignment]
         group_clause = ir.GroupClause(
             group_items=tuple(group_items),

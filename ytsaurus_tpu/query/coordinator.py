@@ -31,6 +31,18 @@ def split_plan(plan: ir.Query) -> tuple[ir.Query, ir.FrontQuery]:
     if plan.limit is not None:
         limit_for_bottom = plan.offset + plan.limit
 
+    if plan.group is not None and any(
+            a.function == "cardinality" for a in plan.group.aggregate_items):
+        # Distinct counts cannot merge from per-shard counts; ship the
+        # filtered rows and run the whole group stage at the front.
+        bottom = replace(plan, group=None, having=None, order=None,
+                         project=None, offset=0, limit=None)
+        front = ir.FrontQuery(
+            schema=bottom.output_schema(), group=plan.group,
+            having=plan.having, order=plan.order, project=plan.project,
+            offset=plan.offset, limit=plan.limit)
+        return bottom, front
+
     if plan.group is not None:
         bottom_aggs: list[ir.AggregateItem] = []
         avg_map: dict[str, tuple[str, str]] = {}

@@ -14,7 +14,7 @@ from typing import Mapping, Optional, Sequence
 import jax
 import numpy as np
 
-from ytsaurus_tpu.chunks.columnar import Column, ColumnarChunk
+from ytsaurus_tpu.chunks.columnar import Column, ColumnarChunk, concat_chunks
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.builder import build_query
@@ -57,6 +57,19 @@ class Evaluator:
         elif isinstance(plan, ir.Query):
             chunk = _project_chunk(chunk, plan.schema)
 
+        result = self._execute(plan, chunk)
+
+        # GROUP BY ... WITH TOTALS: one extra grand-total row (null keys)
+        # aggregated over the same filtered input, appended after the groups
+        # (ref: totals handling in GroupOpHelper/GroupTotalsOpHelper,
+        # cg_routines/registry.cpp:1920; totals_mode=before_having).
+        if plan.group is not None and plan.group.totals:
+            totals_plan = _make_totals_plan(plan)
+            totals = self._execute(totals_plan, chunk)
+            result = concat_chunks([result, totals])
+        return result
+
+    def _execute(self, plan, chunk: ColumnarChunk) -> ColumnarChunk:
         prepared = prepare(plan, chunk)
         key = (ir.fingerprint(plan), chunk.capacity, prepared.binding_shapes())
         jitted = self._cache.get(key)
@@ -111,6 +124,80 @@ def _project_chunk(chunk: ColumnarChunk, schema: TableSchema) -> ColumnarChunk:
         columns[col_schema.name] = col
     return ColumnarChunk(schema=schema, row_count=chunk.row_count,
                          columns=columns)
+
+
+def _typed_null(ty):
+    """A null-valued expression carrying type `ty`: if(false, zero, null)."""
+    return ir.TFunction(
+        type=ty, name="if",
+        args=(ir.TLiteral(type=EValueType.boolean, value=False),
+              ir.TLiteral(type=ty, value=_zero_value(ty)),
+              ir.TLiteral(type=EValueType.null, value=None)))
+
+
+def _make_totals_plan(plan):
+    """Derive the grand-total plan: single constant group key, same
+    aggregates, project with group-key references nulled out, no having
+    (before_having semantics), no order/limit."""
+    from dataclasses import replace as dc_replace
+
+    key_types = {item.name: item.expr.type for item in plan.group.group_items}
+
+    def subst(e):
+        if e is None:
+            return None
+        if isinstance(e, ir.TReference) and e.name in key_types:
+            return _typed_null(e.type)
+        if isinstance(e, ir.TFunction):
+            return dc_replace(e, args=tuple(subst(a) for a in e.args))
+        if isinstance(e, ir.TUnary):
+            return dc_replace(e, operand=subst(e.operand))
+        if isinstance(e, ir.TBinary):
+            return dc_replace(e, lhs=subst(e.lhs), rhs=subst(e.rhs))
+        if isinstance(e, ir.TIn):
+            return dc_replace(e, operands=tuple(subst(o) for o in e.operands))
+        if isinstance(e, ir.TBetween):
+            return dc_replace(e, operands=tuple(subst(o) for o in e.operands))
+        if isinstance(e, ir.TTransform):
+            return dc_replace(e, operands=tuple(subst(o) for o in e.operands),
+                              default=subst(e.default))
+        if isinstance(e, ir.TStringPredicate):
+            return dc_replace(e, operand=subst(e.operand))
+        return e
+
+    const_key = ir.NamedExpr(
+        name="__totals", expr=ir.TLiteral(type=EValueType.int64, value=0))
+    group = ir.GroupClause(group_items=(const_key,),
+                           aggregate_items=plan.group.aggregate_items,
+                           totals=False)
+    if plan.project is not None:
+        project = ir.ProjectClause(items=tuple(
+            ir.NamedExpr(name=i.name, expr=subst(i.expr))
+            for i in plan.project.items))
+    else:
+        # Default projection: null keys + aggregate values, matching the
+        # main query's output schema.
+        items = []
+        for item in plan.group.group_items:
+            items.append(ir.NamedExpr(name=item.name,
+                                      expr=_typed_null(item.expr.type)))
+        for agg in plan.group.aggregate_items:
+            items.append(ir.NamedExpr(
+                name=agg.name,
+                expr=ir.TReference(type=agg.type, name=agg.name)))
+        project = ir.ProjectClause(items=tuple(items))
+    return dc_replace(plan, group=group, having=None, order=None,
+                      project=project, offset=0, limit=None)
+
+
+def _zero_value(ty):
+    if ty is EValueType.string:
+        return b""
+    if ty is EValueType.boolean:
+        return False
+    if ty is EValueType.double:
+        return 0.0
+    return 0
 
 
 # -- convenience API -----------------------------------------------------------

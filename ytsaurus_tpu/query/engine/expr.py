@@ -341,6 +341,17 @@ class ExprBinder:
             return self._bind_string_map(
                 args[0], (lambda v: v.lower()) if name == "lower" else
                 (lambda v: v.upper()))
+        if name == "concat":
+            return self._bind_concat(args[0], args[1])
+        if name in ("is_finite", "is_nan"):
+            a = args[0]
+            fn = jnp.isfinite if name == "is_finite" else jnp.isnan
+
+            def emit_fpred(ctx):
+                data, valid = a.emit(ctx)
+                return fn(data.astype(jnp.float64)), valid
+            return BoundExpr(type=EValueType.boolean, vocab=None,
+                             emit=emit_fpred)
         if name == "length":
             a = args[0]
             vocab = a.vocab if a.vocab is not None else _EMPTY_VOCAB
@@ -425,6 +436,37 @@ class ExprBinder:
             planes = [a.emit(ctx) for a in args]
             return select(ctx, planes)
         return BoundExpr(type=node.type, vocab=None, emit=emit)
+
+    def _bind_concat(self, a: BoundExpr, b: BoundExpr) -> BoundExpr:
+        """String concatenation at the vocabulary level: the result vocab is
+        the (sorted, deduped) cross product of operand vocabs; the device
+        computes pair index c_a * |v_b| + c_b and gathers through a bound
+        remap.  Guarded by a cross-product cap."""
+        va = a.vocab if a.vocab is not None else _EMPTY_VOCAB
+        vb = b.vocab if b.vocab is not None else _EMPTY_VOCAB
+        na, nb = max(len(va), 1), max(len(vb), 1)
+        if na * nb > 1 << 16:
+            raise YtError(
+                f"concat() vocabulary cross product too large "
+                f"({len(va)}x{len(vb)}); reduce distinct values",
+                code=EErrorCode.QueryUnsupported)
+        pairs = [bytes(x) + bytes(y)
+                 for x in (va if len(va) else [b""])
+                 for y in (vb if len(vb) else [b""])]
+        merged = np.array(sorted(set(pairs)), dtype=object)
+        lookup = {v: i for i, v in enumerate(merged)}
+        table = np.array([lookup[p] for p in pairs], dtype=np.int32)
+        slot = self.ctx.add(jnp.asarray(
+            _pad_np(table, _vocab_bucket(len(table)), 0)))
+        gather = _gather_binding(slot)
+        nb_const = nb
+
+        def emit(ctx):
+            da, valid_a = a.emit(ctx)
+            db, valid_b = b.emit(ctx)
+            pair = da.astype(jnp.int32) * nb_const + db.astype(jnp.int32)
+            return gather(ctx, pair), valid_a & valid_b
+        return BoundExpr(type=EValueType.string, vocab=merged, emit=emit)
 
     def _bind_string_map(self, a: BoundExpr, fn) -> BoundExpr:
         """Vocabulary-level string→string transform (lower/upper/…)."""

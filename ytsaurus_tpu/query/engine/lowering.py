@@ -30,6 +30,7 @@ from ytsaurus_tpu.ops.segments import (
     lexsort_indices,
     segment_aggregate,
     segment_boundaries,
+    segment_distinct_count,
     sort_key_planes,
 )
 from ytsaurus_tpu.query import ir
@@ -220,6 +221,11 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                                              nseg, EValueType.int64)
                     new_columns[agg.name] = (_pad(s / jnp.maximum(c, 1)),
                                              _pad(sv))
+                elif agg.function == "cardinality":
+                    data, valid = arg.emit(ctx)
+                    d, dv = segment_distinct_count(data, valid & mask, seg,
+                                                   nseg)
+                    new_columns[agg.name] = (_pad(d), _pad(dv))
                 else:
                     data, valid = arg.emit(ctx)
                     valid = valid & mask
@@ -264,6 +270,12 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                                              capacity, EValueType.int64)
                     cnt = jnp.maximum(c, 1)
                     new_columns[agg.name] = (s / cnt, sv)
+                elif agg.function == "cardinality":
+                    data, valid = arg.emit(ctx)
+                    d, dv = segment_distinct_count(
+                        data[order_idx], valid[order_idx] & sorted_mask,
+                        seg_ids, capacity)
+                    new_columns[agg.name] = (d, dv)
                 else:
                     data, valid = arg.emit(ctx)
                     data = data[order_idx]

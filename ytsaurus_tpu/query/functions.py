@@ -148,6 +148,23 @@ _register("ceil", _infer_double_math, 1)
 _register("sqrt", _infer_double_math, 1)
 _register("lower", _infer_string_to_string, 1)
 _register("upper", _infer_string_to_string, 1)
+
+
+def _infer_concat(ts):
+    if any(t not in (EValueType.string, EValueType.null) for t in ts):
+        raise _type_error("concat", ts)
+    return EValueType.string
+
+
+def _infer_float_pred(ts):
+    if ts[0] not in (EValueType.double, EValueType.null):
+        raise _type_error("float predicate", ts)
+    return EValueType.boolean
+
+
+_register("concat", _infer_concat, 2)
+_register("is_finite", _infer_float_pred, 1)
+_register("is_nan", _infer_float_pred, 1)
 _register("length", _infer_string_to_int, 1)
 _register("is_prefix", _infer_string_pred, 2)
 _register("is_substr", _infer_string_pred, 2)
