@@ -243,3 +243,111 @@ def test_overwrite_clears_sorted_by(client):
     assert client.get("//tmp/out/@sorted_by") == ["k"]
     client.write_table("//tmp/out", [{"k": 9}, {"k": 3}])
     assert not client.exists("//tmp/out/@sorted_by")
+
+
+# --- ordered (queue) tables ---------------------------------------------------
+
+ORDERED_SCHEMA = TableSchema.make([("msg", "string"), ("n", "int64")])
+
+
+def _make_queue(client, path="//q/log"):
+    client.create("table", path, recursive=True,
+                  attributes={"schema": ORDERED_SCHEMA, "dynamic": True})
+    client.mount_table(path)
+    return path
+
+
+def test_ordered_table_append_and_pull(client):
+    q = _make_queue(client)
+    first = client.push_queue(q, [{"msg": "a", "n": 1}, {"msg": "b", "n": 2}])
+    assert first == 0
+    client.insert_rows(q, [{"msg": "c", "n": 3}])   # insert_rows routes too
+    rows = client.pull_queue(q, 1)
+    assert [r["n"] for r in rows] == [2, 3]
+    assert [r["msg"] for r in rows] == [b"b", b"c"]
+    assert [r["$row_index"] for r in rows] == [1, 2]
+
+
+def test_ordered_table_flush_trim_persist(client):
+    q = _make_queue(client)
+    client.push_queue(q, [{"msg": f"m{i}", "n": i} for i in range(10)])
+    (tablet,) = client._mounted_tablets(q)
+    tablet.flush()
+    client.push_queue(q, [{"msg": "fresh", "n": 99}])
+    rows = client.pull_queue(q, 8)
+    assert [r["n"] for r in rows] == [8, 9, 99]
+    client.trim_rows(q, 5)
+    assert [r["n"] for r in client.pull_queue(q, 0)][:2] == [5, 6]
+    # Unmount persists; remount restores indices and trim point.
+    client.unmount_table(q)
+    client.mount_table(q)
+    rows = client.pull_queue(q, 0)
+    assert [r["n"] for r in rows] == [5, 6, 7, 8, 9, 99]
+    assert client.push_queue(q, [{"msg": "after", "n": 100}]) == 11
+
+
+def test_ordered_table_query_with_row_index(client):
+    q = _make_queue(client)
+    client.push_queue(q, [{"msg": f"m{i % 2}", "n": i} for i in range(6)])
+    rows = client.select_rows(
+        f"msg, count(*) AS c FROM [{q}] WHERE $row_index >= 2 GROUP BY msg")
+    assert sorted((r["msg"], r["c"]) for r in rows) == \
+        [(b"m0", 2), (b"m1", 2)]
+
+
+# --- formats ------------------------------------------------------------------
+
+def test_formats_roundtrip():
+    from ytsaurus_tpu.formats import dumps_rows, loads_rows
+    rows = [{"a": 1, "b": "x"}, {"a": 2, "b": "y y"}]
+    # yson/json preserve integer types; dsv is stringly typed.
+    assert loads_rows(dumps_rows(rows, "yson"), "yson") == rows
+    assert loads_rows(dumps_rows(rows, "json"), "json") == rows
+    assert loads_rows(dumps_rows(rows, "dsv"), "dsv") == \
+        [{"a": "1", "b": "x"}, {"a": "2", "b": "y y"}]
+    blob = dumps_rows(rows, "schemaful_dsv", columns=["b", "a"])
+    assert blob == b"x\t1\ny y\t2\n"
+    back = loads_rows(blob, "schemaful_dsv", columns=["b", "a"])
+    assert back[0] == {"b": "x", "a": "1"}
+
+
+def test_dsv_escaping():
+    from ytsaurus_tpu.formats import dumps_rows, loads_rows
+    rows = [{"k": "a=b\tc\nd"}]
+    blob = dumps_rows(rows, "dsv")
+    assert loads_rows(blob, "dsv") == [{"k": "a=b\tc\nd"}]
+
+
+def test_dsv_key_with_equals_roundtrips():
+    from ytsaurus_tpu.formats import dumps_rows, loads_rows
+    rows = [{"a=b": "v", "c": "x=y"}]
+    assert loads_rows(dumps_rows(rows, "dsv"), "dsv") == rows
+
+
+def test_queue_api_routing_guards(client):
+    # Queue APIs on a sorted table / sorted APIs on a queue → typed errors.
+    client.create("table", "//dyn/sorted", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/sorted")
+    q = _make_queue(client, "//q/guard")
+    with pytest.raises(YtError):
+        client.pull_queue("//dyn/sorted", 0)
+    with pytest.raises(YtError):
+        client.trim_rows("//dyn/sorted", 1)
+    with pytest.raises(YtError):
+        client.lookup_rows(q, [(1,)])
+    with pytest.raises(YtError):
+        client.delete_rows(q, [(1,)])
+    with pytest.raises(YtError):
+        client.compact_table(q)
+
+
+def test_table_format_io(client):
+    client.write_table("//fmt/t", b'{"a": 1, "b": "x"}\n{"a": 2, "b": "y"}\n',
+                       format="json",
+                       schema=TableSchema.make([("a", "int64"),
+                                                ("b", "string")]))
+    assert client.read_table("//fmt/t") == \
+        [{"a": 1, "b": b"x"}, {"a": 2, "b": b"y"}]
+    blob = client.read_table("//fmt/t", format="json")
+    assert b'"a": 1' in blob

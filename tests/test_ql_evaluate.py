@@ -486,3 +486,11 @@ def test_cardinality_nan_counts_once():
              {T: ([("k", "int64", "ascending"), ("g", "int64"),
                    ("d", "double")], rows)},
              [{"g": 0, "c": 3}])  # nan, 1.5, inf — nans collapse
+
+
+def test_cardinality_negative_zero_counts_once():
+    rows = [(1, 0, 0.0), (2, 0, -0.0), (3, 0, 2.0)]
+    evaluate(f"g, cardinality(d) AS c FROM [{T}] GROUP BY g",
+             {T: ([("k", "int64", "ascending"), ("g", "int64"),
+                   ("d", "double")], rows)},
+             [{"g": 0, "c": 2}])

@@ -95,9 +95,16 @@ class YtClient:
 
     # ------------------------------------------------------------- static tables
 
-    def write_table(self, path: str, rows: Sequence[dict],
+    def write_table(self, path: str, rows: "Sequence[dict] | bytes",
                     append: bool = False,
-                    schema: "TableSchema | dict | None" = None) -> None:
+                    schema: "TableSchema | dict | None" = None,
+                    format: Optional[str] = None) -> None:
+        if format is not None:
+            from ytsaurus_tpu.formats import loads_rows
+            columns = None
+            if isinstance(schema, TableSchema):
+                columns = schema.column_names
+            rows = loads_rows(rows, format, columns=columns)
         node = self._table_node(path, create=True, schema=schema)
         if node.attributes.get("dynamic"):
             raise YtError("write_table on a dynamic table; use insert_rows",
@@ -120,20 +127,28 @@ class YtClient:
             self.cluster.master.commit_mutation(
                 "remove", path=path + "/@sorted_by", force=True)
 
-    def read_table(self, path: str) -> list[dict]:
+    def read_table(self, path: str, format: Optional[str] = None):
+        """Rows as dicts, or serialized bytes when `format` is given
+        (yson/json/dsv/schemaful_dsv — ref client/formats)."""
         chunks = self._read_table_chunks(path)
         rows: list[dict] = []
         for chunk in chunks:
             rows.extend(chunk.to_rows())
-        return rows
+        if format is None:
+            return rows
+        from ytsaurus_tpu.formats import dumps_rows
+        node = self._table_node(path)
+        schema = self._node_schema(node)
+        columns = schema.column_names if schema else None
+        return dumps_rows(rows, format, columns=columns)
 
     # ------------------------------------------------------------ dynamic tables
 
     def mount_table(self, path: str) -> None:
         node = self._table_node(path)
         schema = self._node_schema(node)
-        if schema is None or not schema.is_sorted:
-            raise YtError("mount_table requires a sorted schema",
+        if schema is None:
+            raise YtError("mount_table requires a schema",
                           code=EErrorCode.TabletNotMounted)
         if not node.attributes.get("dynamic"):
             raise YtError(f"Table {path!r} is not dynamic; "
@@ -141,11 +156,24 @@ class YtClient:
                           code=EErrorCode.TabletNotMounted)
         if node.id in self.cluster.tablets:
             return
-        tablet = Tablet(schema, self.cluster.chunk_store,
-                        tablet_id=f"{node.id}-0",
-                        chunk_cache=self.cluster.chunk_cache)
-        tablet.chunk_ids = list(node.attributes.get("tablet_chunk_ids", []))
-        self.cluster.tablets[node.id] = [tablet]
+        if schema.is_sorted:
+            tablet = Tablet(schema, self.cluster.chunk_store,
+                            tablet_id=f"{node.id}-0",
+                            chunk_cache=self.cluster.chunk_cache)
+            tablet.chunk_ids = list(node.attributes.get("tablet_chunk_ids", []))
+            self.cluster.tablets[node.id] = [tablet]
+        else:
+            # Unsorted dynamic schema → ordered (queue) table.
+            from ytsaurus_tpu.tablet.ordered import OrderedTablet
+            tablet = OrderedTablet(schema, self.cluster.chunk_store,
+                                   tablet_id=f"{node.id}-0",
+                                   chunk_cache=self.cluster.chunk_cache)
+            state = node.attributes.get("ordered_state") or {}
+            tablet.chunk_ids = list(state.get("chunk_ids", []))
+            tablet.chunk_ranges = [tuple(r) for r in state.get("ranges", [])]
+            tablet.base_index = int(state.get("base_index", 0))
+            tablet.trimmed_count = int(state.get("trimmed_count", 0))
+            self.cluster.tablets[node.id] = [tablet]
         self.set(path + "/@tablet_state", "mounted")
 
     def unmount_table(self, path: str) -> None:
@@ -153,13 +181,61 @@ class YtClient:
         tablets = self.cluster.tablets.pop(node.id, None)
         if tablets is None:
             return
-        chunk_ids: list[str] = []
+        from ytsaurus_tpu.tablet.ordered import OrderedTablet
         for tablet in tablets:
             tablet.flush()
-            chunk_ids.extend(tablet.chunk_ids)
             tablet.mounted = False
-        self.set(path + "/@tablet_chunk_ids", chunk_ids)
+        if isinstance(tablets[0], OrderedTablet):
+            t = tablets[0]
+            self.set(path + "/@ordered_state", {
+                "chunk_ids": t.chunk_ids,
+                "ranges": [list(r) for r in t.chunk_ranges],
+                "base_index": t.base_index,
+                "trimmed_count": t.trimmed_count})
+        else:
+            chunk_ids: list[str] = []
+            for tablet in tablets:
+                chunk_ids.extend(tablet.chunk_ids)
+            self.set(path + "/@tablet_chunk_ids", chunk_ids)
         self.set(path + "/@tablet_state", "unmounted")
+
+    # queue (ordered table) API — ref queue_client
+
+    def push_queue(self, path: str, rows: Sequence[dict]) -> int:
+        """Append rows to an ordered table; returns first $row_index."""
+        (tablet,) = self._mounted_tablets(path)
+        from ytsaurus_tpu.tablet.ordered import OrderedTablet
+        if not isinstance(tablet, OrderedTablet):
+            raise YtError(f"{path!r} is not an ordered table",
+                          code=EErrorCode.QueryUnsupported)
+        ts = self.cluster.transactions.timestamps.generate()
+        return tablet.append_rows(list(rows), ts)
+
+    def pull_queue(self, path: str, offset: int = 0,
+                   limit: Optional[int] = None) -> list[dict]:
+        (tablet,) = self._mounted_tablets(path)
+        self._require_ordered(tablet, path)
+        return tablet.read_rows(offset, limit)
+
+    def trim_rows(self, path: str, trimmed_count: int) -> None:
+        (tablet,) = self._mounted_tablets(path)
+        self._require_ordered(tablet, path)
+        tablet.trim_rows(trimmed_count)
+
+    @staticmethod
+    def _require_ordered(tablet, path: str) -> None:
+        from ytsaurus_tpu.tablet.ordered import OrderedTablet
+        if not isinstance(tablet, OrderedTablet):
+            raise YtError(f"{path!r} is not an ordered (queue) table",
+                          code=EErrorCode.QueryUnsupported)
+
+    @staticmethod
+    def _require_sorted(tablet, path: str) -> None:
+        from ytsaurus_tpu.tablet.ordered import OrderedTablet
+        if isinstance(tablet, OrderedTablet):
+            raise YtError(f"{path!r} is an ordered table; this operation "
+                          "requires a sorted dynamic table",
+                          code=EErrorCode.QueryUnsupported)
 
     def freeze_table(self, path: str) -> None:
         for tablet in self._mounted_tablets(path):
@@ -171,6 +247,7 @@ class YtClient:
         ts = retention_timestamp if retention_timestamp is not None else \
             self.cluster.transactions.timestamps.generate()
         for tablet in self._mounted_tablets(path):
+            self._require_sorted(tablet, path)
             tablet.flush()
             tablet.compact(retention_timestamp=ts)
         self._persist_tablet_chunks(path)
@@ -187,6 +264,14 @@ class YtClient:
     def insert_rows(self, path: str, rows: Sequence[dict],
                     tx: Optional[TabletTransaction] = None) -> Optional[int]:
         tablets = self._mounted_tablets(path)
+        from ytsaurus_tpu.tablet.ordered import OrderedTablet
+        if isinstance(tablets[0], OrderedTablet):
+            if tx is not None:
+                raise YtError("Transactional writes to ordered tables are "
+                              "not supported yet",
+                              code=EErrorCode.QueryUnsupported)
+            self.push_queue(path, rows)
+            return None
         txm = self.cluster.transactions
         own = tx is None
         tx = tx or txm.start()
@@ -198,6 +283,7 @@ class YtClient:
     def delete_rows(self, path: str, keys: Sequence[tuple],
                     tx: Optional[TabletTransaction] = None) -> Optional[int]:
         tablets = self._mounted_tablets(path)
+        self._require_sorted(tablets[0], path)
         txm = self.cluster.transactions
         own = tx is None
         tx = tx or txm.start()
@@ -211,6 +297,7 @@ class YtClient:
                     column_names: Optional[Sequence[str]] = None
                     ) -> list[Optional[dict]]:
         (tablet,) = self._mounted_tablets(path)
+        self._require_sorted(tablet, path)
         return tablet.lookup_rows([tuple(k) for k in keys],
                                   timestamp=timestamp,
                                   column_names=column_names)
@@ -326,7 +413,10 @@ class YtClient:
     def _query_shards(self, path: str, timestamp: int) -> list[ColumnarChunk]:
         node = self._table_node(path)
         if node.attributes.get("dynamic"):
+            from ytsaurus_tpu.tablet.ordered import OrderedTablet
             tablets = self._mounted_tablets(path)
+            if isinstance(tablets[0], OrderedTablet):
+                return [t.snapshot() for t in tablets]
             return [t.read_snapshot(timestamp) for t in tablets]
         chunks = [self.cluster.chunk_cache.get(cid)
                   for cid in node.attributes.get("chunk_ids", [])]
@@ -358,6 +448,10 @@ class _SchemaResolver(dict):
         if schema is None:
             raise YtError(f"Table {path!r} has no schema",
                           code=EErrorCode.QueryTypeError)
+        if node.attributes.get("dynamic") and not schema.is_sorted:
+            # Ordered tables expose $row_index/$timestamp system columns.
+            from ytsaurus_tpu.tablet.ordered import ordered_chunk_schema
+            return ordered_chunk_schema(schema).to_unsorted()
         return schema.to_unsorted()
 
 

@@ -1,0 +1,162 @@
+"""Row formats: serialize/parse rowsets as yson / json / dsv / schemaful_dsv.
+
+Ref: yt/yt/client/formats + library/formats — format objects convert between
+wire bytes and rows for table IO and job IO.  The same four format names are
+accepted by `YtClient.read_table(..., format=)` / `write_table(..., format=)`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+from ytsaurus_tpu import yson
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+
+def _to_jsonable(value):
+    if isinstance(value, bytes):
+        return value.decode("utf-8", "replace")
+    if isinstance(value, dict):
+        return {k: _to_jsonable(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_to_jsonable(v) for v in value]
+    return value
+
+
+def _dsv_escape(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\t", "\\t") \
+        .replace("\n", "\\n").replace("=", "\\=")
+
+
+def _dsv_unescape(text: str) -> str:
+    out = []
+    i = 0
+    while i < len(text):
+        c = text[i]
+        if c == "\\" and i + 1 < len(text):
+            nxt = text[i + 1]
+            out.append({"t": "\t", "n": "\n", "\\": "\\", "=": "="}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _dsv_split(text: str, sep: str) -> list[str]:
+    """Split on unescaped separators (backslash escapes survive)."""
+    parts = []
+    buf = []
+    i = 0
+    while i < len(text):
+        c = text[i]
+        if c == "\\" and i + 1 < len(text):
+            buf.append(text[i:i + 2])
+            i += 2
+        elif c == sep:
+            parts.append("".join(buf))
+            buf = []
+            i += 1
+        else:
+            buf.append(c)
+            i += 1
+    parts.append("".join(buf))
+    return parts
+
+
+def _dsv_split_kv(field: str) -> tuple[str, str]:
+    """Split key=value on the first UNESCAPED '='."""
+    i = 0
+    while i < len(field):
+        if field[i] == "\\":
+            i += 2
+        elif field[i] == "=":
+            return field[:i], field[i + 1:]
+        else:
+            i += 1
+    return field, ""
+
+
+def _value_to_text(value) -> str:
+    if value is None:
+        return ""
+    if isinstance(value, bytes):
+        return value.decode("utf-8", "replace")
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    return str(value)
+
+
+def dumps_rows(rows: Sequence[dict], format: str = "yson",
+               columns: Optional[Sequence[str]] = None) -> bytes:
+    """Serialize rows in the named format (list fragment semantics)."""
+    if format == "yson":
+        return b";".join(yson.dumps(row) for row in rows) + \
+            (b";" if rows else b"")
+    if format == "json":
+        return b"\n".join(
+            json.dumps(_to_jsonable(row), sort_keys=True).encode()
+            for row in rows) + (b"\n" if rows else b"")
+    if format == "dsv":
+        lines = []
+        for row in rows:
+            fields = [f"{_dsv_escape(k)}={_dsv_escape(_value_to_text(v))}"
+                      for k, v in row.items() if v is not None]
+            lines.append("\t".join(fields))
+        return ("\n".join(lines) + ("\n" if rows else "")).encode()
+    if format == "schemaful_dsv":
+        if not columns:
+            raise YtError("schemaful_dsv requires a column list",
+                          code=EErrorCode.QueryUnsupported)
+        lines = []
+        for row in rows:
+            lines.append("\t".join(
+                _dsv_escape(_value_to_text(row.get(c))) for c in columns))
+        return ("\n".join(lines) + ("\n" if rows else "")).encode()
+    raise YtError(f"Unknown format {format!r}",
+                  code=EErrorCode.QueryUnsupported)
+
+
+def loads_rows(data: bytes, format: str = "yson",
+               columns: Optional[Sequence[str]] = None) -> list[dict]:
+    """Parse rows from the named format."""
+    if format == "yson":
+        values = yson.loads(data, yson_type="list_fragment")
+        for v in values:
+            if not isinstance(v, dict):
+                raise YtError(f"Expected map rows, got {type(v).__name__}")
+        return values
+    if format == "json":
+        rows = []
+        for line in data.splitlines():
+            if line.strip():
+                rows.append(json.loads(line))
+        return rows
+    if format == "dsv":
+        rows = []
+        for line in data.decode().splitlines():
+            row = {}
+            if line:
+                for field in _dsv_split(line, "\t"):
+                    if not field:
+                        continue
+                    key, value = _dsv_split_kv(field)
+                    row[_dsv_unescape(key)] = _dsv_unescape(value)
+            rows.append(row)
+        return rows
+    if format == "schemaful_dsv":
+        if not columns:
+            raise YtError("schemaful_dsv requires a column list",
+                          code=EErrorCode.QueryUnsupported)
+        rows = []
+        for line in data.decode().splitlines():
+            parts = line.split("\t")
+            if len(parts) != len(columns):
+                raise YtError(f"schemaful_dsv row width {len(parts)} != "
+                              f"{len(columns)}")
+            rows.append({c: _dsv_unescape(p)
+                         for c, p in zip(columns, parts)})
+        return rows
+    raise YtError(f"Unknown format {format!r}",
+                  code=EErrorCode.QueryUnsupported)

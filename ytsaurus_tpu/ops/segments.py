@@ -162,22 +162,27 @@ def segment_distinct_count(data: jax.Array, valid: jax.Array,
     if data.dtype == jnp.bool_:
         data = data.astype(jnp.int8)
     value = jnp.where(valid, data, jnp.zeros_like(data))
+    nan_flag = jnp.zeros(value.shape[0], dtype=jnp.int8)
     if jnp.issubdtype(value.dtype, jnp.floating):
-        # NaN != NaN would count every NaN as distinct: canonicalize all NaNs
-        # to one bit pattern, then compare bit patterns (inf stays distinct).
-        value = jnp.where(jnp.isnan(value),
-                          jnp.full_like(value, jnp.nan), value)
-        value = jax.lax.bitcast_convert_type(
-            value.astype(jnp.float64), jnp.int64)
-    order = jnp.lexsort([value, valid.astype(jnp.int8), seg_ids])
+        # Float equality pitfalls: NaN != NaN (every NaN would count) and
+        # -0.0 == +0.0 bit-wise distinct.  Canonicalize: -0.0 → +0.0 via
+        # `+ 0.0`; NaNs → +inf with a side flag so NaN stays distinct from a
+        # real +inf.  (No bitcast: f64→i64 bitcasts don't lower on TPU X64.)
+        is_nan = jnp.isnan(value)
+        nan_flag = is_nan.astype(jnp.int8)
+        value = jnp.where(is_nan, jnp.full_like(value, jnp.inf),
+                          value + 0.0)
+    order = jnp.lexsort([value, nan_flag, valid.astype(jnp.int8), seg_ids])
     seg_s = seg_ids[order]
     val_s = value[order]
     valid_s = valid[order]
+    nan_s = nan_flag[order]
     prev_seg = jnp.roll(seg_s, 1)
     prev_val = jnp.roll(val_s, 1)
     prev_valid = jnp.roll(valid_s, 1)
+    prev_nan = jnp.roll(nan_s, 1)
     new_value = (seg_s != prev_seg) | (val_s != prev_val) | \
-        (valid_s != prev_valid)
+        (valid_s != prev_valid) | (nan_s != prev_nan)
     new_value = new_value.at[0].set(True)
     flags = (new_value & valid_s).astype(jnp.int64)
     counts = _segment_reduce("sum", flags, seg_s, num_segments)

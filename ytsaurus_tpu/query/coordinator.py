@@ -121,31 +121,15 @@ class _AvgSubstituter:
     def __call__(self, expr: Optional[ir.TExpr]) -> Optional[ir.TExpr]:
         if expr is None or not self.avg_map:
             return expr
-        return self._walk(expr)
+        return ir.map_expr(expr, self._leaf)
 
-    def _walk(self, e: ir.TExpr) -> ir.TExpr:
+    def _leaf(self, e: ir.TExpr) -> ir.TExpr:
         if isinstance(e, ir.TReference) and e.name in self.avg_map:
             s_name, c_name = self.avg_map[e.name]
             s_ref = ir.TReference(type=EValueType.double, name=s_name)
             c_ref = ir.TReference(type=EValueType.int64, name=c_name)
             return ir.TBinary(type=EValueType.double, op="/", lhs=s_ref,
                               rhs=_to_double(c_ref))
-        if isinstance(e, ir.TFunction):
-            return replace(e, args=tuple(self._walk(a) for a in e.args))
-        if isinstance(e, ir.TUnary):
-            return replace(e, operand=self._walk(e.operand))
-        if isinstance(e, ir.TBinary):
-            return replace(e, lhs=self._walk(e.lhs), rhs=self._walk(e.rhs))
-        if isinstance(e, ir.TIn):
-            return replace(e, operands=tuple(self._walk(o) for o in e.operands))
-        if isinstance(e, ir.TBetween):
-            return replace(e, operands=tuple(self._walk(o) for o in e.operands))
-        if isinstance(e, ir.TTransform):
-            return replace(
-                e, operands=tuple(self._walk(o) for o in e.operands),
-                default=self._walk(e.default) if e.default else None)
-        if isinstance(e, ir.TStringPredicate):
-            return replace(e, operand=self._walk(e.operand))
         return e
 
 

@@ -144,26 +144,10 @@ def _make_totals_plan(plan):
     key_types = {item.name: item.expr.type for item in plan.group.group_items}
 
     def subst(e):
-        if e is None:
-            return None
-        if isinstance(e, ir.TReference) and e.name in key_types:
-            return _typed_null(e.type)
-        if isinstance(e, ir.TFunction):
-            return dc_replace(e, args=tuple(subst(a) for a in e.args))
-        if isinstance(e, ir.TUnary):
-            return dc_replace(e, operand=subst(e.operand))
-        if isinstance(e, ir.TBinary):
-            return dc_replace(e, lhs=subst(e.lhs), rhs=subst(e.rhs))
-        if isinstance(e, ir.TIn):
-            return dc_replace(e, operands=tuple(subst(o) for o in e.operands))
-        if isinstance(e, ir.TBetween):
-            return dc_replace(e, operands=tuple(subst(o) for o in e.operands))
-        if isinstance(e, ir.TTransform):
-            return dc_replace(e, operands=tuple(subst(o) for o in e.operands),
-                              default=subst(e.default))
-        if isinstance(e, ir.TStringPredicate):
-            return dc_replace(e, operand=subst(e.operand))
-        return e
+        return ir.map_expr(
+            e, lambda node: _typed_null(node.type)
+            if isinstance(node, ir.TReference) and node.name in key_types
+            else node)
 
     const_key = ir.NamedExpr(
         name="__totals", expr=ir.TLiteral(type=EValueType.int64, value=0))

@@ -212,6 +212,36 @@ class FrontQuery:
         return self.schema
 
 
+def map_expr(expr, fn):
+    """Bottom-up rewrite: apply `fn` to every node, recursing first.
+
+    `fn(node)` returns a replacement node or the node itself.  Shared by the
+    coordinator's avg-state substitution and the totals-plan key nulling —
+    extend HERE when a new expression node type is added.
+    """
+    from dataclasses import replace as dc_replace
+
+    if expr is None:
+        return None
+    e = expr
+    if isinstance(e, TFunction):
+        e = dc_replace(e, args=tuple(map_expr(a, fn) for a in e.args))
+    elif isinstance(e, TUnary):
+        e = dc_replace(e, operand=map_expr(e.operand, fn))
+    elif isinstance(e, TBinary):
+        e = dc_replace(e, lhs=map_expr(e.lhs, fn), rhs=map_expr(e.rhs, fn))
+    elif isinstance(e, TIn):
+        e = dc_replace(e, operands=tuple(map_expr(o, fn) for o in e.operands))
+    elif isinstance(e, TBetween):
+        e = dc_replace(e, operands=tuple(map_expr(o, fn) for o in e.operands))
+    elif isinstance(e, TTransform):
+        e = dc_replace(e, operands=tuple(map_expr(o, fn) for o in e.operands),
+                       default=map_expr(e.default, fn))
+    elif isinstance(e, TStringPredicate):
+        e = dc_replace(e, operand=map_expr(e.operand, fn))
+    return fn(e)
+
+
 # --- fingerprinting -----------------------------------------------------------
 
 

@@ -131,9 +131,9 @@ def tokenize(source: str) -> list[Token]:
                 i = j + 1
                 continue
         # Identifiers / keywords.
-        if c.isalpha() or c == "_":
+        if c.isalpha() or c in "_$":
             j = i
-            while j < n and (source[j].isalnum() or source[j] in "_"):
+            while j < n and (source[j].isalnum() or source[j] in "_$"):
                 j += 1
             word = source[i:j]
             low = word.lower()

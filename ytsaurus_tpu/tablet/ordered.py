@@ -1,0 +1,148 @@
+"""Ordered tablets: append-only row logs (queue tables).
+
+Ref: tablet_node/ordered_dynamic_store.h + queue_client consumer model
+(client/queue_client/consumer_client.h).  Rows have implicit global
+$row_index (append order) and $timestamp; reads are offset-based; trim drops
+a prefix.  Flushing writes index-stamped columnar chunks so the on-disk form
+is queryable like any static chunk.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.chunks.store import ChunkCache, FsChunkStore
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.tablet.dynamic_store import OrderedDynamicStore
+
+
+def ordered_chunk_schema(schema: TableSchema) -> TableSchema:
+    cols = [("$row_index", "int64", "ascending"), ("$timestamp", "int64")]
+    cols += [(c.name, c.type.value) for c in schema]
+    return TableSchema.make(cols)
+
+
+class OrderedTablet:
+    def __init__(self, schema: TableSchema, chunk_store: FsChunkStore,
+                 tablet_id: str = "0",
+                 chunk_cache: Optional[ChunkCache] = None):
+        if schema.is_sorted:
+            raise YtError("Ordered tablets require an unsorted schema",
+                          code=EErrorCode.TabletNotMounted)
+        self.schema = schema
+        self.tablet_id = tablet_id
+        self.chunk_store = chunk_store
+        self.chunk_cache = chunk_cache or ChunkCache(chunk_store)
+        self.store = OrderedDynamicStore(schema)
+        self.chunk_ids: list[str] = []
+        self.chunk_ranges: list[tuple[int, int]] = []   # [start, end) per chunk
+        self.base_index = 0          # first index still in the active store
+        self.trimmed_count = 0
+        self.mounted = True
+        self._lock = threading.RLock()
+
+    # -- writes ----------------------------------------------------------------
+
+    def append_rows(self, rows: Sequence[dict], timestamp: int) -> int:
+        """Returns the $row_index of the first appended row."""
+        with self._lock:
+            if not self.mounted:
+                raise YtError(f"Tablet {self.tablet_id} is not mounted",
+                              code=EErrorCode.TabletNotMounted)
+            from ytsaurus_tpu.tablet.tablet import _normalize_value
+            first = self.base_index + self.store.row_count
+            for row in rows:
+                unknown = set(row) - {c.name for c in self.schema}
+                if unknown and self.schema.strict:
+                    raise YtError(f"Unknown columns {sorted(unknown)}",
+                                  code=EErrorCode.QueryTypeError)
+                normalized = {
+                    c.name: _normalize_value(row.get(c.name), c.type)
+                    for c in self.schema}
+                self.store.append_row(normalized, timestamp)
+            return first
+
+    # -- flush -----------------------------------------------------------------
+
+    def flush(self) -> Optional[str]:
+        with self._lock:
+            n = self.store.row_count
+            if n == 0:
+                return None
+            rows = self.store.read(0)
+            chunk_rows = []
+            for row in rows:
+                out = {"$row_index": self.base_index + row.pop("$row_index"),
+                       "$timestamp": row.pop("$timestamp")}
+                out.update(row)
+                chunk_rows.append(out)
+            chunk = ColumnarChunk.from_rows(
+                ordered_chunk_schema(self.schema), chunk_rows)
+            chunk_id = self.chunk_store.write_chunk(chunk)
+            self.chunk_ids.append(chunk_id)
+            self.chunk_ranges.append((self.base_index, self.base_index + n))
+            self.base_index += n
+            self.store = OrderedDynamicStore(self.schema)
+            return chunk_id
+
+    # -- reads -----------------------------------------------------------------
+
+    @property
+    def row_count(self) -> int:
+        with self._lock:
+            return self.base_index + self.store.row_count
+
+    def read_rows(self, start_index: int = 0,
+                  limit: Optional[int] = None) -> list[dict]:
+        """Rows with $row_index ≥ start_index (post-trim), up to limit."""
+        with self._lock:
+            start_index = max(start_index, self.trimmed_count)
+            end = self.row_count if limit is None else start_index + limit
+            out: list[dict] = []
+            for chunk_id, (lo, hi) in zip(self.chunk_ids, self.chunk_ranges):
+                if hi <= start_index or lo >= end:
+                    continue
+                chunk = self.chunk_cache.get(chunk_id)
+                for row in chunk.to_rows():
+                    idx = row["$row_index"]
+                    if start_index <= idx < end and idx >= self.trimmed_count:
+                        out.append(row)
+            if end > self.base_index:
+                for row in self.store.read(
+                        max(0, start_index - self.base_index)):
+                    idx = self.base_index + row["$row_index"]
+                    if idx >= end:
+                        break
+                    fixed = dict(row)
+                    fixed["$row_index"] = idx
+                    out.append(fixed)
+            out.sort(key=lambda r: r["$row_index"])
+            return out
+
+    def trim_rows(self, trimmed_count: int) -> None:
+        """Logically drop rows below `trimmed_count`; physically drop chunks
+        that are entirely trimmed (ref store_trimmer)."""
+        with self._lock:
+            if trimmed_count > self.row_count:
+                raise YtError("Cannot trim beyond the last row")
+            self.trimmed_count = max(self.trimmed_count, trimmed_count)
+            keep_ids, keep_ranges = [], []
+            for chunk_id, (lo, hi) in zip(self.chunk_ids, self.chunk_ranges):
+                if hi <= self.trimmed_count:
+                    self.chunk_store.remove_chunk(chunk_id)
+                    self.chunk_cache.invalidate(chunk_id)
+                else:
+                    keep_ids.append(chunk_id)
+                    keep_ranges.append((lo, hi))
+            self.chunk_ids = keep_ids
+            self.chunk_ranges = keep_ranges
+
+    def snapshot(self) -> ColumnarChunk:
+        """All live rows (incl. $row_index/$timestamp) as one chunk for
+        queries."""
+        rows = self.read_rows(0)
+        return ColumnarChunk.from_rows(
+            ordered_chunk_schema(self.schema).to_unsorted(), rows)
