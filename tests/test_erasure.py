@@ -1,0 +1,73 @@
+"""Erasure coding tests (ref model: library/cpp/erasure unittests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ytsaurus_tpu import YtError
+from ytsaurus_tpu.chunks import ColumnarChunk
+from ytsaurus_tpu.chunks.erasure import get_erasure_codec
+from ytsaurus_tpu.chunks.store import FsChunkStore
+from ytsaurus_tpu.schema import TableSchema
+
+
+def test_rs63_roundtrip_no_erasures():
+    codec = get_erasure_codec("rs_6_3")
+    blob = bytes(range(256)) * 41 + b"tail"
+    parts = codec.encode(blob)
+    assert len(parts) == 9
+    assert codec.decode(parts, len(blob)) == blob
+
+
+@pytest.mark.parametrize("lost", [
+    (0,), (5,), (6,), (8,), (0, 1), (0, 6), (7, 8), (0, 3, 8), (1, 2, 4),
+    (6, 7, 8), (0, 1, 2),
+])
+def test_rs63_repairs_any_three_erasures(lost):
+    codec = get_erasure_codec("rs_6_3")
+    rng = np.random.default_rng(7)
+    blob = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    parts = list(codec.encode(blob))
+    for i in lost:
+        parts[i] = None
+    assert codec.decode(parts, len(blob)) == blob
+
+
+def test_rs63_four_erasures_fail():
+    codec = get_erasure_codec("rs_6_3")
+    parts = list(codec.encode(b"x" * 600))
+    for i in (0, 2, 6, 8):
+        parts[i] = None
+    with pytest.raises(YtError):
+        codec.decode(parts, 600)
+
+
+def test_store_erasure_chunk_survives_part_loss(tmp_path):
+    store = FsChunkStore(str(tmp_path))
+    schema = TableSchema.make([("k", "int64"), ("s", "string")])
+    chunk = ColumnarChunk.from_rows(
+        schema, [(i, f"row-{i}") for i in range(500)])
+    cid = store.write_chunk(chunk, erasure="rs_6_3")
+    assert store.exists(cid)
+    assert store.list_chunks() == [cid]
+    # Destroy three arbitrary parts (two data + one parity).
+    for i in (1, 4, 7):
+        os.unlink(store._part_path(cid, i))
+    back = store.read_chunk(cid)
+    assert back.to_rows() == chunk.to_rows()
+    # A fourth loss is fatal.
+    os.unlink(store._part_path(cid, 0))
+    with pytest.raises(YtError):
+        store.read_chunk(cid)
+    store.remove_chunk(cid)
+    assert not store.exists(cid)
+
+
+def test_small_blob_erasure():
+    codec = get_erasure_codec("rs_3_2")
+    blob = b"abc"
+    parts = list(codec.encode(blob))
+    parts[0] = None
+    parts[2] = None
+    assert codec.decode(parts, 3) == blob

@@ -1,0 +1,199 @@
+"""Erasure coding: systematic Reed–Solomon over GF(2^8).
+
+Ref: library/cpp/erasure (codecs RS(6,3), LRC(12,2,2) via ISA-L/Jerasure,
+wrapped by yt/yt/library/erasure).  This is an independent numpy
+implementation: a systematic generator derived from an extended Vandermonde
+matrix; any k of the k+m parts reconstruct the original (m erasures
+tolerated).  rs_6_3 matches the reference's default storage codec shape.
+LRC is future work (PARITY.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+# --- GF(2^8) arithmetic (poly 0x11D, generator 2) ----------------------------
+
+_EXP = np.zeros(512, dtype=np.uint8)
+_LOG = np.zeros(256, dtype=np.int32)
+_x = 1
+for _i in range(255):
+    _EXP[_i] = _x
+    _LOG[_x] = _i
+    _x <<= 1
+    if _x & 0x100:
+        _x ^= 0x11D
+for _i in range(255, 512):
+    _EXP[_i] = _EXP[_i - 255]
+
+
+def _gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(_EXP[_LOG[a] + _LOG[b]])
+
+
+def _gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(256) inverse of 0")
+    return int(_EXP[255 - _LOG[a]])
+
+
+def _gf_matmul_vec(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """(r, k) GF matrix × (k, n) byte planes → (r, n)."""
+    r, k = matrix.shape
+    out = np.zeros((r, data.shape[1]), dtype=np.uint8)
+    for i in range(r):
+        acc = np.zeros(data.shape[1], dtype=np.uint8)
+        for j in range(k):
+            c = int(matrix[i, j])
+            if c == 0:
+                continue
+            # Vectorized GF multiply-by-constant via log tables.
+            row = data[j]
+            nz = row != 0
+            prod = np.zeros_like(row)
+            prod[nz] = _EXP[(_LOG[row[nz]] + _LOG[c]) % 255]
+            acc ^= prod
+        out[i] = acc
+    return out
+
+
+def _gf_gauss_invert(matrix: np.ndarray) -> np.ndarray:
+    """Invert a square GF(256) matrix by Gauss-Jordan elimination."""
+    n = matrix.shape[0]
+    aug = np.concatenate(
+        [matrix.astype(np.uint8), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = None
+        for row in range(col, n):
+            if aug[row, col] != 0:
+                pivot = row
+                break
+        if pivot is None:
+            raise YtError("Singular matrix during erasure repair",
+                          code=EErrorCode.ChunkFormatError)
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        inv = _gf_inv(int(aug[col, col]))
+        aug[col] = _gf_constant_mul(aug[col], inv)
+        for row in range(n):
+            if row != col and aug[row, col] != 0:
+                factor = int(aug[row, col])
+                aug[row] ^= _gf_constant_mul(aug[col], factor)
+    return aug[:, n:]
+
+
+def _gf_constant_mul(row: np.ndarray, c: int) -> np.ndarray:
+    if c == 0:
+        return np.zeros_like(row)
+    nz = row != 0
+    out = np.zeros_like(row)
+    out[nz] = _EXP[(_LOG[row[nz]] + _LOG[c]) % 255]
+    return out
+
+
+def _gf_pow(a: int, e: int) -> int:
+    if e == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(_EXP[(_LOG[a] * e) % 255])
+
+
+def _systematic_generator(k: int, m: int) -> np.ndarray:
+    """(k+m, k) systematic generator: top k rows identity, bottom m parity.
+
+    Vandermonde over distinct evaluation points 0..k+m-1 (any k rows are
+    independent), right-multiplied by the inverse of its top k×k block.
+    """
+    v = np.zeros((k + m, k), dtype=np.uint8)
+    for i in range(k + m):
+        for j in range(k):
+            v[i, j] = _gf_pow(i, j)
+    top_inv = _gf_gauss_invert(v[:k].copy())
+    return _gf_matrix_mul(v, top_inv)
+
+
+def _gf_matrix_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    r, k = a.shape
+    k2, c = b.shape
+    assert k == k2
+    out = np.zeros((r, c), dtype=np.uint8)
+    for i in range(r):
+        for j in range(c):
+            acc = 0
+            for t in range(k):
+                acc ^= _gf_mul(int(a[i, t]), int(b[t, j]))
+            out[i, j] = acc
+    return out
+
+
+@dataclass(frozen=True)
+class ErasureCodec:
+    name: str
+    data_parts: int          # k
+    parity_parts: int        # m
+    generator: np.ndarray    # (k+m, k) systematic
+
+    @property
+    def total_parts(self) -> int:
+        return self.data_parts + self.parity_parts
+
+    # -- encode ----------------------------------------------------------------
+
+    def encode(self, blob: bytes) -> list[bytes]:
+        """Split into k data parts (padded) + m parity parts.  Part 0 carries
+        no length header; callers must remember the original byte length."""
+        k = self.data_parts
+        part_len = (len(blob) + k - 1) // k
+        part_len = max(part_len, 1)
+        data = np.frombuffer(
+            blob.ljust(k * part_len, b"\0"), dtype=np.uint8).reshape(k, part_len)
+        parity = _gf_matmul_vec(self.generator[k:], data)
+        return [data[i].tobytes() for i in range(k)] + \
+            [parity[i].tobytes() for i in range(self.parity_parts)]
+
+    # -- decode / repair -------------------------------------------------------
+
+    def decode(self, parts: Sequence[Optional[bytes]], size: int) -> bytes:
+        """Reconstruct the original blob from any k available parts."""
+        k = self.data_parts
+        available = [i for i, p in enumerate(parts) if p is not None]
+        if len(available) < k:
+            raise YtError(
+                f"Erasure decode needs {k} parts, only {len(available)} "
+                f"available", code=EErrorCode.ChunkFormatError)
+        use = available[:k]
+        if use == list(range(k)):
+            data = np.stack([np.frombuffer(parts[i], dtype=np.uint8)
+                             for i in range(k)])
+        else:
+            sub = self.generator[use]                    # (k, k)
+            inv = _gf_gauss_invert(sub)
+            received = np.stack([np.frombuffer(parts[i], dtype=np.uint8)
+                                 for i in use])
+            data = _gf_matmul_vec(inv, received)
+        return data.reshape(-1).tobytes()[:size]
+
+
+_CODECS: dict[str, ErasureCodec] = {}
+
+
+def get_erasure_codec(name: str) -> ErasureCodec:
+    codec = _CODECS.get(name)
+    if codec is None:
+        if name == "rs_6_3":
+            codec = ErasureCodec("rs_6_3", 6, 3, _systematic_generator(6, 3))
+        elif name == "rs_3_2":
+            codec = ErasureCodec("rs_3_2", 3, 2, _systematic_generator(3, 2))
+        else:
+            raise YtError(f"Unknown erasure codec {name!r}",
+                          code=EErrorCode.ChunkFormatError)
+        _CODECS[name] = codec
+    return codec

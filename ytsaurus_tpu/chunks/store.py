@@ -42,19 +42,48 @@ class FsChunkStore:
     def _path(self, chunk_id: str) -> str:
         return os.path.join(self.root, chunk_id[:2], f"{chunk_id}.chunk")
 
+    def _part_path(self, chunk_id: str, index: int) -> str:
+        return os.path.join(self.root, chunk_id[:2],
+                            f"{chunk_id}.part{index}")
+
+    def _erasure_meta_path(self, chunk_id: str) -> str:
+        return os.path.join(self.root, chunk_id[:2], f"{chunk_id}.erasure")
+
     def write_chunk(self, chunk: ColumnarChunk,
                     chunk_id: Optional[str] = None,
-                    codec: Optional[str] = None) -> str:
+                    codec: Optional[str] = None,
+                    erasure: Optional[str] = None) -> str:
         chunk_id = chunk_id or new_chunk_id()
         blob = serialize_chunk(chunk, codec or self.codec)
+        if erasure is not None:
+            return self._write_erasure(chunk_id, blob, erasure)
         path = self._path(chunk_id)
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._atomic_write(path, blob)
+        return chunk_id
+
+    def _atomic_write(self, path: str, blob: bytes) -> None:
         tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)      # atomic publish
+
+    def _write_erasure(self, chunk_id: str, blob: bytes,
+                       erasure: str) -> str:
+        """Erasure-coded layout: k+m part files + a small meta file (ref:
+        striped erasure writer, ytlib/chunk_client/striped_erasure_writer.h)."""
+        from ytsaurus_tpu import yson
+        from ytsaurus_tpu.chunks.erasure import get_erasure_codec
+
+        codec = get_erasure_codec(erasure)
+        parts = codec.encode(blob)
+        os.makedirs(os.path.dirname(self._path(chunk_id)), exist_ok=True)
+        for i, part in enumerate(parts):
+            self._atomic_write(self._part_path(chunk_id, i), part)
+        self._atomic_write(self._erasure_meta_path(chunk_id), yson.dumps(
+            {"codec": erasure, "size": len(blob)}, binary=True))
         return chunk_id
 
     def read_chunk(self, chunk_id: str) -> ColumnarChunk:
@@ -69,27 +98,77 @@ class FsChunkStore:
             with open(path, "rb") as f:
                 return f.read()
         except FileNotFoundError:
+            pass
+        blob = self._read_erasure_blob(chunk_id)
+        if blob is None:
             raise YtError(f"No such chunk {chunk_id}",
                           code=EErrorCode.NoSuchChunk)
+        return blob
+
+    def _read_erasure_blob(self, chunk_id: str) -> Optional[bytes]:
+        from ytsaurus_tpu import yson
+        from ytsaurus_tpu.chunks.erasure import get_erasure_codec
+
+        meta_path = self._erasure_meta_path(chunk_id)
+        try:
+            with open(meta_path, "rb") as f:
+                meta = yson.loads(f.read())
+        except FileNotFoundError:
+            return None
+        codec = get_erasure_codec(meta["codec"])
+        parts: list[Optional[bytes]] = []
+
+        def read_part(i):
+            try:
+                with open(self._part_path(chunk_id, i), "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                return None            # erased / lost part → repair below
+        # Fast path: data parts only; parity reads happen only on damage.
+        parts = [read_part(i) for i in range(codec.data_parts)]
+        if any(p is None for p in parts):
+            parts += [read_part(i) for i in range(codec.data_parts,
+                                                  codec.total_parts)]
+        else:
+            parts += [None] * codec.parity_parts
+        return codec.decode(parts, meta["size"])
 
     def exists(self, chunk_id: str) -> bool:
-        return os.path.exists(self._path(chunk_id))
+        return os.path.exists(self._path(chunk_id)) or \
+            os.path.exists(self._erasure_meta_path(chunk_id))
 
     def remove_chunk(self, chunk_id: str) -> None:
-        try:
-            os.unlink(self._path(chunk_id))
-        except FileNotFoundError:
-            pass
+        paths = [self._path(chunk_id)]
+        meta_path = self._erasure_meta_path(chunk_id)
+        n_parts = 0
+        if os.path.exists(meta_path):
+            from ytsaurus_tpu import yson
+            from ytsaurus_tpu.chunks.erasure import get_erasure_codec
+            try:
+                with open(meta_path, "rb") as f:
+                    n_parts = get_erasure_codec(
+                        yson.loads(f.read())["codec"]).total_parts
+            except Exception:
+                n_parts = 32           # best effort if the meta is damaged
+            paths.append(meta_path)
+            paths.extend(self._part_path(chunk_id, i) for i in range(n_parts))
+        for path in paths:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
 
     def list_chunks(self) -> list[str]:
-        out = []
+        out = set()
         for sub in os.listdir(self.root):
             subdir = os.path.join(self.root, sub)
             if not os.path.isdir(subdir):
                 continue
             for name in os.listdir(subdir):
                 if name.endswith(".chunk"):
-                    out.append(name[:-len(".chunk")])
+                    out.add(name[:-len(".chunk")])
+                elif name.endswith(".erasure"):
+                    out.add(name[:-len(".erasure")])
         return sorted(out)
 
 

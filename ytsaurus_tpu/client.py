@@ -115,12 +115,20 @@ class YtClient:
             self.set(path + "/@schema", table_schema.to_dict())
         chunks: list[str] = list(node.attributes.get("chunk_ids", [])) \
             if append else []
+        stats: list = list(node.attributes.get("chunk_stats", [])) \
+            if append else []
+        # Keep stats aligned with chunk_ids even for pre-stats tables.
+        while len(stats) < len(chunks):
+            stats.append({})
         row_count = int(node.attributes.get("row_count", 0)) if append else 0
         if rows:
+            from ytsaurus_tpu.query.pruning import compute_column_stats
             chunk = ColumnarChunk.from_rows(table_schema, list(rows))
             chunks.append(self.cluster.chunk_store.write_chunk(chunk))
+            stats.append(compute_column_stats(chunk))
             row_count += chunk.row_count
         self.set(path + "/@chunk_ids", chunks)
+        self.set(path + "/@chunk_stats", stats)
         self.set(path + "/@row_count", row_count)
         # Arbitrary rows invalidate any prior sort guarantee.
         if "sorted_by" in node.attributes:
@@ -308,7 +316,10 @@ class YtClient:
                     timestamp: int = MAX_TIMESTAMP) -> list[dict]:
         """Distributed QL over static and mounted dynamic tables."""
         plan = build_query(query, _SchemaResolver(self))
-        source_chunks = self._query_shards(plan.source, timestamp)
+        from ytsaurus_tpu.query.pruning import extract_column_intervals
+        intervals = extract_column_intervals(plan.where)
+        source_chunks = self._query_shards(plan.source, timestamp,
+                                           intervals=intervals)
         foreign = {}
         for join in plan.joins:
             shards = self._query_shards(join.foreign_table, timestamp)
@@ -398,11 +409,14 @@ class YtClient:
                             sorted_by: Optional[list[str]] = None,
                             schema: Optional[TableSchema] = None) -> None:
         node = self._table_node(path, create=True, schema=schema)
+        from ytsaurus_tpu.query.pruning import compute_column_stats
         chunk_ids = [self.cluster.chunk_store.write_chunk(c) for c in chunks]
         total = sum(c.row_count for c in chunks)
         if schema is not None:
             self.set(path + "/@schema", schema.to_dict())
         self.set(path + "/@chunk_ids", chunk_ids)
+        self.set(path + "/@chunk_stats",
+                 [compute_column_stats(c) for c in chunks])
         self.set(path + "/@row_count", total)
         if sorted_by:
             self.set(path + "/@sorted_by", list(sorted_by))
@@ -410,7 +424,8 @@ class YtClient:
             self.cluster.master.commit_mutation(
                 "remove", path=path + "/@sorted_by", force=True)
 
-    def _query_shards(self, path: str, timestamp: int) -> list[ColumnarChunk]:
+    def _query_shards(self, path: str, timestamp: int,
+                      intervals=None) -> list[ColumnarChunk]:
         node = self._table_node(path)
         if node.attributes.get("dynamic"):
             from ytsaurus_tpu.tablet.ordered import OrderedTablet
@@ -418,8 +433,17 @@ class YtClient:
             if isinstance(tablets[0], OrderedTablet):
                 return [t.snapshot() for t in tablets]
             return [t.read_snapshot(timestamp) for t in tablets]
-        chunks = [self.cluster.chunk_cache.get(cid)
-                  for cid in node.attributes.get("chunk_ids", [])]
+        chunk_ids = node.attributes.get("chunk_ids", [])
+        stats = node.attributes.get("chunk_stats", [])
+        # Range-inference analog: skip chunks whose min/max stats cannot
+        # intersect the WHERE-derived intervals.  Stats pair with chunks
+        # positionally, so prune ONLY when the lists are in lockstep (tables
+        # persisted before stats existed must never be misaligned).
+        if intervals and len(stats) == len(chunk_ids):
+            from ytsaurus_tpu.query.pruning import chunk_may_match
+            chunk_ids = [cid for cid, chunk_stats in zip(chunk_ids, stats)
+                         if chunk_may_match(chunk_stats, intervals)]
+        chunks = [self.cluster.chunk_cache.get(cid) for cid in chunk_ids]
         if not chunks:
             schema = self._node_schema(node)
             if schema is None:
