@@ -116,7 +116,6 @@ class FsChunkStore:
         except FileNotFoundError:
             return None
         codec = get_erasure_codec(meta["codec"])
-        parts: list[Optional[bytes]] = []
 
         def read_part(i):
             try:

@@ -326,7 +326,8 @@ class YtClient:
             foreign[join.foreign_table] = (
                 concat_chunks(shards) if len(shards) > 1 else shards[0])
         out = coordinate_and_execute(plan, source_chunks, foreign,
-                                     evaluator=self.cluster.evaluator)
+                                     evaluator=self.cluster.evaluator,
+                                     merge_shards_below=4_000_000)
         return out.to_rows()
 
     # ---------------------------------------------------------------- operations

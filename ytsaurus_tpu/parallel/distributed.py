@@ -129,12 +129,21 @@ class DistributedEvaluator:
         self.mesh = mesh
         self._cache: dict = {}
 
-    def run(self, plan: ir.Query, table: ShardedTable) -> ColumnarChunk:
+    def run(self, plan: ir.Query, table: ShardedTable,
+            shuffle: Optional[bool] = None) -> ColumnarChunk:
+        """Execute a plan SPMD.  `shuffle=True` uses the all_to_all
+        repartition path for GROUP BY (ref CoordinateAndExecuteWithShuffle,
+        engine_api/coordinator.h:92): rows move to hash(key)-owned devices
+        and each device computes its COMPLETE groups — right when group
+        cardinality is high (the all_gather merge would replicate heavy
+        front work).  Default: gather-merge."""
         if plan.joins:
             raise YtError(
                 "SPMD path does not execute joins yet; use "
                 "coordinate_and_execute (host-coordinated) for joined plans",
                 code=EErrorCode.QueryUnsupported)
+        if shuffle and plan.group is not None and not plan.group.totals:
+            return self._run_shuffled(plan, table)
         n = table.n_shards
         cap = table.capacity
         bottom, front = split_plan(plan)
@@ -167,6 +176,128 @@ class DistributedEvaluator:
                 dictionary=out_col.vocab)
         return ColumnarChunk(schema=TableSchema.make(out_schema_cols),
                              row_count=int(out_count), columns=out_columns)
+
+    def _run_shuffled(self, plan: ir.Query, table: ShardedTable
+                      ) -> ColumnarChunk:
+        """GROUP BY via key-hash all_to_all: every device ends up owning
+        complete groups, so group+having run fully local; only
+        order/project/offset/limit merge at the front."""
+        from dataclasses import replace as dc_replace
+
+        import numpy as np
+
+        from ytsaurus_tpu.parallel.shuffle import route_rows, transfer_counts
+        from ytsaurus_tpu.chunks.columnar import pad_capacity
+        from ytsaurus_tpu.query.engine.expr import (
+            BindContext, ColumnBinding, EmitContext, ExprBinder, _mix_u64,
+            _combine_u64,
+        )
+        from ytsaurus_tpu.query.engine.evaluator import Evaluator
+
+        mesh = self.mesh
+        n = table.n_shards
+        cap = table.capacity
+
+        # Bind where + group-key expressions against the (shared) vocab.
+        def bind_keys():
+            bind_ctx = BindContext(columns={
+                name: ColumnBinding(type=col.type, vocab=col.dictionary)
+                for name, col in table.columns.items()})
+            binder = ExprBinder(bind_ctx)
+            where_b = binder.bind(plan.where) if plan.where is not None else None
+            key_b = [binder.bind(item.expr)
+                     for item in plan.group.group_items]
+            return bind_ctx, where_b, key_b
+
+        bind_ctx, where_b, key_b = bind_keys()
+        bindings = tuple(bind_ctx.bindings)
+        names = [c.name for c in plan.schema]
+        columns_global = {name: (table.columns[name].data,
+                                 table.columns[name].valid)
+                          for name in names}
+
+        def dest_ids(columns, row_valid, bnd):
+            ctx = EmitContext(columns=columns, bindings=bnd, capacity=cap)
+            mask = row_valid
+            if where_b is not None:
+                d, v = where_b.emit(ctx)
+                mask = mask & v & d.astype(bool)
+            acc = jnp.full(cap, np.uint64(0x9E3779B97F4A7C15), dtype=jnp.uint64)
+            for kb in key_b:
+                data, valid = kb.emit(ctx)
+                h = _mix_u64(data) if data.dtype != jnp.bool_ \
+                    else _mix_u64(data.astype(jnp.int8))
+                h = jnp.where(valid, h, jnp.zeros_like(h))
+                acc = _combine_u64(acc, h)
+            pid = (acc % np.uint64(n)).astype(jnp.int32)
+            return jnp.where(mask, pid, n), mask
+
+        # Pass 1: transfer matrix → exact quota.
+        def count_pass(columns, row_valid, bnd):
+            pid, mask = dest_ids(columns, row_valid, bnd)
+            return transfer_counts(pid, mask, n)
+
+        counts = jax.jit(shard_map(
+            count_pass, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+            out_specs=P(SHARD_AXIS), check_vma=False))(
+                columns_global, table.row_valid, bindings)
+        quota = pad_capacity(max(int(np.asarray(counts).max()), 1))
+        recv_cap = quota * n
+
+        # Local plan: complete groups per device (group + having only).
+        local_plan = dc_replace(plan, order=None, project=None, offset=0,
+                                limit=None)
+        local_rep = _RepChunk(
+            capacity=recv_cap,
+            columns={name: _RepColumn(type=col.type, dictionary=col.dictionary)
+                     for name, col in table.columns.items()})
+        prepared_local = prepare(local_plan, local_rep)
+        front = ir.FrontQuery(
+            schema=local_plan.post_group_schema(), order=plan.order,
+            project=plan.project, offset=plan.offset, limit=plan.limit)
+
+        def exchange_and_group(columns, row_valid, bnd, local_bnd):
+            pid, mask = dest_ids(columns, row_valid, bnd)
+            recv, recv_mask = route_rows(columns, pid, n, quota, cap)
+            planes, count = prepared_local.run(recv, recv_mask, local_bnd)
+            out = {}
+            for out_col, (d, v) in zip(prepared_local.output, planes):
+                out[out_col.name] = (d[None, :], v[None, :])
+            return out, count[None]
+
+        key = ("shuffled", ir.fingerprint(plan), n, cap, quota,
+               prepared_local.binding_shapes())
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = jax.jit(shard_map(
+                exchange_and_group, mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+                out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), check_vma=False))
+            self._cache[key] = fn
+        out_planes, out_counts = fn(columns_global, table.row_valid, bindings,
+                                    tuple(prepared_local.bindings))
+        counts_np = [int(c) for c in np.asarray(out_counts)]
+        out_cap = prepared_local.out_capacity
+
+        # Assemble per-shard partial chunks, then host front merge.
+        partials = []
+        inter_schema = front.schema
+        for s in range(n):
+            cols = {}
+            for out_col in prepared_local.output:
+                d, v = out_planes[out_col.name]
+                cols[out_col.name] = Column(
+                    type=out_col.type,
+                    data=d.reshape(n, out_cap)[s],
+                    valid=v.reshape(n, out_cap)[s],
+                    dictionary=out_col.vocab)
+            partials.append(ColumnarChunk(
+                schema=inter_schema, row_count=counts_np[s], columns=cols))
+        from ytsaurus_tpu.chunks.columnar import concat_chunks
+        merged = concat_chunks(
+            [p.slice_rows(0, p.row_count) for p in partials])
+        return Evaluator().run_plan(front, merged)
 
     def _build(self, prepared_b, prepared_f, cap: int):
         mesh = self.mesh

@@ -107,6 +107,49 @@ def _sample_pivots(table: ShardedTable, key_names: list[str],
     return pivots
 
 
+def route_rows(planes: dict, pid: jax.Array, n: int, quota: int,
+               cap: int) -> tuple[dict, jax.Array]:
+    """Inside shard_map: scatter local rows into per-destination blocks and
+    all_to_all them.  `pid` in [0, n) for live rows, n for discards.
+    Returns (received planes, received-row mask); receive capacity n*quota."""
+    order = jnp.argsort(pid, stable=True)
+    pid_sorted = pid[order]
+    dest_counts = jax.vmap(lambda d: (pid_sorted == d).sum())(jnp.arange(n + 1))
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                              jnp.cumsum(dest_counts)[:-1]])
+    pos = jnp.arange(cap)
+    slot = pos - starts[jnp.clip(pid_sorted, 0, n)]
+    send_index = jnp.clip(pid_sorted, 0, n - 1) * quota + slot
+    in_quota = (slot < quota) & (pid_sorted < n)
+    send_index = jnp.where(in_quota, send_index, n * quota)
+
+    def route(plane):
+        plane_sorted = plane[order]
+        buf = jnp.zeros(n * quota + 1, dtype=plane.dtype)
+        buf = buf.at[send_index].set(plane_sorted)
+        return buf[: n * quota].reshape(n, quota)
+
+    sent_mask = jnp.zeros(n * quota + 1, dtype=bool).at[send_index].set(
+        in_quota)[: n * quota].reshape(n, quota)
+    recv_mask = jax.lax.all_to_all(sent_mask, SHARD_AXIS, 0, 0,
+                                   tiled=False).reshape(-1)
+    recv: dict = {}
+    for name, (data, valid) in planes.items():
+        r_data = jax.lax.all_to_all(route(data), SHARD_AXIS, 0, 0,
+                                    tiled=False).reshape(-1)
+        r_valid = jax.lax.all_to_all(route(valid), SHARD_AXIS, 0, 0,
+                                     tiled=False).reshape(-1)
+        recv[name] = (r_data, r_valid & recv_mask)
+    return recv, recv_mask
+
+
+def transfer_counts(pid: jax.Array, row_valid: jax.Array, n: int) -> jax.Array:
+    """Inside shard_map: (1, n) per-destination counts for quota sizing."""
+    pid = jnp.where(row_valid, pid, n)
+    counts = jax.vmap(lambda dest: (pid == dest).sum())(jnp.arange(n))
+    return counts[None, :]
+
+
 def sort_table(table: ShardedTable, key_columns: Sequence[str],
                descending: bool = False) -> ShardedTable:
     """Globally sort a ShardedTable by `key_columns` across the mesh.
@@ -166,37 +209,8 @@ def sort_table(table: ShardedTable, key_columns: Sequence[str],
         if descending:
             pid = (n - 1) - pid
         pid = jnp.where(row_valid, pid, n)
-        # Group rows by destination: stable sort by pid.
-        order = jnp.argsort(pid, stable=True)
-        pid_sorted = pid[order]
-        # Slot within destination block: position - start(dest).
-        dest_counts = jax.vmap(lambda d: (pid_sorted == d).sum())(jnp.arange(n + 1))
-        starts = jnp.concatenate([jnp.zeros(1, jnp.int64),
-                                  jnp.cumsum(dest_counts)[:-1]])
-        pos = jnp.arange(cap)
-        slot = pos - starts[jnp.clip(pid_sorted, 0, n)]
-        send_index = jnp.clip(pid_sorted, 0, n - 1) * quota + slot
-        in_quota = (slot < quota) & (pid_sorted < n)
-        send_index = jnp.where(in_quota, send_index, n * quota)
-
-        def route(plane):
-            plane_sorted = plane[order]
-            buf = jnp.zeros(n * quota + 1, dtype=plane.dtype)
-            buf = buf.at[send_index].set(plane_sorted)
-            return buf[: n * quota].reshape(n, quota)
-
-        recv_planes = {}
-        sent_mask = jnp.zeros(n * quota + 1, dtype=bool).at[send_index].set(
-            in_quota)[: n * quota].reshape(n, quota)
-        recv_mask = jax.lax.all_to_all(sent_mask, SHARD_AXIS, 0, 0,
-                                       tiled=False).reshape(-1)
-        for name in names:
-            data, valid = columns_in[name]
-            r_data = jax.lax.all_to_all(route(data), SHARD_AXIS, 0, 0,
-                                        tiled=False).reshape(-1)
-            r_valid = jax.lax.all_to_all(route(valid), SHARD_AXIS, 0, 0,
-                                         tiled=False).reshape(-1)
-            recv_planes[name] = (r_data, r_valid & recv_mask)
+        recv_planes, recv_mask = route_rows(
+            {name: columns_in[name] for name in names}, pid, n, quota, cap)
         # Local sort of received rows by key (absent rows sink last).
         sort_keys = []
         for name in reversed(key_names):

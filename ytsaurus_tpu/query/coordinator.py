@@ -170,17 +170,25 @@ def coordinate_and_execute(
         plan: ir.Query,
         chunks: Sequence[ColumnarChunk],
         foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None,
-        evaluator: Optional[Evaluator] = None) -> ColumnarChunk:
+        evaluator: Optional[Evaluator] = None,
+        merge_shards_below: int = 0) -> ColumnarChunk:
     """Host-coordinated fan-out: run the bottom query per shard (tablet),
     concatenate partial results, run the front merge.
 
     Ref: CoordinateAndExecute (engine_api/coordinator.cpp) — here shard
     results stay on device; only the final row count syncs to host.
+
+    `merge_shards_below`: when > 0, shards are first coalesced so no device
+    program runs over fewer than this many rows — per-program dispatch
+    overhead dominates small shards (ref analog: chunk slice grouping in
+    chunk pools).  0 preserves one program per shard.
     """
     evaluator = evaluator or Evaluator()
     if not chunks:
         raise YtError("coordinate_and_execute: no input shards",
                       code=EErrorCode.QueryExecutionError)
+    if merge_shards_below > 0 and len(chunks) > 1:
+        chunks = _coalesce_shards(chunks, merge_shards_below)
     if len(chunks) == 1:
         return evaluator.run_plan(plan, chunks[0], foreign_chunks)
     bottom, front = split_plan(plan)
@@ -188,3 +196,22 @@ def coordinate_and_execute(
                 for chunk in chunks]
     merged = concat_chunks([p.slice_rows(0, p.row_count) for p in partials])
     return evaluator.run_plan(front, merged)
+
+
+def _coalesce_shards(chunks: Sequence[ColumnarChunk],
+                     min_rows: int) -> list[ColumnarChunk]:
+    groups: list[list[ColumnarChunk]] = []
+    current: list[ColumnarChunk] = []
+    current_rows = 0
+    for chunk in chunks:
+        current.append(chunk)
+        current_rows += chunk.row_count
+        if current_rows >= min_rows:
+            groups.append(current)
+            current, current_rows = [], 0
+    if current:
+        if groups:
+            groups[-1].extend(current)
+        else:
+            groups.append(current)
+    return [concat_chunks(g) if len(g) > 1 else g[0] for g in groups]
