@@ -393,3 +393,82 @@ def test_pruning_skipped_for_pre_stats_tables(client):
                        append=True)
     rows = client.select_rows("k FROM [//tmp/legacy] WHERE k = 5")
     assert [r["k"] for r in rows] == [5]
+
+
+# --- multi-tablet (resharded) dynamic tables ----------------------------------
+
+def test_reshard_and_multi_tablet_ops(client):
+    client.create("table", "//dyn/sharded", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/sharded")
+    client.insert_rows("//dyn/sharded",
+                       [{"key": i, "value": f"v{i}"} for i in range(30)])
+    client.unmount_table("//dyn/sharded")
+    client.reshard_table("//dyn/sharded", [(10,), (20,)])
+    client.mount_table("//dyn/sharded")
+    tablets = client._mounted_tablets("//dyn/sharded")
+    assert len(tablets) == 3
+    # Existing rows redistributed: all keys still readable.
+    rows = client.lookup_rows("//dyn/sharded", [(5,), (15,), (25,), (99,)])
+    assert [r and r["key"] for r in rows] == [5, 15, 25, None]
+    # New writes route to the right tablets.
+    client.insert_rows("//dyn/sharded", [{"key": 3, "value": "low"},
+                                         {"key": 29, "value": "high"}])
+    assert tablets[0].active_store.key_count == 1
+    assert tablets[2].active_store.key_count == 1
+    # select spans all tablets.
+    out = client.select_rows(
+        "count(*) AS c FROM [//dyn/sharded] GROUP BY 1 AS o")
+    assert out == [{"c": 30}]
+    # Deletes route too.
+    client.delete_rows("//dyn/sharded", [(15,)])
+    assert client.lookup_rows("//dyn/sharded", [(15,)]) == [None]
+    # Per-tablet persistence across remount.
+    client.unmount_table("//dyn/sharded")
+    client.mount_table("//dyn/sharded")
+    rows = client.lookup_rows("//dyn/sharded", [(3,), (15,), (29,)])
+    assert rows[0]["value"] == b"low"
+    assert rows[1] is None
+    assert rows[2]["value"] == b"high"
+
+
+def test_reshard_requires_unmounted(client):
+    client.create("table", "//dyn/r", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/r")
+    with pytest.raises(YtError):
+        client.reshard_table("//dyn/r", [(5,)])
+    client.unmount_table("//dyn/r")
+    with pytest.raises(YtError):
+        client.reshard_table("//dyn/r", [(5, 6)])   # wrong key width
+    with pytest.raises(YtError):
+        client.reshard_table("//dyn/r", [(7,), (5,)])  # not increasing
+
+
+def test_compact_resharded_table_survives_restart(tmp_path):
+    client = connect(str(tmp_path))
+    client.create("table", "//dyn/c", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/c")
+    client.insert_rows("//dyn/c", [{"key": i, "value": f"v{i}"}
+                                   for i in range(20)])
+    client.unmount_table("//dyn/c")
+    client.reshard_table("//dyn/c", [(10,)])
+    client.mount_table("//dyn/c")
+    client.insert_rows("//dyn/c", [{"key": 5, "value": "new5"},
+                                   {"key": 15, "value": "new15"}])
+    client.compact_table("//dyn/c")   # persists nested per-tablet chunks
+    client.unmount_table("//dyn/c")
+    reopened = connect(str(tmp_path))
+    reopened.mount_table("//dyn/c")
+    rows = reopened.lookup_rows("//dyn/c", [(5,), (15,), (19,)])
+    assert rows[0]["value"] == b"new5"
+    assert rows[1]["value"] == b"new15"
+    assert rows[2]["value"] == b"v19"
+
+
+def test_duplicate_pivots_rejected(client):
+    client.create("table", "//dyn/dup", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    with pytest.raises(YtError):
+        client.reshard_table("//dyn/dup", [(5,), (5,)])

@@ -165,11 +165,22 @@ class YtClient:
         if node.id in self.cluster.tablets:
             return
         if schema.is_sorted:
-            tablet = Tablet(schema, self.cluster.chunk_store,
-                            tablet_id=f"{node.id}-0",
-                            chunk_cache=self.cluster.chunk_cache)
-            tablet.chunk_ids = list(node.attributes.get("tablet_chunk_ids", []))
-            self.cluster.tablets[node.id] = [tablet]
+            # One tablet per pivot range (ref: tablet pivot keys,
+            # server/master/tablet_server; partition.h range sharding).
+            pivots = [tuple(p) for p in node.attributes.get("pivot_keys", [])]
+            per_tablet = node.attributes.get("tablet_chunk_ids", [])
+            if per_tablet and isinstance(per_tablet[0], str):
+                per_tablet = [per_tablet]      # migrate pre-reshard layout
+            tablets = []
+            for i in range(len(pivots) + 1):
+                tablet = Tablet(schema, self.cluster.chunk_store,
+                                tablet_id=f"{node.id}-{i}",
+                                pivot_key=pivots[i - 1] if i else None,
+                                chunk_cache=self.cluster.chunk_cache)
+                tablet.chunk_ids = list(per_tablet[i]) \
+                    if i < len(per_tablet) else []
+                tablets.append(tablet)
+            self.cluster.tablets[node.id] = tablets
         else:
             # Unsorted dynamic schema → ordered (queue) table.
             from ytsaurus_tpu.tablet.ordered import OrderedTablet
@@ -201,11 +212,79 @@ class YtClient:
                 "base_index": t.base_index,
                 "trimmed_count": t.trimmed_count})
         else:
-            chunk_ids: list[str] = []
-            for tablet in tablets:
-                chunk_ids.extend(tablet.chunk_ids)
-            self.set(path + "/@tablet_chunk_ids", chunk_ids)
+            self.set(path + "/@tablet_chunk_ids",
+                     [list(t.chunk_ids) for t in tablets])
         self.set(path + "/@tablet_state", "unmounted")
+
+    def reshard_table(self, path: str, pivot_keys: Sequence[tuple]) -> None:
+        """Re-shard an (unmounted) sorted dynamic table into len(pivots)+1
+        tablets; existing data redistributes to the new ranges.
+
+        Ref: tablet_server reshard with pivot keys (tablet_manager.h);
+        here redistribution rewrites the versioned chunks per range."""
+        from ytsaurus_tpu.tablet.dynamic_store import _null_safe
+        from ytsaurus_tpu.tablet.tablet import (
+            _versioned_sort_key,
+            versioned_schema,
+        )
+        node = self._table_node(path)
+        if node.id in self.cluster.tablets:
+            raise YtError(f"Table {path!r} must be unmounted to reshard",
+                          code=EErrorCode.TabletNotMounted)
+        schema = self._node_schema(node)
+        if schema is None or not schema.is_sorted or \
+                not node.attributes.get("dynamic"):
+            raise YtError("reshard_table requires a sorted dynamic table",
+                          code=EErrorCode.TabletNotMounted)
+        from ytsaurus_tpu.tablet.tablet import _normalize_value
+        key_cols = schema.key_columns
+        key_width = len(key_cols)
+        pivots = []
+        for p in pivot_keys:
+            p = tuple(p)
+            if len(p) != key_width:
+                raise YtError(f"Pivot {p!r} width != key width {key_width}")
+            pivots.append(tuple(_normalize_value(v, c.type)
+                                for v, c in zip(p, key_cols)))
+        safe_pivots = [_null_safe(p) for p in pivots]
+        if any(a >= b for a, b in zip(safe_pivots, safe_pivots[1:])):
+            raise YtError("Pivot keys must be strictly increasing")
+
+        # Redistribute existing versioned chunks into the new ranges.
+        old = node.attributes.get("tablet_chunk_ids", [])
+        if old and isinstance(old[0], str):
+            old = [old]
+        all_rows: list[dict] = []
+        for ids in old:
+            for cid in ids:
+                all_rows.extend(self.cluster.chunk_store.read_chunk(cid)
+                                .to_rows())
+        key_names = schema.key_column_names
+        buckets: list[list[dict]] = [[] for _ in range(len(pivots) + 1)]
+        for row in all_rows:
+            sk = _null_safe(tuple(row[name] for name in key_names))
+            idx = 0
+            for i, sp in enumerate(safe_pivots):
+                if sk >= sp:
+                    idx = i + 1
+            buckets[idx].append(row)
+        vschema = versioned_schema(schema)
+        per_tablet_ids: list[list[str]] = []
+        for bucket in buckets:
+            if bucket:
+                bucket.sort(key=_versioned_sort_key(schema))
+                chunk = ColumnarChunk.from_rows(vschema, bucket)
+                per_tablet_ids.append(
+                    [self.cluster.chunk_store.write_chunk(chunk)])
+            else:
+                per_tablet_ids.append([])
+        for ids in old:
+            for cid in ids:
+                self.cluster.chunk_store.remove_chunk(cid)
+                self.cluster.chunk_cache.invalidate(cid)
+        self.set(path + "/@pivot_keys", [list(p) for p in pivots])
+        self.set(path + "/@tablet_chunk_ids", per_tablet_ids)
+        self.set(path + "/@tablet_count", len(pivots) + 1)
 
     # queue (ordered table) API — ref queue_client
 
@@ -236,6 +315,24 @@ class YtClient:
         if not isinstance(tablet, OrderedTablet):
             raise YtError(f"{path!r} is not an ordered (queue) table",
                           code=EErrorCode.QueryUnsupported)
+
+    def _route_rows(self, path: str, tablets, rows):
+        """Group rows by owning tablet (pivot ranges); bisect over the
+        tablets' own (already normalized) pivot keys."""
+        import bisect
+
+        from ytsaurus_tpu.tablet.dynamic_store import _null_safe
+        safe_pivots = [
+            _null_safe(tablets[0].normalize_key(tuple(t.pivot_key)))
+            for t in tablets[1:]]
+        out: dict[int, list] = {}
+        for row in rows:
+            key = tablets[0].active_store.key_of(row) \
+                if isinstance(row, dict) else tuple(row)
+            sk = _null_safe(tablets[0].normalize_key(key))
+            idx = bisect.bisect_right(safe_pivots, sk)
+            out.setdefault(idx, []).append(row)
+        return out
 
     @staticmethod
     def _require_sorted(tablet, path: str) -> None:
@@ -283,7 +380,8 @@ class YtClient:
         txm = self.cluster.transactions
         own = tx is None
         tx = tx or txm.start()
-        txm.write_rows(tx, tablets[0], list(rows))
+        for idx, part in self._route_rows(path, tablets, list(rows)).items():
+            txm.write_rows(tx, tablets[idx], part)
         if own:
             return txm.commit(tx)
         return None
@@ -295,7 +393,9 @@ class YtClient:
         txm = self.cluster.transactions
         own = tx is None
         tx = tx or txm.start()
-        txm.delete_rows(tx, tablets[0], [tuple(k) for k in keys])
+        for idx, part in self._route_rows(
+                path, tablets, [tuple(k) for k in keys]).items():
+            txm.delete_rows(tx, tablets[idx], part)
         if own:
             return txm.commit(tx)
         return None
@@ -304,11 +404,20 @@ class YtClient:
                     timestamp: int = MAX_TIMESTAMP,
                     column_names: Optional[Sequence[str]] = None
                     ) -> list[Optional[dict]]:
-        (tablet,) = self._mounted_tablets(path)
-        self._require_sorted(tablet, path)
-        return tablet.lookup_rows([tuple(k) for k in keys],
-                                  timestamp=timestamp,
-                                  column_names=column_names)
+        tablets = self._mounted_tablets(path)
+        self._require_sorted(tablets[0], path)
+        keys = [tuple(k) for k in keys]
+        routed = self._route_rows(path, tablets, keys)
+        results: dict[tuple, Optional[dict]] = {}
+        for idx, part in routed.items():
+            normalized = [tablets[idx].normalize_key(k) for k in part]
+            for nk, row in zip(normalized,
+                               tablets[idx].lookup_rows(
+                                   part, timestamp=timestamp,
+                                   column_names=column_names)):
+                results[nk] = row
+    # preserve request order
+        return [results[tablets[0].normalize_key(k)] for k in keys]
 
     # --------------------------------------------------------------------- query
 
@@ -394,10 +503,10 @@ class YtClient:
     def _persist_tablet_chunks(self, path: str) -> None:
         node = self._table_node(path)
         tablets = self.cluster.tablets.get(node.id, [])
-        chunk_ids: list[str] = []
-        for tablet in tablets:
-            chunk_ids.extend(tablet.chunk_ids)
-        self.set(path + "/@tablet_chunk_ids", chunk_ids)
+        # Nested per-tablet layout — must match mount/unmount exactly, or a
+        # restart reassigns every chunk to tablet 0.
+        self.set(path + "/@tablet_chunk_ids",
+                 [list(t.chunk_ids) for t in tablets])
 
     def _read_table_chunks(self, path: str) -> list[ColumnarChunk]:
         node = self._table_node(path)
