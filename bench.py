@@ -1,13 +1,24 @@
-"""Benchmark: TPC-H Q1 rows/sec on the query engine (BASELINE.md config 1).
+"""Benchmarks for the BASELINE.md configs.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The default (headline) config is TPC-H Q1 rows/sec (config 1); the others
+are selectable with --config:
+
+  q1      scan + filter + 8-aggregate GROUP BY (headline; default)
+  groupby GROUP BY key over a sorted table (hash-aggregate path, config 2)
+  topk    ORDER BY ... LIMIT K (config 3)
+  q3      two-table JOIN + GROUP BY + top-K (TPC-H Q3, config 4)
+  sort    device sort (single-chip stand-in for the 1B-row Sort, config 5)
 
 Baseline: the reference's LLVM-JIT evaluator on a modern x86 core sustains
 roughly 5e7 rows/s on Q1-shaped scan+filter+group (order-of-magnitude from
 vectorized-engine literature; the reference repo publishes no absolute
-numbers — see BASELINE.md).  vs_baseline = ours / 5e7.
+numbers — see BASELINE.md).  vs_baseline = ours / 5e7 for the query configs.
 
-Usage: python bench.py [--smoke] [--rows N] [--iters K]
+NOTE: under the axon tunnel, jax.block_until_ready does NOT synchronize —
+timings force a real device→host read instead.
+
+Usage: python bench.py [--config NAME] [--smoke] [--rows N] [--iters K]
 """
 
 import argparse
@@ -19,8 +30,132 @@ import time
 BASELINE_ROWS_PER_SEC = 5.0e7
 
 
+def _sync(x):
+    """True synchronization: force a host read (see module note)."""
+    import numpy as np
+    leaf = x
+    while isinstance(leaf, (list, tuple)):
+        leaf = leaf[0]
+    np.asarray(leaf).ravel()[:1]
+
+
+def _time_plan(query, tables, iters, evaluator=None):
+    """Compile + time one plan over prepared chunks; returns best seconds."""
+    import jax
+
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.engine.lowering import prepare
+
+    schemas = {path: chunk.schema for path, chunk in tables.items()}
+    plan = build_query(query, schemas)
+    chunk = tables[plan.source]
+    prepared = prepare(plan, chunk)
+    columns = {c.name: (chunk.columns[c.name].data,
+                        chunk.columns[c.name].valid)
+               for c in plan.schema}
+    bindings = tuple(prepared.bindings)
+    row_valid = chunk.row_valid
+    fn = jax.jit(prepared.run)
+    planes, count = fn(columns, row_valid, bindings)   # warm-up / compile
+    _sync(planes)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        planes, count = fn(columns, row_valid, bindings)
+        _sync(planes)
+        times.append(time.perf_counter() - t0)
+    return min(times), int(count)
+
+
+def bench_q1(n_rows, iters):
+    from ytsaurus_tpu.models import tpch
+    chunk = tpch.generate_lineitem(n_rows)
+    best, groups = _time_plan(tpch.Q1, {"//tpch/lineitem": chunk}, iters)
+    assert 1 <= groups <= 6
+    return "tpch_q1_rows_per_sec", n_rows / best, best
+
+def bench_groupby(n_rows, iters):
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.schema import TableSchema
+    rng = np.random.default_rng(0)
+    schema = TableSchema.make([("k", "int64", "ascending"), ("g", "int64"),
+                               ("v", "int64")])
+    chunk = ColumnarChunk.from_arrays(schema, {
+        "k": np.arange(n_rows), "g": rng.integers(0, 10_000, n_rows),
+        "v": rng.integers(0, 1000, n_rows)})
+    best, _ = _time_plan(
+        "g, sum(v) AS s, count(*) AS c FROM [//t] GROUP BY g",
+        {"//t": chunk}, iters)
+    return "groupby_rows_per_sec", n_rows / best, best
+
+def bench_topk(n_rows, iters):
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.schema import TableSchema
+    rng = np.random.default_rng(0)
+    schema = TableSchema.make([("k", "int64"), ("v", "double")])
+    chunk = ColumnarChunk.from_arrays(schema, {
+        "k": np.arange(n_rows), "v": rng.uniform(0, 1, n_rows)})
+    best, count = _time_plan(
+        "k, v FROM [//t] ORDER BY v DESC LIMIT 100", {"//t": chunk}, iters)
+    assert count == 100
+    return "topk_rows_per_sec", n_rows / best, best
+
+def bench_q3(n_rows, iters):
+    from ytsaurus_tpu.models import tpch
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    n_orders = max(n_rows // 4, 1)
+    lineitem = tpch.generate_lineitem(n_rows, n_orders=n_orders)
+    orders = tpch.generate_orders(n_orders)
+    ev = Evaluator()
+    from ytsaurus_tpu.query.builder import build_query
+    plan = build_query(tpch.Q3, {"//tpch/lineitem": tpch.LINEITEM_SCHEMA,
+                                 "//tpch/orders": tpch.ORDERS_SCHEMA})
+    foreign = {"//tpch/orders": orders}
+    out = ev.run_plan(plan, lineitem, foreign)      # warm-up (incl. join)
+    assert out.row_count <= 10
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = ev.run_plan(plan, lineitem, foreign)
+        _sync(out.columns[out.schema.column_names[0]].data)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    return "tpch_q3_rows_per_sec", n_rows / best, best
+
+def bench_sort(n_rows, iters):
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.operations.sort_op import sort_chunk
+    from ytsaurus_tpu.schema import TableSchema
+    rng = np.random.default_rng(0)
+    schema = TableSchema.make([("k", "int64"), ("p", "double")])
+    chunk = ColumnarChunk.from_arrays(schema, {
+        "k": rng.integers(0, 1 << 60, n_rows), "p": rng.uniform(0, 1, n_rows)})
+    out = sort_chunk(chunk, ["k"])                  # warm-up
+    _sync(out.columns["k"].data)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = sort_chunk(chunk, ["k"])
+        _sync(out.columns["k"].data)
+        times.append(time.perf_counter() - t0)
+    return "sort_rows_per_sec", n_rows / min(times), min(times)
+
+
+_CONFIGS = {
+    "q1": (bench_q1, 64_000_000),
+    "groupby": (bench_groupby, 16_000_000),
+    "topk": (bench_topk, 64_000_000),
+    "q3": (bench_q3, 4_000_000),
+    "sort": (bench_sort, 16_000_000),
+}
+
+
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--config", choices=sorted(_CONFIGS), default="q1")
     parser.add_argument("--smoke", action="store_true",
                         help="small row count, CPU-friendly")
     parser.add_argument("--rows", type=int, default=None)
@@ -29,44 +164,16 @@ def main():
 
     import jax
 
-    from ytsaurus_tpu.models import tpch
-    from ytsaurus_tpu.query.builder import build_query
-    from ytsaurus_tpu.query.engine.lowering import prepare
-
-    n_rows = args.rows or (100_000 if args.smoke else 64_000_000)
-    chunk = tpch.generate_lineitem(n_rows)
-    plan = build_query(tpch.Q1, {"//tpch/lineitem": tpch.LINEITEM_SCHEMA})
-    prepared = prepare(plan, chunk)
-    columns = {c.name: (chunk.columns[c.name].data,
-                        chunk.columns[c.name].valid)
-               for c in plan.schema}
-    bindings = tuple(prepared.bindings)
-    row_valid = chunk.row_valid
-    jax.block_until_ready(row_valid)
-    fn = jax.jit(prepared.run)
-
-    # Warm-up / compile.
-    planes, count = fn(columns, row_valid, bindings)
-    jax.block_until_ready(planes)
-    n_groups = int(count)
-    assert 1 <= n_groups <= 6, f"Q1 produced {n_groups} groups"
-
-    times = []
-    for _ in range(args.iters):
-        t0 = time.perf_counter()
-        planes, count = fn(columns, row_valid, bindings)
-        jax.block_until_ready(planes)
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    rows_per_sec = n_rows / best
-
+    fn, default_rows = _CONFIGS[args.config]
+    n_rows = args.rows or (100_000 if args.smoke else default_rows)
+    metric, rows_per_sec, best = fn(n_rows, args.iters)
     print(json.dumps({
-        "metric": "tpch_q1_rows_per_sec",
+        "metric": metric,
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
     }))
-    print(f"# n_rows={n_rows} best={best*1e3:.2f}ms groups={n_groups} "
+    print(f"# config={args.config} n_rows={n_rows} best={best*1e3:.2f}ms "
           f"device={jax.devices()[0].platform}", file=sys.stderr)
 
 

@@ -494,3 +494,121 @@ def test_cardinality_negative_zero_counts_once():
              {T: ([("k", "int64", "ascending"), ("g", "int64"),
                    ("d", "double")], rows)},
              [{"g": 0, "c": 2}])
+
+
+def test_topk_fast_path_with_nulls_desc_and_asc():
+    # Large-capacity single-key ORDER BY LIMIT triggers the top_k candidate
+    # path; null ordering must survive it (asc: nulls first, desc: last).
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.schema import TableSchema
+    rng = np.random.default_rng(0)
+    n = 20_000
+    schema = TableSchema.make([("k", "int64"), ("v", "double")])
+    valids = np.ones(n, dtype=bool)
+    valids[:5] = False            # five null v rows
+    chunk = ColumnarChunk.from_arrays(
+        schema, {"k": np.arange(n), "v": rng.uniform(0, 1, n)},
+        valids={"v": valids, "k": np.ones(n, dtype=bool)})
+    from tests.harness import evaluate
+    rows = evaluate("k, v FROM [//t] ORDER BY v LIMIT 8", {"//t": chunk})
+    assert [r["v"] for r in rows[:5]] == [None] * 5       # nulls first (asc)
+    vs = [r["v"] for r in rows[5:]]
+    assert vs == sorted(vs)
+    rows = evaluate("k, v FROM [//t] ORDER BY v DESC LIMIT 8", {"//t": chunk})
+    assert all(r["v"] is not None for r in rows)
+    vs = [r["v"] for r in rows]
+    assert vs == sorted(vs, reverse=True)
+    # oracle: exact top-8
+    data = np.asarray(chunk.column("v").data[:n])[valids]
+    assert abs(vs[0] - data.max()) < 1e-12
+
+
+def test_int_key_dense_group_path_with_offset_range():
+    # int64 keys in a narrow range far from zero take the dense path.
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.schema import TableSchema
+    from tests.harness import evaluate
+    rng = np.random.default_rng(1)
+    n = 5000
+    base = 7_000_000_000
+    schema = TableSchema.make([("g", "int64"), ("v", "int64")])
+    chunk = ColumnarChunk.from_arrays(
+        schema, {"g": base + rng.integers(0, 100, n),
+                 "v": rng.integers(0, 10, n)})
+    rows = evaluate("g, sum(v) AS s, count(*) AS c FROM [//t] GROUP BY g",
+                    {"//t": chunk})
+    want = {}
+    gs = np.asarray(chunk.column("g").data[:n])
+    vs = np.asarray(chunk.column("v").data[:n])
+    for g, v in zip(gs, vs):
+        e = want.setdefault(int(g), [0, 0])
+        e[0] += int(v)
+        e[1] += 1
+    assert len(rows) == len(want)
+    for r in rows:
+        assert want[r["g"]] == [r["s"], r["c"]]
+
+
+def test_topk_desc_with_many_nulls_and_negatives():
+    # Regression: null rows must not crowd out negative values in the
+    # descending candidate selection, and fillers must be nulls (not
+    # arbitrary rows) when values run out.
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.schema import TableSchema
+    from tests.harness import evaluate
+    n = 20_000
+    schema = TableSchema.make([("k", "int64"), ("v", "int64")])
+    vals = -np.arange(2, n + 2)          # all negative
+    valids = np.ones(n, dtype=bool)
+    valids[:1000] = False                # 1000 nulls
+    chunk = ColumnarChunk.from_arrays(
+        schema, {"k": np.arange(n), "v": vals},
+        valids={"v": valids, "k": np.ones(n, dtype=bool)})
+    rows = evaluate("k, v FROM [//t] ORDER BY v DESC LIMIT 6", {"//t": chunk})
+    got = [r["v"] for r in rows]
+    assert got == [-1002, -1003, -1004, -1005, -1006, -1007]
+
+
+def test_topk_value_at_type_extreme():
+    # A valid row whose inverted key aliases the exclusion sentinel
+    # (v = INT64_MAX ascending) must still be selectable.
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.schema import TableSchema
+    from tests.harness import evaluate
+    n = 20_000
+    schema = TableSchema.make([("k", "int64"), ("v", "int64")])
+    vals = np.arange(n, dtype=np.int64) + 100
+    vals[7] = np.iinfo(np.int64).max
+    chunk = ColumnarChunk.from_arrays(schema, {"k": np.arange(n), "v": vals})
+    rows = evaluate("k, v FROM [//t] ORDER BY v DESC LIMIT 3", {"//t": chunk})
+    assert rows[0]["v"] == np.iinfo(np.int64).max
+    rows = evaluate(
+        "k FROM [//t] WHERE v >= 9223372036854775807 ORDER BY v LIMIT 5",
+        {"//t": chunk})
+    assert [r["k"] for r in rows] == [7]
+
+
+def test_dense_group_uint64_high_range():
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.schema import TableSchema
+    from tests.harness import evaluate
+    base = 2**63 + 5
+    n = 4000
+    rng = np.random.default_rng(0)
+    offs = rng.integers(0, 50, n)
+    schema = TableSchema.make([("g", "uint64"), ("v", "int64")])
+    chunk = ColumnarChunk.from_arrays(
+        schema, {"g": (np.full(n, base, dtype=np.uint64)
+                       + offs.astype(np.uint64)),
+                 "v": np.ones(n, dtype=np.int64)})
+    rows = evaluate("g, count(*) AS c FROM [//t] GROUP BY g", {"//t": chunk})
+    import collections
+    want = collections.Counter((base + int(o)) for o in offs)
+    assert len(rows) == len(want)
+    for r in rows:
+        assert want[r["g"]] == r["c"]

@@ -41,7 +41,7 @@ from ytsaurus_tpu.query.engine.expr import (
     EmitContext,
     ExprBinder,
 )
-from ytsaurus_tpu.schema import EValueType, TableSchema
+from ytsaurus_tpu.schema import EValueType, TableSchema, device_dtype
 
 
 @dataclass
@@ -64,6 +64,35 @@ class PreparedQuery:
     def binding_shapes(self) -> tuple:
         return (tuple((tuple(b.shape), str(b.dtype)) for b in self.bindings),
                 self.structure_key)
+
+
+import weakref
+
+_MINMAX_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _column_min_max(col, ty: EValueType) -> tuple[int, int]:
+    """Min/max of an integer column's valid values, memoized per device
+    plane (two tiny reductions + host reads otherwise repeat on every
+    execution of a cached plan)."""
+    try:
+        cached = _MINMAX_CACHE.get(col.data)
+    except TypeError:
+        cached = None
+    if cached is not None:
+        return cached
+    info = np.iinfo(np.int64 if ty is EValueType.int64 else np.uint64)
+    top = jnp.array(info.max, dtype=col.data.dtype)
+    bot = jnp.array(info.min, dtype=col.data.dtype)
+    lo = int(jnp.min(jnp.where(col.valid, col.data, top)))
+    hi = int(jnp.max(jnp.where(col.valid, col.data, bot)))
+    if hi < lo:               # no valid values at all
+        lo, hi = 0, 0
+    try:
+        _MINMAX_CACHE[col.data] = (lo, hi)
+    except TypeError:
+        pass
+    return lo, hi
 
 
 def _column_bindings(schema: TableSchema, chunk) -> dict[str, ColumnBinding]:
@@ -151,29 +180,55 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
     # table" becomes a dense segment_sum over dict-code strides.
     fast_group = None
     if group is not None:
-        sizes = []
-        for _, bound in group_key_b:
+        # Per key: (size, offset).  Dictionary codes and booleans have known
+        # domains; integer REFERENCE columns get a device min/max probe (one
+        # tiny reduction, host-read) — XLA sorts collapse beyond ~4M rows on
+        # TPU, so avoiding the sort is worth a probe per (chunk, plan).
+        sizes_offsets: "list[tuple[int, int]] | None" = []
+        for item, (_, bound) in zip(group.group_items, group_key_b):
             if bound.type is EValueType.string and bound.vocab is not None:
-                sizes.append(len(bound.vocab))
+                sizes_offsets.append((len(bound.vocab), 0))
             elif bound.type is EValueType.boolean:
-                sizes.append(2)
+                sizes_offsets.append((2, 0))
+            elif bound.type in (EValueType.int64, EValueType.uint64) and \
+                    isinstance(item.expr, ir.TReference):
+                col = chunk.columns.get(item.expr.name) \
+                    if hasattr(chunk, "columns") else None
+                data = getattr(col, "data", None)
+                if data is None:          # rep chunks carry no planes
+                    sizes_offsets = None
+                    break
+                lo, hi = _column_min_max(col, bound.type)
+                if hi - lo + 1 > 65536:
+                    sizes_offsets = None
+                    break
+                sizes_offsets.append((hi - lo + 1, lo))
             else:
-                sizes = None
+                sizes_offsets = None
                 break
-        if sizes is not None:
+        if sizes_offsets is not None:
             dims = 1
-            for s in sizes:
+            for s, _ in sizes_offsets:
                 dims *= s + 1          # +1 slot per key for NULL
             if 0 < dims <= 65536:
                 strides = []
                 acc = 1
-                for s in reversed(sizes):
+                for s, _ in reversed(sizes_offsets):
                     strides.append(acc)
                     acc *= s + 1
                 strides.reverse()
                 from ytsaurus_tpu.chunks.columnar import pad_capacity
-                fast_group = (tuple(sizes), tuple(strides), dims,
+                fast_group = (tuple(sizes_offsets), tuple(strides), dims,
                               pad_capacity(dims + 1))
+
+    # Single-key ORDER BY ... LIMIT k fast path decision (static): full
+    # sorts collapse on TPU beyond a few million rows, so select ~2k
+    # candidates with lax.top_k and only sort those.
+    k_limit = (offset + limit) if limit is not None else None
+    group_stage_cap = fast_group[3] if fast_group else capacity
+    use_topk = (len(order_b) == 1 and k_limit is not None
+                and 0 < k_limit <= 1024 and group_stage_cap > 4 * k_limit)
+    topk_cand_cap = 3 * k_limit if use_topk else None
 
     def run(columns: dict, row_valid: jax.Array, bindings: tuple):
         ctx = EmitContext(columns=columns, bindings=bindings, capacity=capacity)
@@ -184,7 +239,7 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             mask = mask & v & d.astype(bool)
 
         if group is not None and fast_group is not None:
-            sizes, strides, dims, seg_cap = fast_group
+            sizes_offsets, strides, dims, seg_cap = fast_group
             nseg = dims + 1                    # +1 garbage slot for masked rows
 
             def _pad(plane):
@@ -192,8 +247,17 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
 
             key_planes = [b.emit(ctx) for _, b in group_key_b]
             seg = jnp.zeros(capacity, dtype=jnp.int32)
-            for (data, valid), size, stride in zip(key_planes, sizes, strides):
-                code = jnp.where(valid, data.astype(jnp.int32), size)
+            for (data, valid), (size, key_offset), stride in zip(
+                    key_planes, sizes_offsets, strides):
+                if jnp.issubdtype(data.dtype, jnp.integer):
+                    # Modular uint64 subtraction: correct for int64 offsets
+                    # near the type bounds and uint64 keys >= 2^63.
+                    off = np.uint64(key_offset % (1 << 64))
+                    shifted = (data.astype(jnp.uint64) - off).astype(jnp.int32)
+                else:
+                    shifted = (data.astype(jnp.int64)
+                               - key_offset).astype(jnp.int32)
+                code = jnp.where(valid, shifted, size)
                 seg = seg + code * stride
             seg = jnp.where(mask, seg, dims)   # masked-out rows → garbage slot
             present_counts, _ = segment_aggregate(
@@ -201,12 +265,16 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             present = _pad((jnp.arange(nseg) < dims) & (present_counts > 0))
             new_columns: dict[str, tuple[jax.Array, jax.Array]] = {}
             slot = jnp.arange(seg_cap)
-            for (name, bound), size, stride in zip(group_key_b, sizes, strides):
+            for (name, bound), (size, key_offset), stride in zip(
+                    group_key_b, sizes_offsets, strides):
                 code = (slot // stride) % (size + 1)
                 key_valid = code < size
                 data = jnp.clip(code, 0, max(size - 1, 0))
                 if bound.type is EValueType.boolean:
                     data = data.astype(jnp.bool_)
+                elif bound.type in (EValueType.int64, EValueType.uint64):
+                    dt = device_dtype(bound.type)
+                    data = data.astype(dt) + jnp.array(key_offset, dtype=dt)
                 else:
                     data = data.astype(jnp.int32)
                 new_columns[name] = (data, key_valid)
@@ -291,6 +359,50 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                 mask = mask & v & d.astype(bool)
 
         if order_b:
+            # Candidates = top-k by value (masked excluded) ∪ up-to-k null
+            # rows (null ordering differs by direction; the tiny exact sort
+            # below settles it).
+            if use_topk:
+                bound, descending = order_b[0]
+                data, valid = bound.emit(ctx)
+                value, null_key = sort_key_planes(data, valid, descending)
+                # Invert the value so top_k picks the query's front.  Valid
+                # rows compete by value; null rows are all equal (their
+                # position relative to values is settled by the tiny exact
+                # sort below), so an indicator pass covers them; a third
+                # indicator pass covers valid rows whose inverted value
+                # aliases the exclusion sentinel (single value class).
+                if jnp.issubdtype(value.dtype, jnp.unsignedinteger):
+                    inv = ~value
+                elif jnp.issubdtype(value.dtype, jnp.integer) or \
+                        value.dtype == jnp.bool_:
+                    inv = ~value.astype(jnp.int64)
+                else:
+                    inv = -value.astype(jnp.float64)
+                if jnp.issubdtype(inv.dtype, jnp.integer):
+                    bottom = jnp.array(jnp.iinfo(inv.dtype).min, inv.dtype)
+                else:
+                    bottom = jnp.array(-jnp.inf, inv.dtype)
+                include = mask & valid
+                ranked = jnp.where(include, inv, bottom)
+                _, idx1 = jax.lax.top_k(ranked, k_limit)
+                nulls = (mask & ~valid).astype(jnp.int32)
+                _, idx2 = jax.lax.top_k(nulls, k_limit)
+                aliased = (include & (inv == bottom)).astype(jnp.int32)
+                _, idx3 = jax.lax.top_k(aliased, k_limit)
+                cand = jnp.concatenate([idx1, idx2, idx3])
+                # Dedupe candidates (overlap would duplicate rows).
+                cand_sorted = jnp.sort(cand)
+                dup = jnp.concatenate([
+                    jnp.zeros(1, dtype=bool),
+                    cand_sorted[1:] == cand_sorted[:-1]])
+                cand_cap = cand.shape[0]
+                ctx = EmitContext(
+                    columns={name: (d[cand_sorted], v[cand_sorted])
+                             for name, (d, v) in ctx.columns.items()},
+                    bindings=bindings, capacity=cand_cap)
+                mask = mask[cand_sorted] & ~dup
+                stage_cap = cand_cap
             # lexsort: last plane is most significant → first ORDER BY item
             # must be emitted last.
             sort_keys = []
@@ -326,8 +438,9 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
 
     return PreparedQuery(
         run=run, bindings=bind_ctx.bindings, output=output, capacity=capacity,
-        out_capacity=fast_group[3] if fast_group else capacity,
-        structure_key=("fastgrp",) + fast_group[0] if fast_group else ())
+        out_capacity=topk_cand_cap if use_topk else group_stage_cap,
+        structure_key=((("fastgrp",) + fast_group[0] if fast_group else ())
+                       + (("topk", k_limit) if use_topk else ())))
 
 
 def _post_ref(name: str, bound: BoundExpr) -> BoundExpr:
