@@ -28,6 +28,7 @@ class Evaluator:
 
     def __init__(self):
         self._cache: dict = {}
+        self._join_cache: dict = {}
 
     def cache_size(self) -> int:
         return len(self._cache)
@@ -52,7 +53,7 @@ class Evaluator:
                 namespace = _extend_namespace(namespace, join)
                 current = execute_join(
                     current, TableSchema.make(namespace), join,
-                    foreign_chunks[join.foreign_table])
+                    foreign_chunks[join.foreign_table], self._join_cache)
             chunk = current
         elif isinstance(plan, ir.Query):
             chunk = _project_chunk(chunk, plan.schema)

@@ -6,6 +6,10 @@ side is lex-sorted by join key once, each self row finds its match range via
 a vectorized lexicographic binary search, and the (self, foreign) index pairs
 are materialized with a static output capacity computed host-side between the
 two jitted phases (shape buckets keep recompiles bounded).
+
+Both phases are jit-compiled and cached by (join fingerprint, capacities,
+binding shapes); only the total match count crosses to the host between
+them.
 """
 
 from __future__ import annotations
@@ -18,7 +22,7 @@ import numpy as np
 
 from ytsaurus_tpu.chunks.columnar import Column, ColumnarChunk, pad_capacity
 from ytsaurus_tpu.errors import EErrorCode, YtError
-from ytsaurus_tpu.ops.segments import lexsort_indices, sort_key_planes
+from ytsaurus_tpu.ops.segments import lexsort_indices
 from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.engine.expr import (
     BindContext,
@@ -26,40 +30,34 @@ from ytsaurus_tpu.query.engine.expr import (
     EmitContext,
     ExprBinder,
     _merge_vocabs,
+    _pad_np,
     _remap_table,
+    _vocab_bucket,
 )
-from ytsaurus_tpu.schema import EValueType, TableSchema
+from ytsaurus_tpu.schema import TableSchema
 
 
-def _eval_keys(chunk: ColumnarChunk, schema: TableSchema,
-               equations: tuple[ir.TExpr, ...]):
-    """Evaluate join-key expressions over a chunk (eager device ops)."""
+def _bind_keys(chunk: ColumnarChunk, schema: TableSchema,
+               equations: tuple[ir.TExpr, ...], shared_bindings: list):
+    """Host phase: bind join-key expressions against a chunk's vocabularies.
+    All slots index into ONE shared bindings list so both sides' emit
+    closures can run under the same traced tuple."""
     bind_ctx = BindContext(columns={
         c.name: ColumnBinding(type=c.type, vocab=chunk.columns[c.name].dictionary)
-        for c in schema})
+        for c in schema}, bindings=shared_bindings)
     binder = ExprBinder(bind_ctx)
-    bound = [binder.bind(e) for e in equations]
-    ctx = EmitContext(
-        columns={name: (col.data, col.valid)
-                 for name, col in chunk.columns.items()},
-        bindings=tuple(bind_ctx.bindings), capacity=chunk.capacity)
-    planes = [b.emit(ctx) for b in bound]
-    vocabs = [b.vocab for b in bound]
-    return planes, vocabs
+    return [binder.bind(e) for e in equations]
 
 
-def _encode_keys(planes, vocabs, other_vocabs):
-    """Normalize key planes for cross-table comparison: unify string vocabs,
-    encode as (null_rank, value) pairs."""
+def _emit_encoded_keys(bound, remap_slots, ctx: EmitContext):
+    """Trace phase: emit key planes encoded as (null_rank, value) pairs with
+    string codes remapped onto the shared vocabulary."""
     out = []
-    for (data, valid), vocab, other in zip(planes, vocabs, other_vocabs):
-        if vocab is not None or other is not None:
-            merged = _merge_vocabs(vocab, other)
-            table = _remap_table(
-                vocab if vocab is not None else np.array([], dtype=object),
-                merged)
-            remap = jnp.asarray(table)
-            data = remap[jnp.clip(data, 0, len(table) - 1)]
+    for b, slot in zip(bound, remap_slots):
+        data, valid = b.emit(ctx)
+        if slot is not None:
+            table = ctx.bindings[slot]
+            data = table[jnp.clip(data, 0, table.shape[0] - 1)]
         if data.dtype == jnp.bool_:
             data = data.astype(jnp.int8)
         data = jnp.where(valid, data, jnp.zeros_like(data))
@@ -71,7 +69,6 @@ def _lex_less(a_planes, b_planes, a_idx, b_idx, or_equal: bool):
     """Lexicographic a[a_idx] < b[b_idx] (or <= when or_equal) over encoded
     (null_rank, value) key plane pairs; null sorts before any value."""
     result = jnp.full(a_idx.shape, or_equal, dtype=bool)
-    # Walk keys from least to most significant:
     for (av, ad), (bv, bd) in reversed(list(zip(a_planes, b_planes))):
         a_v, a_d = av[a_idx], ad[a_idx]
         b_v, b_d = bv[b_idx], bd[b_idx]
@@ -81,21 +78,24 @@ def _lex_less(a_planes, b_planes, a_idx, b_idx, or_equal: bool):
     return result
 
 
-def _lex_searchsorted(sorted_planes, n_sorted: int, query_planes, side: str):
+def _lex_searchsorted(sorted_planes, n_sorted, max_n: int, query_planes,
+                      side: str):
     """For each query row, binary-search the sorted key planes.
-    side='left' → first index whose key >= query; 'right' → first > query."""
+    side='left' → first index whose key >= query; 'right' → first > query.
+    `n_sorted` is a traced scalar (live row count); `max_n` is the static
+    capacity bound driving the iteration count so the compiled program is
+    row-count independent."""
     cap_q = query_planes[0][0].shape[0]
     lo = jnp.zeros(cap_q, dtype=jnp.int64)
     hi = jnp.full(cap_q, n_sorted, dtype=jnp.int64)
-    iters = max(1, int(np.ceil(np.log2(max(n_sorted, 2)))) + 1)
+    iters = max(1, int(np.ceil(np.log2(max(max_n, 2)))) + 1)
     q_idx = jnp.arange(cap_q)
 
     def body(_, carry):
         lo, hi = carry
         active = lo < hi
         mid = (lo + hi) // 2
-        mid_c = jnp.clip(mid, 0, max(n_sorted - 1, 0))
-        # Move right when sorted[mid] < query (left) / <= query (right).
+        mid_c = jnp.clip(mid, 0, max(max_n - 1, 0))
         go_right = _lex_less(sorted_planes, query_planes, mid_c, q_idx,
                              or_equal=(side == "right"))
         lo = jnp.where(active & go_right, mid + 1, lo)
@@ -106,74 +106,115 @@ def _lex_searchsorted(sorted_planes, n_sorted: int, query_planes, side: str):
     return lo
 
 
+def _join_fingerprint(join: ir.JoinClause) -> str:
+    # ir.fingerprint serializes the full JoinClause (equations, alias,
+    # is_left, pulled columns).
+    return ir.fingerprint(ir.Query(
+        schema=join.foreign_schema, source=join.foreign_table,
+        joins=(join,)))
+
+
 def execute_join(chunk: ColumnarChunk, combined_schema: TableSchema,
-                 join: ir.JoinClause, foreign_chunk: ColumnarChunk
-                 ) -> ColumnarChunk:
+                 join: ir.JoinClause, foreign_chunk: ColumnarChunk,
+                 cache: dict) -> ColumnarChunk:
     """Materialize `chunk ⋈ foreign_chunk` into a wider columnar chunk.
 
-    `combined_schema` is the namespace *after* this join (flat names).
+    `combined_schema` is the namespace *after* this join (flat names);
+    `cache` holds the compiled phase programs (owned by the Evaluator so
+    lifetime/clearing follow the plan cache).
     """
-    self_planes, self_vocabs = _eval_keys(chunk, _chunk_namespace(chunk),
-                                          join.self_equations)
-    foreign_planes, foreign_vocabs = _eval_keys(
-        foreign_chunk, join.foreign_schema, join.foreign_equations)
+    self_schema = chunk.schema
+    all_bindings: list = []
+    self_bound = _bind_keys(chunk, self_schema, join.self_equations,
+                            all_bindings)
+    f_bound = _bind_keys(foreign_chunk, join.foreign_schema,
+                         join.foreign_equations, all_bindings)
+    # String keys: remap both sides onto merged vocabularies (host).
+    self_slots: list = []
+    foreign_slots: list = []
 
-    self_keys = _encode_keys(self_planes, self_vocabs, foreign_vocabs)
-    foreign_keys = _encode_keys(foreign_planes, foreign_vocabs, self_vocabs)
+    def add_binding(value):
+        all_bindings.append(value)
+        return len(all_bindings) - 1
 
-    # Sort foreign side; masked rows sink to the end.  jnp.lexsort treats the
-    # LAST plane as most significant, so emit keys in reverse: first join key
-    # must be most significant to agree with _lex_less.
-    f_mask = foreign_chunk.row_valid
-    sort_keys = []
-    for v, d in reversed(foreign_keys):
-        sort_keys.extend([d, v])
-    sort_keys.append((~f_mask).astype(jnp.int8))
-    f_order = lexsort_indices(sort_keys)
-    f_sorted = [(v[f_order], d[f_order]) for v, d in foreign_keys]
+    for sb, fb in zip(self_bound, f_bound):
+        if sb.vocab is not None or fb.vocab is not None:
+            merged = _merge_vocabs(sb.vocab, fb.vocab)
+            s_vocab = sb.vocab if sb.vocab is not None else \
+                np.array([], dtype=object)
+            f_vocab = fb.vocab if fb.vocab is not None else \
+                np.array([], dtype=object)
+            s_table = _remap_table(s_vocab, merged)
+            f_table = _remap_table(f_vocab, merged)
+            self_slots.append(add_binding(jnp.asarray(
+                _pad_np(s_table, _vocab_bucket(len(s_table)), 0))))
+            foreign_slots.append(add_binding(jnp.asarray(
+                _pad_np(f_table, _vocab_bucket(len(f_table)), 0))))
+        else:
+            self_slots.append(None)
+            foreign_slots.append(None)
+
     n_foreign = foreign_chunk.row_count
+    # Exact vocab lengths of every key expr: bound-vocab-derived Python
+    # constants (e.g. concat's pair-table width) bake into the traced
+    # program, and bucket-padded binding shapes alone cannot distinguish
+    # them.
+    vocab_structure = tuple(
+        (len(b.vocab) if b.vocab is not None else -1)
+        for b in list(self_bound) + list(f_bound))
+    cache_key = (_join_fingerprint(join), chunk.capacity,
+                 foreign_chunk.capacity,
+                 tuple(c.name for c in self_schema),
+                 vocab_structure,
+                 tuple((tuple(b.shape), str(b.dtype)) for b in all_bindings))
+    entry = cache.get(cache_key)
+    if entry is None:
+        entry = _build_join_programs(
+            self_bound, f_bound, self_slots, foreign_slots,
+            chunk.capacity, foreign_chunk.capacity, join.is_left,
+            [c.name for c in self_schema], list(join.foreign_columns))
+        cache[cache_key] = entry
+    phase1, make_phase2 = entry
 
-    lo = _lex_searchsorted(f_sorted, n_foreign, self_keys, "left")
-    hi = _lex_searchsorted(f_sorted, n_foreign, self_keys, "right")
-    s_mask = chunk.row_valid
-    # SQL semantics: a null join key matches nothing (NULL = NULL is unknown).
-    s_null = jnp.zeros(chunk.capacity, dtype=bool)
-    for v, _ in self_keys:
-        s_null = s_null | (v == 0)
-    counts = jnp.where(s_mask & ~s_null, hi - lo, 0)
-    if join.is_left:
-        out_per_row = jnp.where(s_mask, jnp.maximum(counts, 1), 0)
-    else:
-        out_per_row = counts
-    offsets = jnp.cumsum(out_per_row)
-    total = int(offsets[-1])
+    self_columns = {c.name: (chunk.columns[c.name].data,
+                             chunk.columns[c.name].valid)
+                    for c in self_schema}
+    foreign_columns = {name: (foreign_chunk.columns[name].data,
+                              foreign_chunk.columns[name].valid)
+                       for name in set(list(join.foreign_columns) +
+                                       list(join.foreign_schema.column_names))}
+    args = (self_columns, foreign_columns, chunk.row_valid,
+            foreign_chunk.row_valid, tuple(all_bindings),
+            jnp.asarray(n_foreign, dtype=jnp.int64))
+    lo, counts, f_order, total = phase1(*args)
+    total = int(total)
     out_cap = pad_capacity(max(total, 1))
-
-    out_idx = jnp.arange(out_cap)
-    # Row r of self owns output slots [offsets[r-1], offsets[r]).
-    starts = jnp.concatenate([jnp.zeros(1, dtype=offsets.dtype), offsets[:-1]])
-    self_row = jnp.searchsorted(offsets, out_idx, side="right")
-    self_row_c = jnp.clip(self_row, 0, chunk.capacity - 1)
-    within = out_idx - starts[self_row_c]
-    matched = counts[self_row_c] > 0
-    foreign_pos = jnp.clip(lo[self_row_c] + within, 0, foreign_chunk.capacity - 1)
-    foreign_row = f_order[foreign_pos]
-    out_valid_row = out_idx < total
+    phase2 = make_phase2(out_cap)
+    out_planes, self_row, foreign_row = phase2(*args, lo, counts, f_order)
 
     columns: dict[str, Column] = {}
+    self_row_np = None
     for name, col in chunk.columns.items():
-        data = col.data[self_row_c]
-        valid = col.valid[self_row_c] & out_valid_row
+        data, valid = out_planes["self"][name]
+        host_values = None
+        if col.host_values is not None:
+            if self_row_np is None:
+                self_row_np = np.asarray(self_row)
+            host_values = _gather_host(col, self_row_np, out_cap)
         columns[name] = replace(col, data=data, valid=valid,
-                                host_values=_gather_host(col, np.asarray(self_row_c), out_cap))
-    skip = {c.name for c in _chunk_namespace(chunk)}
+                                host_values=host_values)
+    foreign_row_np = None
     for fname in join.foreign_columns:
         fcol = foreign_chunk.columns[fname]
         flat = f"{join.alias}.{fname}" if join.alias else fname
-        data = fcol.data[foreign_row]
-        valid = fcol.valid[foreign_row] & out_valid_row & matched
+        data, valid = out_planes["foreign"][fname]
+        host_values = None
+        if fcol.host_values is not None:
+            if foreign_row_np is None:
+                foreign_row_np = np.asarray(foreign_row)
+            host_values = _gather_host(fcol, foreign_row_np, out_cap)
         columns[flat] = replace(fcol, data=data, valid=valid,
-                                host_values=_gather_host(fcol, np.asarray(foreign_row), out_cap))
+                                host_values=host_values)
     out_columns = {}
     for col_schema in combined_schema:
         if col_schema.name not in columns:
@@ -184,13 +225,87 @@ def execute_join(chunk: ColumnarChunk, combined_schema: TableSchema,
                          columns=out_columns)
 
 
+def _build_join_programs(self_bound, f_bound, self_slots, foreign_slots,
+                         self_cap, foreign_cap,
+                         is_left, self_names, foreign_names):
+    def phase1(self_columns, foreign_columns, s_valid, f_valid, bindings,
+               n_foreign):
+        s_ctx = EmitContext(columns=self_columns, bindings=bindings,
+                            capacity=self_cap)
+        f_ctx = EmitContext(columns=foreign_columns, bindings=bindings,
+                            capacity=foreign_cap)
+        self_keys = _emit_encoded_keys(self_bound, self_slots, s_ctx)
+        foreign_keys = _emit_encoded_keys(f_bound, foreign_slots, f_ctx)
+        # Sort foreign side (first key most significant; masked rows last).
+        sort_keys = []
+        for v, d in reversed(foreign_keys):
+            sort_keys.extend([d, v])
+        sort_keys.append((~f_valid).astype(jnp.int8))
+        f_order = lexsort_indices(sort_keys)
+        f_sorted = [(v[f_order], d[f_order]) for v, d in foreign_keys]
+        lo = _lex_searchsorted(f_sorted, n_foreign, foreign_cap, self_keys,
+                               "left")
+        hi = _lex_searchsorted(f_sorted, n_foreign, foreign_cap, self_keys,
+                               "right")
+        # Null join keys match nothing (SQL semantics).
+        s_null = jnp.zeros(self_cap, dtype=bool)
+        for v, _ in self_keys:
+            s_null = s_null | (v == 0)
+        counts = jnp.where(s_valid & ~s_null, hi - lo, 0)
+        if is_left:
+            per_row = jnp.where(s_valid, jnp.maximum(counts, 1), 0)
+        else:
+            per_row = counts
+        total = jnp.sum(per_row)
+        return lo, counts, f_order, total
+
+    phase2_cache: dict[int, callable] = {}
+
+    def make_phase2(out_cap: int):
+        fn = phase2_cache.get(out_cap)
+        if fn is not None:
+            return fn
+
+        def phase2(self_columns, foreign_columns, s_valid, f_valid, bindings,
+                   n_foreign, lo, counts, f_order):
+            if is_left:
+                per_row = jnp.where(s_valid, jnp.maximum(counts, 1), 0)
+            else:
+                per_row = counts
+            offsets = jnp.cumsum(per_row)
+            total = offsets[-1]
+            starts = jnp.concatenate(
+                [jnp.zeros(1, dtype=offsets.dtype), offsets[:-1]])
+            out_idx = jnp.arange(out_cap)
+            self_row = jnp.searchsorted(offsets, out_idx, side="right")
+            self_row = jnp.clip(self_row, 0, self_cap - 1)
+            within = out_idx - starts[self_row]
+            matched = counts[self_row] > 0
+            foreign_pos = jnp.clip(lo[self_row] + within, 0, foreign_cap - 1)
+            foreign_row = f_order[foreign_pos]
+            out_valid_row = out_idx < total
+            out = {"self": {}, "foreign": {}}
+            for name in self_names:
+                data, valid = self_columns[name]
+                out["self"][name] = (data[self_row],
+                                     valid[self_row] & out_valid_row)
+            for name in foreign_names:
+                data, valid = foreign_columns[name]
+                out["foreign"][name] = (
+                    data[foreign_row],
+                    valid[foreign_row] & out_valid_row & matched)
+            return out, self_row, foreign_row
+
+        fn = jax.jit(phase2)
+        phase2_cache[out_cap] = fn
+        return fn
+
+    return jax.jit(phase1), make_phase2
+
+
 def _gather_host(col: Column, idx: np.ndarray, out_cap: int):
     if col.host_values is None:
         return None
     vals = [col.host_values[int(i)] if int(i) < len(col.host_values) else None
             for i in idx[:out_cap]]
     return vals
-
-
-def _chunk_namespace(chunk: ColumnarChunk) -> TableSchema:
-    return chunk.schema
