@@ -49,7 +49,9 @@ class YtClient:
     def __init__(self, cluster: YtCluster):
         self.cluster = cluster
         from ytsaurus_tpu.operations.scheduler import OperationScheduler
+        from ytsaurus_tpu.query.statistics import QueryStatistics
         self.scheduler = OperationScheduler(self)
+        self.last_query_statistics = QueryStatistics()
 
     # ------------------------------------------------------------------ cypress
 
@@ -423,12 +425,21 @@ class YtClient:
 
     def select_rows(self, query: str,
                     timestamp: int = MAX_TIMESTAMP) -> list[dict]:
-        """Distributed QL over static and mounted dynamic tables."""
+        """Distributed QL over static and mounted dynamic tables.
+
+        Per-query statistics land in `self.last_query_statistics` (ref
+        TQueryStatistics) and in the structured Query log."""
+        import logging as _logging
+
+        from ytsaurus_tpu.query.statistics import QueryStatistics
+        from ytsaurus_tpu.utils.logging import get_logger, log_event
+        stats = QueryStatistics()
+        self.last_query_statistics = stats   # visible even if the query fails
         plan = build_query(query, _SchemaResolver(self))
         from ytsaurus_tpu.query.pruning import extract_column_intervals
         intervals = extract_column_intervals(plan.where)
         source_chunks = self._query_shards(plan.source, timestamp,
-                                           intervals=intervals)
+                                           intervals=intervals, stats=stats)
         foreign = {}
         for join in plan.joins:
             shards = self._query_shards(join.foreign_table, timestamp)
@@ -436,7 +447,10 @@ class YtClient:
                 concat_chunks(shards) if len(shards) > 1 else shards[0])
         out = coordinate_and_execute(plan, source_chunks, foreign,
                                      evaluator=self.cluster.evaluator,
-                                     merge_shards_below=4_000_000)
+                                     merge_shards_below=4_000_000,
+                                     stats=stats)
+        log_event(get_logger("Query"), _logging.INFO, "select_rows",
+                  query=query[:200], **stats.to_dict())
         return out.to_rows()
 
     # ---------------------------------------------------------------- operations
@@ -535,7 +549,7 @@ class YtClient:
                 "remove", path=path + "/@sorted_by", force=True)
 
     def _query_shards(self, path: str, timestamp: int,
-                      intervals=None) -> list[ColumnarChunk]:
+                      intervals=None, stats=None) -> list[ColumnarChunk]:
         node = self._table_node(path)
         if node.attributes.get("dynamic"):
             from ytsaurus_tpu.tablet.ordered import OrderedTablet
@@ -544,15 +558,18 @@ class YtClient:
                 return [t.snapshot() for t in tablets]
             return [t.read_snapshot(timestamp) for t in tablets]
         chunk_ids = node.attributes.get("chunk_ids", [])
-        stats = node.attributes.get("chunk_stats", [])
+        col_stats = node.attributes.get("chunk_stats", [])
         # Range-inference analog: skip chunks whose min/max stats cannot
         # intersect the WHERE-derived intervals.  Stats pair with chunks
         # positionally, so prune ONLY when the lists are in lockstep (tables
         # persisted before stats existed must never be misaligned).
-        if intervals and len(stats) == len(chunk_ids):
+        if intervals and len(col_stats) == len(chunk_ids):
             from ytsaurus_tpu.query.pruning import chunk_may_match
-            chunk_ids = [cid for cid, chunk_stats in zip(chunk_ids, stats)
-                         if chunk_may_match(chunk_stats, intervals)]
+            kept = [cid for cid, chunk_stats in zip(chunk_ids, col_stats)
+                    if chunk_may_match(chunk_stats, intervals)]
+            if stats is not None:
+                stats.shards_pruned += len(chunk_ids) - len(kept)
+            chunk_ids = kept
         chunks = [self.cluster.chunk_cache.get(cid) for cid in chunk_ids]
         if not chunks:
             schema = self._node_schema(node)

@@ -171,7 +171,8 @@ def coordinate_and_execute(
         chunks: Sequence[ColumnarChunk],
         foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None,
         evaluator: Optional[Evaluator] = None,
-        merge_shards_below: int = 0) -> ColumnarChunk:
+        merge_shards_below: int = 0,
+        stats=None) -> ColumnarChunk:
     """Host-coordinated fan-out: run the bottom query per shard (tablet),
     concatenate partial results, run the front merge.
 
@@ -189,13 +190,23 @@ def coordinate_and_execute(
                       code=EErrorCode.QueryExecutionError)
     if merge_shards_below > 0 and len(chunks) > 1:
         chunks = _coalesce_shards(chunks, merge_shards_below)
+    if stats is not None:
+        stats.shards_total += len(chunks)
+        stats.rows_read += sum(c.row_count for c in chunks)
     if len(chunks) == 1:
-        return evaluator.run_plan(plan, chunks[0], foreign_chunks)
-    bottom, front = split_plan(plan)
-    partials = [evaluator.run_plan(bottom, chunk, foreign_chunks)
-                for chunk in chunks]
-    merged = concat_chunks([p.slice_rows(0, p.row_count) for p in partials])
-    return evaluator.run_plan(front, merged)
+        result = evaluator.run_plan(plan, chunks[0], foreign_chunks,
+                                    stats=stats)
+    else:
+        bottom, front = split_plan(plan)
+        partials = [evaluator.run_plan(bottom, chunk, foreign_chunks,
+                                       stats=stats)
+                    for chunk in chunks]
+        merged = concat_chunks(
+            [p.slice_rows(0, p.row_count) for p in partials])
+        result = evaluator.run_plan(front, merged, stats=stats)
+    if stats is not None:
+        stats.rows_written += result.row_count
+    return result
 
 
 def _coalesce_shards(chunks: Sequence[ColumnarChunk],

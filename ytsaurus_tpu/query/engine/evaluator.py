@@ -20,6 +20,7 @@ from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.builder import build_query
 from ytsaurus_tpu.query.engine.joins import execute_join
 from ytsaurus_tpu.query.engine.lowering import prepare
+from ytsaurus_tpu.query.statistics import QueryStatistics
 from ytsaurus_tpu.schema import EValueType, TableSchema
 
 
@@ -37,9 +38,12 @@ class Evaluator:
 
     def run_plan(self, plan: "ir.Query | ir.FrontQuery",
                  chunk: ColumnarChunk,
-                 foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None
+                 foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None,
+                 stats: Optional[QueryStatistics] = None
                  ) -> ColumnarChunk:
         """Execute a plan over one input chunk (plus join tables)."""
+        import time as _time
+        t0 = _time.perf_counter()
         if isinstance(plan, ir.Query) and plan.joins:
             foreign_chunks = foreign_chunks or {}
             # Materialize joins left-to-right, widening the namespace.
@@ -54,11 +58,13 @@ class Evaluator:
                 current = execute_join(
                     current, TableSchema.make(namespace), join,
                     foreign_chunks[join.foreign_table], self._join_cache)
+                if stats is not None:
+                    stats.joins_executed += 1
             chunk = current
         elif isinstance(plan, ir.Query):
             chunk = _project_chunk(chunk, plan.schema)
 
-        result = self._execute(plan, chunk)
+        result = self._execute(plan, chunk, stats)
 
         # GROUP BY ... WITH TOTALS: one extra grand-total row (null keys)
         # aggregated over the same filtered input, appended after the groups
@@ -66,17 +72,24 @@ class Evaluator:
         # cg_routines/registry.cpp:1920; totals_mode=before_having).
         if plan.group is not None and plan.group.totals:
             totals_plan = _make_totals_plan(plan)
-            totals = self._execute(totals_plan, chunk)
+            totals = self._execute(totals_plan, chunk, stats)
             result = concat_chunks([result, totals])
+        if stats is not None:
+            stats.execute_time += _time.perf_counter() - t0
         return result
 
-    def _execute(self, plan, chunk: ColumnarChunk) -> ColumnarChunk:
+    def _execute(self, plan, chunk: ColumnarChunk,
+                 stats: Optional[QueryStatistics] = None) -> ColumnarChunk:
         prepared = prepare(plan, chunk)
         key = (ir.fingerprint(plan), chunk.capacity, prepared.binding_shapes())
         jitted = self._cache.get(key)
         if jitted is None:
             jitted = jax.jit(prepared.run)
             self._cache[key] = jitted
+            if stats is not None:
+                stats.compile_count += 1
+        elif stats is not None:
+            stats.cache_hits += 1
         columns = {c.name: (chunk.columns[c.name].data,
                             chunk.columns[c.name].valid)
                    for c in plan.schema}

@@ -1,0 +1,23 @@
+"""Query statistics (ref: client/query_client/query_statistics.h
+TQueryStatistics — rows read/written, execute time, codegen time, incomplete
+flags; aggregated across subqueries by the coordinator)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class QueryStatistics:
+    rows_read: int = 0
+    rows_written: int = 0
+    execute_time: float = 0.0        # seconds, wall, incl. device sync
+    compile_count: int = 0           # programs compiled (cache misses)
+    cache_hits: int = 0
+    shards_total: int = 0
+    shards_pruned: int = 0
+    joins_executed: int = 0
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+        return asdict(self)

@@ -1,0 +1,75 @@
+"""Structured logging (ref: core/logging — async structured logs with
+per-category levels; here: stdlib logging with a structured formatter and
+per-category level control via YTSAURUS_TPU_LOG_LEVEL / _LOG_CATEGORIES)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any
+
+_CONFIGURED = False
+
+
+class _DynamicStderrHandler(logging.StreamHandler):
+    """Resolves sys.stderr at emit time so redirection/capture works."""
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        import sys
+        return sys.stderr
+
+
+class StructuredFormatter(logging.Formatter):
+    """One JSON object per line: timestamp, level, category, message, fields."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry: dict[str, Any] = {
+            "ts": round(record.created, 6),
+            "level": record.levelname.lower(),
+            "category": record.name,
+            "message": record.getMessage(),
+        }
+        fields = getattr(record, "fields", None)
+        if fields:
+            entry.update(fields)
+        return json.dumps(entry, default=str)
+
+
+def _configure() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    _CONFIGURED = True
+    root = logging.getLogger("ytsaurus_tpu")
+    level_name = os.environ.get("YTSAURUS_TPU_LOG_LEVEL", "WARNING").upper()
+    root.setLevel(getattr(logging, level_name, logging.WARNING))
+    handler = _DynamicStderrHandler()
+    handler.setFormatter(StructuredFormatter())
+    root.addHandler(handler)
+    root.propagate = False
+    # Per-category overrides: "Query=debug,Tablet=info"
+    overrides = os.environ.get("YTSAURUS_TPU_LOG_CATEGORIES", "")
+    for part in overrides.split(","):
+        if "=" in part:
+            category, _, lvl = part.partition("=")
+            logging.getLogger(f"ytsaurus_tpu.{category.strip()}").setLevel(
+                getattr(logging, lvl.strip().upper(), logging.WARNING))
+
+
+def get_logger(category: str) -> logging.Logger:
+    """Category logger ('Query', 'Tablet', 'Master', …)."""
+    _configure()
+    return logging.getLogger(f"ytsaurus_tpu.{category}")
+
+
+def log_event(logger: logging.Logger, level: int, message: str,
+              **fields) -> None:
+    """Structured event: message + key/value fields."""
+    if logger.isEnabledFor(level):
+        logger.log(level, message, extra={"fields": fields})
