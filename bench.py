@@ -162,7 +162,8 @@ def main():
     parser.add_argument("--iters", type=int, default=5)
     args = parser.parse_args()
 
-    import jax
+    from ytsaurus_tpu.utils.backend import ensure_backend
+    jax = ensure_backend()
 
     fn, default_rows = _CONFIGS[args.config]
     n_rows = args.rows or (100_000 if args.smoke else default_rows)

@@ -476,28 +476,28 @@ def test_duplicate_pivots_rejected(client):
 
 def test_query_statistics_and_logging(client, capsys):
     import logging
-    import pytest as _pytest
     from ytsaurus_tpu.utils.logging import get_logger
     logger = get_logger("Query")
     old_level = logger.level
     logger.setLevel(logging.INFO)
-    for base in (0, 100):
-        client.write_table("//t/stats", [{"k": base + i} for i in range(50)],
-                           append=base > 0)
-    client.select_rows("count(*) AS c FROM [//t/stats] WHERE k >= 100 "
-                       "GROUP BY 1 AS o")
-    stats = client.last_query_statistics
-    assert stats.shards_pruned == 1          # first chunk pruned
-    assert stats.rows_read == 50
-    assert stats.rows_written == 1
-    assert stats.execute_time > 0
-    assert stats.compile_count >= 1
-    # second run: cache hits, no compiles
-    client.select_rows("count(*) AS c FROM [//t/stats] WHERE k >= 100 "
-                       "GROUP BY 1 AS o")
-    assert client.last_query_statistics.compile_count == 0
-    assert client.last_query_statistics.cache_hits >= 1
     try:
+        for base in (0, 100):
+            client.write_table("//t/stats",
+                               [{"k": base + i} for i in range(50)],
+                               append=base > 0)
+        client.select_rows("count(*) AS c FROM [//t/stats] WHERE k >= 100 "
+                           "GROUP BY 1 AS o")
+        stats = client.last_query_statistics
+        assert stats.shards_pruned == 1          # first chunk pruned
+        assert stats.rows_read == 50
+        assert stats.rows_written == 1
+        assert stats.execute_time > 0
+        assert stats.compile_count >= 1
+        # second run: cache hits, no compiles
+        client.select_rows("count(*) AS c FROM [//t/stats] WHERE k >= 100 "
+                           "GROUP BY 1 AS o")
+        assert client.last_query_statistics.compile_count == 0
+        assert client.last_query_statistics.cache_hits >= 1
         err = capsys.readouterr().err
         assert '"category": "ytsaurus_tpu.Query"' in err
         assert '"message": "select_rows"' in err

@@ -1,0 +1,38 @@
+"""Backend selection helper for driver entry points.
+
+A dead TPU tunnel HANGS backend initialization (it does not raise), so the
+health probe runs `jax.devices()` in a subprocess with a timeout before this
+process touches backends; on failure the process falls back to CPU with a
+stderr notice so results are never silently mislabeled.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_PROBED = False
+
+
+def ensure_backend(timeout: float = 120.0):
+    """Returns the jax module with a usable backend selected."""
+    global _PROBED
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") in ("cpu", ""):
+        jax.devices()
+        return jax
+    if not _PROBED:
+        _PROBED = True
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout, check=True, capture_output=True,
+                env=dict(os.environ))
+        except Exception:
+            print("# configured accelerator backend unavailable; "
+                  "falling back to CPU", file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+    return jax
