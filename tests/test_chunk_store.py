@@ -133,3 +133,87 @@ def test_inflated_meta_row_count_rejected():
     forged = MAGIC + encode_varint_u(len(meta_blob)) + meta_blob + payload
     with pytest.raises(YtError):
         deserialize_chunk(forged)
+
+
+# --- replicated store ---------------------------------------------------------
+
+def _replicated(tmp_path, n=3, rf=2):
+    from ytsaurus_tpu.chunks.replicated import ReplicatedChunkStore
+    return ReplicatedChunkStore(
+        [str(tmp_path / f"loc{i}") for i in range(n)], replication_factor=rf)
+
+
+def test_replicated_write_places_rf_copies(tmp_path):
+    store = _replicated(tmp_path)
+    chunk = _chunk(32)
+    cid = store.write_chunk(chunk)
+    copies = sum(1 for loc in store.locations if loc.exists(cid))
+    assert copies == 2
+    assert store.read_chunk(cid).to_rows() == chunk.to_rows()
+
+
+def test_replicated_read_survives_location_loss(tmp_path):
+    import shutil
+    store = _replicated(tmp_path)
+    chunk = _chunk(32)
+    cid = store.write_chunk(chunk)
+    # Destroy the first location holding a replica.
+    holder = next(loc for loc in store._placement(cid) if loc.exists(cid))
+    shutil.rmtree(holder.root)
+    import os
+    os.makedirs(holder.root, exist_ok=True)
+    assert store.read_chunk(cid).to_rows() == chunk.to_rows()
+    # Repair-on-read restored the lost replica.
+    copies = sum(1 for loc in store.locations if loc.exists(cid))
+    assert copies == 2
+
+
+def test_replicated_total_loss_raises(tmp_path):
+    store = _replicated(tmp_path)
+    chunk = _chunk(8)
+    cid = store.write_chunk(chunk)
+    for loc in store.locations:
+        loc.remove_chunk(cid)
+    with pytest.raises(YtError):
+        store.read_chunk(cid)
+    assert not store.exists(cid)
+
+
+def test_replicated_erasure_passthrough(tmp_path):
+    store = _replicated(tmp_path)
+    chunk = _chunk(64)
+    cid = store.write_chunk(chunk, erasure="rs_3_2")
+    assert store.exists(cid)
+    assert store.read_chunk(cid).to_rows() == chunk.to_rows()
+
+
+def test_replicated_remove_and_list(tmp_path):
+    store = _replicated(tmp_path)
+    ids = sorted(store.write_chunk(_chunk(8, seed=i)) for i in range(4))
+    assert store.list_chunks() == ids
+    for cid in ids:
+        store.remove_chunk(cid)
+    assert store.list_chunks() == []
+
+
+def test_replicated_erasure_not_duplicated_on_read(tmp_path):
+    store = _replicated(tmp_path)
+    chunk = _chunk(64)
+    cid = store.write_chunk(chunk, erasure="rs_3_2")
+    store.read_chunk(cid)
+    # No full plain replica may appear on other locations.
+    import os
+    plain = sum(1 for loc in store.locations
+                if os.path.exists(loc._path(cid)))
+    assert plain == 0
+
+
+def test_replicated_placement_process_stable(tmp_path):
+    # sha-based placement must not depend on the hash seed of this process.
+    import hashlib
+    store = _replicated(tmp_path)
+    cid = "deadbeef" * 4
+    want = sorted(range(3), key=lambda i: hashlib.sha256(
+        f"{cid}:{i}".encode()).digest())
+    got = [store.locations.index(s) for s in store._placement(cid)]
+    assert got == want

@@ -1,0 +1,145 @@
+"""Replicated chunk store: N locations, read fallback, write-back repair.
+
+Ref: the data-node/master replication pair (server/master/chunk_server/
+chunk_replicator.h issuing Replicate/Repair jobs; replication_reader.cpp
+falling back across replicas).  Collapsed to one process: a chunk writes to
+`replication_factor` locations; reads try locations in order and, after a
+successful read, re-replicate to locations that lost their copy (the
+repair-on-read analog of the replicator's background jobs).  Erasure-coded
+writes pass through to a single location (parity already provides
+redundancy).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.chunks.encoding import DEFAULT_CODEC
+from ytsaurus_tpu.chunks.store import FsChunkStore, new_chunk_id
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils.logging import get_logger, log_event
+
+import logging as _logging
+
+
+class ReplicatedChunkStore:
+    """Drop-in FsChunkStore replacement spanning several directories."""
+
+    def __init__(self, roots: list[str], replication_factor: int = 2,
+                 codec: str = DEFAULT_CODEC):
+        if not roots:
+            raise YtError("ReplicatedChunkStore needs at least one location")
+        self.locations = [FsChunkStore(root, codec=codec) for root in roots]
+        self.replication_factor = min(replication_factor, len(self.locations))
+        self.codec = codec
+        self._log = get_logger("ChunkReplicator")
+
+    # -- placement -------------------------------------------------------------
+
+    def _placement(self, chunk_id: str) -> list[FsChunkStore]:
+        """Deterministic location order per chunk (rendezvous hashing with a
+        process-independent hash — python's hash() is salted per process and
+        would make replicas drift across restarts)."""
+        def rank(i: int) -> bytes:
+            return hashlib.sha256(f"{chunk_id}:{i}".encode()).digest()
+        ranked = sorted(range(len(self.locations)), key=rank)
+        return [self.locations[i] for i in ranked]
+
+    # -- FsChunkStore surface --------------------------------------------------
+
+    def write_chunk(self, chunk: ColumnarChunk,
+                    chunk_id: Optional[str] = None,
+                    codec: Optional[str] = None,
+                    erasure: Optional[str] = None) -> str:
+        chunk_id = chunk_id or new_chunk_id()
+        placement = self._placement(chunk_id)
+        if erasure is not None:
+            placement[0].write_chunk(chunk, chunk_id=chunk_id, codec=codec,
+                                     erasure=erasure)
+            return chunk_id
+        written = 0
+        errors = []
+        for store in placement:
+            if written >= self.replication_factor:
+                break
+            try:
+                store.write_chunk(chunk, chunk_id=chunk_id, codec=codec)
+                written += 1
+            except OSError as e:          # location down/full
+                errors.append(e)
+                log_event(self._log, _logging.WARNING, "replica_write_failed",
+                          chunk_id=chunk_id, location=store.root,
+                          error=str(e))
+        if written == 0:
+            raise YtError(f"All locations failed writing chunk {chunk_id}",
+                          code=EErrorCode.ChunkFormatError,
+                          attributes={"errors": [str(e) for e in errors]})
+        if written < self.replication_factor:
+            log_event(self._log, _logging.WARNING, "chunk_under_replicated",
+                      chunk_id=chunk_id, replicas=written,
+                      target=self.replication_factor)
+        return chunk_id
+
+    def read_chunk(self, chunk_id: str) -> ColumnarChunk:
+        placement = self._placement(chunk_id)
+        last_error: Optional[YtError] = None
+        for idx, store in enumerate(placement):
+            try:
+                chunk = store.read_chunk(chunk_id)
+            except YtError as e:
+                last_error = e
+                continue
+            if not self._is_erasure(chunk_id) and \
+                    (idx > 0 or self._missing_replicas(chunk_id)):
+                # Erasure chunks carry their own redundancy; replicating
+                # them in full would defeat the coding's storage savings.
+                self._repair(chunk_id, chunk)
+            return chunk
+        raise last_error or YtError(f"No such chunk {chunk_id}",
+                                    code=EErrorCode.NoSuchChunk)
+
+    def _is_erasure(self, chunk_id: str) -> bool:
+        import os
+        return any(
+            os.path.exists(store._erasure_meta_path(chunk_id))
+            for store in self.locations)
+
+    def _missing_replicas(self, chunk_id: str) -> bool:
+        placement = self._placement(chunk_id)[: self.replication_factor]
+        return any(not store.exists(chunk_id) for store in placement)
+
+    def _repair(self, chunk_id: str, chunk: ColumnarChunk) -> None:
+        """Re-replicate onto target locations that lost their copy."""
+        placement = self._placement(chunk_id)[: self.replication_factor]
+        for store in placement:
+            if not store.exists(chunk_id):
+                try:
+                    store.write_chunk(chunk, chunk_id=chunk_id)
+                    log_event(self._log, _logging.INFO, "replica_repaired",
+                              chunk_id=chunk_id, location=store.root)
+                except OSError:
+                    continue
+
+    def read_meta(self, chunk_id: str) -> dict:
+        for store in self._placement(chunk_id):
+            try:
+                return store.read_meta(chunk_id)
+            except YtError:
+                continue
+        raise YtError(f"No such chunk {chunk_id}",
+                      code=EErrorCode.NoSuchChunk)
+
+    def exists(self, chunk_id: str) -> bool:
+        return any(store.exists(chunk_id) for store in self.locations)
+
+    def remove_chunk(self, chunk_id: str) -> None:
+        for store in self.locations:
+            store.remove_chunk(chunk_id)
+
+    def list_chunks(self) -> list[str]:
+        out: set[str] = set()
+        for store in self.locations:
+            out.update(store.list_chunks())
+        return sorted(out)

@@ -20,7 +20,9 @@ def ensure_backend(timeout: float = 120.0):
     global _PROBED
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "") in ("cpu", ""):
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Explicit CPU cannot hang; anything else (including auto-selection
+        # with an accelerator plugin present) can.
         jax.devices()
         return jax
     if not _PROBED:
