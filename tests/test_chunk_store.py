@@ -217,3 +217,38 @@ def test_replicated_placement_process_stable(tmp_path):
         f"{cid}:{i}".encode()).digest())
     got = [store.locations.index(s) for s in store._placement(cid)]
     assert got == want
+
+
+def test_replicated_spilled_write_not_over_replicated(tmp_path):
+    import os, stat
+    store = _replicated(tmp_path)
+    chunk = _chunk(16)
+    # Force a spill: make the second placement location unwritable.
+    cid_probe = "feedface" * 4
+    placement = store._placement(cid_probe)
+    os.chmod(placement[1].root, 0o500)
+    try:
+        cid = store.write_chunk(chunk, chunk_id=cid_probe)
+    finally:
+        os.chmod(placement[1].root, 0o700)
+    copies = sum(1 for loc in store.locations if loc.exists(cid))
+    assert copies == 2                      # spilled to the third location
+    # Location recovered: a read must NOT add a third copy.
+    store.read_chunk(cid)
+    copies = sum(1 for loc in store.locations if loc.exists(cid))
+    assert copies == 2
+
+
+def test_replicated_read_survives_unreadable_location(tmp_path):
+    import os
+    store = _replicated(tmp_path)
+    chunk = _chunk(16)
+    cid = store.write_chunk(chunk)
+    holder = next(loc for loc in store._placement(cid) if loc.exists(cid))
+    # Make the file unreadable (EACCES, not FileNotFound).
+    path = holder._path(cid)
+    os.chmod(path, 0o000)
+    try:
+        assert store.read_chunk(cid).to_rows() == chunk.to_rows()
+    finally:
+        os.chmod(path, 0o600)

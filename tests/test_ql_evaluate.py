@@ -612,3 +612,41 @@ def test_dense_group_uint64_high_range():
     assert len(rows) == len(want)
     for r in rows:
         assert want[r["g"]] == r["c"]
+
+
+def test_timestamp_floor_functions():
+    # Oracle: Python datetime over a spread of timestamps incl. pre-epoch.
+    import datetime as dt
+    stamps = [0, 1, 3599, 3600, 86399, 86400, 1_000_000_000,
+              1_719_792_000, 951_782_400,          # 2000-02-29 leap day
+              -1, -86401, -2_208_988_800]          # pre-epoch (1900)
+    rows = [(i, s) for i, s in enumerate(stamps)]
+    tables = {T: ([("k", "int64", "ascending"), ("ts", "int64")], rows)}
+    out = evaluate(
+        "k, timestamp_floor_hour(ts) AS h, timestamp_floor_day(ts) AS d, "
+        "timestamp_floor_week(ts) AS w, timestamp_floor_month(ts) AS m, "
+        "timestamp_floor_year(ts) AS y FROM [//t]", tables)
+    for row, s in zip(sorted(out, key=lambda r: r["k"]), stamps):
+        t = dt.datetime.fromtimestamp(s, dt.timezone.utc)
+        def epoch(d):
+            return int(dt.datetime(d.year, d.month, d.day,
+                                   tzinfo=dt.timezone.utc).timestamp())
+        assert row["h"] == s - (s % 3600), (s, row["h"])
+        assert row["d"] == epoch(t), (s, row["d"])
+        monday = t.date() - dt.timedelta(days=t.weekday())
+        assert row["w"] == int(dt.datetime(
+            monday.year, monday.month, monday.day,
+            tzinfo=dt.timezone.utc).timestamp()), (s, row["w"])
+        assert row["m"] == int(dt.datetime(
+            t.year, t.month, 1, tzinfo=dt.timezone.utc).timestamp()), s
+        assert row["y"] == int(dt.datetime(
+            t.year, 1, 1, tzinfo=dt.timezone.utc).timestamp()), s
+
+
+def test_timestamp_floor_in_group_by():
+    rows = [(i, 86400 * (i // 3) + i) for i in range(9)]
+    evaluate("timestamp_floor_day(ts) AS day, count(*) AS c FROM [//t] "
+             "GROUP BY timestamp_floor_day(ts) AS day",
+             {T: ([("k", "int64", "ascending"), ("ts", "int64")], rows)},
+             [{"day": 0, "c": 3}, {"day": 86400, "c": 3},
+              {"day": 172800, "c": 3}])

@@ -84,49 +84,54 @@ class ReplicatedChunkStore:
 
     def read_chunk(self, chunk_id: str) -> ColumnarChunk:
         placement = self._placement(chunk_id)
-        last_error: Optional[YtError] = None
-        for idx, store in enumerate(placement):
+        last_error: Optional[Exception] = None
+        for store in placement:
             try:
                 chunk = store.read_chunk(chunk_id)
-            except YtError as e:
+            except (YtError, OSError) as e:   # missing OR dying location
                 last_error = e
                 continue
-            if not self._is_erasure(chunk_id) and \
-                    (idx > 0 or self._missing_replicas(chunk_id)):
+            import os
+            is_erasure = os.path.exists(store._erasure_meta_path(chunk_id))
+            if not is_erasure:
                 # Erasure chunks carry their own redundancy; replicating
                 # them in full would defeat the coding's storage savings.
-                self._repair(chunk_id, chunk)
+                self._maybe_repair(chunk_id, chunk, placement)
             return chunk
-        raise last_error or YtError(f"No such chunk {chunk_id}",
-                                    code=EErrorCode.NoSuchChunk)
+        if isinstance(last_error, YtError):
+            raise last_error
+        raise YtError(f"No such chunk {chunk_id}",
+                      code=EErrorCode.NoSuchChunk,
+                      attributes={"last_error": str(last_error)
+                                  if last_error else None})
 
-    def _is_erasure(self, chunk_id: str) -> bool:
-        import os
-        return any(
-            os.path.exists(store._erasure_meta_path(chunk_id))
-            for store in self.locations)
-
-    def _missing_replicas(self, chunk_id: str) -> bool:
-        placement = self._placement(chunk_id)[: self.replication_factor]
-        return any(not store.exists(chunk_id) for store in placement)
-
-    def _repair(self, chunk_id: str, chunk: ColumnarChunk) -> None:
-        """Re-replicate onto target locations that lost their copy."""
-        placement = self._placement(chunk_id)[: self.replication_factor]
+    def _maybe_repair(self, chunk_id: str, chunk: ColumnarChunk,
+                      placement: list[FsChunkStore]) -> None:
+        """Top up to replication_factor TOTAL copies (counting copies on any
+        location — a write that spilled past a failed location must not be
+        re-replicated into over-replication when it recovers)."""
+        holders = [s for s in placement if s.exists(chunk_id)]
+        missing = self.replication_factor - len(holders)
+        if missing <= 0:
+            return
         for store in placement:
-            if not store.exists(chunk_id):
-                try:
-                    store.write_chunk(chunk, chunk_id=chunk_id)
-                    log_event(self._log, _logging.INFO, "replica_repaired",
-                              chunk_id=chunk_id, location=store.root)
-                except OSError:
-                    continue
+            if missing <= 0:
+                break
+            if store in holders:
+                continue
+            try:
+                store.write_chunk(chunk, chunk_id=chunk_id)
+                missing -= 1
+                log_event(self._log, _logging.INFO, "replica_repaired",
+                          chunk_id=chunk_id, location=store.root)
+            except OSError:
+                continue
 
     def read_meta(self, chunk_id: str) -> dict:
         for store in self._placement(chunk_id):
             try:
                 return store.read_meta(chunk_id)
-            except YtError:
+            except (YtError, OSError):
                 continue
         raise YtError(f"No such chunk {chunk_id}",
                       code=EErrorCode.NoSuchChunk)

@@ -343,6 +343,15 @@ class ExprBinder:
                 (lambda v: v.upper()))
         if name == "concat":
             return self._bind_concat(args[0], args[1])
+        if name.startswith("timestamp_floor_"):
+            unit = name[len("timestamp_floor_"):]
+            a = args[0]
+
+            def emit_ts_floor(ctx):
+                data, valid = a.emit(ctx)
+                return _timestamp_floor(data.astype(jnp.int64), unit), valid
+            return BoundExpr(type=EValueType.int64, vocab=None,
+                             emit=emit_ts_floor)
         if name in ("is_finite", "is_nan"):
             a = args[0]
             fn = jnp.isfinite if name == "is_finite" else jnp.isnan
@@ -746,6 +755,53 @@ def _like_to_regex(pattern: bytes, case_insensitive: bool):
     flags = re.DOTALL | (re.IGNORECASE if case_insensitive else 0)
     return re.compile("".join(out).encode("utf-8", errors="surrogateescape"),
                       flags)
+
+
+def _days_to_civil(days: jax.Array):
+    """Vectorized days-since-epoch → (year, month, day), proleptic Gregorian
+    (the civil-from-days algorithm as pure integer device ops)."""
+    z = days + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - doe // 1460 + doe // 36524 - doe // 146096, 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _civil_to_days(y: jax.Array, m: jax.Array, d: jax.Array) -> jax.Array:
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.mod(m + 9, 12)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _timestamp_floor(ts: jax.Array, unit: str) -> jax.Array:
+    """Floor unix seconds to a calendar boundary (weeks start Monday)."""
+    if unit == "hour":
+        return ts - jnp.mod(ts, 3600)
+    if unit == "day":
+        return ts - jnp.mod(ts, 86400)
+    days = jnp.floor_divide(ts, 86400)
+    if unit == "week":
+        dow = jnp.mod(days + 3, 7)       # epoch day was a Thursday
+        return (days - dow) * 86400
+    y, m, _ = _days_to_civil(days)
+    if unit == "month":
+        return _civil_to_days(y, m, jnp.ones_like(m)) * 86400
+    if unit == "year":
+        one = jnp.ones_like(y)
+        return _civil_to_days(y, one, one) * 86400
+    raise YtError(f"Unknown timestamp unit {unit!r}",
+                  code=EErrorCode.QueryUnsupported)
 
 
 def _bytes_hash(v: bytes) -> np.uint64:

@@ -165,6 +165,20 @@ def _infer_float_pred(ts):
 _register("concat", _infer_concat, 2)
 _register("is_finite", _infer_float_pred, 1)
 _register("is_nan", _infer_float_pred, 1)
+
+
+def _infer_timestamp(name):
+    def infer(ts):
+        if ts[0] not in (EValueType.int64, EValueType.uint64, EValueType.null):
+            raise _type_error(name, ts)
+        return EValueType.int64
+    return infer
+
+
+for _name in ("timestamp_floor_hour", "timestamp_floor_day",
+              "timestamp_floor_week", "timestamp_floor_month",
+              "timestamp_floor_year"):
+    _register(_name, _infer_timestamp(_name), 1)
 _register("length", _infer_string_to_int, 1)
 _register("is_prefix", _infer_string_pred, 2)
 _register("is_substr", _infer_string_pred, 2)

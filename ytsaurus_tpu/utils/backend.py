@@ -21,8 +21,10 @@ def ensure_backend(timeout: float = 120.0):
     import jax
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        # Explicit CPU cannot hang; anything else (including auto-selection
-        # with an accelerator plugin present) can.
+        # Even an explicit-CPU env can hang if an accelerator plugin was
+        # pre-registered at interpreter start; pinning via jax.config takes
+        # effect immediately in this process.
+        jax.config.update("jax_platforms", "cpu")
         jax.devices()
         return jax
     if not _PROBED:
