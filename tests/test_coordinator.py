@@ -160,3 +160,41 @@ def test_distributed_with_totals():
     assert totals == [{"g": None, "s": 21}]
     assert sorted((r["g"], r["s"]) for r in rows if r["g"] is not None) == \
         [(0, 9), (1, 6), (2, 6)]
+
+
+def test_distributed_argmax_merges_across_shards():
+    schema = TableSchema.make([("k", "int64", "ascending"), ("g", "int64"),
+                               ("name", "string"), ("score", "int64")])
+    shards = [
+        ColumnarChunk.from_rows(schema, [(1, 0, "a", 10), (2, 0, "b", 30)]),
+        ColumnarChunk.from_rows(schema, [(3, 0, "c", 20), (4, 1, "d", 5)]),
+        ColumnarChunk.from_rows(schema, [(5, 1, "e", 50)]),
+    ]
+    plan = build_query(
+        f"g, argmax(name, score) AS top FROM [{T}] GROUP BY g", {T: schema})
+    out = coordinate_and_execute(plan, shards, evaluator=Evaluator())
+    assert sorted((r["g"], r["top"]) for r in out.to_rows()) == \
+        [(0, b"b"), (1, b"e")]
+
+
+def test_distributed_mixed_aggregate_order_stable():
+    # Output column order with project=None must match single-node even when
+    # argmax/avg states are decomposed for the merge.
+    schema = TableSchema.make([("k", "int64", "ascending"), ("g", "int64"),
+                               ("s", "string"), ("v", "int64")])
+    rows = [(1, 0, "a", 3), (2, 0, "b", 9), (3, 1, "c", 4)]
+    shards = [ColumnarChunk.from_rows(schema, rows[:2]),
+              ColumnarChunk.from_rows(schema, rows[2:])]
+    plan = build_query(
+        f"* FROM [{T}] GROUP BY g", {T: schema})
+    # build a grouped plan with mixed aggregates via explicit query:
+    plan = build_query(
+        "g, sum(v) AS s1, argmax(s, v) AS am, avg(v) AS a FROM [//t] "
+        "GROUP BY g", {T: schema})
+    single = coordinate_and_execute(plan, [ColumnarChunk.from_rows(
+        schema, rows)], evaluator=Evaluator())
+    multi = coordinate_and_execute(plan, shards, evaluator=Evaluator())
+    assert single.schema.column_names == multi.schema.column_names
+    key = lambda r: r["g"]
+    assert sorted(single.to_rows(), key=key) == sorted(multi.to_rows(),
+                                                       key=key)

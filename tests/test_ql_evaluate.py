@@ -650,3 +650,22 @@ def test_timestamp_floor_in_group_by():
              {T: ([("k", "int64", "ascending"), ("ts", "int64")], rows)},
              [{"day": 0, "c": 3}, {"day": 86400, "c": 3},
               {"day": 172800, "c": 3}])
+
+
+def test_argmin_argmax():
+    rows = [(1, 0, "a", 5), (2, 0, "b", 2), (3, 0, "c", 9),
+            (4, 1, "d", 7), (5, 1, "e", None), (6, 1, "f", 1)]
+    tables = {T: ([("k", "int64", "ascending"), ("g", "int64"),
+                   ("s", "string"), ("v", "int64")], rows)}
+    evaluate(f"g, argmin(s, v) AS lo, argmax(s, v) AS hi FROM [{T}] GROUP BY g",
+             tables,
+             [{"g": 0, "lo": "b", "hi": "c"}, {"g": 1, "lo": "f", "hi": "d"}])
+
+
+def test_argmax_nan_by_key_does_not_compete():
+    rows = [(1, 0, "good", 5.0), (2, 0, "poison", float("nan")),
+            (3, 0, "better", 7.0)]
+    evaluate("g, argmax(s, d) AS top FROM [//t] GROUP BY g",
+             {T: ([("k", "int64", "ascending"), ("g", "int64"),
+                   ("s", "string"), ("d", "double")], rows)},
+             [{"g": 0, "top": "better"}])

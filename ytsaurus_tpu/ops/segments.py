@@ -134,10 +134,7 @@ def segment_aggregate(function: str, data: jax.Array, valid: jax.Array,
             out = out.astype(jnp.bool_)
         return out, any_valid
     if function == "first":
-        cap = data.shape[0]
-        idx = jnp.where(contributes, jnp.arange(cap), cap - 1)
-        first_idx = _segment_reduce("min", idx, seg_ids, num_segments)
-        first_idx = jnp.clip(first_idx, 0, cap - 1)
+        first_idx = _segment_first_index(contributes, seg_ids, num_segments)
         return data[first_idx], any_valid
     raise ValueError(f"Unknown segment aggregate {function!r}")
 
@@ -147,6 +144,41 @@ def _reduce_neutral(dtype, function: str):
         return jnp.array(np.inf if function == "min" else -np.inf, dtype=dtype)
     info = jnp.iinfo(dtype)
     return jnp.array(info.max if function == "min" else info.min, dtype=dtype)
+
+
+def _segment_first_index(eligible: jax.Array, seg_ids: jax.Array,
+                         num_segments: int) -> jax.Array:
+    """First row index per segment among `eligible` rows (clipped sentinel
+    when a segment has none — callers must mask validity separately)."""
+    cap = eligible.shape[0]
+    idx = jnp.where(eligible, jnp.arange(cap), cap - 1)
+    first = _segment_reduce("min", idx, seg_ids, num_segments)
+    return jnp.clip(first, 0, cap - 1)
+
+
+def segment_arg_by(value_data: jax.Array, value_valid: jax.Array,
+                   by_data: jax.Array, by_valid: jax.Array,
+                   seg_ids: jax.Array, num_segments: int,
+                   take_max: bool) -> tuple[jax.Array, jax.Array]:
+    """Per segment: the value at the row whose `by` key is smallest/largest
+    (argmin/argmax; rows with null or NaN `by` don't compete; ties take the
+    first row)."""
+    if by_data.dtype == jnp.bool_:
+        by_data = by_data.astype(jnp.int8)
+    competes = by_valid
+    if jnp.issubdtype(by_data.dtype, jnp.floating):
+        # NaN poisons the reduce AND never equals the extreme, which would
+        # select an arbitrary row flagged valid.
+        competes = competes & ~jnp.isnan(by_data)
+    fn = "max" if take_max else "min"
+    neutral = _reduce_neutral(by_data.dtype, fn)
+    masked_by = jnp.where(competes, by_data, neutral)
+    extreme = _segment_reduce(fn, masked_by, seg_ids, num_segments)
+    winner = competes & (masked_by == extreme[seg_ids])
+    first_idx = _segment_first_index(winner, seg_ids, num_segments)
+    any_competes = _segment_reduce(
+        "sum", competes.astype(jnp.int64), seg_ids, num_segments) > 0
+    return value_data[first_idx], value_valid[first_idx] & any_competes
 
 
 def segment_distinct_count(data: jax.Array, valid: jax.Array,

@@ -15,6 +15,7 @@ from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.functions import (
     AGGREGATE_FUNCTIONS,
     SCALAR_FUNCTIONS,
+    TWO_ARG_AGGREGATES,
     is_aggregate,
     is_numeric,
     promote_numeric,
@@ -326,18 +327,29 @@ class _AggregatingBuilder(_ExprBuilder):
 
     def build_aggregate(self, e: ast.FunctionCall) -> ir.TExpr:
         fn = AGGREGATE_FUNCTIONS[e.name]
-        if len(e.args) != 1:
-            raise YtError(f"Aggregate {e.name!r} expects exactly one argument",
-                          code=EErrorCode.QueryTypeError)
+        two_arg = e.name in TWO_ARG_AGGREGATES
+        expected = 2 if two_arg else 1
+        if len(e.args) != expected:
+            raise YtError(
+                f"Aggregate {e.name!r} expects exactly {expected} argument(s)",
+                code=EErrorCode.QueryTypeError)
         argument = self.base_builder.build(e.args[0])
-        key = (e.name, ir._repr_expr(argument))
+        by_argument = None
+        if two_arg:
+            by_argument = self.base_builder.build(e.args[1])
+            if not by_argument.type.is_comparable:
+                raise YtError(f"{e.name} comparison key must be comparable",
+                              code=EErrorCode.QueryTypeError)
+        key = (e.name, ir._repr_expr(argument),
+               ir._repr_expr(by_argument) if by_argument else "")
         slot = self._agg_cache.get(key)
         if slot is None:
             slot = f"_agg{len(self.aggregates)}"
             self.aggregates.append(ir.AggregateItem(
                 name=slot, function=e.name, argument=argument,
                 type=fn.infer_result(argument.type),
-                state_type=fn.infer_state(argument.type)))
+                state_type=fn.infer_state(argument.type),
+                by_argument=by_argument))
             self._agg_cache[key] = slot
             self.namespace[slot] = self.aggregates[-1].type
         return ir.TReference(type=self.namespace[slot], name=slot)

@@ -46,7 +46,22 @@ def split_plan(plan: ir.Query) -> tuple[ir.Query, ir.FrontQuery]:
     if plan.group is not None:
         bottom_aggs: list[ir.AggregateItem] = []
         avg_map: dict[str, tuple[str, str]] = {}
+        argfn_front: dict[str, tuple[str, str]] = {}
         for agg in plan.group.aggregate_items:
+            if agg.function in ("argmin", "argmax"):
+                v_name, b_name = f"{agg.name}__v", f"{agg.name}__b"
+                bottom_aggs.append(ir.AggregateItem(
+                    name=v_name, function=agg.function,
+                    argument=agg.argument, type=agg.type,
+                    state_type=agg.state_type,
+                    by_argument=agg.by_argument))
+                bottom_aggs.append(ir.AggregateItem(
+                    name=b_name,
+                    function="min" if agg.function == "argmin" else "max",
+                    argument=agg.by_argument, type=agg.by_argument.type,
+                    state_type=agg.by_argument.type))
+                argfn_front[agg.name] = (v_name, b_name)
+                continue
             if agg.function == "avg":
                 s_name, c_name = f"{agg.name}__s", f"{agg.name}__c"
                 arg = agg.argument
@@ -70,12 +85,34 @@ def split_plan(plan: ir.Query) -> tuple[ir.Query, ir.FrontQuery]:
             ir.NamedExpr(name=item.name,
                          expr=ir.TReference(type=item.expr.type, name=item.name))
             for item in plan.group.group_items)
-        front_aggs = tuple(
-            ir.AggregateItem(
-                name=agg.name, function=_MERGE_FN[agg.function],
-                argument=ir.TReference(type=agg.state_type, name=agg.name),
-                type=agg.type, state_type=agg.state_type)
-            for agg in bottom_aggs)
+        # Keep the ORIGINAL declaration order: output schemas must match the
+        # single-node plan regardless of how states were decomposed.
+        by_name = {a.name: a for a in plan.group.aggregate_items}
+        front_agg_list = []
+        for agg in plan.group.aggregate_items:
+            if agg.name in argfn_front:
+                v_name, b_name = argfn_front[agg.name]
+                front_agg_list.append(ir.AggregateItem(
+                    name=agg.name, function=agg.function,
+                    argument=ir.TReference(type=agg.type, name=v_name),
+                    type=agg.type, state_type=agg.state_type,
+                    by_argument=ir.TReference(
+                        type=agg.by_argument.type, name=b_name)))
+            elif agg.function == "avg":
+                s_name, c_name = avg_map[agg.name]
+                for state_name, state_fn, ty in (
+                        (s_name, "sum", EValueType.double),
+                        (c_name, "sum", EValueType.int64)):
+                    front_agg_list.append(ir.AggregateItem(
+                        name=state_name, function=state_fn,
+                        argument=ir.TReference(type=ty, name=state_name),
+                        type=ty, state_type=ty))
+            else:
+                front_agg_list.append(ir.AggregateItem(
+                    name=agg.name, function=_MERGE_FN[agg.function],
+                    argument=ir.TReference(type=agg.state_type, name=agg.name),
+                    type=agg.type, state_type=agg.state_type))
+        front_aggs = tuple(front_agg_list)
 
         subst = _AvgSubstituter(avg_map)
         front = ir.FrontQuery(

@@ -30,6 +30,7 @@ from ytsaurus_tpu.ops.segments import (
     lexsort_indices,
     segment_aggregate,
     segment_boundaries,
+    segment_arg_by,
     segment_distinct_count,
     sort_key_planes,
 )
@@ -127,12 +128,14 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             group_key_b.append((item.name, binder.bind(item.expr)))
         for agg in group.aggregate_items:
             arg = binder.bind(agg.argument) if agg.argument is not None else None
-            agg_arg_b.append((agg, arg))
+            by_arg = binder.bind(agg.by_argument) \
+                if agg.by_argument is not None else None
+            agg_arg_b.append((agg, arg, by_arg))
         # Post-group namespace: keys + aggregate slots.
         post_columns: dict[str, ColumnBinding] = {}
         for (name, bound), item in zip(group_key_b, group.group_items):
             post_columns[name] = ColumnBinding(type=bound.type, vocab=bound.vocab)
-        for agg, arg in agg_arg_b:
+        for agg, arg, _ in agg_arg_b:
             vocab = arg.vocab if (arg is not None and
                                   agg.type is EValueType.string) else None
             post_columns[agg.name] = ColumnBinding(type=agg.type, vocab=vocab)
@@ -156,7 +159,7 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
         if group is not None:
             for (name, bound) in group_key_b:
                 project_b.append((name, _post_ref(name, bound)))
-            for agg, arg in agg_arg_b:
+            for agg, arg, _ in agg_arg_b:
                 vocab = arg.vocab if (arg is not None and
                                       agg.type is EValueType.string) else None
                 project_b.append((agg.name, _post_ref_t(agg.name, agg.type, vocab)))
@@ -278,7 +281,7 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                 else:
                     data = data.astype(jnp.int32)
                 new_columns[name] = (data, key_valid)
-            for agg, arg in agg_arg_b:
+            for agg, arg, by_arg in agg_arg_b:
                 if agg.function == "avg":
                     data, valid = arg.emit(ctx)
                     data = data.astype(jnp.float64)
@@ -294,6 +297,13 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                     d, dv = segment_distinct_count(data, valid & mask, seg,
                                                    nseg)
                     new_columns[agg.name] = (_pad(d), _pad(dv))
+                elif agg.function in ("argmin", "argmax"):
+                    vd, vv = arg.emit(ctx)
+                    bd, bv = by_arg.emit(ctx)
+                    out_d, out_v = segment_arg_by(
+                        vd, vv, bd, bv & mask, seg, nseg,
+                        take_max=(agg.function == "argmax"))
+                    new_columns[agg.name] = (_pad(out_d), _pad(out_v))
                 else:
                     data, valid = arg.emit(ctx)
                     valid = valid & mask
@@ -327,7 +337,7 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                     "first", valid.astype(jnp.int8), sorted_mask, seg_ids,
                     capacity, EValueType.null)
                 new_columns[name] = (out_d, out_v.astype(bool))
-            for agg, arg in agg_arg_b:
+            for agg, arg, by_arg in agg_arg_b:
                 if agg.function == "avg":
                     data, valid = arg.emit(ctx)
                     data = data[order_idx].astype(jnp.float64)
@@ -344,6 +354,15 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                         data[order_idx], valid[order_idx] & sorted_mask,
                         seg_ids, capacity)
                     new_columns[agg.name] = (d, dv)
+                elif agg.function in ("argmin", "argmax"):
+                    vd, vv = arg.emit(ctx)
+                    bd, bv = by_arg.emit(ctx)
+                    out_d, out_v = segment_arg_by(
+                        vd[order_idx], vv[order_idx],
+                        bd[order_idx], bv[order_idx] & sorted_mask,
+                        seg_ids, capacity,
+                        take_max=(agg.function == "argmax"))
+                    new_columns[agg.name] = (out_d, out_v)
                 else:
                     data, valid = arg.emit(ctx)
                     data = data[order_idx]

@@ -212,7 +212,12 @@ def _agg_numeric(ty: EValueType) -> EValueType:
     return ty
 
 
+# argmin/argmax take (value_expr, by_expr); result type = value type.
+TWO_ARG_AGGREGATES = {"argmin", "argmax"}
+
 AGGREGATE_FUNCTIONS: dict[str, AggregateFunction] = {
+    "argmin": AggregateFunction("argmin", _agg_same, _agg_same),
+    "argmax": AggregateFunction("argmax", _agg_same, _agg_same),
     "sum": AggregateFunction("sum", _agg_numeric, _agg_numeric),
     "min": AggregateFunction("min", _agg_same, _agg_same),
     "max": AggregateFunction("max", _agg_same, _agg_same),

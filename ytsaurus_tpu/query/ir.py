@@ -112,6 +112,7 @@ class AggregateItem:
     argument: Optional[TExpr]
     type: EValueType         # result type
     state_type: EValueType   # partial-state type (avg keeps (sum,count))
+    by_argument: Optional[TExpr] = None   # argmin/argmax comparison key
 
 
 @dataclass(frozen=True)
@@ -290,7 +291,8 @@ def fingerprint(query: "Query | FrontQuery") -> str:
         parts.append("G(" + ";".join(
             f"{i.name}={_repr_expr(i.expr)}" for i in query.group.group_items) + ")")
         parts.append("A(" + ";".join(
-            f"{a.name}={a.function}({_repr_expr(a.argument) if a.argument else ''})"
+            f"{a.name}={a.function}({_repr_expr(a.argument) if a.argument else ''}"
+            f";{_repr_expr(a.by_argument) if a.by_argument else ''})"
             for a in query.group.aggregate_items) + f";{query.group.totals})")
     parts.append(_repr_expr(query.having))
     if query.order:
