@@ -503,3 +503,59 @@ def test_query_statistics_and_logging(client, capsys):
         assert '"message": "select_rows"' in err
     finally:
         logger.setLevel(old_level)
+
+
+def test_in_memory_mode_pins_tablet_chunks(client):
+    client.create("table", "//dyn/mem", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True,
+                              "in_memory_mode": "uncompressed"})
+    client.mount_table("//dyn/mem")
+    client.insert_rows("//dyn/mem", [{"key": i, "value": f"v{i}"}
+                                     for i in range(10)])
+    client.unmount_table("//dyn/mem")
+    client.mount_table("//dyn/mem")        # remount preloads + pins
+    (tablet,) = client._mounted_tablets("//dyn/mem")
+    cache = client.cluster.chunk_cache
+    assert tablet.chunk_ids
+    for cid in tablet.chunk_ids:
+        assert cid in cache._entries and cid in cache._pinned
+    # Pinned chunks survive eviction pressure.
+    cache.capacity_bytes = 1
+    client.write_table("//tmp/pressure", [{"x": i} for i in range(1000)])
+    client.read_table("//tmp/pressure")
+    for cid in tablet.chunk_ids:
+        assert cid in cache._entries
+    client.unmount_table("//dyn/mem")
+    for cid in client.get("//dyn/mem/@tablet_chunk_ids")[0]:
+        assert cid not in cache._pinned
+
+
+def test_in_memory_pins_follow_flush_compact_and_remove(client):
+    client.create("table", "//dyn/mem2", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True,
+                              "in_memory_mode": "uncompressed"})
+    client.mount_table("//dyn/mem2")
+    cache = client.cluster.chunk_cache
+    client.insert_rows("//dyn/mem2", [{"key": 1, "value": "a"}])
+    client.freeze_table("//dyn/mem2")      # flush-created chunk must pin
+    (tablet,) = client._mounted_tablets("//dyn/mem2")
+    assert all(cid in cache._pinned for cid in tablet.chunk_ids)
+    client.insert_rows("//dyn/mem2", [{"key": 2, "value": "b"}])
+    client.compact_table("//dyn/mem2")     # compacted chunk must pin
+    assert tablet.chunk_ids
+    assert all(cid in cache._pinned for cid in tablet.chunk_ids)
+    pinned_before = set(tablet.chunk_ids)
+    client.remove("//dyn")                 # removing the subtree unpins
+    assert not (pinned_before & cache._pinned)
+
+
+def test_in_memory_mode_ordered_table(client):
+    client.create("table", "//q/mem", recursive=True,
+                  attributes={"schema": ORDERED_SCHEMA, "dynamic": True,
+                              "in_memory_mode": "uncompressed"})
+    client.mount_table("//q/mem")
+    client.push_queue("//q/mem", [{"msg": "x", "n": 1}])
+    (tablet,) = client._mounted_tablets("//q/mem")
+    tablet.flush()
+    cache = client.cluster.chunk_cache
+    assert all(cid in cache._pinned for cid in tablet.chunk_ids)

@@ -182,6 +182,7 @@ class ChunkCache:
         self.store = store
         self.capacity_bytes = capacity_bytes
         self._entries: OrderedDict[str, tuple[ColumnarChunk, int]] = OrderedDict()
+        self._pinned: set[str] = set()
         self._used = 0
         self._lock = threading.Lock()
         self.hits = 0
@@ -209,13 +210,43 @@ class ChunkCache:
             if chunk_id not in self._entries:
                 self._entries[chunk_id] = (chunk, size)
                 self._used += size
-                while self._used > self.capacity_bytes and len(self._entries) > 1:
-                    _, (_, evicted_size) = self._entries.popitem(last=False)
-                    self._used -= evicted_size
+                self._evict_locked()
         return chunk
+
+    def _evict_locked(self) -> None:
+        # Pinned entries (in-memory mode tables) never evict.
+        evictable = [cid for cid in self._entries if cid not in self._pinned]
+        while self._used > self.capacity_bytes and len(evictable) > 1:
+            victim = evictable.pop(0)
+            _, size = self._entries.pop(victim)
+            self._used -= size
+
+    def pin(self, chunk_id: str) -> None:
+        """Keep this chunk's decoded planes resident (ref in_memory_manager
+        preload, tablet_node/in_memory_manager.h:62).  Entry insertion and
+        pin-marking happen under ONE lock acquisition, or a concurrent
+        eviction could drop the chunk between them."""
+        with self._lock:
+            if chunk_id in self._entries:
+                self._pinned.add(chunk_id)
+                self._entries.move_to_end(chunk_id)
+                return
+        chunk = self.store.read_chunk(chunk_id)
+        size = self._chunk_bytes(chunk)
+        with self._lock:
+            if chunk_id not in self._entries:
+                self._entries[chunk_id] = (chunk, size)
+                self._used += size
+            self._pinned.add(chunk_id)
+            self._evict_locked()
+
+    def unpin(self, chunk_id: str) -> None:
+        with self._lock:
+            self._pinned.discard(chunk_id)
 
     def invalidate(self, chunk_id: str) -> None:
         with self._lock:
+            self._pinned.discard(chunk_id)
             entry = self._entries.pop(chunk_id, None)
             if entry is not None:
                 self._used -= entry[1]

@@ -90,7 +90,9 @@ class YtClient:
             stack = [node]
             while stack:
                 current = stack.pop()
-                self.cluster.tablets.pop(current.id, None)
+                dropped = self.cluster.tablets.pop(current.id, None)
+                for tablet in dropped or ():
+                    tablet.set_in_memory(False)
                 stack.extend(current.children.values())
         self.cluster.master.commit_mutation(
             "remove", path=path, recursive=recursive, force=force)
@@ -195,6 +197,11 @@ class YtClient:
             tablet.base_index = int(state.get("base_index", 0))
             tablet.trimmed_count = int(state.get("trimmed_count", 0))
             self.cluster.tablets[node.id] = [tablet]
+        # In-memory mode: tablets own their pins so flush/compact-created
+        # chunks stay resident too (ref EInMemoryMode none/uncompressed).
+        if node.attributes.get("in_memory_mode", "none") != "none":
+            for tablet in self.cluster.tablets[node.id]:
+                tablet.set_in_memory(True)
         self.set(path + "/@tablet_state", "mounted")
 
     def unmount_table(self, path: str) -> None:
@@ -205,6 +212,7 @@ class YtClient:
         from ytsaurus_tpu.tablet.ordered import OrderedTablet
         for tablet in tablets:
             tablet.flush()
+            tablet.set_in_memory(False)
             tablet.mounted = False
         if isinstance(tablets[0], OrderedTablet):
             t = tablets[0]

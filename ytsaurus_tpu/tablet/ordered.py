@@ -42,6 +42,7 @@ class OrderedTablet:
         self.base_index = 0          # first index still in the active store
         self.trimmed_count = 0
         self.mounted = True
+        self.in_memory = False
         self._lock = threading.RLock()
 
     # -- writes ----------------------------------------------------------------
@@ -83,10 +84,21 @@ class OrderedTablet:
                 ordered_chunk_schema(self.schema), chunk_rows)
             chunk_id = self.chunk_store.write_chunk(chunk)
             self.chunk_ids.append(chunk_id)
+            if self.in_memory:
+                self.chunk_cache.pin(chunk_id)
             self.chunk_ranges.append((self.base_index, self.base_index + n))
             self.base_index += n
             self.store = OrderedDynamicStore(self.schema)
             return chunk_id
+
+    def set_in_memory(self, enabled: bool) -> None:
+        with self._lock:
+            self.in_memory = enabled
+            for cid in self.chunk_ids:
+                if enabled:
+                    self.chunk_cache.pin(cid)
+                else:
+                    self.chunk_cache.unpin(cid)
 
     # -- reads -----------------------------------------------------------------
 

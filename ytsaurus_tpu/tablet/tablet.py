@@ -61,6 +61,7 @@ class Tablet:
         self.passive_stores: list[SortedDynamicStore] = []
         self.chunk_ids: list[str] = []      # versioned snapshot chunks
         self.mounted = True
+        self.in_memory = False          # pin chunks in the cache when True
         self.flush_generation = 0
         self._lock = threading.RLock()
         self._host_planes: dict[str, dict] = {}
@@ -115,6 +116,16 @@ class Tablet:
                     best = ts
             return best
 
+    def set_in_memory(self, enabled: bool) -> None:
+        """Preload+pin (or release) this tablet's chunks in the cache."""
+        with self._lock:
+            self.in_memory = enabled
+            for cid in self.chunk_ids:
+                if enabled:
+                    self.chunk_cache.pin(cid)
+                else:
+                    self.chunk_cache.unpin(cid)
+
     def _check_mounted(self):
         if not self.mounted:
             raise YtError(f"Tablet {self.tablet_id} is not mounted",
@@ -143,6 +154,8 @@ class Tablet:
             chunk = ColumnarChunk.from_rows(versioned_schema(self.schema), rows)
             chunk_id = self.chunk_store.write_chunk(chunk)
             self.chunk_ids.append(chunk_id)
+            if self.in_memory:
+                self.chunk_cache.pin(chunk_id)
             self.passive_stores.clear()
             self.flush_generation += 1
             return chunk_id
@@ -166,6 +179,8 @@ class Tablet:
                                                 rows)
                 new_id = self.chunk_store.write_chunk(chunk)
                 self.chunk_ids = [new_id]
+                if self.in_memory:
+                    self.chunk_cache.pin(new_id)
             else:
                 new_id = None
                 self.chunk_ids = []
