@@ -559,3 +559,71 @@ def test_in_memory_mode_ordered_table(client):
     tablet.flush()
     cache = client.cluster.chunk_cache
     assert all(cid in cache._pinned for cid in tablet.chunk_ids)
+
+
+def test_computed_key_columns(client):
+    # Hash-sharding key computed from the user id — the classic computed
+    # column pattern.
+    schema = TableSchema.make([
+        {"name": "hash", "type": "uint64", "sort_order": "ascending",
+         "expression": "farm_hash(user)"},
+        {"name": "user", "type": "string", "sort_order": "ascending"},
+        {"name": "n", "type": "int64"},
+    ], unique_keys=True)
+    client.create("table", "//dyn/computed", recursive=True,
+                  attributes={"schema": schema, "dynamic": True})
+    client.mount_table("//dyn/computed")
+    client.insert_rows("//dyn/computed",
+                       [{"user": "alice", "n": 1}, {"user": "bob", "n": 2}])
+    rows = client.select_rows(
+        "hash, user, n FROM [//dyn/computed] WHERE user = 'alice'")
+    assert len(rows) == 1 and rows[0]["n"] == 1
+    assert isinstance(rows[0]["hash"], int) and rows[0]["hash"] > 0
+    # Same expression, same hash: re-insert overwrites the same key.
+    client.insert_rows("//dyn/computed", [{"user": "alice", "n": 10}])
+    rows = client.select_rows(
+        "n FROM [//dyn/computed] WHERE user = 'alice'")
+    assert rows == [{"n": 10}]
+    # Writing the computed column directly is rejected.
+    with pytest.raises(YtError):
+        client.insert_rows("//dyn/computed",
+                           [{"hash": 1, "user": "x", "n": 0}])
+
+
+def test_computed_column_arithmetic(client):
+    schema = TableSchema.make([
+        {"name": "bucket", "type": "int64", "sort_order": "ascending",
+         "expression": "id % 8"},
+        {"name": "id", "type": "int64", "sort_order": "ascending"},
+        {"name": "v", "type": "int64"},
+    ], unique_keys=True)
+    client.create("table", "//dyn/buckets", recursive=True,
+                  attributes={"schema": schema, "dynamic": True})
+    client.mount_table("//dyn/buckets")
+    client.insert_rows("//dyn/buckets",
+                       [{"id": i, "v": i * 10} for i in range(20)])
+    rows = client.select_rows(
+        "bucket, count(*) AS c FROM [//dyn/buckets] GROUP BY bucket")
+    assert sorted((r["bucket"], r["c"]) for r in rows) == \
+        [(b, 3 if b < 4 else 2) for b in range(8)]
+
+
+def test_computed_keys_filled_for_lookup_and_delete(client):
+    schema = TableSchema.make([
+        {"name": "h", "type": "uint64", "sort_order": "ascending",
+         "expression": "farm_hash(u)"},
+        {"name": "u", "type": "string", "sort_order": "ascending"},
+        {"name": "n", "type": "int64"},
+    ], unique_keys=True)
+    client.create("table", "//dyn/nat", recursive=True,
+                  attributes={"schema": schema, "dynamic": True})
+    client.mount_table("//dyn/nat")
+    client.insert_rows("//dyn/nat", [{"u": "alice", "n": 1},
+                                     {"u": "bob", "n": 2}])
+    # Natural (computed-free) keys work for lookup and delete.
+    rows = client.lookup_rows("//dyn/nat", [("alice",), ("carol",)])
+    assert rows[0]["n"] == 1 and rows[1] is None
+    client.delete_rows("//dyn/nat", [("bob",)])
+    assert client.lookup_rows("//dyn/nat", [("bob",)]) == [None]
+    # Plan cache: repeated fills reuse one built plan per schema.
+    assert len(client._computed_plans) == 1

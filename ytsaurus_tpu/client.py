@@ -22,6 +22,7 @@ from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
 from ytsaurus_tpu.chunks.store import ChunkCache, FsChunkStore
 from ytsaurus_tpu.cypress.master import Master
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.builder import build_query
 from ytsaurus_tpu.query.coordinator import coordinate_and_execute
 from ytsaurus_tpu.query.engine.evaluator import Evaluator
@@ -52,6 +53,7 @@ class YtClient:
         from ytsaurus_tpu.query.statistics import QueryStatistics
         self.scheduler = OperationScheduler(self)
         self.last_query_statistics = QueryStatistics()
+        self._computed_plans: dict = {}
 
     # ------------------------------------------------------------------ cypress
 
@@ -379,6 +381,7 @@ class YtClient:
     def insert_rows(self, path: str, rows: Sequence[dict],
                     tx: Optional[TabletTransaction] = None) -> Optional[int]:
         tablets = self._mounted_tablets(path)
+        rows = self._fill_computed_columns(tablets[0].schema, list(rows))
         from ytsaurus_tpu.tablet.ordered import OrderedTablet
         if isinstance(tablets[0], OrderedTablet):
             if tx is not None:
@@ -400,11 +403,13 @@ class YtClient:
                     tx: Optional[TabletTransaction] = None) -> Optional[int]:
         tablets = self._mounted_tablets(path)
         self._require_sorted(tablets[0], path)
+        keys = self._fill_computed_keys(tablets[0].schema,
+                                        [tuple(k) for k in keys])
         txm = self.cluster.transactions
         own = tx is None
         tx = tx or txm.start()
         for idx, part in self._route_rows(
-                path, tablets, [tuple(k) for k in keys]).items():
+                path, tablets, keys).items():
             txm.delete_rows(tx, tablets[idx], part)
         if own:
             return txm.commit(tx)
@@ -416,7 +421,8 @@ class YtClient:
                     ) -> list[Optional[dict]]:
         tablets = self._mounted_tablets(path)
         self._require_sorted(tablets[0], path)
-        keys = [tuple(k) for k in keys]
+        keys = self._fill_computed_keys(tablets[0].schema,
+                                        [tuple(k) for k in keys])
         routed = self._route_rows(path, tablets, keys)
         results: dict[tuple, Optional[dict]] = {}
         for idx, part in routed.items():
@@ -487,6 +493,91 @@ class YtClient:
             "erase", {"table_path": table_path, **kwargs})
 
     # ----------------------------------------------------------------- internals
+
+    def _computed_plan(self, schema: TableSchema):
+        """Cached (plan, input schema, referenced column names) for a
+        schema's computed columns (ref TColumnEvaluatorCache,
+        engine_api/column_evaluator.h)."""
+        cached = self._computed_plans.get(schema)
+        if cached is not None:
+            return cached
+        computed = [c for c in schema if c.expression]
+        supplied = [c for c in schema if not c.expression]
+        base_schema = TableSchema.make(
+            [(c.name, c.type.value) for c in supplied])
+        select_list = ", ".join(
+            f"{c.expression} AS {c.name}" for c in computed)
+        plan = build_query(f"{select_list} FROM [//$computed]",
+                           {"//$computed": base_schema})
+        for item, col in zip(plan.project.items, computed):
+            if item.expr.type is not col.type:
+                raise YtError(
+                    f"Computed column {col.name!r}: expression type "
+                    f"{item.expr.type.value} != column type {col.type.value}",
+                    code=EErrorCode.QueryTypeError)
+        # Feed only the columns the expressions actually read.
+        referenced: set[str] = set()
+        for item in plan.project.items:
+            ir.map_expr(item.expr, lambda node: (
+                referenced.add(node.name)
+                if isinstance(node, ir.TReference) else None) or node)
+        input_schema = TableSchema.make(
+            [(c.name, c.type.value) for c in supplied
+             if c.name in referenced])
+        plan = build_query(f"{select_list} FROM [//$computed]",
+                           {"//$computed": input_schema})
+        entry = (plan, input_schema, [c.name for c in computed])
+        self._computed_plans[schema] = entry
+        return entry
+
+    def _fill_computed_columns(self, schema: TableSchema,
+                               rows: "list[dict]") -> "list[dict]":
+        """Evaluate `expression` columns from the other columns at write time
+        (ref column evaluator for computed key columns,
+        library/query/engine_api/column_evaluator.h).  Runs the expressions
+        through the query engine itself so semantics match SELECT exactly."""
+        computed = [c for c in schema if c.expression]
+        if not computed or not rows:
+            return rows
+        for row in rows:
+            for c in computed:
+                if c.name in row:
+                    raise YtError(
+                        f"Column {c.name!r} is computed "
+                        f"({c.expression!r}) and cannot be written directly",
+                        code=EErrorCode.QueryTypeError)
+        plan, input_schema, _ = self._computed_plan(schema)
+        chunk = ColumnarChunk.from_rows(
+            input_schema, [{c.name: row.get(c.name) for c in input_schema}
+                           for row in rows])
+        out = self.cluster.evaluator.run_plan(plan, chunk).to_rows()
+        filled = []
+        for row, extra in zip(rows, out):
+            merged = dict(row)
+            merged.update(extra)
+            filled.append(merged)
+        return filled
+
+    def _fill_computed_keys(self, schema: TableSchema,
+                            keys: "list[tuple]") -> "list[tuple]":
+        """Accept keys WITHOUT the computed parts (the natural key) and fill
+        them, mirroring insert-time evaluation; full keys pass through."""
+        key_cols = schema.key_columns
+        computed_idx = [i for i, c in enumerate(key_cols) if c.expression]
+        if not computed_idx or not keys:
+            return keys
+        natural = [c for c in key_cols if not c.expression]
+        if keys and len(keys[0]) == len(key_cols):
+            return keys                    # caller supplied full keys
+        if len(keys[0]) != len(natural):
+            raise YtError(
+                f"Key width {len(keys[0])} matches neither the full key "
+                f"({len(key_cols)}) nor the natural key ({len(natural)})",
+                code=EErrorCode.QueryTypeError)
+        rows = [{c.name: v for c, v in zip(natural, key)} for key in keys]
+        filled_rows = self._fill_computed_columns(schema, rows)
+        return [tuple(row[c.name] for c in key_cols)
+                for row in filled_rows]
 
     def _table_node(self, path: str, create: bool = False,
                     schema: "TableSchema | dict | None" = None):
