@@ -627,3 +627,89 @@ def test_computed_keys_filled_for_lookup_and_delete(client):
     assert client.lookup_rows("//dyn/nat", [("bob",)]) == [None]
     # Plan cache: repeated fills reuse one built plan per schema.
     assert len(client._computed_plans) == 1
+
+
+def test_copy_move_link(client):
+    client.write_table("//a/t", [{"x": 1}, {"x": 2}])
+    # copy: independent metadata, shared immutable chunks
+    client.copy("//a/t", "//b/t", recursive=True)
+    assert client.read_table("//b/t") == client.read_table("//a/t")
+    client.write_table("//b/t", [{"x": 99}])          # diverges
+    assert [r["x"] for r in client.read_table("//a/t")] == [1, 2]
+    # move
+    client.move("//a/t", "//a/renamed")
+    assert not client.exists("//a/t")
+    assert [r["x"] for r in client.read_table("//a/renamed")] == [1, 2]
+    # link resolves through to the target
+    client.link("//a/renamed", "//a/alias")
+    assert client.read_table("//a/alias") == client.read_table("//a/renamed")
+    # survives WAL recovery
+    from ytsaurus_tpu.client import connect
+    reopened = connect(client.cluster.root_dir)
+    assert [r["x"] for r in reopened.read_table("//a/alias")] == [1, 2]
+    # probes
+    with pytest.raises(YtError):
+        client.copy("//a/renamed", "//b/t")           # exists
+    with pytest.raises(YtError):
+        client.link("//no/such", "//a/badlink")
+
+
+def test_move_mounted_table_rejected(client):
+    client.create("table", "//dyn/m", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/m")
+    with pytest.raises(YtError):
+        client.move("//dyn/m", "//dyn/m2")
+
+
+def test_move_failure_is_atomic(client):
+    client.write_table("//m/src", [{"x": 1}])
+    client.write_table("//m/dst", [{"x": 2}])
+    with pytest.raises(YtError):
+        client.move("//m/src", "//m/dst")      # exists → must not destroy src
+    assert client.read_table("//m/src") == [{"x": 1}]
+
+
+def test_move_link_moves_the_link(client):
+    client.write_table("//m/t", [{"x": 7}])
+    client.link("//m/t", "//m/l")
+    client.move("//m/l", "//m/l2")
+    assert client.read_table("//m/l2") == [{"x": 7}]
+    assert client.read_table("//m/t") == [{"x": 7}]   # target untouched
+    assert not client.exists("//m/l")
+
+
+def test_copy_dynamic_table_survives_source_compaction(client):
+    client.create("table", "//dyn/src", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//dyn/src")
+    client.insert_rows("//dyn/src", [{"key": i, "value": f"v{i}"}
+                                     for i in range(5)])
+    with pytest.raises(YtError):
+        client.copy("//dyn/src", "//dyn/copy")        # mounted → refuse
+    client.unmount_table("//dyn/src")
+    client.copy("//dyn/src", "//dyn/copy")
+    # Compacting (which deletes chunks) on the ORIGINAL must not break the copy.
+    client.mount_table("//dyn/src")
+    client.insert_rows("//dyn/src", [{"key": 9, "value": "new"}])
+    client.compact_table("//dyn/src")
+    client.mount_table("//dyn/copy")
+    rows = client.lookup_rows("//dyn/copy", [(0,), (4,), (9,)])
+    assert rows[0]["value"] == b"v0" and rows[1]["value"] == b"v4"
+    assert rows[2] is None                            # copy predates key 9
+
+
+def test_mixed_width_computed_keys(client):
+    schema = TableSchema.make([
+        {"name": "b", "type": "int64", "sort_order": "ascending",
+         "expression": "id % 2"},
+        {"name": "id", "type": "int64", "sort_order": "ascending"},
+        {"name": "v", "type": "int64"}], unique_keys=True)
+    client.create("table", "//dyn/mix", recursive=True,
+                  attributes={"schema": schema, "dynamic": True})
+    client.mount_table("//dyn/mix")
+    client.insert_rows("//dyn/mix", [{"id": i, "v": i} for i in range(4)])
+    rows = client.lookup_rows("//dyn/mix", [(1, 3), (2,)])   # full + natural
+    assert rows[0]["v"] == 3 and rows[1]["v"] == 2
+    with pytest.raises(YtError):
+        client.lookup_rows("//dyn/mix", [(1, 2, 3)])         # bad width

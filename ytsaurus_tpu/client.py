@@ -84,6 +84,66 @@ class YtClient:
     def list(self, path: str) -> list[str]:
         return self.cluster.master.tree.list(path)
 
+    def copy(self, src_path: str, dst_path: str,
+             recursive: bool = False) -> str:
+        """Deep-copy a subtree.  Static-table chunks are shared by reference
+        (they are never deleted); dynamic-table chunks are physically
+        duplicated because compaction/reshard delete the source's chunk
+        files.  Mounted dynamic tables must unmount first."""
+        src_node = self.cluster.master.tree.try_resolve(src_path)
+        if src_node is not None:
+            stack = [src_node]
+            while stack:
+                current = stack.pop()
+                if current.id in self.cluster.tablets:
+                    raise YtError(
+                        f"Unmount dynamic tables under {src_path!r} before "
+                        "copying", code=EErrorCode.TabletNotMounted)
+                stack.extend(current.children.values())
+        node_id = self.cluster.master.commit_mutation(
+            "copy", src=src_path, dst=dst_path, recursive=recursive)
+        self._duplicate_dynamic_chunks(dst_path)
+        return node_id
+
+    def _duplicate_dynamic_chunks(self, path: str) -> None:
+        """Give copied dynamic tables their own chunk files (their sources
+        delete chunks on compaction/reshard)."""
+        tree = self.cluster.master.tree
+        node = tree.try_resolve(path)
+        if node is None:
+            return
+        stack = [(path, node)]
+        while stack:
+            node_path, current = stack.pop()
+            if current.type == "table" and current.attributes.get("dynamic"):
+                per_tablet = current.attributes.get("tablet_chunk_ids", [])
+                if per_tablet and isinstance(per_tablet[0], str):
+                    per_tablet = [per_tablet]
+                fresh = []
+                for ids in per_tablet:
+                    fresh.append([
+                        self.cluster.chunk_store.write_chunk(
+                            self.cluster.chunk_store.read_chunk(cid))
+                        for cid in ids])
+                if fresh:
+                    self.set(node_path + "/@tablet_chunk_ids", fresh)
+            for name, child in current.children.items():
+                stack.append((f"{node_path}/{name}", child))
+
+    def move(self, src_path: str, dst_path: str,
+             recursive: bool = False) -> str:
+        node = self.cluster.master.tree.try_resolve(src_path)
+        if node is not None and node.id in self.cluster.tablets:
+            raise YtError(f"Unmount {src_path!r} before moving it",
+                          code=EErrorCode.TabletNotMounted)
+        return self.cluster.master.commit_mutation(
+            "move", src=src_path, dst=dst_path, recursive=recursive)
+
+    def link(self, target_path: str, link_path: str,
+             recursive: bool = False) -> str:
+        return self.cluster.master.commit_mutation(
+            "link", target=target_path, link=link_path, recursive=recursive)
+
     def remove(self, path: str, recursive: bool = True,
                force: bool = False) -> None:
         node = self.cluster.master.tree.try_resolve(path)
@@ -561,23 +621,34 @@ class YtClient:
     def _fill_computed_keys(self, schema: TableSchema,
                             keys: "list[tuple]") -> "list[tuple]":
         """Accept keys WITHOUT the computed parts (the natural key) and fill
-        them, mirroring insert-time evaluation; full keys pass through."""
+        them, mirroring insert-time evaluation; full-width keys pass
+        through.  Width is checked PER KEY so mixed batches cannot be
+        misinterpreted."""
         key_cols = schema.key_columns
-        computed_idx = [i for i, c in enumerate(key_cols) if c.expression]
-        if not computed_idx or not keys:
+        if not any(c.expression for c in key_cols) or not keys:
             return keys
         natural = [c for c in key_cols if not c.expression]
-        if keys and len(keys[0]) == len(key_cols):
-            return keys                    # caller supplied full keys
-        if len(keys[0]) != len(natural):
-            raise YtError(
-                f"Key width {len(keys[0])} matches neither the full key "
-                f"({len(key_cols)}) nor the natural key ({len(natural)})",
-                code=EErrorCode.QueryTypeError)
-        rows = [{c.name: v for c, v in zip(natural, key)} for key in keys]
-        filled_rows = self._fill_computed_columns(schema, rows)
-        return [tuple(row[c.name] for c in key_cols)
-                for row in filled_rows]
+        if len(natural) == len(key_cols):
+            return keys
+        out: "list[tuple | None]" = [None] * len(keys)
+        to_fill: list[int] = []
+        for i, key in enumerate(keys):
+            if len(key) == len(key_cols):
+                out[i] = key               # full key supplied
+            elif len(key) == len(natural):
+                to_fill.append(i)
+            else:
+                raise YtError(
+                    f"Key width {len(key)} matches neither the full key "
+                    f"({len(key_cols)}) nor the natural key ({len(natural)})",
+                    code=EErrorCode.QueryTypeError)
+        if to_fill:
+            rows = [{c.name: v for c, v in zip(natural, keys[i])}
+                    for i in to_fill]
+            filled_rows = self._fill_computed_columns(schema, rows)
+            for i, row in zip(to_fill, filled_rows):
+                out[i] = tuple(row[c.name] for c in key_cols)
+        return out
 
     def _table_node(self, path: str, create: bool = False,
                     schema: "TableSchema | dict | None" = None):

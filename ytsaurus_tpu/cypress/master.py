@@ -95,7 +95,7 @@ class Master:
 
     # -- mutation pipeline -----------------------------------------------------
 
-    _MUTATIONS = ("create", "remove", "set")
+    _MUTATIONS = ("create", "remove", "set", "copy", "move", "link")
 
     def commit_mutation(self, op: str, **args) -> Any:
         """Log, then apply (ref CommitMutation)."""
@@ -123,6 +123,15 @@ class Master:
                                     force=args.get("force", False))
         if op == "set":
             return self.tree.set(args["path"], args.get("value"))
+        if op == "copy":
+            return self.tree.copy(args["src"], args["dst"],
+                                  recursive=args.get("recursive", False))
+        if op == "move":
+            return self.tree.move(args["src"], args["dst"],
+                                  recursive=args.get("recursive", False))
+        if op == "link":
+            return self.tree.link(args["target"], args["link"],
+                                  recursive=args.get("recursive", False))
         raise AssertionError(op)
 
     # -- snapshots / recovery --------------------------------------------------

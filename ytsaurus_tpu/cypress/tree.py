@@ -73,13 +73,24 @@ class CypressNode:
         return node
 
 
+def _clone(node: CypressNode) -> CypressNode:
+    import copy as _copy
+    cloned = CypressNode(
+        id=uuid.uuid4().hex, type=node.type,
+        attributes=_copy.deepcopy(node.attributes),
+        value=_copy.deepcopy(node.value))
+    cloned.children = {name: _clone(child)
+                       for name, child in node.children.items()}
+    return cloned
+
+
 class CypressTree:
     def __init__(self):
         self.root = CypressNode(id=uuid.uuid4().hex, type="map_node")
 
     # -- resolution ------------------------------------------------------------
 
-    def resolve(self, path: str) -> CypressNode:
+    def resolve(self, path: str, follow_links: bool = True) -> CypressNode:
         tokens, attr = parse_ypath(path)
         if attr is not None:
             raise YtError(f"Expected a node path, got attribute path {path!r}",
@@ -92,6 +103,8 @@ class CypressTree:
                               code=EErrorCode.NoSuchNode,
                               attributes={"path": path})
             node = child
+            if follow_links and node.type == "link":
+                node = self.resolve(node.attributes["target_path"])
         return node
 
     def try_resolve(self, path: str) -> Optional[CypressNode]:
@@ -107,6 +120,10 @@ class CypressTree:
             node = node.children.get(token)
             if node is None:
                 return False
+            if node.type == "link":
+                node = self.try_resolve(node.attributes["target_path"])
+                if node is None:
+                    return False
         if attr is not None:
             return _attr_exists(node, attr)
         return True
@@ -197,6 +214,64 @@ class CypressTree:
         else:
             node.value = value
 
+    def copy(self, src_path: str, dst_path: str,
+             recursive: bool = False) -> str:
+        """Deep-copy a subtree (nodes get fresh ids; attributes copied).
+        Copying a link copies the LINK, not its target."""
+        node = self.resolve(src_path, follow_links=False)
+        cloned = _clone(node)
+        self._attach(dst_path, cloned, recursive)
+        return cloned.id
+
+    def move(self, src_path: str, dst_path: str,
+             recursive: bool = False) -> str:
+        """Atomic move: the destination is validated and prepared BEFORE the
+        source detaches, so a failing move leaves the tree untouched."""
+        node = self.resolve(src_path, follow_links=False)
+        attach = self._prepare_attach(dst_path, recursive)
+        self.remove(src_path)
+        attach(node)
+        return node.id
+
+    def link(self, target_path: str, link_path: str,
+             recursive: bool = False) -> str:
+        """Symlink node storing its target path (resolved on access)."""
+        self.resolve(target_path)          # must exist
+        return self.create(link_path, "link", recursive=recursive,
+                           attributes={"target_path": target_path})
+
+    def _attach(self, path: str, node: CypressNode,
+                recursive: bool) -> None:
+        self._prepare_attach(path, recursive)(node)
+
+    def _prepare_attach(self, path: str, recursive: bool):
+        """Validate + create intermediates; return a closure that attaches a
+        node (all failure modes fire BEFORE any caller-side detach)."""
+        tokens, attr = parse_ypath(path)
+        if attr is not None or not tokens:
+            raise YtError(f"Cannot attach at {path!r}",
+                          code=EErrorCode.ResolveError)
+        parent = self.root
+        for token in tokens[:-1]:
+            if parent.type != "map_node":
+                raise YtError(f"Cannot traverse {parent.type} node")
+            child = parent.children.get(token)
+            if child is None:
+                if not recursive:
+                    raise YtError(f"Missing parent {token!r} for {path!r}",
+                                  code=EErrorCode.NoSuchNode)
+                child = CypressNode(id=uuid.uuid4().hex, type="map_node")
+                parent.children[token] = child
+            parent = child
+        name = tokens[-1]
+        if name in parent.children:
+            raise YtError(f"Node {path!r} already exists",
+                          code=EErrorCode.AlreadyExists)
+
+        def attach(node: CypressNode) -> None:
+            parent.children[name] = node
+        return attach
+
     # -- reads -----------------------------------------------------------------
 
     def get(self, path: str, attributes: Optional[list[str]] = None) -> Any:
@@ -208,6 +283,8 @@ class CypressTree:
                 raise YtError(f"No such node {path!r}",
                               code=EErrorCode.NoSuchNode)
             node = child
+            if node.type == "link":
+                node = self.resolve(node.attributes["target_path"])
         if attr is not None:
             return _attr_get(node, attr)
         return node.to_dict()
