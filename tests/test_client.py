@@ -713,3 +713,53 @@ def test_mixed_width_computed_keys(client):
     assert rows[0]["v"] == 3 and rows[1]["v"] == 2
     with pytest.raises(YtError):
         client.lookup_rows("//dyn/mix", [(1, 2, 3)])         # bad width
+
+
+def test_collect_garbage(client):
+    client.write_table("//g/t", [{"x": 1}])
+    client.write_table("//g/t", [{"x": 2}])      # overwrite orphans chunk 1
+    client.create("table", "//g/d", recursive=True,
+                  attributes={"schema": DYN_SCHEMA, "dynamic": True})
+    client.mount_table("//g/d")
+    client.insert_rows("//g/d", [{"key": 1, "value": "live"}])
+    client.freeze_table("//g/d")                 # runtime tablet chunk
+    n_before = len(client.cluster.chunk_store.list_chunks())
+    removed = client.collect_garbage()
+    assert removed >= 1                          # the orphaned overwrite chunk
+    # Everything still referenced survives and reads fine.
+    assert client.read_table("//g/t") == [{"x": 2}]
+    assert client.lookup_rows("//g/d", [(1,)])[0]["value"] == b"live"
+    assert len(client.cluster.chunk_store.list_chunks()) == n_before - removed
+    # Second sweep removes nothing.
+    assert client.collect_garbage() == 0
+
+
+def test_gc_refuses_during_operations(client):
+    import threading
+    client.write_table("//g/in", [{"x": i} for i in range(5)])
+    gate = threading.Event()
+
+    def slow_mapper(rows):
+        gate.wait(5)
+        return [{"y": r["x"]} for r in rows]
+
+    op = client.scheduler.start_operation(
+        "map", {"mapper": slow_mapper, "input_table_path": "//g/in",
+                "output_table_path": "//g/out"}, sync=False)
+    try:
+        import time
+        for _ in range(50):
+            if op.state == "running":
+                break
+            time.sleep(0.05)
+        with pytest.raises(YtError):
+            client.collect_garbage()
+    finally:
+        gate.set()
+    for _ in range(100):
+        if op.state == "completed":
+            break
+        import time
+        time.sleep(0.05)
+    assert op.state == "completed"
+    client.collect_garbage()       # fine once idle

@@ -46,6 +46,17 @@ class YtCluster:
         self.tablets: dict[str, list[Tablet]] = {}   # node id → tablets
 
 
+def _normalize_per_tablet(ids) -> "list[list[str]]":
+    """tablet_chunk_ids layout: nested per-tablet lists; migrate the old
+    flat layout.  THE one normalizer — GC correctness depends on every
+    reader agreeing (a missed variant mis-marks chunks unreferenced)."""
+    if not ids:
+        return []
+    if isinstance(ids[0], str):
+        return [list(ids)]
+    return [list(sub) for sub in ids]
+
+
 class YtClient:
     def __init__(self, cluster: YtCluster):
         self.cluster = cluster
@@ -86,10 +97,11 @@ class YtClient:
 
     def copy(self, src_path: str, dst_path: str,
              recursive: bool = False) -> str:
-        """Deep-copy a subtree.  Static-table chunks are shared by reference
-        (they are never deleted); dynamic-table chunks are physically
-        duplicated because compaction/reshard delete the source's chunk
-        files.  Mounted dynamic tables must unmount first."""
+        """Deep-copy a subtree.  Static-table chunks are shared by
+        reference (never deleted while ANY table references them — the GC
+        counts both copies); dynamic-table chunks are physically duplicated
+        because compaction/reshard delete the source's chunk files.
+        Mounted dynamic tables must unmount first."""
         src_node = self.cluster.master.tree.try_resolve(src_path)
         if src_node is not None:
             stack = [src_node]
@@ -116,9 +128,8 @@ class YtClient:
         while stack:
             node_path, current = stack.pop()
             if current.type == "table" and current.attributes.get("dynamic"):
-                per_tablet = current.attributes.get("tablet_chunk_ids", [])
-                if per_tablet and isinstance(per_tablet[0], str):
-                    per_tablet = [per_tablet]
+                per_tablet = _normalize_per_tablet(
+                    current.attributes.get("tablet_chunk_ids", []))
                 fresh = []
                 for ids in per_tablet:
                     fresh.append([
@@ -158,6 +169,42 @@ class YtClient:
                 stack.extend(current.children.values())
         self.cluster.master.commit_mutation(
             "remove", path=path, recursive=recursive, force=force)
+
+    def collect_garbage(self) -> int:
+        """Remove chunk files referenced by no table (ref: the master's
+        object GC sweeping unreferenced chunks, object_server).  Returns the
+        number of chunks removed.  Runtime tablet state counts as a
+        reference (mounted tables may hold chunks not yet persisted), and
+        the sweep refuses to run while operations are in flight — a
+        controller writes chunk files before publishing @chunk_ids."""
+        for op in self.scheduler.list_operations():
+            if op.state in ("pending", "running"):
+                raise YtError(
+                    f"Cannot collect garbage while operation {op.id} is "
+                    f"{op.state}", code=EErrorCode.OperationFailed)
+        referenced: set = set()
+
+        stack = [self.cluster.master.tree.root]
+        while stack:
+            node = stack.pop()
+            if node.type == "table":
+                referenced.update(node.attributes.get("chunk_ids", []))
+                for sub in _normalize_per_tablet(
+                        node.attributes.get("tablet_chunk_ids", [])):
+                    referenced.update(sub)
+                state = node.attributes.get("ordered_state") or {}
+                referenced.update(state.get("chunk_ids", []))
+            stack.extend(node.children.values())
+        for tablets in self.cluster.tablets.values():
+            for tablet in tablets:
+                referenced.update(tablet.chunk_ids)
+        removed = 0
+        for cid in self.cluster.chunk_store.list_chunks():
+            if cid not in referenced:
+                self.cluster.chunk_store.remove_chunk(cid)
+                self.cluster.chunk_cache.invalidate(cid)
+                removed += 1
+        return removed
 
     # ------------------------------------------------------------- static tables
 
@@ -234,9 +281,8 @@ class YtClient:
             # One tablet per pivot range (ref: tablet pivot keys,
             # server/master/tablet_server; partition.h range sharding).
             pivots = [tuple(p) for p in node.attributes.get("pivot_keys", [])]
-            per_tablet = node.attributes.get("tablet_chunk_ids", [])
-            if per_tablet and isinstance(per_tablet[0], str):
-                per_tablet = [per_tablet]      # migrate pre-reshard layout
+            per_tablet = _normalize_per_tablet(
+                node.attributes.get("tablet_chunk_ids", []))
             tablets = []
             for i in range(len(pivots) + 1):
                 tablet = Tablet(schema, self.cluster.chunk_store,
@@ -323,9 +369,8 @@ class YtClient:
             raise YtError("Pivot keys must be strictly increasing")
 
         # Redistribute existing versioned chunks into the new ranges.
-        old = node.attributes.get("tablet_chunk_ids", [])
-        if old and isinstance(old[0], str):
-            old = [old]
+        old = _normalize_per_tablet(
+            node.attributes.get("tablet_chunk_ids", []))
         all_rows: list[dict] = []
         for ids in old:
             for cid in ids:
