@@ -42,7 +42,10 @@ def sort_key_planes(data: jax.Array, valid: jax.Array,
 
 
 def lexsort_indices(key_planes: list[jax.Array]) -> jax.Array:
-    """Stable ascending argsort over multiple key planes (major key LAST)."""
+    """Stable ascending argsort over multiple key planes (major key LAST).
+
+    (jnp.lexsort already lowers to ONE variadic lax.sort with a composite
+    comparator in current JAX — do not hand-roll it.)"""
     return jnp.lexsort(key_planes)
 
 
