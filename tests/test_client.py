@@ -763,3 +763,51 @@ def test_gc_refuses_during_operations(client):
         time.sleep(0.05)
     assert op.state == "completed"
     client.collect_garbage()       # fine once idle
+
+
+def test_driver_command_registry(client):
+    from ytsaurus_tpu.driver import COMMANDS, Driver
+    d = Driver(client)
+    d.execute("create", {"type": "map_node", "path": "//drv",
+                         "recursive": True})
+    d.execute("write_table", {"path": "//drv/t",
+                              "rows": [{"x": 1}, {"x": 2}]})
+    assert d.execute("read_table", {"path": "//drv/t"}) == \
+        [{"x": 1}, {"x": 2}]
+    op_id = d.execute("sort", {"input_table_path": "//drv/t",
+                               "output_table_path": "//drv/sorted",
+                               "sort_by": "x"})
+    assert d.execute("get_operation",
+                     {"operation_id": op_id})["state"] == "completed"
+    rows = d.execute("select_rows",
+                     {"query": "sum(x) AS s FROM [//drv/sorted] "
+                               "GROUP BY 1 AS o"})
+    assert rows == [{"s": 3}]
+    assert d.execute("exists", {"path": "//drv/sorted"})
+    with pytest.raises(YtError):
+        d.execute("nonexistent_command")
+    with pytest.raises(YtError):
+        d.execute("get", {})                       # missing path
+    with pytest.raises(YtError):
+        d.execute("get", {"path": "//drv", "bogus": 1})
+    # registry is the API surface: mutating flags are present
+    assert COMMANDS["select_rows"].is_mutating is False
+    assert COMMANDS["insert_rows"].is_mutating is True
+
+
+def test_required_columns_enforced(client):
+    schema = TableSchema.make([
+        {"name": "k", "type": "int64", "sort_order": "ascending",
+         "required": True},
+        {"name": "v", "type": "string", "required": True},
+    ], unique_keys=True)
+    with pytest.raises(YtError):
+        client.write_table("//req/static", [{"k": 1, "v": None}],
+                           schema=schema.to_unsorted())
+    client.create("table", "//req/d", recursive=True,
+                  attributes={"schema": schema, "dynamic": True})
+    client.mount_table("//req/d")
+    with pytest.raises(YtError):
+        client.insert_rows("//req/d", [{"k": 1}])     # missing required v
+    client.insert_rows("//req/d", [{"k": 1, "v": "ok"}])
+    assert client.lookup_rows("//req/d", [(1,)])[0]["v"] == b"ok"

@@ -204,3 +204,40 @@ def test_write_missing_value_column_becomes_null(tablet, txm):
     (row,) = tablet.lookup_rows([(1,)])
     # Full-row write semantics: unspecified value columns become null.
     assert row == {"key": 1, "value": b"partial", "amount": None}
+
+
+def test_batch_required_validation_is_all_or_nothing(tablet, txm):
+    import dataclasses
+    schema = dataclasses.replace(
+        SCHEMA, columns=tuple(
+            dataclasses.replace(c, required=(c.name == "value"))
+            for c in SCHEMA.columns))
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    import tempfile
+    t = Tablet(schema, FsChunkStore(tempfile.mkdtemp()))
+    tx = txm.start()
+    with pytest.raises(YtError):
+        txm.write_rows(tx, t, [{"key": 1, "value": "ok"},
+                               {"key": 2, "value": None}])
+    # Nothing was recorded: commit applies zero rows.
+    txm.commit(tx)
+    assert t.lookup_rows([(1,), (2,)]) == [None, None]
+
+
+def test_commit_to_unmounted_participant_applies_nothing(tmp_path, txm):
+    t1 = Tablet(SCHEMA, FsChunkStore(str(tmp_path / "x")), tablet_id="x")
+    t2 = Tablet(SCHEMA, FsChunkStore(str(tmp_path / "y")), tablet_id="y")
+    tx = txm.start()
+    txm.write_rows(tx, t1, [{"key": 1, "value": "a", "amount": 1}])
+    txm.write_rows(tx, t2, [{"key": 2, "value": "b", "amount": 2}])
+    t2.mounted = False
+    with pytest.raises(YtError):
+        txm.commit(tx)
+    # Atomicity: the mounted participant must not have applied either.
+    assert t1.lookup_rows([(1,)]) == [None]
+    # And locks are free for a new transaction.
+    t2.mounted = True
+    tx2 = txm.start()
+    txm.write_rows(tx2, t1, [{"key": 1, "value": "c", "amount": 3}])
+    txm.commit(tx2)
+    assert t1.lookup_rows([(1,)])[0]["value"] == b"c"

@@ -166,6 +166,12 @@ class ColumnarChunk:
             name = col_schema.name
             ty = col_schema.type
             values = per_col[name]
+            if col_schema.required:
+                for i, v in enumerate(values):
+                    if v is None:
+                        raise YtError(
+                            f"Required column {name!r} is null in row {i}",
+                            code=EErrorCode.QueryTypeError)
             columns[name] = _build_column(ty, values, cap)
         return ColumnarChunk(schema=schema, row_count=n, columns=columns)
 

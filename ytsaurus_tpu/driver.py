@@ -1,0 +1,144 @@
+"""Driver: string command name + parameters → typed client call.
+
+Ref: the reference driver command registry (client/driver/driver.cpp:121) —
+one table of command descriptors shared by every protocol front end (CLI,
+HTTP proxy).  `execute(command, parameters)` dispatches onto YtClient; the
+registry doubles as the machine-readable API surface list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+
+@dataclass(frozen=True)
+class CommandDescriptor:
+    name: str
+    required: tuple[str, ...]
+    optional: tuple[str, ...]
+    is_mutating: bool
+    invoke: Callable
+
+
+def _d(name, required, optional, mutating, invoke):
+    return CommandDescriptor(name=name, required=tuple(required),
+                             optional=tuple(optional), is_mutating=mutating,
+                             invoke=invoke)
+
+
+def _registry() -> dict[str, CommandDescriptor]:
+    c: dict[str, CommandDescriptor] = {}
+    for d in [
+        # cypress
+        _d("create", ("type", "path"), ("attributes", "recursive",
+                                        "ignore_existing"), True,
+           lambda cl, p: cl.create(p["type"], p["path"],
+                                   attributes=p.get("attributes"),
+                                   recursive=p.get("recursive", False),
+                                   ignore_existing=p.get("ignore_existing",
+                                                         False))),
+        _d("get", ("path",), (), False, lambda cl, p: cl.get(p["path"])),
+        _d("set", ("path", "value"), (), True,
+           lambda cl, p: cl.set(p["path"], p["value"])),
+        _d("exists", ("path",), (), False,
+           lambda cl, p: cl.exists(p["path"])),
+        _d("list", ("path",), (), False, lambda cl, p: cl.list(p["path"])),
+        _d("remove", ("path",), ("recursive", "force"), True,
+           lambda cl, p: cl.remove(p["path"],
+                                   recursive=p.get("recursive", True),
+                                   force=p.get("force", False))),
+        _d("copy", ("source_path", "destination_path"), ("recursive",), True,
+           lambda cl, p: cl.copy(p["source_path"], p["destination_path"],
+                                 recursive=p.get("recursive", False))),
+        _d("move", ("source_path", "destination_path"), ("recursive",), True,
+           lambda cl, p: cl.move(p["source_path"], p["destination_path"],
+                                 recursive=p.get("recursive", False))),
+        _d("link", ("target_path", "link_path"), ("recursive",), True,
+           lambda cl, p: cl.link(p["target_path"], p["link_path"],
+                                 recursive=p.get("recursive", False))),
+        # static tables
+        _d("write_table", ("path", "rows"), ("append", "schema", "format"),
+           True,
+           lambda cl, p: cl.write_table(p["path"], p["rows"],
+                                        append=p.get("append", False),
+                                        schema=p.get("schema"),
+                                        format=p.get("format"))),
+        _d("read_table", ("path",), ("format",), False,
+           lambda cl, p: cl.read_table(p["path"], format=p.get("format"))),
+        # dynamic tables
+        _d("mount_table", ("path",), (), True,
+           lambda cl, p: cl.mount_table(p["path"])),
+        _d("unmount_table", ("path",), (), True,
+           lambda cl, p: cl.unmount_table(p["path"])),
+        _d("freeze_table", ("path",), (), True,
+           lambda cl, p: cl.freeze_table(p["path"])),
+        _d("reshard_table", ("path", "pivot_keys"), (), True,
+           lambda cl, p: cl.reshard_table(p["path"], p["pivot_keys"])),
+        _d("insert_rows", ("path", "rows"), (), True,
+           lambda cl, p: cl.insert_rows(p["path"], p["rows"])),
+        _d("delete_rows", ("path", "keys"), (), True,
+           lambda cl, p: cl.delete_rows(p["path"], p["keys"])),
+        _d("lookup_rows", ("path", "keys"), ("column_names", "timestamp"),
+           False,
+           lambda cl, p: cl.lookup_rows(
+               p["path"], p["keys"],
+               **({"timestamp": p["timestamp"]} if "timestamp" in p else {}),
+               column_names=p.get("column_names"))),
+        _d("select_rows", ("query",), (), False,
+           lambda cl, p: cl.select_rows(p["query"])),
+        _d("trim_rows", ("path", "trimmed_row_count"), (), True,
+           lambda cl, p: cl.trim_rows(p["path"], p["trimmed_row_count"])),
+        # operations
+        _d("sort", ("input_table_path", "output_table_path", "sort_by"), (),
+           True,
+           lambda cl, p: cl.run_sort(p["input_table_path"],
+                                     p["output_table_path"],
+                                     p["sort_by"]).id),
+        _d("merge", ("input_table_paths", "output_table_path"), ("mode",),
+           True,
+           lambda cl, p: cl.run_merge(p["input_table_paths"],
+                                      p["output_table_path"],
+                                      mode=p.get("mode", "unordered")).id),
+        _d("erase", ("table_path",), (), True,
+           lambda cl, p: cl.run_erase(p["table_path"]).id),
+        _d("get_operation", ("operation_id",), (), False,
+           lambda cl, p: (lambda op: {"id": op.id, "state": op.state,
+                                      "type": op.type})(
+               cl.scheduler.get_operation(p["operation_id"]))),
+    ]:
+        c[d.name] = d
+    return c
+
+
+COMMANDS = _registry()
+
+
+class Driver:
+    """Executes named commands against a client (ref IDriver::Execute)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def execute(self, command: str, parameters: Optional[dict] = None) -> Any:
+        descriptor = COMMANDS.get(command)
+        if descriptor is None:
+            raise YtError(f"Unknown command {command!r}",
+                          code=EErrorCode.Generic,
+                          attributes={"available": sorted(COMMANDS)})
+        parameters = dict(parameters or {})
+        missing = [name for name in descriptor.required
+                   if name not in parameters]
+        if missing:
+            raise YtError(
+                f"Command {command!r} is missing parameters {missing}",
+                code=EErrorCode.Generic)
+        unknown = set(parameters) - set(descriptor.required) \
+            - set(descriptor.optional)
+        if unknown:
+            raise YtError(
+                f"Command {command!r} got unknown parameters "
+                f"{sorted(unknown)}", code=EErrorCode.Generic)
+        return descriptor.invoke(self.client, parameters)

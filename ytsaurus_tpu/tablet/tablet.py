@@ -87,8 +87,17 @@ class Tablet:
         return tuple(_normalize_value(v, c.type)
                      for v, c in zip(key, key_cols))
 
+    def validate_required(self, normalized_row: dict) -> None:
+        """THE required-column check (single source: used by tablets,
+        transactions, and columnar construction paths must agree)."""
+        for c in self.schema:
+            if c.required and normalized_row.get(c.name) is None:
+                raise YtError(f"Required column {c.name!r} is null",
+                              code=EErrorCode.QueryTypeError)
+
     def write_row(self, row: dict, timestamp: int) -> None:
         row = self.normalize_row(row)
+        self.validate_required(row)
         with self._lock:       # a concurrent flush() must not drop the write
             self._check_mounted()
             self.active_store.write_row(row, timestamp)

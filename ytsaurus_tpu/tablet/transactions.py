@@ -68,6 +68,10 @@ class TransactionManager:
                    rows: list[dict]) -> None:
         key = id(tablet)
         self._tablets[key] = tablet
+        # Validate the WHOLE batch before recording anything: a mid-batch
+        # failure must not leave earlier rows recorded in a live tx.
+        for row in rows:
+            tablet.validate_required(tablet.normalize_row(row))
         for row in rows:
             tx._record(key, _Modification("write", dict(row)))
 
@@ -100,7 +104,14 @@ class TransactionManager:
                            if mod.kind == "write" else tuple(mod.row))
                 touched.append((tablet_key, tablet.normalize_key(row_key)))
         with self._lock:
-            # Phase 1: prepare — acquire locks, detect conflicts.
+            # Phase 1: prepare — participants mounted, locks, conflicts.
+            for tablet_key in tx.modifications:
+                tablet = self._tablets[tablet_key]
+                if not tablet.mounted:
+                    tx.state = "aborted"
+                    raise YtError(
+                        f"Tablet {tablet.tablet_id} is not mounted",
+                        code=EErrorCode.TabletNotMounted)
             acquired: list[tuple[int, tuple]] = []
             try:
                 for tablet_key, row_key in touched:
@@ -125,15 +136,24 @@ class TransactionManager:
                 tx.state = "aborted"
                 raise
             # Phase 2: commit at one timestamp on every participant.
+            # Apply errors must still release locks or later transactions
+            # deadlock on stale lock entries; record/prepare-time validation
+            # (required columns, mounted participants) keeps this phase from
+            # half-applying in the cases we can check upfront.
             commit_ts = self.timestamps.generate()
-            for tablet_key, mods in tx.modifications.items():
-                tablet = self._tablets[tablet_key]
-                for mod in mods:
-                    if mod.kind == "write":
-                        tablet.write_row(mod.row, commit_ts)
-                    else:
-                        tablet.delete_row(mod.row, commit_ts)
-            self._release_locks(tx)
+            try:
+                for tablet_key, mods in tx.modifications.items():
+                    tablet = self._tablets[tablet_key]
+                    for mod in mods:
+                        if mod.kind == "write":
+                            tablet.write_row(mod.row, commit_ts)
+                        else:
+                            tablet.delete_row(mod.row, commit_ts)
+            except Exception:
+                tx.state = "aborted"
+                raise
+            finally:
+                self._release_locks(tx)
             tx.state = "committed"
             return commit_ts
 
