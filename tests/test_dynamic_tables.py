@@ -241,3 +241,25 @@ def test_commit_to_unmounted_participant_applies_nothing(tmp_path, txm):
     txm.write_rows(tx2, t1, [{"key": 1, "value": "c", "amount": 3}])
     txm.commit(tx2)
     assert t1.lookup_rows([(1,)])[0]["value"] == b"c"
+
+
+def test_lookup_row_cache(tablet, txm):
+    _insert(txm, tablet, [{"key": i, "value": f"v{i}", "amount": i}
+                          for i in range(10)])
+    tablet.flush()
+    r1 = tablet.lookup_rows([(3,)])[0]
+    assert tablet.row_cache_misses >= 1
+    hits0 = tablet.row_cache_hits
+    r2 = tablet.lookup_rows([(3,)])[0]
+    assert tablet.row_cache_hits == hits0 + 1
+    assert r1 == r2
+    # Writes invalidate: a new value must be visible immediately.
+    _insert(txm, tablet, [{"key": 3, "value": "fresh", "amount": 99}])
+    assert tablet.lookup_rows([(3,)])[0]["value"] == b"fresh"
+    # Column projection applies after the cache (full row cached).
+    narrow = tablet.lookup_rows([(3,)], column_names=["amount"])[0]
+    assert narrow == {"amount": 99}
+    # Timestamped (historical) reads bypass the cache.
+    ts_hit = tablet.row_cache_hits
+    tablet.lookup_rows([(3,)], timestamp=1)
+    assert tablet.row_cache_hits == ts_hit

@@ -17,6 +17,7 @@ vectorized pass, not a per-row k-way heap merge (tablet_reader.cpp:651).
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Optional, Sequence
 
 import numpy as np
@@ -65,6 +66,13 @@ class Tablet:
         self.flush_generation = 0
         self._lock = threading.RLock()
         self._host_planes: dict[str, dict] = {}
+        # Lookup row cache (ref tablet_node/row_cache.h): key → merged row,
+        # valid for one (write, flush) generation only.
+        self._row_cache: "OrderedDict[tuple, Optional[dict]]" = OrderedDict()
+        self._row_cache_gen: tuple = ()
+        self.row_cache_capacity = 4096
+        self.row_cache_hits = 0
+        self.row_cache_misses = 0
 
     # -- write path (called under the transaction manager) ---------------------
 
@@ -249,21 +257,41 @@ class Tablet:
             key_names = self.schema.key_column_names
             out: list[Optional[dict]] = []
             keys = [self.normalize_key(tuple(k)) for k in keys]
+            # The cache only serves latest-timestamp reads and resets when
+            # any store or chunk set changes.
+            generation = (self.active_store.store_row_count,
+                          len(self.passive_stores), self.flush_generation)
+            cacheable = timestamp == MAX_TIMESTAMP
+            if self._row_cache_gen != generation:
+                self._row_cache.clear()
+                self._row_cache_gen = generation
             for key in keys:
-                versions: list[tuple[int, Optional[dict]]] = []
-                for store in [self.active_store] + self.passive_stores:
-                    versions.extend(store.lookup_versions(key))
-                for cid in self.chunk_ids:
-                    versions.extend(_chunk_lookup_versions(
-                        self._decode(cid), self.schema, key,
-                        self._chunk_host_planes(cid)))
-                merged = _merge_versions(versions, timestamp)
-                if merged is None:
-                    out.append(None)
-                    continue
-                row = dict(zip(key_names, key))
-                row.update(merged)
-                if column_names is not None:
+                if cacheable and key in self._row_cache:
+                    self.row_cache_hits += 1
+                    self._row_cache.move_to_end(key)
+                    cached = self._row_cache[key]
+                    row = dict(cached) if cached is not None else None
+                else:
+                    if cacheable:       # bypassing reads skew no metric
+                        self.row_cache_misses += 1
+                    versions: list[tuple[int, Optional[dict]]] = []
+                    for store in [self.active_store] + self.passive_stores:
+                        versions.extend(store.lookup_versions(key))
+                    for cid in self.chunk_ids:
+                        versions.extend(_chunk_lookup_versions(
+                            self._decode(cid), self.schema, key,
+                            self._chunk_host_planes(cid)))
+                    merged = _merge_versions(versions, timestamp)
+                    if merged is None:
+                        row = None
+                    else:
+                        row = dict(zip(key_names, key))
+                        row.update(merged)
+                    if cacheable:
+                        self._row_cache[key] =                             dict(row) if row is not None else None
+                        while len(self._row_cache) > self.row_cache_capacity:
+                            self._row_cache.popitem(last=False)
+                if row is not None and column_names is not None:
                     row = {name: row.get(name) for name in column_names}
                 out.append(row)
             return out
