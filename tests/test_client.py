@@ -811,3 +811,14 @@ def test_required_columns_enforced(client):
         client.insert_rows("//req/d", [{"k": 1}])     # missing required v
     client.insert_rows("//req/d", [{"k": 1, "v": "ok"}])
     assert client.lookup_rows("//req/d", [(1,)])[0]["v"] == b"ok"
+
+
+def test_pruning_null_between_bound_not_pruned(client):
+    # v BETWEEN # AND 1 admits null rows; a chunk whose non-null range is
+    # outside [_, 1] but that contains nulls must still be read.
+    client.write_table(
+        "//tmp/nullb", [{"k": i, "v": None if i % 2 else 5 + i}
+                        for i in range(4)],
+        schema=TableSchema.make([("k", "int64"), ("v", "int64")]))
+    rows = client.select_rows("k FROM [//tmp/nullb] WHERE v BETWEEN # AND 1")
+    assert sorted(r["k"] for r in rows) == [1, 3]

@@ -669,3 +669,46 @@ def test_argmax_nan_by_key_does_not_compete():
              {T: ([("k", "int64", "ascending"), ("g", "int64"),
                    ("s", "string"), ("d", "double")], rows)},
              [{"g": 0, "top": "better"}])
+
+
+# --- null tuple elements in IN / BETWEEN / TRANSFORM --------------------------
+# Reference semantics (CompareRowValues): null == null, null sorts first.
+
+NULLABLE = {T: ([("k", "int64", "ascending"), ("v", "int64")],
+                [(0, 0), (1, None), (2, 7), (3, None), (4, 1)])}
+
+
+def test_in_null_element_matches_only_null_rows():
+    # A null tuple element must NOT match v = 0 rows; it matches null rows.
+    evaluate(f"k FROM [{T}] WHERE v IN (7, #)", NULLABLE,
+             [{"k": 1}, {"k": 2}, {"k": 3}])
+
+
+def test_in_null_only_tuple():
+    evaluate(f"k FROM [{T}] WHERE v IN (#)", NULLABLE,
+             [{"k": 1}, {"k": 3}])
+
+
+def test_in_no_null_still_excludes_null_rows():
+    evaluate(f"k FROM [{T}] WHERE v IN (0, 1)", NULLABLE,
+             [{"k": 0}, {"k": 4}])
+
+
+def test_in_string_null_element():
+    rows = [(1, "a"), (2, None), (3, "b")]
+    tables = {T: ([("k", "int64", "ascending"), ("s", "string")], rows)}
+    evaluate(f"k FROM [{T}] WHERE s IN ('a', #)", tables,
+             [{"k": 1}, {"k": 2}])
+
+
+def test_between_null_lower_bound_matches_null_rows():
+    # null sorts before every value: BETWEEN # AND 1 covers nulls, 0, 1.
+    evaluate(f"k FROM [{T}] WHERE v BETWEEN # AND 1", NULLABLE,
+             [{"k": 0}, {"k": 1}, {"k": 3}, {"k": 4}])
+
+
+def test_transform_null_from_value():
+    evaluate(f"k, transform(v, (7, #), (100, 200)) AS t FROM [{T}]",
+             NULLABLE,
+             [{"k": 0, "t": None}, {"k": 1, "t": 200}, {"k": 2, "t": 100},
+              {"k": 3, "t": 200}, {"k": 4, "t": None}])

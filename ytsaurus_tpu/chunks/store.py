@@ -214,10 +214,14 @@ class ChunkCache:
         return chunk
 
     def _evict_locked(self) -> None:
-        # Pinned entries (in-memory mode tables) never evict.
+        # Pinned entries (in-memory mode tables) never evict.  The newest
+        # entry (just inserted, still being returned to a caller) survives,
+        # so the cache may overshoot by exactly one chunk's working set.
         evictable = [cid for cid in self._entries if cid not in self._pinned]
-        while self._used > self.capacity_bytes and len(evictable) > 1:
-            victim = evictable.pop(0)
+        i = 0
+        while self._used > self.capacity_bytes and i < len(evictable) - 1:
+            victim = evictable[i]
+            i += 1
             _, size = self._entries.pop(victim)
             self._used -= size
 

@@ -41,6 +41,7 @@ class EErrorCode(enum.IntEnum):
     TabletNotMounted = 1702
     RowIsBlocked = 1703
     TransactionAborted = 1704
+    InvalidTransactionState = 1705
 
     # Scheduler / operations.
     NoSuchOperation = 1800

@@ -530,23 +530,24 @@ class ExprBinder:
 
     def _bind_TIn(self, node: ir.TIn) -> BoundExpr:
         operands = [self.bind(o) for o in node.operands]
-        value_planes = self._bind_value_tuples(operands,
-                                               node.values)
+        value_planes, value_valids = self._bind_value_tuples(operands,
+                                                             node.values)
 
         def emit(ctx):
             op_planes = [o.emit(ctx) for o in operands]
-            all_valid = op_planes[0][1]
-            for _, v in op_planes[1:]:
-                all_valid = all_valid & v
             match_any = jnp.zeros(ctx.capacity, dtype=bool)
             n_values = len(node.values)
             for vi in range(n_values):
                 row_match = jnp.ones(ctx.capacity, dtype=bool)
                 for oi, (data, valid) in enumerate(op_planes):
                     const = ctx.bindings[value_planes[oi]][vi]
-                    row_match = row_match & (data == const)
+                    cvalid = ctx.bindings[value_valids[oi]][vi]
+                    # null element matches null rows; non-null matches equal
+                    # valid rows (null == null per CompareRowValues).
+                    row_match = row_match & jnp.where(
+                        cvalid, valid & (data == const), ~valid)
                 match_any = match_any | row_match
-            return match_any & all_valid, jnp.ones(ctx.capacity, dtype=bool)
+            return match_any, jnp.ones(ctx.capacity, dtype=bool)
         return BoundExpr(type=EValueType.boolean, vocab=None, emit=emit)
 
     def _bind_TBetween(self, node: ir.TBetween) -> BoundExpr:
@@ -559,9 +560,6 @@ class ExprBinder:
 
         def emit(ctx):
             op_planes = [o.emit(ctx) for o in operands]
-            all_valid = op_planes[0][1]
-            for _, v in op_planes[1:]:
-                all_valid = all_valid & v
             in_any = jnp.zeros(ctx.capacity, dtype=bool)
             for lo_len, lo_slots, up_len, up_slots in bound_ranges:
                 ge = _lex_compare(ctx, op_planes[:lo_len], lo_slots, 0, ">=")
@@ -570,12 +568,13 @@ class ExprBinder:
             result = in_any
             if node.negated:
                 result = ~result
-            return result & all_valid, jnp.ones(ctx.capacity, dtype=bool)
+            return result, jnp.ones(ctx.capacity, dtype=bool)
         return BoundExpr(type=EValueType.boolean, vocab=None, emit=emit)
 
     def _bind_TTransform(self, node: ir.TTransform) -> BoundExpr:
         operands = [self.bind(o) for o in node.operands]
-        from_slots = self._bind_value_tuples(operands, node.from_values)
+        from_slots, from_valids = self._bind_value_tuples(
+            operands, node.from_values)
         default = self.bind(node.default) if node.default is not None else None
 
         # Output values (may be strings → need an output vocab).
@@ -611,9 +610,6 @@ class ExprBinder:
 
         def emit(ctx):
             op_planes = [o.emit(ctx) for o in operands]
-            all_valid = op_planes[0][1]
-            for _, v in op_planes[1:]:
-                all_valid = all_valid & v
             n_values = len(node.from_values)
             # Find first matching from-tuple per row.
             match_idx = jnp.full(ctx.capacity, n_values, dtype=jnp.int32)
@@ -621,8 +617,10 @@ class ExprBinder:
                 row_match = jnp.ones(ctx.capacity, dtype=bool)
                 for oi, (data, valid) in enumerate(op_planes):
                     const = ctx.bindings[from_slots[oi]][vi]
-                    row_match = row_match & (data == const)
-                match_idx = jnp.where(row_match & all_valid, vi, match_idx)
+                    cvalid = ctx.bindings[from_valids[oi]][vi]
+                    row_match = row_match & jnp.where(
+                        cvalid, valid & (data == const), ~valid)
+                match_idx = jnp.where(row_match, vi, match_idx)
             matched = match_idx < n_values
             safe_idx = jnp.clip(match_idx, 0, max(n_values - 1, 0))
             to_table = ctx.bindings[to_slot]
@@ -640,10 +638,14 @@ class ExprBinder:
         return BoundExpr(type=node.type, vocab=out_vocab, emit=emit)
 
     def _bind_value_tuples(self, operands: list[BoundExpr],
-                           values) -> list[int]:
-        """Bind literal tuples column-wise; returns one binding slot per
-        operand holding the per-tuple constants (strings → codes, -1 absent)."""
+                           values) -> tuple[list[int], list[int]]:
+        """Bind literal tuples column-wise; returns (value_slots, valid_slots)
+        — one binding slot per operand holding the per-tuple constants
+        (strings → codes) plus one holding the per-tuple element validity
+        (False where the literal is null), so null tuple elements match null
+        rows and nothing else (CompareRowValues semantics: null == null)."""
         slots = []
+        valid_slots = []
         for oi, operand in enumerate(operands):
             col = [tup[oi] if oi < len(tup) else None for tup in values]
             if operand.type is EValueType.string:
@@ -656,10 +658,13 @@ class ExprBinder:
                     else np.int64
                 arr = np.array([v if v is not None else 0 for v in col],
                                dtype=dt)
+            ok = np.array([v is not None for v in col], dtype=bool)
             if len(arr) == 0:
                 arr = np.zeros(1, dtype=arr.dtype)
+                ok = np.zeros(1, dtype=bool)
             slots.append(self.ctx.add(jnp.asarray(arr)))
-        return slots
+            valid_slots.append(self.ctx.add(jnp.asarray(ok)))
+        return slots, valid_slots
 
     # -- string predicates -----------------------------------------------------
 
@@ -709,21 +714,26 @@ def _promote_pair(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
     return a.astype(target), b.astype(target)
 
 
-def _lex_compare(ctx: EmitContext, op_planes, slots: list[int], vi: int,
+def _lex_compare(ctx: EmitContext, op_planes, slots, vi: int,
                  op: str) -> jax.Array:
-    """Lexicographic tuple comparison against bound constants (tuple index vi)."""
+    """Lexicographic tuple comparison against bound constants (tuple index vi).
+    Null-aware: null sorts before every value and equals null (the
+    CompareRowValues total order)."""
+    value_slots, valid_slots = slots
     cap = ctx.capacity
     result = jnp.full(cap, op in ("<=", ">="), dtype=bool)
     # Build from least-significant operand backwards:
     for oi in range(len(op_planes) - 1, -1, -1):
-        data, _ = op_planes[oi]
-        const = ctx.bindings[slots[oi]][vi]
-        eq = data == const
+        data, valid = op_planes[oi]
+        const = ctx.bindings[value_slots[oi]][vi]
+        cvalid = ctx.bindings[valid_slots[oi]][vi]
+        eq = jnp.where(cvalid, valid & (data == const), ~valid)
         if op in ("<=", "<"):
-            lt = data < const
+            lt = jnp.where(cvalid, (~valid) | (data < const),
+                           jnp.zeros(cap, dtype=bool))
             result = lt | (eq & result)
         else:
-            gt = data > const
+            gt = jnp.where(cvalid, valid & (data > const), valid)
             result = gt | (eq & result)
     return result
 

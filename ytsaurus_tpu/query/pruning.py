@@ -92,7 +92,10 @@ def extract_column_intervals(where: Optional[ir.TExpr]) -> dict[str, Interval]:
                 len(e.operands) == 1 and len(e.ranges) == 1 and \
                 isinstance(e.operands[0], ir.TReference):
             (lower, upper) = e.ranges[0]
-            if len(lower) == 1 and len(upper) == 1:
+            # Null bounds admit null rows (null sorts first), which min/max
+            # stats over non-null values cannot prune — no constraint.
+            if len(lower) == 1 and len(upper) == 1 and \
+                    lower[0] is not None and upper[0] is not None:
                 name = e.operands[0].name
                 iv = out.setdefault(name, Interval())
                 out[name] = iv.narrow(Interval(lo=lower[0], hi=upper[0]))

@@ -84,6 +84,9 @@ class TransactionManager:
 
     def abort(self, tx: TabletTransaction) -> None:
         with self._lock:
+            if tx.state == "committing":
+                raise YtError(f"Transaction {tx.id} is committing",
+                              code=EErrorCode.InvalidTransactionState)
             self._release_locks(tx)
             tx.state = "aborted"
 
@@ -93,6 +96,9 @@ class TransactionManager:
         """Prepare (lock + conflict check on every participant), then commit
         at a fresh timestamp.  Raises TransactionLockConflict and aborts on
         any conflict."""
+        # Build the touched-key list BEFORE the state transition: key
+        # normalization can raise on malformed client input, and that must
+        # leave the tx abortable (still 'active'), not stuck 'committing'.
         if tx.state != "active":
             raise YtError(f"Transaction {tx.id} is {tx.state}",
                           code=EErrorCode.NoSuchTransaction)
@@ -104,6 +110,12 @@ class TransactionManager:
                            if mod.kind == "write" else tuple(mod.row))
                 touched.append((tablet_key, tablet.normalize_key(row_key)))
         with self._lock:
+            # Exclusive 'committing' transition under the lock: a concurrent
+            # commit/abort of the same tx must fail fast, not apply twice.
+            if tx.state != "active":
+                raise YtError(f"Transaction {tx.id} is {tx.state}",
+                              code=EErrorCode.NoSuchTransaction)
+            tx.state = "committing"
             # Phase 1: prepare — participants mounted, locks, conflicts.
             for tablet_key in tx.modifications:
                 tablet = self._tablets[tablet_key]

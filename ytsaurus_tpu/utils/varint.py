@@ -38,3 +38,5 @@ def read_varint_u(data: bytes, pos: int) -> tuple[int, int]:
         if not byte & 0x80:
             return result, pos
         shift += 7
+        if shift > 63:
+            raise ValueError("varint too long (more than 64 bits)")
