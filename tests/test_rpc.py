@@ -1,0 +1,157 @@
+"""Bus/RPC plane tests: framing, services, errors, concurrency, retries.
+
+Mirrors the reference's core/rpc/unittests coverage shape (in-process TCP
+loopback service, error propagation, method limits) against the redesigned
+asyncio bus.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.rpc import Channel, RetryingChannel, RpcServer, Service, \
+    rpc_method
+from ytsaurus_tpu.rpc.packet import PacketError, encode_packet
+
+
+class EchoService(Service):
+    name = "echo"
+
+    @rpc_method()
+    def echo(self, body, attachments):
+        return {"echo": body.get("value")}, [bytes(a) for a in attachments]
+
+    @rpc_method()
+    def fail(self, body, attachments):
+        raise YtError("intentional", code=EErrorCode.NoSuchNode,
+                      attributes={"path": "//tmp/x"})
+
+    @rpc_method()
+    def crash(self, body, attachments):
+        raise RuntimeError("boom")
+
+    @rpc_method(concurrency=2)
+    def slow(self, body, attachments):
+        time.sleep(float(body.get("delay", 0.2)))
+        return {"done": True}
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = RpcServer([EchoService()])
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def channel(server):
+    ch = Channel(server.address, timeout=30)
+    yield ch
+    ch.close()
+
+
+def test_echo_roundtrip(channel):
+    body, attachments = channel.call(
+        "echo", "echo", {"value": 42}, [b"blob-one", b"\x00" * 1024])
+    assert body["echo"] == 42
+    assert attachments == [b"blob-one", b"\x00" * 1024]
+
+
+def test_error_propagates_code_and_attributes(channel):
+    with pytest.raises(YtError) as ei:
+        channel.call("echo", "fail", {})
+    assert ei.value.code == EErrorCode.NoSuchNode
+    assert ei.value.attributes["path"] == b"//tmp/x"
+    assert "intentional" in ei.value.message
+
+
+def test_unhandled_exception_wrapped(channel):
+    with pytest.raises(YtError) as ei:
+        channel.call("echo", "crash", {})
+    assert "boom" in ei.value.message
+
+
+def test_no_such_method(channel):
+    with pytest.raises(YtError) as ei:
+        channel.call("echo", "nope", {})
+    assert ei.value.code == EErrorCode.NoSuchMethod
+    with pytest.raises(YtError) as ei:
+        channel.call("ghost", "echo", {})
+    assert ei.value.code == EErrorCode.NoSuchService
+
+
+def test_concurrent_calls_multiplex(channel):
+    results = {}
+    def worker(i):
+        body, _ = channel.call("echo", "echo", {"value": i})
+        results[i] = body["echo"]
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {i: i for i in range(16)}
+
+
+def test_slow_calls_do_not_block_fast_ones(server):
+    ch = Channel(server.address, timeout=30)
+    done = []
+    t = threading.Thread(
+        target=lambda: (ch.call("echo", "slow", {"delay": 1.0}),
+                        done.append("slow")))
+    t.start()
+    t0 = time.monotonic()
+    ch.call("echo", "echo", {"value": 1})
+    assert time.monotonic() - t0 < 0.9        # not serialized behind slow
+    t.join()
+    assert done == ["slow"]
+    ch.close()
+
+
+def test_large_attachment(channel):
+    blob = bytes(range(256)) * (1 << 14)      # 4 MiB
+    body, attachments = channel.call("echo", "echo", {"value": 0}, [blob])
+    assert attachments[0] == blob
+
+
+def test_packet_corruption_detected():
+    import asyncio
+    from ytsaurus_tpu.rpc.packet import read_packet
+    raw = bytearray(encode_packet([b"hello", b"world"]))
+    raw[-1] ^= 0xFF                           # flip a byte in the last part
+
+    async def run():
+        reader = asyncio.StreamReader()
+        reader.feed_data(bytes(raw))
+        reader.feed_eof()
+        await read_packet(reader)
+
+    with pytest.raises(PacketError, match="checksum"):
+        asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_retrying_channel_survives_server_restart():
+    svc = EchoService()
+    srv = RpcServer([svc])
+    srv.start()
+    port = srv.port
+    ch = RetryingChannel(Channel(srv.address, timeout=10))
+    assert ch.call("echo", "echo", {"value": 1})[0]["echo"] == 1
+    srv.stop()
+    # Restart on the same port.
+    srv2 = RpcServer([svc], port=port)
+    srv2.start()
+    assert ch.call("echo", "echo", {"value": 2})[0]["echo"] == 2
+    ch.close()
+    srv2.stop()
+
+
+def test_dead_peer_raises_peer_unavailable():
+    ch = RetryingChannel(Channel("127.0.0.1:1", timeout=2), attempts=2,
+                         backoff=0.05)
+    with pytest.raises(YtError) as ei:
+        ch.call("echo", "echo", {})
+    assert ei.value.code == EErrorCode.PeerUnavailable

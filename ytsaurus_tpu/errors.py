@@ -47,6 +47,13 @@ class EErrorCode(enum.IntEnum):
     NoSuchOperation = 1800
     OperationFailed = 1801
 
+    # RPC (ref: yt/yt/core/rpc/public.h EErrorCode).
+    NoSuchMethod = 1900
+    NoSuchService = 1901
+    TransportError = 1902
+    RpcTimeout = 1903
+    PeerUnavailable = 1904
+
 
 class YtError(Exception):
     """An error with a code, attributes and nested inner errors."""

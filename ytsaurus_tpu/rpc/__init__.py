@@ -1,0 +1,27 @@
+"""Host RPC plane: framed multipart packets over TCP + a service/channel
+layer — the control-plane half of the reference's bus/RPC split.
+
+Ref mapping (design, not translation):
+  framed multipart packets w/ per-part checksums  → rpc/packet.py
+    (core/bus/tcp/packet.h:9)
+  TTcpConnection multiplexing                     → rpc/connection.py
+    (core/bus/tcp/connection.h)
+  service method registry + concurrency limits    → rpc/server.py
+    (core/rpc/service_detail.h)
+  retrying channels                               → rpc/channel.py
+    (core/rpc/retrying_channel.h)
+
+The data plane deliberately does NOT ride on this: rowset movement between
+devices is ICI/DCN collectives (parallel/); this bus carries metadata,
+chunk blobs between hosts, and tablet commands.  Bodies are binary YSON;
+bulk bytes travel as zero-copy attachment parts.
+"""
+
+from ytsaurus_tpu.rpc.channel import Channel, RetryingChannel
+from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
+from ytsaurus_tpu.rpc.server import RpcServer, Service, rpc_method
+
+__all__ = [
+    "Channel", "RetryingChannel", "PacketError", "read_packet",
+    "write_packet", "RpcServer", "Service", "rpc_method",
+]

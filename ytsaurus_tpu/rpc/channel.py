@@ -1,0 +1,217 @@
+"""Client channels: synchronous facade over the asyncio bus.
+
+One shared background event-loop thread per process frames packets for all
+channels (the analog of the reference's shared bus thread pool); callers
+block on concurrent.futures handed across the loop boundary.  A
+RetryingChannel wraps transport failures (never application YtErrors) with
+reconnect + backoff, like core/rpc/retrying_channel.h.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import itertools
+import threading
+import time
+
+from ytsaurus_tpu import yson
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
+from ytsaurus_tpu.rpc.server import error_from_wire
+from ytsaurus_tpu.utils.logging import get_logger
+
+logger = get_logger("rpc")
+
+_loop_lock = threading.Lock()
+_loop: asyncio.AbstractEventLoop | None = None
+
+
+def _shared_loop() -> asyncio.AbstractEventLoop:
+    global _loop
+    with _loop_lock:
+        if _loop is None or _loop.is_closed():
+            loop = asyncio.new_event_loop()
+            thread = threading.Thread(
+                target=loop.run_forever, daemon=True, name="rpc-client-loop")
+            thread.start()
+            _loop = loop
+        return _loop
+
+
+class _ConnState:
+    """One live TCP connection: reader pump + pending request futures."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self.pending: dict[int, asyncio.Future] = {}
+        self.task: asyncio.Future | None = None
+        self.alive = True
+
+
+class Channel:
+    """A connection to one RPC endpoint ("host:port")."""
+
+    def __init__(self, address: str, timeout: float = 60.0):
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self.timeout = timeout
+        self._rid = itertools.count(1)
+        self._lock = threading.Lock()
+        self._connect_lock: asyncio.Lock | None = None
+        self._conn: _ConnState | None = None
+
+    # -- wire ------------------------------------------------------------------
+
+    async def _connect(self) -> "_ConnState":
+        reader, writer = await asyncio.open_connection(self._host, self._port)
+        state = _ConnState(reader=reader, writer=writer)
+
+        async def pump():
+            try:
+                while True:
+                    parts = await read_packet(reader)
+                    envelope = yson.loads(parts[0], encoding=None)
+                    rid = int(envelope["rid"])
+                    fut = state.pending.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result((envelope, parts))
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    PacketError, asyncio.CancelledError) as exc:
+                state.alive = False
+                for fut in state.pending.values():
+                    if not fut.done():
+                        fut.set_exception(ConnectionError(str(exc)))
+                state.pending.clear()
+                writer.close()
+
+        state.task = asyncio.ensure_future(pump())
+        return state
+
+    async def _call_async(self, service: str, method: str, body,
+                          attachments, timeout: float):
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            # Serialized: concurrent first calls must share ONE connection
+            # (unserialized, each would open and leak its own socket+pump).
+            with self._lock:
+                state = self._conn
+            if state is None or not state.alive:
+                state = await self._connect()
+                with self._lock:
+                    self._conn = state
+        rid = next(self._rid)
+        fut = asyncio.get_event_loop().create_future()
+        state.pending[rid] = fut
+        # No await between registration and this check, so the pump cannot
+        # have died without either failing our future or being seen here.
+        if not state.alive:
+            state.pending.pop(rid, None)
+            raise ConnectionError("connection lost")
+        envelope = yson.dumps(
+            {"rid": rid, "kind": "req", "service": service,
+             "method": method}, binary=True)
+        wire_body = yson.dumps(body if body is not None else {}, binary=True)
+        try:
+            await write_packet(state.writer, [envelope, wire_body,
+                                              *attachments])
+            envelope, parts = await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            with self._lock:
+                if self._conn is state:
+                    self._conn = None
+            raise
+        except asyncio.TimeoutError:
+            # A timed-out connection is suspect (half-dead peer, stopped
+            # server loop) — drop it so the next attempt reconnects.
+            state.pending.pop(rid, None)
+            state.alive = False
+            state.writer.close()
+            with self._lock:
+                if self._conn is state:
+                    self._conn = None
+            raise YtError(
+                f"RPC {service}.{method} to {self.address} timed out "
+                f"after {timeout}s", code=EErrorCode.RpcTimeout) from None
+        kind = envelope["kind"]
+        if kind == b"err":
+            raise error_from_wire(yson.loads(parts[1], encoding=None))
+        body = yson.loads(parts[1], encoding=None) if len(parts) > 1 else {}
+        return body, list(parts[2:])
+
+    # -- public sync API -------------------------------------------------------
+
+    def call(self, service: str, method: str, body=None,
+             attachments=(), timeout: float | None = None):
+        """Returns (body: dict, attachments: list[bytes]); raises YtError."""
+        timeout = timeout if timeout is not None else self.timeout
+        loop = _shared_loop()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._call_async(service, method, body, list(attachments),
+                             timeout), loop)
+        try:
+            return fut.result(timeout=timeout + 15)
+        except concurrent.futures.TimeoutError as exc:
+            fut.cancel()
+            raise YtError(
+                f"RPC {service}.{method} to {self.address} stalled on the "
+                "client loop", code=EErrorCode.RpcTimeout) from exc
+        except (ConnectionError, OSError, asyncio.IncompleteReadError) as exc:
+            raise YtError(
+                f"transport failure calling {service}.{method} on "
+                f"{self.address}: {exc}",
+                code=EErrorCode.TransportError) from exc
+
+    def close(self) -> None:
+        with self._lock:
+            state, self._conn = self._conn, None
+        if state is not None:
+            loop = _shared_loop()
+            if state.task is not None:
+                loop.call_soon_threadsafe(state.task.cancel)
+            loop.call_soon_threadsafe(state.writer.close)
+
+
+class RetryingChannel:
+    """Retries TRANSPORT failures (peer restarting, dropped connection);
+    application YtErrors pass through untouched."""
+
+    def __init__(self, channel: Channel, attempts: int = 5,
+                 backoff: float = 0.2):
+        self.channel = channel
+        self.attempts = attempts
+        self.backoff = backoff
+
+    @property
+    def address(self) -> str:
+        return self.channel.address
+
+    def call(self, service: str, method: str, body=None,
+             attachments=(), timeout: float | None = None,
+             idempotent: bool = True):
+        last: YtError | None = None
+        for attempt in range(self.attempts):
+            try:
+                return self.channel.call(service, method, body,
+                                         attachments, timeout)
+            except YtError as err:
+                # A timeout is NOT proof of non-execution: only idempotent
+                # calls may be resent after one (non-idempotent mutations
+                # must dedup server-side via mutation ids instead).
+                retryable = (EErrorCode.TransportError,
+                             EErrorCode.RpcTimeout) if idempotent \
+                    else (EErrorCode.TransportError,)
+                if err.code not in retryable:
+                    raise
+                last = err
+                time.sleep(self.backoff * (2 ** attempt))
+        raise YtError(
+            f"RPC to {self.channel.address} failed after "
+            f"{self.attempts} attempts",
+            code=EErrorCode.PeerUnavailable, inner_errors=[last])
+
+    def close(self) -> None:
+        self.channel.close()

@@ -84,8 +84,9 @@ class TransactionManager:
 
     def abort(self, tx: TabletTransaction) -> None:
         with self._lock:
-            if tx.state == "committing":
-                raise YtError(f"Transaction {tx.id} is committing",
+            if tx.state in ("committing", "committed"):
+                # Aborting a committed tx must not mask its durable writes.
+                raise YtError(f"Transaction {tx.id} is {tx.state}",
                               code=EErrorCode.InvalidTransactionState)
             self._release_locks(tx)
             tx.state = "aborted"

@@ -34,8 +34,17 @@ def ensure_backend(timeout: float = 120.0):
                 [sys.executable, "-c", "import jax; jax.devices()"],
                 timeout=timeout, check=True, capture_output=True,
                 env=dict(os.environ))
-        except Exception:
-            print("# configured accelerator backend unavailable; "
+        except subprocess.TimeoutExpired:
+            print(f"# accelerator backend probe HUNG (> {timeout:.0f}s; "
+                  "dead tunnel?); falling back to CPU", file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+        except subprocess.CalledProcessError as exc:
+            tail = (exc.stderr or b"")[-800:].decode("utf-8", "replace")
+            print("# accelerator backend probe FAILED; falling back to CPU. "
+                  f"probe stderr tail:\n{tail}", file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as exc:  # pragma: no cover - defensive
+            print(f"# accelerator backend probe errored ({exc!r}); "
                   "falling back to CPU", file=sys.stderr)
             jax.config.update("jax_platforms", "cpu")
     jax.devices()
