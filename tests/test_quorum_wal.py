@@ -1,0 +1,187 @@
+"""Quorum WAL unit tests (fake journal channels, no processes).
+
+Covers the Hydra-quorum-changelog semantics the multi-process cluster
+relies on: majority-ack appends, refusal below quorum, longest-majority-
+prefix recovery, replica realignment.
+"""
+
+import pytest
+
+from ytsaurus_tpu.cypress.quorum import QuorumWal
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+
+class FakeJournalChannel:
+    """In-memory data_node journal endpoint with the REAL position-check
+    semantics (a non-contiguous append is rejected, like
+    DataNodeService.journal_append)."""
+
+    def __init__(self):
+        self.records = []
+        self.snapshots = {}
+        self.down = False
+
+    def call(self, service, method, body=None, attachments=(), **kw):
+        if self.down:
+            raise YtError("down", code=EErrorCode.TransportError)
+        assert service == "data_node"
+        if method == "journal_append":
+            position = body.get("position")
+            if position is not None and position != len(self.records):
+                raise YtError("position mismatch",
+                              code=EErrorCode.JournalPositionMismatch,
+                              attributes={"expected": len(self.records)})
+            self.records.extend(body["records"])
+            return {"count": len(self.records)}, []
+        if method == "journal_read":
+            return {"records": list(self.records)}, []
+        if method == "journal_reset":
+            self.records.clear()
+            return {}, []
+        if method == "snapshot_put":
+            self.snapshots["snap"] = (body["seq"], attachments[0])
+            return {}, []
+        if method == "snapshot_get":
+            if "snap" not in self.snapshots:
+                return {"seq": None}, []
+            seq, blob = self.snapshots["snap"]
+            return {"seq": seq}, [blob]
+        raise AssertionError(method)
+
+
+@pytest.fixture()
+def wal3(tmp_path):
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "wal.log"), "master_wal", remotes,
+                    quorum=2)
+    wal.recover()
+    return wal, remotes
+
+
+def test_append_reaches_all_locations(wal3):
+    wal, remotes = wal3
+    wal.append({"op": "set", "args": {"path": "//a"}})
+    assert len(remotes[0].records) == 1
+    assert len(remotes[1].records) == 1
+
+
+def test_append_tolerates_one_location_down(wal3):
+    wal, remotes = wal3
+    remotes[0].down = True
+    wal.append({"op": "set", "args": {"n": 1}})   # local + remote1 = 2/2
+    assert len(remotes[1].records) == 1
+
+
+def test_append_refuses_below_quorum(tmp_path):
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=3)
+    wal.recover()
+    remotes[0].down = True
+    with pytest.raises(YtError) as ei:
+        wal.append({"op": "set"})
+    assert ei.value.code == EErrorCode.PeerUnavailable
+
+
+def test_recover_from_remote_majority_after_local_loss(tmp_path):
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal.recover()
+    for i in range(5):
+        wal.append({"op": "set", "args": {"n": i}})
+    wal.close()
+    # Local disk dies: a fresh local path, same remotes.
+    wal2 = QuorumWal(str(tmp_path / "fresh.log"), "j", remotes, quorum=2)
+    records = wal2.recover()
+    assert [r["args"]["n"] for r in records] == [0, 1, 2, 3, 4]
+
+
+def test_recover_discards_unconfirmed_tail(tmp_path):
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal.recover()
+    for i in range(3):
+        wal.append({"op": "set", "args": {"n": i}})
+    # One replica got an extra record the quorum never confirmed.
+    remotes[0].records.append({"op": "set", "args": {"n": 99}})
+    wal2 = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    records = wal2.recover()
+    assert [r["args"]["n"] for r in records] == [0, 1, 2]
+    # Realignment resets the divergent replica to the committed log.
+    assert [r["args"]["n"] for r in remotes[0].records] == [0, 1, 2]
+
+
+def test_recover_catches_up_lagging_replica(tmp_path):
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal.recover()
+    for i in range(4):
+        wal.append({"op": "set", "args": {"n": i}})
+    remotes[1].records = remotes[1].records[:1]     # lagging replica
+    wal2 = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    records = wal2.recover()
+    assert len(records) == 4                         # local+r0 confirm all
+    assert [r["args"]["n"] for r in remotes[1].records] == [0, 1, 2, 3]
+
+
+def test_recover_refuses_below_quorum(tmp_path):
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal.recover()
+    wal.append({"op": "set"})
+    remotes[0].down = True
+    remotes[1].down = True
+    wal2 = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    with pytest.raises(YtError):
+        wal2.recover()
+
+
+def test_no_holes_replica_down_then_up(tmp_path):
+    """The reviewer's scenario: a replica that missed a record must NOT
+    accept later appends (hole) and must not cause loss of a
+    quorum-acknowledged record in recovery."""
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal.recover()
+    remotes[0].down = True
+    wal.append({"op": "set", "args": {"n": 1}})     # local + B ack
+    remotes[0].down = False
+    wal.append({"op": "set", "args": {"n": 2}})     # A must catch up first
+    # A holds the full prefix, not a holey [r2].
+    assert [r["args"]["n"] for r in remotes[0].records] == [1, 2]
+    # Recovery with B down: local + A still confirm both records.
+    remotes[1].down = True
+    wal2 = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    records = wal2.recover()
+    assert [r["args"]["n"] for r in records] == [1, 2]
+
+
+def test_unsynced_replica_earns_no_quorum_credit(tmp_path):
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=3)
+    wal.recover()
+    wal.append({"op": "set", "args": {"n": 1}})
+    # A silently loses its log AND rejects catch-up: no ack possible.
+    remotes[0].records.clear()
+    remotes[0].down = True
+    with pytest.raises(YtError):
+        wal.append({"op": "set", "args": {"n": 2}})  # 2/3 < quorum 3
+
+
+def test_snapshot_survives_local_disk_loss(tmp_path):
+    from ytsaurus_tpu.cypress.master import Master
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    m1_dir = tmp_path / "m1"
+    wal = QuorumWal(str(m1_dir / "changelog.log"), "j", remotes, quorum=2)
+    m1_dir.mkdir()
+    m1 = Master(str(m1_dir), wal=wal)
+    m1.commit_mutation("create", path="//a", type="map_node")
+    m1.commit_mutation("set", path="//a/@x", value=7)
+    m1.build_snapshot()
+    m1.commit_mutation("set", path="//a/@y", value=8)
+    # Total local disk loss: fresh dir, same remote journal locations.
+    m2_dir = tmp_path / "m2"
+    m2_dir.mkdir()
+    wal2 = QuorumWal(str(m2_dir / "changelog.log"), "j", remotes, quorum=2)
+    m2 = Master(str(m2_dir), wal=wal2)
+    assert m2.tree.get("//a/@x") == 7
+    assert m2.tree.get("//a/@y") == 8

@@ -86,6 +86,20 @@ class FsChunkStore:
             {"codec": erasure, "size": len(blob)}, binary=True))
         return chunk_id
 
+    def put_blob(self, chunk_id: str, blob: bytes,
+                 erasure: Optional[str] = None) -> str:
+        """Store an already-serialized chunk blob (the data-node RPC path:
+        placement decisions happen remotely, bytes land here)."""
+        if erasure is not None:
+            return self._write_erasure(chunk_id, blob, erasure)
+        path = self._path(chunk_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._atomic_write(path, blob)
+        return chunk_id
+
+    def get_blob(self, chunk_id: str) -> bytes:
+        return self._read_blob(chunk_id)
+
     def read_chunk(self, chunk_id: str) -> ColumnarChunk:
         return deserialize_chunk(self._read_blob(chunk_id))
 

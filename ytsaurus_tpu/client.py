@@ -35,15 +35,38 @@ from ytsaurus_tpu.tablet.transactions import TabletTransaction, TransactionManag
 class YtCluster:
     """Everything one process needs to be a cluster (local mode)."""
 
-    def __init__(self, root_dir: str):
+    def __init__(self, root_dir: str, chunk_store=None, master=None):
         self.root_dir = root_dir
         os.makedirs(root_dir, exist_ok=True)
-        self.master = Master(os.path.join(root_dir, "master"))
-        self.chunk_store = FsChunkStore(os.path.join(root_dir, "chunks"))
+        self.master = master if master is not None else \
+            Master(os.path.join(root_dir, "master"))
+        self.chunk_store = chunk_store if chunk_store is not None else \
+            FsChunkStore(os.path.join(root_dir, "chunks"))
         self.chunk_cache = ChunkCache(self.chunk_store)
         self.transactions = TransactionManager()
         self.evaluator = Evaluator()
         self.tablets: dict[str, list[Tablet]] = {}   # node id → tablets
+
+
+def publish_table_chunks(client, chunk_store, path, chunks,
+                         sorted_by=None, schema=None) -> None:
+    """THE static-table chunk attribute protocol (@schema/@chunk_ids/
+    @chunk_stats/@row_count/@sorted_by) — one implementation shared by the
+    in-process client and the remote thin client, so tables stay
+    cross-readable whichever path wrote them."""
+    from ytsaurus_tpu.query.pruning import compute_column_stats
+    chunk_ids = [chunk_store.write_chunk(c) for c in chunks]
+    total = sum(c.row_count for c in chunks)
+    if schema is not None:
+        client.set(path + "/@schema", schema.to_dict())
+    client.set(path + "/@chunk_ids", chunk_ids)
+    client.set(path + "/@chunk_stats",
+               [compute_column_stats(c) for c in chunks])
+    client.set(path + "/@row_count", total)
+    if sorted_by:
+        client.set(path + "/@sorted_by", list(sorted_by))
+    elif client.exists(path + "/@sorted_by"):
+        client.remove(path + "/@sorted_by", force=True)
 
 
 def _normalize_per_tablet(ids) -> "list[list[str]]":
@@ -747,21 +770,9 @@ class YtClient:
     def _write_table_chunks(self, path: str, chunks: list[ColumnarChunk],
                             sorted_by: Optional[list[str]] = None,
                             schema: Optional[TableSchema] = None) -> None:
-        node = self._table_node(path, create=True, schema=schema)
-        from ytsaurus_tpu.query.pruning import compute_column_stats
-        chunk_ids = [self.cluster.chunk_store.write_chunk(c) for c in chunks]
-        total = sum(c.row_count for c in chunks)
-        if schema is not None:
-            self.set(path + "/@schema", schema.to_dict())
-        self.set(path + "/@chunk_ids", chunk_ids)
-        self.set(path + "/@chunk_stats",
-                 [compute_column_stats(c) for c in chunks])
-        self.set(path + "/@row_count", total)
-        if sorted_by:
-            self.set(path + "/@sorted_by", list(sorted_by))
-        elif "sorted_by" in node.attributes:
-            self.cluster.master.commit_mutation(
-                "remove", path=path + "/@sorted_by", force=True)
+        self._table_node(path, create=True, schema=schema)
+        publish_table_chunks(self, self.cluster.chunk_store, path, chunks,
+                             sorted_by=sorted_by, schema=schema)
 
     def _query_shards(self, path: str, timestamp: int,
                       intervals=None, stats=None) -> list[ColumnarChunk]:

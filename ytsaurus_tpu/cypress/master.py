@@ -85,13 +85,20 @@ class Master:
     SNAPSHOT = "snapshot.yson"
     CHANGELOG = "changelog.log"
 
-    def __init__(self, root_dir: str):
+    def __init__(self, root_dir: str, wal=None):
+        from ytsaurus_tpu.cypress.quorum import LocalWal
         self.root_dir = root_dir
         os.makedirs(root_dir, exist_ok=True)
         self._lock = threading.RLock()
+        self._poisoned = False
+        self._snapshot_seq = 0
         self.tree = CypressTree()
+        # wal: LocalWal (default) or QuorumWal over journal locations on
+        # data nodes — recover() returns replayable records, append() is
+        # the durability barrier, reset() truncates after snapshots.
+        self.wal = wal if wal is not None else \
+            LocalWal(os.path.join(root_dir, self.CHANGELOG))
         self._recover()
-        self.changelog = Changelog(os.path.join(root_dir, self.CHANGELOG))
 
     # -- mutation pipeline -----------------------------------------------------
 
@@ -102,12 +109,28 @@ class Master:
         if op not in self._MUTATIONS:
             raise YtError(f"Unknown mutation {op!r}")
         with self._lock:
+            if self._poisoned:
+                raise YtError(
+                    "Master is read-only: a WAL append failed and the "
+                    "in-memory state is ahead of the durable log; restart "
+                    "the primary to recover",
+                    code=EErrorCode.PeerUnavailable)
             # Validate BEFORE logging by applying to the live tree; Hydra
             # validates in the mutation handler too — a failed apply after a
             # logged record would poison recovery, so log only after the
             # apply succeeds, holding the lock (single-writer semantics).
             result = self._apply(op, args)
-            self.changelog.append({"op": op, "args": args})
+            try:
+                self.wal.append({"op": op, "args": args})
+            except YtError:
+                # The tree now holds a mutation the log does not.  Under a
+                # quorum WAL this is a routine network failure, and serving
+                # further mutations would let later LOGGED records depend
+                # on this unlogged one (divergence after replay).  Latch
+                # read-only, like a Hydra leader restarting its automaton
+                # on changelog failure.
+                self._poisoned = True
+                raise
             return result
 
     def _apply(self, op: str, args: dict) -> Any:
@@ -137,9 +160,15 @@ class Master:
     # -- snapshots / recovery --------------------------------------------------
 
     def build_snapshot(self) -> None:
-        """Serialize the tree, truncate the changelog (ref snapshot build)."""
+        """Serialize the tree, replicate the snapshot, truncate the
+        changelog (ref snapshot build).  Remote replication happens FIRST:
+        truncating quorum journals with a local-only snapshot would
+        collapse metadata durability back to one disk."""
         with self._lock:
-            blob = yson.dumps(self.tree.serialize(), binary=True)
+            seq = self._snapshot_seq + 1
+            blob = yson.dumps({"seq": seq, "tree": self.tree.serialize()},
+                              binary=True)
+            self.wal.store_snapshot(seq, blob)
             snap_path = os.path.join(self.root_dir, self.SNAPSHOT)
             tmp = snap_path + ".tmp"
             with open(tmp, "wb") as f:
@@ -148,30 +177,38 @@ class Master:
                 os.fsync(f.fileno())
             os.replace(tmp, snap_path)
             _fsync_dir(self.root_dir)      # make the rename durable first
-            self.changelog.close()
-            log_path = os.path.join(self.root_dir, self.CHANGELOG)
-            os.unlink(log_path)
+            self._snapshot_seq = seq
+            self.wal.reset()
             _fsync_dir(self.root_dir)
-            self.changelog = Changelog(log_path)
+
+    @staticmethod
+    def _load_snapshot_blob(blob: bytes) -> tuple[int, dict]:
+        data = yson.loads(blob)
+        if isinstance(data, dict) and "seq" in data and "tree" in data:
+            return int(data["seq"]), data["tree"]
+        return 0, data              # pre-versioning format
 
     def _recover(self) -> None:
+        local: "tuple[int, dict] | None" = None
         snap_path = os.path.join(self.root_dir, self.SNAPSHOT)
         if os.path.exists(snap_path):
             with open(snap_path, "rb") as f:
-                self.tree = CypressTree.deserialize(yson.loads(f.read()))
-        log_path = os.path.join(self.root_dir, self.CHANGELOG)
-        records, valid_bytes = Changelog.read_all(log_path)
-        for record in records:
+                local = self._load_snapshot_blob(f.read())
+        remote = self.wal.fetch_snapshot()
+        if remote is not None:
+            remote = self._load_snapshot_blob(remote[1])
+        # Newest snapshot wins: a lost local disk recovers from the
+        # replicated copy, and journal tails always belong to the newest
+        # snapshot epoch (reset happens after replication).
+        best = max((s for s in (local, remote) if s is not None),
+                   key=lambda s: s[0], default=None)
+        if best is not None:
+            self._snapshot_seq = best[0]
+            self.tree = CypressTree.deserialize(best[1])
+        for record in self.wal.recover():
             try:
                 self._apply(record["op"], dict(record["args"]))
             except YtError:
                 # Mutations are validated before logging; a failing replay
                 # record means it raced a snapshot — skip.
                 continue
-        # Drop a torn tail so future appends stay recoverable.
-        if os.path.exists(log_path) and \
-                os.path.getsize(log_path) > valid_bytes:
-            with open(log_path, "r+b") as f:
-                f.truncate(valid_bytes)
-                f.flush()
-                os.fsync(f.fileno())

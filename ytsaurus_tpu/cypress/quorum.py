@@ -1,0 +1,257 @@
+"""WAL backends for the master: local file or quorum-of-N locations.
+
+Ref: Hydra quorum changelogs — mutations are acknowledged by a majority of
+changelog replicas before apply (server/lib/hydra/changelog.h + journal
+quorum semantics, server/master/journal_server/journal_node.h:19).
+
+Protocol invariant: every location holds a PREFIX of the single-writer
+log.  Remote appends are position-checked (the data node rejects a
+non-contiguous append), so a replica that missed records can never grow a
+hole; it is marked unsynced, earns no quorum credit, and is caught up from
+the writer's in-memory committed log before acking again.  Recovery reads
+every reachable location and takes the longest prefix present on >= quorum
+locations — sound because prefixes are guaranteed, not assumed.
+
+Snapshots are replicated to the journal locations BEFORE the journals are
+truncated (build_snapshot), so a total local-disk loss still recovers:
+newest quorum snapshot + committed journal tail.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ytsaurus_tpu.cypress.master import Changelog
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils.logging import get_logger
+
+logger = get_logger("quorum")
+
+
+class LocalWal:
+    """Single-location WAL: today's fsync'd changelog file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._log: Optional[Changelog] = None
+
+    def recover(self) -> list[dict]:
+        records, valid = Changelog.read_all(self.path)
+        # Drop a torn tail so future appends stay recoverable.
+        if os.path.exists(self.path) and \
+                os.path.getsize(self.path) > valid:
+            with open(self.path, "r+b") as f:
+                f.truncate(valid)
+                f.flush()
+                os.fsync(f.fileno())
+        self._log = Changelog(self.path)
+        return records
+
+    def append(self, record: dict) -> None:
+        self._log.append(record)
+
+    def reset(self) -> None:
+        """Truncate after a snapshot."""
+        self._log.close()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        self._log = Changelog(self.path)
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+
+    # Snapshot replication is a no-op for a single-location WAL.
+    def store_snapshot(self, seq: int, blob: bytes) -> None:
+        pass
+
+    def fetch_snapshot(self) -> "tuple[int, bytes] | None":
+        return None
+
+
+class _Replica:
+    def __init__(self, channel):
+        self.channel = channel
+        self.synced_len: Optional[int] = None    # None = unknown/unsynced
+
+
+class QuorumWal:
+    """WAL over one local location + remote journal locations."""
+
+    def __init__(self, local_path: str, journal_name: str,
+                 remote_channels: list, quorum: int = 2):
+        self.local = LocalWal(local_path)
+        self.journal_name = journal_name
+        self.replicas = [_Replica(ch) for ch in remote_channels]
+        self.quorum = quorum
+        if quorum > 1 + len(self.replicas):
+            raise YtError(f"quorum {quorum} unreachable with "
+                          f"{1 + len(self.replicas)} locations")
+        self._records: list[dict] = []     # committed log (truncated w/ WAL)
+
+    # -- replica sync ----------------------------------------------------------
+
+    def _catch_up(self, replica: _Replica) -> bool:
+        """Bring one replica to the full committed log; True on success."""
+        try:
+            if replica.synced_len is None:
+                body, _ = replica.channel.call(
+                    "data_node", "journal_read",
+                    {"journal": self.journal_name})
+                have = len(body.get("records", []))
+                if have > len(self._records):
+                    # Longer than the committed log → uncommitted tail from
+                    # a previous incarnation; discard it.
+                    replica.channel.call("data_node", "journal_reset",
+                                         {"journal": self.journal_name},
+                                         idempotent=False)
+                    have = 0
+                replica.synced_len = have
+            if replica.synced_len < len(self._records):
+                missing = self._records[replica.synced_len:]
+                replica.channel.call(
+                    "data_node", "journal_append",
+                    {"journal": self.journal_name, "records": missing,
+                     "position": replica.synced_len}, idempotent=False)
+                replica.synced_len = len(self._records)
+            return True
+        except YtError as err:
+            replica.synced_len = None
+            logger.warning("journal replica catch-up failed: %s", err)
+            return False
+
+    # -- write path ------------------------------------------------------------
+
+    def append(self, record: dict) -> None:
+        position = len(self._records)
+        acks = 0
+        errors = []
+        try:
+            self.local.append(record)
+            acks += 1
+        except OSError as exc:          # local disk failure
+            errors.append(YtError(f"local WAL append failed: {exc}"))
+        for replica in self.replicas:
+            if replica.synced_len != position and not self._sync_to(
+                    replica, position):
+                continue
+            try:
+                replica.channel.call(
+                    "data_node", "journal_append",
+                    {"journal": self.journal_name, "records": [record],
+                     "position": position}, idempotent=False)
+                replica.synced_len = position + 1
+                acks += 1
+            except YtError as err:
+                replica.synced_len = None
+                errors.append(err)
+        if acks < self.quorum:
+            raise YtError(
+                f"WAL append reached {acks}/{self.quorum} locations",
+                code=EErrorCode.PeerUnavailable, inner_errors=errors[:3])
+        self._records.append(record)
+
+    def _sync_to(self, replica: _Replica, position: int) -> bool:
+        """Catch a lagging replica up to `position` committed records."""
+        if not self._catch_up(replica):
+            return False
+        return replica.synced_len == position
+
+    # -- recovery --------------------------------------------------------------
+
+    def recover(self) -> list[dict]:
+        lists: list[Optional[list]] = [self.local.recover()]
+        reachable = 1
+        for replica in self.replicas:
+            try:
+                body, _ = replica.channel.call(
+                    "data_node", "journal_read",
+                    {"journal": self.journal_name})
+                lists.append(list(body.get("records", [])))
+                reachable += 1
+            except YtError as err:
+                logger.warning("journal location unreachable in recovery: "
+                               "%s", err)
+                lists.append(None)
+        if reachable < self.quorum:
+            raise YtError(
+                f"cannot recover: {reachable}/{self.quorum} WAL locations "
+                "reachable", code=EErrorCode.PeerUnavailable)
+        # Longest prefix confirmed by >= quorum locations.  Position-checked
+        # appends guarantee each location IS a prefix, so length comparison
+        # is sound.
+        lengths = sorted((len(lst) for lst in lists if lst is not None),
+                         reverse=True)
+        committed = lengths[self.quorum - 1]
+        source = next(lst for lst in lists
+                      if lst is not None and len(lst) >= committed)
+        self._records = source[:committed]
+        # Re-align the local location; remote replicas catch up lazily at
+        # the next append (and earn no quorum credit until they do).
+        self._realign_local()
+        for replica, lst in zip(self.replicas, lists[1:]):
+            replica.synced_len = None if lst is None or \
+                len(lst) != committed else committed
+            if replica.synced_len is None:
+                self._catch_up(replica)
+        return list(self._records)
+
+    def _realign_local(self) -> None:
+        self.local.reset()
+        for record in self._records:
+            self.local.append(record)
+
+    def reset(self) -> None:
+        self.local.reset()
+        self._records = []
+        for replica in self.replicas:
+            try:
+                replica.channel.call("data_node", "journal_reset",
+                                     {"journal": self.journal_name},
+                                     idempotent=False)
+                replica.synced_len = 0
+            except YtError:
+                replica.synced_len = None
+
+    def close(self) -> None:
+        self.local.close()
+
+    # -- replicated snapshots --------------------------------------------------
+
+    def store_snapshot(self, seq: int, blob: bytes) -> None:
+        """Replicate the snapshot to >= quorum-1 journal locations (the
+        local copy is the quorum-th) BEFORE journals are truncated."""
+        acks = 0
+        errors = []
+        for replica in self.replicas:
+            try:
+                replica.channel.call(
+                    "data_node", "snapshot_put",
+                    {"name": self.journal_name, "seq": seq}, [blob],
+                    idempotent=False)
+                acks += 1
+            except YtError as err:
+                errors.append(err)
+        if acks < self.quorum - 1:
+            raise YtError(
+                f"snapshot replication reached {acks}/{self.quorum - 1} "
+                "remote locations", code=EErrorCode.PeerUnavailable,
+                inner_errors=errors[:3])
+
+    def fetch_snapshot(self) -> "tuple[int, bytes] | None":
+        """Newest snapshot available on any journal location."""
+        best: "tuple[int, bytes] | None" = None
+        for replica in self.replicas:
+            try:
+                body, attachments = replica.channel.call(
+                    "data_node", "snapshot_get",
+                    {"name": self.journal_name})
+                if body.get("seq") is None:
+                    continue
+                seq = int(body["seq"])
+                if best is None or seq > best[0]:
+                    best = (seq, attachments[0])
+            except YtError:
+                continue
+        return best

@@ -91,6 +91,15 @@ def _registry() -> dict[str, CommandDescriptor]:
            lambda cl, p: cl.select_rows(p["query"])),
         _d("trim_rows", ("path", "trimmed_row_count"), (), True,
            lambda cl, p: cl.trim_rows(p["path"], p["trimmed_row_count"])),
+        _d("push_queue", ("path", "rows"), (), True,
+           lambda cl, p: cl.push_queue(p["path"], p["rows"])),
+        _d("pull_queue", ("path", "offset"), ("limit",), False,
+           lambda cl, p: cl.pull_queue(p["path"], p["offset"],
+                                       limit=p.get("limit"))),
+        _d("compact_table", ("path",), (), True,
+           lambda cl, p: cl.compact_table(p["path"])),
+        _d("collect_garbage", (), (), True,
+           lambda cl, p: cl.collect_garbage()),
         # operations
         _d("sort", ("input_table_path", "output_table_path", "sort_by"), (),
            True,

@@ -1,0 +1,5 @@
+"""Local-mode cluster environments (ref yt/python/yt/environment)."""
+
+from ytsaurus_tpu.environment.local import LocalCluster
+
+__all__ = ["LocalCluster"]

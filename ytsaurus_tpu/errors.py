@@ -47,6 +47,9 @@ class EErrorCode(enum.IntEnum):
     NoSuchOperation = 1800
     OperationFailed = 1801
 
+    # Journals / quorum WAL.
+    JournalPositionMismatch = 1850
+
     # RPC (ref: yt/yt/core/rpc/public.h EErrorCode).
     NoSuchMethod = 1900
     NoSuchService = 1901

@@ -1,0 +1,292 @@
+"""RemoteYtClient: the IClient facade over a multi-process cluster.
+
+The thin-client/proxy split (ref rpc_proxy client,
+client/api/rpc_proxy/client_impl.h): metadata and tablet commands go to
+the primary's DriverService; bulk chunk data moves directly between this
+process and the data nodes (RpcChunkStore with the shared rendezvous
+placement) — the control/data-plane split of the reference's native
+client.  Operations (sort/map/merge/erase) run a local controller against
+this client, reading and writing chunks over the node RPC data plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.rpc import Channel, RetryingChannel
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.server.remote_store import RpcChunkStore
+from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+
+
+@dataclass
+class RemoteTransaction:
+    id: str
+    start_timestamp: int
+
+
+class RemoteYtClient:
+    def __init__(self, primary_address: str, timeout: float = 120.0):
+        self.primary_address = primary_address
+        self._channel = RetryingChannel(
+            Channel(primary_address, timeout=timeout))
+        self.chunk_store = RpcChunkStore(self._alive_nodes)
+        from ytsaurus_tpu.operations.scheduler import OperationScheduler
+        from ytsaurus_tpu.query.statistics import QueryStatistics
+        self.scheduler = OperationScheduler(self)
+        self.last_query_statistics = QueryStatistics()
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _alive_nodes(self) -> list[str]:
+        body, _ = self._channel.call("node_tracker", "list_nodes", {})
+        return [a.decode() if isinstance(a, bytes) else a
+                for a in body.get("alive", [])]
+
+    def _execute(self, command: str, parameters: Optional[dict] = None,
+                 attachments=(), idempotent: bool = True):
+        body, out_attachments = self._channel.call(
+            "driver", "execute",
+            {"command": command, "parameters": parameters or {}},
+            attachments, idempotent=idempotent)
+        if body.get("kind") == "blob":
+            return out_attachments[0]
+        return body.get("result")
+
+    def close(self) -> None:
+        self._channel.close()
+        self.chunk_store.close()
+
+    # -- cypress ---------------------------------------------------------------
+
+    def create(self, node_type: str, path: str,
+               attributes: Optional[dict] = None, recursive: bool = False,
+               ignore_existing: bool = False) -> str:
+        attributes = dict(attributes or {})
+        schema = attributes.get("schema")
+        if isinstance(schema, TableSchema):
+            attributes["schema"] = schema.to_dict()
+        return self._execute("create", {
+            "type": node_type, "path": path, "attributes": attributes,
+            "recursive": recursive, "ignore_existing": ignore_existing},
+            idempotent=False)
+
+    def get(self, path: str) -> Any:
+        return self._execute("get", {"path": path})
+
+    def set(self, path: str, value: Any) -> None:
+        self._execute("set", {"path": path, "value": value},
+                      idempotent=False)
+
+    def exists(self, path: str) -> bool:
+        return bool(self._execute("exists", {"path": path}))
+
+    def list(self, path: str) -> list[str]:
+        return list(self._execute("list", {"path": path}))
+
+    def copy(self, src: str, dst: str, recursive: bool = False) -> str:
+        return self._execute("copy", {"source_path": src,
+                                      "destination_path": dst,
+                                      "recursive": recursive},
+                             idempotent=False)
+
+    def move(self, src: str, dst: str, recursive: bool = False) -> str:
+        return self._execute("move", {"source_path": src,
+                                      "destination_path": dst,
+                                      "recursive": recursive},
+                             idempotent=False)
+
+    def link(self, target: str, link: str, recursive: bool = False) -> str:
+        return self._execute("link", {"target_path": target,
+                                      "link_path": link,
+                                      "recursive": recursive},
+                             idempotent=False)
+
+    def remove(self, path: str, recursive: bool = True,
+               force: bool = False) -> None:
+        self._execute("remove", {"path": path, "recursive": recursive,
+                                 "force": force}, idempotent=False)
+
+    def collect_garbage(self) -> int:
+        """Server-side sweep.  NOTE: client-local operations in flight are
+        invisible to the primary; run this only while idle."""
+        return int(self._execute("collect_garbage", {}, idempotent=False))
+
+    # -- static tables ---------------------------------------------------------
+
+    def write_table(self, path: str, rows, append: bool = False,
+                    schema=None, format: Optional[str] = None) -> None:
+        params: dict = {"path": path, "append": append}
+        if schema is not None:
+            params["schema"] = (schema.to_dict()
+                                if isinstance(schema, TableSchema)
+                                else schema)
+        attachments = []
+        if format is not None:
+            params["format"] = format
+            attachments = [rows if isinstance(rows, bytes)
+                           else bytes(rows)]
+        else:
+            params["rows"] = [dict(r) if isinstance(r, dict) else list(r)
+                              for r in rows]
+        self._execute("write_table", params, attachments, idempotent=False)
+
+    def read_table(self, path: str, format: Optional[str] = None):
+        params: dict = {"path": path}
+        if format is not None:
+            params["format"] = format
+        return self._execute("read_table", params)
+
+    # -- dynamic tables --------------------------------------------------------
+
+    def mount_table(self, path: str) -> None:
+        self._execute("mount_table", {"path": path}, idempotent=False)
+
+    def unmount_table(self, path: str) -> None:
+        self._execute("unmount_table", {"path": path}, idempotent=False)
+
+    def freeze_table(self, path: str) -> None:
+        self._execute("freeze_table", {"path": path}, idempotent=False)
+
+    def reshard_table(self, path: str, pivot_keys) -> None:
+        self._execute("reshard_table",
+                      {"path": path,
+                       "pivot_keys": [list(k) for k in pivot_keys]},
+                      idempotent=False)
+
+    def compact_table(self, path: str) -> None:
+        self._execute("compact_table", {"path": path}, idempotent=False)
+
+    def insert_rows(self, path: str, rows: Sequence[dict],
+                    tx: Optional[RemoteTransaction] = None) -> None:
+        rows = [dict(r) for r in rows]
+        if tx is None:
+            self._execute("insert_rows", {"path": path, "rows": rows},
+                          idempotent=False)
+            return
+        self._channel.call("driver", "insert_rows_tx",
+                           {"tx_id": tx.id, "path": path, "rows": rows},
+                           idempotent=False)
+
+    def delete_rows(self, path: str, keys: Sequence[tuple],
+                    tx: Optional[RemoteTransaction] = None) -> None:
+        wire_keys = [list(k) for k in keys]
+        if tx is None:
+            self._execute("delete_rows", {"path": path, "keys": wire_keys},
+                          idempotent=False)
+            return
+        self._channel.call("driver", "delete_rows_tx",
+                           {"tx_id": tx.id, "path": path,
+                            "keys": wire_keys}, idempotent=False)
+
+    def lookup_rows(self, path: str, keys: Sequence[tuple],
+                    timestamp: int = MAX_TIMESTAMP,
+                    column_names: Optional[Sequence[str]] = None):
+        params: dict = {"path": path, "keys": [list(k) for k in keys]}
+        if timestamp != MAX_TIMESTAMP:
+            params["timestamp"] = timestamp
+        if column_names is not None:
+            params["column_names"] = list(column_names)
+        return self._execute("lookup_rows", params)
+
+    def select_rows(self, query: str) -> list[dict]:
+        return self._execute("select_rows", {"query": query})
+
+    def push_queue(self, path: str, rows: Sequence[dict]) -> int:
+        return int(self._execute(
+            "push_queue", {"path": path, "rows": [dict(r) for r in rows]},
+            idempotent=False))
+
+    def pull_queue(self, path: str, offset: int = 0,
+                   limit: Optional[int] = None) -> list[dict]:
+        params: dict = {"path": path, "offset": offset}
+        if limit is not None:
+            params["limit"] = limit
+        return self._execute("pull_queue", params)
+
+    def trim_rows(self, path: str, trimmed_count: int) -> None:
+        self._execute("trim_rows", {"path": path,
+                                    "trimmed_row_count": trimmed_count},
+                      idempotent=False)
+
+    # -- transactions ----------------------------------------------------------
+
+    def start_transaction(self) -> RemoteTransaction:
+        body, _ = self._channel.call("driver", "start_transaction", {},
+                                     idempotent=False)
+        return RemoteTransaction(id=body["tx_id"],
+                                 start_timestamp=int(
+                                     body["start_timestamp"]))
+
+    def commit_transaction(self, tx: RemoteTransaction) -> int:
+        body, _ = self._channel.call("driver", "commit_transaction",
+                                     {"tx_id": tx.id}, idempotent=False)
+        return int(body["commit_timestamp"])
+
+    def abort_transaction(self, tx: RemoteTransaction) -> None:
+        self._channel.call("driver", "abort_transaction", {"tx_id": tx.id},
+                           idempotent=False)
+
+    # -- operations (local controller, remote data plane) ----------------------
+
+    def run_sort(self, input_path: str, output_path: str, sort_by, **kw):
+        return self.scheduler.start_operation(
+            "sort", {"input_table_path": input_path,
+                     "output_table_path": output_path,
+                     "sort_by": list(sort_by), **kw})
+
+    def run_merge(self, input_paths, output_path: str,
+                  mode: str = "unordered", **kw):
+        return self.scheduler.start_operation(
+            "merge", {"input_table_paths": list(input_paths),
+                      "output_table_path": output_path, "mode": mode, **kw})
+
+    def run_map(self, mapper: Callable, input_path: str, output_path: str,
+                **kw):
+        return self.scheduler.start_operation(
+            "map", {"mapper": mapper, "input_table_path": input_path,
+                    "output_table_path": output_path, **kw})
+
+    def run_erase(self, table_path: str, **kw):
+        return self.scheduler.start_operation(
+            "erase", {"table_path": table_path, **kw})
+
+    # -- chunk-level IO for the local operation controllers --------------------
+
+    def _read_table_chunks(self, path: str) -> list[ColumnarChunk]:
+        if bool(self.get(path + "/@dynamic")):
+            schema = TableSchema.from_dict(self.get(path + "/@schema"))
+            rows = self._execute(
+                "select_rows",
+                {"query": f"* FROM [{path}]"})
+            return [ColumnarChunk.from_rows(schema.to_unsorted(),
+                                            rows or [])]
+        chunk_ids = self.get(path + "/@chunk_ids") or []
+        if not chunk_ids:
+            schema_dict = self.get(path + "/@schema")
+            if schema_dict is None:
+                raise YtError(f"Empty table {path!r} has no schema",
+                              code=EErrorCode.NoSuchNode)
+            schema = TableSchema.from_dict(schema_dict)
+            return [ColumnarChunk.from_rows(schema.to_unsorted(), [])]
+        return [self.chunk_store.read_chunk(cid) for cid in chunk_ids]
+
+    def _write_table_chunks(self, path: str, chunks: list[ColumnarChunk],
+                            sorted_by: Optional[list[str]] = None,
+                            schema: Optional[TableSchema] = None) -> None:
+        from ytsaurus_tpu.client import publish_table_chunks
+        if not self.exists(path):
+            attributes: dict = {}
+            if schema is not None:
+                attributes["schema"] = schema.to_dict()
+            self.create("table", path, attributes=attributes,
+                        recursive=True)
+        publish_table_chunks(self, self.chunk_store, path, chunks,
+                             sorted_by=sorted_by, schema=schema)
+
+
+def connect_remote(primary_address: str) -> RemoteYtClient:
+    return RemoteYtClient(primary_address)

@@ -19,6 +19,7 @@ from ytsaurus_tpu import yson
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
 from ytsaurus_tpu.rpc.server import error_from_wire
+from ytsaurus_tpu.rpc.wire import decode_body, encode_body
 from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("rpc")
@@ -114,7 +115,8 @@ class Channel:
         envelope = yson.dumps(
             {"rid": rid, "kind": "req", "service": service,
              "method": method}, binary=True)
-        wire_body = yson.dumps(body if body is not None else {}, binary=True)
+        wire_body = yson.dumps(encode_body(body if body is not None else {}),
+                               binary=True)
         try:
             await write_packet(state.writer, [envelope, wire_body,
                                               *attachments])
@@ -139,7 +141,8 @@ class Channel:
         kind = envelope["kind"]
         if kind == b"err":
             raise error_from_wire(yson.loads(parts[1], encoding=None))
-        body = yson.loads(parts[1], encoding=None) if len(parts) > 1 else {}
+        body = decode_body(yson.loads(parts[1], encoding=None)) \
+            if len(parts) > 1 else {}
         return body, list(parts[2:])
 
     # -- public sync API -------------------------------------------------------

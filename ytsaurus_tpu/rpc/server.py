@@ -18,6 +18,7 @@ import traceback
 from ytsaurus_tpu import yson
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
+from ytsaurus_tpu.rpc.wire import decode_body, encode_body
 from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("rpc")
@@ -81,10 +82,7 @@ class RpcServer:
         self.port = port
         self._services = {}
         for svc in services:
-            methods = svc.rpc_methods()
-            self._services[svc.name] = {
-                mname: (fn, asyncio.Semaphore(conc))
-                for mname, (fn, conc) in methods.items()}
+            self.add_service(svc)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="rpc-worker")
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -92,6 +90,13 @@ class RpcServer:
         self._server: asyncio.AbstractServer | None = None
         self._connections: set = set()
         self._started = threading.Event()
+
+    def add_service(self, svc) -> None:
+        """Register a service (also usable after start: daemons bring up
+        bootstrap services first, then the driver once state is recovered)."""
+        self._services[svc.name] = {
+            mname: (fn, asyncio.Semaphore(conc))
+            for mname, (fn, conc) in svc.rpc_methods().items()}
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -190,8 +195,8 @@ class RpcServer:
                     f"No such method {service}.{method}",
                     code=EErrorCode.NoSuchMethod)
             fn, sem = entry
-            body = yson.loads(parts[1], encoding=None) if len(parts) > 1 \
-                else {}
+            body = decode_body(yson.loads(parts[1], encoding=None)) \
+                if len(parts) > 1 else {}
             attachments = list(parts[2:])
             async with sem:
                 result = await asyncio.get_event_loop().run_in_executor(
@@ -201,8 +206,9 @@ class RpcServer:
             else:
                 out_body, out_attachments = result, []
             reply_env = yson.dumps({"rid": rid, "kind": "rsp"}, binary=True)
-            reply_body = yson.dumps(out_body if out_body is not None else {},
-                                    binary=True)
+            reply_body = yson.dumps(
+                encode_body(out_body if out_body is not None else {}),
+                binary=True)
             out = [reply_env, reply_body, *out_attachments]
         except YtError as err:
             out = [yson.dumps({"rid": rid, "kind": "err"}, binary=True),

@@ -1,0 +1,17 @@
+"""Server processes: the multi-host half of the cluster.
+
+Ref mapping (design, not translation):
+  data node chunk service (server/node/data_node/data_node_service.cpp
+    PutBlocks/GetBlockSet)                        → services.DataNodeService
+  journal chunks (quorum WAL storage,
+    server/node/data_node/journal_chunk.h)        → services.DataNodeService
+    journal_* methods
+  node tracker heartbeats
+    (server/master/node_tracker_server)           → services.NodeTrackerService
+  proxy-hosted driver (server/http_proxy +
+    client/driver/driver.cpp:121)                 → services.DriverService
+  ytserver-all multiplexed binary
+    (server/all/main.cpp)                         → daemon.py --role
+  YTInstance local clusters
+    (yt/python/yt/environment/yt_env.py:179)      → environment/local.py
+"""

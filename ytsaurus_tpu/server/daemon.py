@@ -1,0 +1,175 @@
+"""Daemon entry: `python -m ytsaurus_tpu.server.daemon --role primary|node`.
+
+The multiplexed-binary pattern (ref server/all/main.cpp): one entry point,
+role picked by flag.
+
+  primary  — metadata master + tablet host + transaction coordinator +
+             scheduler + driver proxy, with chunk data placed on remote
+             data nodes (RpcChunkStore) once any register; falls back to a
+             local store location until then.
+  node     — blob chunk store + journal location, heartbeating to the
+             primary.
+
+The bound port is written to <root>/<role>.port for launcher discovery.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+
+def _write_port_file(root: str, role: str, port: int) -> None:
+    path = os.path.join(root, f"{role}.port")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+    os.replace(tmp, path)
+
+
+def run_primary(root: str, port: int, replication_factor: int = 2,
+                journal_nodes: int = 2,
+                bootstrap_timeout: float = 60.0) -> None:
+    from ytsaurus_tpu import yson
+    from ytsaurus_tpu.client import YtClient, YtCluster
+    from ytsaurus_tpu.cypress.master import Master
+    from ytsaurus_tpu.cypress.quorum import QuorumWal
+    from ytsaurus_tpu.errors import YtError
+    from ytsaurus_tpu.rpc import Channel, RetryingChannel, RpcServer
+    from ytsaurus_tpu.server.remote_store import RpcChunkStore
+    from ytsaurus_tpu.server.services import (
+        DriverService,
+        NodeTracker,
+        NodeTrackerService,
+    )
+
+    os.makedirs(root, exist_ok=True)
+    tracker = NodeTracker()
+    # Bootstrap service set first: nodes must be able to register before
+    # the master recovers (quorum WAL recovery reads their journals).
+    server = RpcServer([NodeTrackerService(tracker)], port=port)
+    server.start()
+    _write_port_file(root, "primary", server.port)
+    print(f"primary bootstrap on {server.address}", flush=True)
+
+    # Journal membership is STICKY: chosen once, persisted, reused across
+    # restarts so recovery always consults the same journal owners.
+    journal_cfg_path = os.path.join(root, "journal_config.yson")
+    wanted: list[str] | None = None
+    if os.path.exists(journal_cfg_path):
+        with open(journal_cfg_path, "rb") as f:
+            wanted = [j.decode() if isinstance(j, bytes) else j
+                      for j in yson.loads(f.read())["journal_node_ids"]]
+    deadline = time.monotonic() + bootstrap_timeout
+    chosen: dict[str, str] = {}
+    while time.monotonic() < deadline:
+        alive = tracker.alive()
+        if wanted is not None:
+            if all(i in alive for i in wanted):
+                chosen = {i: alive[i] for i in wanted}
+                break
+        elif len(alive) >= journal_nodes:
+            chosen = dict(sorted(alive.items())[:journal_nodes])
+            break
+        time.sleep(0.2)
+    else:
+        if wanted is not None:
+            raise YtError(f"journal nodes {wanted} did not register within "
+                          f"{bootstrap_timeout}s")
+        print(f"# no data nodes within {bootstrap_timeout}s; "
+              "falling back to local-only WAL", flush=True)
+    if chosen and wanted is None:
+        tmp = journal_cfg_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(yson.dumps({"journal_node_ids": sorted(chosen)},
+                               binary=True))
+        os.replace(tmp, journal_cfg_path)
+
+    master_dir = os.path.join(root, "master")
+    os.makedirs(master_dir, exist_ok=True)
+    wal = None
+    if chosen:
+        channels = [RetryingChannel(Channel(addr, timeout=30),
+                                    attempts=2, backoff=0.1)
+                    for _, addr in sorted(chosen.items())]
+        locations = 1 + len(channels)
+        wal = QuorumWal(os.path.join(master_dir, Master.CHANGELOG),
+                        journal_name="master_wal",
+                        remote_channels=channels,
+                        quorum=locations // 2 + 1)
+        print(f"quorum WAL over local + {sorted(chosen)} "
+              f"(quorum {locations // 2 + 1}/{locations})", flush=True)
+    master = Master(master_dir, wal=wal)
+    # The primary holds NO chunk location of its own: all chunk data lives
+    # on data-node processes.
+    store = RpcChunkStore(tracker.alive_nodes,
+                          replication_factor=replication_factor)
+    cluster = YtCluster(root, chunk_store=store, master=master)
+    client = YtClient(cluster)
+    server.add_service(DriverService(client))
+    print(f"primary serving on {server.address}", flush=True)
+    threading.Event().wait()       # serve until killed
+
+
+def run_node(root: str, port: int, primary_address: str,
+             node_id: str | None = None) -> None:
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.rpc import Channel, RetryingChannel, RpcServer
+    from ytsaurus_tpu.server.services import DataNodeService
+
+    os.makedirs(root, exist_ok=True)
+    node_id = node_id or os.path.basename(os.path.normpath(root))
+    store = FsChunkStore(os.path.join(root, "chunks"))
+    service = DataNodeService(store, os.path.join(root, "journals"))
+    server = RpcServer([service], port=port)
+    server.start()
+    _write_port_file(root, "node", server.port)
+    print(f"data node {node_id} serving on {server.address}", flush=True)
+
+    channel = RetryingChannel(Channel(primary_address, timeout=10),
+                              attempts=2, backoff=0.1)
+    address = server.address
+    while True:
+        try:
+            channel.call("node_tracker", "heartbeat",
+                         {"id": node_id, "address": address})
+        except Exception as exc:      # noqa: BLE001 — keep heartbeating
+            print(f"# heartbeat to {primary_address} failed: {exc}",
+                  file=sys.stderr, flush=True)
+        time.sleep(2.0)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--role", choices=("primary", "node"), required=True)
+    parser.add_argument("--root", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--primary", default=None,
+                        help="primary address (node role)")
+    parser.add_argument("--replication-factor", type=int, default=2)
+    parser.add_argument("--journal-nodes", type=int, default=2,
+                        help="remote WAL locations (0 = local-only WAL)")
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--bootstrap-timeout", type=float, default=60.0)
+    args = parser.parse_args()
+
+    # Daemons never touch accelerators; pin CPU before any jax import so a
+    # dead tunnel cannot hang a server process.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    if args.role == "primary":
+        run_primary(args.root, args.port, args.replication_factor,
+                    journal_nodes=args.journal_nodes,
+                    bootstrap_timeout=args.bootstrap_timeout)
+    else:
+        if not args.primary:
+            parser.error("--primary is required for --role node")
+        run_node(args.root, args.port, args.primary, node_id=args.node_id)
+
+
+if __name__ == "__main__":
+    main()

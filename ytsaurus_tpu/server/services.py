@@ -1,0 +1,302 @@
+"""RPC services hosted by the cluster daemons.
+
+DataNodeService   — blob-level chunk storage + journal (quorum WAL) records.
+NodeTrackerService — data-node registration/heartbeats on the primary.
+DriverService     — the full driver command registry over RPC, plus
+                    tx-id-based transactions and chunk location metadata
+                    (the proxy pattern: the client stays thin).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.rpc import Service, rpc_method
+from ytsaurus_tpu.utils.logging import get_logger
+
+logger = get_logger("server")
+
+
+def _text(v) -> str:
+    return v.decode() if isinstance(v, bytes) else str(v)
+
+
+class DataNodeService(Service):
+    """Serves chunk blobs + journal records from one store location."""
+
+    name = "data_node"
+
+    def __init__(self, store, journal_dir: str):
+        import os
+        self.store = store
+        self.journal_dir = journal_dir
+        os.makedirs(journal_dir, exist_ok=True)
+        self._journals: dict[str, object] = {}
+        self._journal_lock = threading.Lock()
+
+    # -- chunks ---------------------------------------------------------------
+
+    @rpc_method()
+    def put_chunk(self, body, attachments):
+        chunk_id = _text(body["chunk_id"])
+        erasure = body.get("erasure")
+        self.store.put_blob(chunk_id, attachments[0],
+                            erasure=_text(erasure) if erasure else None)
+        return {}
+
+    @rpc_method()
+    def get_chunk(self, body, attachments):
+        chunk_id = _text(body["chunk_id"])
+        return {}, [self.store.get_blob(chunk_id)]
+
+    @rpc_method()
+    def has_chunk(self, body, attachments):
+        return {"exists": self.store.exists(_text(body["chunk_id"]))}
+
+    @rpc_method()
+    def remove_chunk(self, body, attachments):
+        self.store.remove_chunk(_text(body["chunk_id"]))
+        return {}
+
+    @rpc_method()
+    def list_chunks(self, body, attachments):
+        return {"chunk_ids": self.store.list_chunks()}
+
+    # -- journals (quorum changelog storage) ----------------------------------
+    #
+    # Appends are POSITION-CHECKED: the writer states the index its records
+    # start at; a mismatch is rejected, so this location always holds a
+    # prefix of the writer's log (the invariant quorum recovery relies on).
+    # Opening a journal truncates any torn tail first (LocalWal contract).
+
+    @staticmethod
+    def _check_name(name: str) -> str:
+        if not name.replace("_", "").replace("-", "").isalnum():
+            raise YtError(f"Bad journal name {name!r}")
+        return name
+
+    def _journal(self, name: str):
+        import os
+
+        from ytsaurus_tpu.cypress.quorum import LocalWal
+        self._check_name(name)
+        with self._journal_lock:
+            entry = self._journals.get(name)
+            if entry is None:
+                wal = LocalWal(os.path.join(self.journal_dir,
+                                            name + ".log"))
+                count = len(wal.recover())
+                entry = {"wal": wal, "count": count}
+                self._journals[name] = entry
+            return entry
+
+    @rpc_method(concurrency=1)
+    def journal_append(self, body, attachments):
+        entry = self._journal(_text(body["journal"]))
+        position = body.get("position")
+        with self._journal_lock:
+            if position is not None and int(position) != entry["count"]:
+                raise YtError(
+                    f"journal position mismatch: writer at {position}, "
+                    f"location at {entry['count']}",
+                    code=EErrorCode.JournalPositionMismatch,
+                    attributes={"expected": entry["count"]})
+            for record in body["records"]:
+                entry["wal"].append(record)
+                entry["count"] += 1
+        return {"count": entry["count"]}
+
+    @rpc_method()
+    def journal_read(self, body, attachments):
+        import os
+
+        from ytsaurus_tpu.cypress.master import Changelog
+        name = self._check_name(_text(body["journal"]))
+        self._journal(name)        # open (truncates any torn tail)
+        path = os.path.join(self.journal_dir, name + ".log")
+        records, _ = Changelog.read_all(path)
+        return {"records": records}
+
+    @rpc_method(concurrency=1)
+    def journal_reset(self, body, attachments):
+        """Truncate a journal to empty (after a snapshot)."""
+        import os
+        name = self._check_name(_text(body["journal"]))
+        with self._journal_lock:
+            entry = self._journals.pop(name, None)
+            if entry is not None:
+                entry["wal"].close()
+            path = os.path.join(self.journal_dir, name + ".log")
+            if os.path.exists(path):
+                os.unlink(path)
+        return {}
+
+    # -- replicated snapshots --------------------------------------------------
+
+    @rpc_method(concurrency=1)
+    def snapshot_put(self, body, attachments):
+        import os
+        name = self._check_name(_text(body["name"]))
+        seq = int(body["seq"])
+        path = os.path.join(self.journal_dir, f"{name}.snap")
+        tmp = path + ".tmp"
+        from ytsaurus_tpu import yson
+        with open(tmp, "wb") as f:
+            f.write(yson.dumps({"seq": seq}, binary=True))
+            f.write(b"\n")
+            f.write(attachments[0])
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return {}
+
+    @rpc_method()
+    def snapshot_get(self, body, attachments):
+        import os
+        name = self._check_name(_text(body["name"]))
+        path = os.path.join(self.journal_dir, f"{name}.snap")
+        if not os.path.exists(path):
+            return {"seq": None}
+        from ytsaurus_tpu import yson
+        with open(path, "rb") as f:
+            data = f.read()
+        head, _, blob = data.partition(b"\n")
+        meta = yson.loads(head)
+        return {"seq": int(meta["seq"])}, [blob]
+
+
+class NodeTracker:
+    """Alive-node registry kept by the primary (heartbeat-driven).
+
+    Nodes have STABLE ids (their store identity) and ephemeral addresses;
+    journal placement binds to ids, chunk reads resolve addresses live."""
+
+    def __init__(self, liveness_timeout: float = 15.0):
+        self._nodes: dict[str, tuple[str, float]] = {}   # id → (addr, t)
+        self._lock = threading.Lock()
+        self.liveness_timeout = liveness_timeout
+
+    def heartbeat(self, node_id: str, address: str) -> None:
+        with self._lock:
+            self._nodes[node_id] = (address, time.monotonic())
+
+    def alive(self) -> dict[str, str]:
+        now = time.monotonic()
+        with self._lock:
+            return {i: a for i, (a, t) in sorted(self._nodes.items())
+                    if now - t < self.liveness_timeout}
+
+    def alive_nodes(self) -> list[str]:
+        return list(self.alive().values())
+
+    def drop(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+
+class NodeTrackerService(Service):
+    name = "node_tracker"
+
+    def __init__(self, tracker: NodeTracker):
+        self.tracker = tracker
+
+    @rpc_method()
+    def heartbeat(self, body, attachments):
+        self.tracker.heartbeat(_text(body.get("id") or body["address"]),
+                               _text(body["address"]))
+        return {"alive": self.tracker.alive_nodes()}
+
+    @rpc_method()
+    def list_nodes(self, body, attachments):
+        return {"alive": self.tracker.alive_nodes(),
+                "nodes": self.tracker.alive()}
+
+
+class DriverService(Service):
+    """The proxy: executes driver commands against the server-side client.
+
+    Transactions are tx-id based across the wire (the client cannot hold a
+    live TabletTransaction object); the registry maps ids to live tx state,
+    like the reference's transaction leases on the proxy."""
+
+    name = "driver"
+
+    def __init__(self, client):
+        from ytsaurus_tpu.driver import Driver
+        self.client = client
+        self.driver = Driver(client)
+        self._transactions: dict[str, object] = {}
+        self._tx_lock = threading.Lock()
+
+    @rpc_method()
+    def ping(self, body, attachments):
+        return {"ok": True}
+
+    @rpc_method(concurrency=8)
+    def execute(self, body, attachments):
+        command = _text(body["command"])
+        parameters = body.get("parameters") or {}
+        if attachments:
+            # Bulk row payloads (formatted write_table bodies) ride as
+            # attachments, not YSON parameters.
+            parameters = dict(parameters)
+            parameters["rows"] = attachments[0]
+        result = self.driver.execute(command, parameters)
+        if isinstance(result, bytes):
+            return {"kind": "blob"}, [result]
+        return {"kind": "value", "result": result}
+
+    # -- transactions over the wire -------------------------------------------
+
+    def _tx(self, tx_id: str):
+        with self._tx_lock:
+            tx = self._transactions.get(tx_id)
+        if tx is None:
+            raise YtError(f"No such transaction {tx_id}",
+                          code=EErrorCode.NoSuchTransaction)
+        return tx
+
+    @rpc_method()
+    def start_transaction(self, body, attachments):
+        tx = self.client.start_transaction()
+        with self._tx_lock:
+            self._transactions[tx.id] = tx
+        return {"tx_id": tx.id, "start_timestamp": tx.start_timestamp}
+
+    @rpc_method()
+    def commit_transaction(self, body, attachments):
+        tx_id = _text(body["tx_id"])
+        tx = self._tx(tx_id)
+        try:
+            ts = self.client.commit_transaction(tx)
+        finally:
+            with self._tx_lock:
+                self._transactions.pop(tx_id, None)
+        return {"commit_timestamp": ts}
+
+    @rpc_method()
+    def abort_transaction(self, body, attachments):
+        tx_id = _text(body["tx_id"])
+        tx = self._tx(tx_id)
+        try:
+            self.client.abort_transaction(tx)
+        finally:
+            with self._tx_lock:
+                self._transactions.pop(tx_id, None)
+        return {}
+
+    @rpc_method()
+    def insert_rows_tx(self, body, attachments):
+        tx = self._tx(_text(body["tx_id"]))
+        self.client.insert_rows(_text(body["path"]), body["rows"], tx=tx)
+        return {}
+
+    @rpc_method()
+    def delete_rows_tx(self, body, attachments):
+        tx = self._tx(_text(body["tx_id"]))
+        keys = [tuple(k) for k in body["keys"]]
+        self.client.delete_rows(_text(body["path"]), keys, tx=tx)
+        return {}
