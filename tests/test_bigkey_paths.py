@@ -1,0 +1,115 @@
+"""Randomized oracle tests for the >dense-cap GROUP BY (hash-major) and
+packed-key ORDER BY paths — the regimes where full lexsorts collapsed on
+TPU (round-1 finding; VERDICT item 4)."""
+
+import numpy as np
+import pytest
+
+from tests.harness import evaluate
+from ytsaurus_tpu.chunks import ColumnarChunk
+from ytsaurus_tpu.schema import TableSchema
+
+T = "//t"
+
+
+def test_groupby_cardinality_beyond_dense_cap():
+    # 200k distinct keys > 65536 dense-slot cap → hash-major general path.
+    rng = np.random.default_rng(7)
+    n = 400_000
+    g = rng.integers(0, 200_000, n)
+    v = rng.integers(0, 100, n)
+    schema = TableSchema.make([("k", "int64", "ascending"), ("g", "int64"),
+                               ("v", "int64")])
+    chunk = ColumnarChunk.from_arrays(schema, {
+        "k": np.arange(n), "g": g, "v": v})
+    rows = evaluate(f"g, sum(v) AS s, count(*) AS c FROM [{T}] GROUP BY g",
+                    {T: chunk})
+    # numpy oracle
+    import collections
+    want_s = collections.Counter()
+    want_c = collections.Counter()
+    for gi, vi in zip(g.tolist(), v.tolist()):
+        want_s[gi] += vi
+        want_c[gi] += 1
+    assert len(rows) == len(want_s)
+    got = {r["g"]: (r["s"], r["c"]) for r in rows}
+    assert len(got) == len(rows), "duplicate group keys in output"
+    for gi in want_s:
+        assert got[gi] == (want_s[gi], want_c[gi])
+
+
+def test_groupby_multikey_with_nulls_hash_path():
+    rng = np.random.default_rng(3)
+    n = 50_000
+    rows_in = []
+    for i in range(n):
+        a = int(rng.integers(0, 300)) if rng.random() > 0.1 else None
+        b = int(rng.integers(0, 300)) * 7 - 1000 if rng.random() > 0.1 \
+            else None
+        rows_in.append((i, a, b, int(rng.integers(0, 10))))
+    tables = {T: ([("k", "int64", "ascending"), ("a", "int64"),
+                   ("b", "int64"), ("v", "int64")], rows_in)}
+    rows = evaluate(f"a, b, sum(v) AS s FROM [{T}] GROUP BY a, b", tables)
+    import collections
+    want = collections.Counter()
+    for _, a, b, v in rows_in:
+        want[(a, b)] += v
+    assert len(rows) == len(want)
+    got = {(r["a"], r["b"]): r["s"] for r in rows}
+    assert got == dict(want)
+
+
+def test_orderby_two_keys_mixed_direction_with_nulls():
+    rng = np.random.default_rng(5)
+    n = 20_000
+    rows_in = []
+    for i in range(n):
+        a = int(rng.integers(0, 50)) if rng.random() > 0.05 else None
+        d = float(rng.normal()) if rng.random() > 0.05 else None
+        rows_in.append((i, a, d))
+    tables = {T: ([("k", "int64", "ascending"), ("a", "int64"),
+                   ("d", "double")], rows_in)}
+    rows = evaluate(
+        f"k, a, d FROM [{T}] ORDER BY a ASC, d DESC LIMIT 500",
+        {T: ([("k", "int64", "ascending"), ("a", "int64"),
+              ("d", "double")], rows_in)})
+    # Oracle: null-first asc on a; within, desc d with nulls LAST.
+    def key(r):
+        i, a, d = r
+        return (0 if a is None else 1, a if a is not None else 0,
+                1 if d is None else 0, -(d if d is not None else 0.0))
+    want = sorted(rows_in, key=key)[:500]
+    got = [(r["k"], r["a"], r["d"]) for r in rows]
+    for (gk, ga, gd), (wk, wa, wd) in zip(got, want):
+        assert (ga, gd is None) == (wa, wd is None)
+        if gd is not None:
+            assert abs(gd - wd) < 1e-12
+
+
+def test_orderby_float_negative_zero_and_inf():
+    vals = [0.0, -0.0, float("inf"), float("-inf"), 2.5, -2.5, None]
+    tables = {T: ([("k", "int64", "ascending"), ("d", "double")],
+                  [(i, v) for i, v in enumerate(vals)])}
+    rows = evaluate(f"k FROM [{T}] ORDER BY d ASC LIMIT 7", tables)
+    order = [r["k"] for r in rows]
+    # null first, then -inf, -2.5, (-0.0 / 0.0 in either order), 2.5, inf
+    assert order[0] == 6 and order[1] == 3 and order[2] == 5
+    assert set(order[3:5]) == {0, 1}
+    assert order[5] == 4 and order[6] == 2
+
+
+def test_sort_chunk_descending_with_nulls_and_strings():
+    from ytsaurus_tpu.operations.sort_op import sort_chunk
+    rng = np.random.default_rng(11)
+    n = 5000
+    words = [b"w%04d" % i for i in range(200)]
+    s = [words[int(rng.integers(0, 200))] if rng.random() > 0.1 else None
+         for _ in range(n)]
+    schema = TableSchema.make([("s", "string"), ("v", "int64")])
+    chunk = ColumnarChunk.from_rows(
+        schema, [(si, i) for i, si in enumerate(s)])
+    out = sort_chunk(chunk, ["s"], descending=True)
+    got = [r["s"] for r in out.to_rows()]
+    want = sorted(s, key=lambda x: (x is None, () if x is None else
+                                    tuple(-b for b in x)))
+    assert got == want

@@ -35,6 +35,8 @@ class FakeJournalChannel:
             return {"count": len(self.records)}, []
         if method == "journal_read":
             return {"records": list(self.records)}, []
+        if method == "journal_count":
+            return {"count": len(self.records)}, []
         if method == "journal_reset":
             self.records.clear()
             return {}, []
@@ -53,7 +55,7 @@ class FakeJournalChannel:
 def wal3(tmp_path):
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
     wal = QuorumWal(str(tmp_path / "wal.log"), "master_wal", remotes,
-                    quorum=2)
+                    quorum=2, bootstrap_from_local=True)
     wal.recover()
     return wal, remotes
 
@@ -74,7 +76,8 @@ def test_append_tolerates_one_location_down(wal3):
 
 def test_append_refuses_below_quorum(tmp_path):
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
-    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=3)
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=3,
+                    bootstrap_from_local=True)
     wal.recover()
     remotes[0].down = True
     with pytest.raises(YtError) as ei:
@@ -84,7 +87,8 @@ def test_append_refuses_below_quorum(tmp_path):
 
 def test_recover_from_remote_majority_after_local_loss(tmp_path):
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
-    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
     wal.recover()
     for i in range(5):
         wal.append({"op": "set", "args": {"n": i}})
@@ -97,7 +101,8 @@ def test_recover_from_remote_majority_after_local_loss(tmp_path):
 
 def test_recover_discards_unconfirmed_tail(tmp_path):
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
-    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
     wal.recover()
     for i in range(3):
         wal.append({"op": "set", "args": {"n": i}})
@@ -112,7 +117,8 @@ def test_recover_discards_unconfirmed_tail(tmp_path):
 
 def test_recover_catches_up_lagging_replica(tmp_path):
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
-    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
     wal.recover()
     for i in range(4):
         wal.append({"op": "set", "args": {"n": i}})
@@ -125,7 +131,8 @@ def test_recover_catches_up_lagging_replica(tmp_path):
 
 def test_recover_refuses_below_quorum(tmp_path):
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
-    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
     wal.recover()
     wal.append({"op": "set"})
     remotes[0].down = True
@@ -140,7 +147,8 @@ def test_no_holes_replica_down_then_up(tmp_path):
     accept later appends (hole) and must not cause loss of a
     quorum-acknowledged record in recovery."""
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
-    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
     wal.recover()
     remotes[0].down = True
     wal.append({"op": "set", "args": {"n": 1}})     # local + B ack
@@ -157,7 +165,8 @@ def test_no_holes_replica_down_then_up(tmp_path):
 
 def test_unsynced_replica_earns_no_quorum_credit(tmp_path):
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
-    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=3)
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=3,
+                    bootstrap_from_local=True)
     wal.recover()
     wal.append({"op": "set", "args": {"n": 1}})
     # A silently loses its log AND rejects catch-up: no ack possible.
@@ -171,7 +180,8 @@ def test_snapshot_survives_local_disk_loss(tmp_path):
     from ytsaurus_tpu.cypress.master import Master
     remotes = [FakeJournalChannel(), FakeJournalChannel()]
     m1_dir = tmp_path / "m1"
-    wal = QuorumWal(str(m1_dir / "changelog.log"), "j", remotes, quorum=2)
+    wal = QuorumWal(str(m1_dir / "changelog.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
     m1_dir.mkdir()
     m1 = Master(str(m1_dir), wal=wal)
     m1.commit_mutation("create", path="//a", type="map_node")
@@ -185,3 +195,68 @@ def test_snapshot_survives_local_disk_loss(tmp_path):
     m2 = Master(str(m2_dir), wal=wal2)
     assert m2.tree.get("//a/@x") == 7
     assert m2.tree.get("//a/@y") == 8
+
+
+class FakeJournalChannelV2(FakeJournalChannel):
+    """Adds the initialized-tracking + journal_count surface."""
+
+    def __init__(self):
+        super().__init__()
+        self.initialized = False
+
+    def call(self, service, method, body=None, attachments=(), **kw):
+        if self.down:
+            raise YtError("down", code=EErrorCode.TransportError)
+        if method == "journal_read":
+            return {"records": list(self.records),
+                    "initialized": self.initialized}, []
+        if method == "journal_count":
+            return {"count": len(self.records),
+                    "initialized": self.initialized}, []
+        if method == "journal_append":
+            self.initialized = True
+        if method == "journal_reset":
+            self.initialized = True
+        return super().call(service, method, body, attachments, **kw)
+
+
+def test_fresh_remote_journals_cannot_outvote_local_history(tmp_path):
+    """Reviewer scenario A: local-only history upgraded to quorum must NOT
+    be truncated by the empty (uninitialized) remote journals."""
+    path = str(tmp_path / "w.log")
+    from ytsaurus_tpu.cypress.quorum import LocalWal
+    lw = LocalWal(path)
+    lw.recover()
+    for i in range(4):
+        lw.append({"op": "set", "args": {"n": i}})
+    lw.close()
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2()]
+    wal = QuorumWal(path, "j", remotes, quorum=2, bootstrap_from_local=True)
+    records = wal.recover()
+    assert [r["args"]["n"] for r in records] == [0, 1, 2, 3]
+    # Replicas got seeded.
+    assert [r["args"]["n"] for r in remotes[0].records] == [0, 1, 2, 3]
+
+
+def test_wiped_local_cannot_vote_empty_prefix(tmp_path):
+    """Reviewer scenario B: a replaced local disk must not outvote a
+    replica holding acknowledged records; with only one initialized
+    replica reachable, recovery REFUSES instead of losing data."""
+    path = str(tmp_path / "w.log")
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2()]
+    wal = QuorumWal(path, "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
+    wal.recover()
+    wal.append({"op": "set", "args": {"n": 1}})
+    # Wipe local entirely (changelog + init marker); one replica down.
+    import os
+    os.unlink(path)
+    os.unlink(path + ".init")
+    remotes[1].down = True
+    fresh = QuorumWal(str(tmp_path / "w2.log"), "j", remotes, quorum=2)
+    with pytest.raises(YtError):
+        fresh.recover()
+    # With both replicas up, the acknowledged record survives.
+    remotes[1].down = False
+    fresh2 = QuorumWal(str(tmp_path / "w3.log"), "j", remotes, quorum=2)
+    assert [r["args"]["n"] for r in fresh2.recover()] == [1]

@@ -55,12 +55,7 @@ class FsChunkStore:
                     erasure: Optional[str] = None) -> str:
         chunk_id = chunk_id or new_chunk_id()
         blob = serialize_chunk(chunk, codec or self.codec)
-        if erasure is not None:
-            return self._write_erasure(chunk_id, blob, erasure)
-        path = self._path(chunk_id)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        self._atomic_write(path, blob)
-        return chunk_id
+        return self.put_blob(chunk_id, blob, erasure=erasure)
 
     def _atomic_write(self, path: str, blob: bytes) -> None:
         tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"

@@ -30,14 +30,30 @@ logger = get_logger("quorum")
 
 
 class LocalWal:
-    """Single-location WAL: today's fsync'd changelog file."""
+    """Single-location WAL: today's fsync'd changelog file.
+
+    A `.init` marker distinguishes "this location has legitimately empty
+    history" from "this is a fresh disk that never saw the log" — a fresh
+    disk must NOT vote a zero-length prefix in quorum recovery (it would
+    truncate acknowledged records)."""
 
     def __init__(self, path: str):
         self.path = path
         self._log: Optional[Changelog] = None
+        self.was_initialized = os.path.exists(path + ".init") or \
+            os.path.exists(path)
+
+    def _mark_initialized(self) -> None:
+        marker = self.path + ".init"
+        if not os.path.exists(marker):
+            os.makedirs(os.path.dirname(marker) or ".", exist_ok=True)
+            with open(marker, "wb") as f:
+                f.flush()
+                os.fsync(f.fileno())
 
     def recover(self) -> list[dict]:
         records, valid = Changelog.read_all(self.path)
+        self._mark_initialized()
         # Drop a torn tail so future appends stay recoverable.
         if os.path.exists(self.path) and \
                 os.path.getsize(self.path) > valid:
@@ -80,11 +96,16 @@ class QuorumWal:
     """WAL over one local location + remote journal locations."""
 
     def __init__(self, local_path: str, journal_name: str,
-                 remote_channels: list, quorum: int = 2):
+                 remote_channels: list, quorum: int = 2,
+                 bootstrap_from_local: bool = False):
         self.local = LocalWal(local_path)
         self.journal_name = journal_name
         self.replicas = [_Replica(ch) for ch in remote_channels]
         self.quorum = quorum
+        # True exactly when this quorum configuration is being adopted for
+        # the first time over an existing single-location log: the local
+        # history is authoritative and seeds the replicas.
+        self.bootstrap_from_local = bootstrap_from_local
         if quorum > 1 + len(self.replicas):
             raise YtError(f"quorum {quorum} unreachable with "
                           f"{1 + len(self.replicas)} locations")
@@ -96,10 +117,13 @@ class QuorumWal:
         """Bring one replica to the full committed log; True on success."""
         try:
             if replica.synced_len is None:
+                # Length-only probe; the position-checked append protocol
+                # guarantees the replica holds a prefix, so the count alone
+                # decides between catch-up and tail discard.
                 body, _ = replica.channel.call(
-                    "data_node", "journal_read",
+                    "data_node", "journal_count",
                     {"journal": self.journal_name})
-                have = len(body.get("records", []))
+                have = int(body.get("count", 0))
                 if have > len(self._records):
                     # Longer than the committed log → uncommitted tail from
                     # a previous incarnation; discard it.
@@ -161,26 +185,45 @@ class QuorumWal:
     # -- recovery --------------------------------------------------------------
 
     def recover(self) -> list[dict]:
-        lists: list[Optional[list]] = [self.local.recover()]
-        reachable = 1
+        local_initialized = self.local.was_initialized
+        local_records = self.local.recover()
+        if self.bootstrap_from_local:
+            # First adoption of this quorum config: local history (possibly
+            # written under a local-only WAL) is authoritative.
+            self._records = list(local_records)
+            for replica in self.replicas:
+                replica.synced_len = None
+                self._catch_up(replica)
+            return list(self._records)
+        lists: list[Optional[list]] = [
+            local_records if local_initialized else None]
+        if not local_initialized and local_records:
+            raise YtError("local WAL has records but no init marker")
         for replica in self.replicas:
             try:
                 body, _ = replica.channel.call(
                     "data_node", "journal_read",
                     {"journal": self.journal_name})
+                if not body.get("initialized", True):
+                    # A journal this data node never held must not vote a
+                    # zero-length prefix (fresh node disk).
+                    lists.append(None)
+                    continue
                 lists.append(list(body.get("records", [])))
-                reachable += 1
             except YtError as err:
                 logger.warning("journal location unreachable in recovery: "
                                "%s", err)
                 lists.append(None)
-        if reachable < self.quorum:
+        voting = sum(1 for lst in lists if lst is not None)
+        if voting < self.quorum:
             raise YtError(
-                f"cannot recover: {reachable}/{self.quorum} WAL locations "
-                "reachable", code=EErrorCode.PeerUnavailable)
-        # Longest prefix confirmed by >= quorum locations.  Position-checked
-        # appends guarantee each location IS a prefix, so length comparison
-        # is sound.
+                f"cannot recover: {voting}/{self.quorum} initialized WAL "
+                "locations reachable (a fresh/wiped location cannot vote; "
+                "bring more journal owners online)",
+                code=EErrorCode.PeerUnavailable)
+        # Longest prefix confirmed by >= quorum voting locations.
+        # Position-checked appends guarantee each location IS a prefix, so
+        # length comparison is sound.
         lengths = sorted((len(lst) for lst in lists if lst is not None),
                          reverse=True)
         committed = lengths[self.quorum - 1]

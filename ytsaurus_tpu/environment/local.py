@@ -70,12 +70,12 @@ class LocalCluster:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"          # daemons never need the chip
         env.pop("XLA_FLAGS", None)
-        log = open(os.path.join(root, "daemon.log"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ytsaurus_tpu.server.daemon", *args],
-            stdout=log, stderr=subprocess.STDOUT, env=env,
-            cwd=os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))))
+        with open(os.path.join(root, "daemon.log"), "ab") as log:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ytsaurus_tpu.server.daemon", *args],
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))))
         self._procs.append(proc)
 
     def _wait_port(self, root: str, role: str, deadline: float) -> int:

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
 from ytsaurus_tpu.errors import EErrorCode, YtError
-from ytsaurus_tpu.ops.segments import lexsort_indices, sort_key_planes
+from ytsaurus_tpu.ops.segments import packed_sort_indices
 from ytsaurus_tpu.schema import SortOrder, TableSchema
 
 
@@ -27,12 +27,17 @@ def sort_chunk(chunk: ColumnarChunk, key_columns: Sequence[str],
             raise YtError(f"No such sort column {name!r}",
                           code=EErrorCode.QueryTypeError)
     mask = chunk.row_valid
-    sort_keys = []
-    for name in reversed(list(key_columns)):
+    # Packed composite keys: the device sort carries the fewest possible
+    # u64 operands (mask bit + null/value fields); payload columns are
+    # gathered by the permutation afterwards.
+    items = [((~mask), jnp.ones_like(mask), False, 1)]
+    for name in key_columns:
         col = chunk.column(name)
-        sort_keys.extend(sort_key_planes(col.data, col.valid, descending))
-    sort_keys.append((~mask).astype(jnp.int8))
-    order = lexsort_indices(sort_keys)
+        dictionary = getattr(col, "dictionary", None)
+        bits = max(len(dictionary) - 1, 1).bit_length() \
+            if dictionary is not None else 64
+        items.append((col.data, col.valid, descending, bits))
+    order = packed_sort_indices(items)
     columns = {}
     for name, col in chunk.columns.items():
         host_values = None

@@ -99,10 +99,48 @@ def _dense_segment_reduce(function: str, data: jax.Array, seg_ids: jax.Array,
     return jax.vmap(one)(sids)
 
 
+def _sorted_segment_reduce(function: str, data: jax.Array,
+                           seg_ids: jax.Array, num_segments: int):
+    """Segment reduce for NONDECREASING seg_ids with no scatter: a
+    segmented associative scan (the combine resets at segment starts, so
+    float sums keep per-segment precision) + a searchsorted gather at each
+    segment's last row.  TPU scatter-adds serialize (~130 ms per 2M-row
+    f64 plane measured on v5e); log-depth scans and gathers do not."""
+    cap = data.shape[0]
+    starts = jnp.concatenate([
+        jnp.ones(1, dtype=bool), seg_ids[1:] != seg_ids[:-1]])
+    if function == "sum":
+        combine_val = lambda a, b: a + b
+    elif function == "min":
+        combine_val = jnp.minimum
+    elif function == "max":
+        combine_val = jnp.maximum
+    else:
+        raise ValueError(function)
+
+    def combine(x, y):
+        xv, xf = x
+        yv, yf = y
+        return jnp.where(yf, yv, combine_val(xv, yv)), xf | yf
+
+    scanned, _ = jax.lax.associative_scan(combine, (data, starts))
+    sids = jnp.arange(num_segments, dtype=seg_ids.dtype)
+    left = jnp.searchsorted(seg_ids, sids, side="left")
+    right = jnp.searchsorted(seg_ids, sids, side="right")
+    out = scanned[jnp.clip(right - 1, 0, cap - 1)]
+    if function == "sum":
+        neutral = jnp.zeros((), dtype=data.dtype)
+    else:
+        neutral = _reduce_neutral(data.dtype, function)
+    return jnp.where(right > left, out, neutral)
+
+
 def _segment_reduce(function: str, data: jax.Array, seg_ids: jax.Array,
-                    num_segments: int):
+                    num_segments: int, assume_sorted: bool = False):
     if num_segments <= _DENSE_SEGMENT_LIMIT:
         return _dense_segment_reduce(function, data, seg_ids, num_segments)
+    if assume_sorted:
+        return _sorted_segment_reduce(function, data, seg_ids, num_segments)
     if function == "sum":
         return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
     if function == "min":
@@ -114,30 +152,38 @@ def _segment_reduce(function: str, data: jax.Array, seg_ids: jax.Array,
 
 def segment_aggregate(function: str, data: jax.Array, valid: jax.Array,
                       seg_ids: jax.Array, num_segments: int,
-                      value_type: EValueType) -> tuple[jax.Array, jax.Array]:
+                      value_type: EValueType,
+                      assume_sorted: bool = False
+                      ) -> tuple[jax.Array, jax.Array]:
     """Aggregate `data` per segment, skipping nulls. Returns (out, out_valid)
-    planes of length num_segments (static capacity)."""
+    planes of length num_segments (static capacity).  assume_sorted=True
+    (nondecreasing seg_ids — the hash-grouped general path) switches to the
+    scatter-free segmented-scan reduction."""
     contributes = valid
     count = _segment_reduce(
-        "sum", contributes.astype(jnp.int64), seg_ids, num_segments)
+        "sum", contributes.astype(jnp.int64), seg_ids, num_segments,
+        assume_sorted)
     any_valid = count > 0
     if function == "count":
         return count, jnp.ones_like(any_valid)
     if function == "sum":
         masked = jnp.where(contributes, data, jnp.zeros_like(data))
-        out = _segment_reduce("sum", masked, seg_ids, num_segments)
+        out = _segment_reduce("sum", masked, seg_ids, num_segments,
+                              assume_sorted)
         return out, any_valid
     if function == "min" or function == "max":
         if data.dtype == jnp.bool_:
             data = data.astype(jnp.int8)
         neutral = _reduce_neutral(data.dtype, function)
         masked = jnp.where(contributes, data, neutral)
-        out = _segment_reduce(function, masked, seg_ids, num_segments)
+        out = _segment_reduce(function, masked, seg_ids, num_segments,
+                              assume_sorted)
         if value_type is EValueType.boolean:
             out = out.astype(jnp.bool_)
         return out, any_valid
     if function == "first":
-        first_idx = _segment_first_index(contributes, seg_ids, num_segments)
+        first_idx = _segment_first_index(contributes, seg_ids, num_segments,
+                                         assume_sorted)
         return data[first_idx], any_valid
     raise ValueError(f"Unknown segment aggregate {function!r}")
 
@@ -150,19 +196,23 @@ def _reduce_neutral(dtype, function: str):
 
 
 def _segment_first_index(eligible: jax.Array, seg_ids: jax.Array,
-                         num_segments: int) -> jax.Array:
+                         num_segments: int,
+                         assume_sorted: bool = False) -> jax.Array:
     """First row index per segment among `eligible` rows (clipped sentinel
     when a segment has none — callers must mask validity separately)."""
     cap = eligible.shape[0]
     idx = jnp.where(eligible, jnp.arange(cap), cap - 1)
-    first = _segment_reduce("min", idx, seg_ids, num_segments)
+    first = _segment_reduce("min", idx, seg_ids, num_segments,
+                            assume_sorted)
     return jnp.clip(first, 0, cap - 1)
 
 
 def segment_arg_by(value_data: jax.Array, value_valid: jax.Array,
                    by_data: jax.Array, by_valid: jax.Array,
                    seg_ids: jax.Array, num_segments: int,
-                   take_max: bool) -> tuple[jax.Array, jax.Array]:
+                   take_max: bool,
+                   assume_sorted: bool = False
+                   ) -> tuple[jax.Array, jax.Array]:
     """Per segment: the value at the row whose `by` key is smallest/largest
     (argmin/argmax; rows with null or NaN `by` don't compete; ties take the
     first row)."""
@@ -176,11 +226,14 @@ def segment_arg_by(value_data: jax.Array, value_valid: jax.Array,
     fn = "max" if take_max else "min"
     neutral = _reduce_neutral(by_data.dtype, fn)
     masked_by = jnp.where(competes, by_data, neutral)
-    extreme = _segment_reduce(fn, masked_by, seg_ids, num_segments)
+    extreme = _segment_reduce(fn, masked_by, seg_ids, num_segments,
+                              assume_sorted)
     winner = competes & (masked_by == extreme[seg_ids])
-    first_idx = _segment_first_index(winner, seg_ids, num_segments)
+    first_idx = _segment_first_index(winner, seg_ids, num_segments,
+                                     assume_sorted)
     any_competes = _segment_reduce(
-        "sum", competes.astype(jnp.int64), seg_ids, num_segments) > 0
+        "sum", competes.astype(jnp.int64), seg_ids, num_segments,
+        assume_sorted) > 0
     return value_data[first_idx], value_valid[first_idx] & any_competes
 
 
@@ -220,7 +273,9 @@ def segment_distinct_count(data: jax.Array, valid: jax.Array,
         (valid_s != prev_valid) | (nan_s != prev_nan)
     new_value = new_value.at[0].set(True)
     flags = (new_value & valid_s).astype(jnp.int64)
-    counts = _segment_reduce("sum", flags, seg_s, num_segments)
+    # seg_s is the major sort key above, so it is nondecreasing.
+    counts = _segment_reduce("sum", flags, seg_s, num_segments,
+                             assume_sorted=True)
     return counts.astype(jnp.uint64), jnp.ones(num_segments, dtype=bool)
 
 
@@ -228,3 +283,119 @@ def compact_mask(mask: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Indices that move in-mask rows to the front (stable); plus count."""
     order = jnp.argsort(~mask, stable=True)
     return order, jnp.sum(mask.astype(jnp.int64))
+
+
+# --- packed sort keys ---------------------------------------------------------
+#
+# lax.sort moves EVERY operand plane through the whole sort network, so the
+# cost of a lexsort grows with plane count x plane width.  The planes from
+# sort_key_planes (value + null per key, plus the row mask) are collapsed
+# here into as few u64 words as possible via order-preserving bit packing:
+# a two-dict-key ORDER BY + mask becomes ONE u64 operand instead of five.
+# (The reference's row comparers JIT a composite comparator instead —
+# row_comparer_api; on TPU the composite KEY is the idiomatic equivalent.)
+
+_SIGN64 = np.uint64(1 << 63)
+
+
+def monotone_u64(data: jax.Array, valid: jax.Array) -> jax.Array:
+    """Order-preserving full-width u64 encoding of one value plane.
+    Floats use the IEEE total-order flip (NaN sorts above +inf, matching
+    XLA's total-order float comparator)."""
+    if data.dtype == jnp.bool_:
+        enc = data.astype(jnp.uint64)
+    elif jnp.issubdtype(data.dtype, jnp.floating):
+        bits = jax.lax.bitcast_convert_type(
+            data.astype(jnp.float64), jnp.uint64)
+        sign = (bits >> np.uint64(63)).astype(bool)
+        enc = jnp.where(sign, ~bits, bits | _SIGN64)
+    elif jnp.issubdtype(data.dtype, jnp.unsignedinteger):
+        enc = data.astype(jnp.uint64)
+    else:
+        enc = data.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN64
+    return jnp.where(valid, enc, jnp.zeros_like(enc))
+
+
+def pack_key_planes(items) -> list[jax.Array]:
+    """items: (data, valid, descending, value_bits) MAJOR key first.
+
+    value_bits < 64 asserts the encoded value fits [0, 2^bits) (dictionary
+    codes, booleans); 64 means full-width monotone_u64.  Each field carries
+    a null bit above its value (ascending: null sorts first; descending:
+    null sorts last — YT comparator semantics).  Returns u64 planes,
+    major word first; feed reversed() to jnp.lexsort."""
+    words: list[jax.Array] = []
+    bits_left = 0
+    for data, valid, descending, value_bits in items:
+        if value_bits >= 64:
+            enc = monotone_u64(data, valid)
+            if descending:
+                enc = jnp.where(valid, ~enc, jnp.zeros_like(enc))
+            null_plane = ((~valid) if descending else valid).astype(
+                jnp.uint64)
+            # 1-bit null field packs with neighbors; the 64-bit value
+            # takes a full word of its own (must stay less significant
+            # than its null bit).
+            fields = [(null_plane, 1), (enc, 64)]
+        else:
+            enc = data.astype(jnp.uint64) & np.uint64(
+                (1 << value_bits) - 1)
+            if descending:
+                enc = np.uint64((1 << value_bits) - 1) - enc
+            enc = jnp.where(valid, enc, jnp.zeros_like(enc))
+            null_plane = ((~valid) if descending else valid).astype(
+                jnp.uint64)
+            fields = [((null_plane << np.uint64(value_bits)) | enc,
+                       value_bits + 1)]
+        for plane, width in fields:
+            if width > bits_left:
+                words.append(jnp.zeros_like(plane))
+                bits_left = 64
+            bits_left -= width
+            words[-1] = words[-1] | (plane << np.uint64(bits_left))
+    return words
+
+
+def packed_sort_indices(items) -> jax.Array:
+    """Stable ascending argsort over packed key fields (major first)."""
+    words = pack_key_planes(items)
+    return jnp.lexsort(list(reversed(words)))
+
+
+# --- hash-major grouping ------------------------------------------------------
+
+def _group_hash(data: jax.Array, valid: jax.Array,
+                seed: np.uint64) -> jax.Array:
+    x = data.astype(jnp.uint64) if not jnp.issubdtype(
+        data.dtype, jnp.floating) else jax.lax.bitcast_convert_type(
+        data.astype(jnp.float64), jnp.uint64)
+    x = jnp.where(valid, x, np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(33))) * (np.uint64(0xFF51AFD7ED558CCD) ^ seed)
+    x = (x ^ (x >> np.uint64(29))) * np.uint64(0xC4CEB9FE1A85EC53)
+    return x ^ (x >> np.uint64(32)) ^ (valid.astype(jnp.uint64) <<
+                                       np.uint64(63 - (int(seed) & 7)))
+
+
+def hash_group_order(key_planes, mask) -> jax.Array:
+    """Row ordering that makes equal group keys adjacent WITHOUT sorting
+    the key planes themselves: a 128-bit mix of every key plane is sorted
+    instead (2 u64 operands however many group keys there are).
+
+    Group identity rides on 128 hash bits: two distinct key tuples
+    colliding on both words (~2^-128-scale at realistic cardinalities,
+    same trust level as content-addressed storage) could fragment a group
+    into two output rows.  Boundaries are still computed by EXACT key
+    comparison downstream (segment_boundaries), so adjacent collisions
+    split correctly.  The analog of TGroupByClosure's hash table
+    (cg_routines/registry.cpp:1230) restructured for a batch device."""
+    h1 = jnp.zeros(mask.shape[0], dtype=jnp.uint64)
+    h2 = jnp.zeros(mask.shape[0], dtype=jnp.uint64)
+    for data, valid in key_planes:
+        h1 = (h1 ^ _group_hash(data, valid, np.uint64(0))) * \
+            np.uint64(0x100000001B3) + (h1 << np.uint64(7))
+        h2 = (h2 ^ _group_hash(data, valid, np.uint64(0xA5A5A5A5))) * \
+            np.uint64(0x1000193) + (h2 << np.uint64(11))
+    umax = np.uint64(0xFFFFFFFFFFFFFFFF)
+    h1 = jnp.where(mask, h1, umax)     # masked rows sort last
+    h2 = jnp.where(mask, h2, umax)
+    return jnp.lexsort([h2, h1])

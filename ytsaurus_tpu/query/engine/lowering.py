@@ -27,7 +27,9 @@ import numpy as np
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.ops.segments import (
     compact_mask,
+    hash_group_order,
     lexsort_indices,
+    packed_sort_indices,
     segment_aggregate,
     segment_boundaries,
     segment_arg_by,
@@ -319,12 +321,11 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                 mask = mask & v & d.astype(bool)
         elif group is not None:
             key_planes = [b.emit(ctx) for _, b in group_key_b]
-            # Sort: masked-out rows last, then lexicographic by keys.
-            sort_keys: list[jax.Array] = []
-            for data, valid in key_planes:
-                sort_keys.extend(sort_key_planes(data, valid))
-            sort_keys.append((~mask).astype(jnp.int8))   # major key: mask
-            order_idx = lexsort_indices(sort_keys)
+            # Hash-major grouping: the sort carries TWO u64 hash operands
+            # no matter how many group keys there are (a full lexsort of
+            # every key plane collapses on TPU beyond ~4M rows); exact
+            # boundaries are still computed on the real keys below.
+            order_idx = hash_group_order(key_planes, mask)
             sorted_mask = mask[order_idx]
             sorted_keys = [(d[order_idx], v[order_idx]) for d, v in key_planes]
             seg_ids, num_groups = segment_boundaries(sorted_keys, sorted_mask)
@@ -332,10 +333,11 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             for (name, _), (data, valid) in zip(group_key_b, sorted_keys):
                 out_d, _ = segment_aggregate("first", data, sorted_mask,
                                              seg_ids, capacity,
-                                             EValueType.null)
+                                             EValueType.null,
+                                             assume_sorted=True)
                 out_v, _ = segment_aggregate(
                     "first", valid.astype(jnp.int8), sorted_mask, seg_ids,
-                    capacity, EValueType.null)
+                    capacity, EValueType.null, assume_sorted=True)
                 new_columns[name] = (out_d, out_v.astype(bool))
             for agg, arg, by_arg in agg_arg_b:
                 if agg.function == "avg":
@@ -343,9 +345,11 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                     data = data[order_idx].astype(jnp.float64)
                     valid = valid[order_idx] & sorted_mask
                     s, sv = segment_aggregate("sum", data, valid, seg_ids,
-                                              capacity, EValueType.double)
+                                              capacity, EValueType.double,
+                                              assume_sorted=True)
                     c, _ = segment_aggregate("count", data, valid, seg_ids,
-                                             capacity, EValueType.int64)
+                                             capacity, EValueType.int64,
+                                             assume_sorted=True)
                     cnt = jnp.maximum(c, 1)
                     new_columns[agg.name] = (s / cnt, sv)
                 elif agg.function == "cardinality":
@@ -361,14 +365,16 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                         vd[order_idx], vv[order_idx],
                         bd[order_idx], bv[order_idx] & sorted_mask,
                         seg_ids, capacity,
-                        take_max=(agg.function == "argmax"))
+                        take_max=(agg.function == "argmax"),
+                        assume_sorted=True)
                     new_columns[agg.name] = (out_d, out_v)
                 else:
                     data, valid = arg.emit(ctx)
                     data = data[order_idx]
                     valid = valid[order_idx] & sorted_mask
                     out, out_v = segment_aggregate(
-                        agg.function, data, valid, seg_ids, capacity, agg.type)
+                        agg.function, data, valid, seg_ids, capacity,
+                        agg.type, assume_sorted=True)
                     new_columns[agg.name] = (out, out_v)
             mask = jnp.arange(capacity) < num_groups
             ctx = EmitContext(columns=new_columns, bindings=bindings,
@@ -422,14 +428,16 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                     bindings=bindings, capacity=cand_cap)
                 mask = mask[cand_sorted] & ~dup
                 stage_cap = cand_cap
-            # lexsort: last plane is most significant → first ORDER BY item
-            # must be emitted last.
-            sort_keys = []
-            for bound, descending in reversed(order_b):
+            # Packed composite sort key: masked-last bit + every ORDER BY
+            # item (null bit + order-preserving value bits) packed into as
+            # few u64 words as possible — minimum operands through the
+            # device sort network (payload columns are gathered after).
+            items = [((~mask), jnp.ones_like(mask), False, 1)]
+            for bound, descending in order_b:
                 data, valid = bound.emit(ctx)
-                sort_keys.extend(sort_key_planes(data, valid, descending))
-            sort_keys.append((~mask).astype(jnp.int8))
-            order_idx = lexsort_indices(sort_keys)
+                items.append((data, valid, descending,
+                              _order_key_bits(bound)))
+            order_idx = packed_sort_indices(items)
             ctx = EmitContext(
                 columns={name: (d[order_idx], v[order_idx])
                          for name, (d, v) in ctx.columns.items()},
@@ -460,6 +468,16 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
         out_capacity=topk_cand_cap if use_topk else group_stage_cap,
         structure_key=((("fastgrp",) + fast_group[0] if fast_group else ())
                        + (("topk", k_limit) if use_topk else ())))
+
+
+def _order_key_bits(bound: BoundExpr) -> int:
+    """Packed-key width for one ORDER BY item: dictionary codes and bools
+    need few bits; everything else is full-width."""
+    if bound.type is EValueType.boolean:
+        return 1
+    if bound.type is EValueType.string and bound.vocab is not None:
+        return max(len(bound.vocab) - 1, 1).bit_length()
+    return 64
 
 
 def _post_ref(name: str, bound: BoundExpr) -> BoundExpr:

@@ -41,3 +41,9 @@ def decode_body(value):
     if isinstance(value, bytes):
         return value.decode("utf-8")
     return value
+
+
+def wire_text(v) -> str:
+    """Wire value to str (strings arrive as utf-8 str already; bytes from
+    legacy peers decode)."""
+    return v.decode("utf-8", "replace") if isinstance(v, bytes) else str(v)

@@ -96,10 +96,15 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
                                     attempts=2, backoff=0.1)
                     for _, addr in sorted(chosen.items())]
         locations = 1 + len(channels)
+        # First adoption of this quorum config (we just wrote the journal
+        # membership): any existing local log predates the quorum and is
+        # authoritative — it seeds the replicas instead of being outvoted
+        # by their empty journals.
         wal = QuorumWal(os.path.join(master_dir, Master.CHANGELOG),
                         journal_name="master_wal",
                         remote_channels=channels,
-                        quorum=locations // 2 + 1)
+                        quorum=locations // 2 + 1,
+                        bootstrap_from_local=(wanted is None))
         print(f"quorum WAL over local + {sorted(chosen)} "
               f"(quorum {locations // 2 + 1}/{locations})", flush=True)
     master = Master(master_dir, wal=wal)

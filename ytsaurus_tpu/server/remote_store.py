@@ -23,6 +23,7 @@ from ytsaurus_tpu.chunks.encoding import (
 from ytsaurus_tpu.chunks.store import new_chunk_id
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc import Channel, RetryingChannel
+from ytsaurus_tpu.rpc.wire import wire_text as _text
 from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("chunk_client")
@@ -166,6 +167,3 @@ class RpcChunkStore:
             ch.close()
         self._channels.clear()
 
-
-def _text(v) -> str:
-    return v.decode() if isinstance(v, bytes) else str(v)

@@ -15,13 +15,10 @@ from typing import Optional
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc import Service, rpc_method
+from ytsaurus_tpu.rpc.wire import wire_text as _text
 from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("server")
-
-
-def _text(v) -> str:
-    return v.decode() if isinstance(v, bytes) else str(v)
 
 
 class DataNodeService(Service):
@@ -115,10 +112,26 @@ class DataNodeService(Service):
 
         from ytsaurus_tpu.cypress.master import Changelog
         name = self._check_name(_text(body["journal"]))
-        self._journal(name)        # open (truncates any torn tail)
         path = os.path.join(self.journal_dir, name + ".log")
+        # A journal this node never held must be reported as uninitialized
+        # BEFORE any auto-creating open: a fresh disk may not vote an empty
+        # prefix in quorum recovery.
+        if not os.path.exists(path) and name not in self._journals:
+            return {"records": [], "initialized": False}
+        self._journal(name)        # open (truncates any torn tail)
         records, _ = Changelog.read_all(path)
-        return {"records": records}
+        return {"records": records, "initialized": True}
+
+    @rpc_method()
+    def journal_count(self, body, attachments):
+        """Record count only — the cheap liveness/lag probe for catch-up."""
+        import os
+        name = self._check_name(_text(body["journal"]))
+        path = os.path.join(self.journal_dir, name + ".log")
+        if not os.path.exists(path) and name not in self._journals:
+            return {"count": 0, "initialized": False}
+        entry = self._journal(name)
+        return {"count": entry["count"], "initialized": True}
 
     @rpc_method(concurrency=1)
     def journal_reset(self, body, attachments):
@@ -224,12 +237,27 @@ class DriverService(Service):
 
     name = "driver"
 
+    TX_LEASE_SECONDS = 300.0
+
     def __init__(self, client):
         from ytsaurus_tpu.driver import Driver
         self.client = client
         self.driver = Driver(client)
-        self._transactions: dict[str, object] = {}
+        self._transactions: dict[str, tuple[object, float]] = {}
         self._tx_lock = threading.Lock()
+
+    def _sweep_expired_locked(self) -> None:
+        """Abort transactions whose lease lapsed (crashed clients must not
+        hold 2PC row locks forever — the proxy transaction-lease analog)."""
+        now = time.monotonic()
+        for tx_id in [i for i, (_, t) in self._transactions.items()
+                      if now - t > self.TX_LEASE_SECONDS]:
+            tx, _ = self._transactions.pop(tx_id)
+            try:
+                self.client.abort_transaction(tx)
+                logger.warning("aborted expired transaction %s", tx_id)
+            except Exception:      # noqa: BLE001 — sweep must not fail ops
+                pass
 
     @rpc_method()
     def ping(self, body, attachments):
@@ -253,17 +281,22 @@ class DriverService(Service):
 
     def _tx(self, tx_id: str):
         with self._tx_lock:
-            tx = self._transactions.get(tx_id)
-        if tx is None:
+            self._sweep_expired_locked()
+            entry = self._transactions.get(tx_id)
+            if entry is not None:
+                # Touch the lease on every use.
+                self._transactions[tx_id] = (entry[0], time.monotonic())
+        if entry is None:
             raise YtError(f"No such transaction {tx_id}",
                           code=EErrorCode.NoSuchTransaction)
-        return tx
+        return entry[0]
 
     @rpc_method()
     def start_transaction(self, body, attachments):
         tx = self.client.start_transaction()
         with self._tx_lock:
-            self._transactions[tx.id] = tx
+            self._sweep_expired_locked()
+            self._transactions[tx.id] = (tx, time.monotonic())
         return {"tx_id": tx.id, "start_timestamp": tx.start_timestamp}
 
     @rpc_method()
