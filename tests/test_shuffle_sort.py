@@ -126,3 +126,59 @@ def test_sort_strings():
     decoded = [out.columns["s"].dictionary[c] if c is not None else None
                for c in got]
     assert decoded == sorted(decoded)
+
+
+def test_sort_table_heavy_skew_one_hot_key():
+    """One key owns ~50% of all rows: the multi-round exchange must deliver
+    a correct global sort without losing rows (VERDICT round-1 item 7)."""
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.parallel.distributed import ShardedTable
+    from ytsaurus_tpu.parallel.mesh import make_mesh
+    from ytsaurus_tpu.parallel.shuffle import sort_table
+    from ytsaurus_tpu.schema import TableSchema
+
+    schema = TableSchema.make([("k", "int64"), ("p", "int64")])
+    rng = np.random.default_rng(13)
+    mesh = make_mesh(8)
+    chunks = []
+    all_keys = []
+    for s in range(8):
+        n = 400
+        hot = np.full(n // 2, 777)
+        rest = rng.integers(0, 10_000, n - n // 2)
+        k = np.concatenate([hot, rest])
+        rng.shuffle(k)
+        all_keys.extend(k.tolist())
+        chunks.append(ColumnarChunk.from_arrays(
+            schema, {"k": k, "p": np.arange(n) + s * 1000}))
+    table = ShardedTable.from_chunks(mesh, chunks)
+    out = sort_table(table, ["k"])
+    assert out.total_rows == table.total_rows
+    # Global order across shard boundaries.
+    data = np.asarray(out.columns["k"].data)
+    collected = []
+    for s in range(8):
+        cnt = out.row_counts[s]
+        collected.extend(data[s * out.capacity: s * out.capacity + cnt])
+    assert collected == sorted(all_keys)
+
+
+def test_sort_table_single_device_mesh():
+    import numpy as np
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.parallel.distributed import ShardedTable
+    from ytsaurus_tpu.parallel.mesh import make_mesh
+    from ytsaurus_tpu.parallel.shuffle import sort_table
+    from ytsaurus_tpu.schema import TableSchema
+
+    schema = TableSchema.make([("k", "int64"), ("v", "int64")])
+    rng = np.random.default_rng(3)
+    k = rng.integers(0, 1000, 257)
+    chunk = ColumnarChunk.from_arrays(
+        schema, {"k": k, "v": np.arange(257)})
+    mesh = make_mesh(1)
+    table = ShardedTable.from_chunks(mesh, [chunk])
+    out = sort_table(table, ["k"])
+    got = np.asarray(out.columns["k"].data)[:257]
+    assert got.tolist() == sorted(k.tolist())

@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from ytsaurus_tpu.chunks.columnar import Column, pad_capacity
 from ytsaurus_tpu.errors import EErrorCode, YtError
-from ytsaurus_tpu.ops.segments import sort_key_planes
+from ytsaurus_tpu.ops.segments import packed_sort_indices
 from ytsaurus_tpu.parallel.distributed import ShardedTable
 from ytsaurus_tpu.parallel.mesh import SHARD_AXIS
 from ytsaurus_tpu.schema import SortOrder, TableSchema
@@ -165,7 +165,7 @@ def sort_table(table: ShardedTable, key_columns: Sequence[str],
             raise YtError(f"No such key column {name!r}",
                           code=EErrorCode.QueryExecutionError)
     if n == 1:
-        return _sort_single(table, key_names)
+        return _sort_single(table, key_names, descending)
 
     pivots = _sample_pivots(table, key_names)
     # Pivot planes as device constants: [(valid_rank, value)] per key.
@@ -199,25 +199,99 @@ def sort_table(table: ShardedTable, key_columns: Sequence[str],
         out_specs=P(SHARD_AXIS), check_vma=False)(
             key_planes_global, table.row_valid)
     counts_np = np.asarray(counts)              # (n_src, n_dst)
-    quota = pad_capacity(max(int(counts_np.max()), 1))
-    recv_cap = quota * n
 
-    # --- pass 2: route + all_to_all + local sort ------------------------------
-    def exchange(columns_in, key_planes_in, row_valid):
+    # Skew-robust sizing (ref: the partition tree's multi-level splitting,
+    # controllers/sort_controller.cpp:459+, re-expressed for a fixed-shape
+    # collective): receive capacity is the EXACT per-destination need
+    # (max column sum), not n x the hottest (src,dst) cell; a hot cell is
+    # drained over multiple all_to_all rounds with a constant block size
+    # instead of inflating every device's buffers.
+    max_cell = max(int(counts_np.max()), 1)
+    recv_cap = pad_capacity(max(int(counts_np.sum(axis=0).max()), 1))
+    quota = pad_capacity(
+        max((recv_cap + n - 1) // n, (max_cell + 7) // 8, 1))
+    rounds = (max_cell + quota - 1) // quota
+    # Per-destination packing offsets: rows from src s land at
+    # [prefix[s], prefix[s] + counts[s, d]) on destination d.
+    prefix_np = np.zeros((n, n), dtype=np.int64)    # (dst, src)
+    prefix_np[:, 1:] = np.cumsum(counts_np.T, axis=1)[:, :-1]
+    prefix_sharded = jax.device_put(
+        jnp.asarray(prefix_np),
+        jax.sharding.NamedSharding(mesh, P(SHARD_AXIS)))
+
+    # --- pass 2: multi-round route + all_to_all + local sort ------------------
+    def exchange(columns_in, key_planes_in, row_valid, prefix_in):
         row_planes = [_encode_key_plane(d, v) for d, v in key_planes_in]
         pid = _partition_ids(row_planes, pivot_planes, n - 1)
         if descending:
             pid = (n - 1) - pid
         pid = jnp.where(row_valid, pid, n)
-        recv_planes, recv_mask = route_rows(
-            {name: columns_in[name] for name in names}, pid, n, quota, cap)
+        prefix = prefix_in.reshape(n)               # my dst row: per-src base
+        # Stable cell rank of each local row within its (src, dst) cell.
+        order = jnp.argsort(pid, stable=True)
+        pid_sorted = pid[order]
+        dest_counts = jax.vmap(
+            lambda d: (pid_sorted == d).sum())(jnp.arange(n + 1))
+        starts = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                                  jnp.cumsum(dest_counts)[:-1]])
+        pos = jnp.arange(cap)
+        cell_rank = pos - starts[jnp.clip(pid_sorted, 0, n)]
+        planes_sorted = {name: (columns_in[name][0][order],
+                                columns_in[name][1][order])
+                         for name in names}
+        recv_planes = {name: (
+            jnp.zeros(recv_cap, dtype=planes_sorted[name][0].dtype),
+            jnp.zeros(recv_cap, dtype=bool)) for name in names}
+        recv_mask = jnp.zeros(recv_cap, dtype=bool)
+        for r in range(rounds):
+            in_round = (pid_sorted < n) & (cell_rank >= r * quota) & \
+                (cell_rank < (r + 1) * quota)
+            slot = cell_rank - r * quota
+            send_index = jnp.clip(pid_sorted, 0, n - 1) * quota + slot
+            send_index = jnp.where(in_round, send_index, n * quota)
+
+            sent_mask = jnp.zeros(n * quota + 1, dtype=bool).at[
+                send_index].set(in_round)[: n * quota].reshape(n, quota)
+            arrived = jax.lax.all_to_all(sent_mask, SHARD_AXIS, 0, 0,
+                                         tiled=False)     # (n_src, quota)
+            # Destination positions for this round's block from each src.
+            dst_pos = prefix[:, None] + r * quota + jnp.arange(quota)[None, :]
+            dst_pos = jnp.where(arrived, dst_pos, recv_cap)
+            dst_flat = dst_pos.reshape(-1)
+            recv_mask = jnp.concatenate(
+                [recv_mask, jnp.zeros(1, dtype=bool)]).at[dst_flat].set(
+                arrived.reshape(-1))[:recv_cap] | recv_mask
+            for name in names:
+                data_s, valid_s = planes_sorted[name]
+
+                def send(plane):
+                    buf = jnp.zeros(n * quota + 1, dtype=plane.dtype)
+                    buf = buf.at[send_index].set(plane)
+                    return buf[: n * quota].reshape(n, quota)
+
+                rd = jax.lax.all_to_all(send(data_s), SHARD_AXIS, 0, 0,
+                                        tiled=False).reshape(-1)
+                rv = jax.lax.all_to_all(send(valid_s), SHARD_AXIS, 0, 0,
+                                        tiled=False).reshape(-1)
+                acc_d, acc_v = recv_planes[name]
+                # Rounds write DISJOINT position ranges, so plain scatter
+                # over the accumulated planes composes them.
+                acc_d = jnp.concatenate(
+                    [acc_d, jnp.zeros(1, dtype=acc_d.dtype)]).at[
+                    dst_flat].set(rd)[:recv_cap]
+                acc_v = jnp.concatenate(
+                    [acc_v, jnp.zeros(1, dtype=bool)]).at[dst_flat].set(
+                    rv & arrived.reshape(-1))[:recv_cap]
+                recv_planes[name] = (acc_d, acc_v)
+        # Rebuild validity strictly from arrivals (the accumulator ORs).
+        recv_planes = {name: (d, v & recv_mask)
+                       for name, (d, v) in recv_planes.items()}
         # Local sort of received rows by key (absent rows sink last).
-        sort_keys = []
-        for name in reversed(key_names):
+        items = [((~recv_mask), jnp.ones_like(recv_mask), False, 1)]
+        for name in key_names:
             d, v = recv_planes[name]
-            sort_keys.extend(sort_key_planes(d, v & recv_mask, descending))
-        sort_keys.append((~recv_mask).astype(jnp.int8))
-        order2 = jnp.lexsort(sort_keys)
+            items.append((d, v & recv_mask, descending, 64))
+        order2 = packed_sort_indices(items)
         out = {name: (d[order2], v[order2])
                for name, (d, v) in recv_planes.items()}
         out_count = recv_mask.sum()
@@ -227,10 +301,11 @@ def sort_table(table: ShardedTable, key_columns: Sequence[str],
                              table.columns[name].valid) for name in names}
     mapped = shard_map(
         exchange, mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                  P(SHARD_AXIS)),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), check_vma=False)
     out_columns_planes, out_counts = jax.jit(mapped)(
-        columns_global, key_planes_global, table.row_valid)
+        columns_global, key_planes_global, table.row_valid, prefix_sharded)
 
     out_counts_np = [int(c) for c in np.asarray(out_counts)]
     lost = table.total_rows - sum(out_counts_np)
@@ -255,10 +330,23 @@ def sort_table(table: ShardedTable, key_columns: Sequence[str],
                         row_valid=rv)
 
 
-def _sort_single(table: ShardedTable, key_names: list[str]) -> ShardedTable:
-    raise YtError("sort_table requires a multi-device mesh; sort chunks "
-                  "directly for the single-device case",
-                  code=EErrorCode.QueryUnsupported)
+def _sort_single(table: ShardedTable, key_names: list[str],
+                 descending: bool = False) -> ShardedTable:
+    """One-device mesh: plain packed-key sort, same result contract."""
+    mask = table.row_valid
+    items = [((~mask), jnp.ones_like(mask), False, 1)]
+    for name in key_names:
+        col = table.columns[name]
+        items.append((col.data, col.valid & mask, descending, 64))
+    order = packed_sort_indices(items)
+    out_columns = {
+        name: Column(type=col.type, data=col.data[order],
+                     valid=col.valid[order], dictionary=col.dictionary)
+        for name, col in table.columns.items()}
+    return ShardedTable(
+        schema=_sorted_schema(table.schema, key_names, descending),
+        mesh=table.mesh, capacity=table.capacity, columns=out_columns,
+        row_counts=list(table.row_counts), row_valid=mask[order])
 
 
 def _sorted_schema(schema: TableSchema, key_names: list[str],

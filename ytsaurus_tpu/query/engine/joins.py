@@ -106,6 +106,28 @@ def _lex_searchsorted(sorted_planes, n_sorted, max_n: int, query_planes,
     return lo
 
 
+def sort_foreign_keys(f_keys, f_valid):
+    """Sort encoded foreign key planes (masked rows last); returns
+    (f_order, f_sorted).  THE foreign-side ordering used by both the host
+    join phases and the SPMD broadcast join."""
+    sort_keys = []
+    for v, d in reversed(f_keys):
+        sort_keys.extend([d, v])
+    sort_keys.append((~f_valid).astype(jnp.int8))
+    f_order = lexsort_indices(sort_keys)
+    return f_order, [(v[f_order], d[f_order]) for v, d in f_keys]
+
+
+def null_key_mask(self_keys):
+    """Rows whose join key has ANY null component (match nothing — SQL
+    semantics)."""
+    cap = self_keys[0][0].shape[0]
+    s_null = jnp.zeros(cap, dtype=bool)
+    for v, _ in self_keys:
+        s_null = s_null | (v == 0)
+    return s_null
+
+
 def _join_fingerprint(join: ir.JoinClause) -> str:
     # ir.fingerprint serializes the full JoinClause (equations, alias,
     # is_left, pulled columns).
@@ -237,20 +259,12 @@ def _build_join_programs(self_bound, f_bound, self_slots, foreign_slots,
         self_keys = _emit_encoded_keys(self_bound, self_slots, s_ctx)
         foreign_keys = _emit_encoded_keys(f_bound, foreign_slots, f_ctx)
         # Sort foreign side (first key most significant; masked rows last).
-        sort_keys = []
-        for v, d in reversed(foreign_keys):
-            sort_keys.extend([d, v])
-        sort_keys.append((~f_valid).astype(jnp.int8))
-        f_order = lexsort_indices(sort_keys)
-        f_sorted = [(v[f_order], d[f_order]) for v, d in foreign_keys]
+        f_order, f_sorted = sort_foreign_keys(foreign_keys, f_valid)
         lo = _lex_searchsorted(f_sorted, n_foreign, foreign_cap, self_keys,
                                "left")
         hi = _lex_searchsorted(f_sorted, n_foreign, foreign_cap, self_keys,
                                "right")
-        # Null join keys match nothing (SQL semantics).
-        s_null = jnp.zeros(self_cap, dtype=bool)
-        for v, _ in self_keys:
-            s_null = s_null | (v == 0)
+        s_null = null_key_mask(self_keys)
         counts = jnp.where(s_valid & ~s_null, hi - lo, 0)
         if is_left:
             per_row = jnp.where(s_valid, jnp.maximum(counts, 1), 0)
