@@ -263,3 +263,155 @@ def test_lookup_row_cache(tablet, txm):
     ts_hit = tablet.row_cache_hits
     tablet.lookup_rows([(3,)], timestamp=1)
     assert tablet.row_cache_hits == ts_hit
+
+
+# --- per-column versioned writes (TVersionedRow partial-write semantics) ------
+
+def _fresh_tablet(tmp_path, name="pc"):
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.tablet.tablet import Tablet
+    schema = TableSchema.make([
+        ("k", "int64", "ascending"), ("a", "int64"), ("b", "string"),
+        ("c", "double")])
+    return Tablet(schema, FsChunkStore(str(tmp_path / name)))
+
+
+def test_partial_writes_merge_per_column(tmp_path):
+    t = _fresh_tablet(tmp_path)
+    t.write_row({"k": 1, "a": 10, "b": "x", "c": 1.5}, timestamp=100)
+    t.write_row({"k": 1, "a": 20}, timestamp=200, update=True)
+    t.write_row({"k": 1, "b": "y"}, timestamp=300, update=True)
+    (row,) = t.lookup_rows([(1,)])
+    assert (row["a"], row["b"], row["c"]) == (20, b"y", 1.5)
+    # Historical reads see per-timestamp column states.
+    (row,) = t.lookup_rows([(1,)], timestamp=250)
+    assert (row["a"], row["b"], row["c"]) == (20, b"x", 1.5)
+    (row,) = t.lookup_rows([(1,)], timestamp=150)
+    assert (row["a"], row["b"], row["c"]) == (10, b"x", 1.5)
+
+
+def test_partial_writes_survive_flush_and_compaction(tmp_path):
+    t = _fresh_tablet(tmp_path)
+    t.write_row({"k": 1, "a": 1, "b": "base", "c": 0.5}, timestamp=100)
+    t.flush()
+    t.write_row({"k": 1, "a": 2}, timestamp=200, update=True)
+    t.flush()
+    t.write_row({"k": 1, "c": 9.5}, timestamp=300, update=True)
+    # Mixed store/chunk merge before compaction.
+    (row,) = t.lookup_rows([(1,)])
+    assert (row["a"], row["b"], row["c"]) == (2, b"base", 9.5)
+    t.flush()
+    t.compact()                 # full history retained (retention 0)
+    (row,) = t.lookup_rows([(1,)], timestamp=250)
+    assert (row["a"], row["b"], row["c"]) == (2, b"base", 0.5)
+    (row,) = t.lookup_rows([(1,)])
+    assert (row["a"], row["b"], row["c"]) == (2, b"base", 9.5)
+    # Snapshot read path agrees.
+    rows = t.read_snapshot().to_rows()
+    assert rows == [{"k": 1, "a": 2, "b": b"base", "c": 9.5}]
+
+
+def test_compaction_consolidates_partial_base(tmp_path):
+    from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+    t = _fresh_tablet(tmp_path)
+    t.write_row({"k": 1, "a": 1, "b": "old", "c": 0.1}, timestamp=100)
+    t.write_row({"k": 1, "a": 2}, timestamp=200, update=True)
+    t.write_row({"k": 1, "b": "new"}, timestamp=300, update=True)
+    t.flush()
+    # Retention above all versions: history collapses to one merged base.
+    t.compact(retention_timestamp=400)
+    (row,) = t.lookup_rows([(1,)])
+    assert (row["a"], row["b"], row["c"]) == (2, b"new", 0.1)
+    chunk = t._decode(t.chunk_ids[0])
+    versions = [r for r in chunk.to_rows()]
+    assert len(versions) == 1   # consolidated single version
+
+
+def test_delete_bounds_partial_merge(tmp_path):
+    t = _fresh_tablet(tmp_path)
+    t.write_row({"k": 1, "a": 1, "b": "x", "c": 1.0}, timestamp=100)
+    t.delete_row((1,), timestamp=200)
+    t.write_row({"k": 1, "a": 5}, timestamp=300, update=True)
+    # Columns from before the delete must NOT leak through the merge.
+    (row,) = t.lookup_rows([(1,)])
+    assert row["a"] == 5 and row["b"] is None and row["c"] is None
+    # And the same through flush + snapshot read.
+    t.flush()
+    rows = t.read_snapshot().to_rows()
+    assert rows == [{"k": 1, "a": 5, "b": None, "c": None}]
+
+
+def test_update_mode_via_client(tmp_path):
+    from ytsaurus_tpu.client import connect
+    client = connect(str(tmp_path / "cluster"))
+    schema = TableSchema.make([("k", "int64", "ascending"),
+                               ("x", "int64"), ("y", "int64")])
+    client.create("table", "//dyn/u", recursive=True,
+                  attributes={"schema": schema, "dynamic": True})
+    client.mount_table("//dyn/u")
+    client.insert_rows("//dyn/u", [{"k": 1, "x": 1, "y": 1}])
+    client.insert_rows("//dyn/u", [{"k": 1, "x": 7}], update=True)
+    (row,) = client.lookup_rows("//dyn/u", [(1,)])
+    assert row["x"] == 7 and row["y"] == 1
+    # Default overwrite mode nulls unstated columns.
+    client.insert_rows("//dyn/u", [{"k": 1, "x": 8}])
+    (row,) = client.lookup_rows("//dyn/u", [(1,)])
+    assert row["x"] == 8 and row["y"] is None
+
+
+def test_pre_percolumn_chunks_survive_compaction(tmp_path):
+    """Chunks persisted BEFORE the $w: written-flag layout mean whole-row
+    writes; reads AND compaction must honor that (reviewer-reproduced
+    data-loss scenario)."""
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    t = _fresh_tablet(tmp_path, "legacy")
+    # Build an old-format versioned chunk by hand (no $w columns).
+    old_schema = TableSchema.make([
+        ("k", "int64", "ascending"), ("$timestamp", "int64"),
+        ("$tombstone", "boolean"), ("a", "int64"), ("b", "string"),
+        ("c", "double")])
+    chunk = ColumnarChunk.from_rows(
+        old_schema, [{"k": 1, "$timestamp": 100, "$tombstone": False,
+                      "a": 7, "b": b"x", "c": 2.5}])
+    cid = t.chunk_store.write_chunk(chunk)
+    t.chunk_ids.append(cid)
+    (row,) = t.lookup_rows([(1,)])
+    assert (row["a"], row["b"], row["c"]) == (7, b"x", 2.5)
+    t.compact()
+    (row,) = t.lookup_rows([(1,)])
+    assert (row["a"], row["b"], row["c"]) == (7, b"x", 2.5)
+    rows = t.read_snapshot().to_rows()
+    assert rows == [{"k": 1, "a": 7, "b": b"x", "c": 2.5}]
+
+
+def test_update_batch_validated_at_record_time(tmp_path):
+    """A bad row in an update-mode batch must fail BEFORE anything is
+    recorded — a commit-phase failure would half-apply the transaction."""
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.tablet.tablet import Tablet
+    from ytsaurus_tpu.tablet.transactions import TransactionManager
+    schema = TableSchema.make([
+        ("k", "int64", "ascending"),
+        {"name": "a", "type": "int64", "required": True},
+        ("b", "int64")])
+    t = Tablet(schema, FsChunkStore(str(tmp_path / "v")))
+    tm = TransactionManager()
+    tx = tm.start()
+    with pytest.raises(YtError):
+        tm.write_rows(tx, t, [{"k": 1, "a": 1, "b": 1},
+                              {"k": 2, "a": None}], update=True)
+    tm.commit(tx)               # nothing was recorded → empty commit
+    assert t.lookup_rows([(1,), (2,)]) == [None, None]
+    # Unknown columns also fail at record time.
+    tx2 = tm.start()
+    with pytest.raises(YtError):
+        tm.write_rows(tx2, t, [{"k": 4, "nosuch": 5}], update=True)
+
+
+def test_from_arrays_object_strings_with_nulls():
+    import numpy as np
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    schema = TableSchema.make([("s", "string")])
+    chunk = ColumnarChunk.from_arrays(
+        schema, {"s": np.array([b"a", None, b"c"], dtype=object)})
+    assert [r["s"] for r in chunk.to_rows()] == [b"a", None, b"c"]

@@ -48,17 +48,30 @@ def pad_capacity(n: int) -> int:
 
 
 def _encode_strings(values: Sequence[Optional[bytes]]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Order-preserving dictionary encode. Returns (codes, valid, vocab)."""
+    """Order-preserving dictionary encode. Returns (codes, valid, vocab).
+
+    Vectorized for high-cardinality columns (the round-1 "string cliff"):
+    a fixed-width bytes array + ONE np.unique(return_inverse) replaces the
+    per-value Python dict lookups — C-speed for ~1M-distinct columns (the
+    sortedness of np.unique keeps code order == byte order, which the
+    range/comparison lowering relies on)."""
     valid = np.array([v is not None for v in values], dtype=bool)
-    present = [v for v in values if v is not None]
-    vocab = np.array(sorted(set(present)), dtype=object)
-    if len(vocab):
-        lookup = {v: i for i, v in enumerate(vocab)}
-        codes = np.array([lookup[v] if v is not None else 0 for v in values],
-                         dtype=np.int32)
-    else:
-        codes = np.zeros(len(values), dtype=np.int32)
-    return codes, valid, vocab
+    if not valid.any():
+        return (np.zeros(len(values), dtype=np.int32), valid,
+                np.array([], dtype=object))
+    # Object dtype (NOT numpy "S": fixed-width strips trailing NULs and
+    # would corrupt arbitrary binary strings).
+    packed = np.empty(len(values), dtype=object)
+    packed[:] = [v if v is not None else b"" for v in values]
+    vocab, codes = np.unique(packed, return_inverse=True)
+    codes = codes.astype(np.int32)
+    # b"" padding for nulls may introduce a phantom vocab entry; keep it
+    # only if a VALID row actually holds the empty string.
+    if len(vocab) and vocab[0] == b"" and not (
+            valid & (codes == 0)).any():
+        vocab = vocab[1:]
+        codes = np.maximum(codes - 1, 0)
+    return codes, valid, np.asarray(vocab, dtype=object)
 
 
 def _to_bytes(v) -> bytes:
@@ -195,6 +208,41 @@ class ColumnarChunk:
             arr = np.asarray(arrays[name])
             if len(arr) != n:
                 raise YtError(f"Column {name!r} length {len(arr)} != {n}")
+            vocab = None
+            if ty is EValueType.string:
+                if dictionaries is not None and name in dictionaries:
+                    vocab = np.asarray(dictionaries[name], dtype=object)
+                else:
+                    # Raw string array: vectorized dictionary encode (the
+                    # high-cardinality path; ONE np.unique, no per-value
+                    # Python lookups).  "S"/"U" inputs are fixed-width
+                    # already (numpy cannot represent trailing NULs there);
+                    # object arrays unique losslessly over arbitrary bytes.
+                    raw = arr
+                    if raw.dtype.kind == "U":
+                        raw = np.char.encode(raw, "utf-8")
+                    if raw.dtype.kind == "O":
+                        # None entries mark nulls; replace with b"" so
+                        # np.unique can compare, masked out via validity.
+                        none_mask = np.array(
+                            [v is None for v in raw], dtype=bool)
+                        if none_mask.any():
+                            raw = raw.copy()
+                            raw[none_mask] = b""
+                            if valids is None or name not in valids:
+                                v0 = np.ones(n, dtype=bool)
+                                v0[none_mask] = False
+                                valids = dict(valids or {})
+                                valids[name] = v0
+                    if raw.dtype.kind in ("S", "O"):
+                        vocab_s, codes = np.unique(raw, return_inverse=True)
+                        vocab = np.empty(len(vocab_s), dtype=object)
+                        vocab[:] = [bytes(v) for v in vocab_s]
+                        arr = codes.astype(np.int32)
+                    else:
+                        raise YtError(
+                            f"String column {name!r} needs a dictionary "
+                            "or a string-typed array")
             dt = device_dtype(ty)
             data = np.zeros(cap, dtype=dt)
             data[:n] = arr.astype(dt)
@@ -203,11 +251,6 @@ class ColumnarChunk:
                 valid[:n] = np.asarray(valids[name], dtype=bool)
             else:
                 valid[:n] = True
-            vocab = None
-            if ty is EValueType.string:
-                if dictionaries is None or name not in dictionaries:
-                    raise YtError(f"String column {name!r} needs a dictionary")
-                vocab = np.asarray(dictionaries[name], dtype=object)
             columns[name] = Column(type=ty, data=jnp.asarray(data),
                                    valid=jnp.asarray(valid), dictionary=vocab)
         return ColumnarChunk(schema=schema, row_count=n, columns=columns)
@@ -314,15 +357,24 @@ def unify_dictionaries(columns: Sequence[Column]) -> tuple[list[Column], np.ndar
     device gather per column (codes -> new codes), keeping order preservation.
     """
     vocabs = [c.dictionary for c in columns if c.dictionary is not None]
-    merged = np.array(sorted({v for vocab in vocabs for v in vocab}), dtype=object)
-    lookup = {v: i for i, v in enumerate(merged)}
+    # Vectorized union + remap (np.unique / searchsorted over object
+    # arrays — lossless for arbitrary bytes): high-cardinality vocab
+    # merges were the round-1 host cliff.
+    if vocabs:
+        merged = np.unique(np.concatenate(
+            [np.asarray(v, dtype=object) for v in vocabs]))
+    else:
+        merged = np.array([], dtype=object)
+    merged = np.asarray(merged, dtype=object)
     out = []
     for col in columns:
         if col.type is not EValueType.string:
             out.append(col)
             continue
         old_vocab = col.dictionary if col.dictionary is not None else np.array([], dtype=object)
-        remap_np = np.array([lookup[v] for v in old_vocab], dtype=np.int32)
+        remap_np = np.searchsorted(
+            merged, np.asarray(old_vocab, dtype=object)).astype(np.int32) \
+            if len(old_vocab) else np.array([], dtype=np.int32)
         if len(remap_np) == 0:
             remap_np = np.zeros(1, dtype=np.int32)
         remap = jnp.asarray(remap_np)

@@ -507,7 +507,11 @@ class YtClient:
         self.cluster.transactions.abort(tx)
 
     def insert_rows(self, path: str, rows: Sequence[dict],
-                    tx: Optional[TabletTransaction] = None) -> Optional[int]:
+                    tx: Optional[TabletTransaction] = None,
+                    update: bool = False) -> Optional[int]:
+        """update=True: write only the provided columns; missing ones merge
+        per column from older versions (ref ModifyRows update mode +
+        versioned_row_merger partial writes)."""
         tablets = self._mounted_tablets(path)
         rows = self._fill_computed_columns(tablets[0].schema, list(rows))
         from ytsaurus_tpu.tablet.ordered import OrderedTablet
@@ -522,7 +526,7 @@ class YtClient:
         own = tx is None
         tx = tx or txm.start()
         for idx, part in self._route_rows(path, tablets, list(rows)).items():
-            txm.write_rows(tx, tablets[idx], part)
+            txm.write_rows(tx, tablets[idx], part, update=update)
         if own:
             return txm.commit(tx)
         return None

@@ -77,8 +77,9 @@ def _registry() -> dict[str, CommandDescriptor]:
            lambda cl, p: cl.freeze_table(p["path"])),
         _d("reshard_table", ("path", "pivot_keys"), (), True,
            lambda cl, p: cl.reshard_table(p["path"], p["pivot_keys"])),
-        _d("insert_rows", ("path", "rows"), (), True,
-           lambda cl, p: cl.insert_rows(p["path"], p["rows"])),
+        _d("insert_rows", ("path", "rows"), ("update",), True,
+           lambda cl, p: cl.insert_rows(p["path"], p["rows"],
+                                        update=p.get("update", False))),
         _d("delete_rows", ("path", "keys"), (), True,
            lambda cl, p: cl.delete_rows(p["path"], p["keys"])),
         _d("lookup_rows", ("path", "keys"), ("column_names", "timestamp"),

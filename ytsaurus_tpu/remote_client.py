@@ -161,15 +161,17 @@ class RemoteYtClient:
         self._execute("compact_table", {"path": path}, idempotent=False)
 
     def insert_rows(self, path: str, rows: Sequence[dict],
-                    tx: Optional[RemoteTransaction] = None) -> None:
+                    tx: Optional[RemoteTransaction] = None,
+                    update: bool = False) -> None:
         rows = [dict(r) for r in rows]
         if tx is None:
-            self._execute("insert_rows", {"path": path, "rows": rows},
+            self._execute("insert_rows",
+                          {"path": path, "rows": rows, "update": update},
                           idempotent=False)
             return
         self._channel.call("driver", "insert_rows_tx",
-                           {"tx_id": tx.id, "path": path, "rows": rows},
-                           idempotent=False)
+                           {"tx_id": tx.id, "path": path, "rows": rows,
+                            "update": update}, idempotent=False)
 
     def delete_rows(self, path: str, keys: Sequence[tuple],
                     tx: Optional[RemoteTransaction] = None) -> None:

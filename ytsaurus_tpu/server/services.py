@@ -324,7 +324,8 @@ class DriverService(Service):
     @rpc_method()
     def insert_rows_tx(self, body, attachments):
         tx = self._tx(_text(body["tx_id"]))
-        self.client.insert_rows(_text(body["path"]), body["rows"], tx=tx)
+        self.client.insert_rows(_text(body["path"]), body["rows"], tx=tx,
+                                update=bool(body.get("update", False)))
         return {}
 
     @rpc_method()

@@ -1,19 +1,11 @@
-"""Sorted dynamic store: the in-memory MVCC row store.
+"""In-memory dynamic stores.
 
-Ref: server/node/tablet_node/sorted_dynamic_store.h — a lock-free skip list
-of rows, each holding per-column edit lists of (timestamp, value) pairs plus
-write/delete timestamp lists.  TPU-native reframing: the hot compute path
-reads COLUMNAR SNAPSHOTS (built on flush/rotation and merged on device); the
-dynamic store itself is a host-side ordered map of versioned rows — the
-mutation log before columnarization — so it optimizes for write latency and
-snapshot building, not per-row device access.
-
-Versions per key:
-  writes:  (timestamp, {column: value})   — FULL row state (a write replaces
-           the whole row; value columns absent from the write become null —
-           per-column partial-update merge à la the reference's versioned
-           values is a TODO)
-  deletes: (timestamp, None)              — tombstone
+Ref: sorted_dynamic_store.h (MVCC edit lists) / ordered_dynamic_store.h.
+SortedDynamicStore versions are per-column: a version records ONLY the
+columns it wrote (update=True partial writes carry just those; overwrite
+writes state every value column explicitly), and reads merge newest-per-
+column above the latest delete — TVersionedRow semantics
+(client/table_client/versioned_row.h:90, versioned_row_merger.h).
 """
 
 from __future__ import annotations
@@ -51,9 +43,20 @@ class SortedDynamicStore:
             raise YtError(f"Row is missing key column {e.args[0]!r}",
                           code=EErrorCode.QueryTypeError)
 
-    def write_row(self, row: dict, timestamp: int) -> None:
+    def write_row(self, row: dict, timestamp: int,
+                  update: bool = False) -> None:
+        """update=False (default): the write STATES every value column
+        (missing ones become explicit nulls — the reference's overwrite
+        mode).  update=True: only the provided columns are written; the
+        rest merge from older versions per column (TVersionedRow partial
+        writes, client/table_client/versioned_row.h:90 +
+        versioned_row_merger.h)."""
         key = self.key_of(row)
-        values = {name: row.get(name) for name in self.value_names}
+        if update:
+            values = {name: row[name] for name in self.value_names
+                      if name in row}
+        else:
+            values = {name: row.get(name) for name in self.value_names}
         self._append(key, timestamp, values)
 
     def delete_row(self, key_row: dict | tuple, timestamp: int) -> None:
@@ -100,7 +103,9 @@ class SortedDynamicStore:
 
     def versioned_rows(self) -> list[dict]:
         """Flatten to versioned row dicts (newest first per key) for
-        flushing: key columns + $timestamp + $tombstone + value columns."""
+        flushing: key columns + $timestamp + $tombstone + value columns +
+        per-column $w: written flags (partial writes carry False for
+        columns the version does not state)."""
         out = []
         for key, versions in self.iter_items():
             for ts, state in sorted(versions, key=lambda v: -v[0]):
@@ -108,7 +113,9 @@ class SortedDynamicStore:
                 row["$timestamp"] = ts
                 row["$tombstone"] = state is None
                 for name in self.value_names:
-                    row[name] = (state or {}).get(name)
+                    written = state is not None and name in state
+                    row[name] = state.get(name) if written else None
+                    row[f"$w:{name}"] = written
                 out.append(row)
         return out
 

@@ -32,8 +32,12 @@ from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
 
 def versioned_schema(schema: TableSchema) -> TableSchema:
     """Schema of versioned snapshot chunks: keys + $timestamp/$tombstone +
-    values (keys keep their sort order; versions are sorted within key by
-    descending timestamp at flush time)."""
+    per value column (value plane, $w: written-flag plane).  The written
+    planes are the per-column timestamp dimension of TVersionedRow
+    (client/table_client/versioned_row.h:90-141): a version only carries
+    the columns it wrote, so partial writes merge per column on read.
+    Keys keep their sort order; versions sort within key by descending
+    timestamp at flush time."""
     cols = []
     for c in schema:
         if c.sort_order is not None:
@@ -43,6 +47,7 @@ def versioned_schema(schema: TableSchema) -> TableSchema:
     for c in schema:
         if c.sort_order is None:
             cols.append((c.name, c.type.value))
+            cols.append((f"$w:{c.name}", "boolean"))
     return TableSchema.make(cols)
 
 
@@ -95,20 +100,28 @@ class Tablet:
         return tuple(_normalize_value(v, c.type)
                      for v, c in zip(key, key_cols))
 
-    def validate_required(self, normalized_row: dict) -> None:
+    def validate_required(self, normalized_row: dict,
+                          partial: bool = False) -> None:
         """THE required-column check (single source: used by tablets,
-        transactions, and columnar construction paths must agree)."""
+        transactions, and columnar construction paths must agree).
+        partial=True (update-mode writes): only columns the row STATES are
+        checked — unstated required columns keep their old values."""
         for c in self.schema:
-            if c.required and normalized_row.get(c.name) is None:
+            if not c.required:
+                continue
+            if partial and c.name not in normalized_row:
+                continue
+            if normalized_row.get(c.name) is None:
                 raise YtError(f"Required column {c.name!r} is null",
                               code=EErrorCode.QueryTypeError)
 
-    def write_row(self, row: dict, timestamp: int) -> None:
+    def write_row(self, row: dict, timestamp: int,
+                  update: bool = False) -> None:
         row = self.normalize_row(row)
-        self.validate_required(row)
+        self.validate_required(row, partial=update)
         with self._lock:       # a concurrent flush() must not drop the write
             self._check_mounted()
-            self.active_store.write_row(row, timestamp)
+            self.active_store.write_row(row, timestamp, update=update)
 
     def delete_row(self, key: tuple, timestamp: int) -> None:
         key = self.normalize_key(key)
@@ -186,8 +199,13 @@ class Tablet:
                 return None
             chunks = [self._decode(cid) for cid in self.chunk_ids]
             rows: list[dict] = []
+            value_names = [c.name for c in self.schema
+                           if c.sort_order is None]
             for chunk in chunks:
-                rows.extend(chunk.to_rows())
+                for row in chunk.to_rows():
+                    for name in value_names:
+                        row[f"$w:{name}"] = _written(row, name)
+                    rows.append(row)
             rows.sort(key=_versioned_sort_key(self.schema))
             rows = _drop_superseded(rows, self.schema, retention_timestamp)
             old_ids = list(self.chunk_ids)
@@ -286,6 +304,10 @@ class Tablet:
                         row = None
                     else:
                         row = dict(zip(key_names, key))
+                        # Columns no surviving version wrote read as null.
+                        for c in self.schema:
+                            if c.sort_order is None:
+                                row[c.name] = None
                         row.update(merged)
                     if cacheable:
                         self._row_cache[key] =                             dict(row) if row is not None else None
@@ -313,6 +335,15 @@ def _normalize_value(value, ty: EValueType):
 
 # -- versioned row helpers -----------------------------------------------------
 
+def _written(row: dict, name: str) -> bool:
+    """Did this version state column `name`?  Chunks persisted before the
+    per-column layout carry no $w: planes — or carry them as nulls after a
+    re-encode — and mean whole-row writes, so ABSENT and None both read as
+    written (only an explicit False means unwritten)."""
+    flag = row.get(f"$w:{name}")
+    return True if flag is None else bool(flag)
+
+
 
 def _versioned_sort_key(schema: TableSchema):
     key_names = schema.key_column_names
@@ -327,66 +358,118 @@ def _versioned_sort_key(schema: TableSchema):
 
 def _mvcc_select(versioned_rows: list[dict], schema: TableSchema,
                  timestamp: int) -> list[dict]:
-    """Pick the newest version ≤ timestamp per key; drop tombstones.
-    Input must be sorted by (key, -ts)."""
+    """Per-column MVCC merge at `timestamp` (versioned_row_merger.h
+    semantics): the newest delete <= ts bounds the merge; each column takes
+    its newest write after that bound that STATES the column.  Input must
+    be sorted by (key, -ts)."""
     key_names = schema.key_column_names
     value_names = [c.name for c in schema if c.sort_order is None]
     out = []
-    prev_key = object()
+    prev_key: object = object()
+    visible: Optional[dict] = None
+    filled: set = set()
+    deleted = False
+
+    def emit():
+        if visible is not None:
+            for name in value_names:
+                visible.setdefault(name, None)
+            out.append(visible)
+
     for row in versioned_rows:
         key = tuple(row[name] for name in key_names)
-        if row["$timestamp"] > timestamp:
+        if key != prev_key:
+            emit()
+            prev_key = key
+            visible = None
+            filled = set()
+            deleted = False
+        if deleted or row["$timestamp"] > timestamp:
             continue
-        if key == prev_key:
-            continue
-        prev_key = key
         if row["$tombstone"]:
+            deleted = True          # older versions are invisible
             continue
-        visible = {name: row[name] for name in key_names}
+        if visible is None:
+            visible = {name: row[name] for name in key_names}
         for name in value_names:
-            visible[name] = row.get(name)
-        out.append(visible)
+            if name not in filled and _written(row, name):
+                visible[name] = row.get(name)
+                filled.add(name)
+    emit()
     return out
 
 
 def _drop_superseded(versioned_rows: list[dict], schema: TableSchema,
                      retention_timestamp: int) -> list[dict]:
     """Major-compaction retention: keep every version newer than
-    `retention_timestamp` plus the newest visible state at it (unless that
-    state is a tombstone, which can then be dropped).  Input sorted by
-    (key, -ts)."""
+    `retention_timestamp`; versions at/below it collapse into ONE
+    consolidated base version holding the per-column merged visible state
+    at the retention timestamp (the merger's "merge partial writes"
+    compaction mode) — or nothing if that state is a delete.  Input sorted
+    by (key, -ts); output preserves that order."""
     key_names = schema.key_column_names
+    value_names = [c.name for c in schema if c.sort_order is None]
     out = []
-    prev_key: object = object()
-    kept_base = False
-    for row in versioned_rows:
-        key = tuple(row[name] for name in key_names)
-        if key != prev_key:
-            prev_key = key
-            kept_base = False
-        if row["$timestamp"] > retention_timestamp:
-            out.append(row)
-        elif not kept_base:
-            kept_base = True
-            if not row["$tombstone"]:
+    i = 0
+    n = len(versioned_rows)
+    while i < n:
+        key = tuple(versioned_rows[i][name] for name in key_names)
+        group = []
+        while i < n and tuple(versioned_rows[i][name]
+                              for name in key_names) == key:
+            group.append(versioned_rows[i])
+            i += 1
+        base_rows = []
+        for row in group:
+            if row["$timestamp"] > retention_timestamp:
                 out.append(row)
+            else:
+                base_rows.append(row)
+        if not base_rows:
+            continue
+        # Per-column merge of the <= retention versions.
+        merged: Optional[dict] = None
+        filled: set = set()
+        base_ts = None
+        for row in base_rows:           # newest first
+            if row["$tombstone"]:
+                break                   # older versions invisible
+            if merged is None:
+                merged = {name: row[name] for name in key_names}
+                base_ts = row["$timestamp"]
+            for name in value_names:
+                if name not in filled and _written(row, name):
+                    merged[name] = row.get(name)
+                    filled.add(name)
+        if merged is not None:
+            merged["$timestamp"] = base_ts
+            merged["$tombstone"] = False
+            for name in value_names:
+                merged.setdefault(name, None)
+                merged[f"$w:{name}"] = True     # consolidated: states all
+            out.append(merged)
     return out
 
 
 def _merge_versions(versions: list[tuple[int, Optional[dict]]],
                     timestamp: int) -> Optional[dict]:
-    """Newest visible state from (ts, full-state-or-None) pairs."""
-    best_ts = -1
-    best_state: Optional[dict] = None
-    found = False
-    for ts, state in versions:
-        if ts <= timestamp and ts > best_ts:
-            best_ts = ts
-            best_state = state
-            found = True
-    if not found or best_state is None:
-        return None
-    return dict(best_state)
+    """Per-column merge from (ts, written-columns-dict-or-None) pairs:
+    the newest delete <= ts bounds the merge; each column takes its newest
+    stated value after the bound (TVersionedRow lookup merge)."""
+    live = sorted((v for v in versions if v[0] <= timestamp),
+                  key=lambda v: -v[0])
+    merged: Optional[dict] = None
+    filled: set = set()
+    for ts, state in live:
+        if state is None:
+            break                       # delete: older versions invisible
+        if merged is None:
+            merged = {}
+        for name, value in state.items():
+            if name not in filled:
+                merged[name] = value
+                filled.add(name)
+    return merged
 
 
 def _chunk_lookup_versions(chunk: ColumnarChunk, schema: TableSchema,
@@ -399,8 +482,11 @@ def _chunk_lookup_versions(chunk: ColumnarChunk, schema: TableSchema,
         if row["$tombstone"]:
             out.append((row["$timestamp"], None))
         else:
+            # Only columns the version wrote ($w: flags; chunks from before
+            # the per-column layout carry none → whole-row semantics).
             out.append((row["$timestamp"],
-                        {name: row.get(name) for name in value_names}))
+                        {name: row.get(name) for name in value_names
+                         if _written(row, name)}))
     return out
 
 

@@ -29,6 +29,7 @@ from ytsaurus_tpu.tablet.timestamp import TimestampProvider
 class _Modification:
     kind: str                 # "write" | "delete"
     row: dict | tuple
+    update: bool = False      # partial write (per-column merge)
 
 
 @dataclass
@@ -65,15 +66,17 @@ class TransactionManager:
         return tx
 
     def write_rows(self, tx: TabletTransaction, tablet: Tablet,
-                   rows: list[dict]) -> None:
+                   rows: list[dict], update: bool = False) -> None:
         key = id(tablet)
         self._tablets[key] = tablet
         # Validate the WHOLE batch before recording anything: a mid-batch
-        # failure must not leave earlier rows recorded in a live tx.
+        # failure must not leave earlier rows recorded in a live tx (and a
+        # commit-phase failure would half-apply the transaction).
         for row in rows:
-            tablet.validate_required(tablet.normalize_row(row))
+            tablet.validate_required(tablet.normalize_row(row),
+                                     partial=update)
         for row in rows:
-            tx._record(key, _Modification("write", dict(row)))
+            tx._record(key, _Modification("write", dict(row), update))
 
     def delete_rows(self, tx: TabletTransaction, tablet: Tablet,
                     keys: list[tuple]) -> None:
@@ -159,7 +162,8 @@ class TransactionManager:
                     tablet = self._tablets[tablet_key]
                     for mod in mods:
                         if mod.kind == "write":
-                            tablet.write_row(mod.row, commit_ts)
+                            tablet.write_row(mod.row, commit_ts,
+                                             update=mod.update)
                         else:
                             tablet.delete_row(mod.row, commit_ts)
             except Exception:
