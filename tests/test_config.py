@@ -1,0 +1,134 @@
+"""Config system: YsonStruct validation/merge + dynamic config manager."""
+
+import pytest
+
+from ytsaurus_tpu.config import (
+    DaemonConfig,
+    DynamicConfigManager,
+    RpcConfig,
+    YsonStruct,
+    param,
+)
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+
+class CacheConfig(YsonStruct):
+    capacity = param(100, type=int, ge=0)
+    codec = param("lz4", type=str, choices={"none", "lz4", "zstd"})
+
+
+class RootConfig(YsonStruct):
+    name = param("x", type=str)
+    ratio = param(0.5, type=float, ge=0.0, le=1.0)
+    cache = param(type=CacheConfig)
+
+    def postprocess(self):
+        if self.name == "forbidden":
+            raise YtError("bad name", code=EErrorCode.InvalidConfig)
+
+
+def test_defaults():
+    cfg = RootConfig()
+    assert cfg.name == "x" and cfg.ratio == 0.5
+    assert cfg.cache.capacity == 100 and cfg.cache.codec == "lz4"
+
+
+def test_from_dict_nested_and_bytes_keys():
+    cfg = RootConfig.from_dict(
+        {b"name": b"prod", "cache": {b"capacity": 7, "codec": b"zstd"}})
+    assert cfg.name == "prod"
+    assert cfg.cache.capacity == 7 and cfg.cache.codec == "zstd"
+
+
+def test_int_promotes_to_float():
+    assert RootConfig.from_dict({"ratio": 1}).ratio == 1.0
+
+
+@pytest.mark.parametrize("data", [
+    {"ratio": 2.0},                       # > le
+    {"cache": {"capacity": -1}},          # < ge
+    {"cache": {"codec": "gzip"}},         # not in choices
+    {"ratio": "half"},                    # wrong type
+    {"nope": 1},                          # unrecognized
+    {"name": "forbidden"},                # postprocess
+])
+def test_validation_failures(data):
+    with pytest.raises(YtError) as ei:
+        RootConfig.from_dict(data)
+    assert ei.value.code == EErrorCode.InvalidConfig
+
+
+def test_error_names_the_path():
+    with pytest.raises(YtError, match="cache/capacity"):
+        RootConfig.from_dict({"cache": {"capacity": -5}})
+
+
+def test_explicit_null_resets_to_default():
+    cfg = RootConfig.from_dict({"cache": {"capacity": None}})
+    assert cfg.cache.capacity == 100
+    merged = RootConfig().merge({"ratio": None})
+    assert merged.ratio == 0.5
+
+
+def test_merge_is_recursive_and_nondestructive():
+    base = RootConfig()
+    merged = base.merge({"cache": {"capacity": 9}})
+    assert merged.cache.capacity == 9
+    assert merged.cache.codec == "lz4"      # untouched sibling survives
+    assert base.cache.capacity == 100        # original untouched
+
+
+def test_round_trip():
+    cfg = RootConfig.from_dict({"name": "a", "cache": {"capacity": 3}})
+    assert RootConfig.from_dict(cfg.to_dict()) == cfg
+
+
+def test_keep_unrecognized():
+    class Loose(YsonStruct):
+        keep_unrecognized = True
+        a = param(1, type=int)
+
+    cfg = Loose.from_dict({"a": 2, "extra": "kept"})
+    assert cfg.a == 2 and cfg.unrecognized == {"extra": "kept"}
+    assert cfg.to_dict()["extra"] == "kept"
+
+
+def test_daemon_config_shape():
+    cfg = DaemonConfig.from_dict({
+        "role": "primary",
+        "rpc": {"port": 9013},
+        "master": {"journal_nodes": 3},
+    })
+    assert cfg.rpc.port == 9013
+    assert cfg.rpc.max_workers == RpcConfig().max_workers
+    assert cfg.master.journal_nodes == 3
+
+
+def test_dynamic_config_applies_and_keeps_last_good():
+    patches = [None]
+
+    manager = DynamicConfigManager(lambda: patches[0], RootConfig(),
+                                   period=1000)
+    seen = []
+    manager.subscribe(lambda cfg: seen.append(cfg.cache.capacity))
+
+    assert not manager.poll_once()           # no patch, no change
+    patches[0] = {"cache": {"capacity": 5}}
+    assert manager.poll_once()
+    assert manager.config.cache.capacity == 5
+    assert seen == [5]
+
+    # Same patch again: no re-fire.
+    assert not manager.poll_once()
+
+    # Bad patch: rejected, last good config stays, error exported.
+    patches[0] = {"cache": {"capacity": -3}}
+    assert not manager.poll_once()
+    assert manager.config.cache.capacity == 5
+    assert manager.last_error is not None \
+        and manager.last_error.code == EErrorCode.InvalidConfig
+
+    # Recovery after a bad patch.
+    patches[0] = {"cache": {"capacity": 8}}
+    assert manager.poll_once()
+    assert manager.config.cache.capacity == 8 and manager.last_error is None

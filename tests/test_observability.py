@@ -1,0 +1,220 @@
+"""Observability: sensors, tracing, Orchid, monitoring endpoint, RPC wiring."""
+
+import json
+import urllib.request
+
+import pytest
+
+from ytsaurus_tpu.server.monitoring import MonitoringServer
+from ytsaurus_tpu.server.orchid import OrchidService, OrchidTree, default_orchid
+from ytsaurus_tpu.utils.profiling import (
+    Histogram,
+    Profiler,
+    ProfilerRegistry,
+)
+from ytsaurus_tpu.utils.tracing import (
+    TraceContext,
+    current_trace,
+    get_collector,
+    start_span,
+)
+
+
+# -- sensors -------------------------------------------------------------------
+
+def test_counter_gauge_summary():
+    reg = ProfilerRegistry()
+    prof = Profiler("/test", registry=reg)
+    prof.counter("requests").increment()
+    prof.counter("requests").increment(2)
+    prof.gauge("depth").set(7)
+    prof.summary("latency").record(0.5)
+    prof.summary("latency").record(1.5)
+
+    assert prof.counter("requests").get() == 3
+    assert prof.gauge("depth").get() == 7
+    s = prof.summary("latency")
+    assert s.count == 2 and s.sum == 2.0 and s.min == 0.5 and s.max == 1.5
+
+    text = reg.render_prometheus()
+    assert "test_requests 3" in text
+    assert "test_depth 7" in text
+    assert "test_latency_sum 2.0" in text
+
+
+def test_tags_make_distinct_sensors():
+    reg = ProfilerRegistry()
+    prof = Profiler("/q", registry=reg)
+    prof.with_tags(pool="a").counter("n").increment()
+    prof.with_tags(pool="b").counter("n").increment(5)
+    text = reg.render_prometheus()
+    assert 'q_n{pool="a"} 1' in text
+    assert 'q_n{pool="b"} 5' in text
+
+
+def test_histogram_buckets():
+    h = Histogram(bounds=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.record(v)
+    samples = dict((suffix, val) for _k, suffix, val in h.samples())
+    assert samples['.bucket{le="1.0"}'] == 1
+    assert samples['.bucket{le="10.0"}'] == 2
+    assert samples['.bucket{le="+Inf"}'] == 3
+    assert samples[".count"] == 3
+
+
+def test_registry_collect_snapshot():
+    reg = ProfilerRegistry()
+    Profiler("/x", registry=reg).counter("c").increment(4)
+    snap = reg.collect()
+    assert snap["/x/c"] == 4
+
+
+# -- tracing -------------------------------------------------------------------
+
+def test_span_nesting_and_collection():
+    with TraceContext("root") as root:
+        assert current_trace() is root
+        with start_span("child", table="//t") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_span_id == root.span_id
+    assert current_trace() is None
+    spans = get_collector().find(root.trace_id)
+    names = {s.name for s in spans}
+    assert names == {"root", "child"}
+    child_rec = next(s for s in spans if s.name == "child")
+    assert child_rec.tags["table"] == "//t"
+
+
+def test_trace_wire_round_trip():
+    ctx = TraceContext("a", sampled=True)
+    ctx.set_baggage("user", "alice")
+    wire = ctx.to_wire()
+    # Simulate YSON transport byte-keys.
+    wire = {k.encode(): v for k, v in wire.items()}
+    remote = TraceContext.from_wire(wire, "server_side")
+    assert remote.trace_id == ctx.trace_id
+    assert remote.parent_span_id == ctx.span_id
+    assert remote.baggage == {"user": "alice"}
+
+
+def test_unsampled_spans_not_collected():
+    ctx = TraceContext("quiet", sampled=False)
+    with ctx:
+        pass
+    assert not get_collector().find(ctx.trace_id)
+
+
+# -- orchid --------------------------------------------------------------------
+
+def test_orchid_get_descends_into_producer_output():
+    tree = OrchidTree()
+    tree.register("/tablets", lambda: {"t1": {"rows": 10}, "t2": {"rows": 3}})
+    tree.register_value("/version", "1.0")
+    assert tree.get("/tablets/t1/rows") == 10
+    assert tree.get("/version") == "1.0"
+    assert tree.list("/tablets") == ["t1", "t2"]
+    assert tree.list("/") == ["tablets", "version"]
+
+
+def test_orchid_missing_path():
+    from ytsaurus_tpu.errors import YtError
+    tree = OrchidTree()
+    tree.register("/a", lambda: {"b": 1})
+    with pytest.raises(YtError):
+        tree.get("/a/nope")
+    with pytest.raises(YtError):
+        tree.get("/zzz")
+
+
+def test_default_orchid_has_sensors_and_spans():
+    tree = default_orchid()
+    assert isinstance(tree.get("/monitoring/sensors"), dict)
+    assert isinstance(tree.get("/tracing/recent_spans"), list)
+
+
+# -- monitoring http -----------------------------------------------------------
+
+def test_monitoring_endpoints():
+    reg = ProfilerRegistry()
+    Profiler("/mon", registry=reg).counter("hits").increment(2)
+    tree = OrchidTree()
+    tree.register("/state", lambda: {"phase": "leading", "peers": [1, 2]})
+    server = MonitoringServer(tree, reg)
+    server.start()
+    try:
+        base = f"http://{server.address}"
+        assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+        metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "mon_hits 2" in metrics
+        state = json.loads(
+            urllib.request.urlopen(f"{base}/orchid/state").read())
+        assert state == {"phase": "leading", "peers": [1, 2]}
+        phase = json.loads(
+            urllib.request.urlopen(f"{base}/orchid/state/phase").read())
+        assert phase == "leading"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/orchid/zzz")
+    finally:
+        server.stop()
+
+
+# -- rpc propagation -----------------------------------------------------------
+
+def test_rpc_propagates_trace_and_counts_requests():
+    from ytsaurus_tpu.rpc import Channel, RpcServer
+    from ytsaurus_tpu.rpc.server import Service, rpc_method
+    from ytsaurus_tpu.utils import profiling
+
+    seen = {}
+
+    class Echo(Service):
+        name = "echo"
+
+        @rpc_method()
+        def ping(self, body, attachments):
+            ctx = current_trace()
+            seen["trace_id"] = ctx.trace_id if ctx else None
+            seen["baggage"] = dict(ctx.baggage) if ctx else {}
+            return {"pong": True}
+
+    server = RpcServer([Echo()])
+    server.start()
+    channel = Channel(server.address, timeout=10)
+    try:
+        with TraceContext("client_op") as root:
+            root.set_baggage("user", "bob")
+            body, _ = channel.call("echo", "ping", {})
+        assert body["pong"] is True
+        assert seen["trace_id"] == root.trace_id
+        assert seen["baggage"].get("user") in ("bob", b"bob")
+        # Server span was exported with the same trace id.
+        names = {s.name for s in get_collector().find(root.trace_id)}
+        assert "echo.ping" in names
+        # Request sensor ticked.
+        counter = profiling.Profiler("/rpc/server").with_tags(
+            service="echo", method="ping").counter("request_count")
+        assert counter.get() >= 1
+    finally:
+        channel.close()
+        server.stop()
+
+
+def test_orchid_service_over_rpc():
+    from ytsaurus_tpu.rpc import Channel, RpcServer
+
+    tree = OrchidTree()
+    tree.register("/live", lambda: {"n": 42})
+    server = RpcServer([OrchidService(tree)])
+    server.start()
+    channel = Channel(server.address, timeout=10)
+    try:
+        body, _ = channel.call("orchid", "get", {"path": "/live/n"})
+        assert body["value"] == 42
+        body, _ = channel.call("orchid", "list", {"path": "/"})
+        names = [n.decode() if isinstance(n, bytes) else n
+                 for n in body["names"]]
+        assert names == ["live"]
+    finally:
+        channel.close()
+        server.stop()

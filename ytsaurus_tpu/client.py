@@ -99,6 +99,9 @@ class YtClient:
             schema = attributes.get("schema")
             if isinstance(schema, TableSchema):
                 attributes["schema"] = schema.to_dict()
+            elif isinstance(schema, (list, tuple)):
+                # YT-style bare column list.
+                attributes["schema"] = TableSchema.make(schema).to_dict()
             attributes.setdefault("dynamic", False)
             attributes.setdefault("chunk_ids", [])
             attributes.setdefault("row_count", 0)

@@ -1,0 +1,335 @@
+"""Declarative validated configs + dynamic config delivery.
+
+Ref shape: core/ytree/yson_struct.h (TYsonStruct: registered parameters with
+defaults, validators, postprocessors, recursive merge) and
+library/dynamic_config/dynamic_config_manager.h:23 (polls a Cypress path,
+diffs, applies, keeps the last good config on validation failure).
+
+Redesign: instead of C++ macro registration, a `YsonStruct` base class scans
+class-level `param(...)` declarations at subclass creation.  Values load
+from YSON-shaped dicts (bytes keys tolerated), merge recursively, and
+round-trip through `to_dict` for persistence in Cypress documents.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils.logging import get_logger
+
+logger = get_logger("Config")
+
+
+class _Param:
+    """One declared parameter: default, type, constraints."""
+
+    __slots__ = ("name", "default", "default_factory", "type", "ge", "le",
+                 "choices", "validator")
+
+    def __init__(self, default=None, *, default_factory=None, type=None,
+                 ge=None, le=None, choices=None, validator=None):
+        self.name: str = ""            # filled by __set_name__
+        self.default = default
+        self.default_factory = default_factory
+        self.type = type
+        self.ge = ge
+        self.le = le
+        self.choices = choices
+        self.validator = validator
+
+    def __set_name__(self, owner, name):
+        self.name = name
+
+    def make_default(self):
+        if self.default_factory is not None:
+            return self.default_factory()
+        if isinstance(self.type, type) and issubclass(self.type, YsonStruct) \
+                and self.default is None:
+            return self.type()
+        return self.default
+
+    def check(self, value, path: str) -> Any:
+        if value is None:
+            # Explicit null resets to the default (it must NOT bypass
+            # validation and poison consumers with unexpected Nones).
+            return self.make_default()
+        if self.type is not None:
+            if isinstance(self.type, type) and issubclass(self.type,
+                                                          YsonStruct):
+                if isinstance(value, dict):
+                    value = self.type.from_dict(value, path=path)
+                elif not isinstance(value, self.type):
+                    raise YtError(f"Config {path}: expected map for "
+                                  f"{self.type.__name__}, got {value!r}",
+                                  code=EErrorCode.InvalidConfig)
+            elif self.type is float and isinstance(value, int) \
+                    and not isinstance(value, bool):
+                value = float(value)
+            elif self.type is str and isinstance(value, bytes):
+                value = value.decode("utf-8")
+            elif not isinstance(value, self.type) \
+                    or (self.type is int and isinstance(value, bool)):
+                raise YtError(f"Config {path}: expected "
+                              f"{self.type.__name__}, got {value!r}",
+                              code=EErrorCode.InvalidConfig)
+        if self.ge is not None and value < self.ge:
+            raise YtError(f"Config {path}: {value!r} < minimum {self.ge!r}",
+                          code=EErrorCode.InvalidConfig)
+        if self.le is not None and value > self.le:
+            raise YtError(f"Config {path}: {value!r} > maximum {self.le!r}",
+                          code=EErrorCode.InvalidConfig)
+        if self.choices is not None and value not in self.choices:
+            raise YtError(f"Config {path}: {value!r} not one of "
+                          f"{sorted(self.choices)!r}",
+                          code=EErrorCode.InvalidConfig)
+        if self.validator is not None:
+            self.validator(value)
+        return value
+
+
+def param(default=None, **kwargs) -> Any:
+    """Declare a config parameter on a YsonStruct subclass."""
+    return _Param(default, **kwargs)
+
+
+class YsonStruct:
+    """Base for declarative configs; see module docstring.
+
+    Subclasses declare parameters:
+
+        class StoreConfig(YsonStruct):
+            capacity_bytes = param(1 << 30, type=int, ge=0)
+            codec = param("lz4", type=str, choices={"none", "lz4", "zstd"})
+
+    Unknown keys raise by default; set `keep_unrecognized = True` to retain
+    them (exposed via `.unrecognized`).
+    """
+
+    keep_unrecognized = False
+    _params: dict[str, _Param] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        merged: dict[str, _Param] = dict(cls.__mro__[1]._params) \
+            if hasattr(cls.__mro__[1], "_params") else {}
+        for name, value in list(vars(cls).items()):
+            if isinstance(value, _Param):
+                merged[name] = value
+        cls._params = merged
+
+    def __init__(self, **overrides):
+        self.unrecognized: dict[str, Any] = {}
+        for name, p in self._params.items():
+            setattr(self, name, p.make_default())
+        for name, value in overrides.items():
+            if name not in self._params:
+                raise YtError(f"Unknown config parameter {name!r}",
+                              code=EErrorCode.InvalidConfig)
+            setattr(self, name, self._params[name].check(value, name))
+        self.postprocess()
+
+    # -- hooks -----------------------------------------------------------------
+
+    def postprocess(self) -> None:
+        """Cross-field validation; override in subclasses."""
+
+    # -- load / dump -----------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: dict, path: str = "") -> "YsonStruct":
+        self = cls.__new__(cls)
+        self.unrecognized = {}
+        data = {(k.decode("utf-8") if isinstance(k, bytes) else k): v
+                for k, v in (data or {}).items()}
+        for name, p in cls._params.items():
+            here = f"{path}/{name}" if path else name
+            if name in data:
+                setattr(self, name, p.check(data.pop(name), here))
+            else:
+                setattr(self, name, p.make_default())
+        if data:
+            if cls.keep_unrecognized:
+                self.unrecognized = data
+            else:
+                raise YtError(
+                    f"Unrecognized config keys at {path or '/'}: "
+                    f"{sorted(data)!r}", code=EErrorCode.InvalidConfig)
+        self.postprocess()
+        return self
+
+    def to_dict(self) -> dict:
+        out = {}
+        for name in self._params:
+            value = getattr(self, name)
+            out[name] = value.to_dict() if isinstance(value, YsonStruct) \
+                else value
+        out.update(self.unrecognized)
+        return out
+
+    # -- merge -----------------------------------------------------------------
+
+    def merge(self, patch: Optional[dict]) -> "YsonStruct":
+        """Recursive merge: returns a NEW validated instance; `self` is
+        untouched (the dynamic-config manager keeps the old config when the
+        merged one fails validation)."""
+        merged = _deep_merge(self.to_dict(), patch or {})
+        return type(self).from_dict(merged)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other.to_dict() == self.to_dict()
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={getattr(self, k)!r}" for k in self._params)
+        return f"{type(self).__name__}({inner})"
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    out = dict(base)
+    for key, value in patch.items():
+        if isinstance(key, bytes):
+            key = key.decode("utf-8")
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Daemon configs (static YSON file; every server role loads one of these).
+# ---------------------------------------------------------------------------
+
+class RpcConfig(YsonStruct):
+    bind_host = param("127.0.0.1", type=str)
+    port = param(0, type=int, ge=0, le=65535)
+    max_workers = param(16, type=int, ge=1)
+    call_timeout = param(30.0, type=float, ge=0.0)
+    retry_attempts = param(2, type=int, ge=1)
+    retry_backoff = param(0.1, type=float, ge=0.0)
+
+
+class ChunkStoreConfig(YsonStruct):
+    cache_capacity_bytes = param(1 << 30, type=int, ge=0)
+    replication_factor = param(2, type=int, ge=1)
+    erasure_codec = param("none", type=str,
+                          choices={"none", "rs_6_3", "rs_3_2"})
+
+
+class MasterConfig(YsonStruct):
+    snapshot_every = param(1024, type=int, ge=1)
+    journal_nodes = param(2, type=int, ge=0)
+    bootstrap_timeout = param(60.0, type=float, ge=0.0)
+
+
+class SchedulerConfig(YsonStruct):
+    fair_share_update_period = param(0.1, type=float, ge=0.0)
+    max_running_jobs = param(8, type=int, ge=1)
+    speculative_after = param(5.0, type=float, ge=0.0)
+
+
+class DaemonConfig(YsonStruct):
+    """Top-level daemon config (`--config file.yson`)."""
+
+    role = param("primary", type=str, choices={"primary", "node", "proxy"})
+    root = param(None, type=str)
+    rpc = param(type=RpcConfig)
+    chunk_store = param(type=ChunkStoreConfig)
+    master = param(type=MasterConfig)
+    scheduler = param(type=SchedulerConfig)
+
+    def postprocess(self):
+        if self.role == "node" and self.chunk_store.replication_factor < 1:
+            raise YtError("node role requires replication_factor >= 1",
+                          code=EErrorCode.InvalidConfig)
+
+    @classmethod
+    def load(cls, path: str) -> "DaemonConfig":
+        from ytsaurus_tpu import yson
+        with open(path, "rb") as f:
+            return cls.from_dict(yson.loads(f.read()))
+
+
+# ---------------------------------------------------------------------------
+# Dynamic config manager
+# ---------------------------------------------------------------------------
+
+class DynamicConfigManager:
+    """Polls a Cypress document for config patches and applies them.
+
+    Ref: library/dynamic_config/dynamic_config_manager.h:23 — the manager
+    periodically fetches `//sys/<component>/@config`-style state, validates
+    the merged config, fires subscriber callbacks on change, and keeps
+    serving the last good config when a bad patch lands (the error is
+    logged + exported via `last_error`).
+    """
+
+    def __init__(self, fetch: Callable[[], Optional[dict]],
+                 base_config: YsonStruct, period: float = 1.0):
+        self._fetch = fetch
+        self._base = base_config
+        self._period = period
+        self._lock = threading.Lock()
+        self._current = base_config
+        self._last_patch: Optional[dict] = None
+        self.last_error: Optional[YtError] = None
+        self.update_count = 0
+        self._subscribers: list[Callable[[YsonStruct], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def config(self) -> YsonStruct:
+        with self._lock:
+            return self._current
+
+    def subscribe(self, callback: Callable[[YsonStruct], None]) -> None:
+        self._subscribers.append(callback)
+
+    def poll_once(self) -> bool:
+        """One fetch+merge+apply cycle; True if the config changed."""
+        try:
+            patch = self._fetch()
+        except Exception as exc:   # noqa: BLE001 — fetch is an RPC boundary;
+            # the poll loop must survive transport/teardown errors.
+            self.last_error = exc if isinstance(exc, YtError) else \
+                YtError(f"dynamic config fetch failed: {exc!r}")
+            return False
+        if patch == self._last_patch:
+            return False
+        try:
+            new_config = self._base.merge(patch)
+        except YtError as exc:
+            # Keep the last good config; surface the failure.
+            self.last_error = exc
+            logger.warning("rejecting dynamic config patch: %s", exc)
+            return False
+        self._last_patch = patch
+        self.last_error = None
+        with self._lock:
+            if new_config == self._current:
+                return False
+            self._current = new_config
+        self.update_count += 1
+        for callback in self._subscribers:
+            try:
+                callback(new_config)
+            except Exception as exc:   # noqa: BLE001 — subscriber boundary
+                logger.error("dynamic config subscriber failed: %r", exc)
+        return True
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dynamic-config")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._period):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -50,6 +50,14 @@ class EErrorCode(enum.IntEnum):
     # Journals / quorum WAL.
     JournalPositionMismatch = 1850
 
+    # Config (ref: yt/yt/core/ytree yson_struct validation).
+    InvalidConfig = 216
+
+    # Security (ref: yt/yt/client/security_client/public.h).
+    AuthenticationError = 900
+    AuthorizationError = 901
+    AccountLimitExceeded = 902
+
     # RPC (ref: yt/yt/core/rpc/public.h EErrorCode).
     NoSuchMethod = 1900
     NoSuchService = 1901

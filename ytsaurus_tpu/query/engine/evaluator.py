@@ -43,7 +43,22 @@ class Evaluator:
                  ) -> ColumnarChunk:
         """Execute a plan over one input chunk (plus join tables)."""
         import time as _time
+
+        from ytsaurus_tpu.utils.tracing import start_span
         t0 = _time.perf_counter()
+        # Span per plan execution, tagged with the plan fingerprint (ref:
+        # evaluator.cpp:67-75 annotates spans with query fingerprints);
+        # computed once and reused as the compile-cache key.
+        fp = ir.fingerprint(plan)
+        span = start_span("Evaluator.run_plan", fingerprint=fp,
+                          rows=chunk.row_count)
+        with span:
+            return self._run_plan_traced(plan, chunk, foreign_chunks,
+                                         stats, t0, fp)
+
+    def _run_plan_traced(self, plan, chunk, foreign_chunks, stats, t0,
+                         fp=None):
+        import time as _time
         if isinstance(plan, ir.Query) and plan.joins:
             foreign_chunks = foreign_chunks or {}
             # Materialize joins left-to-right, widening the namespace.
@@ -64,7 +79,7 @@ class Evaluator:
         elif isinstance(plan, ir.Query):
             chunk = _project_chunk(chunk, plan.schema)
 
-        result = self._execute(plan, chunk, stats)
+        result = self._execute(plan, chunk, stats, fp=fp)
 
         # GROUP BY ... WITH TOTALS: one extra grand-total row (null keys)
         # aggregated over the same filtered input, appended after the groups
@@ -79,9 +94,11 @@ class Evaluator:
         return result
 
     def _execute(self, plan, chunk: ColumnarChunk,
-                 stats: Optional[QueryStatistics] = None) -> ColumnarChunk:
+                 stats: Optional[QueryStatistics] = None,
+                 fp: Optional[str] = None) -> ColumnarChunk:
         prepared = prepare(plan, chunk)
-        key = (ir.fingerprint(plan), chunk.capacity, prepared.binding_shapes())
+        key = (fp or ir.fingerprint(plan), chunk.capacity,
+               prepared.binding_shapes())
         jitted = self._cache.get(key)
         if jitted is None:
             jitted = jax.jit(prepared.run)

@@ -60,6 +60,17 @@ class RemoteYtClient:
         self._channel.close()
         self.chunk_store.close()
 
+    # -- orchid ----------------------------------------------------------------
+
+    def get_orchid(self, path: str = "/") -> Any:
+        """Live daemon state (ref: orchid_service.h virtual trees)."""
+        body, _ = self._channel.call("orchid", "get", {"path": path})
+        return body.get("value")
+
+    def list_orchid(self, path: str = "/") -> list[str]:
+        body, _ = self._channel.call("orchid", "list", {"path": path})
+        return list(body.get("names", []))
+
     # -- cypress ---------------------------------------------------------------
 
     def create(self, node_type: str, path: str,

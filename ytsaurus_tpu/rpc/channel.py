@@ -92,7 +92,7 @@ class Channel:
         return state
 
     async def _call_async(self, service: str, method: str, body,
-                          attachments, timeout: float):
+                          attachments, timeout: float, trace_wire=None):
         if self._connect_lock is None:
             self._connect_lock = asyncio.Lock()
         async with self._connect_lock:
@@ -112,9 +112,11 @@ class Channel:
         if not state.alive:
             state.pending.pop(rid, None)
             raise ConnectionError("connection lost")
-        envelope = yson.dumps(
-            {"rid": rid, "kind": "req", "service": service,
-             "method": method}, binary=True)
+        req = {"rid": rid, "kind": "req", "service": service,
+               "method": method}
+        if trace_wire is not None:
+            req["trace"] = trace_wire
+        envelope = yson.dumps(req, binary=True)
         wire_body = yson.dumps(encode_body(body if body is not None else {}),
                                binary=True)
         try:
@@ -151,10 +153,15 @@ class Channel:
              attachments=(), timeout: float | None = None):
         """Returns (body: dict, attachments: list[bytes]); raises YtError."""
         timeout = timeout if timeout is not None else self.timeout
+        # Trace context is captured HERE, on the calling thread — contextvars
+        # do not flow into the shared loop thread.
+        from ytsaurus_tpu.utils.tracing import current_trace
+        ambient = current_trace()
+        trace_wire = ambient.to_wire() if ambient is not None else None
         loop = _shared_loop()
         fut = asyncio.run_coroutine_threadsafe(
             self._call_async(service, method, body, list(attachments),
-                             timeout), loop)
+                             timeout, trace_wire), loop)
         try:
             return fut.result(timeout=timeout + 15)
         except concurrent.futures.TimeoutError as exc:

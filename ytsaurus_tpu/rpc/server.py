@@ -20,8 +20,11 @@ from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
 from ytsaurus_tpu.rpc.wire import decode_body, encode_body
 from ytsaurus_tpu.utils.logging import get_logger
+from ytsaurus_tpu.utils.profiling import Profiler
+from ytsaurus_tpu.utils.tracing import TraceContext
 
 logger = get_logger("rpc")
+_profiler = Profiler("/rpc/server")
 
 
 def rpc_method(name: str | None = None, concurrency: int = 16):
@@ -198,9 +201,23 @@ class RpcServer:
             body = decode_body(yson.loads(parts[1], encoding=None)) \
                 if len(parts) > 1 else {}
             attachments = list(parts[2:])
+            trace_wire = envelope.get("trace")
+
+            def invoke():
+                # Server span continues the caller's trace (ref: rpc
+                # handlers run under the propagated TTraceContext).
+                with TraceContext.from_wire(trace_wire,
+                                            f"{service}.{method}") as span:
+                    span.add_tag("service", service)
+                    prof = _profiler.with_tags(service=service,
+                                               method=method)
+                    prof.counter("request_count").increment()
+                    with prof.timer("request_time"):
+                        return fn(body, attachments)
+
             async with sem:
                 result = await asyncio.get_event_loop().run_in_executor(
-                    self._pool, fn, body, attachments)
+                    self._pool, invoke)
             if isinstance(result, tuple):
                 out_body, out_attachments = result
             else:

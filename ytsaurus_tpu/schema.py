@@ -247,6 +247,9 @@ class TableSchema:
         }
 
     @classmethod
-    def from_dict(cls, d: dict[str, Any]) -> "TableSchema":
+    def from_dict(cls, d: "dict[str, Any] | list") -> "TableSchema":
+        # YT accepts a bare column list as @schema; honor that shape too.
+        if isinstance(d, (list, tuple)):
+            return cls.make(d)
         return cls.make(d["columns"], strict=d.get("strict", True),
                         unique_keys=d.get("unique_keys", False))

@@ -46,6 +46,9 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         NodeTrackerService,
     )
 
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    from ytsaurus_tpu.server.orchid import OrchidService, default_orchid
+
     os.makedirs(root, exist_ok=True)
     tracker = NodeTracker()
     # Bootstrap service set first: nodes must be able to register before
@@ -53,6 +56,12 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     server = RpcServer([NodeTrackerService(tracker)], port=port)
     server.start()
     _write_port_file(root, "primary", server.port)
+    orchid = default_orchid()
+    orchid.register("/node_tracker/alive", tracker.alive)
+    server.add_service(OrchidService(orchid))
+    monitoring = MonitoringServer(orchid)
+    monitoring.start()
+    _write_port_file(root, "primary.monitoring", monitoring.port)
     print(f"primary bootstrap on {server.address}", flush=True)
 
     # Journal membership is STICKY: chosen once, persisted, reused across
@@ -125,13 +134,22 @@ def run_node(root: str, port: int, primary_address: str,
     from ytsaurus_tpu.rpc import Channel, RetryingChannel, RpcServer
     from ytsaurus_tpu.server.services import DataNodeService
 
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    from ytsaurus_tpu.server.orchid import OrchidService, default_orchid
+
     os.makedirs(root, exist_ok=True)
     node_id = node_id or os.path.basename(os.path.normpath(root))
     store = FsChunkStore(os.path.join(root, "chunks"))
     service = DataNodeService(store, os.path.join(root, "journals"))
-    server = RpcServer([service], port=port)
+    orchid = default_orchid()
+    orchid.register("/data_node", lambda: {
+        "id": node_id, "chunk_count": len(store.list_chunks())})
+    server = RpcServer([service, OrchidService(orchid)], port=port)
     server.start()
     _write_port_file(root, "node", server.port)
+    monitoring = MonitoringServer(orchid)
+    monitoring.start()
+    _write_port_file(root, "node.monitoring", monitoring.port)
     print(f"data node {node_id} serving on {server.address}", flush=True)
 
     channel = RetryingChannel(Channel(primary_address, timeout=10),

@@ -1,0 +1,105 @@
+"""Monitoring HTTP endpoint: /metrics (Prometheus), /orchid/..., /healthz.
+
+Ref shape: library/profiling/solomon/exporter.h:25 — every daemon hosts a
+pull endpoint the monitoring system scrapes; Orchid doubles as the
+human-readable live-state browser.  stdlib http.server on a daemon thread
+is plenty: scrape traffic is tiny and the handlers only read in-process
+state.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ytsaurus_tpu.errors import YtError
+from ytsaurus_tpu.server.orchid import OrchidTree
+from ytsaurus_tpu.utils.profiling import ProfilerRegistry, get_registry
+
+
+class MonitoringServer:
+    def __init__(self, orchid: Optional[OrchidTree] = None,
+                 registry: Optional[ProfilerRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.orchid = orchid or OrchidTree()
+        self.registry = registry or get_registry()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):      # silence stderr chatter
+                pass
+
+            def do_GET(self):
+                try:
+                    outer._handle(self)
+                except (ConnectionError, BrokenPipeError):
+                    pass
+                except Exception as exc:   # noqa: BLE001 — one bad orchid
+                    # producer must yield a diagnosable 500, not a dropped
+                    # connection.
+                    try:
+                        outer._reply(self, 500, repr(exc).encode(),
+                                     "text/plain")
+                    except (ConnectionError, BrokenPipeError):
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="monitoring-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- request handling ------------------------------------------------------
+
+    def _handle(self, request) -> None:
+        path = request.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._reply(request, 200, b"ok", "text/plain")
+        elif path in ("/metrics", "/solomon"):
+            body = self.registry.render_prometheus().encode()
+            self._reply(request, 200, body, "text/plain; version=0.0.4")
+        elif path == "/orchid" or path.startswith("/orchid/"):
+            sub = path[len("/orchid"):] or "/"
+            try:
+                value = self.orchid.get(sub)
+            except YtError as err:
+                self._reply(request, 404,
+                            json.dumps(err.to_dict()).encode(),
+                            "application/json")
+                return
+            body = json.dumps(value, default=_json_default,
+                              indent=2).encode()
+            self._reply(request, 200, body, "application/json")
+        else:
+            self._reply(request, 404, b"not found", "text/plain")
+
+    @staticmethod
+    def _reply(request, status: int, body: bytes, ctype: str) -> None:
+        request.send_response(status)
+        request.send_header("Content-Type", ctype)
+        request.send_header("Content-Length", str(len(body)))
+        request.end_headers()
+        request.wfile.write(body)
+
+
+def _json_default(value):
+    if isinstance(value, bytes):
+        return value.decode("utf-8", "replace")
+    return str(value)

@@ -1,0 +1,140 @@
+"""Orchid: a virtual tree of live daemon state.
+
+Ref shape: library/orchid/orchid_service.h — every daemon exposes a YTree
+of live internals (config, sensors, connections, tablet state) served over
+RPC and mounted into Cypress so operators browse it with normal tree reads.
+
+Redesign: producers are callables registered at slash-paths; a read walks
+the static registry to the deepest matching producer, invokes it ONCE, then
+descends into the returned plain dict.  Served two ways: the `orchid` RPC
+service (thin client: `client.get_orchid(path)`) and the monitoring HTTP
+endpoint (`server/monitoring.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.rpc.server import Service, rpc_method
+
+
+def _split(path: str) -> list[str]:
+    return [t for t in path.split("/") if t]
+
+
+class OrchidTree:
+    """Registry of live-state producers."""
+
+    def __init__(self):
+        self._producers: dict[tuple, Callable[[], object]] = {}
+
+    def register(self, path: str, producer: Callable[[], object]) -> None:
+        """Mount a producer at `/a/b`; it returns a plain dict/value each
+        read (never cached — Orchid is live state by definition)."""
+        self._producers[tuple(_split(path))] = producer
+
+    def register_value(self, path: str, value) -> None:
+        self.register(path, lambda: value)
+
+    # -- reads -----------------------------------------------------------------
+
+    def get(self, path: str = "/"):
+        tokens = tuple(_split(path))
+        # Deepest registered producer that prefixes the path.
+        for depth in range(len(tokens), -1, -1):
+            producer = self._producers.get(tokens[:depth])
+            if producer is not None:
+                return _descend(producer(), tokens[depth:], path)
+        # No direct producer: synthesize the directory level.
+        children = self._level(tokens)
+        if children is None:
+            raise YtError(f"Orchid has no node {path!r}",
+                          code=EErrorCode.ResolveError)
+        return {name: "..." for name in children}
+
+    def list(self, path: str = "/") -> list[str]:
+        """Child names: structural sub-mounts plus keys of the produced
+        value when a producer covers the path."""
+        tokens = tuple(_split(path))
+        names: set[str] = set()
+        structural = False
+        for key in self._producers:
+            if len(key) > len(tokens) and key[:len(tokens)] == tokens:
+                names.add(key[len(tokens)])
+                structural = True
+        if any(key == tokens[:len(key)] for key in self._producers
+               if len(key) <= len(tokens)):
+            value = self.get(path)
+            if isinstance(value, dict):
+                names.update(k.decode() if isinstance(k, bytes) else str(k)
+                             for k in value)
+            elif not structural:
+                raise YtError(f"Orchid node {path!r} is not a map",
+                              code=EErrorCode.ResolveError)
+        elif not structural and tokens:
+            raise YtError(f"Orchid has no node {path!r}",
+                          code=EErrorCode.ResolveError)
+        return sorted(names)
+
+    def _level(self, tokens: tuple) -> set | None:
+        """Child names at a purely-structural level, None if absent."""
+        children = set()
+        found = False
+        for key in self._producers:
+            if len(key) > len(tokens) and key[:len(tokens)] == tokens:
+                children.add(key[len(tokens)])
+                found = True
+            elif key == tokens:
+                found = True
+        return children if found or not tokens else None
+
+
+def _descend(value, tokens, path: str):
+    for token in tokens:
+        if isinstance(value, dict):
+            if token in value:
+                value = value[token]
+                continue
+            if token.encode() in value:
+                value = value[token.encode()]
+                continue
+        if isinstance(value, (list, tuple)) and token.isdigit() \
+                and int(token) < len(value):
+            value = value[int(token)]
+            continue
+        raise YtError(f"Orchid has no node {path!r} (at {token!r})",
+                      code=EErrorCode.ResolveError)
+    return value
+
+
+class OrchidService(Service):
+    """RPC surface over an OrchidTree."""
+
+    name = "orchid"
+
+    def __init__(self, tree: OrchidTree):
+        self.tree = tree
+
+    @rpc_method()
+    def get(self, body, attachments):
+        return {"value": self.tree.get(body.get("path", "/"))}
+
+    @rpc_method()
+    def list(self, body, attachments):
+        return {"names": self.tree.list(body.get("path", "/"))}
+
+
+def default_orchid(config=None) -> OrchidTree:
+    """Standard daemon mounts: /config, /monitoring/sensors, /tracing."""
+    from ytsaurus_tpu.utils.profiling import get_registry
+    from ytsaurus_tpu.utils.tracing import get_collector
+
+    tree = OrchidTree()
+    if config is not None:
+        tree.register("/config", lambda: config.to_dict())
+    tree.register("/monitoring/sensors", get_registry().collect)
+    tree.register("/tracing/recent_spans",
+                  lambda: [s.to_dict() for s in
+                           get_collector().snapshot()[-64:]])
+    return tree

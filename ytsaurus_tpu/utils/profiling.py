@@ -1,0 +1,234 @@
+"""Typed sensors on a tag tree + Prometheus-format export.
+
+Ref shape: library/profiling (TProfiler: counters/gauges/summaries/
+histograms registered under a tag tree, per-CPU sharded) and
+library/profiling/solomon/exporter.h:25 (pull endpoint scraped by the
+monitoring system, Prometheus-compatible rendering).
+
+Redesign: one process-wide `ProfilerRegistry`; a `Profiler` is a (prefix,
+tags) view onto it.  Sensors are lock-striped rather than per-CPU — host
+Python threads, not fibers, are the concurrency unit here.  Rendering is
+Prometheus text exposition (the de-facto pull format); the HTTP endpoint
+lives on each daemon's monitoring server (`server/monitoring.py`).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Optional
+
+
+def _format_tags(tags: dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+def _sanitize(name: str) -> str:
+    return name.strip("/").replace("/", "_").replace("-", "_").replace(".", "_")
+
+
+class Counter:
+    """Monotone counter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def increment(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._value += delta
+
+    def get(self) -> float:
+        return self._value
+
+    def samples(self):
+        yield "counter", "", self._value
+
+
+class Gauge:
+    """Last-set value."""
+
+    def __init__(self):
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    def get(self) -> float:
+        return self._value
+
+    def samples(self):
+        yield "gauge", "", self._value
+
+
+class Summary:
+    """Count/sum/min/max/last of observed values."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.last = 0.0
+
+    def record(self, value: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+            self.last = value
+
+    def samples(self):
+        yield "summary", ".sum", self.sum
+        yield "summary", ".count", self.count
+        if self.count:
+            yield "summary", ".min", self.min
+            yield "summary", ".max", self.max
+
+
+class Histogram:
+    """Fixed-bucket histogram (upper bounds; +Inf implicit)."""
+
+    DEFAULT_BOUNDS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                      30.0, 60.0)
+
+    def __init__(self, bounds=None):
+        self.bounds = tuple(bounds or self.DEFAULT_BOUNDS)
+        self._lock = threading.Lock()
+        self.buckets = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+
+    def record(self, value: float) -> None:
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self.buckets[idx] += 1
+            self.count += 1
+            self.sum += value
+
+    def samples(self):
+        cumulative = 0
+        for bound, n in zip(self.bounds, self.buckets):
+            cumulative += n
+            yield "histogram", f'.bucket{{le="{bound}"}}', cumulative
+        yield "histogram", '.bucket{le="+Inf"}', self.count
+        yield "histogram", ".sum", self.sum
+        yield "histogram", ".count", self.count
+
+
+class Timer:
+    """Context manager recording elapsed seconds into a Summary/Histogram."""
+
+    def __init__(self, sensor):
+        self._sensor = sensor
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._sensor.record(time.perf_counter() - self._t0)
+        return False
+
+
+class ProfilerRegistry:
+    """All sensors of one process, keyed by (name, frozen tags)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sensors: dict[tuple, object] = {}
+
+    def _get(self, name: str, tags: dict, factory):
+        key = (name, tuple(sorted(tags.items())))
+        with self._lock:
+            sensor = self._sensors.get(key)
+            if sensor is None:
+                sensor = self._sensors[key] = factory()
+            return sensor
+
+    def render_prometheus(self) -> str:
+        """Text exposition format, stable ordering."""
+        lines = []
+        with self._lock:
+            items = sorted(self._sensors.items(),
+                           key=lambda kv: (kv[0][0], kv[0][1]))
+        for (name, tags), sensor in items:
+            metric = _sanitize(name)
+            tag_str = _format_tags(dict(tags))
+            for _kind, suffix, value in sensor.samples():
+                if suffix.startswith(".bucket"):
+                    # merge histogram le-tag with sensor tags
+                    le = suffix[len(".bucket"):]
+                    base = tag_str[:-1] + "," + le[1:] if tag_str \
+                        else le
+                    lines.append(f"{metric}_bucket{base} {value}")
+                else:
+                    lines.append(
+                        f"{metric}{suffix.replace('.', '_')}{tag_str} "
+                        f"{value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def collect(self) -> dict:
+        """Live snapshot as a plain dict (Orchid's data source)."""
+        out = {}
+        with self._lock:
+            items = list(self._sensors.items())
+        for (name, tags), sensor in items:
+            entry = {suffix or "value": value
+                     for _k, suffix, value in sensor.samples()
+                     if not suffix.startswith(".bucket")}
+            key = name + _format_tags(dict(tags))
+            out[key] = entry if len(entry) > 1 else next(iter(entry.values()))
+        return out
+
+
+_global_registry = ProfilerRegistry()
+
+
+def get_registry() -> ProfilerRegistry:
+    return _global_registry
+
+
+class Profiler:
+    """A (prefix, tags) view: `Profiler('/query', {'pool': 'prod'})`.
+
+    Ref TProfiler semantics: `.with_tags()` refines, sensor getters
+    create-or-fetch.
+    """
+
+    def __init__(self, prefix: str = "", tags: Optional[dict] = None,
+                 registry: Optional[ProfilerRegistry] = None):
+        self.prefix = prefix
+        self.tags = dict(tags or {})
+        self.registry = registry or _global_registry
+
+    def with_prefix(self, prefix: str) -> "Profiler":
+        return Profiler(self.prefix + prefix, self.tags, self.registry)
+
+    def with_tags(self, **tags) -> "Profiler":
+        return Profiler(self.prefix, {**self.tags, **tags}, self.registry)
+
+    def _name(self, name: str) -> str:
+        return f"{self.prefix}/{name}" if self.prefix else name
+
+    def counter(self, name: str) -> Counter:
+        return self.registry._get(self._name(name), self.tags, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self.registry._get(self._name(name), self.tags, Gauge)
+
+    def summary(self, name: str) -> Summary:
+        return self.registry._get(self._name(name), self.tags, Summary)
+
+    def histogram(self, name: str, bounds=None) -> Histogram:
+        return self.registry._get(self._name(name), self.tags,
+                                  lambda: Histogram(bounds))
+
+    def timer(self, name: str) -> Timer:
+        return Timer(self.summary(name))

@@ -1,0 +1,154 @@
+"""Distributed trace contexts with sampling and baggage.
+
+Ref shape: core/tracing/trace_context.h:75 — a TTraceContext carries
+(trace id, span id, parent span id, sampled flag, baggage), is propagated
+implicitly through fibers and explicitly through RPC headers, and finished
+spans go to an exporter (Jaeger in the reference).
+
+Redesign: a `contextvars`-based ambient context (survives asyncio + thread
+pools via explicit capture in the RPC layer), spans finished into an
+in-process ring buffer that Orchid/tests read; the wire encoding is a plain
+dict injected into the RPC envelope.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+_current: contextvars.ContextVar[Optional["TraceContext"]] = \
+    contextvars.ContextVar("trace_context", default=None)
+
+
+class SpanRecord:
+    """One finished span (exporter unit)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span_id", "name", "start",
+                 "duration", "tags", "baggage")
+
+    def __init__(self, ctx: "TraceContext", duration: float):
+        self.trace_id = ctx.trace_id
+        self.span_id = ctx.span_id
+        self.parent_span_id = ctx.parent_span_id
+        self.name = ctx.name
+        self.start = ctx.start_time
+        self.duration = duration
+        self.tags = dict(ctx.tags)
+        self.baggage = dict(ctx.baggage)
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class SpanCollector:
+    """Ring buffer of finished sampled spans."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._spans: list[SpanRecord] = []
+
+    def add(self, span: SpanRecord) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self.capacity:
+                del self._spans[:len(self._spans) - self.capacity]
+
+    def drain(self) -> list[SpanRecord]:
+        with self._lock:
+            spans, self._spans = self._spans, []
+            return spans
+
+    def snapshot(self) -> list[SpanRecord]:
+        with self._lock:
+            return list(self._spans)
+
+    def find(self, trace_id: str) -> list[SpanRecord]:
+        return [s for s in self.snapshot() if s.trace_id == trace_id]
+
+
+_collector = SpanCollector()
+
+
+def get_collector() -> SpanCollector:
+    return _collector
+
+
+class TraceContext:
+    """One span; use as a context manager to time + activate it."""
+
+    def __init__(self, name: str, *, trace_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None, sampled: bool = True,
+                 baggage: Optional[dict] = None):
+        self.name = name
+        self.trace_id = trace_id or uuid.uuid4().hex
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_span_id = parent_span_id
+        self.sampled = sampled
+        self.baggage: dict[str, Any] = dict(baggage or {})
+        self.tags: dict[str, Any] = {}
+        self.start_time = 0.0
+        self._token = None
+
+    # -- structure -------------------------------------------------------------
+
+    def create_child(self, name: str) -> "TraceContext":
+        return TraceContext(name, trace_id=self.trace_id,
+                            parent_span_id=self.span_id,
+                            sampled=self.sampled, baggage=self.baggage)
+
+    def add_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    def set_baggage(self, key: str, value: Any) -> None:
+        self.baggage[key] = value
+
+    # -- activation ------------------------------------------------------------
+
+    def __enter__(self) -> "TraceContext":
+        self.start_time = time.time()
+        self._t0 = time.perf_counter()
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _current.reset(self._token)
+        if self.sampled:
+            _collector.add(SpanRecord(self, time.perf_counter() - self._t0))
+        return False
+
+    # -- wire ------------------------------------------------------------------
+
+    def to_wire(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "sampled": self.sampled, "baggage": self.baggage}
+
+    @classmethod
+    def from_wire(cls, wire: Optional[dict], name: str) -> "TraceContext":
+        if not wire:
+            return cls(name)
+        def _text(v):
+            return v.decode() if isinstance(v, bytes) else v
+        wire = {(_text(k)): v for k, v in wire.items()}
+        return cls(name, trace_id=_text(wire.get("trace_id")),
+                   parent_span_id=_text(wire.get("span_id")),
+                   sampled=bool(wire.get("sampled", True)),
+                   baggage={_text(k): (_text(v) if isinstance(v, bytes)
+                                       else v)
+                            for k, v in (wire.get("baggage") or {}).items()})
+
+
+def current_trace() -> Optional[TraceContext]:
+    return _current.get()
+
+
+def start_span(name: str, **tags) -> TraceContext:
+    """Child of the ambient context, or a fresh root."""
+    parent = _current.get()
+    ctx = parent.create_child(name) if parent is not None \
+        else TraceContext(name)
+    ctx.tags.update(tags)
+    return ctx
