@@ -1,0 +1,273 @@
+"""Master transactions/locks + security (users, ACLs, accounts, quotas)."""
+
+import os
+
+import pytest
+
+from ytsaurus_tpu.client import YtClient, YtCluster
+from ytsaurus_tpu.cypress.master import Master
+from ytsaurus_tpu.cypress.security import authenticated_user
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+
+@pytest.fixture
+def client(tmp_path):
+    return YtClient(YtCluster(str(tmp_path / "cluster")))
+
+
+# -- master transactions -------------------------------------------------------
+
+def test_tx_commit_keeps_changes(client):
+    tx = client.start_tx()
+    client.create("map_node", "//home", tx=tx)
+    client.set("//home/@flag", 1, tx=tx)
+    client.commit_tx(tx)
+    assert client.get("//home/@flag") == 1
+
+
+def test_tx_abort_rolls_back_create_and_set(client):
+    client.create("map_node", "//home")
+    client.set("//home/@color", "blue")
+    tx = client.start_tx()
+    client.create("map_node", "//home/sub", tx=tx)
+    client.set("//home/@color", "red", tx=tx)
+    client.abort_tx(tx)
+    assert not client.exists("//home/sub")
+    assert client.get("//home/@color") == "blue"
+
+
+def test_tx_abort_restores_removed_subtree(client):
+    client.create("map_node", "//a/b", recursive=True)
+    client.set("//a/b/@x", 42)
+    tx = client.start_tx()
+    client.remove("//a", tx=tx)
+    assert not client.exists("//a")
+    client.abort_tx(tx)
+    assert client.get("//a/b/@x") == 42
+
+
+def test_exclusive_lock_blocks_other_writers(client):
+    client.create("map_node", "//locked")
+    tx = client.start_tx()
+    client.lock("//locked", mode="exclusive", tx=tx)
+    with pytest.raises(YtError) as ei:
+        client.set("//locked/@x", 1)            # non-tx writer
+    assert ei.value.code == EErrorCode.ConcurrentTransactionLockConflict
+    other = client.start_tx()
+    with pytest.raises(YtError):
+        client.set("//locked/@x", 1, tx=other)   # other tx
+    # Subtree containment: creating UNDER the locked node also conflicts.
+    with pytest.raises(YtError):
+        client.create("map_node", "//locked/child", tx=other)
+    client.commit_tx(tx)
+    client.set("//locked/@x", 1)                 # free after commit
+
+
+def test_shared_locks_coexist_but_block_exclusive(client):
+    client.create("map_node", "//shared")
+    tx1, tx2 = client.start_tx(), client.start_tx()
+    client.lock("//shared", mode="shared", tx=tx1)
+    client.lock("//shared", mode="shared", tx=tx2)   # ok
+    tx3 = client.start_tx()
+    with pytest.raises(YtError):
+        client.lock("//shared", mode="exclusive", tx=tx3)
+
+
+def test_snapshot_lock_pins_reads(client):
+    client.create("map_node", "//snap")
+    client.set("//snap/@v", 1)
+    tx = client.start_tx()
+    client.lock("//snap", mode="snapshot", tx=tx)
+    client.set("//snap/@v", 2)                   # outside writer proceeds
+    assert client.get("//snap/@v") == 2
+    assert client.get("//snap/@v", tx=tx) == 1   # pinned view
+
+
+def test_nested_tx_commit_into_parent_then_abort(client):
+    client.create("map_node", "//n")
+    parent = client.start_tx()
+    child = client.start_tx(parent=parent)
+    client.set("//n/@x", 10, tx=child)
+    client.commit_tx(child)
+    assert client.get("//n/@x") == 10
+    client.abort_tx(parent)                      # parent abort undoes child
+    assert not client.exists("//n/@x")
+
+
+def test_implicit_locks_conflict_between_txs(client):
+    client.create("map_node", "//w")
+    tx1 = client.start_tx()
+    client.set("//w/@a", 1, tx=tx1)              # implicit exclusive lock
+    tx2 = client.start_tx()
+    with pytest.raises(YtError):
+        client.set("//w/@b", 2, tx=tx2)
+
+
+def test_tx_state_survives_restart(tmp_path):
+    root = str(tmp_path / "cluster")
+    client = YtClient(YtCluster(root))
+    client.create("map_node", "//persist")
+    client.set("//persist/@v", "old")
+    tx = client.start_tx()
+    client.set("//persist/@v", "dirty", tx=tx)
+    client.cluster.master.build_snapshot()       # undo must be IN snapshot
+
+    reopened = YtClient(YtCluster(root))
+    assert reopened.get("//persist/@v") == "dirty"
+    reopened.abort_tx(tx)                        # rollback after restart
+    assert reopened.get("//persist/@v") == "old"
+
+
+def test_tx_survives_restart_via_wal_replay_alone(tmp_path):
+    """No snapshot: recovery must REPLAY tx_start with its original id, or
+    every later tx-scoped record orphans (regression: ids were generated at
+    apply time, so replay minted fresh ones)."""
+    root = str(tmp_path / "cluster")
+    client = YtClient(YtCluster(root))
+    client.create("map_node", "//r")
+    client.set("//r/@v", "old")
+    tx = client.start_tx()
+    client.set("//r/@v", "dirty", tx=tx)
+
+    reopened = YtClient(YtCluster(root))
+    assert reopened.get("//r/@v") == "dirty"
+    assert tx in reopened.cluster.master.tx_manager.transactions
+    reopened.abort_tx(tx)
+    assert reopened.get("//r/@v") == "old"
+
+
+# -- security ------------------------------------------------------------------
+
+def test_users_groups_membership(client):
+    sec = client.cluster.security
+    sec.create_user("alice")
+    sec.create_group("devs", members=["alice"])
+    assert "devs" in sec.groups_of("alice")
+    sec.remove_member("devs", "alice")
+    assert "devs" not in sec.groups_of("alice")
+
+
+def test_acl_allow_and_deny(client):
+    sec = client.cluster.security
+    sec.create_user("alice")
+    sec.create_user("bob")
+    client.create("map_node", "//prod")
+    client.set("//prod/@acl", [
+        {"action": "allow", "subjects": ["alice"],
+         "permissions": ["read", "write"]},
+    ])
+    with authenticated_user("alice"):
+        client.set("//prod/@tag", 1)             # allowed
+        assert client.get("//prod/@tag") == 1
+    with authenticated_user("bob"):
+        with pytest.raises(YtError) as ei:
+            client.set("//prod/@tag", 2)
+        assert ei.value.code == EErrorCode.AuthorizationError
+
+
+def test_acl_inheritance_and_deny_wins(client):
+    sec = client.cluster.security
+    sec.create_user("alice")
+    client.create("map_node", "//top/mid/leaf", recursive=True)
+    client.set("//top/@acl", [
+        {"action": "allow", "subjects": ["alice"],
+         "permissions": ["write"]}])
+    with authenticated_user("alice"):
+        client.set("//top/mid/leaf/@x", 1)       # inherited allow
+    client.set("//top/mid/@acl", [
+        {"action": "deny", "subjects": ["alice"],
+         "permissions": ["write"]}])
+    with authenticated_user("alice"):
+        with pytest.raises(YtError):
+            client.set("//top/mid/leaf/@x", 2)   # deny beats allow
+
+
+def test_group_based_acl(client):
+    sec = client.cluster.security
+    sec.create_user("carol")
+    sec.create_group("admins", members=["carol"])
+    client.create("map_node", "//adm")
+    client.set("//adm/@acl", [
+        {"action": "allow", "subjects": ["admins"],
+         "permissions": ["write"]}])
+    with authenticated_user("carol"):
+        client.set("//adm/@ok", True)
+
+
+def test_reads_default_open_writes_closed(client):
+    sec = client.cluster.security
+    sec.create_user("eve")
+    client.create("map_node", "//data")
+    with authenticated_user("eve"):
+        assert client.get("//data") == {}        # default read ok
+        with pytest.raises(YtError):
+            client.set("//data/@x", 1)           # no write grant
+
+
+def test_unknown_user_rejected(client):
+    with authenticated_user("ghost"):
+        with pytest.raises(YtError) as ei:
+            client.get("//sys")
+    assert ei.value.code == EErrorCode.AuthenticationError
+
+
+def test_account_quota_node_count(client):
+    sec = client.cluster.security
+    sec.create_account("small", resource_limits={"node_count": 2})
+    client.create("map_node", "//qq")
+    client.set("//qq/@account", "small")
+    client.create("map_node", "//qq/a")
+    client.create("map_node", "//qq/b")
+    with pytest.raises(YtError) as ei:
+        client.create("map_node", "//qq/c")
+    assert ei.value.code == EErrorCode.AccountLimitExceeded
+    # Removal frees quota.
+    client.remove("//qq/a")
+    client.create("map_node", "//qq/c")
+
+
+def test_account_disk_quota_on_write(client):
+    sec = client.cluster.security
+    sec.create_account("tiny", resource_limits={"disk_space": 64})
+    client.create("map_node", "//t")
+    client.set("//t/@account", "tiny")
+    with pytest.raises(YtError) as ei:
+        client.write_table("//t/big", [{"k": i} for i in range(1000)])
+    assert ei.value.code == EErrorCode.AccountLimitExceeded
+
+
+def test_remote_security_and_tx(tmp_path):
+    """Thin-client surface over a real daemon cluster."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from ytsaurus_tpu.environment import LocalCluster
+    from ytsaurus_tpu.remote_client import connect_remote
+
+    with LocalCluster(str(tmp_path / "lc"), n_nodes=1) as cluster:
+        cl = connect_remote(cluster.primary_address)
+        cl.create_user("alice")
+        cl.create("map_node", "//secured")
+        cl.set("//secured/@acl", [
+            {"action": "allow", "subjects": ["alice"],
+             "permissions": ["read", "write"]}])
+        assert cl.check_permission("alice", "write",
+                                   "//secured")["action"] == "allow"
+
+        alice = cl.as_user("alice")
+        alice.set("//secured/@note", "hi")
+        assert alice.get("//secured/@note") == "hi"
+
+        cl.create_user("bob")
+        bob = cl.as_user("bob")
+        with pytest.raises(YtError) as ei:
+            bob.set("//secured/@note", "nope")
+        assert ei.value.code == EErrorCode.AuthorizationError
+
+        # Master tx over the wire.
+        tx = cl.start_tx()
+        cl.set("//secured/@note", "dirty", tx=tx)
+        cl.abort_tx(tx)
+        assert cl.get("//secured/@note") == "hi"
+        alice.close()
+        bob.close()
+        cl.close()

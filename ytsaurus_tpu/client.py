@@ -46,6 +46,9 @@ class YtCluster:
         self.transactions = TransactionManager()
         self.evaluator = Evaluator()
         self.tablets: dict[str, list[Tablet]] = {}   # node id → tablets
+        from ytsaurus_tpu.cypress.security import SecurityManager
+        self.security = SecurityManager(self.master)
+        self.security.ensure_defaults()
 
 
 def publish_table_chunks(client, chunk_store, path, chunks,
@@ -67,6 +70,17 @@ def publish_table_chunks(client, chunk_store, path, chunks,
         client.set(path + "/@sorted_by", list(sorted_by))
     elif client.exists(path + "/@sorted_by"):
         client.remove(path + "/@sorted_by", force=True)
+
+
+def _chunk_bytes(chunk) -> int:
+    """Approximate resident bytes of a chunk's column planes (quota unit)."""
+    import numpy as np
+    total = 0
+    for col in chunk.columns.values():
+        total += np.asarray(col.data).nbytes
+        if col.valid is not None:
+            total += np.asarray(col.valid).nbytes
+    return total
 
 
 def _normalize_per_tablet(ids) -> "list[list[str]]":
@@ -93,7 +107,9 @@ class YtClient:
 
     def create(self, node_type: str, path: str,
                attributes: Optional[dict] = None, recursive: bool = False,
-               ignore_existing: bool = False) -> str:
+               ignore_existing: bool = False, tx: Optional[str] = None) -> str:
+        parent = path.rsplit("/", 1)[0] or "/"
+        self.cluster.security.validate_permission("write", parent)
         attributes = dict(attributes or {})
         if node_type == "table":
             schema = attributes.get("schema")
@@ -105,21 +121,85 @@ class YtClient:
             attributes.setdefault("dynamic", False)
             attributes.setdefault("chunk_ids", [])
             attributes.setdefault("row_count", 0)
-        return self.cluster.master.commit_mutation(
-            "create", path=path, type=node_type, attributes=attributes,
-            recursive=recursive, ignore_existing=ignore_existing)
+        # Charge exactly the nodes this call will create: none when the
+        # target pre-exists (ignore_existing), plus missing ancestors for
+        # recursive creates.
+        new_nodes = self._count_new_nodes(path, recursive)
+        if new_nodes:
+            self._charge(path, node_count=new_nodes)   # quota gate first
+        try:
+            return self.cluster.master.commit_mutation(
+                "create", path=path, type=node_type, attributes=attributes,
+                recursive=recursive, ignore_existing=ignore_existing, tx=tx)
+        except YtError:
+            if new_nodes:
+                self._charge(path, node_count=-new_nodes)
+            raise
 
-    def get(self, path: str) -> Any:
+    def _count_new_nodes(self, path: str, recursive: bool) -> int:
+        tree = self.cluster.master.tree
+        if tree.try_resolve(path) is not None:
+            return 0
+        if not recursive:
+            return 1
+        count = 1
+        parent = path.rsplit("/", 1)[0]
+        while parent and parent != "/" and \
+                tree.try_resolve(parent) is None:
+            count += 1
+            parent = parent.rsplit("/", 1)[0]
+        return count
+
+    def get(self, path: str, tx: Optional[str] = None) -> Any:
+        self.cluster.security.validate_permission("read", path)
+        if tx is not None:
+            # Snapshot-locked reads see the pinned copy.
+            pinned = self.cluster.master.tx_manager.read_snapshot(tx, path)
+            if pinned is not None:
+                return pinned
         return self.cluster.master.tree.get(path)
 
-    def set(self, path: str, value: Any) -> None:
-        self.cluster.master.commit_mutation("set", path=path, value=value)
+    def set(self, path: str, value: Any, tx: Optional[str] = None) -> None:
+        self.cluster.security.validate_permission("write", path)
+        self.cluster.master.commit_mutation("set", path=path, value=value,
+                                            tx=tx)
 
     def exists(self, path: str) -> bool:
         return self.cluster.master.tree.exists(path)
 
     def list(self, path: str) -> list[str]:
+        self.cluster.security.validate_permission("read", path)
         return self.cluster.master.tree.list(path)
+
+    # -- master transactions / locks ------------------------------------------
+    # (ref: master transactions + cypress locks, transaction_server and
+    # node_detail.h; commands mirror the driver's start_tx/lock surface)
+
+    def start_tx(self, parent: Optional[str] = None) -> str:
+        return self.cluster.master.commit_mutation("tx_start",
+                                                   parent_id=parent)
+
+    def commit_tx(self, tx: str) -> None:
+        self.cluster.master.commit_mutation("tx_commit", tx_id=tx)
+
+    def abort_tx(self, tx: str) -> None:
+        self.cluster.master.commit_mutation("tx_abort", tx_id=tx)
+
+    def lock(self, path: str, mode: str = "exclusive",
+             tx: Optional[str] = None) -> None:
+        if tx is None:
+            raise YtError("lock requires a transaction")
+        self.cluster.master.commit_mutation("lock", tx_id=tx, path=path,
+                                            mode=mode)
+
+    # -- accounts / quota metering ---------------------------------------------
+
+    def _charge(self, path: str, **deltas) -> None:
+        """Meter account usage; quota violations raise BEFORE data lands."""
+        security = self.cluster.security
+        account = security.account_of(path)
+        if self.exists(f"//sys/accounts/{account}"):
+            security.charge_account(account, **deltas)
 
     def copy(self, src_path: str, dst_path: str,
              recursive: bool = False) -> str:
@@ -182,19 +262,45 @@ class YtClient:
             "link", target=target_path, link=link_path, recursive=recursive)
 
     def remove(self, path: str, recursive: bool = True,
-               force: bool = False) -> None:
+               force: bool = False, tx: Optional[str] = None) -> None:
+        self.cluster.security.validate_permission("remove", path)
         node = self.cluster.master.tree.try_resolve(path)
-        if node is not None:
-            # Evict tablets of every dynamic table in the removed subtree.
+        # One subtree walk: tally metered usage + find mounted tables.
+        freed_nodes, freed_disk, freed_chunks = 0, 0, 0
+        mounted: list[str] = []
+        if node is not None and "/@" not in path:
             stack = [node]
             while stack:
                 current = stack.pop()
-                dropped = self.cluster.tablets.pop(current.id, None)
-                for tablet in dropped or ():
-                    tablet.set_in_memory(False)
+                freed_nodes += 1
+                usage = current.attributes.get("resource_usage") or {}
+                freed_disk += int(usage.get("disk_space", 0))
+                freed_chunks += int(usage.get("chunk_count", 0))
+                if current.id in self.cluster.tablets:
+                    mounted.append(current.id)
                 stack.extend(current.children.values())
+        if tx is not None and mounted:
+            # A transactional remove can be rolled back, but tablet
+            # eviction cannot — refuse rather than strand a restored
+            # dynamic table without its tablets.
+            raise YtError(
+                f"Unmount dynamic tables under {path!r} before a "
+                "transactional remove", code=EErrorCode.TabletNotMounted)
+        account = self.cluster.security.account_of(path)
+        # Mutation FIRST (it can fail on a lock conflict); irreversible
+        # side effects — tablet eviction, quota credit — only after it
+        # lands.  Transactional removes skip the quota credit: an abort
+        # restores the nodes, and usage must still cover them.
         self.cluster.master.commit_mutation(
-            "remove", path=path, recursive=recursive, force=force)
+            "remove", path=path, recursive=recursive, force=force, tx=tx)
+        for node_id in mounted:
+            for tablet in self.cluster.tablets.pop(node_id, ()):
+                tablet.set_in_memory(False)
+        if tx is None and (freed_nodes or freed_disk or freed_chunks):
+            if self.exists(f"//sys/accounts/{account}"):
+                self.cluster.security.charge_account(
+                    account, node_count=-freed_nodes,
+                    disk_space=-freed_disk, chunk_count=-freed_chunks)
 
     def collect_garbage(self) -> int:
         """Remove chunk files referenced by no table (ref: the master's
@@ -238,6 +344,7 @@ class YtClient:
                     append: bool = False,
                     schema: "TableSchema | dict | None" = None,
                     format: Optional[str] = None) -> None:
+        self.cluster.security.validate_permission("write", path)
         if format is not None:
             from ytsaurus_tpu.formats import loads_rows
             columns = None
@@ -263,6 +370,8 @@ class YtClient:
         if rows:
             from ytsaurus_tpu.query.pruning import compute_column_stats
             chunk = ColumnarChunk.from_rows(table_schema, list(rows))
+            self._meter_table(path, node, chunk_delta=1,
+                              disk_delta=_chunk_bytes(chunk))
             chunks.append(self.cluster.chunk_store.write_chunk(chunk))
             stats.append(compute_column_stats(chunk))
             row_count += chunk.row_count
@@ -274,9 +383,21 @@ class YtClient:
             self.cluster.master.commit_mutation(
                 "remove", path=path + "/@sorted_by", force=True)
 
+    def _meter_table(self, path: str, node, chunk_delta: int,
+                     disk_delta: int) -> None:
+        """Account charge for new chunk data + per-node usage bookkeeping
+        (the remove path frees from @resource_usage)."""
+        self._charge(path, disk_space=disk_delta, chunk_count=chunk_delta)
+        usage = dict(node.attributes.get("resource_usage") or {})
+        usage["disk_space"] = int(usage.get("disk_space", 0)) + disk_delta
+        usage["chunk_count"] = int(usage.get("chunk_count", 0)) + chunk_delta
+        self.cluster.master.commit_mutation(
+            "set", path=path + "/@resource_usage", value=usage)
+
     def read_table(self, path: str, format: Optional[str] = None):
         """Rows as dicts, or serialized bytes when `format` is given
         (yson/json/dsv/schemaful_dsv — ref client/formats)."""
+        self.cluster.security.validate_permission("read", path)
         chunks = self._read_table_chunks(path)
         rows: list[dict] = []
         for chunk in chunks:
@@ -292,6 +413,7 @@ class YtClient:
     # ------------------------------------------------------------ dynamic tables
 
     def mount_table(self, path: str) -> None:
+        self.cluster.security.validate_permission("mount", path)
         node = self._table_node(path)
         schema = self._node_schema(node)
         if schema is None:

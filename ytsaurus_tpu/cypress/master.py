@@ -87,12 +87,14 @@ class Master:
 
     def __init__(self, root_dir: str, wal=None):
         from ytsaurus_tpu.cypress.quorum import LocalWal
+        from ytsaurus_tpu.cypress.transactions import MasterTransactionManager
         self.root_dir = root_dir
         os.makedirs(root_dir, exist_ok=True)
         self._lock = threading.RLock()
         self._poisoned = False
         self._snapshot_seq = 0
         self.tree = CypressTree()
+        self.tx_manager = MasterTransactionManager(self.tree)
         # wal: LocalWal (default) or QuorumWal over journal locations on
         # data nodes — recover() returns replayable records, append() is
         # the durability barrier, reset() truncates after snapshots.
@@ -102,12 +104,19 @@ class Master:
 
     # -- mutation pipeline -----------------------------------------------------
 
-    _MUTATIONS = ("create", "remove", "set", "copy", "move", "link")
+    _MUTATIONS = ("create", "remove", "set", "copy", "move", "link",
+                  "tx_start", "tx_commit", "tx_abort", "lock")
+    _TREE_MUTATIONS = ("create", "remove", "set", "copy", "move", "link")
 
     def commit_mutation(self, op: str, **args) -> Any:
         """Log, then apply (ref CommitMutation)."""
         if op not in self._MUTATIONS:
             raise YtError(f"Unknown mutation {op!r}")
+        if op == "tx_start" and not args.get("tx_id"):
+            # The id MUST be fixed before logging: replay regenerating a
+            # fresh id would orphan every subsequent tx-scoped record.
+            import uuid
+            args["tx_id"] = uuid.uuid4().hex
         with self._lock:
             if self._poisoned:
                 raise YtError(
@@ -134,6 +143,30 @@ class Master:
             return result
 
     def _apply(self, op: str, args: dict) -> Any:
+        # Transaction lifecycle + lock mutations (ref: transaction_server
+        # master transactions riding the same Hydra mutation pipeline).
+        if op == "tx_start":
+            return self.tx_manager.start(args.get("tx_id"),
+                                         args.get("parent_id"))
+        if op == "tx_commit":
+            return self.tx_manager.commit(args["tx_id"])
+        if op == "tx_abort":
+            return self.tx_manager.abort(args["tx_id"])
+        if op == "lock":
+            return self.tx_manager.lock(args["tx_id"], args["path"],
+                                        args.get("mode", "exclusive"))
+        # Tree mutations: lock-conflict check + undo capture first (the
+        # undo must observe the pre-mutation state); the undo is recorded
+        # only after the tree op succeeds.
+        tx_id = args.get("tx")
+        undo = self.tx_manager.before_mutation(tx_id, op,
+                                               {k: v for k, v in args.items()
+                                                if k != "tx"})
+        result = self._apply_tree_op(op, args)
+        self.tx_manager.after_mutation(tx_id, undo)
+        return result
+
+    def _apply_tree_op(self, op: str, args: dict) -> Any:
         if op == "create":
             return self.tree.create(
                 args["path"], args["type"],
@@ -166,7 +199,8 @@ class Master:
         collapse metadata durability back to one disk."""
         with self._lock:
             seq = self._snapshot_seq + 1
-            blob = yson.dumps({"seq": seq, "tree": self.tree.serialize()},
+            blob = yson.dumps({"seq": seq, "tree": self.tree.serialize(),
+                               "transactions": self.tx_manager.serialize()},
                               binary=True)
             self.wal.store_snapshot(seq, blob)
             snap_path = os.path.join(self.root_dir, self.SNAPSHOT)
@@ -182,11 +216,12 @@ class Master:
             _fsync_dir(self.root_dir)
 
     @staticmethod
-    def _load_snapshot_blob(blob: bytes) -> tuple[int, dict]:
+    def _load_snapshot_blob(blob: bytes) -> tuple[int, dict, dict]:
         data = yson.loads(blob)
         if isinstance(data, dict) and "seq" in data and "tree" in data:
-            return int(data["seq"]), data["tree"]
-        return 0, data              # pre-versioning format
+            return (int(data["seq"]), data["tree"],
+                    data.get("transactions") or {})
+        return 0, data, {}          # pre-versioning format
 
     def _recover(self) -> None:
         local: "tuple[int, dict] | None" = None
@@ -203,8 +238,13 @@ class Master:
         best = max((s for s in (local, remote) if s is not None),
                    key=lambda s: s[0], default=None)
         if best is not None:
+            from ytsaurus_tpu.cypress.transactions import (
+                MasterTransactionManager,
+            )
             self._snapshot_seq = best[0]
             self.tree = CypressTree.deserialize(best[1])
+            self.tx_manager = MasterTransactionManager.deserialize(
+                self.tree, best[2])
         for record in self.wal.recover():
             try:
                 self._apply(record["op"], dict(record["args"]))

@@ -34,22 +34,56 @@ def _registry() -> dict[str, CommandDescriptor]:
     for d in [
         # cypress
         _d("create", ("type", "path"), ("attributes", "recursive",
-                                        "ignore_existing"), True,
+                                        "ignore_existing", "tx"), True,
            lambda cl, p: cl.create(p["type"], p["path"],
                                    attributes=p.get("attributes"),
                                    recursive=p.get("recursive", False),
                                    ignore_existing=p.get("ignore_existing",
-                                                         False))),
-        _d("get", ("path",), (), False, lambda cl, p: cl.get(p["path"])),
-        _d("set", ("path", "value"), (), True,
-           lambda cl, p: cl.set(p["path"], p["value"])),
+                                                         False),
+                                   tx=p.get("tx"))),
+        _d("get", ("path",), ("tx",), False,
+           lambda cl, p: cl.get(p["path"], tx=p.get("tx"))),
+        _d("set", ("path", "value"), ("tx",), True,
+           lambda cl, p: cl.set(p["path"], p["value"], tx=p.get("tx"))),
         _d("exists", ("path",), (), False,
            lambda cl, p: cl.exists(p["path"])),
         _d("list", ("path",), (), False, lambda cl, p: cl.list(p["path"])),
-        _d("remove", ("path",), ("recursive", "force"), True,
+        _d("remove", ("path",), ("recursive", "force", "tx"), True,
            lambda cl, p: cl.remove(p["path"],
                                    recursive=p.get("recursive", True),
-                                   force=p.get("force", False))),
+                                   force=p.get("force", False),
+                                   tx=p.get("tx"))),
+        # master transactions + locks (ref: start_tx/lock driver commands)
+        _d("start_tx", (), ("parent",), True,
+           lambda cl, p: cl.start_tx(parent=p.get("parent"))),
+        _d("commit_tx", ("tx",), (), True,
+           lambda cl, p: cl.commit_tx(p["tx"])),
+        _d("abort_tx", ("tx",), (), True,
+           lambda cl, p: cl.abort_tx(p["tx"])),
+        _d("lock", ("path", "tx"), ("mode",), True,
+           lambda cl, p: cl.lock(p["path"],
+                                 mode=p.get("mode", "exclusive"),
+                                 tx=p["tx"])),
+        # security (ref: security_client commands)
+        _d("create_user", ("name",), (), True,
+           lambda cl, p: cl.cluster.security.create_user(p["name"])),
+        _d("create_group", ("name",), ("members",), True,
+           lambda cl, p: cl.cluster.security.create_group(
+               p["name"], members=p.get("members"))),
+        _d("create_account", ("name",), ("resource_limits",), True,
+           lambda cl, p: cl.cluster.security.create_account(
+               p["name"], resource_limits=p.get("resource_limits"))),
+        _d("add_member", ("group", "member"), (), True,
+           lambda cl, p: cl.cluster.security.add_member(p["group"],
+                                                        p["member"])),
+        _d("remove_member", ("group", "member"), (), True,
+           lambda cl, p: cl.cluster.security.remove_member(p["group"],
+                                                           p["member"])),
+        _d("check_permission", ("user", "permission", "path"), (), False,
+           lambda cl, p: {"action": "allow"
+                          if cl.cluster.security.check_permission(
+                              p["user"], p["permission"], p["path"])
+                          else "deny"}),
         _d("copy", ("source_path", "destination_path"), ("recursive",), True,
            lambda cl, p: cl.copy(p["source_path"], p["destination_path"],
                                  recursive=p.get("recursive", False))),

@@ -130,6 +130,35 @@ class LocalCluster:
                 proc.wait(timeout=10)
         self._procs.clear()
 
+    def restart_primary(self, timeout: float = 120.0) -> None:
+        """Stop the primary and bring it back on the same state root
+        (recovery-path fault injection: quorum WAL replay + snapshot load).
+        The address may change; read `primary_address` afterwards."""
+        proc = self._procs[0]
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        self._procs.pop(0)
+        deadline = time.monotonic() + timeout
+        primary_root = os.path.join(self.root_dir, "primary")
+        # Rebind the SAME port: data nodes heartbeat a fixed primary
+        # address (stable daemon addresses, as in real deployments).
+        old_port = self.primary_address.rsplit(":", 1)[1]
+        self._spawn("primary", primary_root,
+                    ["--role", "primary", "--root", primary_root,
+                     "--port", old_port,
+                     "--replication-factor", str(self.replication_factor),
+                     "--journal-nodes", str(min(2, self.n_nodes))])
+        # _spawn appends; keep the primary at index 0 (kill_node contract).
+        self._procs.insert(0, self._procs.pop())
+        port = self._wait_port(primary_root, "primary", deadline)
+        self.primary_address = f"127.0.0.1:{port}"
+        self._wait_ready(deadline)
+
     def kill_node(self, index: int) -> None:
         """Hard-kill one data node (fault injection for replica fallback)."""
         # procs[0] is the primary; nodes follow in order.

@@ -29,8 +29,11 @@ class RemoteTransaction:
 
 
 class RemoteYtClient:
-    def __init__(self, primary_address: str, timeout: float = 120.0):
+    def __init__(self, primary_address: str, timeout: float = 120.0,
+                 user: str = "root"):
         self.primary_address = primary_address
+        self.timeout = timeout
+        self.user = user
         self._channel = RetryingChannel(
             Channel(primary_address, timeout=timeout))
         self.chunk_store = RpcChunkStore(self._alive_nodes)
@@ -50,7 +53,8 @@ class RemoteYtClient:
                  attachments=(), idempotent: bool = True):
         body, out_attachments = self._channel.call(
             "driver", "execute",
-            {"command": command, "parameters": parameters or {}},
+            {"command": command, "parameters": parameters or {},
+             "user": self.user},
             attachments, idempotent=idempotent)
         if body.get("kind") == "blob":
             return out_attachments[0]
@@ -59,6 +63,54 @@ class RemoteYtClient:
     def close(self) -> None:
         self._channel.close()
         self.chunk_store.close()
+
+    # -- master transactions / locks / security --------------------------------
+
+    def as_user(self, user: str) -> "RemoteYtClient":
+        """A view of this cluster authenticated as another principal
+        (shares nothing; its own channel)."""
+        return RemoteYtClient(self.primary_address, timeout=self.timeout,
+                              user=user)
+
+    def start_tx(self, parent: Optional[str] = None) -> str:
+        return self._execute("start_tx", {"parent": parent}
+                             if parent else {})
+
+    def commit_tx(self, tx: str) -> None:
+        self._execute("commit_tx", {"tx": tx}, idempotent=False)
+
+    def abort_tx(self, tx: str) -> None:
+        self._execute("abort_tx", {"tx": tx}, idempotent=False)
+
+    def lock(self, path: str, mode: str = "exclusive",
+             tx: Optional[str] = None) -> None:
+        self._execute("lock", {"path": path, "mode": mode, "tx": tx},
+                      idempotent=False)
+
+    def create_user(self, name: str) -> None:
+        self._execute("create_user", {"name": name})
+
+    def create_group(self, name: str,
+                     members: Optional[list] = None) -> None:
+        params = {"name": name}
+        if members is not None:
+            params["members"] = members
+        self._execute("create_group", params)
+
+    def create_account(self, name: str,
+                       resource_limits: Optional[dict] = None) -> None:
+        params = {"name": name}
+        if resource_limits is not None:
+            params["resource_limits"] = resource_limits
+        self._execute("create_account", params)
+
+    def add_member(self, group: str, member: str) -> None:
+        self._execute("add_member", {"group": group, "member": member})
+
+    def check_permission(self, user: str, permission: str,
+                         path: str) -> dict:
+        return self._execute("check_permission", {
+            "user": user, "permission": permission, "path": path})
 
     # -- orchid ----------------------------------------------------------------
 
@@ -75,22 +127,30 @@ class RemoteYtClient:
 
     def create(self, node_type: str, path: str,
                attributes: Optional[dict] = None, recursive: bool = False,
-               ignore_existing: bool = False) -> str:
+               ignore_existing: bool = False,
+               tx: Optional[str] = None) -> str:
         attributes = dict(attributes or {})
         schema = attributes.get("schema")
         if isinstance(schema, TableSchema):
             attributes["schema"] = schema.to_dict()
-        return self._execute("create", {
+        params = {
             "type": node_type, "path": path, "attributes": attributes,
-            "recursive": recursive, "ignore_existing": ignore_existing},
-            idempotent=False)
+            "recursive": recursive, "ignore_existing": ignore_existing}
+        if tx is not None:
+            params["tx"] = tx
+        return self._execute("create", params, idempotent=False)
 
-    def get(self, path: str) -> Any:
-        return self._execute("get", {"path": path})
+    def get(self, path: str, tx: Optional[str] = None) -> Any:
+        params = {"path": path}
+        if tx is not None:
+            params["tx"] = tx
+        return self._execute("get", params)
 
-    def set(self, path: str, value: Any) -> None:
-        self._execute("set", {"path": path, "value": value},
-                      idempotent=False)
+    def set(self, path: str, value: Any, tx: Optional[str] = None) -> None:
+        params = {"path": path, "value": value}
+        if tx is not None:
+            params["tx"] = tx
+        self._execute("set", params, idempotent=False)
 
     def exists(self, path: str) -> bool:
         return bool(self._execute("exists", {"path": path}))
@@ -117,9 +177,11 @@ class RemoteYtClient:
                              idempotent=False)
 
     def remove(self, path: str, recursive: bool = True,
-               force: bool = False) -> None:
-        self._execute("remove", {"path": path, "recursive": recursive,
-                                 "force": force}, idempotent=False)
+               force: bool = False, tx: Optional[str] = None) -> None:
+        params = {"path": path, "recursive": recursive, "force": force}
+        if tx is not None:
+            params["tx"] = tx
+        self._execute("remove", params, idempotent=False)
 
     def collect_garbage(self) -> int:
         """Server-side sweep.  NOTE: client-local operations in flight are

@@ -265,6 +265,7 @@ class DriverService(Service):
 
     @rpc_method(concurrency=8)
     def execute(self, body, attachments):
+        from ytsaurus_tpu.cypress.security import authenticated_user
         command = _text(body["command"])
         parameters = body.get("parameters") or {}
         if attachments:
@@ -272,7 +273,11 @@ class DriverService(Service):
             # attachments, not YSON parameters.
             parameters = dict(parameters)
             parameters["rows"] = attachments[0]
-        result = self.driver.execute(command, parameters)
+        # Per-request principal (ref: TAuthenticatedUserGuard around every
+        # driver invocation).
+        user = _text(body.get("user") or "root")
+        with authenticated_user(user):
+            result = self.driver.execute(command, parameters)
         if isinstance(result, bytes):
             return {"kind": "blob"}, [result]
         return {"kind": "value", "result": result}
