@@ -265,8 +265,24 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                 code = jnp.where(valid, shifted, size)
                 seg = seg + code * stride
             seg = jnp.where(mask, seg, dims)   # masked-out rows → garbage slot
+            # Above the dense-reduce limit the reduction needs segment-
+            # sorted rows (scatter-adds serialize on TPU) — ONE u32 sort
+            # here is shared by every aggregate below.
+            from ytsaurus_tpu.ops.segments import presort_segments
+            grp_order = presort_segments(seg, nseg)
+            presorted = grp_order is not None
+            if presorted:
+                seg = seg[grp_order]
+                gmask = mask[grp_order]
+            else:
+                gmask = mask
+
+            def _r(plane):
+                return plane if grp_order is None else plane[grp_order]
+
             present_counts, _ = segment_aggregate(
-                "count", mask, mask, seg, nseg, EValueType.int64)
+                "count", gmask, gmask, seg, nseg, EValueType.int64,
+                assume_sorted=presorted)
             present = _pad((jnp.arange(nseg) < dims) & (present_counts > 0))
             new_columns: dict[str, tuple[jax.Array, jax.Array]] = {}
             slot = jnp.arange(seg_cap)
@@ -286,31 +302,35 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             for agg, arg, by_arg in agg_arg_b:
                 if agg.function == "avg":
                     data, valid = arg.emit(ctx)
-                    data = data.astype(jnp.float64)
-                    valid = valid & mask
+                    data = _r(data).astype(jnp.float64)
+                    valid = _r(valid) & gmask
                     s, sv = segment_aggregate("sum", data, valid, seg,
-                                              nseg, EValueType.double)
+                                              nseg, EValueType.double,
+                                              assume_sorted=presorted)
                     c, _ = segment_aggregate("count", data, valid, seg,
-                                             nseg, EValueType.int64)
+                                             nseg, EValueType.int64,
+                                             assume_sorted=presorted)
                     new_columns[agg.name] = (_pad(s / jnp.maximum(c, 1)),
                                              _pad(sv))
                 elif agg.function == "cardinality":
                     data, valid = arg.emit(ctx)
-                    d, dv = segment_distinct_count(data, valid & mask, seg,
-                                                   nseg)
+                    d, dv = segment_distinct_count(
+                        _r(data), _r(valid) & gmask, seg, nseg)
                     new_columns[agg.name] = (_pad(d), _pad(dv))
                 elif agg.function in ("argmin", "argmax"):
                     vd, vv = arg.emit(ctx)
                     bd, bv = by_arg.emit(ctx)
                     out_d, out_v = segment_arg_by(
-                        vd, vv, bd, bv & mask, seg, nseg,
-                        take_max=(agg.function == "argmax"))
+                        _r(vd), _r(vv), _r(bd), _r(bv) & gmask, seg, nseg,
+                        take_max=(agg.function == "argmax"),
+                        assume_sorted=presorted)
                     new_columns[agg.name] = (_pad(out_d), _pad(out_v))
                 else:
                     data, valid = arg.emit(ctx)
-                    valid = valid & mask
+                    valid = _r(valid) & gmask
                     out, out_v = segment_aggregate(
-                        agg.function, data, valid, seg, nseg, agg.type)
+                        agg.function, _r(data), valid, seg, nseg, agg.type,
+                        assume_sorted=presorted)
                     new_columns[agg.name] = (_pad(out), _pad(out_v))
             mask = present
             stage_cap = seg_cap
