@@ -1,0 +1,238 @@
+"""Chunk pools, fair share, job manager (user commands, speculation,
+preemption), sliced map operations."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ytsaurus_tpu.chunks import ColumnarChunk
+from ytsaurus_tpu.client import YtClient, YtCluster
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.operations.chunk_pools import Stripe, build_stripes
+from ytsaurus_tpu.operations.fair_share import (
+    PoolState,
+    compute_fair_shares,
+    find_preemptable,
+    pick_pool,
+)
+from ytsaurus_tpu.operations.jobs import Job, JobManager, run_command_job
+from ytsaurus_tpu.schema import TableSchema
+
+
+@pytest.fixture
+def client(tmp_path):
+    return YtClient(YtCluster(str(tmp_path / "cluster")))
+
+
+def _chunk(n, start=0):
+    schema = TableSchema.make([("k", "int64"), ("v", "int64")])
+    return ColumnarChunk.from_arrays(schema, {
+        "k": np.arange(start, start + n), "v": np.arange(n) * 2})
+
+
+# -- chunk pools ---------------------------------------------------------------
+
+def test_stripes_split_oversized_chunk():
+    stripes = build_stripes([_chunk(10_000)], rows_per_job=3000)
+    assert len(stripes) == 4
+    assert sum(s.row_count for s in stripes) == 10_000
+    assert all(s.row_count <= 3000 for s in stripes)
+    # Materialized stripes cover every row exactly once.
+    seen = []
+    for s in stripes:
+        seen.extend(r["k"] for r in s.materialize().to_rows())
+    assert sorted(seen) == list(range(10_000))
+
+
+def test_stripes_pack_small_chunks():
+    chunks = [_chunk(100, start=i * 100) for i in range(20)]
+    stripes = build_stripes(chunks, rows_per_job=1000)
+    assert len(stripes) == 2
+    assert all(s.row_count == 1000 for s in stripes)
+
+
+def test_stripes_ordered_keeps_order():
+    chunks = [_chunk(500, start=i * 500) for i in range(4)]
+    stripes = build_stripes(chunks, rows_per_job=600, ordered=True)
+    flat = []
+    for s in stripes:
+        flat.extend(r["k"] for r in s.materialize().to_rows())
+    assert flat == list(range(2000))
+
+
+def test_stripes_max_job_count():
+    stripes = build_stripes([_chunk(10_000)], rows_per_job=100,
+                            max_job_count=3)
+    assert len(stripes) <= 3
+
+
+# -- fair share ----------------------------------------------------------------
+
+def test_fair_share_weights():
+    a = PoolState("a", weight=3.0, pending=100)
+    b = PoolState("b", weight=1.0, pending=100)
+    compute_fair_shares([a, b], total_slots=8)
+    assert abs(a.fair_share - 0.75) < 1e-9
+    assert abs(b.fair_share - 0.25) < 1e-9
+
+
+def test_fair_share_respects_demand_and_redistributes():
+    a = PoolState("a", weight=1.0, pending=1)      # tiny demand
+    b = PoolState("b", weight=1.0, pending=100)
+    compute_fair_shares([a, b], total_slots=8)
+    assert abs(a.fair_share - 1 / 8) < 1e-9        # capped by demand
+    assert abs(b.fair_share - 7 / 8) < 1e-9        # takes the slack
+
+
+def test_min_share_guarantee():
+    a = PoolState("a", weight=0.001, min_share_ratio=0.5, pending=100)
+    b = PoolState("b", weight=10.0, pending=100)
+    compute_fair_shares([a, b], total_slots=10)
+    assert a.fair_share >= 0.5 - 1e-9
+
+
+def test_pick_pool_serves_most_starved():
+    a = PoolState("a", pending=5, running=3)
+    b = PoolState("b", pending=5, running=0)
+    compute_fair_shares([a, b], total_slots=4)
+    assert pick_pool([a, b]).name == "b"
+
+
+def test_find_preemptable():
+    a = PoolState("a", running=4, pending=0)
+    b = PoolState("b", running=0, pending=3)
+    compute_fair_shares([a, b], total_slots=4)
+    assert find_preemptable([a, b]).name == "a"
+    # No starvation → no preemption.
+    c = PoolState("c", running=2, pending=0)
+    compute_fair_shares([c], total_slots=4)
+    assert find_preemptable([c]) is None
+
+
+# -- job manager ---------------------------------------------------------------
+
+def test_jobs_run_and_collect_results():
+    manager = JobManager(slots=3)
+    jobs = [Job(op_id="op1", index=i, run=lambda j, i=i: i * i)
+            for i in range(10)]
+    results = manager.run_all(jobs)
+    assert results == [i * i for i in range(10)]
+    assert all(j.state == "completed" for j in jobs)
+
+
+def test_job_failure_propagates():
+    manager = JobManager(slots=2)
+
+    def boom(job):
+        raise YtError("nope", code=EErrorCode.OperationFailed)
+
+    jobs = [Job(op_id="op1", index=0, run=boom)]
+    with pytest.raises(YtError, match="nope"):
+        manager.run_all(jobs)
+
+
+def test_command_job_pipes_and_stderr():
+    manager = JobManager(slots=1)
+
+    def run(job):
+        return run_command_job(job, "tr a-z A-Z", b"hello\n")
+
+    [result] = manager.run_all([Job(op_id="o", index=0, run=run,
+                                    preemptible=True)])
+    assert result == b"HELLO\n"
+
+    def bad(job):
+        return run_command_job(job, "echo oops >&2; exit 3", b"")
+
+    job = Job(op_id="o", index=1, run=bad, preemptible=True)
+    with pytest.raises(YtError) as ei:
+        manager.run_all([job])
+    assert ei.value.attributes.get("exit_code") == 3
+    assert b"oops" in job.stderr_tail
+
+
+def test_speculative_twin_rescues_straggler():
+    manager = JobManager(slots=4, speculation_factor=1.5,
+                         min_speculation_seconds=0.3)
+    state = {"first": True}
+
+    def sometimes_slow(job):
+        # First attempt hangs (a straggler); the twin returns fast.
+        if state["first"]:
+            state["first"] = False
+            return run_command_job(job, "sleep 30; echo late", b"")
+        return run_command_job(job, "echo fast", b"")
+
+    quick = [Job(op_id="op", index=i,
+                 run=lambda j: run_command_job(j, "echo q", b""),
+                 preemptible=True) for i in range(3)]
+    straggler = Job(op_id="op", index=99, run=sometimes_slow,
+                    preemptible=True)
+    t0 = time.monotonic()
+    manager.run_all(quick + [straggler], timeout=20)
+    assert time.monotonic() - t0 < 15          # did not wait out the sleep
+    assert straggler.result in (b"fast\n", b"late\n")
+
+
+def test_preemption_requeues_over_share_job():
+    manager = JobManager(slots=2)
+    # Fill both slots with long-running pool-a commands.
+    hogs = [Job(op_id="a", index=i, pool="a", preemptible=True,
+                run=lambda j: run_command_job(j, "sleep 60; echo hog", b""))
+            for i in range(2)]
+    manager.submit(hogs)
+    time.sleep(0.5)
+    # A starving pool-b job arrives.
+    quick = Job(op_id="b", index=0, pool="b",
+                run=lambda j: run_command_job(j, "echo fast", b""),
+                preemptible=True)
+    manager.submit([quick])
+    assert manager.maybe_preempt() is True
+    manager.wait([quick], timeout=20)
+    assert quick.result == b"fast\n"
+    # The victim re-queued rather than failed.
+    assert any(j.attempt > 0 or j.state in ("pending", "running")
+               for j in hogs)
+    manager.abort_operation("a")
+
+
+# -- sliced map operations -----------------------------------------------------
+
+def test_map_python_callable_sliced(client):
+    client.write_table("//in", [{"k": i, "v": i * 2} for i in range(5000)])
+    op = client.run_map(lambda rows: [{"k": r["k"], "v": r["v"] + 1}
+                                      for r in rows],
+                        "//in", "//out", rows_per_job=1000)
+    assert op.state == "completed"
+    assert op.result["jobs"] == 5
+    out = client.read_table("//out")
+    assert len(out) == 5000
+    assert {r["v"] - 2 * r["k"] for r in out} == {1}
+
+
+def test_map_shell_command(client):
+    client.write_table("//in", [{"k": i} for i in range(100)])
+    op = client.run_map("cat", "//in", "//out", job_count=4)
+    assert op.state == "completed"
+    assert op.result["jobs"] >= 2
+    assert sorted(r["k"] for r in client.read_table("//out")) == \
+        list(range(100))
+
+
+def test_map_command_failure_reports_stderr(client):
+    client.write_table("//in", [{"k": 1}])
+    with pytest.raises(YtError) as ei:
+        client.run_map("echo broken >&2; exit 7", "//in", "//out")
+    err = ei.value
+    # The stderr tail + exit code surface through the operation error.
+    flat = str(err.to_dict())
+    assert "broken" in flat and "7" in flat
+
+
+def test_map_command_jq_style_transform(client):
+    client.write_table("//in", [{"name": "a"}, {"name": "b"}])
+    op = client.run_map("sed s/name/user/", "//in", "//out")
+    assert op.state == "completed"
+    out = client.read_table("//out")
+    assert sorted(r["user"] for r in out) == [b"a", b"b"]

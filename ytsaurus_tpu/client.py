@@ -739,11 +739,17 @@ class YtClient:
             "input_table_paths": list(input_paths),
             "output_table_path": output_path, "mode": mode, **kwargs})
 
-    def run_map(self, mapper: Callable, input_path: str, output_path: str,
-                **kwargs):
-        return self.scheduler.start_operation("map", {
-            "mapper": mapper, "input_table_path": input_path,
-            "output_table_path": output_path, **kwargs})
+    def run_map(self, mapper: "Callable | str", input_path: str,
+                output_path: str, **kwargs):
+        """mapper: a Python callable rows→rows, or a shell COMMAND string
+        run in job-proxy subprocesses (ref user_job.cpp pipes)."""
+        spec = {"input_table_path": input_path,
+                "output_table_path": output_path, **kwargs}
+        if isinstance(mapper, str):
+            spec["command"] = mapper
+        else:
+            spec["mapper"] = mapper
+        return self.scheduler.start_operation("map", spec)
 
     def run_erase(self, table_path: str, **kwargs):
         return self.scheduler.start_operation(

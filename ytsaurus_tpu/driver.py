@@ -148,6 +148,13 @@ def _registry() -> dict[str, CommandDescriptor]:
                                       mode=p.get("mode", "unordered")).id),
         _d("erase", ("table_path",), (), True,
            lambda cl, p: cl.run_erase(p["table_path"]).id),
+        _d("map", ("command", "input_table_path", "output_table_path"),
+           ("format", "pool", "job_count", "ordered"), True,
+           lambda cl, p: cl.run_map(
+               p["command"], p["input_table_path"],
+               p["output_table_path"],
+               **{k: p[k] for k in ("format", "pool", "job_count",
+                                    "ordered") if k in p}).id),
         _d("get_operation", ("operation_id",), (), False,
            lambda cl, p: (lambda op: {"id": op.id, "state": op.state,
                                       "type": op.type})(

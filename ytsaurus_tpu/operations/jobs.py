@@ -1,0 +1,436 @@
+"""Job execution plane: worker slots, user-job proxies, speculation,
+preemption.
+
+Ref shape:
+  exec_node slot manager + job controller  (server/node/exec_node/) —
+    N worker slots run jobs scheduled onto them;
+  job proxy user jobs (server/job_proxy/user_job.cpp) — user code runs in
+    a SEPARATE process, rows piped through wire formats on stdin/stdout,
+    stderr tail captured onto the job;
+  speculative jobs (controllers/speculative_job_manager.h) — a straggler
+    gets a duplicate; first finisher wins, the loser is aborted;
+  preemption (scheduler strategy) — jobs of pools above fair share abort
+    to unblock starving pools.
+
+Redesign: slots are threads (the compute inside a job is a jitted device
+program or a child process, so Python threads don't serialize the real
+work).  Command jobs run `/bin/sh -c <command>` with formatted rows on
+stdin — arbitrary user binaries work, isolation is process-level.
+Python-callable jobs run in-slot (they cannot be killed, so they are
+neither preemptible nor speculated; command jobs are both).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.operations.fair_share import (
+    PoolState,
+    compute_fair_shares,
+    find_preemptable,
+    pick_pool,
+)
+from ytsaurus_tpu.utils.logging import get_logger
+from ytsaurus_tpu.utils.profiling import Profiler
+
+logger = get_logger("Jobs")
+_profiler = Profiler("/jobs")
+
+STDERR_TAIL_BYTES = 16 << 10
+
+
+@dataclass
+class Job:
+    """One schedulable unit.  `run` does the work (already bound to its
+    input stripe); command jobs also set `command` so the manager can
+    kill/speculate them."""
+
+    op_id: str
+    index: int
+    run: Callable[["Job"], object]
+    pool: str = "default"
+    preemptible: bool = False        # command jobs: killable + restartable
+    id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
+    state: str = "pending"           # pending|running|completed|failed|aborted
+    result: object = None
+    error: Optional[YtError] = None
+    attempt: int = 0
+    started_at: float = 0.0
+    duration: float = 0.0
+    stderr_tail: bytes = b""
+    speculative_of: "Optional[Job]" = None
+    on_done: Optional[Callable[["Job"], None]] = None
+    # live process handle for kill-based preemption/speculation-loss
+    _proc: Optional[subprocess.Popen] = None
+    _done: threading.Event = field(default_factory=threading.Event)
+    _lost: bool = False              # lost the speculative race
+    _preempted: bool = False         # killed for fairness; will requeue
+
+
+class JobManager:
+    """Slots + fair-share pick + speculation + preemption for one process.
+
+    Operations submit job lists and wait; the manager schedules across
+    ALL live operations by pool fair share.
+    """
+
+    def __init__(self, slots: int = 4,
+                 speculation_factor: float = 3.0,
+                 min_speculation_seconds: float = 5.0,
+                 pool_config: Optional[Callable[[str], dict]] = None):
+        self.slots = slots
+        self.speculation_factor = speculation_factor
+        self.min_speculation_seconds = min_speculation_seconds
+        self._pool_config = pool_config or (lambda name: {})
+        self._lock = threading.Condition()
+        self._pending: list[Job] = []
+        self._running: list[Job] = []
+        self._workers: list[threading.Thread] = []
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = False
+        self._completed_durations: dict[str, list[float]] = {}
+
+    # -- public ----------------------------------------------------------------
+
+    def submit(self, jobs: "list[Job]") -> None:
+        with self._lock:
+            self._pending.extend(jobs)
+            self._ensure_workers()
+            self._lock.notify_all()
+
+    def wait(self, jobs: "list[Job]", timeout: Optional[float] = None,
+             raise_on_failure: bool = True) -> None:
+        deadline = time.monotonic() + timeout if timeout else None
+        for job in jobs:
+            remaining = None if deadline is None else \
+                max(deadline - time.monotonic(), 0.0)
+            if not job._done.wait(remaining):
+                raise YtError(f"Job {job.id} timed out",
+                              code=EErrorCode.Timeout)
+        if raise_on_failure:
+            for job in jobs:
+                if job.state == "failed":
+                    raise job.error or YtError(
+                        f"Job {job.id} failed",
+                        code=EErrorCode.OperationFailed)
+
+    def run_all(self, jobs: "list[Job]",
+                timeout: Optional[float] = None) -> "list[object]":
+        """Submit + wait; results in submission order (speculative winners
+        folded in)."""
+        self.submit(jobs)
+        self.wait(jobs, timeout=timeout)
+        return [j.result for j in jobs]
+
+    def abort_operation(self, op_id: str) -> None:
+        with self._lock:
+            dropped = [j for j in self._pending if j.op_id == op_id]
+            self._pending = [j for j in self._pending if j.op_id != op_id]
+            for job in dropped:
+                # Waiters may hold these: they must observe a terminal
+                # state, not hang on a job that will never run.
+                job.state = "aborted"
+                job.error = YtError("operation aborted",
+                                    code=EErrorCode.Canceled)
+                job._done.set()
+            for job in self._running:
+                if job.op_id == op_id:
+                    self._kill(job)
+            self._completed_durations.pop(op_id, None)
+            self._lock.notify_all()
+
+    def finish_operation(self, op_id: str) -> None:
+        """Drop per-operation bookkeeping once its jobs are settled (the
+        duration history otherwise grows forever in a long-lived client)."""
+        with self._lock:
+            self._completed_durations.pop(op_id, None)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"pending": len(self._pending),
+                    "running": len(self._running),
+                    "slots": self.slots}
+
+    # -- scheduling ------------------------------------------------------------
+
+    def _ensure_workers(self) -> None:
+        while len(self._workers) < self.slots:
+            worker = threading.Thread(target=self._worker_loop, daemon=True,
+                                      name=f"job-slot-{len(self._workers)}")
+            self._workers.append(worker)
+            worker.start()
+        if self._monitor is None:
+            # Speculation + preemption must fire even when EVERY slot is
+            # busy (exactly the starvation case), so they run on their own
+            # cadence, not only from idle workers.
+            self._monitor = threading.Thread(target=self._monitor_loop,
+                                             daemon=True,
+                                             name="job-monitor")
+            self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop:
+            time.sleep(0.25)
+            with self._lock:
+                try:
+                    self._maybe_speculate_locked()
+                    self._maybe_preempt_locked()
+                except Exception:   # noqa: BLE001 — monitor must survive
+                    logger.exception("job monitor pass failed")
+
+    def _pool_states(self) -> "list[PoolState]":
+        pools: dict[str, PoolState] = {}
+
+        def state(name: str) -> PoolState:
+            if name not in pools:
+                cfg = self._pool_config(name) or {}
+                pools[name] = PoolState(
+                    name=name,
+                    weight=float(cfg.get("weight", 1.0)),
+                    min_share_ratio=float(cfg.get("min_share_ratio", 0.0)),
+                    max_running_jobs=cfg.get("max_running_jobs"))
+            return pools[name]
+
+        for job in self._pending:
+            state(job.pool).pending += 1
+        for job in self._running:
+            state(job.pool).running += 1
+        result = list(pools.values())
+        compute_fair_shares(result, self.slots)
+        return result
+
+    def _next_job_locked(self) -> Optional[Job]:
+        if not self._pending:
+            return None
+        pools = self._pool_states()
+        chosen = pick_pool(pools)
+        if chosen is None:
+            return None
+        for i, job in enumerate(self._pending):
+            if job.pool == chosen.name:
+                return self._pending.pop(i)
+        return None
+
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                with self._lock:
+                    job = self._next_job_locked()
+                    while job is None:
+                        if self._stop:
+                            return
+                        self._lock.wait(timeout=0.5)
+                        job = self._next_job_locked()
+                    job.state = "running"
+                    job.started_at = time.monotonic()
+                    self._running.append(job)
+                self._execute(job)
+            except Exception:   # noqa: BLE001 — a slot must never die
+                logger.exception("job slot scheduling pass failed")
+                time.sleep(0.1)
+
+    # -- execution -------------------------------------------------------------
+
+    def _execute(self, job: Job) -> None:
+        prof = _profiler.with_tags(pool=job.pool)
+        prof.counter("started").increment()
+        try:
+            result = job.run(job)
+            ok = True
+        except YtError as err:
+            ok = False
+            error = err
+        except Exception as exc:      # noqa: BLE001 — job boundary
+            ok = False
+            error = YtError(f"Job crashed: {exc!r}",
+                            code=EErrorCode.OperationFailed)
+        duration = time.monotonic() - job.started_at
+        with self._lock:
+            if job in self._running:
+                self._running.remove(job)
+            if job._done.is_set():
+                # Already settled by a winning speculative twin (result
+                # copied, waiters woken) — this unwinding run must not
+                # clobber the settled state or re-queue a delivered job.
+                job._proc = None
+                return
+            job.duration = duration
+            if job._preempted:
+                # Same object re-queues (waiters hold it); don't signal.
+                job._preempted = False
+                job._proc = None
+                job.state = "pending"
+                job.attempt += 1
+                self._pending.append(job)
+                self._lock.notify_all()
+                return
+            if job._lost:
+                job.state = "aborted"
+            elif ok:
+                job.state = "completed"
+                job.result = result
+                self._completed_durations.setdefault(job.op_id, []).append(
+                    duration)
+                self._settle_speculation_locked(job)
+            else:
+                job.state = "failed"
+                job.error = error
+                prof.counter("failed").increment()
+            job._done.set()
+            self._lock.notify_all()
+        if job.on_done is not None:
+            try:
+                job.on_done(job)
+            except Exception:      # noqa: BLE001 — observer boundary
+                pass
+
+    def _kill(self, job: Job) -> None:
+        job._lost = True
+        _kill_job_process(job)
+
+    # -- speculation -----------------------------------------------------------
+
+    def _maybe_speculate_locked(self) -> None:
+        """Duplicate stragglers: a preemptible job running far beyond the
+        operation's median completed duration gets a twin (first finisher
+        wins, ref speculative_job_manager.h)."""
+        now = time.monotonic()
+        for job in list(self._running):
+            if not job.preemptible or job.speculative_of is not None:
+                continue
+            if any(s.speculative_of is job
+                   for s in self._pending + self._running):
+                continue
+            done = self._completed_durations.get(job.op_id) or []
+            if not done:
+                continue
+            median = sorted(done)[len(done) // 2]
+            threshold = max(median * self.speculation_factor,
+                            self.min_speculation_seconds)
+            if now - job.started_at < threshold:
+                continue
+            twin = Job(op_id=job.op_id, index=job.index, run=job.run,
+                       pool=job.pool, preemptible=True,
+                       speculative_of=job)
+            twin.attempt = job.attempt + 1
+            logger.info("speculating job %s (running %.1fs > %.1fs)",
+                        job.id, now - job.started_at, threshold)
+            _profiler.counter("speculated").increment()
+            self._pending.append(twin)
+
+    def _settle_speculation_locked(self, winner: Job) -> None:
+        """First finisher wins; abort the twin."""
+        rival = winner.speculative_of
+        if rival is not None and not rival._done.is_set():
+            # Twin finished first: copy the result onto the original so
+            # waiters (which hold the original) observe success.
+            rival.result = winner.result
+            rival.state = "completed"
+            rival.duration = winner.duration
+            self._kill(rival)
+            rival._done.set()
+            if rival in self._running:
+                self._running.remove(rival)
+        for job in self._pending + self._running:
+            if job.speculative_of is winner:
+                if job in self._pending:
+                    self._pending.remove(job)
+                    job.state = "aborted"
+                    job._done.set()
+                else:
+                    self._kill(job)
+
+    # -- preemption ------------------------------------------------------------
+
+    def maybe_preempt(self) -> bool:
+        """Kill the newest preemptible job of the most-over-share pool when
+        another pool is starving; the victim re-queues (attempt + 1).
+        Runs automatically from idle workers; public for direct prodding."""
+        with self._lock:
+            return self._maybe_preempt_locked()
+
+    def _maybe_preempt_locked(self) -> bool:
+        pools = self._pool_states()
+        victim_pool = find_preemptable(pools)
+        if victim_pool is None:
+            return False
+        victims = [j for j in self._running
+                   if j.pool == victim_pool.name and j.preemptible
+                   and not j._lost and not j._preempted]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda j: j.started_at)
+        logger.info("preempting job %s of pool %s", victim.id, victim.pool)
+        _profiler.counter("preempted").increment()
+        # The SAME object re-queues when its killed run unwinds (see
+        # _execute) — waiters keep their handle.
+        victim._preempted = True
+        _kill_job_process(victim)
+        self._lock.notify_all()
+        return True
+
+
+# -- user-job proxies ----------------------------------------------------------
+
+
+def _kill_job_process(job: Job) -> None:
+    """Kill the job's WHOLE process group: killing only /bin/sh leaves its
+    children holding the stdout pipe, and communicate() then blocks until
+    they exit on their own."""
+    import os
+    import signal
+    proc = job._proc
+    if proc is not None and proc.poll() is None:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+
+def run_command_job(job: Job, command: str, input_blob: bytes,
+                    timeout: Optional[float] = None,
+                    env: Optional[dict] = None) -> bytes:
+    """Run a user command with formatted rows on stdin; returns stdout.
+
+    Ref: job_proxy user_job.cpp — a separate process (own process group,
+    the slot-isolation analog), wire-format pipes, stderr tail kept on
+    the job, non-zero exit = job failure."""
+    import os
+    proc = subprocess.Popen(
+        ["/bin/sh", "-c", command],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        start_new_session=True,
+        env={**os.environ, **(env or {}),
+             "YT_JOB_ID": job.id, "YT_JOB_INDEX": str(job.index),
+             "YT_OPERATION_ID": job.op_id})
+    job._proc = proc
+    try:
+        stdout, stderr = proc.communicate(input_blob, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _kill_job_process(job)
+        proc.communicate()
+        raise YtError(f"User job {job.id} timed out",
+                      code=EErrorCode.Timeout)
+    finally:
+        job._proc = None
+    job.stderr_tail = stderr[-STDERR_TAIL_BYTES:]
+    if job._lost:
+        raise YtError("job preempted", code=EErrorCode.Canceled)
+    if proc.returncode != 0:
+        raise YtError(
+            f"User job {job.id} failed with exit code {proc.returncode}",
+            code=EErrorCode.OperationFailed,
+            attributes={"stderr": job.stderr_tail.decode("utf-8",
+                                                         "replace"),
+                        "exit_code": proc.returncode})
+    return stdout

@@ -7,11 +7,14 @@ Ref mapping:
     (controller_agent/operation_controller_detail.cpp: SafePrepare /
      SafeMaterialize / commit)
   operation records in Cypress         → //sys/operations/<id> attributes
-Jobs here are whole-chunk device programs rather than per-slice user
-processes; the controller state machine, operation records, and failure
-propagation match the reference's shape.  Scheduling fan-out across many
-hosts arrives with the multi-host control plane (future round); operations
-run synchronously or on a worker thread.
+  chunk pools / job slicing            → operations/chunk_pools.py
+  fair share over pools + preemption   → operations/fair_share.py
+  user-process jobs + speculation      → operations/jobs.py
+
+Sort/merge stay whole-device programs (their inner parallelism is the
+device mesh); map fans out over sliced stripes on the shared JobManager —
+user code runs either as Python callables or as shell commands in job-
+proxy subprocesses with wire-format pipes.
 """
 
 from __future__ import annotations
@@ -33,13 +36,44 @@ class Operation:
     state: str = "pending"         # pending|running|completed|failed|aborted
     error: Optional[dict] = None
     result: dict = field(default_factory=dict)
+    progress: dict = field(default_factory=dict)   # jobs total/completed
 
 
 class OperationScheduler:
-    def __init__(self, client):
+    def __init__(self, client, slots: int = 4):
+        from ytsaurus_tpu.operations.jobs import JobManager
         self.client = client
         self._operations: dict[str, Operation] = {}
         self._lock = threading.Lock()
+        self._pool_cache: dict[str, tuple[float, dict]] = {}
+        self.job_manager = JobManager(slots=slots,
+                                      pool_config=self._pool_config)
+
+    _POOL_CONFIG_TTL = 5.0
+
+    def _pool_config(self, name: str) -> dict:
+        """Pool definitions from Cypress (//sys/pools/<name>/@...), the
+        reference's pool-tree objects (scheduler_pool_server).  Cached
+        with a short TTL: this runs per scheduling decision under the
+        JobManager lock, and against a remote cluster each lookup is an
+        RPC."""
+        import time as _time
+        cached = self._pool_cache.get(name)
+        now = _time.monotonic()
+        if cached is not None and now - cached[0] < self._POOL_CONFIG_TTL:
+            return cached[1]
+        path = f"//sys/pools/{name}"
+        out: dict = {}
+        try:
+            if self.client.exists(path):
+                for key in ("weight", "min_share_ratio",
+                            "max_running_jobs"):
+                    if self.client.exists(f"{path}/@{key}"):
+                        out[key] = self.client.get(f"{path}/@{key}")
+        except Exception:     # noqa: BLE001 — config lookup must not fail jobs
+            pass
+        self._pool_cache[name] = (now, out)
+        return out
 
     # -- public API ------------------------------------------------------------
 
@@ -77,7 +111,8 @@ class OperationScheduler:
             if controller is None:
                 raise YtError(f"Unknown operation type {op.type!r}",
                               code=EErrorCode.OperationFailed)
-            result = controller(self.client, op.spec)
+            result = controller(self.client, op.spec, op=op,
+                                job_manager=self.job_manager)
             op.result = result or {}
             op.state = "completed"
         except YtError as e:
@@ -115,7 +150,7 @@ def _clean_spec(spec: dict) -> dict:
 # -- controllers ---------------------------------------------------------------
 
 
-def _sort_controller(client, spec: dict) -> dict:
+def _sort_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     """Ref: sort_controller.cpp — here: read input chunks, device sort (or
     mesh shuffle when a mesh is attached), write output."""
     from ytsaurus_tpu.operations.sort_op import sort_chunks
@@ -136,7 +171,7 @@ def _sort_controller(client, spec: dict) -> dict:
     return {"rows": out.row_count}
 
 
-def _merge_controller(client, spec: dict) -> dict:
+def _merge_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     """Ref: ordered/sorted merge (ordered_controller.cpp,
     sorted_controller.cpp)."""
     from ytsaurus_tpu.chunks.columnar import concat_chunks
@@ -166,25 +201,89 @@ def _merge_controller(client, spec: dict) -> dict:
     return {"rows": out.row_count}
 
 
-def _map_controller(client, spec: dict) -> dict:
+def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     """Ref: unordered_controller.cpp + the user-process map job
-    (job_proxy/user_job.cpp).  The mapper is a Python callable
-    rows→rows (row-dict iterables); query-shaped mappers should use
-    select_rows instead."""
-    mapper: Callable = spec["mapper"]
+    (job_proxy/user_job.cpp).
+
+    Two user-code shapes:
+      spec["mapper"]  — a Python callable rows→rows, run in-slot;
+      spec["command"] — a shell command; rows stream through a job-proxy
+                        subprocess on stdin/stdout in spec["format"]
+                        (default json lines), stderr tail kept on errors.
+    Input slices into stripes via the chunk pool, jobs run concurrently
+    on the shared JobManager under spec["pool"] fair share; stragglers
+    get speculative twins (command jobs)."""
+    from ytsaurus_tpu.formats import dumps_rows, loads_rows
+    from ytsaurus_tpu.operations.chunk_pools import build_stripes
+    from ytsaurus_tpu.operations.jobs import Job, run_command_job
+
+    mapper: Optional[Callable] = spec.get("mapper")
+    command: Optional[str] = spec.get("command")
+    if (mapper is None) == (command is None):
+        raise YtError("map spec requires exactly one of mapper/command")
     input_path = _one(spec, "input_table_path")
     output_path = _one(spec, "output_table_path")
+    fmt = spec.get("format", "json")
+    pool = spec.get("pool", "default")
     chunks = client._read_table_chunks(input_path)
+    rows_per_job = spec.get("rows_per_job")
+    if rows_per_job is None and spec.get("job_count"):
+        total = sum(c.row_count for c in chunks)
+        rows_per_job = max(-(-total // max(int(spec["job_count"]), 1)), 1)
+    stripes = build_stripes(
+        chunks, ordered=bool(spec.get("ordered", False)),
+        rows_per_job=rows_per_job or 4_000_000,
+        max_job_count=spec.get("max_job_count"))
+    if not stripes:
+        client.write_table(output_path, [],
+                           schema=spec.get("output_schema"))
+        return {"rows": 0, "jobs": 0}
+
+    def make_run(stripe):
+        if mapper is not None:
+            def run_py(job):
+                return list(mapper(stripe.materialize().to_rows()))
+            return run_py, False
+
+        def run_cmd(job):
+            blob = dumps_rows(stripe.materialize().to_rows(), fmt)
+            out = run_command_job(job, command, blob,
+                                  timeout=spec.get("job_time_limit"))
+            return loads_rows(out, fmt)
+        return run_cmd, True
+
+    op_id = op.id if op is not None else uuid.uuid4().hex
+    if op is not None:
+        op.progress = {"total": len(stripes), "completed": 0}
+
+    def on_done(job) -> None:
+        # Live progress: clients polling get_operation see jobs land as
+        # they finish, not a 0→N jump at the end.
+        if op is not None and job.state == "completed":
+            op.progress["completed"] = op.progress.get("completed", 0) + 1
+
+    jobs = []
+    for i, stripe in enumerate(stripes):
+        run, preemptible = make_run(stripe)
+        jobs.append(Job(op_id=op_id, index=i, run=run, pool=pool,
+                        preemptible=preemptible, on_done=on_done))
+    job_manager.submit(jobs)
+    try:
+        job_manager.wait(jobs)
+    except YtError:
+        job_manager.abort_operation(op_id)
+        raise
+    finally:
+        job_manager.finish_operation(op_id)
     out_rows: list[dict] = []
-    for chunk in chunks:
-        result = mapper(chunk.to_rows())
-        out_rows.extend(result)
+    for job in jobs:
+        out_rows.extend(job.result or [])
     schema = spec.get("output_schema")
     client.write_table(output_path, out_rows, schema=schema)
-    return {"rows": len(out_rows)}
+    return {"rows": len(out_rows), "jobs": len(jobs)}
 
 
-def _erase_controller(client, spec: dict) -> dict:
+def _erase_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     path = _one(spec, "table_path")
     client._write_table_chunks(path, [])
     return {"rows": 0}

@@ -319,11 +319,15 @@ class RemoteYtClient:
             "merge", {"input_table_paths": list(input_paths),
                       "output_table_path": output_path, "mode": mode, **kw})
 
-    def run_map(self, mapper: Callable, input_path: str, output_path: str,
-                **kw):
-        return self.scheduler.start_operation(
-            "map", {"mapper": mapper, "input_table_path": input_path,
-                    "output_table_path": output_path, **kw})
+    def run_map(self, mapper: "Callable | str", input_path: str,
+                output_path: str, **kw):
+        spec = {"input_table_path": input_path,
+                "output_table_path": output_path, **kw}
+        if isinstance(mapper, str):
+            spec["command"] = mapper
+        else:
+            spec["mapper"] = mapper
+        return self.scheduler.start_operation("map", spec)
 
     def run_erase(self, table_path: str, **kw):
         return self.scheduler.start_operation(
