@@ -67,6 +67,22 @@ def test_stripes_max_job_count():
     assert len(stripes) <= 3
 
 
+def test_stripes_max_job_count_multi_chunk_hard_cap():
+    # Greedy packing overshoots on awkward multi-chunk splits; the cap is
+    # a contract (regression: 5x600 rows with cap 2 produced 3 stripes).
+    chunks = [_chunk(600, start=i * 600) for i in range(5)]
+    stripes = build_stripes(chunks, rows_per_job=100, max_job_count=2)
+    assert len(stripes) <= 2
+    assert sum(s.row_count for s in stripes) == 3000
+    ordered = build_stripes(chunks, rows_per_job=100, max_job_count=2,
+                            ordered=True)
+    assert len(ordered) <= 2
+    flat = []
+    for s in ordered:
+        flat.extend(r["k"] for r in s.materialize().to_rows())
+    assert flat == list(range(3000))
+
+
 # -- fair share ----------------------------------------------------------------
 
 def test_fair_share_weights():

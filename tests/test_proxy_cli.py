@@ -1,0 +1,150 @@
+"""HTTP proxy (/api/v4) + `yt` CLI over a real multi-process cluster."""
+
+import io
+import json
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from ytsaurus_tpu.environment import LocalCluster  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("proxy_cluster"))
+    with LocalCluster(root, n_nodes=1, replication_factor=1,
+                      http_proxy=True) as lc:
+        yield lc
+
+
+def _url(cluster, path):
+    return f"http://{cluster.http_proxy_address}{path}"
+
+
+def _post(cluster, command, params, user="root"):
+    req = urllib.request.Request(
+        _url(cluster, f"/api/v4/{command}"),
+        data=json.dumps(params).encode(),
+        headers={"Content-Type": "application/json", "X-YT-User": user},
+        method="POST")
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def test_ping_and_discovery(cluster):
+    assert urllib.request.urlopen(_url(cluster, "/ping")).status == 200
+    commands = json.loads(
+        urllib.request.urlopen(_url(cluster, "/api/v4")).read())
+    assert "select_rows" in commands and "write_table" in commands
+    hosts = json.loads(
+        urllib.request.urlopen(_url(cluster, "/hosts")).read())
+    assert hosts == [cluster.http_proxy_address]
+
+
+def test_rest_cypress_roundtrip(cluster):
+    _post(cluster, "create", {"type": "map_node", "path": "//rest",
+                              "recursive": True})
+    _post(cluster, "set", {"path": "//rest/@tag", "value": 42})
+    got = json.loads(urllib.request.urlopen(
+        _url(cluster, "/api/v4/get?path=%22//rest/@tag%22")).read())
+    assert got["value"] == 42
+    got = _post(cluster, "exists", {"path": "//rest"})
+    assert got["value"] is True
+
+
+def test_rest_table_write_read_select(cluster):
+    _post(cluster, "create", {"type": "table", "path": "//rest/t",
+                              "attributes": {"schema": [
+                                  {"name": "k", "type": "int64",
+                                   "sort_order": "ascending"},
+                                  {"name": "v", "type": "int64"}]}})
+    rows = "".join(json.dumps({"k": i, "v": i * i}) + "\n"
+                   for i in range(50))
+    req = urllib.request.Request(
+        _url(cluster, "/api/v4/write_table"),
+        data=rows.encode(),
+        headers={"X-YT-Parameters": json.dumps({"path": "//rest/t",
+                                                "format": "json"})},
+        method="PUT")
+    urllib.request.urlopen(req)
+
+    blob = urllib.request.urlopen(_url(
+        cluster, '/api/v4/read_table?path="//rest/t"&format="json"')).read()
+    back = [json.loads(line) for line in blob.splitlines() if line.strip()]
+    assert len(back) == 50 and back[7] == {"k": 7, "v": 49}
+
+    result = _post(cluster, "select_rows",
+                   {"query": "sum(v) AS s FROM [//rest/t] GROUP BY 1"})
+    assert result["value"][0]["s"] == sum(i * i for i in range(50))
+
+
+def test_rest_error_shape(cluster):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, "get", {"path": "//no/such/node"})
+    body = json.loads(ei.value.read())
+    assert body["code"] != 0 and "message" in body
+    assert ei.value.headers.get("X-YT-Error")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, "frobnicate", {})
+    assert ei.value.status == 404
+
+
+def test_rest_authenticated_user(cluster):
+    _post(cluster, "create_user", {"name": "restuser"})
+    _post(cluster, "create", {"type": "map_node", "path": "//restsec"})
+    _post(cluster, "set", {"path": "//restsec/@acl", "value": [
+        {"action": "allow", "subjects": ["restuser"],
+         "permissions": ["read", "write"]}]})
+    _post(cluster, "set", {"path": "//restsec/@ok", "value": 1},
+          user="restuser")
+    # A second user without the ACE is denied.
+    _post(cluster, "create_user", {"name": "outsider"})
+    with pytest.raises(urllib.error.HTTPError):
+        _post(cluster, "set", {"path": "//restsec/@nope", "value": 2},
+              user="outsider")
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def _yt(cluster, *argv, stdin: bytes = b""):
+    from ytsaurus_tpu import cli
+    old_stdout, old_stdin = sys.stdout, sys.stdin
+    sys.stdout = io.TextIOWrapper(io.BytesIO(), encoding="utf-8")
+    sys.stdin = io.TextIOWrapper(io.BytesIO(stdin), encoding="utf-8")
+    try:
+        rc = cli.run(["--proxy", cluster.primary_address, *argv])
+        sys.stdout.flush()
+        out = sys.stdout.buffer.getvalue()
+    finally:
+        sys.stdout, sys.stdin = old_stdout, old_stdin
+    return rc, out
+
+
+def test_cli_end_to_end(cluster):
+    rc, _ = _yt(cluster, "create", "map_node", "//cli", "-r")
+    assert rc == 0
+    rc, _ = _yt(cluster, "write-table", "//cli/t",
+                stdin=b'{"k": 1, "v": 10}\n{"k": 2, "v": 20}\n')
+    assert rc == 0
+    rc, out = _yt(cluster, "read-table", "//cli/t", "--format", "json")
+    rows = [json.loads(l) for l in out.splitlines() if l.strip()]
+    assert rows == [{"k": 1, "v": 10}, {"k": 2, "v": 20}]
+    rc, out = _yt(cluster, "select-rows",
+                  "sum(v) AS s FROM [//cli/t] GROUP BY 1")
+    assert rc == 0 and json.loads(out)[0]["s"] == 30
+    rc, out = _yt(cluster, "list", "/")
+    assert rc == 0 and "cli" in json.loads(out)
+    rc, out = _yt(cluster, "map", "cat", "--src", "//cli/t",
+                  "--dst", "//cli/out")
+    assert rc == 0 and json.loads(out)["state"] == "completed"
+    rc, out = _yt(cluster, "exists", "//cli/out")
+    assert rc == 0 and json.loads(out) is True
+    # Errors come back as rc=1 with a structured error on stderr.
+    rc, _ = _yt(cluster, "get", "//definitely/missing")
+    assert rc == 1

@@ -1,0 +1,233 @@
+"""The `yt` command-line interface.
+
+Ref shape: yt/python/yt/wrapper/cli_impl.py — one binary, subcommand per
+driver command, `--proxy` (or YT_PROXY env) selects the cluster, table
+data flows through stdin/stdout in wire formats.
+
+Usage (python -m ytsaurus_tpu.cli, or the `yt()` console entry):
+
+  yt --proxy 127.0.0.1:9013 list /
+  yt create map_node //home/me -r
+  yt write-table //t --format json   < rows.json
+  yt read-table //t --format dsv
+  yt select-rows 'k, sum(v) AS s FROM [//t] GROUP BY k'
+  yt map 'grep foo' --src //in --dst //out
+  yt sort --src //in --dst //out --sort-by k
+  yt start-tx / commit-tx / lock ...
+
+The proxy address is the PRIMARY RPC endpoint (the thin-client plane);
+`--user` stamps the authenticated principal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from ytsaurus_tpu.errors import YtError
+
+
+def _json_default(value):
+    if isinstance(value, bytes):
+        return value.decode("utf-8", "replace")
+    return str(value)
+
+
+def _print(value) -> None:
+    if value is None:
+        return
+    if isinstance(value, bytes):
+        sys.stdout.buffer.write(value)
+        if not value.endswith(b"\n"):
+            sys.stdout.buffer.write(b"\n")
+        return
+    print(json.dumps(value, default=_json_default, indent=2))
+
+
+def _rows_arg(rows: Optional[str]):
+    blob = rows.encode() if rows else sys.stdin.buffer.read()
+    return blob
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="yt")
+    parser.add_argument("--proxy", default=os.environ.get("YT_PROXY"),
+                        help="primary address host:port (env YT_PROXY)")
+    parser.add_argument("--user", default=os.environ.get("YT_USER", "root"))
+    sub = parser.add_subparsers(dest="subcommand", required=True)
+
+    def cmd(name, *args_defs, **kw):
+        p = sub.add_parser(name, **kw)
+        for flags, opts in args_defs:
+            p.add_argument(*flags, **opts)
+        return p
+
+    cmd("list", (("path",), {"nargs": "?", "default": "/"}))
+    cmd("get", (("path",), {}))
+    cmd("set", (("path",), {}), (("value",), {}))
+    cmd("exists", (("path",), {}))
+    cmd("create", (("type",), {}), (("path",), {}),
+        (("-r", "--recursive"), {"action": "store_true"}),
+        (("-i", "--ignore-existing"), {"action": "store_true"}),
+        (("--attributes",), {"default": None}))
+    cmd("remove", (("path",), {}),
+        (("-f", "--force"), {"action": "store_true"}))
+    cmd("copy", (("src",), {}), (("dst",), {}),
+        (("-r", "--recursive"), {"action": "store_true"}))
+    cmd("move", (("src",), {}), (("dst",), {}),
+        (("-r", "--recursive"), {"action": "store_true"}))
+    cmd("link", (("target",), {}), (("link",), {}))
+    cmd("write-table", (("path",), {}),
+        (("--format",), {"default": "json"}),
+        (("--append",), {"action": "store_true"}),
+        (("--rows",), {"default": None, "help": "inline rows (else stdin)"}))
+    cmd("read-table", (("path",), {}), (("--format",), {"default": "json"}))
+    cmd("select-rows", (("query",), {}))
+    cmd("insert-rows", (("path",), {}),
+        (("--rows",), {"default": None}))
+    cmd("lookup-rows", (("path",), {}), (("--keys",), {"required": True}))
+    cmd("mount-table", (("path",), {}))
+    cmd("unmount-table", (("path",), {}))
+    cmd("map", (("mapper_command",), {}),
+        (("--src",), {"required": True}), (("--dst",), {"required": True}),
+        (("--format",), {"default": "json"}),
+        (("--pool",), {"default": "default"}),
+        (("--job-count",), {"type": int, "default": None}))
+    cmd("sort", (("--src",), {"required": True}),
+        (("--dst",), {"required": True}),
+        (("--sort-by",), {"required": True,
+                          "help": "comma-separated key columns"}))
+    cmd("merge", (("--src",), {"required": True,
+                               "help": "comma-separated input tables"}),
+        (("--dst",), {"required": True}),
+        (("--mode",), {"default": "unordered"}))
+    cmd("erase", (("path",), {}))
+    cmd("start-tx")
+    cmd("commit-tx", (("tx",), {}))
+    cmd("abort-tx", (("tx",), {}))
+    cmd("lock", (("path",), {}), (("--tx",), {"required": True}),
+        (("--mode",), {"default": "exclusive"}))
+    cmd("create-user", (("name",), {}))
+    cmd("create-account", (("name",), {}))
+    cmd("check-permission", (("user",), {}), (("permission",), {}),
+        (("path",), {}))
+    cmd("get-operation", (("op_id",), {}))
+    cmd("orchid", (("path",), {"nargs": "?", "default": "/"}))
+    return parser
+
+
+def run(argv: "list[str] | None" = None,
+        client=None) -> int:
+    args = build_parser().parse_args(argv)
+    caller_owns_client = client is not None
+    if client is None:
+        if not args.proxy:
+            print("error: --proxy (or YT_PROXY) is required",
+                  file=sys.stderr)
+            return 2
+        # The thin client never needs the accelerator; pin the platform
+        # BEFORE any lazy jax import (env alone is insufficient when an
+        # accelerator plugin is pre-registered — a dead tunnel would hang
+        # the CLI).  YT_CLI_PLATFORM overrides for on-device operations.
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ.get("YT_CLI_PLATFORM", "cpu"))
+        from ytsaurus_tpu.remote_client import RemoteYtClient
+        client = RemoteYtClient(args.proxy, user=args.user)
+    try:
+        _print(_dispatch(client, args))
+        return 0
+    except YtError as err:
+        print(json.dumps(err.to_dict(), default=_json_default),
+              file=sys.stderr)
+        return 1
+    finally:
+        if not caller_owns_client and hasattr(client, "close"):
+            client.close()
+
+
+def _dispatch(cl, a):
+    c = a.subcommand
+    if c == "list":
+        return cl.list(a.path)
+    if c == "get":
+        return cl.get(a.path)
+    if c == "set":
+        return cl.set(a.path, json.loads(a.value))
+    if c == "exists":
+        return cl.exists(a.path)
+    if c == "create":
+        attributes = json.loads(a.attributes) if a.attributes else None
+        return cl.create(a.type, a.path, attributes=attributes,
+                         recursive=a.recursive,
+                         ignore_existing=a.ignore_existing)
+    if c == "remove":
+        return cl.remove(a.path, force=a.force)
+    if c == "copy":
+        return cl.copy(a.src, a.dst, recursive=a.recursive)
+    if c == "move":
+        return cl.move(a.src, a.dst, recursive=a.recursive)
+    if c == "link":
+        return cl.link(a.target, a.link)
+    if c == "write-table":
+        return cl.write_table(a.path, _rows_arg(a.rows), format=a.format,
+                              append=a.append)
+    if c == "read-table":
+        return cl.read_table(a.path, format=a.format)
+    if c == "select-rows":
+        return cl.select_rows(a.query)
+    if c == "insert-rows":
+        rows = json.loads(_rows_arg(a.rows))
+        return cl.insert_rows(a.path, rows)
+    if c == "lookup-rows":
+        keys = [tuple(k) for k in json.loads(a.keys)]
+        return cl.lookup_rows(a.path, keys)
+    if c == "mount-table":
+        return cl.mount_table(a.path)
+    if c == "unmount-table":
+        return cl.unmount_table(a.path)
+    if c == "map":
+        kw = {"format": a.format, "pool": a.pool}
+        if a.job_count:
+            kw["job_count"] = a.job_count
+        op = cl.run_map(a.mapper_command, a.src, a.dst, **kw)
+        return {"operation_id": op.id, "state": op.state}
+    if c == "sort":
+        op = cl.run_sort(a.src, a.dst, a.sort_by.split(","))
+        return {"operation_id": op.id, "state": op.state}
+    if c == "merge":
+        op = cl.run_merge(a.src.split(","), a.dst, mode=a.mode)
+        return {"operation_id": op.id, "state": op.state}
+    if c == "erase":
+        op = cl.run_erase(a.path)
+        return {"operation_id": op.id, "state": op.state}
+    if c == "start-tx":
+        return cl.start_tx()
+    if c == "commit-tx":
+        return cl.commit_tx(a.tx)
+    if c == "abort-tx":
+        return cl.abort_tx(a.tx)
+    if c == "lock":
+        return cl.lock(a.path, mode=a.mode, tx=a.tx)
+    if c == "create-user":
+        return cl.create_user(a.name)
+    if c == "create-account":
+        return cl.create_account(a.name)
+    if c == "check-permission":
+        return cl.check_permission(a.user, a.permission, a.path)
+    if c == "get-operation":
+        return cl._execute("get_operation", {"operation_id": a.op_id})
+    if c == "orchid":
+        return cl.get_orchid(a.path)
+    raise AssertionError(c)
+
+
+def main() -> None:
+    raise SystemExit(run())
+
+
+if __name__ == "__main__":
+    main()

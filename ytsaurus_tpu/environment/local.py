@@ -20,11 +20,13 @@ from ytsaurus_tpu.rpc import Channel, RetryingChannel
 
 class LocalCluster:
     def __init__(self, root_dir: str, n_nodes: int = 2,
-                 replication_factor: int = 2):
+                 replication_factor: int = 2, http_proxy: bool = False):
         self.root_dir = root_dir
         self.n_nodes = n_nodes
         self.replication_factor = replication_factor
+        self.http_proxy = http_proxy
         self.primary_address: str | None = None
+        self.http_proxy_address: str | None = None
         self.node_addresses: list[str] = []
         self._procs: list[subprocess.Popen] = []
 
@@ -52,6 +54,13 @@ class LocalCluster:
                 port = self._wait_port(node_root, "node", deadline)
                 self.node_addresses.append(f"127.0.0.1:{port}")
             self._wait_ready(deadline)
+            if self.http_proxy:
+                proxy_root = os.path.join(self.root_dir, "proxy")
+                self._spawn("proxy", proxy_root,
+                            ["--role", "proxy", "--root", proxy_root,
+                             "--primary", self.primary_address])
+                port = self._wait_port(proxy_root, "proxy", deadline)
+                self.http_proxy_address = f"127.0.0.1:{port}"
         except BaseException:
             # A failed start must not leak daemon processes.
             self.stop()
@@ -62,7 +71,7 @@ class LocalCluster:
         os.makedirs(root, exist_ok=True)
         # Drop stale port files: a restart on the same root must not hand
         # out the previous incarnation's ports.
-        for stale in ("primary.port", "node.port"):
+        for stale in ("primary.port", "node.port", "proxy.port"):
             try:
                 os.unlink(os.path.join(root, stale))
             except FileNotFoundError:

@@ -23,13 +23,11 @@ DEFAULT_ROWS_PER_JOB = 4_000_000
 
 
 def chunk_data_weight(chunk: ColumnarChunk) -> int:
-    """Approximate payload bytes (plane bytes pro-rated to live rows)."""
-    import numpy as np
+    """Approximate payload bytes (plane bytes pro-rated to live rows).
+    Uses .nbytes metadata only — never forces a device-to-host copy."""
     if chunk.capacity == 0:
         return 0
-    total = 0
-    for col in chunk.columns.values():
-        total += np.asarray(col.data).nbytes
+    total = sum(col.data.nbytes for col in chunk.columns.values())
     return int(total * (chunk.row_count / chunk.capacity))
 
 
@@ -41,13 +39,15 @@ class Stripe:
     row_count: int = 0
     data_weight: int = 0
 
-    def add(self, chunk: ColumnarChunk, start: int, end: int) -> None:
+    def add(self, chunk: ColumnarChunk, start: int, end: int,
+            chunk_weight: "int | None" = None) -> None:
         self.slices.append((chunk, start, end))
         rows = end - start
         self.row_count += rows
         if chunk.row_count:
-            self.data_weight += int(
-                chunk_data_weight(chunk) * rows / chunk.row_count)
+            if chunk_weight is None:
+                chunk_weight = chunk_data_weight(chunk)
+            self.data_weight += int(chunk_weight * rows / chunk.row_count)
 
     def materialize(self) -> ColumnarChunk:
         parts = []
@@ -83,9 +83,10 @@ def build_stripes(chunks: Sequence[ColumnarChunk],
     chunks = [c for c in chunks if c.row_count > 0]
     if not chunks:
         return []
+    weights = {id(c): chunk_data_weight(c) for c in chunks}
     if max_job_count:
         total_rows = sum(c.row_count for c in chunks)
-        total_weight = sum(chunk_data_weight(c) for c in chunks)
+        total_weight = sum(weights.values())
         rows_per_job = max(rows_per_job,
                            -(-total_rows // max_job_count))
         data_weight_per_job = max(data_weight_per_job,
@@ -102,9 +103,9 @@ def build_stripes(chunks: Sequence[ColumnarChunk],
 
     # Unordered: sort descending by weight for tighter packing.
     pending = list(chunks) if ordered else sorted(
-        chunks, key=chunk_data_weight, reverse=True)
+        chunks, key=lambda c: weights[id(c)], reverse=True)
     for chunk in pending:
-        weight = chunk_data_weight(chunk)
+        weight = weights[id(chunk)]
         bytes_per_row = max(weight // max(chunk.row_count, 1), 1)
         max_rows_by_weight = max(data_weight_per_job // bytes_per_row, 1)
         max_rows = min(rows_per_job, max_rows_by_weight)
@@ -115,9 +116,25 @@ def build_stripes(chunks: Sequence[ColumnarChunk],
                     <= data_weight_per_job)
             if current.slices and not fits:
                 flush()
-            current.add(chunk, start, end)
+            current.add(chunk, start, end, chunk_weight=weight)
             if current.row_count >= rows_per_job or \
                     current.data_weight >= data_weight_per_job:
                 flush()
     flush()
+    # max_job_count is a HARD cap: greedy packing can overshoot on
+    # multi-chunk inputs, so fold the smallest stripes together (adjacent
+    # ones when ordered, to preserve row order).
+    while max_job_count and len(stripes) > max_job_count:
+        if ordered:
+            i = min(range(len(stripes) - 1),
+                    key=lambda j: stripes[j].row_count +
+                    stripes[j + 1].row_count)
+            j = i + 1
+        else:
+            by_rows = sorted(range(len(stripes)),
+                             key=lambda j: stripes[j].row_count)
+            i, j = sorted(by_rows[:2])
+        for args in stripes[j].slices:
+            stripes[i].add(*args)
+        del stripes[j]
     return stripes

@@ -88,6 +88,9 @@ class JobManager:
         self.speculation_factor = speculation_factor
         self.min_speculation_seconds = min_speculation_seconds
         self._pool_config = pool_config or (lambda name: {})
+        # Config lookups may be Cypress RPCs; they run OUTSIDE the lock
+        # (submit + monitor refresh this cache; scheduling reads it).
+        self._pool_cfg_cache: dict[str, dict] = {}
         self._lock = threading.Condition()
         self._pending: list[Job] = []
         self._running: list[Job] = []
@@ -99,10 +102,20 @@ class JobManager:
     # -- public ----------------------------------------------------------------
 
     def submit(self, jobs: "list[Job]") -> None:
+        self._refresh_pool_configs({j.pool for j in jobs})
         with self._lock:
             self._pending.extend(jobs)
             self._ensure_workers()
             self._lock.notify_all()
+
+    def _refresh_pool_configs(self, names) -> None:
+        """Fetch pool configs WITHOUT holding the scheduling lock (they
+        may be remote RPCs; a dead primary must not freeze the slots)."""
+        for name in names:
+            try:
+                self._pool_cfg_cache[name] = self._pool_config(name) or {}
+            except Exception:   # noqa: BLE001 — config must not fail jobs
+                self._pool_cfg_cache.setdefault(name, {})
 
     def wait(self, jobs: "list[Job]", timeout: Optional[float] = None,
              raise_on_failure: bool = True) -> None:
@@ -175,8 +188,15 @@ class JobManager:
             self._monitor.start()
 
     def _monitor_loop(self) -> None:
+        last_refresh = 0.0
         while not self._stop:
             time.sleep(0.25)
+            now = time.monotonic()
+            if now - last_refresh > 5.0:
+                with self._lock:
+                    names = {j.pool for j in self._pending + self._running}
+                self._refresh_pool_configs(names)   # outside the lock
+                last_refresh = now
             with self._lock:
                 try:
                     self._maybe_speculate_locked()
@@ -189,7 +209,7 @@ class JobManager:
 
         def state(name: str) -> PoolState:
             if name not in pools:
-                cfg = self._pool_config(name) or {}
+                cfg = self._pool_cfg_cache.get(name) or {}
                 pools[name] = PoolState(
                     name=name,
                     weight=float(cfg.get("weight", 1.0)),
@@ -317,7 +337,7 @@ class JobManager:
                 continue
             twin = Job(op_id=job.op_id, index=job.index, run=job.run,
                        pool=job.pool, preemptible=True,
-                       speculative_of=job)
+                       speculative_of=job, on_done=job.on_done)
             twin.attempt = job.attempt + 1
             logger.info("speculating job %s (running %.1fs > %.1fs)",
                         job.id, now - job.started_at, threshold)
@@ -329,7 +349,10 @@ class JobManager:
         rival = winner.speculative_of
         if rival is not None and not rival._done.is_set():
             # Twin finished first: copy the result onto the original so
-            # waiters (which hold the original) observe success.
+            # waiters (which hold the original) observe success.  The
+            # logical job's on_done fires exactly once — here via the
+            # twin's own _execute; the original's unwinding run takes the
+            # settled-state early return BEFORE its callback.
             rival.result = winner.result
             rival.state = "completed"
             rival.duration = winner.duration
@@ -405,6 +428,10 @@ def run_command_job(job: Job, command: str, input_blob: bytes,
     the slot-isolation analog), wire-format pipes, stderr tail kept on
     the job, non-zero exit = job failure."""
     import os
+    if job._lost or job._preempted:
+        # Killed before the process spawned: don't start work that is
+        # already condemned.
+        raise YtError("job canceled before start", code=EErrorCode.Canceled)
     proc = subprocess.Popen(
         ["/bin/sh", "-c", command],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -414,6 +441,10 @@ def run_command_job(job: Job, command: str, input_blob: bytes,
              "YT_JOB_ID": job.id, "YT_JOB_INDEX": str(job.index),
              "YT_OPERATION_ID": job.op_id})
     job._proc = proc
+    if job._lost or job._preempted:
+        # A kill issued between the check above and _proc assignment saw
+        # no process; finish the kill ourselves.
+        _kill_job_process(job)
     try:
         stdout, stderr = proc.communicate(input_blob, timeout=timeout)
     except subprocess.TimeoutExpired:

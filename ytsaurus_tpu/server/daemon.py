@@ -165,9 +165,26 @@ def run_node(root: str, port: int, primary_address: str,
         time.sleep(2.0)
 
 
+def run_proxy(root: str, port: int, primary_address: str) -> None:
+    """HTTP proxy daemon: REST /api/v4 bridged to the primary's RPC plane
+    (ref: the standalone http_proxy process, server/http_proxy)."""
+    from ytsaurus_tpu.remote_client import RemoteYtClient
+    from ytsaurus_tpu.server.http_proxy import HttpProxy
+
+    os.makedirs(root, exist_ok=True)
+    proxy = HttpProxy(
+        lambda user: RemoteYtClient(primary_address, user=user),
+        port=port)
+    _write_port_file(root, "proxy", proxy.port)
+    print(f"http proxy serving on {proxy.address} -> {primary_address}",
+          flush=True)
+    proxy.serve_forever()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--role", choices=("primary", "node"), required=True)
+    parser.add_argument("--role", choices=("primary", "node", "proxy"),
+                        required=True)
     parser.add_argument("--root", required=True)
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--primary", default=None,
@@ -188,6 +205,10 @@ def main() -> None:
         run_primary(args.root, args.port, args.replication_factor,
                     journal_nodes=args.journal_nodes,
                     bootstrap_timeout=args.bootstrap_timeout)
+    elif args.role == "proxy":
+        if not args.primary:
+            parser.error("--primary is required for --role proxy")
+        run_proxy(args.root, args.port, args.primary)
     else:
         if not args.primary:
             parser.error("--primary is required for --role node")
