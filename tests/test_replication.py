@@ -1,0 +1,160 @@
+"""Table replication: async replicator, sync fanout, tracker, fallback.
+
+Ref model: replicated dynamic tables (tablet_node/table_replicator.cpp),
+sync-replica commit fanout (ytlib/api/native/transaction.cpp:737-830),
+replicated_table_tracker mode flips, hedged replica fallback reads.
+"""
+
+import pytest
+
+from ytsaurus_tpu import YtError
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.tablet.replication import (
+    ReplicatedTableTracker,
+    TableReplicator,
+)
+
+SCHEMA = TableSchema.make([
+    ("key", "int64", "ascending"), ("a", "string"), ("b", "int64")],
+    unique_keys=True)
+
+
+def make_table(client, path):
+    client.create("table", path, recursive=True,
+                  attributes={"schema": SCHEMA, "dynamic": True})
+    client.mount_table(path)
+
+
+@pytest.fixture
+def upstream(tmp_path):
+    return connect(str(tmp_path / "up"))
+
+
+@pytest.fixture
+def downstream_root(tmp_path):
+    return str(tmp_path / "down")
+
+
+def test_async_replication_roundtrip(upstream, downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r")
+    rid = upstream.create_table_replica(
+        "//t", "//r", cluster_root=downstream_root, mode="async")
+    upstream.insert_rows("//t", [{"key": 1, "a": "x", "b": 10},
+                                 {"key": 2, "a": "y", "b": 20}])
+    repl = TableReplicator(upstream)
+    assert repl.lag("//t", rid) == 2
+    assert repl.replicate_step("//t") == {rid: 2}
+    assert repl.lag("//t", rid) == 0
+    # The replicator's cached remote client shares the tablet state.
+    rc = repl.replica_client(downstream_root)
+    assert rc.lookup_rows("//r", [(1,), (2,)]) == [
+        {"key": 1, "a": b"x", "b": 10},
+        {"key": 2, "a": b"y", "b": 20}]
+    # Idempotent: nothing new to pull.
+    assert repl.replicate_step("//t") == {rid: 0}
+
+
+def test_async_replication_partial_writes_and_deletes(upstream,
+                                                      downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r")
+    rid = upstream.create_table_replica(
+        "//t", "//r", cluster_root=downstream_root, mode="async")
+    repl = TableReplicator(upstream)
+    upstream.insert_rows("//t", [{"key": 1, "a": "x", "b": 1}])
+    repl.replicate_step("//t")
+    # Partial (update-mode) write replicates as a partial write.
+    upstream.insert_rows("//t", [{"key": 1, "b": 2}], update=True)
+    upstream.insert_rows("//t", [{"key": 3, "a": "z", "b": 3}])
+    upstream.delete_rows("//t", [(3,)])
+    repl.replicate_step("//t")
+    rc = repl.replica_client(downstream_root)
+    assert rc.lookup_rows("//r", [(1,)]) == [{"key": 1, "a": b"x", "b": 2}]
+    assert rc.lookup_rows("//r", [(3,)]) == [None]
+    assert repl.lag("//t", rid) == 0
+
+
+def test_sync_replica_commit_fanout(upstream, downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r")
+    upstream.create_table_replica(
+        "//t", "//r", cluster_root=downstream_root, mode="sync")
+    upstream.insert_rows("//t", [{"key": 7, "a": "s", "b": 70}])
+    # Visible on the replica immediately, no replicator pass needed.
+    rc = upstream.table_replicator.replica_client(downstream_root)
+    assert rc.lookup_rows("//r", [(7,)]) == [{"key": 7, "a": b"s", "b": 70}]
+    upstream.delete_rows("//t", [(7,)])
+    assert rc.lookup_rows("//r", [(7,)]) == [None]
+
+
+def test_broken_sync_replica_fails_write(upstream, downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r")
+    upstream.create_table_replica(
+        "//t", "//r", cluster_root=downstream_root, mode="sync")
+    rc = upstream.table_replicator.replica_client(downstream_root)
+    rc.unmount_table("//r")
+    with pytest.raises(YtError):
+        upstream.insert_rows("//t", [{"key": 1, "a": "x", "b": 1}])
+    # Upstream must not have committed either (atomic fanout).
+    upstream_rows = upstream.select_rows("key FROM [//t]")
+    assert upstream_rows == []
+
+
+def test_tracker_demotes_and_promotes(upstream, downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r1")
+    make_table(down, "//r2")
+    rid1 = upstream.create_table_replica(
+        "//t", "//r1", cluster_root=downstream_root, mode="sync")
+    rid2 = upstream.create_table_replica(
+        "//t", "//r2", cluster_root=downstream_root, mode="async")
+    upstream.insert_rows("//t", [{"key": 1, "a": "x", "b": 1}])
+    repl = TableReplicator(upstream)
+    tracker = ReplicatedTableTracker(repl)
+    # Break the sync replica: tracker must demote it and promote the
+    # async one (after catching it up).
+    rc = repl.replica_client(downstream_root)
+    rc.unmount_table("//r1")
+    result = tracker.step("//t")
+    assert result["health"][rid1] is not None
+    replicas = upstream.get_table_replicas("//t")
+    assert replicas[rid1]["mode"] == "async"
+    assert replicas[rid2]["mode"] == "sync"
+    assert result["sync_count"] == 1
+    # The promoted replica was caught up before the flip.
+    assert rc.lookup_rows("//r2", [(1,)]) == [{"key": 1, "a": b"x", "b": 1}]
+
+
+def test_lookup_replica_fallback(upstream, downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r")
+    upstream.create_table_replica(
+        "//t", "//r", cluster_root=downstream_root, mode="async")
+    upstream.insert_rows("//t", [{"key": 5, "a": "f", "b": 50}])
+    upstream.table_replicator.replicate_step("//t")
+    upstream.unmount_table("//t")
+    with pytest.raises(YtError):
+        upstream.lookup_rows("//t", [(5,)])
+    assert upstream.lookup_rows("//t", [(5,)], replica_fallback=True) == [
+        {"key": 5, "a": b"f", "b": 50}]
+
+
+def test_same_cluster_replica(upstream):
+    make_table(upstream, "//t")
+    make_table(upstream, "//backup")
+    rid = upstream.create_table_replica("//t", "//backup", mode="async")
+    upstream.insert_rows("//t", [{"key": 1, "a": "x", "b": 1}])
+    repl = TableReplicator(upstream)
+    repl.replicate_step("//t")
+    assert upstream.lookup_rows("//backup", [(1,)]) == [
+        {"key": 1, "a": b"x", "b": 1}]
+    assert repl.lag("//t", rid) == 0

@@ -102,6 +102,15 @@ class YtClient:
         self.scheduler = OperationScheduler(self)
         self.last_query_statistics = QueryStatistics()
         self._computed_plans: dict = {}
+        self._table_replicator = None
+
+    @property
+    def table_replicator(self):
+        """Lazy shared TableReplicator (caches remote-cluster clients)."""
+        if self._table_replicator is None:
+            from ytsaurus_tpu.tablet.replication import TableReplicator
+            self._table_replicator = TableReplicator(self)
+        return self._table_replicator
 
     # ------------------------------------------------------------------ cypress
 
@@ -464,6 +473,10 @@ class YtClient:
         node = self._table_node(path)
         tablets = self.cluster.tablets.pop(node.id, None)
         if tablets is None:
+            # Not materialized in this connection — still record the state
+            # so other connections stop lazily re-mounting it.
+            if node.attributes.get("tablet_state") == "mounted":
+                self.set(path + "/@tablet_state", "unmounted")
             return
         from ytsaurus_tpu.tablet.ordered import OrderedTablet
         for tablet in tablets:
@@ -652,8 +665,19 @@ class YtClient:
         tx = tx or txm.start()
         for idx, part in self._route_rows(path, tablets, list(rows)).items():
             txm.write_rows(tx, tablets[idx], part, update=update)
+        # Sync replicas join the SAME 2PC commit (ref transaction.cpp:737
+        # sync-replica fanout): their tablets are extra participants, so a
+        # broken sync replica fails the write before anything commits.
+        sync_targets = self._sync_replica_targets(path)
+        for rid, rc, rpath in sync_targets:
+            rtablets = rc._mounted_tablets(rpath)
+            for idx, part in rc._route_rows(rpath, rtablets,
+                                            list(rows)).items():
+                txm.write_rows(tx, rtablets[idx], part, update=update)
         if own:
-            return txm.commit(tx)
+            commit_ts = txm.commit(tx)
+            self._advance_sync_checkpoints(path, sync_targets, commit_ts)
+            return commit_ts
         return None
 
     def delete_rows(self, path: str, keys: Sequence[tuple],
@@ -668,14 +692,118 @@ class YtClient:
         for idx, part in self._route_rows(
                 path, tablets, keys).items():
             txm.delete_rows(tx, tablets[idx], part)
+        sync_targets = self._sync_replica_targets(path)
+        for rid, rc, rpath in sync_targets:
+            rtablets = rc._mounted_tablets(rpath)
+            for idx, part in rc._route_rows(rpath, rtablets, keys).items():
+                txm.delete_rows(tx, rtablets[idx], part)
         if own:
-            return txm.commit(tx)
+            commit_ts = txm.commit(tx)
+            self._advance_sync_checkpoints(path, sync_targets, commit_ts)
+            return commit_ts
         return None
+
+    # --------------------------------------------------------------- replication
+
+    def create_table_replica(self, table_path: str, replica_path: str,
+                             cluster_root: Optional[str] = None,
+                             mode: str = "async",
+                             enabled: bool = True) -> str:
+        """Register a replica of a replicated (dynamic) table.  The replica
+        table must exist (same schema) on the target cluster; cluster_root
+        None means this cluster.  Ref: CreateTableReplica
+        (client/api/client.h), table_replica objects (tablet_server)."""
+        from ytsaurus_tpu.tablet import replication as repl
+        if mode not in ("sync", "async"):
+            raise YtError(f"Bad replica mode {mode!r}",
+                          code=EErrorCode.QueryTypeError)
+        self._table_node(table_path)
+        replicas = repl.replica_descriptors(self, table_path)
+        rid = f"replica-{len(replicas)}"
+        while rid in replicas:
+            rid = rid + "-1"
+        replicas[rid] = {"path": replica_path, "cluster_root": cluster_root,
+                         "mode": mode, "enabled": bool(enabled),
+                         "last_replicated_ts": 0, "error": None}
+        repl.set_replica_descriptors(self, table_path, replicas)
+        return rid
+
+    def alter_table_replica(self, table_path: str, replica_id: str,
+                            mode: Optional[str] = None,
+                            enabled: Optional[bool] = None) -> None:
+        from ytsaurus_tpu.tablet import replication as repl
+        replicas = repl.replica_descriptors(self, table_path)
+        if replica_id not in replicas:
+            raise YtError(f"No such replica {replica_id!r}",
+                          code=EErrorCode.ResolveError)
+        if mode is not None:
+            if mode not in ("sync", "async"):
+                raise YtError(f"Bad replica mode {mode!r}",
+                              code=EErrorCode.QueryTypeError)
+            replicas[replica_id]["mode"] = mode
+        if enabled is not None:
+            replicas[replica_id]["enabled"] = bool(enabled)
+        repl.set_replica_descriptors(self, table_path, replicas)
+
+    def get_table_replicas(self, table_path: str) -> dict:
+        from ytsaurus_tpu.tablet import replication as repl
+        return repl.replica_descriptors(self, table_path)
+
+    def _sync_replica_targets(self, path: str):
+        """(replica_id, replica_client, replica_path) for each enabled
+        sync replica of `path` (empty for non-replicated tables)."""
+        from ytsaurus_tpu.tablet import replication as repl
+        out = []
+        for rid, info in repl.replica_descriptors(self, path).items():
+            if info.get("enabled") and info.get("mode") == "sync":
+                rc = self.table_replicator.replica_client(
+                    info.get("cluster_root"))
+                out.append((rid, rc, info["path"]))
+        return out
+
+    def _advance_sync_checkpoints(self, path: str, sync_targets,
+                                  commit_ts: int) -> None:
+        if not sync_targets:
+            return
+        from ytsaurus_tpu.tablet import replication as repl
+        replicas = repl.replica_descriptors(self, path)
+        for rid, _rc, _rpath in sync_targets:
+            if rid in replicas:
+                replicas[rid]["last_replicated_ts"] = commit_ts
+        repl.set_replica_descriptors(self, path, replicas)
 
     def lookup_rows(self, path: str, keys: Sequence[tuple],
                     timestamp: int = MAX_TIMESTAMP,
-                    column_names: Optional[Sequence[str]] = None
+                    column_names: Optional[Sequence[str]] = None,
+                    replica_fallback: bool = False
                     ) -> list[Optional[dict]]:
+        """Point reads.  replica_fallback=True: when the upstream table is
+        unavailable, read from the freshest enabled replica instead (sync
+        replicas first) — the in-process analog of hedged replica reads
+        (core/rpc/hedging_channel.h, client hedging)."""
+        if replica_fallback:
+            try:
+                return self.lookup_rows(path, keys, timestamp=timestamp,
+                                        column_names=column_names)
+            except YtError as primary_err:
+                from ytsaurus_tpu.tablet import replication as repl
+                replicas = repl.replica_descriptors(self, path)
+                ranked = sorted(
+                    replicas.values(),
+                    key=lambda i: (i.get("mode") != "sync",
+                                   -int(i.get("last_replicated_ts", 0))))
+                for info in ranked:
+                    if not info.get("enabled"):
+                        continue
+                    try:
+                        rc = self.table_replicator.replica_client(
+                            info.get("cluster_root"))
+                        return rc.lookup_rows(
+                            info["path"], keys, timestamp=timestamp,
+                            column_names=column_names)
+                    except YtError:
+                        continue
+                raise primary_err
         tablets = self._mounted_tablets(path)
         self._require_sorted(tablets[0], path)
         keys = self._fill_computed_keys(tablets[0].schema,
@@ -882,6 +1010,14 @@ class YtClient:
     def _mounted_tablets(self, path: str) -> list[Tablet]:
         node = self._table_node(path)
         tablets = self.cluster.tablets.get(node.id)
+        if tablets is None and \
+                node.attributes.get("tablet_state") == "mounted":
+            # Mount state is cluster metadata: a fresh connection to a
+            # cluster whose master says "mounted" re-materializes the
+            # tablets from the persisted chunk lists (ref: tablet cells
+            # recover mounted tablets from the master after restart).
+            self.mount_table(path)
+            tablets = self.cluster.tablets.get(node.id)
         if tablets is None:
             raise YtError(f"Table {path!r} is not mounted",
                           code=EErrorCode.TabletNotMounted)

@@ -37,6 +37,14 @@ class TimestampProvider:
         with self._lock:
             return self._last
 
+    def observe(self, ts: int) -> None:
+        """Fold an externally observed timestamp into the clock (hybrid
+        logical clock advance: replicated commits keep local timestamps
+        monotone across clusters/processes)."""
+        with self._lock:
+            if ts > self._last:
+                self._last = ts
+
 
 _global_provider = TimestampProvider()
 
