@@ -53,12 +53,16 @@ def _iters_left(times, iters):
 
 
 def _sync(x):
-    """True synchronization: force a host read (see module note)."""
+    """True synchronization: force a host read (see module note).  Slice
+    ON DEVICE first so only one element crosses the tunnel — np.asarray of
+    a whole result plane costs seconds at ~17 MB/s."""
     import numpy as np
     leaf = x
     while isinstance(leaf, (list, tuple)):
         leaf = leaf[0]
-    np.asarray(leaf).ravel()[:1]
+    if hasattr(leaf, "ravel"):
+        leaf = leaf.ravel()[:1]
+    np.asarray(leaf)
 
 
 def _time_plan(query, tables, iters, evaluator=None):
@@ -91,34 +95,30 @@ def _time_plan(query, tables, iters, evaluator=None):
 
 def bench_q1(n_rows, iters):
     from ytsaurus_tpu.models import tpch
-    chunk = tpch.generate_lineitem(n_rows)
+    chunk = tpch.generate_lineitem_device(n_rows)
     best, groups = _time_plan(tpch.Q1, {"//tpch/lineitem": chunk}, iters)
     assert 1 <= groups <= 6
     return "tpch_q1_rows_per_sec", n_rows / best, best
 
 def bench_groupby(n_rows, iters):
-    import numpy as np
-    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.models import tpch
     from ytsaurus_tpu.schema import TableSchema
-    rng = np.random.default_rng(0)
     schema = TableSchema.make([("k", "int64", "ascending"), ("g", "int64"),
                                ("v", "int64")])
-    chunk = ColumnarChunk.from_arrays(schema, {
-        "k": np.arange(n_rows), "g": rng.integers(0, 10_000, n_rows),
-        "v": rng.integers(0, 1000, n_rows)})
+    chunk = tpch.device_chunk(schema, tpch.device_planes({
+        "k": ("arange",), "g": ("randint", 0, 10_000),
+        "v": ("randint", 0, 1000)}, n_rows), n_rows)
     best, _ = _time_plan(
         "g, sum(v) AS s, count(*) AS c FROM [//t] GROUP BY g",
         {"//t": chunk}, iters)
     return "groupby_rows_per_sec", n_rows / best, best
 
 def bench_topk(n_rows, iters):
-    import numpy as np
-    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.models import tpch
     from ytsaurus_tpu.schema import TableSchema
-    rng = np.random.default_rng(0)
     schema = TableSchema.make([("k", "int64"), ("v", "double")])
-    chunk = ColumnarChunk.from_arrays(schema, {
-        "k": np.arange(n_rows), "v": rng.uniform(0, 1, n_rows)})
+    chunk = tpch.device_chunk(schema, tpch.device_planes({
+        "k": ("arange",), "v": ("uniform", 0.0, 1.0)}, n_rows), n_rows)
     best, count = _time_plan(
         "k, v FROM [//t] ORDER BY v DESC LIMIT 100", {"//t": chunk}, iters)
     assert count == 100
@@ -128,8 +128,8 @@ def bench_q3(n_rows, iters):
     from ytsaurus_tpu.models import tpch
     from ytsaurus_tpu.query.engine.evaluator import Evaluator
     n_orders = max(n_rows // 4, 1)
-    lineitem = tpch.generate_lineitem(n_rows, n_orders=n_orders)
-    orders = tpch.generate_orders(n_orders)
+    lineitem = tpch.generate_lineitem_device(n_rows, n_orders=n_orders)
+    orders = tpch.generate_orders_device(n_orders)
     ev = Evaluator()
     from ytsaurus_tpu.query.builder import build_query
     plan = build_query(tpch.Q3, {"//tpch/lineitem": tpch.LINEITEM_SCHEMA,
@@ -147,14 +147,13 @@ def bench_q3(n_rows, iters):
     return "tpch_q3_rows_per_sec", n_rows / best, best
 
 def bench_sort(n_rows, iters):
-    import numpy as np
-    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.models import tpch
     from ytsaurus_tpu.operations.sort_op import sort_chunk
     from ytsaurus_tpu.schema import TableSchema
-    rng = np.random.default_rng(0)
     schema = TableSchema.make([("k", "int64"), ("p", "double")])
-    chunk = ColumnarChunk.from_arrays(schema, {
-        "k": rng.integers(0, 1 << 60, n_rows), "p": rng.uniform(0, 1, n_rows)})
+    chunk = tpch.device_chunk(schema, tpch.device_planes({
+        "k": ("randint", 0, 1 << 60), "p": ("uniform", 0.0, 1.0)},
+        n_rows), n_rows)
     out = sort_chunk(chunk, ["k"])                  # warm-up
     _sync(out.columns["k"].data)
     times = []
@@ -168,17 +167,18 @@ def bench_sort(n_rows, iters):
 def bench_strings(n_rows, iters):
     """GROUP BY over a high-cardinality (~n/10 distinct) string column."""
     import numpy as np
-    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.models import tpch
     from ytsaurus_tpu.schema import TableSchema
-    rng = np.random.default_rng(0)
     n_distinct = max(n_rows // 10, 1)
-    codes = rng.integers(0, n_distinct, n_rows)
     schema = TableSchema.make([("k", "int64", "ascending"), ("s", "string"),
                                ("v", "int64")])
-    chunk = ColumnarChunk.from_arrays(schema, {
-        "k": np.arange(n_rows),
-        "s": np.array([b"u%08d" % c for c in codes], dtype=object),
-        "v": rng.integers(0, 1000, n_rows)})
+    # Codes on device; only the (host-side) vocabulary is materialized.
+    vocab = np.empty(n_distinct, dtype=object)
+    vocab[:] = [b"u%08d" % c for c in range(n_distinct)]
+    chunk = tpch.device_chunk(schema, tpch.device_planes({
+        "k": ("arange",), "s": ("randint", 0, n_distinct),
+        "v": ("randint", 0, 1000)}, n_rows), n_rows,
+        dictionaries={"s": vocab})
     best, groups = _time_plan(
         "s, sum(v) AS t FROM [//t] GROUP BY s", {"//t": chunk}, iters)
     assert groups <= n_distinct

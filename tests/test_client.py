@@ -38,13 +38,13 @@ def test_master_recovery(tmp_path):
     client.set("//data/@answer", 42)
     client.write_table("//data/t", [{"x": 1}, {"x": 2}])
     # Re-open the cluster from disk: WAL replay must restore everything.
-    reopened = connect(str(tmp_path))
+    reopened = connect(str(tmp_path), fresh=True)
     assert reopened.get("//data/@answer") == 42
     assert reopened.read_table("//data/t") == [{"x": 1}, {"x": 2}]
     # Snapshot + more mutations + recovery.
     reopened.cluster.master.build_snapshot()
     reopened.set("//data/@post_snapshot", True)
-    third = connect(str(tmp_path))
+    third = connect(str(tmp_path), fresh=True)
     assert third.get("//data/@answer") == 42
     assert third.get("//data/@post_snapshot") is True
 
@@ -208,9 +208,9 @@ def test_torn_changelog_tail_truncated(tmp_path):
     log = str(tmp_path) + "/master/changelog.log"
     with open(log, "ab") as f:
         f.write(b"\x7f\x01\x02")          # garbage partial record
-    re1 = connect(str(tmp_path))
+    re1 = connect(str(tmp_path), fresh=True)
     re1.create("map_node", "//b", recursive=True)
-    re2 = connect(str(tmp_path))
+    re2 = connect(str(tmp_path), fresh=True)
     assert re2.exists("//a") and re2.exists("//b")
 
 
@@ -459,7 +459,7 @@ def test_compact_resharded_table_survives_restart(tmp_path):
                                    {"key": 15, "value": "new15"}])
     client.compact_table("//dyn/c")   # persists nested per-tablet chunks
     client.unmount_table("//dyn/c")
-    reopened = connect(str(tmp_path))
+    reopened = connect(str(tmp_path), fresh=True)
     reopened.mount_table("//dyn/c")
     rows = reopened.lookup_rows("//dyn/c", [(5,), (15,), (19,)])
     assert rows[0]["value"] == b"new5"
@@ -645,7 +645,7 @@ def test_copy_move_link(client):
     assert client.read_table("//a/alias") == client.read_table("//a/renamed")
     # survives WAL recovery
     from ytsaurus_tpu.client import connect
-    reopened = connect(client.cluster.root_dir)
+    reopened = connect(client.cluster.root_dir, fresh=True)
     assert [r["x"] for r in reopened.read_table("//a/alias")] == [1, 2]
     # probes
     with pytest.raises(YtError):

@@ -1,0 +1,127 @@
+"""Hunks: out-of-row storage for large values.
+
+Ref model: hunks (ytlib/table_client/hunks.h), hunk stores
+(tablet_node/hunk_store.h), hunk chunk sweeper, TColumnSchema
+max_inline_hunk_size.
+"""
+
+import pytest
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.chunks.encoding import read_chunk_meta, serialize_chunk
+from ytsaurus_tpu.chunks.hunks import HUNK_PREFIX, is_hunk_id
+from ytsaurus_tpu.chunks.store import FsChunkStore
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.schema import TableSchema
+
+BIG = b"B" * 4096
+BIG2 = b"C" * 8192
+
+HUNK_SCHEMA = TableSchema.make([
+    ("key", "int64", "ascending"),
+    {"name": "v", "type": "string", "max_inline_hunk_size": 256},
+], unique_keys=True)
+
+
+def hunk_ids(store):
+    return [cid for cid in store.list_chunks() if is_hunk_id(cid)]
+
+
+def test_chunk_roundtrip_with_hunks(tmp_path):
+    store = FsChunkStore(str(tmp_path))
+    schema = TableSchema.make([
+        ("k", "int64"),
+        {"name": "v", "type": "string", "max_inline_hunk_size": 256}])
+    rows = [{"k": 0, "v": b"small"}, {"k": 1, "v": BIG},
+            {"k": 2, "v": BIG2}, {"k": 3, "v": None}]
+    chunk = ColumnarChunk.from_rows(schema, rows)
+    cid = store.write_chunk(chunk)
+    # Payloads live out-of-row: the data chunk blob is small, two hunk
+    # blobs exist, and the meta names them.
+    assert len(store.get_blob(cid)) < 2048
+    assert len(hunk_ids(store)) == 2
+    meta = read_chunk_meta(store.get_blob(cid))
+    assert sorted(meta["hunk_chunk_ids"]) == sorted(hunk_ids(store))
+    assert store.read_chunk(cid).to_rows() == rows
+
+
+def test_hunks_content_addressed_no_rewrite(tmp_path):
+    store = FsChunkStore(str(tmp_path))
+    schema = TableSchema.make([
+        ("k", "int64"),
+        {"name": "v", "type": "string", "max_inline_hunk_size": 256}])
+    c1 = store.write_chunk(ColumnarChunk.from_rows(
+        schema, [{"k": 1, "v": BIG}]))
+    ids_before = hunk_ids(store)
+    # A second chunk carrying the same big value reuses the same hunk blob.
+    store.write_chunk(ColumnarChunk.from_rows(
+        schema, [{"k": 2, "v": BIG}, {"k": 3, "v": b"tiny"}]))
+    assert hunk_ids(store) == ids_before
+    assert store.read_chunk(c1).to_rows() == [{"k": 1, "v": BIG}]
+
+
+def test_serialize_without_store_keeps_inline(tmp_path):
+    schema = TableSchema.make([
+        ("k", "int64"),
+        {"name": "v", "type": "string", "max_inline_hunk_size": 256}])
+    blob = serialize_chunk(ColumnarChunk.from_rows(
+        schema, [{"k": 1, "v": BIG}]))
+    assert "hunk_chunk_ids" not in read_chunk_meta(blob)
+
+
+def test_dynamic_table_hunks_end_to_end(tmp_path):
+    client = connect(str(tmp_path))
+    client.create("table", "//t", recursive=True,
+                  attributes={"schema": HUNK_SCHEMA, "dynamic": True})
+    client.mount_table("//t")
+    client.insert_rows("//t", [{"key": 1, "v": b"small"},
+                               {"key": 2, "v": BIG}])
+    store = client.cluster.chunk_store
+    tablet = client._mounted_tablets("//t")[0]
+    tablet.flush()
+    assert len(hunk_ids(store)) == 1
+    # Reads resolve refs transparently.
+    assert client.lookup_rows("//t", [(2,)]) == [{"key": 2, "v": BIG}]
+    assert client.select_rows("key FROM [//t] WHERE v = 'small'") == \
+        [{"key": 1}]
+    # Compaction keeps the content-addressed hunk in place.
+    ids_before = hunk_ids(store)
+    client.insert_rows("//t", [{"key": 3, "v": BIG2}])
+    tablet.flush()
+    tablet.compact()
+    assert set(ids_before) <= set(hunk_ids(store))
+    assert client.lookup_rows("//t", [(2,), (3,)]) == [
+        {"key": 2, "v": BIG}, {"key": 3, "v": BIG2}]
+    # Survives unmount/remount (refs round-trip through the wire format).
+    client.unmount_table("//t")
+    client.mount_table("//t")
+    assert client.lookup_rows("//t", [(3,)]) == [{"key": 3, "v": BIG2}]
+
+
+def test_hunk_sweeper_gc(tmp_path):
+    client = connect(str(tmp_path))
+    client.create("table", "//t", recursive=True,
+                  attributes={"schema": HUNK_SCHEMA, "dynamic": True})
+    client.mount_table("//t")
+    client.insert_rows("//t", [{"key": 1, "v": BIG}, {"key": 2, "v": BIG2}])
+    tablet = client._mounted_tablets("//t")[0]
+    tablet.flush()
+    store = client.cluster.chunk_store
+    assert len(hunk_ids(store)) == 2
+    # Live hunks survive a GC pass.
+    client.unmount_table("//t")
+    client.collect_garbage()
+    assert len(hunk_ids(store)) == 2
+    client.mount_table("//t")
+    # Dropping one big value orphans its hunk after compaction + GC.
+    client.delete_rows("//t", [(2,)])
+    tablet = client._mounted_tablets("//t")[0]
+    tablet.flush()
+    tablet.compact(retention_timestamp=2 ** 62)
+    client.unmount_table("//t")
+    removed = client.collect_garbage()
+    assert removed >= 1
+    remaining = hunk_ids(store)
+    assert len(remaining) == 1
+    client.mount_table("//t")
+    assert client.lookup_rows("//t", [(1,)]) == [{"key": 1, "v": BIG}]

@@ -148,6 +148,23 @@ def test_lookup_replica_fallback(upstream, downstream_root):
         {"key": 5, "a": b"f", "b": 50}]
 
 
+def test_sync_checkpoint_advances_under_caller_tx(upstream,
+                                                  downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r")
+    rid = upstream.create_table_replica(
+        "//t", "//r", cluster_root=downstream_root, mode="sync")
+    tx = upstream.start_transaction()
+    upstream.insert_rows("//t", [{"key": 1, "a": "x", "b": 1}], tx=tx)
+    upstream.commit_transaction(tx)
+    # Checkpoint advanced: demoting to async must not replay the write.
+    repl = TableReplicator(upstream)
+    assert repl.lag("//t", rid) == 0
+    upstream.alter_table_replica("//t", rid, mode="async")
+    assert repl.replicate_step("//t") == {rid: 0}
+
+
 def test_same_cluster_replica(upstream):
     make_table(upstream, "//t")
     make_table(upstream, "//backup")

@@ -19,6 +19,7 @@ Checksums are CRC-64 via the native library (ytsaurus_tpu.native).
 from __future__ import annotations
 
 import struct
+from dataclasses import replace
 from typing import Optional
 
 import jax.numpy as jnp
@@ -27,6 +28,7 @@ import numpy as np
 from ytsaurus_tpu import native, yson
 from ytsaurus_tpu.chunks.columnar import Column, ColumnarChunk, pad_capacity
 from ytsaurus_tpu.chunks.compression import get_codec
+from ytsaurus_tpu.chunks.hunks import HunkRef
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.schema import EValueType, TableSchema, device_dtype
 
@@ -55,10 +57,19 @@ def _encode_column(col: Column, ty: EValueType, n: int) -> tuple[bytes, bytes]:
             native.delta_encode(data.astype(np.int64)))
         vocab = col.dictionary if col.dictionary is not None else \
             np.array([], dtype=object)
+        # Tagged entries: 0 = inline bytes, 1 = hunk ref (id, length).
         parts = [_encode_varint_u(len(vocab))]
         for v in vocab:
-            parts.append(_encode_varint_u(len(v)))
-            parts.append(bytes(v))
+            if isinstance(v, HunkRef):
+                hid = v.hunk_id.encode()
+                parts.append(b"\x01")
+                parts.append(_encode_varint_u(len(hid)))
+                parts.append(hid)
+                parts.append(_encode_varint_u(v.length))
+            else:
+                parts.append(b"\x00")
+                parts.append(_encode_varint_u(len(v)))
+                parts.append(bytes(v))
         aux = b"".join(parts)
     elif ty is EValueType.any:
         block = b""
@@ -74,7 +85,8 @@ def _encode_column(col: Column, ty: EValueType, n: int) -> tuple[bytes, bytes]:
 
 
 def _decode_column(ty: EValueType, data_block: bytes, aux_block: bytes,
-                   valid: np.ndarray, n: int, cap: int) -> Column:
+                   valid: np.ndarray, n: int, cap: int,
+                   format_version: int = 2) -> Column:
     dictionary = None
     host_values = None
     if ty in (EValueType.int64, EValueType.uint64):
@@ -90,10 +102,26 @@ def _decode_column(ty: EValueType, data_block: bytes, aux_block: bytes,
         count, pos = _decode_varint_u(aux_block, 0)
         vocab = []
         for _ in range(count):
-            length, pos = _decode_varint_u(aux_block, pos)
-            vocab.append(aux_block[pos:pos + length])
-            pos += length
-        dictionary = np.array(vocab, dtype=object)
+            if format_version >= 2:
+                tag = aux_block[pos]
+                pos += 1
+            else:
+                tag = 0                     # v1: untagged inline entries
+            if tag == 0:
+                length, pos = _decode_varint_u(aux_block, pos)
+                vocab.append(aux_block[pos:pos + length])
+                pos += length
+            elif tag == 1:
+                id_len, pos = _decode_varint_u(aux_block, pos)
+                hid = aux_block[pos:pos + id_len].decode()
+                pos += id_len
+                length, pos = _decode_varint_u(aux_block, pos)
+                vocab.append(HunkRef(hunk_id=hid, length=length))
+            else:
+                raise YtError(f"Bad vocab entry tag {tag}",
+                              code=EErrorCode.ChunkFormatError)
+        dictionary = np.empty(count, dtype=object)
+        dictionary[:] = vocab
     elif ty is EValueType.any:
         # utf-8 decode so str payloads round-trip as str (bytes that are not
         # valid utf-8 stay bytes — the YSON wire format cannot distinguish).
@@ -113,11 +141,16 @@ def _decode_column(ty: EValueType, data_block: bytes, aux_block: bytes,
                   dictionary=dictionary, host_values=host_values)
 
 
-def serialize_chunk(chunk: ColumnarChunk, codec: str = DEFAULT_CODEC) -> bytes:
+def serialize_chunk(chunk: ColumnarChunk, codec: str = DEFAULT_CODEC,
+                    hunk_store=None) -> bytes:
+    """hunk_store: when given, string-column vocab entries whose column
+    schema sets max_inline_hunk_size move out-of-row into content-addressed
+    hunk blobs (ref hunks.h); their ids land in meta["hunk_chunk_ids"]."""
     compress, _ = get_codec(codec)
     n = chunk.row_count
     blocks: list[bytes] = []
     columns_meta = []
+    hunk_chunk_ids: set[str] = set()
     offset = 0
 
     def add_block(raw: bytes) -> dict:
@@ -135,6 +168,14 @@ def serialize_chunk(chunk: ColumnarChunk, codec: str = DEFAULT_CODEC) -> bytes:
 
     for col_schema in chunk.schema:
         col = chunk.columns[col_schema.name]
+        if hunk_store is not None and \
+                col_schema.max_inline_hunk_size is not None and \
+                col.dictionary is not None:
+            from ytsaurus_tpu.chunks.hunks import hunkify_vocab
+            vocab, ids = hunkify_vocab(hunk_store, col.dictionary,
+                                       col_schema.max_inline_hunk_size)
+            hunk_chunk_ids.update(ids)
+            col = replace(col, dictionary=vocab)
         data_block, aux_block = _encode_column(col, col_schema.type, n)
         valid_block = native.bitmap_pack(
             np.asarray(col.valid[:n]).astype(np.uint8))
@@ -146,12 +187,15 @@ def serialize_chunk(chunk: ColumnarChunk, codec: str = DEFAULT_CODEC) -> bytes:
         })
 
     meta = {
-        "format_version": 1,
+        # v2: tagged string-vocab entries (inline | hunk ref); v1 readable.
+        "format_version": 2,
         "codec": codec,
         "row_count": n,
         "schema": chunk.schema.to_dict(),
         "columns": columns_meta,
     }
+    if hunk_chunk_ids:
+        meta["hunk_chunk_ids"] = sorted(hunk_chunk_ids)
     meta_blob = yson.dumps(meta, binary=True)
     return b"".join([MAGIC, _encode_varint_u(len(meta_blob)), meta_blob]
                     + blocks)
@@ -167,7 +211,8 @@ def read_chunk_meta(blob: bytes) -> dict:
 
 
 def deserialize_chunk(blob: bytes,
-                      capacity: Optional[int] = None) -> ColumnarChunk:
+                      capacity: Optional[int] = None,
+                      hunk_store=None) -> ColumnarChunk:
     meta = read_chunk_meta(blob)
     _, decompress = get_codec(meta["codec"])
     start = meta["_data_start"]
@@ -190,15 +235,23 @@ def deserialize_chunk(blob: bytes,
                           code=EErrorCode.ChunkFormatError)
         return raw
 
+    has_hunks = bool(meta.get("hunk_chunk_ids"))
     columns: dict[str, Column] = {}
     try:
         for col_meta in meta["columns"]:
             name = col_meta["name"]
             col_schema = schema.get(name)
             valid = native.bitmap_unpack(read_block(col_meta["valid"]), n)
-            columns[name] = _decode_column(
+            column = _decode_column(
                 col_schema.type, read_block(col_meta["data"]),
-                read_block(col_meta["aux"]), valid, n, cap)
+                read_block(col_meta["aux"]), valid, n, cap,
+                format_version=int(meta.get("format_version", 1)))
+            if has_hunks and column.dictionary is not None and \
+                    any(isinstance(v, HunkRef) for v in column.dictionary):
+                from ytsaurus_tpu.chunks.hunks import resolve_vocab
+                column = replace(column, dictionary=resolve_vocab(
+                    hunk_store, column.dictionary))
+            columns[name] = column
     except (ValueError, IndexError, KeyError) as e:
         raise YtError(f"Chunk decode failed: {e}",
                       code=EErrorCode.ChunkFormatError)

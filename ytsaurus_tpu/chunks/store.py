@@ -54,7 +54,7 @@ class FsChunkStore:
                     codec: Optional[str] = None,
                     erasure: Optional[str] = None) -> str:
         chunk_id = chunk_id or new_chunk_id()
-        blob = serialize_chunk(chunk, codec or self.codec)
+        blob = serialize_chunk(chunk, codec or self.codec, hunk_store=self)
         return self.put_blob(chunk_id, blob, erasure=erasure)
 
     def _atomic_write(self, path: str, blob: bytes) -> None:
@@ -96,7 +96,7 @@ class FsChunkStore:
         return self._read_blob(chunk_id)
 
     def read_chunk(self, chunk_id: str) -> ColumnarChunk:
-        return deserialize_chunk(self._read_blob(chunk_id))
+        return deserialize_chunk(self._read_blob(chunk_id), hunk_store=self)
 
     def read_meta(self, chunk_id: str) -> dict:
         return read_chunk_meta(self._read_blob(chunk_id))

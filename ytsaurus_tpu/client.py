@@ -16,6 +16,7 @@ Operations: run_sort/run_merge/run_map/run_erase via the scheduler.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
@@ -339,10 +340,25 @@ class YtClient:
         for tablets in self.cluster.tablets.values():
             for tablet in tablets:
                 referenced.update(tablet.chunk_ids)
+        # Hunk chunks are live iff a live data chunk's meta references them
+        # (ref hunk_chunk_sweeper: ref-counted hunk chunk attachment).
+        # The meta pass costs a read per live chunk, so only hunk-bearing
+        # stores pay it.
+        from ytsaurus_tpu.chunks.hunks import is_hunk_id
+        store = self.cluster.chunk_store
+        all_ids = store.list_chunks()
+        if any(is_hunk_id(cid) for cid in all_ids):
+            for cid in all_ids:
+                if cid in referenced and not is_hunk_id(cid):
+                    try:
+                        referenced.update(
+                            store.read_meta(cid).get("hunk_chunk_ids", []))
+                    except YtError:
+                        pass
         removed = 0
-        for cid in self.cluster.chunk_store.list_chunks():
+        for cid in all_ids:
             if cid not in referenced:
-                self.cluster.chunk_store.remove_chunk(cid)
+                store.remove_chunk(cid)
                 self.cluster.chunk_cache.invalidate(cid)
                 removed += 1
         return removed
@@ -639,7 +655,12 @@ class YtClient:
         return self.cluster.transactions.start()
 
     def commit_transaction(self, tx: TabletTransaction) -> int:
-        return self.cluster.transactions.commit(tx)
+        commit_ts = self.cluster.transactions.commit(tx)
+        # Sync-replica checkpoints for writes staged under this caller-owned
+        # transaction (kept on the tx so an abort advances nothing).
+        for path, sync_targets in getattr(tx, "pending_sync_advances", []):
+            self._advance_sync_checkpoints(path, sync_targets, commit_ts)
+        return commit_ts
 
     def abort_transaction(self, tx: TabletTransaction) -> None:
         self.cluster.transactions.abort(tx)
@@ -678,6 +699,9 @@ class YtClient:
             commit_ts = txm.commit(tx)
             self._advance_sync_checkpoints(path, sync_targets, commit_ts)
             return commit_ts
+        if sync_targets:
+            tx.pending_sync_advances = getattr(
+                tx, "pending_sync_advances", []) + [(path, sync_targets)]
         return None
 
     def delete_rows(self, path: str, keys: Sequence[tuple],
@@ -701,6 +725,9 @@ class YtClient:
             commit_ts = txm.commit(tx)
             self._advance_sync_checkpoints(path, sync_targets, commit_ts)
             return commit_ts
+        if sync_targets:
+            tx.pending_sync_advances = getattr(
+                tx, "pending_sync_advances", []) + [(path, sync_targets)]
         return None
 
     # --------------------------------------------------------------- replication
@@ -1145,6 +1172,22 @@ def _value_type(value) -> EValueType:
     return EValueType.any
 
 
-def connect(root_dir: str) -> YtClient:
-    """Open (or create) a local cluster rooted at `root_dir`."""
-    return YtClient(YtCluster(root_dir))
+_cluster_registry: dict = {}
+_cluster_registry_lock = threading.Lock()
+
+
+def connect(root_dir: str, fresh: bool = False) -> YtClient:
+    """Open (or create) a local cluster rooted at `root_dir`.
+
+    One YtCluster instance per root per process: two clients connecting to
+    the same root share cluster state, exactly like two clients of the same
+    daemons (and two master instances must not double-write one WAL).
+    fresh=True drops the cached instance and re-opens from disk — the
+    restart/recovery path for tests exercising WAL replay."""
+    key = os.path.realpath(root_dir)
+    with _cluster_registry_lock:
+        cluster = _cluster_registry.get(key)
+        if cluster is None or fresh:
+            cluster = YtCluster(root_dir)
+            _cluster_registry[key] = cluster
+    return YtClient(cluster)

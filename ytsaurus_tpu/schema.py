@@ -87,6 +87,9 @@ class ColumnSchema:
     expression: Optional[str] = None  # computed column (key evaluator)
     aggregate: Optional[str] = None   # aggregate column for dynamic tables
     lock: Optional[str] = None        # lock group for dynamic-table writes
+    # Values >= this many bytes store out-of-row in hunk chunks
+    # (ref TColumnSchema::MaxInlineHunkSize, client/table_client/schema.h).
+    max_inline_hunk_size: Optional[int] = None
 
     def with_sort_order(self, order: Optional[SortOrder]) -> "ColumnSchema":
         return replace(self, sort_order=order)
@@ -103,6 +106,8 @@ class ColumnSchema:
             d["aggregate"] = self.aggregate
         if self.lock is not None:
             d["lock"] = self.lock
+        if self.max_inline_hunk_size is not None:
+            d["max_inline_hunk_size"] = self.max_inline_hunk_size
         return d
 
     @classmethod
@@ -115,6 +120,7 @@ class ColumnSchema:
             expression=d.get("expression"),
             aggregate=d.get("aggregate"),
             lock=d.get("lock"),
+            max_inline_hunk_size=d.get("max_inline_hunk_size"),
         )
 
 

@@ -81,7 +81,7 @@ class RpcChunkStore:
                     codec: Optional[str] = None,
                     erasure: Optional[str] = None) -> str:
         chunk_id = chunk_id or new_chunk_id()
-        blob = serialize_chunk(chunk, codec or self.codec)
+        blob = serialize_chunk(chunk, codec or self.codec, hunk_store=self)
         self.put_blob(chunk_id, blob, erasure=erasure)
         return chunk_id
 
@@ -127,7 +127,7 @@ class RpcChunkStore:
                       code=EErrorCode.NoSuchChunk, inner_errors=errors[:3])
 
     def read_chunk(self, chunk_id: str) -> ColumnarChunk:
-        return deserialize_chunk(self.get_blob(chunk_id))
+        return deserialize_chunk(self.get_blob(chunk_id), hunk_store=self)
 
     def read_meta(self, chunk_id: str) -> dict:
         return read_chunk_meta(self.get_blob(chunk_id))

@@ -35,11 +35,11 @@ REPLICAS_ATTR = "replicas"
 
 
 def replica_descriptors(client, table_path: str) -> dict:
-    """The @replicas attribute: replica_id → descriptor dict."""
-    try:
-        return dict(client.get(table_path + "/@" + REPLICAS_ATTR))
-    except YtError:
-        return {}
+    """The @replicas attribute: replica_id → descriptor dict.  Reads the
+    node attribute directly — this sits on the hot write path, so the
+    common non-replicated case must not build/catch an exception."""
+    node = client._table_node(table_path)
+    return dict(node.attributes.get(REPLICAS_ATTR) or {})
 
 
 def set_replica_descriptors(client, table_path: str, replicas: dict) -> None:

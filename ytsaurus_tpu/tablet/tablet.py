@@ -38,7 +38,8 @@ def versioned_schema(schema: TableSchema) -> TableSchema:
     the columns it wrote, so partial writes merge per column on read.
     Keys keep their sort order; versions sort within key by descending
     timestamp at flush time."""
-    cols = []
+    from dataclasses import replace as _replace
+    cols: list = []
     for c in schema:
         if c.sort_order is not None:
             cols.append((c.name, c.type.value, c.sort_order.value))
@@ -46,7 +47,9 @@ def versioned_schema(schema: TableSchema) -> TableSchema:
     cols.append(("$tombstone", "boolean"))
     for c in schema:
         if c.sort_order is None:
-            cols.append((c.name, c.type.value))
+            # Keep hunk thresholds so flushes store big values out-of-row.
+            cols.append(_replace(c, sort_order=None, expression=None,
+                                 aggregate=None, required=False))
             cols.append((f"$w:{c.name}", "boolean"))
     return TableSchema.make(cols)
 
