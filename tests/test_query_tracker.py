@@ -1,0 +1,120 @@
+"""Query tracker: persistent queries, states, results, engines.
+
+Ref model: server/query_tracker (start/get/list/abort/read_query_result,
+engine field, result row caps).
+"""
+
+import pytest
+
+from ytsaurus_tpu import YtError
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.driver import Driver
+from ytsaurus_tpu.server.query_tracker import QueryTracker, register_engine
+
+
+@pytest.fixture
+def client(tmp_path):
+    c = connect(str(tmp_path))
+    c.write_table("//data/t", [{"k": i, "v": i * 10} for i in range(5)])
+    return c
+
+
+def test_query_lifecycle(client):
+    qt = client.query_tracker
+    qid = qt.start_query("k, v FROM [//data/t] WHERE k >= 3")
+    record = qt.wait(qid)
+    assert record["state"] == "completed"
+    assert record["engine"] == "ql"
+    assert record["finish_time"] >= record["start_time"]
+    assert qt.read_query_result(qid) == [
+        {"k": 3, "v": 30}, {"k": 4, "v": 40}]
+
+
+def test_failed_query_records_error(client):
+    qt = client.query_tracker
+    qid = qt.start_query("k FROM [//no/such/table]")
+    record = qt.wait(qid)
+    assert record["state"] == "failed"
+    assert "no/such/table" in record["error"]
+    with pytest.raises(YtError):
+        qt.read_query_result(qid)
+
+
+def test_list_and_abort(client):
+    qt = QueryTracker(client)
+    done = qt.start_query("k FROM [//data/t]", sync=True)
+    # sync=True: already completed; abort must refuse.
+    with pytest.raises(YtError):
+        qt.abort_query(done)
+    listed = qt.list_queries(state="completed")
+    assert [q["id"] for q in listed] == [done]
+    assert qt.list_queries(state="failed") == []
+
+
+def test_result_truncation(client):
+    qt = QueryTracker(client, result_row_limit=2)
+    qid = qt.start_query("k FROM [//data/t]", sync=True)
+    record = qt.get_query(qid)
+    assert record["truncated"] is True
+    assert len(qt.read_query_result(qid)) == 2
+
+
+def test_custom_engine_plug_point(client):
+    register_engine("rot13", lambda cl, q: [{"echo": q[::-1]}])
+    qt = QueryTracker(client)
+    qid = qt.start_query("abc", engine="rot13", sync=True)
+    assert qt.read_query_result(qid) == [{"echo": "cba"}]
+    with pytest.raises(YtError):
+        qt.start_query("x", engine="nope")
+
+
+def test_query_records_scoped_per_user(client):
+    from ytsaurus_tpu.cypress.security import authenticated_user
+    sec = client.cluster.security
+    sec.create_user("alice")
+    sec.create_user("bob")
+    client.set("//data/t/@acl", [
+        {"action": "allow", "subjects": ["alice", "bob"],
+         "permissions": ["read"]}])
+    qt = QueryTracker(client)
+    with authenticated_user("alice"):
+        qid = qt.start_query("k FROM [//data/t] WHERE k = 0", sync=True)
+        assert qt.read_query_result(qid) == [{"k": 0}]
+    # Another user can neither see nor read alice's query.
+    with authenticated_user("bob"):
+        assert qt.list_queries() == []
+        with pytest.raises(YtError):
+            qt.read_query_result(qid)
+        with pytest.raises(YtError):
+            qt.get_query(qid)
+    # Root (superuser) sees everything.
+    assert [q["id"] for q in qt.list_queries()] == [qid]
+
+
+def test_async_query_runs_as_caller(client):
+    """The worker thread must NOT escalate to root (ref: query tracker
+    executes under the query's user)."""
+    from ytsaurus_tpu.cypress.security import authenticated_user
+    sec = client.cluster.security
+    sec.create_user("carol")
+    client.write_table("//secret", [{"s": 1}])
+    client.set("//secret/@acl", [
+        {"action": "deny", "subjects": ["carol"], "permissions": ["read"]}])
+    qt = QueryTracker(client)
+    with authenticated_user("carol"):
+        qid = qt.start_query("s FROM [//secret]")
+        record = qt.wait(qid)
+    assert record["state"] == "failed"
+    assert "carol" in record["error"] or "denied" in record["error"].lower()
+
+
+def test_driver_commands(client):
+    drv = Driver(client)
+    qid = drv.execute("start_query",
+                      {"query": "k FROM [//data/t] WHERE k = 1"})
+    client.query_tracker.wait(qid)
+    assert drv.execute("get_query",
+                          {"query_id": qid})["state"] == "completed"
+    assert drv.execute("read_query_result",
+                          {"query_id": qid}) == [{"k": 1}]
+    assert len(drv.execute("list_queries", {})) == 1

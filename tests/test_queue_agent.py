@@ -1,0 +1,101 @@
+"""Queue agent: consumers, offsets, lags, auto-trim.
+
+Ref model: client/queue_client consumer tables + server/queue_agent
+controller passes (status, vital-consumer-gated trimming).
+"""
+
+import pytest
+
+from ytsaurus_tpu import YtError
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.server.queue_agent import QueueAgent
+
+QUEUE_SCHEMA = TableSchema.make([("msg", "string"), ("n", "int64")])
+
+
+@pytest.fixture
+def client(tmp_path):
+    return connect(str(tmp_path))
+
+
+def make_queue(client, path, n_rows=10):
+    client.create("table", path, recursive=True,
+                  attributes={"schema": QUEUE_SCHEMA, "dynamic": True})
+    client.mount_table(path)
+    client.push_queue(path, [{"msg": f"m{i}", "n": i} for i in range(n_rows)])
+
+
+def test_consumer_pull_advance_cycle(client):
+    make_queue(client, "//q")
+    client.register_queue_consumer("//q", "//c")
+    rows, next_off = client.pull_consumer("//c", "//q", limit=4)
+    assert [r["n"] for r in rows] == [0, 1, 2, 3]
+    assert next_off == 4
+    client.advance_consumer("//c", "//q", next_off)
+    rows, next_off = client.pull_consumer("//c", "//q", limit=4)
+    assert [r["n"] for r in rows] == [4, 5, 6, 7]
+    assert next_off == 8
+    # Optimistic concurrency: stale old_offset is rejected.
+    with pytest.raises(YtError):
+        client.advance_consumer("//c", "//q", 9, old_offset=2)
+    client.advance_consumer("//c", "//q", 8, old_offset=4)
+    # Offsets never move backwards.
+    with pytest.raises(YtError):
+        client.advance_consumer("//c", "//q", 3)
+
+
+def test_queue_status_and_lag(client):
+    make_queue(client, "//q", n_rows=6)
+    client.register_queue_consumer("//q", "//c1")
+    client.register_queue_consumer("//q", "//c2", vital=False)
+    client.advance_consumer("//c1", "//q", 4)
+    agent = QueueAgent(client)
+    status = agent.queue_status("//q")
+    assert status["partitions"][0]["upper_row_index"] == 6
+    assert status["consumers"]["//c1"] == {
+        "offset": 4, "lag": 2, "vital": True}
+    assert status["consumers"]["//c2"]["lag"] == 6
+    assert status["consumers"]["//c2"]["vital"] is False
+
+
+def test_auto_trim_gated_by_vital_consumers(client):
+    make_queue(client, "//q", n_rows=10)
+    client.set("//q/@auto_trim_config", {"enable": True})
+    client.register_queue_consumer("//q", "//vital1")
+    client.register_queue_consumer("//q", "//vital2")
+    client.register_queue_consumer("//q", "//lazy", vital=False)
+    client.advance_consumer("//vital1", "//q", 7)
+    client.advance_consumer("//vital2", "//q", 5)
+    agent = QueueAgent(client)
+    out = agent.step()
+    # Trim to min(vital offsets)=5; the non-vital consumer at 0 is ignored.
+    assert out["//q"]["partitions"][0]["trimmed_row_count"] == 5
+    assert out["//q"]["partitions"][0]["available_row_count"] == 5
+    # @queue_status exported for observability.
+    assert client.get("//q/@queue_status")["partitions"][0][
+        "trimmed_row_count"] == 5
+    # A consumer behind the trim horizon resumes at the horizon.
+    rows, next_off = client.pull_consumer("//lazy", "//q", limit=2)
+    assert [r["n"] for r in rows] == [5, 6]
+    assert next_off == 7
+
+
+def test_unregister_consumer(client):
+    make_queue(client, "//q")
+    client.register_queue_consumer("//q", "//c")
+    client.unregister_queue_consumer("//q", "//c")
+    agent = QueueAgent(client)
+    assert agent.queue_status("//q")["consumers"] == {}
+    assert agent._registered_queues() == []
+
+
+def test_register_validates(client):
+    make_queue(client, "//q")
+    # Non-queue target rejected.
+    client.write_table("//static", [{"a": 1}])
+    with pytest.raises(YtError):
+        client.register_queue_consumer("//static", "//c")
+    # Existing non-consumer table rejected as a consumer.
+    with pytest.raises(YtError):
+        client.register_queue_consumer("//q", "//static")

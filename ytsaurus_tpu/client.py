@@ -104,6 +104,7 @@ class YtClient:
         self.last_query_statistics = QueryStatistics()
         self._computed_plans: dict = {}
         self._table_replicator = None
+        self._query_tracker = None
 
     @property
     def table_replicator(self):
@@ -112,6 +113,14 @@ class YtClient:
             from ytsaurus_tpu.tablet.replication import TableReplicator
             self._table_replicator = TableReplicator(self)
         return self._table_replicator
+
+    @property
+    def query_tracker(self):
+        """Lazy shared QueryTracker (ref server/query_tracker)."""
+        if self._query_tracker is None:
+            from ytsaurus_tpu.server.query_tracker import QueryTracker
+            self._query_tracker = QueryTracker(self)
+        return self._query_tracker
 
     # ------------------------------------------------------------------ cypress
 
@@ -603,6 +612,31 @@ class YtClient:
         self._require_ordered(tablet, path)
         tablet.trim_rows(trimmed_count)
 
+    # ------------------------------------------------------ queue consumers
+
+    def register_queue_consumer(self, queue_path: str, consumer_path: str,
+                                vital: bool = True) -> None:
+        from ytsaurus_tpu.server.queue_agent import register_consumer
+        register_consumer(self, queue_path, consumer_path, vital=vital)
+
+    def unregister_queue_consumer(self, queue_path: str,
+                                  consumer_path: str) -> None:
+        from ytsaurus_tpu.server.queue_agent import unregister_consumer
+        unregister_consumer(self, queue_path, consumer_path)
+
+    def advance_consumer(self, consumer_path: str, queue_path: str,
+                         new_offset: int,
+                         old_offset: Optional[int] = None) -> None:
+        from ytsaurus_tpu.server.queue_agent import advance_consumer
+        advance_consumer(self, consumer_path, queue_path, new_offset,
+                         old_offset=old_offset)
+
+    def pull_consumer(self, consumer_path: str, queue_path: str,
+                      limit: Optional[int] = None
+                      ) -> "tuple[list[dict], int]":
+        from ytsaurus_tpu.server.queue_agent import pull_consumer
+        return pull_consumer(self, consumer_path, queue_path, limit=limit)
+
     @staticmethod
     def _require_ordered(tablet, path: str) -> None:
         from ytsaurus_tpu.tablet.ordered import OrderedTablet
@@ -862,6 +896,12 @@ class YtClient:
         stats = QueryStatistics()
         self.last_query_statistics = stats   # visible even if the query fails
         plan = build_query(query, _SchemaResolver(self))
+        # Every source table requires read permission (ref: query agent
+        # checks table read access before executing subqueries).
+        self.cluster.security.validate_permission("read", plan.source)
+        for join in plan.joins:
+            self.cluster.security.validate_permission(
+                "read", join.foreign_table)
         from ytsaurus_tpu.query.pruning import extract_column_intervals
         intervals = extract_column_intervals(plan.where)
         source_chunks = self._query_shards(plan.source, timestamp,

@@ -159,6 +159,41 @@ def _registry() -> dict[str, CommandDescriptor]:
            lambda cl, p: (lambda op: {"id": op.id, "state": op.state,
                                       "type": op.type})(
                cl.scheduler.get_operation(p["operation_id"]))),
+        # queue consumers (ref queue_client + queue_agent verbs)
+        _d("register_queue_consumer", ("queue_path", "consumer_path"),
+           ("vital",), True,
+           lambda cl, p: cl.register_queue_consumer(
+               p["queue_path"], p["consumer_path"],
+               vital=p.get("vital", True))),
+        _d("unregister_queue_consumer", ("queue_path", "consumer_path"), (),
+           True,
+           lambda cl, p: cl.unregister_queue_consumer(
+               p["queue_path"], p["consumer_path"])),
+        _d("advance_consumer", ("consumer_path", "queue_path", "new_offset"),
+           ("old_offset",), True,
+           lambda cl, p: cl.advance_consumer(
+               p["consumer_path"], p["queue_path"], p["new_offset"],
+               old_offset=p.get("old_offset"))),
+        _d("pull_consumer", ("consumer_path", "queue_path"), ("limit",),
+           False,
+           lambda cl, p: (lambda rows, off: {"rows": rows,
+                                             "next_offset": off})(
+               *cl.pull_consumer(p["consumer_path"], p["queue_path"],
+                                 limit=p.get("limit")))),
+        # query tracker (ref server/query_tracker verbs)
+        _d("start_query", ("query",), ("engine", "annotations"), True,
+           lambda cl, p: cl.query_tracker.start_query(
+               p["query"], engine=p.get("engine", "ql"),
+               annotations=p.get("annotations"))),
+        _d("get_query", ("query_id",), (), False,
+           lambda cl, p: cl.query_tracker.get_query(p["query_id"])),
+        _d("list_queries", (), ("state", "engine"), False,
+           lambda cl, p: cl.query_tracker.list_queries(
+               state=p.get("state"), engine=p.get("engine"))),
+        _d("read_query_result", ("query_id",), (), False,
+           lambda cl, p: cl.query_tracker.read_query_result(p["query_id"])),
+        _d("abort_query", ("query_id",), (), True,
+           lambda cl, p: cl.query_tracker.abort_query(p["query_id"])),
     ]:
         c[d.name] = d
     return c
