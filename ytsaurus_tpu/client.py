@@ -379,7 +379,25 @@ class YtClient:
                     schema: "TableSchema | dict | None" = None,
                     format: Optional[str] = None) -> None:
         self.cluster.security.validate_permission("write", path)
-        if format is not None:
+        if format == "arrow":
+            from ytsaurus_tpu.arrow import (
+                arrow_ipc_to_rows,
+                arrow_schema_to_table_schema,
+            )
+            if schema is None:
+                import pyarrow as _pa
+                with _pa.ipc.open_stream(rows) as reader:
+                    schema = arrow_schema_to_table_schema(reader.schema)
+            rows = arrow_ipc_to_rows(rows)
+        elif format == "skiff":
+            from ytsaurus_tpu.formats import loads_skiff
+            if schema is None:
+                raise YtError("skiff writes require a schema",
+                              code=EErrorCode.QueryUnsupported)
+            if not isinstance(schema, TableSchema):
+                schema = TableSchema.from_dict(schema)
+            rows = loads_skiff(rows, schema)
+        elif format is not None:
             from ytsaurus_tpu.formats import loads_rows
             columns = None
             if isinstance(schema, TableSchema):
@@ -430,17 +448,28 @@ class YtClient:
 
     def read_table(self, path: str, format: Optional[str] = None):
         """Rows as dicts, or serialized bytes when `format` is given
-        (yson/json/dsv/schemaful_dsv — ref client/formats)."""
+        (yson/json/dsv/schemaful_dsv/skiff/arrow — ref client/formats,
+        client/arrow)."""
         self.cluster.security.validate_permission("read", path)
         chunks = self._read_table_chunks(path)
+        if format == "arrow":
+            # Columnar fast path: planes → arrow arrays, no row walk.
+            from ytsaurus_tpu.arrow import chunks_to_arrow_ipc
+            return chunks_to_arrow_ipc(chunks)
         rows: list[dict] = []
         for chunk in chunks:
             rows.extend(chunk.to_rows())
         if format is None:
             return rows
-        from ytsaurus_tpu.formats import dumps_rows
         node = self._table_node(path)
         schema = self._node_schema(node)
+        if format == "skiff":
+            from ytsaurus_tpu.formats import dumps_skiff
+            if schema is None:
+                from ytsaurus_tpu.client import infer_schema
+                schema = infer_schema(rows)
+            return dumps_skiff(rows, schema)
+        from ytsaurus_tpu.formats import dumps_rows
         columns = schema.column_names if schema else None
         return dumps_rows(rows, format, columns=columns)
 

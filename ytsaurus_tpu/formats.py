@@ -160,3 +160,108 @@ def loads_rows(data: bytes, format: str = "yson",
         return rows
     raise YtError(f"Unknown format {format!r}",
                   code=EErrorCode.QueryUnsupported)
+
+
+# --------------------------------------------------------------------- skiff
+#
+# Skiff (ref client/formats skiff + library/skiff): schema-driven binary row
+# format — no per-value tags, so parsing is branch-light and rows are dense.
+# Wire per row: uint16 table index, then each schema column in order:
+#   optional columns: variant8 tag (0 = null, 1 = value) then the payload
+#   int64/uint64:     8-byte LE
+#   double:           8-byte LE IEEE
+#   boolean:          1 byte
+#   string:           uint32 LE length + bytes    ("string32")
+#   any:              uint32 LE length + binary YSON ("yson32")
+
+import struct as _struct
+
+from ytsaurus_tpu.schema import EValueType as _EVT
+
+
+def _skiff_required(col) -> bool:
+    return bool(col.required)
+
+
+def dumps_skiff(rows: Sequence[dict], schema) -> bytes:
+    out = bytearray()
+    for row in rows:
+        out += _struct.pack("<H", 0)             # table index
+        for col in schema:
+            value = row.get(col.name)
+            if not _skiff_required(col):
+                if value is None:
+                    out.append(0)
+                    continue
+                out.append(1)
+            elif value is None:
+                raise YtError(f"Required column {col.name!r} is null",
+                              code=EErrorCode.QueryTypeError)
+            ty = col.type
+            if ty in (_EVT.int64, _EVT.uint64):
+                out += _struct.pack("<q" if ty is _EVT.int64 else "<Q",
+                                    int(value))
+            elif ty is _EVT.double:
+                out += _struct.pack("<d", float(value))
+            elif ty is _EVT.boolean:
+                out.append(1 if value else 0)
+            elif ty is _EVT.string:
+                data = value.encode() if isinstance(value, str) else \
+                    bytes(value)
+                out += _struct.pack("<I", len(data)) + data
+            elif ty is _EVT.any:
+                blob = yson.dumps(value, binary=True)
+                out += _struct.pack("<I", len(blob)) + blob
+            else:
+                raise YtError(f"Skiff cannot encode type {ty.value!r}",
+                              code=EErrorCode.QueryUnsupported)
+    return bytes(out)
+
+
+def loads_skiff(data: bytes, schema) -> list[dict]:
+    rows: list[dict] = []
+    pos = 0
+    n = len(data)
+    while pos < n:
+        if pos + 2 > n:
+            raise YtError("Truncated skiff row header",
+                          code=EErrorCode.ChunkFormatError)
+        (_table_index,) = _struct.unpack_from("<H", data, pos)
+        pos += 2
+        row: dict = {}
+        for col in schema:
+            if not _skiff_required(col):
+                tag = data[pos]
+                pos += 1
+                if tag == 0:
+                    row[col.name] = None
+                    continue
+                if tag != 1:
+                    raise YtError(f"Bad skiff variant tag {tag}",
+                                  code=EErrorCode.ChunkFormatError)
+            ty = col.type
+            if ty in (_EVT.int64, _EVT.uint64):
+                (row[col.name],) = _struct.unpack_from(
+                    "<q" if ty is _EVT.int64 else "<Q", data, pos)
+                pos += 8
+            elif ty is _EVT.double:
+                (row[col.name],) = _struct.unpack_from("<d", data, pos)
+                pos += 8
+            elif ty is _EVT.boolean:
+                row[col.name] = bool(data[pos])
+                pos += 1
+            elif ty is _EVT.string:
+                (length,) = _struct.unpack_from("<I", data, pos)
+                pos += 4
+                row[col.name] = bytes(data[pos:pos + length])
+                pos += length
+            elif ty is _EVT.any:
+                (length,) = _struct.unpack_from("<I", data, pos)
+                pos += 4
+                row[col.name] = yson.loads(bytes(data[pos:pos + length]))
+                pos += length
+            else:
+                raise YtError(f"Skiff cannot decode type {ty.value!r}",
+                              code=EErrorCode.QueryUnsupported)
+        rows.append(row)
+    return rows
