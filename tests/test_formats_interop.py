@@ -42,6 +42,25 @@ def test_skiff_required_dense():
         dumps_skiff([{"k": None, "x": 1.0}], schema)
 
 
+def test_skiff_truncation_raises(tmp_path):
+    blob = dumps_skiff(ROWS, SCHEMA)
+    for cut in (1, 3, 9):
+        with pytest.raises(YtError):
+            loads_skiff(blob[:-cut], SCHEMA)
+
+
+def test_arrow_empty_table(tmp_path):
+    import pyarrow as pa
+    client = connect(str(tmp_path))
+    client.create("table", "//empty", recursive=True,
+                  attributes={"schema": SCHEMA})
+    blob = client.read_table("//empty", format="arrow")
+    with pa.ipc.open_stream(blob) as reader:
+        table = reader.read_all()
+    assert table.num_rows == 0
+    assert table.column_names == SCHEMA.column_names
+
+
 def test_skiff_through_client(tmp_path):
     client = connect(str(tmp_path))
     client.write_table("//t", ROWS, schema=SCHEMA)

@@ -1,0 +1,117 @@
+"""Secondary indexes: maintenance on write, query rewrite, backfill.
+
+Ref model: library/query/secondary_index + index-table maintenance in the
+tablet write path.
+"""
+
+import pytest
+
+from ytsaurus_tpu import YtError
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.schema import TableSchema
+
+SCHEMA = TableSchema.make([
+    ("id", "int64", "ascending"), ("city", "string"), ("score", "int64")],
+    unique_keys=True)
+
+
+@pytest.fixture
+def client(tmp_path):
+    c = connect(str(tmp_path))
+    c.create("table", "//users", recursive=True,
+             attributes={"schema": SCHEMA, "dynamic": True})
+    c.mount_table("//users")
+    return c
+
+
+def test_backfill_and_query_rewrite(client):
+    client.insert_rows("//users", [
+        {"id": 1, "city": "spb", "score": 10},
+        {"id": 2, "city": "msk", "score": 20},
+        {"id": 3, "city": "spb", "score": 30}])
+    client.create_secondary_index("//users", "//users_by_city", ["city"])
+    # Index table backfilled with (city, id) keys.
+    assert client.select_rows(
+        "city, id FROM [//users_by_city]") == [
+        {"city": b"msk", "id": 2},
+        {"city": b"spb", "id": 1}, {"city": b"spb", "id": 3}]
+    # Query on the indexed column serves via the index.
+    rows = client.select_rows(
+        "id, score FROM [//users] WHERE city = 'spb'")
+    assert rows == [{"id": 1, "score": 10}, {"id": 3, "score": 30}]
+
+
+def test_index_maintained_on_writes(client):
+    client.create_secondary_index("//users", "//by_city", ["city"])
+    client.insert_rows("//users", [{"id": 1, "city": "spb", "score": 1}])
+    # Move the row to a new city: the stale entry must disappear.
+    client.insert_rows("//users", [{"id": 1, "city": "msk", "score": 2}])
+    assert client.select_rows("city, id FROM [//by_city]") == [
+        {"city": b"msk", "id": 1}]
+    assert client.select_rows(
+        "id FROM [//users] WHERE city = 'spb'") == []
+    assert client.select_rows(
+        "id FROM [//users] WHERE city = 'msk'") == [{"id": 1}]
+    # Partial (update-mode) write that does not touch the indexed column
+    # keeps the entry.
+    client.insert_rows("//users", [{"id": 1, "score": 99}], update=True)
+    assert client.select_rows(
+        "id, score FROM [//users] WHERE city = 'msk'") == [
+        {"id": 1, "score": 99}]
+    # Delete removes the index entry.
+    client.delete_rows("//users", [(1,)])
+    assert client.select_rows("city FROM [//by_city]") == []
+
+
+def test_index_on_numeric_range(client):
+    client.create_secondary_index("//users", "//by_score", ["score"])
+    client.insert_rows("//users", [
+        {"id": i, "city": "c", "score": i * 10} for i in range(8)])
+    rows = client.select_rows(
+        "id FROM [//users] WHERE score >= 30 AND score < 60")
+    assert rows == [{"id": 3}, {"id": 4}, {"id": 5}]
+
+
+def test_index_transactional_with_source(client):
+    """An aborted transaction leaves no index entries behind."""
+    client.create_secondary_index("//users", "//by_city", ["city"])
+    tx = client.start_transaction()
+    client.insert_rows("//users", [{"id": 5, "city": "kzn", "score": 5}],
+                       tx=tx)
+    client.abort_transaction(tx)
+    assert client.select_rows("city FROM [//by_city]") == []
+    assert client.lookup_rows("//users", [(5,)]) == [None]
+
+
+def test_multiple_writes_same_key_one_tx(client):
+    """Read-your-writes: two writes to one key in one transaction must not
+    leave a stale index entry for the intermediate value."""
+    client.create_secondary_index("//users", "//by_city", ["city"])
+    tx = client.start_transaction()
+    client.insert_rows("//users", [{"id": 1, "city": "aaa", "score": 1}],
+                       tx=tx)
+    client.insert_rows("//users", [{"id": 1, "city": "bbb", "score": 2}],
+                       tx=tx)
+    client.commit_transaction(tx)
+    assert client.select_rows("city, id FROM [//by_city]") == [
+        {"city": b"bbb", "id": 1}]
+    rows = client.select_rows("id FROM [//users] WHERE city >= 'aaa'")
+    assert rows == [{"id": 1}]
+
+
+def test_drop_index(client):
+    client.create_secondary_index("//users", "//by_city", ["city"])
+    client.drop_secondary_index("//users", "//by_city")
+    assert not client.exists("//by_city")
+    # Writes no longer maintain it; queries fall back to scans.
+    client.insert_rows("//users", [{"id": 1, "city": "spb", "score": 1}])
+    assert client.select_rows(
+        "id FROM [//users] WHERE city = 'spb'") == [{"id": 1}]
+
+
+def test_create_validates(client):
+    with pytest.raises(YtError):
+        client.create_secondary_index("//users", "//idx", ["nope"])
+    client.write_table("//static", [{"a": 1}])
+    with pytest.raises(YtError):
+        client.create_secondary_index("//static", "//idx", ["a"])

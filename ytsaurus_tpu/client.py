@@ -455,6 +455,13 @@ class YtClient:
         if format == "arrow":
             # Columnar fast path: planes → arrow arrays, no row walk.
             from ytsaurus_tpu.arrow import chunks_to_arrow_ipc
+            if not chunks:
+                schema = self._node_schema(self._table_node(path))
+                if schema is None:
+                    raise YtError(
+                        "arrow reads of an empty schemaless table need "
+                        "a schema", code=EErrorCode.QueryUnsupported)
+                chunks = [ColumnarChunk.from_rows(schema.to_unsorted(), [])]
             return chunks_to_arrow_ipc(chunks)
         rows: list[dict] = []
         for chunk in chunks:
@@ -466,7 +473,6 @@ class YtClient:
         if format == "skiff":
             from ytsaurus_tpu.formats import dumps_skiff
             if schema is None:
-                from ytsaurus_tpu.client import infer_schema
                 schema = infer_schema(rows)
             return dumps_skiff(rows, schema)
         from ytsaurus_tpu.formats import dumps_rows
@@ -718,6 +724,7 @@ class YtClient:
         return self.cluster.transactions.start()
 
     def commit_transaction(self, tx: TabletTransaction) -> int:
+        self._finalize_tx(tx)
         commit_ts = self.cluster.transactions.commit(tx)
         # Sync-replica checkpoints for writes staged under this caller-owned
         # transaction (kept on the tx so an abort advances nothing).
@@ -747,6 +754,11 @@ class YtClient:
         txm = self.cluster.transactions
         own = tx is None
         tx = tx or txm.start()
+        # Secondary-index rows ride the same transaction; the net mutation
+        # set is computed at commit (finalize_index_mutations).
+        from ytsaurus_tpu.tablet.secondary_index import record_index_intent
+        record_index_intent(self, tx, path, self._table_node(path),
+                            tablets[0].schema, list(rows), None, update)
         for idx, part in self._route_rows(path, tablets, list(rows)).items():
             txm.write_rows(tx, tablets[idx], part, update=update)
         # Sync replicas join the SAME 2PC commit (ref transaction.cpp:737
@@ -759,6 +771,7 @@ class YtClient:
                                             list(rows)).items():
                 txm.write_rows(tx, rtablets[idx], part, update=update)
         if own:
+            self._finalize_tx(tx)
             commit_ts = txm.commit(tx)
             self._advance_sync_checkpoints(path, sync_targets, commit_ts)
             return commit_ts
@@ -776,6 +789,9 @@ class YtClient:
         txm = self.cluster.transactions
         own = tx is None
         tx = tx or txm.start()
+        from ytsaurus_tpu.tablet.secondary_index import record_index_intent
+        record_index_intent(self, tx, path, self._table_node(path),
+                            tablets[0].schema, None, keys, False)
         for idx, part in self._route_rows(
                 path, tablets, keys).items():
             txm.delete_rows(tx, tablets[idx], part)
@@ -785,6 +801,7 @@ class YtClient:
             for idx, part in rc._route_rows(rpath, rtablets, keys).items():
                 txm.delete_rows(tx, rtablets[idx], part)
         if own:
+            self._finalize_tx(tx)
             commit_ts = txm.commit(tx)
             self._advance_sync_checkpoints(path, sync_targets, commit_ts)
             return commit_ts
@@ -838,6 +855,14 @@ class YtClient:
     def get_table_replicas(self, table_path: str) -> dict:
         from ytsaurus_tpu.tablet import replication as repl
         return repl.replica_descriptors(self, table_path)
+
+    def _finalize_tx(self, tx) -> None:
+        """Pre-commit hook: stage net secondary-index mutations recorded
+        under this transaction."""
+        from ytsaurus_tpu.tablet.secondary_index import (
+            finalize_index_mutations,
+        )
+        finalize_index_mutations(self, self.cluster.transactions, tx)
 
     def _sync_replica_targets(self, path: str):
         """(replica_id, replica_client, replica_path) for each enabled
@@ -933,8 +958,12 @@ class YtClient:
                 "read", join.foreign_table)
         from ytsaurus_tpu.query.pruning import extract_column_intervals
         intervals = extract_column_intervals(plan.where)
-        source_chunks = self._query_shards(plan.source, timestamp,
-                                           intervals=intervals, stats=stats)
+        source_chunks = self._indexed_source_chunks(plan, intervals,
+                                                    timestamp)
+        if source_chunks is None:
+            source_chunks = self._query_shards(plan.source, timestamp,
+                                               intervals=intervals,
+                                               stats=stats)
         foreign = {}
         for join in plan.joins:
             shards = self._query_shards(join.foreign_table, timestamp)
@@ -947,6 +976,44 @@ class YtClient:
         log_event(get_logger("Query"), _logging.INFO, "select_rows",
                   query=query[:200], **stats.to_dict())
         return out.to_rows()
+
+    def _indexed_source_chunks(self, plan, intervals, timestamp):
+        """Serve the scan from a secondary index when one applies (WHERE
+        bounds the index prefix); None → fall back to the shard scan.
+        Ref: secondary-index predicate rewrite."""
+        from ytsaurus_tpu.tablet.secondary_index import (
+            fetch_via_index,
+            pick_index,
+        )
+        try:
+            node = self._table_node(plan.source)
+        except YtError:
+            return None
+        if not node.attributes.get("dynamic"):
+            return None
+        desc = pick_index(node, intervals)
+        if desc is None:
+            return None
+        schema = self._node_schema(node)
+        try:
+            rows = fetch_via_index(self, plan.source, schema, desc,
+                                   intervals, timestamp)
+        except YtError:
+            return None
+        if rows is None:
+            return None
+        return [ColumnarChunk.from_rows(schema.to_unsorted(), rows)]
+
+    def create_secondary_index(self, table_path: str, index_path: str,
+                               columns: Sequence[str]) -> None:
+        from ytsaurus_tpu.tablet.secondary_index import create_secondary_index
+        create_secondary_index(self, table_path, index_path, columns)
+
+    def drop_secondary_index(self, table_path: str, index_path: str,
+                             remove_table: bool = True) -> None:
+        from ytsaurus_tpu.tablet.secondary_index import drop_secondary_index
+        drop_secondary_index(self, table_path, index_path,
+                             remove_table=remove_table)
 
     # ---------------------------------------------------------------- operations
 

@@ -222,15 +222,19 @@ def loads_skiff(data: bytes, schema) -> list[dict]:
     rows: list[dict] = []
     pos = 0
     n = len(data)
-    while pos < n:
-        if pos + 2 > n:
-            raise YtError("Truncated skiff row header",
+    def need(at: int, count: int, what: str) -> None:
+        if at + count > n:
+            raise YtError(f"Truncated skiff {what} at offset {at}",
                           code=EErrorCode.ChunkFormatError)
+
+    while pos < n:
+        need(pos, 2, "row header")
         (_table_index,) = _struct.unpack_from("<H", data, pos)
         pos += 2
         row: dict = {}
         for col in schema:
             if not _skiff_required(col):
+                need(pos, 1, f"variant tag of {col.name!r}")
                 tag = data[pos]
                 pos += 1
                 if tag == 0:
@@ -241,25 +245,27 @@ def loads_skiff(data: bytes, schema) -> list[dict]:
                                   code=EErrorCode.ChunkFormatError)
             ty = col.type
             if ty in (_EVT.int64, _EVT.uint64):
+                need(pos, 8, col.name)
                 (row[col.name],) = _struct.unpack_from(
                     "<q" if ty is _EVT.int64 else "<Q", data, pos)
                 pos += 8
             elif ty is _EVT.double:
+                need(pos, 8, col.name)
                 (row[col.name],) = _struct.unpack_from("<d", data, pos)
                 pos += 8
             elif ty is _EVT.boolean:
+                need(pos, 1, col.name)
                 row[col.name] = bool(data[pos])
                 pos += 1
-            elif ty is _EVT.string:
+            elif ty in (_EVT.string, _EVT.any):
+                need(pos, 4, f"length of {col.name!r}")
                 (length,) = _struct.unpack_from("<I", data, pos)
                 pos += 4
-                row[col.name] = bytes(data[pos:pos + length])
+                need(pos, length, f"payload of {col.name!r}")
+                payload = bytes(data[pos:pos + length])
                 pos += length
-            elif ty is _EVT.any:
-                (length,) = _struct.unpack_from("<I", data, pos)
-                pos += 4
-                row[col.name] = yson.loads(bytes(data[pos:pos + length]))
-                pos += length
+                row[col.name] = payload if ty is _EVT.string \
+                    else yson.loads(payload)
             else:
                 raise YtError(f"Skiff cannot decode type {ty.value!r}",
                               code=EErrorCode.QueryUnsupported)
