@@ -345,6 +345,13 @@ class YtClient:
                     referenced.update(sub)
                 state = node.attributes.get("ordered_state") or {}
                 referenced.update(state.get("chunk_ids", []))
+            # Operation snapshots root their per-stripe output chunks:
+            # revival after a controller death must still find them.
+            snap = node.attributes.get("snapshot")
+            if isinstance(snap, dict):
+                referenced.update(
+                    cid for cid in (snap.get("completed") or {}).values()
+                    if cid)
             stack.extend(node.children.values())
         for tablets in self.cluster.tablets.values():
             for tablet in tablets:

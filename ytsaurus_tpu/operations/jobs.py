@@ -66,6 +66,15 @@ class Job:
     stderr_tail: bytes = b""
     speculative_of: "Optional[Job]" = None
     on_done: Optional[Callable[["Job"], None]] = None
+    # Job splitter (ref job_splitter.h): returns child jobs covering this
+    # job's remaining input; the manager kills the straggler and settles
+    # it from the children's results (in index order).
+    splitter: "Optional[Callable[['Job'], list['Job']]]" = None
+    split_children: "Optional[list['Job']]" = None
+    # Split children run half-sized inputs: their durations must not feed
+    # the straggler median, or healthy full-size jobs start "straggling".
+    record_duration: bool = True
+    _split_pending: bool = False     # chosen for split; blocks speculation
     # live process handle for kill-based preemption/speculation-loss
     _proc: Optional[subprocess.Popen] = None
     _done: threading.Event = field(default_factory=threading.Event)
@@ -98,6 +107,7 @@ class JobManager:
         self._monitor: Optional[threading.Thread] = None
         self._stop = False
         self._completed_durations: dict[str, list[float]] = {}
+        self._split_parents: list[Job] = []
 
     # -- public ----------------------------------------------------------------
 
@@ -155,6 +165,13 @@ class JobManager:
             for job in self._running:
                 if job.op_id == op_id:
                     self._kill(job)
+            for parent in list(self._split_parents):
+                if parent.op_id == op_id:
+                    parent.state = "aborted"
+                    parent.error = YtError("operation aborted",
+                                           code=EErrorCode.Canceled)
+                    parent._done.set()
+                    self._split_parents.remove(parent)
             self._completed_durations.pop(op_id, None)
             self._lock.notify_all()
 
@@ -197,12 +214,26 @@ class JobManager:
                     names = {j.pool for j in self._pending + self._running}
                 self._refresh_pool_configs(names)   # outside the lock
                 last_refresh = now
+            to_split: list[Job] = []
+            settled: list[Job] = []
             with self._lock:
                 try:
+                    to_split = self._split_candidates_locked()
                     self._maybe_speculate_locked()
                     self._maybe_preempt_locked()
+                    settled = self._settle_splits_locked()
                 except Exception:   # noqa: BLE001 — monitor must survive
                     logger.exception("job monitor pass failed")
+            # User splitters and on_done observers may do RPCs/chunk IO —
+            # NEVER under the scheduling lock (every slot would stall).
+            for job in to_split:
+                self._perform_split(job)
+            for parent in settled:
+                if parent.on_done is not None:
+                    try:
+                        parent.on_done(parent)
+                    except Exception:   # noqa: BLE001 — observer boundary
+                        pass
 
     def _pool_states(self) -> "list[PoolState]":
         pools: dict[str, PoolState] = {}
@@ -290,13 +321,19 @@ class JobManager:
                 self._pending.append(job)
                 self._lock.notify_all()
                 return
+            if job._lost and job.split_children is not None:
+                # Killed FOR the split: stays unsettled until the children
+                # deliver (the monitor's settle pass owns it now).
+                job._proc = None
+                return
             if job._lost:
                 job.state = "aborted"
             elif ok:
                 job.state = "completed"
                 job.result = result
-                self._completed_durations.setdefault(job.op_id, []).append(
-                    duration)
+                if job.record_duration:
+                    self._completed_durations.setdefault(
+                        job.op_id, []).append(duration)
                 self._settle_speculation_locked(job)
             else:
                 job.state = "failed"
@@ -314,6 +351,92 @@ class JobManager:
         job._lost = True
         _kill_job_process(job)
 
+    # -- job splitting ---------------------------------------------------------
+
+    def _straggler_threshold(self, op_id: str) -> Optional[float]:
+        done = self._completed_durations.get(op_id) or []
+        if not done:
+            return None
+        median = sorted(done)[len(done) // 2]
+        return max(median * self.speculation_factor,
+                   self.min_speculation_seconds)
+
+    def _split_candidates_locked(self) -> "list[Job]":
+        """Stragglers eligible for a split (ref job_splitter.h).  Splitting
+        beats speculation when available: the duplicate would repeat ALL
+        the work, the split halves it.  The user splitter itself runs
+        OUTSIDE the lock (_perform_split)."""
+        now = time.monotonic()
+        out = []
+        for job in list(self._running):
+            if job.splitter is None or job.split_children is not None or \
+                    job._split_pending or not job.preemptible or \
+                    job.speculative_of is not None:
+                continue
+            if any(s.speculative_of is job
+                   for s in self._pending + self._running):
+                continue
+            threshold = self._straggler_threshold(job.op_id)
+            if threshold is None or now - job.started_at < threshold:
+                continue
+            job._split_pending = True     # blocks speculation meanwhile
+            out.append(job)
+        return out
+
+    def _perform_split(self, job: Job) -> None:
+        try:
+            children = job.splitter(job)
+        except Exception:   # noqa: BLE001 — splitter is user territory
+            logger.exception("job splitter failed for %s", job.id)
+            job.splitter = None
+            job._split_pending = False
+            return
+        if len(children) < 2:
+            job.splitter = None          # too small; speculation may apply
+            job._split_pending = False
+            return
+        for child in children:
+            child.record_duration = False
+        with self._lock:
+            # The job may have settled while the splitter ran.
+            if job._done.is_set() or job not in self._running or \
+                    job.split_children is not None:
+                return
+            logger.info("splitting job %s into %d children",
+                        job.id, len(children))
+            _profiler.counter("split").increment()
+            job.split_children = children
+            self._split_parents.append(job)
+            self._kill(job)      # unwinds unsettled; children settle it
+            self._pending.extend(children)
+            self._lock.notify_all()
+
+    def _settle_splits_locked(self) -> "list[Job]":
+        """A split parent completes when every child has; the first child
+        failure fails the parent.  Returns the settled parents — their
+        on_done observers fire OUTSIDE the lock."""
+        settled = []
+        for parent in list(self._split_parents):
+            children = parent.split_children or []
+            failed = next((c for c in children if c.state == "failed"),
+                          None)
+            if failed is not None:
+                parent.state = "failed"
+                parent.error = failed.error
+            elif all(c.state == "completed" for c in children):
+                parent.state = "completed"
+                result: list = []
+                for child in children:
+                    result.extend(child.result or [])
+                parent.result = result
+            else:
+                continue
+            self._split_parents.remove(parent)
+            parent._done.set()
+            self._lock.notify_all()
+            settled.append(parent)
+        return settled
+
     # -- speculation -----------------------------------------------------------
 
     def _maybe_speculate_locked(self) -> None:
@@ -322,18 +445,14 @@ class JobManager:
         wins, ref speculative_job_manager.h)."""
         now = time.monotonic()
         for job in list(self._running):
-            if not job.preemptible or job.speculative_of is not None:
+            if not job.preemptible or job.speculative_of is not None or \
+                    job.split_children is not None or job._split_pending:
                 continue
             if any(s.speculative_of is job
                    for s in self._pending + self._running):
                 continue
-            done = self._completed_durations.get(job.op_id) or []
-            if not done:
-                continue
-            median = sorted(done)[len(done) // 2]
-            threshold = max(median * self.speculation_factor,
-                            self.min_speculation_seconds)
-            if now - job.started_at < threshold:
+            threshold = self._straggler_threshold(job.op_id)
+            if threshold is None or now - job.started_at < threshold:
                 continue
             twin = Job(op_id=job.op_id, index=job.index, run=job.run,
                        pool=job.pool, preemptible=True,

@@ -101,6 +101,40 @@ class OperationScheduler:
     def list_operations(self) -> list[Operation]:
         return list(self._operations.values())
 
+    def revive_operations(self) -> list[Operation]:
+        """Re-run operations a dead controller left pending/running (ref
+        revival from snapshots, snapshot_downloader.cpp).  Command-job map
+        operations resume from their @snapshot (completed stripes skipped);
+        other types re-run deterministically from the recorded spec.
+        Python-callable mappers cannot revive — the callable is not
+        serializable — and fail with the normal spec error."""
+        revived = []
+        if not self.client.exists("//sys/operations"):
+            return revived
+        for op_id in self.client.list("//sys/operations"):
+            doc = f"//sys/operations/{op_id}"
+            with self._lock:
+                if op_id in self._operations:
+                    continue     # live in THIS controller; not orphaned
+            try:
+                if self.client.get(doc + "/@state") not in (
+                        "pending", "running"):
+                    continue
+                op = Operation(
+                    id=op_id,
+                    type=self.client.get(doc + "/@operation_type"),
+                    spec=dict(self.client.get(doc + "/@spec")))
+            except YtError:
+                continue
+            with self._lock:
+                self._operations[op.id] = op
+            try:
+                self._run(op)
+            except YtError:
+                pass        # state recorded on the op; caller inspects
+            revived.append(op)
+        return revived
+
     # -- lifecycle -------------------------------------------------------------
 
     def _run(self, op: Operation) -> None:
@@ -145,6 +179,71 @@ class OperationScheduler:
 
 def _clean_spec(spec: dict) -> dict:
     return {k: v for k, v in spec.items() if not callable(v)}
+
+
+class _Snapshot:
+    """Operation progress snapshot (ref controller snapshots via
+    fork+Phoenix, controller_agent/snapshot_builder.cpp:177 — redesigned:
+    no fork; per-stripe outputs persist as ordinary chunks and the
+    completed-set lives under //sys/operations/<id>/@snapshot, so revival
+    is a plan-match + skip, not a process-image restore)."""
+
+    def __init__(self, client, op_id: str, plan: dict):
+        self.client = client
+        self.doc = f"//sys/operations/{op_id}"
+        self.path = self.doc + "/@snapshot"
+        self.plan = plan
+        self._lock = threading.Lock()
+
+    def load(self) -> "dict[int, str]":
+        """Completed stripe index → output chunk id, iff the recorded plan
+        matches the deterministic re-plan (inputs unchanged)."""
+        if not self.client.exists(self.doc) or \
+                not self.client.exists(self.path):
+            return {}
+        snap = self.client.get(self.path)
+        if snap.get("plan") != self.plan:
+            return {}
+        return {int(k): v for k, v in (snap.get("completed") or {}).items()}
+
+    def record(self, index: int, rows: list) -> None:
+        from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+        from ytsaurus_tpu.client import infer_schema
+        chunk_id = ""
+        if rows:
+            chunk = ColumnarChunk.from_rows(infer_schema(rows), rows)
+            chunk_id = self.client.cluster.chunk_store.write_chunk(chunk)
+        with self._lock:
+            snap = self.client.get(self.path) \
+                if self.client.exists(self.path) else {}
+            if snap.get("plan") != self.plan:
+                snap = {"plan": self.plan, "completed": {}}
+            snap.setdefault("completed", {})[str(index)] = chunk_id
+            self.client.set(self.path, snap)
+
+    def read_output(self, chunk_id: str) -> list:
+        if not chunk_id:
+            return []
+        return self.client.cluster.chunk_store.read_chunk(chunk_id).to_rows()
+
+    def clear(self) -> None:
+        """Drop the snapshot + its chunks once the output is published.
+        Snapshot state is system-owned (like the records themselves)."""
+        from ytsaurus_tpu.cypress.security import (
+            ROOT_USER,
+            authenticated_user,
+        )
+        if not self.client.exists(self.path):
+            return
+        snap = self.client.get(self.path)
+        for chunk_id in (snap.get("completed") or {}).values():
+            if chunk_id:
+                try:
+                    self.client.cluster.chunk_store.remove_chunk(chunk_id)
+                except YtError:
+                    pass
+        with authenticated_user(ROOT_USER):
+            self.client.remove(self.path, force=True)
 
 
 # -- controllers ---------------------------------------------------------------
@@ -214,7 +313,7 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     on the shared JobManager under spec["pool"] fair share; stragglers
     get speculative twins (command jobs)."""
     from ytsaurus_tpu.formats import dumps_rows, loads_rows
-    from ytsaurus_tpu.operations.chunk_pools import build_stripes
+    from ytsaurus_tpu.operations.chunk_pools import build_stripes, split_stripe
     from ytsaurus_tpu.operations.jobs import Job, run_command_job
 
     mapper: Optional[Callable] = spec.get("mapper")
@@ -226,6 +325,12 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     fmt = spec.get("format", "json")
     pool = spec.get("pool", "default")
     chunks = client._read_table_chunks(input_path)
+    input_node = client._table_node(input_path)
+    input_chunk_ids = list(input_node.attributes.get("chunk_ids", []))
+    # Snapshots are plan-keyed by the input chunk list; dynamic tables
+    # have no stable chunk list, so their operations restart from scratch
+    # on revival rather than risk stale per-stripe outputs.
+    snapshot_ok = not input_node.attributes.get("dynamic")
     rows_per_job = spec.get("rows_per_job")
     if rows_per_job is None and spec.get("job_count"):
         total = sum(c.row_count for c in chunks)
@@ -238,6 +343,17 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
         client.write_table(output_path, [],
                            schema=spec.get("output_schema"))
         return {"rows": 0, "jobs": 0}
+
+    op_id = op.id if op is not None else uuid.uuid4().hex
+    # Controller snapshot (ref fork+Phoenix operation snapshots,
+    # snapshot_builder.cpp): per-stripe outputs persist as chunks under
+    # @snapshot so a revived operation skips completed work.  Valid only
+    # while the deterministic stripe plan matches (input chunks + split).
+    snap = _Snapshot(client, op_id, plan={
+        "input_chunk_ids": input_chunk_ids,
+        "stripe_count": len(stripes)}) \
+        if command is not None and snapshot_ok else None
+    completed_outputs = snap.load() if snap is not None else {}
 
     def make_run(stripe):
         if mapper is not None:
@@ -252,21 +368,46 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
             return loads_rows(out, fmt)
         return run_cmd, True
 
-    op_id = op.id if op is not None else uuid.uuid4().hex
+    def make_splitter(stripe):
+        """Straggler split (ref job_splitter.h): halve the stripe, same
+        command, children settle the parent (command jobs only)."""
+        def split(parent):
+            halves = split_stripe(stripe)
+            if len(halves) < 2:
+                return []
+            children = []
+            for h, half in enumerate(halves):
+                run, _ = make_run(half)
+                children.append(Job(
+                    op_id=op_id, index=parent.index, run=run, pool=pool,
+                    preemptible=True, splitter=make_splitter(half)))
+            return children
+        return split
+
+    total = len(stripes)
     if op is not None:
-        op.progress = {"total": len(stripes), "completed": 0}
+        op.progress = {"total": total,
+                       "completed": len(completed_outputs)}
 
     def on_done(job) -> None:
         # Live progress: clients polling get_operation see jobs land as
         # they finish, not a 0→N jump at the end.
-        if op is not None and job.state == "completed":
+        if job.state != "completed":
+            return
+        if op is not None:
             op.progress["completed"] = op.progress.get("completed", 0) + 1
+        if snap is not None:
+            snap.record(job.index, job.result or [])
 
     jobs = []
     for i, stripe in enumerate(stripes):
+        if i in completed_outputs:
+            continue
         run, preemptible = make_run(stripe)
         jobs.append(Job(op_id=op_id, index=i, run=run, pool=pool,
-                        preemptible=preemptible, on_done=on_done))
+                        preemptible=preemptible, on_done=on_done,
+                        splitter=make_splitter(stripe)
+                        if command is not None else None))
     job_manager.submit(jobs)
     try:
         job_manager.wait(jobs)
@@ -275,12 +416,19 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
         raise
     finally:
         job_manager.finish_operation(op_id)
+    by_index = {job.index: (job.result or []) for job in jobs}
     out_rows: list[dict] = []
-    for job in jobs:
-        out_rows.extend(job.result or [])
+    for i in range(total):
+        if i in by_index:
+            out_rows.extend(by_index[i])
+        else:
+            out_rows.extend(snap.read_output(completed_outputs[i]))
     schema = spec.get("output_schema")
     client.write_table(output_path, out_rows, schema=schema)
-    return {"rows": len(out_rows), "jobs": len(jobs)}
+    if snap is not None:
+        snap.clear()
+    return {"rows": len(out_rows), "jobs": len(jobs),
+            "revived_jobs": len(completed_outputs)}
 
 
 def _erase_controller(client, spec: dict, op=None, job_manager=None) -> dict:
