@@ -20,7 +20,7 @@ from typing import Any, Optional
 
 from ytsaurus_tpu import yson
 from ytsaurus_tpu.cypress.tree import CypressTree
-from ytsaurus_tpu.errors import YtError
+from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.utils.varint import encode_varint_u, read_varint_u
 
 
@@ -105,7 +105,7 @@ class Master:
     # -- mutation pipeline -----------------------------------------------------
 
     _MUTATIONS = ("create", "remove", "set", "copy", "move", "link",
-                  "tx_start", "tx_commit", "tx_abort", "lock")
+                  "tx_start", "tx_commit", "tx_abort", "lock", "batch")
     _TREE_MUTATIONS = ("create", "remove", "set", "copy", "move", "link")
 
     def commit_mutation(self, op: str, **args) -> Any:
@@ -143,6 +143,20 @@ class Master:
             return result
 
     def _apply(self, op: str, args: dict) -> Any:
+        if op == "batch":
+            # One WAL record applying several tree ops atomically — the
+            # carrier for Hive message application (handler effects + the
+            # last-applied bump must land together for exactly-once).
+            # Sub-ops are restricted to the simple tree verbs whose only
+            # failure mode is resolution, checked up front.
+            ops = args["ops"]
+            for sub in ops:
+                if sub["op"] not in ("create", "set", "remove"):
+                    raise YtError(
+                        f"batch sub-op {sub['op']!r} not allowed",
+                        code=EErrorCode.Generic)
+            return [self._apply(sub["op"], dict(sub["args"]))
+                    for sub in ops]
         # Transaction lifecycle + lock mutations (ref: transaction_server
         # master transactions riding the same Hydra mutation pipeline).
         if op == "tx_start":
