@@ -1,0 +1,72 @@
+"""CHYT-analog SQL dialect: translation + execution via query tracker.
+
+Ref model: yt/chyt (ClickHouse SQL over YT tables) served through the
+query tracker's engine registry (server/query_tracker/chyt_engine.cpp).
+"""
+
+import pytest
+
+from ytsaurus_tpu import YtError
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.ecosystem.sql import translate_sql
+from ytsaurus_tpu.server.query_tracker import QueryTracker
+
+
+def test_translate_basics():
+    assert translate_sql('SELECT a, b FROM "//t" WHERE a <> 2') == \
+        "a, b FROM [//t] WHERE a != 2"
+    assert translate_sql("SELECT * FROM `//dir/t` LIMIT 5") == \
+        "* FROM [//dir/t] LIMIT 5"
+    assert translate_sql("SELECT x FROM t ORDER BY x DESC "
+                         "LIMIT 10 OFFSET 20") == \
+        "x FROM [//t] ORDER BY x DESC OFFSET 20 LIMIT 10"
+    assert translate_sql(
+        'SELECT uniq(u) AS c FROM "//t" GROUP BY g;') == \
+        "cardinality (u) AS c FROM [//t] GROUP BY g"
+    # ANSI double-quoted identifiers outside FROM become bare names.
+    assert translate_sql('SELECT "weird name" FROM [//t]') == \
+        "weird name FROM [//t]"
+
+
+def test_sql_execution(tmp_path):
+    client = connect(str(tmp_path))
+    client.write_table("//sales", [
+        {"region": "eu", "amount": 10},
+        {"region": "us", "amount": 20},
+        {"region": "eu", "amount": 30}])
+    qt = QueryTracker(client)
+    qid = qt.start_query(
+        'SELECT region, sum(amount) AS total FROM "//sales" '
+        "GROUP BY region ORDER BY region ASC LIMIT 10",
+        engine="chyt", sync=True)
+    assert qt.read_query_result(qid) == [
+        {"region": b"eu", "total": 40}, {"region": b"us", "total": 20}]
+    # Alias engine name.
+    qid2 = qt.start_query(
+        "SELECT region, count(*) AS n FROM `//sales` GROUP BY region "
+        "ORDER BY region ASC LIMIT 5", engine="sql", sync=True)
+    assert qt.read_query_result(qid2) == [
+        {"region": b"eu", "n": 2}, {"region": b"us", "n": 1}]
+
+
+def test_sql_join(tmp_path):
+    client = connect(str(tmp_path))
+    client.write_table("//facts", [{"k": 1, "g": 0}, {"k": 2, "g": 1}])
+    client.write_table("//dims", [{"g": 0, "name": "even"},
+                                  {"g": 1, "name": "odd"}])
+    qt = QueryTracker(client)
+    qid = qt.start_query(
+        'SELECT k, name FROM "//facts" JOIN "//dims" USING g '
+        "ORDER BY k ASC LIMIT 10", engine="chyt", sync=True)
+    assert qt.read_query_result(qid) == [
+        {"k": 1, "name": b"even"}, {"k": 2, "name": b"odd"}]
+
+
+def test_sql_errors_surface(tmp_path):
+    client = connect(str(tmp_path))
+    qt = QueryTracker(client)
+    qid = qt.start_query("SELECT ~~~ nonsense", engine="chyt", sync=True)
+    record = qt.get_query(qid)
+    assert record["state"] == "failed"
+    with pytest.raises(YtError):
+        qt.read_query_result(qid)

@@ -1,0 +1,164 @@
+"""ANSI/ClickHouse-flavored SQL over YT tables — the CHYT analog.
+
+Ref mapping (yt/chyt):
+  CHYT accepts ClickHouse SQL over YT tables     → translate_sql rewrites
+  (`SELECT ... FROM "//path"`), converting          the dialect onto the
+  schemas/blocks into the CH engine                 native QL engine (the
+  (chyt/server/conversion.h)                        columnar XLA backend
+                                                    IS the vectorized
+                                                    engine here, so no
+                                                    second execution
+                                                    engine is embedded)
+  query dispatch via Query Tracker engines       → registered as engine
+  (server/query_tracker/chyt_engine.cpp)           "chyt" / alias "sql"
+
+Dialect deltas handled:
+  SELECT * / SELECT cols FROM "//path" | `//path` | [//path]
+  ANSI double-quoted / backticked identifiers → bare identifiers
+  <>  → !=            (inequality)
+  CH aggregate names  → native (uniq/uniqExact → cardinality, any → first)
+  LIMIT n OFFSET m    → OFFSET m LIMIT n (QL clause order)
+Strings must use single quotes (ANSI); double quotes always mean
+identifiers, exactly like ClickHouse's default dialect.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+_TOKEN = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<string>'(?:\\.|[^'\\])*')
+  | (?P<dquote>"(?:[^"\\]|\\.)*")
+  | (?P<btick>`[^`]*`)
+  | (?P<bracket>\[[^\]]*\])
+  | (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?u?)
+  | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
+  | (?P<op><>|<=|>=|!=|\|\||[-+*/%(),=<>.])
+""", re.VERBOSE)
+
+_AGG_RENAMES = {
+    "uniq": "cardinality",
+    "uniqexact": "cardinality",
+    "any": "first",
+}
+
+_TABLE_KEYWORDS = {"from", "join"}
+
+
+def _tokens(text: str):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise YtError(f"SQL: cannot tokenize at {text[pos:pos + 20]!r}",
+                          code=EErrorCode.QueryParseError)
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        yield kind, m.group()
+
+
+def translate_sql(sql: str) -> str:
+    """ClickHouse/ANSI-flavored SELECT → native QL text."""
+    out: list[str] = []
+    expecting_table = False
+    limit_value = None
+    offset_value = None
+    state = "normal"
+    for kind, tok in _tokens(sql.strip().rstrip(";")):
+        low = tok.lower()
+        if state == "limit" and kind == "num":
+            limit_value = tok
+            state = "normal"
+            continue
+        if state == "offset" and kind == "num":
+            offset_value = tok
+            state = "normal"
+            continue
+        if kind == "word" and low == "limit":
+            state = "limit"
+            continue
+        if kind == "word" and low == "offset":
+            state = "offset"
+            continue
+        if expecting_table:
+            out.append(_table_ref(kind, tok))
+            expecting_table = False
+            continue
+        if kind == "word" and low in _TABLE_KEYWORDS:
+            out.append(tok)
+            expecting_table = True
+            continue
+        if kind == "dquote":
+            # ANSI: double quotes are identifiers.
+            out.append(tok[1:-1])
+            continue
+        if kind == "btick":
+            out.append(tok[1:-1])
+            continue
+        if kind == "op" and tok == "<>":
+            out.append("!=")
+            continue
+        if kind == "word" and low in _AGG_RENAMES:
+            out.append(_AGG_RENAMES[low])
+            continue
+        out.append(tok)
+    ql = _respace(out)
+    if ql.lower().startswith("select "):
+        ql = ql[len("select "):]
+    # QL clause order: ... OFFSET m LIMIT n.
+    if offset_value is not None:
+        ql += f" OFFSET {offset_value}"
+    if limit_value is not None:
+        ql += f" LIMIT {limit_value}"
+    return ql
+
+
+def _table_ref(kind: str, tok: str) -> str:
+    if kind == "bracket":
+        return tok                       # already QL form
+    if kind == "dquote" or kind == "btick":
+        return f"[{tok[1:-1]}]"
+    if kind == "word":
+        # Bare identifier: treat as an absolute cypress path component
+        # under the root ("FROM my_table" → [//my_table], matching CHYT's
+        # default-database-as-directory mapping).
+        path = tok if tok.startswith("//") else f"//{tok}"
+        return f"[{path}]"
+    if kind == "string":
+        return f"[{tok[1:-1]}]"
+    raise YtError(f"SQL: bad table reference {tok!r}",
+                  code=EErrorCode.QueryParseError)
+
+
+_NO_SPACE_BEFORE = {",", ")", "."}
+_NO_SPACE_AFTER = {"(", "."}
+
+
+def _respace(tokens: "list[str]") -> str:
+    parts: list[str] = []
+    prev = ""
+    for tok in tokens:
+        if parts and tok not in _NO_SPACE_BEFORE and \
+                prev not in _NO_SPACE_AFTER:
+            parts.append(" ")
+        parts.append(tok)
+        prev = tok
+    return "".join(parts)
+
+
+def execute_sql(client, sql: str) -> "list[dict]":
+    return client.select_rows(translate_sql(sql))
+
+
+def register() -> None:
+    from ytsaurus_tpu.server.query_tracker import register_engine
+    register_engine("chyt", execute_sql)
+    register_engine("sql", execute_sql)
+
+
+register()

@@ -325,12 +325,19 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     fmt = spec.get("format", "json")
     pool = spec.get("pool", "default")
     chunks = client._read_table_chunks(input_path)
-    input_node = client._table_node(input_path)
-    input_chunk_ids = list(input_node.attributes.get("chunk_ids", []))
+
+    def attr(name, default):
+        try:
+            return client.get(f"{input_path}/@{name}")
+        except YtError:
+            return default
+
+    input_chunk_ids = list(attr("chunk_ids", []))
     # Snapshots are plan-keyed by the input chunk list; dynamic tables
     # have no stable chunk list, so their operations restart from scratch
-    # on revival rather than risk stale per-stripe outputs.
-    snapshot_ok = not input_node.attributes.get("dynamic")
+    # on revival rather than risk stale per-stripe outputs.  Remote thin
+    # clients have no direct chunk store either — local controllers only.
+    snapshot_ok = not attr("dynamic", False) and hasattr(client, "cluster")
     rows_per_job = spec.get("rows_per_job")
     if rows_per_job is None and spec.get("job_count"):
         total = sum(c.row_count for c in chunks)
