@@ -1,0 +1,66 @@
+"""Tablet balancer: automatic reshard by size.
+
+Ref model: server/tablet_balancer + partition sample keys
+(tablet_node/partition.h) — split oversized tablets, merge tiny ones, at
+quantile pivots over live keys.
+"""
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.tablet.balancer import TabletBalancer
+
+SCHEMA = TableSchema.make([
+    ("k", "int64", "ascending"), ("v", "int64")], unique_keys=True)
+
+
+@pytest.fixture
+def client(tmp_path):
+    return connect(str(tmp_path))
+
+
+def make_table(client, path, n_rows, desired=100):
+    client.create("table", path, recursive=True,
+                  attributes={"schema": SCHEMA, "dynamic": True,
+                              "desired_tablet_row_count": desired})
+    client.mount_table(path)
+    client.insert_rows(path, [{"k": i, "v": i} for i in range(n_rows)])
+
+
+def test_split_oversized_tablet(client):
+    make_table(client, "//t", 400, desired=100)
+    balancer = TabletBalancer(client)
+    assert balancer.needs_balancing("//t")
+    assert balancer.balance_table("//t") is True
+    counts = balancer.tablet_row_counts("//t")
+    assert len(counts) == 4
+    assert all(50 <= c <= 200 for c in counts)
+    # Data intact across the reshard.
+    assert client.select_rows("k FROM [//t] WHERE k = 399") == [{"k": 399}]
+    assert sum(counts) == 400
+    # Balanced now: no further reshard.
+    assert balancer.balance_table("//t") is False
+
+
+def test_merge_tiny_tablets(client):
+    make_table(client, "//t", 40, desired=100)
+    client.unmount_table("//t")
+    client.reshard_table("//t", [(10,), (20,), (30,)])
+    client.mount_table("//t")
+    balancer = TabletBalancer(client)
+    assert balancer.needs_balancing("//t")
+    assert balancer.balance_table("//t") is True
+    assert len(balancer.tablet_row_counts("//t")) == 1
+    assert client.lookup_rows("//t", [(35,)]) == [{"k": 35, "v": 35}]
+
+
+def test_step_respects_opt_out(client):
+    make_table(client, "//busy", 400, desired=100)
+    make_table(client, "//frozen", 400, desired=100)
+    client.set("//frozen/@enable_tablet_balancer", False)
+    balancer = TabletBalancer(client)
+    out = balancer.step()
+    assert out["//busy"] is True
+    assert "//frozen" not in out
+    assert len(balancer.tablet_row_counts("//frozen")) == 1

@@ -1011,6 +1011,50 @@ class YtClient:
             return None
         return [ColumnarChunk.from_rows(schema.to_unsorted(), rows)]
 
+    def backup_table(self, src_path: str, dst_path: str,
+                     timestamp: Optional[int] = None) -> None:
+        """Consistent backup of a dynamic table as of `timestamp` (default
+        now): versions newer than the cutoff are excluded, timestamps are
+        PRESERVED so a restored table serves the same MVCC reads.
+
+        Ref: backup_manager (tablet_node/backup_manager.h) — checkpoint
+        timestamp + per-tablet clipped stores; here the clip is a
+        vectorized filter over the versioned snapshot planes."""
+        from ytsaurus_tpu.tablet.tablet import (
+            _versioned_sort_key,
+            versioned_schema,
+        )
+        tablets = self._mounted_tablets(src_path)
+        self._require_sorted(tablets[0], src_path)
+        schema = tablets[0].schema
+        cutoff = timestamp if timestamp is not None else \
+            self.cluster.transactions.timestamps.generate()
+        node = self._table_node(src_path)
+        pivots = [list(p) for p in node.attributes.get("pivot_keys", [])]
+        self.create("table", dst_path, recursive=True,
+                    attributes={"schema": schema, "dynamic": True,
+                                "pivot_keys": pivots,
+                                "backup_timestamp": cutoff})
+        per_tablet_chunks: list[list[str]] = []
+        vschema = versioned_schema(schema)
+        for tablet in tablets:
+            rows = [r for r in tablet.versioned_rows_snapshot()
+                    if r["$timestamp"] <= cutoff]
+            rows.sort(key=_versioned_sort_key(schema))
+            if rows:
+                chunk = ColumnarChunk.from_rows(vschema, rows)
+                per_tablet_chunks.append(
+                    [self.cluster.chunk_store.write_chunk(chunk)])
+            else:
+                per_tablet_chunks.append([])
+        self.set(dst_path + "/@tablet_chunk_ids", per_tablet_chunks)
+        self.set(dst_path + "/@tablet_state", "unmounted")
+
+    def restore_table_backup(self, backup_path: str, dst_path: str) -> None:
+        """Materialize a backup as a fresh dynamic table (chunks COPY so
+        the restored table's lifecycle is independent of the backup's)."""
+        self.copy(backup_path, dst_path, recursive=True)
+
     def create_secondary_index(self, table_path: str, index_path: str,
                                columns: Sequence[str]) -> None:
         from ytsaurus_tpu.tablet.secondary_index import create_secondary_index

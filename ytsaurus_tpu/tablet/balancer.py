@@ -1,0 +1,111 @@
+"""Tablet balancer: keep tablet sizes bounded by automatic resharding.
+
+Ref mapping:
+  server/tablet_balancer (+ master-side     → TabletBalancer.step scans
+  tablet_manager reshard actions)             mounted sorted dynamic
+                                              tables and reshards the
+                                              unbalanced ones
+  partition sample keys                      → pivot selection samples row
+  (tablet_node/partition.h:39-49)             keys from tablet snapshots
+                                              and cuts at row-count
+                                              quantiles
+  @enable_tablet_balancer / desired sizes    → same attributes here
+  (bundle/tablet config)
+
+Design delta: resharding is the existing pivot-rewrite path (unmount →
+reshard → remount), so balancing is a policy loop over row-count stats,
+not a separate data mover.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ytsaurus_tpu.errors import YtError
+
+DEFAULT_DESIRED_ROWS = 1_000_000
+
+
+class TabletBalancer:
+    def __init__(self, client,
+                 desired_tablet_rows: int = DEFAULT_DESIRED_ROWS):
+        self.client = client
+        self.desired_tablet_rows = desired_tablet_rows
+
+    def _table_desired(self, node) -> int:
+        return int(node.attributes.get("desired_tablet_row_count")
+                   or self.desired_tablet_rows)
+
+    def tablet_row_counts(self, path: str) -> list[int]:
+        return [t.read_snapshot().row_count
+                for t in self.client._mounted_tablets(path)]
+
+    def needs_balancing(self, path: str) -> bool:
+        """Split-worthy: a tablet over 2x desired; merge-worthy: two
+        adjacent tablets together under half the desired size."""
+        node = self.client._table_node(path)
+        desired = self._table_desired(node)
+        counts = self.tablet_row_counts(path)
+        if any(c > 2 * desired for c in counts):
+            return True
+        return any(counts[i] + counts[i + 1] < desired // 2
+                   for i in range(len(counts) - 1))
+
+    def compute_pivots(self, path: str, desired: int) -> list[tuple]:
+        """Quantile pivots over the live keys (sample-key analog)."""
+        tablets = self.client._mounted_tablets(path)
+        key_names = tablets[0].schema.key_column_names
+        keys: list[tuple] = []
+        for tablet in tablets:
+            chunk = tablet.read_snapshot()
+            rows = chunk.to_rows()
+            keys.extend(tuple(r[n] for n in key_names) for r in rows)
+        keys.sort()
+        total = len(keys)
+        if total == 0:
+            return []
+        n_tablets = max(-(-total // desired), 1)
+        pivots = []
+        for i in range(1, n_tablets):
+            pivot = keys[i * total // n_tablets]
+            if not pivots or pivot > pivots[-1]:
+                pivots.append(pivot)
+        return pivots
+
+    def balance_table(self, path: str) -> bool:
+        """Reshard one table if unbalanced.  Returns True when resharded."""
+        node = self.client._table_node(path)
+        if not self.needs_balancing(path):
+            return False
+        desired = self._table_desired(node)
+        pivots = self.compute_pivots(path, desired)
+        self.client.unmount_table(path)
+        try:
+            self.client.reshard_table(path, pivots)
+        finally:
+            self.client.mount_table(path)
+        return True
+
+    def step(self) -> dict:
+        """One balancer pass over every mounted sorted dynamic table with
+        balancing enabled (@enable_tablet_balancer, default True)."""
+        out = {}
+        stack = [("/", self.client.cluster.master.tree.root)]
+        while stack:
+            path, node = stack.pop()
+            for name, child in node.children.items():
+                stack.append((f"/{path.rstrip('/')}/{name}", child))
+            if node.type != "table" or \
+                    not node.attributes.get("dynamic") or \
+                    node.attributes.get("tablet_state") != "mounted":
+                continue
+            if node.attributes.get("enable_tablet_balancer") is False:
+                continue
+            try:
+                tablets = self.client.cluster.tablets.get(node.id)
+                if not tablets or not tablets[0].schema.is_sorted:
+                    continue
+                out[path] = self.balance_table(path)
+            except YtError as err:
+                out[path] = str(err)
+        return out
