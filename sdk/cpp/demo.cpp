@@ -1,0 +1,47 @@
+// SDK demo/integration binary: exercised by tests/test_cpp_sdk.py against
+// a live LocalCluster HTTP proxy.  Exit 0 = every check passed.
+#include "yt_client.hpp"
+
+#include <cstdlib>
+#include <iostream>
+
+int main(int argc, char** argv) {
+    if (argc != 3) {
+        std::cerr << "usage: demo <host> <port>\n";
+        return 2;
+    }
+    try {
+        yt_tpu::Client client(argv[1], std::atoi(argv[2]));
+
+        std::string commands = client.ListCommands();
+        if (commands.find("select_rows") == std::string::npos) {
+            std::cerr << "command registry missing select_rows\n";
+            return 1;
+        }
+
+        client.Create("map_node", "//from_cpp");
+        if (!client.Exists("//from_cpp")) {
+            std::cerr << "created node does not exist\n";
+            return 1;
+        }
+        client.Set("//from_cpp/@origin", "\"cpp-sdk\"");
+
+        client.WriteTable("//from_cpp/t",
+                          "[{\"k\": 1, \"v\": 10},"
+                          " {\"k\": 2, \"v\": 20},"
+                          " {\"k\": 3, \"v\": 30}]");
+        std::string rows =
+            client.SelectRows("k, v FROM [//from_cpp/t] WHERE k >= 2");
+        if (rows.find("\"k\": 2") == std::string::npos &&
+            rows.find("\"k\":2") == std::string::npos) {
+            std::cerr << "select result missing k=2: " << rows << "\n";
+            return 1;
+        }
+        std::string all = client.ReadTable("//from_cpp/t");
+        std::cout << "SDK OK " << all << "\n";
+        return 0;
+    } catch (const std::exception& err) {
+        std::cerr << "SDK FAILED: " << err.what() << "\n";
+        return 1;
+    }
+}

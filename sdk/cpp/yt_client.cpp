@@ -1,0 +1,193 @@
+// C++ SDK implementation: HTTP/1.1 over POSIX sockets, no dependencies.
+#include "yt_client.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+
+namespace yt_tpu {
+
+namespace {
+
+class Socket {
+public:
+    explicit Socket(int fd) : fd_(fd) {}
+    ~Socket() { if (fd_ >= 0) ::close(fd_); }
+    Socket(const Socket&) = delete;
+    Socket& operator=(const Socket&) = delete;
+    int fd() const { return fd_; }
+
+private:
+    int fd_;
+};
+
+int ConnectTo(const std::string& host, int port) {
+    struct addrinfo hints;
+    std::memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    const std::string service = std::to_string(port);
+    if (getaddrinfo(host.c_str(), service.c_str(), &hints, &res) != 0) {
+        throw YtError(0, "cannot resolve " + host);
+    }
+    int fd = -1;
+    for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
+        fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+        ::close(fd);
+        fd = -1;
+    }
+    freeaddrinfo(res);
+    if (fd < 0) {
+        throw YtError(0, "cannot connect to " + host + ":" + service);
+    }
+    return fd;
+}
+
+void SendAll(int fd, const std::string& data) {
+    size_t sent = 0;
+    while (sent < data.size()) {
+        ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, 0);
+        if (n <= 0) throw YtError(0, "send failed");
+        sent += static_cast<size_t>(n);
+    }
+}
+
+std::string RecvUntilClosedOrLength(int fd) {
+    std::string buf;
+    char chunk[4096];
+    ssize_t n;
+    size_t header_end = std::string::npos;
+    size_t content_length = std::string::npos;
+    while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) {
+        buf.append(chunk, static_cast<size_t>(n));
+        if (header_end == std::string::npos) {
+            header_end = buf.find("\r\n\r\n");
+            if (header_end != std::string::npos) {
+                // Parse Content-Length from the headers (the proxy always
+                // sends it).
+                std::string headers = buf.substr(0, header_end);
+                for (auto& c : headers) c = static_cast<char>(tolower(c));
+                auto pos = headers.find("content-length:");
+                if (pos != std::string::npos) {
+                    content_length = static_cast<size_t>(
+                        std::stoul(headers.substr(pos + 15)));
+                }
+            }
+        }
+        if (header_end != std::string::npos &&
+            content_length != std::string::npos &&
+            buf.size() >= header_end + 4 + content_length) {
+            break;
+        }
+    }
+    return buf;
+}
+
+}  // namespace
+
+std::string JsonQuote(const std::string& raw) {
+    std::string out = "\"";
+    for (char c : raw) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            default:
+                if (static_cast<unsigned char>(c) < 0x20) {
+                    char esc[8];
+                    std::snprintf(esc, sizeof(esc), "\\u%04x", c);
+                    out += esc;
+                } else {
+                    out += c;
+                }
+        }
+    }
+    out += "\"";
+    return out;
+}
+
+Client::Client(std::string host, int port, std::string user)
+    : host_(std::move(host)), port_(port), user_(std::move(user)) {}
+
+std::string Client::Request(const std::string& method,
+                            const std::string& path,
+                            const std::string& body) const {
+    Socket sock(ConnectTo(host_, port_));
+    std::ostringstream req;
+    req << method << " " << path << " HTTP/1.1\r\n"
+        << "Host: " << host_ << ":" << port_ << "\r\n"
+        << "X-YT-User: " << user_ << "\r\n"
+        << "Content-Type: application/json\r\n"
+        << "Content-Length: " << body.size() << "\r\n"
+        << "Connection: close\r\n\r\n"
+        << body;
+    SendAll(sock.fd(), req.str());
+    std::string response = RecvUntilClosedOrLength(sock.fd());
+    auto header_end = response.find("\r\n\r\n");
+    if (header_end == std::string::npos || response.size() < 12) {
+        throw YtError(0, "malformed HTTP response");
+    }
+    int status = std::stoi(response.substr(9, 3));
+    std::string payload = response.substr(header_end + 4);
+    if (status < 200 || status >= 300) {
+        throw YtError(status, payload);
+    }
+    return payload;
+}
+
+std::string Client::Execute(const std::string& command,
+                            const std::string& json_params) const {
+    return Request("POST", "/api/v4/" + command, json_params);
+}
+
+std::string Client::ListCommands() const {
+    return Request("GET", "/api/v4", "");
+}
+
+void Client::Create(const std::string& type, const std::string& path,
+                    const std::string& attributes_json) const {
+    Execute("create", "{\"type\":" + JsonQuote(type) +
+                      ",\"path\":" + JsonQuote(path) +
+                      ",\"recursive\":true" +
+                      ",\"attributes\":" + attributes_json + "}");
+}
+
+bool Client::Exists(const std::string& path) const {
+    std::string out = Execute("exists", "{\"path\":" + JsonQuote(path) + "}");
+    return out.find("true") != std::string::npos;
+}
+
+std::string Client::Get(const std::string& path) const {
+    return Execute("get", "{\"path\":" + JsonQuote(path) + "}");
+}
+
+void Client::Set(const std::string& path,
+                 const std::string& value_json) const {
+    Execute("set", "{\"path\":" + JsonQuote(path) +
+                   ",\"value\":" + value_json + "}");
+}
+
+void Client::WriteTable(const std::string& path,
+                        const std::string& rows_json) const {
+    Execute("write_table", "{\"path\":" + JsonQuote(path) +
+                           ",\"rows\":" + rows_json + "}");
+}
+
+std::string Client::ReadTable(const std::string& path) const {
+    return Execute("read_table", "{\"path\":" + JsonQuote(path) + "}");
+}
+
+std::string Client::SelectRows(const std::string& query) const {
+    return Execute("select_rows", "{\"query\":" + JsonQuote(query) + "}");
+}
+
+}  // namespace yt_tpu

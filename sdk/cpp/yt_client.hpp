@@ -1,0 +1,57 @@
+// C++ SDK: a native client for the ytsaurus_tpu HTTP proxy (/api/v4).
+//
+// Ref mapping: yt/cpp/mapreduce — the reference's high-level C++ client
+// talks to clusters through the HTTP/RPC proxies; this SDK speaks the
+// same driver-command surface over the HTTP proxy (every command in the
+// driver registry is callable via Execute).  Parameters and results are
+// JSON text: the SDK stays dependency-free (POSIX sockets only), and
+// callers bring whatever JSON library they prefer.
+#pragma once
+
+#include <stdexcept>
+#include <string>
+
+namespace yt_tpu {
+
+struct YtError : std::runtime_error {
+    int http_status;
+    YtError(int status, const std::string& body)
+        : std::runtime_error("YT proxy error (HTTP " +
+                             std::to_string(status) + "): " + body),
+          http_status(status) {}
+};
+
+class Client {
+public:
+    Client(std::string host, int port, std::string user = "root");
+
+    // POST /api/v4/<command> with a JSON parameter object; returns the
+    // raw JSON response body.  Throws YtError on non-2xx.
+    std::string Execute(const std::string& command,
+                        const std::string& json_params) const;
+
+    // Convenience verbs (thin wrappers over Execute).
+    void Create(const std::string& type, const std::string& path,
+                const std::string& attributes_json = "{}") const;
+    bool Exists(const std::string& path) const;
+    std::string Get(const std::string& path) const;
+    void Set(const std::string& path, const std::string& value_json) const;
+    void WriteTable(const std::string& path,
+                    const std::string& rows_json) const;
+    std::string ReadTable(const std::string& path) const;
+    std::string SelectRows(const std::string& query) const;
+    std::string ListCommands() const;   // GET /api/v4
+
+private:
+    std::string host_;
+    int port_;
+    std::string user_;
+
+    std::string Request(const std::string& method, const std::string& path,
+                        const std::string& body) const;
+};
+
+// Minimal JSON string escaping for building parameter objects.
+std::string JsonQuote(const std::string& raw);
+
+}  // namespace yt_tpu

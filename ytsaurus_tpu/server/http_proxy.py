@@ -194,7 +194,9 @@ class HttpProxy:
                  user: str):
         client = self._client(user)
         descriptor = COMMANDS[command]
-        if command == "write_table":
+        if command == "write_table" and "rows" not in params:
+            # Raw table payload in the request body (PUT/POST with a
+            # format); JSON parameter rows take the registry path instead.
             params.setdefault("format", "json")
             return client.write_table(
                 params["path"], data_body, format=params["format"],
