@@ -113,3 +113,34 @@ def test_sort_chunk_descending_with_nulls_and_strings():
     want = sorted(s, key=lambda x: (x is None, () if x is None else
                                     tuple(-b for b in x)))
     assert got == want
+
+
+def test_lsd_radix_argsort_matches_single_pass():
+    """The large-N LSD path (one stable single-word sort per key word)
+    must produce EXACTLY the single-pass variadic network's permutation —
+    including stability across duplicate composite keys."""
+    import jax.numpy as jnp
+
+    from ytsaurus_tpu.ops.segments import stable_argsort_u32
+
+    rng = np.random.default_rng(7)
+    n = 5000
+    words = [
+        jnp.asarray(rng.integers(0, 50, n, dtype=np.uint32)),   # many dups
+        jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32)),
+        jnp.asarray(rng.integers(0, 3, n, dtype=np.uint32)),    # heavy dups
+    ]
+    single = np.asarray(stable_argsort_u32(words, lsd=False))
+    radix = np.asarray(stable_argsort_u32(words, lsd=True))
+    np.testing.assert_array_equal(single, radix)
+
+
+def test_lsd_threshold_env_controls_default(monkeypatch):
+    from ytsaurus_tpu.ops import segments
+
+    monkeypatch.setattr(segments, "LSD_SORT_THRESHOLD", 10)
+    import jax.numpy as jnp
+    words = [jnp.asarray(np.arange(100, dtype=np.uint32)[::-1].copy()),
+             jnp.asarray(np.zeros(100, dtype=np.uint32))]
+    order = np.asarray(segments.stable_argsort_u32(words))
+    np.testing.assert_array_equal(order, np.arange(100)[::-1])

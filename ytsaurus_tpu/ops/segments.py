@@ -8,6 +8,8 @@ reduction over static-capacity planes — batch-friendly for the VPU/MXU.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -409,11 +411,37 @@ def pack_key_planes(items) -> list[jax.Array]:
     return words
 
 
-def stable_argsort_u32(words: list[jax.Array]) -> jax.Array:
+# Above this row count, multi-word variadic sorts leave the single-pass
+# network (which emulates the composite comparator inside every compare)
+# for the LSD radix path below.  Tunable: the v5e cliff sits past ~8M.
+LSD_SORT_THRESHOLD = int(os.environ.get("YT_TPU_LSD_SORT_THRESHOLD",
+                                        8 * 1024 * 1024))
+
+
+def stable_argsort_u32(words: list[jax.Array],
+                       lsd: "bool | None" = None) -> jax.Array:
     """Stable ascending argsort over u32 key words (major first); the
-    payload rides as a u32 iota so no 64-bit plane enters the sort."""
+    payload rides as a u32 iota so no 64-bit plane enters the sort.
+
+    Large multi-word keys take an LSD radix path: one stable SINGLE-key
+    sort per word, least-significant first (radix 2^32 with XLA's native
+    u32 sort as the digit pass).  Every comparator stays one native word
+    wide, which is what the one-pass variadic network cannot do — its
+    composite comparator re-evaluates every word inside each of the
+    O(n log^2 n) compare-exchanges, and collapses past ~8M rows on v5e
+    (the round-1 "sort cliff"; the analog of the reference's partition
+    tree for arbitrarily large keyspaces, sort_controller.cpp:459+)."""
     n = words[0].shape[0]
     iota = jnp.arange(n, dtype=jnp.uint32)
+    if lsd is None:
+        lsd = len(words) > 1 and n > LSD_SORT_THRESHOLD
+    if lsd:
+        perm = iota
+        for word in reversed(words):
+            keys = jnp.take(word, perm)
+            _, perm = jax.lax.sort((keys, perm), num_keys=1,
+                                   is_stable=True)
+        return perm
     out = jax.lax.sort((*words, iota), num_keys=len(words),
                        is_stable=True)
     return out[-1]
