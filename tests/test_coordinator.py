@@ -198,3 +198,36 @@ def test_distributed_mixed_aggregate_order_stable():
     key = lambda r: r["g"]
     assert sorted(single.to_rows(), key=key) == sorted(multi.to_rows(),
                                                        key=key)
+
+
+def test_limit_early_exit_skips_shards():
+    """A bare LIMIT (no ORDER BY/GROUP BY) stops launching shard programs
+    once enough rows are collected (ref pull-model limit stop)."""
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    from ytsaurus_tpu.schema import TableSchema
+
+    schema = TableSchema.make([("k", "int64")])
+    shards = [ColumnarChunk.from_rows(
+        schema, [{"k": i * 100 + j} for j in range(10)]) for i in range(6)]
+    plan = build_query("k FROM [//t] LIMIT 15", {"//t": schema})
+    stats = QueryStatistics()
+    out = coordinate_and_execute(plan, shards, stats=stats)
+    assert out.row_count == 15
+    assert stats.shards_skipped == 4          # 2 shards gave 20 >= 15
+    # ORDER BY must NOT early-exit (needs every shard).
+    plan2 = build_query("k FROM [//t] ORDER BY k DESC LIMIT 3",
+                        {"//t": schema})
+    stats2 = QueryStatistics()
+    out2 = coordinate_and_execute(plan2, shards, stats=stats2)
+    assert stats2.shards_skipped == 0
+    assert [r["k"] for r in out2.to_rows()] == [509, 508, 507]
+    # WHERE + LIMIT: filtered shards keep the scan going until satisfied.
+    plan3 = build_query("k FROM [//t] WHERE k >= 500 LIMIT 5",
+                        {"//t": schema})
+    stats3 = QueryStatistics()
+    out3 = coordinate_and_execute(plan3, shards, stats=stats3)
+    assert out3.row_count == 5
+    assert stats3.shards_skipped == 0         # only the last shard matches

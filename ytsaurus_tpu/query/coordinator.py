@@ -235,9 +235,27 @@ def coordinate_and_execute(
                                     stats=stats)
     else:
         bottom, front = split_plan(plan)
-        partials = [evaluator.run_plan(bottom, chunk, foreign_chunks,
-                                       stats=stats)
-                    for chunk in chunks]
+        # LIMIT early-exit (ref: pull-model readers stop at the limit,
+        # CoordinateAndExecute ordered scans, coordinator.h:81-90): with
+        # no ORDER BY and no aggregation, any offset+limit rows satisfy
+        # the query — stop launching shard programs once the partials
+        # hold enough.  The per-shard row-count read is the bounded-batch
+        # "device predicate feedback" loop from SURVEY §7.
+        needed = None
+        if plan.limit is not None and plan.order is None \
+                and plan.group is None:
+            needed = plan.offset + plan.limit
+        partials = []
+        collected = 0
+        for i, chunk in enumerate(chunks):
+            partial = evaluator.run_plan(bottom, chunk, foreign_chunks,
+                                         stats=stats)
+            partials.append(partial)
+            collected += partial.row_count
+            if needed is not None and collected >= needed:
+                if stats is not None:
+                    stats.shards_skipped += len(chunks) - (i + 1)
+                break
         merged = concat_chunks(
             [p.slice_rows(0, p.row_count) for p in partials])
         result = evaluator.run_plan(front, merged, stats=stats)

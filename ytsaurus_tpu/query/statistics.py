@@ -16,6 +16,7 @@ class QueryStatistics:
     cache_hits: int = 0
     shards_total: int = 0
     shards_pruned: int = 0
+    shards_skipped: int = 0          # LIMIT early-exit left these unread
     joins_executed: int = 0
 
     def to_dict(self) -> dict:
