@@ -20,12 +20,27 @@ class FakeJournalChannel:
         self.records = []
         self.snapshots = {}
         self.down = False
+        self.epoch = 0
 
     def call(self, service, method, body=None, attachments=(), **kw):
         if self.down:
             raise YtError("down", code=EErrorCode.TransportError)
         assert service == "data_node"
+        if method == "journal_acquire":
+            if body["epoch"] <= self.epoch:
+                return {"granted": False, "epoch": self.epoch}, []
+            self.epoch = body["epoch"]
+            return {"granted": True, "epoch": self.epoch}, []
+        if method == "journal_epoch":
+            return {"epoch": self.epoch}, []
         if method == "journal_append":
+            epoch = body.get("epoch")
+            if epoch is not None:
+                if epoch < self.epoch:
+                    raise YtError("fenced",
+                                  code=EErrorCode.JournalEpochFenced,
+                                  attributes={"stored_epoch": self.epoch})
+                self.epoch = max(self.epoch, epoch)
             position = body.get("position")
             if position is not None and position != len(self.records):
                 raise YtError("position mismatch",
@@ -260,3 +275,50 @@ def test_wiped_local_cannot_vote_empty_prefix(tmp_path):
     remotes[1].down = False
     fresh2 = QuorumWal(str(tmp_path / "w3.log"), "j", remotes, quorum=2)
     assert [r["args"]["n"] for r in fresh2.recover()] == [1]
+
+
+def test_epoch_fencing_stops_stale_writer(tmp_path):
+    """A second master acquiring the journals fences the first: its next
+    append fails fast with JournalEpochFenced (fail-stop, no interleaved
+    log) — ref Hydra changelog acquisition."""
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    old = QuorumWal(str(tmp_path / "old.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
+    old.recover()
+    old.append({"op": "set", "args": {"n": 1}})
+    assert old.epoch == 1
+    # New master takes over the SAME remote journals.
+    new = QuorumWal(str(tmp_path / "new.log"), "j", remotes, quorum=2)
+    new.recover()
+    assert new.epoch == 2
+    assert [r["args"]["n"] for r in new._records] == [1]
+    new.append({"op": "set", "args": {"n": 2}})
+    # The stale writer is rejected immediately.
+    with pytest.raises(YtError) as err:
+        old.append({"op": "set", "args": {"n": 99}})
+    assert err.value.code == EErrorCode.JournalEpochFenced
+    # The log holds ONLY the new master's history.
+    assert [r["args"]["n"] for r in remotes[0].records] == [1, 2]
+
+
+def test_epoch_acquisition_needs_remote_grants(tmp_path):
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    # One replica down: acquisition still succeeds (liveness under one
+    # dead location) and the returning replica learns the epoch from the
+    # first append that reaches it.
+    remotes[0].down = True
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
+    wal.recover()
+    wal.append({"op": "set", "args": {"n": 1}})
+    remotes[0].down = False
+    wal.append({"op": "set", "args": {"n": 2}})
+    assert remotes[0].epoch == wal.epoch
+    # Every replica down: takeover refused.
+    remotes2 = [FakeJournalChannel(), FakeJournalChannel()]
+    for r in remotes2:
+        r.down = True
+    wal2 = QuorumWal(str(tmp_path / "w2.log"), "j", remotes2, quorum=2,
+                     bootstrap_from_local=True)
+    with pytest.raises(YtError):
+        wal2.recover()

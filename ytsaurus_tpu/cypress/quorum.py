@@ -110,6 +110,77 @@ class QuorumWal:
             raise YtError(f"quorum {quorum} unreachable with "
                           f"{1 + len(self.replicas)} locations")
         self._records: list[dict] = []     # committed log (truncated w/ WAL)
+        self.epoch: int = 0                # 0 = not yet acquired
+
+    # -- epoch fencing ---------------------------------------------------------
+
+    def _local_epoch_path(self) -> str:
+        return self.local.path + ".epoch"
+
+    def _local_stored_epoch(self) -> int:
+        try:
+            with open(self._local_epoch_path(), "rb") as f:
+                return int(f.read().strip() or b"0")
+        except (OSError, ValueError):
+            return 0
+
+    def _store_local_epoch(self, epoch: int) -> None:
+        tmp = self._local_epoch_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(str(epoch).encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._local_epoch_path())
+
+    def acquire_epoch(self) -> int:
+        """Claim write ownership: epoch = max(stored)+1, granted by a
+        MAJORITY of locations (ref Hydra changelog acquisition).  Any
+        previous writer's appends are rejected from then on — split-brain
+        masters fence each other instead of interleaving one log."""
+        observed = [self._local_stored_epoch()]
+        for replica in self.replicas:
+            try:
+                body, _ = replica.channel.call(
+                    "data_node", "journal_epoch",
+                    {"journal": self.journal_name})
+                observed.append(int(body.get("epoch", 0)))
+            except YtError:
+                pass
+        candidate = max(observed) + 1
+        self._store_local_epoch(candidate)
+        if not self.replicas:
+            # Single-location deployment: one process owns the file.
+            self.epoch = candidate
+            return candidate
+        # Grants are counted over the SHARED remote locations only: two
+        # candidate masters have disjoint local locations, so quorums
+        # counting locals need not intersect.  A majority of remotes must
+        # grant; a replica that was down during acquisition learns the new
+        # epoch from the first append that reaches it (journal_append
+        # raises the stored epoch monotonically), and a stale writer that
+        # slips records onto such a replica first is corrected by the
+        # divergence reset in _catch_up — the same
+        # acquisition-plus-lease shape as Hydra, where strict fencing of
+        # every minority subset is traded for liveness under one dead
+        # location.
+        grants = 0
+        for replica in self.replicas:
+            try:
+                body, _ = replica.channel.call(
+                    "data_node", "journal_acquire",
+                    {"journal": self.journal_name, "epoch": candidate},
+                    idempotent=False)
+                if body.get("granted"):
+                    grants += 1
+            except YtError:
+                pass
+        needed = max(self.quorum - 1, (len(self.replicas) + 1) // 2)
+        if grants < needed:
+            raise YtError(
+                f"epoch acquisition granted by {grants}/{needed} remote "
+                "locations", code=EErrorCode.PeerUnavailable)
+        self.epoch = candidate
+        return candidate
 
     # -- replica sync ----------------------------------------------------------
 
@@ -137,11 +208,17 @@ class QuorumWal:
                 replica.channel.call(
                     "data_node", "journal_append",
                     {"journal": self.journal_name, "records": missing,
-                     "position": replica.synced_len}, idempotent=False)
+                     "position": replica.synced_len,
+                     "epoch": self.epoch or None}, idempotent=False)
                 replica.synced_len = len(self._records)
             return True
         except YtError as err:
             replica.synced_len = None
+            if err.code == EErrorCode.JournalEpochFenced:
+                raise YtError(
+                    "WAL writer fenced during catch-up: a newer master "
+                    "acquired the journal",
+                    code=EErrorCode.JournalEpochFenced, inner_errors=[err])
             logger.warning("journal replica catch-up failed: %s", err)
             return False
 
@@ -164,12 +241,22 @@ class QuorumWal:
                 replica.channel.call(
                     "data_node", "journal_append",
                     {"journal": self.journal_name, "records": [record],
-                     "position": position}, idempotent=False)
+                     "position": position, "epoch": self.epoch or None},
+                    idempotent=False)
                 replica.synced_len = position + 1
                 acks += 1
             except YtError as err:
                 replica.synced_len = None
                 errors.append(err)
+                if err.code == EErrorCode.JournalEpochFenced:
+                    # A newer master owns this journal: fail-stop NOW —
+                    # assembling a quorum from the remaining locations
+                    # would interleave two writers into one log.
+                    raise YtError(
+                        "WAL writer fenced: a newer master acquired the "
+                        "journal; this master must stop writing",
+                        code=EErrorCode.JournalEpochFenced,
+                        inner_errors=[err])
         if acks < self.quorum:
             raise YtError(
                 f"WAL append reached {acks}/{self.quorum} locations",
@@ -191,6 +278,7 @@ class QuorumWal:
             # First adoption of this quorum config: local history (possibly
             # written under a local-only WAL) is authoritative.
             self._records = list(local_records)
+            self.acquire_epoch()
             for replica in self.replicas:
                 replica.synced_len = None
                 self._catch_up(replica)
@@ -233,6 +321,9 @@ class QuorumWal:
         # Re-align the local location; remote replicas catch up lazily at
         # the next append (and earn no quorum credit until they do).
         self._realign_local()
+        # Fence any previous writer BEFORE this incarnation writes (ref
+        # Hydra changelog acquisition at epoch start).
+        self.acquire_epoch()
         for replica, lst in zip(self.replicas, lists[1:]):
             replica.synced_len = None if lst is None or \
                 len(lst) != committed else committed

@@ -90,11 +90,71 @@ class DataNodeService(Service):
                 self._journals[name] = entry
             return entry
 
+    def _epoch_path(self, name: str) -> str:
+        import os
+        return os.path.join(self.journal_dir, name + ".epoch")
+
+    def _stored_epoch(self, name: str) -> int:
+        import os
+        path = self._epoch_path(name)
+        if not os.path.exists(path):
+            return 0
+        try:
+            with open(path, "rb") as f:
+                return int(f.read().strip() or b"0")
+        except (OSError, ValueError):
+            return 0
+
+    def _store_epoch(self, name: str, epoch: int) -> None:
+        import os
+        path = self._epoch_path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(str(epoch).encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @rpc_method(concurrency=1)
+    def journal_acquire(self, body, attachments):
+        """Epoch acquisition (ref Hydra changelog acquisition /
+        lease_tracker fencing): a writer claims a higher epoch; stale
+        writers' appends are rejected from then on."""
+        name = self._check_name(_text(body["journal"]))
+        epoch = int(body["epoch"])
+        with self._journal_lock:
+            stored = self._stored_epoch(name)
+            if epoch <= stored:
+                return {"granted": False, "epoch": stored}
+            self._store_epoch(name, epoch)
+            return {"granted": True, "epoch": epoch}
+
+    @rpc_method()
+    def journal_epoch(self, body, attachments):
+        name = self._check_name(_text(body["journal"]))
+        with self._journal_lock:
+            return {"epoch": self._stored_epoch(name)}
+
     @rpc_method(concurrency=1)
     def journal_append(self, body, attachments):
-        entry = self._journal(_text(body["journal"]))
+        name = _text(body["journal"])
+        entry = self._journal(name)
         position = body.get("position")
+        epoch = body.get("epoch")
         with self._journal_lock:
+            if epoch is not None:
+                stored = self._stored_epoch(name)
+                if int(epoch) < stored:
+                    raise YtError(
+                        f"journal writer fenced: epoch {epoch} < {stored} "
+                        "(a newer master acquired this journal)",
+                        code=EErrorCode.JournalEpochFenced,
+                        attributes={"stored_epoch": stored})
+                if int(epoch) > stored:
+                    # A replica that missed the acquisition learns the
+                    # epoch from the first append of the new writer.
+                    self._store_epoch(name, int(epoch))
             if position is not None and int(position) != entry["count"]:
                 raise YtError(
                     f"journal position mismatch: writer at {position}, "
