@@ -21,6 +21,19 @@ class FakeJournalChannel:
         self.snapshots = {}
         self.down = False
         self.epoch = 0
+        self.writer = ""
+
+    def _check(self, body):
+        epoch = body.get("epoch")
+        if epoch is None:
+            return
+        writer = body.get("writer") or ""
+        if epoch < self.epoch or (epoch == self.epoch and self.writer
+                                  and writer != self.writer):
+            raise YtError("fenced", code=EErrorCode.JournalEpochFenced,
+                          attributes={"stored_epoch": self.epoch})
+        if epoch > self.epoch:
+            self.epoch, self.writer = epoch, writer
 
     def call(self, service, method, body=None, attachments=(), **kw):
         if self.down:
@@ -30,17 +43,12 @@ class FakeJournalChannel:
             if body["epoch"] <= self.epoch:
                 return {"granted": False, "epoch": self.epoch}, []
             self.epoch = body["epoch"]
+            self.writer = body.get("writer") or ""
             return {"granted": True, "epoch": self.epoch}, []
         if method == "journal_epoch":
             return {"epoch": self.epoch}, []
         if method == "journal_append":
-            epoch = body.get("epoch")
-            if epoch is not None:
-                if epoch < self.epoch:
-                    raise YtError("fenced",
-                                  code=EErrorCode.JournalEpochFenced,
-                                  attributes={"stored_epoch": self.epoch})
-                self.epoch = max(self.epoch, epoch)
+            self._check(body)
             position = body.get("position")
             if position is not None and position != len(self.records):
                 raise YtError("position mismatch",
@@ -53,6 +61,7 @@ class FakeJournalChannel:
         if method == "journal_count":
             return {"count": len(self.records)}, []
         if method == "journal_reset":
+            self._check(body)
             self.records.clear()
             return {}, []
         if method == "snapshot_put":
@@ -322,3 +331,46 @@ def test_epoch_acquisition_needs_remote_grants(tmp_path):
                      bootstrap_from_local=True)
     with pytest.raises(YtError):
         wal2.recover()
+
+
+def test_orphaned_fence_recovers(tmp_path):
+    """A takeover that dies between epoch acquisition and writing leaves
+    an orphaned higher epoch; the active master re-acquires above it and
+    keeps serving instead of latching read-only."""
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    active = QuorumWal(str(tmp_path / "a.log"), "j", remotes, quorum=2,
+                       bootstrap_from_local=True)
+    active.recover()
+    active.append({"op": "set", "args": {"n": 1}})
+    # Orphaned acquisition: epoch bumped, but the candidate never writes.
+    for r in remotes:
+        r.epoch, r.writer = active.epoch + 1, "dead-candidate"
+    active.append({"op": "set", "args": {"n": 2}})      # self-heals
+    assert active.epoch > 2
+    assert [r["args"]["n"] for r in remotes[0].records] == [1, 2]
+
+
+def test_stale_divergence_reset_is_fenced(tmp_path):
+    """A stale master's catch-up must not journal_reset away the new
+    master's committed records (the reset carries the epoch too)."""
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    old = QuorumWal(str(tmp_path / "old.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
+    old.recover()
+    old.append({"op": "set", "args": {"n": 1}})
+    new = QuorumWal(str(tmp_path / "new.log"), "j", remotes, quorum=2)
+    new.recover()
+    new.append({"op": "set", "args": {"n": 2}})
+    # The stale master believes fewer records exist; its catch-up sees a
+    # "longer" remote log and tries to reset it — fenced, and because the
+    # new master HAS written, re-acquisition is refused → fail-stop.
+    remotes[0].records_longer_than = None
+    for r in old.replicas:
+        r.synced_len = None
+    with pytest.raises(YtError) as err:
+        old.append({"op": "set", "args": {"n": 99}})
+    assert err.value.code in (EErrorCode.JournalEpochFenced,
+                              EErrorCode.PeerUnavailable)
+    # New master's records intact on both replicas.
+    assert [r["args"]["n"] for r in remotes[0].records] == [1, 2]
+    assert [r["args"]["n"] for r in remotes[1].records] == [1, 2]

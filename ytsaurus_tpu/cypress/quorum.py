@@ -111,6 +111,8 @@ class QuorumWal:
                           f"{1 + len(self.replicas)} locations")
         self._records: list[dict] = []     # committed log (truncated w/ WAL)
         self.epoch: int = 0                # 0 = not yet acquired
+        import uuid
+        self.writer_id: str = uuid.uuid4().hex[:12]
 
     # -- epoch fencing ---------------------------------------------------------
 
@@ -118,19 +120,15 @@ class QuorumWal:
         return self.local.path + ".epoch"
 
     def _local_stored_epoch(self) -> int:
-        try:
-            with open(self._local_epoch_path(), "rb") as f:
-                return int(f.read().strip() or b"0")
-        except (OSError, ValueError):
-            return 0
+        from ytsaurus_tpu.utils.diskio import read_epoch_file
+        return read_epoch_file(self._local_epoch_path())[0]
 
     def _store_local_epoch(self, epoch: int) -> None:
-        tmp = self._local_epoch_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(str(epoch).encode())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._local_epoch_path())
+        from ytsaurus_tpu.utils.diskio import write_epoch_file
+        write_epoch_file(self._local_epoch_path(), epoch, self.writer_id)
+
+    def _fence_body(self) -> dict:
+        return {"epoch": self.epoch or None, "writer": self.writer_id}
 
     def acquire_epoch(self) -> int:
         """Claim write ownership: epoch = max(stored)+1, granted by a
@@ -168,7 +166,8 @@ class QuorumWal:
             try:
                 body, _ = replica.channel.call(
                     "data_node", "journal_acquire",
-                    {"journal": self.journal_name, "epoch": candidate},
+                    {"journal": self.journal_name, "epoch": candidate,
+                     "writer": self.writer_id},
                     idempotent=False)
                 if body.get("granted"):
                     grants += 1
@@ -184,7 +183,7 @@ class QuorumWal:
 
     # -- replica sync ----------------------------------------------------------
 
-    def _catch_up(self, replica: _Replica) -> bool:
+    def _catch_up(self, replica: _Replica, _retry_ok: bool = True) -> bool:
         """Bring one replica to the full committed log; True on success."""
         try:
             if replica.synced_len is None:
@@ -198,9 +197,10 @@ class QuorumWal:
                 if have > len(self._records):
                     # Longer than the committed log → uncommitted tail from
                     # a previous incarnation; discard it.
-                    replica.channel.call("data_node", "journal_reset",
-                                         {"journal": self.journal_name},
-                                         idempotent=False)
+                    replica.channel.call(
+                        "data_node", "journal_reset",
+                        {"journal": self.journal_name,
+                         **self._fence_body()}, idempotent=False)
                     have = 0
                 replica.synced_len = have
             if replica.synced_len < len(self._records):
@@ -209,25 +209,56 @@ class QuorumWal:
                     "data_node", "journal_append",
                     {"journal": self.journal_name, "records": missing,
                      "position": replica.synced_len,
-                     "epoch": self.epoch or None}, idempotent=False)
+                     **self._fence_body()}, idempotent=False)
                 replica.synced_len = len(self._records)
             return True
         except YtError as err:
             replica.synced_len = None
             if err.code == EErrorCode.JournalEpochFenced:
-                raise YtError(
-                    "WAL writer fenced during catch-up: a newer master "
-                    "acquired the journal",
-                    code=EErrorCode.JournalEpochFenced, inner_errors=[err])
+                if _retry_ok and self._maybe_reacquire():
+                    return self._catch_up(replica, _retry_ok=False)
+                raise self._fenced_error(err)
             logger.warning("journal replica catch-up failed: %s", err)
             return False
 
     # -- write path ------------------------------------------------------------
 
+    def _maybe_reacquire(self) -> bool:
+        """Recovery from an ORPHANED fence: a takeover that died between
+        acquiring its epoch and reaching quorum leaves a higher epoch
+        behind with NO records.  If no reachable location holds records
+        beyond our committed log, no new writer exists — re-acquire (we
+        observe the orphan and claim above it).  Any longer log means a
+        real new master: fail-stop."""
+        for replica in self.replicas:
+            try:
+                body, _ = replica.channel.call(
+                    "data_node", "journal_count",
+                    {"journal": self.journal_name})
+                if int(body.get("count", 0)) > len(self._records):
+                    return False
+            except YtError:
+                continue
+        try:
+            self.acquire_epoch()
+            logger.warning("re-acquired journal %s after an orphaned "
+                           "fence (epoch now %d)", self.journal_name,
+                           self.epoch)
+            return True
+        except YtError:
+            return False
+
+    def _fenced_error(self, err: YtError) -> YtError:
+        return YtError(
+            "WAL writer fenced: a newer master acquired the journal; "
+            "this master must stop writing",
+            code=EErrorCode.JournalEpochFenced, inner_errors=[err])
+
     def append(self, record: dict) -> None:
         position = len(self._records)
         acks = 0
         errors = []
+        reacquired = False
         try:
             self.local.append(record)
             acks += 1
@@ -237,26 +268,30 @@ class QuorumWal:
             if replica.synced_len != position and not self._sync_to(
                     replica, position):
                 continue
-            try:
-                replica.channel.call(
-                    "data_node", "journal_append",
-                    {"journal": self.journal_name, "records": [record],
-                     "position": position, "epoch": self.epoch or None},
-                    idempotent=False)
-                replica.synced_len = position + 1
-                acks += 1
-            except YtError as err:
-                replica.synced_len = None
-                errors.append(err)
-                if err.code == EErrorCode.JournalEpochFenced:
-                    # A newer master owns this journal: fail-stop NOW —
-                    # assembling a quorum from the remaining locations
-                    # would interleave two writers into one log.
-                    raise YtError(
-                        "WAL writer fenced: a newer master acquired the "
-                        "journal; this master must stop writing",
-                        code=EErrorCode.JournalEpochFenced,
-                        inner_errors=[err])
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    replica.channel.call(
+                        "data_node", "journal_append",
+                        {"journal": self.journal_name, "records": [record],
+                         "position": position, **self._fence_body()},
+                        idempotent=False)
+                    replica.synced_len = position + 1
+                    acks += 1
+                except YtError as err:
+                    replica.synced_len = None
+                    errors.append(err)
+                    if err.code == EErrorCode.JournalEpochFenced:
+                        if not reacquired and attempts == 1 and \
+                                self._maybe_reacquire():
+                            reacquired = True
+                            continue        # retry under the new epoch
+                        # A newer master owns this journal: fail-stop —
+                        # assembling a quorum from the remaining
+                        # locations would interleave two writers.
+                        raise self._fenced_error(err)
+                break
         if acks < self.quorum:
             raise YtError(
                 f"WAL append reached {acks}/{self.quorum} locations",
@@ -341,9 +376,10 @@ class QuorumWal:
         self._records = []
         for replica in self.replicas:
             try:
-                replica.channel.call("data_node", "journal_reset",
-                                     {"journal": self.journal_name},
-                                     idempotent=False)
+                replica.channel.call(
+                    "data_node", "journal_reset",
+                    {"journal": self.journal_name, **self._fence_body()},
+                    idempotent=False)
                 replica.synced_len = 0
             except YtError:
                 replica.synced_len = None
@@ -362,7 +398,8 @@ class QuorumWal:
             try:
                 replica.channel.call(
                     "data_node", "snapshot_put",
-                    {"name": self.journal_name, "seq": seq}, [blob],
+                    {"name": self.journal_name, "seq": seq,
+                     **self._fence_body()}, [blob],
                     idempotent=False)
                 acks += 1
             except YtError as err:

@@ -32,6 +32,7 @@ class DataNodeService(Service):
         self.journal_dir = journal_dir
         os.makedirs(journal_dir, exist_ok=True)
         self._journals: dict[str, object] = {}
+        self._epochs: dict[str, tuple] = {}   # journal → (epoch, writer)
         self._journal_lock = threading.Lock()
 
     # -- chunks ---------------------------------------------------------------
@@ -94,67 +95,71 @@ class DataNodeService(Service):
         import os
         return os.path.join(self.journal_dir, name + ".epoch")
 
-    def _stored_epoch(self, name: str) -> int:
-        import os
-        path = self._epoch_path(name)
-        if not os.path.exists(path):
-            return 0
-        try:
-            with open(path, "rb") as f:
-                return int(f.read().strip() or b"0")
-        except (OSError, ValueError):
-            return 0
+    def _epoch_state(self, name: str) -> "tuple[int, str]":
+        """Cached (epoch, writer) — the append hot path must not read the
+        sidecar file per record (it is loaded once per process)."""
+        cached = self._epochs.get(name)
+        if cached is None:
+            from ytsaurus_tpu.utils.diskio import read_epoch_file
+            cached = read_epoch_file(self._epoch_path(name))
+            self._epochs[name] = cached
+        return cached
 
-    def _store_epoch(self, name: str, epoch: int) -> None:
-        import os
-        path = self._epoch_path(name)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(str(epoch).encode())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+    def _set_epoch_state(self, name: str, epoch: int, writer: str) -> None:
+        from ytsaurus_tpu.utils.diskio import write_epoch_file
+        write_epoch_file(self._epoch_path(name), epoch, writer)
+        self._epochs[name] = (epoch, writer)
+
+    def _check_writer(self, name: str, epoch, writer) -> None:
+        """Fencing rule shared by append/reset/snapshot: a request from an
+        older epoch — or the same epoch under a DIFFERENT writer id (two
+        candidates tied on disjoint grant sets) — is rejected; a newer
+        epoch is adopted (a replica that missed the acquisition learns it
+        from the first write that reaches it)."""
+        if epoch is None:
+            return
+        epoch = int(epoch)
+        writer = _text(writer or "")
+        stored, stored_writer = self._epoch_state(name)
+        if epoch < stored or (epoch == stored and stored_writer and
+                              writer != stored_writer):
+            raise YtError(
+                f"journal writer fenced: epoch {epoch}/{writer!r} vs "
+                f"stored {stored}/{stored_writer!r}",
+                code=EErrorCode.JournalEpochFenced,
+                attributes={"stored_epoch": stored})
+        if epoch > stored:
+            self._set_epoch_state(name, epoch, writer)
 
     @rpc_method(concurrency=1)
     def journal_acquire(self, body, attachments):
         """Epoch acquisition (ref Hydra changelog acquisition /
-        lease_tracker fencing): a writer claims a higher epoch; stale
-        writers' appends are rejected from then on."""
+        lease_tracker fencing): a writer claims a strictly higher epoch;
+        stale writers' journal writes are rejected from then on."""
         name = self._check_name(_text(body["journal"]))
         epoch = int(body["epoch"])
+        writer = _text(body.get("writer") or "")
         with self._journal_lock:
-            stored = self._stored_epoch(name)
+            stored, _ = self._epoch_state(name)
             if epoch <= stored:
                 return {"granted": False, "epoch": stored}
-            self._store_epoch(name, epoch)
+            self._set_epoch_state(name, epoch, writer)
             return {"granted": True, "epoch": epoch}
 
     @rpc_method()
     def journal_epoch(self, body, attachments):
         name = self._check_name(_text(body["journal"]))
         with self._journal_lock:
-            return {"epoch": self._stored_epoch(name)}
+            epoch, writer = self._epoch_state(name)
+            return {"epoch": epoch, "writer": writer}
 
     @rpc_method(concurrency=1)
     def journal_append(self, body, attachments):
         name = _text(body["journal"])
         entry = self._journal(name)
         position = body.get("position")
-        epoch = body.get("epoch")
         with self._journal_lock:
-            if epoch is not None:
-                stored = self._stored_epoch(name)
-                if int(epoch) < stored:
-                    raise YtError(
-                        f"journal writer fenced: epoch {epoch} < {stored} "
-                        "(a newer master acquired this journal)",
-                        code=EErrorCode.JournalEpochFenced,
-                        attributes={"stored_epoch": stored})
-                if int(epoch) > stored:
-                    # A replica that missed the acquisition learns the
-                    # epoch from the first append of the new writer.
-                    self._store_epoch(name, int(epoch))
+            self._check_writer(name, body.get("epoch"), body.get("writer"))
             if position is not None and int(position) != entry["count"]:
                 raise YtError(
                     f"journal position mismatch: writer at {position}, "
@@ -195,10 +200,13 @@ class DataNodeService(Service):
 
     @rpc_method(concurrency=1)
     def journal_reset(self, body, attachments):
-        """Truncate a journal to empty (after a snapshot)."""
+        """Truncate a journal to empty (after a snapshot, or a divergence
+        reset in catch-up).  FENCED like appends: a stale master's
+        divergence reset must not destroy the new master's records."""
         import os
         name = self._check_name(_text(body["journal"]))
         with self._journal_lock:
+            self._check_writer(name, body.get("epoch"), body.get("writer"))
             entry = self._journals.pop(name, None)
             if entry is not None:
                 entry["wal"].close()
@@ -213,6 +221,9 @@ class DataNodeService(Service):
     def snapshot_put(self, body, attachments):
         import os
         name = self._check_name(_text(body["name"]))
+        with self._journal_lock:
+            self._check_writer(name, body.get("epoch"),
+                               body.get("writer"))
         seq = int(body["seq"])
         path = os.path.join(self.journal_dir, f"{name}.snap")
         tmp = path + ".tmp"

@@ -1,0 +1,29 @@
+"""Small durable-file helpers shared by WAL/journal epoch state."""
+
+from __future__ import annotations
+
+import os
+
+
+def read_epoch_file(path: str) -> tuple[int, str]:
+    """(epoch, writer_id) from a fenced-epoch sidecar; (0, "") when
+    missing/corrupt (corrupt = no fencing history, same as fresh)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read().decode().split()
+        epoch = int(raw[0]) if raw else 0
+        writer = raw[1] if len(raw) > 1 else ""
+        return epoch, writer
+    except (OSError, ValueError, IndexError):
+        return 0, ""
+
+
+def write_epoch_file(path: str, epoch: int, writer_id: str) -> None:
+    """Atomic, fsync'd publish of (epoch, writer_id)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(f"{epoch} {writer_id}".encode())
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
