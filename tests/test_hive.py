@@ -112,3 +112,87 @@ def test_bidirectional_mailboxes(cells):
     # Inbox/outbox state is per-direction.
     assert hb.last_applied("cell-a") == 0
     assert ha.last_applied("cell-b") == 1
+
+
+def test_batch_mid_failure_rolls_back(tmp_path):
+    """A batch whose sub-op fails on RESOLUTION mid-way must leave no
+    partial effects: earlier sub-ops roll back, no WAL record is written,
+    and the master keeps serving (not poisoned)."""
+    client = connect(str(tmp_path / "c"))
+    master = client.cluster.master
+    client.create("document", "//existing")
+    with pytest.raises(YtError):
+        master.commit_mutation("batch", ops=[
+            {"op": "create", "args": {"path": "//fresh",
+                                      "type": "document"}},
+            {"op": "set", "args": {"path": "//fresh", "value": 7}},
+            # Fails: create over an existing node.
+            {"op": "create", "args": {"path": "//existing",
+                                      "type": "document"}},
+        ])
+    # Earlier sub-ops rolled back.
+    assert not client.exists("//fresh")
+    # Master still serves mutations (atomic failure, not poison).
+    client.create("document", "//after")
+    assert client.exists("//after")
+    # Replay agrees: no partial batch in the WAL.
+    from ytsaurus_tpu.cypress.master import Master
+    reloaded = Master(master.root_dir)
+    assert reloaded.tree.try_resolve("//fresh") is None
+    assert reloaded.tree.try_resolve("//after") is not None
+
+
+def test_concurrent_posts_lose_no_message(tmp_path):
+    """Racing posters must not lose a message or duplicate a seqno
+    (outbox read-modify-write is serialized per manager)."""
+    import threading
+    client = connect(str(tmp_path / "c"))
+    hive = HiveManager(client, "cell-x")
+    n_threads, per_thread = 4, 25
+    def poster(k):
+        for i in range(per_thread):
+            hive.post("cell-y", "append", {"value": (k, i)})
+    threads = [threading.Thread(target=poster, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    state = client.get("//sys/hive/cell-x/outbox/cell-y")
+    seqnos = [m["seqno"] for m in state["messages"]]
+    assert len(seqnos) == n_threads * per_thread
+    assert sorted(seqnos) == list(range(1, n_threads * per_thread + 1))
+
+
+def test_batch_malformed_subop_rolls_back(tmp_path):
+    """A sub-op raising a NON-YtError (malformed args) must also roll
+    back — not leave earlier sub-ops applied with no WAL record."""
+    client = connect(str(tmp_path / "c"))
+    master = client.cluster.master
+    with pytest.raises(KeyError):
+        master.commit_mutation("batch", ops=[
+            {"op": "create", "args": {"path": "//first",
+                                      "type": "document"}},
+            {"op": "create", "args": {"type": "document"}},   # no path
+        ])
+    assert not client.exists("//first")
+    client.create("document", "//after")        # not poisoned
+
+
+def test_batch_recursive_create_rolls_back_ancestors(tmp_path):
+    """Rollback of a recursive create removes the TOPMOST materialized
+    node, not just the leaf."""
+    client = connect(str(tmp_path / "c"))
+    master = client.cluster.master
+    client.create("document", "//existing")
+    with pytest.raises(YtError):
+        master.commit_mutation("batch", ops=[
+            {"op": "create", "args": {"path": "//x/y/z", "type": "document",
+                                      "recursive": True}},
+            {"op": "create", "args": {"path": "//existing",
+                                      "type": "document"}},
+        ])
+    assert not client.exists("//x")
+    from ytsaurus_tpu.cypress.master import Master
+    reloaded = Master(master.root_dir)
+    assert reloaded.tree.try_resolve("//x") is None

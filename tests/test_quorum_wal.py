@@ -169,18 +169,22 @@ def test_recover_refuses_below_quorum(tmp_path):
 def test_no_holes_replica_down_then_up(tmp_path):
     """The reviewer's scenario: a replica that missed a record must NOT
     accept later appends (hole) and must not cause loss of a
-    quorum-acknowledged record in recovery."""
-    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    quorum-acknowledged record in recovery.  Three remotes: takeover
+    needs a strict majority of remote locations, so recovery with one
+    remote down requires an odd remote count to stay live."""
+    remotes = [FakeJournalChannel(), FakeJournalChannel(),
+               FakeJournalChannel()]
     wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
                     bootstrap_from_local=True)
     wal.recover()
     remotes[0].down = True
-    wal.append({"op": "set", "args": {"n": 1}})     # local + B ack
+    wal.append({"op": "set", "args": {"n": 1}})     # local + B + C ack
     remotes[0].down = False
     wal.append({"op": "set", "args": {"n": 2}})     # A must catch up first
     # A holds the full prefix, not a holey [r2].
     assert [r["args"]["n"] for r in remotes[0].records] == [1, 2]
-    # Recovery with B down: local + A still confirm both records.
+    # Recovery with B down: local + A + C still confirm both records and
+    # grant the takeover (2-of-3 strict remote majority).
     remotes[1].down = True
     wal2 = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2)
     records = wal2.recover()
@@ -311,10 +315,11 @@ def test_epoch_fencing_stops_stale_writer(tmp_path):
 
 
 def test_epoch_acquisition_needs_remote_grants(tmp_path):
-    remotes = [FakeJournalChannel(), FakeJournalChannel()]
-    # One replica down: acquisition still succeeds (liveness under one
-    # dead location) and the returning replica learns the epoch from the
-    # first append that reaches it.
+    remotes = [FakeJournalChannel(), FakeJournalChannel(),
+               FakeJournalChannel()]
+    # One of THREE replicas down: acquisition still succeeds (2-of-3 is
+    # a strict remote majority) and the returning replica learns the
+    # epoch from the first append that reaches it.
     remotes[0].down = True
     wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
                     bootstrap_from_local=True)
@@ -323,14 +328,23 @@ def test_epoch_acquisition_needs_remote_grants(tmp_path):
     remotes[0].down = False
     wal.append({"op": "set", "args": {"n": 2}})
     assert remotes[0].epoch == wal.epoch
-    # Every replica down: takeover refused.
+    # Half the remotes down (1 of 2): NOT a strict majority — takeover
+    # refused even though one grant is reachable (two candidates on
+    # disjoint halves must never both win).
     remotes2 = [FakeJournalChannel(), FakeJournalChannel()]
-    for r in remotes2:
-        r.down = True
+    remotes2[0].down = True
     wal2 = QuorumWal(str(tmp_path / "w2.log"), "j", remotes2, quorum=2,
                      bootstrap_from_local=True)
     with pytest.raises(YtError):
         wal2.recover()
+    # Every replica down: takeover refused.
+    remotes3 = [FakeJournalChannel(), FakeJournalChannel()]
+    for r in remotes3:
+        r.down = True
+    wal3 = QuorumWal(str(tmp_path / "w3.log"), "j", remotes3, quorum=2,
+                     bootstrap_from_local=True)
+    with pytest.raises(YtError):
+        wal3.recover()
 
 
 def test_orphaned_fence_recovers(tmp_path):
@@ -374,3 +388,52 @@ def test_stale_divergence_reset_is_fenced(tmp_path):
     # New master's records intact on both replicas.
     assert [r["args"]["n"] for r in remotes[0].records] == [1, 2]
     assert [r["args"]["n"] for r in remotes[1].records] == [1, 2]
+
+
+def test_partitioned_stale_master_cannot_reacquire(tmp_path):
+    """ADVICE r2: a fenced stale master that cannot probe a MAJORITY of
+    remotes must fail-stop, not re-acquire — the unreachable replica may
+    be the very location holding the new master's records."""
+    remotes = [FakeJournalChannel(), FakeJournalChannel()]
+    old = QuorumWal(str(tmp_path / "old.log"), "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
+    old.recover()
+    old.append({"op": "set", "args": {"n": 1}})
+    # A new writer acquired epoch 2 everywhere but its records landed
+    # only on replica B — which the stale master cannot reach.
+    for r in remotes:
+        r.epoch, r.writer = old.epoch + 1, "new-master"
+    remotes[1].records.append({"op": "set", "args": {"n": 2}})
+    remotes[1].down = True
+    # Stale master: append is fenced on A; the reacquire probe reaches
+    # only 1/2 remotes (not a majority) -> inconclusive -> fail-stop.
+    with pytest.raises(YtError) as err:
+        old.append({"op": "set", "args": {"n": 99}})
+    assert err.value.code in (EErrorCode.JournalEpochFenced,
+                              EErrorCode.PeerUnavailable)
+    # The new master's record on B survives untouched.
+    assert [r["args"]["n"] for r in remotes[1].records] == [1, 2]
+
+
+def test_membership_extend_seeds_before_quorum_bump(tmp_path):
+    """extend() grows the journal set after recovery: new locations get
+    the full committed log first, then the larger quorum applies, so a
+    degraded bootstrap membership is never pinned forever."""
+    first = [FakeJournalChannel()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", first, quorum=1,
+                    bootstrap_from_local=True)
+    wal.recover()
+    for i in range(3):
+        wal.append({"op": "set", "args": {"n": i}})
+    extra = [FakeJournalChannel(), FakeJournalChannel()]
+    assert wal.extend(extra) == 2
+    assert wal.quorum == 3                      # majority of 4 locations
+    for r in extra:
+        assert [x["args"]["n"] for x in r.records] == [0, 1, 2]
+    wal.append({"op": "set", "args": {"n": 3}})
+    assert [x["args"]["n"] for x in extra[0].records] == [0, 1, 2, 3]
+    # An unreachable candidate is NOT adopted (no phantom quorum member).
+    dead = FakeJournalChannel()
+    dead.down = True
+    assert wal.extend([dead]) == 0
+    assert len(wal.replicas) == 3

@@ -41,6 +41,11 @@ class HiveManager:
         self.client = client
         self.cell_id = cell_id
         self._handlers: dict[str, Callable] = {}
+        # Outbox mutation is read-modify-write over a document; concurrent
+        # posters (daemon worker threads share one manager) must not lose
+        # a message or duplicate a seqno.
+        import threading
+        self._outbox_lock = threading.Lock()
 
     def register_handler(self, message_type: str,
                          handler: Callable[[dict], "list | None"]) -> None:
@@ -61,7 +66,7 @@ class HiveManager:
         """Enqueue a message; durable before this returns (outbox state is
         a WAL mutation).  Returns the message's seqno."""
         path = self._outbox_path(dst_cell)
-        with authenticated_user(ROOT_USER):
+        with self._outbox_lock, authenticated_user(ROOT_USER):
             if not self.client.exists(path):
                 self.client.create("document", path, recursive=True)
                 self.client.set(path, {"next_seqno": 1, "messages": []})
@@ -95,10 +100,14 @@ class HiveManager:
             if dst_hive.apply(self.cell_id, msg):
                 applied += 1
         last = dst_hive.last_applied(self.cell_id)
-        remaining = [m for m in messages if m["seqno"] > last]
-        if len(remaining) != len(messages):
-            state["messages"] = remaining
-            with authenticated_user(ROOT_USER):
+        # Trim under the outbox lock, re-reading: a concurrent post may
+        # have appended past the snapshot taken above.
+        with self._outbox_lock, authenticated_user(ROOT_USER):
+            state = dict(self.client.get(path))
+            remaining = [m for m in state["messages"]
+                         if m["seqno"] > last]
+            if len(remaining) != len(state["messages"]):
+                state["messages"] = remaining
                 self.client.set(path, state)
         return applied
 

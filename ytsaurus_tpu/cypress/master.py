@@ -21,15 +21,8 @@ from typing import Any, Optional
 from ytsaurus_tpu import yson
 from ytsaurus_tpu.cypress.tree import CypressTree
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils.diskio import fsync_dir as _fsync_dir
 from ytsaurus_tpu.utils.varint import encode_varint_u, read_varint_u
-
-
-def _fsync_dir(path: str) -> None:
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
 
 
 class Changelog:
@@ -147,16 +140,39 @@ class Master:
             # One WAL record applying several tree ops atomically — the
             # carrier for Hive message application (handler effects + the
             # last-applied bump must land together for exactly-once).
-            # Sub-ops are restricted to the simple tree verbs whose only
-            # failure mode is resolution, checked up front.
+            # Name validation up front; RESOLUTION failures can still hit
+            # any sub-op mid-batch (create over an existing node, remove
+            # of a missing path), so each sub-op's undo is captured before
+            # it applies and a failure rolls the earlier sub-ops back —
+            # all-or-nothing, matching the single-WAL-record semantics
+            # (the record is only logged if the whole apply succeeds).
             ops = args["ops"]
             for sub in ops:
                 if sub["op"] not in ("create", "set", "remove"):
                     raise YtError(
                         f"batch sub-op {sub['op']!r} not allowed",
                         code=EErrorCode.Generic)
-            return [self._apply(sub["op"], dict(sub["args"]))
-                    for sub in ops]
+            undos: list = []
+            results: list = []
+            try:
+                for sub in ops:
+                    sub_args = dict(sub["args"])
+                    undos.append(
+                        self.tx_manager.capture_undo(sub["op"], sub_args))
+                    results.append(self._apply(sub["op"], sub_args))
+            except BaseException:
+                # Any failure — resolution YtError or a malformed sub-op
+                # raising KeyError — must roll earlier sub-ops back, or
+                # the tree diverges from the (never-written) WAL record.
+                try:
+                    for undo in reversed(undos):
+                        self.tx_manager.apply_undo(undo)
+                except Exception:
+                    # Rollback itself failed: the tree diverged from the
+                    # log with no record to cover it — latch read-only.
+                    self._poisoned = True
+                raise
+            return results
         # Transaction lifecycle + lock mutations (ref: transaction_server
         # master transactions riding the same Hydra mutation pipeline).
         if op == "tx_start":

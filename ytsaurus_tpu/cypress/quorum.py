@@ -152,15 +152,15 @@ class QuorumWal:
             return candidate
         # Grants are counted over the SHARED remote locations only: two
         # candidate masters have disjoint local locations, so quorums
-        # counting locals need not intersect.  A majority of remotes must
-        # grant; a replica that was down during acquisition learns the new
-        # epoch from the first append that reaches it (journal_append
-        # raises the stored epoch monotonically), and a stale writer that
-        # slips records onto such a replica first is corrected by the
-        # divergence reset in _catch_up — the same
-        # acquisition-plus-lease shape as Hydra, where strict fencing of
-        # every minority subset is traded for liveness under one dead
-        # location.
+        # counting locals need not intersect.  A STRICT majority of the
+        # remote locations must grant — for even remote counts that is
+        # n/2+1 (2-of-2 for two journal nodes), so any two successful
+        # acquisitions share a granting remote and the later epoch fences
+        # the earlier writer there.  The cost is liveness: with two
+        # remotes, one dead remote blocks acquisition.  That is the
+        # trade the fencing guarantee requires (ceil(n/2) grants would
+        # let two candidates win on disjoint halves and commit divergent
+        # logs, each using own-local + its granted remote for appends).
         grants = 0
         for replica in self.replicas:
             try:
@@ -173,7 +173,7 @@ class QuorumWal:
                     grants += 1
             except YtError:
                 pass
-        needed = max(self.quorum - 1, (len(self.replicas) + 1) // 2)
+        needed = len(self.replicas) // 2 + 1
         if grants < needed:
             raise YtError(
                 f"epoch acquisition granted by {grants}/{needed} remote "
@@ -226,19 +226,26 @@ class QuorumWal:
     def _maybe_reacquire(self) -> bool:
         """Recovery from an ORPHANED fence: a takeover that died between
         acquiring its epoch and reaching quorum leaves a higher epoch
-        behind with NO records.  If no reachable location holds records
-        beyond our committed log, no new writer exists — re-acquire (we
-        observe the orphan and claim above it).  Any longer log means a
-        real new master: fail-stop."""
+        behind with NO records.  Re-acquire only on POSITIVE evidence: a
+        strict majority of remote locations answered the probe and none
+        holds records beyond our committed log.  An unreachable replica is
+        inconclusive, not absolving — it may be the very location holding
+        a new master's records, and a partitioned stale master that
+        treated silence as absence would claim a higher epoch and resume
+        writing.  Any longer log means a real new master: fail-stop."""
+        probed = 0
         for replica in self.replicas:
             try:
                 body, _ = replica.channel.call(
                     "data_node", "journal_count",
                     {"journal": self.journal_name})
+                probed += 1
                 if int(body.get("count", 0)) > len(self._records):
                     return False
             except YtError:
                 continue
+        if probed < len(self.replicas) // 2 + 1:
+            return False
         try:
             self.acquire_epoch()
             logger.warning("re-acquired journal %s after an orphaned "
@@ -365,6 +372,28 @@ class QuorumWal:
             if replica.synced_len is None:
                 self._catch_up(replica)
         return list(self._records)
+
+    def extend(self, channels: list) -> int:
+        """Grow the membership AFTER recovery: seed each new location with
+        the full committed log (position-checked appends from 0), then
+        adopt the larger quorum.  Seeding first keeps the invariant that
+        >= quorum locations hold every committed record — adopting the
+        quorum before seeding would make the existing history
+        unrecoverable under the new threshold.  Returns the number of
+        locations successfully added."""
+        added = 0
+        for channel in channels:
+            replica = _Replica(channel)
+            replica.synced_len = None
+            self.replicas.append(replica)
+            if self._catch_up(replica) and \
+                    replica.synced_len == len(self._records):
+                added += 1
+            else:
+                self.replicas.pop()
+        if added:
+            self.quorum = (1 + len(self.replicas)) // 2 + 1
+        return added
 
     def _realign_local(self) -> None:
         self.local.reset()

@@ -216,9 +216,18 @@ class MasterTransactionManager:
         if op == "create":
             # ignore_existing on a pre-existing node creates nothing —
             # undoing it must NOT delete the pre-existing subtree.
-            if tree.try_resolve(_node_path(args["path"])) is not None:
+            path = _node_path(args["path"])
+            if tree.try_resolve(path) is not None:
                 return ("noop",)
-            return ("remove_if_created", args["path"])
+            # A recursive create materializes intermediate map nodes too;
+            # the undo must remove the TOPMOST node the create builds or
+            # rollback leaves orphan ancestors behind.
+            tokens, _ = parse_ypath(args["path"])
+            for i in range(1, len(tokens) + 1):
+                candidate = "//" + "/".join(tokens[:i])
+                if tree.try_resolve(candidate) is None:
+                    return ("remove_if_created", candidate)
+            return ("remove_if_created", path)
         if op == "set":
             path = args["path"]
             tokens, attr = parse_ypath(path)
@@ -252,6 +261,15 @@ class MasterTransactionManager:
         if op == "link":
             return ("remove_if_created", args["link"])
         return ("noop",)
+
+    # Batch atomicity support: the master captures/replays undo entries
+    # around multi-op WAL records (Master._apply "batch") so a mid-batch
+    # resolution failure rolls earlier sub-ops back.
+    def capture_undo(self, op: str, args: dict) -> tuple:
+        return self._capture_undo(op, args)
+
+    def apply_undo(self, entry: tuple) -> None:
+        self._apply_undo(entry)
 
     def _apply_undo(self, entry: tuple) -> None:
         kind = entry[0]

@@ -31,7 +31,7 @@ def _write_port_file(root: str, role: str, port: int) -> None:
 
 
 def run_primary(root: str, port: int, replication_factor: int = 2,
-                journal_nodes: int = 2,
+                journal_nodes: int = 3,
                 bootstrap_timeout: float = 60.0) -> None:
     from ytsaurus_tpu import yson
     from ytsaurus_tpu.client import YtClient, YtCluster
@@ -88,14 +88,31 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         if wanted is not None:
             raise YtError(f"journal nodes {wanted} did not register within "
                           f"{bootstrap_timeout}s")
-        print(f"# no data nodes within {bootstrap_timeout}s; "
-              "falling back to local-only WAL", flush=True)
-    if chosen and wanted is None:
+        # Fewer nodes than asked for: take what registered rather than
+        # collapsing to a local-only WAL.  Epoch acquisition needs a
+        # strict majority of remotes, so an ODD remote count (default 3)
+        # keeps takeover live under one dead journal node; an even count
+        # still appends fine but requires all remotes up at takeover.
+        alive = tracker.alive()
+        if alive and journal_nodes > 0:
+            chosen = dict(sorted(alive.items())[:journal_nodes])
+            print(f"# only {len(chosen)}/{journal_nodes} journal nodes "
+                  f"registered within {bootstrap_timeout}s; using "
+                  f"{sorted(chosen)} (membership upgrades after recovery "
+                  "as more nodes register)", flush=True)
+        else:
+            print(f"# no data nodes within {bootstrap_timeout}s; "
+                  "falling back to local-only WAL", flush=True)
+
+    def _persist_journal_config(ids: list[str]) -> None:
         tmp = journal_cfg_path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(yson.dumps({"journal_node_ids": sorted(chosen)},
+            f.write(yson.dumps({"journal_node_ids": sorted(ids)},
                                binary=True))
         os.replace(tmp, journal_cfg_path)
+
+    if chosen and wanted is None:
+        _persist_journal_config(sorted(chosen))
 
     master_dir = os.path.join(root, "master")
     os.makedirs(master_dir, exist_ok=True)
@@ -117,6 +134,30 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         print(f"quorum WAL over local + {sorted(chosen)} "
               f"(quorum {locations // 2 + 1}/{locations})", flush=True)
     master = Master(master_dir, wal=wal)
+    # A membership persisted while under-strength (slow node startup on a
+    # previous boot) upgrades here, AFTER recovery: new locations are
+    # seeded with the full committed log before the larger quorum is
+    # adopted, so the sticky config never pins the cluster to a degraded
+    # journal set forever.
+    if wal is not None and len(chosen) < journal_nodes:
+        extra = {i: a for i, a in sorted(tracker.alive().items())
+                 if i not in chosen}
+        extra = dict(list(extra.items())[:journal_nodes - len(chosen)])
+        adopted = {}
+        for node_id, addr in sorted(extra.items()):
+            channel = RetryingChannel(Channel(addr, timeout=30),
+                                      attempts=2, backoff=0.1)
+            # One node at a time: only nodes the WAL actually KEPT are
+            # persisted — a failed catch-up must not become a phantom
+            # quorum member that outvotes acknowledged records next boot.
+            if wal.extend([channel]) == 1:
+                adopted[node_id] = addr
+        if adopted:
+            chosen.update(adopted)
+            _persist_journal_config(sorted(chosen))
+            print(f"quorum WAL membership upgraded to "
+                  f"{sorted(chosen)} (quorum {wal.quorum})",
+                  flush=True)
     # The primary holds NO chunk location of its own: all chunk data lives
     # on data-node processes.
     store = RpcChunkStore(tracker.alive_nodes,
@@ -190,8 +231,10 @@ def main() -> None:
     parser.add_argument("--primary", default=None,
                         help="primary address (node role)")
     parser.add_argument("--replication-factor", type=int, default=2)
-    parser.add_argument("--journal-nodes", type=int, default=2,
-                        help="remote WAL locations (0 = local-only WAL)")
+    parser.add_argument("--journal-nodes", type=int, default=3,
+                        help="remote WAL locations (0 = local-only WAL); "
+                             "odd counts keep takeover live under one "
+                             "dead journal node")
     parser.add_argument("--node-id", default=None)
     parser.add_argument("--bootstrap-timeout", type=float, default=60.0)
     args = parser.parse_args()

@@ -5,6 +5,15 @@ from __future__ import annotations
 import os
 
 
+def fsync_dir(path: str) -> None:
+    """Make a rename/creation in `path` durable."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def read_epoch_file(path: str) -> tuple[int, str]:
     """(epoch, writer_id) from a fenced-epoch sidecar; (0, "") when
     missing/corrupt (corrupt = no fencing history, same as fresh)."""
@@ -19,11 +28,15 @@ def read_epoch_file(path: str) -> tuple[int, str]:
 
 
 def write_epoch_file(path: str, epoch: int, writer_id: str) -> None:
-    """Atomic, fsync'd publish of (epoch, writer_id)."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    """Atomic, fsync'd publish of (epoch, writer_id).  The parent
+    directory is fsync'd after the rename: a granted/adopted fence epoch
+    must survive a crash, or a node can forget a grant it already made."""
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(f"{epoch} {writer_id}".encode())
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(parent)
