@@ -227,12 +227,24 @@ def _load_verified():
         return None
 
 
+def _git_rev():
+    try:
+        import subprocess
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
 def _save_verified(platform, name, line, n_rows, best):
     data = _load_verified() or {}
     results = data.setdefault("results", {})
     results[name] = {
         "line": line, "n_rows": n_rows, "best_ms": round(best * 1e3, 2),
-        "device": platform,
+        "device": platform, "rev": _git_rev(),
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     data["device"] = platform
@@ -243,10 +255,15 @@ def _save_verified(platform, name, line, n_rows, best):
 
 
 def _emit_verified(name, entry):
-    # In-band staleness marker: a replayed capture must be
-    # distinguishable from a fresh measurement in stdout alone.
+    # In-band staleness markers: a replayed capture must be
+    # distinguishable from a fresh measurement in stdout alone.  The
+    # revision it was captured at is included rather than gating replay
+    # on it — the capture exists precisely so an end-of-round outage
+    # (after later commits) can't zero a round that HAS on-chip numbers.
     line = dict(entry["line"])
     line["replayed_from"] = entry["captured_at"]
+    if entry.get("rev"):
+        line["captured_rev"] = entry["rev"]
     print(json.dumps(line), flush=True)
     print(f"# config={name} VERIFIED on-chip capture from "
           f"{entry['captured_at']} (n_rows={entry['n_rows']} "
@@ -282,7 +299,8 @@ def _run_config(name, args, platform):
     line = _emit(metric, rows_per_sec)
     print(f"# config={name} n_rows={n_rows} best={best*1e3:.2f}ms "
           f"device={platform}", file=sys.stderr)
-    if platform != "cpu" and not args.smoke:
+    if platform != "cpu" and not args.smoke and args.rows is None:
+        # Only default-config runs are representative enough to replay.
         _save_verified(platform, name, line, n_rows, best)
 
 
@@ -353,7 +371,9 @@ def _run_all(names, args, platform, emit_fallback):
             emit_fallback(name)
             continue
         if name == "q1":
-            child_timeout = max(60.0, remaining - 10.0)
+            # Never exceed the global budget: a hung q1 child must die
+            # early enough for the fallback line to print in-budget.
+            child_timeout = max(20.0, remaining - 10.0)
         else:
             left = len([n for n in names[idx:] if n != "q1"])
             child_timeout = max(45.0, (remaining - q1_reserve) / left)

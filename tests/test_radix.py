@@ -1,0 +1,157 @@
+"""Tiled radix sort engine (ops/radix.py) + exact group ordering.
+
+Correctness oracle: numpy stable sorts.  The radix engine must match the
+variadic-network engine bit-for-bit (same stable order) for every key
+shape, because stable_argsort_u32 dispatches between them by size.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ytsaurus_tpu.ops.radix import radix_argsort_u32
+from ytsaurus_tpu.ops.segments import (
+    hash_group_order,
+    pack_key_planes_bits,
+    packed_sort_indices,
+    segment_boundaries,
+    stable_argsort_u32,
+)
+
+
+def _np_stable_argsort(words):
+    # np.lexsort takes minor key FIRST; words are major-first.
+    return np.lexsort(tuple(np.asarray(w) for w in reversed(words)))
+
+
+@pytest.mark.parametrize("n", [1, 2, 5, 100, 2048, 2049, 5000, 100_000])
+def test_radix_single_word(n):
+    rng = np.random.default_rng(n)
+    word = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+    got = np.asarray(radix_argsort_u32([word]))
+    expect = _np_stable_argsort([word])
+    np.testing.assert_array_equal(got, expect)
+
+
+@pytest.mark.parametrize("engine", ["gather", "scatter"])
+def test_radix_multi_word(engine):
+    rng = np.random.default_rng(7)
+    n = 10_000
+    keys = rng.integers(0, 1 << 63, n, dtype=np.uint64)
+    hi = jnp.asarray((keys >> 32).astype(np.uint32))
+    lo = jnp.asarray(keys.astype(np.uint32))
+    got = np.asarray(radix_argsort_u32([hi, lo], engine=engine))
+    expect = _np_stable_argsort([hi, lo])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_radix_stability_with_duplicates():
+    rng = np.random.default_rng(3)
+    n = 50_000
+    word = jnp.asarray(rng.integers(0, 7, n, dtype=np.uint32))
+    got = np.asarray(radix_argsort_u32([word]))
+    expect = _np_stable_argsort([word])
+    np.testing.assert_array_equal(got, expect)      # ties keep input order
+
+
+def test_radix_word_bits_skips_high_bytes():
+    rng = np.random.default_rng(11)
+    n = 30_000
+    word = jnp.asarray(rng.integers(0, 1 << 12, n, dtype=np.uint32))
+    got = np.asarray(radix_argsort_u32([word], word_bits=[12]))
+    expect = _np_stable_argsort([word])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_radix_all_equal_and_extremes():
+    n = 4096
+    ones = jnp.full(n, 0xFFFFFFFF, dtype=jnp.uint32)
+    np.testing.assert_array_equal(np.asarray(radix_argsort_u32([ones])),
+                                  np.arange(n))
+    zeros = jnp.zeros(n, dtype=jnp.uint32)
+    np.testing.assert_array_equal(np.asarray(radix_argsort_u32([zeros])),
+                                  np.arange(n))
+
+
+def test_engine_dispatch_matches_network(monkeypatch):
+    rng = np.random.default_rng(5)
+    n = 20_000
+    w1 = jnp.asarray(rng.integers(0, 50, n, dtype=np.uint32))
+    w2 = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+    monkeypatch.setenv("YT_TPU_SORT_ENGINE", "network")
+    a = np.asarray(stable_argsort_u32([w1, w2]))
+    monkeypatch.setenv("YT_TPU_SORT_ENGINE", "radix")
+    b = np.asarray(stable_argsort_u32([w1, w2]))
+    monkeypatch.setenv("YT_TPU_SORT_ENGINE", "lsd32")
+    c = np.asarray(stable_argsort_u32([w1, w2]))
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+
+
+def test_packed_sort_small_fields_radix(monkeypatch):
+    """Packed small fields (null bit + value bits in one word) sort the
+    same under the radix engine, including the shifted tail word."""
+    rng = np.random.default_rng(9)
+    n = 10_000
+    data = jnp.asarray(rng.integers(0, 30, n, dtype=np.int64))
+    valid = jnp.asarray(rng.random(n) > 0.1)
+    items = [(data, valid, False, 5),
+             (jnp.asarray(rng.integers(0, 4, n, dtype=np.int64)),
+              jnp.ones(n, dtype=bool), True, 2)]
+    monkeypatch.setenv("YT_TPU_SORT_ENGINE", "network")
+    a = np.asarray(packed_sort_indices(items))
+    monkeypatch.setenv("YT_TPU_SORT_ENGINE", "radix")
+    b = np.asarray(packed_sort_indices(items))
+    np.testing.assert_array_equal(a, b)
+    words, bits = pack_key_planes_bits(items)
+    assert len(words) == 1 and bits == [9]       # 1+5 + 1+2 bits packed
+
+
+@pytest.mark.parametrize("engine", ["network", "radix"])
+def test_group_order_exact_null_vs_zero(monkeypatch, engine):
+    """NULL and literal 0 are distinct groups; masked rows sort last;
+    group identity is exact (no hash involved)."""
+    monkeypatch.setenv("YT_TPU_SORT_ENGINE", engine)
+    data = jnp.asarray([0, 5, 0, 5, 0, 7], dtype=jnp.int64)
+    valid = jnp.asarray([True, True, False, True, True, True])
+    mask = jnp.asarray([True, True, True, True, True, False])
+    order = np.asarray(hash_group_order([(data, valid)], mask))
+    # Masked row (index 5) last.
+    assert order[-1] == 5
+    sorted_keys = [(data[order], valid[order])]
+    seg, nseg = segment_boundaries(sorted_keys, mask[order])
+    # Groups: NULL, 0, 5 -> 3 groups (7 is masked out).
+    assert int(nseg) == 3
+    # The NULL row (2) must not group with the zero rows (0, 4).
+    seg = np.asarray(seg)
+    pos = {int(r): seg[i] for i, r in enumerate(order)}
+    assert pos[0] == pos[4]
+    assert pos[2] != pos[0]
+    assert pos[1] == pos[3]
+
+
+def test_group_order_multi_key_adjacency():
+    rng = np.random.default_rng(17)
+    n = 30_000
+    k1 = jnp.asarray(rng.integers(-50, 50, n, dtype=np.int64))
+    v1 = jnp.asarray(rng.random(n) > 0.05)
+    k2 = jnp.asarray(rng.random(n).astype(np.float64) * 4 // 1)
+    v2 = jnp.asarray(rng.random(n) > 0.05)
+    mask = jnp.asarray(rng.random(n) > 0.1)
+    order = np.asarray(hash_group_order([(k1, v1), (k2, v2)], mask))
+    # Every (key-tuple) group must be CONTIGUOUS among unmasked rows.
+    mask_np = np.asarray(mask)
+    rows = [(bool(mask_np[i]),
+             (None if not v1[i] else int(k1[i]),
+              None if not v2[i] else float(k2[i])))
+            for i in np.asarray(order)]
+    unmasked = [key for m, key in rows if m]
+    assert all(not m for m, _ in rows[len(unmasked):])   # masked tail
+    seen = set()
+    prev = object()
+    for key in unmasked:
+        if key != prev:
+            assert key not in seen, f"group {key} fragmented"
+            seen.add(key)
+            prev = key

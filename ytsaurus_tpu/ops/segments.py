@@ -368,7 +368,7 @@ def monotone_u32_words(data: jax.Array,
     return [jnp.where(valid, w, zero) for w in words]
 
 
-def pack_key_planes(items) -> list[jax.Array]:
+def pack_key_planes_bits(items) -> tuple[list[jax.Array], list[int]]:
     """items: (data, valid, descending, value_bits) MAJOR key first.
 
     value_bits <= 31 asserts the encoded value fits [0, 2^bits) AND
@@ -376,9 +376,12 @@ def pack_key_planes(items) -> list[jax.Array]:
     booleans, small ints); anything wider goes full-width via
     monotone_u32_words.  Each field carries a null bit above its value
     (ascending: null sorts first; descending: null sorts last — YT
-    comparator semantics).  Returns u32 planes, major word first: TPU
-    compares u32 natively, so the sort network never touches an emulated
-    64-bit comparator."""
+    comparator semantics).  Returns (u32 planes major-first, significant
+    LOW bits per plane): the last word is shifted down so its unused bits
+    sit HIGH and zero, letting the radix engine skip whole byte passes
+    (a 12-bit packed key costs 2 passes, not 4).  TPU compares u32
+    natively, so no sort path ever touches an emulated 64-bit
+    comparator."""
     words: list[jax.Array] = []
     bits_left = 0
 
@@ -408,34 +411,60 @@ def pack_key_planes(items) -> list[jax.Array]:
             enc = jnp.where(valid, enc, jnp.zeros_like(enc))
             push((null_plane << np.uint32(value_bits)) | enc,
                  value_bits + 1)
-    return words
+    sig = [32] * len(words)
+    if words and bits_left:
+        # Unused bits of the final word move from LOW to HIGH (zeros):
+        # relative order is unchanged, and byte passes above the
+        # significant width can be skipped.
+        words[-1] = words[-1] >> np.uint32(bits_left)
+        sig[-1] = 32 - bits_left
+    return words, sig
 
 
-# Above this row count, multi-word variadic sorts leave the single-pass
-# network (which emulates the composite comparator inside every compare)
-# for the LSD radix path below.  Tunable: the v5e cliff sits past ~8M.
+# Above this row count, sorts leave the single-pass network (which
+# re-evaluates the composite comparator inside every compare-exchange of
+# an O(n log^2 n) network whose depth grows with the FULL row count) for
+# the tiled radix engine.  Tunable: the v5e cliff sits past ~8M.
 LSD_SORT_THRESHOLD = int(os.environ.get("YT_TPU_LSD_SORT_THRESHOLD",
                                         8 * 1024 * 1024))
 
 
 def stable_argsort_u32(words: list[jax.Array],
-                       lsd: "bool | None" = None) -> jax.Array:
+                       lsd: "bool | None" = None,
+                       word_bits: "list[int] | None" = None) -> jax.Array:
     """Stable ascending argsort over u32 key words (major first); the
     payload rides as a u32 iota so no 64-bit plane enters the sort.
 
-    Large multi-word keys take an LSD radix path: one stable SINGLE-key
-    sort per word, least-significant first (radix 2^32 with XLA's native
-    u32 sort as the digit pass).  Every comparator stays one native word
-    wide, which is what the one-pass variadic network cannot do — its
-    composite comparator re-evaluates every word inside each of the
-    O(n log^2 n) compare-exchanges, and collapses past ~8M rows on v5e
-    (the round-1 "sort cliff"; the analog of the reference's partition
-    tree for arbitrarily large keyspaces, sort_controller.cpp:459+)."""
+    word_bits[k] (optional) bounds the significant LOW bits of word k —
+    the radix engine skips byte passes above the bound.
+
+    Engine dispatch (YT_TPU_SORT_ENGINE overrides):
+      network — one variadic lax.sort; best below the ~8M network cliff.
+      lsd32   — one full-width stable u32 lax.sort per word (round-2
+                engine, kept for measurement).
+      radix   — tiled 8-bit LSD counting sort (ops/radix.py): per-TILE
+                sort networks + histogram rank movement; depth never
+                grows with n.  Default past LSD_SORT_THRESHOLD.
+      radix_scatter — radix with the permutation-scatter write path.
+    """
     n = words[0].shape[0]
+    engine = os.environ.get("YT_TPU_SORT_ENGINE", "auto")
+    if lsd is not None:                      # explicit caller override
+        engine = "lsd32" if lsd else "network"
+    if engine == "auto":
+        # The network's comparator cost grows with operand count too
+        # (round-1 observation: full multi-plane lexsorts collapse past
+        # ~4M rows), so the cliff threshold scales down with word count.
+        effective = min(LSD_SORT_THRESHOLD,
+                        2 * LSD_SORT_THRESHOLD // max(len(words), 1))
+        engine = "network" if n <= effective else "radix"
+    if engine in ("radix", "radix_scatter"):
+        from ytsaurus_tpu.ops.radix import radix_argsort_u32
+        return radix_argsort_u32(
+            words, word_bits,
+            engine="scatter" if engine == "radix_scatter" else "gather")
     iota = jnp.arange(n, dtype=jnp.uint32)
-    if lsd is None:
-        lsd = len(words) > 1 and n > LSD_SORT_THRESHOLD
-    if lsd:
+    if engine == "lsd32":
         perm = iota
         for word in reversed(words):
             keys = jnp.take(word, perm)
@@ -449,51 +478,47 @@ def stable_argsort_u32(words: list[jax.Array],
 
 def packed_sort_indices(items) -> jax.Array:
     """Stable ascending argsort over packed key fields (major first)."""
-    return stable_argsort_u32(pack_key_planes(items))
+    words, bits = pack_key_planes_bits(items)
+    return stable_argsort_u32(words, word_bits=bits)
 
 
-# --- hash-major grouping ------------------------------------------------------
-
-def _group_hash(data: jax.Array, valid: jax.Array,
-                seed: np.uint64) -> jax.Array:
-    if jnp.issubdtype(data.dtype, jnp.floating):
-        hi, lo = _f64_bits_u32(data)
-        x = (hi.astype(jnp.uint64) << np.uint64(32)) | lo.astype(jnp.uint64)
-    else:
-        x = data.astype(jnp.uint64)
-    x = jnp.where(valid, x, np.uint64(0x9E3779B97F4A7C15))
-    x = (x ^ (x >> np.uint64(33))) * (np.uint64(0xFF51AFD7ED558CCD) ^ seed)
-    x = (x ^ (x >> np.uint64(29))) * np.uint64(0xC4CEB9FE1A85EC53)
-    return x ^ (x >> np.uint64(32)) ^ (valid.astype(jnp.uint64) <<
-                                       np.uint64(63 - (int(seed) & 7)))
-
+# --- exact grouping order -----------------------------------------------------
 
 def hash_group_order(key_planes, mask) -> jax.Array:
-    """Row ordering that makes equal group keys adjacent WITHOUT sorting
-    the key planes themselves: a 128-bit mix of every key plane is sorted
-    instead (2 u64 operands however many group keys there are).
+    """Row ordering that makes equal group keys adjacent, masked rows
+    last, using the EXACT order-preserving key encoding.
 
-    Group identity rides on 128 hash bits: two distinct key tuples
-    colliding on both words (~2^-128-scale at realistic cardinalities,
-    same trust level as content-addressed storage) could fragment a group
-    into two output rows.  Boundaries are still computed by EXACT key
-    comparison downstream (segment_boundaries), so adjacent collisions
-    split correctly.  The analog of TGroupByClosure's hash table
-    (cg_routines/registry.cpp:1230) restructured for a batch device."""
-    h1 = jnp.zeros(mask.shape[0], dtype=jnp.uint64)
-    h2 = jnp.zeros(mask.shape[0], dtype=jnp.uint64)
+    History: rounds 1-2 ordered rows by a 128-bit hash of the key planes
+    (cheap fixed operand count, but a full double-word collision could
+    silently merge or fragment a group).  The tiled radix engine makes
+    the exact encoding the cheaper path as well for typical key shapes —
+    one int64 key is 9 byte passes versus the hash's 16 — so group
+    identity no longer rides on any hash bits at all: the analog of
+    TGroupByClosure's exact hash table semantics
+    (yt/yt/library/query/engine/cg_routines/registry.cpp:1230), reached
+    by counting-sort adjacency instead of open addressing.
+
+    Encoding: word0 packs [masked-out bit (most significant) | one
+    validity bit per key], then each key contributes its full monotone
+    u32 words.  Invalid values are zeroed by monotone_u32_words, so the
+    validity bit alone distinguishes NULL from literal zero."""
+    n = mask.shape[0]
+    words: list[jax.Array] = []
+    bits: list[int] = []
+    flags = (~mask).astype(jnp.uint32)
+    nflag = 1
     for data, valid in key_planes:
-        h1 = (h1 ^ _group_hash(data, valid, np.uint64(0))) * \
-            np.uint64(0x100000001B3) + (h1 << np.uint64(7))
-        h2 = (h2 ^ _group_hash(data, valid, np.uint64(0xA5A5A5A5))) * \
-            np.uint64(0x1000193) + (h2 << np.uint64(11))
-    umax = np.uint64(0xFFFFFFFFFFFFFFFF)
-    h1 = jnp.where(mask, h1, umax)     # masked rows sort last
-    h2 = jnp.where(mask, h2, umax)
-    # The 128 hash bits ride the sort network as FOUR u32 words (native
-    # comparators) rather than two emulated u64 operands.
-    words = [(h1 >> np.uint64(32)).astype(jnp.uint32),
-             h1.astype(jnp.uint32),
-             (h2 >> np.uint64(32)).astype(jnp.uint32),
-             h2.astype(jnp.uint32)]
-    return stable_argsort_u32(words)
+        if nflag == 32:            # >31 keys: overflow into another word
+            words.append(flags)
+            bits.append(nflag)
+            flags = jnp.zeros(n, dtype=jnp.uint32)
+            nflag = 0
+        flags = (flags << np.uint32(1)) | valid.astype(jnp.uint32)
+        nflag += 1
+    words.append(flags)
+    bits.append(nflag)
+    for data, valid in key_planes:
+        vw = monotone_u32_words(data, valid)
+        words.extend(vw)
+        bits.extend([32] * len(vw))
+    return stable_argsort_u32(words, word_bits=bits)

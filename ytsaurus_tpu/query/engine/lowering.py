@@ -341,10 +341,10 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                 mask = mask & v & d.astype(bool)
         elif group is not None:
             key_planes = [b.emit(ctx) for _, b in group_key_b]
-            # Hash-major grouping: the sort carries TWO u64 hash operands
-            # no matter how many group keys there are (a full lexsort of
-            # every key plane collapses on TPU beyond ~4M rows); exact
-            # boundaries are still computed on the real keys below.
+            # Exact grouping order: equal key tuples made adjacent via
+            # the order-preserving key encoding (segments.py), masked
+            # rows last; large/wide keys dispatch to the tiled radix
+            # engine (ops/radix.py) instead of the one-pass network.
             order_idx = hash_group_order(key_planes, mask)
             sorted_mask = mask[order_idx]
             sorted_keys = [(d[order_idx], v[order_idx]) for d, v in key_planes]

@@ -20,9 +20,12 @@ import time
 
 _PROBED = False
 
-# Per-attempt timeouts; short first so a healthy tunnel answers in seconds
-# and a flapping one gets several chances inside the window.
-_ATTEMPT_TIMEOUTS = (45.0, 60.0, 90.0, 120.0)
+# Early-attempt timeouts; short first so a healthy tunnel answers in
+# seconds and a flapping one gets quick retries.  The FINAL attempt uses
+# the whole remaining window, so a slow-but-alive tunnel (answers in,
+# say, 130s) still lands on the accelerator instead of being cut off by
+# escalation steps.
+_ATTEMPT_TIMEOUTS = (30.0, 60.0)
 
 
 def _probe_once(timeout: float) -> "tuple[bool, str]":
@@ -66,13 +69,20 @@ def ensure_backend(timeout: float = 120.0, window: float | None = None):
         _PROBED = True
         if window is None:
             window = float(os.environ.get("BENCH_PROBE_WINDOW", 120.0))
+        # A caller asking for a long single-probe timeout must get at
+        # least that much total grace (the final attempt runs to the
+        # window's end).
+        window = max(window, timeout)
         deadline = time.monotonic() + window
         ok = False
         attempt = 0
         while True:
-            per_attempt = min(
-                _ATTEMPT_TIMEOUTS[min(attempt, len(_ATTEMPT_TIMEOUTS) - 1)],
-                timeout, max(deadline - time.monotonic(), 5.0))
+            remaining = max(deadline - time.monotonic(), 5.0)
+            if attempt < len(_ATTEMPT_TIMEOUTS):
+                per_attempt = min(_ATTEMPT_TIMEOUTS[attempt], timeout,
+                                  remaining)
+            else:
+                per_attempt = remaining       # final attempt: all of it
             ok, reason = _probe_once(per_attempt)
             attempt += 1
             if ok:
