@@ -129,9 +129,12 @@ def radix_argsort_u32(words: list[jax.Array],
                       word_bits: "list[int] | None" = None,
                       engine: str = "gather") -> jax.Array:
     """Stable ascending argsort over u32 key words (major word first) via
-    LSD 8-bit radix passes.  `word_bits[k]` bounds the significant LOW
-    bits of word k (higher bits must be zero) — byte passes above the
-    bound are skipped, so a packed 12-bit key costs 2 passes, not 4.
+    LSD radix passes.  `word_bits[k]` bounds the significant LOW bits of
+    word k (higher bits must be zero) — digit passes above the bound are
+    skipped, so a packed 12-bit key costs 2 byte passes, not 4.
+
+    engine: "gather" | "scatter" (ops above) | "pallas" (counting pass
+    as a Pallas TPU kernel + permutation scatter, ops/pallas_radix.py).
 
     Pad rows (to the tile multiple) carry all-ones keys and sort last;
     ties against real all-ones rows resolve to the real rows first by
@@ -139,18 +142,30 @@ def radix_argsort_u32(words: list[jax.Array],
     n = words[0].shape[0]
     if word_bits is None:
         word_bits = [32] * len(words)
-    tile = min(RADIX_TILE, 1 << max(n - 1, 1).bit_length())
+    if engine == "pallas":
+        from ytsaurus_tpu.ops.pallas_radix import (
+            PALLAS_BITS,
+            PALLAS_TILE,
+            radix_pass_pallas,
+        )
+        pass_bits = PALLAS_BITS
+        tile = PALLAS_TILE
+        pass_fn = lambda d, p: radix_pass_pallas(d, p, PALLAS_BITS)  # noqa: E731
+    else:
+        pass_bits = RADIX_BITS
+        tile = min(RADIX_TILE, 1 << max(n - 1, 1).bit_length())
+        pass_fn = lambda d, p: radix_pass(d, p, engine=engine)  # noqa: E731
     padded = ((n + tile - 1) // tile) * tile
     n_pad = padded - n
     perm = jnp.arange(padded, dtype=jnp.uint32)
+    mask = np.uint32((1 << pass_bits) - 1)
     for word, bits in zip(reversed(words), reversed(word_bits)):
         if bits <= 0:
             continue
         # Pad keys sort last: all-ones is the maximum in every pass.
         fill = np.uint32((1 << min(bits, 32)) - 1)
         wpad = _pad_to_tile(word.astype(jnp.uint32), n_pad, fill)
-        for shift in range(0, min(bits, 32), RADIX_BITS):
-            digit = (jnp.take(wpad, perm) >> np.uint32(shift)) \
-                & np.uint32(_B - 1)
-            (perm,) = radix_pass(digit, [perm], engine=engine)
+        for shift in range(0, min(bits, 32), pass_bits):
+            digit = (jnp.take(wpad, perm) >> np.uint32(shift)) & mask
+            (perm,) = pass_fn(digit, [perm])
     return perm[:n]

@@ -446,6 +446,11 @@ def stable_argsort_u32(words: list[jax.Array],
                 sort networks + histogram rank movement; depth never
                 grows with n.  Default past LSD_SORT_THRESHOLD.
       radix_scatter — radix with the permutation-scatter write path.
+      radix_pallas (alias: pallas) — counting pass as a Pallas TPU
+                kernel + permutation scatter (ops/pallas_radix.py).
+
+    Unknown engine names raise (a typo must not silently run the
+    one-pass network into the very cliff the engines exist to avoid).
     """
     n = words[0].shape[0]
     engine = os.environ.get("YT_TPU_SORT_ENGINE", "auto")
@@ -458,11 +463,14 @@ def stable_argsort_u32(words: list[jax.Array],
         effective = min(LSD_SORT_THRESHOLD,
                         2 * LSD_SORT_THRESHOLD // max(len(words), 1))
         engine = "network" if n <= effective else "radix"
-    if engine in ("radix", "radix_scatter"):
+    if engine in ("radix", "radix_scatter", "radix_pallas", "pallas"):
         from ytsaurus_tpu.ops.radix import radix_argsort_u32
-        return radix_argsort_u32(
-            words, word_bits,
-            engine="scatter" if engine == "radix_scatter" else "gather")
+        sub_engine = {"radix": "gather", "radix_scatter": "scatter",
+                      "radix_pallas": "pallas",
+                      "pallas": "pallas"}[engine]
+        return radix_argsort_u32(words, word_bits, engine=sub_engine)
+    if engine not in ("network", "lsd32"):
+        raise ValueError(f"unknown YT_TPU_SORT_ENGINE {engine!r}")
     iota = jnp.arange(n, dtype=jnp.uint32)
     if engine == "lsd32":
         perm = iota
