@@ -1,0 +1,148 @@
+"""Leader election + automatic master failover.
+
+Unit level: lease grant/renew/fence rules on the journal plane and the
+elector's takeover/step-down decisions (fake channels).  Process level:
+a 2-master cluster survives a leader kill mid-write-load with no
+acknowledged-write loss (ref: Hydra elections + lease_tracker,
+yt/yt/server/lib/election/).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tests.test_quorum_wal import FakeJournalChannel
+from ytsaurus_tpu.cypress.election import LeaderElector
+from ytsaurus_tpu.cypress.quorum import QuorumWal
+from ytsaurus_tpu.errors import YtError
+
+
+class FakeLeaseChannel(FakeJournalChannel):
+    """Adds the lease surface of DataNodeService."""
+
+    def __init__(self):
+        super().__init__()
+        self.lease = ("", 0.0)
+
+    def call(self, service, method, body=None, attachments=(), **kw):
+        if self.down:
+            raise YtError("down", code=2)
+        if method == "journal_lease":
+            holder, expiry = self.lease
+            return {"writer": holder, "epoch": self.epoch,
+                    "remaining": max(expiry - time.monotonic(), 0.0)}, []
+        if method == "journal_lease_renew":
+            if body["epoch"] < self.epoch or (
+                    body["epoch"] == self.epoch and self.writer and
+                    body["writer"] != self.writer):
+                return {"granted": False, "epoch": self.epoch}, []
+            self.lease = (body["writer"],
+                          time.monotonic() + body["ttl"])
+            return {"granted": True}, []
+        if method == "journal_acquire":
+            holder, expiry = self.lease
+            if holder and holder != body.get("writer") and \
+                    time.monotonic() < expiry:
+                return {"granted": False, "epoch": self.epoch,
+                        "lease_holder": holder}, []
+            out = super().call(service, method, body, attachments, **kw)
+            if out[0].get("granted") and body.get("lease_ttl"):
+                self.lease = (body.get("writer"),
+                              time.monotonic() + body["lease_ttl"])
+            return out
+        return super().call(service, method, body, attachments, **kw)
+
+
+def test_acquire_grants_lease_and_blocks_disruption(tmp_path):
+    remotes = [FakeLeaseChannel(), FakeLeaseChannel(), FakeLeaseChannel()]
+    leader = QuorumWal(str(tmp_path / "a.log"), "j", remotes, quorum=2,
+                       count_local_ack=False, bootstrap_from_local=True,
+                       lease_ttl=5.0)
+    leader.recover()
+    # Lease landed with the acquisition on every remote.
+    assert all(r.lease[0] == leader.writer_id for r in remotes)
+    # A flapping standby cannot fence the healthy leader: acquisition is
+    # refused while the lease stands.
+    standby = QuorumWal(str(tmp_path / "b.log"), "j", remotes, quorum=2,
+                        count_local_ack=False, lease_ttl=5.0)
+    with pytest.raises(YtError):
+        standby.recover()
+    leader.append({"op": "set", "args": {"n": 1}})   # still the writer
+
+
+def test_elector_waits_for_foreign_lease_expiry(tmp_path):
+    remotes = [FakeLeaseChannel(), FakeLeaseChannel(), FakeLeaseChannel()]
+    for r in remotes:
+        r.lease = ("other-writer", time.monotonic() + 0.8)
+    elector = LeaderElector("j", remotes, "me", lease_ttl=1.0,
+                            poll_interval=0.1)
+    t0 = time.monotonic()
+    assert elector.wait_until_electable(timeout=10.0)
+    assert time.monotonic() - t0 >= 0.7      # waited out the lease
+    elector.stop()
+
+
+def test_elector_step_down_when_fenced():
+    remotes = [FakeLeaseChannel(), FakeLeaseChannel(), FakeLeaseChannel()]
+    for r in remotes:
+        r.epoch, r.writer = 1, "me"
+        r.lease = ("me", time.monotonic() + 5.0)
+    lost = threading.Event()
+    elector = LeaderElector("j", remotes, "me", lease_ttl=0.9)
+    elector.start_renewing(1, lost.set)
+    time.sleep(0.4)
+    assert not lost.is_set()                 # healthy renewal
+    # A new writer fences the epoch on every location.
+    for r in remotes:
+        r.epoch, r.writer = 2, "usurper"
+    assert lost.wait(timeout=5.0)            # step-down fires
+    elector.stop()
+
+
+def test_leader_failover_no_acked_write_loss(tmp_path):
+    """VERDICT r2 #3 done-criterion: kill the leader mid-write-load;
+    the standby takes over and every ACKNOWLEDGED write survives."""
+    from ytsaurus_tpu.environment import LocalCluster
+    from ytsaurus_tpu.remote_client import connect_remote
+
+    with LocalCluster(str(tmp_path / "c"), n_nodes=3, n_masters=2,
+                      lease_ttl=3.0) as cluster:
+        client = connect_remote(cluster.master_addresses)
+        client.create("map_node", "//home/f", recursive=True)
+        acked: list[int] = []
+        failed: list[int] = []
+        done = threading.Event()
+
+        def writer():
+            for i in range(400):
+                try:
+                    client.create("document", f"//home/f/d{i}")
+                    acked.append(i)
+                except YtError:
+                    failed.append(i)   # in-flight during failover: fine
+                if done.is_set():
+                    return
+
+        thread = threading.Thread(target=writer)
+        thread.start()
+        try:
+            # Let some writes land, then kill the leader.
+            deadline = time.monotonic() + 30
+            while len(acked) < 20 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert len(acked) >= 20
+            killed = cluster.kill_leader()
+            # Writes continue through the failover window.
+            thread.join(timeout=180)
+            assert not thread.is_alive()
+        finally:
+            done.set()
+            thread.join(timeout=30)
+        new_leader = cluster.leader_index(timeout=60)
+        assert new_leader != killed
+        # Failover actually made progress: writes landed after the kill.
+        assert len(acked) >= 50
+        names = set(client.list("//home/f"))
+        missing = [i for i in acked if f"d{i}" not in names]
+        assert not missing, f"acked writes lost: {missing[:10]}"

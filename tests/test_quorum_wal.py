@@ -437,3 +437,38 @@ def test_membership_extend_seeds_before_quorum_bump(tmp_path):
     dead.down = True
     assert wal.extend([dead]) == 0
     assert len(wal.replicas) == 3
+
+
+def test_remote_only_quorum_survives_leader_loss(tmp_path):
+    """Election-mode quorum math: with count_local_ack=False an acked
+    record lives on a strict majority of REMOTES, so a successor master
+    recovering with a FRESH local location cannot lose it.  (With
+    local-credit quorums the same scenario drops the record: ack =
+    local + 2-of-3 remotes, but the successor reads only the remotes.)"""
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2(),
+               FakeJournalChannelV2()]
+    a = QuorumWal(str(tmp_path / "a.log"), "j", remotes, quorum=2,
+                  count_local_ack=False, bootstrap_from_local=True)
+    a.recover()
+    remotes[2].down = True                   # one remote out
+    a.append({"op": "set", "args": {"n": 1}})    # acked: r0 + r1 = 2/2
+    # Leader host dies entirely; lagging remote returns.
+    remotes[2].down = False
+    b = QuorumWal(str(tmp_path / "b.log"), "j", remotes, quorum=2,
+                  count_local_ack=False)
+    records = b.recover()
+    assert [r["args"]["n"] for r in records] == [1]
+
+
+def test_remote_only_quorum_append_needs_remote_majority(tmp_path):
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2(),
+               FakeJournalChannelV2()]
+    wal = QuorumWal(str(tmp_path / "w.log"), "j", remotes, quorum=2,
+                    count_local_ack=False, bootstrap_from_local=True)
+    wal.recover()
+    remotes[0].down = True
+    remotes[1].down = True
+    # Local append alone earns no credit: 1-of-3 remotes < 2.
+    with pytest.raises(YtError) as err:
+        wal.append({"op": "set", "args": {"n": 1}})
+    assert err.value.code == EErrorCode.PeerUnavailable

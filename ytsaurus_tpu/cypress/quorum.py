@@ -97,11 +97,25 @@ class QuorumWal:
 
     def __init__(self, local_path: str, journal_name: str,
                  remote_channels: list, quorum: int = 2,
-                 bootstrap_from_local: bool = False):
+                 bootstrap_from_local: bool = False,
+                 lease_ttl: float = 0.0,
+                 count_local_ack: bool = True):
         self.local = LocalWal(local_path)
         self.journal_name = journal_name
         self.replicas = [_Replica(ch) for ch in remote_channels]
         self.quorum = quorum
+        # >0 under leader election: epoch acquisition also claims the
+        # leader lease on each granting location (see LeaderElector).
+        self.lease_ttl = lease_ttl
+        # count_local_ack=False = REMOTE-ONLY quorum, required under
+        # multi-master failover: a successor master recovers with a
+        # FRESH local location, so a record acked against "local + k
+        # remotes" may sit on only k remotes — the read and write
+        # quorums must intersect over the SHARED (remote) locations
+        # alone.  The local file still takes every append; it just earns
+        # no quorum credit and no recovery vote (it accelerates
+        # restart-in-place, like a Hydra follower's local changelog).
+        self.count_local_ack = count_local_ack
         # True exactly when this quorum configuration is being adopted for
         # the first time over an existing single-location log: the local
         # history is authoritative and seeds the replicas.
@@ -162,12 +176,14 @@ class QuorumWal:
         # let two candidates win on disjoint halves and commit divergent
         # logs, each using own-local + its granted remote for appends).
         grants = 0
+        acquire_body = {"journal": self.journal_name, "epoch": candidate,
+                        "writer": self.writer_id}
+        if self.lease_ttl > 0:
+            acquire_body["lease_ttl"] = self.lease_ttl
         for replica in self.replicas:
             try:
                 body, _ = replica.channel.call(
-                    "data_node", "journal_acquire",
-                    {"journal": self.journal_name, "epoch": candidate,
-                     "writer": self.writer_id},
+                    "data_node", "journal_acquire", dict(acquire_body),
                     idempotent=False)
                 if body.get("granted"):
                     grants += 1
@@ -268,7 +284,8 @@ class QuorumWal:
         reacquired = False
         try:
             self.local.append(record)
-            acks += 1
+            if self.count_local_ack:
+                acks += 1
         except OSError as exc:          # local disk failure
             errors.append(YtError(f"local WAL append failed: {exc}"))
         for replica in self.replicas:
@@ -325,8 +342,12 @@ class QuorumWal:
                 replica.synced_len = None
                 self._catch_up(replica)
             return list(self._records)
+        # Under remote-only quorum the local history holds no vote (a
+        # successor's fresh local must not dilute the read quorum, and a
+        # stale local must not stretch it).
         lists: list[Optional[list]] = [
-            local_records if local_initialized else None]
+            local_records if local_initialized and self.count_local_ack
+            else None]
         if not local_initialized and local_records:
             raise YtError("local WAL has records but no init marker")
         for replica in self.replicas:
@@ -392,7 +413,9 @@ class QuorumWal:
             else:
                 self.replicas.pop()
         if added:
-            self.quorum = (1 + len(self.replicas)) // 2 + 1
+            locations = len(self.replicas) + \
+                (1 if self.count_local_ack else 0)
+            self.quorum = locations // 2 + 1
         return added
 
     def _realign_local(self) -> None:
@@ -419,8 +442,9 @@ class QuorumWal:
     # -- replicated snapshots --------------------------------------------------
 
     def store_snapshot(self, seq: int, blob: bytes) -> None:
-        """Replicate the snapshot to >= quorum-1 journal locations (the
-        local copy is the quorum-th) BEFORE journals are truncated."""
+        """Replicate the snapshot to enough journal locations BEFORE the
+        journals are truncated: quorum-1 remotes when the local copy
+        counts toward the quorum, a full remote quorum otherwise."""
         acks = 0
         errors = []
         for replica in self.replicas:
@@ -433,9 +457,10 @@ class QuorumWal:
                 acks += 1
             except YtError as err:
                 errors.append(err)
-        if acks < self.quorum - 1:
+        needed = self.quorum - 1 if self.count_local_ack else self.quorum
+        if acks < needed:
             raise YtError(
-                f"snapshot replication reached {acks}/{self.quorum - 1} "
+                f"snapshot replication reached {acks}/{needed} "
                 "remote locations", code=EErrorCode.PeerUnavailable,
                 inner_errors=errors[:3])
 

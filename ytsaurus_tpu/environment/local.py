@@ -20,12 +20,16 @@ from ytsaurus_tpu.rpc import Channel, RetryingChannel
 
 class LocalCluster:
     def __init__(self, root_dir: str, n_nodes: int = 2,
-                 replication_factor: int = 2, http_proxy: bool = False):
+                 replication_factor: int = 2, http_proxy: bool = False,
+                 n_masters: int = 1, lease_ttl: float = 4.0):
         self.root_dir = root_dir
         self.n_nodes = n_nodes
+        self.n_masters = n_masters
+        self.lease_ttl = lease_ttl
         self.replication_factor = replication_factor
         self.http_proxy = http_proxy
         self.primary_address: str | None = None
+        self.master_addresses: list[str] = []
         self.http_proxy_address: str | None = None
         self.node_addresses: list[str] = []
         self._procs: list[subprocess.Popen] = []
@@ -35,20 +39,33 @@ class LocalCluster:
     def start(self, timeout: float = 120.0) -> "LocalCluster":
         os.makedirs(self.root_dir, exist_ok=True)
         deadline = time.monotonic() + timeout
+        election = self.n_masters > 1
         try:
-            primary_root = os.path.join(self.root_dir, "primary")
-            self._spawn("primary", primary_root,
-                        ["--role", "primary", "--root", primary_root,
-                         "--replication-factor",
-                         str(self.replication_factor),
-                         "--journal-nodes", str(min(2, self.n_nodes))])
-            port = self._wait_port(primary_root, "primary", deadline)
-            self.primary_address = f"127.0.0.1:{port}"
+            self._master_args: list[list[str]] = []
+            for m in range(self.n_masters):
+                name = "primary" if m == 0 else f"primary{m}"
+                primary_root = os.path.join(self.root_dir, name)
+                args = ["--role", "primary", "--root", primary_root,
+                        "--replication-factor",
+                        str(self.replication_factor),
+                        "--journal-nodes", str(min(3, self.n_nodes))]
+                if election:
+                    args += ["--election", "--master-index", str(m),
+                             "--lease-ttl", str(self.lease_ttl)]
+                self._master_args.append(args)
+                self._spawn(name, primary_root, args)
+            for m in range(self.n_masters):
+                name = "primary" if m == 0 else f"primary{m}"
+                primary_root = os.path.join(self.root_dir, name)
+                port = self._wait_port(primary_root, "primary", deadline)
+                self.master_addresses.append(f"127.0.0.1:{port}")
+            self.primary_address = self.master_addresses[0]
+            primaries = ",".join(self.master_addresses)
             for i in range(self.n_nodes):
                 node_root = os.path.join(self.root_dir, f"node{i}")
                 self._spawn(f"node{i}", node_root,
                             ["--role", "node", "--root", node_root,
-                             "--primary", self.primary_address])
+                             "--primary", primaries])
             for i in range(self.n_nodes):
                 node_root = os.path.join(self.root_dir, f"node{i}")
                 port = self._wait_port(node_root, "node", deadline)
@@ -99,26 +116,35 @@ class LocalCluster:
                       f"(see {root}/daemon.log)")
 
     def _wait_ready(self, deadline: float) -> None:
-        channel = RetryingChannel(Channel(self.primary_address, timeout=10),
-                                  attempts=3, backoff=0.2)
+        """Ready = some master is LEADER with every node registered and
+        the driver answering (under election the leader may be any
+        master)."""
+        channels = {addr: RetryingChannel(Channel(addr, timeout=10),
+                                          attempts=3, backoff=0.2)
+                    for addr in (self.master_addresses or
+                                 [self.primary_address])}
         try:
             while time.monotonic() < deadline:
                 self._check_daemons()
-                try:
-                    body, _ = channel.call("node_tracker", "list_nodes", {})
-                    alive = body.get("alive", [])
-                    if len(alive) >= self.n_nodes:
-                        # Driver comes up after WAL recovery; ready means
-                        # BOTH planes answer.
+                for addr, channel in channels.items():
+                    try:
+                        body, _ = channel.call("node_tracker",
+                                               "list_nodes", {})
+                        alive = body.get("alive", [])
+                        if len(alive) < self.n_nodes:
+                            continue
+                        # Driver comes up after WAL recovery (on the
+                        # leader only); ready means BOTH planes answer.
                         channel.call("driver", "ping", {})
                         return
-                except YtError:
-                    pass
+                    except YtError:
+                        continue
                 time.sleep(0.2)
             raise YtError(
                 f"cluster not ready: {self.n_nodes} nodes expected")
         finally:
-            channel.close()
+            for channel in channels.values():
+                channel.close()
 
     def _check_daemons(self) -> None:
         for proc in self._procs:
@@ -139,11 +165,13 @@ class LocalCluster:
                 proc.wait(timeout=10)
         self._procs.clear()
 
-    def restart_primary(self, timeout: float = 120.0) -> None:
-        """Stop the primary and bring it back on the same state root
-        (recovery-path fault injection: quorum WAL replay + snapshot load).
+    def restart_primary(self, timeout: float = 120.0,
+                        index: int = 0) -> None:
+        """Stop a master and bring it back on the same state root with
+        the SAME flags (recovery-path fault injection: quorum WAL replay
+        + snapshot load; under election it rejoins as a candidate).
         The address may change; read `primary_address` afterwards."""
-        proc = self._procs[0]
+        proc = self._procs[index]
         if proc.poll() is None:
             proc.send_signal(signal.SIGTERM)
             try:
@@ -151,29 +179,63 @@ class LocalCluster:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=10)
-        self._procs.pop(0)
+        self._procs.pop(index)
         deadline = time.monotonic() + timeout
-        primary_root = os.path.join(self.root_dir, "primary")
+        name = "primary" if index == 0 else f"primary{index}"
+        primary_root = os.path.join(self.root_dir, name)
         # Rebind the SAME port: data nodes heartbeat a fixed primary
         # address (stable daemon addresses, as in real deployments).
-        old_port = self.primary_address.rsplit(":", 1)[1]
-        self._spawn("primary", primary_root,
-                    ["--role", "primary", "--root", primary_root,
-                     "--port", old_port,
-                     "--replication-factor", str(self.replication_factor),
-                     "--journal-nodes", str(min(2, self.n_nodes))])
-        # _spawn appends; keep the primary at index 0 (kill_node contract).
-        self._procs.insert(0, self._procs.pop())
+        old_port = (self.master_addresses[index] if self.master_addresses
+                    else self.primary_address).rsplit(":", 1)[1]
+        self._spawn(name, primary_root,
+                    self._master_args[index] + ["--port", old_port])
+        # _spawn appends; keep masters before nodes (kill_node contract).
+        self._procs.insert(index, self._procs.pop())
         port = self._wait_port(primary_root, "primary", deadline)
-        self.primary_address = f"127.0.0.1:{port}"
+        if self.master_addresses:
+            self.master_addresses[index] = f"127.0.0.1:{port}"
+        if index == 0:
+            self.primary_address = f"127.0.0.1:{port}"
         self._wait_ready(deadline)
 
     def kill_node(self, index: int) -> None:
         """Hard-kill one data node (fault injection for replica fallback)."""
-        # procs[0] is the primary; nodes follow in order.
-        proc = self._procs[1 + index]
+        # procs[0..n_masters-1] are masters; nodes follow in order.
+        proc = self._procs[self.n_masters + index]
         proc.kill()
         proc.wait(timeout=10)
+
+    # -- multi-master helpers --------------------------------------------------
+
+    def leader_index(self, timeout: float = 30.0) -> int:
+        """Index of the master currently reporting role=leader."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for m, addr in enumerate(self.master_addresses):
+                if self._procs[m].poll() is not None:
+                    continue
+                channel = Channel(addr, timeout=5)
+                try:
+                    body, _ = channel.call("master", "get_role", {})
+                    role = body.get("role")
+                    role = role.decode() if isinstance(role, bytes) \
+                        else role
+                    if role == "leader":
+                        return m
+                except YtError:
+                    continue
+                finally:
+                    channel.close()
+            time.sleep(0.3)
+        raise YtError("no master reported leadership in time")
+
+    def kill_leader(self) -> int:
+        """Hard-kill the current leader master; returns its index."""
+        m = self.leader_index()
+        proc = self._procs[m]
+        proc.kill()
+        proc.wait(timeout=10)
+        return m
 
     def __enter__(self) -> "LocalCluster":
         return self.start()

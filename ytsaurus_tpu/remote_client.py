@@ -29,13 +29,26 @@ class RemoteTransaction:
 
 
 class RemoteYtClient:
-    def __init__(self, primary_address: str, timeout: float = 120.0,
-                 user: str = "root"):
-        self.primary_address = primary_address
+    def __init__(self, primary_address: "str | Sequence[str]",
+                 timeout: float = 120.0, user: str = "root"):
+        """primary_address: one address, or several (list or
+        comma-separated) under multi-master election — the client then
+        sticks to whichever master serves and rides out failovers by
+        rotating (rpc.FailoverChannel)."""
+        if isinstance(primary_address, str):
+            addresses = [a.strip() for a in primary_address.split(",")
+                         if a.strip()]
+        else:
+            addresses = list(primary_address)
+        self.primary_address = ",".join(addresses)
         self.timeout = timeout
         self.user = user
-        self._channel = RetryingChannel(
-            Channel(primary_address, timeout=timeout))
+        if len(addresses) > 1:
+            from ytsaurus_tpu.rpc import FailoverChannel
+            self._channel = FailoverChannel(addresses, timeout=timeout)
+        else:
+            self._channel = RetryingChannel(
+                Channel(addresses[0], timeout=timeout))
         self.chunk_store = RpcChunkStore(self._alive_nodes)
         from ytsaurus_tpu.operations.scheduler import OperationScheduler
         from ytsaurus_tpu.query.statistics import QueryStatistics
@@ -367,5 +380,6 @@ class RemoteYtClient:
                              sorted_by=sorted_by, schema=schema)
 
 
-def connect_remote(primary_address: str) -> RemoteYtClient:
+def connect_remote(primary_address: "str | Sequence[str]"
+                   ) -> RemoteYtClient:
     return RemoteYtClient(primary_address)

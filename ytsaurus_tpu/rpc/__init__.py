@@ -17,11 +17,15 @@ chunk blobs between hosts, and tablet commands.  Bodies are binary YSON;
 bulk bytes travel as zero-copy attachment parts.
 """
 
-from ytsaurus_tpu.rpc.channel import Channel, RetryingChannel
+from ytsaurus_tpu.rpc.channel import (
+    Channel,
+    FailoverChannel,
+    RetryingChannel,
+)
 from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
 from ytsaurus_tpu.rpc.server import RpcServer, Service, rpc_method
 
 __all__ = [
-    "Channel", "RetryingChannel", "PacketError", "read_packet",
+    "Channel", "FailoverChannel", "RetryingChannel", "PacketError", "read_packet",
     "write_packet", "RpcServer", "Service", "rpc_method",
 ]

@@ -225,3 +225,68 @@ class RetryingChannel:
 
     def close(self) -> None:
         self.channel.close()
+
+
+class FailoverChannel:
+    """Channel over several master addresses: sticks to the one that
+    last answered, rotates on errors that mean "this peer cannot serve
+    me" (dead process, or a follower without the service), and keeps
+    cycling with backoff until the failover window closes — which is
+    what rides out a leader election.
+
+    Retry semantics extend RetryingChannel's: NoSuchService (follower —
+    the call never dispatched) and TransportError rotate always;
+    RpcTimeout / PeerUnavailable rotate only for idempotent calls (a
+    timed-out mutation may have executed).
+    Ref: dynamic channel pools + peer rediscovery
+    (yt/yt/core/rpc/dynamic_channel_pool.h)."""
+
+    def __init__(self, addresses: "list[str]", timeout: float = 120.0,
+                 failover_window: float = 45.0, backoff: float = 0.3):
+        if not addresses:
+            raise ValueError("FailoverChannel needs at least one address")
+        self._channels = [Channel(a, timeout=timeout) for a in addresses]
+        self._current = 0
+        self.failover_window = failover_window
+        self.backoff = backoff
+
+    @property
+    def address(self) -> str:
+        return self._channels[self._current].address
+
+    def call(self, service: str, method: str, body=None,
+             attachments=(), timeout: float | None = None,
+             idempotent: bool = True):
+        deadline = time.monotonic() + self.failover_window
+        rotate_always = (EErrorCode.NoSuchService,
+                         EErrorCode.TransportError)
+        rotate_idempotent = rotate_always + (EErrorCode.RpcTimeout,
+                                             EErrorCode.PeerUnavailable)
+        rotatable = rotate_idempotent if idempotent else rotate_always
+        last: YtError | None = None
+        cycle = 0
+        while True:
+            channel = self._channels[self._current]
+            try:
+                return channel.call(service, method, body, attachments,
+                                    timeout)
+            except YtError as err:
+                if err.code not in rotatable:
+                    raise
+                last = err
+                self._current = (self._current + 1) % len(self._channels)
+                cycle += 1
+                if time.monotonic() > deadline:
+                    raise YtError(
+                        "no master answered within the failover window "
+                        f"({self.failover_window:.0f}s)",
+                        code=EErrorCode.PeerUnavailable,
+                        inner_errors=[last])
+                if cycle % len(self._channels) == 0:
+                    time.sleep(min(self.backoff *
+                                   (2 ** (cycle // len(self._channels))),
+                                   3.0))
+
+    def close(self) -> None:
+        for channel in self._channels:
+            channel.close()

@@ -32,9 +32,12 @@ def _write_port_file(root: str, role: str, port: int) -> None:
 
 def run_primary(root: str, port: int, replication_factor: int = 2,
                 journal_nodes: int = 3,
-                bootstrap_timeout: float = 60.0) -> None:
+                bootstrap_timeout: float = 60.0,
+                election: bool = False, master_index: int = 0,
+                lease_ttl: float = 6.0) -> None:
     from ytsaurus_tpu import yson
     from ytsaurus_tpu.client import YtClient, YtCluster
+    from ytsaurus_tpu.cypress.election import LeaderElector
     from ytsaurus_tpu.cypress.master import Master
     from ytsaurus_tpu.cypress.quorum import QuorumWal
     from ytsaurus_tpu.errors import YtError
@@ -42,6 +45,7 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     from ytsaurus_tpu.server.remote_store import RpcChunkStore
     from ytsaurus_tpu.server.services import (
         DriverService,
+        MasterService,
         NodeTracker,
         NodeTrackerService,
     )
@@ -53,11 +57,14 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     tracker = NodeTracker()
     # Bootstrap service set first: nodes must be able to register before
     # the master recovers (quorum WAL recovery reads their journals).
-    server = RpcServer([NodeTrackerService(tracker)], port=port)
+    role = {"value": "follower" if election else "leader"}
+    server = RpcServer([NodeTrackerService(tracker),
+                        MasterService(role)], port=port)
     server.start()
     _write_port_file(root, "primary", server.port)
     orchid = default_orchid()
     orchid.register("/node_tracker/alive", tracker.alive)
+    orchid.register("/master/role", lambda: role["value"])
     server.add_service(OrchidService(orchid))
     monitoring = MonitoringServer(orchid)
     monitoring.start()
@@ -72,10 +79,54 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         with open(journal_cfg_path, "rb") as f:
             wanted = [j.decode() if isinstance(j, bytes) else j
                       for j in yson.loads(f.read())["journal_node_ids"]]
+    def _fetch_published_membership() -> "list[str] | None":
+        """Highest-epoch membership record found on any alive node.
+        Under multi-master election the journal nodes are the shared
+        source of truth for WHICH nodes form the quorum set — each
+        master guessing from its own registration-order view could
+        yield non-intersecting quorum sets (acked-write loss)."""
+        best: "tuple[int, list[str]] | None" = None
+        for _, addr in sorted(tracker.alive().items()):
+            channel = Channel(addr, timeout=5)
+            try:
+                body, _ = channel.call("data_node",
+                                       "journal_membership_get",
+                                       {"journal": "master_wal"})
+                members = body.get("member_ids")
+                if members is not None:
+                    members = [m.decode() if isinstance(m, bytes) else m
+                               for m in members]
+                    epoch = int(body.get("epoch", 0))
+                    if best is None or epoch > best[0]:
+                        best = (epoch, members)
+            except YtError:
+                continue
+            finally:
+                channel.close()
+        return best[1] if best is not None else None
+
     deadline = time.monotonic() + bootstrap_timeout
     chosen: dict[str, str] = {}
+    if election:
+        # Under election the sticky LOCAL config is advisory only: the
+        # record published on the journal nodes (highest epoch) always
+        # wins, since membership may have been upgraded by another
+        # master while this one was down.
+        wanted = None
     while time.monotonic() < deadline:
         alive = tracker.alive()
+        if election:
+            # Prefer membership already published to the journal nodes
+            # (a previous leader's choice) over choosing our own.
+            published = _fetch_published_membership()
+            if published is not None and published != wanted:
+                wanted = published
+                continue
+            if wanted is None and master_index != 0:
+                # Standbys never bootstrap membership; they wait for the
+                # bootstrapping master's published record.
+                time.sleep(0.3)
+                continue
         if wanted is not None:
             if all(i in alive for i in wanted):
                 chosen = {i: alive[i] for i in wanted}
@@ -88,6 +139,12 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         if wanted is not None:
             raise YtError(f"journal nodes {wanted} did not register within "
                           f"{bootstrap_timeout}s")
+        if election:
+            # No degraded bootstrap under election: divergent degraded
+            # sets across masters can fail to intersect.
+            raise YtError(
+                f"election bootstrap needs {journal_nodes} journal nodes "
+                f"(or a published membership) within {bootstrap_timeout}s")
         # Fewer nodes than asked for: take what registered rather than
         # collapsing to a local-only WAL.  Epoch acquisition needs a
         # strict majority of remotes, so an ODD remote count (default 3)
@@ -117,23 +174,83 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     master_dir = os.path.join(root, "master")
     os.makedirs(master_dir, exist_ok=True)
     wal = None
+    elector = None
     if chosen:
         channels = [RetryingChannel(Channel(addr, timeout=30),
                                     attempts=2, backoff=0.1)
                     for _, addr in sorted(chosen.items())]
         locations = 1 + len(channels)
-        # First adoption of this quorum config (we just wrote the journal
-        # membership): any existing local log predates the quorum and is
-        # authoritative — it seeds the replicas instead of being outvoted
-        # by their empty journals.
-        wal = QuorumWal(os.path.join(master_dir, Master.CHANGELOG),
-                        journal_name="master_wal",
-                        remote_channels=channels,
-                        quorum=locations // 2 + 1,
-                        bootstrap_from_local=(wanted is None))
+
+        def make_wal():
+            # First adoption of this quorum config (we just wrote the
+            # journal membership): any existing local log predates the
+            # quorum and is authoritative — it seeds the replicas
+            # instead of being outvoted by their empty journals.  Under
+            # election, only master 0 may bootstrap-from-local: a fresh
+            # STANDBY's empty local history is NOT authoritative (it
+            # would reset the leader's journals to empty).
+            # Election mode uses a REMOTE-ONLY quorum: a failover
+            # successor recovers with a fresh local location, so read
+            # and write quorums must intersect over the shared journal
+            # nodes alone (see QuorumWal.count_local_ack).
+            return QuorumWal(
+                os.path.join(master_dir, Master.CHANGELOG),
+                journal_name="master_wal",
+                remote_channels=channels,
+                quorum=(len(channels) // 2 + 1) if election
+                else locations // 2 + 1,
+                count_local_ack=not election,
+                bootstrap_from_local=(
+                    wanted is None and
+                    (not election or master_index == 0)),
+                lease_ttl=lease_ttl if election else 0.0)
+
+        wal = make_wal()
         print(f"quorum WAL over local + {sorted(chosen)} "
               f"(quorum {locations // 2 + 1}/{locations})", flush=True)
-    master = Master(master_dir, wal=wal)
+    if election and wal is None:
+        raise YtError("--election requires journal nodes (the journal "
+                      "plane carries votes and leases)")
+    def _publish_membership() -> None:
+        """Write the (epoch-stamped) membership to every journal node so
+        any master resolves the same quorum set."""
+        for replica in wal.replicas:
+            try:
+                replica.channel.call(
+                    "data_node", "journal_membership_put",
+                    {"journal": wal.journal_name, "epoch": wal.epoch,
+                     "writer": wal.writer_id,
+                     "member_ids": sorted(chosen)}, idempotent=False)
+            except YtError as err:
+                print(f"# membership publish failed on one node: {err}",
+                      flush=True)
+
+    if election:
+        # Candidate loop: wait for the lease plane to be takeover-free,
+        # then try to win the epoch (which also claims the lease on each
+        # granting location).  A lost race returns to standby.
+        while True:
+            elector = LeaderElector(
+                "master_wal",
+                lambda: [r.channel for r in wal.replicas],
+                wal.writer_id, lease_ttl=lease_ttl,
+                hold_down=master_index * (lease_ttl / 4.0))
+            print(f"standby (master {master_index}): awaiting "
+                  "leadership", flush=True)
+            elector.wait_until_electable()
+            try:
+                master = Master(master_dir, wal=wal)
+                break
+            except YtError as err:
+                print(f"takeover failed: {err}; back to standby",
+                      flush=True)
+                elector.stop()
+                wal.close()          # no fd leak across retries
+                time.sleep(1.0)
+                wal = make_wal()     # fresh writer identity for next try
+        _publish_membership()
+    else:
+        master = Master(master_dir, wal=wal)
     # A membership persisted while under-strength (slow node startup on a
     # previous boot) upgrades here, AFTER recovery: new locations are
     # seeded with the full committed log before the larger quorum is
@@ -155,9 +272,26 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         if adopted:
             chosen.update(adopted)
             _persist_journal_config(sorted(chosen))
+            if election:
+                _publish_membership()
             print(f"quorum WAL membership upgraded to "
                   f"{sorted(chosen)} (quorum {wal.quorum})",
                   flush=True)
+    if election and elector is not None:
+        def on_lease_lost():
+            # The automaton may be ahead of what a new leader recovered;
+            # serving (even reads) risks confusion — fail-stop for a
+            # supervised restart as a follower (Hydra restart semantics).
+            master._poisoned = True
+            role["value"] = "follower"
+            print("leadership lost (lease not renewable); exiting for "
+                  "supervised restart", flush=True)
+            os._exit(17)
+
+        # Epoch via callable: _maybe_reacquire bumps it after orphaned
+        # fences, and renewals must follow or a healthy leader's
+        # renewals are denied everywhere.
+        elector.start_renewing(lambda: wal.epoch, on_lease_lost)
     # The primary holds NO chunk location of its own: all chunk data lives
     # on data-node processes.
     store = RpcChunkStore(tracker.alive_nodes,
@@ -165,7 +299,10 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     cluster = YtCluster(root, chunk_store=store, master=master)
     client = YtClient(cluster)
     server.add_service(DriverService(client))
-    print(f"primary serving on {server.address}", flush=True)
+    role["value"] = "leader"
+    print(f"primary serving on {server.address}"
+          + (f" (leader, master {master_index})" if election else ""),
+          flush=True)
     threading.Event().wait()       # serve until killed
 
 
@@ -193,17 +330,29 @@ def run_node(root: str, port: int, primary_address: str,
     _write_port_file(root, "node.monitoring", monitoring.port)
     print(f"data node {node_id} serving on {server.address}", flush=True)
 
-    channel = RetryingChannel(Channel(primary_address, timeout=10),
-                              attempts=2, backoff=0.1)
+    # Multi-master: heartbeat EVERY primary (comma-separated), each on
+    # its OWN thread — a hung (not dead) master must not stall the
+    # heartbeats that keep this node alive on the healthy leader.
     address = server.address
-    while True:
-        try:
-            channel.call("node_tracker", "heartbeat",
-                         {"id": node_id, "address": address})
-        except Exception as exc:      # noqa: BLE001 — keep heartbeating
-            print(f"# heartbeat to {primary_address} failed: {exc}",
-                  file=sys.stderr, flush=True)
-        time.sleep(2.0)
+
+    def beat(primary: str) -> None:
+        channel = RetryingChannel(Channel(primary, timeout=10),
+                                  attempts=2, backoff=0.1)
+        while True:
+            try:
+                channel.call("node_tracker", "heartbeat",
+                             {"id": node_id, "address": address})
+            except Exception as exc:  # noqa: BLE001 — keep heartbeating
+                print(f"# heartbeat to {primary} failed: {exc}",
+                      file=sys.stderr, flush=True)
+            time.sleep(2.0)
+
+    primaries = [a.strip() for a in primary_address.split(",")
+                 if a.strip()]
+    for primary in primaries[1:]:
+        threading.Thread(target=beat, args=(primary,),
+                         daemon=True, name=f"heartbeat-{primary}").start()
+    beat(primaries[0])
 
 
 def run_proxy(root: str, port: int, primary_address: str) -> None:
@@ -237,6 +386,14 @@ def main() -> None:
                              "dead journal node")
     parser.add_argument("--node-id", default=None)
     parser.add_argument("--bootstrap-timeout", type=float, default=60.0)
+    parser.add_argument("--election", action="store_true",
+                        help="multi-master mode: lease-based leader "
+                             "election over the journal plane")
+    parser.add_argument("--master-index", type=int, default=0,
+                        help="this master's index (staggers takeover "
+                             "attempts; index 0 bootstraps fresh "
+                             "clusters)")
+    parser.add_argument("--lease-ttl", type=float, default=6.0)
     args = parser.parse_args()
 
     # Daemons never touch accelerators; pin CPU before any jax import so a
@@ -247,7 +404,10 @@ def main() -> None:
     if args.role == "primary":
         run_primary(args.root, args.port, args.replication_factor,
                     journal_nodes=args.journal_nodes,
-                    bootstrap_timeout=args.bootstrap_timeout)
+                    bootstrap_timeout=args.bootstrap_timeout,
+                    election=args.election,
+                    master_index=args.master_index,
+                    lease_ttl=args.lease_ttl)
     elif args.role == "proxy":
         if not args.primary:
             parser.error("--primary is required for --role proxy")

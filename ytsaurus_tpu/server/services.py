@@ -33,6 +33,10 @@ class DataNodeService(Service):
         os.makedirs(journal_dir, exist_ok=True)
         self._journals: dict[str, object] = {}
         self._epochs: dict[str, tuple] = {}   # journal → (epoch, writer)
+        # journal → (writer, monotonic expiry).  In-memory only: a
+        # restarted journal node forgets leases, which merely makes a
+        # takeover attempt possible — epoch fencing still arbitrates it.
+        self._leases: dict[str, tuple[str, float]] = {}
         self._journal_lock = threading.Lock()
 
     # -- chunks ---------------------------------------------------------------
@@ -135,16 +139,106 @@ class DataNodeService(Service):
     def journal_acquire(self, body, attachments):
         """Epoch acquisition (ref Hydra changelog acquisition /
         lease_tracker fencing): a writer claims a strictly higher epoch;
-        stale writers' journal writes are rejected from then on."""
+        stale writers' journal writes are rejected from then on.
+
+        While an unexpired lease is held by a DIFFERENT writer the grant
+        is refused — a flapping standby must not fence a healthy leader
+        (disruption guard; safety never depends on it).  A granted
+        acquisition also grants the lease when lease_ttl is present, so
+        an elected leader is lease-covered before its first write."""
         name = self._check_name(_text(body["journal"]))
         epoch = int(body["epoch"])
         writer = _text(body.get("writer") or "")
+        ttl = float(body.get("lease_ttl") or 0)
         with self._journal_lock:
+            holder, expiry = self._leases.get(name, ("", 0.0))
+            if holder and holder != writer and \
+                    time.monotonic() < expiry:
+                return {"granted": False, "epoch": self._epoch_state(name)[0],
+                        "lease_holder": holder}
             stored, _ = self._epoch_state(name)
             if epoch <= stored:
                 return {"granted": False, "epoch": stored}
             self._set_epoch_state(name, epoch, writer)
+            if ttl > 0:
+                self._leases[name] = (writer, time.monotonic() + ttl)
             return {"granted": True, "epoch": epoch}
+
+    @rpc_method(concurrency=1)
+    def journal_lease_renew(self, body, attachments):
+        """Leader lease renewal: granted ONLY to the exact current epoch
+        holder — a fenced writer learns it lost leadership here, and a
+        writer that never won journal_acquire cannot install a lease by
+        presenting a higher epoch (renewal never adopts epochs; only
+        acquisition and position-checked appends do)."""
+        name = self._check_name(_text(body["journal"]))
+        epoch = int(body["epoch"])
+        writer = _text(body.get("writer") or "")
+        ttl = float(body.get("ttl") or 0)
+        with self._journal_lock:
+            stored, stored_writer = self._epoch_state(name)
+            if epoch != stored or (stored_writer and
+                                   writer != stored_writer):
+                return {"granted": False, "epoch": stored}
+            self._leases[name] = (writer, time.monotonic() + ttl)
+            return {"granted": True}
+
+    @rpc_method()
+    def journal_lease(self, body, attachments):
+        """Lease status probe for election candidates."""
+        name = self._check_name(_text(body["journal"]))
+        with self._journal_lock:
+            holder, expiry = self._leases.get(name, ("", 0.0))
+            epoch, _ = self._epoch_state(name)
+            return {"writer": holder, "epoch": epoch,
+                    "remaining": max(expiry - time.monotonic(), 0.0)}
+
+    # -- journal membership (shared source of truth for multi-master) ----------
+    #
+    # Which node ids form the quorum set is itself metadata that every
+    # master must agree on; it lives ON the journal nodes (fenced writes,
+    # epoch-stamped) so a standby reads it instead of guessing from its
+    # own view of node registration order.
+
+    def _membership_path(self, name: str) -> str:
+        import os
+        return os.path.join(self.journal_dir, name + ".members")
+
+    @rpc_method(concurrency=1)
+    def journal_membership_put(self, body, attachments):
+        import os
+
+        from ytsaurus_tpu import yson
+        name = self._check_name(_text(body["journal"]))
+        with self._journal_lock:
+            self._check_writer(name, body.get("epoch"),
+                               body.get("writer"))
+            payload = yson.dumps(
+                {"epoch": int(body["epoch"]),
+                 "member_ids": [_text(m) for m in body["member_ids"]]},
+                binary=True)
+            path = self._membership_path(name)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        return {}
+
+    @rpc_method()
+    def journal_membership_get(self, body, attachments):
+        import os
+
+        from ytsaurus_tpu import yson
+        name = self._check_name(_text(body["journal"]))
+        path = self._membership_path(name)
+        if not os.path.exists(path):
+            return {"member_ids": None, "epoch": 0}
+        with open(path, "rb") as f:
+            data = yson.loads(f.read())
+        return {"member_ids": data.get("member_ids"),
+                "epoch": int(data.get("epoch", 0))}
 
     @rpc_method()
     def journal_epoch(self, body, attachments):
@@ -250,6 +344,22 @@ class DataNodeService(Service):
         head, _, blob = data.partition(b"\n")
         meta = yson.loads(head)
         return {"seq": int(meta["seq"])}, [blob]
+
+
+class MasterService(Service):
+    """Role probe for election-aware clients: leader or follower.
+
+    Ref shape: the election service's GetStatus / cell directory role
+    discovery (yt/yt/server/lib/election/)."""
+
+    name = "master"
+
+    def __init__(self, role_ref: dict):
+        self.role_ref = role_ref       # {"value": "leader" | "follower"}
+
+    @rpc_method()
+    def get_role(self, body, attachments):
+        return {"role": self.role_ref["value"]}
 
 
 class NodeTracker:
