@@ -43,6 +43,9 @@ class YtCluster:
             Master(os.path.join(root_dir, "master"))
         self.chunk_store = chunk_store if chunk_store is not None else \
             FsChunkStore(os.path.join(root_dir, "chunks"))
+        # id -> address of live data nodes (set by the primary daemon);
+        # non-empty enables dispatching command jobs to exec-node slots.
+        self.node_directory: "Callable[[], dict] | None" = None
         self.chunk_cache = ChunkCache(self.chunk_store)
         self.transactions = TransactionManager()
         self.evaluator = Evaluator()
@@ -105,6 +108,16 @@ class YtClient:
         self._computed_plans: dict = {}
         self._table_replicator = None
         self._query_tracker = None
+
+    def exec_node_addresses(self) -> dict:
+        """id -> address of data nodes hosting exec slots ({} in pure
+        local mode, where jobs run in-process)."""
+        if self.cluster.node_directory is None:
+            return {}
+        try:
+            return dict(self.cluster.node_directory())
+        except Exception:   # noqa: BLE001 — directory is advisory
+            return {}
 
     @property
     def table_replicator(self):

@@ -314,7 +314,11 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     get speculative twins (command jobs)."""
     from ytsaurus_tpu.formats import dumps_rows, loads_rows
     from ytsaurus_tpu.operations.chunk_pools import build_stripes, split_stripe
-    from ytsaurus_tpu.operations.jobs import Job, run_command_job
+    from ytsaurus_tpu.operations.jobs import (
+        Job,
+        run_command_job,
+        run_remote_command_job,
+    )
 
     mapper: Optional[Callable] = spec.get("mapper")
     command: Optional[str] = spec.get("command")
@@ -362,11 +366,85 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
         if command is not None and snapshot_ok else None
     completed_outputs = snap.load() if snap is not None else {}
 
+    # Distributed exec plane (ref server/node/exec_node/): command jobs
+    # dispatch to job slots on data-node daemons whenever the cluster
+    # has any, reading their input chunks LOCAL-FIRST on the node; the
+    # in-process path remains for pure local mode and Python mappers.
+    exec_nodes: dict = {}
+    if command is not None and spec.get("remote_jobs", True):
+        try:
+            exec_nodes = dict(client.exec_node_addresses())
+        except Exception:   # noqa: BLE001 — directory is advisory
+            exec_nodes = {}
+    chunk_to_id: dict[int, str] = {}
+    if exec_nodes and len(input_chunk_ids) == len(chunks):
+        chunk_to_id = {id(c): cid for c, cid in
+                       zip(chunks, input_chunk_ids)}
+
     def make_run(stripe):
         if mapper is not None:
             def run_py(job):
                 return list(mapper(stripe.materialize().to_rows()))
             return run_py, False
+
+        if exec_nodes:
+            def run_remote(job):
+                from ytsaurus_tpu.server.remote_store import placement_rank
+                addrs = list(dict(exec_nodes).values())
+                by_id = all(id(c) in chunk_to_id
+                            for c, _, _ in stripe.slices)
+                body = {"command": command, "format": fmt,
+                        "op_id": op_id, "job_id": job.id,
+                        "time_limit": spec.get("job_time_limit"),
+                        "env": spec.get("environment") or {}}
+                blob = None
+                if by_id:
+                    # Node-side materialization: rank by the first
+                    # slice's chunk placement so a replica holder runs
+                    # the job (local read); rotate within the replica
+                    # set by index for spread, and past it on retries
+                    # (node-death revival).
+                    first = chunk_to_id[id(stripe.slices[0][0])]
+                    ranked = placement_rank(first, addrs)
+                    body["slices"] = [
+                        {"chunk_id": chunk_to_id[id(c)],
+                         "start": s, "end": e}
+                        for c, s, e in stripe.slices]
+                    body["peers"] = addrs
+                    spread = min(2, len(ranked))
+                    offset = (job.index + job.attempt) % spread \
+                        if job.attempt == 0 else \
+                        (job.index + job.attempt) % len(ranked)
+                    order = ranked[offset:] + ranked[:offset]
+                else:
+                    # No stable chunk ids (e.g. dynamic input): ship the
+                    # formatted rows with the spec.
+                    blob = dumps_rows(stripe.materialize().to_rows(),
+                                      fmt)
+                    offset = (job.index + job.attempt) % len(addrs)
+                    order = addrs[offset:] + addrs[:offset]
+                time_limit = spec.get("job_time_limit")
+                poll_timeout = time_limit + 60 if time_limit else None
+                last: "YtError | None" = None
+                for addr in order:
+                    try:
+                        out = run_remote_command_job(
+                            job, addr, dict(body), input_blob=blob,
+                            timeout=poll_timeout)
+                        return loads_rows(out, fmt)
+                    except YtError as err:
+                        if err.code in (EErrorCode.TransportError,
+                                        EErrorCode.PeerUnavailable,
+                                        EErrorCode.RpcTimeout,
+                                        EErrorCode.NoSuchOperation):
+                            # Node died or restarted mid-job: revive the
+                            # job on the next node.
+                            last = err
+                            continue
+                        raise
+                raise last or YtError("no exec node accepted the job",
+                                      code=EErrorCode.PeerUnavailable)
+            return run_remote, True
 
         def run_cmd(job):
             blob = dumps_rows(stripe.materialize().to_rows(), fmt)

@@ -62,6 +62,13 @@ class RemoteYtClient:
         return [a.decode() if isinstance(a, bytes) else a
                 for a in body.get("alive", [])]
 
+    def exec_node_addresses(self) -> dict:
+        """id -> address of data nodes hosting exec slots."""
+        def _t(x):
+            return x.decode() if isinstance(x, bytes) else x
+        body, _ = self._channel.call("node_tracker", "list_nodes", {})
+        return {_t(k): _t(v) for k, v in (body.get("nodes") or {}).items()}
+
     def _execute(self, command: str, parameters: Optional[dict] = None,
                  attachments=(), idempotent: bool = True):
         body, out_attachments = self._channel.call(

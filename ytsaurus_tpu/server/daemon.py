@@ -79,13 +79,18 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         with open(journal_cfg_path, "rb") as f:
             wanted = [j.decode() if isinstance(j, bytes) else j
                       for j in yson.loads(f.read())["journal_node_ids"]]
-    def _fetch_published_membership() -> "list[str] | None":
-        """Highest-epoch membership record found on any alive node.
-        Under multi-master election the journal nodes are the shared
-        source of truth for WHICH nodes form the quorum set — each
-        master guessing from its own registration-order view could
-        yield non-intersecting quorum sets (acked-write loss)."""
+    def _fetch_published_membership(
+            ) -> "tuple[list[str] | None, bool]":
+        """(highest-epoch membership record found on any alive node,
+        every-alive-node-answered).  Under multi-master election the
+        journal nodes are the shared source of truth for WHICH nodes
+        form the quorum set — each master guessing from its own
+        registration-order view could yield non-intersecting quorum
+        sets (acked-write loss).  The completeness bit gates choosing a
+        FRESH membership: "no record found" only counts when every node
+        actually answered."""
         best: "tuple[int, list[str]] | None" = None
+        complete = True
         for _, addr in sorted(tracker.alive().items()):
             channel = Channel(addr, timeout=5)
             try:
@@ -100,13 +105,17 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
                     if best is None or epoch > best[0]:
                         best = (epoch, members)
             except YtError:
+                complete = False
                 continue
             finally:
                 channel.close()
-        return best[1] if best is not None else None
+        return (best[1] if best is not None else None), complete
 
     deadline = time.monotonic() + bootstrap_timeout
     chosen: dict[str, str] = {}
+    had_prior_config = wanted is not None
+    fresh_bootstrap = False
+    clean_sweeps = 0
     if election:
         # Under election the sticky LOCAL config is advisory only: the
         # record published on the journal nodes (highest epoch) always
@@ -118,15 +127,27 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         if election:
             # Prefer membership already published to the journal nodes
             # (a previous leader's choice) over choosing our own.
-            published = _fetch_published_membership()
-            if published is not None and published != wanted:
-                wanted = published
-                continue
-            if wanted is None and master_index != 0:
-                # Standbys never bootstrap membership; they wait for the
-                # bootstrapping master's published record.
-                time.sleep(0.3)
-                continue
+            published, complete = _fetch_published_membership()
+            if published is not None:
+                if published != wanted:
+                    wanted = published
+                    continue
+            elif wanted is None:
+                # A fresh membership may be chosen ONLY by master 0, on
+                # a root with no prior config (a restart implies a
+                # published record exists somewhere — wait for it), and
+                # only after two consecutive COMPLETE sweeps of enough
+                # nodes found nothing (a transiently unreachable node
+                # may be the one holding the record).
+                clean_sweeps = clean_sweeps + 1 \
+                    if complete and len(alive) >= journal_nodes else 0
+                if master_index != 0 or had_prior_config or \
+                        clean_sweeps < 2:
+                    time.sleep(0.3)
+                    continue
+                chosen = dict(sorted(alive.items())[:journal_nodes])
+                fresh_bootstrap = True
+                break
         if wanted is not None:
             if all(i in alive for i in wanted):
                 chosen = {i: alive[i] for i in wanted}
@@ -175,24 +196,29 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     os.makedirs(master_dir, exist_ok=True)
     wal = None
     elector = None
+
+    def _build_channels(members: dict) -> list:
+        return [RetryingChannel(Channel(addr, timeout=30),
+                                attempts=2, backoff=0.1)
+                for _, addr in sorted(members.items())]
+
     if chosen:
-        channels = [RetryingChannel(Channel(addr, timeout=30),
-                                    attempts=2, backoff=0.1)
-                    for _, addr in sorted(chosen.items())]
-        locations = 1 + len(channels)
+        channels = _build_channels(chosen)
 
         def make_wal():
             # First adoption of this quorum config (we just wrote the
             # journal membership): any existing local log predates the
             # quorum and is authoritative — it seeds the replicas
             # instead of being outvoted by their empty journals.  Under
-            # election, only master 0 may bootstrap-from-local: a fresh
-            # STANDBY's empty local history is NOT authoritative (it
-            # would reset the leader's journals to empty).
+            # election only a verified FRESH bootstrap (master 0, no
+            # prior config, complete no-record sweeps) may treat local
+            # history as authoritative: anything else would reset the
+            # journals from a stale or empty local log.
             # Election mode uses a REMOTE-ONLY quorum: a failover
             # successor recovers with a fresh local location, so read
             # and write quorums must intersect over the shared journal
             # nodes alone (see QuorumWal.count_local_ack).
+            locations = 1 + len(channels)
             return QuorumWal(
                 os.path.join(master_dir, Master.CHANGELOG),
                 journal_name="master_wal",
@@ -201,13 +227,12 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
                 else locations // 2 + 1,
                 count_local_ack=not election,
                 bootstrap_from_local=(
-                    wanted is None and
-                    (not election or master_index == 0)),
+                    fresh_bootstrap if election else wanted is None),
                 lease_ttl=lease_ttl if election else 0.0)
 
         wal = make_wal()
         print(f"quorum WAL over local + {sorted(chosen)} "
-              f"(quorum {locations // 2 + 1}/{locations})", flush=True)
+              f"(quorum {wal.quorum})", flush=True)
     if election and wal is None:
         raise YtError("--election requires journal nodes (the journal "
                       "plane carries votes and leases)")
@@ -238,6 +263,25 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
             print(f"standby (master {master_index}): awaiting "
                   "leadership", flush=True)
             elector.wait_until_electable()
+            # Re-resolve membership RIGHT BEFORE takeover: the previous
+            # leader may have upgraded it while this standby slept, and
+            # recovering over a stale subset could drop records acked on
+            # the newer set (then re-publish the stale set at a higher
+            # epoch, poisoning future bootstraps).
+            latest, _ = _fetch_published_membership()
+            if latest is not None and sorted(latest) != sorted(chosen):
+                alive_now = tracker.alive()
+                if all(i in alive_now for i in latest):
+                    print(f"membership changed to {sorted(latest)}; "
+                          "rebuilding WAL", flush=True)
+                    elector.stop()
+                    wal.close()
+                    chosen.clear()
+                    chosen.update({i: alive_now[i] for i in latest})
+                    _persist_journal_config(sorted(chosen))
+                    channels = _build_channels(chosen)
+                    wal = make_wal()
+                    continue
             try:
                 master = Master(master_dir, wal=wal)
                 break
@@ -297,6 +341,7 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     store = RpcChunkStore(tracker.alive_nodes,
                           replication_factor=replication_factor)
     cluster = YtCluster(root, chunk_store=store, master=master)
+    cluster.node_directory = tracker.alive    # enables exec-node dispatch
     client = YtClient(cluster)
     server.add_service(DriverService(client))
     role["value"] = "leader"
@@ -315,14 +360,19 @@ def run_node(root: str, port: int, primary_address: str,
     from ytsaurus_tpu.server.monitoring import MonitoringServer
     from ytsaurus_tpu.server.orchid import OrchidService, default_orchid
 
+    from ytsaurus_tpu.server.exec_service import ExecNodeService
+
     os.makedirs(root, exist_ok=True)
     node_id = node_id or os.path.basename(os.path.normpath(root))
     store = FsChunkStore(os.path.join(root, "chunks"))
     service = DataNodeService(store, os.path.join(root, "journals"))
+    exec_service = ExecNodeService(store)
     orchid = default_orchid()
     orchid.register("/data_node", lambda: {
         "id": node_id, "chunk_count": len(store.list_chunks())})
-    server = RpcServer([service, OrchidService(orchid)], port=port)
+    orchid.register("/exec_node", lambda: exec_service.exec_stats({}, ()))
+    server = RpcServer([service, exec_service,
+                        OrchidService(orchid)], port=port)
     server.start()
     _write_port_file(root, "node", server.port)
     monitoring = MonitoringServer(orchid)
