@@ -7,14 +7,14 @@ prefix recovery, replica realignment.
 
 import pytest
 
-from ytsaurus_tpu.cypress.quorum import QuorumWal
+from ytsaurus_tpu.cypress.quorum import EPOCH_KEY, QuorumWal, record_epoch
 from ytsaurus_tpu.errors import EErrorCode, YtError
 
 
 class FakeJournalChannel:
     """In-memory data_node journal endpoint with the REAL position-check
-    semantics (a non-contiguous append is rejected, like
-    DataNodeService.journal_append)."""
+    and prev-epoch-check semantics (a non-contiguous or tail-divergent
+    append is rejected, like DataNodeService.journal_append)."""
 
     def __init__(self):
         self.records = []
@@ -22,6 +22,9 @@ class FakeJournalChannel:
         self.down = False
         self.epoch = 0
         self.writer = ""
+
+    def _last_epoch(self) -> int:
+        return record_epoch(self.records[-1]) if self.records else 0
 
     def _check(self, body):
         epoch = body.get("epoch")
@@ -54,12 +57,17 @@ class FakeJournalChannel:
                 raise YtError("position mismatch",
                               code=EErrorCode.JournalPositionMismatch,
                               attributes={"expected": len(self.records)})
+            prev = body.get("prev_epoch")
+            if prev is not None and prev != self._last_epoch():
+                raise YtError("tail diverged",
+                              code=EErrorCode.JournalDivergence)
             self.records.extend(body["records"])
             return {"count": len(self.records)}, []
         if method == "journal_read":
             return {"records": list(self.records)}, []
         if method == "journal_count":
-            return {"count": len(self.records)}, []
+            return {"count": len(self.records),
+                    "last_epoch": self._last_epoch()}, []
         if method == "journal_reset":
             self._check(body)
             self.records.clear()
@@ -240,7 +248,8 @@ class FakeJournalChannelV2(FakeJournalChannel):
                     "initialized": self.initialized}, []
         if method == "journal_count":
             return {"count": len(self.records),
-                    "initialized": self.initialized}, []
+                    "initialized": self.initialized,
+                    "last_epoch": self._last_epoch()}, []
         if method == "journal_append":
             self.initialized = True
         if method == "journal_reset":
@@ -458,6 +467,107 @@ def test_remote_only_quorum_survives_leader_loss(tmp_path):
                   count_local_ack=False)
     records = b.recover()
     assert [r["args"]["n"] for r in records] == [1]
+
+
+def test_recover_preserves_acked_record_on_partial_read(tmp_path):
+    """ADVICE r3 high: with 3 remotes (quorum 2), a record acked by A+B
+    while C lags, followed by leader death and recovery reaching only
+    B+C, must NOT truncate the acked record (the old quorum-th-longest
+    rule adopted C's shorter log and journal_reset B — destroying the
+    only surviving reachable copy)."""
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2(),
+               FakeJournalChannelV2()]          # A, B, C
+    a = QuorumWal(str(tmp_path / "a.log"), "j", remotes, quorum=2,
+                  count_local_ack=False, bootstrap_from_local=True)
+    a.recover()
+    a.append({"op": "set", "args": {"n": 1}})       # all three
+    remotes[2].down = True                          # C lags
+    a.append({"op": "set", "args": {"n": 2}})       # acked: A + B
+    # Leader dies; C returns but A becomes unreachable.
+    remotes[2].down = False
+    remotes[0].down = True
+    b = QuorumWal(str(tmp_path / "b.log"), "j", remotes, quorum=2,
+                  count_local_ack=False)
+    records = b.recover()
+    assert [r["args"]["n"] for r in records] == [1, 2]
+    # B keeps both records; C is caught up, not the other way round.
+    assert [r["args"]["n"] for r in remotes[1].records] == [1, 2]
+    assert [r["args"]["n"] for r in remotes[2].records] == [1, 2]
+
+
+def test_recover_prefers_newest_epoch_over_stale_fork(tmp_path):
+    """A fenced writer's unacked fork (older epoch, possibly longer) must
+    lose recovery to the newest-epoch log, and the forked location is
+    reset + reseeded — records carry epoch tags precisely for this."""
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2(),
+               FakeJournalChannelV2()]          # A, B, C
+    w1 = QuorumWal(str(tmp_path / "w1.log"), "j", remotes, quorum=2,
+                   count_local_ack=False, bootstrap_from_local=True)
+    w1.recover()
+    w1.append({"op": "set", "args": {"n": 1}})
+    # W1's dying append lands only on A (unacked fork, epoch 1).
+    remotes[0].records.append(
+        {"op": "set", "args": {"n": 88}, EPOCH_KEY: w1.epoch})
+    # W2 takes over with A unreachable, commits its own record (epoch 2).
+    remotes[0].down = True
+    w2 = QuorumWal(str(tmp_path / "w2.log"), "j", remotes, quorum=2,
+                   count_local_ack=False)
+    w2.recover()
+    w2.append({"op": "set", "args": {"n": 2}})
+    # Full recovery with every location reachable: the epoch-2 log wins
+    # even though A's fork has equal length; A is reset and reseeded.
+    remotes[0].down = False
+    w3 = QuorumWal(str(tmp_path / "w3.log"), "j", remotes, quorum=2,
+                   count_local_ack=False)
+    records = w3.recover()
+    assert [r["args"]["n"] for r in records] == [1, 2]
+    assert [r["args"]["n"] for r in remotes[0].records] == [1, 2]
+
+
+def test_append_repairs_equal_length_fork(tmp_path):
+    """Steady state: a location holding an equal-length stale-epoch fork
+    is detected by the count+tail-epoch probe on the next append and is
+    reset + reseeded instead of silently extending the fork."""
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2(),
+               FakeJournalChannelV2()]
+    w1 = QuorumWal(str(tmp_path / "w1.log"), "j", remotes, quorum=2,
+                   count_local_ack=False, bootstrap_from_local=True)
+    w1.recover()
+    w1.append({"op": "set", "args": {"n": 1}})
+    remotes[0].records.append(
+        {"op": "set", "args": {"n": 88}, EPOCH_KEY: w1.epoch})
+    remotes[0].down = True
+    w2 = QuorumWal(str(tmp_path / "w2.log"), "j", remotes, quorum=2,
+                   count_local_ack=False)
+    w2.recover()
+    w2.append({"op": "set", "args": {"n": 2}})      # B, C at epoch-2 log
+    # A returns holding [1, 88(e1)] — same length as the committed log.
+    remotes[0].down = False
+    w2.append({"op": "set", "args": {"n": 3}})
+    assert [r["args"]["n"] for r in remotes[0].records] == [1, 2, 3]
+
+
+def test_recover_adopts_newest_epoch_unacked_tail(tmp_path):
+    """An unacknowledged tail from the NEWEST epoch may be adopted (VR
+    semantics: it becomes committed retroactively — sound because no
+    conflicting record was ever acknowledged) and recovery re-replicates
+    it to a full quorum before returning."""
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2(),
+               FakeJournalChannelV2()]
+    w1 = QuorumWal(str(tmp_path / "w1.log"), "j", remotes, quorum=2,
+                   count_local_ack=False, bootstrap_from_local=True)
+    w1.recover()
+    w1.append({"op": "set", "args": {"n": 1}})
+    # The writer's dying append reached only A — same (newest) epoch.
+    remotes[0].records.append(
+        {"op": "set", "args": {"n": 2}, EPOCH_KEY: w1.epoch})
+    w2 = QuorumWal(str(tmp_path / "w2.log"), "j", remotes, quorum=2,
+                   count_local_ack=False)
+    records = w2.recover()
+    assert [r["args"]["n"] for r in records] == [1, 2]
+    # The adopted tail now lives on a full quorum.
+    for r in remotes:
+        assert [x["args"]["n"] for x in r.records] == [1, 2]
 
 
 def test_remote_only_quorum_append_needs_remote_majority(tmp_path):

@@ -34,10 +34,22 @@ class Changelog:
         self._file = open(path, "ab")
         self._lock = threading.Lock()
 
-    def append(self, record: dict) -> None:
+    def append(self, record: dict) -> int:
+        """Appends one record; returns the byte offset the record starts
+        at (callers can truncate back to it to drop exactly this
+        record)."""
         blob = yson.dumps(record, binary=True)
         with self._lock:
+            offset = self._file.tell()
             self._file.write(encode_varint_u(len(blob)) + blob)
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            return offset
+
+    def truncate_to(self, byte_len: int) -> None:
+        with self._lock:
+            self._file.truncate(byte_len)
+            self._file.seek(byte_len)
             self._file.flush()
             os.fsync(self._file.fileno())
 

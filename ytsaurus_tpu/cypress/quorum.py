@@ -4,13 +4,30 @@ Ref: Hydra quorum changelogs — mutations are acknowledged by a majority of
 changelog replicas before apply (server/lib/hydra/changelog.h + journal
 quorum semantics, server/master/journal_server/journal_node.h:19).
 
-Protocol invariant: every location holds a PREFIX of the single-writer
-log.  Remote appends are position-checked (the data node rejects a
-non-contiguous append), so a replica that missed records can never grow a
-hole; it is marked unsynced, earns no quorum credit, and is caught up from
-the writer's in-memory committed log before acking again.  Recovery reads
-every reachable location and takes the longest prefix present on >= quorum
-locations — sound because prefixes are guaranteed, not assumed.
+Protocol invariants (Viewstamped-Replication-style; ref Hydra changelog
+acquisition + VR view change):
+
+- Every record is tagged with the EPOCH of the writer that created it
+  (like a Raft entry's term); tags never change when a later writer
+  re-replicates the record.
+- Remote appends are position-checked AND prev-epoch-checked (the data
+  node rejects an append whose stated predecessor epoch differs from its
+  own last record's epoch), so a location's log is always a prefix of
+  the log of the writer that last appended to it; divergent forks left
+  by fenced writers are detected and reset, never silently extended.
+- Recovery reads an INTERSECTING set of voting locations (>= n-q+1, so
+  it shares a member with every write quorum) and adopts the log with
+  the highest (last-record epoch, length) — the VR "most up-to-date"
+  rule.  A record acknowledged by any write quorum is therefore visible
+  to recovery on at least one voter, and the newest-epoch rule makes
+  that voter win against shorter or stale-fork logs.  An UNacknowledged
+  tail from the newest epoch may be adopted (it becomes committed
+  retroactively, which is sound — no conflicting record was ever
+  acknowledged) or discarded if no voter holds it; what can never
+  happen is loss of an acknowledged record.
+- Recovery re-replicates the adopted log until >= quorum locations hold
+  it before acknowledging recovery, so the adopted tail is as durable
+  as any acked record by the time the master applies it.
 
 Snapshots are replicated to the journal locations BEFORE the journals are
 truncated (build_snapshot), so a total local-disk loss still recovers:
@@ -28,6 +45,18 @@ from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("quorum")
 
+# Key under which a record carries the epoch of the writer that created
+# it.  Tagged in place (records are master-mutation dicts); records
+# written before epoch tagging existed read as epoch 0.
+EPOCH_KEY = "$qe"
+
+
+def record_epoch(record) -> int:
+    """Epoch the record was created under (0 for pre-tagging records)."""
+    if isinstance(record, dict):
+        return int(record.get(EPOCH_KEY, 0))
+    return 0
+
 
 class LocalWal:
     """Single-location WAL: today's fsync'd changelog file.
@@ -40,6 +69,7 @@ class LocalWal:
     def __init__(self, path: str):
         self.path = path
         self._log: Optional[Changelog] = None
+        self._last_offset: Optional[int] = None
         self.was_initialized = os.path.exists(path + ".init") or \
             os.path.exists(path)
 
@@ -65,11 +95,21 @@ class LocalWal:
         return records
 
     def append(self, record: dict) -> None:
-        self._log.append(record)
+        self._last_offset = self._log.append(record)
+
+    def drop_last(self) -> None:
+        """Remove exactly the most recent append — O(1), no rewrite.
+        Valid only immediately after an append (the offset is not
+        tracked across recover/reset)."""
+        if self._last_offset is None:
+            raise YtError("no append to drop")
+        self._log.truncate_to(self._last_offset)
+        self._last_offset = None
 
     def reset(self) -> None:
         """Truncate after a snapshot."""
         self._log.close()
+        self._last_offset = None
         if os.path.exists(self.path):
             os.unlink(self.path)
         self._log = Changelog(self.path)
@@ -203,16 +243,18 @@ class QuorumWal:
         """Bring one replica to the full committed log; True on success."""
         try:
             if replica.synced_len is None:
-                # Length-only probe; the position-checked append protocol
-                # guarantees the replica holds a prefix, so the count alone
-                # decides between catch-up and tail discard.
+                # Length + last-epoch probe; position- and prev-epoch-
+                # checked appends guarantee that a location whose last
+                # record's epoch matches ours at that position holds a
+                # prefix of the committed log, so the pair decides
+                # between catch-up and divergence reset.
                 body, _ = replica.channel.call(
                     "data_node", "journal_count",
                     {"journal": self.journal_name})
                 have = int(body.get("count", 0))
-                if have > len(self._records):
-                    # Longer than the committed log → uncommitted tail from
-                    # a previous incarnation; discard it.
+                if self._fork_visible(have, body.get("last_epoch")):
+                    # Uncommitted tail or a stale writer's fork from a
+                    # previous incarnation; discard and reseed.
                     replica.channel.call(
                         "data_node", "journal_reset",
                         {"journal": self.journal_name,
@@ -225,6 +267,9 @@ class QuorumWal:
                     "data_node", "journal_append",
                     {"journal": self.journal_name, "records": missing,
                      "position": replica.synced_len,
+                     "prev_epoch": record_epoch(
+                         self._records[replica.synced_len - 1])
+                     if replica.synced_len else 0,
                      **self._fence_body()}, idempotent=False)
                 replica.synced_len = len(self._records)
             return True
@@ -234,8 +279,34 @@ class QuorumWal:
                 if _retry_ok and self._maybe_reacquire():
                     return self._catch_up(replica, _retry_ok=False)
                 raise self._fenced_error(err)
+            if err.code == EErrorCode.JournalDivergence and _retry_ok:
+                # The location's tail belongs to another writer's fork
+                # (probe raced, or the location predates last-epoch
+                # reporting): reset it and reseed in one more pass.
+                try:
+                    replica.channel.call(
+                        "data_node", "journal_reset",
+                        {"journal": self.journal_name,
+                         **self._fence_body()}, idempotent=False)
+                    replica.synced_len = 0
+                    return self._catch_up(replica, _retry_ok=False)
+                except YtError as reset_err:
+                    logger.warning("journal divergence reset failed: %s",
+                                   reset_err)
+                    return False
             logger.warning("journal replica catch-up failed: %s", err)
             return False
+
+    def _fork_visible(self, have: int, last_epoch) -> bool:
+        """True when a location's (count, tail-epoch) probe reveals a log
+        that is NOT a prefix of the committed log — a longer log, or an
+        equal/shorter one whose tail record carries a different epoch.
+        Shared by catch-up (reset + reseed) and orphaned-fence
+        re-acquisition (refuse): the fencing argument needs both paths
+        to agree on what counts as another writer's fork."""
+        return have > len(self._records) or (
+            last_epoch is not None and 0 < have and
+            int(last_epoch) != record_epoch(self._records[have - 1]))
 
     # -- write path ------------------------------------------------------------
 
@@ -256,8 +327,9 @@ class QuorumWal:
                     "data_node", "journal_count",
                     {"journal": self.journal_name})
                 probed += 1
-                if int(body.get("count", 0)) > len(self._records):
-                    return False
+                if self._fork_visible(int(body.get("count", 0)),
+                                      body.get("last_epoch")):
+                    return False        # another writer's fork is visible
             except YtError:
                 continue
         if probed < len(self.replicas) // 2 + 1:
@@ -278,49 +350,95 @@ class QuorumWal:
             code=EErrorCode.JournalEpochFenced, inner_errors=[err])
 
     def append(self, record: dict) -> None:
+        self._append_attempt(record, _retries=3)
+
+    def _append_attempt(self, payload: dict, _retries: int) -> None:
         position = len(self._records)
+        attempt_epoch = self.epoch
+        # Tag the record with the writing epoch (a copy — the caller's
+        # dict stays clean).  Tags are immutable once the record is
+        # committed: later writers re-replicate it with its original
+        # epoch, which is what recovery's newest-epoch rule relies on.
+        # An IN-FLIGHT record is re-tagged if this writer re-acquires a
+        # higher epoch mid-append (orphaned-fence recovery): epochs in
+        # any log must be non-decreasing, or a fenced competitor's fork
+        # could outrank a log holding acknowledged records.
+        record = payload
+        if isinstance(record, dict) and EPOCH_KEY not in record:
+            record = dict(record)
+            record[EPOCH_KEY] = attempt_epoch
+        prev_epoch = record_epoch(self._records[-1]) if self._records else 0
         acks = 0
         errors = []
-        reacquired = False
+        local_appended = False
         try:
             self.local.append(record)
+            local_appended = True
             if self.count_local_ack:
                 acks += 1
         except OSError as exc:          # local disk failure
             errors.append(YtError(f"local WAL append failed: {exc}"))
         for replica in self.replicas:
-            if replica.synced_len != position and not self._sync_to(
-                    replica, position):
+            synced = replica.synced_len == position or \
+                self._sync_to(replica, position)
+            # _sync_to may have re-acquired a new epoch after an orphaned
+            # fence; the in-flight record must carry the new epoch, so
+            # restart the whole attempt before extending any log with a
+            # stale-tagged record (epochs in a log must not regress).
+            if self.epoch != attempt_epoch:
+                return self._restart_append(payload, _retries, errors,
+                                            local_appended)
+            if not synced:
                 continue
-            attempts = 0
-            while True:
-                attempts += 1
-                try:
-                    replica.channel.call(
-                        "data_node", "journal_append",
-                        {"journal": self.journal_name, "records": [record],
-                         "position": position, **self._fence_body()},
-                        idempotent=False)
-                    replica.synced_len = position + 1
-                    acks += 1
-                except YtError as err:
-                    replica.synced_len = None
-                    errors.append(err)
-                    if err.code == EErrorCode.JournalEpochFenced:
-                        if not reacquired and attempts == 1 and \
-                                self._maybe_reacquire():
-                            reacquired = True
-                            continue        # retry under the new epoch
-                        # A newer master owns this journal: fail-stop —
-                        # assembling a quorum from the remaining
-                        # locations would interleave two writers.
-                        raise self._fenced_error(err)
-                break
+            try:
+                replica.channel.call(
+                    "data_node", "journal_append",
+                    {"journal": self.journal_name, "records": [record],
+                     "position": position, "prev_epoch": prev_epoch,
+                     **self._fence_body()},
+                    idempotent=False)
+                replica.synced_len = position + 1
+                acks += 1
+            except YtError as err:
+                replica.synced_len = None
+                errors.append(err)
+                if err.code == EErrorCode.JournalEpochFenced:
+                    if _retries > 0 and self._maybe_reacquire():
+                        return self._restart_append(payload, _retries,
+                                                    errors, local_appended)
+                    # A newer master owns this journal: fail-stop —
+                    # assembling a quorum from the remaining locations
+                    # would interleave two writers.
+                    raise self._fenced_error(err)
         if acks < self.quorum:
             raise YtError(
                 f"WAL append reached {acks}/{self.quorum} locations",
                 code=EErrorCode.PeerUnavailable, inner_errors=errors[:3])
         self._records.append(record)
+
+    def _restart_append(self, payload: dict, retries: int, errors: list,
+                        local_appended: bool) -> None:
+        """Redo an append after a mid-append epoch re-acquisition: rewind
+        every location that may hold the stale-tagged in-flight copy
+        (local via an O(1) drop of the one record; replicas via the
+        divergence/longer-log reset in catch-up) and retry under the new
+        epoch.  Any disk failure here surfaces as YtError so the master's
+        poison latch can stop serving a tree that is ahead of its WAL."""
+        if retries <= 0:
+            raise YtError(
+                "WAL append could not settle under a stable epoch",
+                code=EErrorCode.PeerUnavailable, inner_errors=errors[:3])
+        if local_appended:
+            try:
+                self.local.drop_last()
+            except OSError as exc:
+                raise YtError(
+                    f"local WAL rewind failed: {exc}",
+                    code=EErrorCode.PeerUnavailable,
+                    inner_errors=errors[:3])
+        for replica in self.replicas:
+            replica.synced_len = None
+        return self._append_attempt(payload, _retries=retries - 1)
 
     def _sync_to(self, replica: _Replica, position: int) -> bool:
         """Catch a lagging replica up to `position` committed records."""
@@ -366,32 +484,55 @@ class QuorumWal:
                                "%s", err)
                 lists.append(None)
         voting = sum(1 for lst in lists if lst is not None)
-        if voting < self.quorum:
+        n_voting = len(self.replicas) + (1 if self.count_local_ack else 0)
+        # The read set must intersect EVERY write quorum (>= n-q+1
+        # voters), or an acknowledged record held by exactly q voters
+        # could be invisible to recovery and truncated (ADVICE r3 high:
+        # ack on A+B, recovery via B+C used to adopt C's shorter log).
+        needed = max(self.quorum, n_voting - self.quorum + 1)
+        if voting < needed:
             raise YtError(
-                f"cannot recover: {voting}/{self.quorum} initialized WAL "
-                "locations reachable (a fresh/wiped location cannot vote; "
+                f"cannot recover: {voting}/{needed} initialized WAL "
+                "locations reachable (the read set must intersect every "
+                "write quorum; a fresh/wiped location cannot vote — "
                 "bring more journal owners online)",
                 code=EErrorCode.PeerUnavailable)
-        # Longest prefix confirmed by >= quorum voting locations.
-        # Position-checked appends guarantee each location IS a prefix, so
-        # length comparison is sound.
-        lengths = sorted((len(lst) for lst in lists if lst is not None),
-                         reverse=True)
-        committed = lengths[self.quorum - 1]
-        source = next(lst for lst in lists
-                      if lst is not None and len(lst) >= committed)
-        self._records = source[:committed]
-        # Re-align the local location; remote replicas catch up lazily at
-        # the next append (and earn no quorum credit until they do).
+        # Adopt the most up-to-date log among the voters: highest
+        # (last-record epoch, length) — the VR view-change rule.  The
+        # intersection guarantee puts every acknowledged record on at
+        # least one voter, and no fenced writer's fork can carry a newer
+        # epoch than the writer that fenced it, so the chosen log
+        # contains every acknowledged record.  Its (possibly unacked)
+        # tail is adopted wholesale and re-replicated below.
+        def _up_to_date(lst: list) -> "tuple[int, int]":
+            return (record_epoch(lst[-1]) if lst else 0, len(lst))
+
+        best = max((lst for lst in lists if lst is not None),
+                   key=_up_to_date)
+        self._records = list(best)
+        committed = len(self._records)
         self._realign_local()
         # Fence any previous writer BEFORE this incarnation writes (ref
         # Hydra changelog acquisition at epoch start).
         self.acquire_epoch()
+        # Re-replicate the adopted log until >= quorum locations hold it:
+        # an adopted tail held by one voter must be as durable as any
+        # acked record before the master applies it.
+        holders = 1 if self.count_local_ack else 0   # local just realigned
         for replica, lst in zip(self.replicas, lists[1:]):
-            replica.synced_len = None if lst is None or \
-                len(lst) != committed else committed
-            if replica.synced_len is None:
+            if lst is not None and lst == self._records[:len(lst)]:
+                replica.synced_len = len(lst)
+            else:
+                replica.synced_len = None
+            if replica.synced_len != committed:
                 self._catch_up(replica)
+            if replica.synced_len == committed:
+                holders += 1
+        if holders < self.quorum:
+            raise YtError(
+                f"recovered log replicated to only {holders}/{self.quorum} "
+                "locations; refusing to serve from an under-replicated "
+                "tail", code=EErrorCode.PeerUnavailable)
         return list(self._records)
 
     def extend(self, channels: list) -> int:

@@ -50,6 +50,7 @@ class EErrorCode(enum.IntEnum):
     # Journals / quorum WAL.
     JournalPositionMismatch = 1850
     JournalEpochFenced = 1851
+    JournalDivergence = 1852
 
     # Config (ref: yt/yt/core/ytree yson_struct validation).
     InvalidConfig = 216

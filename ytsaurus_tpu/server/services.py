@@ -83,15 +83,17 @@ class DataNodeService(Service):
     def _journal(self, name: str):
         import os
 
-        from ytsaurus_tpu.cypress.quorum import LocalWal
+        from ytsaurus_tpu.cypress.quorum import LocalWal, record_epoch
         self._check_name(name)
         with self._journal_lock:
             entry = self._journals.get(name)
             if entry is None:
                 wal = LocalWal(os.path.join(self.journal_dir,
                                             name + ".log"))
-                count = len(wal.recover())
-                entry = {"wal": wal, "count": count}
+                records = wal.recover()
+                entry = {"wal": wal, "count": len(records),
+                         "last_epoch": record_epoch(records[-1])
+                         if records else 0}
                 self._journals[name] = entry
             return entry
 
@@ -249,9 +251,11 @@ class DataNodeService(Service):
 
     @rpc_method(concurrency=1)
     def journal_append(self, body, attachments):
+        from ytsaurus_tpu.cypress.quorum import record_epoch
         name = _text(body["journal"])
         entry = self._journal(name)
         position = body.get("position")
+        prev_epoch = body.get("prev_epoch")
         with self._journal_lock:
             self._check_writer(name, body.get("epoch"), body.get("writer"))
             if position is not None and int(position) != entry["count"]:
@@ -260,9 +264,21 @@ class DataNodeService(Service):
                     f"location at {entry['count']}",
                     code=EErrorCode.JournalPositionMismatch,
                     attributes={"expected": entry["count"]})
+            # Raft-style consistency check: the writer states the epoch
+            # of ITS record preceding this append; a mismatch means this
+            # location's tail is another (fenced) writer's fork and must
+            # be reset, not extended.
+            if prev_epoch is not None and \
+                    int(prev_epoch) != entry["last_epoch"]:
+                raise YtError(
+                    f"journal tail diverged: writer expects prev epoch "
+                    f"{prev_epoch}, location tail epoch is "
+                    f"{entry['last_epoch']}",
+                    code=EErrorCode.JournalDivergence)
             for record in body["records"]:
                 entry["wal"].append(record)
                 entry["count"] += 1
+                entry["last_epoch"] = record_epoch(record)
         return {"count": entry["count"]}
 
     @rpc_method()
@@ -283,14 +299,16 @@ class DataNodeService(Service):
 
     @rpc_method()
     def journal_count(self, body, attachments):
-        """Record count only — the cheap liveness/lag probe for catch-up."""
+        """Count + tail-epoch — the cheap liveness/lag/divergence probe
+        for catch-up (no record payloads cross the wire)."""
         import os
         name = self._check_name(_text(body["journal"]))
         path = os.path.join(self.journal_dir, name + ".log")
         if not os.path.exists(path) and name not in self._journals:
             return {"count": 0, "initialized": False}
         entry = self._journal(name)
-        return {"count": entry["count"], "initialized": True}
+        return {"count": entry["count"], "initialized": True,
+                "last_epoch": entry["last_epoch"]}
 
     @rpc_method(concurrency=1)
     def journal_reset(self, body, attachments):
