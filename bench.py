@@ -239,6 +239,34 @@ def _git_rev():
         return "unknown"
 
 
+# Source trees whose changes can move benchmark numbers.  A verified
+# capture is replayed as the config's primary line ONLY when none of
+# these changed between the captured revision and HEAD — a capture from
+# an older revision of the measured code must not mask a regression
+# (ADVICE r3: stale replay attribution).
+_PERF_PATHS = ("bench.py", "ytsaurus_tpu/ops", "ytsaurus_tpu/query",
+               "ytsaurus_tpu/models", "ytsaurus_tpu/parallel",
+               "ytsaurus_tpu/chunks", "ytsaurus_tpu/utils")
+
+
+def _capture_current(entry) -> bool:
+    """True when the capture measures the same perf-relevant code as the
+    WORKING TREE (not just HEAD — uncommitted edits to the measured code
+    must invalidate the capture too)."""
+    rev = entry.get("rev")
+    if not rev or rev == "unknown":
+        return False
+    try:
+        import subprocess
+        proc = subprocess.run(
+            ["git", "diff", "--quiet", rev, "--", *_PERF_PATHS],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, timeout=10)
+        return proc.returncode == 0
+    except Exception:
+        return False
+
+
 def _save_verified(platform, name, line, n_rows, best):
     data = _load_verified() or {}
     results = data.setdefault("results", {})
@@ -256,10 +284,10 @@ def _save_verified(platform, name, line, n_rows, best):
 
 def _emit_verified(name, entry):
     # In-band staleness markers: a replayed capture must be
-    # distinguishable from a fresh measurement in stdout alone.  The
-    # revision it was captured at is included rather than gating replay
-    # on it — the capture exists precisely so an end-of-round outage
-    # (after later commits) can't zero a round that HAS on-chip numbers.
+    # distinguishable from a fresh measurement in stdout alone.  Callers
+    # gate on _capture_current so the replayed value always measures the
+    # same perf-relevant code as HEAD; these fields let the reader audit
+    # that.
     line = dict(entry["line"])
     line["replayed_from"] = entry["captured_at"]
     if entry.get("rev"):
@@ -285,12 +313,17 @@ def _run_config(name, args, platform):
     if platform == "cpu" and not args.smoke and args.rows is None:
         verified = _load_verified() or {}
         entry = (verified.get("results") or {}).get(name)
-        if entry and entry.get("device") != "cpu":
+        if entry and entry.get("device") != "cpu" and \
+                _capture_current(entry):
             # Tunnel down now, but this config HAS a verified on-chip
-            # number from earlier in the round — re-emit it rather than
-            # burning the budget on a CPU run nobody will read.
+            # number for THIS code — re-emit it rather than burning the
+            # budget on a CPU run nobody will read.
             _emit_verified(name, entry)
             return
+        if entry and entry.get("device") != "cpu":
+            print(f"# config={name}: stale on-chip capture "
+                  f"(rev {entry.get('rev')}) NOT replayed: perf-relevant "
+                  "code changed since; measuring on CPU", file=sys.stderr)
     fn, accel_rows, cpu_rows = _CONFIGS[name]
     default_rows = cpu_rows if platform == "cpu" else accel_rows
     n_rows = args.rows or (100_000 if args.smoke else default_rows)
@@ -325,10 +358,11 @@ def main():
         if config == "all" else (config,)
 
     def _emit_fallback(name):
-        """Best line available without measuring: verified capture if one
-        exists, else an honest zero."""
+        """Best line available without measuring: a verified capture of
+        THIS code if one exists, else an honest zero."""
         entry = ((_load_verified() or {}).get("results") or {}).get(name)
-        if entry and entry.get("device") != "cpu":
+        if entry and entry.get("device") != "cpu" and \
+                _capture_current(entry):
             _emit_verified(name, entry)
         else:
             _emit(_METRIC_NAMES[name], 0.0)

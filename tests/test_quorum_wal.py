@@ -570,6 +570,41 @@ def test_recover_adopts_newest_epoch_unacked_tail(tmp_path):
         assert [x["args"]["n"] for x in r.records] == [1, 2]
 
 
+def test_local_append_failure_never_holes_the_local_log(tmp_path):
+    """A transient local-disk append failure must not let later appends
+    land past the gap: a holed local log is a non-prefix that recovery
+    could adopt (dropping the skipped acked record).  After a failure
+    the local location takes no appends until realigned — shorter but
+    honest — and recovery still preserves every acked record."""
+    path = str(tmp_path / "w.log")
+    remotes = [FakeJournalChannelV2(), FakeJournalChannelV2(),
+               FakeJournalChannelV2()]
+    wal = QuorumWal(path, "j", remotes, quorum=2,
+                    bootstrap_from_local=True)
+    wal.recover()
+
+    def flaky(record):
+        raise OSError("disk error")
+
+    orig = wal.local.append
+    wal.local.append = flaky
+    wal.append({"op": "set", "args": {"n": 1}})  # acked by the remotes
+    wal.local.append = orig
+    wal.append({"op": "set", "args": {"n": 2}})  # local must NOT take it
+    wal.close()
+    from ytsaurus_tpu.cypress.master import Changelog
+    records, _ = Changelog.read_all(path)
+    assert records == []        # a true (empty) prefix, not [r2]
+    # Crash; recovery with one remote down still keeps both records
+    # (local's short prefix cannot outvote a remote's full log).
+    remotes[2].down = True
+    wal2 = QuorumWal(path, "j", remotes, quorum=2)
+    assert [r["args"]["n"] for r in wal2.recover()] == [1, 2]
+    # And the local location is whole again afterwards.
+    records, _ = Changelog.read_all(path)
+    assert [r["args"]["n"] for r in records] == [1, 2]
+
+
 def test_remote_only_quorum_append_needs_remote_majority(tmp_path):
     remotes = [FakeJournalChannelV2(), FakeJournalChannelV2(),
                FakeJournalChannelV2()]

@@ -46,6 +46,16 @@ def test_radix_multi_word(engine):
     np.testing.assert_array_equal(got, expect)
 
 
+@pytest.mark.parametrize("engine", ["gather", "scatter"])
+def test_radix_empty_input(engine):
+    """ADVICE r3: a forced engine must return an empty permutation for
+    n=0, not crash on degenerate tile math."""
+    empty = jnp.zeros((0,), jnp.uint32)
+    got = np.asarray(radix_argsort_u32([empty], engine=engine))
+    assert got.shape == (0,)
+    assert got.dtype == np.uint32
+
+
 def test_radix_stability_with_duplicates():
     rng = np.random.default_rng(3)
     n = 50_000

@@ -155,3 +155,49 @@ def test_dead_peer_raises_peer_unavailable():
     with pytest.raises(YtError) as ei:
         ch.call("echo", "echo", {})
     assert ei.value.code == EErrorCode.PeerUnavailable
+
+
+def test_nonidempotent_retries_connect_failure():
+    """A connect-refused transport failure provably never dispatched, so
+    even a non-idempotent call retries it (ADVICE r3: only a mid-call
+    drop is ambiguous and must surface)."""
+    ch = RetryingChannel(Channel("127.0.0.1:1", timeout=2), attempts=2,
+                         backoff=0.05)
+    with pytest.raises(YtError) as ei:
+        ch.call("echo", "echo", {}, idempotent=False)
+    # Exhausted retries (not surfaced on attempt 1): PeerUnavailable.
+    assert ei.value.code == EErrorCode.PeerUnavailable
+    ch.close()
+
+
+def test_nonidempotent_midcall_drop_surfaces():
+    """A connection that dies AFTER the request was dispatched must
+    surface to a non-idempotent caller instead of being resent (the
+    mutation may have executed on the dying peer).  Emulated
+    deterministically at the channel layer: a dispatched TransportError
+    (no dispatched=False marker) must not be retried."""
+    from ytsaurus_tpu.rpc.channel import _never_dispatched
+    dispatched_err = YtError("conn dropped mid-call",
+                             code=EErrorCode.TransportError)
+    undispatched_err = YtError("connect refused",
+                               code=EErrorCode.TransportError,
+                               attributes={"dispatched": False})
+    assert not _never_dispatched(dispatched_err)
+    assert _never_dispatched(undispatched_err)
+
+    class OneShotChannel:
+        address = "fake"
+        calls = 0
+
+        def call(self, *a, **kw):
+            OneShotChannel.calls += 1
+            raise dispatched_err
+
+        def close(self):
+            pass
+
+    ch = RetryingChannel(OneShotChannel(), attempts=3, backoff=0.01)
+    with pytest.raises(YtError) as ei:
+        ch.call("echo", "echo", {}, idempotent=False)
+    assert ei.value.code == EErrorCode.TransportError
+    assert OneShotChannel.calls == 1          # surfaced, not retried

@@ -164,6 +164,14 @@ class QuorumWal:
             raise YtError(f"quorum {quorum} unreachable with "
                           f"{1 + len(self.replicas)} locations")
         self._records: list[dict] = []     # committed log (truncated w/ WAL)
+        # Latched on the first failed local append: a local log that
+        # skipped a record must take NO further appends, or it becomes a
+        # holed non-prefix that recovery could adopt (losing the skipped
+        # acked record) while still looking like a valid voter.  By
+        # never appending past a failure the local log stays a true
+        # prefix — shorter, but honest — and keeps its voting rights.
+        # Cleared when _realign_local rewrites it whole.
+        self._local_broken = False
         self.epoch: int = 0                # 0 = not yet acquired
         import uuid
         self.writer_id: str = uuid.uuid4().hex[:12]
@@ -371,13 +379,19 @@ class QuorumWal:
         acks = 0
         errors = []
         local_appended = False
-        try:
-            self.local.append(record)
-            local_appended = True
-            if self.count_local_ack:
-                acks += 1
-        except OSError as exc:          # local disk failure
-            errors.append(YtError(f"local WAL append failed: {exc}"))
+        if not self._local_broken:
+            try:
+                self.local.append(record)
+                local_appended = True
+                if self.count_local_ack:
+                    acks += 1
+            except OSError as exc:      # local disk failure
+                self._local_broken = True
+                errors.append(YtError(f"local WAL append failed: {exc}"))
+        else:
+            errors.append(YtError(
+                "local WAL skipped: broken since an earlier append "
+                "failure (awaiting realign)"))
         for replica in self.replicas:
             synced = replica.synced_len == position or \
                 self._sync_to(replica, position)
@@ -563,9 +577,11 @@ class QuorumWal:
         self.local.reset()
         for record in self._records:
             self.local.append(record)
+        self._local_broken = False      # whole again (a full rewrite)
 
     def reset(self) -> None:
         self.local.reset()
+        self._local_broken = False      # empty log is a valid prefix
         self._records = []
         for replica in self.replicas:
             try:

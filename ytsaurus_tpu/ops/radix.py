@@ -55,6 +55,8 @@ def radix_pass(digit: jax.Array, payloads: list[jax.Array],
     digit and each payload are (N,) with N % RADIX_TILE == 0; returns the
     payloads reordered by a stable counting sort on digit."""
     n = digit.shape[0]
+    if n == 0:
+        return list(payloads)
     tile = min(RADIX_TILE, n)
     nt = n // tile
     log_tile = tile.bit_length() - 1
@@ -140,6 +142,10 @@ def radix_argsort_u32(words: list[jax.Array],
     ties against real all-ones rows resolve to the real rows first by
     stability (pad payload indices are appended after)."""
     n = words[0].shape[0]
+    if n == 0:
+        # A forced engine must not die on an empty rowset (tile math
+        # degenerates); the identity permutation is the sorted order.
+        return jnp.arange(0, dtype=jnp.uint32)
     if word_bits is None:
         word_bits = [32] * len(words)
     if engine == "pallas":

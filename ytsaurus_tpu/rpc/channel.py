@@ -101,7 +101,15 @@ class Channel:
             with self._lock:
                 state = self._conn
             if state is None or not state.alive:
-                state = await self._connect()
+                try:
+                    state = await self._connect()
+                except (ConnectionError, OSError) as exc:
+                    # The request was never sent: safe to resend even a
+                    # non-idempotent call (see RetryingChannel).
+                    raise YtError(
+                        f"cannot connect to {self.address}: {exc}",
+                        code=EErrorCode.TransportError,
+                        attributes={"dispatched": False}) from exc
                 with self._lock:
                     self._conn = state
         rid = next(self._rid)
@@ -111,7 +119,10 @@ class Channel:
         # have died without either failing our future or being seen here.
         if not state.alive:
             state.pending.pop(rid, None)
-            raise ConnectionError("connection lost")
+            raise YtError(
+                f"connection to {self.address} lost before dispatch",
+                code=EErrorCode.TransportError,
+                attributes={"dispatched": False})
         req = {"rid": rid, "kind": "req", "service": service,
                "method": method}
         if trace_wire is not None:
@@ -185,6 +196,15 @@ class Channel:
             loop.call_soon_threadsafe(state.writer.close)
 
 
+def _never_dispatched(err: "YtError") -> bool:
+    """True when a transport failure provably happened BEFORE the request
+    was sent (connection refused), making even a non-idempotent resend
+    safe.  A mid-call drop proves nothing — the peer may have executed
+    the mutation before dying."""
+    return err.code == EErrorCode.TransportError and \
+        err.attributes.get("dispatched") is False
+
+
 class RetryingChannel:
     """Retries TRANSPORT failures (peer restarting, dropped connection);
     application YtErrors pass through untouched."""
@@ -208,13 +228,17 @@ class RetryingChannel:
                 return self.channel.call(service, method, body,
                                          attachments, timeout)
             except YtError as err:
-                # A timeout is NOT proof of non-execution: only idempotent
-                # calls may be resent after one (non-idempotent mutations
-                # must dedup server-side via mutation ids instead).
-                retryable = (EErrorCode.TransportError,
-                             EErrorCode.RpcTimeout) if idempotent \
-                    else (EErrorCode.TransportError,)
-                if err.code not in retryable:
+                # Neither a timeout NOR a dropped connection proves
+                # non-execution (the mutation may have run on a dying
+                # peer): a non-idempotent call is resent only when the
+                # transport failure happened before dispatch (connect
+                # refused — the request never left this process).
+                if idempotent:
+                    retryable = err.code in (EErrorCode.TransportError,
+                                             EErrorCode.RpcTimeout)
+                else:
+                    retryable = _never_dispatched(err)
+                if not retryable:
                     raise
                 last = err
                 time.sleep(self.backoff * (2 ** attempt))
@@ -235,9 +259,10 @@ class FailoverChannel:
     what rides out a leader election.
 
     Retry semantics extend RetryingChannel's: NoSuchService (follower —
-    the call never dispatched) and TransportError rotate always;
-    RpcTimeout / PeerUnavailable rotate only for idempotent calls (a
-    timed-out mutation may have executed).
+    the call never dispatched) and never-dispatched connect failures
+    rotate always; dispatched TransportError / RpcTimeout /
+    PeerUnavailable rotate only for idempotent calls (the mutation may
+    have executed on the dying peer).
     Ref: dynamic channel pools + peer rediscovery
     (yt/yt/core/rpc/dynamic_channel_pool.h)."""
 
@@ -258,11 +283,6 @@ class FailoverChannel:
              attachments=(), timeout: float | None = None,
              idempotent: bool = True):
         deadline = time.monotonic() + self.failover_window
-        rotate_always = (EErrorCode.NoSuchService,
-                         EErrorCode.TransportError)
-        rotate_idempotent = rotate_always + (EErrorCode.RpcTimeout,
-                                             EErrorCode.PeerUnavailable)
-        rotatable = rotate_idempotent if idempotent else rotate_always
         last: YtError | None = None
         cycle = 0
         while True:
@@ -271,7 +291,20 @@ class FailoverChannel:
                 return channel.call(service, method, body, attachments,
                                     timeout)
             except YtError as err:
-                if err.code not in rotatable:
+                if idempotent:
+                    rotatable = err.code in (
+                        EErrorCode.NoSuchService, EErrorCode.TransportError,
+                        EErrorCode.RpcTimeout, EErrorCode.PeerUnavailable)
+                else:
+                    # NoSuchService is the follower's answer — the call
+                    # never executed there.  A dropped connection only
+                    # rotates when the request provably never left this
+                    # process; otherwise the mutation may have committed
+                    # on the dying leader and a resend would double-run
+                    # it (no server-side mutation-id dedup).
+                    rotatable = err.code == EErrorCode.NoSuchService or \
+                        _never_dispatched(err)
+                if not rotatable:
                     raise
                 last = err
                 self._current = (self._current + 1) % len(self._channels)

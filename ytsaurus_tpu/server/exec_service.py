@@ -18,6 +18,7 @@ import subprocess
 import threading
 import time
 import uuid
+import weakref
 from typing import Optional
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
@@ -29,6 +30,25 @@ logger = get_logger("exec_node")
 
 STDERR_TAIL_BYTES = 16 << 10
 RESULT_TTL_SECONDS = 600.0
+# Once the stdout blob has been handed to a poll, it is kept only this
+# long (a lost poll RESPONSE can still be re-polled within the grace);
+# the full TTL applies only to results nobody has fetched yet.  Must
+# comfortably exceed the scheduler's poll RPC timeout + retry backoff
+# (operations/jobs.py polls with a 30s channel timeout), or a timed-out
+# delivery response could find the result swept on retry and double-run
+# the job.
+DELIVERED_GRACE_SECONDS = 120.0
+SWEEP_INTERVAL_SECONDS = 60.0
+
+
+def _sweep_loop(service_ref, stop: threading.Event) -> None:
+    while not stop.wait(SWEEP_INTERVAL_SECONDS):
+        service = service_ref()
+        if service is None:
+            return
+        with service._lock:
+            service._sweep_locked()
+        del service
 
 
 class ExecNodeService(Service):
@@ -42,6 +62,19 @@ class ExecNodeService(Service):
         self._by_key: dict[str, str] = {}     # dedup: job_key -> job_id
         self._lock = threading.Lock()
         self._started_total = 0
+        # Timer-driven sweep: a burst of large-output jobs followed by
+        # idle time must not pin the blobs until the next start_job.
+        # The thread holds only a weakref (a dropped service instance
+        # must not be pinned forever by its own sweeper) and exits on
+        # close() or garbage collection.
+        self._stop = threading.Event()
+        self._sweeper = threading.Thread(
+            target=_sweep_loop, args=(weakref.ref(self), self._stop),
+            daemon=True, name="exec-job-sweeper")
+        self._sweeper.start()
+
+    def close(self) -> None:
+        self._stop.set()
 
     # -- RPC surface -----------------------------------------------------------
 
@@ -102,6 +135,8 @@ class ExecNodeService(Service):
         if entry["error"] is not None:
             out["error"] = str(entry["error"])
         if entry["state"] == "completed":
+            if entry.get("delivered") is None:
+                entry["delivered"] = time.monotonic()
             return out, [entry["stdout"]]
         return out
 
@@ -129,7 +164,10 @@ class ExecNodeService(Service):
         now = time.monotonic()
         for job_id in [j for j, e in self._jobs.items()
                        if e["state"] != "running"
-                       and now - e["created"] > RESULT_TTL_SECONDS]:
+                       and (now - e["created"] > RESULT_TTL_SECONDS
+                            or (e.get("delivered") is not None and
+                                now - e["delivered"] >
+                                DELIVERED_GRACE_SECONDS))]:
             del self._jobs[job_id]
         self._by_key = {k: v for k, v in self._by_key.items()
                         if v in self._jobs}
