@@ -224,9 +224,11 @@ def test_spmd_left_join():
     assert canon(out) == canon(want)
 
 
-def test_spmd_join_rejects_duplicate_foreign_keys():
-    from ytsaurus_tpu.errors import EErrorCode, YtError
+def test_spmd_join_duplicate_foreign_keys_partitioned():
+    """Non-unique foreign keys take the partitioned-exchange path (match
+    expansion: one output row per (self, foreign) pair)."""
     from ytsaurus_tpu.parallel.mesh import make_mesh
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
 
     left_schema = TableSchema.make([("k", "int64"), ("v", "int64")])
     dim_schema = TableSchema.make([("dk", "int64", "ascending"),
@@ -239,7 +241,111 @@ def test_spmd_join_rejects_duplicate_foreign_keys():
     table = ShardedTable.from_chunks(mesh, chunks)
     plan = build_query("k, x FROM [//l] JOIN [//d] ON k = dk",
                        {"//l": left_schema, "//d": dim_schema})
-    with pytest.raises(YtError) as ei:
-        DistributedEvaluator(mesh).run(plan, table,
-                                       foreign_chunks={"//d": dim})
-    assert ei.value.code == EErrorCode.QueryUnsupported
+    out = DistributedEvaluator(mesh).run(
+        plan, table, foreign_chunks={"//d": dim}).to_rows()
+    from ytsaurus_tpu.chunks.columnar import concat_chunks
+    want = Evaluator().run_plan(plan, concat_chunks(chunks),
+                                {"//d": dim}).to_rows()
+    canon = lambda rows: sorted((r["k"], r["x"]) for r in rows)
+    assert canon(out) == canon(want)
+    assert len(out) == 8 * (2 + 1)      # k=1 matches twice, k=2 once
+
+
+def test_spmd_fact_to_fact_join_matches_host():
+    """VERDICT r2 #5 done-criterion: a non-unique-key two-fact-table
+    join (both sides large, both routed by key hash) matches the host
+    oracle on the 8-device mesh, including GROUP BY on top."""
+    from ytsaurus_tpu.parallel.mesh import make_mesh
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+
+    rng = np.random.default_rng(17)
+    a_schema = TableSchema.make([("ak", "int64"), ("av", "double")])
+    b_schema = TableSchema.make([("bk", "int64"), ("bv", "int64")])
+    n_b = 700
+    fact_b = ColumnarChunk.from_arrays(b_schema, {
+        "bk": rng.integers(0, 50, n_b),          # heavily duplicated keys
+        "bv": rng.integers(0, 1000, n_b)})
+    mesh = make_mesh(8)
+    chunks = []
+    for s in range(8):
+        n = 120 + 9 * s
+        chunks.append(ColumnarChunk.from_arrays(a_schema, {
+            "ak": rng.integers(0, 80, n),        # duplicated, partial overlap
+            "av": rng.uniform(0, 10, n)}))
+    table = ShardedTable.from_chunks(mesh, chunks)
+    query = ("ak, sum(av) AS s, count(*) AS c "
+             "FROM [//a] JOIN [//b] ON ak = bk GROUP BY ak")
+    plan = build_query(query, {"//a": a_schema, "//b": b_schema})
+    ev = DistributedEvaluator(mesh)
+    out = ev.run(plan, table, foreign_chunks={"//b": fact_b}).to_rows()
+    from ytsaurus_tpu.chunks.columnar import concat_chunks
+    want = Evaluator().run_plan(plan, concat_chunks(chunks),
+                                {"//b": fact_b}).to_rows()
+    got = {r["ak"]: (round(r["s"], 6), r["c"]) for r in out}
+    expect = {r["ak"]: (round(r["s"], 6), r["c"]) for r in want}
+    assert got == expect
+    # Same join under the shuffled GROUP BY path (join + shuffle compose).
+    out_sh = ev.run(plan, table, foreign_chunks={"//b": fact_b},
+                    shuffle=True).to_rows()
+    got_sh = {r["ak"]: (round(r["s"], 6), r["c"]) for r in out_sh}
+    assert got_sh == expect
+
+
+def test_spmd_left_join_duplicates_and_nulls():
+    """LEFT join through the partitioned path: null-keyed and unmatched
+    self rows survive with null foreign columns; duplicate matches
+    expand."""
+    from ytsaurus_tpu.parallel.mesh import make_mesh
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+
+    left_schema = TableSchema.make([("k", "int64"), ("v", "int64")])
+    dim_schema = TableSchema.make([("dk", "int64"), ("x", "int64")])
+    dim = ColumnarChunk.from_rows(dim_schema, [(0, 100), (0, 101), (2, 102)])
+    mesh = make_mesh(8)
+    chunks = [ColumnarChunk.from_rows(left_schema, [
+        (0, s), (1, s), (None, s)]) for s in range(8)]
+    table = ShardedTable.from_chunks(mesh, chunks)
+    plan = build_query("k, v, x FROM [//l] LEFT JOIN [//d] ON k = dk",
+                       {"//l": left_schema, "//d": dim_schema})
+    out = DistributedEvaluator(mesh).run(
+        plan, table, foreign_chunks={"//d": dim}).to_rows()
+    from ytsaurus_tpu.chunks.columnar import concat_chunks
+    want = Evaluator().run_plan(plan, concat_chunks(chunks),
+                                {"//d": dim}).to_rows()
+    canon = lambda rows: sorted(
+        (r["k"] if r["k"] is not None else -99, r["v"],
+         r["x"] if r["x"] is not None else -99) for r in rows)
+    assert canon(out) == canon(want)
+
+
+def test_spmd_string_key_join():
+    """String join keys ride merged vocabularies on the SPMD paths (both
+    broadcast-unique and partitioned shapes)."""
+    from ytsaurus_tpu.parallel.mesh import make_mesh
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+
+    left_schema = TableSchema.make([("name", "string"), ("v", "int64")])
+    dim_schema = TableSchema.make([("dname", "string"), ("x", "int64")])
+    # Unique keys → broadcast path.
+    dim_u = ColumnarChunk.from_rows(dim_schema, [
+        ("alpha", 1), ("beta", 2), ("gamma", 3)])
+    # Duplicate keys → partitioned path.
+    dim_d = ColumnarChunk.from_rows(dim_schema, [
+        ("alpha", 1), ("alpha", 2), ("delta", 9)])
+    mesh = make_mesh(8)
+    names = ["alpha", "beta", "delta", "zeta"]
+    chunks = [ColumnarChunk.from_rows(left_schema, [
+        (names[(s + i) % 4], i) for i in range(5)]) for s in range(8)]
+    table = ShardedTable.from_chunks(mesh, chunks)
+    from ytsaurus_tpu.chunks.columnar import concat_chunks
+    merged = concat_chunks(chunks)
+    for dim in (dim_u, dim_d):
+        plan = build_query(
+            "name, v, x FROM [//l] JOIN [//d] ON name = dname",
+            {"//l": left_schema, "//d": dim_schema})
+        out = DistributedEvaluator(mesh).run(
+            plan, table, foreign_chunks={"//d": dim}).to_rows()
+        want = Evaluator().run_plan(plan, merged, {"//d": dim}).to_rows()
+        canon = lambda rows: sorted((r["name"], r["v"], r["x"])
+                                    for r in rows)
+        assert canon(out) == canon(want) and len(out) > 0

@@ -135,6 +135,38 @@ def _assemble_chunk(prepared_output, out_planes, out_count) -> ColumnarChunk:
                          row_count=int(out_count), columns=out_columns)
 
 
+def _vocab_remap_slots(self_bound, f_bound, bindings: list):
+    """String join keys: both sides' dictionary codes are remapped onto a
+    MERGED vocabulary so equality compares one code space (the SPMD
+    analog of execute_join's host remap).  Returns per-key binding slots
+    (None for non-string keys); tables are appended to `bindings`."""
+    import numpy as np
+
+    from ytsaurus_tpu.query.engine.expr import (
+        _merge_vocabs, _pad_np, _remap_table, _vocab_bucket,
+    )
+
+    self_slots: list = []
+    foreign_slots: list = []
+    for sb, fb in zip(self_bound, f_bound):
+        if sb.vocab is None and fb.vocab is None:
+            self_slots.append(None)
+            foreign_slots.append(None)
+            continue
+        s_vocab = sb.vocab if sb.vocab is not None \
+            else np.array([], dtype=object)
+        f_vocab = fb.vocab if fb.vocab is not None \
+            else np.array([], dtype=object)
+        merged = _merge_vocabs(s_vocab, f_vocab)
+        for vocab in (s_vocab, f_vocab):
+            table = _remap_table(vocab, merged)
+            bindings.append(jnp.asarray(
+                _pad_np(table, _vocab_bucket(len(table)), 0)))
+        self_slots.append(len(bindings) - 2)
+        foreign_slots.append(len(bindings) - 1)
+    return self_slots, foreign_slots
+
+
 @dataclass
 class _JoinSetup:
     """Device-resident broadcast-join plan: replicated sorted foreign
@@ -164,32 +196,43 @@ class DistributedEvaluator:
         cardinality is high (the all_gather merge would replicate heavy
         front work).  Default: gather-merge.
 
-        Joined plans run as device-resident broadcast joins: each foreign
-        table is key-sorted once, replicated to every device, and probed
-        per shard with a vectorized lexicographic binary search (the batch
-        reshaping of MultiJoinOpHelper's foreign lookups,
-        cg_routines/registry.cpp:599).  Requires unique foreign join keys
-        (lookup-join shape, e.g. TPC-H Q3) — others raise QueryUnsupported
-        and take the host-coordinated path."""
+        Joined plans run one of two ways:
+        - broadcast join (unique foreign keys, the lookup shape, e.g.
+          TPC-H Q3): each foreign table is key-sorted once, replicated to
+          every device, and probed per shard with a vectorized
+          lexicographic binary search (the batch reshaping of
+          MultiJoinOpHelper's foreign lookups, cg_routines/
+          registry.cpp:599);
+        - partitioned hash join (non-unique keys / fact-to-fact, or
+          under shuffle=True): BOTH sides are routed by join-key hash
+          over one all_to_all so equal keys co-locate, then each device
+          joins locally with match expansion — the shuffle-aware join of
+          engine_api/coordinator.h:92-97.
+        String keys work on both paths via merged vocabularies."""
         join_setup = None
         if plan.joins:
-            if shuffle:
-                raise YtError(
-                    "shuffle=True with joins is not supported yet: the "
-                    "gather-merge path would be chosen silently; run the "
-                    "join without shuffle or pre-join the table",
-                    code=EErrorCode.QueryUnsupported)
-            join_setup = self._prepare_joins(plan, table,
-                                             foreign_chunks or {})
+            join_setup = None if shuffle else self._prepare_joins(
+                plan, table, foreign_chunks or {})
+            if join_setup is None:
+                return self._run_partitioned(plan, table,
+                                             foreign_chunks or {},
+                                             bool(shuffle))
         if shuffle and plan.group is not None and not plan.group.totals:
             return self._run_shuffled(plan, table)
+        if join_setup is None:
+            columns_global = {name: (col.data, col.valid)
+                              for name, col in table.columns.items()}
+            rep_columns = {
+                name: _RepColumn(type=col.type, dictionary=col.dictionary)
+                for name, col in table.columns.items()}
+            return self._finish_gather(plan, columns_global,
+                                       table.row_valid, rep_columns,
+                                       table.capacity)
         n = table.n_shards
         cap = table.capacity
         bottom, front = split_plan(plan)
 
-        rep = table.rep_chunk()
-        if join_setup is not None:
-            rep = _RepChunk(capacity=cap, columns=join_setup.rep_columns)
+        rep = _RepChunk(capacity=cap, columns=join_setup.rep_columns)
         prepared_b = prepare(bottom, rep)
         inter_rep = _RepChunk(
             capacity=n * prepared_b.out_capacity,
@@ -199,7 +242,7 @@ class DistributedEvaluator:
 
         key = (ir.fingerprint(bottom), ir.fingerprint(front), n, cap,
                prepared_b.binding_shapes(), prepared_f.binding_shapes(),
-               join_setup.fingerprint if join_setup else None)
+               join_setup.fingerprint)
         fn = self._cache.get(key)
         if fn is None:
             fn = self._build(prepared_b, prepared_f, cap, join_setup)
@@ -208,18 +251,305 @@ class DistributedEvaluator:
         columns = {c.name: (table.columns[c.name].data,
                             table.columns[c.name].valid)
                    for c in bottom.schema if c.name in base_names}
-        extra = (join_setup.args, tuple(join_setup.bindings)) \
-            if join_setup else ()
         out_planes, out_count = fn(columns, table.row_valid,
                                    tuple(prepared_b.bindings),
-                                   tuple(prepared_f.bindings), *extra)
+                                   tuple(prepared_f.bindings),
+                                   join_setup.args,
+                                   tuple(join_setup.bindings))
         return _assemble_chunk(prepared_f.output, out_planes, out_count)
+
+    def _finish_gather(self, plan: ir.Query, columns_global: dict,
+                       row_valid, rep_columns: dict, cap: int
+                       ) -> ColumnarChunk:
+        """Bottom-per-shard + all_gather front merge over bare sharded
+        planes (the no-join tail of run(), reusable after a partitioned
+        join has replaced the table planes)."""
+        n = self.mesh.devices.size
+        bottom, front = split_plan(plan)
+        rep = _RepChunk(capacity=cap, columns=dict(rep_columns))
+        prepared_b = prepare(bottom, rep)
+        inter_rep = _RepChunk(
+            capacity=n * prepared_b.out_capacity,
+            columns={c.name: _RepColumn(type=c.type, dictionary=c.vocab)
+                     for c in prepared_b.output})
+        prepared_f = prepare(front, inter_rep)
+        key = ("finish", ir.fingerprint(bottom), ir.fingerprint(front), n,
+               cap, prepared_b.binding_shapes(),
+               prepared_f.binding_shapes())
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(prepared_b, prepared_f, cap, None)
+            self._cache[key] = fn
+        columns = {c.name: columns_global[c.name]
+                   for c in bottom.schema if c.name in columns_global}
+        out_planes, out_count = fn(columns, row_valid,
+                                   tuple(prepared_b.bindings),
+                                   tuple(prepared_f.bindings))
+        return _assemble_chunk(prepared_f.output, out_planes, out_count)
+
+    def _run_partitioned(self, plan: ir.Query, table: ShardedTable,
+                         foreign_chunks: dict, shuffle: bool
+                         ) -> ColumnarChunk:
+        """Partitioned hash join: route BOTH sides of each join by
+        join-key hash over one all_to_all so equal keys co-locate, then
+        join locally per device with match expansion — the general
+        fact-to-fact shape (non-unique foreign keys), composing with the
+        shuffled GROUP BY.  Ref: shuffle-aware join coordination,
+        engine_api/coordinator.h:92-97 + executor.cpp join routing.
+
+        Static-shape discipline (per join): a count pass sizes the
+        exchange quotas; a route+probe program moves rows and computes
+        per-self-row match ranges (outputs stay device-resident); the
+        host reads only the per-device totals to pick the expansion
+        capacity; an expand program materializes the joined planes."""
+        from dataclasses import replace as dc_replace
+
+        from ytsaurus_tpu.chunks.columnar import pad_capacity
+        from ytsaurus_tpu.parallel.shuffle import route_rows, transfer_counts
+        from ytsaurus_tpu.query.engine.expr import (
+            BindContext, ColumnBinding, EmitContext, ExprBinder,
+            _combine_u64, _mix_u64,
+        )
+        from ytsaurus_tpu.query.engine.joins import (
+            _bind_keys, _emit_encoded_keys, _lex_searchsorted,
+            null_key_mask, sort_foreign_keys,
+        )
+
+        mesh = self.mesh
+        n = table.n_shards
+        shard_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+
+        cur_cap = table.capacity
+        columns_global = {name: (col.data, col.valid)
+                          for name, col in table.columns.items()}
+        # Only planes the plan actually reads ride the exchange — a wide
+        # table joined on one key must not pay all_to_all bandwidth for
+        # dead columns.
+        needed = ir.referenced_columns(plan)
+        if needed is not None:
+            columns_global = {name: planes
+                              for name, planes in columns_global.items()
+                              if name in needed}
+        row_valid = table.row_valid
+        namespace = {name: ColumnBinding(type=col.type, vocab=col.dictionary)
+                     for name, col in table.columns.items()}
+        rep_columns = {
+            name: _RepColumn(type=col.type, dictionary=col.dictionary)
+            for name, col in table.columns.items()}
+
+        for join_index, join in enumerate(plan.joins):
+            foreign = foreign_chunks.get(join.foreign_table)
+            if foreign is None:
+                raise YtError(
+                    f"No data provided for join table "
+                    f"{join.foreign_table!r}",
+                    code=EErrorCode.QueryExecutionError)
+            bindings: list = []
+            bind_ctx = BindContext(columns=dict(namespace),
+                                   bindings=bindings)
+            binder = ExprBinder(bind_ctx)
+            self_bound = [binder.bind(e) for e in join.self_equations]
+            f_bound = _bind_keys(foreign, join.foreign_schema,
+                                 join.foreign_equations, bindings)
+            self_slots, foreign_slots = _vocab_remap_slots(
+                self_bound, f_bound, bindings)
+            bnd = tuple(bindings)
+            is_left = join.is_left
+            s_cap = cur_cap
+
+            flat_names = [
+                (f"{join.alias}.{f}" if join.alias else f, f)
+                for f in join.foreign_columns]
+            if needed is not None:
+                flat_names = [(flat, f) for flat, f in flat_names
+                              if flat in needed]
+            # Shard the foreign table across the mesh (1/n per device);
+            # route only the planes the join reads (key-expression
+            # sources + pulled columns that survive pruning).
+            f_count = foreign.row_count
+            f_slice = pad_capacity(max((f_count + n - 1) // n, 1))
+            f_total = n * f_slice
+            f_key_refs: set = set()
+            for eq in join.foreign_equations:
+                f_key_refs.update(ir.expr_references(eq))
+            f_names = sorted(f_key_refs | {f for _, f in flat_names})
+            f_global = {}
+            for fname in f_names:
+                fcol = foreign.columns[fname]
+                pad = f_total - f_count
+                data = jnp.concatenate(
+                    [fcol.data[:f_count],
+                     jnp.zeros(pad, dtype=fcol.data.dtype)])
+                valid = jnp.concatenate(
+                    [fcol.valid[:f_count], jnp.zeros(pad, dtype=bool)])
+                f_global[fname] = (jax.device_put(data, shard_sharding),
+                                   jax.device_put(valid, shard_sharding))
+            f_row_valid = jax.device_put(
+                jnp.arange(f_total) < f_count, shard_sharding)
+
+            def make_pid(keys, mask, keep_null_local: bool):
+                """Destination device by key hash; null-keyed live rows
+                stay local for LEFT joins (they must still emit an
+                unmatched output row) and are discarded otherwise."""
+                acc = jnp.full(mask.shape, np.uint64(0x9E3779B97F4A7C15),
+                               dtype=jnp.uint64)
+                for v, d in keys:
+                    h = _mix_u64(d)
+                    h = jnp.where(v > 0, h, jnp.zeros_like(h))
+                    acc = _combine_u64(acc, h)
+                pid = (acc % np.uint64(n)).astype(jnp.int32)
+                null = null_key_mask(keys)
+                if keep_null_local:
+                    me = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32)
+                    pid = jnp.where(null, me, pid)
+                else:
+                    pid = jnp.where(null, n, pid)
+                return jnp.where(mask, pid, n)
+
+            def emit_self(cols, capacity, bnd_t):
+                ctx = EmitContext(columns=cols, bindings=bnd_t,
+                                  capacity=capacity)
+                return _emit_encoded_keys(self_bound, self_slots, ctx)
+
+            def emit_foreign(cols, capacity, bnd_t):
+                ctx = EmitContext(columns=cols, bindings=bnd_t,
+                                  capacity=capacity)
+                return _emit_encoded_keys(f_bound, foreign_slots, ctx)
+
+            def count_pass(cols, mask, fcols, fmask, bnd_t):
+                pid_s = make_pid(emit_self(cols, s_cap, bnd_t), mask,
+                                 is_left)
+                pid_f = make_pid(emit_foreign(fcols, f_slice, bnd_t),
+                                 fmask, False)
+                return (transfer_counts(pid_s, pid_s < n, n),
+                        transfer_counts(pid_f, pid_f < n, n))
+
+            key_base = ("pjoin", ir.fingerprint(plan), join_index, n,
+                        s_cap, f_slice, f_count > 0,
+                        tuple((tuple(b.shape), str(b.dtype))
+                              for b in bindings))
+            cfn = self._cache.get(key_base + ("count",))
+            if cfn is None:
+                cfn = jax.jit(shard_map(
+                    count_pass, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS),) * 4 + (P(),),
+                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    check_vma=False))
+                self._cache[key_base + ("count",)] = cfn
+            counts_s, counts_f = cfn(columns_global, row_valid, f_global,
+                                     f_row_valid, bnd)
+            quota_s = pad_capacity(max(int(np.asarray(counts_s).max()), 1))
+            quota_f = pad_capacity(max(int(np.asarray(counts_f).max()), 1))
+            S, F = n * quota_s, n * quota_f
+
+            def route_probe(cols, mask, fcols, fmask, bnd_t):
+                pid_s = make_pid(emit_self(cols, s_cap, bnd_t), mask,
+                                 is_left)
+                recv_s, mask_s = route_rows(cols, pid_s, n, quota_s, s_cap)
+                pid_f = make_pid(emit_foreign(fcols, f_slice, bnd_t),
+                                 fmask, False)
+                recv_f, mask_f = route_rows(fcols, pid_f, n, quota_f,
+                                            f_slice)
+                s_keys = emit_self(recv_s, S, bnd_t)
+                f_keys = emit_foreign(recv_f, F, bnd_t)
+                f_order, f_sorted = sort_foreign_keys(f_keys, mask_f)
+                n_f = mask_f.sum()
+                lo = _lex_searchsorted(f_sorted, n_f, F, s_keys, "left")
+                hi = _lex_searchsorted(f_sorted, n_f, F, s_keys, "right")
+                s_null = null_key_mask(s_keys)
+                counts = jnp.where(mask_s & ~s_null, hi - lo, 0)
+                per_row = jnp.where(mask_s, jnp.maximum(counts, 1), 0) \
+                    if is_left else counts
+                return (recv_s, mask_s, recv_f, f_order, lo, counts,
+                        per_row.sum()[None])
+
+            pfn = self._cache.get(key_base + ("probe", quota_s, quota_f))
+            if pfn is None:
+                pfn = jax.jit(shard_map(
+                    route_probe, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS),) * 4 + (P(),),
+                    out_specs=(P(SHARD_AXIS),) * 7, check_vma=False))
+                self._cache[key_base + ("probe", quota_s, quota_f)] = pfn
+            (recv_s, mask_s, recv_f, f_order, lo, counts,
+             totals) = pfn(columns_global, row_valid, f_global,
+                           f_row_valid, bnd)
+            out_cap = pad_capacity(max(int(np.asarray(totals).max()), 1))
+            self_names = sorted(columns_global)
+
+            def expand(recv_s, mask_s, recv_f, f_order, lo, counts):
+                per_row = jnp.where(mask_s, jnp.maximum(counts, 1), 0) \
+                    if is_left else counts
+                offsets = jnp.cumsum(per_row)
+                total = offsets[-1]
+                starts = jnp.concatenate(
+                    [jnp.zeros(1, dtype=offsets.dtype), offsets[:-1]])
+                out_idx = jnp.arange(out_cap)
+                self_row = jnp.clip(
+                    jnp.searchsorted(offsets, out_idx, side="right"),
+                    0, S - 1)
+                within = out_idx - starts[self_row]
+                matched = counts[self_row] > 0
+                f_pos = jnp.clip(lo[self_row] + within, 0, F - 1)
+                f_row = f_order[f_pos]
+                live = out_idx < total
+                out = {}
+                for name in self_names:
+                    d, v = recv_s[name]
+                    out[name] = (d[self_row], v[self_row] & live)
+                for flat, fname in flat_names:
+                    d, v = recv_f[fname]
+                    out[flat] = (d[f_row], v[f_row] & live & matched)
+                return out, live
+
+            efn = self._cache.get(
+                key_base + ("expand", quota_s, quota_f, out_cap))
+            if efn is None:
+                efn = jax.jit(shard_map(
+                    expand, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS),) * 6,
+                    out_specs=P(SHARD_AXIS), check_vma=False))
+                self._cache[
+                    key_base + ("expand", quota_s, quota_f, out_cap)] = efn
+            columns_global, row_valid = efn(recv_s, mask_s, recv_f,
+                                            f_order, lo, counts)
+            cur_cap = out_cap
+            for flat, fname in flat_names:
+                fcol = foreign.columns[fname]
+                namespace[flat] = ColumnBinding(type=fcol.type,
+                                                vocab=fcol.dictionary)
+                rep_columns[flat] = _RepColumn(type=fcol.type,
+                                               dictionary=fcol.dictionary)
+
+        plan_nojoin = dc_replace(plan, joins=())
+        if needed is not None:
+            # The finish stages bind every schema column; drop the ones
+            # pruned out of the exchange so the namespaces agree.
+            plan_nojoin = dc_replace(plan_nojoin, schema=TableSchema(
+                columns=tuple(c for c in plan.schema
+                              if c.name in needed)))
+        if shuffle and plan.group is not None and not plan.group.totals:
+            return self._finish_shuffled(plan_nojoin, columns_global,
+                                         row_valid, rep_columns, cur_cap)
+        return self._finish_gather(plan_nojoin, columns_global, row_valid,
+                                   rep_columns, cur_cap)
 
     def _run_shuffled(self, plan: ir.Query, table: ShardedTable
                       ) -> ColumnarChunk:
+        columns_global = {name: (col.data, col.valid)
+                          for name, col in table.columns.items()}
+        rep_columns = {
+            name: _RepColumn(type=col.type, dictionary=col.dictionary)
+            for name, col in table.columns.items()}
+        return self._finish_shuffled(plan, columns_global, table.row_valid,
+                                     rep_columns, table.capacity)
+
+    def _finish_shuffled(self, plan: ir.Query, columns_global: dict,
+                         row_valid, rep_columns: dict, cap: int
+                         ) -> ColumnarChunk:
         """GROUP BY via key-hash all_to_all: every device ends up owning
         complete groups, so group+having run fully local; only
-        order/project/offset/limit merge at the front."""
+        order/project/offset/limit merge at the front.  Operates on bare
+        sharded planes so it also finishes partitioned-join outputs."""
         from dataclasses import replace as dc_replace
 
         import numpy as np
@@ -232,14 +562,13 @@ class DistributedEvaluator:
         )
 
         mesh = self.mesh
-        n = table.n_shards
-        cap = table.capacity
+        n = mesh.devices.size
 
         # Bind where + group-key expressions against the (shared) vocab.
         def bind_keys():
             bind_ctx = BindContext(columns={
-                name: ColumnBinding(type=col.type, vocab=col.dictionary)
-                for name, col in table.columns.items()})
+                name: ColumnBinding(type=rc.type, vocab=rc.dictionary)
+                for name, rc in rep_columns.items()})
             binder = ExprBinder(bind_ctx)
             where_b = binder.bind(plan.where) if plan.where is not None else None
             key_b = [binder.bind(item.expr)
@@ -248,10 +577,8 @@ class DistributedEvaluator:
 
         bind_ctx, where_b, key_b = bind_keys()
         bindings = tuple(bind_ctx.bindings)
-        names = [c.name for c in plan.schema]
-        columns_global = {name: (table.columns[name].data,
-                                 table.columns[name].valid)
-                          for name in names}
+        names = [c.name for c in plan.schema if c.name in columns_global]
+        columns_global = {name: columns_global[name] for name in names}
 
         def dest_ids(columns, row_valid, bnd):
             ctx = EmitContext(columns=columns, bindings=bnd, capacity=cap)
@@ -278,7 +605,7 @@ class DistributedEvaluator:
             count_pass, mesh=mesh,
             in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
             out_specs=P(SHARD_AXIS), check_vma=False))(
-                columns_global, table.row_valid, bindings)
+                columns_global, row_valid, bindings)
         quota = pad_capacity(max(int(np.asarray(counts).max()), 1))
         recv_cap = quota * n
 
@@ -290,8 +617,9 @@ class DistributedEvaluator:
                                 limit=None)
         local_rep = _RepChunk(
             capacity=recv_cap,
-            columns={name: _RepColumn(type=col.type, dictionary=col.dictionary)
-                     for name, col in table.columns.items()})
+            columns={name: _RepColumn(type=rc.type,
+                                      dictionary=rc.dictionary)
+                     for name, rc in rep_columns.items()})
         prepared_local = prepare(local_plan, local_rep)
         front = ir.FrontQuery(
             schema=local_plan.post_group_schema(), order=plan.order,
@@ -327,17 +655,21 @@ class DistributedEvaluator:
                 in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P(), P()),
                 out_specs=P(), check_vma=False))
             self._cache[key] = fn
-        out_planes, out_count = fn(columns_global, table.row_valid, bindings,
+        out_planes, out_count = fn(columns_global, row_valid, bindings,
                                    tuple(prepared_local.bindings),
                                    tuple(prepared_front.bindings))
         return _assemble_chunk(prepared_front.output, out_planes,
                                out_count)
 
     def _prepare_joins(self, plan: ir.Query, table: ShardedTable,
-                       foreign_chunks: dict) -> _JoinSetup:
+                       foreign_chunks: dict) -> "Optional[_JoinSetup]":
         """Bind every join as a replicated lookup: sort the foreign side
         once on the host device, verify key uniqueness, and return a
-        traceable per-shard probe step."""
+        traceable per-shard probe step.  String keys ride merged
+        vocabularies (self codes remapped at probe time via a binding
+        table, foreign codes remapped host-side before the sort).
+        Returns None when any join's foreign keys are NOT unique — the
+        caller falls back to the partitioned-exchange path."""
         from ytsaurus_tpu.query.engine.expr import (
             BindContext, ColumnBinding, EmitContext, ExprBinder,
         )
@@ -372,26 +704,26 @@ class DistributedEvaluator:
             self_bound = [binder.bind(e) for e in join.self_equations]
             f_bound = _bind_keys(foreign, join.foreign_schema,
                                  join.foreign_equations, bindings)
-            if any(b.vocab is not None for b in self_bound + f_bound):
-                raise YtError(
-                    "SPMD join on string keys is not supported yet; use "
-                    "the host-coordinated path",
-                    code=EErrorCode.QueryUnsupported)
+            self_slots, foreign_slots = _vocab_remap_slots(
+                self_bound, f_bound, bindings)
             # Host phase: encode + sort the foreign keys, verify unique.
             f_ctx = EmitContext(columns={
                 name: (foreign.columns[name].data,
                        foreign.columns[name].valid)
                 for name in foreign.schema.column_names},
                 bindings=tuple(bindings), capacity=foreign.capacity)
-            f_keys = _emit_encoded_keys(f_bound, [None] * len(f_bound),
-                                        f_ctx)
+            f_keys = _emit_encoded_keys(f_bound, foreign_slots, f_ctx)
             n_foreign = foreign.row_count
             # Host phase cached per (join shape, foreign chunk identity):
             # repeated queries against an unchanged dimension table must
             # not re-sort it or pay the uniqueness-check device sync.
             host_key = ("join-host", ir.fingerprint(ir.Query(
                 schema=join.foreign_schema, source=join.foreign_table,
-                joins=(join,))), id(foreign), foreign.capacity, n_foreign)
+                joins=(join,))), id(foreign), foreign.capacity, n_foreign,
+                # Remapped codes depend on BOTH sides' vocabularies (the
+                # merged space): key the cache on their identities.
+                tuple(id(b.vocab) if b.vocab is not None else None
+                      for b in list(self_bound) + list(f_bound)))
             cached = self._cache.get(host_key)
             if cached is None:
                 f_order, f_sorted = sort_foreign_keys(f_keys,
@@ -410,10 +742,7 @@ class DistributedEvaluator:
                 self._cache[host_key] = cached
             f_order, f_sorted, unique = cached
             if not unique:
-                raise YtError(
-                    "SPMD join requires unique foreign join keys "
-                    "(lookup-join shape); use the host-coordinated path",
-                    code=EErrorCode.QueryUnsupported)
+                return None     # fact-to-fact: partitioned exchange path
             # Replicated args: sorted key planes + gathered foreign columns.
             arg_start = len(args)
             for v, d in f_sorted:
@@ -431,19 +760,21 @@ class DistributedEvaluator:
                 rep_columns[flat] = _RepColumn(type=fcol.type,
                                                dictionary=fcol.dictionary)
             args.append(jnp.asarray(n_foreign, dtype=jnp.int64))
-            steps.append((self_bound, len(f_keys), join.is_left,
-                          flat_names, (arg_start, len(args)),
+            steps.append((self_bound, self_slots, len(f_keys),
+                          join.is_left, flat_names, (arg_start, len(args)),
                           foreign.capacity))
             fingerprint_parts.append(
                 (ir.fingerprint(ir.Query(schema=join.foreign_schema,
                                          source=join.foreign_table,
                                          joins=(join,))),
-                 foreign.capacity, n_foreign > 0))
+                 foreign.capacity, n_foreign > 0,
+                 tuple(len(b.vocab) if b.vocab is not None else -1
+                       for b in list(self_bound) + list(f_bound))))
 
         join_bindings = tuple(bindings)
 
         def apply(columns, mask, bnd, join_args):
-            for (self_bound, n_keys, is_left, flat_names,
+            for (self_bound, self_slots, n_keys, is_left, flat_names,
                  (a0, a1), f_cap) in steps:
                 sl = join_args[a0:a1]
                 f_sorted = [(sl[2 * i], sl[2 * i + 1])
@@ -452,7 +783,7 @@ class DistributedEvaluator:
                 ctx = EmitContext(columns=columns, bindings=bnd,
                                   capacity=cap)
                 self_keys = _emit_encoded_keys(
-                    self_bound, [None] * len(self_bound), ctx)
+                    self_bound, self_slots, ctx)
                 lo = _lex_searchsorted(f_sorted, n_foreign, f_cap,
                                        self_keys, "left")
                 hi = _lex_searchsorted(f_sorted, n_foreign, f_cap,

@@ -98,6 +98,57 @@ class TStringPredicate(TExpr):
     negated: bool = False
 
 
+def expr_references(expr):
+    """Yield every TReference name inside an expression tree."""
+    import dataclasses as _dc
+    if isinstance(expr, TReference):
+        yield expr.name
+        return
+    if not isinstance(expr, TExpr):
+        return
+    for field in _dc.fields(expr):
+        value = getattr(expr, field.name)
+        if isinstance(value, TExpr):
+            yield from expr_references(value)
+        elif isinstance(value, (tuple, list)):
+            for item in value:
+                if isinstance(item, TExpr):
+                    yield from expr_references(item)
+
+
+def referenced_columns(query: "Query") -> "Optional[set[str]]":
+    """Input-namespace columns the plan actually reads, or None when
+    every schema column flows to the output (bare select: no projection
+    and no grouping).  Used to prune planes before expensive data
+    movement (e.g. the partitioned-join exchange)."""
+    if query.project is None and query.group is None:
+        return None
+    refs: set[str] = set()
+
+    def add(expr) -> None:
+        if expr is not None:
+            refs.update(expr_references(expr))
+
+    add(query.where)
+    if query.group is not None:
+        for item in query.group.group_items:
+            add(item.expr)
+        for agg in query.group.aggregate_items:
+            add(agg.argument)
+            add(agg.by_argument)
+    add(query.having)
+    if query.order is not None:
+        for item in query.order.items:
+            add(item.expr)
+    if query.project is not None:
+        for item in query.project.items:
+            add(item.expr)
+    for join in query.joins:
+        for eq in join.self_equations:
+            add(eq)
+    return refs
+
+
 @dataclass(frozen=True)
 class NamedExpr:
     name: str
