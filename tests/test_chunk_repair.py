@@ -1,0 +1,108 @@
+"""Background chunk re-replication (VERDICT r2 #6).
+
+Kill a node holding one of a chunk's two replicas; the master's chunk
+replicator restores the replication factor within its scan interval with
+NO read on the chunk's path (ref chunk_replicator.h Replicate jobs).
+"""
+
+import time
+
+from ytsaurus_tpu.remote_client import connect_remote
+from ytsaurus_tpu.rpc import Channel
+
+
+def _node_chunks(address: str) -> set[str]:
+    ch = Channel(address, timeout=15)
+    try:
+        body, _ = ch.call("data_node", "list_chunks", {})
+        return {c.decode() if isinstance(c, bytes) else c
+                for c in body.get("chunk_ids", [])}
+    finally:
+        ch.close()
+
+
+def test_dead_node_chunks_re_replicate_without_reads(tmp_path):
+    from ytsaurus_tpu.environment import LocalCluster
+
+    with LocalCluster(str(tmp_path / "repair"), n_nodes=3) as cluster:
+        client = connect_remote(cluster.primary_address)
+        rows = [{"k": i, "v": float(i)} for i in range(500)]
+        client.write_table("//repair/t", rows)
+
+        # Locate every chunk's holders straight from the nodes.
+        per_node = {a: _node_chunks(a) for a in cluster.node_addresses}
+        all_chunks = set().union(*per_node.values())
+        assert all_chunks, "no chunks written"
+        # RF=2: every chunk is on exactly 2 of the 3 nodes.
+        for cid in all_chunks:
+            assert sum(cid in s for s in per_node.values()) == 2
+
+        # Kill a node that holds at least one chunk.
+        victim = next(i for i, a in enumerate(cluster.node_addresses)
+                      if per_node[a])
+        victim_addr = cluster.node_addresses[victim]
+        lost = per_node[victim_addr]
+        cluster.kill_node(victim)
+
+        # No reads anywhere.  Within a few scan intervals every lost
+        # chunk must be back at RF=2 across the surviving nodes.
+        survivors = [a for a in cluster.node_addresses
+                     if a != victim_addr]
+        deadline = time.monotonic() + 60
+        missing = set(lost)
+        while time.monotonic() < deadline:
+            counts = {cid: 0 for cid in lost}
+            for addr in survivors:
+                held = _node_chunks(addr)
+                for cid in lost:
+                    if cid in held:
+                        counts[cid] += 1
+            missing = {cid for cid, c in counts.items() if c < 2}
+            if not missing:
+                break
+            time.sleep(1.0)
+        assert not missing, \
+            f"chunks still under-replicated after repair window: {missing}"
+
+        # The data stayed readable afterwards (sanity, not the repair
+        # mechanism).
+        got = client.read_table("//repair/t")
+        assert len(got) == 500
+        client.close()
+
+
+def test_replicator_scan_unit(tmp_path):
+    """Unit-level: scan_once computes targets from rendezvous placement
+    and issues replicate_chunk only for missing target replicas."""
+    from ytsaurus_tpu.server.chunk_replicator import ChunkReplicator
+    from ytsaurus_tpu.server.remote_store import placement_rank
+
+    calls = []
+
+    class FakeNode:
+        def __init__(self, address, chunks):
+            self.address = address
+            self.chunks = set(chunks)
+
+        def call(self, service, method, body=None, attachments=(), **kw):
+            if method == "list_chunks":
+                return {"chunk_ids": sorted(self.chunks)}, []
+            if method == "replicate_chunk":
+                calls.append((self.address, body["chunk_id"],
+                              body["target"]))
+                return {}, []
+            raise AssertionError(method)
+
+    nodes = {f"n{i}": FakeNode(f"n{i}", []) for i in range(3)}
+    targets = placement_rank("c1", sorted(nodes))[:2]
+    # c1 present only on its first target → one replication to the other.
+    nodes[targets[0]].chunks.add("c1")
+    rep = ChunkReplicator(lambda: sorted(nodes), replication_factor=2)
+    rep._channels = dict(nodes)
+    issued = rep.scan_once()
+    assert issued == 1
+    assert calls == [(targets[0], "c1", targets[1])]
+    # Fully-replicated chunk → no-op scan.
+    calls.clear()
+    nodes[targets[1]].chunks.add("c1")
+    assert rep.scan_once() == 0 and calls == []

@@ -145,6 +145,18 @@ class FsChunkStore:
         return os.path.exists(self._path(chunk_id)) or \
             os.path.exists(self._erasure_meta_path(chunk_id))
 
+    def erasure_codec_of(self, chunk_id: str) -> Optional[str]:
+        """Codec name when the chunk is stored erasure-coded, else None
+        (lets the replicator preserve the encoding on the target)."""
+        from ytsaurus_tpu import yson
+        try:
+            with open(self._erasure_meta_path(chunk_id), "rb") as f:
+                meta = yson.loads(f.read())
+        except FileNotFoundError:
+            return None
+        codec = meta.get("codec")
+        return codec.decode() if isinstance(codec, bytes) else codec
+
     def remove_chunk(self, chunk_id: str) -> None:
         paths = [self._path(chunk_id)]
         meta_path = self._erasure_meta_path(chunk_id)

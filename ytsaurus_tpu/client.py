@@ -98,6 +98,44 @@ def _normalize_per_tablet(ids) -> "list[list[str]]":
     return [list(sub) for sub in ids]
 
 
+def _hedged_race(attempts: "list[Callable]", delay: float,
+                 base_error: YtError):
+    """Run `attempts` staggered by `delay`; first success wins, failures
+    arm the next attempt immediately.  Raises base_error when every
+    attempt fails (ref core/rpc/hedging_channel.h semantics generalized
+    to N backups)."""
+    import concurrent.futures as cf
+
+    if not attempts:
+        raise base_error
+    pool = cf.ThreadPoolExecutor(max_workers=len(attempts),
+                                 thread_name_prefix="hedged-lookup")
+    try:
+        futures: list = []
+        next_idx = 0
+        errors: list[YtError] = []
+        while True:
+            if next_idx < len(attempts):
+                futures.append(pool.submit(attempts[next_idx]))
+                next_idx += 1
+            if not futures:
+                raise YtError(
+                    "all hedged replica lookups failed",
+                    code=base_error.code,
+                    inner_errors=[base_error] + errors[:3])
+            timeout = delay if next_idx < len(attempts) else None
+            done, _ = cf.wait(futures, timeout=timeout,
+                              return_when=cf.FIRST_COMPLETED)
+            for fut in done:
+                futures.remove(fut)
+                try:
+                    return fut.result()
+                except YtError as err:
+                    errors.append(err)
+    finally:
+        pool.shutdown(wait=False)
+
+
 class YtClient:
     def __init__(self, cluster: YtCluster):
         self.cluster = cluster
@@ -108,6 +146,8 @@ class YtClient:
         self._computed_plans: dict = {}
         self._table_replicator = None
         self._query_tracker = None
+        # Stagger between hedged replica lookups (hedging_channel.h).
+        self.lookup_hedging_delay = 0.05
 
     def exec_node_addresses(self) -> dict:
         """id -> address of data nodes hosting exec slots ({} in pure
@@ -334,20 +374,20 @@ class YtClient:
                     account, node_count=-freed_nodes,
                     disk_space=-freed_disk, chunk_count=-freed_chunks)
 
-    def collect_garbage(self) -> int:
-        """Remove chunk files referenced by no table (ref: the master's
-        object GC sweeping unreferenced chunks, object_server).  Returns the
-        number of chunks removed.  Runtime tablet state counts as a
-        reference (mounted tables may hold chunks not yet persisted), and
-        the sweep refuses to run while operations are in flight — a
-        controller writes chunk files before publishing @chunk_ids."""
-        for op in self.scheduler.list_operations():
-            if op.state in ("pending", "running"):
-                raise YtError(
-                    f"Cannot collect garbage while operation {op.id} is "
-                    f"{op.state}", code=EErrorCode.OperationFailed)
-        referenced: set = set()
+    def referenced_chunk_ids(self) -> set:
+        """Every chunk id rooted by the metadata tree or live runtime
+        tablet state (tables, per-tablet stores, ordered stores,
+        operation snapshots).  Hunk chunks are NOT resolved here — their
+        liveness needs a meta read per data chunk (see collect_garbage).
+        Shared by GC (what to keep) and the chunk replicator (what is
+        worth re-replicating).  Walks under the master's mutation lock:
+        the replicator calls this from its scan thread and a mutating
+        dict mid-iteration would abort the walk."""
+        with self.cluster.master._lock:
+            return self._referenced_chunk_ids_locked()
 
+    def _referenced_chunk_ids_locked(self) -> set:
+        referenced: set = set()
         stack = [self.cluster.master.tree.root]
         while stack:
             node = stack.pop()
@@ -369,6 +409,21 @@ class YtClient:
         for tablets in self.cluster.tablets.values():
             for tablet in tablets:
                 referenced.update(tablet.chunk_ids)
+        return referenced
+
+    def collect_garbage(self) -> int:
+        """Remove chunk files referenced by no table (ref: the master's
+        object GC sweeping unreferenced chunks, object_server).  Returns the
+        number of chunks removed.  Runtime tablet state counts as a
+        reference (mounted tables may hold chunks not yet persisted), and
+        the sweep refuses to run while operations are in flight — a
+        controller writes chunk files before publishing @chunk_ids."""
+        for op in self.scheduler.list_operations():
+            if op.state in ("pending", "running"):
+                raise YtError(
+                    f"Cannot collect garbage while operation {op.id} is "
+                    f"{op.state}", code=EErrorCode.OperationFailed)
+        referenced = self.referenced_chunk_ids()
         # Hunk chunks are live iff a live data chunk's meta references them
         # (ref hunk_chunk_sweeper: ref-counted hunk chunk attachment).
         # The meta pass costs a read per live chunk, so only hunk-bearing
@@ -913,9 +968,12 @@ class YtClient:
                     replica_fallback: bool = False
                     ) -> list[Optional[dict]]:
         """Point reads.  replica_fallback=True: when the upstream table is
-        unavailable, read from the freshest enabled replica instead (sync
-        replicas first) — the in-process analog of hedged replica reads
-        (core/rpc/hedging_channel.h, client hedging)."""
+        unavailable, read from the replicas — HEDGED, not sequential
+        (core/rpc/hedging_channel.h): the best replica (sync first, then
+        freshest) starts immediately and each further replica is armed
+        after `lookup_hedging_delay`, first success wins — so one slow
+        replica bounds tail latency at ~delay + healthy-replica latency
+        instead of the slow replica's timeout."""
         if replica_fallback:
             try:
                 return self.lookup_rows(path, keys, timestamp=timestamp,
@@ -923,22 +981,25 @@ class YtClient:
             except YtError as primary_err:
                 from ytsaurus_tpu.tablet import replication as repl
                 replicas = repl.replica_descriptors(self, path)
-                ranked = sorted(
-                    replicas.values(),
-                    key=lambda i: (i.get("mode") != "sync",
-                                   -int(i.get("last_replicated_ts", 0))))
-                for info in ranked:
-                    if not info.get("enabled"):
-                        continue
-                    try:
-                        rc = self.table_replicator.replica_client(
-                            info.get("cluster_root"))
-                        return rc.lookup_rows(
-                            info["path"], keys, timestamp=timestamp,
-                            column_names=column_names)
-                    except YtError:
-                        continue
-                raise primary_err
+                ranked = [
+                    info for info in sorted(
+                        replicas.values(),
+                        key=lambda i: (i.get("mode") != "sync",
+                                       -int(i.get("last_replicated_ts",
+                                                  0))))
+                    if info.get("enabled")]
+
+                def from_replica(info):
+                    rc = self.table_replicator.replica_client(
+                        info.get("cluster_root"))
+                    return rc.lookup_rows(
+                        info["path"], keys, timestamp=timestamp,
+                        column_names=column_names)
+
+                return _hedged_race(
+                    [lambda info=info: from_replica(info)
+                     for info in ranked],
+                    self.lookup_hedging_delay, primary_err)
         tablets = self._mounted_tablets(path)
         self._require_sorted(tablets[0], path)
         keys = self._fill_computed_keys(tablets[0].schema,

@@ -135,6 +135,16 @@ def _assemble_chunk(prepared_output, out_planes, out_count) -> ColumnarChunk:
                          row_count=int(out_count), columns=out_columns)
 
 
+def _canonical_hash_plane(data: jax.Array) -> jax.Array:
+    """Canonicalize values before hashing for routing: -0.0 and +0.0
+    compare equal but differ by bit pattern, so without this two rows
+    that MATCH under the join/group comparison could land on different
+    devices and never meet."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return jnp.where(data == 0, jnp.zeros_like(data), data)
+    return data
+
+
 def _vocab_remap_slots(self_bound, f_bound, bindings: list):
     """String join keys: both sides' dictionary codes are remapped onto a
     MERGED vocabulary so equality compares one code space (the SPMD
@@ -219,51 +229,27 @@ class DistributedEvaluator:
                                              bool(shuffle))
         if shuffle and plan.group is not None and not plan.group.totals:
             return self._run_shuffled(plan, table)
+        columns_global = {name: (col.data, col.valid)
+                          for name, col in table.columns.items()}
         if join_setup is None:
-            columns_global = {name: (col.data, col.valid)
-                              for name, col in table.columns.items()}
             rep_columns = {
                 name: _RepColumn(type=col.type, dictionary=col.dictionary)
                 for name, col in table.columns.items()}
-            return self._finish_gather(plan, columns_global,
-                                       table.row_valid, rep_columns,
-                                       table.capacity)
-        n = table.n_shards
-        cap = table.capacity
-        bottom, front = split_plan(plan)
-
-        rep = _RepChunk(capacity=cap, columns=join_setup.rep_columns)
-        prepared_b = prepare(bottom, rep)
-        inter_rep = _RepChunk(
-            capacity=n * prepared_b.out_capacity,
-            columns={c.name: _RepColumn(type=c.type, dictionary=c.vocab)
-                     for c in prepared_b.output})
-        prepared_f = prepare(front, inter_rep)
-
-        key = (ir.fingerprint(bottom), ir.fingerprint(front), n, cap,
-               prepared_b.binding_shapes(), prepared_f.binding_shapes(),
-               join_setup.fingerprint)
-        fn = self._cache.get(key)
-        if fn is None:
-            fn = self._build(prepared_b, prepared_f, cap, join_setup)
-            self._cache[key] = fn
-        base_names = table.schema.column_names
-        columns = {c.name: (table.columns[c.name].data,
-                            table.columns[c.name].valid)
-                   for c in bottom.schema if c.name in base_names}
-        out_planes, out_count = fn(columns, table.row_valid,
-                                   tuple(prepared_b.bindings),
-                                   tuple(prepared_f.bindings),
-                                   join_setup.args,
-                                   tuple(join_setup.bindings))
-        return _assemble_chunk(prepared_f.output, out_planes, out_count)
+        else:
+            rep_columns = join_setup.rep_columns
+        return self._finish_gather(plan, columns_global, table.row_valid,
+                                   rep_columns, table.capacity,
+                                   join_setup=join_setup)
 
     def _finish_gather(self, plan: ir.Query, columns_global: dict,
-                       row_valid, rep_columns: dict, cap: int
+                       row_valid, rep_columns: dict, cap: int,
+                       join_setup: "Optional[_JoinSetup]" = None
                        ) -> ColumnarChunk:
         """Bottom-per-shard + all_gather front merge over bare sharded
-        planes (the no-join tail of run(), reusable after a partitioned
-        join has replaced the table planes)."""
+        planes — run()'s tail for both the no-join and broadcast-join
+        shapes, reusable after a partitioned join has replaced the table
+        planes.  With join_setup, the broadcast probe runs as a traced
+        step ahead of the bottom query inside the same program."""
         n = self.mesh.devices.size
         bottom, front = split_plan(plan)
         rep = _RepChunk(capacity=cap, columns=dict(rep_columns))
@@ -275,16 +261,19 @@ class DistributedEvaluator:
         prepared_f = prepare(front, inter_rep)
         key = ("finish", ir.fingerprint(bottom), ir.fingerprint(front), n,
                cap, prepared_b.binding_shapes(),
-               prepared_f.binding_shapes())
+               prepared_f.binding_shapes(),
+               join_setup.fingerprint if join_setup else None)
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._build(prepared_b, prepared_f, cap, None)
+            fn = self._build(prepared_b, prepared_f, cap, join_setup)
             self._cache[key] = fn
         columns = {c.name: columns_global[c.name]
                    for c in bottom.schema if c.name in columns_global}
+        extra = (join_setup.args, tuple(join_setup.bindings)) \
+            if join_setup else ()
         out_planes, out_count = fn(columns, row_valid,
                                    tuple(prepared_b.bindings),
-                                   tuple(prepared_f.bindings))
+                                   tuple(prepared_f.bindings), *extra)
         return _assemble_chunk(prepared_f.output, out_planes, out_count)
 
     def _run_partitioned(self, plan: ir.Query, table: ShardedTable,
@@ -394,7 +383,7 @@ class DistributedEvaluator:
                 acc = jnp.full(mask.shape, np.uint64(0x9E3779B97F4A7C15),
                                dtype=jnp.uint64)
                 for v, d in keys:
-                    h = _mix_u64(d)
+                    h = _mix_u64(_canonical_hash_plane(d))
                     h = jnp.where(v > 0, h, jnp.zeros_like(h))
                     acc = _combine_u64(acc, h)
                 pid = (acc % np.uint64(n)).astype(jnp.int32)
@@ -589,8 +578,9 @@ class DistributedEvaluator:
             acc = jnp.full(cap, np.uint64(0x9E3779B97F4A7C15), dtype=jnp.uint64)
             for kb in key_b:
                 data, valid = kb.emit(ctx)
-                h = _mix_u64(data) if data.dtype != jnp.bool_ \
-                    else _mix_u64(data.astype(jnp.int8))
+                if data.dtype == jnp.bool_:
+                    data = data.astype(jnp.int8)
+                h = _mix_u64(_canonical_hash_plane(data))
                 h = jnp.where(valid, h, jnp.zeros_like(h))
                 acc = _combine_u64(acc, h)
             pid = (acc % np.uint64(n)).astype(jnp.int32)

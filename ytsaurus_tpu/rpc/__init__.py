@@ -20,12 +20,14 @@ bulk bytes travel as zero-copy attachment parts.
 from ytsaurus_tpu.rpc.channel import (
     Channel,
     FailoverChannel,
+    HedgingChannel,
     RetryingChannel,
 )
 from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
 from ytsaurus_tpu.rpc.server import RpcServer, Service, rpc_method
 
 __all__ = [
-    "Channel", "FailoverChannel", "RetryingChannel", "PacketError", "read_packet",
-    "write_packet", "RpcServer", "Service", "rpc_method",
+    "Channel", "FailoverChannel", "HedgingChannel", "RetryingChannel",
+    "PacketError", "read_packet", "write_packet", "RpcServer", "Service",
+    "rpc_method",
 ]

@@ -161,8 +161,12 @@ class Channel:
     # -- public sync API -------------------------------------------------------
 
     def call(self, service: str, method: str, body=None,
-             attachments=(), timeout: float | None = None):
-        """Returns (body: dict, attachments: list[bytes]); raises YtError."""
+             attachments=(), timeout: float | None = None,
+             idempotent: bool = True):
+        """Returns (body: dict, attachments: list[bytes]); raises YtError.
+        `idempotent` is accepted (and ignored) so every channel shares
+        one call signature — a bare Channel never resends, so the flag
+        only matters to the retrying/failover/hedging wrappers."""
         timeout = timeout if timeout is not None else self.timeout
         # Trace context is captured HERE, on the calling thread — contextvars
         # do not flow into the shared loop thread.
@@ -249,6 +253,80 @@ class RetryingChannel:
 
     def close(self) -> None:
         self.channel.close()
+
+
+class HedgingChannel:
+    """Race a DELAYED backup request against the primary (ref
+    core/rpc/hedging_channel.h): when the primary has not answered
+    within `hedging_delay`, the same request is sent to the backup and
+    the first success wins — tail latency of one slow peer is bounded by
+    hedging_delay + the healthy peer's latency, instead of the slow
+    peer's timeout.  A fast primary FAILURE hedges immediately.
+
+    Hedging applies only to idempotent calls: a duplicated mutation
+    would double-execute, so non-idempotent calls go primary-only."""
+
+    def __init__(self, primary, backup, hedging_delay: float = 0.05):
+        self.primary = primary
+        self.backup = backup
+        self.hedging_delay = hedging_delay
+        self._pool: "concurrent.futures.ThreadPoolExecutor | None" = None
+        self._pool_lock = threading.Lock()
+
+    @property
+    def address(self) -> str:
+        return self.primary.address
+
+    def _submit(self, fn, *args):
+        with self._pool_lock:
+            if self._pool is None:
+                # Losing (slow) requests park a worker until they finish,
+                # so the cap must cover request_rate x slow_latency; past
+                # it hedging degrades to waiting on the primary, which is
+                # safe but unbounded — 64 covers realistic lookup rates.
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=64, thread_name_prefix="hedge")
+            return self._pool.submit(fn, *args)
+
+    def call(self, service: str, method: str, body=None,
+             attachments=(), timeout: float | None = None,
+             idempotent: bool = True):
+        if not idempotent:
+            # The flag must reach a wrapped RetryingChannel/Failover
+            # channel too, or IT would resend the mutation.
+            return self.primary.call(service, method, body, attachments,
+                                     timeout, idempotent=False)
+        first = self._submit(self.primary.call, service, method, body,
+                             attachments, timeout)
+        try:
+            return first.result(timeout=self.hedging_delay)
+        except concurrent.futures.TimeoutError:
+            pass                    # slow primary → arm the backup
+        except YtError:
+            # Fast failure: no point waiting out the delay.
+            return self.backup.call(service, method, body, attachments,
+                                    timeout)
+        second = self._submit(self.backup.call, service, method, body,
+                              attachments, timeout)
+        pending = {first, second}
+        last_err: YtError | None = None
+        while pending:
+            done, pending = concurrent.futures.wait(
+                pending, return_when=concurrent.futures.FIRST_COMPLETED)
+            for fut in done:
+                try:
+                    return fut.result()
+                except YtError as err:
+                    last_err = err
+        raise last_err
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+        self.primary.close()
+        self.backup.close()
 
 
 class FailoverChannel:

@@ -344,6 +344,18 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     cluster.node_directory = tracker.alive    # enables exec-node dispatch
     client = YtClient(cluster)
     server.add_service(DriverService(client))
+    # Background re-replication: a dead node's chunks regain their
+    # replication factor within ~interval, read or no read (ref
+    # chunk_replicator.h).  A follower's empty node tracker makes its
+    # scans no-ops, so starting unconditionally is safe under election.
+    # Liveness from the metadata tree keeps deleted chunks from being
+    # resurrected off a node that missed their removal.
+    from ytsaurus_tpu.server.chunk_replicator import ChunkReplicator
+    replicator = ChunkReplicator(
+        tracker.alive_nodes, replication_factor=replication_factor,
+        liveness_provider=client.referenced_chunk_ids)
+    replicator.start()
+    orchid.register("/chunk_replicator", lambda: dict(replicator.stats))
     role["value"] = "leader"
     print(f"primary serving on {server.address}"
           + (f" (leader, master {master_index})" if election else ""),

@@ -37,6 +37,7 @@ class DataNodeService(Service):
         # restarted journal node forgets leases, which merely makes a
         # takeover attempt possible — epoch fencing still arbitrates it.
         self._leases: dict[str, tuple[str, float]] = {}
+        self._peers: dict[str, object] = {}   # replicate_chunk channels
         self._journal_lock = threading.Lock()
 
     # -- chunks ---------------------------------------------------------------
@@ -66,6 +67,31 @@ class DataNodeService(Service):
     @rpc_method()
     def list_chunks(self, body, attachments):
         return {"chunk_ids": self.store.list_chunks()}
+
+    @rpc_method()
+    def replicate_chunk(self, body, attachments):
+        """Push one locally-held chunk to a peer data node — the
+        Replicate/Repair job of the master's chunk replicator
+        (chunk_replicator.h), executed node-to-node so chunk data never
+        crosses the master.  Erasure chunks: get_blob reconstructs from
+        surviving parts (repairing local damage as a side effect) and
+        the target re-encodes the full part set."""
+        from ytsaurus_tpu.rpc import Channel, RetryingChannel
+        chunk_id = _text(body["chunk_id"])
+        target = _text(body["target"])
+        blob = self.store.get_blob(chunk_id)
+        req = {"chunk_id": chunk_id}
+        erasure = self.store.erasure_codec_of(chunk_id)
+        if erasure is not None:
+            req["erasure"] = erasure
+        with self._journal_lock:
+            peer = self._peers.get(target)
+            if peer is None:
+                peer = RetryingChannel(Channel(target, timeout=60),
+                                       attempts=2, backoff=0.1)
+                self._peers[target] = peer
+        peer.call("data_node", "put_chunk", req, [blob])
+        return {}
 
     # -- journals (quorum changelog storage) ----------------------------------
     #
