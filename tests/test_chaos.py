@@ -1,0 +1,142 @@
+"""Chaos replication: cards, eras, coordinated sync cutover (VERDICT r2
+#8).  Ref: chaos_server replication cards + chaos_agent era semantics.
+"""
+
+import threading
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.tablet.chaos import ChaosCoordinator, current_era, get_card
+
+SCHEMA = TableSchema.make([
+    ("key", "int64", "ascending"), ("a", "string"), ("b", "int64")],
+    unique_keys=True)
+
+
+def make_table(client, path):
+    client.create("table", path, recursive=True,
+                  attributes={"schema": SCHEMA, "dynamic": True})
+    client.mount_table(path)
+
+
+@pytest.fixture
+def upstream(tmp_path):
+    return connect(str(tmp_path / "up"))
+
+
+@pytest.fixture
+def downstream_root(tmp_path):
+    return str(tmp_path / "down")
+
+
+def _rows_of(client, path):
+    out = client.select_rows(f"key, a, b FROM [{path}]")
+    return sorted((r["key"], r["a"], r["b"]) for r in out)
+
+
+def test_card_era_history(upstream, downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r1")
+    make_table(down, "//r2")
+    r1 = upstream.create_table_replica(
+        "//t", "//r1", cluster_root=downstream_root, mode="sync")
+    r2 = upstream.create_table_replica(
+        "//t", "//r2", cluster_root=downstream_root, mode="async")
+    coord = ChaosCoordinator(upstream)
+    assert coord.era("//t") == 1
+    era = coord.switch_sync("//t", r2)
+    assert era == 3                      # joint era + switched era
+    card = get_card(upstream, "//t")
+    assert [h["reason"] for h in card["history"]] == [
+        "created", f"joint:{r2}", f"switched:{r2}"]
+    # Joint era had BOTH sync (never a window without a sync replica).
+    joint_modes = card["history"][1]["modes"]
+    assert joint_modes[r1] == "sync" and joint_modes[r2] == "sync"
+    replicas = upstream.get_table_replicas("//t")
+    assert replicas[r1]["mode"] == "async"
+    assert replicas[r2]["mode"] == "sync"
+    # Switching to the current sync replica is a no-op.
+    assert coord.switch_sync("//t", r2) == 3
+
+
+def test_switch_sync_preserves_and_serves_writes(upstream,
+                                                 downstream_root):
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r1")
+    make_table(down, "//r2")
+    r1 = upstream.create_table_replica(
+        "//t", "//r1", cluster_root=downstream_root, mode="sync")
+    r2 = upstream.create_table_replica(
+        "//t", "//r2", cluster_root=downstream_root, mode="async")
+    upstream.insert_rows("//t", [{"key": i, "a": f"v{i}", "b": i}
+                                 for i in range(20)])
+    coord = ChaosCoordinator(upstream)
+    coord.switch_sync("//t", r2)
+    # Pre-switch rows reached r2 via the gap catch-up, with no
+    # replicate_step ever run.
+    assert _rows_of(down, "//r2") == _rows_of(upstream, "//t")
+    # Post-switch writes land on r2 synchronously.
+    upstream.insert_rows("//t", [{"key": 100, "a": "x", "b": 1}])
+    assert down.lookup_rows("//r2", [(100,)]) == [
+        {"key": 100, "a": b"x", "b": 1}]
+    # r1 (now async) catches up via the replicator as usual.
+    upstream.table_replicator.replicate_step("//t")
+    assert _rows_of(down, "//r1") == _rows_of(upstream, "//t")
+
+
+def test_switch_under_load_no_lost_or_duplicated_writes(upstream,
+                                                        downstream_root):
+    """VERDICT done-criterion: sync/async swap UNDER WRITE LOAD with no
+    lost and no duplicated writes on either replica."""
+    down = connect(downstream_root)
+    make_table(upstream, "//t")
+    make_table(down, "//r1")
+    make_table(down, "//r2")
+    r1 = upstream.create_table_replica(
+        "//t", "//r1", cluster_root=downstream_root, mode="sync")
+    r2 = upstream.create_table_replica(
+        "//t", "//r2", cluster_root=downstream_root, mode="async")
+    coord = ChaosCoordinator(upstream)
+
+    n_rows = 300
+    failures: list = []
+    done = threading.Event()
+
+    def writer():
+        try:
+            for i in range(n_rows):
+                upstream.insert_rows(
+                    "//t", [{"key": i, "a": f"w{i}", "b": i * 2}])
+        except Exception as exc:     # noqa: BLE001 — surface in assert
+            failures.append(exc)
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=writer)
+    thread.start()
+    # Swap the sync replica back and forth while the writer runs.
+    for target in (r2, r1, r2, r1, r2):
+        coord.switch_sync("//t", target)
+        if done.is_set():
+            break
+    thread.join(timeout=120)
+    assert not thread.is_alive() and not failures, failures
+    # Drain any async tail on both replicas.
+    upstream.table_replicator.replicate_step("//t")
+    coord.switch_sync("//t", r1)     # forces r2's gap closed too
+    upstream.table_replicator.replicate_step("//t")
+
+    want = _rows_of(upstream, "//t")
+    assert len(want) == n_rows                       # upstream complete
+    got_r1 = _rows_of(down, "//r1")
+    got_r2 = _rows_of(down, "//r2")
+    assert got_r1 == want, "r1 lost or duplicated writes"
+    assert got_r2 == want, "r2 lost or duplicated writes"
+    # Era advanced once per switch phase, with full history retained.
+    card = get_card(upstream, "//t")
+    assert current_era(upstream, "//t") == card["history"][-1]["era"]
+    assert len(card["history"]) >= 9

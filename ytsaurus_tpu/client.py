@@ -100,40 +100,18 @@ def _normalize_per_tablet(ids) -> "list[list[str]]":
 
 def _hedged_race(attempts: "list[Callable]", delay: float,
                  base_error: YtError):
-    """Run `attempts` staggered by `delay`; first success wins, failures
-    arm the next attempt immediately.  Raises base_error when every
-    attempt fails (ref core/rpc/hedging_channel.h semantics generalized
-    to N backups)."""
-    import concurrent.futures as cf
+    """rpc.channel.hedged_race with the replica-fallback error shape:
+    base_error (the primary-table failure) is always the root cause."""
+    from ytsaurus_tpu.rpc.channel import hedged_race
 
     if not attempts:
         raise base_error
-    pool = cf.ThreadPoolExecutor(max_workers=len(attempts),
-                                 thread_name_prefix="hedged-lookup")
     try:
-        futures: list = []
-        next_idx = 0
-        errors: list[YtError] = []
-        while True:
-            if next_idx < len(attempts):
-                futures.append(pool.submit(attempts[next_idx]))
-                next_idx += 1
-            if not futures:
-                raise YtError(
-                    "all hedged replica lookups failed",
-                    code=base_error.code,
-                    inner_errors=[base_error] + errors[:3])
-            timeout = delay if next_idx < len(attempts) else None
-            done, _ = cf.wait(futures, timeout=timeout,
-                              return_when=cf.FIRST_COMPLETED)
-            for fut in done:
-                futures.remove(fut)
-                try:
-                    return fut.result()
-                except YtError as err:
-                    errors.append(err)
-    finally:
-        pool.shutdown(wait=False)
+        return hedged_race(attempts, delay)
+    except YtError as err:
+        raise YtError("all hedged replica lookups failed",
+                      code=base_error.code,
+                      inner_errors=[base_error, err])
 
 
 class YtClient:
@@ -406,8 +384,12 @@ class YtClient:
                     cid for cid in (snap.get("completed") or {}).values()
                     if cid)
             stack.extend(node.children.values())
-        for tablets in self.cluster.tablets.values():
-            for tablet in tablets:
+        # The master lock covers the tree, not cluster.tablets (mount/
+        # unmount mutate it lock-free): snapshot the dict and each
+        # tablet list in one C-level pass so a concurrent mount cannot
+        # abort the replicator's walk mid-iteration.
+        for tablets in list(self.cluster.tablets.values()):
+            for tablet in list(tablets):
                 referenced.update(tablet.chunk_ids)
         return referenced
 
@@ -803,8 +785,10 @@ class YtClient:
         commit_ts = self.cluster.transactions.commit(tx)
         # Sync-replica checkpoints for writes staged under this caller-owned
         # transaction (kept on the tx so an abort advances nothing).
-        for path, sync_targets in getattr(tx, "pending_sync_advances", []):
+        for path, sync_targets, era0 in getattr(
+                tx, "pending_sync_advances", []):
             self._advance_sync_checkpoints(path, sync_targets, commit_ts)
+            self._recheck_replication_era(path, era0, commit_ts)
         return commit_ts
 
     def abort_transaction(self, tx: TabletTransaction) -> None:
@@ -839,7 +823,7 @@ class YtClient:
         # Sync replicas join the SAME 2PC commit (ref transaction.cpp:737
         # sync-replica fanout): their tablets are extra participants, so a
         # broken sync replica fails the write before anything commits.
-        sync_targets = self._sync_replica_targets(path)
+        era0, sync_targets = self._replication_state(path)
         for rid, rc, rpath in sync_targets:
             rtablets = rc._mounted_tablets(rpath)
             for idx, part in rc._route_rows(rpath, rtablets,
@@ -849,10 +833,12 @@ class YtClient:
             self._finalize_tx(tx)
             commit_ts = txm.commit(tx)
             self._advance_sync_checkpoints(path, sync_targets, commit_ts)
+            self._recheck_replication_era(path, era0, commit_ts)
             return commit_ts
-        if sync_targets:
+        if sync_targets or era0 is not None:
             tx.pending_sync_advances = getattr(
-                tx, "pending_sync_advances", []) + [(path, sync_targets)]
+                tx, "pending_sync_advances", []) + \
+                [(path, sync_targets, era0)]
         return None
 
     def delete_rows(self, path: str, keys: Sequence[tuple],
@@ -870,7 +856,7 @@ class YtClient:
         for idx, part in self._route_rows(
                 path, tablets, keys).items():
             txm.delete_rows(tx, tablets[idx], part)
-        sync_targets = self._sync_replica_targets(path)
+        era0, sync_targets = self._replication_state(path)
         for rid, rc, rpath in sync_targets:
             rtablets = rc._mounted_tablets(rpath)
             for idx, part in rc._route_rows(rpath, rtablets, keys).items():
@@ -879,10 +865,12 @@ class YtClient:
             self._finalize_tx(tx)
             commit_ts = txm.commit(tx)
             self._advance_sync_checkpoints(path, sync_targets, commit_ts)
+            self._recheck_replication_era(path, era0, commit_ts)
             return commit_ts
-        if sync_targets:
+        if sync_targets or era0 is not None:
             tx.pending_sync_advances = getattr(
-                tx, "pending_sync_advances", []) + [(path, sync_targets)]
+                tx, "pending_sync_advances", []) + \
+                [(path, sync_targets, era0)]
         return None
 
     # --------------------------------------------------------------- replication
@@ -942,14 +930,48 @@ class YtClient:
     def _sync_replica_targets(self, path: str):
         """(replica_id, replica_client, replica_path) for each enabled
         sync replica of `path` (empty for non-replicated tables)."""
+        return self._replication_state(path)[1]
+
+    def _replication_state(self, path: str):
+        """(era, sync_targets) in one node read.  era is None for a
+        plain non-replicated table (the common case pays one attribute
+        probe and nothing else); otherwise it is the replication-card
+        era observed for this write, re-checked after commit so a commit
+        racing a chaos sync cutover re-delivers its events to the new
+        configuration (chaos_agent.h era semantics)."""
         from ytsaurus_tpu.tablet import replication as repl
+        node = self._table_node(path)
+        replicas = node.attributes.get(repl.REPLICAS_ATTR) or {}
+        card = node.attributes.get("replication_card")
+        if not replicas and not card:
+            return None, []
+        era = int(card["era"]) if card else 0
         out = []
-        for rid, info in repl.replica_descriptors(self, path).items():
+        for rid, info in replicas.items():
             if info.get("enabled") and info.get("mode") == "sync":
                 rc = self.table_replicator.replica_client(
                     info.get("cluster_root"))
                 out.append((rid, rc, info["path"]))
-        return out
+        return era, out
+
+    def _replication_era(self, path: str) -> "Optional[int]":
+        node = self._table_node(path)
+        card = node.attributes.get("replication_card")
+        if card:
+            return int(card["era"])
+        return 0 if node.attributes.get("replicas") else None
+
+    def _recheck_replication_era(self, path: str, era0,
+                                 commit_ts: int) -> None:
+        """Post-commit era check: a chaos sync cutover that raced this
+        commit may have enrolled a sync replica the fanout missed;
+        re-deliver the commit's events to the current configuration
+        (idempotent over preserved timestamps)."""
+        if era0 is None:
+            return
+        if self._replication_era(path) != era0:
+            from ytsaurus_tpu.tablet import chaos
+            chaos.redeliver_commit(self, path, commit_ts)
 
     def _advance_sync_checkpoints(self, path: str, sync_targets,
                                   commit_ts: int) -> None:

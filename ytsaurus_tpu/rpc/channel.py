@@ -255,6 +255,50 @@ class RetryingChannel:
         self.channel.close()
 
 
+def hedged_race(attempts: "list", delay: float):
+    """First-success race with staggered arming (ref
+    core/rpc/hedging_channel.h generalized to N attempts): attempt 0
+    starts immediately; attempt i+1 is armed after `delay` with no
+    answer, or IMMEDIATELY when attempt i fails.  Raises the last
+    YtError when every attempt fails.  Losing attempts run on abandoned
+    daemon threads — a wedged loser cannot block the caller or
+    interpreter exit."""
+    import queue as _queue
+
+    if not attempts:
+        raise YtError("hedged race with no attempts",
+                      code=EErrorCode.PeerUnavailable)
+    results: "_queue.SimpleQueue" = _queue.SimpleQueue()
+
+    def run(fn):
+        try:
+            results.put(("ok", fn()))
+        except YtError as err:
+            results.put(("err", err))
+
+    started = 0
+    pending = 0
+    last: YtError | None = None
+    while True:
+        if started < len(attempts):
+            threading.Thread(target=run, args=(attempts[started],),
+                             daemon=True,
+                             name=f"hedge-{started}").start()
+            started += 1
+            pending += 1
+        if pending == 0:
+            raise last
+        try:
+            kind, value = results.get(
+                timeout=delay if started < len(attempts) else None)
+        except _queue.Empty:
+            continue                # stagger elapsed: arm the next
+        pending -= 1
+        if kind == "ok":
+            return value
+        last = value                # failure: arm the next immediately
+
+
 class HedgingChannel:
     """Race a DELAYED backup request against the primary (ref
     core/rpc/hedging_channel.h): when the primary has not answered
@@ -270,23 +314,10 @@ class HedgingChannel:
         self.primary = primary
         self.backup = backup
         self.hedging_delay = hedging_delay
-        self._pool: "concurrent.futures.ThreadPoolExecutor | None" = None
-        self._pool_lock = threading.Lock()
 
     @property
     def address(self) -> str:
         return self.primary.address
-
-    def _submit(self, fn, *args):
-        with self._pool_lock:
-            if self._pool is None:
-                # Losing (slow) requests park a worker until they finish,
-                # so the cap must cover request_rate x slow_latency; past
-                # it hedging degrades to waiting on the primary, which is
-                # safe but unbounded — 64 covers realistic lookup rates.
-                self._pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=64, thread_name_prefix="hedge")
-            return self._pool.submit(fn, *args)
 
     def call(self, service: str, method: str, body=None,
              attachments=(), timeout: float | None = None,
@@ -296,35 +327,14 @@ class HedgingChannel:
             # channel too, or IT would resend the mutation.
             return self.primary.call(service, method, body, attachments,
                                      timeout, idempotent=False)
-        first = self._submit(self.primary.call, service, method, body,
-                             attachments, timeout)
-        try:
-            return first.result(timeout=self.hedging_delay)
-        except concurrent.futures.TimeoutError:
-            pass                    # slow primary → arm the backup
-        except YtError:
-            # Fast failure: no point waiting out the delay.
-            return self.backup.call(service, method, body, attachments,
-                                    timeout)
-        second = self._submit(self.backup.call, service, method, body,
-                              attachments, timeout)
-        pending = {first, second}
-        last_err: YtError | None = None
-        while pending:
-            done, pending = concurrent.futures.wait(
-                pending, return_when=concurrent.futures.FIRST_COMPLETED)
-            for fut in done:
-                try:
-                    return fut.result()
-                except YtError as err:
-                    last_err = err
-        raise last_err
+        return hedged_race(
+            [lambda: self.primary.call(service, method, body, attachments,
+                                       timeout),
+             lambda: self.backup.call(service, method, body, attachments,
+                                      timeout)],
+            self.hedging_delay)
 
     def close(self) -> None:
-        with self._pool_lock:
-            pool, self._pool = self._pool, None
-        if pool is not None:
-            pool.shutdown(wait=False)
         self.primary.close()
         self.backup.close()
 

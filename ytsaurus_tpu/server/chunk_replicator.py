@@ -54,7 +54,8 @@ class ChunkReplicator:
         self._channels: dict[str, RetryingChannel] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.stats = {"scans": 0, "replications_requested": 0,
+        self.stats = {"scans": 0, "scans_skipped": 0,
+                      "replications_requested": 0,
                       "replications_failed": 0, "chunks_seen": 0,
                       "under_replicated": 0}
 
@@ -85,6 +86,15 @@ class ChunkReplicator:
                     holders.setdefault(cid, set()).add(address)
             except YtError:
                 continue
+        if len(reachable) < len(alive):
+            # A heartbeat-ALIVE node failed one listing (GC pause,
+            # transient overload): re-computing rendezvous targets
+            # without it would mass-copy chunks to off-rank nodes that
+            # nothing ever prunes.  Skip the scan; a genuinely dead node
+            # leaves the alive set within the tracker's liveness timeout
+            # and the next scan acts on the settled membership.
+            self.stats["scans_skipped"] += 1
+            return 0
         self.stats["chunks_seen"] = len(holders)
         live: "set | None" = None
         if self._liveness_provider is not None:
