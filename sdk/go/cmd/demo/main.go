@@ -1,0 +1,94 @@
+// Demo/CI binary: CRUD + static table + dynamic insert/lookup/select
+// round-trip against a live proxy.  Exits non-zero on any mismatch;
+// tests/test_go_sdk.py builds and runs it against a LocalCluster.
+package main
+
+import (
+	"fmt"
+	"os"
+	"reflect"
+
+	"ytsaurus-tpu/sdk/go/yt"
+)
+
+func must(err error) {
+	if err != nil {
+		fmt.Fprintln(os.Stderr, "FAIL:", err)
+		os.Exit(1)
+	}
+}
+
+func check(cond bool, what string) {
+	if !cond {
+		fmt.Fprintln(os.Stderr, "FAIL:", what)
+		os.Exit(1)
+	}
+}
+
+func main() {
+	if len(os.Args) < 2 {
+		fmt.Fprintln(os.Stderr, "usage: demo <proxy host:port>")
+		os.Exit(2)
+	}
+	c := yt.NewClient(os.Args[1])
+	must(c.Ping())
+
+	// Cypress CRUD.
+	must(c.Create("map_node", "//go/home", &yt.CreateOptions{Recursive: true}))
+	must(c.Set("//go/home/@owner", "gopher"))
+	var owner string
+	must(c.Get("//go/home/@owner", &owner))
+	check(owner == "gopher", "attribute round-trip")
+	ok, err := c.Exists("//go/home")
+	must(err)
+	check(ok, "exists after create")
+	names, err := c.List("//go")
+	must(err)
+	check(len(names) == 1 && names[0] == "home", "list children")
+
+	// Static table write/read.
+	rows := []map[string]any{
+		{"name": "a", "score": 1.5},
+		{"name": "b", "score": 2.5},
+	}
+	must(c.WriteTable("//go/static", rows))
+	got, err := c.ReadTable("//go/static")
+	must(err)
+	check(len(got) == 2 && got[0]["name"] == "a" &&
+		got[1]["score"] == 2.5, "static table round-trip")
+
+	// Dynamic table insert/lookup/select.
+	schema := []map[string]any{
+		{"name": "k", "type": "int64", "sort_order": "ascending"},
+		{"name": "v", "type": "string"},
+	}
+	must(c.Create("table", "//go/dyn", &yt.CreateOptions{
+		Recursive:  true,
+		Attributes: map[string]any{"schema": schema, "dynamic": true},
+	}))
+	must(c.MountTable("//go/dyn"))
+	must(c.InsertRows("//go/dyn", []map[string]any{
+		{"k": 1, "v": "one"}, {"k": 2, "v": "two"}, {"k": 3, "v": "three"},
+	}))
+	looked, err := c.LookupRows("//go/dyn", [][]any{{2}, {99}})
+	must(err)
+	check(len(looked) == 2 && looked[0]["v"] == "two" && looked[1] == nil,
+		"lookup hit+miss")
+	selected, err := c.SelectRows(
+		"k, v FROM [//go/dyn] WHERE k >= 2 ORDER BY k LIMIT 10")
+	must(err)
+	check(reflect.DeepEqual(
+		[]any{selected[0]["k"], selected[1]["k"]}, []any{2.0, 3.0}),
+		"select ordered rows")
+	must(c.DeleteRows("//go/dyn", [][]any{{1}}))
+	looked, err = c.LookupRows("//go/dyn", [][]any{{1}})
+	must(err)
+	check(looked[0] == nil, "delete visible")
+
+	must(c.Remove("//go/static", false))
+	ok, err = c.Exists("//go/static")
+	must(err)
+	check(!ok, "removed")
+
+	fmt.Println("GO-SDK-DEMO PASS")
+}

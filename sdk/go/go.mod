@@ -1,0 +1,3 @@
+module ytsaurus-tpu/sdk/go
+
+go 1.20

@@ -1,0 +1,219 @@
+// Package yt is a native Go client for the ytsaurus_tpu HTTP proxy
+// (/api/v4) — the counterpart of the reference's first-class Go SDK
+// (yt/go/yt/interface.go + yt/go/yt/internal/httpclient) over this
+// framework's REST surface.  Dependency-free: net/http + encoding/json
+// only.  Every command in the driver registry is callable through
+// Execute; the typed verbs below cover the interface.go CRUD +
+// dynamic-table surface.
+package yt
+
+import (
+	"bytes"
+	"encoding/json"
+	"fmt"
+	"io"
+	"net/http"
+	"strings"
+	"time"
+)
+
+// Error is a non-2xx proxy response (the X-YT-Error payload rides the
+// response body as JSON).
+type Error struct {
+	HTTPStatus int
+	Body       string
+}
+
+func (e *Error) Error() string {
+	return fmt.Sprintf("yt: proxy error (HTTP %d): %s", e.HTTPStatus, e.Body)
+}
+
+// Client talks to one HTTP proxy.  Zero-value fields are defaulted by
+// NewClient; construct directly only if you set every field.
+type Client struct {
+	Addr       string // "host:port"
+	User       string // rides X-YT-User (per-request principal)
+	HTTPClient *http.Client
+}
+
+// NewClient returns a client for the proxy at addr ("host:port").
+func NewClient(addr string) *Client {
+	return &Client{
+		Addr:       addr,
+		User:       "root",
+		HTTPClient: &http.Client{Timeout: 120 * time.Second},
+	}
+}
+
+func (c *Client) do(method, path string, body []byte,
+	contentType string) ([]byte, error) {
+	req, err := http.NewRequest(method, "http://"+c.Addr+path,
+		bytes.NewReader(body))
+	if err != nil {
+		return nil, err
+	}
+	req.Header.Set("X-YT-User", c.User)
+	if contentType != "" {
+		req.Header.Set("Content-Type", contentType)
+	}
+	resp, err := c.HTTPClient.Do(req)
+	if err != nil {
+		return nil, err
+	}
+	defer resp.Body.Close()
+	data, err := io.ReadAll(resp.Body)
+	if err != nil {
+		return nil, err
+	}
+	if resp.StatusCode < 200 || resp.StatusCode >= 300 {
+		return nil, &Error{HTTPStatus: resp.StatusCode, Body: string(data)}
+	}
+	return data, nil
+}
+
+// Execute POSTs one driver command with a JSON parameter object and
+// returns the raw response body (a {"value": ...} wrapper for JSON
+// results, raw format bytes for table payloads).
+func (c *Client) Execute(command string, params any) ([]byte, error) {
+	blob, err := json.Marshal(params)
+	if err != nil {
+		return nil, err
+	}
+	return c.do("POST", "/api/v4/"+command, blob, "application/json")
+}
+
+// execute runs a command and unmarshals the {"value": ...} wrapper into
+// out (which may be nil for commands whose result is ignored).
+func (c *Client) execute(command string, params any, out any) error {
+	data, err := c.Execute(command, params)
+	if err != nil || out == nil {
+		return err
+	}
+	var wrapper struct {
+		Value json.RawMessage `json:"value"`
+	}
+	if err := json.Unmarshal(data, &wrapper); err != nil {
+		return fmt.Errorf("yt: bad %s response: %w", command, err)
+	}
+	if wrapper.Value == nil {
+		return nil
+	}
+	return json.Unmarshal(wrapper.Value, out)
+}
+
+// Ping checks proxy liveness (GET /ping).
+func (c *Client) Ping() error {
+	_, err := c.do("GET", "/ping", nil, "")
+	return err
+}
+
+// CreateOptions mirrors the create verb's optional parameters.
+type CreateOptions struct {
+	Recursive  bool
+	Attributes map[string]any
+}
+
+func (c *Client) Create(typ, path string, opts *CreateOptions) error {
+	params := map[string]any{"type": typ, "path": path}
+	if opts != nil {
+		params["recursive"] = opts.Recursive
+		if opts.Attributes != nil {
+			params["attributes"] = opts.Attributes
+		}
+	}
+	return c.execute("create", params, nil)
+}
+
+func (c *Client) Exists(path string) (bool, error) {
+	var out bool
+	err := c.execute("exists", map[string]any{"path": path}, &out)
+	return out, err
+}
+
+// Get reads a Cypress node or attribute into out (a pointer).
+func (c *Client) Get(path string, out any) error {
+	return c.execute("get", map[string]any{"path": path}, out)
+}
+
+func (c *Client) Set(path string, value any) error {
+	return c.execute("set",
+		map[string]any{"path": path, "value": value}, nil)
+}
+
+func (c *Client) Remove(path string, recursive bool) error {
+	return c.execute("remove",
+		map[string]any{"path": path, "recursive": recursive}, nil)
+}
+
+func (c *Client) List(path string) ([]string, error) {
+	var out []string
+	err := c.execute("list", map[string]any{"path": path}, &out)
+	return out, err
+}
+
+// WriteTable writes rows to a static table (overwrites).
+func (c *Client) WriteTable(path string, rows []map[string]any) error {
+	return c.execute("write_table",
+		map[string]any{"path": path, "rows": rows}, nil)
+}
+
+// ReadTable reads a static table as rows (json-lines wire format).
+func (c *Client) ReadTable(path string) ([]map[string]any, error) {
+	data, err := c.Execute("read_table",
+		map[string]any{"path": path, "format": "json"})
+	if err != nil {
+		return nil, err
+	}
+	return parseJSONRows(data)
+}
+
+func parseJSONRows(data []byte) ([]map[string]any, error) {
+	rows := []map[string]any{}
+	for _, line := range strings.Split(string(data), "\n") {
+		line = strings.TrimSpace(line)
+		if line == "" {
+			continue
+		}
+		var row map[string]any
+		if err := json.Unmarshal([]byte(line), &row); err != nil {
+			return nil, fmt.Errorf("yt: bad table row %q: %w", line, err)
+		}
+		rows = append(rows, row)
+	}
+	return rows, nil
+}
+
+// -- dynamic tables ---------------------------------------------------------
+
+func (c *Client) MountTable(path string) error {
+	return c.execute("mount_table", map[string]any{"path": path}, nil)
+}
+
+func (c *Client) UnmountTable(path string) error {
+	return c.execute("unmount_table", map[string]any{"path": path}, nil)
+}
+
+func (c *Client) InsertRows(path string, rows []map[string]any) error {
+	return c.execute("insert_rows",
+		map[string]any{"path": path, "rows": rows}, nil)
+}
+
+func (c *Client) DeleteRows(path string, keys [][]any) error {
+	return c.execute("delete_rows",
+		map[string]any{"path": path, "keys": keys}, nil)
+}
+
+// LookupRows point-reads; each result element is the row or nil.
+func (c *Client) LookupRows(path string, keys [][]any) ([]map[string]any, error) {
+	var out []map[string]any
+	err := c.execute("lookup_rows",
+		map[string]any{"path": path, "keys": keys}, &out)
+	return out, err
+}
+
+// SelectRows runs a QL query and returns the result rows.
+func (c *Client) SelectRows(query string) ([]map[string]any, error) {
+	var out []map[string]any
+	err := c.execute("select_rows", map[string]any{"query": query}, &out)
+	return out, err
+}

@@ -954,12 +954,6 @@ class YtClient:
                 out.append((rid, rc, info["path"]))
         return era, out
 
-    def _replication_era(self, path: str) -> "Optional[int]":
-        node = self._table_node(path)
-        card = node.attributes.get("replication_card")
-        if card:
-            return int(card["era"])
-        return 0 if node.attributes.get("replicas") else None
 
     def _recheck_replication_era(self, path: str, era0,
                                  commit_ts: int) -> None:
@@ -969,8 +963,8 @@ class YtClient:
         (idempotent over preserved timestamps)."""
         if era0 is None:
             return
-        if self._replication_era(path) != era0:
-            from ytsaurus_tpu.tablet import chaos
+        from ytsaurus_tpu.tablet import chaos
+        if chaos.current_era(self, path) != era0:
             chaos.redeliver_commit(self, path, commit_ts)
 
     def _advance_sync_checkpoints(self, path: str, sync_targets,

@@ -255,14 +255,23 @@ class RetryingChannel:
         self.channel.close()
 
 
+# Cluster-wide cap on in-flight hedge threads: losing (slow) attempts
+# park a daemon thread until they finish, so the cap must cover
+# request_rate x slow_latency.  Past it, hedged_race degrades to running
+# attempts sequentially in the caller's thread — safe, just unhedged.
+_HEDGE_SLOTS = threading.BoundedSemaphore(64)
+
+
 def hedged_race(attempts: "list", delay: float):
     """First-success race with staggered arming (ref
     core/rpc/hedging_channel.h generalized to N attempts): attempt 0
     starts immediately; attempt i+1 is armed after `delay` with no
     answer, or IMMEDIATELY when attempt i fails.  Raises the last
-    YtError when every attempt fails.  Losing attempts run on abandoned
-    daemon threads — a wedged loser cannot block the caller or
-    interpreter exit."""
+    YtError when every attempt fails; a NON-YtError from any attempt
+    propagates immediately (a programming error must never be swallowed
+    into a silent hang).  Losing attempts run on abandoned daemon
+    threads — a wedged loser cannot block the caller or interpreter
+    exit."""
     import queue as _queue
 
     if not attempts:
@@ -272,21 +281,37 @@ def hedged_race(attempts: "list", delay: float):
 
     def run(fn):
         try:
-            results.put(("ok", fn()))
-        except YtError as err:
-            results.put(("err", err))
+            try:
+                results.put(("ok", fn()))
+            except BaseException as err:  # noqa: BLE001 — relayed below
+                results.put(("err", err))
+        finally:
+            _HEDGE_SLOTS.release()
 
     started = 0
     pending = 0
     last: YtError | None = None
     while True:
         if started < len(attempts):
-            threading.Thread(target=run, args=(attempts[started],),
-                             daemon=True,
-                             name=f"hedge-{started}").start()
-            started += 1
-            pending += 1
-        if pending == 0:
+            fn = attempts[started]
+            if _HEDGE_SLOTS.acquire(blocking=False):
+                started += 1
+                threading.Thread(target=run, args=(fn,), daemon=True,
+                                 name=f"hedge-{started}").start()
+                pending += 1
+            elif pending == 0:
+                # Saturated with nothing in flight: run inline
+                # (sequential fallback) rather than spawning unboundedly.
+                started += 1
+                try:
+                    return fn()
+                except YtError as err:
+                    last = err
+                    continue
+            # Saturated with attempts in flight: fall through and wait
+            # on their results (a fast success must win over blocking
+            # inline on the next attempt); arming retries next loop.
+        if pending == 0 and started >= len(attempts):
             raise last
         try:
             kind, value = results.get(
@@ -296,6 +321,8 @@ def hedged_race(attempts: "list", delay: float):
         pending -= 1
         if kind == "ok":
             return value
+        if not isinstance(value, YtError):
+            raise value             # programming error: surface, loudly
         last = value                # failure: arm the next immediately
 
 

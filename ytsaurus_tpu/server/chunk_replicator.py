@@ -30,6 +30,16 @@ from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("chunk_replicator")
 
+# Consecutive list_chunks failures after which a heartbeat-alive node is
+# treated as storage-dead (scans proceed without it instead of waiting
+# for membership to settle).
+LISTING_FAILURE_THRESHOLD = 3
+# Hard bound on consecutive skipped scans: a node that FLAPS (fails,
+# then answers, resetting its failure count) must not defer repair of
+# chunks lost elsewhere indefinitely — after this many skips the scan
+# proceeds with whatever answered.
+MAX_CONSECUTIVE_SKIPS = 5
+
 
 class ChunkReplicator:
     """Periodic scan → replicate under-replicated chunks toward their
@@ -52,6 +62,8 @@ class ChunkReplicator:
         self.interval = interval
         self.timeout = timeout
         self._channels: dict[str, RetryingChannel] = {}
+        self._listing_failures: dict[str, int] = {}
+        self._consecutive_skips = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"scans": 0, "scans_skipped": 0,
@@ -81,20 +93,28 @@ class ChunkReplicator:
                 body, _ = self._channel(address).call(
                     "data_node", "list_chunks", {})
                 reachable.append(address)
+                self._listing_failures.pop(address, None)
                 for cid in body.get("chunk_ids", []):
                     cid = cid.decode() if isinstance(cid, bytes) else cid
                     holders.setdefault(cid, set()).add(address)
             except YtError:
-                continue
-        if len(reachable) < len(alive):
-            # A heartbeat-ALIVE node failed one listing (GC pause,
-            # transient overload): re-computing rendezvous targets
-            # without it would mass-copy chunks to off-rank nodes that
-            # nothing ever prunes.  Skip the scan; a genuinely dead node
-            # leaves the alive set within the tracker's liveness timeout
-            # and the next scan acts on the settled membership.
+                self._listing_failures[address] = \
+                    self._listing_failures.get(address, 0) + 1
+        # A heartbeat-ALIVE node that failed a listing is either having a
+        # TRANSIENT hiccup (GC pause, overload) — re-computing rendezvous
+        # targets without it would mass-copy chunks off-rank, so skip the
+        # scan and let membership settle — or it is PERSISTENTLY broken
+        # (dead disk behind a live heartbeat), in which case after
+        # LISTING_FAILURE_THRESHOLD consecutive failures its chunks ARE
+        # effectively lost and re-replicating around it is the point.
+        settling = [a for a in alive if a not in reachable and
+                    self._listing_failures.get(a, 0) <
+                    LISTING_FAILURE_THRESHOLD]
+        if settling and self._consecutive_skips < MAX_CONSECUTIVE_SKIPS:
+            self._consecutive_skips += 1
             self.stats["scans_skipped"] += 1
             return 0
+        self._consecutive_skips = 0
         self.stats["chunks_seen"] = len(holders)
         live: "set | None" = None
         if self._liveness_provider is not None:

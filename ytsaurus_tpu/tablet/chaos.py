@@ -87,16 +87,26 @@ class ChaosCoordinator:
         self.client.set(table_path + "/@" + CARD_ATTR, card)
 
     def _bump(self, table_path: str, reason: str) -> int:
-        card = self.ensure_card(table_path)
-        replicas = repl.replica_descriptors(self.client, table_path)
-        card["era"] = int(card["era"]) + 1
-        card["history"] = list(card["history"]) + [{
-            "era": card["era"], "reason": reason,
-            "modes": {rid: info.get("mode")
-                      for rid, info in replicas.items()},
-            "ts": time.time()}]
-        self._store(table_path, card)
-        return card["era"]
+        """Era bump as an ATOMIC read-modify-write: the whole get+set
+        runs under the master's mutation lock, so two coordinators
+        (threads, or remote drivers executing inside the same leader
+        process) cannot both read era N and store N+1 — a lost bump
+        would let a racing writer's post-commit era check pass without
+        re-delivering to the new configuration.  Multi-master safety
+        comes from the coordinator living with the LEADER (a follower's
+        writes are fenced by the WAL epoch), matching the reference's
+        single chaos cell owning each card."""
+        with self.client.cluster.master._lock:
+            card = self.ensure_card(table_path)
+            replicas = repl.replica_descriptors(self.client, table_path)
+            card["era"] = int(card["era"]) + 1
+            card["history"] = list(card["history"]) + [{
+                "era": card["era"], "reason": reason,
+                "modes": {rid: info.get("mode")
+                          for rid, info in replicas.items()},
+                "ts": time.time()}]
+            self._store(table_path, card)
+            return card["era"]
 
     def _catch_up_from(self, table_path: str, replica_id: str,
                        from_ts: int) -> int:
