@@ -1,0 +1,820 @@
+"""QL regression corpus — parameterized cases growing toward the
+reference suite's scale (library/query/unittests/ql_query_ut.cpp ~600
+cases; VERDICT r2 #10 asked for >= 300 total across the harness).
+
+Every case runs the full parse -> typed IR -> XLA lowering -> execute
+pipeline through tests/harness.evaluate.  Sections mirror the reference
+suite's grouping: expression edge cases, null semantics per operator,
+strings + string functions, scalar functions/casts, aggregates and
+GROUP BY shapes, ORDER BY / LIMIT, and join shapes.
+"""
+
+import pytest
+
+from tests.harness import evaluate
+
+T = "//t"
+D = "//d"
+
+INT_COLS = [("k", "int64", "ascending"), ("v", "int64")]
+ABC_COLS = [("k", "int64", "ascending"), ("a", "int64"), ("b", "int64")]
+STR_COLS = [("k", "int64", "ascending"), ("s", "string")]
+DBL_COLS = [("k", "int64", "ascending"), ("x", "double")]
+U64_COLS = [("k", "int64", "ascending"), ("u", "uint64")]
+BOOL_COLS = [("k", "int64", "ascending"), ("f", "boolean")]
+
+
+def tbl(rows, cols=INT_COLS, path=T):
+    return {path: (cols, rows)}
+
+
+KV6 = tbl([(i, i * 10) for i in range(6)])
+NULLS = tbl([(1, 10), (2, None), (3, 30), (4, None), (5, 50)])
+AB = tbl([(1, 3, 2), (2, -7, 2), (3, 0, 0), (4, None, 5), (5, 8, None)],
+         ABC_COLS)
+STRS = tbl([(1, "apple"), (2, "Banana"), (3, "cherry"), (4, None),
+            (5, ""), (6, "apple pie")], STR_COLS)
+DBLS = tbl([(1, 1.5), (2, -2.5), (3, 0.0), (4, None), (5, 100.25)],
+           DBL_COLS)
+GRP = tbl([(1, 0, 1), (2, 1, 2), (3, 0, 3), (4, 1, 4), (5, 0, 5),
+           (6, 2, None), (7, 2, None)],
+          [("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")])
+
+
+def run(query, tables, expected, ordered=False):
+    evaluate(query, tables, expected, ordered=ordered)
+
+
+# ---------------------------------------------------------------------------
+# A. arithmetic, unary, bitwise — C/C++ integer semantics
+# ---------------------------------------------------------------------------
+
+ARITH = [
+    ("add", f"k + v AS r FROM [{T}]", tbl([(2, 3)]), [{"r": 5}]),
+    ("sub", f"k - v AS r FROM [{T}]", tbl([(2, 5)]), [{"r": -3}]),
+    ("mul", f"k * v AS r FROM [{T}]", tbl([(4, -6)]), [{"r": -24}]),
+    ("div_exact", f"v / k AS r FROM [{T}]", tbl([(4, 12)]), [{"r": 3}]),
+    ("div_trunc_pos", f"v / k AS r FROM [{T}]", tbl([(2, 7)]), [{"r": 3}]),
+    ("div_trunc_neg", f"v / k AS r FROM [{T}]", tbl([(2, -7)]),
+     [{"r": -3}]),
+    ("div_trunc_neg_divisor", f"v / k AS r FROM [{T}]", tbl([(-2, 7)]),
+     [{"r": -3}]),
+    ("div_by_zero_null", f"v / k AS r FROM [{T}]", tbl([(0, 7)]),
+     [{"r": None}]),
+    ("mod_pos", f"v % k AS r FROM [{T}]", tbl([(3, 7)]), [{"r": 1}]),
+    ("mod_neg_dividend", f"v % k AS r FROM [{T}]", tbl([(3, -7)]),
+     [{"r": -1}]),
+    ("mod_by_zero_null", f"v % k AS r FROM [{T}]", tbl([(0, 7)]),
+     [{"r": None}]),
+    ("precedence_mul_over_add", f"k + v * 2 AS r FROM [{T}]",
+     tbl([(1, 10)]), [{"r": 21}]),
+    ("parens_override", f"(k + v) * 2 AS r FROM [{T}]", tbl([(1, 10)]),
+     [{"r": 22}]),
+    ("unary_minus", f"-v AS r FROM [{T}]", tbl([(1, -5)]), [{"r": 5}]),
+    ("unary_minus_expr", f"-(k + v) AS r FROM [{T}]", tbl([(1, 2)]),
+     [{"r": -3}]),
+    ("bitnot", f"~v AS r FROM [{T}]", tbl([(1, 0)]), [{"r": -1}]),
+    ("bitand", f"v & 3 AS r FROM [{T}]", tbl([(1, 5)]), [{"r": 1}]),
+    ("bitor", f"v | 2 AS r FROM [{T}]", tbl([(1, 5)]), [{"r": 7}]),
+    ("bitxor", f"v ^ 1 AS r FROM [{T}]", tbl([(1, 5)]), [{"r": 4}]),
+    ("shl", f"v << 4 AS r FROM [{T}]", tbl([(1, 3)]), [{"r": 48}]),
+    ("shr", f"v >> 2 AS r FROM [{T}]", tbl([(1, 29)]), [{"r": 7}]),
+    ("shr_negative_arithmetic", f"v >> 1 AS r FROM [{T}]", tbl([(1, -8)]),
+     [{"r": -4}]),
+    ("chained_sub_left_assoc", f"v - k - 1 AS r FROM [{T}]",
+     tbl([(2, 10)]), [{"r": 7}]),
+    ("double_add", f"x + 0.25 AS r FROM [{T}]", tbl([(1, 1.5)], DBL_COLS),
+     [{"r": 1.75}]),
+    ("double_div", f"x / 2 AS r FROM [{T}]", tbl([(1, 7.0)], DBL_COLS),
+     [{"r": 3.5}]),
+    ("double_neg", f"-x AS r FROM [{T}]", tbl([(1, -2.5)], DBL_COLS),
+     [{"r": 2.5}]),
+    ("int_double_promotion", f"k + x AS r FROM [{T}]",
+     tbl([(2, 0.5)], DBL_COLS), [{"r": 2.5}]),
+    ("literal_only_projection", f"1 + 2 AS r FROM [{T}]", tbl([(1, 0)]),
+     [{"r": 3}]),
+    ("null_plus_value_is_null", f"a + b AS r FROM [{T}]",
+     tbl([(4, None, 5)], ABC_COLS), [{"r": None}]),
+    ("null_mul_is_null", f"a * b AS r FROM [{T}]",
+     tbl([(5, 8, None)], ABC_COLS), [{"r": None}]),
+    ("null_div_is_null", f"a / b AS r FROM [{T}]",
+     tbl([(4, None, 5)], ABC_COLS), [{"r": None}]),
+    ("null_bitand_is_null", f"a & b AS r FROM [{T}]",
+     tbl([(4, None, 5)], ABC_COLS), [{"r": None}]),
+    ("null_shift_is_null", f"a << b AS r FROM [{T}]",
+     tbl([(5, 8, None)], ABC_COLS), [{"r": None}]),
+    ("mixed_null_and_value_rows", f"a + 1 AS r FROM [{T}]", AB,
+     [{"r": 4}, {"r": -6}, {"r": 1}, {"r": None}, {"r": 9}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in ARITH],
+                         ids=[c[0] for c in ARITH])
+def test_arithmetic(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# B. comparisons + WHERE null semantics (NULL never matches, even NOT)
+# ---------------------------------------------------------------------------
+
+CMP = [
+    ("lt", f"k FROM [{T}] WHERE v < 20", KV6, [{"k": 0}, {"k": 1}]),
+    ("le", f"k FROM [{T}] WHERE v <= 20", KV6,
+     [{"k": 0}, {"k": 1}, {"k": 2}]),
+    ("gt", f"k FROM [{T}] WHERE v > 30", KV6, [{"k": 4}, {"k": 5}]),
+    ("ge", f"k FROM [{T}] WHERE v >= 40", KV6, [{"k": 4}, {"k": 5}]),
+    ("eq", f"k FROM [{T}] WHERE v = 30", KV6, [{"k": 3}]),
+    ("ne", f"k FROM [{T}] WHERE v != 30", KV6,
+     [{"k": 0}, {"k": 1}, {"k": 2}, {"k": 4}, {"k": 5}]),
+    ("expr_both_sides", f"k FROM [{T}] WHERE k * 10 = v", KV6,
+     [{"k": i} for i in range(6)]),
+    ("null_eq_filters", f"k FROM [{T}] WHERE v = 10", NULLS, [{"k": 1}]),
+    ("null_ne_filters_null_rows", f"k FROM [{T}] WHERE v != 10", NULLS,
+     [{"k": 3}, {"k": 5}]),
+    ("null_lt_filters", f"k FROM [{T}] WHERE v < 40", NULLS,
+     [{"k": 1}, {"k": 3}]),
+    ("not_pushes_through_null", f"k FROM [{T}] WHERE NOT (v < 40)", NULLS,
+     [{"k": 5}]),
+    ("is_null_predicate", f"k FROM [{T}] WHERE is_null(v)", NULLS,
+     [{"k": 2}, {"k": 4}]),
+    ("not_is_null", f"k FROM [{T}] WHERE NOT is_null(v)", NULLS,
+     [{"k": 1}, {"k": 3}, {"k": 5}]),
+    ("and_short_null", f"k FROM [{T}] WHERE v > 0 AND v < 40", NULLS,
+     [{"k": 1}, {"k": 3}]),
+    ("or_with_null_side", f"k FROM [{T}] WHERE v = 10 OR v = 50", NULLS,
+     [{"k": 1}, {"k": 5}]),
+    ("double_eq", f"k FROM [{T}] WHERE x = -2.5", DBLS, [{"k": 2}]),
+    ("double_lt_zero", f"k FROM [{T}] WHERE x < 0.0", DBLS, [{"k": 2}]),
+    ("bool_col_negated", f"k FROM [{T}] WHERE NOT f",
+     tbl([(1, True), (2, False), (3, None)], BOOL_COLS), [{"k": 2}]),
+    ("cmp_string_lt", f"k FROM [{T}] WHERE s < 'b'", STRS,
+     # byte-wise: 'B' (0x42) < 'b' (0x62), so "Banana" matches too
+     [{"k": 1}, {"k": 2}, {"k": 5}, {"k": 6}]),
+    ("cmp_string_ge", f"k FROM [{T}] WHERE s >= 'cherry'", STRS,
+     [{"k": 3}]),
+    ("cmp_string_eq_empty", f"k FROM [{T}] WHERE s = ''", STRS,
+     [{"k": 5}]),
+    ("uint64_cmp", f"k FROM [{T}] WHERE u > 9000000000000000000",
+     tbl([(1, 2**63 + 5), (2, 17)], U64_COLS), [{"k": 1}]),
+    ("where_false_empty", f"k FROM [{T}] WHERE 1 = 2", KV6, []),
+    ("where_true_all", f"k FROM [{T}] WHERE 1 = 1", KV6,
+     [{"k": i} for i in range(6)]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in CMP],
+                         ids=[c[0] for c in CMP])
+def test_comparisons(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# C. IN / BETWEEN / LIKE / CASE / if / transform
+# ---------------------------------------------------------------------------
+
+COMB = [
+    ("in_single", f"k FROM [{T}] WHERE k IN (3)", KV6, [{"k": 3}]),
+    ("in_none_match", f"k FROM [{T}] WHERE k IN (77, 88)", KV6, []),
+    ("in_expr_subject", f"k FROM [{T}] WHERE k % 3 IN (0)", KV6,
+     [{"k": 0}, {"k": 3}]),
+    ("not_in", f"k FROM [{T}] WHERE k NOT IN (0, 1, 2, 3)", KV6,
+     [{"k": 4}, {"k": 5}]),
+    ("in_null_subject_excluded", f"k FROM [{T}] WHERE v IN (10, 30)",
+     NULLS, [{"k": 1}, {"k": 3}]),
+    ("between_inclusive_ends", f"k FROM [{T}] WHERE k BETWEEN 1 AND 1",
+     KV6, [{"k": 1}]),
+    ("between_empty_range", f"k FROM [{T}] WHERE k BETWEEN 4 AND 2", KV6,
+     []),
+    ("between_on_expr", f"k FROM [{T}] WHERE v / 10 BETWEEN 2 AND 3",
+     KV6, [{"k": 2}, {"k": 3}]),
+    ("like_underscore", f"k FROM [{T}] WHERE s LIKE '_pple'", STRS,
+     [{"k": 1}]),
+    ("like_percent_middle", f"k FROM [{T}] WHERE s LIKE 'a%e'", STRS,
+     [{"k": 1}, {"k": 6}]),
+    ("like_exact_no_wildcards", f"k FROM [{T}] WHERE s LIKE 'cherry'",
+     STRS, [{"k": 3}]),
+    ("like_empty_pattern", f"k FROM [{T}] WHERE s LIKE ''", STRS,
+     [{"k": 5}]),
+    ("like_case_sensitive", f"k FROM [{T}] WHERE s LIKE 'banana'", STRS,
+     []),
+    ("ilike_case_insensitive", f"k FROM [{T}] WHERE s ILIKE 'banana'",
+     STRS, [{"k": 2}]),
+    ("like_null_subject", f"k FROM [{T}] WHERE s LIKE '%'", STRS,
+     [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 5}, {"k": 6}]),
+    ("if_int_branches", f"if(v >= 30, 1, 0) AS r FROM [{T}]", KV6,
+     [{"r": 0}, {"r": 0}, {"r": 0}, {"r": 1}, {"r": 1}, {"r": 1}]),
+    ("if_nested", f"if(k < 2, 'lo', if(k < 4, 'mid', 'hi')) AS r "
+     f"FROM [{T}]", tbl([(1, 0), (3, 0), (5, 0)]),
+     [{"r": "lo"}, {"r": "mid"}, {"r": "hi"}]),
+    ("if_null_condition_null_result",
+     f"if(a > 0, 1, 0) AS r FROM [{T}]", tbl([(4, None, 5)], ABC_COLS),
+     [{"r": None}]),
+    ("if_null_function", f"if_null(a, 99) AS r FROM [{T}]", AB,
+     [{"r": 3}, {"r": -7}, {"r": 0}, {"r": 99}, {"r": 8}]),
+    ("if_null_passthrough", f"if_null(b, a) AS r FROM [{T}]",
+     tbl([(5, 8, None)], ABC_COLS), [{"r": 8}]),
+    ("case_no_else_null", f"CASE WHEN k = 1 THEN 7 END AS r FROM [{T}]",
+     tbl([(1, 0), (2, 0)]), [{"r": 7}, {"r": None}]),
+    ("case_first_match_wins",
+     f"CASE WHEN k > 0 THEN 'a' WHEN k > 1 THEN 'b' END AS r FROM [{T}]",
+     tbl([(2, 0)]), [{"r": "a"}]),
+    ("case_operand_strings",
+     f"CASE s WHEN 'apple' THEN 1 WHEN 'cherry' THEN 2 ELSE 0 END AS r "
+     f"FROM [{T}]", STRS,
+     # null operand: s = 'apple' is null, if() propagates -> null row
+     [{"r": 1}, {"r": 0}, {"r": 2}, {"r": None}, {"r": 0}, {"r": 0}]),
+    ("case_in_where",
+     f"k FROM [{T}] WHERE CASE WHEN k < 3 THEN k ELSE 0 END = 2", KV6,
+     [{"k": 2}]),
+    ("transform_with_default", f"transform(k, (0, 1), (10, 11), -5) AS r "
+     f"FROM [{T}]", tbl([(0, 0), (1, 0), (2, 0)]),
+     [{"r": 10}, {"r": 11}, {"r": -5}]),
+    ("transform_no_default_null",
+     f"transform(k, (0, 1), (10, 11)) AS r FROM [{T}]",
+     tbl([(0, 0), (9, 0)]), [{"r": 10}, {"r": None}]),
+    ("transform_in_where",
+     f"k FROM [{T}] WHERE transform(k, (1, 2), (10, 20), 0) = 20", KV6,
+     [{"k": 2}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in COMB],
+                         ids=[c[0] for c in COMB])
+def test_conditionals(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# D. string functions
+# ---------------------------------------------------------------------------
+
+STRF = [
+    ("length", f"length(s) AS r FROM [{T}]", STRS,
+     [{"r": 5}, {"r": 6}, {"r": 6}, {"r": None}, {"r": 0}, {"r": 9}]),
+    ("lower", f"lower(s) AS r FROM [{T}]", tbl([(1, "MiXeD")], STR_COLS),
+     [{"r": "mixed"}]),
+    ("upper", f"upper(s) AS r FROM [{T}]", tbl([(1, "MiXeD")], STR_COLS),
+     [{"r": "MIXED"}]),
+    ("lower_null", f"lower(s) AS r FROM [{T}]",
+     tbl([(1, None)], STR_COLS), [{"r": None}]),
+    ("concat_literal", f"concat(s, '!') AS r FROM [{T}]",
+     tbl([(1, "hey")], STR_COLS), [{"r": "hey!"}]),
+    ("concat_null_propagates", f"concat(s, '!') AS r FROM [{T}]",
+     tbl([(1, None)], STR_COLS), [{"r": None}]),
+    ("is_prefix_hit", f"k FROM [{T}] WHERE is_prefix('app', s)", STRS,
+     [{"k": 1}, {"k": 6}]),
+    ("is_prefix_empty_prefix", f"k FROM [{T}] WHERE is_prefix('', s)",
+     STRS, [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 5}, {"k": 6}]),
+    ("is_substr_hit", f"k FROM [{T}] WHERE is_substr('err', s)", STRS,
+     [{"k": 3}]),
+    ("is_substr_space", f"k FROM [{T}] WHERE is_substr(' ', s)", STRS,
+     [{"k": 6}]),
+    ("length_in_where", f"k FROM [{T}] WHERE length(s) > 6", STRS,
+     [{"k": 6}]),
+    ("upper_in_group",
+     f"upper(s) AS u, count(*) AS c FROM [{T}] GROUP BY upper(s) AS u",
+     tbl([(1, "ab"), (2, "AB"), (3, "cd")], STR_COLS),
+     [{"u": "AB", "c": 2}, {"u": "CD", "c": 1}]),
+    ("concat_in_order_by",
+     f"s FROM [{T}] ORDER BY concat(s, '') LIMIT 3",
+     tbl([(1, "b"), (2, "a"), (3, "c")], STR_COLS),
+     [{"s": "a"}, {"s": "b"}, {"s": "c"}]),
+    ("string_min_max",
+     f"min(s) AS lo, max(s) AS hi FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, "pear"), (2, "fig"), (3, "plum")], STR_COLS),
+     [{"lo": "fig", "hi": "plum"}]),
+    ("farm_hash_deterministic",
+     # farm_hash hashes the null marker too (non-null result), so every
+     # row satisfies the self-equality
+     f"k FROM [{T}] WHERE farm_hash(s) = farm_hash(s)", STRS,
+     [{"k": i} for i in range(1, 7)]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in STRF],
+                         ids=[c[0] for c in STRF])
+def test_string_functions(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# E. numeric functions and casts
+# ---------------------------------------------------------------------------
+
+NUMF = [
+    ("abs_int", f"abs(v) AS r FROM [{T}]", tbl([(1, -7)]), [{"r": 7}]),
+    ("abs_double", f"abs(x) AS r FROM [{T}]", tbl([(1, -2.5)], DBL_COLS),
+     [{"r": 2.5}]),
+    ("abs_null", f"abs(a) AS r FROM [{T}]", tbl([(4, None, 5)], ABC_COLS),
+     [{"r": None}]),
+    ("ceil", f"ceil(x) AS r FROM [{T}]", tbl([(1, 1.2)], DBL_COLS),
+     [{"r": 2.0}]),
+    ("ceil_negative", f"ceil(x) AS r FROM [{T}]",
+     tbl([(1, -1.2)], DBL_COLS), [{"r": -1.0}]),
+    ("floor", f"floor(x) AS r FROM [{T}]", tbl([(1, 1.8)], DBL_COLS),
+     [{"r": 1.0}]),
+    ("floor_negative", f"floor(x) AS r FROM [{T}]",
+     tbl([(1, -1.2)], DBL_COLS), [{"r": -2.0}]),
+    ("sqrt", f"sqrt(x) AS r FROM [{T}]", tbl([(1, 6.25)], DBL_COLS),
+     [{"r": 2.5}]),
+    ("min_of_two", f"min_of(k, v) AS r FROM [{T}]", tbl([(5, 3)]),
+     [{"r": 3}]),
+    ("max_of_two", f"max_of(k, v) AS r FROM [{T}]", tbl([(5, 3)]),
+     [{"r": 5}]),
+    ("min_of_three", f"min_of(k, v, 0) AS r FROM [{T}]", tbl([(5, 3)]),
+     [{"r": 0}]),
+    ("max_of_doubles", f"max_of(x, 0.0) AS r FROM [{T}]",
+     tbl([(1, -2.5)], DBL_COLS), [{"r": 0.0}]),
+    ("int64_cast_from_double", f"int64(x) AS r FROM [{T}]",
+     tbl([(1, 3.9)], DBL_COLS), [{"r": 3}]),
+    ("double_cast_from_int", f"double(v) / 2 AS r FROM [{T}]",
+     tbl([(1, 7)]), [{"r": 3.5}]),
+    ("uint64_cast", f"uint64(v) AS r FROM [{T}]", tbl([(1, 7)]),
+     [{"r": 7}]),
+    ("int64_cast_of_uint", f"int64(u) AS r FROM [{T}]",
+     tbl([(1, 7)], U64_COLS), [{"r": 7}]),
+    ("boolean_cast", f"k FROM [{T}] WHERE boolean(v)",
+     tbl([(1, 0), (2, 3)]), [{"k": 2}]),
+    ("is_finite_true", f"k FROM [{T}] WHERE is_finite(x)", DBLS,
+     [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 5}]),
+    ("is_finite_false_on_div0", f"k FROM [{T}] WHERE NOT is_finite(x / 0.0)",
+     tbl([(1, 1.0)], DBL_COLS), [{"k": 1}]),
+    ("is_nan_detects", f"k FROM [{T}] WHERE is_nan(x - x)",
+     tbl([(1, 1.0)], DBL_COLS), []),
+    ("sqrt_in_where", f"k FROM [{T}] WHERE sqrt(x) > 10.0", DBLS,
+     [{"k": 5}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in NUMF],
+                         ids=[c[0] for c in NUMF])
+def test_numeric_functions(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# F. aggregates and GROUP BY shapes
+# ---------------------------------------------------------------------------
+
+AGG = [
+    ("sum_per_group", f"g, sum(v) AS s FROM [{T}] GROUP BY g", GRP,
+     [{"g": 0, "s": 9}, {"g": 1, "s": 6}, {"g": 2, "s": None}]),
+    ("count_skips_nulls", f"g, count(v) AS c FROM [{T}] GROUP BY g", GRP,
+     [{"g": 0, "c": 3}, {"g": 1, "c": 2}, {"g": 2, "c": 0}]),
+    ("count_star_counts_rows", f"g, count(*) AS c FROM [{T}] GROUP BY g",
+     GRP, [{"g": 0, "c": 3}, {"g": 1, "c": 2}, {"g": 2, "c": 2}]),
+    ("min_max", f"g, min(v) AS lo, max(v) AS hi FROM [{T}] GROUP BY g",
+     GRP, [{"g": 0, "lo": 1, "hi": 5}, {"g": 1, "lo": 2, "hi": 4},
+           {"g": 2, "lo": None, "hi": None}]),
+    ("avg_double_result", f"g, avg(v) AS a FROM [{T}] GROUP BY g", GRP,
+     [{"g": 0, "a": 3.0}, {"g": 1, "a": 3.0}, {"g": 2, "a": None}]),
+    ("first_any_member", f"g, first(g) AS f FROM [{T}] GROUP BY g", GRP,
+     [{"g": 0, "f": 0}, {"g": 1, "f": 1}, {"g": 2, "f": 2}]),
+    ("sum_of_expression", f"g, sum(v * v) AS s FROM [{T}] GROUP BY g",
+     GRP, [{"g": 0, "s": 35}, {"g": 1, "s": 20}, {"g": 2, "s": None}]),
+    ("group_by_two_keys",
+     f"a, b, count(*) AS c FROM [{T}] GROUP BY a, b",
+     tbl([(1, 1, 1), (2, 1, 1), (3, 1, 2), (4, 2, 1)], ABC_COLS),
+     [{"a": 1, "b": 1, "c": 2}, {"a": 1, "b": 2, "c": 1},
+      {"a": 2, "b": 1, "c": 1}]),
+    ("group_key_expression_mod",
+     f"k % 2 AS p, count(*) AS c FROM [{T}] GROUP BY k % 2 AS p", KV6,
+     [{"p": 0, "c": 3}, {"p": 1, "c": 3}]),
+    ("group_by_string_key",
+     f"s, count(*) AS c FROM [{T}] GROUP BY s",
+     tbl([(1, "x"), (2, "y"), (3, "x"), (4, None)], STR_COLS),
+     [{"s": "x", "c": 2}, {"s": "y", "c": 1}, {"s": None, "c": 1}]),
+    ("group_by_bool_key",
+     f"f, count(*) AS c FROM [{T}] GROUP BY f",
+     tbl([(1, True), (2, False), (3, True)], BOOL_COLS),
+     [{"f": True, "c": 2}, {"f": False, "c": 1}]),
+    ("having_on_count",
+     f"g, count(*) AS c FROM [{T}] GROUP BY g HAVING count(*) > 2", GRP,
+     [{"g": 0, "c": 3}]),
+    ("having_on_min",
+     f"g, min(v) AS lo FROM [{T}] GROUP BY g HAVING min(v) = 2", GRP,
+     [{"g": 1, "lo": 2}]),
+    ("having_filters_all",
+     f"g, sum(v) AS s FROM [{T}] GROUP BY g HAVING sum(v) > 100", GRP,
+     []),
+    ("having_uses_ungrouped_agg",
+     f"g FROM [{T}] GROUP BY g HAVING sum(v) >= 9", GRP, [{"g": 0}]),
+    ("aggregate_only_no_keys",
+     f"sum(v) AS s, count(*) AS c FROM [{T}] GROUP BY 1 AS one", GRP,
+     [{"s": 15, "c": 7}]),
+    ("avg_of_doubles", f"avg(x) AS a FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, 1.0), (2, 2.0), (3, 6.0)], DBL_COLS), [{"a": 3.0}]),
+    ("sum_uint64",
+     f"sum(u) AS s FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, 3), (2, 4)], U64_COLS), [{"s": 7}]),
+    ("cardinality_exact_small",
+     f"cardinality(v) AS c FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, 5), (2, 5), (3, 7), (4, None)]), [{"c": 2}]),
+    ("argmin_basic",
+     f"argmin(k, v) AS r FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, 30), (2, 10), (3, 20)]), [{"r": 2}]),
+    ("argmax_basic",
+     f"argmax(k, v) AS r FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, 30), (2, 10), (3, 20)]), [{"r": 1}]),
+    ("group_then_project_expression",
+     f"g * 100 AS gg, sum(v) AS s FROM [{T}] GROUP BY g", GRP,
+     [{"gg": 0, "s": 9}, {"gg": 100, "s": 6}, {"gg": 200, "s": None}]),
+    ("group_by_if_expression",
+     f"if(v < 3, 'small', 'big') AS b, count(*) AS c FROM [{T}] "
+     f"WHERE v IS NOT NULL GROUP BY if(v < 3, 'small', 'big') AS b"
+     .replace(" WHERE v IS NOT NULL", ""),
+     tbl([(1, 1), (2, 2), (3, 3), (4, 4)]),
+     [{"b": "small", "c": 2}, {"b": "big", "c": 2}]),
+    ("where_then_group",
+     f"g, count(*) AS c FROM [{T}] WHERE v > 1 GROUP BY g", GRP,
+     [{"g": 0, "c": 2}, {"g": 1, "c": 2}]),
+    ("group_order_limit",
+     f"g, sum(v) AS s FROM [{T}] GROUP BY g ORDER BY g DESC LIMIT 2",
+     GRP, [{"g": 2, "s": None}, {"g": 1, "s": 6}]),
+    ("with_totals_row",
+     f"g, sum(v) AS s FROM [{T}] GROUP BY g WITH TOTALS "
+     f"ORDER BY g LIMIT 10",
+     tbl([(1, 0, 1), (2, 0, 2), (3, 1, 4)],
+         [("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")]),
+     [{"g": None, "s": 7}, {"g": 0, "s": 3}, {"g": 1, "s": 4}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in AGG],
+                         ids=[c[0] for c in AGG])
+def test_aggregates(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# G. ORDER BY / LIMIT / OFFSET (ordered comparisons)
+# ---------------------------------------------------------------------------
+
+ORDER = [
+    ("asc", f"k FROM [{T}] ORDER BY v LIMIT 6", KV6,
+     [{"k": i} for i in range(6)]),
+    ("desc", f"k FROM [{T}] ORDER BY v DESC LIMIT 6", KV6,
+     [{"k": i} for i in reversed(range(6))]),
+    ("limit_caps", f"k FROM [{T}] ORDER BY k LIMIT 2", KV6,
+     [{"k": 0}, {"k": 1}]),
+    ("offset_skips", f"k FROM [{T}] ORDER BY k OFFSET 4 LIMIT 10", KV6,
+     [{"k": 4}, {"k": 5}]),
+    ("offset_past_end", f"k FROM [{T}] ORDER BY k OFFSET 99 LIMIT 5",
+     KV6, []),
+    ("limit_zero", f"k FROM [{T}] ORDER BY k LIMIT 0", KV6, []),
+    ("multi_key_mixed",
+     f"a, b FROM [{T}] ORDER BY a, b DESC LIMIT 10",
+     tbl([(1, 1, 1), (2, 1, 3), (3, 0, 9), (4, 1, 2)], ABC_COLS),
+     [{"a": 0, "b": 9}, {"a": 1, "b": 3}, {"a": 1, "b": 2},
+      {"a": 1, "b": 1}]),
+    ("order_by_string_desc",
+     f"s FROM [{T}] ORDER BY s DESC LIMIT 3",
+     tbl([(1, "b"), (2, "a"), (3, "c")], STR_COLS),
+     [{"s": "c"}, {"s": "b"}, {"s": "a"}]),
+    ("order_nulls_first_asc",
+     f"v FROM [{T}] ORDER BY v LIMIT 3", NULLS,
+     [{"v": None}, {"v": None}, {"v": 10}]),
+    ("order_nulls_last_desc",
+     f"v FROM [{T}] ORDER BY v DESC LIMIT 3", NULLS,
+     [{"v": 50}, {"v": 30}, {"v": 10}]),
+    ("order_by_unprojected_column",
+     f"k FROM [{T}] ORDER BY v DESC LIMIT 2", NULLS,
+     [{"k": 5}, {"k": 3}]),
+    ("order_by_expression_abs",
+     f"v FROM [{T}] ORDER BY abs(v - 25) LIMIT 2",
+     tbl([(1, 10), (2, 24), (3, 50)]), [{"v": 24}, {"v": 10}]),
+    ("order_stable_against_dup_keys",
+     f"a, b FROM [{T}] ORDER BY a LIMIT 4",
+     tbl([(1, 1, 4), (2, 1, 3), (3, 1, 2), (4, 0, 1)], ABC_COLS),
+     None),
+    ("order_doubles_negative",
+     f"x FROM [{T}] ORDER BY x LIMIT 3", DBLS,
+     [{"x": None}, {"x": -2.5}, {"x": 0.0}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in ORDER],
+                         ids=[c[0] for c in ORDER])
+def test_ordering(query, tables, expected):
+    if expected is None:
+        rows = evaluate(query, tables)
+        assert [r["a"] for r in rows] == [0, 1, 1, 1]
+        return
+    run(query, tables, expected, ordered=True)
+
+
+# ---------------------------------------------------------------------------
+# H. join shapes
+# ---------------------------------------------------------------------------
+
+JT = {
+    T: ([("k", "int64", "ascending"), ("g", "int64"), ("w", "int64")],
+        [(1, 100, 1), (2, 200, 2), (3, 100, 3), (4, 300, 4),
+         (5, None, 5)]),
+    D: ([("g", "int64", "ascending"), ("name", "string"),
+         ("rank", "int64")],
+        [(100, "alpha", 1), (200, "beta", 2), (400, "gamma", 3)]),
+}
+
+JOINS = [
+    ("inner_basic", f"k, name FROM [{T}] JOIN [{D}] USING g", JT,
+     [{"k": 1, "name": "alpha"}, {"k": 2, "name": "beta"},
+      {"k": 3, "name": "alpha"}]),
+    ("inner_null_key_never_matches",
+     f"k FROM [{T}] JOIN [{D}] USING g WHERE k = 5", JT, []),
+    ("left_keeps_unmatched",
+     f"k, name FROM [{T}] LEFT JOIN [{D}] USING g", JT,
+     [{"k": 1, "name": "alpha"}, {"k": 2, "name": "beta"},
+      {"k": 3, "name": "alpha"}, {"k": 4, "name": None},
+      {"k": 5, "name": None}]),
+    ("join_where_on_foreign",
+     f"k FROM [{T}] JOIN [{D}] USING g WHERE rank = 1", JT,
+     [{"k": 1}, {"k": 3}]),
+    ("join_where_on_self",
+     f"name FROM [{T}] JOIN [{D}] USING g WHERE w >= 2", JT,
+     [{"name": "beta"}, {"name": "alpha"}]),
+    ("join_project_both_sides",
+     f"w + rank AS r FROM [{T}] JOIN [{D}] USING g", JT,
+     [{"r": 2}, {"r": 4}, {"r": 4}]),
+    ("join_group_on_foreign_key",
+     f"name, sum(w) AS s FROM [{T}] JOIN [{D}] USING g GROUP BY name",
+     JT, [{"name": "alpha", "s": 4}, {"name": "beta", "s": 2}]),
+    ("join_order_by_foreign",
+     f"k FROM [{T}] JOIN [{D}] USING g ORDER BY rank DESC, k LIMIT 3",
+     JT, [{"k": 2}, {"k": 1}, {"k": 3}]),
+    ("join_empty_foreign",
+     f"k, name FROM [{T}] JOIN [{D}] USING g",
+     {T: JT[T], D: (JT[D][0], [])}, []),
+    ("left_join_empty_foreign",
+     f"k, name FROM [{T}] LEFT JOIN [{D}] USING g",
+     {T: JT[T], D: (JT[D][0], [])},
+     [{"k": i, "name": None} for i in range(1, 6)]),
+    ("join_empty_self",
+     f"k, name FROM [{T}] JOIN [{D}] USING g",
+     {T: (JT[T][0], []), D: JT[D]}, []),
+    ("join_on_expression_scaled",
+     f"k, d.name AS n FROM [{T}] JOIN [{D}] AS d ON g * 2 = d.g * 2",
+     JT, [{"k": 1, "n": "alpha"}, {"k": 2, "n": "beta"},
+          {"k": 3, "n": "alpha"}]),
+    ("join_duplicate_foreign_fanout",
+     f"k, x FROM [{T}] JOIN [{D}] USING g",
+     {T: ([("k", "int64", "ascending"), ("g", "int64")], [(1, 7), (2, 8)]),
+      D: ([("g", "int64", "ascending"), ("x", "int64")],
+          [(7, 70), (7, 71), (9, 90)])},
+     [{"k": 1, "x": 70}, {"k": 1, "x": 71}]),
+    ("left_join_duplicate_foreign_fanout",
+     f"k, x FROM [{T}] LEFT JOIN [{D}] USING g",
+     {T: ([("k", "int64", "ascending"), ("g", "int64")], [(1, 7), (2, 8)]),
+      D: ([("g", "int64", "ascending"), ("x", "int64")],
+          [(7, 70), (7, 71), (9, 90)])},
+     [{"k": 1, "x": 70}, {"k": 1, "x": 71}, {"k": 2, "x": None}]),
+    ("string_key_join",
+     f"k, r FROM [{T}] JOIN [{D}] ON s = t",
+     {T: ([("k", "int64", "ascending"), ("s", "string")],
+          [(1, "a"), (2, "b"), (3, None)]),
+      D: ([("t", "string", "ascending"), ("r", "int64")],
+          [("a", 10), ("c", 30)])},
+     [{"k": 1, "r": 10}]),
+    ("multi_key_join_both_match",
+     f"k, val FROM [{T}] JOIN [{D}] ON a = c AND b = d",
+     {T: ([("k", "int64", "ascending"), ("a", "int64"), ("b", "int64")],
+          [(1, 1, 1), (2, 1, 2), (3, 2, 1)]),
+      D: ([("c", "int64", "ascending"), ("d", "int64", "ascending"),
+           ("val", "int64")],
+          [(1, 1, 11), (1, 2, 12), (2, 2, 22)])},
+     [{"k": 1, "val": 11}, {"k": 2, "val": 12}]),
+    ("join_then_having",
+     f"name, count(*) AS c FROM [{T}] JOIN [{D}] USING g GROUP BY name "
+     f"HAVING count(*) > 1", JT, [{"name": "alpha", "c": 2}]),
+    ("two_joins_chained",
+     f"k, n1, n2 FROM [{T}] JOIN [//d1] ON g = g1 JOIN [//d2] ON w = g2",
+     {T: ([("k", "int64", "ascending"), ("g", "int64"), ("w", "int64")],
+          [(1, 10, 20), (2, 11, 21), (3, 10, 99)]),
+      "//d1": ([("g1", "int64", "ascending"), ("n1", "int64")],
+               [(10, 100), (11, 110)]),
+      "//d2": ([("g2", "int64", "ascending"), ("n2", "int64")],
+               [(20, 200), (21, 210)])},
+     [{"k": 1, "n1": 100, "n2": 200}, {"k": 2, "n1": 110, "n2": 210}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in JOINS],
+                         ids=[c[0] for c in JOINS])
+def test_join_shapes(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# I. mixed pipelines (where + group + having + order + limit in one)
+# ---------------------------------------------------------------------------
+
+MIXED = [
+    ("full_pipeline",
+     f"g, sum(v) AS s FROM [{T}] WHERE v > 1 GROUP BY g "
+     f"HAVING sum(v) >= 4 ORDER BY sum(v) DESC LIMIT 2", GRP,
+     [{"g": 0, "s": 8}, {"g": 1, "s": 6}]),
+    ("project_after_group_arith",
+     f"g + 1 AS gg, sum(v) * 2 AS ss FROM [{T}] WHERE g < 2 GROUP BY g",
+     GRP, [{"gg": 1, "ss": 18}, {"gg": 2, "ss": 12}]),
+    ("distinct_via_group",
+     f"v / 20 AS bucket FROM [{T}] GROUP BY v / 20 AS bucket", KV6,
+     [{"bucket": 0}, {"bucket": 1}, {"bucket": 2}]),
+    ("where_in_group_order",
+     f"g, max(v) AS m FROM [{T}] WHERE v IN (1, 2, 3, 4) GROUP BY g "
+     f"ORDER BY max(v) DESC LIMIT 10", GRP,
+     [{"g": 1, "m": 4}, {"g": 0, "m": 3}]),
+    ("expression_soup",
+     f"if(k % 2 = 0, 'even', 'odd') AS par, count(*) AS c, "
+     f"sum(v + 1) AS s FROM [{T}] "
+     f"GROUP BY if(k % 2 = 0, 'even', 'odd') AS par", KV6,
+     [{"par": "even", "c": 3, "s": 63}, {"par": "odd", "c": 3, "s": 93}]),
+    ("limit_after_group_without_order",
+     f"g FROM [{T}] GROUP BY g LIMIT 2", GRP, None),
+    ("between_and_like_combo",
+     f"k FROM [{T}] WHERE k BETWEEN 1 AND 6 AND s LIKE '%p%'", STRS,
+     [{"k": 1}, {"k": 6}]),
+    ("case_aggregated",
+     f"sum(CASE WHEN v < 3 THEN 1 ELSE 0 END) AS small FROM [{T}] "
+     f"GROUP BY 1 AS one",
+     tbl([(1, 1), (2, 2), (3, 3), (4, 4)]), [{"small": 2}]),
+    ("order_by_two_aggs",
+     f"g, count(*) AS c, sum(v) AS s FROM [{T}] GROUP BY g "
+     f"ORDER BY count(*) DESC, sum(v) LIMIT 10", GRP,
+     # second key ascending: the null sum sorts FIRST among the ties
+     [{"g": 0, "c": 3, "s": 9}, {"g": 2, "c": 2, "s": None},
+      {"g": 1, "c": 2, "s": 6}]),
+    ("left_join_group_counts_unmatched",
+     f"name, count(*) AS c FROM [{T}] LEFT JOIN [{D}] USING g "
+     f"GROUP BY name", JT,
+     [{"name": "alpha", "c": 2}, {"name": "beta", "c": 1},
+      {"name": None, "c": 2}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in MIXED],
+                         ids=[c[0] for c in MIXED])
+def test_mixed_pipelines(query, tables, expected):
+    if expected is None:
+        rows = evaluate(query, tables)
+        assert len(rows) > 0
+        return
+    ordered = "ORDER BY" in query
+    run(query, tables, expected, ordered=ordered)
+
+
+# ---------------------------------------------------------------------------
+# J. type-boundary, timestamp, and regression odds-and-ends
+# ---------------------------------------------------------------------------
+
+HOUR = 3600
+DAY = 24 * HOUR
+
+EDGE = [
+    ("int64_min_passes_through", f"v FROM [{T}]",
+     tbl([(1, -(2**63))]), [{"v": -(2**63)}]),
+    ("int64_max_passes_through", f"v FROM [{T}]",
+     tbl([(1, 2**63 - 1)]), [{"v": 2**63 - 1}]),
+    ("uint64_max_passes_through", f"u FROM [{T}]",
+     tbl([(1, 2**64 - 1)], U64_COLS), [{"u": 2**64 - 1}]),
+    ("uint64_sum_wraps_mod_2_64",
+     f"sum(u) AS s FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, 2**63 + 1), (2, 2**63 + 2)], U64_COLS), [{"s": 3}]),
+    ("uint64_group_key_high",
+     f"u, count(*) AS c FROM [{T}] GROUP BY u",
+     tbl([(1, 2**64 - 1), (2, 2**64 - 1), (3, 1)], U64_COLS),
+     [{"u": 2**64 - 1, "c": 2}, {"u": 1, "c": 1}]),
+    ("double_negative_zero_equals_zero",
+     f"k FROM [{T}] WHERE x = 0.0", tbl([(1, -0.0), (2, 1.0)], DBL_COLS),
+     [{"k": 1}]),
+    ("double_scientific_literal",
+     f"k FROM [{T}] WHERE x > 1e2", tbl([(1, 99.0), (2, 101.0)], DBL_COLS),
+     [{"k": 2}]),
+    ("negative_literal_in_in",
+     f"k FROM [{T}] WHERE v IN (-10, 10)", tbl([(1, -10), (2, 5)]),
+     [{"k": 1}]),
+    ("ts_floor_hour",
+     f"timestamp_floor_hour(v) AS r FROM [{T}]",
+     tbl([(1, 5 * HOUR + 123)]), [{"r": 5 * HOUR}]),
+    ("ts_floor_day",
+     f"timestamp_floor_day(v) AS r FROM [{T}]",
+     tbl([(1, 3 * DAY + 7 * HOUR)]), [{"r": 3 * DAY}]),
+    ("ts_floor_in_where",
+     f"k FROM [{T}] WHERE timestamp_floor_day(v) = 0",
+     tbl([(1, DAY - 1), (2, DAY)]), [{"k": 1}]),
+    ("ts_floor_group",
+     f"timestamp_floor_hour(v) AS h, count(*) AS c FROM [{T}] "
+     f"GROUP BY timestamp_floor_hour(v) AS h",
+     tbl([(1, 10), (2, 20), (3, HOUR + 1)]),
+     [{"h": 0, "c": 2}, {"h": HOUR, "c": 1}]),
+    ("concat_three_nested",
+     f"concat(concat(s, '-'), s) AS r FROM [{T}]",
+     tbl([(1, "ab")], STR_COLS), [{"r": "ab-ab"}]),
+    ("length_of_concat",
+     f"length(concat(s, 'xy')) AS r FROM [{T}]",
+     tbl([(1, "ab")], STR_COLS), [{"r": 4}]),
+    ("upper_of_lower_roundtrip",
+     f"upper(lower(s)) AS r FROM [{T}]", tbl([(1, "MiX")], STR_COLS),
+     [{"r": "MIX"}]),
+    ("cast_roundtrip_int_double_int",
+     f"int64(double(v)) AS r FROM [{T}]", tbl([(1, 41)]), [{"r": 41}]),
+    ("if_null_chain",
+     f"if_null(if_null(a, b), 0) AS r FROM [{T}]",
+     tbl([(1, None, None), (2, None, 5), (3, 7, 1)], ABC_COLS),
+     [{"r": 0}, {"r": 5}, {"r": 7}]),
+    ("abs_of_difference",
+     f"abs(a - b) AS r FROM [{T}]", tbl([(1, 3, 9)], ABC_COLS),
+     [{"r": 6}]),
+    ("min_of_with_null_arg",
+     # min_of/max_of skip null arguments (LEAST-like, not propagating)
+     f"min_of(a, b) AS r FROM [{T}]", tbl([(4, None, 5)], ABC_COLS),
+     [{"r": 5}]),
+    ("where_on_projected_source_column",
+     f"v AS w FROM [{T}] WHERE v > 30", KV6,
+     [{"w": 40}, {"w": 50}]),
+    ("duplicate_output_names_allowed",
+     f"k AS a, k + 1 AS b FROM [{T}]", tbl([(1, 0)]),
+     [{"a": 1, "b": 2}]),
+    ("empty_table_scan", f"k FROM [{T}]", tbl([]), []),
+    ("empty_table_group",
+     f"sum(v) AS s, count(*) AS c FROM [{T}] GROUP BY 1 AS one",
+     tbl([]), []),
+    ("empty_table_order_limit",
+     f"k FROM [{T}] ORDER BY k LIMIT 5", tbl([]), []),
+    ("single_row_everything",
+     f"k, v, k + v AS s FROM [{T}] WHERE k = 1 ORDER BY k LIMIT 1",
+     tbl([(1, 2)]), [{"k": 1, "v": 2, "s": 3}]),
+    ("all_rows_filtered_then_group",
+     f"g, sum(v) AS s FROM [{T}] WHERE v > 999 GROUP BY g", GRP, []),
+    ("group_by_key_column_itself",
+     f"k, count(*) AS c FROM [{T}] GROUP BY k", tbl([(1, 0), (2, 0)]),
+     [{"k": 1, "c": 1}, {"k": 2, "c": 1}]),
+    ("between_strings",
+     f"k FROM [{T}] WHERE s BETWEEN 'a' AND 'b'", STRS,
+     [{"k": 1}, {"k": 6}]),
+    ("in_with_duplicated_elements",
+     f"k FROM [{T}] WHERE k IN (1, 1, 1, 2)", KV6,
+     [{"k": 1}, {"k": 2}]),
+    ("not_like",
+     f"k FROM [{T}] WHERE s NOT LIKE '%a%'", STRS,
+     [{"k": 3}, {"k": 5}]),
+    ("like_escaped_nothing_special",
+     f"k FROM [{T}] WHERE s LIKE 'apple pie'", STRS, [{"k": 6}]),
+    ("where_between_and_in_combo",
+     f"k FROM [{T}] WHERE k BETWEEN 0 AND 3 AND k IN (2, 3, 4)", KV6,
+     [{"k": 2}, {"k": 3}]),
+    ("avg_preserves_fraction",
+     f"avg(v) AS a FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, 1), (2, 2)]), [{"a": 1.5}]),
+    ("sum_of_negatives",
+     f"sum(v) AS s FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, -5), (2, -7)]), [{"s": -12}]),
+    ("count_on_expression",
+     f"count(v / 0) AS c FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, 5), (2, 6)]), [{"c": 0}]),
+    ("max_of_mixed_sign_doubles",
+     f"max(x) AS m FROM [{T}] GROUP BY 1 AS one",
+     tbl([(1, -1.5), (2, -0.5)], DBL_COLS), [{"m": -0.5}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in EDGE],
+                         ids=[c[0] for c in EDGE])
+def test_type_and_edge_cases(query, tables, expected):
+    run(query, tables, expected)
+
+
+def test_string_between_via_dynamic_table(tmp_path):
+    """End-to-end regression: string BETWEEN with non-vocabulary bounds
+    through the full client path (dynamic store -> snapshot -> select),
+    not just the harness chunks."""
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.schema import TableSchema
+
+    cl = connect(str(tmp_path / "c"))
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("s", "string"), ("v", "int64")],
+        unique_keys=True)
+    cl.create("table", "//q/t", recursive=True,
+              attributes={"schema": schema, "dynamic": True})
+    cl.mount_table("//q/t")
+    cl.insert_rows("//q/t", [
+        {"k": 1, "s": "apple", "v": 1},
+        {"k": 2, "s": "Banana", "v": 2},
+        {"k": 3, "s": "cherry", "v": 3}])
+    rows = cl.select_rows("k FROM [//q/t] WHERE s BETWEEN 'a' AND 'b'")
+    assert [r["k"] for r in rows] == [1]
+    # Byte-wise: 'B' (0x42) < 'apple' (0x61...) < 'cherry' — all match.
+    rows = cl.select_rows(
+        "k FROM [//q/t] WHERE s BETWEEN 'B' AND 'cherry'")
+    assert sorted(r["k"] for r in rows) == [1, 2, 3]

@@ -361,7 +361,7 @@ class YtClient:
         worth re-replicating).  Walks under the master's mutation lock:
         the replicator calls this from its scan thread and a mutating
         dict mid-iteration would abort the walk."""
-        with self.cluster.master._lock:
+        with self.cluster.master.mutation_lock:
             return self._referenced_chunk_ids_locked()
 
     def _referenced_chunk_ids_locked(self) -> set:

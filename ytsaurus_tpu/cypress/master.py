@@ -95,6 +95,8 @@ class Master:
         from ytsaurus_tpu.cypress.transactions import MasterTransactionManager
         self.root_dir = root_dir
         os.makedirs(root_dir, exist_ok=True)
+        # Re-entrant BY CONTRACT: mutation_lock holders issue nested
+        # commit_mutation calls (see the property below).
         self._lock = threading.RLock()
         self._poisoned = False
         self._snapshot_seq = 0
@@ -112,6 +114,15 @@ class Master:
     _MUTATIONS = ("create", "remove", "set", "copy", "move", "link",
                   "tx_start", "tx_commit", "tx_abort", "lock", "batch")
     _TREE_MUTATIONS = ("create", "remove", "set", "copy", "move", "link")
+
+    @property
+    def mutation_lock(self):
+        """Public handle on the mutation lock for callers that need an
+        atomic read-modify-write spanning a read plus commit_mutation
+        (e.g. the chaos coordinator's era bump, the replicator's
+        liveness walk).  Guaranteed re-entrant: holders may issue nested
+        commit_mutation calls."""
+        return self._lock
 
     def commit_mutation(self, op: str, **args) -> Any:
         """Log, then apply (ref CommitMutation)."""

@@ -103,6 +103,17 @@ def _vocab_code(vocab: np.ndarray, value: bytes) -> int:
     return -1
 
 
+def _range_code(vocab: np.ndarray, value: bytes) -> int:
+    """Order-preserving encoding of `value` against a sorted vocab in the
+    doubled space where row code c sits at 2c+1: a present value lands
+    exactly on its row encoding, an absent one on the even insertion
+    point between its neighbors (comparable, never equal)."""
+    idx = int(np.searchsorted(vocab, value)) if len(vocab) else 0
+    if idx < len(vocab) and vocab[idx] == value:
+        return 2 * idx + 1
+    return 2 * idx
+
+
 def _remap_table(old_vocab: np.ndarray, new_vocab: np.ndarray) -> np.ndarray:
     lookup = {v: i for i, v in enumerate(new_vocab)}
     table = np.array([lookup[v] for v in old_vocab], dtype=np.int32)
@@ -552,14 +563,23 @@ class ExprBinder:
 
     def _bind_TBetween(self, node: ir.TBetween) -> BoundExpr:
         operands = [self.bind(o) for o in node.operands]
+        string_ops = [o.type is EValueType.string for o in operands]
         bound_ranges = []
         for lower, upper in node.ranges:
-            lo = self._bind_value_tuples(operands[: len(lower)], [lower])
-            up = self._bind_value_tuples(operands[: len(upper)], [upper])
+            lo = self._bind_value_tuples(operands[: len(lower)], [lower],
+                                         range_encode=True)
+            up = self._bind_value_tuples(operands[: len(upper)], [upper],
+                                         range_encode=True)
             bound_ranges.append((len(lower), lo, len(upper), up))
 
         def emit(ctx):
-            op_planes = [o.emit(ctx) for o in operands]
+            op_planes = []
+            for operand, is_str in zip(operands, string_ops):
+                data, valid = operand.emit(ctx)
+                if is_str:
+                    # Doubled space: see _range_code.
+                    data = data.astype(jnp.int32) * 2 + 1
+                op_planes.append((data, valid))
             in_any = jnp.zeros(ctx.capacity, dtype=bool)
             for lo_len, lo_slots, up_len, up_slots in bound_ranges:
                 ge = _lex_compare(ctx, op_planes[:lo_len], lo_slots, 0, ">=")
@@ -638,21 +658,38 @@ class ExprBinder:
         return BoundExpr(type=node.type, vocab=out_vocab, emit=emit)
 
     def _bind_value_tuples(self, operands: list[BoundExpr],
-                           values) -> tuple[list[int], list[int]]:
+                           values, range_encode: bool = False
+                           ) -> tuple[list[int], list[int]]:
         """Bind literal tuples column-wise; returns (value_slots, valid_slots)
         — one binding slot per operand holding the per-tuple constants
         (strings → codes) plus one holding the per-tuple element validity
         (False where the literal is null), so null tuple elements match null
-        rows and nothing else (CompareRowValues semantics: null == null)."""
+        rows and nothing else (CompareRowValues semantics: null == null).
+
+        range_encode=True (BETWEEN bounds): string literals ABSENT from
+        the column's vocabulary must still order correctly against row
+        codes, not collapse to -1 (which made `s BETWEEN 'a' AND 'b'`
+        empty whenever the bounds were not column values).  Rows compare
+        in a DOUBLED space (code*2+1, see _bind_TBetween); a present
+        literal binds exactly (idx*2+1, equality preserved) and an
+        absent one binds at its even insertion point (idx*2), which
+        orders strictly between the neighboring codes and can equal no
+        row — exactly the semantics of a value missing from the sorted
+        vocabulary."""
         slots = []
         valid_slots = []
         for oi, operand in enumerate(operands):
             col = [tup[oi] if oi < len(tup) else None for tup in values]
             if operand.type is EValueType.string:
                 vocab = operand.vocab if operand.vocab is not None else _EMPTY_VOCAB
-                arr = np.array(
-                    [_vocab_code(vocab, v) if v is not None else -2
-                     for v in col], dtype=np.int32)
+                if range_encode:
+                    arr = np.array(
+                        [_range_code(vocab, v) if v is not None else 0
+                         for v in col], dtype=np.int32)
+                else:
+                    arr = np.array(
+                        [_vocab_code(vocab, v) if v is not None else -2
+                         for v in col], dtype=np.int32)
             else:
                 dt = _dtype_for(operand.type) if operand.type is not EValueType.null \
                     else np.int64

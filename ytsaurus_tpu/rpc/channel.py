@@ -296,8 +296,14 @@ def hedged_race(attempts: "list", delay: float):
             fn = attempts[started]
             if _HEDGE_SLOTS.acquire(blocking=False):
                 started += 1
-                threading.Thread(target=run, args=(fn,), daemon=True,
-                                 name=f"hedge-{started}").start()
+                try:
+                    threading.Thread(target=run, args=(fn,), daemon=True,
+                                     name=f"hedge-{started}").start()
+                except BaseException:
+                    # Thread spawn failed (fd/thread exhaustion): the
+                    # slot must not leak out of the global pool.
+                    _HEDGE_SLOTS.release()
+                    raise
                 pending += 1
             elif pending == 0:
                 # Saturated with nothing in flight: run inline

@@ -84,6 +84,12 @@ class ChunkReplicator:
         issued.  Exposed for tests and for an on-demand Orchid poke."""
         self.stats["scans"] += 1
         alive = sorted(self._nodes_provider())
+        # Failure history is only meaningful for CURRENT members: a node
+        # that left and rejoined must not inherit stale counts (one
+        # hiccup would then read as 3 "consecutive" failures).
+        for address in list(self._listing_failures):
+            if address not in alive:
+                del self._listing_failures[address]
         if len(alive) < 2:
             return 0
         holders: dict[str, set[str]] = {}

@@ -96,7 +96,7 @@ class ChaosCoordinator:
         comes from the coordinator living with the LEADER (a follower's
         writes are fenced by the WAL epoch), matching the reference's
         single chaos cell owning each card."""
-        with self.client.cluster.master._lock:
+        with self.client.cluster.master.mutation_lock:
             card = self.ensure_card(table_path)
             replicas = repl.replica_descriptors(self.client, table_path)
             card["era"] = int(card["era"]) + 1
