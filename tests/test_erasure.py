@@ -71,3 +71,83 @@ def test_small_blob_erasure():
     parts[0] = None
     parts[2] = None
     assert codec.decode(parts, 3) == blob
+
+
+def test_lrc_roundtrip_and_shape():
+    codec = get_erasure_codec("lrc_12_2_2")
+    assert codec.data_parts == 12 and codec.total_parts == 16
+    blob = bytes(range(256)) * 7 + b"tail"
+    parts = codec.encode(blob)
+    assert len(parts) == 16
+    assert codec.decode(parts, len(blob)) == blob
+    # Local parity really is the XOR of its group.
+    import numpy as np
+    group0 = np.frombuffer(parts[0], np.uint8).copy()
+    for i in range(1, 6):
+        group0 ^= np.frombuffer(parts[i], np.uint8)
+    assert group0.tobytes() == parts[12]
+
+
+def test_lrc_single_erasure_repairs_from_local_group_only():
+    """Locality: one lost part rebuilds from its OWN group's 6 surviving
+    parts (XOR) — the other group and the global parities may all be
+    unavailable.  This is LRC's point: single-failure repair reads 6
+    parts, not 12."""
+    codec = get_erasure_codec("lrc_12_2_2")
+    blob = b"locality-matters" * 37
+    encoded = codec.encode(blob)
+    parts = list(encoded)
+    for i in [2] + list(range(6, 12)) + [13, 14, 15]:
+        parts[i] = None
+    assert codec.repair_part(parts, 2) == encoded[2]
+    # Local parity itself repairs group-locally too.
+    parts = list(encoded)
+    for i in [12] + list(range(6, 12)) + [13, 14, 15]:
+        parts[i] = None
+    assert codec.repair_part(parts, 12) == encoded[12]
+    # Global parity has no locality: needs a full-rank subset.
+    parts = list(encoded)
+    parts[14] = None
+    assert codec.repair_part(parts, 14) == encoded[14]
+
+
+def test_lrc_all_three_erasure_patterns_reconstruct():
+    from itertools import combinations
+    codec = get_erasure_codec("lrc_12_2_2")
+    blob = b"every-3-pattern" * 3
+    encoded = codec.encode(blob)
+    for lost in combinations(range(16), 3):
+        parts = [None if i in lost else p for i, p in enumerate(encoded)]
+        assert codec.decode(parts, len(blob)) == blob, lost
+
+
+def test_lrc_four_erasures_mixed_outcomes():
+    codec = get_erasure_codec("lrc_12_2_2")
+    blob = b"four-erasures" * 11
+    encoded = codec.encode(blob)
+    # Spread across groups + parities: recoverable.
+    parts = [None if i in (0, 7, 12, 14) else p
+             for i, p in enumerate(encoded)]
+    assert codec.decode(parts, len(blob)) == blob
+    # Three data erasures in ONE group plus that group's local parity:
+    # only two independent equations (g0, g1) remain for three unknowns.
+    parts = [None if i in (0, 1, 2, 12) else p
+             for i, p in enumerate(encoded)]
+    with pytest.raises(YtError):
+        codec.decode(parts, len(blob))
+
+
+def test_store_lrc_chunk_survives_part_loss(tmp_path):
+    import os
+
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.schema import TableSchema
+
+    store = FsChunkStore(str(tmp_path))
+    schema = TableSchema.make([("a", "int64")])
+    chunk = ColumnarChunk.from_rows(schema, [(i,) for i in range(100)])
+    cid = store.write_chunk(chunk, erasure="lrc_12_2_2")
+    for i in (1, 8, 14):
+        os.unlink(store._part_path(cid, i))
+    assert store.read_chunk(cid).to_rows() == chunk.to_rows()

@@ -1,0 +1,270 @@
+"""Kafka proxy: wire-protocol (v0) round-trips against a live TCP
+listener backed by ordered tables.
+
+Ref model: yt/yt/server/kafka_proxy — stock Kafka clients against YT
+queues.  No Kafka client library ships in this image, so the test
+speaks the public v0 wire format directly over TCP (framing, request
+headers, message sets built per spec — exercising the server exactly
+as a real client would).
+"""
+
+import socket
+import struct
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.server.kafka_proxy import (
+    API_FETCH,
+    API_LIST_OFFSETS,
+    API_METADATA,
+    API_OFFSET_COMMIT,
+    API_OFFSET_FETCH,
+    API_PRODUCE,
+    API_VERSIONS,
+    KafkaProxy,
+    Reader,
+    array,
+    bytes_,
+    encode_message,
+    i16,
+    i32,
+    i64,
+    string,
+)
+
+
+@pytest.fixture
+def proxy(tmp_path):
+    client = connect(str(tmp_path / "c"))
+    p = KafkaProxy(client, topic_root="//kafka").start()
+    yield p
+    p.stop()
+
+
+def call(proxy, api_key, body, version=0, client_id="pytest"):
+    """One framed request/response round-trip over a fresh socket."""
+    payload = i16(api_key) + i16(version) + i32(77) + string(client_id) \
+        + body
+    with socket.create_connection((proxy.host, proxy.port),
+                                  timeout=30) as sock:
+        sock.sendall(struct.pack(">i", len(payload)) + payload)
+        header = sock.recv(4)
+        (length,) = struct.unpack(">i", header)
+        data = b""
+        while len(data) < length:
+            chunk = sock.recv(length - len(data))
+            assert chunk, "connection closed mid-response"
+            data += chunk
+    r = Reader(data)
+    assert r.i32() == 77            # correlation id echoes
+    return r
+
+
+def test_kafka_proxy_in_cluster_daemon(tmp_path):
+    """The proxy runs inside the primary daemon (real process): produce
+    over TCP, then observe the rows through the Python thin client."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from ytsaurus_tpu.environment import LocalCluster
+    from ytsaurus_tpu.remote_client import connect_remote
+
+    with LocalCluster(str(tmp_path / "kc"), n_nodes=1,
+                      replication_factor=1, kafka_proxy=True) as cluster:
+        host, port = cluster.kafka_address.rsplit(":", 1)
+
+        class P:                                   # call() shim
+            pass
+        p = P()
+        p.host, p.port = host, int(port)
+        _produce(p, "wire", [(b"a", b"1"), (None, b"2")])
+        high, msgs = _fetch(p, "wire", 0)
+        assert high == 2
+        assert msgs == [(0, b"a", b"1"), (1, None, b"2")]
+        cl = connect_remote(cluster.primary_address)
+        rows = cl.pull_queue("//kafka/wire", offset=0)
+        assert [r["value"] for r in rows] == [b"1", b"2"]
+
+
+def test_api_versions(proxy):
+    r = call(proxy, API_VERSIONS, b"")
+    assert r.i16() == 0
+    n = r.i32()
+    keys = []
+    for _ in range(n):
+        keys.append(r.i16())
+        r.i16()
+        r.i16()
+    assert {API_PRODUCE, API_FETCH, API_METADATA,
+            API_VERSIONS} <= set(keys)
+
+
+def test_metadata_auto_creates_topic(proxy):
+    r = call(proxy, API_METADATA, array([string("events")]))
+    n_brokers = r.i32()
+    assert n_brokers == 1
+    assert r.i32() == 0             # broker node id
+    assert r.string() == proxy.host
+    assert r.i32() == proxy.port
+    n_topics = r.i32()
+    assert n_topics == 1
+    assert r.i16() == 0             # topic error
+    assert r.string() == "events"
+    n_parts = r.i32()
+    assert n_parts == 1
+    assert r.i16() == 0 and r.i32() == 0
+    # The backing ordered table exists.
+    assert proxy.client.exists("//kafka/events")
+
+
+def _produce(proxy, topic, records):
+    message_set = b"".join(
+        encode_message(k, v, 0) for k, v in records)
+    body = i16(1) + i32(30000) + array([
+        string(topic) + array([i32(0) + bytes_(message_set)])])
+    r = call(proxy, API_PRODUCE, body)
+    assert r.i32() == 1
+    assert r.string() == topic
+    assert r.i32() == 1
+    assert r.i32() == 0             # partition
+    assert r.i16() == 0             # error
+    return r.i64()                  # base offset
+
+
+def _fetch(proxy, topic, offset, max_bytes=1 << 20):
+    body = i32(-1) + i32(100) + i32(1) + array([
+        string(topic) + array([i32(0) + i64(offset) + i32(max_bytes)])])
+    r = call(proxy, API_FETCH, body)
+    assert r.i32() == 1
+    assert r.string() == topic
+    assert r.i32() == 1
+    assert r.i32() == 0
+    assert r.i16() == 0
+    high = r.i64()
+    blob = r.bytes_() or b""
+    out = []
+    rr = Reader(blob)
+    while rr.pos + 12 <= len(rr.data):
+        off = rr.i64()
+        size = rr.i32()
+        msg = Reader(rr._take(size))
+        msg.i32()
+        msg.i8()
+        msg.i8()
+        out.append((off, msg.bytes_(), msg.bytes_()))
+    return high, out
+
+
+def test_produce_fetch_roundtrip(proxy):
+    base = _produce(proxy, "logs", [(b"k1", b"hello"), (None, b"world")])
+    assert base == 0
+    high, msgs = _fetch(proxy, "logs", 0)
+    assert high == 2
+    assert msgs == [(0, b"k1", b"hello"), (1, None, b"world")]
+    # Append more; fetch from a mid offset.
+    assert _produce(proxy, "logs", [(b"k3", b"!")]) == 2
+    high, msgs = _fetch(proxy, "logs", 2)
+    assert high == 3
+    assert msgs == [(2, b"k3", b"!")]
+    # Fetch at the head: empty message set, watermark reported.
+    high, msgs = _fetch(proxy, "logs", 3)
+    assert high == 3 and msgs == []
+
+
+def test_unsupported_version_answered_in_v0_shape(proxy):
+    r = call(proxy, API_VERSIONS, b"", version=3)
+    assert r.i16() == 35            # UNSUPPORTED_VERSION, v0 body
+    assert r.i32() > 0              # supported api array still present
+
+
+def test_acks_zero_produce_sends_no_response(proxy):
+    message_set = encode_message(None, b"fire-and-forget", 0)
+    body = i16(0) + i32(30000) + array([
+        string("noack") + array([i32(0) + bytes_(message_set)])])
+    payload = i16(API_PRODUCE) + i16(0) + i32(5) + string("t") + body
+    with socket.create_connection((proxy.host, proxy.port),
+                                  timeout=10) as sock:
+        sock.sendall(struct.pack(">i", len(payload)) + payload)
+        # No response frame: the next (normal) request's response must be
+        # the FIRST bytes read — correlation id framing stays in sync.
+        payload2 = i16(API_VERSIONS) + i16(0) + i32(42) + string("t")
+        sock.sendall(struct.pack(">i", len(payload2)) + payload2)
+        header = sock.recv(4)
+        (length,) = struct.unpack(">i", header)
+        data = b""
+        while len(data) < length:
+            data += sock.recv(length - len(data))
+        assert Reader(data).i32() == 42
+    # The acks=0 write still landed.
+    _, msgs = _fetch(proxy, "noack", 0)
+    assert [v for _, _, v in msgs] == [b"fire-and-forget"]
+
+
+def test_compressed_message_set_rejected(proxy):
+    _produce(proxy, "gz", [(None, b"plain")])      # topic exists
+    # attributes=1 (gzip wrapper): refused with CORRUPT_MESSAGE.
+    body_msg = struct.pack(">b", 0) + struct.pack(">b", 1) + \
+        i32(-1) + bytes_(b"\x1f\x8b-not-really-gzip")
+    import zlib as _z
+    crc = struct.unpack(">i", struct.pack(
+        ">I", _z.crc32(body_msg) & 0xFFFFFFFF))[0]
+    message_set = i64(0) + i32(len(body_msg) + 4) + i32(crc) + body_msg
+    body = i16(1) + i32(30000) + array([
+        string("gz") + array([i32(0) + bytes_(message_set)])])
+    r = call(proxy, API_PRODUCE, body)
+    r.i32()
+    assert r.string() == "gz"
+    r.i32()
+    assert r.i32() == 0
+    assert r.i16() == 2             # CORRUPT_MESSAGE
+    # Nothing was appended.
+    high, _ = _fetch(proxy, "gz", 0)
+    assert high == 1
+
+
+def test_fetch_respects_max_bytes(proxy):
+    _produce(proxy, "big", [(None, bytes(200)) for _ in range(10)])
+    _, msgs = _fetch(proxy, "big", 0, max_bytes=500)
+    assert 1 <= len(msgs) < 10
+
+
+def test_list_offsets(proxy):
+    _produce(proxy, "off", [(None, b"a"), (None, b"b")])
+    body = i32(-1) + array([
+        string("off") + array([i32(0) + i64(-1) + i32(1)])])
+    r = call(proxy, API_LIST_OFFSETS, body)
+    r.i32()
+    assert r.string() == "off"
+    r.i32()
+    assert r.i32() == 0 and r.i16() == 0
+    n = r.i32()
+    assert n == 1 and r.i64() == 2          # latest == high watermark
+
+
+def test_offset_commit_and_fetch(proxy):
+    _produce(proxy, "grp", [(None, b"x"), (None, b"y"), (None, b"z")])
+    body = string("team-a") + array([
+        string("grp") + array([i32(0) + i64(2) + string("")])])
+    r = call(proxy, API_OFFSET_COMMIT, body)
+    r.i32()
+    assert r.string() == "grp"
+    r.i32()
+    assert r.i32() == 0 and r.i16() == 0
+    # Offset fetch round-trips the committed position.
+    body = string("team-a") + array([
+        string("grp") + array([i32(0)])])
+    r = call(proxy, API_OFFSET_FETCH, body)
+    r.i32()
+    assert r.string() == "grp"
+    r.i32()
+    assert r.i32() == 0
+    assert r.i64() == 2
+    # Unknown group: -1 (no committed offset).
+    body = string("team-b") + array([
+        string("grp") + array([i32(0)])])
+    r = call(proxy, API_OFFSET_FETCH, body)
+    r.i32()
+    r.string()
+    r.i32()
+    r.i32()
+    assert r.i64() == -1

@@ -1,12 +1,15 @@
-"""Erasure coding: systematic Reed–Solomon over GF(2^8).
+"""Erasure coding: systematic Reed–Solomon and LRC over GF(2^8).
 
 Ref: library/cpp/erasure (codecs RS(6,3), LRC(12,2,2) via ISA-L/Jerasure,
 wrapped by yt/yt/library/erasure).  This is an independent numpy
 implementation: a systematic generator derived from an extended Vandermonde
-matrix; any k of the k+m parts reconstruct the original (m erasures
-tolerated).  rs_6_3 matches the reference's default storage codec shape.
-LRC is future work (PARITY.md).
-"""
+matrix; decode selects a full-rank subset of the available rows, so any
+recoverable erasure pattern reconstructs.  rs_6_3 matches the reference's
+default storage codec shape; lrc_12_2_2 is the production-default family
+(README.md:3-7): 12 data parts in two locality groups of 6, one XOR
+parity per group (single-part repair reads only its group) plus two
+Vandermonde global parities (every 3-erasure pattern and many 4-erasure
+patterns reconstruct)."""
 
 from __future__ import annotations
 
@@ -140,6 +143,10 @@ class ErasureCodec:
     data_parts: int          # k
     parity_parts: int        # m
     generator: np.ndarray    # (k+m, k) systematic
+    # Locality groups (LRC): part-index tuples whose members XOR to
+    # zero, so any single member rebuilds from the rest of its group.
+    # Empty for MDS codes (RS).
+    groups: "tuple[tuple[int, ...], ...]" = ()
 
     @property
     def total_parts(self) -> int:
@@ -162,24 +169,104 @@ class ErasureCodec:
     # -- decode / repair -------------------------------------------------------
 
     def decode(self, parts: Sequence[Optional[bytes]], size: int) -> bytes:
-        """Reconstruct the original blob from any k available parts."""
+        """Reconstruct the original blob from a recoverable subset of
+        parts.  Row selection is rank-aware: for MDS codes (RS) any k
+        parts work; for LRC some k-subsets are dependent (e.g. both
+        local parities against erasures concentrated in one group), so
+        the decoder picks an invertible row set from EVERYTHING
+        available instead of blindly taking the first k."""
+        return self._data_matrix(parts).reshape(-1).tobytes()[:size]
+
+    def _data_matrix(self, parts: Sequence[Optional[bytes]]) -> np.ndarray:
         k = self.data_parts
         available = [i for i, p in enumerate(parts) if p is not None]
-        if len(available) < k:
-            raise YtError(
-                f"Erasure decode needs {k} parts, only {len(available)} "
-                f"available", code=EErrorCode.ChunkFormatError)
-        use = available[:k]
-        if use == list(range(k)):
-            data = np.stack([np.frombuffer(parts[i], dtype=np.uint8)
+        if available[: k] == list(range(k)):
+            return np.stack([np.frombuffer(parts[i], dtype=np.uint8)
                              for i in range(k)])
-        else:
-            sub = self.generator[use]                    # (k, k)
-            inv = _gf_gauss_invert(sub)
-            received = np.stack([np.frombuffer(parts[i], dtype=np.uint8)
-                                 for i in use])
-            data = _gf_matmul_vec(inv, received)
-        return data.reshape(-1).tobytes()[:size]
+        use = _select_invertible_rows(self.generator, available, k)
+        if use is None:
+            raise YtError(
+                f"Erasure decode: available parts {available} do not "
+                f"span the data (codec {self.name}); unrecoverable "
+                "erasure pattern", code=EErrorCode.ChunkFormatError)
+        sub = self.generator[use]                        # (k, k)
+        inv = _gf_gauss_invert(sub)
+        received = np.stack([np.frombuffer(parts[i], dtype=np.uint8)
+                             for i in use])
+        return _gf_matmul_vec(inv, received)
+
+    def locality_group(self, index: int) -> "Optional[list[int]]":
+        """The part indices whose XOR rebuilds `index` (its locality
+        group minus `index`); None when the codec has no locality
+        structure or the part belongs to no group (global parity)."""
+        for group in self.groups:
+            if index in group:
+                return [m for m in group if m != index]
+        return None
+
+    def repair_part(self, parts: Sequence[Optional[bytes]],
+                    index: int) -> bytes:
+        """Rebuild ONE part.  LRC's locality benefit: a part inside a
+        locality group XOR-repairs from the 6 other group members (the
+        other group and the global parities may be unavailable); the
+        general path reconstructs the data matrix and re-encodes."""
+        group = self.locality_group(index)
+        if group is not None and all(parts[m] is not None for m in group):
+            acc = np.frombuffer(parts[group[0]], dtype=np.uint8).copy()
+            for m in group[1:]:
+                acc ^= np.frombuffer(parts[m], dtype=np.uint8)
+            return acc.tobytes()
+        data = self._data_matrix(parts)
+        return _gf_matmul_vec(self.generator[index: index + 1],
+                              data)[0].tobytes()
+
+
+def _select_invertible_rows(generator: np.ndarray, available: list,
+                            k: int) -> "Optional[list]":
+    """Greedy full-rank row selection over GF(2^8): walk the available
+    generator rows, keep each row that is independent of those already
+    kept (Gaussian reduction), stop at k.  Prefers data rows (identity —
+    cheapest) because `available` is index-ordered."""
+    chosen: list = []
+    basis: list = []            # reduced rows with their pivot columns
+    for idx in available:
+        row = generator[idx].astype(np.uint8).copy()
+        for pivot_col, basis_row in basis:
+            if row[pivot_col]:
+                factor = row[pivot_col]
+                row = row ^ np.array(
+                    [_gf_mul(int(factor), int(b)) for b in basis_row],
+                    dtype=np.uint8)
+        nz = np.nonzero(row)[0]
+        if len(nz) == 0:
+            continue            # dependent on rows already chosen
+        pivot = int(nz[0])
+        inv = _gf_inv(int(row[pivot]))
+        row = np.array([_gf_mul(inv, int(b)) for b in row],
+                       dtype=np.uint8)
+        basis.append((pivot, row))
+        chosen.append(idx)
+        if len(chosen) == k:
+            return chosen
+    return None
+
+
+def _lrc_generator() -> np.ndarray:
+    """LRC(12,2,2): identity for the 12 data parts, one XOR row per
+    locality group of 6 (parts 12, 13), two Vandermonde global parity
+    rows over distinct nonzero field elements (parts 14, 15).  Distinct
+    alphas make every within-group Vandermonde minor invertible, so all
+    3-erasure patterns reconstruct; squaring is a field automorphism, so
+    the second global row stays independent."""
+    k = 12
+    rows = [np.eye(k, dtype=np.uint8)]
+    l0 = np.array([1] * 6 + [0] * 6, dtype=np.uint8)
+    l1 = np.array([0] * 6 + [1] * 6, dtype=np.uint8)
+    alphas = [int(_EXP[i]) for i in range(k)]       # 2^i, all distinct
+    g0 = np.array(alphas, dtype=np.uint8)
+    g1 = np.array([_gf_mul(a, a) for a in alphas], dtype=np.uint8)
+    rows.append(np.stack([l0, l1, g0, g1]))
+    return np.vstack(rows)
 
 
 _CODECS: dict[str, ErasureCodec] = {}
@@ -192,6 +279,11 @@ def get_erasure_codec(name: str) -> ErasureCodec:
             codec = ErasureCodec("rs_6_3", 6, 3, _systematic_generator(6, 3))
         elif name == "rs_3_2":
             codec = ErasureCodec("rs_3_2", 3, 2, _systematic_generator(3, 2))
+        elif name == "lrc_12_2_2":
+            codec = ErasureCodec(
+                "lrc_12_2_2", 12, 4, _lrc_generator(),
+                groups=(tuple(range(0, 6)) + (12,),
+                        tuple(range(6, 12)) + (13,)))
         else:
             raise YtError(f"Unknown erasure codec {name!r}",
                           code=EErrorCode.ChunkFormatError)

@@ -21,16 +21,25 @@ from ytsaurus_tpu.rpc import Channel, RetryingChannel
 class LocalCluster:
     def __init__(self, root_dir: str, n_nodes: int = 2,
                  replication_factor: int = 2, http_proxy: bool = False,
-                 n_masters: int = 1, lease_ttl: float = 4.0):
+                 n_masters: int = 1, lease_ttl: float = 4.0,
+                 kafka_proxy: bool = False):
         self.root_dir = root_dir
         self.n_nodes = n_nodes
         self.n_masters = n_masters
         self.lease_ttl = lease_ttl
         self.replication_factor = replication_factor
         self.http_proxy = http_proxy
+        if kafka_proxy and n_masters > 1:
+            # The kafka listener lives inside master 0; after a failover
+            # it would point at a dead process.  Until the proxy follows
+            # the leader, refuse the combination rather than serve a
+            # port that silently dies.
+            raise ValueError("kafka_proxy requires n_masters == 1")
+        self.kafka_proxy = kafka_proxy
         self.primary_address: str | None = None
         self.master_addresses: list[str] = []
         self.http_proxy_address: str | None = None
+        self.kafka_address: str | None = None
         self.node_addresses: list[str] = []
         self._procs: list[subprocess.Popen] = []
 
@@ -52,6 +61,8 @@ class LocalCluster:
                 if election:
                     args += ["--election", "--master-index", str(m),
                              "--lease-ttl", str(self.lease_ttl)]
+                if self.kafka_proxy and m == 0:
+                    args += ["--kafka"]
                 self._master_args.append(args)
                 self._spawn(name, primary_root, args)
             for m in range(self.n_masters):
@@ -60,6 +71,10 @@ class LocalCluster:
                 port = self._wait_port(primary_root, "primary", deadline)
                 self.master_addresses.append(f"127.0.0.1:{port}")
             self.primary_address = self.master_addresses[0]
+            if self.kafka_proxy:
+                primary_root = os.path.join(self.root_dir, "primary")
+                port = self._wait_port(primary_root, "kafka", deadline)
+                self.kafka_address = f"127.0.0.1:{port}"
             primaries = ",".join(self.master_addresses)
             for i in range(self.n_nodes):
                 node_root = os.path.join(self.root_dir, f"node{i}")

@@ -34,7 +34,7 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
                 journal_nodes: int = 3,
                 bootstrap_timeout: float = 60.0,
                 election: bool = False, master_index: int = 0,
-                lease_ttl: float = 6.0) -> None:
+                lease_ttl: float = 6.0, kafka: bool = False) -> None:
     from ytsaurus_tpu import yson
     from ytsaurus_tpu.client import YtClient, YtCluster
     from ytsaurus_tpu.cypress.election import LeaderElector
@@ -356,6 +356,14 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         liveness_provider=client.referenced_chunk_ids)
     replicator.start()
     orchid.register("/chunk_replicator", lambda: dict(replicator.stats))
+    if kafka:
+        # Kafka wire protocol over queues (ref server/kafka_proxy):
+        # in-process with the primary, like the query tracker / queue
+        # agent, so consumer registrations ride the same client.
+        from ytsaurus_tpu.server.kafka_proxy import KafkaProxy
+        kafka_proxy = KafkaProxy(client).start()
+        _write_port_file(root, "kafka", kafka_proxy.port)
+        print(f"kafka proxy serving on {kafka_proxy.address}", flush=True)
     role["value"] = "leader"
     print(f"primary serving on {server.address}"
           + (f" (leader, master {master_index})" if election else ""),
@@ -456,6 +464,9 @@ def main() -> None:
                              "attempts; index 0 bootstraps fresh "
                              "clusters)")
     parser.add_argument("--lease-ttl", type=float, default=6.0)
+    parser.add_argument("--kafka", action="store_true",
+                        help="serve the Kafka wire protocol over queues "
+                             "(primary role; port in <root>/kafka.port)")
     args = parser.parse_args()
 
     # Daemons never touch accelerators; pin CPU before any jax import so a
@@ -469,7 +480,7 @@ def main() -> None:
                     bootstrap_timeout=args.bootstrap_timeout,
                     election=args.election,
                     master_index=args.master_index,
-                    lease_ttl=args.lease_ttl)
+                    lease_ttl=args.lease_ttl, kafka=args.kafka)
     elif args.role == "proxy":
         if not args.primary:
             parser.error("--primary is required for --role proxy")
