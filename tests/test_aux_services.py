@@ -1,0 +1,162 @@
+"""Discovery server + master cache (aux processes, SURVEY §2.10).
+
+Ref models: yt/yt/server/discovery_server (group membership with TTL
+leases) and yt/yt/server/master_cache (read-through metadata cache on
+the driver wire surface).
+"""
+
+import time
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from ytsaurus_tpu.client import connect  # noqa: E402
+from ytsaurus_tpu.rpc import Channel, RpcServer  # noqa: E402
+from ytsaurus_tpu.server.discovery import (  # noqa: E402
+    DiscoveryService,
+    DiscoveryTracker,
+)
+from ytsaurus_tpu.server.master_cache import MasterCacheService  # noqa: E402
+
+
+# -- discovery ---------------------------------------------------------------
+
+def test_discovery_membership_and_ttl():
+    tracker = DiscoveryTracker(member_ttl=0.2)
+    tracker.heartbeat("/proxies/http", "p1", "h1:80", {"role": "proxy"})
+    tracker.heartbeat("/proxies/http", "p2", "h2:80")
+    tracker.heartbeat("/trackers", "q1", "h3:81")
+    members = tracker.list_members("/proxies/http")
+    assert [m["id"] for m in members] == ["p1", "p2"]
+    assert members[0]["attributes"] == {"role": "proxy"}
+    assert tracker.list_groups() == ["/proxies/http", "/trackers"]
+    assert tracker.list_groups("/proxies") == ["/proxies/http"]
+    # Lease expiry drops members (and empty groups) without any leave.
+    time.sleep(0.25)
+    tracker.heartbeat("/proxies/http", "p2", "h2:80")
+    assert [m["id"] for m in tracker.list_members("/proxies/http")] == \
+        ["p2"]
+    assert tracker.list_groups() == ["/proxies/http"]
+    # Explicit leave.
+    tracker.leave("/proxies/http", "p2")
+    assert tracker.list_members("/proxies/http") == []
+
+
+def test_discovery_over_rpc():
+    srv = RpcServer([DiscoveryService(DiscoveryTracker(member_ttl=5.0))])
+    srv.start()
+    try:
+        ch = Channel(srv.address, timeout=15)
+        body, _ = ch.call("discovery", "heartbeat",
+                          {"group": "/qt", "member_id": "a",
+                           "address": "x:1"})
+        assert body["ttl"] == 5.0
+        body, _ = ch.call("discovery", "list_members", {"group": "/qt"})
+        assert [m["address"] for m in body["members"]] == [b"x:1"] or \
+            [m["address"] for m in body["members"]] == ["x:1"]
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_discovery_prefix_is_segment_aware():
+    tracker = DiscoveryTracker()
+    tracker.heartbeat("/proxies/http", "a", "")
+    tracker.heartbeat("/proxiesold", "b", "")
+    assert tracker.list_groups("/proxies") == ["/proxies/http"]
+    assert tracker.list_groups("/proxiesold") == ["/proxiesold"]
+
+
+def test_discovery_rejects_bad_group():
+    tracker = DiscoveryTracker()
+    from ytsaurus_tpu.errors import YtError
+    with pytest.raises(YtError):
+        tracker.heartbeat("no-slash", "m", "")
+
+
+# -- master cache ------------------------------------------------------------
+
+@pytest.fixture
+def upstream(tmp_path):
+    from ytsaurus_tpu.server.services import DriverService
+    client = connect(str(tmp_path / "m"))
+    srv = RpcServer([DriverService(client)])
+    srv.start()
+    yield client, srv
+    srv.stop()
+
+
+def test_master_cache_serves_stale_within_ttl(upstream, tmp_path):
+    client, upstream_srv = upstream
+    cache_service = MasterCacheService(upstream_srv.address, ttl=30.0)
+    cache_srv = RpcServer([cache_service])
+    cache_srv.start()
+    try:
+        from ytsaurus_tpu.remote_client import connect_remote
+        client.create("document", "//cfg/x", recursive=True)
+        client.set("//cfg/x", 1)
+        through_cache = connect_remote(cache_srv.address)
+        assert through_cache.get("//cfg/x") == 1
+        assert cache_service.stats["misses"] == 1
+        # Repeat: served from cache, upstream not consulted again.
+        assert through_cache.get("//cfg/x") == 1
+        assert cache_service.stats["hits"] == 1
+        # Upstream changes are invisible until the TTL lapses — the
+        # documented staleness contract of a metadata cache.
+        client.set("//cfg/x", 2)
+        assert through_cache.get("//cfg/x") == 1
+    finally:
+        cache_srv.stop()
+
+
+def test_master_cache_expires_and_forwards_mutations(upstream):
+    client, upstream_srv = upstream
+    cache_service = MasterCacheService(upstream_srv.address, ttl=0.2)
+    cache_srv = RpcServer([cache_service])
+    cache_srv.start()
+    try:
+        from ytsaurus_tpu.remote_client import connect_remote
+        through_cache = connect_remote(cache_srv.address)
+        # Mutations forward (and are NOT cached).
+        through_cache.create("document", "//d/v", recursive=True)
+        through_cache.set("//d/v", 10)
+        assert cache_service.stats["forwarded"] >= 2
+        assert through_cache.get("//d/v") == 10
+        client.set("//d/v", 11)
+        time.sleep(0.25)                 # ttl lapse → fresh read
+        assert through_cache.get("//d/v") == 11
+        # exists/list are cacheable too.
+        assert through_cache.exists("//d/v") is True
+        assert through_cache.list("//d") == ["v"]
+    finally:
+        cache_srv.stop()
+
+
+def test_master_cache_forwards_transactions(upstream, tmp_path):
+    """The full driver tx surface works THROUGH the cache (dynamic-table
+    writes forward to the primary, which owns the tx state)."""
+    from ytsaurus_tpu.schema import TableSchema
+
+    client, upstream_srv = upstream
+    cache_srv = RpcServer([MasterCacheService(upstream_srv.address)])
+    cache_srv.start()
+    try:
+        from ytsaurus_tpu.remote_client import connect_remote
+        schema = TableSchema.make(
+            [("k", "int64", "ascending"), ("v", "int64")],
+            unique_keys=True)
+        through_cache = connect_remote(cache_srv.address)
+        through_cache.create("table", "//dyn/t", recursive=True,
+                             attributes={"schema": schema,
+                                         "dynamic": True})
+        through_cache.mount_table("//dyn/t")
+        tx = through_cache.start_transaction()
+        through_cache.insert_rows("//dyn/t", [{"k": 1, "v": 10}], tx=tx)
+        through_cache.commit_transaction(tx)
+        assert through_cache.lookup_rows("//dyn/t", [(1,)]) == [
+            {"k": 1, "v": 10}]
+    finally:
+        cache_srv.stop()

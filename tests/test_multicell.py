@@ -1,0 +1,148 @@
+"""Multicell Cypress: portal entrances delegating subtrees to secondary
+cells (ref cypress_server portal_entrance/portal_exit + cell_master
+multicell; Hive carries cross-cell lifecycle).
+"""
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.errors import YtError
+
+
+@pytest.fixture
+def cells(tmp_path):
+    primary = connect(str(tmp_path / "primary"))
+    secondary_root = str(tmp_path / "secondary")
+    secondary = connect(secondary_root)
+    return primary, secondary, secondary_root
+
+
+def test_portal_routes_cypress_verbs(cells):
+    primary, secondary, secondary_root = cells
+    primary.create("portal_entrance", "//federated", recursive=True,
+                   attributes={"cell_root": secondary_root,
+                               "cell_tag": 2})
+    # Writes beneath the portal land on the secondary cell's master.
+    primary.create("map_node", "//federated/home", recursive=True)
+    primary.set("//federated/home/@owner", "beta-team")
+    assert primary.get("//federated/home/@owner") == "beta-team"
+    assert primary.exists("//federated/home")
+    assert primary.list("//federated") == ["home"]
+    # ...observable directly on the secondary, absent from the primary.
+    assert secondary.get("//federated/home/@owner") == "beta-team"
+    assert primary.cluster.master.tree.try_resolve(
+        "//federated/home") is None
+    # The entrance node itself stays primary metadata.
+    assert primary.get("//federated/@cell_tag") == 2
+    # remove routes too.
+    primary.remove("//federated/home")
+    assert not primary.exists("//federated/home")
+    assert not secondary.exists("//federated/home")
+
+
+def test_portal_routes_table_data(cells):
+    primary, secondary, secondary_root = cells
+    primary.create("portal_entrance", "//cold", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    rows = [{"k": i, "v": f"r{i}"} for i in range(10)]
+    primary.write_table("//cold/archive", rows)
+    got = primary.read_table("//cold/archive")
+    assert [r["k"] for r in got] == list(range(10))
+    # Chunk data + metadata live on the secondary cell.
+    assert secondary.get("//cold/archive/@row_count") == 10
+    assert primary.cluster.master.tree.try_resolve("//cold/archive") is None
+
+
+def test_portal_removal_dismantles_exit_via_hive(cells):
+    primary, secondary, secondary_root = cells
+    primary.create("portal_entrance", "//p", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    primary.create("document", "//p/a/b", recursive=True)
+    assert secondary.exists("//p/a/b")
+    primary.remove("//p")
+    assert not primary.exists("//p")
+    # The exit subtree is gone on the secondary — removed by the Hive
+    # message handler, atomically with the inbox ack.
+    assert not secondary.exists("//p")
+    # Idempotence: re-creating and removing again works (fresh seqnos).
+    primary.create("portal_entrance", "//p", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    primary.create("document", "//p/c", recursive=True)
+    primary.remove("//p")
+    assert not secondary.exists("//p")
+
+
+def test_ancestor_remove_dismantles_nested_exits(cells):
+    """Removing an ANCESTOR of a portal must dismantle the exit too, or
+    the secondary leaks the subtree and a recreated portal resurrects
+    stale data."""
+    primary, secondary, secondary_root = cells
+    primary.create("map_node", "//dir", recursive=True)
+    primary.create("portal_entrance", "//dir/p",
+                   attributes={"cell_root": secondary_root})
+    primary.write_table("//dir/p/data", [{"k": 1}])
+    assert secondary.exists("//dir/p/data")
+    primary.remove("//dir")
+    assert not secondary.exists("//dir/p"), "exit subtree leaked"
+    # Recreating the portal starts clean.
+    primary.create("map_node", "//dir", recursive=True)
+    primary.create("portal_entrance", "//dir/p",
+                   attributes={"cell_root": secondary_root})
+    assert primary.list("//dir/p") == []
+
+
+def test_portal_create_ignore_existing(cells):
+    primary, _, secondary_root = cells
+    primary.create("portal_entrance", "//idem", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    # Idempotent bootstrap re-run.
+    primary.create("portal_entrance", "//idem", recursive=True,
+                   attributes={"cell_root": secondary_root},
+                   ignore_existing=True)
+    with pytest.raises(YtError):
+        primary.create("portal_entrance", "//idem", recursive=True,
+                       attributes={"cell_root": secondary_root})
+
+
+def test_get_on_entrance_resolves_to_exit(cells):
+    primary, _, secondary_root = cells
+    primary.create("portal_entrance", "//g", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    primary.set("//g/leaf", 7)
+    got = primary.get("//g")
+    assert got.get("leaf") == 7          # exit content, not the entrance
+    # Attribute reads still address the ENTRANCE node.
+    assert primary.get("//g/@cell_root") == secondary_root
+
+
+def test_tx_under_portal_rejected(cells):
+    primary, _, secondary_root = cells
+    primary.create("portal_entrance", "//txp", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    tx = primary.start_tx()
+    with pytest.raises(YtError):
+        primary.set("//txp/x", 1, tx=tx)
+    with pytest.raises(YtError):
+        primary.remove("//txp/x", force=True, tx=tx)
+    primary.abort_tx(tx)
+
+
+def test_portal_requires_cell_root(cells):
+    primary, _, _ = cells
+    with pytest.raises(YtError):
+        primary.create("portal_entrance", "//bad", recursive=True,
+                       attributes={})
+
+
+def test_chained_portals(cells, tmp_path):
+    primary, secondary, secondary_root = cells
+    third_root = str(tmp_path / "third")
+    third = connect(third_root)
+    primary.create("portal_entrance", "//a", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    # A portal INSIDE the secondary cell chains to a third cell.
+    primary.create("portal_entrance", "//a/b",
+                   attributes={"cell_root": third_root})
+    primary.set("//a/b/leaf", 42)
+    assert third.get("//a/b/leaf") == 42
+    assert primary.get("//a/b/leaf") == 42

@@ -1,0 +1,106 @@
+"""Scheduler simulator: fairness properties of the PRODUCTION strategy
+math under synthetic workloads (ref yt/yt/tools/scheduler_simulator)."""
+
+import pytest
+
+from ytsaurus_tpu.operations.simulator import (
+    SimOperation,
+    SimPool,
+    simulate,
+)
+
+
+def _flood(pool, op_id, n_jobs=200, duration=1.0, arrival=0.0):
+    return SimOperation(id=op_id, pool=pool, arrival=arrival,
+                        n_jobs=n_jobs, job_duration=duration)
+
+
+def test_equal_weights_split_evenly():
+    result = simulate(
+        [SimPool("a"), SimPool("b")],
+        [_flood("a", "opA"), _flood("b", "opB")],
+        total_slots=8)
+    ratio = result.usage_ratio("a", "b")
+    assert 0.9 < ratio < 1.1, ratio
+    assert result.completions["opA"] == pytest.approx(
+        result.completions["opB"], rel=0.1)
+
+
+def test_weights_split_proportionally():
+    result = simulate(
+        [SimPool("heavy", weight=2.0), SimPool("light", weight=1.0)],
+        [_flood("heavy", "opH", n_jobs=400), _flood("light", "opL")],
+        total_slots=9)
+    # While both are saturated, heavy gets ~2x the slots.  Compare the
+    # usage integrals up to the lighter pool's completion.
+    t_light = result.completions["opL"]
+    heavy_until = sum(
+        min(s[1]["heavy"], 9) * (result.samples[i + 1][0] - s[0])
+        for i, s in enumerate(result.samples[:-1]) if s[0] < t_light)
+    light_until = sum(
+        min(s[1]["light"], 9) * (result.samples[i + 1][0] - s[0])
+        for i, s in enumerate(result.samples[:-1]) if s[0] < t_light)
+    assert 1.6 < heavy_until / max(light_until, 1e-9) < 2.4
+
+
+def test_min_share_guarantee_bounds_wait():
+    # A tiny guaranteed pool must start work immediately even while a
+    # big pool floods every slot.
+    result = simulate(
+        [SimPool("bulk", weight=10.0),
+         SimPool("latency", min_share_ratio=0.25)],
+        [_flood("bulk", "opBulk", n_jobs=500),
+         _flood("latency", "opLat", n_jobs=10, arrival=5.0)],
+        total_slots=8)
+    assert result.wait_times["opLat"] <= 1.0 + 1e-9
+
+
+def test_preemption_rescues_starving_pool():
+    pools = [SimPool("a"), SimPool("b")]
+    ops = [_flood("a", "opA", n_jobs=64, duration=10.0),
+           _flood("b", "opB", n_jobs=8, duration=1.0, arrival=2.0)]
+    with_preemption = simulate(pools, ops, total_slots=8,
+                               preemption=True)
+    without = simulate(pools, ops, total_slots=8, preemption=False)
+    # Without preemption, b waits for a 10s job to drain; with it, b
+    # starts promptly at its fair share.
+    assert with_preemption.wait_times["opB"] < without.wait_times["opB"]
+    assert with_preemption.preemptions > 0
+    # Preempted work is requeued, never lost: everything completes.
+    assert set(with_preemption.completions) == {"opA", "opB"}
+
+
+def test_makespan_matches_total_work():
+    # One pool, no contention: makespan == total work / slots.
+    result = simulate([SimPool("only")],
+                      [_flood("only", "op", n_jobs=40, duration=2.0)],
+                      total_slots=8)
+    assert result.makespan == pytest.approx(40 * 2.0 / 8, rel=1e-6)
+    assert result.pool_usage_integral["only"] == pytest.approx(
+        40 * 2.0, rel=1e-6)
+
+
+def test_fifo_within_pool():
+    result = simulate(
+        [SimPool("p")],
+        [SimOperation("first", "p", 0.0, 8, 1.0),
+         SimOperation("second", "p", 0.0, 8, 1.0)],
+        total_slots=4)
+    assert result.wait_times["first"] <= result.wait_times["second"]
+    assert result.completions["first"] <= result.completions["second"]
+
+
+def test_max_running_jobs_cap():
+    result = simulate(
+        [SimPool("capped", max_running_jobs=2), SimPool("free")],
+        [_flood("capped", "opC", n_jobs=20),
+         _flood("free", "opF", n_jobs=20)],
+        total_slots=8)
+    for _, by_pool in result.samples:
+        assert by_pool["capped"] <= 2
+    assert set(result.completions) == {"opC", "opF"}
+
+
+def test_unknown_pool_rejected():
+    with pytest.raises(ValueError):
+        simulate([SimPool("a")], [_flood("nope", "op")], total_slots=2)

@@ -158,6 +158,28 @@ class YtClient:
     def create(self, node_type: str, path: str,
                attributes: Optional[dict] = None, recursive: bool = False,
                ignore_existing: bool = False, tx: Optional[str] = None) -> str:
+        from ytsaurus_tpu.cypress import multicell
+        if node_type == multicell.PORTAL_TYPE:
+            multicell.reject_tx(tx)
+            delegate = multicell.route(self, path)
+            if delegate is not None:
+                # An entrance beneath another portal belongs to THAT
+                # cell (chained portals).
+                return delegate.create(node_type, path,
+                                       attributes=attributes,
+                                       recursive=recursive,
+                                       ignore_existing=ignore_existing)
+            parent = path.rsplit("/", 1)[0] or "/"
+            self.cluster.security.validate_permission("write", parent)
+            return multicell.create_portal(self, path, attributes or {},
+                                           recursive=recursive,
+                                           ignore_existing=ignore_existing)
+        delegate = multicell.route(self, path)
+        if delegate is not None:
+            multicell.reject_tx(tx)
+            return delegate.create(node_type, path, attributes=attributes,
+                                   recursive=recursive,
+                                   ignore_existing=ignore_existing)
         parent = path.rsplit("/", 1)[0] or "/"
         self.cluster.security.validate_permission("write", parent)
         attributes = dict(attributes or {})
@@ -201,6 +223,12 @@ class YtClient:
         return count
 
     def get(self, path: str, tx: Optional[str] = None) -> Any:
+        from ytsaurus_tpu.cypress import multicell
+        # Reading the entrance path resolves to the exit (like list).
+        delegate = multicell.route(self, path, include_self=True)
+        if delegate is not None:
+            multicell.reject_tx(tx)
+            return delegate.get(path)
         self.cluster.security.validate_permission("read", path)
         if tx is not None:
             # Snapshot-locked reads see the pinned copy.
@@ -210,14 +238,28 @@ class YtClient:
         return self.cluster.master.tree.get(path)
 
     def set(self, path: str, value: Any, tx: Optional[str] = None) -> None:
+        from ytsaurus_tpu.cypress import multicell
+        delegate = multicell.route(self, path)
+        if delegate is not None:
+            multicell.reject_tx(tx)
+            return delegate.set(path, value)
         self.cluster.security.validate_permission("write", path)
         self.cluster.master.commit_mutation("set", path=path, value=value,
                                             tx=tx)
 
     def exists(self, path: str) -> bool:
+        from ytsaurus_tpu.cypress import multicell
+        delegate = multicell.route(self, path)
+        if delegate is not None:
+            return delegate.exists(path)
         return self.cluster.master.tree.exists(path)
 
     def list(self, path: str) -> list[str]:
+        from ytsaurus_tpu.cypress import multicell
+        # Listing the entrance itself shows the EXIT's children.
+        delegate = multicell.route(self, path, include_self=True)
+        if delegate is not None:
+            return delegate.list(path)
         self.cluster.security.validate_permission("read", path)
         return self.cluster.master.tree.list(path)
 
@@ -313,8 +355,24 @@ class YtClient:
 
     def remove(self, path: str, recursive: bool = True,
                force: bool = False, tx: Optional[str] = None) -> None:
+        from ytsaurus_tpu.cypress import multicell
+        delegate = multicell.route(self, path)
+        if delegate is not None:
+            multicell.reject_tx(tx)
+            return delegate.remove(path, recursive=recursive, force=force)
         self.cluster.security.validate_permission("remove", path)
         node = self.cluster.master.tree.try_resolve(path)
+        if node is not None and node.type == multicell.PORTAL_TYPE \
+                and "/@" not in path:
+            # Entrance removal dismantles the exit subtree on its cell
+            # (exactly-once via Hive).
+            return multicell.remove_portal(self, path,
+                                           dict(node.attributes))
+        if node is not None and "/@" not in path:
+            # Entrances INSIDE the removed subtree must dismantle their
+            # exits too, or the secondary cell leaks the subtree (and a
+            # recreated portal would resurrect stale data under it).
+            multicell.cleanup_portals_under(self, path, node)
         # One subtree walk: tally metered usage + find mounted tables.
         freed_nodes, freed_disk, freed_chunks = 0, 0, 0
         mounted: list[str] = []
@@ -435,6 +493,11 @@ class YtClient:
                     append: bool = False,
                     schema: "TableSchema | dict | None" = None,
                     format: Optional[str] = None) -> None:
+        from ytsaurus_tpu.cypress import multicell
+        delegate = multicell.route(self, path)
+        if delegate is not None:
+            return delegate.write_table(path, rows, append=append,
+                                        schema=schema, format=format)
         self.cluster.security.validate_permission("write", path)
         if format == "arrow":
             from ytsaurus_tpu.arrow import (
@@ -507,6 +570,10 @@ class YtClient:
         """Rows as dicts, or serialized bytes when `format` is given
         (yson/json/dsv/schemaful_dsv/skiff/arrow — ref client/formats,
         client/arrow)."""
+        from ytsaurus_tpu.cypress import multicell
+        delegate = multicell.route(self, path)
+        if delegate is not None:
+            return delegate.read_table(path, format=format)
         self.cluster.security.validate_permission("read", path)
         chunks = self._read_table_chunks(path)
         if format == "arrow":

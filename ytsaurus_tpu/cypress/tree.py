@@ -17,7 +17,7 @@ from ytsaurus_tpu.errors import EErrorCode, YtError
 
 NODE_TYPES = {
     "map_node", "table", "file", "document", "string_node", "int64_node",
-    "list_node", "link",
+    "list_node", "link", "portal_entrance",
 }
 
 

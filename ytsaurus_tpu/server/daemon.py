@@ -356,6 +356,16 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         liveness_provider=client.referenced_chunk_ids)
     replicator.start()
     orchid.register("/chunk_replicator", lambda: dict(replicator.stats))
+    # Generalized service discovery (ref server/discovery_server): any
+    # process can publish into named groups; NodeTracker stays the
+    # data-node special case.
+    from ytsaurus_tpu.server.discovery import (
+        DiscoveryService,
+        DiscoveryTracker,
+    )
+    discovery = DiscoveryTracker()
+    server.add_service(DiscoveryService(discovery))
+    orchid.register("/discovery", discovery.list_groups)
     if kafka:
         # Kafka wire protocol over queues (ref server/kafka_proxy):
         # in-process with the primary, like the query tracker / queue
@@ -443,7 +453,9 @@ def run_proxy(root: str, port: int, primary_address: str) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--role", choices=("primary", "node", "proxy"),
+    parser.add_argument("--role",
+                        choices=("primary", "node", "proxy",
+                                 "master_cache"),
                         required=True)
     parser.add_argument("--root", required=True)
     parser.add_argument("--port", type=int, default=0)
@@ -485,6 +497,11 @@ def main() -> None:
         if not args.primary:
             parser.error("--primary is required for --role proxy")
         run_proxy(args.root, args.port, args.primary)
+    elif args.role == "master_cache":
+        if not args.primary:
+            parser.error("--primary is required for --role master_cache")
+        from ytsaurus_tpu.server.master_cache import run_master_cache
+        run_master_cache(args.root, args.port, args.primary)
     else:
         if not args.primary:
             parser.error("--primary is required for --role node")
