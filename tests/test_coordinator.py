@@ -200,6 +200,98 @@ def test_distributed_mixed_aggregate_order_stable():
                                                        key=key)
 
 
+def test_order_by_key_prefix_early_exit():
+    """ORDER BY over the shard-range key + LIMIT walks shards from the
+    matching end and stops (ref ordered scans w/ scanOrder,
+    engine_api/coordinator.h:81-90)."""
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    from ytsaurus_tpu.schema import TableSchema
+
+    schema = TableSchema.make([("k", "int64", "ascending"),
+                               ("v", "int64")])
+    shards = [ColumnarChunk.from_rows(
+        schema, [(i * 100 + j, j) for j in range(10)]) for i in range(6)]
+
+    # ASC: only the first shard needed.
+    plan = build_query("k FROM [//t] ORDER BY k LIMIT 5", {"//t": schema})
+    stats = QueryStatistics()
+    out = coordinate_and_execute(plan, shards, range_ordered_by=["k"],
+                                 stats=stats)
+    assert [r["k"] for r in out.to_rows()] == [0, 1, 2, 3, 4]
+    assert stats.shards_skipped == 5
+
+    # DESC: scan from the tail.
+    plan = build_query("k FROM [//t] ORDER BY k DESC LIMIT 3",
+                       {"//t": schema})
+    stats = QueryStatistics()
+    out = coordinate_and_execute(plan, shards, range_ordered_by=["k"],
+                                 stats=stats)
+    assert [r["k"] for r in out.to_rows()] == [509, 508, 507]
+    assert stats.shards_skipped == 5
+
+    # WHERE keeps scanning until enough rows PASS the filter.
+    plan = build_query("k FROM [//t] WHERE v >= 8 ORDER BY k LIMIT 4",
+                       {"//t": schema})
+    stats = QueryStatistics()
+    out = coordinate_and_execute(plan, shards, range_ordered_by=["k"],
+                                 stats=stats)
+    assert [r["k"] for r in out.to_rows()] == [8, 9, 108, 109]
+    assert stats.shards_skipped == 4
+
+    # OFFSET counts toward the scan budget.
+    plan = build_query("k FROM [//t] ORDER BY k OFFSET 12 LIMIT 3",
+                       {"//t": schema})
+    out = coordinate_and_execute(plan, shards, range_ordered_by=["k"])
+    assert [r["k"] for r in out.to_rows()] == [102, 103, 104]
+
+    # Non-key ORDER BY must NOT early-exit.
+    plan = build_query("k FROM [//t] ORDER BY v LIMIT 3", {"//t": schema})
+    stats = QueryStatistics()
+    coordinate_and_execute(plan, shards, range_ordered_by=["k"],
+                           stats=stats)
+    assert stats.shards_skipped == 0
+
+    # Mixed directions must NOT early-exit.
+    plan = build_query("k FROM [//t] ORDER BY k, v DESC LIMIT 3",
+                       {"//t": schema})
+    stats = QueryStatistics()
+    coordinate_and_execute(plan, shards, range_ordered_by=["k", "v"],
+                           stats=stats)
+    assert stats.shards_skipped == 0
+
+    # No range info: behave exactly as before.
+    plan = build_query("k FROM [//t] ORDER BY k LIMIT 5", {"//t": schema})
+    stats = QueryStatistics()
+    out = coordinate_and_execute(plan, shards, stats=stats)
+    assert [r["k"] for r in out.to_rows()] == [0, 1, 2, 3, 4]
+    assert stats.shards_skipped == 0
+
+
+def test_order_by_early_exit_via_dynamic_table(tmp_path):
+    """End-to-end: a resharded sorted dynamic table serves ORDER BY key
+    LIMIT scanning only the needed tablets."""
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.schema import TableSchema
+
+    cl = connect(str(tmp_path / "c"))
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("v", "int64")], unique_keys=True)
+    cl.create("table", "//o/t", recursive=True,
+              attributes={"schema": schema, "dynamic": True})
+    cl.reshard_table("//o/t", pivot_keys=[(100,), (200,), (300,)])
+    cl.mount_table("//o/t")
+    cl.insert_rows("//o/t", [{"k": k, "v": k * 2} for k in range(0, 400)])
+    rows = cl.select_rows("k, v FROM [//o/t] ORDER BY k DESC LIMIT 3")
+    assert [r["k"] for r in rows] == [399, 398, 397]
+    assert cl.last_query_statistics.shards_skipped >= 1
+    rows = cl.select_rows("k FROM [//o/t] ORDER BY k LIMIT 2")
+    assert [r["k"] for r in rows] == [0, 1]
+    assert cl.last_query_statistics.shards_skipped >= 1
+
+
 def test_limit_early_exit_skips_shards():
     """A bare LIMIT (no ORDER BY/GROUP BY) stops launching shard programs
     once enough rows are collected (ref pull-model limit stop)."""

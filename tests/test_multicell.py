@@ -134,6 +134,88 @@ def test_portal_requires_cell_root(cells):
                        attributes={})
 
 
+def test_chained_portal_cleanup_reaches_third_cell(cells, tmp_path):
+    """Dismantling a portal whose EXIT contains another portal must
+    dismantle the third cell's exit too — otherwise recreating the
+    chain resurrects stale third-cell data."""
+    primary, secondary, secondary_root = cells
+    third_root = str(tmp_path / "third")
+    third = connect(third_root)
+    primary.create("portal_entrance", "//a", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    primary.create("portal_entrance", "//a/b",
+                   attributes={"cell_root": third_root})
+    primary.set("//a/b/leaf", 42)
+    assert third.get("//a/b/leaf") == 42
+    primary.remove("//a")
+    assert not third.exists("//a/b"), "third-cell exit leaked"
+    # Recreate the chain: no resurrection.
+    primary.create("portal_entrance", "//a", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    primary.create("portal_entrance", "//a/b",
+                   attributes={"cell_root": third_root})
+    assert not primary.exists("//a/b/leaf")
+
+
+def test_portal_acl_checked_at_entrance(cells):
+    """Primary principals work through portals: the primary validates
+    its ACLs at the entrance, the cell executes under cell trust (the
+    secondary has no copy of the primary's user registry)."""
+    from ytsaurus_tpu.cypress.security import authenticated_user
+
+    primary, secondary, secondary_root = cells
+    primary.cluster.security.create_user("alice")
+    primary.create("portal_entrance", "//acl", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    primary.set("//acl/@acl", [{"action": "allow", "subjects": ["alice"],
+                                "permissions": ["read", "write"]}])
+    with authenticated_user("alice"):
+        primary.set("//acl/doc", 5)
+        assert primary.get("//acl/doc") == 5
+    # Deny alice at the entrance: routed writes refuse on the PRIMARY.
+    primary.set("//acl/@acl", [{"action": "deny", "subjects": ["alice"],
+                                "permissions": ["write"]}])
+    from ytsaurus_tpu.errors import YtError as _E
+    with authenticated_user("alice"):
+        with pytest.raises(_E):
+            primary.set("//acl/doc", 6)
+    assert primary.get("//acl/doc") == 5
+
+
+def test_nonroutable_verbs_fail_loudly(cells):
+    primary, _, secondary_root = cells
+    primary.create("portal_entrance", "//nr", recursive=True,
+                   attributes={"cell_root": secondary_root})
+    primary.create("map_node", "//plain", recursive=True)
+    for call in (
+            lambda: primary.mount_table("//nr/t"),
+            lambda: primary.copy("//plain", "//nr/shadow"),
+            lambda: primary.copy("//nr/x", "//plain/y"),
+            lambda: primary.move("//plain", "//nr/m"),
+            lambda: primary.link("//plain", "//nr/l")):
+        with pytest.raises(YtError) as err:
+            call()
+        assert "portal" in str(err.value)
+
+
+def test_failed_ancestor_remove_keeps_exit_intact(cells):
+    """A REFUSED primary remove must not have destroyed exit data (the
+    dismantle happens only after the primary mutation commits)."""
+    primary, secondary, secondary_root = cells
+    primary.create("map_node", "//guard", recursive=True)
+    primary.create("portal_entrance", "//guard/p",
+                   attributes={"cell_root": secondary_root})
+    primary.set("//guard/p/keep", 1)
+    # A transactional remove of a portal-bearing subtree is refused...
+    tx = primary.start_tx()
+    with pytest.raises(YtError):
+        primary.remove("//guard", tx=tx)
+    primary.abort_tx(tx)
+    # ...and the exit data survives the refusal.
+    assert secondary.get("//guard/p/keep") == 1
+    assert primary.get("//guard/p/keep") == 1
+
+
 def test_chained_portals(cells, tmp_path):
     primary, secondary, secondary_root = cells
     third_root = str(tmp_path / "third")

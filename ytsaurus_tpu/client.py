@@ -98,6 +98,11 @@ def _normalize_per_tablet(ids) -> "list[list[str]]":
     return [list(sub) for sub in ids]
 
 
+def _mc():
+    from ytsaurus_tpu.cypress import multicell
+    return multicell
+
+
 def _hedged_race(attempts: "list[Callable]", delay: float,
                  base_error: YtError):
     """rpc.channel.hedged_race with the replica-fallback error shape:
@@ -161,25 +166,28 @@ class YtClient:
         from ytsaurus_tpu.cypress import multicell
         if node_type == multicell.PORTAL_TYPE:
             multicell.reject_tx(tx)
-            delegate = multicell.route(self, path)
+            delegate = multicell.delegate_for(self, path, "write")
             if delegate is not None:
                 # An entrance beneath another portal belongs to THAT
                 # cell (chained portals).
-                return delegate.create(node_type, path,
-                                       attributes=attributes,
-                                       recursive=recursive,
-                                       ignore_existing=ignore_existing)
+                with multicell.as_cell_principal():
+                    return delegate.create(
+                        node_type, path, attributes=attributes,
+                        recursive=recursive,
+                        ignore_existing=ignore_existing)
             parent = path.rsplit("/", 1)[0] or "/"
             self.cluster.security.validate_permission("write", parent)
             return multicell.create_portal(self, path, attributes or {},
                                            recursive=recursive,
                                            ignore_existing=ignore_existing)
-        delegate = multicell.route(self, path)
+        delegate = multicell.delegate_for(self, path, "write")
         if delegate is not None:
             multicell.reject_tx(tx)
-            return delegate.create(node_type, path, attributes=attributes,
-                                   recursive=recursive,
-                                   ignore_existing=ignore_existing)
+            with multicell.as_cell_principal():
+                return delegate.create(node_type, path,
+                                       attributes=attributes,
+                                       recursive=recursive,
+                                       ignore_existing=ignore_existing)
         parent = path.rsplit("/", 1)[0] or "/"
         self.cluster.security.validate_permission("write", parent)
         attributes = dict(attributes or {})
@@ -225,10 +233,12 @@ class YtClient:
     def get(self, path: str, tx: Optional[str] = None) -> Any:
         from ytsaurus_tpu.cypress import multicell
         # Reading the entrance path resolves to the exit (like list).
-        delegate = multicell.route(self, path, include_self=True)
+        delegate = multicell.delegate_for(self, path, "read",
+                                          include_self=True)
         if delegate is not None:
             multicell.reject_tx(tx)
-            return delegate.get(path)
+            with multicell.as_cell_principal():
+                return delegate.get(path)
         self.cluster.security.validate_permission("read", path)
         if tx is not None:
             # Snapshot-locked reads see the pinned copy.
@@ -239,27 +249,31 @@ class YtClient:
 
     def set(self, path: str, value: Any, tx: Optional[str] = None) -> None:
         from ytsaurus_tpu.cypress import multicell
-        delegate = multicell.route(self, path)
+        delegate = multicell.delegate_for(self, path, "write")
         if delegate is not None:
             multicell.reject_tx(tx)
-            return delegate.set(path, value)
+            with multicell.as_cell_principal():
+                return delegate.set(path, value)
         self.cluster.security.validate_permission("write", path)
         self.cluster.master.commit_mutation("set", path=path, value=value,
                                             tx=tx)
 
     def exists(self, path: str) -> bool:
         from ytsaurus_tpu.cypress import multicell
-        delegate = multicell.route(self, path)
+        delegate = multicell.delegate_for(self, path, None)
         if delegate is not None:
-            return delegate.exists(path)
+            with multicell.as_cell_principal():
+                return delegate.exists(path)
         return self.cluster.master.tree.exists(path)
 
     def list(self, path: str) -> list[str]:
         from ytsaurus_tpu.cypress import multicell
         # Listing the entrance itself shows the EXIT's children.
-        delegate = multicell.route(self, path, include_self=True)
+        delegate = multicell.delegate_for(self, path, "read",
+                                          include_self=True)
         if delegate is not None:
-            return delegate.list(path)
+            with multicell.as_cell_principal():
+                return delegate.list(path)
         self.cluster.security.validate_permission("read", path)
         return self.cluster.master.tree.list(path)
 
@@ -279,6 +293,7 @@ class YtClient:
 
     def lock(self, path: str, mode: str = "exclusive",
              tx: Optional[str] = None) -> None:
+        _mc().reject_under_portal(self, path, "lock")
         if tx is None:
             raise YtError("lock requires a transaction")
         self.cluster.master.commit_mutation("lock", tx_id=tx, path=path,
@@ -300,6 +315,8 @@ class YtClient:
         counts both copies); dynamic-table chunks are physically duplicated
         because compaction/reshard delete the source's chunk files.
         Mounted dynamic tables must unmount first."""
+        _mc().reject_under_portal(self, src_path, "copy")
+        _mc().reject_under_portal(self, dst_path, "copy")
         src_node = self.cluster.master.tree.try_resolve(src_path)
         if src_node is not None:
             stack = [src_node]
@@ -341,6 +358,8 @@ class YtClient:
 
     def move(self, src_path: str, dst_path: str,
              recursive: bool = False) -> str:
+        _mc().reject_under_portal(self, src_path, "move")
+        _mc().reject_under_portal(self, dst_path, "move")
         node = self.cluster.master.tree.try_resolve(src_path)
         if node is not None and node.id in self.cluster.tablets:
             raise YtError(f"Unmount {src_path!r} before moving it",
@@ -350,29 +369,41 @@ class YtClient:
 
     def link(self, target_path: str, link_path: str,
              recursive: bool = False) -> str:
+        _mc().reject_under_portal(self, target_path, "link")
+        _mc().reject_under_portal(self, link_path, "link")
         return self.cluster.master.commit_mutation(
             "link", target=target_path, link=link_path, recursive=recursive)
 
     def remove(self, path: str, recursive: bool = True,
                force: bool = False, tx: Optional[str] = None) -> None:
         from ytsaurus_tpu.cypress import multicell
-        delegate = multicell.route(self, path)
+        delegate = multicell.delegate_for(self, path, "remove")
         if delegate is not None:
             multicell.reject_tx(tx)
-            return delegate.remove(path, recursive=recursive, force=force)
+            with multicell.as_cell_principal():
+                return delegate.remove(path, recursive=recursive,
+                                       force=force)
         self.cluster.security.validate_permission("remove", path)
         node = self.cluster.master.tree.try_resolve(path)
         if node is not None and node.type == multicell.PORTAL_TYPE \
                 and "/@" not in path:
             # Entrance removal dismantles the exit subtree on its cell
-            # (exactly-once via Hive).
+            # (exactly-once via Hive, AFTER the primary removal commits).
             return multicell.remove_portal(self, path,
-                                           dict(node.attributes))
+                                           dict(node.attributes),
+                                           recursive=recursive, tx=tx)
+        nested_portals = []
         if node is not None and "/@" not in path:
             # Entrances INSIDE the removed subtree must dismantle their
             # exits too, or the secondary cell leaks the subtree (and a
             # recreated portal would resurrect stale data under it).
-            multicell.cleanup_portals_under(self, path, node)
+            # Collected now, dismantled only after the primary removal
+            # COMMITS — a refused/failed remove must not destroy exit
+            # data — which also means such a removal cannot ride a
+            # rollback-able transaction.
+            nested_portals = multicell.portals_under(path, node)
+            if nested_portals:
+                multicell.reject_tx(tx)
         # One subtree walk: tally metered usage + find mounted tables.
         freed_nodes, freed_disk, freed_chunks = 0, 0, 0
         mounted: list[str] = []
@@ -401,6 +432,8 @@ class YtClient:
         # restores the nodes, and usage must still cover them.
         self.cluster.master.commit_mutation(
             "remove", path=path, recursive=recursive, force=force, tx=tx)
+        for entrance_path, cell_root in nested_portals:
+            multicell._dismantle_exit(self, cell_root, entrance_path)
         for node_id in mounted:
             for tablet in self.cluster.tablets.pop(node_id, ()):
                 tablet.set_in_memory(False)
@@ -494,10 +527,11 @@ class YtClient:
                     schema: "TableSchema | dict | None" = None,
                     format: Optional[str] = None) -> None:
         from ytsaurus_tpu.cypress import multicell
-        delegate = multicell.route(self, path)
+        delegate = multicell.delegate_for(self, path, "write")
         if delegate is not None:
-            return delegate.write_table(path, rows, append=append,
-                                        schema=schema, format=format)
+            with multicell.as_cell_principal():
+                return delegate.write_table(path, rows, append=append,
+                                            schema=schema, format=format)
         self.cluster.security.validate_permission("write", path)
         if format == "arrow":
             from ytsaurus_tpu.arrow import (
@@ -571,9 +605,10 @@ class YtClient:
         (yson/json/dsv/schemaful_dsv/skiff/arrow — ref client/formats,
         client/arrow)."""
         from ytsaurus_tpu.cypress import multicell
-        delegate = multicell.route(self, path)
+        delegate = multicell.delegate_for(self, path, "read")
         if delegate is not None:
-            return delegate.read_table(path, format=format)
+            with multicell.as_cell_principal():
+                return delegate.read_table(path, format=format)
         self.cluster.security.validate_permission("read", path)
         chunks = self._read_table_chunks(path)
         if format == "arrow":
@@ -606,6 +641,7 @@ class YtClient:
     # ------------------------------------------------------------ dynamic tables
 
     def mount_table(self, path: str) -> None:
+        _mc().reject_under_portal(self, path, "mount_table")
         self.cluster.security.validate_permission("mount", path)
         node = self._table_node(path)
         schema = self._node_schema(node)
@@ -654,6 +690,7 @@ class YtClient:
         self.set(path + "/@tablet_state", "mounted")
 
     def unmount_table(self, path: str) -> None:
+        _mc().reject_under_portal(self, path, "unmount_table")
         node = self._table_node(path)
         tablets = self.cluster.tablets.pop(node.id, None)
         if tablets is None:
@@ -680,6 +717,7 @@ class YtClient:
         self.set(path + "/@tablet_state", "unmounted")
 
     def reshard_table(self, path: str, pivot_keys: Sequence[tuple]) -> None:
+        _mc().reject_under_portal(self, path, "reshard_table")
         """Re-shard an (unmounted) sorted dynamic table into len(pivots)+1
         tablets; existing data redistributes to the new ranges.
 
@@ -1122,12 +1160,24 @@ class YtClient:
                 "read", join.foreign_table)
         from ytsaurus_tpu.query.pruning import extract_column_intervals
         intervals = extract_column_intervals(plan.where)
+        range_ordered_by = None
         source_chunks = self._indexed_source_chunks(plan, intervals,
                                                     timestamp)
         if source_chunks is None:
             source_chunks = self._query_shards(plan.source, timestamp,
                                                intervals=intervals,
                                                stats=stats)
+            # Tablet shards of a sorted dynamic table arrive in pivot
+            # order: range-ordered by the key columns, which unlocks the
+            # ORDER BY <key prefix> LIMIT early exit.
+            try:
+                node = self._table_node(plan.source)
+                if node.attributes.get("dynamic"):
+                    schema = self._node_schema(node)
+                    if schema is not None and schema.key_column_names:
+                        range_ordered_by = list(schema.key_column_names)
+            except YtError:
+                pass
         foreign = {}
         for join in plan.joins:
             shards = self._query_shards(join.foreign_table, timestamp)
@@ -1136,6 +1186,7 @@ class YtClient:
         out = coordinate_and_execute(plan, source_chunks, foreign,
                                      evaluator=self.cluster.evaluator,
                                      merge_shards_below=4_000_000,
+                                     range_ordered_by=range_ordered_by,
                                      stats=stats)
         log_event(get_logger("Query"), _logging.INFO, "select_rows",
                   query=query[:200], **stats.to_dict())

@@ -78,6 +78,44 @@ def route(client, path: str, include_self: bool = False):
     return cell_client(client, cell_root)
 
 
+def delegate_for(client, path: str, permission: "Optional[str]",
+                 include_self: bool = False):
+    """Routed-verb front door: resolves the owning cell AND enforces the
+    PRIMARY's ACLs at the portal entrance.  The cell executes the call
+    under the cell-trust principal (root) — cross-cell requests carry
+    the primary's authorization decision, not a per-cell user registry
+    (primary principals do not exist in the secondary's //sys/users).
+    Wrap the delegated call in as_cell_principal()."""
+    hit = portal_prefix(client, path, include_self=include_self)
+    if hit is None:
+        return None
+    entrance, attrs = hit
+    if permission is not None:
+        client.cluster.security.validate_permission(permission, entrance)
+    cell_root = attrs.get("cell_root")
+    if not cell_root:
+        raise YtError("portal entrance has no @cell_root",
+                      code=EErrorCode.ResolveError)
+    return cell_client(client, cell_root)
+
+
+def as_cell_principal():
+    """Context for delegated calls: the cell trusts the primary's ACL
+    check at the entrance."""
+    from ytsaurus_tpu.cypress.security import ROOT_USER, authenticated_user
+    return authenticated_user(ROOT_USER)
+
+
+def reject_under_portal(client, path: str, what: str) -> None:
+    """Loud failure for verbs that do not route across portals yet
+    (copy/move/link/lock, dynamic-table verbs): acting on the primary
+    tree would either miss or SHADOW the secondary's nodes."""
+    if portal_prefix(client, path) is not None:
+        raise YtError(f"{what} across a portal is not supported yet "
+                      f"({path!r} lives on a secondary cell)",
+                      code=EErrorCode.QueryUnsupported)
+
+
 def reject_tx(tx) -> None:
     if tx is not None:
         raise YtError("cross-cell transactions are not supported",
@@ -105,38 +143,51 @@ def create_portal(client, path: str, attributes: dict,
     return node_id
 
 
-def cleanup_portals_under(client, path: str, node) -> None:
-    """Dismantle the exits of every portal entrance inside the subtree
-    rooted at `node` (called before an ancestor remove commits, so the
-    Hive posts are durable first)."""
+def portals_under(path: str, node) -> "list[tuple[str, str]]":
+    """(entrance path, cell_root) for every portal entrance inside the
+    subtree rooted at `node` (including `node` itself)."""
+    out: list = []
     stack = [(path, node)]
     while stack:
         prefix, current = stack.pop()
         if current.type == PORTAL_TYPE:
             cell_root = (current.attributes or {}).get("cell_root")
             if cell_root:
-                src = hive_of(client)
-                dst = hive_of(cell_client(client, cell_root))
-                _ensure_cleanup_handler(dst)
-                src.post(dst.cell_id, EXIT_CLEANUP, {"path": prefix})
-                src.flush(dst)
+                out.append((prefix, cell_root))
             continue                # nothing routable lives beneath it
         for name, child in current.children.items():
             stack.append((f"{prefix}/{name}", child))
+    return out
 
 
-def remove_portal(client, path: str, entrance_attrs: dict) -> None:
+def remove_portal(client, path: str, entrance_attrs: dict,
+                  recursive: bool = True, tx=None) -> None:
     """Remove the entrance, then dismantle the exit subtree on the
-    secondary via Hive (exactly-once; survives a primary crash between
-    the two steps because the outbox post is durable BEFORE the
-    entrance removal commits its ack to the caller)."""
+    secondary via Hive.  Order matters: the PRIMARY removal commits
+    first, so a failed/refused primary remove never destroys exit data;
+    a crash between the two steps leaks the exit until the next cleanup
+    (bounded, and strictly safer than the converse).  Cross-cell
+    removal cannot ride a primary transaction — a rollback could not
+    restore the exit — so tx is rejected."""
+    reject_tx(tx)
     cell_root = entrance_attrs.get("cell_root")
+    exit_client = cell_client(client, cell_root)
+    if not recursive and exit_client.exists(path) and \
+            exit_client.list(path):
+        raise YtError(f"Cannot remove non-empty portal {path!r} without "
+                      "recursive=True", code=EErrorCode.Generic)
+    client.cluster.master.commit_mutation("remove", path=path,
+                                          recursive=True)
+    _dismantle_exit(client, cell_root, path)
+
+
+def _dismantle_exit(client, cell_root: str, path: str) -> None:
+    """Exactly-once exit removal through Hive (durable outbox intent,
+    idempotent receiver)."""
     src = hive_of(client)
     dst = hive_of(cell_client(client, cell_root))
     _ensure_cleanup_handler(dst)
     src.post(dst.cell_id, EXIT_CLEANUP, {"path": path})
-    client.cluster.master.commit_mutation("remove", path=path,
-                                          recursive=True)
     src.flush(dst)
 
 
@@ -164,8 +215,14 @@ def _ensure_cleanup_handler(manager) -> None:
 
     def handle(payload: dict):
         path = payload["path"]
-        if not manager.client.exists(path):
+        node = manager.client.cluster.master.tree.try_resolve(path)
+        if node is None:
             return []               # already gone: idempotent
+        # Portals CHAINED inside this exit must dismantle their own
+        # (third-cell) exits too, or a recreated chain resurrects stale
+        # data there.
+        for nested_path, nested_root in portals_under(path, node):
+            _dismantle_exit(manager.client, nested_root, nested_path)
         return [("remove", {"path": path, "recursive": True})]
 
     manager.register_handler(EXIT_CLEANUP, handle)

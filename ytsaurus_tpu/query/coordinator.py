@@ -203,12 +203,34 @@ def _default_project(plan: ir.Query, subst: _AvgSubstituter
     return ir.ProjectClause(items=tuple(items))
 
 
+def _ordered_scan_direction(plan: ir.Query,
+                            range_ordered_by) -> Optional[str]:
+    """'asc'/'desc' when ORDER BY + LIMIT can stop scanning range-ordered
+    shards early: every order item is a bare reference, the referenced
+    names form a prefix of the shard-range key, and the direction is
+    uniform.  None otherwise."""
+    if not range_ordered_by or plan.order is None or \
+            plan.limit is None or plan.group is not None:
+        return None
+    items = plan.order.items
+    if not items or not all(isinstance(it.expr, ir.TReference)
+                            for it in items):
+        return None
+    if len({it.descending for it in items}) != 1:
+        return None
+    names = [it.expr.name for it in items]
+    if names != list(range_ordered_by)[: len(names)]:
+        return None
+    return "desc" if items[0].descending else "asc"
+
+
 def coordinate_and_execute(
         plan: ir.Query,
         chunks: Sequence[ColumnarChunk],
         foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None,
         evaluator: Optional[Evaluator] = None,
         merge_shards_below: int = 0,
+        range_ordered_by: Optional[Sequence[str]] = None,
         stats=None) -> ColumnarChunk:
     """Host-coordinated fan-out: run the bottom query per shard (tablet),
     concatenate partial results, run the front merge.
@@ -220,13 +242,45 @@ def coordinate_and_execute(
     program runs over fewer than this many rows — per-program dispatch
     overhead dominates small shards (ref analog: chunk slice grouping in
     chunk pools).  0 preserves one program per shard.
+
+    `range_ordered_by`: key column names by which the SHARDS are range-
+    ordered (tablet pivot order for sorted dynamic tables).  Lets ORDER
+    BY <key prefix> LIMIT scan shards from the matching end and stop
+    once offset+limit rows passed the filter — the reference's ordered
+    scan with scanOrder (engine_api/coordinator.h:81-90).
     """
     evaluator = evaluator or Evaluator()
     if not chunks:
         raise YtError("coordinate_and_execute: no input shards",
                       code=EErrorCode.QueryExecutionError)
+    # Early-exit budget, decided BEFORE any shard coalescing: when a
+    # LIMIT scan can stop after the first shard or two, merging every
+    # shard into one big program would do strictly more work than the
+    # exit saves.
+    needed = None
+    scan_direction = None
+    if plan.limit is not None and plan.group is None:
+        if plan.order is None:
+            needed = plan.offset + plan.limit
+        else:
+            scan_direction = _ordered_scan_direction(plan,
+                                                     range_ordered_by)
+            if scan_direction is not None:
+                needed = plan.offset + plan.limit
     if merge_shards_below > 0 and len(chunks) > 1:
-        chunks = _coalesce_shards(chunks, merge_shards_below)
+        if scan_direction is None:
+            # Bare LIMIT (or no early exit): full coalescing — a
+            # selective WHERE may scan everything, so dispatch overhead
+            # dominates and the early exit still skips whole groups.
+            chunks = _coalesce_shards(chunks, merge_shards_below)
+        else:
+            # Ordered exit: the scan is expected to stop after ~needed
+            # rows, so a group only needs to hold the scan budget —
+            # merging further would drag unwanted rows into the first
+            # program and forfeit the skip.  (A selective WHERE on an
+            # ordered scan pays per-shard dispatch; that is the price
+            # of being able to stop at all.)
+            chunks = _coalesce_shards(chunks, max(needed, 1))
     if stats is not None:
         stats.shards_total += len(chunks)
         stats.rows_read += sum(c.row_count for c in chunks)
@@ -241,20 +295,24 @@ def coordinate_and_execute(
         # the query — stop launching shard programs once the partials
         # hold enough.  The per-shard row-count read is the bounded-batch
         # "device predicate feedback" loop from SURVEY §7.
-        needed = None
-        if plan.limit is not None and plan.order is None \
-                and plan.group is None:
-            needed = plan.offset + plan.limit
+        # Ordered scan: shards range-ordered by the ORDER BY prefix are
+        # walked from the matching end; once offset+limit rows passed
+        # the filter, no unscanned shard can hold a better-ranked row
+        # (its whole key range sorts after).  Ties at the boundary pick
+        # among equal keys, which ORDER BY leaves unspecified anyway.
+        scan_chunks = list(chunks)
+        if scan_direction == "desc":
+            scan_chunks.reverse()
         partials = []
         collected = 0
-        for i, chunk in enumerate(chunks):
+        for i, chunk in enumerate(scan_chunks):
             partial = evaluator.run_plan(bottom, chunk, foreign_chunks,
                                          stats=stats)
             partials.append(partial)
             collected += partial.row_count
             if needed is not None and collected >= needed:
                 if stats is not None:
-                    stats.shards_skipped += len(chunks) - (i + 1)
+                    stats.shards_skipped += len(scan_chunks) - (i + 1)
                 break
         merged = concat_chunks(
             [p.slice_rows(0, p.row_count) for p in partials])
