@@ -62,6 +62,100 @@ def test_sql_join(tmp_path):
         {"k": 1, "name": b"even"}, {"k": 2, "name": b"odd"}]
 
 
+def test_translate_dialect_extensions():
+    # CH LIMIT offset, count shorthand.
+    assert translate_sql("SELECT x FROM t ORDER BY x LIMIT 20, 10") == \
+        "x FROM [//t] ORDER BY x OFFSET 20 LIMIT 10"
+    # == equality and casts.
+    assert translate_sql("SELECT toInt64(x) AS i FROM t WHERE y == 3") \
+        == "int64 (x) AS i FROM [//t] WHERE y = 3"
+    assert translate_sql("SELECT toFloat64(x) AS d FROM t") == \
+        "double (x) AS d FROM [//t]"
+    # DISTINCT → GROUP BY.
+    assert translate_sql("SELECT DISTINCT a, b FROM t") == \
+        "a, b FROM [//t] GROUP BY a, b"
+    # -If combinators become null-skipping CASE aggregates with CH's
+    # zero default on empty match sets.
+    assert translate_sql("SELECT countIf(x > 2) AS c FROM t") == \
+        "if_null (sum (CASE WHEN x > 2 THEN 1 END), 0) AS c FROM [//t]"
+    assert translate_sql(
+        "SELECT sumIf(v, g = 1) AS s FROM t GROUP BY g") == \
+        "if_null (sum (CASE WHEN g = 1 THEN v END), 0) AS s " \
+        "FROM [//t] GROUP BY g"
+    with pytest.raises(YtError):
+        translate_sql("SELECT toString(x) FROM t")
+    with pytest.raises(YtError):
+        translate_sql("SELECT DISTINCT a + 1 FROM t")
+
+
+def test_sql_conditional_aggregates_and_distinct(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    client = connect(str(tmp_path))
+    client.write_table("//ev", [
+        {"g": 0, "v": 1}, {"g": 0, "v": 5}, {"g": 1, "v": 7},
+        {"g": 1, "v": 2}, {"g": 0, "v": 9}])
+    rows = execute_sql(client,
+                       "SELECT countIf(v > 4) AS big, sumIf(v, v > 4) "
+                       "AS s FROM `//ev` GROUP BY 1 AS one")
+    assert rows == [{"big": 3, "s": 21}]
+    rows = execute_sql(client, "SELECT DISTINCT g FROM `//ev`")
+    assert sorted(r["g"] for r in rows) == [0, 1]
+
+
+def test_distinct_with_order_by_and_empty_if_combinators(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    # GROUP BY lands BEFORE ORDER BY in the rewritten clause order.
+    assert translate_sql("SELECT DISTINCT a FROM t ORDER BY a LIMIT 3") \
+        == "a FROM [//t] GROUP BY a ORDER BY a LIMIT 3"
+    with pytest.raises(YtError):
+        translate_sql("SELECT DISTINCT a FROM t GROUP BY a")
+    client = connect(str(tmp_path))
+    client.write_table("//e", [{"g": 1, "v": 4}, {"g": 2, "v": 6}])
+    rows = execute_sql(client,
+                       "SELECT DISTINCT g FROM `//e` ORDER BY g DESC "
+                       "LIMIT 10")
+    assert [r["g"] for r in rows] == [2, 1]
+    # CH default-value semantics: no matching rows → 0, not NULL.
+    rows = execute_sql(client,
+                       "SELECT countIf(v > 100) AS c, sumIf(v, v > 100) "
+                       "AS s FROM `//e` GROUP BY 1 AS one")
+    assert rows == [{"c": 0, "s": 0}]
+
+
+def test_subquery_split_ignores_string_literals(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    client = connect(str(tmp_path))
+    client.write_table("//notes", [{"note": "from (x)", "v": 1},
+                                   {"note": "plain", "v": 2}])
+    rows = execute_sql(
+        client, "SELECT v FROM `//notes` WHERE note = 'from (x)'")
+    assert [r["v"] for r in rows] == [1]
+
+
+def test_sql_subquery(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    client = connect(str(tmp_path))
+    client.write_table("//orders", [
+        {"cust": "a", "amount": 10}, {"cust": "a", "amount": 20},
+        {"cust": "b", "amount": 5}, {"cust": "c", "amount": 50}])
+    # Outer aggregate over an inner per-customer aggregate.
+    rows = execute_sql(client, """
+        SELECT count(*) AS n, max(total) AS top FROM (
+            SELECT cust, sum(amount) AS total FROM `//orders`
+            GROUP BY cust
+        ) AS per_cust WHERE total > 6 GROUP BY 1 AS one""")
+    (row,) = rows
+    assert row["n"] == 2 and row["top"] == 50
+    # Plain projection over a filtered subquery, with ORDER.
+    rows = execute_sql(client, """
+        SELECT cust, total FROM (
+            SELECT cust, sum(amount) AS total FROM `//orders`
+            GROUP BY cust
+        ) ORDER BY total DESC LIMIT 2""")
+    assert [r["total"] for r in rows] == [50, 30]
+    assert rows[0]["cust"] in (b"c", "c")
+
+
 def test_sql_errors_surface(tmp_path):
     client = connect(str(tmp_path))
     qt = QueryTracker(client)

@@ -63,21 +63,6 @@ def cell_client(client, cell_root: str):
     return client.table_replicator.replica_client(cell_root)
 
 
-def route(client, path: str, include_self: bool = False):
-    """The client that owns `path` (a secondary cell's), or None when
-    the primary owns it.  Chained portals resolve recursively on the
-    secondary."""
-    hit = portal_prefix(client, path, include_self=include_self)
-    if hit is None:
-        return None
-    _, attrs = hit
-    cell_root = attrs.get("cell_root")
-    if not cell_root:
-        raise YtError("portal entrance has no @cell_root",
-                      code=EErrorCode.ResolveError)
-    return cell_client(client, cell_root)
-
-
 def delegate_for(client, path: str, permission: "Optional[str]",
                  include_self: bool = False):
     """Routed-verb front door: resolves the owning cell AND enforces the
@@ -172,8 +157,9 @@ def remove_portal(client, path: str, entrance_attrs: dict,
     reject_tx(tx)
     cell_root = entrance_attrs.get("cell_root")
     exit_client = cell_client(client, cell_root)
-    if not recursive and exit_client.exists(path) and \
-            exit_client.list(path):
+    with as_cell_principal():
+        non_empty = exit_client.exists(path) and exit_client.list(path)
+    if not recursive and non_empty:
         raise YtError(f"Cannot remove non-empty portal {path!r} without "
                       "recursive=True", code=EErrorCode.Generic)
     client.cluster.master.commit_mutation("remove", path=path,
@@ -220,7 +206,11 @@ def _ensure_cleanup_handler(manager) -> None:
             return []               # already gone: idempotent
         # Portals CHAINED inside this exit must dismantle their own
         # (third-cell) exits too, or a recreated chain resurrects stale
-        # data there.
+        # data there.  This bends Hive's declarative-handler contract
+        # (remote posts happen DURING apply, outside the atomic ack
+        # batch), which is safe here because dismantles are idempotent:
+        # a crash-then-reapply re-posts a cleanup whose receiver finds
+        # the path already gone and acks a no-op.
         for nested_path, nested_root in portals_under(path, node):
             _dismantle_exit(manager.client, nested_root, nested_path)
         return [("remove", {"path": path, "recursive": True})]

@@ -14,10 +14,18 @@ Ref mapping (yt/chyt):
 
 Dialect deltas handled:
   SELECT * / SELECT cols FROM "//path" | `//path` | [//path]
+  SELECT ... FROM (SELECT ...)   — subqueries: the inner SELECT runs
+      first and the outer query evaluates over its materialized rowset
+      (CHYT's subquery pushdown collapses to two engine passes here)
+  SELECT DISTINCT a, b FROM t    → GROUP BY a, b
   ANSI double-quoted / backticked identifiers → bare identifiers
-  <>  → !=            (inequality)
-  CH aggregate names  → native (uniq/uniqExact → cardinality, any → first)
-  LIMIT n OFFSET m    → OFFSET m LIMIT n (QL clause order)
+  <> / ==             → != / =
+  CH aggregate names  → native (uniq/uniqExact → cardinality, any →
+      first, countIf/sumIf/avgIf/minIf/maxIf → agg(CASE WHEN c THEN x
+      END) — aggregates skip nulls, matching the -If combinators)
+  CH casts            → native (toInt64 → int64, toUInt64 → uint64,
+      toFloat64 → double, toString is rejected [no string casts])
+  LIMIT n OFFSET m / LIMIT m, n  → OFFSET m LIMIT n (QL clause order)
 Strings must use single quotes (ANSI); double quotes always mean
 identifiers, exactly like ClickHouse's default dialect.
 """
@@ -36,13 +44,30 @@ _TOKEN = re.compile(r"""
   | (?P<bracket>\[[^\]]*\])
   | (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?u?)
   | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
-  | (?P<op><>|<=|>=|!=|\|\||[-+*/%(),=<>.])
+  | (?P<op><>|<=|>=|!=|==|\|\||[-+*/%(),=<>.])
 """, re.VERBOSE)
 
 _AGG_RENAMES = {
     "uniq": "cardinality",
     "uniqexact": "cardinality",
     "any": "first",
+}
+
+_CAST_RENAMES = {
+    "toint64": "int64",
+    "touint64": "uint64",
+    "tofloat64": "double",
+}
+
+# aggIf(x, cond) → agg(CASE WHEN cond THEN x END); countIf(cond) →
+# sum(CASE WHEN cond THEN 1 END).  Null-skipping aggregation gives the
+# -If combinator semantics exactly.
+_IF_COMBINATORS = {
+    "countif": "sum",
+    "sumif": "sum",
+    "avgif": "avg",
+    "minif": "min",
+    "maxif": "max",
 }
 
 _TABLE_KEYWORDS = {"from", "join"}
@@ -62,17 +87,103 @@ def _tokens(text: str):
         yield kind, m.group()
 
 
+def _rewrite_if_combinators(toks: "list[tuple[str, str]]"
+                            ) -> "list[tuple[str, str]]":
+    """aggIf(x, cond) → agg(CASE WHEN cond THEN x END); countIf(cond)
+    → sum(CASE WHEN cond THEN 1 END).  Recursive: arguments may nest
+    further combinators."""
+    out: list = []
+    i = 0
+    while i < len(toks):
+        kind, tok = toks[i]
+        low = tok.lower()
+        if kind == "word" and low in _IF_COMBINATORS and \
+                i + 1 < len(toks) and toks[i + 1][1] == "(":
+            depth = 0
+            j = i + 1
+            args: list = [[]]
+            while j < len(toks):
+                k2, t2 = toks[j]
+                if t2 == "(":
+                    depth += 1
+                    if depth > 1:
+                        args[-1].append((k2, t2))
+                elif t2 == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                    args[-1].append((k2, t2))
+                elif t2 == "," and depth == 1:
+                    args.append([])
+                else:
+                    args[-1].append((k2, t2))
+                j += 1
+            if depth != 0:
+                raise YtError(f"SQL: unbalanced parens in {tok}(...)",
+                              code=EErrorCode.QueryParseError)
+            args = [_rewrite_if_combinators(a) for a in args]
+            if low == "countif":
+                if len(args) != 1:
+                    raise YtError("SQL: countIf takes one argument",
+                                  code=EErrorCode.QueryParseError)
+                cond, value = args[0], [("num", "1")]
+            else:
+                if len(args) != 2:
+                    raise YtError(f"SQL: {tok} takes (value, condition)",
+                                  code=EErrorCode.QueryParseError)
+                value, cond = args
+            # CH -If combinators return the aggregate's DEFAULT on an
+            # empty match set (0 for count/sum); our aggregates return
+            # NULL over empty sets, so those two wrap in if_null.
+            zero_default = low in ("countif", "sumif")
+            if zero_default:
+                out.append(("word", "if_null"))
+                out.append(("op", "("))
+            out.append(("word", _IF_COMBINATORS[low]))
+            out.append(("op", "("))
+            out.append(("word", "CASE"))
+            out.append(("word", "WHEN"))
+            out.extend(cond)
+            out.append(("word", "THEN"))
+            out.extend(value)
+            out.append(("word", "END"))
+            out.append(("op", ")"))
+            if zero_default:
+                out.append(("op", ","))
+                out.append(("num", "0"))
+                out.append(("op", ")"))
+            i = j + 1
+            continue
+        out.append((kind, tok))
+        i += 1
+    return out
+
+
 def translate_sql(sql: str) -> str:
-    """ClickHouse/ANSI-flavored SELECT → native QL text."""
+    """ClickHouse/ANSI-flavored SELECT → native QL text (flat queries;
+    subqueries are orchestrated by execute_sql)."""
+    toks = _rewrite_if_combinators(list(_tokens(sql.strip().rstrip(";"))))
     out: list[str] = []
     expecting_table = False
     limit_value = None
     offset_value = None
     state = "normal"
-    for kind, tok in _tokens(sql.strip().rstrip(";")):
+    distinct_items: "list[str] | None" = None
+    collecting_distinct = False
+    for kind, tok in toks:
         low = tok.lower()
         if state == "limit" and kind == "num":
             limit_value = tok
+            state = "limit_tail"
+            continue
+        if state == "limit_tail":
+            if tok == ",":
+                # CH shorthand: LIMIT offset, count.
+                state = "limit_second"
+                continue
+            state = "normal"
+        if state == "limit_second" and kind == "num":
+            offset_value, limit_value = limit_value, tok
             state = "normal"
             continue
         if state == "offset" and kind == "num":
@@ -85,6 +196,25 @@ def translate_sql(sql: str) -> str:
         if kind == "word" and low == "offset":
             state = "offset"
             continue
+        if kind == "word" and low == "distinct" and \
+                out and out[-1].lower() == "select":
+            collecting_distinct = True
+            distinct_items = []
+            continue
+        if collecting_distinct:
+            if kind == "word" and low in _TABLE_KEYWORDS:
+                collecting_distinct = False
+            elif kind == "word":
+                distinct_items.append(tok)
+                out.append(tok)
+                continue
+            elif tok == ",":
+                out.append(tok)
+                continue
+            else:
+                raise YtError(
+                    "SQL: SELECT DISTINCT supports bare column lists "
+                    "only", code=EErrorCode.QueryParseError)
         if expecting_table:
             out.append(_table_ref(kind, tok))
             expecting_table = False
@@ -103,10 +233,31 @@ def translate_sql(sql: str) -> str:
         if kind == "op" and tok == "<>":
             out.append("!=")
             continue
+        if kind == "op" and tok == "==":
+            out.append("=")
+            continue
         if kind == "word" and low in _AGG_RENAMES:
             out.append(_AGG_RENAMES[low])
             continue
+        if kind == "word" and low in _CAST_RENAMES:
+            out.append(_CAST_RENAMES[low])
+            continue
+        if kind == "word" and low == "tostring":
+            raise YtError("SQL: toString is not supported (no string "
+                          "casts)", code=EErrorCode.QueryUnsupported)
         out.append(tok)
+    if distinct_items:
+        lows = [t.lower() for t in out]
+        if "group" in lows:
+            raise YtError("SQL: DISTINCT cannot combine with GROUP BY",
+                          code=EErrorCode.QueryParseError)
+        group_toks = ["GROUP", "BY"]
+        for i, item in enumerate(distinct_items):
+            if i:
+                group_toks.append(",")
+            group_toks.append(item)
+        insert_at = lows.index("order") if "order" in lows else len(out)
+        out[insert_at:insert_at] = group_toks
     ql = _respace(out)
     if ql.lower().startswith("select "):
         ql = ql[len("select "):]
@@ -151,8 +302,109 @@ def _respace(tokens: "list[str]") -> str:
     return "".join(parts)
 
 
+_SUBQUERY_TABLE = "//__chyt_subquery__"
+
+
+def _mask_strings(sql: str) -> str:
+    """Same-length copy with quoted literals blanked, so clause searches
+    and paren counting cannot match inside strings."""
+    out = list(sql)
+    i = 0
+    while i < len(sql):
+        if sql[i] == "'":
+            j = i + 1
+            while j < len(sql):
+                if sql[j] == "\\":
+                    j += 2
+                    continue
+                if sql[j] == "'":
+                    break
+                j += 1
+            for k in range(i + 1, min(j, len(sql))):
+                out[k] = "_"
+            i = j + 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+def _split_subquery(sql: str) -> "tuple[str, str] | None":
+    """`outer FROM ( inner ) [AS alias] rest` → (inner SQL, outer SQL
+    with the parenthesized subquery replaced by a synthetic table ref).
+    Returns None when the query has no FROM-subquery."""
+    masked = _mask_strings(sql)
+    m = re.search(r"\bfrom\s*\(", masked, re.IGNORECASE)
+    if m is None:
+        return None
+    start = masked.index("(", m.start())
+    depth = 0
+    for i in range(start, len(masked)):
+        if masked[i] == "(":
+            depth += 1
+        elif masked[i] == ")":
+            depth -= 1
+            if depth == 0:
+                inner = sql[start + 1: i]
+                rest = sql[i + 1:]
+                # Drop an optional `[AS] alias` after the subquery (QL
+                # has one namespace; clause keywords are not aliases).
+                alias = re.match(r"\s*(?:as\s+)?([A-Za-z_][A-Za-z0-9_]*)",
+                                 rest, re.IGNORECASE)
+                if alias and alias.group(1).lower() not in (
+                        "where", "group", "order", "having", "limit",
+                        "offset", "join", "on"):
+                    rest = rest[alias.end():]
+                outer = (sql[: m.start()] +
+                         f"FROM [{_SUBQUERY_TABLE}]" + rest)
+                return inner, outer
+    raise YtError("SQL: unbalanced parens in FROM (...)",
+                  code=EErrorCode.QueryParseError)
+
+
+def _infer_schema(rows: "list[dict]"):
+    """Column types from materialized subquery rows (None-only columns
+    default to int64)."""
+    from ytsaurus_tpu.schema import TableSchema
+    if not rows:
+        raise YtError("SQL: empty subquery result (schema unknown)",
+                      code=EErrorCode.QueryExecutionError)
+    kinds: dict = {}
+    for row in rows:
+        for name, value in row.items():
+            if value is None:
+                kinds.setdefault(name, None)
+            elif isinstance(value, bool):
+                kinds[name] = "boolean"
+            elif isinstance(value, int):
+                if kinds.get(name) not in ("double", "uint64"):
+                    kinds[name] = "uint64" if value >= 2**63 else "int64"
+            elif isinstance(value, float):
+                kinds[name] = "double"
+            elif isinstance(value, (bytes, str)):
+                kinds[name] = "string"
+    cols = [(name, kind or "int64") for name, kind in kinds.items()]
+    return TableSchema.make(cols)
+
+
 def execute_sql(client, sql: str) -> "list[dict]":
-    return client.select_rows(translate_sql(sql))
+    """CH-dialect execution, including one level of FROM-subquery: the
+    inner SELECT runs first and the outer query evaluates over its
+    materialized rowset (CHYT collapses subqueries into engine passes
+    the same way; here each pass IS a full coordinated query)."""
+    sql = sql.strip().rstrip(";")
+    split = _split_subquery(sql)
+    if split is None:
+        return client.select_rows(translate_sql(sql))
+    inner_sql, outer_sql = split
+    inner_rows = execute_sql(client, inner_sql)     # nested levels recurse
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.query import select_rows as chunk_select
+    decoded = [{k: (v.decode() if isinstance(v, bytes) else v)
+                for k, v in r.items()} for r in inner_rows]
+    chunk = ColumnarChunk.from_rows(_infer_schema(decoded), decoded)
+    result = chunk_select(translate_sql(outer_sql),
+                          {_SUBQUERY_TABLE: chunk})
+    return result.to_rows()
 
 
 def register() -> None:

@@ -64,6 +64,10 @@ class QueryTracker:
                     annotations: Optional[dict] = None,
                     sync: bool = False) -> str:
         if engine not in _ENGINES:
+            # Ecosystem engines register on import; load them on first
+            # use so `engine="chyt"` works without explicit wiring.
+            import ytsaurus_tpu.ecosystem.sql  # noqa: F401
+        if engine not in _ENGINES:
             raise YtError(f"Unknown query engine {engine!r}; "
                           f"available: {sorted(_ENGINES)}",
                           code=EErrorCode.QueryUnsupported)
