@@ -122,6 +122,23 @@ def test_distinct_with_order_by_and_empty_if_combinators(tmp_path):
     assert rows == [{"c": 0, "s": 0}]
 
 
+def test_empty_subquery_yields_empty_not_error(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    client = connect(str(tmp_path))
+    client.write_table("//s", [{"v": 1}, {"v": 2}])
+    # Plain projection over an empty subquery → empty rowset.
+    rows = execute_sql(client,
+                       "SELECT v FROM (SELECT v FROM `//s` "
+                       "WHERE v > 100)")
+    assert rows == []
+    # Aggregation over it → the group simply does not exist (QL GROUP
+    # BY over zero rows yields zero groups).
+    rows = execute_sql(client,
+                       "SELECT count(*) AS n FROM (SELECT v FROM `//s` "
+                       "WHERE v > 100) GROUP BY 1 AS one")
+    assert rows == []
+
+
 def test_subquery_split_ignores_string_literals(tmp_path):
     from ytsaurus_tpu.ecosystem.sql import execute_sql
     client = connect(str(tmp_path))

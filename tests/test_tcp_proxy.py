@@ -1,0 +1,57 @@
+"""TCP proxy: leader-following byte router (ref yt/yt/server/tcp_proxy).
+"""
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from ytsaurus_tpu.remote_client import connect_remote  # noqa: E402
+from ytsaurus_tpu.server.tcp_proxy import TcpProxy  # noqa: E402
+
+
+def test_tcp_proxy_routes_thin_client(tmp_path):
+    from ytsaurus_tpu.environment import LocalCluster
+
+    with LocalCluster(str(tmp_path / "c"), n_nodes=1,
+                      replication_factor=1) as cluster:
+        proxy = TcpProxy([cluster.primary_address]).start()
+        try:
+            # The client speaks to the PROXY address only.
+            cl = connect_remote(proxy.address)
+            cl.create("document", "//via/proxy", recursive=True)
+            cl.set("//via/proxy", {"ok": True})
+            assert cl.get("//via/proxy") == {"ok": True}
+            cl.write_table("//via/t", [{"x": i} for i in range(50)])
+            assert len(cl.read_table("//via/t")) == 50
+            assert proxy.stats["connections"] >= 1
+            assert set(proxy.stats["routed_to"]) == \
+                {cluster.primary_address}
+        finally:
+            proxy.stop()
+
+
+def test_tcp_proxy_follows_leader(tmp_path):
+    from ytsaurus_tpu.environment import LocalCluster
+
+    with LocalCluster(str(tmp_path / "e"), n_nodes=3, n_masters=2,
+                      lease_ttl=3.0) as cluster:
+        leader = cluster.leader_index(timeout=60)
+        proxy = TcpProxy(list(cluster.master_addresses)).start()
+        try:
+            cl = connect_remote(proxy.address)
+            cl.create("document", "//lf/a", recursive=True)
+            assert proxy.stats["routed_to"] == {
+                cluster.master_addresses[leader]:
+                    proxy.stats["connections"]}
+            # Kill the leader: NEW connections route to the successor.
+            cluster.kill_leader()
+            new_leader = cluster.leader_index(timeout=60)
+            assert new_leader != leader
+            cl2 = connect_remote(proxy.address)
+            assert cl2.exists("//lf/a")
+            assert cluster.master_addresses[new_leader] in \
+                proxy.stats["routed_to"]
+        finally:
+            proxy.stop()

@@ -401,10 +401,30 @@ def execute_sql(client, sql: str) -> "list[dict]":
     from ytsaurus_tpu.query import select_rows as chunk_select
     decoded = [{k: (v.decode() if isinstance(v, bytes) else v)
                 for k, v in r.items()} for r in inner_rows]
-    chunk = ColumnarChunk.from_rows(_infer_schema(decoded), decoded)
+    if decoded:
+        schema = _infer_schema(decoded)
+    else:
+        # Empty inner result is routine (selective WHERE), not an
+        # error: take the schema from the inner PLAN so the outer query
+        # can still aggregate to its CH-correct empty/zero result.
+        schema = _planned_schema(client, inner_sql)
+    chunk = ColumnarChunk.from_rows(schema, decoded)
     result = chunk_select(translate_sql(outer_sql),
                           {_SUBQUERY_TABLE: chunk})
     return result.to_rows()
+
+
+def _planned_schema(client, inner_sql: str):
+    """Output schema of a (flat) inner query via the QL builder — used
+    when no rows materialized to infer types from."""
+    from ytsaurus_tpu.client import _SchemaResolver
+    from ytsaurus_tpu.query.builder import build_query
+    if _split_subquery(inner_sql) is not None:
+        raise YtError(
+            "SQL: empty nested subquery result (schema unknown)",
+            code=EErrorCode.QueryExecutionError)
+    plan = build_query(translate_sql(inner_sql), _SchemaResolver(client))
+    return plan.output_schema().to_unsorted()
 
 
 def register() -> None:

@@ -455,7 +455,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--role",
                         choices=("primary", "node", "proxy",
-                                 "master_cache"),
+                                 "master_cache", "tcp_proxy"),
                         required=True)
     parser.add_argument("--root", required=True)
     parser.add_argument("--port", type=int, default=0)
@@ -502,6 +502,17 @@ def main() -> None:
             parser.error("--primary is required for --role master_cache")
         from ytsaurus_tpu.server.master_cache import run_master_cache
         run_master_cache(args.root, args.port, args.primary)
+    elif args.role == "tcp_proxy":
+        if not args.primary:
+            parser.error("--primary is required for --role tcp_proxy")
+        from ytsaurus_tpu.server.tcp_proxy import TcpProxy
+        os.makedirs(args.root, exist_ok=True)
+        proxy = TcpProxy([a.strip() for a in args.primary.split(",")
+                          if a.strip()], port=args.port).start()
+        _write_port_file(args.root, "tcp_proxy", proxy.port)
+        print(f"tcp proxy serving on {proxy.address} -> {args.primary}",
+              flush=True)
+        threading.Event().wait()
     else:
         if not args.primary:
             parser.error("--primary is required for --role node")
