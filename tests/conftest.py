@@ -15,6 +15,11 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# Every test runs "sanitized": structural invariant checks at subsystem
+# boundaries (utils/invariants.py — the debug-build assertion analog).
+# Plain assignment, not setdefault: an inherited =0 from a profiling
+# shell must not silently turn the sanitizer off for the whole suite.
+os.environ["YT_TPU_INVARIANTS"] = "1"
 
 import jax  # noqa: E402
 

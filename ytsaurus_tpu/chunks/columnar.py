@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils.invariants import check as _invariant_check
 from ytsaurus_tpu.schema import EValueType, TableSchema, device_dtype
 
 LANE = 128  # last-dim tiling unit on TPU; capacities are multiples of this
@@ -186,7 +187,9 @@ class ColumnarChunk:
                             f"Required column {name!r} is null in row {i}",
                             code=EErrorCode.QueryTypeError)
             columns[name] = _build_column(ty, values, cap)
-        return ColumnarChunk(schema=schema, row_count=n, columns=columns)
+        chunk = ColumnarChunk(schema=schema, row_count=n, columns=columns)
+        _invariant_check("chunks", chunk)
+        return chunk
 
     @staticmethod
     def from_arrays(schema: TableSchema, arrays: Mapping[str, np.ndarray],
@@ -253,7 +256,9 @@ class ColumnarChunk:
                 valid[:n] = True
             columns[name] = Column(type=ty, data=jnp.asarray(data),
                                    valid=jnp.asarray(valid), dictionary=vocab)
-        return ColumnarChunk(schema=schema, row_count=n, columns=columns)
+        chunk = ColumnarChunk(schema=schema, row_count=n, columns=columns)
+        _invariant_check("chunks", chunk)
+        return chunk
 
     # --- materialization ------------------------------------------------------
 

@@ -41,6 +41,7 @@ from typing import Optional
 
 from ytsaurus_tpu.cypress.master import Changelog
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils.invariants import check as _invariant_check
 from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("quorum")
@@ -429,6 +430,7 @@ class QuorumWal:
                 f"WAL append reached {acks}/{self.quorum} locations",
                 code=EErrorCode.PeerUnavailable, inner_errors=errors[:3])
         self._records.append(record)
+        _invariant_check("wal", self._records[-2:])  # tail: non-decreasing
 
     def _restart_append(self, payload: dict, retries: int, errors: list,
                         local_appended: bool) -> None:
@@ -547,6 +549,7 @@ class QuorumWal:
                 f"recovered log replicated to only {holders}/{self.quorum} "
                 "locations; refusing to serve from an under-replicated "
                 "tail", code=EErrorCode.PeerUnavailable)
+        _invariant_check("wal", self._records)
         return list(self._records)
 
     def extend(self, channels: list) -> int:

@@ -25,6 +25,7 @@ import numpy as np
 from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
 from ytsaurus_tpu.chunks.store import ChunkCache, FsChunkStore
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils.invariants import check as _invariant_check
 from ytsaurus_tpu.schema import EValueType, SortOrder, TableSchema
 from ytsaurus_tpu.tablet.dynamic_store import SortedDynamicStore
 from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
@@ -184,6 +185,8 @@ class Tablet:
             for store in self.passive_stores:
                 rows.extend(store.versioned_rows())
             rows.sort(key=_versioned_sort_key(self.schema))
+            _invariant_check("versioned_rows",
+                             (self.schema.key_column_names, rows))
             chunk = ColumnarChunk.from_rows(versioned_schema(self.schema), rows)
             chunk_id = self.chunk_store.write_chunk(chunk)
             self.chunk_ids.append(chunk_id)
@@ -191,6 +194,7 @@ class Tablet:
                 self.chunk_cache.pin(chunk_id)
             self.passive_stores.clear()
             self.flush_generation += 1
+            _invariant_check("tablet", self)
             return chunk_id
 
     def compact(self, retention_timestamp: int = 0) -> Optional[str]:
@@ -211,6 +215,8 @@ class Tablet:
                     rows.append(row)
             rows.sort(key=_versioned_sort_key(self.schema))
             rows = _drop_superseded(rows, self.schema, retention_timestamp)
+            _invariant_check("versioned_rows",
+                             (self.schema.key_column_names, rows))
             old_ids = list(self.chunk_ids)
             if rows:
                 chunk = ColumnarChunk.from_rows(versioned_schema(self.schema),
@@ -227,6 +233,7 @@ class Tablet:
                 self.chunk_cache.invalidate(cid)
                 self._host_planes.pop(cid, None)
             self.flush_generation += 1
+            _invariant_check("tablet", self)
             return new_id
 
     # -- read path -------------------------------------------------------------
