@@ -1,0 +1,139 @@
+"""Sequoia groundwork: the resolve ground-table stays consistent with
+the master tree through the mutation stream (ref sequoia_server +
+sequoia_client ground tables)."""
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.cypress.sequoia import RESOLVE_PATH, SequoiaResolver
+
+
+@pytest.fixture
+def resolver(tmp_path):
+    client = connect(str(tmp_path / "c"))
+    client.create("map_node", "//pre/existing", recursive=True)
+    return client, SequoiaResolver(client).enable()
+
+
+def test_bootstrap_full_sync(resolver):
+    client, seq = resolver
+    hit = seq.resolve("//pre/existing")
+    assert hit is not None
+    assert hit["node_type"] == "map_node"
+    assert seq.verify() == []
+
+
+def test_mutations_maintain_resolve_table(resolver):
+    client, seq = resolver
+    client.create("document", "//a/b/c", recursive=True)
+    assert seq.resolve("//a/b/c")["node_type"] == "document"
+    # Recursive creates materialize ancestor records too.
+    assert seq.resolve("//a")["node_type"] == "map_node"
+    assert seq.resolve("//a/b")["node_type"] == "map_node"
+
+    client.write_table("//a/t", [{"x": 1}])
+    assert seq.resolve("//a/t")["node_type"] == "table"
+
+    client.copy("//a", "//a2", recursive=True)
+    assert seq.resolve("//a2/b/c") is not None
+    client.move("//a2", "//a3")
+    assert seq.resolve("//a2") is None
+    assert seq.resolve("//a3/b/c") is not None
+
+    client.remove("//a")
+    assert seq.resolve("//a") is None
+    assert seq.resolve("//a/b/c") is None
+    assert seq.verify() == []
+
+
+def test_resolve_matches_tree_ids(resolver):
+    client, seq = resolver
+    client.create("document", "//idcheck", recursive=True)
+    node = client.cluster.master.tree.resolve("//idcheck")
+    assert seq.resolve("//idcheck")["node_id"] == node.id
+
+
+def test_verify_detects_and_full_sync_repairs(resolver):
+    client, seq = resolver
+    client.create("document", "//d/x", recursive=True)
+    assert seq.verify() == []
+    # Sabotage: drop one record behind the maintainer's back.
+    client.delete_rows(RESOLVE_PATH, [("//d/x",)])
+    assert "//d/x" in seq.verify()
+    seq.full_sync()
+    assert seq.verify() == []
+    assert seq.resolve("//d/x") is not None
+
+
+def test_resolve_excludes_own_subtree(resolver):
+    client, seq = resolver
+    # The resolve table does not mirror itself (no recursion).
+    assert seq.resolve(RESOLVE_PATH) is None
+    assert all(not p.startswith("//sys/sequoia") for p in seq.verify())
+
+
+def test_set_creates_and_replaces_children(resolver):
+    client, seq = resolver
+    # set can CREATE a node outright...
+    client.set("//brandnew", 5)
+    assert seq.resolve("//brandnew") is not None
+    # ...and replace a map_node's entire child set.
+    client.create("document", "//m/old", recursive=True)
+    client.set("//m", {"fresh": 1})
+    assert seq.resolve("//m/old") is None
+    assert seq.resolve("//m/fresh") is not None
+    assert seq.verify() == []
+
+
+def test_tx_abort_resyncs(resolver):
+    client, seq = resolver
+    tx = client.start_tx()
+    client.create("document", "//txnode", recursive=True, tx=tx)
+    assert seq.resolve("//txnode") is not None
+    client.abort_tx(tx)
+    assert seq.resolve("//txnode") is None      # no phantom node
+    assert seq.verify() == []
+
+
+def test_links_resolve_consistently(resolver):
+    client, seq = resolver
+    client.create("document", "//tgt", recursive=True)
+    client.link("//tgt", "//lnk")
+    target_id = client.cluster.master.tree.resolve("//tgt").id
+    assert seq.resolve("//lnk")["node_id"] == target_id
+    assert seq.verify() == []
+    # full_sync must agree with the incremental path on link semantics.
+    seq.full_sync()
+    assert seq.resolve("//lnk")["node_id"] == target_id
+    assert seq.verify() == []
+
+
+def test_quoted_path_removal(resolver):
+    client, seq = resolver
+    client.create("map_node", "//data/it's", recursive=True)
+    client.create("document", "//data/it's/leaf")
+    assert seq.resolve("//data/it's/leaf") is not None
+    client.remove("//data/it's")
+    assert seq.resolve("//data/it's") is None
+    assert seq.resolve("//data/it's/leaf") is None
+    assert seq.verify() == []
+
+
+def test_excluded_prefix_is_segment_aware(resolver):
+    client, seq = resolver
+    client.create("document", "//sys/sequoia_backup", recursive=True)
+    assert seq.resolve("//sys/sequoia_backup") is not None
+    assert seq.verify() == []
+
+
+def test_under_mutation_load_stays_consistent(resolver):
+    client, seq = resolver
+    for i in range(40):
+        client.create("document", f"//load/d{i}", recursive=True)
+        if i % 3 == 0:
+            client.set(f"//load/d{i}", {"v": i})
+        if i % 7 == 0 and i:
+            client.remove(f"//load/d{i - 1}")
+    assert seq.verify() == []
+    assert seq.resolve("//load/d2") is not None
+    assert seq.resolve("//load/d6") is None       # removed at i=7

@@ -99,6 +99,7 @@ class Master:
         # commit_mutation calls (see the property below).
         self._lock = threading.RLock()
         self._poisoned = False
+        self._mutation_listeners: list = []
         self._snapshot_seq = 0
         self.tree = CypressTree()
         self.tx_manager = MasterTransactionManager(self.tree)
@@ -156,7 +157,19 @@ class Master:
                 # on changelog failure.
                 self._poisoned = True
                 raise
+            for listener in self._mutation_listeners:
+                try:
+                    listener(op, args, result)
+                except Exception:   # noqa: BLE001 — observers never poison
+                    pass
             return result
+
+    def add_mutation_listener(self, listener) -> None:
+        """Post-commit observer: listener(op, args, result) runs after a
+        mutation is durably logged (Sequoia resolve-table maintenance,
+        metrics).  Observers must not mutate the tree via
+        commit_mutation from the callback (the lock is held)."""
+        self._mutation_listeners.append(listener)
 
     def _apply(self, op: str, args: dict) -> Any:
         if op == "batch":

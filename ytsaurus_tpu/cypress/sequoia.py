@@ -1,0 +1,227 @@
+"""Sequoia groundwork: Cypress resolution backed by a dynamic table.
+
+Ref: yt/yt/server/master/sequoia_server/ + the ground tables under
+yt/yt/ytlib/sequoia_client/ — the reference's escape from
+all-metadata-in-one-master's-RAM: node records move into distributed
+dynamic tables ("ground" tables, starting with path→node resolution),
+so the metadata plane scales like any other table and masters become
+coordinators over it.
+
+This module realizes the first slice the reference built: the RESOLVE
+table.  `//sys/sequoia/resolve` is an ordinary sorted dynamic table
+(path → node id, type, revision) maintained TRANSACTIONALLY with the
+master's mutation stream via a post-commit listener; `resolve()` serves
+path lookups from the table — a point lookup instead of a tree walk —
+and `verify()` proves table/tree agreement (the consistency invariant
+Sequoia's migration hinges on).  Records store the RESOLVED node (links
+follow to their target, like the resolve it replaces); a transaction
+abort rolls the tree back through undo entries invisible to the
+mutation stream, so aborts trigger a full resync (metadata aborts are
+rare; the reference handles this case with Sequoia transactions, which
+is the next slice).
+
+Scope honesty: node CONTENT still lives in the master tree; what rides
+the table is resolution metadata.  That is exactly how the reference
+staged it — resolve first, then per-object tables.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from ytsaurus_tpu.errors import YtError
+from ytsaurus_tpu.schema import TableSchema
+
+RESOLVE_PATH = "//sys/sequoia/resolve"
+
+RESOLVE_SCHEMA = TableSchema.make([
+    ("path", "string", "ascending"),
+    ("node_id", "string"),
+    ("node_type", "string"),
+    ("revision", "int64"),
+], unique_keys=True)
+
+# Subtree whose mutations must NOT be mirrored (the resolve table's own
+# home — mirroring it would recurse through its mount metadata).
+_EXCLUDED_ROOT = "//sys/sequoia"
+
+
+def _excluded(path: str) -> bool:
+    return path == _EXCLUDED_ROOT or \
+        path.startswith(_EXCLUDED_ROOT + "/")
+
+
+def _text(value) -> str:
+    return value.decode() if isinstance(value, bytes) else value
+
+
+class SequoiaResolver:
+    """Maintains and serves the resolve table for one cluster."""
+
+    def __init__(self, client):
+        self.client = client
+        self._revision = 0
+        self._enabled = False
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def enable(self) -> "SequoiaResolver":
+        """Create + mount the resolve table, full-sync it from the tree,
+        and subscribe to the mutation stream — atomically under the
+        master mutation lock, so no mutation can slip between the sync
+        walk and the subscription."""
+        if not self.client.exists(RESOLVE_PATH):
+            self.client.create("table", RESOLVE_PATH, recursive=True,
+                               attributes={"schema": RESOLVE_SCHEMA,
+                                           "dynamic": True})
+            self.client.mount_table(RESOLVE_PATH)
+        master = self.client.cluster.master
+        with master.mutation_lock:
+            self.full_sync()
+            master.add_mutation_listener(self._on_mutation)
+        self._enabled = True
+        return self
+
+    def _walk_tree(self) -> "Iterator[tuple[str, object]]":
+        """(path, RESOLVED node) for every non-excluded tree path — THE
+        single walk shared by full_sync and verify, resolving through
+        links exactly like the incremental path does (try_resolve), so
+        the two sides can never drift on link semantics."""
+        tree = self.client.cluster.master.tree
+        stack = [("/", tree.root)]
+        while stack:
+            path, node = stack.pop()
+            for name, child in list(node.children.items()):
+                child_path = f"//{name}" if path == "/" else \
+                    f"{path}/{name}"
+                if _excluded(child_path):
+                    continue
+                resolved = tree.try_resolve(child_path)
+                if resolved is not None:
+                    yield child_path, resolved
+                stack.append((child_path, child))
+
+    def full_sync(self) -> int:
+        """Rebuild the table from the live tree (bootstrap, post-abort
+        resync, or repair after a detected divergence)."""
+        rows = [{"path": path, "node_id": node.id,
+                 "node_type": node.type, "revision": self._revision}
+                for path, node in self._walk_tree()]
+        existing = self.client.select_rows(f"path FROM [{RESOLVE_PATH}]")
+        if existing:
+            self.client.delete_rows(
+                RESOLVE_PATH, [(r["path"],) for r in existing])
+        if rows:
+            self.client.insert_rows(RESOLVE_PATH, rows)
+        return len(rows)
+
+    # -- incremental maintenance ----------------------------------------------
+
+    def _on_mutation(self, op: str, args: dict, result) -> None:
+        try:
+            self._apply_mutation(op, args)
+        except YtError:
+            # Upkeep must never block the mutation path; a miss degrades
+            # to a stale entry that verify()/full_sync repairs.
+            pass
+
+    def _apply_mutation(self, op: str, args: dict) -> None:
+        self._revision += 1
+        if op == "create":
+            self._upsert(args.get("path"))
+        elif op == "remove":
+            self._drop_subtree(args.get("path"))
+        elif op == "set":
+            path = args.get("path")
+            if path and "/@" not in path:
+                # A value set can CREATE the node, and a map_node set
+                # replaces its whole child set: resync the subtree.
+                self._drop_subtree(path)
+                self._upsert_subtree(path)
+        elif op in ("copy", "move"):
+            if op == "move":
+                self._drop_subtree(args.get("src"))
+            self._upsert_subtree(args.get("dst"))
+        elif op == "link":
+            self._upsert(args.get("link"))
+        elif op == "tx_abort":
+            # The rollback edits the tree through undo entries the
+            # mutation stream never sees; resync (aborted metadata txs
+            # are rare — Sequoia transactions are the next slice).
+            self.full_sync()
+        elif op == "batch":
+            for sub in args.get("ops") or []:
+                self._apply_mutation(sub.get("op"), sub.get("args") or {})
+
+    def _skip(self, path: "Optional[str]") -> bool:
+        return not path or "/@" in path or _excluded(path)
+
+    def _upsert(self, path: "Optional[str]") -> None:
+        if self._skip(path):
+            return
+        node = self.client.cluster.master.tree.try_resolve(path)
+        if node is None:
+            return                  # e.g. a dangling link target
+        self.client.insert_rows(RESOLVE_PATH, [{
+            "path": path, "node_id": node.id, "node_type": node.type,
+            "revision": self._revision}])
+        # Ancestors materialized by recursive creates get records too.
+        parent = path.rsplit("/", 1)[0]
+        if parent and parent != "/" and not self._known(parent):
+            self._upsert(parent)
+
+    def _upsert_subtree(self, path: "Optional[str]") -> None:
+        if self._skip(path):
+            return
+        node = self.client.cluster.master.tree.try_resolve(path)
+        if node is None:
+            return
+        self._upsert(path)
+        for name in list(node.children):
+            self._upsert_subtree(f"{path}/{name}")
+
+    def _known(self, path: str) -> bool:
+        hit = self.client.lookup_rows(RESOLVE_PATH, [(path,)])
+        return hit[0] is not None
+
+    def _drop_subtree(self, path: "Optional[str]") -> None:
+        if self._skip(path):
+            return
+        # Full-scan + host-side prefix filter: immune to quote/escape
+        # games in node names (no path text is ever spliced into QL).
+        prefix = path.rstrip("/")
+        doomed = []
+        for row in self.client.select_rows(f"path FROM [{RESOLVE_PATH}]"):
+            candidate = _text(row["path"])
+            if candidate == prefix or candidate.startswith(prefix + "/"):
+                doomed.append((candidate,))
+        if doomed:
+            self.client.delete_rows(RESOLVE_PATH, doomed)
+
+    # -- serving ---------------------------------------------------------------
+
+    def resolve(self, path: str) -> "Optional[dict]":
+        """Point lookup: {node_id, node_type} or None.  THE Sequoia win:
+        resolution is a table read, not a masters-memory tree walk."""
+        (row,) = self.client.lookup_rows(RESOLVE_PATH, [(path,)])
+        if row is None:
+            return None
+        return {"node_id": _text(row["node_id"]),
+                "node_type": _text(row["node_type"])}
+
+    def verify(self) -> "list[str]":
+        """Table/tree agreement check over the FULL namespace; returns
+        divergent paths (empty = consistent).  The Sequoia migration
+        invariant, checkable any time because both sides coexist."""
+        divergent: list[str] = []
+        table_ids: dict[str, str] = {}
+        for row in self.client.select_rows(
+                f"path, node_id FROM [{RESOLVE_PATH}]"):
+            table_ids[_text(row["path"])] = _text(row["node_id"])
+        tree_paths = set()
+        for path, node in self._walk_tree():
+            tree_paths.add(path)
+            if table_ids.get(path) != node.id:
+                divergent.append(path)
+        divergent.extend(p for p in table_ids if p not in tree_paths)
+        return sorted(set(divergent))
