@@ -96,15 +96,33 @@ def test_tx_abort_resyncs(resolver):
 
 
 def test_links_resolve_consistently(resolver):
+    """Rows record the RAW node: a link row is the link itself (type
+    'link'), so the incremental path, full_sync, and verify agree — and
+    removing the TARGET never strands the link's row."""
     client, seq = resolver
     client.create("document", "//tgt", recursive=True)
     client.link("//tgt", "//lnk")
-    target_id = client.cluster.master.tree.resolve("//tgt").id
-    assert seq.resolve("//lnk")["node_id"] == target_id
+    link_id = client.cluster.master.tree.resolve(
+        "//lnk", follow_links=False).id
+    hit = seq.resolve("//lnk")
+    assert hit == {"node_id": link_id, "node_type": "link"}
     assert seq.verify() == []
-    # full_sync must agree with the incremental path on link semantics.
     seq.full_sync()
-    assert seq.resolve("//lnk")["node_id"] == target_id
+    assert seq.resolve("//lnk") == hit
+    assert seq.verify() == []
+    # Target removal: the link row stays valid (it records the link).
+    client.remove("//tgt")
+    assert seq.resolve("//lnk") == hit
+    assert seq.verify() == []
+
+
+def test_noncanonical_paths_share_one_row(resolver):
+    client, seq = resolver
+    client.create("document", "//x//y", recursive=True)
+    assert seq.resolve("//x/y") is not None
+    assert seq.verify() == []
+    client.remove("//x//y")
+    assert seq.resolve("//x/y") is None
     assert seq.verify() == []
 
 

@@ -145,6 +145,51 @@ class FsChunkStore:
         return os.path.exists(self._path(chunk_id)) or \
             os.path.exists(self._erasure_meta_path(chunk_id))
 
+    def verify_chunk(self, chunk_id: str) -> bool:
+        """Deep-verify one chunk: decode the blob, which re-checks every
+        block's CRC-64 (and, for erasure chunks, reconstructs through
+        any damaged parts).  False = the stored bytes cannot produce a
+        valid chunk — scrub material."""
+        from ytsaurus_tpu.chunks.encoding import deserialize_chunk
+        try:
+            deserialize_chunk(self._read_blob(chunk_id), hunk_store=self)
+            return True
+        except Exception:   # noqa: BLE001 — corruption surfaces as
+            # anything (CRC YtError, varint ValueError, meta KeyError):
+            # every decode failure means the stored bytes are bad.
+            return False
+
+    def _chunk_paths(self, chunk_id: str) -> "list[str]":
+        """Every file that can belong to this chunk (blob, erasure meta
+        + parts) — THE enumeration shared by remove and quarantine, so a
+        layout change cannot desync them."""
+        paths = [self._path(chunk_id)]
+        meta_path = self._erasure_meta_path(chunk_id)
+        if os.path.exists(meta_path):
+            from ytsaurus_tpu import yson
+            from ytsaurus_tpu.chunks.erasure import get_erasure_codec
+            try:
+                with open(meta_path, "rb") as f:
+                    name = yson.loads(f.read())["codec"]
+                    name = name.decode() if isinstance(name, bytes) \
+                        else name
+                    total = get_erasure_codec(name).total_parts
+            except Exception:   # noqa: BLE001 — damaged meta: sweep wide
+                total = 32
+            paths.append(meta_path)
+            paths.extend(self._part_path(chunk_id, i)
+                         for i in range(total))
+        return paths
+
+    def quarantine_chunk(self, chunk_id: str) -> None:
+        """Move a corrupt chunk's files aside (`.quarantine` suffix) so
+        the store stops advertising it while the bytes stay on disk for
+        post-mortem — the scrubber's analog of the reference marking a
+        replica as failed before the replicator re-replicates."""
+        for path in self._chunk_paths(chunk_id):
+            if os.path.exists(path):
+                os.replace(path, path + ".quarantine")
+
     def erasure_codec_of(self, chunk_id: str) -> Optional[str]:
         """Codec name when the chunk is stored erasure-coded, else None
         (lets the replicator preserve the encoding on the target)."""
@@ -158,21 +203,7 @@ class FsChunkStore:
         return codec.decode() if isinstance(codec, bytes) else codec
 
     def remove_chunk(self, chunk_id: str) -> None:
-        paths = [self._path(chunk_id)]
-        meta_path = self._erasure_meta_path(chunk_id)
-        n_parts = 0
-        if os.path.exists(meta_path):
-            from ytsaurus_tpu import yson
-            from ytsaurus_tpu.chunks.erasure import get_erasure_codec
-            try:
-                with open(meta_path, "rb") as f:
-                    n_parts = get_erasure_codec(
-                        yson.loads(f.read())["codec"]).total_parts
-            except Exception:
-                n_parts = 32           # best effort if the meta is damaged
-            paths.append(meta_path)
-            paths.extend(self._part_path(chunk_id, i) for i in range(n_parts))
-        for path in paths:
+        for path in self._chunk_paths(chunk_id):
             try:
                 os.unlink(path)
             except FileNotFoundError:

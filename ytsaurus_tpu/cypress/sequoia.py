@@ -13,12 +13,13 @@ table.  `//sys/sequoia/resolve` is an ordinary sorted dynamic table
 master's mutation stream via a post-commit listener; `resolve()` serves
 path lookups from the table — a point lookup instead of a tree walk —
 and `verify()` proves table/tree agreement (the consistency invariant
-Sequoia's migration hinges on).  Records store the RESOLVED node (links
-follow to their target, like the resolve it replaces); a transaction
-abort rolls the tree back through undo entries invisible to the
-mutation stream, so aborts trigger a full resync (metadata aborts are
-rare; the reference handles this case with Sequoia transactions, which
-is the next slice).
+Sequoia's migration hinges on).  Records store the RAW node at each
+path — a link row carries the link's own id and type "link", so link
+TRAVERSAL stays a resolver-layer concern and removing a link's target
+never invalidates the link's row.  A transaction abort rolls the tree
+back through undo entries invisible to the mutation stream, so aborts
+trigger a full resync (metadata aborts are rare; the reference handles
+this with Sequoia transactions, the next slice).
 
 Scope honesty: node CONTENT still lives in the master tree; what rides
 the table is resolution metadata.  That is exactly how the reference
@@ -55,6 +56,19 @@ def _text(value) -> str:
     return value.decode() if isinstance(value, bytes) else value
 
 
+def _canon(path: str) -> "Optional[str]":
+    """Canonical table key for a client-supplied path ('//a//b' and
+    '//a/b' address the same node and must share one row)."""
+    from ytsaurus_tpu.cypress.tree import parse_ypath
+    try:
+        tokens, attr = parse_ypath(path)
+    except YtError:
+        return None
+    if attr is not None or not tokens:
+        return None
+    return "//" + "/".join(tokens)
+
+
 class SequoiaResolver:
     """Maintains and serves the resolve table for one cluster."""
 
@@ -62,6 +76,11 @@ class SequoiaResolver:
         self.client = client
         self._revision = 0
         self._enabled = False
+        # Host-side mirror of the table's key set: subtree drops become
+        # an in-memory prefix scan + exact-key deletes, instead of a
+        # table scan under the master mutation lock (and no path text is
+        # ever spliced into QL).
+        self._paths: set = set()
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -83,10 +102,10 @@ class SequoiaResolver:
         return self
 
     def _walk_tree(self) -> "Iterator[tuple[str, object]]":
-        """(path, RESOLVED node) for every non-excluded tree path — THE
-        single walk shared by full_sync and verify, resolving through
-        links exactly like the incremental path does (try_resolve), so
-        the two sides can never drift on link semantics."""
+        """(path, RAW node) for every non-excluded tree path — THE single
+        walk shared by full_sync and verify.  Raw (no link following):
+        a link row records the link itself, so target mutations never
+        invalidate it and walks cannot loop through cyclic links."""
         tree = self.client.cluster.master.tree
         stack = [("/", tree.root)]
         while stack:
@@ -96,9 +115,7 @@ class SequoiaResolver:
                     f"{path}/{name}"
                 if _excluded(child_path):
                     continue
-                resolved = tree.try_resolve(child_path)
-                if resolved is not None:
-                    yield child_path, resolved
+                yield child_path, child
                 stack.append((child_path, child))
 
     def full_sync(self) -> int:
@@ -113,6 +130,7 @@ class SequoiaResolver:
                 RESOLVE_PATH, [(r["path"],) for r in existing])
         if rows:
             self.client.insert_rows(RESOLVE_PATH, rows)
+        self._paths = {r["path"] for r in rows}
         return len(rows)
 
     # -- incremental maintenance ----------------------------------------------
@@ -157,52 +175,57 @@ class SequoiaResolver:
         return not path or "/@" in path or _excluded(path)
 
     def _upsert(self, path: "Optional[str]") -> None:
+        path = _canon(path) if path else None
         if self._skip(path):
             return
-        node = self.client.cluster.master.tree.try_resolve(path)
+        node = self.client.cluster.master.tree.try_resolve(
+            path, follow_links=False)
         if node is None:
-            return                  # e.g. a dangling link target
+            return
         self.client.insert_rows(RESOLVE_PATH, [{
             "path": path, "node_id": node.id, "node_type": node.type,
             "revision": self._revision}])
+        self._paths.add(path)
         # Ancestors materialized by recursive creates get records too.
         parent = path.rsplit("/", 1)[0]
-        if parent and parent != "/" and not self._known(parent):
+        if parent and parent != "/" and parent not in self._paths:
             self._upsert(parent)
 
     def _upsert_subtree(self, path: "Optional[str]") -> None:
+        path = _canon(path) if path else None
         if self._skip(path):
             return
-        node = self.client.cluster.master.tree.try_resolve(path)
+        # RAW node: recursion follows real children only (a link's
+        # children are the target's business, recorded at its own path).
+        node = self.client.cluster.master.tree.try_resolve(
+            path, follow_links=False)
         if node is None:
             return
         self._upsert(path)
         for name in list(node.children):
             self._upsert_subtree(f"{path}/{name}")
 
-    def _known(self, path: str) -> bool:
-        hit = self.client.lookup_rows(RESOLVE_PATH, [(path,)])
-        return hit[0] is not None
-
     def _drop_subtree(self, path: "Optional[str]") -> None:
+        path = _canon(path) if path else None
         if self._skip(path):
             return
-        # Full-scan + host-side prefix filter: immune to quote/escape
-        # games in node names (no path text is ever spliced into QL).
-        prefix = path.rstrip("/")
-        doomed = []
-        for row in self.client.select_rows(f"path FROM [{RESOLVE_PATH}]"):
-            candidate = _text(row["path"])
-            if candidate == prefix or candidate.startswith(prefix + "/"):
-                doomed.append((candidate,))
+        doomed = [p for p in self._paths
+                  if p == path or p.startswith(path + "/")]
         if doomed:
-            self.client.delete_rows(RESOLVE_PATH, doomed)
+            self.client.delete_rows(RESOLVE_PATH,
+                                    [(p,) for p in doomed])
+            self._paths.difference_update(doomed)
 
     # -- serving ---------------------------------------------------------------
 
     def resolve(self, path: str) -> "Optional[dict]":
-        """Point lookup: {node_id, node_type} or None.  THE Sequoia win:
-        resolution is a table read, not a masters-memory tree walk."""
+        """Point lookup: {node_id, node_type} or None — the RAW node at
+        the path (a link reports type "link"; traversal is the next
+        resolver layer).  THE Sequoia win: resolution is a table read,
+        not a masters-memory tree walk."""
+        path = _canon(path)
+        if path is None:
+            return None
         (row,) = self.client.lookup_rows(RESOLVE_PATH, [(path,)])
         if row is None:
             return None

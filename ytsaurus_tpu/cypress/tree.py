@@ -107,9 +107,10 @@ class CypressTree:
                 node = self.resolve(node.attributes["target_path"])
         return node
 
-    def try_resolve(self, path: str) -> Optional[CypressNode]:
+    def try_resolve(self, path: str,
+                    follow_links: bool = True) -> Optional[CypressNode]:
         try:
-            return self.resolve(path)
+            return self.resolve(path, follow_links=follow_links)
         except YtError:
             return None
 

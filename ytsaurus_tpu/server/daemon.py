@@ -401,6 +401,26 @@ def run_node(root: str, port: int, primary_address: str,
     orchid.register("/data_node", lambda: {
         "id": node_id, "chunk_count": len(store.list_chunks())})
     orchid.register("/exec_node", lambda: exec_service.exec_stats({}, ()))
+    # Periodic checksum scrub: corrupt chunks quarantine themselves and
+    # the master's replicator restores RF from healthy holders.
+    scrub_interval = float(os.environ.get("YT_TPU_SCRUB_INTERVAL", 300))
+    scrub_state = {"checked": 0, "corrupt": 0}
+
+    def scrub_loop() -> None:
+        while True:
+            time.sleep(scrub_interval)
+            try:
+                out = service.scrub_chunks({}, ())
+                scrub_state["checked"] += out["checked"]
+                scrub_state["corrupt"] += len(out["corrupt"])
+            except Exception as exc:  # noqa: BLE001 — keep scrubbing
+                print(f"# scrub failed: {exc}", file=sys.stderr,
+                      flush=True)
+
+    if scrub_interval > 0:
+        threading.Thread(target=scrub_loop, daemon=True,
+                         name="chunk-scrubber").start()
+    orchid.register("/data_node/scrub", lambda: dict(scrub_state))
     server = RpcServer([service, exec_service,
                         OrchidService(orchid)], port=port)
     server.start()

@@ -68,6 +68,26 @@ class DataNodeService(Service):
     def list_chunks(self, body, attachments):
         return {"chunk_ids": self.store.list_chunks()}
 
+    @rpc_method(concurrency=1)
+    def scrub_chunks(self, body, attachments):
+        """Background checksum scrub (ref: the reference's disk-failure
+        detection + replica failure marks feeding the replicator):
+        deep-verify every local chunk's block CRCs; corrupt ones are
+        QUARANTINED so list_chunks stops advertising them and the
+        master's chunk replicator restores the replication factor from
+        healthy holders — with no read on the user path."""
+        corrupt: list = []
+        checked = 0
+        only = body.get("chunk_ids")
+        ids = [_text(c) for c in only] if only else \
+            self.store.list_chunks()
+        for chunk_id in ids:
+            checked += 1
+            if not self.store.verify_chunk(chunk_id):
+                self.store.quarantine_chunk(chunk_id)
+                corrupt.append(chunk_id)
+        return {"checked": checked, "corrupt": corrupt}
+
     @rpc_method()
     def replicate_chunk(self, body, attachments):
         """Push one locally-held chunk to a peer data node — the
