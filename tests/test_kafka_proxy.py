@@ -228,6 +228,45 @@ def test_fetch_respects_max_bytes(proxy):
     assert 1 <= len(msgs) < 10
 
 
+def test_fetch_long_poll_blocks_until_data(proxy):
+    """max_wait/min_bytes: a fetch at the head blocks until a producer
+    appends (or the wait elapses) instead of busy-returning empty."""
+    import threading
+    import time as _time
+
+    _produce(proxy, "lp", [(None, b"seed")])
+
+    def delayed_produce():
+        _time.sleep(0.4)
+        _produce(proxy, "lp", [(None, b"fresh")])
+
+    t = threading.Thread(target=delayed_produce)
+    t.start()
+    t0 = _time.monotonic()
+    body = i32(-1) + i32(5000) + i32(1) + array([
+        string("lp") + array([i32(0) + i64(1) + i32(1 << 20)])])
+    r = call(proxy, API_FETCH, body)
+    elapsed = _time.monotonic() - t0
+    t.join()
+    r.i32()
+    r.string()
+    r.i32()
+    r.i32()
+    assert r.i16() == 0
+    assert r.i64() == 2                     # watermark after the append
+    blob = r.bytes_() or b""
+    assert b"fresh" in blob
+    assert 0.3 < elapsed < 4.0              # blocked, then woke on data
+    # An empty poll with a short wait returns promptly and empty.
+    t0 = _time.monotonic()
+    body = i32(-1) + i32(200) + i32(1) + array([
+        string("lp") + array([i32(0) + i64(2) + i32(1 << 20)])])
+    r = call(proxy, API_FETCH, body)
+    assert _time.monotonic() - t0 < 2.0
+    r.i32(); r.string(); r.i32(); r.i32(); r.i16(); r.i64()
+    assert (r.bytes_() or b"") == b""
+
+
 def test_list_offsets(proxy):
     _produce(proxy, "off", [(None, b"a"), (None, b"b")])
     body = i32(-1) + array([

@@ -150,7 +150,6 @@ class FsChunkStore:
         block's CRC-64 (and, for erasure chunks, reconstructs through
         any damaged parts).  False = the stored bytes cannot produce a
         valid chunk — scrub material."""
-        from ytsaurus_tpu.chunks.encoding import deserialize_chunk
         try:
             deserialize_chunk(self._read_blob(chunk_id), hunk_store=self)
             return True
@@ -187,8 +186,10 @@ class FsChunkStore:
         post-mortem — the scrubber's analog of the reference marking a
         replica as failed before the replicator re-replicates."""
         for path in self._chunk_paths(chunk_id):
-            if os.path.exists(path):
+            try:
                 os.replace(path, path + ".quarantine")
+            except FileNotFoundError:
+                continue            # raced with remove/another scrub
 
     def erasure_codec_of(self, chunk_id: str) -> Optional[str]:
         """Codec name when the chunk is stored erasure-coded, else None

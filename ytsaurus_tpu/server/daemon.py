@@ -374,6 +374,15 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         kafka_proxy = KafkaProxy(client).start()
         _write_port_file(root, "kafka", kafka_proxy.port)
         print(f"kafka proxy serving on {kafka_proxy.address}", flush=True)
+    if os.environ.get("YT_TPU_SEQUOIA", "") not in ("", "0"):
+        # Sequoia resolve ground table (cypress/sequoia.py): path
+        # resolution served from a dynamic table, kept consistent off
+        # the mutation stream.
+        from ytsaurus_tpu.cypress.sequoia import SequoiaResolver
+        sequoia = SequoiaResolver(client).enable()
+        orchid.register("/sequoia", lambda: {
+            "enabled": True, "records": len(sequoia._paths)})
+        print("sequoia resolve table enabled", flush=True)
     role["value"] = "leader"
     print(f"primary serving on {server.address}"
           + (f" (leader, master {master_index})" if election else ""),

@@ -378,19 +378,51 @@ class KafkaProxy:
         return array(topic_bodies)
 
     def _fetch(self, r: Reader) -> bytes:
+        import time as _time
         r.i32()                     # replica_id
-        r.i32()                     # max_wait_ms (no long-poll yet)
-        r.i32()                     # min_bytes
+        max_wait_ms = r.i32()
+        min_bytes = r.i32()
         n_topics = r.i32()
-        topic_bodies = []
+        requests = []
         for _ in range(n_topics):
             topic = r.string()
             n_parts = r.i32()
-            part_bodies = []
+            parts = []
             for _ in range(n_parts):
-                partition = r.i32()
-                fetch_offset = r.i64()
-                max_bytes = r.i32()
+                parts.append((r.i32(), r.i64(), r.i32()))
+            requests.append((topic, parts))
+        # Kafka long-poll: block up to max_wait_ms until data exists
+        # past the requested offsets (capped — a poller must not pin a
+        # handler thread forever).  The wait polls only row counts; the
+        # full response is built ONCE on wake/timeout.  Error responses
+        # (unknown topic) and min_bytes<=0 return immediately, like a
+        # real broker.
+        deadline = _time.monotonic() + min(max(max_wait_ms, 0), 30_000) \
+            / 1000.0
+        if min_bytes > 0:
+            while _time.monotonic() < deadline:
+                ready = False
+                for topic, parts in requests:
+                    if not self._topic_exists(topic):
+                        ready = True            # error body: answer now
+                        break
+                    high = self._tablet(topic).row_count
+                    if any(offset < high for _, offset, _ in parts):
+                        ready = True
+                        break
+                if ready:
+                    break
+                _time.sleep(min(0.05,
+                                max(deadline - _time.monotonic(), 0)))
+        topic_bodies, _ = self._build_fetch(requests)
+        return array(topic_bodies)
+
+    def _build_fetch(self, requests) -> "tuple[list[bytes], int]":
+        topic_bodies = []
+        data_bytes = 0
+        for topic, parts in requests:
+            part_bodies = []
+            for partition, fetch_offset, max_bytes in parts:
                 if not self._topic_exists(topic):
                     part_bodies.append(
                         i32(partition) + i16(ERR_UNKNOWN_TOPIC) + i64(-1) +
@@ -409,11 +441,12 @@ class KafkaProxy:
                     if len(out) + len(msg) > max_bytes and out:
                         break
                     out.extend(msg)
+                data_bytes += len(out)
                 part_bodies.append(
                     i32(partition) + i16(ERR_NONE) + i64(high) +
                     bytes_(bytes(out)))
             topic_bodies.append(string(topic) + array(part_bodies))
-        return array(topic_bodies)
+        return topic_bodies, data_bytes
 
     def _list_offsets(self, r: Reader) -> bytes:
         r.i32()                     # replica_id

@@ -39,6 +39,7 @@ class DataNodeService(Service):
         self._leases: dict[str, tuple[str, float]] = {}
         self._peers: dict[str, object] = {}   # replicate_chunk channels
         self._journal_lock = threading.Lock()
+        self._scrub_lock = threading.Lock()
 
     # -- chunks ---------------------------------------------------------------
 
@@ -81,11 +82,16 @@ class DataNodeService(Service):
         only = body.get("chunk_ids")
         ids = [_text(c) for c in only] if only else \
             self.store.list_chunks()
-        for chunk_id in ids:
-            checked += 1
-            if not self.store.verify_chunk(chunk_id):
-                self.store.quarantine_chunk(chunk_id)
-                corrupt.append(chunk_id)
+        # One scrub at a time — the RPC concurrency cap does not bind
+        # the daemon's direct in-process calls, so serialize here.
+        with self._scrub_lock:
+            for chunk_id in ids:
+                if not self.store.exists(chunk_id):
+                    continue        # deleted mid-scan: not corruption
+                checked += 1
+                if not self.store.verify_chunk(chunk_id):
+                    self.store.quarantine_chunk(chunk_id)
+                    corrupt.append(chunk_id)
         return {"checked": checked, "corrupt": corrupt}
 
     @rpc_method()
