@@ -137,6 +137,29 @@ def test_operations_over_rpc(client):
         [2, 4, 6]
 
 
+def test_reduce_and_map_reduce_over_rpc(client):
+    rows = [{"k": i % 4, "v": i} for i in range(40)]
+    client.write_table("//mp/red/in", rows)
+    client.run_sort("//mp/red/in", "//mp/red/sorted", ["k"])
+
+    def reducer(key, group):
+        return [{"k": key["k"], "n": len(group)}]
+
+    op = client.run_reduce(reducer, "//mp/red/sorted", "//mp/red/out",
+                           reduce_by="k")
+    assert op.state == "completed"
+    assert {r["k"]: r["n"]
+            for r in client.read_table("//mp/red/out")} == \
+        {k: 10 for k in range(4)}
+    op = client.run_map_reduce(
+        None, reducer, "//mp/red/in", "//mp/red/mr", reduce_by="k",
+        partition_count=2)
+    assert op.state == "completed"
+    assert {r["k"]: r["n"]
+            for r in client.read_table("//mp/red/mr")} == \
+        {k: 10 for k in range(4)}
+
+
 def test_error_codes_cross_the_wire(client):
     with pytest.raises(YtError) as ei:
         client.read_table("//mp/none/such")

@@ -145,6 +145,18 @@ def test_cli_end_to_end(cluster):
     assert rc == 0 and json.loads(out)["state"] == "completed"
     rc, out = _yt(cluster, "exists", "//cli/out")
     assert rc == 0 and json.loads(out) is True
+    rc, out = _yt(cluster, "sort", "--src", "//cli/t",
+                  "--dst", "//cli/sorted", "--sort-by", "k")
+    assert rc == 0 and json.loads(out)["state"] == "completed"
+    rc, out = _yt(cluster, "reduce", "cat", "--src", "//cli/sorted",
+                  "--dst", "//cli/red", "--reduce-by", "k")
+    assert rc == 0 and json.loads(out)["state"] == "completed"
+    rc, out = _yt(cluster, "map-reduce", "cat", "--src", "//cli/t",
+                  "--dst", "//cli/mr", "--reduce-by", "k")
+    assert rc == 0 and json.loads(out)["state"] == "completed"
+    rc, out = _yt(cluster, "read-table", "//cli/mr", "--format", "json")
+    rows = [json.loads(l) for l in out.splitlines() if l.strip()]
+    assert sorted(r["k"] for r in rows) == [1, 2]
     # Errors come back as rc=1 with a structured error on stderr.
     rc, _ = _yt(cluster, "get", "//definitely/missing")
     assert rc == 1

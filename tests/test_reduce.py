@@ -1,0 +1,338 @@
+"""Reduce + MapReduce controllers.
+
+Ref model: CreateReduceController (controller_agent/controllers/
+sorted_controller.cpp:1451) — key-guarantee job slicing over sorted
+input; CreateMapReduceController (sort_controller.cpp:5029) — partition
+→ hash shuffle → per-partition sort + reduce (partition_sort_job.cpp:43).
+"""
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.operations.reduce_op import (
+    iter_groups,
+    key_aligned_ranges,
+    partition_rows,
+    stable_key_hash,
+)
+
+
+@pytest.fixture
+def client(tmp_path):
+    return connect(str(tmp_path))
+
+
+# -- slicing / grouping units --------------------------------------------------
+
+
+def test_key_aligned_ranges_cut_only_on_key_change():
+    keys = [(1,), (1,), (2,), (2,), (3,)]
+    assert key_aligned_ranges(keys, 2) == [(0, 2), (2, 4), (4, 5)]
+    assert key_aligned_ranges(keys, 3) == [(0, 4), (4, 5)]
+    assert key_aligned_ranges(keys, 100) == [(0, 5)]
+    assert key_aligned_ranges([], 10) == []
+
+
+def test_key_aligned_ranges_oversized_group_stays_whole():
+    keys = [(7,)] * 10 + [(8,)]
+    ranges = key_aligned_ranges(keys, 3)
+    # The 10-row key group cannot split; it fills one range alone.
+    assert ranges == [(0, 10), (10, 11)]
+
+
+def test_iter_groups():
+    rows = [{"k": 1, "v": 10}, {"k": 1, "v": 11}, {"k": 2, "v": 20}]
+    groups = list(iter_groups(rows, ["k"]))
+    assert [g[0] for g in groups] == [{"k": 1}, {"k": 2}]
+    assert [len(g[1]) for g in groups] == [2, 1]
+    assert list(iter_groups([], ["k"])) == []
+
+
+def test_partition_rows_stable_and_complete():
+    rows = [{"k": i % 7, "v": i} for i in range(100)]
+    parts = partition_rows(rows, ["k"], 4)
+    assert sum(len(p) for p in parts) == 100
+    # Same key never lands in two partitions.
+    for k in range(7):
+        hit = [i for i, p in enumerate(parts)
+               if any(r["k"] == k for r in p)]
+        assert len(hit) == 1
+    # Hash is process-stable (documented values guard against drift —
+    # revival re-partitions in a fresh process and must agree).
+    assert stable_key_hash((1,)) == stable_key_hash((1,))
+    assert stable_key_hash((b"a", 1)) != stable_key_hash((b"a", 2))
+
+
+# -- sorted reduce -------------------------------------------------------------
+
+
+def _oracle_counts(rows, key="k"):
+    out = {}
+    for r in rows:
+        out[r[key]] = out.get(r[key], 0) + 1
+    return out
+
+
+def test_reduce_python_counts_per_key(client):
+    rows = [{"k": i % 13, "v": i} for i in range(997)]
+    client.write_table("//in", rows)
+    client.run_sort("//in", "//sorted", sort_by=["k"])
+
+    def reducer(key, group):
+        return [{"k": key["k"], "n": len(group)}]
+
+    op = client.run_reduce(reducer, "//sorted", "//out", reduce_by="k",
+                           job_count=5)
+    assert op.state == "completed"
+    out = {r["k"]: r["n"] for r in client.read_table("//out")}
+    assert out == _oracle_counts(rows)
+
+
+def test_reduce_key_guarantee_with_tiny_jobs(client):
+    """Even with rows_per_job=1, each key group reaches ONE reducer call
+    whole (the reference's key guarantee)."""
+    rows = [{"k": i % 5, "v": i} for i in range(50)]
+    client.write_table("//in", rows)
+    client.run_sort("//in", "//sorted", sort_by=["k"])
+
+    def reducer(key, group):
+        return [{"k": key["k"], "n": len(group)}]
+
+    op = client.run_reduce(reducer, "//sorted", "//out", reduce_by="k",
+                           rows_per_job=1)
+    assert op.state == "completed"
+    assert op.result["jobs"] == 5          # one aligned stripe per key
+    out = {r["k"]: r["n"] for r in client.read_table("//out")}
+    assert out == {k: 10 for k in range(5)}
+
+
+def test_reduce_rejects_unsorted_input(client):
+    client.write_table("//in", [{"k": 3}, {"k": 1}])
+    with pytest.raises(YtError) as ei:
+        client.run_reduce(lambda key, g: [], "//in", "//out", reduce_by="k")
+    assert ei.value.find(EErrorCode.SortOrderViolation) is not None
+
+
+def test_reduce_rejects_wrong_sort_prefix(client):
+    client.write_table("//in", [{"k": 1, "s": 2}])
+    client.run_sort("//in", "//sorted", sort_by=["s", "k"])
+    with pytest.raises(YtError):
+        client.run_reduce(lambda key, g: [], "//sorted", "//out",
+                          reduce_by="k")
+
+
+def test_reduce_sort_by_must_extend_reduce_by(client):
+    client.write_table("//in", [{"k": 1, "s": 2}])
+    client.run_sort("//in", "//sorted", sort_by=["k", "s"])
+    with pytest.raises(YtError):
+        client.run_reduce(lambda key, g: [], "//sorted", "//out",
+                          reduce_by="k", sort_by=["s"])
+
+
+def test_reduce_secondary_sort_order_within_group(client):
+    """sort_by beyond reduce_by orders rows INSIDE each group (ref
+    reduce sort_by semantics)."""
+    rows = [{"k": i % 3, "s": 100 - i} for i in range(30)]
+    client.write_table("//in", rows)
+    client.run_sort("//in", "//sorted", sort_by=["k", "s"])
+
+    def reducer(key, group):
+        order = [r["s"] for r in group]
+        return [{"k": key["k"], "ordered": int(order == sorted(order))}]
+
+    op = client.run_reduce(reducer, "//sorted", "//out", reduce_by="k",
+                           sort_by=["k", "s"])
+    assert op.state == "completed"
+    assert all(r["ordered"] == 1 for r in client.read_table("//out"))
+
+
+def test_reduce_multiple_sorted_inputs_merge(client):
+    a = [{"k": i, "src": 1} for i in range(0, 20, 2)]
+    b = [{"k": i, "src": 2} for i in range(0, 20, 3)]
+    client.write_table("//a", a)
+    client.run_sort("//a", "//sa", sort_by=["k"])
+    client.write_table("//b", b)
+    client.run_sort("//b", "//sb", sort_by=["k"])
+
+    def reducer(key, group):
+        return [{"k": key["k"], "n": len(group)}]
+
+    op = client.run_reduce(reducer, ["//sa", "//sb"], "//out",
+                           reduce_by="k")
+    assert op.state == "completed"
+    oracle = _oracle_counts(a + b)
+    assert {r["k"]: r["n"] for r in client.read_table("//out")} == oracle
+
+
+def test_reduce_shell_command_streams_sorted_groups(client):
+    client.write_table("//in", [{"k": i % 4} for i in range(40)])
+    client.run_sort("//in", "//sorted", sort_by=["k"])
+    op = client.run_reduce("cat", "//sorted", "//out", reduce_by="k",
+                           job_count=3)
+    assert op.state == "completed"
+    out = [r["k"] for r in client.read_table("//out")]
+    assert out == sorted(out)          # stripes concatenate in key order
+    assert len(out) == 40
+
+
+def test_reduce_empty_input(client):
+    from ytsaurus_tpu.schema import TableSchema
+    client.write_table("//in", [],
+                       schema=TableSchema.make([("k", "int64")]))
+    client.run_sort("//in", "//sorted", sort_by=["k"])
+    op = client.run_reduce(lambda key, g: [{"boom": 1}], "//sorted",
+                           "//out", reduce_by="k")
+    assert op.state == "completed"
+    assert op.result["rows"] == 0
+    assert client.read_table("//out") == []
+
+
+# -- map_reduce ----------------------------------------------------------------
+
+
+def test_map_reduce_word_count(client):
+    docs = [{"text": f"w{i % 17} w{i % 5}"} for i in range(300)]
+    client.write_table("//docs", docs)
+
+    def mapper(rows):
+        for r in rows:
+            text = r["text"]
+            if isinstance(text, bytes):
+                text = text.decode()
+            for w in text.split():
+                yield {"word": w, "one": 1}
+
+    def reducer(key, group):
+        return [{"word": key["word"], "count": sum(r["one"]
+                                                   for r in group)}]
+
+    op = client.run_map_reduce(mapper, reducer, "//docs", "//counts",
+                               reduce_by="word", partition_count=4)
+    assert op.state == "completed"
+    assert op.result["partitions"] == 4
+    oracle: dict = {}
+    for d in docs:
+        for w in d["text"].split():
+            oracle[w] = oracle.get(w, 0) + 1
+    got = {r["word"].decode(): r["count"]
+           for r in client.read_table("//counts")}
+    assert got == oracle
+
+
+def test_map_reduce_identity_mapper(client):
+    rows = [{"k": i % 6, "v": i} for i in range(120)]
+    client.write_table("//in", rows)
+
+    def reducer(key, group):
+        return [{"k": key["k"], "total": sum(r["v"] for r in group)}]
+
+    op = client.run_map_reduce(None, reducer, "//in", "//out",
+                               reduce_by="k", partition_count=3)
+    assert op.state == "completed"
+    oracle: dict = {}
+    for r in rows:
+        oracle[r["k"]] = oracle.get(r["k"], 0) + r["v"]
+    assert {r["k"]: r["total"] for r in client.read_table("//out")} == \
+        oracle
+
+
+def test_map_reduce_commands_identity(client):
+    rows = [{"k": i % 3, "v": i} for i in range(30)]
+    client.write_table("//in", rows)
+    op = client.run_map_reduce("cat", "cat", "//in", "//out",
+                               reduce_by="k", partition_count=2)
+    assert op.state == "completed"
+    out = client.read_table("//out")
+    assert sorted((r["k"], r["v"]) for r in out) == \
+        sorted((r["k"], r["v"]) for r in rows)
+    # Each partition's stream is key-sorted before reduce.
+    assert op.result["partitions"] == 2
+
+
+def test_map_reduce_secondary_sort(client):
+    rows = [{"k": i % 3, "s": 100 - i} for i in range(60)]
+    client.write_table("//in", rows)
+
+    def reducer(key, group):
+        order = [r["s"] for r in group]
+        return [{"k": key["k"], "ordered": int(order == sorted(order))}]
+
+    op = client.run_map_reduce(None, reducer, "//in", "//out",
+                               reduce_by="k", sort_by=["k", "s"],
+                               partition_count=2)
+    assert op.state == "completed"
+    assert all(r["ordered"] == 1 for r in client.read_table("//out"))
+
+
+# -- revival -------------------------------------------------------------------
+
+
+def test_reduce_revival_skips_completed_ranges(tmp_path):
+    """Forge a crashed reduce: snapshot holds stripe 0's output; revival
+    runs only stripe 1 (plan-matched on chunk ids + ranges)."""
+    client = connect(str(tmp_path))
+    client.write_table("//in", [{"k": i // 2} for i in range(8)])
+    client.run_sort("//in", "//sorted", sort_by=["k"])
+    spec = {"command": "cat", "input_table_path": "//sorted",
+            "output_table_path": "//out", "reduce_by": ["k"],
+            "rows_per_job": 4, "format": "json"}
+    from ytsaurus_tpu.operations.scheduler import _Snapshot, _clean_spec
+    op_id = "feedc0de"
+    doc = f"//sys/operations/{op_id}"
+    client.create("document", doc, recursive=True)
+    client.set(doc + "/@operation_type", "reduce")
+    client.set(doc + "/@spec", _clean_spec(spec))
+    client.set(doc + "/@state", "running")
+    snap = _Snapshot(client, op_id, plan={
+        "kind": "reduce",
+        "input_chunk_ids": list(client.get("//sorted/@chunk_ids")),
+        "ranges": [[0, 4], [4, 8]], "command": "cat"})
+    snap.record(0, [{"k": 0, "marker": "snap"}, {"k": 1, "marker": "snap"}])
+    revived = client.scheduler.revive_operations()
+    assert [op.id for op in revived] == [op_id]
+    op = revived[0]
+    assert op.state == "completed"
+    assert op.result["revived_jobs"] == 1
+    rows = client.read_table("//out")
+    markers = [r.get("marker") for r in rows]
+    assert markers[:2] == [b"snap", b"snap"]
+    assert [r["k"] for r in rows[2:]] == [2, 2, 3, 3]
+    assert not client.exists(doc + "/@snapshot")
+
+
+def test_map_reduce_revival_skips_completed_partitions(tmp_path):
+    """Forge a crashed map_reduce with partition 0 complete: the map
+    phase re-runs (deterministic) and only partition 1 reduces."""
+    client = connect(str(tmp_path))
+    rows = [{"k": i % 4, "v": i} for i in range(20)]
+    client.write_table("//in", rows)
+    spec = {"reduce_command": "cat", "input_table_path": "//in",
+            "output_table_path": "//out", "reduce_by": ["k"],
+            "partition_count": 2, "format": "json"}
+    from ytsaurus_tpu.operations.scheduler import _Snapshot, _clean_spec
+    op_id = "0ddba11"
+    doc = f"//sys/operations/{op_id}"
+    client.create("document", doc, recursive=True)
+    client.set(doc + "/@operation_type", "map_reduce")
+    client.set(doc + "/@spec", _clean_spec(spec))
+    client.set(doc + "/@state", "running")
+    snap = _Snapshot(client, op_id, plan={
+        "kind": "map_reduce",
+        "input_chunk_ids": list(client.get("//in/@chunk_ids")),
+        "partition_count": 2, "map_command": None,
+        "reduce_command": "cat"})
+    snap.record(0, [{"marker": "p0"}])
+    revived = client.scheduler.revive_operations()
+    op = revived[0]
+    assert op.state == "completed"
+    assert op.result["revived_jobs"] == 1
+    out = client.read_table("//out")
+    # Partition 0 came from the snapshot; partition 1 re-computed.
+    expected_p1 = partition_rows(
+        [dict(r) for r in rows], ["k"], 2)[1]
+    got_markers = [r for r in out if r.get("marker") == b"p0"]
+    assert len(got_markers) == 1
+    rest = [(r["k"], r["v"]) for r in out if "marker" not in r or
+            r.get("marker") is None]
+    assert sorted(rest) == sorted((r["k"], r["v"]) for r in expected_p1)

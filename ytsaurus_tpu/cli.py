@@ -100,6 +100,20 @@ def build_parser() -> argparse.ArgumentParser:
         (("--dst",), {"required": True}),
         (("--sort-by",), {"required": True,
                           "help": "comma-separated key columns"}))
+    cmd("reduce", (("reducer_command",), {}),
+        (("--src",), {"required": True}), (("--dst",), {"required": True}),
+        (("--reduce-by",), {"required": True,
+                            "help": "comma-separated key columns"}),
+        (("--sort-by",), {"default": None}),
+        (("--format",), {"default": "json"}),
+        (("--job-count",), {"type": int, "default": None}))
+    cmd("map-reduce", (("reducer_command",), {}),
+        (("--mapper-command",), {"default": None}),
+        (("--src",), {"required": True}), (("--dst",), {"required": True}),
+        (("--reduce-by",), {"required": True}),
+        (("--sort-by",), {"default": None}),
+        (("--partition-count",), {"type": int, "default": None}),
+        (("--format",), {"default": "json"}))
     cmd("merge", (("--src",), {"required": True,
                                "help": "comma-separated input tables"}),
         (("--dst",), {"required": True}),
@@ -197,6 +211,25 @@ def _dispatch(cl, a):
         return {"operation_id": op.id, "state": op.state}
     if c == "sort":
         op = cl.run_sort(a.src, a.dst, a.sort_by.split(","))
+        return {"operation_id": op.id, "state": op.state}
+    if c == "reduce":
+        kw = {"format": a.format}
+        if a.sort_by:
+            kw["sort_by"] = a.sort_by.split(",")
+        if a.job_count:
+            kw["job_count"] = a.job_count
+        op = cl.run_reduce(a.reducer_command, a.src, a.dst,
+                           reduce_by=a.reduce_by.split(","), **kw)
+        return {"operation_id": op.id, "state": op.state}
+    if c == "map-reduce":
+        kw = {"format": a.format}
+        if a.sort_by:
+            kw["sort_by"] = a.sort_by.split(",")
+        if a.partition_count:
+            kw["partition_count"] = a.partition_count
+        op = cl.run_map_reduce(a.mapper_command, a.reducer_command,
+                               a.src, a.dst,
+                               reduce_by=a.reduce_by.split(","), **kw)
         return {"operation_id": op.id, "state": op.state}
     if c == "merge":
         op = cl.run_merge(a.src.split(","), a.dst, mode=a.mode)

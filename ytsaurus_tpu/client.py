@@ -1305,6 +1305,45 @@ class YtClient:
         return self.scheduler.start_operation(
             "erase", {"table_path": table_path, **kwargs})
 
+    def run_reduce(self, reducer: "Callable | str",
+                   input_path: "str | Sequence[str]", output_path: str,
+                   reduce_by: "str | Sequence[str]", **kwargs):
+        """Sorted reduce (ref CreateReduceController,
+        sorted_controller.cpp:1451).  reducer: a Python callable
+        (key_dict, group_rows) -> rows, or a shell COMMAND streaming
+        key-contiguous sorted rows on stdin/stdout."""
+        spec = {"output_table_path": output_path,
+                "reduce_by": reduce_by, **kwargs}
+        if isinstance(input_path, str):
+            spec["input_table_path"] = input_path
+        else:
+            spec["input_table_paths"] = list(input_path)
+        if isinstance(reducer, str):
+            spec["command"] = reducer
+        else:
+            spec["reducer"] = reducer
+        return self.scheduler.start_operation("reduce", spec)
+
+    def run_map_reduce(self, mapper: "Callable | str | None",
+                       reducer: "Callable | str", input_path: str,
+                       output_path: str,
+                       reduce_by: "str | Sequence[str]", **kwargs):
+        """MapReduce (ref CreateMapReduceController,
+        sort_controller.cpp:5029): map+partition → hash shuffle →
+        per-partition sort + reduce.  mapper may be None (identity)."""
+        spec = {"input_table_path": input_path,
+                "output_table_path": output_path,
+                "reduce_by": reduce_by, **kwargs}
+        if isinstance(mapper, str):
+            spec["map_command"] = mapper
+        elif mapper is not None:
+            spec["mapper"] = mapper
+        if isinstance(reducer, str):
+            spec["reduce_command"] = reducer
+        else:
+            spec["reducer"] = reducer
+        return self.scheduler.start_operation("map_reduce", spec)
+
     # ----------------------------------------------------------------- internals
 
     def _computed_plan(self, schema: TableSchema):

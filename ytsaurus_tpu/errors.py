@@ -47,6 +47,9 @@ class EErrorCode(enum.IntEnum):
     NoSuchOperation = 1800
     OperationFailed = 1801
 
+    # Table client (ref: yt/yt/client/table_client/public.h).
+    SortOrderViolation = 301
+
     # Journals / quorum WAL.
     JournalPositionMismatch = 1850
     JournalEpochFenced = 1851

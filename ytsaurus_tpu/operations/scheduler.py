@@ -1,4 +1,5 @@
-"""Operations: scheduler + controllers for map / merge / sort / erase.
+"""Operations: scheduler + controllers for map / merge / sort / erase /
+reduce / map_reduce.
 
 Ref mapping:
   TScheduler + StartOperation RPC      → OperationScheduler.start_operation
@@ -356,15 +357,6 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
         return {"rows": 0, "jobs": 0}
 
     op_id = op.id if op is not None else uuid.uuid4().hex
-    # Controller snapshot (ref fork+Phoenix operation snapshots,
-    # snapshot_builder.cpp): per-stripe outputs persist as chunks under
-    # @snapshot so a revived operation skips completed work.  Valid only
-    # while the deterministic stripe plan matches (input chunks + split).
-    snap = _Snapshot(client, op_id, plan={
-        "input_chunk_ids": input_chunk_ids,
-        "stripe_count": len(stripes)}) \
-        if command is not None and snapshot_ok else None
-    completed_outputs = snap.load() if snap is not None else {}
 
     # Distributed exec plane (ref server/node/exec_node/): command jobs
     # dispatch to job slots on data-node daemons whenever the cluster
@@ -469,14 +461,74 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
             return children
         return split
 
-    total = len(stripes)
+    # Controller snapshot (ref fork+Phoenix operation snapshots,
+    # snapshot_builder.cpp): per-stripe outputs persist as chunks under
+    # @snapshot so a revived operation skips completed work.  Valid only
+    # while the deterministic stripe plan matches (input chunks + split).
+    outputs, revived = _run_user_jobs(
+        client, op, job_manager, spec, stripes, make_run,
+        plan={"input_chunk_ids": input_chunk_ids,
+              "stripe_count": len(stripes)},
+        is_command=command is not None and snapshot_ok,
+        make_splitter=make_splitter if command is not None else None,
+        publish=lambda outs: client.write_table(
+            output_path, [row for part in outs for row in part],
+            schema=spec.get("output_schema")))
+    return {"rows": sum(len(part) for part in outputs),
+            "jobs": len(stripes) - revived, "revived_jobs": revived}
+
+
+def _erase_controller(client, spec: dict, op=None, job_manager=None) -> dict:
+    path = _one(spec, "table_path")
+    client._write_table_chunks(path, [])
+    return {"rows": 0}
+
+
+def _spec_keys(spec: dict, name: str, default=None) -> list[str]:
+    value = spec.get(name)
+    if value is None:           # absent OR explicitly None → default
+        value = default
+    if value is None:
+        raise YtError(f"Operation spec requires {name!r}")
+    return [value] if isinstance(value, str) else list(value)
+
+
+def _reduce_keys(spec: dict) -> "tuple[list[str], list[str]]":
+    """(reduce_by, sort_by) with sort_by defaulting to reduce_by and
+    required to extend it (ref reduce sort_by semantics)."""
+    reduce_by = _spec_keys(spec, "reduce_by")
+    sort_by = _spec_keys(spec, "sort_by", default=reduce_by)
+    if sort_by[: len(reduce_by)] != reduce_by:
+        raise YtError(f"sort_by {sort_by} must start with reduce_by "
+                      f"{reduce_by}", code=EErrorCode.QueryTypeError)
+    return reduce_by, sort_by
+
+
+def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
+                   plan: dict, is_command: bool,
+                   make_splitter=None,
+                   publish=None) -> "tuple[list, int]":
+    """Shared fan-out for the map/reduce/map_reduce user-job phases:
+    one job per work item on the JobManager, with command-job snapshot
+    revival (_Snapshot, plan-keyed) and optional straggler splitting.
+
+    make_runner(item) -> (run, preemptible);
+    make_splitter(item) -> Job.splitter (command jobs only);
+    publish(outputs) runs BEFORE snapshot cleanup so a crash between
+    output write and snapshot removal stays revivable.
+    Returns (per-item outputs in item order, revived_count)."""
+    op_id = op.id if op is not None else uuid.uuid4().hex
+    from ytsaurus_tpu.operations.jobs import Job
+
+    snapshot_ok = is_command and hasattr(client, "cluster")
+    snap = _Snapshot(client, op_id, plan=plan) if snapshot_ok else None
+    completed = snap.load() if snap is not None else {}
+    pool = spec.get("pool", "default")
+    total = len(work_items)
     if op is not None:
-        op.progress = {"total": total,
-                       "completed": len(completed_outputs)}
+        op.progress = {"total": total, "completed": len(completed)}
 
     def on_done(job) -> None:
-        # Live progress: clients polling get_operation see jobs land as
-        # they finish, not a 0→N jump at the end.
         if job.state != "completed":
             return
         if op is not None:
@@ -485,14 +537,14 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
             snap.record(job.index, job.result or [])
 
     jobs = []
-    for i, stripe in enumerate(stripes):
-        if i in completed_outputs:
+    for i, item in enumerate(work_items):
+        if i in completed:
             continue
-        run, preemptible = make_run(stripe)
+        run, preemptible = make_runner(item)
         jobs.append(Job(op_id=op_id, index=i, run=run, pool=pool,
                         preemptible=preemptible, on_done=on_done,
-                        splitter=make_splitter(stripe)
-                        if command is not None else None))
+                        splitter=make_splitter(item)
+                        if make_splitter is not None else None))
     job_manager.submit(jobs)
     try:
         job_manager.wait(jobs)
@@ -502,24 +554,263 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     finally:
         job_manager.finish_operation(op_id)
     by_index = {job.index: (job.result or []) for job in jobs}
-    out_rows: list[dict] = []
+    outputs = []
     for i in range(total):
         if i in by_index:
-            out_rows.extend(by_index[i])
+            outputs.append(by_index[i])
         else:
-            out_rows.extend(snap.read_output(completed_outputs[i]))
-    schema = spec.get("output_schema")
-    client.write_table(output_path, out_rows, schema=schema)
+            outputs.append(snap.read_output(completed[i]))
+    if publish is not None:
+        publish(outputs)
     if snap is not None:
         snap.clear()
-    return {"rows": len(out_rows), "jobs": len(jobs),
-            "revived_jobs": len(completed_outputs)}
+    return outputs, len(completed)
 
 
-def _erase_controller(client, spec: dict, op=None, job_manager=None) -> dict:
-    path = _one(spec, "table_path")
-    client._write_table_chunks(path, [])
-    return {"rows": 0}
+def _make_reduce_runner(reducer, command, reduce_by, fmt, spec):
+    """Runner factory over a LAZY key-sorted row source (rows_fn runs on
+    the job slot, not the controller thread).  Python reducers get
+    yt.wrapper-style (key_dict, group_rows) per group; command reducers
+    stream the sorted rows through job-proxy pipes (contiguous key groups
+    on stdin — the classic streaming-reduce contract)."""
+    from ytsaurus_tpu.formats import dumps_rows, loads_rows
+    from ytsaurus_tpu.operations.jobs import run_command_job
+    from ytsaurus_tpu.operations.reduce_op import iter_groups
+
+    def make(rows_fn):
+        if reducer is not None:
+            def run_py(job):
+                out: list[dict] = []
+                for key, group in iter_groups(rows_fn(), reduce_by):
+                    out.extend(reducer(key, group))
+                return out
+            return run_py, False
+
+        def run_cmd(job):
+            blob = dumps_rows(rows_fn(), fmt)
+            out = run_command_job(job, command, blob,
+                                  timeout=spec.get("job_time_limit"))
+            return loads_rows(out, fmt)
+        return run_cmd, True
+    return make
+
+
+def _sort_rows_for_reduce(rows: list, sort_by: list) -> list:
+    """Sort intermediate rows by the reduce sort key.  Device lexsort when
+    the rows are schema-uniform (the partition_sort_job analog); host
+    fallback for ragged user-job output the columnar planes reject —
+    type-ranked so mixed-type columns still admit a total order."""
+    if not rows:
+        return rows
+    try:
+        from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+        from ytsaurus_tpu.client import infer_schema
+        from ytsaurus_tpu.operations.sort_op import sort_chunk
+        chunk = ColumnarChunk.from_rows(infer_schema(rows), rows)
+        return sort_chunk(chunk, sort_by).to_rows()
+    except Exception:       # noqa: BLE001 — ragged rows: host stable sort
+        def key(row):
+            out = []
+            for k in sort_by:
+                v = row.get(k)
+                if v is None:
+                    out.append((0, 0))
+                elif isinstance(v, (bool, int, float)):
+                    out.append((1, v))
+                elif isinstance(v, bytes):
+                    out.append((2, v))
+                elif isinstance(v, str):
+                    out.append((3, v))
+                else:
+                    out.append((4, repr(v)))
+            return tuple(out)
+        return sorted(rows, key=key)
+
+
+def _reduce_controller(client, spec: dict, op=None, job_manager=None) -> dict:
+    """Sorted Reduce (ref sorted_controller.cpp:1451
+    CreateReduceController).
+
+    The reference merges sorted chunk readers and slices jobs at key
+    boundaries (the key guarantee).  Here the merge of already-sorted
+    inputs is one device lexsort over the concatenated columnar planes,
+    and stripes cut only where the reduce key changes — so every key
+    group lands in exactly one job."""
+    from ytsaurus_tpu.operations.reduce_op import (
+        decode_keys,
+        key_aligned_ranges,
+        validate_sorted_input,
+    )
+    from ytsaurus_tpu.operations.sort_op import sort_chunks
+
+    reducer = spec.get("reducer")
+    command = spec.get("command")
+    if (reducer is None) == (command is None):
+        raise YtError("reduce spec requires exactly one of reducer/command")
+    reduce_by, sort_by = _reduce_keys(spec)
+    input_paths = spec.get("input_table_paths") or \
+        [_one(spec, "input_table_path")]
+    output_path = _one(spec, "output_table_path")
+    fmt = spec.get("format", "json")
+
+    chunks = []
+    input_chunk_ids: list[str] = []
+    plan_stable = True          # chunk ids readable → snapshot plan keyed
+    for path in input_paths:
+        validate_sorted_input(client, path, reduce_by)
+        chunks.extend(client._read_table_chunks(path))
+        try:
+            input_chunk_ids.extend(client.get(path + "/@chunk_ids") or [])
+        except YtError:
+            plan_stable = False
+    chunks = [c for c in chunks if c.row_count > 0]
+    if not chunks:
+        client.write_table(output_path, [],
+                           schema=spec.get("output_schema"))
+        return {"rows": 0, "jobs": 0}
+    merged = sort_chunks(_align_schemas(chunks), sort_by)
+    keys = decode_keys(merged, reduce_by)
+    rows_per_job = spec.get("rows_per_job") or 4_000_000
+    if spec.get("job_count"):
+        rows_per_job = max(-(-len(keys) // max(int(spec["job_count"]), 1)),
+                           1)
+    ranges = key_aligned_ranges(keys, rows_per_job)
+
+    base = _make_reduce_runner(reducer, command, reduce_by, fmt, spec)
+
+    def make(rng):
+        start, end = rng
+        # Slice the merged columnar chunk lazily: rows decode on the job
+        # slot (the stripe.materialize() analog), not the controller.
+        return base(lambda: merged.slice_rows(start, end).to_rows())
+
+    outputs, revived = _run_user_jobs(
+        client, op, job_manager, spec, ranges, make,
+        plan={"kind": "reduce", "input_chunk_ids": input_chunk_ids,
+              "ranges": [list(r) for r in ranges], "command": command},
+        is_command=command is not None and plan_stable,
+        publish=lambda outs: client.write_table(
+            output_path, [row for part in outs for row in part],
+            schema=spec.get("output_schema")))
+    return {"rows": sum(len(part) for part in outputs),
+            "jobs": len(ranges) - revived, "revived_jobs": revived}
+
+
+def _map_reduce_controller(client, spec: dict, op=None,
+                           job_manager=None) -> dict:
+    """MapReduce (ref sort_controller.cpp:5029 CreateMapReduceController):
+    map+partition jobs → hash shuffle by reduce key → per-partition
+    sort + reduce jobs (partition_sort_job.cpp:43 semantics).
+
+    Redesign: the reference streams partition chunks through a partition
+    tree; here map jobs hash-route their output rows in-job (stable CRC,
+    revival-safe) and each reduce job device-sorts its partition before
+    grouping — the shuffle itself is row movement between job results,
+    not a cluster data plane, because operation intermediates are
+    operation-lifetime state."""
+    from ytsaurus_tpu.formats import dumps_rows, loads_rows
+    from ytsaurus_tpu.operations.chunk_pools import build_stripes
+    from ytsaurus_tpu.operations.jobs import run_command_job
+    from ytsaurus_tpu.operations.reduce_op import partition_rows
+
+    mapper = spec.get("mapper")
+    map_command = spec.get("map_command")
+    reducer = spec.get("reducer")
+    reduce_command = spec.get("reduce_command")
+    if (reducer is None) == (reduce_command is None):
+        raise YtError(
+            "map_reduce spec requires exactly one of reducer/reduce_command")
+    if mapper is not None and map_command is not None:
+        raise YtError("map_reduce spec allows at most one of "
+                      "mapper/map_command")
+    reduce_by, sort_by = _reduce_keys(spec)
+    input_path = _one(spec, "input_table_path")
+    output_path = _one(spec, "output_table_path")
+    fmt = spec.get("format", "json")
+    chunks = client._read_table_chunks(input_path)
+    chunks = [c for c in chunks if c.row_count > 0]
+    if not chunks:
+        client.write_table(output_path, [],
+                           schema=spec.get("output_schema"))
+        return {"rows": 0, "jobs": 0}
+    total_rows = sum(c.row_count for c in chunks)
+    rows_per_job = spec.get("rows_per_job") or 4_000_000
+    partition_count = int(spec.get("partition_count") or
+                          max(min(-(-total_rows // rows_per_job), 64), 1))
+    stripes = build_stripes(chunks, rows_per_job=rows_per_job,
+                            max_job_count=spec.get("max_job_count"))
+    # Snapshot revival is valid only when the whole pipeline is free of
+    # Python callables (commands re-run deterministically; closures don't
+    # survive a controller restart).  Dynamic tables have no stable chunk
+    # list (rows change while @chunk_ids stays fixed), so their snapshot
+    # plans would silently go stale — no revival for them, as in map.
+    def _attr(name, default):
+        try:
+            return client.get(f"{input_path}/@{name}")
+        except YtError:
+            return default
+
+    input_chunk_ids = list(_attr("chunk_ids", []) or [])
+    plan_stable = bool(input_chunk_ids) and not _attr("dynamic", False)
+    is_command = mapper is None and reducer is None and plan_stable
+
+    # -- phase 1: map + partition (each job hash-routes its own output) --------
+    def make_map(stripe):
+        def run_map(job):
+            rows = stripe.materialize().to_rows()
+            if mapper is not None:
+                rows = list(mapper(rows))
+            elif map_command is not None:
+                blob = dumps_rows(rows, fmt)
+                out = run_command_job(job, map_command, blob,
+                                      timeout=spec.get("job_time_limit"))
+                rows = loads_rows(out, fmt)
+            return partition_rows(rows, reduce_by, partition_count)
+        return run_map, map_command is not None
+
+    plan = {"kind": "map_reduce", "input_chunk_ids": input_chunk_ids,
+            "partition_count": partition_count,
+            "map_command": map_command, "reduce_command": reduce_command}
+
+    # Revival fast path: when every reduce partition is already recorded
+    # in the snapshot, skip the (deterministic) map phase entirely.
+    op_id = op.id if op is not None else uuid.uuid4().hex
+    snap_ok = is_command and hasattr(client, "cluster")
+    probe = _Snapshot(client, op_id, plan=plan) if snap_ok else None
+    pre_completed = probe.load() if probe is not None else {}
+    map_jobs_run = 0
+    if len(pre_completed) == partition_count:
+        partitions: "list[list[dict]]" = [[] for _ in range(partition_count)]
+    else:
+        buckets, _ = _run_user_jobs(
+            client, op, job_manager, spec, stripes, make_map,
+            plan={}, is_command=False)   # map phase re-runs on revival
+        map_jobs_run = len(stripes)
+        partitions = [[] for _ in range(partition_count)]
+        for job_buckets in buckets:
+            for p, rows in enumerate(job_buckets):
+                partitions[p].extend(rows)
+
+    # -- phase 2: per-partition device sort + reduce ---------------------------
+    make_reduce_base = _make_reduce_runner(
+        reducer, reduce_command, reduce_by, fmt, spec)
+
+    def make_reduce(rows):
+        # Sort runs INSIDE the job via the lazy rows_fn (the
+        # partition_sort_job analog): device lexsort on a job slot, not
+        # the controller thread.
+        return make_reduce_base(
+            lambda: _sort_rows_for_reduce(rows, sort_by))
+
+    outputs, revived = _run_user_jobs(
+        client, op, job_manager, spec, partitions, make_reduce,
+        plan=plan, is_command=is_command,
+        publish=lambda outs: client.write_table(
+            output_path, [row for part in outs for row in part],
+            schema=spec.get("output_schema")))
+    return {"rows": sum(len(part) for part in outputs),
+            "jobs": map_jobs_run + partition_count - revived,
+            "partitions": partition_count, "revived_jobs": revived}
 
 
 def _align_schemas(chunks):
@@ -557,4 +848,6 @@ _CONTROLLERS = {
     "merge": _merge_controller,
     "map": _map_controller,
     "erase": _erase_controller,
+    "reduce": _reduce_controller,
+    "map_reduce": _map_reduce_controller,
 }

@@ -353,6 +353,37 @@ class RemoteYtClient:
         return self.scheduler.start_operation(
             "erase", {"table_path": table_path, **kw})
 
+    def run_reduce(self, reducer: "Callable | str",
+                   input_path: "str | Sequence[str]", output_path: str,
+                   reduce_by, **kw):
+        spec = {"output_table_path": output_path,
+                "reduce_by": reduce_by, **kw}
+        if isinstance(input_path, str):
+            spec["input_table_path"] = input_path
+        else:
+            spec["input_table_paths"] = list(input_path)
+        if isinstance(reducer, str):
+            spec["command"] = reducer
+        else:
+            spec["reducer"] = reducer
+        return self.scheduler.start_operation("reduce", spec)
+
+    def run_map_reduce(self, mapper: "Callable | str | None",
+                       reducer: "Callable | str", input_path: str,
+                       output_path: str, reduce_by, **kw):
+        spec = {"input_table_path": input_path,
+                "output_table_path": output_path,
+                "reduce_by": reduce_by, **kw}
+        if isinstance(mapper, str):
+            spec["map_command"] = mapper
+        elif mapper is not None:
+            spec["mapper"] = mapper
+        if isinstance(reducer, str):
+            spec["reduce_command"] = reducer
+        else:
+            spec["reducer"] = reducer
+        return self.scheduler.start_operation("map_reduce", spec)
+
     # -- chunk-level IO for the local operation controllers --------------------
 
     def _read_table_chunks(self, path: str) -> list[ColumnarChunk]:
