@@ -1,0 +1,148 @@
+"""External (spill-capable) sort: HBM-budgeted range partitioning.
+
+Ref model: the Sort controller partition tree
+(controllers/sort_controller.cpp:459 — partitions sized so each final
+sort fits one job's memory), samples_fetcher key sampling, partition_job
+row routing.  Redesigned host-spill pipeline in ops/bigsort.py.
+"""
+
+import numpy as np
+import pytest
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.errors import YtError
+from ytsaurus_tpu.ops.bigsort import SpillStats, external_sort
+from ytsaurus_tpu.schema import TableSchema
+
+SCHEMA = TableSchema.make([("k", "int64"), ("v", "double")])
+
+
+def _blocks(keys: np.ndarray, block_rows: int = 5000):
+    rng = np.random.default_rng(7)
+    out = []
+    for lo in range(0, len(keys), block_rows):
+        k = keys[lo: lo + block_rows]
+        out.append(ColumnarChunk.from_arrays(
+            SCHEMA, {"k": k, "v": rng.random(len(k))}))
+    return out
+
+
+def _sorted_keys(chunks) -> np.ndarray:
+    return np.concatenate(
+        [np.asarray(c.columns["k"].data[: c.row_count]) for c in chunks]
+    ) if chunks else np.array([], dtype=np.int64)
+
+
+def test_external_sort_uniform_keys_budget_respected():
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 40, size=30_000)
+    stats = SpillStats()
+    out = list(external_sort(_blocks(keys), ["k"],
+                             budget_bytes=2000 * 18 * 2, stats=stats))
+    got = _sorted_keys(out)
+    assert (got == np.sort(keys)).all()
+    assert stats.ranges > 1                       # really partitioned
+    assert stats.peak_range_rows <= stats.budget_rows
+    # Every yielded chunk individually respects the budget too.
+    assert max(c.row_count for c in out) <= stats.budget_rows
+
+
+def test_external_sort_skewed_keys_resplit():
+    rng = np.random.default_rng(1)
+    keys = np.where(rng.random(30_000) < 0.9,
+                    rng.integers(0, 10, 30_000),
+                    rng.integers(0, 1 << 40, 30_000))
+    stats = SpillStats()
+    out = list(external_sort(_blocks(keys), ["k"],
+                             budget_bytes=2000 * 18 * 2, stats=stats))
+    assert (_sorted_keys(out) == np.sort(keys)).all()
+    assert stats.resplits > 0                     # the tree went deeper
+    # Only single-key runs (indivisible) may exceed the budget.
+    _, counts = np.unique(keys, return_counts=True)
+    biggest_dup = int(counts.max())
+    assert stats.peak_range_rows <= max(stats.budget_rows,
+                                        2 * biggest_dup)
+
+
+def test_external_sort_descending_and_small_input():
+    rng = np.random.default_rng(2)
+    keys = rng.integers(0, 1000, size=3_000)
+    out = list(external_sort(_blocks(keys, 1000), ["k"],
+                             budget_bytes=1 << 30, descending=True))
+    assert len(out) == 1                          # HBM-resident fast path
+    assert (_sorted_keys(out) == np.sort(keys)[::-1]).all()
+
+
+def test_external_sort_nulls_first_and_stats():
+    rows = [{"k": None if i % 7 == 0 else int(i * 13 % 997),
+             "v": float(i)} for i in range(3000)]
+    blocks = [ColumnarChunk.from_rows(SCHEMA, rows[i * 1000:(i + 1) * 1000])
+              for i in range(3)]
+    stats = SpillStats()
+    out = list(external_sort(blocks, ["k"], budget_bytes=500 * 18 * 2,
+                             stats=stats))
+    flat = [r["k"] for c in out for r in c.to_rows()]
+    n_null = sum(1 for r in rows if r["k"] is None)
+    assert all(x is None for x in flat[:n_null])
+    vals = [x for x in flat if x is not None]
+    assert vals == sorted(vals)
+    assert stats.spilled_rows == 3000
+    assert sum(stats.range_rows) == 3000
+
+
+def test_external_sort_multi_key():
+    rng = np.random.default_rng(3)
+    schema = TableSchema.make([("a", "int64"), ("b", "int64")])
+    a = rng.integers(0, 8, size=20_000)
+    b = rng.integers(0, 1 << 30, size=20_000)
+    blocks = [ColumnarChunk.from_arrays(
+        schema, {"a": a[lo: lo + 4000], "b": b[lo: lo + 4000]})
+        for lo in range(0, 20_000, 4000)]
+    out = list(external_sort(blocks, ["a", "b"],
+                             budget_bytes=3000 * 18 * 2))
+    got = [(r["a"], r["b"]) for c in out for r in c.to_rows()]
+    assert got == sorted(zip(a.tolist(), b.tolist()))
+
+
+def test_external_sort_rejects_string_keys():
+    schema = TableSchema.make([("s", "string")])
+    chunk = ColumnarChunk.from_rows(schema, [{"s": "x"}, {"s": "a"}])
+    with pytest.raises(YtError):
+        list(external_sort([chunk], ["s"], budget_bytes=100))
+
+
+def test_sort_controller_spill_path(tmp_path):
+    """run_sort over a tiny hbm_budget routes through the external sort
+    and publishes one sorted chunk per range; reads still see one
+    globally sorted table."""
+    from ytsaurus_tpu.client import connect
+    client = connect(str(tmp_path))
+    rng = np.random.default_rng(5)
+    rows = [{"k": int(k), "v": float(i)}
+            for i, k in enumerate(rng.integers(0, 1 << 40, size=6000))]
+    client.write_table("//in", rows)
+    op = client.run_sort("//in", "//out", sort_by=["k"],
+                         hbm_budget=1000 * 18 * 2)
+    assert op.state == "completed"
+    assert op.result["spill_ranges"] > 1
+    assert client.get("//out/@chunk_ids") and \
+        len(client.get("//out/@chunk_ids")) > 1
+    out = [r["k"] for r in client.read_table("//out")]
+    assert out == sorted(r["k"] for r in rows)
+    assert client.get("//out/@sorted_by") == ["k"]
+    # The spilled output still feeds downstream sorted consumers (reduce).
+    got = {}
+    client.run_reduce(lambda key, g: [{"k": key["k"], "n": len(g)}],
+                      "//out", "//red", reduce_by="k")
+    got = {r["k"]: r["n"] for r in client.read_table("//red")}
+    assert sum(got.values()) == 6000
+
+
+def test_external_sort_callable_suppliers():
+    rng = np.random.default_rng(4)
+    keys = rng.integers(0, 1 << 40, size=10_000)
+    blocks = _blocks(keys, 2500)
+    suppliers = [lambda c=c: c for c in blocks]
+    out = list(external_sort(suppliers, ["k"],
+                             budget_bytes=2000 * 18 * 2))
+    assert (_sorted_keys(out) == np.sort(keys)).all()

@@ -157,6 +157,9 @@ def test_cli_end_to_end(cluster):
     rc, out = _yt(cluster, "read-table", "//cli/mr", "--format", "json")
     rows = [json.loads(l) for l in out.splitlines() if l.strip()]
     assert sorted(r["k"] for r in rows) == [1, 2]
+    rc, out = _yt(cluster, "vanilla", "--tasks",
+                  '{"t": {"job_count": 2, "command": "true"}}')
+    assert rc == 0 and json.loads(out)["state"] == "completed"
     # Errors come back as rc=1 with a structured error on stderr.
     rc, _ = _yt(cluster, "get", "//definitely/missing")
     assert rc == 1

@@ -1,0 +1,188 @@
+"""Vanilla (gang) + remote-copy operations.
+
+Ref model: vanilla_controller.cpp:130 (named tasks × job_count, gang
+restart discipline — the CHYT-clique hosting primitive) and
+controllers/remote_copy_controller.cpp (cross-cluster table pull).
+"""
+
+import socket
+import time
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.errors import EErrorCode, YtError
+
+
+@pytest.fixture
+def client(tmp_path):
+    return connect(str(tmp_path))
+
+
+def test_vanilla_python_tasks(client):
+    def worker(task_name, rank):
+        return [{"task": task_name, "rank": rank}]
+
+    op = client.run_vanilla({
+        "alpha": {"job_count": 3, "callable": worker},
+        "beta": {"job_count": 1, "callable": worker},
+    })
+    assert op.state == "completed"
+    assert op.result["jobs"] == 4
+    assert op.result["gang_restarts"] == 0
+    assert op.result["task_output_rows"] == {"alpha": 3, "beta": 1}
+
+
+def test_vanilla_command_output_table(client):
+    op = client.run_vanilla({
+        "emit": {"job_count": 2,
+                 "command": 'echo "{\\"cookie\\": $YT_JOB_COOKIE}"',
+                 "output_table_path": "//vanilla_out"},
+    })
+    assert op.state == "completed"
+    rows = client.read_table("//vanilla_out")
+    assert sorted(r["cookie"] for r in rows) == [0, 1]
+
+
+def test_vanilla_gang_restart_on_any_failure(client, tmp_path):
+    """One flaky job's failure restarts the WHOLE gang: the steady task
+    re-runs too (counted via an append file)."""
+    flag = tmp_path / "flag"
+    count = tmp_path / "count"
+    op = client.run_vanilla({
+        "flaky": {"job_count": 1,
+                  "command": f'if [ ! -f {flag} ]; then touch {flag}; '
+                             f'exit 1; fi'},
+        "steady": {"job_count": 1,
+                   "command": f'echo run >> {count}'},
+    })
+    assert op.state == "completed"
+    assert op.result["gang_restarts"] == 1
+    assert count.read_text().count("run") == 2      # gang-wide restart
+
+
+def test_vanilla_failing_sibling_condemns_long_lived_mate(client):
+    """A failing rank must kill a still-running (long-lived) rank mate
+    promptly — the gang wait short-circuits on first casualty instead of
+    waiting for every job to exit on its own."""
+    t0 = time.monotonic()
+    with pytest.raises(YtError):
+        client.run_vanilla({
+            "server": {"job_count": 1, "command": "sleep 600"},
+            "worker": {"job_count": 1, "command": "exit 1"},
+        }, max_gang_restarts=0)
+    assert time.monotonic() - t0 < 30      # nowhere near sleep 600
+
+
+def test_vanilla_gang_exhausts_restarts(client, tmp_path):
+    with pytest.raises(YtError) as ei:
+        client.run_vanilla({
+            "doomed": {"job_count": 1, "command": "exit 3"},
+        }, max_gang_restarts=1)
+    assert "exit code 3" in str(ei.value.to_dict())
+
+
+def test_vanilla_gang_all_or_nothing_slots(client):
+    """A gang larger than the slot pool is rejected up front (partial
+    acquisition would deadlock)."""
+    slots = client.scheduler.job_manager.slots
+    with pytest.raises(YtError) as ei:
+        client.run_vanilla({
+            "big": {"job_count": slots + 1, "command": "true"},
+        })
+    assert "all-or-nothing" in str(ei.value)
+
+
+def test_vanilla_hosts_long_lived_server_until_abort(client, tmp_path):
+    """The clique pattern: an async vanilla op runs a real TCP server;
+    clients talk to it; abort_operation tears it down."""
+    port = _free_port()
+    script = tmp_path / "server.py"
+    script.write_text(
+        "import socket, sys\n"
+        "s = socket.socket()\n"
+        "s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+        "s.bind(('127.0.0.1', int(sys.argv[1])))\n"
+        "s.listen(1)\n"
+        "while True:\n"
+        "    c, _ = s.accept()\n"
+        "    c.sendall(b'pong')\n"
+        "    c.close()\n")
+    op = client.run_vanilla({
+        "clique": {"job_count": 1,
+                   "command": f"exec python3 {script} {port}"},
+    }, sync=False)
+    reply = None
+    for _ in range(100):                 # server needs a moment to bind
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=1) as conn:
+                reply = conn.recv(16)
+            break
+        except OSError:
+            time.sleep(0.1)
+    assert reply == b"pong"
+    assert op.state == "running"
+    client.abort_operation(op.id)
+    assert op.state == "aborted"
+    # The server process dies with the operation.
+    for _ in range(50):
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=0.2):
+                pass
+            time.sleep(0.1)
+        except OSError:
+            break
+    else:
+        pytest.fail("server survived operation abort")
+    # The controller thread must not resurrect the op as completed.
+    time.sleep(0.3)
+    assert op.state == "aborted"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- remote copy ---------------------------------------------------------------
+
+
+def test_remote_copy_between_clusters(tmp_path):
+    from ytsaurus_tpu.environment import LocalCluster
+    from ytsaurus_tpu.remote_client import connect_remote
+
+    with LocalCluster(str(tmp_path / "src"), n_nodes=1) as src_cluster:
+        src = connect_remote(src_cluster.primary_address)
+        rows = [{"k": i, "v": f"r{i}"} for i in range(50)]
+        src.write_table("//exports/t", rows)
+        src.run_sort("//exports/t", "//exports/sorted", ["k"])
+        src.set("//exports/sorted/@note", "from-src")
+
+        dst = connect(str(tmp_path / "dst"))
+        op = dst.run_remote_copy(src_cluster.primary_address,
+                                 "//exports/sorted", "//imported",
+                                 attribute_keys=["note"])
+        assert op.state == "completed"
+        assert op.result["rows"] == 50
+        got = dst.read_table("//imported")
+        assert [r["k"] for r in got] == list(range(50))
+        assert got[0]["v"] == b"r0"
+        assert dst.get("//imported/@sorted_by") == ["k"]
+        assert dst.get("//imported/@note") == "from-src"
+        # Sorted output feeds a local reduce directly.
+        dst.run_reduce(lambda key, g: [{"k": key["k"]}], "//imported",
+                       "//red", reduce_by="k")
+        assert len(dst.read_table("//red")) == 50
+        src.close()
+
+
+def test_remote_copy_missing_table_fails(client):
+    from ytsaurus_tpu.environment import LocalCluster
+    import tempfile
+    with LocalCluster(tempfile.mkdtemp(), n_nodes=1) as src_cluster:
+        with pytest.raises(YtError):
+            client.run_remote_copy(src_cluster.primary_address,
+                                   "//no/such", "//out")

@@ -119,6 +119,15 @@ def build_parser() -> argparse.ArgumentParser:
         (("--dst",), {"required": True}),
         (("--mode",), {"default": "unordered"}))
     cmd("erase", (("path",), {}))
+    cmd("vanilla", (("--tasks",), {"required": True,
+                                   "help": "JSON: {name: {job_count, "
+                                           "command}}"}),
+        (("--max-gang-restarts",), {"type": int, "default": 2}))
+    cmd("remote-copy", (("--cluster",), {"required": True,
+                                         "help": "source cluster "
+                                                 "host:port"}),
+        (("--src",), {"required": True}), (("--dst",), {"required": True}))
+    cmd("abort-op", (("op_id",), {}))
     cmd("start-tx")
     cmd("commit-tx", (("tx",), {}))
     cmd("abort-tx", (("tx",), {}))
@@ -236,6 +245,18 @@ def _dispatch(cl, a):
         return {"operation_id": op.id, "state": op.state}
     if c == "erase":
         op = cl.run_erase(a.path)
+        return {"operation_id": op.id, "state": op.state}
+    if c == "vanilla":
+        op = cl.run_vanilla(json.loads(a.tasks),
+                            max_gang_restarts=a.max_gang_restarts)
+        return {"operation_id": op.id, "state": op.state,
+                "result": op.result}
+    if c == "remote-copy":
+        op = cl.run_remote_copy(a.cluster, a.src, a.dst)
+        return {"operation_id": op.id, "state": op.state,
+                "result": op.result}
+    if c == "abort-op":
+        op = cl.abort_operation(a.op_id)
         return {"operation_id": op.id, "state": op.state}
     if c == "start-tx":
         return cl.start_tx()

@@ -1344,6 +1344,26 @@ class YtClient:
             spec["reducer"] = reducer
         return self.scheduler.start_operation("map_reduce", spec)
 
+    def run_vanilla(self, tasks: dict, sync: bool = True, **kwargs):
+        """Gang operation with no input (ref vanilla_controller.cpp:130):
+        tasks = {name: {"job_count": N, "command": ... | "callable": ...}}.
+        sync=False hosts long-lived server commands (the clique pattern);
+        stop them with abort_operation."""
+        return self.scheduler.start_operation(
+            "vanilla", {"tasks": tasks, **kwargs}, sync=sync)
+
+    def run_remote_copy(self, cluster_address: str, input_path: str,
+                        output_path: str, **kwargs):
+        """Copy a table from another cluster (ref
+        remote_copy_controller.cpp)."""
+        return self.scheduler.start_operation("remote_copy", {
+            "cluster_address": cluster_address,
+            "input_table_path": input_path,
+            "output_table_path": output_path, **kwargs})
+
+    def abort_operation(self, op_id: str):
+        return self.scheduler.abort_operation(op_id)
+
     # ----------------------------------------------------------------- internals
 
     def _computed_plan(self, schema: TableSchema):

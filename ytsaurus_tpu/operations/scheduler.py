@@ -102,6 +102,21 @@ class OperationScheduler:
     def list_operations(self) -> list[Operation]:
         return list(self._operations.values())
 
+    def abort_operation(self, op_id: str) -> Operation:
+        """Abort a running operation: kill its jobs, mark it aborted (ref
+        scheduler.cpp AbortOperation).  The aborted state is terminal —
+        the controller thread must not overwrite it with completed."""
+        op = self.get_operation(op_id)
+        with self._lock:
+            if op.state in ("completed", "failed", "aborted"):
+                return op
+            op.state = "aborted"
+            op.error = YtError("operation aborted",
+                               code=EErrorCode.Canceled).to_dict()
+        self.job_manager.abort_operation(op_id)
+        self._record(op)
+        return op
+
     def revive_operations(self) -> list[Operation]:
         """Re-run operations a dead controller left pending/running (ref
         revival from snapshots, snapshot_downloader.cpp).  Command-job map
@@ -139,7 +154,12 @@ class OperationScheduler:
     # -- lifecycle -------------------------------------------------------------
 
     def _run(self, op: Operation) -> None:
-        op.state = "running"
+        # State transitions race with abort_operation (async ops): every
+        # transition takes the lock, and aborted is terminal.
+        with self._lock:
+            if op.state == "aborted":
+                return                      # aborted before the thread ran
+            op.state = "running"
         self._record(op)
         try:
             controller = _CONTROLLERS.get(op.type)
@@ -148,17 +168,25 @@ class OperationScheduler:
                               code=EErrorCode.OperationFailed)
             result = controller(self.client, op.spec, op=op,
                                 job_manager=self.job_manager)
-            op.result = result or {}
-            op.state = "completed"
+            with self._lock:
+                if op.state != "aborted":
+                    op.result = result or {}
+                    op.state = "completed"
         except YtError as e:
-            op.state = "failed"
-            op.error = e.to_dict()
+            with self._lock:
+                if op.state != "aborted":
+                    op.state = "failed"
+                    op.error = e.to_dict()
         except Exception as e:                      # noqa: BLE001
-            op.state = "failed"
-            op.error = YtError(
-                f"Operation crashed: {e}",
-                code=EErrorCode.OperationFailed,
-                attributes={"traceback": traceback.format_exc()}).to_dict()
+            with self._lock:
+                if op.state != "aborted":
+                    op.state = "failed"
+                    op.error = YtError(
+                        f"Operation crashed: {e}",
+                        code=EErrorCode.OperationFailed,
+                        attributes={
+                            "traceback":
+                                traceback.format_exc()}).to_dict()
         self._record(op)
         if op.state == "failed" and op.spec.get("raise_on_failure", True):
             raise YtError.from_dict(op.error)
@@ -179,7 +207,14 @@ class OperationScheduler:
 
 
 def _clean_spec(spec: dict) -> dict:
-    return {k: v for k, v in spec.items() if not callable(v)}
+    """Strip Python callables (any nesting depth) before persisting the
+    spec to Cypress — vanilla specs nest them under tasks.<name>."""
+    out = {}
+    for k, v in spec.items():
+        if callable(v):
+            continue
+        out[k] = _clean_spec(v) if isinstance(v, dict) else v
+    return out
 
 
 class _Snapshot:
@@ -251,8 +286,15 @@ class _Snapshot:
 
 
 def _sort_controller(client, spec: dict, op=None, job_manager=None) -> dict:
-    """Ref: sort_controller.cpp — here: read input chunks, device sort (or
-    mesh shuffle when a mesh is attached), write output."""
+    """Ref: sort_controller.cpp — read input chunks, device sort (or mesh
+    shuffle when a mesh is attached), write output.  Inputs whose device
+    footprint exceeds the HBM budget go through the external sort
+    (ops/bigsort: range partition + host spill + per-range sorts — the
+    partition-tree analog of sort_controller.cpp:459), producing one
+    sorted output chunk per range instead of one giant resident table."""
+    import os as _os
+
+    from ytsaurus_tpu.operations.chunk_pools import chunk_data_weight
     from ytsaurus_tpu.operations.sort_op import sort_chunks
 
     input_path = _one(spec, "input_table_path")
@@ -260,12 +302,29 @@ def _sort_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     sort_by = spec["sort_by"]
     if isinstance(sort_by, str):
         sort_by = [sort_by]
+    descending = spec.get("descending", False)
     chunks = client._read_table_chunks(input_path)
     if not chunks:
         client._write_table_chunks(output_path, [], sorted_by=sort_by)
         return {"rows": 0}
-    out = sort_chunks(chunks, sort_by,
-                      descending=spec.get("descending", False))
+    budget = int(spec.get("hbm_budget") or
+                 _os.environ.get("YT_TPU_HBM_BUDGET", 8 << 30))
+    total_weight = sum(chunk_data_weight(c) for c in chunks)
+    numeric_only = all(
+        col.dictionary is None and col.host_values is None
+        for c in chunks for col in c.columns.values())
+    if total_weight * 2 > budget and numeric_only:
+        from ytsaurus_tpu.ops.bigsort import SpillStats, external_sort
+        stats = SpillStats()
+        outs = list(external_sort(chunks, sort_by, budget_bytes=budget,
+                                  descending=descending, stats=stats))
+        client._write_table_chunks(
+            output_path, outs, sorted_by=sort_by,
+            schema=outs[0].schema if outs else None)
+        return {"rows": sum(c.row_count for c in outs),
+                "spill_ranges": stats.ranges,
+                "resplits": stats.resplits}
+    out = sort_chunks(chunks, sort_by, descending=descending)
     client._write_table_chunks(output_path, [out], sorted_by=sort_by,
                                schema=out.schema)
     return {"rows": out.row_count}
@@ -813,6 +872,179 @@ def _map_reduce_controller(client, spec: dict, op=None,
             "partitions": partition_count, "revived_jobs": revived}
 
 
+def _vanilla_controller(client, spec: dict, op=None,
+                        job_manager=None) -> dict:
+    """Vanilla (gang) operations (ref vanilla_controller.cpp:130): named
+    tasks × job_count jobs with NO input tables — the hosting primitive
+    for CHYT cliques and everything strawberry-shaped.
+
+    Gang semantics: the whole gang must fit the slot pool (all-or-nothing
+    acquisition — a partial gang would deadlock the cluster), and ANY job
+    failure restarts the ENTIRE gang (ref vanilla_controller.cpp gang
+    rank restart), up to max_gang_restarts.  Long-lived commands (servers)
+    run until the operation is aborted."""
+    from ytsaurus_tpu.formats import loads_rows
+    from ytsaurus_tpu.operations.jobs import Job, run_command_job
+
+    tasks = spec.get("tasks")
+    if not tasks or not isinstance(tasks, dict):
+        raise YtError("vanilla spec requires tasks: {name: {...}}")
+    gang = bool(spec.get("gang", True))
+    max_restarts = int(spec.get("max_gang_restarts", 2))
+    fmt = spec.get("format", "json")
+    pool = spec.get("pool", "default")
+    op_id = op.id if op is not None else uuid.uuid4().hex
+
+    plans = []                       # (task_name, job_count, runner spec)
+    total = 0
+    for name in sorted(tasks):
+        task = tasks[name]
+        job_count = int(task.get("job_count", 1))
+        if job_count < 1:
+            raise YtError(f"vanilla task {name!r}: job_count must be >= 1")
+        command = task.get("command")
+        fn = task.get("callable")
+        if (command is None) == (fn is None):
+            raise YtError(f"vanilla task {name!r} requires exactly one "
+                          "of command/callable")
+        plans.append((name, job_count, command, fn, task))
+        total += job_count
+    if gang and total > job_manager.slots:
+        raise YtError(
+            f"vanilla gang of {total} jobs cannot acquire "
+            f"{job_manager.slots} slots (all-or-nothing scheduling)",
+            code=EErrorCode.OperationFailed)
+
+    attempt = 0
+    while True:
+        jobs: list = []
+        index = 0
+        for name, job_count, command, fn, task in plans:
+            for rank in range(job_count):
+                if command is not None:
+                    def run_cmd(job, _cmd=command, _name=name,
+                                _rank=rank, _task=task):
+                        out = run_command_job(
+                            job, _cmd, b"",
+                            timeout=_task.get("job_time_limit") or
+                            spec.get("job_time_limit"),
+                            env={"YT_TASK_NAME": _name,
+                                 "YT_JOB_COOKIE": str(_rank),
+                                 **(_task.get("environment") or {})})
+                        return loads_rows(out, fmt) if out.strip() else []
+                    run, preemptible = run_cmd, True
+                else:
+                    def run_py(job, _fn=fn, _name=name, _rank=rank):
+                        return list(_fn(_name, _rank) or [])
+                    run, preemptible = run_py, False
+                jobs.append(Job(op_id=op_id, index=index, run=run,
+                                pool=pool, preemptible=preemptible))
+                index += 1
+        if op is not None:
+            op.progress = {"total": total, "completed": 0,
+                           "gang_attempt": attempt}
+        # Gang wait with FIRST-casualty short-circuit: a failing sibling
+        # must condemn still-running (possibly long-lived) rank mates
+        # immediately, not after they exit on their own.
+        wake = threading.Event()
+        for job in jobs:
+            job.on_done = lambda _job: wake.set()
+        job_manager.submit(jobs)
+        try:
+            while True:
+                states = [j.state for j in jobs]
+                if all(s == "completed" for s in states):
+                    break
+                if any(s in ("failed", "aborted") for s in states):
+                    break
+                if op is not None and op.state == "aborted":
+                    break
+                wake.wait(0.2)
+                wake.clear()
+        finally:
+            job_manager.finish_operation(op_id)
+        if all(j.state == "completed" for j in jobs):
+            break
+        # Gang discipline: one casualty condemns the whole rank set.
+        job_manager.abort_operation(op_id)
+        if op is not None and op.state == "aborted":
+            raise YtError("operation aborted", code=EErrorCode.Canceled)
+        attempt += 1
+        first_error = next((j.error for j in jobs if j.error is not None),
+                           None)
+        if attempt > max_restarts:
+            raise first_error or YtError(
+                "vanilla gang failed", code=EErrorCode.OperationFailed)
+
+    # Optional per-task output tables (ref vanilla output table specs).
+    outputs: dict = {}
+    cursor = 0
+    for name, job_count, _command, _fn, task in plans:
+        rows = [row for job in jobs[cursor: cursor + job_count]
+                for row in (job.result or [])]
+        cursor += job_count
+        outputs[name] = len(rows)
+        out_path = task.get("output_table_path")
+        if out_path:
+            client.write_table(out_path, rows,
+                               schema=task.get("output_schema"))
+    return {"jobs": total, "gang_restarts": attempt,
+            "task_output_rows": outputs}
+
+
+def _remote_copy_controller(client, spec: dict, op=None,
+                            job_manager=None) -> dict:
+    """Remote copy (ref controllers/remote_copy_controller.cpp): pull a
+    table from ANOTHER cluster into this one through the remote thin
+    client — chunk-shaped reads on the source, ordinary chunk publishes
+    on the destination, schema + sort order preserved."""
+    from ytsaurus_tpu.remote_client import connect_remote
+
+    cluster_address = spec.get("cluster_address") or \
+        spec.get("cluster_connection")
+    if not cluster_address:
+        raise YtError("remote_copy spec requires cluster_address")
+    input_path = _one(spec, "input_table_path")
+    output_path = _one(spec, "output_table_path")
+    src = connect_remote(cluster_address)
+    try:
+        chunks = src._read_table_chunks(input_path)
+        schema = None
+        sorted_by = None
+        try:
+            schema_dict = src.get(input_path + "/@schema")
+            if schema_dict:
+                from ytsaurus_tpu.schema import TableSchema
+                schema = TableSchema.from_dict(schema_dict)
+        except YtError:
+            pass
+        try:
+            sorted_by = src.get(input_path + "/@sorted_by")
+        except YtError:
+            sorted_by = None
+        chunks = [c for c in chunks if c.row_count > 0]
+        client._write_table_chunks(output_path, chunks,
+                                   sorted_by=sorted_by, schema=schema)
+        # User attributes ride along (ref remote copy attribute keys).
+        # They were requested EXPLICITLY: a missing one is an error, not
+        # a silent drop.
+        missing = []
+        for key in spec.get("attribute_keys") or []:
+            try:
+                client.set(f"{output_path}/@{key}",
+                           src.get(f"{input_path}/@{key}"))
+            except YtError:
+                missing.append(key)
+        if missing:
+            raise YtError(
+                f"remote_copy: requested attribute_keys {missing} absent "
+                f"on {input_path!r}", code=EErrorCode.ResolveError)
+        return {"rows": sum(c.row_count for c in chunks),
+                "chunks": len(chunks)}
+    finally:
+        src.close()
+
+
 def _align_schemas(chunks):
     """Inputs from different tables may agree on columns but differ in order
     or sort annotations; align them onto one unsorted schema for merging."""
@@ -850,4 +1082,6 @@ _CONTROLLERS = {
     "erase": _erase_controller,
     "reduce": _reduce_controller,
     "map_reduce": _map_reduce_controller,
+    "vanilla": _vanilla_controller,
+    "remote_copy": _remote_copy_controller,
 }

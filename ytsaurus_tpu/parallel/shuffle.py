@@ -70,6 +70,20 @@ def _partition_ids(row_planes, pivot_planes, n_pivots: int) -> jax.Array:
     return pid
 
 
+def quantile_pivots(sample_rows: "list[tuple]", n: int,
+                    key_arity: int) -> "list[tuple]":
+    """n-1 quantile pivots from sampled (valid, value) key tuples; the
+    shared samples→bounds step of every range-partition path (ref
+    partitioning_parameters_evaluator.cpp)."""
+    sample_rows = sorted(sample_rows)
+    pivots = []
+    for j in range(1, n):
+        pivots.append(sample_rows[(j * len(sample_rows)) // n]
+                      if sample_rows
+                      else tuple((False, 0) for _ in range(key_arity)))
+    return pivots
+
+
 def _sample_pivots(table: ShardedTable, key_names: list[str],
                    samples_per_shard: int = 256) -> list[tuple]:
     """Host-side: evenly sample keys from every shard, take quantile pivots.
@@ -99,12 +113,7 @@ def _sample_pivots(table: ShardedTable, key_names: list[str],
         sample_rows.append(tuple(
             (bool(key_data[name][1][i]), key_data[name][0][i].item())
             for name in key_names))
-    sample_rows.sort()
-    pivots = []
-    for j in range(1, n):
-        pivots.append(sample_rows[(j * len(sample_rows)) // n]
-                      if sample_rows else tuple((False, 0) for _ in key_names))
-    return pivots
+    return quantile_pivots(sample_rows, n, len(key_names))
 
 
 def route_rows(planes: dict, pid: jax.Array, n: int, quota: int,

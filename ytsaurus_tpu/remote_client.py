@@ -384,6 +384,20 @@ class RemoteYtClient:
             spec["reducer"] = reducer
         return self.scheduler.start_operation("map_reduce", spec)
 
+    def run_vanilla(self, tasks: dict, sync: bool = True, **kw):
+        return self.scheduler.start_operation(
+            "vanilla", {"tasks": tasks, **kw}, sync=sync)
+
+    def run_remote_copy(self, cluster_address: str, input_path: str,
+                        output_path: str, **kw):
+        return self.scheduler.start_operation("remote_copy", {
+            "cluster_address": cluster_address,
+            "input_table_path": input_path,
+            "output_table_path": output_path, **kw})
+
+    def abort_operation(self, op_id: str):
+        return self.scheduler.abort_operation(op_id)
+
     # -- chunk-level IO for the local operation controllers --------------------
 
     def _read_table_chunks(self, path: str) -> list[ColumnarChunk]:
