@@ -155,3 +155,206 @@ def test_under_mutation_load_stays_consistent(resolver):
     assert seq.verify() == []
     assert seq.resolve("//load/d2") is not None
     assert seq.resolve("//load/d6") is None       # removed at i=7
+
+
+# -- slice 2: per-object records + cypress-proxy read path ---------------------
+
+
+def test_read_path_serves_from_tables_only(resolver):
+    """get/list/exists/attributes answered WITHOUT touching the master
+    tree (cypress_proxy-style)."""
+    client, seq = resolver
+    client.create("map_node", "//app", recursive=True)
+    client.create("document", "//app/config")
+    client.set("//app/config", {"threads": 8, "name": "q"})
+    client.set("//app/config/@owner", "alice")
+    client.create("document", "//app/flag")
+    client.set("//app/flag", 7)
+
+    # The USER subtree must never be resolved through the master tree
+    # during proxy reads (the ground tables' own paths legitimately are —
+    # in the reference they live on the ground cluster).
+    tree = client.cluster.master.tree
+    real_try, real_resolve = tree.try_resolve, tree.resolve
+
+    def _guard(path):
+        assert not str(path).startswith("//app"), \
+            "proxy read resolved a user path via the master tree"
+
+    def guarded_try(path, *a, **k):
+        _guard(path)
+        return real_try(path, *a, **k)
+
+    def guarded_resolve(path, *a, **k):
+        _guard(path)
+        return real_resolve(path, *a, **k)
+    tree.try_resolve, tree.resolve = guarded_try, guarded_resolve
+    try:
+        assert seq.read_exists("//app/config")
+        assert not seq.read_exists("//app/ghost")
+        assert sorted(seq.read_list("//app")) == ["config", "flag"]
+        assert seq.read_get("//app/config") == {"threads": 8, "name": "q"}
+        assert seq.read_get("//app/flag") == 7
+        assert seq.read_get("//app") == {
+            "config": {"threads": 8, "name": "q"}, "flag": 7}
+        assert seq.read_attribute("//app/config", "owner") == "alice"
+    finally:
+        tree.try_resolve, tree.resolve = real_try, real_resolve
+    assert seq.verify() == []
+
+
+def test_attribute_edits_refresh_node_records(resolver):
+    client, seq = resolver
+    client.create("document", "//rec", recursive=True)
+    client.set("//rec/@color", "red")
+    assert seq.read_attribute("//rec", "color") == "red"
+    client.set("//rec/@color", "blue")
+    assert seq.read_attribute("//rec", "color") == "blue"
+    client.remove("//rec/@color")
+    with pytest.raises(Exception):
+        seq.read_attribute("//rec", "color")
+    assert seq.verify() == []
+
+
+def test_tx_abort_is_scoped_not_full_resync(resolver):
+    """The abort resync touches only the aborted paths: full_sync must
+    NOT run (abort-scoped undo replacing the slice-1 full resync)."""
+    client, seq = resolver
+    client.create("document", "//stable/keep", recursive=True)
+    calls = {"n": 0}
+    real_full_sync = seq.full_sync
+
+    def counting_full_sync():
+        calls["n"] += 1
+        return real_full_sync()
+    seq.full_sync = counting_full_sync
+    tx = client.start_tx()
+    client.create("document", "//txa/b", recursive=True, tx=tx)
+    client.set("//stable/keep", {"v": 1}, tx=tx)
+    client.abort_tx(tx)
+    assert calls["n"] == 0                      # scoped, not full
+    assert seq.resolve("//txa/b") is None
+    assert seq.resolve("//txa") is None
+    assert seq.read_get("//stable/keep") is None    # rolled-back value
+    assert seq.verify() == []
+
+
+def test_tx_commit_rolls_back_uncommitted_children_scoped(resolver):
+    client, seq = resolver
+    calls = {"n": 0}
+    real_full_sync = seq.full_sync
+    seq.full_sync = lambda: calls.__setitem__("n", calls["n"] + 1) or \
+        real_full_sync()
+    outer = client.start_tx()
+    inner = client.start_tx(parent=outer)
+    client.create("document", "//nested/child", recursive=True, tx=inner)
+    client.commit_tx(outer)      # inner never committed → rolled back
+    assert calls["n"] == 0
+    assert seq.resolve("//nested/child") is None
+    assert seq.verify() == []
+
+
+def test_verify_detects_orphan_children_edge(resolver):
+    """A stale children row (ghost edge) is a divergence full_sync must
+    repair — verify() may not silently pass it."""
+    from ytsaurus_tpu.cypress.sequoia import CHILDREN_PATH
+    client, seq = resolver
+    client.create("map_node", "//par", recursive=True)
+    parent_id = seq.resolve("//par")["node_id"]
+    assert seq.verify() == []
+    client.insert_rows(CHILDREN_PATH, [{
+        "parent_id": parent_id, "child_key": "ghost",
+        "child_id": "deadbeef"}])
+    assert seq.verify() != []
+    seq.full_sync()
+    assert seq.verify() == []
+    assert seq.read_list("//par") == []
+
+
+def test_multiprocess_randomized_workload_stays_consistent(tmp_path,
+                                                           monkeypatch):
+    """The slice-2 'Done' criterion over REAL processes: a remote client
+    runs a randomized create/copy/remove/set/abort workload against a
+    live cluster with Sequoia enabled; verify() (via orchid) proves the
+    ground tables agree with the tree."""
+    import random
+
+    from ytsaurus_tpu.environment import LocalCluster
+    from ytsaurus_tpu.remote_client import connect_remote
+    from ytsaurus_tpu.rpc import Channel
+
+    monkeypatch.setenv("YT_TPU_SEQUOIA", "1")
+    with LocalCluster(str(tmp_path / "cl"), n_nodes=1) as cluster:
+        client = connect_remote(cluster.primary_address)
+        rng = random.Random(42)
+        live: list[str] = []
+        for step in range(60):
+            roll = rng.random()
+            if roll < 0.4 or not live:
+                path = f"//w/n{step}"
+                client.create("document", path, recursive=True)
+                client.set(path, {"step": step})
+                live.append(path)
+            elif roll < 0.55:
+                client.remove(live.pop(rng.randrange(len(live))),
+                              force=True)
+            elif roll < 0.7:
+                src = rng.choice(live)
+                dst = f"//w/copy{step}"
+                client.copy(src, dst)
+                live.append(dst)
+            elif roll < 0.85:
+                client.set(f"{rng.choice(live)}/@mark", step)
+            else:
+                tx = client.start_tx()
+                path = f"//w/tx{step}"
+                client.create("document", path, recursive=True, tx=tx)
+                if rng.random() < 0.5:
+                    client.abort_tx(tx)
+                else:
+                    client.commit_tx(tx)
+                    live.append(path)
+        ch = Channel(cluster.primary_address, timeout=60)
+        body, _ = ch.call("orchid", "get", {"path": "/sequoia"})
+        ch.close()
+        state = body["value"]
+        assert state["enabled"] is True
+        assert state["divergent"] == []
+        client.close()
+
+
+def test_randomized_workload_with_aborts_stays_consistent(resolver):
+    """The slice-2 'Done' criterion: create/copy/remove/set/abort chaos,
+    then verify() proves all three ground tables agree with the tree."""
+    import random
+    client, seq = resolver
+    rng = random.Random(20260730)
+    live: list[str] = []
+    for step in range(120):
+        roll = rng.random()
+        if roll < 0.35 or not live:
+            path = f"//w/n{step}"
+            client.create("document", path, recursive=True)
+            client.set(path, {"step": step})
+            live.append(path)
+        elif roll < 0.5:
+            victim = live.pop(rng.randrange(len(live)))
+            client.remove(victim, force=True)
+        elif roll < 0.65:
+            src = rng.choice(live)
+            dst = f"//w/copy{step}"
+            client.copy(src, dst)
+            live.append(dst)
+        elif roll < 0.8:
+            path = rng.choice(live)
+            client.set(f"{path}/@mark", step)
+        else:
+            tx = client.start_tx()
+            path = f"//w/tx{step}"
+            client.create("document", path, recursive=True, tx=tx)
+            if rng.random() < 0.5:
+                client.abort_tx(tx)
+            else:
+                client.commit_tx(tx)
+                live.append(path)
+    assert seq.verify() == []

@@ -41,6 +41,22 @@ def _covers(a: str, b: str) -> bool:
     return a == b or b.startswith(a + "/") or a.startswith(b + "/")
 
 
+def _undo_paths(entry: tuple) -> "set[str]":
+    """Node paths an undo entry edits when replayed (attribute entries
+    collapse to their node's path)."""
+    kind = entry[0]
+    if kind == "seq":
+        out: set = set()
+        for sub in entry[1:]:
+            out.update(_undo_paths(sub))
+        return out
+    if kind in ("remove_if_created", "restore"):
+        return {entry[1]}
+    if kind in ("set_attr", "remove_attr"):
+        return {_node_path(entry[1])}
+    return set()
+
+
 @dataclass
 class MasterTransaction:
     id: str
@@ -102,13 +118,17 @@ class MasterTransactionManager:
                                                      parent_id=parent_id)
         return tx_id
 
-    def commit(self, tx_id: str) -> None:
+    def commit(self, tx_id: str) -> "list[str]":
         """Changes are already live (write-through); commit hands locks and
-        undo to the parent (nested tx) or discards them (top-level)."""
+        undo to the parent (nested tx) or discards them (top-level).
+        Returns the node paths ROLLED BACK by aborting uncommitted
+        children — rollback edits the tree outside the mutation stream,
+        so post-commit observers (Sequoia) resync exactly those."""
         tx = self._get(tx_id)
+        touched: set = set()
         for child in list(tx.children):
             if child in self.transactions:
-                self.abort(child)       # uncommitted children roll back
+                touched.update(self.abort(child))   # children roll back
         parent = self.transactions.get(tx.parent_id) \
             if tx.parent_id else None
         if parent is not None:
@@ -119,19 +139,26 @@ class MasterTransactionManager:
                     parent.locks[path] = mode
             parent.children.remove(tx_id)
         del self.transactions[tx_id]
+        return sorted(touched)
 
-    def abort(self, tx_id: str) -> None:
+    def abort(self, tx_id: str) -> "list[str]":
+        """Roll the transaction back; returns every node path the undo
+        replay touched (the abort-scoped resync set for observers — the
+        Sequoia alternative to a full table resync)."""
         tx = self._get(tx_id)
+        touched: set = set()
         for child in list(tx.children):
             if child in self.transactions:
-                self.abort(child)
+                touched.update(self.abort(child))
         for entry in reversed(tx.undo):
+            touched.update(_undo_paths(entry))
             self._apply_undo(entry)
         if tx.parent_id and tx.parent_id in self.transactions:
             parent = self.transactions[tx.parent_id]
             if tx_id in parent.children:
                 parent.children.remove(tx_id)
         del self.transactions[tx_id]
+        return sorted(touched)
 
     def _get(self, tx_id: str) -> MasterTransaction:
         tx = self.transactions.get(tx_id)

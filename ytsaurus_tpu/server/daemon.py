@@ -380,9 +380,16 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         # the mutation stream.
         from ytsaurus_tpu.cypress.sequoia import SequoiaResolver
         sequoia = SequoiaResolver(client).enable()
-        orchid.register("/sequoia", lambda: {
-            "enabled": True, "records": len(sequoia._paths)})
-        print("sequoia resolve table enabled", flush=True)
+        def _sequoia_state():
+            # verify() walks the tree against table snapshots: take the
+            # mutation lock so a concurrent mutation can't produce a
+            # torn (spuriously divergent) read.
+            with client.cluster.master.mutation_lock:
+                return {"enabled": True,
+                        "records": len(sequoia._paths),
+                        "divergent": sequoia.verify()}
+        orchid.register("/sequoia", _sequoia_state)
+        print("sequoia ground tables enabled", flush=True)
     role["value"] = "leader"
     print(f"primary serving on {server.address}"
           + (f" (leader, master {master_index})" if election else ""),
