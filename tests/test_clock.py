@@ -1,0 +1,174 @@
+"""Clock quorum: HLC timestamps from elected clock peers with
+quorum-persisted ceilings.
+
+Ref model: server/clock_server/cluster_clock (the quorum whose only
+state is the timestamp ceiling), server/timestamp_provider (the serving
+daemon), ytlib/transaction_client (client-side request batching).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ytsaurus_tpu.errors import YtError
+from ytsaurus_tpu.rpc import RpcServer
+from ytsaurus_tpu.tablet.clock import (
+    CEILING_QUANTUM,
+    ClockService,
+    QuorumTimestampProvider,
+)
+
+
+class _FakeClock:
+    """Always-leader clock core for provider-side unit tests."""
+
+    def __init__(self):
+        self._last = 1000
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def generate_batch(self, count=1):
+        with self._lock:
+            self.calls += 1
+            first = self._last + 1
+            self._last += count
+            return first, count
+
+    @property
+    def is_leader(self):
+        return True
+
+
+@pytest.fixture
+def fake_clock_server():
+    core = _FakeClock()
+    server = RpcServer([ClockService(core)], port=0)
+    server.start()
+    yield core, server.address
+    server.stop()
+
+
+def test_provider_generates_unique_monotone(fake_clock_server):
+    core, address = fake_clock_server
+    provider = QuorumTimestampProvider([address])
+    got = [provider.generate() for _ in range(20)]
+    assert got == sorted(got) and len(set(got)) == 20
+    batch = provider.generate_batch(50)
+    assert len(batch) == 50 and batch[0] > got[-1]
+    assert batch == sorted(set(batch))
+    provider.close()
+
+
+def test_provider_coalesces_concurrent_requests(fake_clock_server):
+    """Threads arriving together share RPCs (the transaction_client
+    batcher): far fewer server calls than client generate() calls."""
+    core, address = fake_clock_server
+    provider = QuorumTimestampProvider([address])
+    provider.generate()                       # warm the channel
+    calls_before = core.calls
+    results: list[int] = []
+    lock = threading.Lock()
+
+    def worker():
+        ts = provider.generate()
+        with lock:
+            results.append(ts)
+
+    threads = [threading.Thread(target=worker) for _ in range(40)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 40
+    assert len(set(results)) == 40            # all unique
+    assert core.calls - calls_before < 40     # coalescing happened
+    provider.close()
+
+
+def test_provider_fails_over_between_peers(fake_clock_server):
+    core, address = fake_clock_server
+    provider = QuorumTimestampProvider(
+        ["127.0.0.1:1", address], failover_deadline=20.0)
+    ts = provider.generate()                  # dead peer skipped
+    assert ts > 0
+    provider.close()
+
+
+# -- full-stack quorum ---------------------------------------------------------
+
+
+def test_clock_leader_failover_stays_monotone(tmp_path):
+    """Kill-the-clock-leader: the standby takes over and every new
+    timestamp is strictly above every pre-kill one (the persisted
+    ceiling is the proof)."""
+    from ytsaurus_tpu.environment import LocalCluster
+
+    with LocalCluster(str(tmp_path / "c"), n_nodes=3, n_masters=1,
+                      n_clocks=2, lease_ttl=3.0) as cluster:
+        provider = QuorumTimestampProvider(cluster.clock_addresses,
+                                           failover_deadline=60.0)
+        before = provider.generate_batch(500)
+        assert before == sorted(set(before))
+        killed = cluster.kill_clock_leader()
+        after = provider.generate_batch(500)
+        assert after[0] > before[-1]          # monotone across failover
+        assert after == sorted(set(after))
+        assert cluster.clock_leader_index(timeout=60) != killed
+        provider.close()
+
+
+def test_tablet_commits_use_quorum_with_primary_down(tmp_path):
+    """The VERDICT done-criterion: with the primary master KILLED, the
+    successor keeps committing tablet transactions, and their
+    timestamps (from the clock quorum, which never restarted) stay
+    strictly monotone across the master failover."""
+    from ytsaurus_tpu.environment import LocalCluster
+    from ytsaurus_tpu.remote_client import connect_remote
+    from ytsaurus_tpu.schema import TableSchema
+
+    with LocalCluster(str(tmp_path / "c"), n_nodes=3, n_masters=2,
+                      n_clocks=2, lease_ttl=3.0) as cluster:
+        client = connect_remote(cluster.master_addresses)
+        schema = TableSchema.make([("k", "int64", "ascending"),
+                                   ("v", "string")])
+        client.create("table", "//dyn", recursive=True,
+                      attributes={"schema": schema, "dynamic": True})
+        client.mount_table("//dyn")
+        client.insert_rows("//dyn", [{"k": 1, "v": "pre"}])
+        tx = client.start_transaction()
+        ts_before = tx.start_timestamp
+        client.abort_transaction(tx)
+        assert ts_before > 0
+
+        killed = cluster.kill_leader()
+        # The successor serves; retry through the failover window.
+        deadline = time.monotonic() + 120
+        ts_after = None
+        while time.monotonic() < deadline:
+            try:
+                tx = client.start_transaction()
+                ts_after = tx.start_timestamp
+                client.abort_transaction(tx)
+                break
+            except YtError:
+                time.sleep(0.5)
+        assert ts_after is not None, "successor never served"
+        assert ts_after > ts_before      # quorum clock: monotone across
+        assert cluster.leader_index(timeout=60) != killed
+
+        # Tablet commits land on the successor with quorum timestamps.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                client.create("table", "//dyn2", recursive=True,
+                              attributes={"schema": schema,
+                                          "dynamic": True})
+                client.mount_table("//dyn2")
+                client.insert_rows("//dyn2", [{"k": 7, "v": "post"}])
+                break
+            except YtError:
+                time.sleep(0.5)
+        rows = client.lookup_rows("//dyn2", [(7,)])
+        assert rows[0]["v"] == b"post"
+        client.close()

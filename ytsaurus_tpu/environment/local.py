@@ -22,10 +22,11 @@ class LocalCluster:
     def __init__(self, root_dir: str, n_nodes: int = 2,
                  replication_factor: int = 2, http_proxy: bool = False,
                  n_masters: int = 1, lease_ttl: float = 4.0,
-                 kafka_proxy: bool = False):
+                 kafka_proxy: bool = False, n_clocks: int = 0):
         self.root_dir = root_dir
         self.n_nodes = n_nodes
         self.n_masters = n_masters
+        self.n_clocks = n_clocks
         self.lease_ttl = lease_ttl
         self.replication_factor = replication_factor
         self.http_proxy = http_proxy
@@ -41,6 +42,7 @@ class LocalCluster:
         self.http_proxy_address: str | None = None
         self.kafka_address: str | None = None
         self.node_addresses: list[str] = []
+        self.clock_addresses: list[str] = []
         self._procs: list[subprocess.Popen] = []
 
     # -- lifecycle -------------------------------------------------------------
@@ -50,6 +52,25 @@ class LocalCluster:
         deadline = time.monotonic() + timeout
         election = self.n_masters > 1
         try:
+            # Clock peers spawn FIRST and bind port 0 themselves (their
+            # RPC surface answers NotClockLeader until the journal plane
+            # exists): masters need the clock ADDRESSES at spawn, while
+            # the clocks learn the (later) node addresses by polling a
+            # journals file — no pre-allocated ports, no bind race.
+            clock_procs = self._pending_clock_procs = []
+            journals_path = os.path.join(self.root_dir, "journals.txt")
+            for c in range(self.n_clocks):
+                clock_root = os.path.join(self.root_dir, f"clock{c}")
+                self._spawn(f"clock{c}", clock_root, [
+                    "--role", "clock", "--root", clock_root,
+                    "--journals-file", journals_path,
+                    "--master-index", str(c),
+                    "--lease-ttl", str(self.lease_ttl)])
+                clock_procs.append(self._procs.pop())
+            for c in range(self.n_clocks):
+                clock_root = os.path.join(self.root_dir, f"clock{c}")
+                port = self._wait_port(clock_root, "clock", deadline)
+                self.clock_addresses.append(f"127.0.0.1:{port}")
             self._master_args: list[list[str]] = []
             for m in range(self.n_masters):
                 name = "primary" if m == 0 else f"primary{m}"
@@ -61,6 +82,8 @@ class LocalCluster:
                 if election:
                     args += ["--election", "--master-index", str(m),
                              "--lease-ttl", str(self.lease_ttl)]
+                if self.n_clocks:
+                    args += ["--clocks", ",".join(self.clock_addresses)]
                 if self.kafka_proxy and m == 0:
                     args += ["--kafka"]
                 self._master_args.append(args)
@@ -85,6 +108,16 @@ class LocalCluster:
                 node_root = os.path.join(self.root_dir, f"node{i}")
                 port = self._wait_port(node_root, "node", deadline)
                 self.node_addresses.append(f"127.0.0.1:{port}")
+            if self.n_clocks:
+                # Journal plane is up: hand its addresses to the waiting
+                # clock daemons (atomic publish), and restore the
+                # masters→nodes→clocks order the index helpers assume.
+                tmp = journals_path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(",".join(self.node_addresses))
+                os.replace(tmp, journals_path)
+                self._procs.extend(clock_procs)
+                self._pending_clock_procs = []
             self._wait_ready(deadline)
             if self.http_proxy:
                 proxy_root = os.path.join(self.root_dir, "proxy")
@@ -103,7 +136,8 @@ class LocalCluster:
         os.makedirs(root, exist_ok=True)
         # Drop stale port files: a restart on the same root must not hand
         # out the previous incarnation's ports.
-        for stale in ("primary.port", "node.port", "proxy.port"):
+        for stale in ("primary.port", "node.port", "proxy.port",
+                      "clock.port"):
             try:
                 os.unlink(os.path.join(root, stale))
             except FileNotFoundError:
@@ -169,16 +203,21 @@ class LocalCluster:
                               "startup (see its daemon.log)")
 
     def stop(self) -> None:
-        for proc in self._procs:
+        # Clock procs not yet folded into _procs (startup failed before
+        # the journal plane came up) must not leak.
+        doomed = self._procs + list(getattr(self, "_pending_clock_procs",
+                                            []) or [])
+        for proc in doomed:
             if proc.poll() is None:
                 proc.send_signal(signal.SIGTERM)
-        for proc in self._procs:
+        for proc in doomed:
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=10)
         self._procs.clear()
+        self._pending_clock_procs = []
 
     def restart_primary(self, timeout: float = 120.0,
                         index: int = 0) -> None:
@@ -222,27 +261,37 @@ class LocalCluster:
 
     # -- multi-master helpers --------------------------------------------------
 
-    def leader_index(self, timeout: float = 30.0) -> int:
-        """Index of the master currently reporting role=leader."""
+    def _poll_leader(self, addresses, proc_offset: int, service: str,
+                     method: str, is_leader, timeout: float,
+                     what: str) -> int:
+        """Shared leader poll for any role: index of the first peer whose
+        `service.method` response satisfies is_leader(body)."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            for m, addr in enumerate(self.master_addresses):
-                if self._procs[m].poll() is not None:
+            for i, addr in enumerate(addresses):
+                if self._procs[proc_offset + i].poll() is not None:
                     continue
                 channel = Channel(addr, timeout=5)
                 try:
-                    body, _ = channel.call("master", "get_role", {})
-                    role = body.get("role")
-                    role = role.decode() if isinstance(role, bytes) \
-                        else role
-                    if role == "leader":
-                        return m
+                    body, _ = channel.call(service, method, {})
+                    if is_leader(body):
+                        return i
                 except YtError:
                     continue
                 finally:
                     channel.close()
             time.sleep(0.3)
-        raise YtError("no master reported leadership in time")
+        raise YtError(f"no {what} reported leadership in time")
+
+    def leader_index(self, timeout: float = 30.0) -> int:
+        """Index of the master currently reporting role=leader."""
+        def is_leader(body):
+            role = body.get("role")
+            role = role.decode() if isinstance(role, bytes) else role
+            return role == "leader"
+        return self._poll_leader(self.master_addresses, 0, "master",
+                                 "get_role", is_leader, timeout,
+                                 "master")
 
     def kill_leader(self) -> int:
         """Hard-kill the current leader master; returns its index."""
@@ -251,6 +300,23 @@ class LocalCluster:
         proc.kill()
         proc.wait(timeout=10)
         return m
+
+    # -- clock-quorum helpers --------------------------------------------------
+
+    def clock_leader_index(self, timeout: float = 30.0) -> int:
+        """Index of the clock peer currently leading the quorum."""
+        return self._poll_leader(
+            self.clock_addresses, self.n_masters + self.n_nodes,
+            "clock", "clock_state", lambda body: bool(body.get("leader")),
+            timeout, "clock peer")
+
+    def kill_clock_leader(self) -> int:
+        """Hard-kill the current clock leader; returns its index."""
+        c = self.clock_leader_index()
+        proc = self._procs[self.n_masters + self.n_nodes + c]
+        proc.kill()
+        proc.wait(timeout=10)
+        return c
 
     def __enter__(self) -> "LocalCluster":
         return self.start()

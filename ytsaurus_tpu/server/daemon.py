@@ -34,7 +34,8 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
                 journal_nodes: int = 3,
                 bootstrap_timeout: float = 60.0,
                 election: bool = False, master_index: int = 0,
-                lease_ttl: float = 6.0, kafka: bool = False) -> None:
+                lease_ttl: float = 6.0, kafka: bool = False,
+                clocks: "str | None" = None) -> None:
     from ytsaurus_tpu import yson
     from ytsaurus_tpu.client import YtClient, YtCluster
     from ytsaurus_tpu.cypress.election import LeaderElector
@@ -342,6 +343,17 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
                           replication_factor=replication_factor)
     cluster = YtCluster(root, chunk_store=store, master=master)
     cluster.node_directory = tracker.alive    # enables exec-node dispatch
+    if clocks:
+        # Tablet commits take timestamps from the CLOCK QUORUM, not an
+        # in-process provider: timestamps stay monotone across master
+        # failover because the oracle outlives any master (ref
+        # clock_server/cluster_clock).
+        from ytsaurus_tpu.tablet.clock import QuorumTimestampProvider
+        provider = QuorumTimestampProvider(
+            [a.strip() for a in clocks.split(",") if a.strip()])
+        cluster.transactions.timestamps = provider
+        print(f"tablet timestamps from clock quorum: {clocks}",
+              flush=True)
     client = YtClient(cluster)
     server.add_service(DriverService(client))
     # Background re-replication: a dead node's chunks regain their
@@ -471,6 +483,64 @@ def run_node(root: str, port: int, primary_address: str,
     beat(primaries[0])
 
 
+def run_clock(root: str, port: int, journals: "str | None", index: int,
+              lease_ttl: float,
+              journals_file: "str | None" = None) -> None:
+    """Clock-quorum peer (ref server/clock_server/cluster_clock +
+    server/timestamp_provider): serves HLC timestamps under a
+    quorum-persisted ceiling, independent of the masters — tablet
+    commits keep taking timestamps with the primary down.
+
+    The RPC port binds FIRST (answering NotClockLeader until the core
+    exists), so launchers can learn the address before the journal
+    plane is even up; --journals-file is polled for the journal
+    addresses, breaking the clock↔node startup ordering cycle without
+    pre-allocating ports."""
+    import time as _time
+
+    from ytsaurus_tpu.rpc import Channel, RpcServer
+    from ytsaurus_tpu.tablet.clock import (
+        ClockServer,
+        ClockService,
+        NotClockLeader,
+    )
+
+    os.makedirs(root, exist_ok=True)
+    holder: dict = {"clock": None}
+
+    class _LateBound:
+        def generate_batch(self, count=1):
+            clock = holder["clock"]
+            if clock is None:
+                raise NotClockLeader()
+            return clock.generate_batch(count)
+
+        @property
+        def is_leader(self):
+            clock = holder["clock"]
+            return bool(clock is not None and clock.is_leader)
+
+    server = RpcServer([ClockService(_LateBound())], port=port)
+    server.start()
+    _write_port_file(root, "clock", server.port)
+    print(f"clock peer {index} serving on {server.address}", flush=True)
+    if journals is None:
+        while True:
+            try:
+                with open(journals_file) as f:
+                    journals = f.read().strip()
+                if journals:
+                    break
+            except FileNotFoundError:
+                pass
+            _time.sleep(0.2)
+    channels = [Channel(a.strip(), timeout=10)
+                for a in journals.split(",") if a.strip()]
+    holder["clock"] = ClockServer(root, channels, index=index,
+                                  lease_ttl=lease_ttl).start()
+    threading.Event().wait()
+
+
 def run_proxy(root: str, port: int, primary_address: str) -> None:
     """HTTP proxy daemon: REST /api/v4 bridged to the primary's RPC plane
     (ref: the standalone http_proxy process, server/http_proxy)."""
@@ -491,8 +561,16 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--role",
                         choices=("primary", "node", "proxy",
-                                 "master_cache", "tcp_proxy"),
+                                 "master_cache", "tcp_proxy", "clock"),
                         required=True)
+    parser.add_argument("--journals", default=None,
+                        help="journal-node addresses (clock role)")
+    parser.add_argument("--journals-file", default=None,
+                        help="file to poll for journal addresses "
+                             "(clock role; alternative to --journals)")
+    parser.add_argument("--clocks", default=None,
+                        help="clock-peer addresses (primary role): take "
+                             "tablet timestamps from the clock quorum")
     parser.add_argument("--root", required=True)
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--primary", default=None,
@@ -528,7 +606,8 @@ def main() -> None:
                     bootstrap_timeout=args.bootstrap_timeout,
                     election=args.election,
                     master_index=args.master_index,
-                    lease_ttl=args.lease_ttl, kafka=args.kafka)
+                    lease_ttl=args.lease_ttl, kafka=args.kafka,
+                    clocks=args.clocks)
     elif args.role == "proxy":
         if not args.primary:
             parser.error("--primary is required for --role proxy")
@@ -538,6 +617,13 @@ def main() -> None:
             parser.error("--primary is required for --role master_cache")
         from ytsaurus_tpu.server.master_cache import run_master_cache
         run_master_cache(args.root, args.port, args.primary)
+    elif args.role == "clock":
+        if not args.journals and not args.journals_file:
+            parser.error("--journals or --journals-file is required "
+                         "for --role clock")
+        run_clock(args.root, args.port, args.journals,
+                  args.master_index, args.lease_ttl,
+                  journals_file=args.journals_file)
     elif args.role == "tcp_proxy":
         if not args.primary:
             parser.error("--primary is required for --role tcp_proxy")
