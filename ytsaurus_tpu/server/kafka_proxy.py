@@ -31,6 +31,7 @@ from typing import Optional
 
 from ytsaurus_tpu.errors import YtError
 from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.server.kafka_groups import GroupCoordinator
 from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("kafka_proxy")
@@ -43,10 +44,17 @@ API_LIST_OFFSETS = 2
 API_METADATA = 3
 API_OFFSET_COMMIT = 8
 API_OFFSET_FETCH = 9
+API_FIND_COORDINATOR = 10
+API_JOIN_GROUP = 11
+API_HEARTBEAT = 12
+API_LEAVE_GROUP = 13
+API_SYNC_GROUP = 14
 API_VERSIONS = 18
 
 SUPPORTED_APIS = (API_PRODUCE, API_FETCH, API_LIST_OFFSETS, API_METADATA,
-                  API_OFFSET_COMMIT, API_OFFSET_FETCH, API_VERSIONS)
+                  API_OFFSET_COMMIT, API_OFFSET_FETCH,
+                  API_FIND_COORDINATOR, API_JOIN_GROUP, API_HEARTBEAT,
+                  API_LEAVE_GROUP, API_SYNC_GROUP, API_VERSIONS)
 
 ERR_NONE = 0
 ERR_CORRUPT_MESSAGE = 2
@@ -211,6 +219,9 @@ class KafkaProxy:
         self.host = host
         self.port = self._server.server_address[1]
         self._thread: "threading.Thread | None" = None
+        # Consumer-group membership (ref group_coordinator.h): this
+        # proxy IS every group's coordinator (single-proxy model).
+        self.groups = GroupCoordinator()
 
     @property
     def address(self) -> str:
@@ -226,6 +237,7 @@ class KafkaProxy:
         return self
 
     def stop(self) -> None:
+        self.groups.stop()
         self._server.shutdown()
         self._server.server_close()
 
@@ -307,6 +319,11 @@ class KafkaProxy:
             API_LIST_OFFSETS: self._list_offsets,
             API_OFFSET_COMMIT: self._offset_commit,
             API_OFFSET_FETCH: self._offset_fetch,
+            API_FIND_COORDINATOR: self._find_coordinator,
+            API_JOIN_GROUP: self._join_group,
+            API_HEARTBEAT: self._heartbeat,
+            API_LEAVE_GROUP: self._leave_group,
+            API_SYNC_GROUP: self._sync_group,
         }.get(api_key)
         if handler is None:
             logger.warning("unsupported api key %d", api_key)
@@ -505,6 +522,58 @@ class KafkaProxy:
                 part_bodies.append(i32(partition) + i16(err))
             topic_bodies.append(string(topic) + array(part_bodies))
         return array(topic_bodies)
+
+    # -- consumer groups (v0 shapes; ref group_coordinator.h) ------------------
+
+    def _find_coordinator(self, r: Reader) -> bytes:
+        r.string()                  # group_id: this proxy coordinates all
+        return i16(ERR_NONE) + i32(0) + string(self.host) + i32(self.port)
+
+    def _join_group(self, r: Reader) -> bytes:
+        group_id = r.string() or ""
+        session_timeout = r.i32()
+        member_id = r.string() or ""
+        protocol_type = r.string() or ""
+        n = r.i32()
+        protocols = []
+        for _ in range(max(n, 0)):
+            name = r.string() or ""
+            protocols.append((name, r.bytes_() or b""))
+        result = self.groups.join_group(group_id, session_timeout,
+                                        member_id, protocol_type,
+                                        protocols)
+        if result.get("error"):
+            return i16(result["error"]) + i32(-1) + string("") + \
+                string("") + string(member_id) + array([])
+        members = array([string(mid) + bytes_(meta)
+                         for mid, meta in result["members"]])
+        return i16(ERR_NONE) + i32(result["generation"]) + \
+            string(result["protocol"]) + string(result["leader_id"]) + \
+            string(result["member_id"]) + members
+
+    def _sync_group(self, r: Reader) -> bytes:
+        group_id = r.string() or ""
+        generation = r.i32()
+        member_id = r.string() or ""
+        n = r.i32()
+        assignments = []
+        for _ in range(max(n, 0)):
+            mid = r.string() or ""
+            assignments.append((mid, r.bytes_() or b""))
+        err, assignment = self.groups.sync_group(
+            group_id, generation, member_id, assignments)
+        return i16(err) + bytes_(assignment)
+
+    def _heartbeat(self, r: Reader) -> bytes:
+        group_id = r.string() or ""
+        generation = r.i32()
+        member_id = r.string() or ""
+        return i16(self.groups.heartbeat(group_id, generation, member_id))
+
+    def _leave_group(self, r: Reader) -> bytes:
+        group_id = r.string() or ""
+        member_id = r.string() or ""
+        return i16(self.groups.leave_group(group_id, member_id))
 
     def _offset_fetch(self, r: Reader) -> bytes:
         group = r.string()
