@@ -1,0 +1,766 @@
+"""QL regression corpus, part 2 — regex/string/hash function family,
+deeper null/edge semantics, aggregate and ordering breadth.
+
+Together with test_ql_corpus.py this grows the harness toward the
+reference suite's scale (library/query/unittests/evaluate/
+ql_query_ut.cpp ~600 cases).  Cases are written from the reference's
+BEHAVIOR (C++ integer semantics, RE2-compatible regex subset,
+null-propagation rules), not ported text.
+"""
+
+import pytest
+
+from tests.harness import evaluate
+
+T = "//t"
+
+INT_COLS = [("k", "int64", "ascending"), ("v", "int64")]
+STR_COLS = [("k", "int64", "ascending"), ("s", "string")]
+DBL_COLS = [("k", "int64", "ascending"), ("x", "double")]
+U64_COLS = [("k", "int64", "ascending"), ("u", "uint64")]
+SV_COLS = [("k", "int64", "ascending"), ("s", "string"), ("v", "int64")]
+
+
+def tbl(rows, cols=INT_COLS, path=T):
+    return {path: (cols, rows)}
+
+
+WORDS = tbl([(1, "apple"), (2, "Banana"), (3, "cherry"), (4, None),
+             (5, ""), (6, "apple pie"), (7, "a1b2c3")], STR_COLS)
+NUMSTR = tbl([(1, "42"), (2, "-17"), (3, "0"), (4, "notanum"),
+              (5, None), (6, " 8 "), (7, "9999999999999")], STR_COLS)
+KV8 = tbl([(i, i * 7) for i in range(8)])
+MIX = tbl([(1, "red", 10), (2, "blue", 20), (3, "red", 30),
+           (4, None, 40), (5, "blue", None), (6, "green", 60)], SV_COLS)
+
+
+def run(query, tables, expected, ordered=False):
+    evaluate(query, tables, expected, ordered=ordered)
+
+
+# ---------------------------------------------------------------------------
+# A. regex family (RE2-compatible subset; patterns are plan-time literals)
+# ---------------------------------------------------------------------------
+
+REGEX = [
+    ("full_match_hit", f"k FROM [{T}] WHERE regex_full_match('a.*e', s)",
+     WORDS, [{"k": 1}, {"k": 6}]),
+    ("full_match_is_anchored",
+     f"k FROM [{T}] WHERE regex_full_match('pple', s)", WORDS, []),
+    ("full_match_empty_pattern_matches_empty",
+     f"k FROM [{T}] WHERE regex_full_match('', s)", WORDS, [{"k": 5}]),
+    ("full_match_null_never_matches",
+     f"k FROM [{T}] WHERE regex_full_match('.*', s)", WORDS,
+     [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 5}, {"k": 6}, {"k": 7}]),
+    ("partial_match_substring",
+     f"k FROM [{T}] WHERE regex_partial_match('pp', s)", WORDS,
+     [{"k": 1}, {"k": 6}]),
+    ("partial_match_case_sensitive",
+     f"k FROM [{T}] WHERE regex_partial_match('banana', s)", WORDS, []),
+    ("partial_match_case_insensitive_flag",
+     f"k FROM [{T}] WHERE regex_partial_match('(?i)banana', s)", WORDS,
+     [{"k": 2}]),
+    ("partial_match_digit_class",
+     f"k FROM [{T}] WHERE regex_partial_match('[0-9]', s)", WORDS,
+     [{"k": 7}]),
+    ("partial_match_alternation",
+     f"k FROM [{T}] WHERE regex_partial_match('cherry|Banana', s)",
+     WORDS, [{"k": 2}, {"k": 3}]),
+    ("full_match_quantifier",
+     f"k FROM [{T}] WHERE regex_full_match('[a-z0-9]+', s)", WORDS,
+     [{"k": 1}, {"k": 3}, {"k": 7}]),
+    ("not_partial_match",
+     f"k FROM [{T}] WHERE NOT regex_partial_match('a', s)", WORDS,
+     [{"k": 3}, {"k": 5}]),
+    ("match_in_projection",
+     f"regex_partial_match('rr', s) AS m FROM [{T}] WHERE k = 3", WORDS,
+     [{"m": True}]),
+    ("match_null_projects_null",
+     f"regex_partial_match('x', s) AS m FROM [{T}] WHERE k = 4", WORDS,
+     [{"m": None}]),
+    ("replace_first_one_hit",
+     f"regex_replace_first('p', s, '_') AS r FROM [{T}] WHERE k = 1",
+     WORDS, [{"r": b"a_ple"}]),
+    ("replace_all_every_hit",
+     f"regex_replace_all('p', s, '_') AS r FROM [{T}] WHERE k = 1",
+     WORDS, [{"r": b"a__le"}]),
+    ("replace_all_group_backref",
+     f"regex_replace_all('([0-9])', s, '<\\\\1>') AS r FROM [{T}] "
+     "WHERE k = 7", WORDS, [{"r": b"a<1>b<2>c<3>"}]),
+    ("replace_no_hit_identity",
+     f"regex_replace_all('zz', s, '_') AS r FROM [{T}] WHERE k = 3",
+     WORDS, [{"r": b"cherry"}]),
+    ("replace_null_is_null",
+     f"regex_replace_all('a', s, '_') AS r FROM [{T}] WHERE k = 4",
+     WORDS, [{"r": None}]),
+    ("escape_specials",
+     f"regex_escape(s) AS r FROM [{T}] WHERE k = 6", WORDS,
+     [{"r": b"apple\\ pie"}]),
+    ("escape_then_match_self",
+     f"k FROM [{T}] WHERE regex_full_match('apple\\\\ pie', s)", WORDS,
+     [{"k": 6}]),
+    ("chained_replace_then_length",
+     f"length(regex_replace_all('[aeiou]', s, '')) AS r FROM [{T}] "
+     "WHERE k = 1", WORDS, [{"r": 3}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in REGEX],
+                         ids=[c[0] for c in REGEX])
+def test_regex_family(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# B. substr / parse_int64 / hashes / sha256
+# ---------------------------------------------------------------------------
+
+STRF2 = [
+    ("substr_middle", f"substr(s, 1, 3) AS r FROM [{T}] WHERE k = 1",
+     WORDS, [{"r": b"ppl"}]),
+    ("substr_from_only", f"substr(s, 2) AS r FROM [{T}] WHERE k = 3",
+     WORDS, [{"r": b"erry"}]),
+    ("substr_past_end", f"substr(s, 100) AS r FROM [{T}] WHERE k = 1",
+     WORDS, [{"r": b""}]),
+    ("substr_len_past_end", f"substr(s, 3, 99) AS r FROM [{T}] WHERE k = 1",
+     WORDS, [{"r": b"le"}]),
+    ("substr_zero_len", f"substr(s, 2, 0) AS r FROM [{T}] WHERE k = 1",
+     WORDS, [{"r": b""}]),
+    ("substr_null", f"substr(s, 0, 2) AS r FROM [{T}] WHERE k = 4",
+     WORDS, [{"r": None}]),
+    ("substr_of_empty", f"substr(s, 0, 2) AS r FROM [{T}] WHERE k = 5",
+     WORDS, [{"r": b""}]),
+    ("substr_in_where", f"k FROM [{T}] WHERE substr(s, 0, 1) = 'a'",
+     WORDS, [{"k": 1}, {"k": 6}, {"k": 7}]),
+    ("parse_int64_plain", f"parse_int64(s) AS r FROM [{T}] WHERE k = 1",
+     NUMSTR, [{"r": 42}]),
+    ("parse_int64_negative", f"parse_int64(s) AS r FROM [{T}] WHERE k = 2",
+     NUMSTR, [{"r": -17}]),
+    ("parse_int64_zero", f"parse_int64(s) AS r FROM [{T}] WHERE k = 3",
+     NUMSTR, [{"r": 0}]),
+    ("parse_int64_garbage_null",
+     f"parse_int64(s) AS r FROM [{T}] WHERE k = 4", NUMSTR, [{"r": None}]),
+    ("parse_int64_null_in_null_out",
+     f"parse_int64(s) AS r FROM [{T}] WHERE k = 5", NUMSTR, [{"r": None}]),
+    ("parse_int64_strips_spaces",
+     f"parse_int64(s) AS r FROM [{T}] WHERE k = 6", NUMSTR, [{"r": 8}]),
+    ("parse_int64_large", f"parse_int64(s) AS r FROM [{T}] WHERE k = 7",
+     NUMSTR, [{"r": 9999999999999}]),
+    ("parse_int64_arithmetic",
+     f"parse_int64(s) * 2 AS r FROM [{T}] WHERE k = 1", NUMSTR,
+     [{"r": 84}]),
+    ("parse_int64_filter",
+     f"k FROM [{T}] WHERE parse_int64(s) > 0", NUMSTR,
+     [{"k": 1}, {"k": 6}, {"k": 7}]),
+    ("sha256_len_32", f"length(sha256(s)) AS r FROM [{T}] WHERE k = 1",
+     WORDS, [{"r": 32}]),
+    ("sha256_distinct_inputs",
+     f"k FROM [{T}] WHERE sha256(s) = sha256('apple')", WORDS,
+     [{"k": 1}]),
+    ("sha256_null", f"sha256(s) AS r FROM [{T}] WHERE k = 4", WORDS,
+     [{"r": None}]),
+    ("bigb_hash_self_equal",
+     f"k FROM [{T}] WHERE bigb_hash(s) = bigb_hash(s)", WORDS,
+     [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 5}, {"k": 6}, {"k": 7}]),
+    ("bigb_differs_from_farm",
+     f"k FROM [{T}] WHERE bigb_hash(s) = farm_hash(s)", WORDS, []),
+    ("substr_group_key",
+     f"substr(s, 0, 1) AS c, sum(v) AS t FROM [{T}] "
+     "GROUP BY substr(s, 0, 1)",
+     MIX, [{"c": b"r", "t": 40}, {"c": b"b", "t": 20},
+           {"c": None, "t": 40}, {"c": b"g", "t": 60}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in STRF2],
+                         ids=[c[0] for c in STRF2])
+def test_string_function_family(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# C. LIKE family breadth
+# ---------------------------------------------------------------------------
+
+LIKE = [
+    ("like_prefix", f"k FROM [{T}] WHERE s LIKE 'apple%'", WORDS,
+     [{"k": 1}, {"k": 6}]),
+    ("like_suffix", f"k FROM [{T}] WHERE s LIKE '%pie'", WORDS,
+     [{"k": 6}]),
+    ("like_contains", f"k FROM [{T}] WHERE s LIKE '%err%'", WORDS,
+     [{"k": 3}]),
+    ("like_single_char", f"k FROM [{T}] WHERE s LIKE '_pple'", WORDS,
+     [{"k": 1}]),
+    ("like_exact", f"k FROM [{T}] WHERE s LIKE 'cherry'", WORDS,
+     [{"k": 3}]),
+    ("like_empty_pattern", f"k FROM [{T}] WHERE s LIKE ''", WORDS,
+     [{"k": 5}]),
+    ("not_like", f"k FROM [{T}] WHERE s NOT LIKE '%a%'", WORDS,
+     [{"k": 3}, {"k": 5}]),
+    ("ilike_case_folds", f"k FROM [{T}] WHERE s ILIKE 'banana'", WORDS,
+     [{"k": 2}]),
+    ("ilike_wildcard", f"k FROM [{T}] WHERE s ILIKE 'A%'", WORDS,
+     [{"k": 1}, {"k": 6}, {"k": 7}]),
+    ("rlike_regex", f"k FROM [{T}] WHERE s RLIKE '[ac].*'", WORDS,
+     [{"k": 1}, {"k": 3}, {"k": 6}, {"k": 7}]),
+    ("like_null_never", f"k FROM [{T}] WHERE s LIKE '%'", WORDS,
+     [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 5}, {"k": 6}, {"k": 7}]),
+    ("like_escaped_percent_literal",
+     f"k FROM [{T}] WHERE s LIKE 'a1b2c3'", WORDS, [{"k": 7}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in LIKE],
+                         ids=[c[0] for c in LIKE])
+def test_like_family(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# D. IN / BETWEEN / CASE / TRANSFORM breadth
+# ---------------------------------------------------------------------------
+
+COND2 = [
+    ("in_single", f"k FROM [{T}] WHERE v IN (14)", KV8, [{"k": 2}]),
+    ("in_many", f"k FROM [{T}] WHERE v IN (0, 7, 28)", KV8,
+     [{"k": 0}, {"k": 1}, {"k": 4}]),
+    ("in_none_match", f"k FROM [{T}] WHERE v IN (999)", KV8, []),
+    ("not_in", f"k FROM [{T}] WHERE v NOT IN (0, 7)", KV8,
+     [{"k": 2}, {"k": 3}, {"k": 4}, {"k": 5}, {"k": 6}, {"k": 7}]),
+    ("in_strings", f"k FROM [{T}] WHERE s IN ('red', 'green')", MIX,
+     [{"k": 1}, {"k": 3}, {"k": 6}]),
+    ("not_in_strings_null_comparable",
+     # IN is a key-tuple compare (ref CompareRowValues): null is an
+     # ordinary key value, so the null row passes NOT IN ('red').
+     f"k FROM [{T}] WHERE s NOT IN ('red')", MIX,
+     [{"k": 2}, {"k": 4}, {"k": 5}, {"k": 6}]),
+    ("between_inclusive", f"k FROM [{T}] WHERE v BETWEEN 7 AND 21", KV8,
+     [{"k": 1}, {"k": 2}, {"k": 3}]),
+    ("between_empty_range", f"k FROM [{T}] WHERE v BETWEEN 100 AND 90",
+     KV8, []),
+    ("not_between", f"k FROM [{T}] WHERE v NOT BETWEEN 1 AND 100", KV8,
+     [{"k": 0}]),
+    ("between_strings", f"k FROM [{T}] WHERE s BETWEEN 'blue' AND 'green'",
+     MIX, [{"k": 2}, {"k": 5}, {"k": 6}]),
+    ("case_value_form",
+     f"CASE v WHEN 0 THEN 100 WHEN 7 THEN 200 ELSE -1 END AS r "
+     f"FROM [{T}] WHERE k < 3", KV8,
+     [{"r": 100}, {"r": 200}, {"r": -1}]),
+    ("case_on_modulo",
+     f"CASE v % 5 WHEN 0 THEN 'z' ELSE 'nz' END AS r FROM [{T}] "
+     "WHERE k IN (0, 1)", KV8, [{"r": b"z"}, {"r": b"nz"}]),
+    ("case_searched_form",
+     f"CASE WHEN v < 10 THEN 'low' ELSE 'high' END AS r FROM [{T}] "
+     "WHERE k IN (0, 3)", KV8, [{"r": b"low"}, {"r": b"high"}]),
+    ("case_no_else_null",
+     f"CASE WHEN v = 999 THEN 1 END AS r FROM [{T}] WHERE k = 0", KV8,
+     [{"r": None}]),
+    ("case_first_match_wins",
+     f"CASE WHEN v >= 0 THEN 'a' WHEN v >= 10 THEN 'b' END AS r "
+     f"FROM [{T}] WHERE k = 3", KV8, [{"r": b"a"}]),
+    ("transform_basic",
+     f"transform(s, ('red', 'blue'), ('R', 'B')) AS r FROM [{T}] "
+     "WHERE k <= 2", MIX, [{"r": b"R"}, {"r": b"B"}]),
+    ("transform_default_null",
+     f"transform(s, ('red'), ('R')) AS r FROM [{T}] WHERE k = 6", MIX,
+     [{"r": None}]),
+    ("transform_ints",
+     f"transform(v, (10, 20), (1, 2)) AS r FROM [{T}] WHERE k <= 2",
+     MIX, [{"r": 1}, {"r": 2}]),
+    ("if_nested",
+     f"if(v > 15, if(v > 25, 'big', 'mid'), 'small') AS r FROM [{T}] "
+     "WHERE k IN (1, 2, 3)", MIX,
+     [{"r": b"small"}, {"r": b"mid"}, {"r": b"big"}]),
+    ("if_null_coalesce_chain",
+     f"if_null(v, 0) + if_null(v, 100) AS r FROM [{T}] WHERE k = 5",
+     MIX, [{"r": 100}]),
+    ("in_with_arith", f"k FROM [{T}] WHERE v % 5 IN (0)", KV8,
+     [{"k": 0}, {"k": 5}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in COND2],
+                         ids=[c[0] for c in COND2])
+def test_conditional_breadth(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# E. aggregates: argmin/argmax, HAVING, grouped function results
+# ---------------------------------------------------------------------------
+
+AGG2 = [
+    ("argmax_picks_row",
+     f"argmax(s, v) AS r FROM [{T}] GROUP BY 1", MIX, [{"r": b"green"}]),
+    ("argmin_picks_row",
+     f"argmin(s, v) AS r FROM [{T}] GROUP BY 1", MIX, [{"r": b"red"}]),
+    ("grouped_argmax",
+     f"s, argmax(k, v) AS r FROM [{T}] WHERE s != '' GROUP BY s", MIX,
+     [{"s": b"red", "r": 3}, {"s": b"blue", "r": 2},
+      {"s": b"green", "r": 6}]),
+    ("having_filters_groups",
+     f"s, sum(v) AS t FROM [{T}] GROUP BY s HAVING sum(v) > 30", MIX,
+     [{"s": b"red", "t": 40}, {"s": None, "t": 40},
+      {"s": b"green", "t": 60}]),
+    ("having_on_count",
+     f"s, count(*) AS n FROM [{T}] GROUP BY s HAVING count(*) > 1", MIX,
+     [{"s": b"red", "n": 2}, {"s": b"blue", "n": 2}]),
+    ("count_star_vs_column",
+     f"count(*) AS a, count(v) AS b FROM [{T}] GROUP BY 1", MIX,
+     [{"a": 6, "b": 5}]),
+    ("sum_of_expression",
+     f"sum(v * 2) AS r FROM [{T}] GROUP BY 1", MIX, [{"r": 320}]),
+    ("avg_is_double",
+     f"avg(v) AS r FROM [{T}] WHERE s = 'red' GROUP BY 1", MIX,
+     [{"r": 20.0}]),
+    ("min_max_strings",
+     f"min(s) AS lo, max(s) AS hi FROM [{T}] GROUP BY 1", MIX,
+     [{"lo": b"blue", "hi": b"red"}]),
+    ("cardinality_estimates",
+     f"cardinality(s) AS c FROM [{T}] GROUP BY 1", MIX, [{"c": 3}]),
+    ("group_by_function_result",
+     f"v % 2 AS p, count(*) AS n FROM [{T}] WHERE v != 0 GROUP BY v % 2",
+     KV8, [{"p": 0, "n": 3}, {"p": 1, "n": 4}]),
+    ("group_by_regex_class",
+     f"regex_partial_match('r', s) AS has_r, count(*) AS n FROM [{T}] "
+     "WHERE s != '' GROUP BY regex_partial_match('r', s)", MIX,
+     [{"has_r": True, "n": 3}, {"has_r": False, "n": 2}]),
+    ("first_in_group",
+     f"s, first(v) AS f FROM [{T}] WHERE s = 'red' GROUP BY s", MIX,
+     [{"s": b"red", "f": 10}]),
+    ("sum_all_null_group_is_null",
+     f"s, sum(v) AS t FROM [{T}] WHERE k = 5 GROUP BY s", MIX,
+     [{"s": b"blue", "t": None}]),
+    ("global_aggregate_empty_input",
+     f"sum(v) AS t FROM [{T}] WHERE v > 999 GROUP BY 1", MIX, []),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in AGG2],
+                         ids=[c[0] for c in AGG2])
+def test_aggregate_breadth(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# F. uint64 / double / boolean edges
+# ---------------------------------------------------------------------------
+
+BIG = (1 << 63) + 5          # exceeds int64: lives only in uint64
+EDGE = [
+    ("u64_big_roundtrip", f"u FROM [{T}] WHERE u > 0",
+     tbl([(1, BIG)], U64_COLS), [{"u": BIG}]),
+    ("u64_compare_large", f"k FROM [{T}] WHERE u >= {BIG}",
+     tbl([(1, BIG), (2, 7)], U64_COLS), [{"k": 1}]),
+    ("u64_sum", f"sum(u) AS r FROM [{T}] GROUP BY 1",
+     tbl([(1, 3), (2, 4)], U64_COLS), [{"r": 7}]),
+    ("u64_modulo", f"u % 10 AS r FROM [{T}]",
+     tbl([(1, BIG)], U64_COLS), [{"r": BIG % 10}]),
+    ("int_overflow_wraps", f"v + v AS r FROM [{T}]",
+     tbl([(1, (1 << 62))]), [{"r": -(1 << 63)}]),
+    ("int_min_abs_wraps", f"abs(v) AS r FROM [{T}]",
+     tbl([(1, -(1 << 63))]), [{"r": -(1 << 63)}]),
+    ("double_inf_compare", f"k FROM [{T}] WHERE x / 0.0 > 1e308",
+     tbl([(1, 1.0), (2, -1.0)], DBL_COLS), [{"k": 1}]),
+    ("double_nan_never_equal", f"k FROM [{T}] WHERE x / 0.0 = x / 0.0",
+     tbl([(1, 0.0)], DBL_COLS), []),
+    ("double_neg_zero_equals_zero", f"k FROM [{T}] WHERE x = 0.0",
+     tbl([(1, -0.0)], DBL_COLS), [{"k": 1}]),
+    ("double_precise_small", f"x * 3.0 AS r FROM [{T}]",
+     tbl([(1, 0.5)], DBL_COLS), [{"r": 1.5}]),
+    ("bool_and_or",
+     f"k FROM [{T}] WHERE boolean(v) AND NOT boolean(v - v)",
+     tbl([(1, 2), (2, 0)]), [{"k": 1}]),
+    ("int64_cast_truncates_toward_zero", f"int64(x) AS r FROM [{T}]",
+     tbl([(1, -3.9)], DBL_COLS), [{"r": -3}]),
+    ("double_cast_of_u64", f"double(u) AS r FROM [{T}]",
+     tbl([(1, 4)], U64_COLS), [{"r": 4.0}]),
+    ("uint64_of_negative_wraps", f"uint64(v) AS r FROM [{T}]",
+     tbl([(1, -1)]), [{"r": (1 << 64) - 1}]),
+    ("shift_by_63", f"v << 62 AS r FROM [{T}]", tbl([(1, 1)]),
+     [{"r": 1 << 62}]),
+    ("xor_self_is_zero", f"v ^ v AS r FROM [{T}]", tbl([(1, 12345)]),
+     [{"r": 0}]),
+    ("division_by_nonzero_after_filter",
+     f"v / k AS r FROM [{T}] WHERE k != 0", tbl([(0, 5), (2, 10)]),
+     [{"r": 5}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in EDGE],
+                         ids=[c[0] for c in EDGE])
+def test_numeric_edges(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# G. ORDER BY / LIMIT / OFFSET combinations
+# ---------------------------------------------------------------------------
+
+ORD = [
+    ("order_limit", f"v FROM [{T}] ORDER BY v ASC LIMIT 3", KV8,
+     [{"v": 0}, {"v": 7}, {"v": 14}]),
+    ("order_desc_limit", f"v FROM [{T}] ORDER BY v DESC LIMIT 2", KV8,
+     [{"v": 49}, {"v": 42}]),
+    ("order_offset", f"v FROM [{T}] ORDER BY v ASC, k ASC "
+     "OFFSET 2 LIMIT 2", KV8, [{"v": 14}, {"v": 21}]),
+    ("order_by_two_keys",
+     # Null string sorts first ascending.
+     f"s, v FROM [{T}] WHERE v != 0 ORDER BY s ASC, v DESC LIMIT 3",
+     MIX, [{"s": None, "v": 40}, {"s": b"blue", "v": 20},
+           {"s": b"green", "v": 60}]),
+    ("order_by_expression",
+     f"k FROM [{T}] ORDER BY v % 5 ASC, k ASC LIMIT 2", KV8,
+     [{"k": 0}, {"k": 5}]),
+    ("order_nulls_first_asc",
+     f"k FROM [{T}] ORDER BY v ASC LIMIT 2", MIX,
+     [{"k": 5}, {"k": 1}]),
+    ("limit_larger_than_input", f"k FROM [{T}] ORDER BY k ASC LIMIT 99",
+     tbl([(1, 1), (2, 2)]), [{"k": 1}, {"k": 2}]),
+    ("offset_past_end", f"k FROM [{T}] ORDER BY k ASC OFFSET 99 LIMIT 5",
+     tbl([(1, 1)]), []),
+    ("order_strings_desc",
+     f"s FROM [{T}] WHERE s != '' ORDER BY s DESC LIMIT 2", MIX,
+     [{"s": b"red"}, {"s": b"red"}]),
+    ("distinct_then_order",
+     f"v % 5 AS m FROM [{T}] GROUP BY v % 5 ORDER BY v % 5 ASC LIMIT 10",
+     KV8, [{"m": 0}, {"m": 1}, {"m": 2}, {"m": 3}, {"m": 4}],
+     True),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected,ordered",
+                         [(c[1], c[2], c[3],
+                           c[4] if len(c) > 4 else True)
+                          for c in ORD],
+                         ids=[c[0] for c in ORD])
+def test_ordering_breadth(query, tables, expected, ordered):
+    run(query, tables, expected, ordered=ordered)
+
+
+# ---------------------------------------------------------------------------
+# H. composition: concat/upper/lower/length/timestamps interplay
+# ---------------------------------------------------------------------------
+
+TS = tbl([(1, 0), (2, 3_600), (3, 90_061), (4, 694_861),
+          (5, 31_536_000), (6, None)],
+         [("k", "int64", "ascending"), ("t", "int64")])
+
+COMPOSE = [
+    ("upper_lower_roundtrip",
+     f"lower(upper(s)) AS r FROM [{T}] WHERE k = 2", WORDS,
+     [{"r": b"banana"}]),
+    ("concat_columns", f"concat(s, s) AS r FROM [{T}] WHERE k = 1",
+     WORDS, [{"r": b"appleapple"}]),
+    ("concat_literal", f"concat(s, '!') AS r FROM [{T}] WHERE k = 3",
+     WORDS, [{"r": b"cherry!"}]),
+    ("concat_null_is_null", f"concat(s, 'x') AS r FROM [{T}] WHERE k = 4",
+     WORDS, [{"r": None}]),
+    ("length_empty", f"length(s) AS r FROM [{T}] WHERE k = 5", WORDS,
+     [{"r": 0}]),
+    ("length_null", f"length(s) AS r FROM [{T}] WHERE k = 4", WORDS,
+     [{"r": None}]),
+    ("length_of_upper", f"length(upper(s)) AS r FROM [{T}] WHERE k = 6",
+     WORDS, [{"r": 9}]),
+    ("upper_in_where", f"k FROM [{T}] WHERE upper(s) = 'BANANA'", WORDS,
+     [{"k": 2}]),
+    ("lower_group_by",
+     f"lower(substr(s, 0, 1)) AS c, count(*) AS n FROM [{T}] "
+     "WHERE s != '' GROUP BY lower(substr(s, 0, 1))", WORDS,
+     [{"c": b"a", "n": 3}, {"c": b"b", "n": 1}, {"c": b"c", "n": 1}]),
+    ("is_prefix_literal", f"k FROM [{T}] WHERE is_prefix('app', s)",
+     WORDS, [{"k": 1}, {"k": 6}]),
+    ("is_substr_literal", f"k FROM [{T}] WHERE is_substr('err', s)",
+     WORDS, [{"k": 3}]),
+    ("ts_floor_hour", f"timestamp_floor_hour(t) AS r FROM [{T}] "
+     "WHERE k = 3", TS, [{"r": 90_000}]),
+    ("ts_floor_day", f"timestamp_floor_day(t) AS r FROM [{T}] "
+     "WHERE k = 4", TS, [{"r": 691_200}]),
+    ("ts_floor_year", f"timestamp_floor_year(t) AS r FROM [{T}] "
+     "WHERE k = 5", TS, [{"r": 31_536_000}]),
+    ("ts_floor_null", f"timestamp_floor_day(t) AS r FROM [{T}] "
+     "WHERE k = 6", TS, [{"r": None}]),
+    ("ts_floor_zero", f"timestamp_floor_week(t) AS r FROM [{T}] "
+     "WHERE k = 1", TS, [{"r": -259_200}]),
+    ("ts_group_by_hour",
+     f"timestamp_floor_hour(t) AS h, count(*) AS n FROM [{T}] "
+     "WHERE t != 0 GROUP BY timestamp_floor_hour(t)", TS,
+     [{"h": 3_600, "n": 1}, {"h": 90_000, "n": 1},
+      {"h": 694_800, "n": 1}, {"h": 31_536_000, "n": 1}]),
+    ("farm_hash_of_int", f"k FROM [{T}] WHERE farm_hash(v) != 0",
+     tbl([(1, 5)]), [{"k": 1}]),
+    ("farm_hash_multi_arg",
+     f"k FROM [{T}] WHERE farm_hash(k, v) = farm_hash(k, v)",
+     tbl([(1, 5)]), [{"k": 1}]),
+    ("hash_distributes",
+     f"farm_hash(v) % 4 AS b, count(*) AS n FROM [{T}] "
+     "GROUP BY farm_hash(v) % 4 HAVING count(*) > 0",
+     tbl([(i, i) for i in range(40)]),
+     None),
+    ("min_of_mixed_null",
+     f"min_of(v, if_null(v, 99)) AS r FROM [{T}]",
+     tbl([(1, None)]), [{"r": 99}]),
+    ("concat_of_substr",
+     f"concat(substr(s, 0, 3), '...') AS r FROM [{T}] WHERE k = 2",
+     WORDS, [{"r": b"Ban..."}]),
+    ("regex_on_upper",
+     f"k FROM [{T}] WHERE regex_full_match('[A-Z ]+', upper(s))",
+     WORDS, [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 6}]),
+    ("nested_if_null_strings",
+     f"if_null(s, 'missing') AS r FROM [{T}] WHERE k = 4", WORDS,
+     [{"r": b"missing"}]),
+    ("case_over_length",
+     f"CASE WHEN length(s) > 5 THEN 'long' ELSE 'short' END AS r "
+     f"FROM [{T}] WHERE k IN (1, 3)", WORDS,
+     [{"r": b"short"}, {"r": b"long"}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in COMPOSE],
+                         ids=[c[0] for c in COMPOSE])
+def test_composition(query, tables, expected):
+    if expected is None:
+        rows = evaluate(query, tables)
+        assert sum(r["n"] for r in rows) == 40    # partitions cover all
+    else:
+        run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# I. SPMD dual-check: the same queries through the 8-device mesh
+# ---------------------------------------------------------------------------
+
+SPMD_SCHEMA = [("k", "int64", "ascending"), ("s", "string"),
+               ("v", "int64"), ("x", "double")]
+
+
+def _spmd_fixture():
+    import numpy as np
+
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.parallel.mesh import make_mesh
+    from ytsaurus_tpu.schema import TableSchema
+
+    rng = np.random.default_rng(7)
+    words = np.array([b"alpha", b"beta", b"gamma", b"delta", b""],
+                     dtype=object)
+    schema = TableSchema.make(SPMD_SCHEMA)
+    chunks = []
+    base = 0
+    for shard in range(8):
+        n = 40 + shard * 7
+        rows = []
+        for i in range(n):
+            w = words[int(rng.integers(0, len(words)))]
+            rows.append((base + i,
+                         None if i % 11 == 0 else w,
+                         None if i % 13 == 0 else int(rng.integers(0, 50)),
+                         float(rng.uniform(-5, 5))))
+        base += n
+        chunks.append(ColumnarChunk.from_rows(schema, rows))
+    return make_mesh(8), schema, chunks
+
+
+SPMD_QUERIES = [
+    "regex_spmd_filter",
+    "regex_replace_spmd",
+    "substr_spmd_group",
+    "parse_like_spmd",
+    "sha_len_spmd",
+    "bigb_spmd_group",
+    "upper_spmd",
+    "case_spmd",
+    "in_spmd",
+    "between_spmd",
+    "hash_mod_spmd",
+    "minmax_spmd",
+    "having_spmd",
+    "ts_floor_spmd",
+    "ilike_spmd",
+]
+
+_SPMD_SQL = {
+    "regex_spmd_filter":
+        f"k FROM [{T}] WHERE regex_partial_match('a', s)",
+    "regex_replace_spmd":
+        f"regex_replace_all('a', s, '_') AS r, count(*) AS n FROM [{T}] "
+        "GROUP BY regex_replace_all('a', s, '_')",
+    "substr_spmd_group":
+        f"substr(s, 0, 1) AS c, count(*) AS n FROM [{T}] "
+        "GROUP BY substr(s, 0, 1)",
+    "parse_like_spmd":
+        f"k FROM [{T}] WHERE s LIKE '%eta'",
+    "sha_len_spmd":
+        f"length(sha256(s)) AS l, count(*) AS n FROM [{T}] "
+        "GROUP BY length(sha256(s))",
+    "bigb_spmd_group":
+        f"bigb_hash(s) % 4 AS b, count(*) AS n FROM [{T}] "
+        "WHERE s != '' GROUP BY bigb_hash(s) % 4",
+    "upper_spmd":
+        f"upper(s) AS u, count(*) AS n FROM [{T}] GROUP BY upper(s)",
+    "case_spmd":
+        f"CASE WHEN v < 25 THEN 'lo' ELSE 'hi' END AS c, count(*) AS n "
+        f"FROM [{T}] WHERE v != 0 GROUP BY "
+        "CASE WHEN v < 25 THEN 'lo' ELSE 'hi' END",
+    "in_spmd":
+        f"k FROM [{T}] WHERE s IN ('alpha', 'gamma') AND v IN "
+        "(1, 2, 3, 4, 5, 6, 7)",
+    "between_spmd":
+        f"k FROM [{T}] WHERE v BETWEEN 10 AND 20 AND s BETWEEN "
+        "'beta' AND 'delta'",
+    "hash_mod_spmd":
+        f"farm_hash(v) % 8 AS b, count(*) AS n FROM [{T}] "
+        "GROUP BY farm_hash(v) % 8",
+    "minmax_spmd":
+        f"min_of(v, 25) AS m, count(*) AS n FROM [{T}] "
+        "GROUP BY min_of(v, 25)",
+    "having_spmd":
+        f"s, sum(v) AS t FROM [{T}] GROUP BY s HAVING sum(v) > 100",
+    "ts_floor_spmd":
+        f"timestamp_floor_hour(v * 600) AS h, count(*) AS n FROM [{T}] "
+        "GROUP BY timestamp_floor_hour(v * 600)",
+    "ilike_spmd":
+        f"k FROM [{T}] WHERE s ILIKE 'ALPHA'",
+}
+
+
+@pytest.fixture(scope="module")
+def spmd_env():
+    return _spmd_fixture()
+
+
+@pytest.mark.parametrize("case", SPMD_QUERIES)
+def test_spmd_matches_local(case, spmd_env):
+    """Every new-function query family answers IDENTICALLY on the local
+    single-chunk path and the 8-shard SPMD path (the dual-check the
+    original corpus established, extended to the new registry tail)."""
+    from ytsaurus_tpu.chunks.columnar import concat_chunks
+    from ytsaurus_tpu.parallel.distributed import (
+        DistributedEvaluator,
+        ShardedTable,
+    )
+    from ytsaurus_tpu.query.builder import build_query
+
+    mesh, schema, chunks = spmd_env
+    query = _SPMD_SQL[case]
+    local = evaluate(query, {T: concat_chunks(chunks)})
+    plan = build_query(query, {T: schema})
+    table = ShardedTable.from_chunks(mesh, chunks)
+    spmd = DistributedEvaluator(mesh).run(plan, table).to_rows()
+
+    def canon(rows):
+        return sorted(
+            (tuple(sorted((k, repr(v)) for k, v in r.items()))
+             for r in rows))
+    assert canon(spmd) == canon(local), \
+        f"SPMD diverged from local for: {query}"
+
+
+# ---------------------------------------------------------------------------
+# J. join + subquery breadth with the new functions
+# ---------------------------------------------------------------------------
+
+D = "//d"
+DIM_COLS = [("g", "int64", "ascending"), ("name", "string")]
+
+
+def _two(rows_f, rows_d):
+    return {T: ([("k", "int64", "ascending"), ("g", "int64"),
+                 ("v", "int64")], rows_f),
+            D: (DIM_COLS, rows_d)}
+
+
+FACTS = [(1, 0, 10), (2, 1, 20), (3, 0, 30), (4, 2, 40), (5, 1, 50)]
+DIMS = [(0, "zero"), (1, "one"), (3, "three")]
+
+JOIN2 = [
+    ("join_then_regex",
+     f"k FROM [{T}] JOIN [{D}] USING g "
+     "WHERE regex_partial_match('o', name)",
+     _two(FACTS, DIMS), [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 5}]),
+    ("join_project_upper",
+     f"k, upper(name) AS u FROM [{T}] JOIN [{D}] USING g WHERE k = 2",
+     _two(FACTS, DIMS), [{"k": 2, "u": b"ONE"}]),
+    ("join_group_by_dim",
+     f"name, sum(v) AS t FROM [{T}] JOIN [{D}] USING g GROUP BY name",
+     _two(FACTS, DIMS),
+     [{"name": b"zero", "t": 40}, {"name": b"one", "t": 70}]),
+    ("join_unmatched_dropped",
+     f"k FROM [{T}] JOIN [{D}] USING g WHERE g = 2",
+     _two(FACTS, DIMS), []),
+    ("left_join_keeps_unmatched",
+     f"k, name FROM [{T}] LEFT JOIN [{D}] USING g WHERE k = 4",
+     _two(FACTS, DIMS), [{"k": 4, "name": None}]),
+    ("join_substr_on_dim",
+     f"substr(name, 0, 1) AS c, count(*) AS n FROM [{T}] "
+     f"JOIN [{D}] USING g GROUP BY substr(name, 0, 1)",
+     _two(FACTS, DIMS), [{"c": b"z", "n": 2}, {"c": b"o", "n": 2}]),
+    ("join_having",
+     f"name, count(*) AS n FROM [{T}] JOIN [{D}] USING g "
+     "GROUP BY name HAVING count(*) >= 2",
+     _two(FACTS, DIMS),
+     [{"name": b"zero", "n": 2}, {"name": b"one", "n": 2}]),
+    ("join_where_both_sides",
+     f"k FROM [{T}] JOIN [{D}] USING g WHERE v > 15 AND name != 'zero'",
+     _two(FACTS, DIMS), [{"k": 2}, {"k": 5}]),
+    ("join_order_by_dim",
+     f"k FROM [{T}] JOIN [{D}] USING g ORDER BY name ASC, k ASC LIMIT 3",
+     _two(FACTS, DIMS), [{"k": 2}, {"k": 5}, {"k": 1}]),
+    ("join_then_in",
+     f"k FROM [{T}] JOIN [{D}] USING g WHERE name IN ('one')",
+     _two(FACTS, DIMS), [{"k": 2}, {"k": 5}]),
+    ("join_if_null_dim",
+     f"k, if_null(name, '?') AS n FROM [{T}] LEFT JOIN [{D}] USING g "
+     "WHERE k = 4", _two(FACTS, DIMS), [{"k": 4, "n": b"?"}]),
+    ("join_empty_dim_table",
+     f"k FROM [{T}] JOIN [{D}] USING g", _two(FACTS, []), []),
+    ("join_count_star",
+     f"count(*) AS n FROM [{T}] JOIN [{D}] USING g GROUP BY 1",
+     _two(FACTS, DIMS), [{"n": 4}]),
+    ("self_like_filter_both",
+     f"k FROM [{T}] JOIN [{D}] USING g WHERE name LIKE '%e%' AND "
+     "v BETWEEN 10 AND 30", _two(FACTS, DIMS),
+     [{"k": 1}, {"k": 2}, {"k": 3}]),
+    ("join_transform_dim",
+     f"transform(name, ('zero', 'one'), ('Z', 'O')) AS c, "
+     f"count(*) AS n FROM [{T}] JOIN [{D}] USING g "
+     "GROUP BY transform(name, ('zero', 'one'), ('Z', 'O'))",
+     _two(FACTS, DIMS), [{"c": b"Z", "n": 2}, {"c": b"O", "n": 2}]),
+    ("join_bigb_group",
+     f"bigb_hash(name) % 2 AS b, count(*) AS n FROM [{T}] "
+     f"JOIN [{D}] USING g GROUP BY bigb_hash(name) % 2 "
+     "HAVING count(*) > 0", _two(FACTS, DIMS), None),
+    ("order_by_length_of_name",
+     f"name FROM [{T}] JOIN [{D}] USING g "
+     "ORDER BY length(name) ASC, name ASC LIMIT 2",
+     _two(FACTS, DIMS), [{"name": b"one"}, {"name": b"one"}]),
+    ("where_parse_int64_of_concat",
+     f"k FROM [{T}] WHERE parse_int64(concat('1', '0')) = 10",
+     tbl([(1, 1)]), [{"k": 1}]),
+    ("aggregate_of_regex_replace",
+     f"count(*) AS n FROM [{T}] WHERE "
+     "length(regex_replace_all('0', '100', 'x')) = 3 GROUP BY 1",
+     tbl([(1, 1)]), [{"n": 1}]),
+    ("substr_out_of_order_args_error_free",
+     f"substr('hello', 1, 2) AS r FROM [{T}]", tbl([(1, 1)]),
+     [{"r": b"el"}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in JOIN2],
+                         ids=[c[0] for c in JOIN2])
+def test_join_breadth(query, tables, expected):
+    if expected is None:
+        rows = evaluate(query, tables)
+        assert sum(r["n"] for r in rows) == 4
+        return
+    ordered = "ORDER BY" in query
+    run(query, tables, expected, ordered=ordered)

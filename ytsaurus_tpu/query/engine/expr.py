@@ -24,6 +24,7 @@ library/query/engine/cg_fragment_compiler.cpp):
 
 from __future__ import annotations
 
+import hashlib
 import re
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -373,19 +374,8 @@ class ExprBinder:
             return BoundExpr(type=EValueType.boolean, vocab=None,
                              emit=emit_fpred)
         if name == "length":
-            a = args[0]
-            vocab = a.vocab if a.vocab is not None else _EMPTY_VOCAB
-            table = np.array([len(v) for v in vocab], dtype=np.int64)
-            if len(table) == 0:
-                table = np.zeros(1, dtype=np.int64)
-            slot = self.ctx.add(jnp.asarray(
-                _pad_np(table, _vocab_bucket(len(table)), 0)))
-            gather = _gather_binding(slot)
-
-            def emit_len(ctx):
-                data, valid = a.emit(ctx)
-                return gather(ctx, data), valid
-            return BoundExpr(type=EValueType.int64, vocab=None, emit=emit_len)
+            return self._bind_vocab_table(args[0], EValueType.int64,
+                                          np.int64, len)
         if name in ("is_prefix", "is_substr"):
             # Non-literal pattern path comes through here; only literal
             # patterns (TStringPredicate) are supported for now.
@@ -393,6 +383,86 @@ class ExprBinder:
                           code=EErrorCode.QueryUnsupported)
         if name == "farm_hash":
             return self._bind_hash(args)
+        if name in ("regex_full_match", "regex_partial_match"):
+            # Pattern compiles at PLAN time against the vocabulary (ref
+            # regex_* builtins run RE2 per row; here the match set is a
+            # host-computed table consumed by one device gather).
+            rx = _compile_regex(_literal_bytes(node.args[0], name), name)
+            return self._bind_vocab_table(
+                args[1], EValueType.boolean, np.bool_,
+                (lambda v: rx.fullmatch(v) is not None)
+                if name == "regex_full_match"
+                else (lambda v: rx.search(v) is not None))
+        if name in ("regex_replace_first", "regex_replace_all"):
+            rx = _compile_regex(_literal_bytes(node.args[0], name), name)
+            rewrite = _literal_bytes(node.args[2], name)
+            count = 1 if name == "regex_replace_first" else 0
+            try:
+                return self._bind_string_map(
+                    args[1], lambda v: rx.sub(rewrite, v, count=count))
+            except re.error as exc:
+                raise YtError(f"{name}: invalid rewrite "
+                              f"{rewrite!r}: {exc}",
+                              code=EErrorCode.QueryParseError)
+        if name == "regex_escape":
+            return self._bind_string_map(args[0], re.escape)
+        if name == "sha256":
+            return self._bind_string_map(
+                args[0], lambda v: hashlib.sha256(v).digest())
+        if name == "bigb_hash":
+            # A farm_hash-class string hash with its own mix (ref
+            # bigb_hash over uids) — domain-separated from farm_hash.
+            return self._bind_vocab_table(
+                args[0], EValueType.uint64, np.uint64,
+                lambda v: _bytes_hash(b"bigb:" + v))
+        if name == "parse_int64":
+            s = args[0]
+            vocab = s.vocab if s.vocab is not None else _EMPTY_VOCAB
+
+            def _try_parse(v: bytes):
+                # Reference FromString semantics: optional sign + digits
+                # only (Python int() would also take '1_2'), and the
+                # value must FIT int64 (overflow → null, not a bind-time
+                # OverflowError from np.int64).
+                try:
+                    text = v.strip()
+                except AttributeError:
+                    return 0, False
+                if not re.fullmatch(rb"[+-]?[0-9]+", text):
+                    return 0, False
+                value = int(text)
+                if not (-(1 << 63) <= value < (1 << 63)):
+                    return 0, False
+                return value, True
+            parsed = [_try_parse(v) for v in vocab]
+            val_t = np.array([p[0] for p in parsed] or [0],
+                             dtype=np.int64)
+            ok_t = np.array([p[1] for p in parsed] or [False],
+                            dtype=np.bool_)
+            val_slot = self.ctx.add(jnp.asarray(
+                _pad_np(val_t, _vocab_bucket(len(val_t)), 0)))
+            ok_slot = self.ctx.add(jnp.asarray(
+                _pad_np(ok_t, _vocab_bucket(len(ok_t)), 0)))
+            g_val = _gather_binding(val_slot)
+            g_ok = _gather_binding(ok_slot)
+
+            def emit_parse(ctx):
+                data, valid = s.emit(ctx)
+                # Unparseable strings yield null (ref parse_int64
+                # error→null semantics for the non-throwing variant).
+                return g_val(ctx, data), valid & g_ok(ctx, data)
+            return BoundExpr(type=EValueType.int64, vocab=None,
+                             emit=emit_parse)
+        if name == "substr":
+            start = int(_literal_int(node.args[1], name))
+            length = int(_literal_int(node.args[2], name)) \
+                if len(node.args) > 2 else None
+            if start < 0 or (length is not None and length < 0):
+                raise YtError("substr: start/length must be >= 0",
+                              code=EErrorCode.QueryTypeError)
+            end = None if length is None else start + length
+            return self._bind_string_map(
+                args[0], lambda v: v[start:end])
         if name in ("min_of", "max_of"):
             pick_min = name == "min_of"
 
@@ -487,6 +557,22 @@ class ExprBinder:
             pair = da.astype(jnp.int32) * nb_const + db.astype(jnp.int32)
             return gather(ctx, pair), valid_a & valid_b
         return BoundExpr(type=EValueType.string, vocab=merged, emit=emit)
+
+    def _bind_vocab_table(self, a: BoundExpr, result_type: EValueType,
+                          np_dtype, fn) -> BoundExpr:
+        """String → scalar via a host-computed per-vocabulary table and
+        one device gather (the length/regex/hash shape)."""
+        vocab = a.vocab if a.vocab is not None else _EMPTY_VOCAB
+        table = np.array([fn(v) for v in vocab] or [np_dtype()],
+                         dtype=np_dtype)
+        slot = self.ctx.add(jnp.asarray(
+            _pad_np(table, _vocab_bucket(len(table)), 0)))
+        gather = _gather_binding(slot)
+
+        def emit(ctx):
+            data, valid = a.emit(ctx)
+            return gather(ctx, data), valid
+        return BoundExpr(type=result_type, vocab=None, emit=emit)
 
     def _bind_string_map(self, a: BoundExpr, fn) -> BoundExpr:
         """Vocabulary-level string→string transform (lower/upper/…)."""
@@ -782,7 +868,7 @@ def _string_matcher(node: ir.TStringPredicate):
     if node.kind == "substr":
         return lambda v: pattern in v
     if node.kind == "regex":
-        rx = re.compile(pattern)
+        rx = _compile_regex(pattern, "regex predicate")
         return lambda v: rx.fullmatch(v) is not None
     if node.kind == "like":
         rx = _like_to_regex(pattern, node.case_insensitive)
@@ -849,6 +935,33 @@ def _timestamp_floor(ts: jax.Array, unit: str) -> jax.Array:
         return _civil_to_days(y, one, one) * 86400
     raise YtError(f"Unknown timestamp unit {unit!r}",
                   code=EErrorCode.QueryUnsupported)
+
+
+def _compile_regex(pattern: bytes, what: str):
+    try:
+        return re.compile(pattern)
+    except re.error as exc:
+        raise YtError(f"{what}: invalid regex {pattern!r}: {exc}",
+                      code=EErrorCode.QueryParseError)
+
+
+def _literal_bytes(arg, what: str) -> bytes:
+    """Plan-time literal string (patterns/rewrites compile against the
+    vocabulary at bind time; a computed pattern has no vocabulary-sized
+    table)."""
+    if not isinstance(arg, ir.TLiteral) or not isinstance(arg.value,
+                                                          (bytes, str)):
+        raise YtError(f"{what} requires a literal string argument",
+                      code=EErrorCode.QueryUnsupported)
+    value = arg.value
+    return value.encode() if isinstance(value, str) else value
+
+
+def _literal_int(arg, what: str) -> int:
+    if not isinstance(arg, ir.TLiteral) or not isinstance(arg.value, int):
+        raise YtError(f"{what} requires a literal integer argument",
+                      code=EErrorCode.QueryUnsupported)
+    return arg.value
 
 
 def _bytes_hash(v: bytes) -> np.uint64:

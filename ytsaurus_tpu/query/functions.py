@@ -183,8 +183,57 @@ _register("length", _infer_string_to_int, 1)
 _register("is_prefix", _infer_string_pred, 2)
 _register("is_substr", _infer_string_pred, 2)
 _register("farm_hash", _infer_hash, 1, 16)
+
+
+def _infer_string_hash(ts):
+    # bigb_hash hashes uid STRINGS (ref bigb_hash registration) — the
+    # lowering builds a per-vocabulary table, so non-string input is a
+    # type error, not silent zeros.
+    if ts[0] not in (EValueType.string, EValueType.null):
+        raise _type_error("bigb_hash", ts)
+    return EValueType.uint64
+
+
+_register("bigb_hash", _infer_string_hash, 1, 1)
 _register("min_of", lambda ts: _min_of(ts), 2, 16)
 _register("max_of", lambda ts: _min_of(ts), 2, 16)
+
+
+# Regex family (ref base/builtin_function_registry.cpp regex_* — RE2
+# there, Python re here; the QL surface is identical for the shared
+# syntax subset).  Pattern (and rewrite) arguments must be literals:
+# they compile at plan time against the column vocabulary.
+def _infer_regex_match(ts):
+    if any(t not in (EValueType.string, EValueType.null) for t in ts):
+        raise _type_error("regex match", ts)
+    return EValueType.boolean
+
+
+def _infer_regex_replace(ts):
+    if any(t not in (EValueType.string, EValueType.null) for t in ts):
+        raise _type_error("regex replace", ts)
+    return EValueType.string
+
+
+_register("regex_full_match", _infer_regex_match, 2)
+_register("regex_partial_match", _infer_regex_match, 2)
+_register("regex_replace_first", _infer_regex_replace, 3)
+_register("regex_replace_all", _infer_regex_replace, 3)
+_register("regex_escape", _infer_string_to_string, 1)
+_register("sha256", _infer_string_to_string, 1)
+_register("parse_int64", _infer_string_to_int, 1)
+
+
+def _infer_substr(ts):
+    if ts[0] not in (EValueType.string, EValueType.null):
+        raise _type_error("substr", ts)
+    for t in ts[1:]:
+        if t not in (EValueType.int64, EValueType.uint64):
+            raise _type_error("substr", ts)
+    return EValueType.string
+
+
+_register("substr", _infer_substr, 2, 3)
 
 
 def _min_of(ts):
