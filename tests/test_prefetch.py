@@ -1,0 +1,151 @@
+"""Adaptive ordered prefetch: lazy shard staging + feedback-bounded
+lookahead in the coordinator.
+
+Ref model: engine_api/coordinator.h:81-90 — scanOrder + prefetch; an
+ordered LIMIT must not stage the shards its early exit skips, and a
+full scan overlaps shard i+1's staging with shard i's evaluation.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.harness import evaluate  # noqa: F401  (env pinning via conftest)
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+from ytsaurus_tpu.query.statistics import QueryStatistics
+from ytsaurus_tpu.schema import TableSchema
+
+T = "//t"
+SCHEMA = TableSchema.make([("k", "int64", "ascending"), ("v", "int64")])
+
+
+def _shards(n=8, rows=50):
+    out = []
+    for s in range(n):
+        ks = np.arange(rows) + s * 1000
+        out.append(ColumnarChunk.from_arrays(
+            SCHEMA, {"k": ks, "v": ks * 2}))
+    return out
+
+
+def test_ordered_limit_touches_at_most_two_shards():
+    """The done-criterion: 8 range-ordered shards, ORDER BY key LIMIT —
+    only the shard(s) the scan actually read were staged."""
+    staged: list[int] = []
+    chunks = _shards()
+
+    def supplier(i):
+        def make():
+            staged.append(i)
+            return chunks[i]
+        return make
+
+    stats = QueryStatistics()
+    plan = build_query(f"k, v FROM [{T}] ORDER BY k ASC LIMIT 5",
+                       {T: SCHEMA})
+    out = coordinate_and_execute(
+        plan, [supplier(i) for i in range(8)],
+        merge_shards_below=4_000_000, range_ordered_by=["k"],
+        stats=stats)
+    rows = out.to_rows()
+    assert [r["k"] for r in rows] == [0, 1, 2, 3, 4]
+    assert len(set(staged)) <= 2, f"staged shards: {sorted(set(staged))}"
+    assert stats.shards_staged <= 2
+    assert stats.shards_skipped >= 6
+
+
+def test_ordered_limit_desc_stages_from_the_far_end():
+    staged: list[int] = []
+    chunks = _shards()
+
+    def supplier(i):
+        def make():
+            staged.append(i)
+            return chunks[i]
+        return make
+
+    stats = QueryStatistics()
+    plan = build_query(f"k FROM [{T}] ORDER BY k DESC LIMIT 3",
+                       {T: SCHEMA})
+    out = coordinate_and_execute(
+        plan, [supplier(i) for i in range(8)],
+        merge_shards_below=4_000_000, range_ordered_by=["k"],
+        stats=stats)
+    assert [r["k"] for r in out.to_rows()] == [7049, 7048, 7047]
+    assert 7 in staged                    # scanned from the top end
+    assert 0 not in staged                # never touched the bottom
+
+
+def test_lazy_matches_eager_results():
+    chunks = _shards(5, 30)
+    for query in (
+            f"sum(v) AS s FROM [{T}] GROUP BY 1",
+            f"k FROM [{T}] WHERE v % 100 = 0 ORDER BY k ASC LIMIT 4",
+            f"k FROM [{T}] LIMIT 7"):
+        plan = build_query(query, {T: SCHEMA})
+        eager = coordinate_and_execute(
+            plan, list(chunks), range_ordered_by=["k"]).to_rows()
+        lazy = coordinate_and_execute(
+            plan, [(lambda c=c: c) for c in chunks],
+            range_ordered_by=["k"]).to_rows()
+
+        def canon(rows):
+            return sorted(tuple(sorted(r.items())) for r in rows)
+        assert canon(lazy) == canon(eager), query
+
+
+def test_full_scan_overlaps_stage_with_compute():
+    """The second done-criterion: with slow staging, the pipelined scan
+    beats the serial stage-then-evaluate lower bound."""
+    n, delay = 6, 0.2
+    chunks = _shards(n, 2000)
+    evals = []
+
+    def supplier(i):
+        def make():
+            time.sleep(delay)             # slow store fetch
+            return chunks[i]
+        return make
+
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    evaluator = Evaluator()
+    plan = build_query(f"sum(v) AS s FROM [{T}] GROUP BY 1", {T: SCHEMA})
+    # Warm the compile cache so timing measures staging overlap, not XLA.
+    coordinate_and_execute(plan, [(lambda c=c: c) for c in chunks],
+                           evaluator=evaluator)
+    t0 = time.perf_counter()
+    out = coordinate_and_execute(plan, [supplier(i) for i in range(n)],
+                                 evaluator=evaluator)
+    elapsed = time.perf_counter() - t0
+    expect = sum(r["k"] * 2 for c in chunks for r in c.to_rows())
+    assert out.to_rows()[0]["s"] == expect
+    serial_staging = n * delay
+    assert elapsed < serial_staging * 0.85, \
+        f"no overlap: {elapsed:.2f}s vs serial staging {serial_staging:.2f}s"
+
+
+def test_client_ordered_limit_stages_few_shards(tmp_path):
+    """End-to-end through the client: a resharded sorted dynamic table,
+    ORDER BY key LIMIT — the statistics prove the skipped tablets were
+    never staged."""
+    client = connect(str(tmp_path))
+    client.create("table", "//dyn", recursive=True,
+                  attributes={"schema": SCHEMA, "dynamic": True})
+    client.reshard_table("//dyn", [(100,), (200,), (300,), (400,),
+                                   (500,), (600,), (700,)])
+    client.mount_table("//dyn")
+    client.insert_rows("//dyn", [{"k": i, "v": i} for i in range(800)])
+    rows = client.select_rows(
+        "k FROM [//dyn] ORDER BY k ASC LIMIT 5")
+    assert [r["k"] for r in rows] == [0, 1, 2, 3, 4]
+    stats = client.last_query_statistics
+    assert stats.shards_staged <= 2, stats.to_dict()
+    assert stats.shards_skipped >= 6, stats.to_dict()
+    # Full scans still see every row.
+    rows = client.select_rows("sum(v) AS s FROM [//dyn] GROUP BY 1")
+    assert rows[0]["s"] == sum(range(800))

@@ -1164,9 +1164,13 @@ class YtClient:
         source_chunks = self._indexed_source_chunks(plan, intervals,
                                                     timestamp)
         if source_chunks is None:
+            # LIMIT scans stage shards lazily: the coordinator's
+            # adaptive prefetcher fetches only what the early exit
+            # reads, and pipelines staging under evaluation.
+            lazy = plan.limit is not None and plan.group is None
             source_chunks = self._query_shards(plan.source, timestamp,
                                                intervals=intervals,
-                                               stats=stats)
+                                               stats=stats, lazy=lazy)
             # Tablet shards of a sorted dynamic table arrive in pivot
             # order: range-ordered by the key columns, which unlocks the
             # ORDER BY <key prefix> LIMIT early exit.
@@ -1527,13 +1531,35 @@ class YtClient:
                              sorted_by=sorted_by, schema=schema)
 
     def _query_shards(self, path: str, timestamp: int,
-                      intervals=None, stats=None) -> list[ColumnarChunk]:
+                      intervals=None, stats=None,
+                      lazy: bool = False) -> list:
+        """Shard chunks for a scan.  lazy=True returns zero-arg
+        SUPPLIERS instead of chunks: staging (tablet snapshot / chunk
+        decode) is deferred into the coordinator's adaptive prefetcher,
+        so an ordered LIMIT never touches the shards its early exit
+        skips (ref coordinator.h scanOrder/prefetch)."""
         node = self._table_node(path)
         if node.attributes.get("dynamic"):
             from ytsaurus_tpu.tablet.ordered import OrderedTablet
+            from ytsaurus_tpu.tablet.timestamp import (
+                ASYNC_LAST_COMMITTED,
+            )
             tablets = self._mounted_tablets(path)
             if isinstance(tablets[0], OrderedTablet):
+                # Ordered snapshots have no timestamp to pin a cut to:
+                # deferring them would read tablets at different times.
                 return [t.snapshot() for t in tablets]
+            if lazy:
+                if timestamp >= ASYNC_LAST_COMMITTED:   # any read-latest
+                    # Deferred snapshots taken at read-latest would see
+                    # DIFFERENT cuts (shard 5 snapshots minutes after
+                    # shard 0 under a slow scan).  Pin one concrete
+                    # timestamp now: every supplier reads the same
+                    # consistent MVCC cut whenever it runs.
+                    timestamp = \
+                        self.cluster.transactions.timestamps.generate()
+                return [(lambda t=t, ts=timestamp: t.read_snapshot(ts))
+                        for t in tablets]
             return [t.read_snapshot(timestamp) for t in tablets]
         chunk_ids = node.attributes.get("chunk_ids", [])
         col_stats = node.attributes.get("chunk_stats", [])
@@ -1548,14 +1574,16 @@ class YtClient:
             if stats is not None:
                 stats.shards_pruned += len(chunk_ids) - len(kept)
             chunk_ids = kept
-        chunks = [self.cluster.chunk_cache.get(cid) for cid in chunk_ids]
-        if not chunks:
+        if not chunk_ids:
             schema = self._node_schema(node)
             if schema is None:
                 raise YtError(f"Empty table {path!r} has no schema",
                               code=EErrorCode.NoSuchNode)
             return [ColumnarChunk.from_rows(schema.to_unsorted(), [])]
-        return chunks
+        if lazy:
+            return [(lambda cid=cid: self.cluster.chunk_cache.get(cid))
+                    for cid in chunk_ids]
+        return [self.cluster.chunk_cache.get(cid) for cid in chunk_ids]
 
 
 class _SchemaResolver(dict):

@@ -224,9 +224,65 @@ def _ordered_scan_direction(plan: ir.Query,
     return "desc" if items[0].descending else "asc"
 
 
+class _PrefetchScanner:
+    """Adaptive ordered prefetch (ref engine_api/coordinator.h:81-90 —
+    scanOrder + prefetch): while shard i evaluates on device, shards
+    i+1..i+window stage on background threads.  The window is
+    FEEDBACK-BOUNDED: an early-exit scan starts at 1 (it expects to
+    stop; staging ahead would touch chunks the exit saves), and doubles
+    each time the scan actually continues, up to max_window — a scan
+    that keeps going converges to full pipelining."""
+
+    def __init__(self, shards, window: int = 1, max_window: int = 4,
+                 stats=None, count_rows: bool = False):
+        from concurrent.futures import ThreadPoolExecutor
+        self.shards = list(shards)
+        self.window = max(window, 1)
+        self.max_window = max_window
+        self.stats = stats
+        self.count_rows = count_rows
+        self._futures: dict = {}
+        self._executor = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="shard-prefetch")
+
+    def _submit(self, i: int) -> None:
+        if 0 <= i < len(self.shards) and i not in self._futures:
+            shard = self.shards[i]
+            if callable(shard):
+                self._futures[i] = self._executor.submit(shard)
+            else:
+                from concurrent.futures import Future
+                fut: Future = Future()
+                fut.set_result(shard)
+                self._futures[i] = fut
+
+    def get(self, i: int) -> ColumnarChunk:
+        self._submit(i)
+        for j in range(i + 1, i + 1 + self.window):
+            self._submit(j)
+        chunk = self._futures.pop(i).result()
+        # Staged-shard accounting is meaningful only for LAZY scans
+        # (eager inputs were fetched before the coordinator ever ran).
+        if self.stats is not None and self.count_rows:
+            self.stats.shards_staged += 1
+            self.stats.rows_read += chunk.row_count
+        return chunk
+
+    def feedback(self) -> None:
+        """The scan continued past a shard: stage further ahead."""
+        self.window = min(self.window * 2, self.max_window)
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=False, cancel_futures=True)
+
+
+def _materialize(shard) -> ColumnarChunk:
+    return shard() if callable(shard) else shard
+
+
 def coordinate_and_execute(
         plan: ir.Query,
-        chunks: Sequence[ColumnarChunk],
+        chunks: Sequence,
         foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None,
         evaluator: Optional[Evaluator] = None,
         merge_shards_below: int = 0,
@@ -238,7 +294,13 @@ def coordinate_and_execute(
     Ref: CoordinateAndExecute (engine_api/coordinator.cpp) — here shard
     results stay on device; only the final row count syncs to host.
 
-    `merge_shards_below`: when > 0, shards are first coalesced so no device
+    `chunks` entries may be ColumnarChunks OR zero-arg callables
+    producing them (LAZY shards): staging then happens inside the scan
+    through the adaptive prefetcher, so an ordered LIMIT touches only
+    the shards it actually reads, and a full scan overlaps shard i+1's
+    staging with shard i's evaluation.
+
+    `merge_shards_below`: when > 0, shards are coalesced so no device
     program runs over fewer than this many rows — per-program dispatch
     overhead dominates small shards (ref analog: chunk slice grouping in
     chunk pools).  0 preserves one program per shard.
@@ -253,6 +315,7 @@ def coordinate_and_execute(
     if not chunks:
         raise YtError("coordinate_and_execute: no input shards",
                       code=EErrorCode.QueryExecutionError)
+    lazy = any(callable(c) for c in chunks)
     # Early-exit budget, decided BEFORE any shard coalescing: when a
     # LIMIT scan can stop after the first shard or two, merging every
     # shard into one big program would do strictly more work than the
@@ -267,7 +330,7 @@ def coordinate_and_execute(
                                                      range_ordered_by)
             if scan_direction is not None:
                 needed = plan.offset + plan.limit
-    if merge_shards_below > 0 and len(chunks) > 1:
+    if merge_shards_below > 0 and len(chunks) > 1 and not lazy:
         if scan_direction is None:
             # Bare LIMIT (or no early exit): full coalescing — a
             # selective WHERE may scan everything, so dispatch overhead
@@ -283,9 +346,14 @@ def coordinate_and_execute(
             chunks = _coalesce_shards(chunks, max(needed, 1))
     if stats is not None:
         stats.shards_total += len(chunks)
-        stats.rows_read += sum(c.row_count for c in chunks)
+        if not lazy:
+            stats.rows_read += sum(c.row_count for c in chunks)
     if len(chunks) == 1:
-        result = evaluator.run_plan(plan, chunks[0], foreign_chunks,
+        chunk = _materialize(chunks[0])
+        if lazy and stats is not None:
+            stats.shards_staged += 1
+            stats.rows_read += chunk.row_count
+        result = evaluator.run_plan(plan, chunk, foreign_chunks,
                                     stats=stats)
     else:
         bottom, front = split_plan(plan)
@@ -303,17 +371,51 @@ def coordinate_and_execute(
         scan_chunks = list(chunks)
         if scan_direction == "desc":
             scan_chunks.reverse()
+        # Lazy shards could not be pre-coalesced (row counts unknown
+        # before staging): group AFTER materialization.  ANY early exit
+        # (ordered or bare LIMIT) caps the group at the scan budget —
+        # staging past `needed` rows before the first program would
+        # fetch exactly the chunks the exit exists to save.  (The eager
+        # path coalesces bare LIMITs fully only because its chunks were
+        # already staged — a sunk cost lazy scans don't have.)
+        group_threshold = 0
+        if lazy and merge_shards_below > 0:
+            group_threshold = max(needed, 1) if needed is not None \
+                else merge_shards_below
+        scanner = _PrefetchScanner(
+            scan_chunks,
+            window=1 if needed is not None else 2,
+            stats=stats, count_rows=lazy)
         partials = []
-        collected = 0
-        for i, chunk in enumerate(scan_chunks):
-            partial = evaluator.run_plan(bottom, chunk, foreign_chunks,
-                                         stats=stats)
-            partials.append(partial)
-            collected += partial.row_count
-            if needed is not None and collected >= needed:
-                if stats is not None:
-                    stats.shards_skipped += len(scan_chunks) - (i + 1)
-                break
+        try:
+            collected = 0
+            group: list = []
+            group_rows = 0
+            for i in range(len(scan_chunks)):
+                chunk = scanner.get(i)
+                if group_threshold > 0:
+                    group.append(chunk)
+                    group_rows += chunk.row_count
+                    if group_rows < group_threshold and \
+                            i + 1 < len(scan_chunks):
+                        # No feedback here: only an EVALUATION that
+                        # declined to exit proves the scan continues.
+                        continue
+                    chunk = concat_chunks(group) if len(group) > 1 \
+                        else group[0]
+                    group, group_rows = [], 0
+                partial = evaluator.run_plan(bottom, chunk,
+                                             foreign_chunks, stats=stats)
+                partials.append(partial)
+                collected += partial.row_count
+                if needed is not None and collected >= needed:
+                    if stats is not None:
+                        stats.shards_skipped += \
+                            len(scan_chunks) - (i + 1)
+                    break
+                scanner.feedback()
+        finally:
+            scanner.close()
         merged = concat_chunks(
             [p.slice_rows(0, p.row_count) for p in partials])
         result = evaluator.run_plan(front, merged, stats=stats)

@@ -17,6 +17,7 @@ class QueryStatistics:
     shards_total: int = 0
     shards_pruned: int = 0
     shards_skipped: int = 0          # LIMIT early-exit left these unread
+    shards_staged: int = 0           # shards actually fetched/decoded
     joins_executed: int = 0
 
     def to_dict(self) -> dict:
