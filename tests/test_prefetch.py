@@ -6,11 +6,9 @@ ordered LIMIT must not stage the shards its early exit skips, and a
 full scan overlaps shard i+1's staging with shard i's evaluation.
 """
 
-import threading
 import time
 
 import numpy as np
-import pytest
 
 from tests.harness import evaluate  # noqa: F401  (env pinning via conftest)
 from ytsaurus_tpu.chunks.columnar import ColumnarChunk

@@ -22,11 +22,14 @@ class LocalCluster:
     def __init__(self, root_dir: str, n_nodes: int = 2,
                  replication_factor: int = 2, http_proxy: bool = False,
                  n_masters: int = 1, lease_ttl: float = 4.0,
-                 kafka_proxy: bool = False, n_clocks: int = 0):
+                 kafka_proxy: bool = False, n_clocks: int = 0,
+                 scheduler: bool = False):
         self.root_dir = root_dir
         self.n_nodes = n_nodes
         self.n_masters = n_masters
         self.n_clocks = n_clocks
+        self.with_scheduler = scheduler
+        self.scheduler_address: "str | None" = None
         self.lease_ttl = lease_ttl
         self.replication_factor = replication_factor
         self.http_proxy = http_proxy
@@ -118,6 +121,15 @@ class LocalCluster:
                 os.replace(tmp, journals_path)
                 self._procs.extend(clock_procs)
                 self._pending_clock_procs = []
+            if self.with_scheduler:
+                # The operation daemon (scheduler + controller agent
+                # split out of the master process).
+                sched_root = os.path.join(self.root_dir, "scheduler")
+                self._spawn("scheduler", sched_root, [
+                    "--role", "scheduler", "--root", sched_root,
+                    "--primary", ",".join(self.master_addresses)])
+                port = self._wait_port(sched_root, "scheduler", deadline)
+                self.scheduler_address = f"127.0.0.1:{port}"
             self._wait_ready(deadline)
             if self.http_proxy:
                 proxy_root = os.path.join(self.root_dir, "proxy")
@@ -137,7 +149,7 @@ class LocalCluster:
         # Drop stale port files: a restart on the same root must not hand
         # out the previous incarnation's ports.
         for stale in ("primary.port", "node.port", "proxy.port",
-                      "clock.port"):
+                      "clock.port", "scheduler.port"):
             try:
                 os.unlink(os.path.join(root, stale))
             except FileNotFoundError:
@@ -300,6 +312,33 @@ class LocalCluster:
         proc.kill()
         proc.wait(timeout=10)
         return m
+
+    # -- operation-daemon helpers ----------------------------------------------
+
+    def _scheduler_proc_index(self) -> int:
+        if not self.with_scheduler:
+            raise YtError("cluster started without scheduler=True")
+        return self.n_masters + self.n_nodes + self.n_clocks
+
+    def kill_scheduler(self) -> None:
+        """Hard-kill the operation daemon (kill -9 fault injection)."""
+        proc = self._procs[self._scheduler_proc_index()]
+        proc.kill()
+        proc.wait(timeout=10)
+
+    def restart_scheduler(self, timeout: float = 120.0) -> None:
+        """Bring the operation daemon back on the same root: it revives
+        orphaned operations from their Cypress records + snapshots."""
+        index = self._scheduler_proc_index()
+        self._procs.pop(index)
+        sched_root = os.path.join(self.root_dir, "scheduler")
+        self._spawn("scheduler", sched_root, [
+            "--role", "scheduler", "--root", sched_root,
+            "--primary", ",".join(self.master_addresses)])
+        self._procs.insert(index, self._procs.pop())
+        deadline = time.monotonic() + timeout
+        port = self._wait_port(sched_root, "scheduler", deadline)
+        self.scheduler_address = f"127.0.0.1:{port}"
 
     # -- clock-quorum helpers --------------------------------------------------
 

@@ -249,6 +249,12 @@ class _PrefetchScanner:
         if 0 <= i < len(self.shards) and i not in self._futures:
             shard = self.shards[i]
             if callable(shard):
+                # Count at SUBMIT: a window-prefetched shard the exit
+                # then skips was still fetched/decoded, and the staged
+                # counter must say so.  (Eager inputs were fetched
+                # before the coordinator ran — not counted here.)
+                if self.stats is not None and self.count_rows:
+                    self.stats.shards_staged += 1
                 self._futures[i] = self._executor.submit(shard)
             else:
                 from concurrent.futures import Future
@@ -261,10 +267,7 @@ class _PrefetchScanner:
         for j in range(i + 1, i + 1 + self.window):
             self._submit(j)
         chunk = self._futures.pop(i).result()
-        # Staged-shard accounting is meaningful only for LAZY scans
-        # (eager inputs were fetched before the coordinator ever ran).
         if self.stats is not None and self.count_rows:
-            self.stats.shards_staged += 1
             self.stats.rows_read += chunk.row_count
         return chunk
 

@@ -561,7 +561,8 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--role",
                         choices=("primary", "node", "proxy",
-                                 "master_cache", "tcp_proxy", "clock"),
+                                 "master_cache", "tcp_proxy", "clock",
+                                 "scheduler"),
                         required=True)
     parser.add_argument("--journals", default=None,
                         help="journal-node addresses (clock role)")
@@ -617,6 +618,11 @@ def main() -> None:
             parser.error("--primary is required for --role master_cache")
         from ytsaurus_tpu.server.master_cache import run_master_cache
         run_master_cache(args.root, args.port, args.primary)
+    elif args.role == "scheduler":
+        if not args.primary:
+            parser.error("--primary is required for --role scheduler")
+        from ytsaurus_tpu.server.scheduler_daemon import run_scheduler
+        run_scheduler(args.root, args.port, args.primary)
     elif args.role == "clock":
         if not args.journals and not args.journals_file:
             parser.error("--journals or --journals-file is required "
