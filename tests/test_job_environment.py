@@ -1,0 +1,103 @@
+"""Job isolation environments: per-job resource enforcement.
+
+Ref model: server/node/exec_node/job_environment.cpp (simple / porto /
+CRI) — here realized as rlimits applied between fork and exec, with
+failure classification so an operator sees WHY a limited job died.
+"""
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.errors import YtError
+from ytsaurus_tpu.operations.job_environment import (
+    classify_failure,
+    limits_from_spec,
+    make_preexec,
+)
+
+
+@pytest.fixture
+def client(tmp_path):
+    return connect(str(tmp_path))
+
+
+def test_limits_extraction():
+    assert limits_from_spec({}) is None
+    assert limits_from_spec({"memory_limit": 1 << 30}) == \
+        {"memory_limit": 1 << 30}
+    assert limits_from_spec({"cpu_limit": 2, "nice": 5,
+                             "command": "cat"}) == \
+        {"cpu_limit": 2, "nice": 5}
+    assert make_preexec(None) is None
+    assert make_preexec({"memory_limit": 1 << 30}) is not None
+
+
+def test_memory_limit_kills_allocation(client):
+    """A job allocating past memory_limit dies and the error names the
+    cause; a job under the limit sails through."""
+    client.write_table("//in", [{"k": 1}])
+    hog = ("python3 -c \"import sys; x = bytearray(512 * 1024 * 1024); "
+           "sys.stdout.write(sys.stdin.read())\"")
+    with pytest.raises(YtError) as ei:
+        client.run_map(hog, "//in", "//out",
+                       memory_limit=128 << 20, remote_jobs=False)
+    flat = str(ei.value.to_dict())
+    assert "memory limit exceeded" in flat or "MemoryError" in flat
+    # Same allocation WITHOUT the limit succeeds (the box has RAM).
+    op = client.run_map(hog, "//in", "//out2", remote_jobs=False)
+    assert op.state == "completed"
+
+
+def test_cpu_limit_kills_spinner(client):
+    """RLIMIT_CPU caps CPU seconds, distinct from wall-clock timeouts:
+    a busy-loop dies even though no job_time_limit is set."""
+    client.write_table("//in", [{"k": 1}])
+    with pytest.raises(YtError) as ei:
+        client.run_map("while :; do :; done", "//in", "//out",
+                       cpu_limit=1, remote_jobs=False)
+    flat = str(ei.value.to_dict())
+    assert "cpu limit exceeded" in flat or "exit code -" in flat
+
+
+def test_limited_job_within_budget_unaffected(client):
+    client.write_table("//in", [{"k": i} for i in range(20)])
+    op = client.run_map("cat", "//in", "//out",
+                        memory_limit=256 << 20, cpu_limit=30,
+                        max_open_files=256, remote_jobs=False)
+    assert op.state == "completed"
+    assert len(client.read_table("//out")) == 20
+
+
+def test_limits_enforced_on_exec_nodes(tmp_path):
+    """The distributed path: limits ride the start_job RPC and the exec
+    NODE applies them to the user process."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from ytsaurus_tpu.environment import LocalCluster
+    from ytsaurus_tpu.remote_client import connect_remote
+
+    with LocalCluster(str(tmp_path / "c"), n_nodes=1) as cluster:
+        cl = connect_remote(cluster.primary_address)
+        cl.write_table("//in", [{"k": 1}])
+        hog = ("python3 -c \"import sys; x = bytearray(512 * 1024 * "
+               "1024); sys.stdout.write(sys.stdin.read())\"")
+        with pytest.raises(YtError) as ei:
+            cl.run_map(hog, "//in", "//out", memory_limit=128 << 20)
+        flat = str(ei.value.to_dict())
+        assert "memory limit" in flat or "MemoryError" in flat or \
+            "exited" in flat
+        op = cl.run_map("cat", "//in", "//ok", memory_limit=256 << 20)
+        assert op.state == "completed"
+        cl.close()
+
+
+def test_classify_failure():
+    import signal
+    assert classify_failure(0, b"", {"memory_limit": 1}) is None
+    assert classify_failure(1, b"MemoryError",
+                            {"memory_limit": 1}) == \
+        "memory limit exceeded (RLIMIT_AS)"
+    assert classify_failure(-signal.SIGXCPU, b"",
+                            {"cpu_limit": 1}) == \
+        "cpu limit exceeded (SIGXCPU)"
+    assert classify_failure(1, b"boom", None) is None

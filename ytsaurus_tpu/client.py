@@ -47,6 +47,11 @@ class YtCluster:
         # non-empty enables dispatching command jobs to exec-node slots.
         self.node_directory: "Callable[[], dict] | None" = None
         self.chunk_cache = ChunkCache(self.chunk_store)
+        # Chunks written but not yet published to any table (the chunk
+        # merger's write→CAS window): GC and the replicator must treat
+        # them as referenced or a concurrent sweep deletes a chunk a
+        # table is about to adopt.
+        self.protected_chunk_ids: set = set()
         self.transactions = TransactionManager()
         self.evaluator = Evaluator()
         self.tablets: dict[str, list[Tablet]] = {}   # node id → tablets
@@ -482,6 +487,8 @@ class YtClient:
         for tablets in list(self.cluster.tablets.values()):
             for tablet in list(tablets):
                 referenced.update(tablet.chunk_ids)
+        # Written-but-unpublished chunks (chunk merger's CAS window).
+        referenced.update(self.cluster.protected_chunk_ids)
         return referenced
 
     def collect_garbage(self) -> int:

@@ -623,13 +623,21 @@ def run_remote_command_job(job: Job, address: str, body: dict,
 
 def run_command_job(job: Job, command: str, input_blob: bytes,
                     timeout: Optional[float] = None,
-                    env: Optional[dict] = None) -> bytes:
+                    env: Optional[dict] = None,
+                    limits: Optional[dict] = None) -> bytes:
     """Run a user command with formatted rows on stdin; returns stdout.
 
     Ref: job_proxy user_job.cpp — a separate process (own process group,
     the slot-isolation analog), wire-format pipes, stderr tail kept on
-    the job, non-zero exit = job failure."""
+    the job, non-zero exit = job failure.  `limits` applies the job
+    environment's resource enforcement (rlimits) in the child — see
+    operations/job_environment.py."""
     import os
+
+    from ytsaurus_tpu.operations.job_environment import (
+        classify_failure,
+        make_preexec,
+    )
     if job._lost or job._preempted:
         # Killed before the process spawned: don't start work that is
         # already condemned.
@@ -639,6 +647,7 @@ def run_command_job(job: Job, command: str, input_blob: bytes,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         start_new_session=True,
+        preexec_fn=make_preexec(limits),
         env={**os.environ, **(env or {}),
              "YT_JOB_ID": job.id, "YT_JOB_INDEX": str(job.index),
              "YT_OPERATION_ID": job.op_id})
@@ -660,10 +669,14 @@ def run_command_job(job: Job, command: str, input_blob: bytes,
     if job._lost:
         raise YtError("job preempted", code=EErrorCode.Canceled)
     if proc.returncode != 0:
+        attributes = {"stderr": job.stderr_tail.decode("utf-8",
+                                                       "replace"),
+                      "exit_code": proc.returncode}
+        cause = classify_failure(proc.returncode, job.stderr_tail,
+                                 limits)
+        if cause:
+            attributes["probable_cause"] = cause
         raise YtError(
             f"User job {job.id} failed with exit code {proc.returncode}",
-            code=EErrorCode.OperationFailed,
-            attributes={"stderr": job.stderr_tail.decode("utf-8",
-                                                         "replace"),
-                        "exit_code": proc.returncode})
+            code=EErrorCode.OperationFailed, attributes=attributes)
     return stdout

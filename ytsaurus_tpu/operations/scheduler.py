@@ -444,9 +444,13 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
                 addrs = list(dict(exec_nodes).values())
                 by_id = all(id(c) in chunk_to_id
                             for c, _, _ in stripe.slices)
+                from ytsaurus_tpu.operations.job_environment import (
+                    limits_from_spec,
+                )
                 body = {"command": command, "format": fmt,
                         "op_id": op_id, "job_id": job.id,
                         "time_limit": spec.get("job_time_limit"),
+                        "limits": limits_from_spec(spec),
                         "env": spec.get("environment") or {}}
                 blob = None
                 if by_id:
@@ -498,9 +502,13 @@ def _map_controller(client, spec: dict, op=None, job_manager=None) -> dict:
             return run_remote, True
 
         def run_cmd(job):
+            from ytsaurus_tpu.operations.job_environment import (
+                limits_from_spec,
+            )
             blob = dumps_rows(stripe.materialize().to_rows(), fmt)
             out = run_command_job(job, command, blob,
-                                  timeout=spec.get("job_time_limit"))
+                                  timeout=spec.get("job_time_limit"),
+                                  limits=limits_from_spec(spec))
             return loads_rows(out, fmt)
         return run_cmd, True
 
@@ -646,9 +654,13 @@ def _make_reduce_runner(reducer, command, reduce_by, fmt, spec):
             return run_py, False
 
         def run_cmd(job):
+            from ytsaurus_tpu.operations.job_environment import (
+                limits_from_spec,
+            )
             blob = dumps_rows(rows_fn(), fmt)
             out = run_command_job(job, command, blob,
-                                  timeout=spec.get("job_time_limit"))
+                                  timeout=spec.get("job_time_limit"),
+                                  limits=limits_from_spec(spec))
             return loads_rows(out, fmt)
         return run_cmd, True
     return make
@@ -820,9 +832,13 @@ def _map_reduce_controller(client, spec: dict, op=None,
             if mapper is not None:
                 rows = list(mapper(rows))
             elif map_command is not None:
+                from ytsaurus_tpu.operations.job_environment import (
+                    limits_from_spec,
+                )
                 blob = dumps_rows(rows, fmt)
                 out = run_command_job(job, map_command, blob,
-                                      timeout=spec.get("job_time_limit"))
+                                      timeout=spec.get("job_time_limit"),
+                                      limits=limits_from_spec(spec))
                 rows = loads_rows(out, fmt)
             return partition_rows(rows, reduce_by, partition_count)
         return run_map, map_command is not None
@@ -924,13 +940,20 @@ def _vanilla_controller(client, spec: dict, op=None,
                 if command is not None:
                     def run_cmd(job, _cmd=command, _name=name,
                                 _rank=rank, _task=task):
+                        from ytsaurus_tpu.operations.job_environment \
+                            import limits_from_spec
                         out = run_command_job(
                             job, _cmd, b"",
                             timeout=_task.get("job_time_limit") or
                             spec.get("job_time_limit"),
                             env={"YT_TASK_NAME": _name,
                                  "YT_JOB_COOKIE": str(_rank),
-                                 **(_task.get("environment") or {})})
+                                 **(_task.get("environment") or {})},
+                            # Per-KEY merge: a task overriding one limit
+                            # must not drop the operation-wide others.
+                            limits={**(limits_from_spec(spec) or {}),
+                                    **(limits_from_spec(_task) or {})}
+                            or None)
                         return loads_rows(out, fmt) if out.strip() else []
                     run, preemptible = run_cmd, True
                 else:

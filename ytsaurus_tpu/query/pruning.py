@@ -176,4 +176,9 @@ def compute_column_stats(chunk) -> dict:
                 entry["min"] = int(data.min())
                 entry["max"] = int(data.max())
         out[name] = entry
+    # Not a column: per-chunk row count rides the stats so metadata-only
+    # consumers (chunk merger sizing) never decode the chunk.  "$" can
+    # never collide with a column name, and chunk_may_match looks
+    # columns up by name so it skips this key.
+    out["$row_count"] = n
     return out

@@ -368,6 +368,10 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         liveness_provider=client.referenced_chunk_ids)
     replicator.start()
     orchid.register("/chunk_replicator", lambda: dict(replicator.stats))
+    # Small-chunk background compaction (ref chunk_merger.h:136).
+    from ytsaurus_tpu.server.chunk_merger import ChunkMerger
+    merger = ChunkMerger(client).start()
+    orchid.register("/chunk_merger", lambda: dict(merger.stats))
     # Generalized service discovery (ref server/discovery_server): any
     # process can publish into named groups; NodeTracker stays the
     # data-node special case.

@@ -96,6 +96,11 @@ class ExecNodeService(Service):
             "peers": [_text(p) for p in (body.get("peers") or [])],
             "job_id": _text(body.get("job_id") or ""),
             "op_id": _text(body.get("op_id") or ""),
+            # Job environment enforcement (rlimits applied in the child;
+            # operations/job_environment.py).
+            "limits": {_text(k): int(v)
+                       for k, v in (body.get("limits") or {}).items()}
+            or None,
         }
         input_blob = attachments[0] if attachments else None
         job_key = _text(body.get("job_key") or "")
@@ -210,10 +215,14 @@ class ExecNodeService(Service):
                                   code=EErrorCode.Canceled)
                 if input_blob is None:
                     input_blob = self._materialize(spec)
+                from ytsaurus_tpu.operations.job_environment import (
+                    make_preexec,
+                )
                 proc = subprocess.Popen(
                     ["/bin/sh", "-c", spec["command"]],
                     stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                     stderr=subprocess.PIPE, start_new_session=True,
+                    preexec_fn=make_preexec(spec.get("limits")),
                     env={**os.environ, **spec["env"],
                          "YT_JOB_ID": spec["job_id"] or job_id,
                          "YT_OPERATION_ID": spec["op_id"]})
@@ -233,9 +242,17 @@ class ExecNodeService(Service):
                 if entry["aborted"]:
                     raise YtError("job aborted", code=EErrorCode.Canceled)
                 if proc.returncode != 0:
+                    from ytsaurus_tpu.operations.job_environment import (
+                        classify_failure,
+                    )
+                    cause = classify_failure(
+                        proc.returncode, entry["stderr"],
+                        spec.get("limits"))
                     raise YtError(
                         f"user job exited {proc.returncode}",
-                        code=EErrorCode.OperationFailed)
+                        code=EErrorCode.OperationFailed,
+                        attributes={"probable_cause": cause}
+                        if cause else {})
                 entry["stdout"] = stdout
                 entry["state"] = "completed"
             except YtError as err:
