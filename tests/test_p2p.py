@@ -1,0 +1,129 @@
+"""P2P hot-chunk distribution.
+
+Ref model: server/node/data_node/p2p.h (TP2PDistributor) — a hammered
+chunk seeds temporary copies onto peers so read load spreads; seeds
+evict after the heat passes, and pre-existing replicas are never
+evicted.
+"""
+
+import time
+
+import pytest
+
+from ytsaurus_tpu.chunks.store import FsChunkStore
+from ytsaurus_tpu.rpc import Channel, RpcServer
+from ytsaurus_tpu.server.p2p import P2PDistributor
+from ytsaurus_tpu.server.services import DataNodeService
+
+
+@pytest.fixture
+def trio(tmp_path):
+    """Three in-process data nodes with real RPC between them."""
+    nodes = []
+    for i in range(3):
+        store = FsChunkStore(str(tmp_path / f"n{i}" / "chunks"))
+        service = DataNodeService(store, str(tmp_path / f"n{i}" / "j"))
+        server = RpcServer([service], port=0)
+        server.start()
+        nodes.append({"store": store, "service": service,
+                      "server": server,
+                      "address": f"127.0.0.1:{server.port}"})
+    yield nodes
+    for n in nodes:
+        try:
+            n["server"].stop()
+        except Exception:       # noqa: BLE001 — a test may have stopped it
+            pass
+
+
+def _distributor(nodes, i, **kw):
+    kw.setdefault("hot_threshold", 5)
+    kw.setdefault("window", 0.4)
+    kw.setdefault("cooldown", 0.5)
+    kw.setdefault("fanout", 2)
+    peers = [n["address"] for n in nodes]
+    return P2PDistributor(nodes[i]["store"],
+                          lambda: nodes[i]["address"],
+                          lambda: peers, **kw)
+
+
+def test_hot_chunk_seeds_to_peers_and_evicts(trio):
+    src = trio[0]
+    src["store"].put_blob("hot1", b"x" * 1024)
+    p2p = _distributor(trio, 0)
+    for _ in range(10):
+        p2p.record_read("hot1")
+    p2p.tick_once()
+    assert trio[1]["store"].exists("hot1")
+    assert trio[2]["store"].exists("hot1")
+    assert p2p.stats["seeded_copies"] == 2
+    # Heat passes: NO more record_read calls — the tick itself must
+    # expire the stale window, or seeds would reheat forever.
+    time.sleep(0.6)
+    p2p.tick_once()
+    assert not trio[1]["store"].exists("hot1")
+    assert not trio[2]["store"].exists("hot1")
+    assert src["store"].exists("hot1")             # the origin stays
+    assert p2p.stats["evicted_copies"] == 2
+
+
+def test_cold_chunks_not_seeded(trio):
+    trio[0]["store"].put_blob("cold", b"y" * 64)
+    p2p = _distributor(trio, 0)
+    p2p.record_read("cold")
+    p2p.tick_once()
+    assert not trio[1]["store"].exists("cold")
+    assert p2p.stats["seeded_copies"] == 0
+
+
+def test_existing_holders_never_evicted(trio):
+    """A peer that already held the chunk is not a seed target, so
+    eviction can never delete a real replica."""
+    trio[0]["store"].put_blob("shared", b"z" * 128)
+    trio[1]["store"].put_blob("shared", b"z" * 128)   # real replica
+    p2p = _distributor(trio, 0)
+    for _ in range(10):
+        p2p.record_read("shared")
+    p2p.tick_once()
+    assert trio[2]["store"].exists("shared")          # seeded here only
+    with p2p._lock:
+        entry = p2p._seeded["shared"]
+    assert trio[1]["address"] not in entry["targets"]
+    time.sleep(0.6)
+    p2p.tick_once()
+    assert trio[1]["store"].exists("shared")          # replica SURVIVES
+    assert not trio[2]["store"].exists("shared")
+
+
+def test_continued_heat_extends_seed_lease(trio):
+    trio[0]["store"].put_blob("warm", b"w" * 64)
+    p2p = _distributor(trio, 0)
+    for _ in range(10):
+        p2p.record_read("warm")
+    p2p.tick_once()
+    assert trio[1]["store"].exists("warm")
+    time.sleep(0.6)
+    for _ in range(10):
+        p2p.record_read("warm")                        # still hot
+    p2p.tick_once()
+    assert trio[1]["store"].exists("warm")             # lease extended
+
+
+def test_seeded_copy_serves_reads_when_origin_dies(trio):
+    """The availability payoff: a seeded copy answers get_chunk after
+    the origin is gone — exactly what the client's fallback path probes
+    for."""
+    trio[0]["store"].put_blob("payoff", b"p" * 256)
+    p2p = _distributor(trio, 0, cooldown=60.0)
+    for _ in range(10):
+        p2p.record_read("payoff")
+    p2p.tick_once()
+    trio[0]["server"].stop()                           # origin dies
+    holder = trio[1] if trio[1]["store"].exists("payoff") else trio[2]
+    channel = Channel(holder["address"], timeout=10)
+    try:
+        _, attachments = channel.call("data_node", "get_chunk",
+                                      {"chunk_id": "payoff"})
+        assert attachments[0] == b"p" * 256
+    finally:
+        channel.close()

@@ -457,6 +457,39 @@ def run_node(root: str, port: int, primary_address: str,
                         OrchidService(orchid)], port=port)
     server.start()
     _write_port_file(root, "node", server.port)
+    # P2P hot-chunk distribution (ref data_node/p2p.h TP2PDistributor):
+    # reads past the heat threshold seed copies onto peers, discovered
+    # through the primary's node tracker.
+    from ytsaurus_tpu.server.p2p import P2PDistributor
+    self_address = f"127.0.0.1:{server.port}"
+
+    def p2p_peers() -> list:
+        from ytsaurus_tpu.errors import YtError as _YtError
+        from ytsaurus_tpu.rpc import Channel
+        # Every primary answers (the node already heartbeats them all);
+        # falling over keeps discovery alive when one master is down.
+        for addr in primary_address.split(","):
+            if not addr.strip():
+                continue
+            channel = Channel(addr.strip(), timeout=10)
+            try:
+                body, _ = channel.call("node_tracker", "list_nodes", {})
+                return [a.decode() if isinstance(a, bytes) else a
+                        for a in body.get("alive") or []]
+            except _YtError:
+                continue
+            finally:
+                channel.close()
+        return []
+
+    p2p = P2PDistributor(
+        store, lambda: self_address, p2p_peers,
+        hot_threshold=int(os.environ.get("YT_TPU_P2P_THRESHOLD", 50)),
+        window=float(os.environ.get("YT_TPU_P2P_WINDOW", 5.0)),
+        cooldown=float(os.environ.get("YT_TPU_P2P_COOLDOWN", 120.0)),
+    ).start()
+    service.p2p = p2p
+    orchid.register("/data_node/p2p", lambda: dict(p2p.stats))
     monitoring = MonitoringServer(orchid)
     monitoring.start()
     _write_port_file(root, "node.monitoring", monitoring.port)

@@ -21,6 +21,19 @@ from ytsaurus_tpu.utils.logging import get_logger
 logger = get_logger("server")
 
 
+def chunk_push_request(store, chunk_id: str) -> "tuple[dict, bytes]":
+    """(body, blob) for a node-to-node chunk push — ONE protocol shared
+    by the replicator's replicate_chunk job and P2P seeding (erasure
+    chunks reconstruct on read and carry their codec tag so the target
+    re-encodes the full part set)."""
+    blob = store.get_blob(chunk_id)
+    body = {"chunk_id": chunk_id}
+    erasure = store.erasure_codec_of(chunk_id)
+    if erasure is not None:
+        body["erasure"] = erasure
+    return body, blob
+
+
 class DataNodeService(Service):
     """Serves chunk blobs + journal records from one store location."""
 
@@ -51,9 +64,15 @@ class DataNodeService(Service):
                             erasure=_text(erasure) if erasure else None)
         return {}
 
+    # Set by the daemon when P2P hot-chunk distribution is on; reads
+    # then feed its heat accounting (server/p2p.py).
+    p2p = None
+
     @rpc_method()
     def get_chunk(self, body, attachments):
         chunk_id = _text(body["chunk_id"])
+        if self.p2p is not None:
+            self.p2p.record_read(chunk_id)
         return {}, [self.store.get_blob(chunk_id)]
 
     @rpc_method()
@@ -105,11 +124,7 @@ class DataNodeService(Service):
         from ytsaurus_tpu.rpc import Channel, RetryingChannel
         chunk_id = _text(body["chunk_id"])
         target = _text(body["target"])
-        blob = self.store.get_blob(chunk_id)
-        req = {"chunk_id": chunk_id}
-        erasure = self.store.erasure_codec_of(chunk_id)
-        if erasure is not None:
-            req["erasure"] = erasure
+        req, blob = chunk_push_request(self.store, chunk_id)
         with self._journal_lock:
             peer = self._peers.get(target)
             if peer is None:
