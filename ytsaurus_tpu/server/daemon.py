@@ -372,6 +372,31 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     from ytsaurus_tpu.server.chunk_merger import ChunkMerger
     merger = ChunkMerger(client).start()
     orchid.register("/chunk_merger", lambda: dict(merger.stats))
+    # Continuous CPU profiler + span export (ref ytprof cpu_profiler.h,
+    # jaeger/tracer.h): always-on statistical sampling served via
+    # Orchid; finished spans batch-flush to <root>/traces.jsonl.
+    profiler_interval = float(os.environ.get("YT_TPU_PROFILER_INTERVAL",
+                                             0.05))
+    if profiler_interval > 0:
+        from ytsaurus_tpu.utils.profiler import (
+            SamplingProfiler,
+            TraceExporter,
+            jsonl_sink,
+        )
+        cpu_profiler = SamplingProfiler(
+            interval=profiler_interval).start()
+        orchid.register("/profiler", lambda: {
+            **cpu_profiler.state(),
+            "hotspots": cpu_profiler.hotspots()})
+        orchid.register("/profiler/collapsed",
+                        lambda: cpu_profiler.collapsed())
+        exporter = TraceExporter(
+            jsonl_sink(os.path.join(root, "traces.jsonl"))).start()
+        orchid.register("/tracing/export", lambda: dict(exporter.stats))
+        # The exporter DRAINS the collector: recent_spans now serves
+        # from the exporter's tail or it would always read empty.
+        orchid.register("/tracing/recent_spans",
+                        lambda: list(exporter.recent))
     # Generalized service discovery (ref server/discovery_server): any
     # process can publish into named groups; NodeTracker stays the
     # data-node special case.

@@ -116,17 +116,21 @@ class P2PDistributor:
             for cid in reheated:
                 if cid in self._seeded:
                     self._seeded[cid]["expiry"] = now + self.cooldown
+        peers = None
+        if hot:
+            # ONE peer-discovery RPC per tick, shared by every hot
+            # chunk (not one per chunk per tick).
+            me = self._self_address()
+            peers = [p for p in self._peers() if p and p != me]
         for cid in hot:
-            self._seed(cid)
+            self._seed(cid, peers)
         for cid in expired:
             self._evict(cid)
 
-    def _seed(self, chunk_id: str) -> None:
+    def _seed(self, chunk_id: str, peers: "Sequence[str]") -> None:
         from ytsaurus_tpu.server.services import chunk_push_request
         if not self.store.exists(chunk_id):
             return
-        me = self._self_address()
-        peers = [p for p in self._peers() if p and p != me]
         targets = []
         body = None
         blob = None
@@ -146,11 +150,14 @@ class P2PDistributor:
             except YtError as exc:
                 logger.warning("p2p seed of %s to %s failed: %s",
                                chunk_id, peer, exc)
+        # An empty targets entry is recorded too: every eligible peer
+        # already holds the chunk, and re-probing the whole fan-out on
+        # every tick while the heat lasts would be pure RPC churn.
+        with self._lock:
+            self._seeded[chunk_id] = {
+                "targets": targets,
+                "expiry": time.monotonic() + self.cooldown}
         if targets:
-            with self._lock:
-                self._seeded[chunk_id] = {
-                    "targets": targets,
-                    "expiry": time.monotonic() + self.cooldown}
             self.stats["hot_chunks"] += 1
             self.stats["seeded_copies"] += len(targets)
             logger.info("p2p: seeded hot chunk %s to %s", chunk_id,
@@ -158,12 +165,26 @@ class P2PDistributor:
 
     def _evict(self, chunk_id: str) -> None:
         with self._lock:
-            entry = self._seeded.pop(chunk_id, None)
+            entry = self._seeded.get(chunk_id)
         if entry is None:
             return
+        remaining = []
         for peer in entry["targets"]:
             try:
                 self._call(peer, "remove_chunk", {"chunk_id": chunk_id})
                 self.stats["evicted_copies"] += 1
             except YtError:
-                pass                # peer gone: nothing to evict
+                # Transient failure must NOT leak the copy forever:
+                # keep the target and retry on a later tick.
+                remaining.append(peer)
+        with self._lock:
+            attempts = entry.get("evict_attempts", 0) + 1
+            if remaining and attempts < 5:
+                entry["targets"] = remaining
+                entry["evict_attempts"] = attempts
+                entry["expiry"] = time.monotonic() + \
+                    min(self.cooldown, 10.0)
+            else:
+                # All removed, or the peer is presumed dead (its disk
+                # went with it — nothing left to evict).
+                self._seeded.pop(chunk_id, None)
