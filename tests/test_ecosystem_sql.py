@@ -181,3 +181,73 @@ def test_sql_errors_surface(tmp_path):
     assert record["state"] == "failed"
     with pytest.raises(YtError):
         qt.read_query_result(qid)
+
+
+# -- JOIN forms (ref CHYT join translation) ------------------------------------
+
+def _join_fixture(tmp_path):
+    client = connect(str(tmp_path))
+    client.write_table("//facts", [{"g": i % 3, "v": i} for i in range(9)])
+    client.write_table("//dims", [{"g": 0, "name": "zero"},
+                                  {"g": 1, "name": "one"}])
+    return client
+
+
+def test_join_modifiers_normalize(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    client = _join_fixture(tmp_path)
+    base = execute_sql(
+        client, 'SELECT name, sum(v) AS t FROM "//facts" '
+                'JOIN "//dims" USING g GROUP BY name')
+    want = {tuple(sorted(r.items())) for r in base}
+    for form in ("INNER JOIN", "ALL INNER JOIN", "ANY JOIN"):
+        rows = execute_sql(
+            client, f'SELECT name, sum(v) AS t FROM "//facts" '
+                    f'{form} "//dims" USING g GROUP BY name')
+        assert {tuple(sorted(r.items())) for r in rows} == want, form
+
+
+def test_join_table_aliases_and_qualified_columns(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    client = _join_fixture(tmp_path)
+    rows = execute_sql(
+        client, 'SELECT f.v, d.name FROM "//facts" AS f '
+                'JOIN "//dims" AS d ON f.g = d.g '
+                'ORDER BY f.v ASC LIMIT 3')
+    assert [r["v"] for r in rows] == [0, 1, 3]
+    assert rows[0]["name"] == b"zero"
+    # Bare (AS-less) aliases work too.
+    rows = execute_sql(
+        client, 'SELECT d.name, sum(f.v) AS t FROM "//facts" f '
+                'JOIN "//dims" d USING g GROUP BY d.name')
+    assert {r["name"]: r["t"] for r in rows} == \
+        {b"zero": 9, b"one": 12}
+
+
+def test_left_join_keeps_unmatched(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    client = _join_fixture(tmp_path)
+    rows = execute_sql(
+        client, 'SELECT v, name FROM "//facts" '
+                'LEFT JOIN "//dims" USING g WHERE v = 8')
+    assert rows == [{"v": 8, "name": None}]
+
+
+def test_unsupported_join_kinds_fail_loudly(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import execute_sql
+    client = _join_fixture(tmp_path)
+    for kind in ("CROSS", "RIGHT", "FULL"):
+        with pytest.raises(YtError):
+            execute_sql(client, f'SELECT 1 AS x FROM "//facts" '
+                                f'{kind} JOIN "//dims" USING g')
+
+
+def test_on_clause_with_distinct_names_preserved(tmp_path):
+    from ytsaurus_tpu.ecosystem.sql import translate_sql
+    # Same-name equalities become USING; distinct names stay ON.
+    ql = translate_sql('SELECT x FROM "//a" t1 JOIN "//b" t2 '
+                       'ON t1.k = t2.j')
+    assert "ON" in ql and "USING" not in ql
+    ql = translate_sql('SELECT x FROM "//a" t1 JOIN "//b" t2 '
+                       'ON t1.k = t2.k AND t1.h = t2.h')
+    assert "USING k , h" in ql or "USING k, h" in ql

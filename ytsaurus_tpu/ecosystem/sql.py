@@ -159,10 +159,136 @@ def _rewrite_if_combinators(toks: "list[tuple[str, str]]"
     return out
 
 
+_JOIN_MODIFIERS = {"inner", "any", "all", "outer", "left"}
+_UNSUPPORTED_JOINS = {"cross", "right", "full", "semi", "anti", "asof"}
+_ALIAS_RESERVED = {"on", "using", "where", "group", "order", "limit",
+                   "having", "offset", "join", "left", "inner", "any",
+                   "all", "cross", "as", "asc", "desc", "with", "union",
+                   "settings"}
+
+
+def _normalize_joins(toks):
+    """CH join modifiers → QL's two forms.  INNER/ALL are QL's plain
+    JOIN; ANY is accepted and behaves identically when the right side
+    is key-unique (the dimension-join case — CH's own ALL default
+    matches QL exactly); CROSS/RIGHT/FULL/ASOF have no QL counterpart
+    and fail loudly."""
+    out = []
+    i, n = 0, len(toks)
+    while i < n:
+        kind, tok = toks[i]
+        low = tok.lower()
+        if kind == "word" and (low in _JOIN_MODIFIERS or
+                               low in _UNSUPPORTED_JOINS):
+            j = i
+            mods = []
+            while j < n and toks[j][0] == "word" and \
+                    toks[j][1].lower() in (_JOIN_MODIFIERS |
+                                           _UNSUPPORTED_JOINS):
+                mods.append(toks[j][1].lower())
+                j += 1
+            if j < n and toks[j][0] == "word" and \
+                    toks[j][1].lower() == "join":
+                bad = [m for m in mods if m in _UNSUPPORTED_JOINS]
+                if bad:
+                    raise YtError(
+                        f"SQL: {bad[0].upper()} JOIN is not supported",
+                        code=EErrorCode.QueryUnsupported)
+                if "left" in mods:
+                    out.append(("word", "LEFT"))
+                i = j
+                continue
+        out.append(toks[i])
+        i += 1
+    return out
+
+
+def _strip_table_aliases(toks):
+    """Remove `[table] AS alias` / `[table] alias` (QL has no table
+    aliases) and return the alias names, so qualified column refs can
+    drop their prefixes."""
+    out = []
+    aliases: set = set()
+    i, n = 0, len(toks)
+    while i < n:
+        kind, tok = toks[i]
+        out.append(toks[i])
+        if kind == "word" and tok.lower() in _TABLE_KEYWORDS and \
+                i + 1 < n:
+            out.append(toks[i + 1])          # the table reference
+            i += 1
+            j = i + 1
+            if j < n and toks[j][0] == "word" and \
+                    toks[j][1].lower() == "as":
+                j += 1
+            if j < n and toks[j][0] == "word" and \
+                    "." not in toks[j][1] and \
+                    toks[j][1].lower() not in _ALIAS_RESERVED:
+                aliases.add(toks[j][1])
+                i = j                        # alias tokens dropped
+        i += 1
+    return out, aliases
+
+
+def _on_to_using(toks):
+    """After alias stripping, `ON g = g AND h = h` is the degenerate
+    same-column equality CH writes as `f.g = d.g` — in QL's flat join
+    namespace that reads as ambiguous self-equality, so rewrite it to
+    `USING g, h`.  Mixed-name equalities stay as ON."""
+    clause_ends = {"where", "group", "order", "limit", "having",
+                   "offset", "join", "left", "settings"}
+    out = []
+    i, n = 0, len(toks)
+    while i < n:
+        kind, tok = toks[i]
+        if kind == "word" and tok.lower() == "on":
+            pairs = []
+            j = i + 1
+            while j + 2 < n and toks[j][0] == "word" and \
+                    toks[j + 1] == ("op", "=") and \
+                    toks[j + 2][0] == "word":
+                pairs.append((toks[j][1], toks[j + 2][1]))
+                j += 3
+                if j < n and toks[j][0] == "word" and \
+                        toks[j][1].lower() == "and":
+                    j += 1
+                    continue
+                break
+            # Rewrite ONLY when the whole ON clause was consumed as
+            # same-name pairs and scanning stopped at a clause boundary
+            # (or the end) — a trailing non-equality conjunct
+            # (ON a=b AND v>5) must keep the original text, not lose
+            # its AND.
+            ends_clean = j >= n or (toks[j][0] == "word" and
+                                    toks[j][1].lower() in clause_ends)
+            if ends_clean and pairs and \
+                    all(a == b for a, b in pairs):
+                out.append(("word", "USING"))
+                for p, (name, _) in enumerate(pairs):
+                    if p:
+                        out.append(("op", ","))
+                    out.append(("word", name))
+                i = j
+                continue
+        out.append(toks[i])
+        i += 1
+    return out
+
+
 def translate_sql(sql: str) -> str:
     """ClickHouse/ANSI-flavored SELECT → native QL text (flat queries;
     subqueries are orchestrated by execute_sql)."""
     toks = _rewrite_if_combinators(list(_tokens(sql.strip().rstrip(";"))))
+    toks = _normalize_joins(toks)
+    toks, aliases = _strip_table_aliases(toks)
+    if aliases:
+        # Qualified refs (f.col) lose their table prefix: the joined
+        # namespace is flat in QL.
+        toks = [(kind, tok.split(".", 1)[1])
+                if kind == "word" and "." in tok and
+                tok.split(".", 1)[0] in aliases else (kind, tok)
+                for kind, tok in toks]
+        toks = _on_to_using(toks)
     out: list[str] = []
     expecting_table = False
     limit_value = None

@@ -375,8 +375,15 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     # Continuous CPU profiler + span export (ref ytprof cpu_profiler.h,
     # jaeger/tracer.h): always-on statistical sampling served via
     # Orchid; finished spans batch-flush to <root>/traces.jsonl.
-    profiler_interval = float(os.environ.get("YT_TPU_PROFILER_INTERVAL",
-                                             0.05))
+    try:
+        profiler_interval = float(
+            os.environ.get("YT_TPU_PROFILER_INTERVAL", 0.05))
+    except ValueError:
+        # 'off'/'50ms'/'': the operator meant SOMETHING non-default —
+        # disable rather than refuse to boot the primary.
+        print("# YT_TPU_PROFILER_INTERVAL unparseable; profiler off",
+              flush=True)
+        profiler_interval = 0.0
     if profiler_interval > 0:
         from ytsaurus_tpu.utils.profiler import (
             SamplingProfiler,

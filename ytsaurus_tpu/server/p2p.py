@@ -134,6 +134,7 @@ class P2PDistributor:
         targets = []
         body = None
         blob = None
+        failures = 0
         for peer in peers:
             if len(targets) >= self.fanout:
                 break
@@ -148,9 +149,14 @@ class P2PDistributor:
                 self._call(peer, "put_chunk", body, attachments=[blob])
                 targets.append(peer)
             except YtError as exc:
+                failures += 1
                 logger.warning("p2p seed of %s to %s failed: %s",
                                chunk_id, peer, exc)
-        # An empty targets entry is recorded too: every eligible peer
+        if not targets and failures:
+            # Every attempt errored (blip, peer restart): do NOT record
+            # — the next tick must retry while the chunk stays hot.
+            return
+        # An empty-but-clean result IS recorded: every eligible peer
         # already holds the chunk, and re-probing the whole fan-out on
         # every tick while the heat lasts would be pure RPC churn.
         with self._lock:

@@ -41,6 +41,7 @@ class SamplingProfiler:
         self._lock = threading.Lock()
         self._samples: "dict[str, int]" = {}     # collapsed stack → hits
         self._total = 0
+        self._idle = 0                           # blocked-wait samples
         self._stop = threading.Event()
         self._thread: "Optional[threading.Thread]" = None
 
@@ -62,11 +63,25 @@ class SamplingProfiler:
 
     # -- sampling --------------------------------------------------------------
 
+    # Leaves parked in these stdlib files are blocking waits, not CPU:
+    # a daemon's many idle threads (RPC workers, background loops) would
+    # otherwise dominate every profile with Event.wait frames.  The
+    # reference's SIGPROF sampler gets this for free (it only fires on
+    # CPU time); this is the frame-walker's approximation.
+    _WAIT_FILES = ("threading.py", "selectors.py", "socket.py", "ssl.py",
+                   "queue.py", "socketserver.py")
+
     def sample_once(self, exclude_thread: "Optional[int]" = None) -> None:
         frames = sys._current_frames()
         stacks = []
+        idle = 0
         for thread_id, frame in frames.items():
             if thread_id == exclude_thread:
+                continue
+            leaf = frame.f_code
+            leaf_file = leaf.co_filename.rsplit("/", 1)[-1]
+            if leaf_file in self._WAIT_FILES or leaf.co_name == "sleep":
+                idle += 1
                 continue
             parts = []
             depth = 0
@@ -79,6 +94,7 @@ class SamplingProfiler:
                 depth += 1
             stacks.append(";".join(reversed(parts)))
         with self._lock:
+            self._idle += idle
             for stack in stacks:
                 if stack in self._samples or \
                         len(self._samples) < self.max_entries:
@@ -116,6 +132,7 @@ class SamplingProfiler:
     def state(self) -> dict:
         with self._lock:
             return {"total_samples": self._total,
+                    "idle_samples": self._idle,
                     "distinct_stacks": len(self._samples),
                     "interval": self.interval}
 
@@ -123,6 +140,7 @@ class SamplingProfiler:
         with self._lock:
             self._samples.clear()
             self._total = 0
+            self._idle = 0
 
 
 class TraceExporter:
@@ -141,6 +159,7 @@ class TraceExporter:
         # endpoints (/tracing/recent_spans): the exporter keeps its own
         # recent tail so those can serve from HERE when export is on.
         self.recent: "deque[dict]" = deque(maxlen=recent_capacity)
+        self._flush_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: "Optional[threading.Thread]" = None
 
@@ -162,15 +181,18 @@ class TraceExporter:
                 pass
 
     def flush_once(self) -> int:
-        spans = self.collector.drain()
-        if not spans:
-            return 0
-        batch = [s.to_dict() for s in spans]
-        self.sink(batch)
-        self.recent.extend(batch)
-        self.stats["batches"] += 1
-        self.stats["spans"] += len(batch)
-        return len(batch)
+        # stop() flushes the tail on the CALLER's thread while the loop
+        # may be mid-flush: serialize, or stats/sink writes interleave.
+        with self._flush_lock:
+            spans = self.collector.drain()
+            if not spans:
+                return 0
+            batch = [s.to_dict() for s in spans]
+            self.sink(batch)
+            self.recent.extend(batch)
+            self.stats["batches"] += 1
+            self.stats["spans"] += len(batch)
+            return len(batch)
 
 
 def jsonl_sink(path: str,
