@@ -449,3 +449,48 @@ def test_leave_group_triggers_rebalance(proxy):
     assert call(proxy, API_LEAVE_GROUP, body).i16() == 0
     # Gone: its heartbeats are now rejected.
     assert _heartbeat(proxy, "g3", a["generation"], a["member_id"]) == 25
+
+
+def test_api_versions_advertises_v1_and_v1_bodies_parse(proxy):
+    from ytsaurus_tpu.server.kafka_proxy import (
+        API_VERSIONS,
+        SUPPORTED_VERSIONS,
+    )
+    r = call(proxy, API_VERSIONS, b"")
+    assert r.i16() == 0
+    n = r.i32()
+    advertised = {}
+    for _ in range(n):
+        key = r.i16()
+        r.i16()                         # min
+        advertised[key] = r.i16()       # max
+    assert advertised[API_PRODUCE] == 1
+    assert advertised[API_FETCH] == 1
+    assert advertised == SUPPORTED_VERSIONS
+    # Produce v1: response carries the throttle_time tail.
+    msg = encode_message(None, b"v1-payload", 0)
+    body = i16(1) + i32(1000) + array([
+        string("vt") + array([i32(0) + bytes_(msg)])])
+    r = call(proxy, API_PRODUCE, body, version=1)
+    n = r.i32()
+    assert n == 1
+    assert r.string() == "vt"
+    r.i32()
+    assert r.i32() == 0 and r.i16() == 0
+    r.i64()                             # base offset
+    assert r.i32() == 0                 # throttle_time_ms
+    # Fetch v1: throttle_time comes FIRST.
+    body = i32(-1) + i32(0) + i32(0) + array([
+        string("vt") + array([i32(0) + i64(0) + i32(1 << 20)])])
+    r = call(proxy, API_FETCH, body, version=1)
+    assert r.i32() == 0                 # throttle_time_ms
+    assert r.i32() == 1                 # topic count
+    assert r.string() == "vt"
+    # Versions past the advertised max still close the connection.
+    import socket as _socket
+    import struct as _struct
+    payload = i16(API_FETCH) + i16(9) + i32(5) + string("x") + b""
+    with _socket.create_connection((proxy.host, proxy.port),
+                                   timeout=10) as sock:
+        sock.sendall(_struct.pack(">i", len(payload)) + payload)
+        assert sock.recv(4) == b""      # closed

@@ -1,13 +1,16 @@
-"""Kafka proxy: the Kafka wire protocol (v0 APIs) over ordered tables.
+"""Kafka proxy: the Kafka wire protocol over ordered tables.
 
 Ref: yt/yt/server/kafka_proxy/server.h (+ the kafka protocol codec under
 yt/yt/client/kafka/) — the reference terminates the Kafka binary
 protocol in front of queues so stock Kafka clients can produce/consume
-YT queues.  This proxy speaks the v0 wire format (the baseline every
-client library supports):
+YT queues.  The proxy speaks v0 for every API (the baseline all client
+libraries support) and negotiates up to v1 for Produce/Fetch via
+ApiVersions (v1 adds throttle_time_ms framing to those responses):
 
-  ApiVersions(18)  Metadata(3)  ListOffsets(2)  Produce(0)  Fetch(1)
-  OffsetCommit(8)  OffsetFetch(9)
+  ApiVersions(18)  Metadata(3)  ListOffsets(2)  Produce(0..1)
+  Fetch(0..1)  OffsetCommit(8)  OffsetFetch(9)
+  FindCoordinator(10)  JoinGroup(11)  Heartbeat(12)  LeaveGroup(13)
+  SyncGroup(14)
 
 Topic model: topic `name` maps to the ordered table `<root>/name`
 (auto-created on first Metadata when auto_create, like Kafka's
@@ -51,10 +54,16 @@ API_LEAVE_GROUP = 13
 API_SYNC_GROUP = 14
 API_VERSIONS = 18
 
-SUPPORTED_APIS = (API_PRODUCE, API_FETCH, API_LIST_OFFSETS, API_METADATA,
-                  API_OFFSET_COMMIT, API_OFFSET_FETCH,
-                  API_FIND_COORDINATOR, API_JOIN_GROUP, API_HEARTBEAT,
-                  API_LEAVE_GROUP, API_SYNC_GROUP, API_VERSIONS)
+# api key → highest supported version.  v1 adds throttle_time_ms to
+# Produce/Fetch responses (request bodies are unchanged), which is what
+# ApiVersions-aware clients negotiate down to; everything else is v0.
+SUPPORTED_VERSIONS = {
+    API_PRODUCE: 1, API_FETCH: 1, API_LIST_OFFSETS: 0, API_METADATA: 0,
+    API_OFFSET_COMMIT: 0, API_OFFSET_FETCH: 0, API_FIND_COORDINATOR: 0,
+    API_JOIN_GROUP: 0, API_HEARTBEAT: 0, API_LEAVE_GROUP: 0,
+    API_SYNC_GROUP: 0, API_VERSIONS: 0,
+}
+SUPPORTED_APIS = tuple(SUPPORTED_VERSIONS)
 
 ERR_NONE = 0
 ERR_CORRUPT_MESSAGE = 2
@@ -301,21 +310,32 @@ class KafkaProxy:
         api_version = r.i16()
         correlation_id = r.i32()
         r.string()                  # client_id
-        if api_version != 0:
+        max_version = SUPPORTED_VERSIONS.get(api_key)
+        if max_version is None:
+            # Unknown API key: the right diagnosis is the KEY, and the
+            # connection closes (no version of it has a known shape).
+            raise YtError(f"unsupported api key {api_key}",
+                          code=ERR_UNSUPPORTED_VERSION)
+        if not 0 <= api_version <= max_version:
             if api_key == API_VERSIONS:
                 # Spec: answer UNSUPPORTED_VERSION in the v0 shape so
                 # the client can retry with a version we speak.
                 return i32(correlation_id) + i16(
                     ERR_UNSUPPORTED_VERSION) + array(
-                    [i16(k) + i16(0) + i16(0) for k in SUPPORTED_APIS])
-            logger.warning("unsupported api version %d for key %d",
-                           api_version, api_key)
-            return None             # close: body shapes differ past v0
+                    [i16(k) + i16(0) + i16(SUPPORTED_VERSIONS[k])
+                     for k in SUPPORTED_APIS])
+            # Body shapes differ beyond the advertised version: raising
+            # makes the connection handler CLOSE the socket (a None
+            # return would mean "no response due" and leave the client
+            # hanging on an open connection).
+            raise YtError(f"unsupported api version {api_version} for "
+                          f"key {api_key}",
+                          code=ERR_UNSUPPORTED_VERSION)
         handler = {
             API_VERSIONS: self._api_versions,
             API_METADATA: self._metadata,
-            API_PRODUCE: self._produce,
-            API_FETCH: self._fetch,
+            API_PRODUCE: lambda rr: self._produce(rr, api_version),
+            API_FETCH: lambda rr: self._fetch(rr, api_version),
             API_LIST_OFFSETS: self._list_offsets,
             API_OFFSET_COMMIT: self._offset_commit,
             API_OFFSET_FETCH: self._offset_fetch,
@@ -325,9 +345,6 @@ class KafkaProxy:
             API_LEAVE_GROUP: self._leave_group,
             API_SYNC_GROUP: self._sync_group,
         }.get(api_key)
-        if handler is None:
-            logger.warning("unsupported api key %d", api_key)
-            return None
         body = handler(r)
         if body is None:
             return None             # acks=0 produce
@@ -335,7 +352,8 @@ class KafkaProxy:
 
     def _api_versions(self, r: Reader) -> bytes:
         return i16(ERR_NONE) + array(
-            [i16(k) + i16(0) + i16(0) for k in SUPPORTED_APIS])
+            [i16(k) + i16(0) + i16(SUPPORTED_VERSIONS[k])
+             for k in SUPPORTED_APIS])
 
     def _metadata(self, r: Reader) -> bytes:
         n = r.i32()
@@ -359,7 +377,8 @@ class KafkaProxy:
                 string(topic) + partitions)
         return brokers + array(topic_bodies)
 
-    def _produce(self, r: Reader) -> "Optional[bytes]":
+    def _produce(self, r: Reader,
+                 version: int = 0) -> "Optional[bytes]":
         acks = r.i16()
         r.i32()                     # timeout
         n_topics = r.i32()
@@ -392,9 +411,12 @@ class KafkaProxy:
             # The client will not read a response; sending one would
             # desync its next request's framing.
             return None
-        return array(topic_bodies)
+        out = array(topic_bodies)
+        if version >= 1:
+            out += i32(0)               # throttle_time_ms (v1 tail)
+        return out
 
-    def _fetch(self, r: Reader) -> bytes:
+    def _fetch(self, r: Reader, version: int = 0) -> bytes:
         import time as _time
         r.i32()                     # replica_id
         max_wait_ms = r.i32()
@@ -432,7 +454,8 @@ class KafkaProxy:
                 _time.sleep(min(0.05,
                                 max(deadline - _time.monotonic(), 0)))
         topic_bodies, _ = self._build_fetch(requests)
-        return array(topic_bodies)
+        prefix = i32(0) if version >= 1 else b""    # throttle_time_ms
+        return prefix + array(topic_bodies)
 
     def _build_fetch(self, requests) -> "tuple[list[bytes], int]":
         topic_bodies = []
