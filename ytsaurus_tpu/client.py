@@ -1553,8 +1553,15 @@ class YtClient:
             )
             tablets = self._mounted_tablets(path)
             if isinstance(tablets[0], OrderedTablet):
-                # Ordered snapshots have no timestamp to pin a cut to:
-                # deferring them would read tablets at different times.
+                if lazy:
+                    # Pin ONE commit-timestamp cut: deferred suppliers
+                    # then read the same moment whenever they run.  A
+                    # caller's CONCRETE timestamp is honored (mirrors
+                    # the sorted branch); only read-latest regenerates.
+                    cut = timestamp if timestamp < ASYNC_LAST_COMMITTED \
+                        else self.cluster.transactions.timestamps.generate()
+                    return [(lambda t=t: t.snapshot(cut))
+                            for t in tablets]
                 return [t.snapshot() for t in tablets]
             if lazy:
                 if timestamp >= ASYNC_LAST_COMMITTED:   # any read-latest

@@ -152,9 +152,16 @@ class OrderedTablet:
             self.chunk_ids = keep_ids
             self.chunk_ranges = keep_ranges
 
-    def snapshot(self) -> ColumnarChunk:
+    def snapshot(self, timestamp: "Optional[int]" = None) -> ColumnarChunk:
         """All live rows (incl. $row_index/$timestamp) as one chunk for
-        queries."""
+        queries.  With `timestamp`, only rows whose commit $timestamp is
+        ≤ it — the consistent-cut form deferred multi-tablet scans pin
+        to, so every shard of an ordered table reads the SAME moment no
+        matter when its snapshot supplier actually runs (the
+        read_snapshot(ts) analog sorted tablets already have)."""
         rows = self.read_rows(0)
+        if timestamp is not None:
+            rows = [r for r in rows
+                    if (r.get("$timestamp") or 0) <= timestamp]
         return ColumnarChunk.from_rows(
             ordered_chunk_schema(self.schema).to_unsorted(), rows)
