@@ -1552,26 +1552,23 @@ class YtClient:
                 ASYNC_LAST_COMMITTED,
             )
             tablets = self._mounted_tablets(path)
+            if lazy and timestamp >= ASYNC_LAST_COMMITTED:
+                # Deferred snapshots taken at read-latest would see
+                # DIFFERENT cuts (shard 5 snapshots minutes after shard
+                # 0 under a slow scan).  Pin one concrete timestamp
+                # now — shared by BOTH table kinds — so every supplier
+                # reads the same consistent cut whenever it runs; a
+                # caller's concrete timestamp passes through untouched.
+                timestamp = \
+                    self.cluster.transactions.timestamps.generate()
             if isinstance(tablets[0], OrderedTablet):
+                concrete = timestamp if timestamp < ASYNC_LAST_COMMITTED \
+                    else None           # eager read-latest: no filter
                 if lazy:
-                    # Pin ONE commit-timestamp cut: deferred suppliers
-                    # then read the same moment whenever they run.  A
-                    # caller's CONCRETE timestamp is honored (mirrors
-                    # the sorted branch); only read-latest regenerates.
-                    cut = timestamp if timestamp < ASYNC_LAST_COMMITTED \
-                        else self.cluster.transactions.timestamps.generate()
-                    return [(lambda t=t: t.snapshot(cut))
+                    return [(lambda t=t: t.snapshot(concrete))
                             for t in tablets]
-                return [t.snapshot() for t in tablets]
+                return [t.snapshot(concrete) for t in tablets]
             if lazy:
-                if timestamp >= ASYNC_LAST_COMMITTED:   # any read-latest
-                    # Deferred snapshots taken at read-latest would see
-                    # DIFFERENT cuts (shard 5 snapshots minutes after
-                    # shard 0 under a slow scan).  Pin one concrete
-                    # timestamp now: every supplier reads the same
-                    # consistent MVCC cut whenever it runs.
-                    timestamp = \
-                        self.cluster.transactions.timestamps.generate()
                 return [(lambda t=t, ts=timestamp: t.read_snapshot(ts))
                         for t in tablets]
             return [t.read_snapshot(timestamp) for t in tablets]
