@@ -1,0 +1,237 @@
+"""QL regression corpus, part 3 — cast matrix, null propagation
+through every function family, WITH TOTALS, multi-key grouping, and
+composition depth.
+
+With parts 1 and 2 this brings the harness to ~500 cases (reference
+scale: library/query/unittests/evaluate/ql_query_ut.cpp ~600).  As
+before: behavior-derived, not ported text.
+"""
+
+import pytest
+
+from tests.harness import evaluate
+
+T = "//t"
+INT_COLS = [("k", "int64", "ascending"), ("v", "int64")]
+MULTI = [("k", "int64", "ascending"), ("a", "int64"), ("b", "int64"),
+         ("x", "double"), ("s", "string")]
+
+
+def tbl(rows, cols=INT_COLS, path=T):
+    return {path: (cols, rows)}
+
+
+M = tbl([(1, 0, 0, 1.5, "p"), (2, 0, 1, -2.5, "q"), (3, 1, 0, 0.25, "p"),
+         (4, 1, 1, None, None), (5, None, 0, 4.0, "r"),
+         (6, 2, None, -0.5, "q")], MULTI)
+
+
+def run(query, tables, expected, ordered=False):
+    evaluate(query, tables, expected, ordered=ordered)
+
+
+# ---------------------------------------------------------------------------
+# A. cast matrix — every source/target pair at edge values
+# ---------------------------------------------------------------------------
+
+CASTS = [
+    ("i2d_exact", f"double(v) AS r FROM [{T}]", tbl([(1, 5)]),
+     [{"r": 5.0}]),
+    ("i2d_large", f"double(v) AS r FROM [{T}]", tbl([(1, 1 << 53)]),
+     [{"r": float(1 << 53)}]),
+    ("d2i_floor_pos", f"int64(x) AS r FROM [{T}]",
+     tbl([(1, 0, 0, 2.99, "z")], MULTI), [{"r": 2}]),
+    ("d2i_ceil_neg", f"int64(x) AS r FROM [{T}]",
+     tbl([(1, 0, 0, -2.99, "z")], MULTI), [{"r": -2}]),
+    ("i2u_neg_wraps", f"uint64(v) AS r FROM [{T}]", tbl([(1, -2)]),
+     [{"r": (1 << 64) - 2}]),
+    ("u2i_big_wraps", f"int64(uint64(v)) AS r FROM [{T}]",
+     tbl([(1, -1)]), [{"r": -1}]),
+    ("b2i_true", f"int64(v = 1) AS r FROM [{T}]", tbl([(1, 1)]),
+     [{"r": 1}]),
+    ("b2i_false", f"int64(v = 2) AS r FROM [{T}]", tbl([(1, 1)]),
+     [{"r": 0}]),
+    ("i2b_zero", f"boolean(v) AS r FROM [{T}]", tbl([(1, 0)]),
+     [{"r": False}]),
+    ("i2b_nonzero", f"boolean(v) AS r FROM [{T}]", tbl([(1, -3)]),
+     [{"r": True}]),
+    ("d2b", f"boolean(x) AS r FROM [{T}]",
+     tbl([(1, 0, 0, 0.5, "z")], MULTI), [{"r": True}]),
+    ("cast_null_any_target", f"double(a) AS r FROM [{T}] WHERE k = 5",
+     M, [{"r": None}]),
+    ("chained_casts", f"int64(double(uint64(v))) AS r FROM [{T}]",
+     tbl([(1, 7)]), [{"r": 7}]),
+    ("cast_in_where", f"k FROM [{T}] WHERE double(v) / 2.0 > 1.4",
+     tbl([(1, 2), (2, 3)]), [{"k": 2}]),
+    ("cast_in_group_key",
+     f"int64(x) AS b, count(*) AS n FROM [{T}] WHERE x > 0 "
+     "GROUP BY int64(x)", M,
+     [{"b": 1, "n": 1}, {"b": 0, "n": 1}, {"b": 4, "n": 1}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in CASTS],
+                         ids=[c[0] for c in CASTS])
+def test_cast_matrix(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# B. null propagation through every function family
+# ---------------------------------------------------------------------------
+
+NULLP = [
+    ("null_upper", f"upper(s) AS r FROM [{T}] WHERE k = 4", M,
+     [{"r": None}]),
+    ("null_length", f"length(s) AS r FROM [{T}] WHERE k = 4", M,
+     [{"r": None}]),
+    ("null_concat_left", f"concat(s, 'x') AS r FROM [{T}] WHERE k = 4",
+     M, [{"r": None}]),
+    ("null_abs", f"abs(a) AS r FROM [{T}] WHERE k = 5", M,
+     [{"r": None}]),
+    ("null_floor", f"floor(x) AS r FROM [{T}] WHERE k = 4", M,
+     [{"r": None}]),
+    ("null_min_of_one_side", f"min_of(a, 99) AS r FROM [{T}] WHERE k = 5",
+     M, [{"r": 99}]),
+    ("null_if_cond_is_false_branch",
+     f"if(a > 0, 'yes', 'no') AS r FROM [{T}] WHERE k = 5", M,
+     [{"r": None}]),
+    ("null_is_null_true", f"k FROM [{T}] WHERE is_null(a)", M,
+     [{"k": 5}]),
+    ("null_is_null_projected",
+     f"is_null(s) AS r FROM [{T}] WHERE k = 4", M, [{"r": True}]),
+    ("null_if_null_passthrough",
+     f"if_null(a, -1) AS r FROM [{T}] WHERE k IN (3, 5)", M,
+     [{"r": 1}, {"r": -1}]),
+    ("null_timestamp_floor",
+     f"timestamp_floor_hour(a) AS r FROM [{T}] WHERE k = 5", M,
+     [{"r": None}]),
+    ("null_arith_chain",
+     f"(a + b) * 2 - 1 AS r FROM [{T}] WHERE k IN (1, 5)", M,
+     [{"r": -1}, {"r": None}]),
+    ("null_never_groups_with_zero",
+     f"a, count(*) AS n FROM [{T}] GROUP BY a", M,
+     [{"a": 0, "n": 2}, {"a": 1, "n": 2}, {"a": None, "n": 1},
+      {"a": 2, "n": 1}]),
+    ("null_not_counted", f"count(a) AS n FROM [{T}] GROUP BY 1", M,
+     [{"n": 5}]),
+    ("null_sum_skips", f"sum(a) AS t FROM [{T}] GROUP BY 1", M,
+     [{"t": 4}]),
+    ("null_avg_skips", f"avg(b) AS r FROM [{T}] GROUP BY 1", M,
+     [{"r": 0.4}]),
+    ("null_min_skips", f"min(x) AS r FROM [{T}] GROUP BY 1", M,
+     [{"r": -2.5}]),
+    ("null_argmax_skips_null_weight",
+     f"argmax(k, a) AS r FROM [{T}] GROUP BY 1", M, [{"r": 6}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in NULLP],
+                         ids=[c[0] for c in NULLP])
+def test_null_propagation(query, tables, expected):
+    run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# C. WITH TOTALS + multi-key grouping shapes
+# ---------------------------------------------------------------------------
+
+TOTALS = [
+    ("totals_basic",
+     # WHERE a != 99 drops the null-a row (three-valued comparison);
+     # the totals row itself carries a=null.
+     f"a, sum(b) AS t FROM [{T}] WHERE a != 99 GROUP BY a WITH TOTALS",
+     M, [{"a": 0, "t": 1}, {"a": 1, "t": 1},
+         {"a": 2, "t": None}, {"a": None, "t": 2}]),
+    ("multi_key_group",
+     f"a, b, count(*) AS n FROM [{T}] WHERE k <= 4 GROUP BY a, b", M,
+     [{"a": 0, "b": 0, "n": 1}, {"a": 0, "b": 1, "n": 1},
+      {"a": 1, "b": 0, "n": 1}, {"a": 1, "b": 1, "n": 1}]),
+    ("multi_key_with_expression",
+     f"a, b % 2 AS p, count(*) AS n FROM [{T}] WHERE b != 99 "
+     "GROUP BY a, b % 2", M,
+     [{"a": 0, "p": 0, "n": 1}, {"a": 0, "p": 1, "n": 1},
+      {"a": 1, "p": 0, "n": 1}, {"a": 1, "p": 1, "n": 1},
+      {"a": None, "p": 0, "n": 1}]),
+    ("group_by_string_and_int",
+     f"s, a, count(*) AS n FROM [{T}] WHERE s != '' GROUP BY s, a", M,
+     [{"s": b"p", "a": 0, "n": 1}, {"s": b"q", "a": 0, "n": 1},
+      {"s": b"p", "a": 1, "n": 1}, {"s": b"r", "a": None, "n": 1},
+      {"s": b"q", "a": 2, "n": 1}]),
+    ("having_on_multi_key",
+     f"a, b, count(*) AS n FROM [{T}] GROUP BY a, b "
+     "HAVING count(*) >= 1 AND a = 0", M,
+     [{"a": 0, "b": 0, "n": 1}, {"a": 0, "b": 1, "n": 1}]),
+    ("order_after_group",
+     f"a, sum(b) AS t FROM [{T}] WHERE a != 99 GROUP BY a "
+     "ORDER BY a ASC LIMIT 10", M,
+     [{"a": 0, "t": 1}, {"a": 1, "t": 1}, {"a": 2, "t": None}]),
+    ("count_distinct_via_cardinality",
+     f"cardinality(s) AS c FROM [{T}] GROUP BY 1", M, [{"c": 3}]),
+    ("nested_aggregate_expression",
+     f"sum(a * b) AS t FROM [{T}] GROUP BY 1", M, [{"t": 1}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in TOTALS],
+                         ids=[c[0] for c in TOTALS])
+def test_totals_and_multikey(query, tables, expected):
+    ordered = len(tables) and "ORDER BY" in query
+    run(query, tables, expected, ordered=ordered)
+
+
+# ---------------------------------------------------------------------------
+# D. composition depth: nested conditionals / functions / predicates
+# ---------------------------------------------------------------------------
+
+DEPTH = [
+    ("if_inside_case",
+     f"CASE WHEN if(a = 0, b = 0, FALSE) THEN 'both0' ELSE 'other' END "
+     f"AS r FROM [{T}] WHERE k IN (1, 2)", M,
+     [{"r": b"both0"}, {"r": b"other"}]),
+    ("case_inside_arith",
+     f"(CASE a WHEN 0 THEN 10 ELSE 20 END) + b AS r FROM [{T}] "
+     "WHERE k IN (1, 3)", M, [{"r": 10}, {"r": 20}]),
+    ("transform_of_concat",
+     f"transform(concat(s, s), ('pp', 'qq'), (1, 2)) AS r FROM [{T}] "
+     "WHERE k IN (1, 2)", M, [{"r": 1}, {"r": 2}]),
+    ("regex_of_if_null",
+     f"k FROM [{T}] WHERE regex_partial_match('p', if_null(s, 'p'))",
+     M, [{"k": 1}, {"k": 3}, {"k": 4}]),
+    ("substr_of_upper_in_group",
+     f"substr(upper(s), 0, 1) AS c, count(*) AS n FROM [{T}] "
+     "WHERE s != '' GROUP BY substr(upper(s), 0, 1)", M,
+     [{"c": b"P", "n": 2}, {"c": b"Q", "n": 2}, {"c": b"R", "n": 1}]),
+    ("between_on_expression",
+     f"k FROM [{T}] WHERE a * 2 + b BETWEEN 1 AND 2", M,
+     [{"k": 2}, {"k": 3}]),
+    ("in_on_function_result",
+     f"k FROM [{T}] WHERE length(if_null(s, '??')) IN (1)", M,
+     [{"k": 1}, {"k": 2}, {"k": 3}, {"k": 5}, {"k": 6}]),
+    ("boolean_algebra_chain",
+     # k=5 (a null): NOT(null AND true) is null → three-valued AND
+     # filters the row even though the left disjunct is true.
+     f"k FROM [{T}] WHERE (a = 0 OR b = 0) AND NOT (a = 0 AND b = 0)",
+     M, [{"k": 2}, {"k": 3}]),
+    ("double_negation", f"k FROM [{T}] WHERE NOT (NOT (a = 1))", M,
+     [{"k": 3}, {"k": 4}]),
+    ("arith_on_aggregates",
+     f"sum(a) * 10 + count(*) AS r FROM [{T}] GROUP BY 1", M,
+     [{"r": 46}]),
+    ("minmax_of_aggregates",
+     f"min_of(min(a), 0 - max(b)) AS r FROM [{T}] GROUP BY 1", M,
+     [{"r": -1}]),
+    ("deep_if_null_chain",
+     f"if_null(if_null(a, b), -9) AS r FROM [{T}] WHERE k IN (5, 6)",
+     M, [{"r": 0}, {"r": 2}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in DEPTH],
+                         ids=[c[0] for c in DEPTH])
+def test_composition_depth(query, tables, expected):
+    run(query, tables, expected)
