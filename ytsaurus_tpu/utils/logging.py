@@ -1,6 +1,9 @@
 """Structured logging (ref: core/logging — async structured logs with
-per-category levels; here: stdlib logging with a structured formatter and
-per-category level control via YTSAURUS_TPU_LOG_LEVEL / _LOG_CATEGORIES)."""
+per-category levels, size-rotated compressed files; here: stdlib logging
+with a structured formatter, per-category level control via
+YTSAURUS_TPU_LOG_LEVEL / _LOG_CATEGORIES, and optional rotated+gzipped
+file output via YTSAURUS_TPU_LOG_FILE [+ _LOG_MAX_BYTES/_LOG_BACKUPS]
+— the ref's rotating compressed writer, log_manager.cpp)."""
 
 from __future__ import annotations
 
@@ -52,6 +55,21 @@ def _configure() -> None:
     handler = _DynamicStderrHandler()
     handler.setFormatter(StructuredFormatter())
     root.addHandler(handler)
+    log_file = os.environ.get("YTSAURUS_TPU_LOG_FILE")
+    if log_file:
+        # One env var reaches EVERY daemon a launcher spawns, and the
+        # rotating handler is not multi-process safe (a rotation in one
+        # process unlinks the inode others still write).  Each process
+        # therefore gets its own file: base-<pid>.ext.
+        base, dot, ext = log_file.rpartition(".")
+        if dot:
+            log_file = f"{base}-{os.getpid()}.{ext}"
+        else:
+            log_file = f"{log_file}-{os.getpid()}"
+        root.addHandler(make_rotating_handler(
+            log_file,
+            max_bytes=_env_int("YTSAURUS_TPU_LOG_MAX_BYTES", 64 << 20),
+            backups=_env_int("YTSAURUS_TPU_LOG_BACKUPS", 3)))
     root.propagate = False
     # Per-category overrides: "Query=debug,Tablet=info"
     overrides = os.environ.get("YTSAURUS_TPU_LOG_CATEGORIES", "")
@@ -60,6 +78,41 @@ def _configure() -> None:
             category, _, lvl = part.partition("=")
             logging.getLogger(f"ytsaurus_tpu.{category.strip()}").setLevel(
                 getattr(logging, lvl.strip().upper(), logging.WARNING))
+
+
+def _env_int(name: str, default: int) -> int:
+    """Lenient like the module's other knobs: a malformed value falls
+    back instead of aborting the first get_logger() call."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def make_rotating_handler(path: str, max_bytes: int = 64 << 20,
+                          backups: int = 3) -> logging.Handler:
+    """Size-rotated file handler whose rotated segments gzip on the way
+    out (ref core/logging's compressed rotating writer): `path` is the
+    live log; `path.1.gz` … `path.N.gz` are the history, oldest
+    dropped past `backups`."""
+    import gzip
+    import shutil
+    from logging.handlers import RotatingFileHandler
+
+    class _GzRotatingHandler(RotatingFileHandler):
+        def rotation_filename(self, default_name: str) -> str:
+            return default_name + ".gz"
+
+        def rotate(self, source: str, dest: str) -> None:
+            with open(source, "rb") as src, \
+                    gzip.open(dest, "wb") as out:
+                shutil.copyfileobj(src, out)
+            os.remove(source)
+
+    handler = _GzRotatingHandler(path, maxBytes=max_bytes,
+                                 backupCount=backups)
+    handler.setFormatter(StructuredFormatter())
+    return handler
 
 
 def get_logger(category: str) -> logging.Logger:
