@@ -235,3 +235,96 @@ DEPTH = [
                          ids=[c[0] for c in DEPTH])
 def test_composition_depth(query, tables, expected):
     run(query, tables, expected)
+
+
+# ---------------------------------------------------------------------------
+# E. tuple predicates, LIKE escapes, arithmetic semantics breadth
+# ---------------------------------------------------------------------------
+
+PAIRS = tbl([(1, 1), (2, 5), (3, 2), (4, 1), (5, 9)],
+            [("a", "int64", "ascending"), ("b", "int64")], T)
+ESC = tbl([(1, "100%"), (2, "100x"), (3, "a_b"), (4, "axb"),
+           (5, "back\\slash")],
+          [("k", "int64", "ascending"), ("s", "string")], T)
+
+EXTRA = [
+    ("tuple_in",
+     f"a FROM [{T}] WHERE (a, b) IN ((1, 1), (3, 2), (5, 5))", PAIRS,
+     [{"a": 1}, {"a": 3}]),
+    ("tuple_in_none_match",
+     f"a FROM [{T}] WHERE (a, b) IN ((1, 2))", PAIRS, []),
+    ("tuple_between_lexicographic",
+     # (a,b) in the LEX range [(1,5), (4,0)] — row (2,5),(3,2) inside,
+     # (1,1) below, (4,1),(5,9) above.
+     f"a FROM [{T}] WHERE (a, b) BETWEEN ((1, 5) AND (4, 0))", PAIRS,
+     [{"a": 2}, {"a": 3}]),
+    ("tuple_between_multiple_ranges",
+     f"a FROM [{T}] WHERE (a, b) BETWEEN ((1, 0) AND (1, 9), "
+     "(5, 0) AND (5, 9))", PAIRS, [{"a": 1}, {"a": 5}]),
+    ("like_escaped_percent",
+     f"k FROM [{T}] WHERE s LIKE '100\\\\%'", ESC, [{"k": 1}]),
+    ("like_escaped_underscore",
+     f"k FROM [{T}] WHERE s LIKE 'a\\\\_b'", ESC, [{"k": 3}]),
+    ("like_unescaped_underscore_wildcards",
+     f"k FROM [{T}] WHERE s LIKE 'a_b'", ESC, [{"k": 3}, {"k": 4}]),
+    ("like_literal_backslash",
+     f"k FROM [{T}] WHERE s LIKE 'back%slash'", ESC, [{"k": 5}]),
+    ("div_by_larger", f"b / a AS r FROM [{T}] WHERE a = 2", PAIRS,
+     [{"r": 2}]),
+    ("mod_sign_follows_dividend", f"(0 - b) % a AS r FROM [{T}] "
+     "WHERE a = 2", PAIRS, [{"r": -1}]),
+    ("unary_minus_chain", f"0 - (0 - b) AS r FROM [{T}] WHERE a = 1",
+     PAIRS, [{"r": 1}]),
+    ("bitnot", f"~b AS r FROM [{T}] WHERE a = 1", PAIRS, [{"r": -2}]),
+    ("shift_right", f"b >> 1 AS r FROM [{T}] WHERE a = 5", PAIRS,
+     [{"r": 4}]),
+    ("bit_or_and_xor",
+     f"(b | 2) + (b & 2) + (b ^ 2) AS r FROM [{T}] WHERE a = 3",
+     PAIRS, [{"r": 4}]),
+    ("farm_hash_multiarg_stable",
+     f"a FROM [{T}] WHERE farm_hash(a, b) = farm_hash(a, b)", PAIRS,
+     [{"a": 1}, {"a": 2}, {"a": 3}, {"a": 4}, {"a": 5}]),
+    ("farm_hash_order_sensitive",
+     f"a FROM [{T}] WHERE farm_hash(a, b) = farm_hash(b, a) AND a != b",
+     PAIRS, []),
+    ("min_of_mixed_width",
+     f"min_of(a, b, a + b, 100) AS r FROM [{T}] WHERE a = 2", PAIRS,
+     [{"r": 2}]),
+    ("max_of_negative",
+     f"max_of(0 - a, 0 - b) AS r FROM [{T}] WHERE a = 2", PAIRS,
+     [{"r": -2}]),
+    ("comparison_chain_via_and",
+     f"a FROM [{T}] WHERE 1 <= a AND a <= 3 AND b < 3", PAIRS,
+     [{"a": 1}, {"a": 3}]),
+    ("order_by_two_directions",
+     f"a, b FROM [{T}] ORDER BY b ASC, a DESC LIMIT 3", PAIRS,
+     [{"a": 4, "b": 1}, {"a": 1, "b": 1}, {"a": 3, "b": 2}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in EXTRA],
+                         ids=[c[0] for c in EXTRA])
+def test_tuple_predicates_and_arith(query, tables, expected):
+    run(query, tables, expected, ordered="ORDER BY" in query)
+
+
+LIKE_ESCAPE_EDGE = [
+    ("like_escaped_backslash_literal",
+     f"k FROM [{T}] WHERE s LIKE 'back\\\\\\\\slash'", ESC, [{"k": 5}]),
+]
+
+
+@pytest.mark.parametrize("query,tables,expected",
+                         [c[1:] for c in LIKE_ESCAPE_EDGE],
+                         ids=[c[0] for c in LIKE_ESCAPE_EDGE])
+def test_like_escape_edges(query, tables, expected):
+    run(query, tables, expected)
+
+
+def test_like_invalid_escape_is_a_query_error():
+    from ytsaurus_tpu.errors import YtError as _YtError
+    with pytest.raises(_YtError):
+        evaluate(f"k FROM [{T}] WHERE s LIKE 'a\\\\xb'", ESC)
+    with pytest.raises(_YtError):
+        evaluate(f"k FROM [{T}] WHERE s LIKE 'trailing\\\\'", ESC)

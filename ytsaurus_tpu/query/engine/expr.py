@@ -877,14 +877,33 @@ def _string_matcher(node: ir.TStringPredicate):
 
 
 def _like_to_regex(pattern: bytes, case_insensitive: bool):
+    """SQL LIKE → regex: % and _ wildcard; backslash escapes the next
+    character (\\% and \\_ match literally, \\\\ is a backslash — the
+    standard ESCAPE '\\' semantics the reference's LIKE applies)."""
     out = []
-    for ch in pattern.decode("utf-8", errors="surrogateescape"):
+    chars = pattern.decode("utf-8", errors="surrogateescape")
+    i = 0
+    while i < len(chars):
+        ch = chars[i]
+        if ch == "\\":
+            # Standard ESCAPE: only %, _, and \ may follow; anything
+            # else (incl. a trailing lone backslash) is a pattern error,
+            # not a silent guess.
+            if i + 1 >= len(chars) or chars[i + 1] not in "%_\\":
+                raise YtError(
+                    f"LIKE: invalid escape in pattern {pattern!r} "
+                    f"(backslash must precede %, _ or \\)",
+                    code=EErrorCode.QueryParseError)
+            out.append(re.escape(chars[i + 1]))
+            i += 2
+            continue
         if ch == "%":
             out.append(".*")
         elif ch == "_":
             out.append(".")
         else:
             out.append(re.escape(ch))
+        i += 1
     flags = re.DOTALL | (re.IGNORECASE if case_insensitive else 0)
     return re.compile("".join(out).encode("utf-8", errors="surrogateescape"),
                       flags)
