@@ -584,6 +584,9 @@ SPMD_QUERIES = [
     "having_spmd",
     "ts_floor_spmd",
     "ilike_spmd",
+    "tuple_in_spmd",
+    "like_escape_spmd",
+    "order_two_dirs_spmd",
 ]
 
 _SPMD_SQL = {
@@ -628,6 +631,13 @@ _SPMD_SQL = {
         "GROUP BY timestamp_floor_hour(v * 600)",
     "ilike_spmd":
         f"k FROM [{T}] WHERE s ILIKE 'ALPHA'",
+    "tuple_in_spmd":
+        f"k FROM [{T}] WHERE (s, v) IN (('alpha', 1), ('beta', 2), "
+        "('gamma', 3))",
+    "like_escape_spmd":
+        f"k FROM [{T}] WHERE s LIKE '%a' AND s NOT LIKE 'a\\\\_%'",
+    "order_two_dirs_spmd":
+        f"k, v FROM [{T}] WHERE v != 0 ORDER BY v DESC, k ASC LIMIT 9",
 }
 
 
@@ -654,6 +664,12 @@ def test_spmd_matches_local(case, spmd_env):
     plan = build_query(query, {T: schema})
     table = ShardedTable.from_chunks(mesh, chunks)
     spmd = DistributedEvaluator(mesh).run(plan, table).to_rows()
+    if "ORDER BY" in query:
+        # Deterministic order (unique tiebreak): the SEQUENCE is the
+        # contract — canonicalizing would let a lost merge re-sort
+        # slip through.
+        assert spmd == local, f"SPMD order diverged for: {query}"
+        return
 
     def canon(rows):
         return sorted(
