@@ -13,6 +13,8 @@ config, printing the headline (TPC-H Q1, config 1) last:
   q3      two-table JOIN + GROUP BY + top-K (TPC-H Q3, config 4)
   sort    device sort (single-chip stand-in for the 1B-row Sort, config 5)
   strings GROUP BY over a ~1M-distinct string column (hash-bucket path)
+  window  running sum + rank OVER (PARTITION BY ... ORDER BY ...) over
+          2M rows (segmented prefix-scan window subsystem)
   all     run every config, one JSON line each (headline line printed last)
 
 Row counts are scaled to the ACTUAL platform after backend probing: a CPU
@@ -245,6 +247,25 @@ def bench_strings(n_rows, iters):
     return "strings_groupby_rows_per_sec", n_rows / best, best
 
 
+def bench_window(n_rows, iters):
+    """Window subsystem (ISSUE 1): running sum + rank over ~1k
+    partitions — one packed u32 sort + segmented prefix scans
+    (query/engine/window.py)."""
+    from ytsaurus_tpu.models import tpch
+    from ytsaurus_tpu.schema import TableSchema
+    schema = TableSchema.make([("k", "int64", "ascending"),
+                               ("g", "int64"), ("v", "int64")])
+    chunk = tpch.device_chunk(schema, tpch.device_planes({
+        "k": ("arange",), "g": ("randint", 0, 1000),
+        "v": ("randint", 0, 1000)}, n_rows), n_rows)
+    best, count = _time_plan(
+        "k, sum(v) OVER (PARTITION BY g ORDER BY k) AS s, "
+        "rank() OVER (PARTITION BY g ORDER BY k) AS r FROM [//t]",
+        {"//t": chunk}, iters)
+    assert count == n_rows
+    return "window_rows_per_sec", n_rows / best, best
+
+
 # config -> (fn, default rows on an accelerator, default rows on CPU)
 _CONFIGS = {
     "q1": (bench_q1, 64_000_000, 2_000_000),
@@ -253,6 +274,7 @@ _CONFIGS = {
     "q3": (bench_q3, 4_000_000, 500_000),
     "sort": (bench_sort, 64_000_000, 1_000_000),
     "strings": (bench_strings, 10_000_000, 500_000),
+    "window": (bench_window, 2_000_000, 500_000),
 }
 
 
@@ -364,6 +386,7 @@ _METRIC_NAMES = {
     "q3": "tpch_q3_rows_per_sec",
     "sort": "sort_rows_per_sec",
     "strings": "strings_groupby_rows_per_sec",
+    "window": "window_rows_per_sec",
 }
 
 
@@ -412,7 +435,7 @@ def main():
     _DEADLINE = time.monotonic() + args.budget
 
     config = args.config
-    names = ("groupby", "topk", "q3", "sort", "strings", "q1") \
+    names = ("groupby", "topk", "q3", "sort", "strings", "window", "q1") \
         if config == "all" else (config,)
 
     def _emit_fallback(name):

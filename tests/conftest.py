@@ -28,6 +28,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: minutes-long compile-heavy suites excluded from the tier-1 "
+        "quick pass (ROADMAP.md runs -m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     import numpy as np

@@ -10,6 +10,10 @@ import numpy as np
 import pytest
 
 from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+
+# ~1 min of spill-pipeline compiles: excluded from the tier-1 quick pass
+# (-m 'not slow'); run explicitly via `pytest tests/test_bigsort.py`.
+pytestmark = pytest.mark.slow
 from ytsaurus_tpu.errors import YtError
 from ytsaurus_tpu.ops.bigsort import SpillStats, external_sort
 from ytsaurus_tpu.schema import TableSchema

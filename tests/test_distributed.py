@@ -3,6 +3,11 @@
 import numpy as np
 import pytest
 
+# Minutes of 8-device shard_map compiles: excluded from the tier-1 quick
+# pass (-m 'not slow'); the SPMD paths stay tier-1-covered by the
+# dual-check families in test_ql_corpus2.py / test_ql_window.py.
+pytestmark = pytest.mark.slow
+
 from ytsaurus_tpu.chunks import ColumnarChunk
 from ytsaurus_tpu.parallel.distributed import DistributedEvaluator, ShardedTable
 from ytsaurus_tpu.query.builder import build_query

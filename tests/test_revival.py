@@ -106,6 +106,90 @@ def test_map_revival_skips_completed_stripes(tmp_path):
     assert not client.exists(doc + "/@snapshot")
 
 
+def _wait_idle(client, deadline=60.0):
+    """Event-based wait: controller settled = no pending/running jobs."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        stats = client.scheduler.job_manager.stats()
+        if stats["pending"] == 0 and stats["running"] == 0:
+            # One extra beat lets the controller thread run its (would-
+            # be) publish after the jobs settle — the window the
+            # regression guards.
+            time.sleep(0.5)
+            stats = client.scheduler.job_manager.stats()
+            if stats["pending"] == 0 and stats["running"] == 0:
+                return
+        time.sleep(0.05)
+    raise AssertionError("job manager never settled")
+
+
+def test_abort_mid_map_keeps_destination_and_snapshot(tmp_path):
+    """Aborting a map mid-run must NOT publish partial rows over the
+    destination table, and must leave the revival snapshot intact (an
+    aborted wait used to fall through to publish + snap.clear)."""
+    client = connect(str(tmp_path))
+    client.write_table("//in", [{"x": i} for i in range(4)])
+    client.write_table("//out", [{"x": 999, "marker": "sentinel"}])
+    gate = tmp_path / "gate"
+    # Exactly one stripe completes (atomic mkdir wins); the rest block
+    # until the abort kills them.
+    cmd = (f"mkdir {gate} 2>/dev/null "
+           f"&& echo '{{\"x\": 7}}' || sleep 600")
+    op = client.scheduler.start_operation("map", {
+        "command": cmd, "input_table_path": "//in",
+        "output_table_path": "//out", "rows_per_job": 2,
+        "format": "json"}, sync=False)
+    doc = f"//sys/operations/{op.id}"
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:     # wait for the completed stripe
+        if client.exists(doc + "/@snapshot") and \
+                (client.get(doc + "/@snapshot").get("completed") or {}):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("no stripe completed before abort")
+    client.abort_operation(op.id)
+    _wait_idle(client)
+    assert op.state == "aborted"
+    # Destination untouched: still exactly the sentinel row.
+    out = client.read_table("//out")
+    assert [r.get("marker") for r in out] == [b"sentinel"]
+    # Revival snapshot intact (the completed stripe's record survives).
+    snap = client.get(doc + "/@snapshot")
+    assert len(snap.get("completed") or {}) >= 1
+
+
+def test_abort_mid_map_reduce_skips_reduce_phase(tmp_path):
+    """An abort landing during the MAP phase of map_reduce must stop the
+    reduce phase from running and publishing."""
+    client = connect(str(tmp_path))
+    client.write_table("//in", [{"x": i} for i in range(4)])
+    client.write_table("//mr_out", [{"x": 999, "marker": "sentinel"}])
+    gate = tmp_path / "gate"
+    map_cmd = (f"mkdir {gate} 2>/dev/null "
+               f"&& echo '{{\"x\": 1}}' || sleep 600")
+    reduce_ran = tmp_path / "reduce_ran"
+    op = client.scheduler.start_operation("map_reduce", {
+        "map_command": map_cmd,
+        "reduce_command": f"touch {reduce_ran}; cat",
+        "input_table_path": "//in", "output_table_path": "//mr_out",
+        "reduce_by": "x", "rows_per_job": 2, "format": "json"},
+        sync=False)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:     # one map job ran, one blocks
+        if gate.exists():
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("map phase never started")
+    client.abort_operation(op.id)
+    _wait_idle(client)
+    assert op.state == "aborted"
+    assert not reduce_ran.exists()         # reduce phase never launched
+    out = client.read_table("//mr_out")
+    assert [r.get("marker") for r in out] == [b"sentinel"]
+
+
 def test_revival_plan_mismatch_restarts(tmp_path):
     """A changed input invalidates the snapshot: everything re-runs."""
     client = connect(str(tmp_path))

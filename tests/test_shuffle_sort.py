@@ -8,6 +8,11 @@ import numpy as np
 import pytest
 
 from ytsaurus_tpu.chunks import ColumnarChunk
+
+# Minutes of 8-device shard_map compiles: excluded from the tier-1 quick
+# pass (-m 'not slow'); the all_to_all path stays tier-1-covered by the
+# SPMD dual-checks in test_ql_corpus2.py / test_ql_window.py.
+pytestmark = pytest.mark.slow
 from ytsaurus_tpu.parallel.distributed import ShardedTable
 from ytsaurus_tpu.parallel.mesh import make_mesh
 from ytsaurus_tpu.parallel.shuffle import sort_table

@@ -584,6 +584,7 @@ def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
     publish(outputs) runs BEFORE snapshot cleanup so a crash between
     output write and snapshot removal stays revivable.
     Returns (per-item outputs in item order, revived_count)."""
+    _raise_if_aborted(op)      # an abort during an earlier phase stops here
     op_id = op.id if op is not None else uuid.uuid4().hex
     from ytsaurus_tpu.operations.jobs import Job
 
@@ -620,6 +621,13 @@ def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
         raise
     finally:
         job_manager.finish_operation(op_id)
+    # An abort landing during the wait settles its jobs as 'aborted'
+    # (empty results) without raising; publishing would then overwrite
+    # the destination with partial rows and snap.clear() would destroy
+    # the revival snapshot.  Stop BEFORE either.
+    if any(job.state == "aborted" for job in jobs):
+        raise YtError("operation aborted", code=EErrorCode.Canceled)
+    _raise_if_aborted(op)
     by_index = {job.index: (job.result or []) for job in jobs}
     outputs = []
     for i in range(total):
@@ -632,6 +640,14 @@ def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
     if snap is not None:
         snap.clear()
     return outputs, len(completed)
+
+
+def _raise_if_aborted(op) -> None:
+    """Abort barrier between controller phases: a multi-phase controller
+    (map_reduce) must not start its next phase — or publish — after the
+    operation was aborted."""
+    if op is not None and op.state == "aborted":
+        raise YtError("operation aborted", code=EErrorCode.Canceled)
 
 
 def _make_reduce_runner(reducer, command, reduce_by, fmt, spec):
@@ -865,6 +881,10 @@ def _map_reduce_controller(client, spec: dict, op=None,
         for job_buckets in buckets:
             for p, rows in enumerate(job_buckets):
                 partitions[p].extend(rows)
+
+    # An abort that landed during the map phase must stop the reduce
+    # phase from running (and publishing) at all.
+    _raise_if_aborted(op)
 
     # -- phase 2: per-partition device sort + reduce ---------------------------
     make_reduce_base = _make_reduce_runner(

@@ -298,6 +298,136 @@ def segment_distinct_count(data: jax.Array, valid: jax.Array,
     return counts.astype(jnp.uint64), jnp.ones(num_segments, dtype=bool)
 
 
+# --- segmented prefix scans (window-function backbone) ------------------------
+#
+# Window functions (query/engine/window.py) lower to these: ranking is a
+# segmented position/peer scan, running aggregates are segmented inclusive
+# scans, ROWS frames are scan differences (sum/count) or doubling-table
+# range queries (min/max).  All operate on SEGMENT-SORTED planes (equal
+# partition keys adjacent); `starts[i]` marks row i as the first of its
+# segment (starts[0] must be True for a non-empty plane).
+
+
+def _scan_combine(combine_val):
+    """Segmented-scan monoid over (value, start_flag) pairs: the combine
+    resets at segment starts (associative — the standard construction)."""
+    def combine(x, y):
+        xv, xf = x
+        yv, yf = y
+        return jnp.where(yf, yv, combine_val(xv, yv)), xf | yf
+    return combine
+
+
+def segment_scan(function: str, data: jax.Array,
+                 starts: jax.Array) -> jax.Array:
+    """Segmented INCLUSIVE prefix scan (sum/min/max), log-depth via
+    associative_scan — no scatters, the TPU-native window primitive."""
+    if function == "sum":
+        combine_val = lambda a, b: a + b
+    elif function == "min":
+        combine_val = jnp.minimum
+    elif function == "max":
+        combine_val = jnp.maximum
+    else:
+        raise ValueError(f"Unknown scan function {function!r}")
+    scanned, _ = jax.lax.associative_scan(
+        _scan_combine(combine_val), (data, starts))
+    return scanned
+
+
+def segment_suffix_scan(function: str, data: jax.Array,
+                        starts: jax.Array) -> jax.Array:
+    """Segmented inclusive SUFFIX scan (combine toward segment ends):
+    reverse the plane, rebuild start flags from the forward ends, scan,
+    reverse back."""
+    n = data.shape[0]
+    ends = jnp.concatenate([starts[1:], jnp.ones(1, dtype=bool)])
+    return segment_scan(function, data[::-1], ends[::-1])[::-1]
+
+
+def segment_start_index(starts: jax.Array) -> jax.Array:
+    """Per row: index of its segment's FIRST row.  Running max of
+    (starts ? i : 0) — segment starts arrive in increasing index order,
+    so no reset is needed."""
+    iota = jnp.arange(starts.shape[0], dtype=jnp.int32)
+    return jax.lax.associative_scan(
+        jnp.maximum, jnp.where(starts, iota, jnp.zeros_like(iota)))
+
+
+def segment_end_index(starts: jax.Array) -> jax.Array:
+    """Per row: index of its segment's LAST row (reverse of
+    segment_start_index over the mirrored plane)."""
+    n = starts.shape[0]
+    ends = jnp.concatenate([starts[1:], jnp.ones(1, dtype=bool)])
+    iota = jnp.arange(n, dtype=jnp.int32)
+    rev_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(ends[::-1], iota, jnp.zeros_like(iota)))
+    return (n - 1) - rev_start[::-1]
+
+
+def segment_position(starts: jax.Array) -> jax.Array:
+    """0-based row position within its segment (row_number() - 1)."""
+    iota = jnp.arange(starts.shape[0], dtype=jnp.int32)
+    return iota - segment_start_index(starts)
+
+
+def segment_shift(data: jax.Array, valid: jax.Array, starts: jax.Array,
+                  shift: int, seg_lo: "jax.Array | None" = None,
+                  seg_hi: "jax.Array | None" = None
+                  ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Within-segment shifted gather: row i reads row i-shift (shift>0 =
+    lag, shift<0 = lead).  Returns (data, valid, in_segment) — rows whose
+    source falls outside their own segment get in_segment=False and the
+    caller substitutes the default.  Callers that already hold the
+    per-row segment bounds pass seg_lo/seg_hi to skip recomputing the
+    two index scans."""
+    n = data.shape[0]
+    src = jnp.arange(n, dtype=jnp.int32) - shift
+    if seg_lo is None:
+        seg_lo = segment_start_index(starts)
+    if seg_hi is None:
+        seg_hi = segment_end_index(starts)
+    in_seg = (src >= seg_lo) & (src <= seg_hi)
+    src = jnp.clip(src, 0, n - 1)
+    return data[src], valid[src], in_seg
+
+
+def segment_range_extreme(function: str, data: jax.Array, valid: jax.Array,
+                          lo: jax.Array, hi: jax.Array,
+                          max_width: int) -> jax.Array:
+    """Per-row min/max over rows [lo_i, hi_i] (a ROWS frame already
+    clipped inside the row's segment; lo_i <= hi_i, hi_i - lo_i + 1 <=
+    max_width).  Sparse-table range query: level p holds the reduce of
+    the 2^p rows starting at each index (O(n log w) build, two gathers
+    per query) — the log-depth sliding-window reduction bounded frames
+    need where a prefix-scan difference only works for sums."""
+    n = data.shape[0]
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int8)
+    neutral = _reduce_neutral(data.dtype, function)
+    combine = jnp.minimum if function == "min" else jnp.maximum
+    base = jnp.where(valid, data, neutral)
+    n_levels = max(int(max_width).bit_length() - 1, 1)   # floor(log2(w))
+    levels = [base]
+    for p in range(1, n_levels + 1):
+        half = 1 << (p - 1)
+        prev = levels[-1]
+        shifted = jnp.concatenate(
+            [prev[half:], jnp.full(half, neutral, dtype=prev.dtype)])
+        levels.append(combine(prev, shifted))
+    table = jnp.stack(levels)                    # (n_levels+1, n)
+    length = (hi - lo + 1).astype(jnp.int32)
+    # p = floor(log2(length)) via static comparisons (exact, no floats).
+    p = jnp.zeros(n, dtype=jnp.int32)
+    for k in range(1, n_levels + 1):
+        p = p + (length >= (1 << k)).astype(jnp.int32)
+    pow_p = (jnp.ones(n, dtype=jnp.int32) << p)
+    flat = table.reshape(-1)
+    left = flat[p * n + jnp.clip(lo, 0, n - 1)]
+    right = flat[p * n + jnp.clip(hi - pow_p + 1, 0, n - 1)]
+    return combine(left, right)
+
+
 def compact_mask(mask: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Indices that move in-mask rows to the front (stable); plus count."""
     order = stable_argsort_u32([(~mask).astype(jnp.uint32)])

@@ -1,0 +1,18 @@
+"""JAX version compatibility for the parallel layer.
+
+`shard_map` moved from `jax.experimental.shard_map` (jax 0.4.x, kwarg
+`check_rep`) to the top-level `jax.shard_map` (kwarg `check_vma`).  The
+modules in this package code against the new spelling; this shim adapts
+older installs so the SPMD paths work on both.
+"""
+
+from __future__ import annotations
+
+try:                                     # jax >= 0.5
+    from jax import shard_map            # noqa: F401
+except ImportError:                      # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)

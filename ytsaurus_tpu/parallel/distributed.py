@@ -20,8 +20,9 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ytsaurus_tpu.parallel.compat import shard_map
 
 from ytsaurus_tpu.chunks.columnar import (
     Column,
@@ -227,6 +228,21 @@ class DistributedEvaluator:
                 return self._run_partitioned(plan, table,
                                              foreign_chunks or {},
                                              bool(shuffle))
+        if plan.window is not None and plan.window.partition_items and \
+                shuffle is not False and join_setup is None:
+            # Window functions co-partition by the PARTITION BY key over
+            # one all_to_all (default path): each device then owns
+            # COMPLETE partitions and computes exact windows locally;
+            # only order/project/offset/limit merge at the front.
+            # shuffle=False forces the gather-merge fallback (the front
+            # recomputes the window over the full gathered rowset).
+            return self._finish_shuffled(
+                plan, {name: (col.data, col.valid)
+                       for name, col in table.columns.items()},
+                table.row_valid,
+                {name: _RepColumn(type=col.type, dictionary=col.dictionary)
+                 for name, col in table.columns.items()},
+                table.capacity)
         if shuffle and plan.group is not None and not plan.group.totals:
             return self._run_shuffled(plan, table)
         columns_global = {name: (col.data, col.valid)
@@ -516,6 +532,11 @@ class DistributedEvaluator:
             plan_nojoin = dc_replace(plan_nojoin, schema=TableSchema(
                 columns=tuple(c for c in plan.schema
                               if c.name in needed)))
+        if plan_nojoin.window is not None and \
+                plan_nojoin.window.partition_items and shuffle:
+            return self._finish_shuffled(
+                plan_nojoin, columns_global, row_valid, rep_columns,
+                cur_cap)
         if shuffle and plan.group is not None and not plan.group.totals:
             return self._finish_shuffled(plan_nojoin, columns_global,
                                          row_valid, rep_columns, cur_cap)
@@ -535,10 +556,17 @@ class DistributedEvaluator:
     def _finish_shuffled(self, plan: ir.Query, columns_global: dict,
                          row_valid, rep_columns: dict, cap: int
                          ) -> ColumnarChunk:
-        """GROUP BY via key-hash all_to_all: every device ends up owning
-        complete groups, so group+having run fully local; only
-        order/project/offset/limit merge at the front.  Operates on bare
-        sharded planes so it also finishes partitioned-join outputs."""
+        """Key-hash all_to_all finish, shared by two stage shapes:
+
+        - GROUP BY (route by group key): every device owns complete
+          groups, so group+having run fully local;
+        - window stage (route by PARTITION BY key): every device owns
+          complete partitions, so the segmented-scan window stage is
+          exact per device.
+
+        Only order/project/offset/limit merge at the front.  Operates on
+        bare sharded planes so it also finishes partitioned-join
+        outputs."""
         from dataclasses import replace as dc_replace
 
         import numpy as np
@@ -553,15 +581,18 @@ class DistributedEvaluator:
         mesh = self.mesh
         n = mesh.devices.size
 
-        # Bind where + group-key expressions against the (shared) vocab.
+        # Bind where + routing-key expressions (PARTITION BY keys for a
+        # window stage, group keys otherwise) against the (shared) vocab.
+        key_items = plan.window.partition_items if plan.window is not None \
+            else plan.group.group_items
+
         def bind_keys():
             bind_ctx = BindContext(columns={
                 name: ColumnBinding(type=rc.type, vocab=rc.dictionary)
                 for name, rc in rep_columns.items()})
             binder = ExprBinder(bind_ctx)
             where_b = binder.bind(plan.where) if plan.where is not None else None
-            key_b = [binder.bind(item.expr)
-                     for item in plan.group.group_items]
+            key_b = [binder.bind(item.expr) for item in key_items]
             return bind_ctx, where_b, key_b
 
         bind_ctx, where_b, key_b = bind_keys()
@@ -599,10 +630,12 @@ class DistributedEvaluator:
         quota = pad_capacity(max(int(np.asarray(counts).max()), 1))
         recv_cap = quota * n
 
-        # Local plan: complete groups per device (group + having only),
-        # then the front (order/project/offset/limit) runs ON THE MESH over
-        # the all_gathered group rows — no host round-trip (the round-1
-        # host-merge contradiction of this module's framing).
+        # Local plan: complete groups (group + having) or complete
+        # partitions (where + window, identity projection carrying the
+        # slots) per device; then the front (order/project/offset/limit)
+        # runs ON THE MESH over the all_gathered rows — no host
+        # round-trip (the round-1 host-merge contradiction of this
+        # module's framing).
         local_plan = dc_replace(plan, order=None, project=None, offset=0,
                                 limit=None)
         local_rep = _RepChunk(
@@ -612,7 +645,7 @@ class DistributedEvaluator:
                      for name, rc in rep_columns.items()})
         prepared_local = prepare(local_plan, local_rep)
         front = ir.FrontQuery(
-            schema=local_plan.post_group_schema(), order=plan.order,
+            schema=local_plan.output_schema(), order=plan.order,
             project=plan.project, offset=plan.offset, limit=plan.limit)
         out_cap = prepared_local.out_capacity
         front_rep = _RepChunk(

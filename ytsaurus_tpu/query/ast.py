@@ -85,6 +85,29 @@ class LikeExpr(Expr):
 
 
 @dataclass(frozen=True)
+class FrameBound:
+    """One end of a ROWS frame."""
+    kind: str                # unbounded_preceding | preceding | current_row
+                             # | following | unbounded_following
+    offset: Optional[int] = None   # literal row count for (preceding|following)
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    partition_by: tuple[Expr, ...] = ()
+    order_by: tuple["OrderItem", ...] = ()
+    frame: Optional[tuple[FrameBound, FrameBound]] = None   # ROWS BETWEEN a AND b
+
+
+@dataclass(frozen=True)
+class WindowExpr(Expr):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [ROWS BETWEEN ...])."""
+    function: str            # lower-cased window function name
+    args: tuple[Expr, ...]
+    spec: WindowSpec
+
+
+@dataclass(frozen=True)
 class SelectItem:
     expr: Expr
     alias: Optional[str] = None

@@ -16,6 +16,7 @@ from ytsaurus_tpu.query.functions import (
     AGGREGATE_FUNCTIONS,
     SCALAR_FUNCTIONS,
     TWO_ARG_AGGREGATES,
+    WINDOW_FUNCTIONS,
     is_aggregate,
     is_numeric,
     promote_numeric,
@@ -53,6 +54,9 @@ def render_expr(e: ast.Expr) -> str:
         return "case(...)"
     if isinstance(e, ast.LikeExpr):
         return f"{render_expr(e.text)} like {render_expr(e.pattern)}"
+    if isinstance(e, ast.WindowExpr):
+        return (f"{e.function}({', '.join(render_expr(a) for a in e.args)})"
+                " over (...)")
     return "expr"
 
 
@@ -207,6 +211,12 @@ class _ExprBuilder:
         if isinstance(e, ast.CaseExpr):
             return self.build(_desugar_case(e))
 
+        if isinstance(e, ast.WindowExpr):
+            raise YtError(
+                "Window functions are only allowed in the SELECT list "
+                "of a non-grouped query",
+                code=EErrorCode.QueryTypeError)
+
         if isinstance(e, ast.LikeExpr):
             text = self.build(e.text)
             if text.type not in (EValueType.string, EValueType.null):
@@ -355,6 +365,167 @@ class _AggregatingBuilder(_ExprBuilder):
         return ir.TReference(type=self.namespace[slot], name=slot)
 
 
+def _normalize_frame(frame: "tuple[ast.FrameBound, ast.FrameBound]"
+                     ) -> ir.Frame:
+    """ROWS BETWEEN bounds → the signed-offset Frame tuple."""
+    lower, upper = frame
+
+    def conv(bound: ast.FrameBound, is_start: bool) -> tuple[str, int]:
+        if bound.kind == "unbounded_preceding":
+            if not is_start:
+                raise YtError("Frame end cannot be UNBOUNDED PRECEDING",
+                              code=EErrorCode.QueryParseError)
+            return ("unbounded", 0)
+        if bound.kind == "unbounded_following":
+            if is_start:
+                raise YtError("Frame start cannot be UNBOUNDED FOLLOWING",
+                              code=EErrorCode.QueryParseError)
+            return ("unbounded", 0)
+        if bound.kind == "current_row":
+            return ("offset", 0)
+        if bound.kind == "preceding":
+            return ("offset", -int(bound.offset))
+        if bound.kind == "following":
+            return ("offset", int(bound.offset))
+        raise YtError(f"Unknown frame bound {bound.kind!r}")
+
+    lo_kind, lo_off = conv(lower, True)
+    hi_kind, hi_off = conv(upper, False)
+    if lo_kind == "offset" and hi_kind == "offset" and lo_off > hi_off:
+        raise YtError("Frame start must not follow frame end",
+                      code=EErrorCode.QueryParseError)
+    return (lo_kind, lo_off, hi_kind, hi_off)
+
+
+class _WindowBuilder(_ExprBuilder):
+    """Builds SELECT/ORDER expressions of a non-grouped query, turning
+    window calls into WindowItem slots (the analog of how
+    _AggregatingBuilder extracts AggregateItems).  All window calls in a
+    query must share one (PARTITION BY, ORDER BY) spec — one sort serves
+    every item; per-item ROWS frames may differ."""
+
+    def __init__(self, base_builder: _ExprBuilder):
+        super().__init__(base_builder.namespace, base_builder.alias_map)
+        self.base_builder = base_builder
+        self.partition: "Optional[tuple[ast.Expr, ...]]" = None
+        self.order: "Optional[tuple[ast.OrderItem, ...]]" = None
+        self.items: list[ir.WindowItem] = []
+        self._cache: dict[tuple, str] = {}
+
+    def build(self, e: ast.Expr) -> ir.TExpr:
+        if isinstance(e, ast.WindowExpr):
+            return self.build_window(e)
+        if isinstance(e, ast.CaseExpr):
+            return self.build(_desugar_case(e))
+        return super().build(e)
+
+    def build_window(self, e: ast.WindowExpr) -> ir.TExpr:
+        fn = WINDOW_FUNCTIONS.get(e.function)
+        if fn is None:
+            raise YtError(f"Unknown window function {e.function!r}",
+                          code=EErrorCode.QueryTypeError)
+        if not (fn.min_args <= len(e.args) <= fn.max_args):
+            raise YtError(
+                f"Window function {e.function!r} expects "
+                f"{fn.min_args}..{fn.max_args} arguments, got {len(e.args)}",
+                code=EErrorCode.QueryTypeError)
+        # One shared partition spec per query; ONE common ORDER BY among
+        # the items that order at all (an order-less item has a whole-
+        # partition frame, so the shared sort cannot change its result).
+        if self.partition is None:
+            self.partition = e.spec.partition_by
+        elif self.partition != e.spec.partition_by:
+            raise YtError(
+                "All window functions in one query must share the same "
+                "PARTITION BY spec", code=EErrorCode.QueryUnsupported)
+        if e.spec.order_by:
+            if self.order is None:
+                self.order = e.spec.order_by
+            elif self.order != e.spec.order_by:
+                raise YtError(
+                    "All ordered window functions in one query must share "
+                    "the same ORDER BY spec",
+                    code=EErrorCode.QueryUnsupported)
+        if fn.needs_order and not e.spec.order_by:
+            raise YtError(f"{e.function} requires ORDER BY in OVER (...)",
+                          code=EErrorCode.QueryTypeError)
+        if e.spec.frame is not None and not fn.is_aggregate:
+            raise YtError(
+                f"{e.function} does not accept a ROWS frame",
+                code=EErrorCode.QueryTypeError)
+        if e.spec.frame is not None and not e.spec.order_by:
+            raise YtError("A ROWS frame requires ORDER BY in OVER (...)",
+                          code=EErrorCode.QueryTypeError)
+
+        argument = None
+        offset = 1
+        default = None
+        if e.function in ("lag", "lead"):
+            argument = self.base_builder.build(e.args[0])
+            if len(e.args) > 1:
+                if not isinstance(e.args[1], ast.Literal) or \
+                        not isinstance(e.args[1].value, int) or \
+                        isinstance(e.args[1].value, bool) or \
+                        e.args[1].value < 0:
+                    raise YtError(
+                        f"{e.function} offset must be a non-negative "
+                        "integer literal", code=EErrorCode.QueryTypeError)
+                offset = int(e.args[1].value)
+            if len(e.args) > 2:
+                default = self.base_builder.build(e.args[2])
+                unify(argument.type, default.type, f"{e.function} default")
+            result_type = argument.type if argument.type is not \
+                EValueType.null else \
+                (default.type if default is not None else argument.type)
+        elif fn.min_args > 0 or e.args:
+            argument = self.base_builder.build(e.args[0]) if e.args else None
+            result_type = fn.infer_result(
+                argument.type if argument is not None else None)
+        else:
+            result_type = fn.infer_result(None)
+
+        if fn.is_aggregate:
+            # Implicit default with ORDER BY = the standard RANGE
+            # UNBOUNDED PRECEDING..CURRENT ROW: the frame extends to the
+            # end of the current PEER group, so tied order keys share
+            # one value.  An explicit ROWS frame stays row-exact.
+            frame = _normalize_frame(e.spec.frame) \
+                if e.spec.frame is not None else \
+                (ir.PEERS_FRAME if e.spec.order_by
+                 else ir.WHOLE_PARTITION_FRAME)
+        else:
+            frame = ir.WHOLE_PARTITION_FRAME
+
+        key = (e.function,
+               ir._repr_expr(argument) if argument is not None else "",
+               frame, offset,
+               ir._repr_expr(default) if default is not None else "")
+        slot = self._cache.get(key)
+        if slot is None:
+            slot = f"_win{len(self.items)}"
+            self.items.append(ir.WindowItem(
+                name=slot, function=e.function, argument=argument,
+                type=result_type, frame=frame, offset=offset,
+                default=default))
+            self._cache[key] = slot
+            self.namespace[slot] = result_type
+        return ir.TReference(type=self.namespace[slot], name=slot)
+
+    def window_clause(self) -> "Optional[ir.WindowClause]":
+        if not self.items:
+            return None
+        partition_items = tuple(
+            ir.NamedExpr(name=f"_winp{i}", expr=self.base_builder.build(p))
+            for i, p in enumerate(self.partition or ()))
+        order_items = tuple(
+            ir.OrderItem(expr=self.base_builder.build(oi.expr),
+                         descending=oi.descending)
+            for oi in (self.order or ()))
+        return ir.WindowClause(partition_items=partition_items,
+                               order_items=order_items,
+                               items=tuple(self.items))
+
+
 def build_query(source: str | ast.QueryAst,
                 schemas: Mapping[str, TableSchema]) -> ir.Query:
     """Parse + build a typed plan.
@@ -461,7 +632,8 @@ def build_query(source: str | ast.QueryAst,
         if q.having is not None:
             raise YtError("HAVING requires GROUP BY",
                           code=EErrorCode.QueryParseError)
-        final_builder = base_builder
+        # Non-grouped queries may carry window calls in the SELECT list.
+        final_builder = _WindowBuilder(base_builder)
 
     project = None
     if q.select is not None:
@@ -491,12 +663,17 @@ def build_query(source: str | ast.QueryAst,
         raise YtError("ORDER BY requires LIMIT (ref QL semantics)",
                       code=EErrorCode.QueryParseError)
 
+    window_clause = None
+    if isinstance(final_builder, _WindowBuilder):
+        window_clause = final_builder.window_clause()
+
     return ir.Query(
         schema=combined_schema,
         source=q.source,
         joins=tuple(join_clauses),
         where=where,
         group=group_clause,
+        window=window_clause,
         having=having,
         order=order,
         project=project,

@@ -31,6 +31,20 @@ def split_plan(plan: ir.Query) -> tuple[ir.Query, ir.FrontQuery]:
     if plan.limit is not None:
         limit_for_bottom = plan.offset + plan.limit
 
+    if plan.window is not None:
+        # Window functions need COMPLETE partitions: per-shard windows
+        # over arbitrary row placement would be wrong, so the bottom
+        # only filters and the window stage runs at the front over the
+        # merged rowset (the shuffled SPMD path instead co-partitions by
+        # the PARTITION BY key — parallel/distributed.py).
+        bottom = replace(plan, window=None, having=None, order=None,
+                         project=None, offset=0, limit=None)
+        front = ir.FrontQuery(
+            schema=bottom.output_schema(), window=plan.window,
+            order=plan.order, project=plan.project,
+            offset=plan.offset, limit=plan.limit)
+        return bottom, front
+
     if plan.group is not None and any(
             a.function == "cardinality" for a in plan.group.aggregate_items):
         # Distinct counts cannot merge from per-shard counts; ship the
@@ -325,7 +339,11 @@ def coordinate_and_execute(
     # exit saves.
     needed = None
     scan_direction = None
-    if plan.limit is not None and plan.group is None:
+    # No early exit for window plans: every row of a partition (on any
+    # shard) feeds the front's window stage, so a partial scan would
+    # change window values, not just row selection.
+    if plan.limit is not None and plan.group is None and \
+            plan.window is None:
         if plan.order is None:
             needed = plan.offset + plan.limit
         else:

@@ -147,6 +147,18 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             having_b = post_binder.bind(plan.having)
     final_binder = post_binder if post_binder is not None else binder
 
+    # Window stage: binds partition/order/item expressions and registers
+    # the slot columns so ORDER BY / projection can reference them.
+    window = plan.window
+    win_stage = None
+    if window is not None:
+        if group is not None:
+            raise YtError("Window functions cannot combine with GROUP BY",
+                          code=EErrorCode.QueryUnsupported)
+        from ytsaurus_tpu.query.engine.window import WindowStage
+        win_stage = WindowStage(window, binder)
+        bind_ctx.columns.update(win_stage.slot_bindings())
+
     order_b: list[tuple[BoundExpr, bool]] = []
     if plan.order is not None:
         for item in plan.order.items:
@@ -171,6 +183,14 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                     (col_schema.name,
                      final_binder.bind(ir.TReference(type=col_schema.type,
                                                      name=col_schema.name))))
+            if window is not None:
+                # Identity projection carries the window slots (the
+                # bottom stage of a distributed window plan).
+                for item in window.items:
+                    project_b.append(
+                        (item.name,
+                         final_binder.bind(ir.TReference(type=item.type,
+                                                         name=item.name))))
 
     output = [OutputColumn(name=name, type=b.type, vocab=b.vocab)
               for name, b in project_b]
@@ -402,6 +422,12 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             if having_b is not None:
                 d, v = having_b.emit(ctx)
                 mask = mask & v & d.astype(bool)
+
+        if win_stage is not None:
+            # Window columns join the namespace; no rows move.
+            win_columns = win_stage.emit(ctx, mask)
+            ctx = EmitContext(columns={**ctx.columns, **win_columns},
+                              bindings=bindings, capacity=stage_cap)
 
         if order_b:
             # Candidates = top-k by value (masked excluded) ∪ up-to-k null

@@ -137,6 +137,14 @@ def referenced_columns(query: "Query") -> "Optional[set[str]]":
             add(agg.argument)
             add(agg.by_argument)
     add(query.having)
+    if query.window is not None:
+        for item in query.window.partition_items:
+            add(item.expr)
+        for oi in query.window.order_items:
+            add(oi.expr)
+        for w in query.window.items:
+            add(w.argument)
+            add(w.default)
     if query.order is not None:
         for item in query.order.items:
             add(item.expr)
@@ -171,6 +179,42 @@ class GroupClause:
     group_items: tuple[NamedExpr, ...]
     aggregate_items: tuple[AggregateItem, ...]
     totals: bool = False
+
+
+# Normalized frame: (start_kind, start_offset, end_kind, end_offset) with
+# kind in {unbounded, offset, peer}; offsets are SIGNED row deltas relative
+# to the current row (k PRECEDING → -k, k FOLLOWING → +k).  "peer" (end
+# only) extends to the last row of the current ORDER-BY peer group — the
+# SQL-standard default frame (RANGE UNBOUNDED PRECEDING .. CURRENT ROW):
+# tied order keys share one value.  Explicit ROWS frames stay row-exact.
+Frame = tuple[str, int, str, int]
+
+WHOLE_PARTITION_FRAME: Frame = ("unbounded", 0, "unbounded", 0)
+PEERS_FRAME: Frame = ("unbounded", 0, "peer", 0)
+
+
+@dataclass(frozen=True)
+class WindowItem:
+    """One window function: `name` is its slot in the output namespace."""
+    name: str
+    function: str            # row_number | rank | dense_rank | lag | lead |
+                             # first_value | last_value | sum | min | max |
+                             # avg | count
+    argument: Optional[TExpr]
+    type: EValueType         # result type
+    frame: Frame = WHOLE_PARTITION_FRAME
+    offset: int = 1          # lag/lead row distance (>= 0)
+    default: Optional[TExpr] = None   # lag/lead out-of-partition fill
+
+
+@dataclass(frozen=True)
+class WindowClause:
+    """Window stage: ONE shared (partition, order) spec for every item
+    (per-item frames vary).  Computed over the post-WHERE rowset in the
+    input namespace; each item adds a column, no rows move."""
+    partition_items: tuple[NamedExpr, ...]
+    order_items: tuple["OrderItem", ...]
+    items: tuple[WindowItem, ...]
 
 
 @dataclass(frozen=True)
@@ -213,6 +257,7 @@ class Query:
     joins: tuple[JoinClause, ...] = ()
     where: Optional[TExpr] = None
     group: Optional[GroupClause] = None
+    window: Optional[WindowClause] = None
     having: Optional[TExpr] = None
     order: Optional[OrderClause] = None
     project: Optional[ProjectClause] = None
@@ -235,7 +280,12 @@ class Query:
                 [(item.name, item.expr.type.value) for item in self.project.items])
         if self.group is not None:
             return self.post_group_schema()
-        return self.schema.to_unsorted()
+        cols = [(c.name, c.type.value) for c in self.schema.to_unsorted()]
+        if self.window is not None:
+            # Identity projection carries the window slots along so a
+            # front stage can still reference them.
+            cols += [(w.name, w.type.value) for w in self.window.items]
+        return TableSchema.make(cols)
 
 
 @dataclass(frozen=True)
@@ -243,10 +293,11 @@ class FrontQuery:
     """Coordinator-side merge query (ref TFrontQuery, base/query.h:559).
 
     Runs over the concatenation of bottom-query outputs: re-groups partial
-    aggregate states, re-applies having/order/project/offset/limit.
+    aggregate states, re-applies window/having/order/project/offset/limit.
     """
     schema: TableSchema                    # = bottom intermediate schema
     group: Optional[GroupClause] = None    # merge-combine aggregates
+    window: Optional[WindowClause] = None  # recompute over the merged rowset
     having: Optional[TExpr] = None
     order: Optional[OrderClause] = None
     project: Optional[ProjectClause] = None
@@ -260,6 +311,10 @@ class FrontQuery:
         if self.group is not None:
             cols = [(i.name, i.expr.type.value) for i in self.group.group_items]
             cols += [(a.name, a.type.value) for a in self.group.aggregate_items]
+            return TableSchema.make(cols)
+        if self.window is not None:
+            cols = [(c.name, c.type.value) for c in self.schema]
+            cols += [(w.name, w.type.value) for w in self.window.items]
             return TableSchema.make(cols)
         return self.schema
 
@@ -345,6 +400,16 @@ def fingerprint(query: "Query | FrontQuery") -> str:
             f"{a.name}={a.function}({_repr_expr(a.argument) if a.argument else ''}"
             f";{_repr_expr(a.by_argument) if a.by_argument else ''})"
             for a in query.group.aggregate_items) + f";{query.group.totals})")
+    if query.window:
+        parts.append("WIN(" + ";".join(
+            f"{i.name}={_repr_expr(i.expr)}"
+            for i in query.window.partition_items) + "|" + ";".join(
+            f"{_repr_expr(i.expr)}:{i.descending}"
+            for i in query.window.order_items) + "|" + ";".join(
+            f"{w.name}={w.function}({_repr_expr(w.argument) if w.argument else ''}"
+            f";{w.frame};{w.offset};"
+            f"{_repr_expr(w.default) if w.default else ''})"
+            for w in query.window.items) + ")")
     parts.append(_repr_expr(query.having))
     if query.order:
         parts.append("O(" + ";".join(

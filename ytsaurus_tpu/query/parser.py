@@ -75,6 +75,25 @@ class _Parser:
             return self.advance().value
         return None
 
+    # OVER / PARTITION / ROWS / UNBOUNDED / PRECEDING / FOLLOWING / CURRENT /
+    # ROW are contextual words (matched case-insensitively where the window
+    # grammar expects them) rather than reserved keywords, so existing
+    # queries may keep using them as column names.
+
+    def _at_word(self, *names: str) -> bool:
+        tok = self.cur
+        return tok.kind in (TokenKind.IDENT, TokenKind.KEYWORD) and \
+            isinstance(tok.value, str) and tok.value.lower() in names
+
+    def accept_word(self, *names: str) -> Optional[str]:
+        if self._at_word(*names):
+            return self.advance().value.lower()
+        return None
+
+    def expect_word(self, name: str) -> None:
+        if self.accept_word(name) is None:
+            raise self.error(f"Expected {name.upper()}")
+
     # --- expressions ----------------------------------------------------------
 
     def parse_expression(self, min_prec: int = 0) -> ast.Expr:
@@ -224,7 +243,11 @@ class _Parser:
                         while self.accept_op(","):
                             args.append(self.parse_expression())
                 self.expect_op(")")
-                return ast.FunctionCall(name.lower(), tuple(args))
+                call = ast.FunctionCall(name.lower(), tuple(args))
+                if self._at_word("over") and \
+                        self.tokens[self.pos + 1].is_op("("):
+                    return self.parse_over(call)
+                return call
             # Qualified reference t.col.
             if self.cur.is_op("."):
                 self.advance()
@@ -234,6 +257,62 @@ class _Parser:
                 return ast.Reference(name=col.value, table=name)
             return ast.Reference(name=name)
         raise self.error(f"Unexpected token {tok.value!r}")
+
+    def parse_over(self, call: ast.FunctionCall) -> ast.Expr:
+        """fn(args) OVER (PARTITION BY e, ... ORDER BY e [ASC|DESC], ...
+        [ROWS BETWEEN bound AND bound])."""
+        self.expect_word("over")
+        self.expect_op("(")
+        partition: list[ast.Expr] = []
+        if self.accept_word("partition"):
+            self.expect_keyword("by")
+            partition.append(self.parse_expression())
+            while self.accept_op(","):
+                partition.append(self.parse_expression())
+        order_items: list[ast.OrderItem] = []
+        if self.accept_keyword("order"):
+            self.expect_keyword("by")
+            while True:
+                expr = self.parse_expression()
+                desc = False
+                if self.accept_keyword("desc"):
+                    desc = True
+                elif self.accept_keyword("asc"):
+                    pass
+                order_items.append(ast.OrderItem(expr=expr, descending=desc))
+                if not self.accept_op(","):
+                    break
+        frame = None
+        if self.accept_word("rows"):
+            self.expect_keyword("between")
+            lower = self.parse_frame_bound()
+            self.expect_keyword("and")
+            upper = self.parse_frame_bound()
+            frame = (lower, upper)
+        self.expect_op(")")
+        return ast.WindowExpr(
+            function=call.name, args=call.args,
+            spec=ast.WindowSpec(partition_by=tuple(partition),
+                                order_by=tuple(order_items), frame=frame))
+
+    def parse_frame_bound(self) -> ast.FrameBound:
+        if self.accept_word("unbounded"):
+            which = self.accept_word("preceding", "following")
+            if which is None:
+                raise self.error("Expected PRECEDING or FOLLOWING")
+            return ast.FrameBound(kind=f"unbounded_{which}")
+        if self.accept_word("current"):
+            if self.accept_word("row") is None:
+                raise self.error("Expected ROW after CURRENT")
+            return ast.FrameBound(kind="current_row")
+        tok = self.cur
+        if tok.kind in (TokenKind.INT, TokenKind.UINT):
+            self.advance()
+            which = self.accept_word("preceding", "following")
+            if which is None:
+                raise self.error("Expected PRECEDING or FOLLOWING")
+            return ast.FrameBound(kind=which, offset=int(tok.value))
+        raise self.error("Expected ROWS frame bound")
 
     def parse_case(self) -> ast.Expr:
         self.expect_keyword("case")

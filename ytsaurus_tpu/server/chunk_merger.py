@@ -42,11 +42,14 @@ class ChunkMerger:
         self.interval = interval
         self.stats = {"scans": 0, "tables_merged": 0,
                       "chunks_merged_away": 0, "cas_races_lost": 0}
-        # (path, chunk-id tuple) → row counts: an unchanged table whose
+        # path → (chunk-id tuple, row counts): an unchanged table whose
         # stats predate $row_count is decoded at most once per process.
-        self._row_count_memo: "dict[tuple, list[int]]" = {}
+        self._row_count_memo: \
+            "dict[str, tuple[tuple, list[int]]]" = {}
         self._stop = threading.Event()
         self._thread: "Optional[threading.Thread]" = None
+
+    _MEMO_LIMIT = 512          # stats-less tables memoized at once
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -134,14 +137,23 @@ class ChunkMerger:
                 all(isinstance(s, dict) and "$row_count" in s
                     for s in old_stats):
             return [int(s["$row_count"]) for s in old_stats]
-        key = (path, tuple(snapshot_ids))
-        cached = self._row_count_memo.get(key)
-        if cached is None:
-            cached = [self.client.cluster.chunk_cache.get(cid).row_count
+        ids = tuple(snapshot_ids)
+        cached = self._row_count_memo.get(path)
+        if cached is None or cached[0] != ids:
+            counts = [self.client.cluster.chunk_cache.get(cid).row_count
                       for cid in snapshot_ids]
-            self._row_count_memo.clear()      # one table at a time
-            self._row_count_memo[key] = cached
-        return cached
+            # Keyed PER PATH (one entry per table, replaced when its
+            # chunk list changes): a scan over many stats-less tables
+            # must not evict each other's memo every table, or every
+            # scan re-decodes every chunk of every such table.  Bounded
+            # FIFO so deleted/renamed tables cannot leak entries in a
+            # long-lived master process.
+            while len(self._row_count_memo) >= self._MEMO_LIMIT:
+                self._row_count_memo.pop(
+                    next(iter(self._row_count_memo)))
+            self._row_count_memo[path] = (ids, counts)
+            return counts
+        return cached[1]
 
     def _merge_table(self, path: str) -> bool:
         from ytsaurus_tpu.chunks.columnar import concat_chunks

@@ -428,15 +428,50 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
         # the mutation stream.
         from ytsaurus_tpu.cypress.sequoia import SequoiaResolver
         sequoia = SequoiaResolver(client).enable()
-        def _sequoia_state():
-            # verify() walks the tree against table snapshots: take the
-            # mutation lock so a concurrent mutation can't produce a
+        # verify() is a full tree walk + three ground-table scans under
+        # the mutation lock — far too heavy to run on EVERY /sequoia
+        # Orchid read (each read would stall the whole mutation stream).
+        # Reads serve cached counters; verification runs on a background
+        # cadence, and /sequoia/verify is the explicit on-demand action.
+        verify_state = {"divergent": [], "verify_runs": 0,
+                        "verified_at": None}
+
+        def _sequoia_verify():
+            # The tree walk compares live tree vs table snapshots: hold
+            # the mutation lock so a concurrent mutation can't produce a
             # torn (spuriously divergent) read.
             with client.cluster.master.mutation_lock:
-                return {"enabled": True,
-                        "records": len(sequoia._paths),
-                        "divergent": sequoia.verify()}
+                divergent = sequoia.verify()
+            verify_state["divergent"] = divergent
+            verify_state["verify_runs"] += 1
+            verify_state["verified_at"] = time.time()
+            return {"divergent": divergent,
+                    "verify_runs": verify_state["verify_runs"]}
+
+        def _sequoia_state():
+            return {"enabled": True,
+                    "records": len(sequoia._paths),
+                    "divergent": list(verify_state["divergent"]),
+                    "verify_runs": verify_state["verify_runs"],
+                    "verified_at": verify_state["verified_at"]}
+
+        _sequoia_verify()                  # one startup pass seeds the cache
+        verify_interval = float(
+            os.environ.get("YT_TPU_SEQUOIA_VERIFY_INTERVAL", 60))
+
+        def _sequoia_verify_loop() -> None:
+            while True:
+                time.sleep(verify_interval)
+                try:
+                    _sequoia_verify()
+                except Exception as exc:  # noqa: BLE001 — keep the cadence
+                    print(f"# sequoia verify failed: {exc}", flush=True)
+
+        if verify_interval > 0:
+            threading.Thread(target=_sequoia_verify_loop, daemon=True,
+                             name="sequoia-verify").start()
         orchid.register("/sequoia", _sequoia_state)
+        orchid.register("/sequoia/verify", _sequoia_verify)
         print("sequoia ground tables enabled", flush=True)
     role["value"] = "leader"
     print(f"primary serving on {server.address}"
