@@ -247,6 +247,57 @@ def bench_strings(n_rows, iters):
     return "strings_groupby_rows_per_sec", n_rows / best, best
 
 
+def bench_select(n_rows, iters):
+    """Host-coordinated distributed select (coordinate_and_execute over
+    8 shards): scan + filter + GROUP BY through the per-shard recovery
+    ladder (ISSUE 2).  Also proves the DISABLED failpoint fast path adds
+    no measurable overhead — the sites sit on this exact code path."""
+    from ytsaurus_tpu.models import tpch
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.schema import TableSchema
+    from ytsaurus_tpu.utils import failpoints
+
+    # Fast-path micro-check: a disabled failpoint hit must be ~free
+    # (one module-global read), or threading sites through every I/O
+    # boundary would tax fault-free production.
+    probe = failpoints.register_site("bench.overhead.probe")
+    n_probe = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        probe.hit()
+    per_hit = (time.perf_counter() - t0) / n_probe
+    print(f"# failpoints disabled fast path: {per_hit * 1e9:.0f} ns/hit",
+          file=sys.stderr)
+    assert per_hit < 5e-6, \
+        f"disabled failpoint hit too slow: {per_hit * 1e9:.0f} ns"
+
+    schema = TableSchema.make([("k", "int64", "ascending"), ("g", "int64"),
+                               ("v", "int64")])
+    chunk = tpch.device_chunk(schema, tpch.device_planes({
+        "k": ("arange",), "g": ("randint", 0, 10_000),
+        "v": ("randint", 0, 1000)}, n_rows), n_rows)
+    n_shards = 8
+    per = max(n_rows // n_shards, 1)
+    shards = [chunk.slice_rows(i * per, min((i + 1) * per, n_rows))
+              for i in range(n_shards) if i * per < n_rows]
+    plan = build_query(
+        "g, sum(v) AS s, count(*) AS c FROM [//t] WHERE v < 900 GROUP BY g",
+        {"//t": schema})
+    ev = Evaluator()
+    out = coordinate_and_execute(plan, shards, evaluator=ev)   # warm-up
+    _sync(out.columns[out.schema.column_names[0]].data)
+    times = []
+    while _iters_left(times, iters):
+        t0 = time.perf_counter()
+        out = coordinate_and_execute(plan, shards, evaluator=ev)
+        _sync(out.columns[out.schema.column_names[0]].data)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    return "select_rows_per_sec", n_rows / best, best
+
+
 def bench_window(n_rows, iters):
     """Window subsystem (ISSUE 1): running sum + rank over ~1k
     partitions — one packed u32 sort + segmented prefix scans
@@ -275,6 +326,7 @@ _CONFIGS = {
     "sort": (bench_sort, 64_000_000, 1_000_000),
     "strings": (bench_strings, 10_000_000, 500_000),
     "window": (bench_window, 2_000_000, 500_000),
+    "select": (bench_select, 16_000_000, 1_000_000),
 }
 
 
@@ -387,6 +439,7 @@ _METRIC_NAMES = {
     "sort": "sort_rows_per_sec",
     "strings": "strings_groupby_rows_per_sec",
     "window": "window_rows_per_sec",
+    "select": "select_rows_per_sec",
 }
 
 
@@ -435,7 +488,8 @@ def main():
     _DEADLINE = time.monotonic() + args.budget
 
     config = args.config
-    names = ("groupby", "topk", "q3", "sort", "strings", "window", "q1") \
+    names = ("groupby", "topk", "q3", "sort", "strings", "window",
+             "select", "q1") \
         if config == "all" else (config,)
 
     def _emit_fallback(name):

@@ -35,6 +35,34 @@ def pytest_configure(config):
         "quick pass (ROADMAP.md runs -m 'not slow')")
 
 
+@pytest.fixture(autouse=True)
+def _failpoint_leak_guard():
+    """Leak guard (ISSUE 2 satellite): a test that leaves a failpoint
+    schedule active would inject faults into every later test — fail THAT
+    test, loudly, and disarm before anything else runs."""
+    yield
+    from ytsaurus_tpu.utils import failpoints
+
+    leaked = failpoints.active_spec()
+    if leaked is not None:
+        failpoints.deactivate()
+        pytest.fail(f"test left failpoints active: {leaked!r}")
+
+
+@pytest.fixture
+def failpoints_active():
+    """Scoped activation helper: `failpoints_active(spec, seed=7)` arms a
+    schedule for the remainder of the test and guarantees disarm on
+    teardown (even when the test body raises)."""
+    from ytsaurus_tpu.utils import failpoints
+
+    def arm(spec: str, seed: int = 0):
+        failpoints.activate(spec, seed=seed)
+
+    yield arm
+    failpoints.deactivate()
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     import numpy as np

@@ -60,7 +60,7 @@ def test_dead_node_chunks_re_replicate_without_reads(tmp_path):
             missing = {cid for cid, c in counts.items() if c < 2}
             if not missing:
                 break
-            time.sleep(1.0)
+            time.sleep(0.25)
         assert not missing, \
             f"chunks still under-replicated after repair window: {missing}"
 
@@ -115,7 +115,7 @@ def test_scrub_quarantines_and_replicator_heals(tmp_path):
             if sum(cid in _node_chunks(a)
                    for a in cluster.node_addresses) >= 2:
                 break
-            time.sleep(1.0)
+            time.sleep(0.25)
         assert sum(cid in _node_chunks(a)
                    for a in cluster.node_addresses) >= 2
         # And the data stayed intact.
@@ -157,3 +157,46 @@ def test_replicator_scan_unit(tmp_path):
     calls.clear()
     nodes[targets[1]].chunks.add("c1")
     assert rep.scan_once() == 0 and calls == []
+
+
+def test_erasure_repair_on_read_with_injected_location_loss(tmp_path):
+    """ISSUE 2: an injected part loss forces the erasure read ladder
+    through parity reconstruction, and repair-on-read rebuilds the lost
+    part files in place (ref chunk_replicator.h Repair jobs)."""
+    import os
+
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.schema import TableSchema
+    from ytsaurus_tpu.utils import failpoints
+
+    store = FsChunkStore(str(tmp_path / "store"))
+    schema = TableSchema.make([("k", "int64"), ("v", "double")])
+    chunk = ColumnarChunk.from_rows(
+        schema, [(i, float(i * 3)) for i in range(400)])
+    cid = store.write_chunk(chunk, erasure="rs_3_2")
+    baseline = store.read_chunk(cid).to_rows()
+
+    # Injected loss: the first part read "vanishes"; parity reconstructs
+    # and the counters prove the site fired.
+    before = failpoints.counters()["chunks.erasure.part_read"]["triggers"]
+    with failpoints.active("chunks.erasure.part_read=error:times=1"):
+        assert store.read_chunk(cid).to_rows() == baseline
+    after = failpoints.counters()["chunks.erasure.part_read"]["triggers"]
+    assert after == before + 1
+
+    # Real location loss: delete two of five part files (rs_3_2 survives
+    # any two); the read reconstructs AND rewrites them on disk.
+    for i in (0, 3):
+        os.unlink(store._part_path(cid, i))
+    assert store.read_chunk(cid).to_rows() == baseline
+    for i in (0, 3):
+        assert os.path.exists(store._part_path(cid, i)), \
+            f"repair-on-read did not restore part {i}"
+    # The restored parts are byte-identical to a fresh encode.
+    from ytsaurus_tpu.chunks.erasure import get_erasure_codec
+    codec = get_erasure_codec("rs_3_2")
+    fresh = codec.encode(store.get_blob(cid))
+    for i in range(codec.total_parts):
+        with open(store._part_path(cid, i), "rb") as f:
+            assert f.read() == fresh[i]

@@ -100,9 +100,15 @@ def test_elector_step_down_when_fenced():
     elector.stop()
 
 
+@pytest.mark.slow
 def test_leader_failover_no_acked_write_loss(tmp_path):
     """VERDICT r2 #3 done-criterion: kill the leader mid-write-load;
-    the standby takes over and every ACKNOWLEDGED write survives."""
+    the standby takes over and every ACKNOWLEDGED write survives.
+
+    slow: ~50s of sequential fsync'd writes through a real 2-master
+    failover — the single largest tier-1 wall-clock item; the quick pass
+    keeps election coverage via the other tests here + the clock-quorum
+    failover tests, and the full (slow-inclusive) pass still runs it."""
     from ytsaurus_tpu.environment import LocalCluster
     from ytsaurus_tpu.remote_client import connect_remote
 

@@ -56,8 +56,13 @@ def test_store_erasure_chunk_survives_part_loss(tmp_path):
         os.unlink(store._part_path(cid, i))
     back = store.read_chunk(cid)
     assert back.to_rows() == chunk.to_rows()
-    # A fourth loss is fatal.
-    os.unlink(store._part_path(cid, 0))
+    # Repair-on-read (ISSUE 2): the successful decode rebuilt the lost
+    # parts in place, so the chunk is back at full redundancy.
+    for i in (1, 4, 7):
+        assert os.path.exists(store._part_path(cid, i))
+    # Four simultaneous losses exceed rs_6_3's parity: fatal.
+    for i in (0, 2, 6, 8):
+        os.unlink(store._part_path(cid, i))
     with pytest.raises(YtError):
         store.read_chunk(cid)
     store.remove_chunk(cid)

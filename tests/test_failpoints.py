@@ -1,0 +1,191 @@
+"""Deterministic failpoint subsystem (ISSUE 2 tentpole): registry,
+schedule parsing, trigger arithmetic, modes, counters, leak guard."""
+
+import time
+
+import pytest
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    failpoints.deactivate()
+    yield
+    failpoints.deactivate()
+
+
+def _site(name, **kw):
+    return failpoints.register_site(name, **kw)
+
+
+def test_disabled_site_is_noop():
+    site = _site("t.noop")
+    before = site.hits
+    for _ in range(100):
+        site.hit()
+    assert site.hits == before          # hits only count while active
+
+
+def test_parse_spec_rules():
+    rules = failpoints.parse_spec(
+        "a.b=error:times=2;c.d=delay:ms=7:p=0.5;e.f=crash-once")
+    assert rules["a.b"].mode == "error" and rules["a.b"].times == 2
+    assert rules["c.d"].ms == 7.0 and rules["c.d"].p == 0.5
+    assert rules["e.f"].mode == "crash-once"
+    assert rules["e.f"].times == 1      # crash-once disarms itself
+    with pytest.raises(YtError):
+        failpoints.parse_spec("a.b=explode")
+    with pytest.raises(YtError):
+        failpoints.parse_spec("garbage")
+    with pytest.raises(YtError):
+        failpoints.parse_spec("a.b=error:wat=1")
+
+
+def test_error_mode_times_and_counters():
+    site = _site("t.err", error=lambda s: OSError(f"boom {s}"))
+    h0, t0 = site.hits, site.triggers
+    with failpoints.active("t.err=error:times=2"):
+        with pytest.raises(OSError):
+            site.hit()
+        with pytest.raises(OSError):
+            site.hit()
+        site.hit()                      # budget exhausted: clean
+        site.hit()
+    assert site.hits - h0 == 4
+    assert site.triggers - t0 == 2
+    counters = failpoints.counters()["t.err"]
+    assert counters["triggers"] >= 2
+
+
+def test_after_and_one_in():
+    site = _site("t.sched")
+    fired = []
+    with failpoints.active("t.sched=error:after=2:1in=3"):
+        for i in range(11):
+            try:
+                site.hit()
+            except YtError:
+                fired.append(i)
+    # Skips hits 0-1, then every 3rd eligible hit: 2, 5, 8.
+    assert fired == [2, 5, 8]
+
+
+def test_probability_deterministic_per_seed():
+    site = _site("t.prob")
+
+    def run(seed):
+        out = []
+        with failpoints.active("t.prob=error:p=0.5", seed=seed):
+            for i in range(32):
+                try:
+                    site.hit()
+                except YtError:
+                    out.append(i)
+        return out
+
+    a, b = run(7), run(7)
+    assert a == b                       # same seed → same schedule
+    assert run(8) != a                  # and the seed actually matters
+    assert 0 < len(a) < 32
+
+
+def test_delay_mode_sleeps():
+    site = _site("t.delay")
+    with failpoints.active("t.delay=delay:ms=30:times=1"):
+        t0 = time.monotonic()
+        site.hit()
+        assert time.monotonic() - t0 >= 0.02
+        t0 = time.monotonic()
+        site.hit()                      # disarmed: fast
+        assert time.monotonic() - t0 < 0.02
+
+
+def test_crash_once_pierces_except_exception():
+    site = _site("t.crash")
+    with failpoints.active("t.crash=crash-once"):
+        with pytest.raises(failpoints.InjectedCrash):
+            try:
+                site.hit()
+            except Exception:           # noqa: BLE001 — the point: a
+                # simulated crash must NOT be caught by normal recovery.
+                pytest.fail("InjectedCrash was caught by except Exception")
+        site.hit()                      # once: disarmed
+
+
+def test_torn_write_only_mangles_write_sites():
+    site = _site("t.torn")
+    with failpoints.active("t.torn=torn-write:times=1"):
+        site.hit()                      # non-write probe: no-op
+        assert site.triggers >= 0
+        blob, torn = site.write_hit(b"x" * 100)
+        assert torn and len(blob) == 50
+        blob, torn = site.write_hit(b"x" * 100)
+        assert not torn and len(blob) == 100
+
+
+def test_nested_activation_restores_previous():
+    site = _site("t.nest")
+    with failpoints.active("t.nest=error:times=100"):
+        with failpoints.active("other.site=delay"):
+            site.hit()                  # outer schedule suspended
+        with pytest.raises(YtError):
+            site.hit()                  # outer schedule restored
+    assert failpoints.active_spec() is None
+
+
+def test_unknown_site_in_spec_is_allowed():
+    with failpoints.active("never.imported.site=error"):
+        _site("t.other").hit()          # unrelated site unaffected
+
+
+def test_configure_from_config_object():
+    from ytsaurus_tpu.config import FailpointsConfig
+    site = _site("t.cfg")
+    failpoints.configure(FailpointsConfig(spec="t.cfg=error:times=1",
+                                          seed=3))
+    try:
+        with pytest.raises(YtError):
+            site.hit()
+    finally:
+        failpoints.deactivate()
+    failpoints.configure(FailpointsConfig())    # empty spec: no-op
+    assert failpoints.active_spec() is None
+
+
+def test_counters_exported_through_monitoring_endpoint():
+    import json
+    import urllib.request
+
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    site = _site("t.mon")
+    with failpoints.active("t.mon=error:times=1"):
+        with pytest.raises(YtError):
+            site.hit()
+        srv = MonitoringServer()
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://{srv.address}/failpoints", timeout=10).read()
+            doc = json.loads(body)
+            assert doc["active_spec"] == "t.mon=error:times=1"
+            assert doc["sites"]["t.mon"]["triggers"] >= 1
+            assert doc["schedule"]["t.mon"]["mode"] == "error"
+            metrics = urllib.request.urlopen(
+                f"http://{srv.address}/metrics", timeout=10).read().decode()
+            assert 'failpoints_triggers{site="t.mon"}' in metrics
+        finally:
+            srv.stop()
+
+
+def test_retry_policy_delay_shape():
+    from ytsaurus_tpu.config import RetryPolicyConfig
+    policy = RetryPolicyConfig(attempts=5, backoff=0.1, backoff_cap=0.3,
+                               jitter=0.5)
+    for attempt, cap in ((0, 0.1), (1, 0.2), (2, 0.3), (5, 0.3)):
+        for _ in range(8):
+            d = policy.delay(attempt)
+            assert cap * 0.5 <= d <= cap    # jitter only shrinks
+    none = RetryPolicyConfig(attempts=1, backoff=0.1, jitter=0.0)
+    assert none.delay(0) == 0.1

@@ -214,3 +214,71 @@ def test_revival_plan_mismatch_restarts(tmp_path):
     assert op.result["revived_jobs"] == 0
     assert op.result["jobs"] == 2
     assert sorted(r["x"] for r in client.read_table("//out")) == [0, 1, 2, 3]
+
+
+def test_crash_between_snapshot_record_and_publish_revives(tmp_path):
+    """ISSUE 2: a crash-once failpoint at `scheduler.publish` kills the
+    controller AFTER every stripe is snapshot-recorded but BEFORE the
+    output publishes.  InjectedCrash pierces the controller's error
+    handling (like a real process death), so the operation doc stays
+    'running' — and revival must replay purely from the snapshot."""
+    from ytsaurus_tpu.utils import failpoints
+
+    client = connect(str(tmp_path))
+    client.write_table("//in", [{"x": i} for i in range(4)])
+    spec = {"command": "cat", "input_table_path": "//in",
+            "output_table_path": "//out", "rows_per_job": 2,
+            "format": "json"}
+    with failpoints.active("scheduler.publish=crash-once"):
+        with pytest.raises(failpoints.InjectedCrash):
+            client.scheduler.start_operation("map", spec)
+    [op_id] = client.list("//sys/operations")
+    doc = f"//sys/operations/{op_id}"
+    # The "crashed" controller recorded neither completion nor failure.
+    assert client.get(doc + "/@state") == "running"
+    # Snapshot records land from worker-thread on_done observers, which
+    # may still be in flight when the controller crash unwinds.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.exists(doc + "/@snapshot") and len(
+                client.get(doc + "/@snapshot").get("completed") or {}) == 2:
+            break
+        time.sleep(0.05)
+    snap = client.get(doc + "/@snapshot")
+    assert len(snap.get("completed") or {}) == 2
+    assert not client.exists("//out")
+    # Simulate the controller process dying: forget the live operation.
+    client.scheduler._operations.clear()
+    revived = client.scheduler.revive_operations()
+    assert [op.id for op in revived] == [op_id]
+    op = revived[0]
+    assert op.state == "completed"
+    assert op.result["revived_jobs"] == 2      # everything from snapshot
+    assert op.result["jobs"] == 0              # no stripe re-ran
+    assert sorted(r["x"] for r in client.read_table("//out")) == [0, 1, 2, 3]
+    assert not client.exists(doc + "/@snapshot")
+
+
+def test_injected_job_failures_absorbed_by_quarantine(tmp_path):
+    """max_failed_job_count (ISSUE 2 hardening): transient job failures
+    requeue within the per-job attempt budget instead of failing the
+    operation; one past the budget fails it."""
+    from ytsaurus_tpu.errors import YtError
+    from ytsaurus_tpu.utils import failpoints
+
+    client = connect(str(tmp_path))
+    client.write_table("//in", [{"x": i} for i in range(4)])
+    spec = {"command": "cat", "input_table_path": "//in",
+            "output_table_path": "//out", "rows_per_job": 2,
+            "max_failed_job_count": 3, "format": "json"}
+    with failpoints.active("jobs.start=error:times=2"):
+        op = client.scheduler.start_operation("map", spec)
+    assert op.state == "completed"
+    assert sorted(r["x"] for r in client.read_table("//out")) == [0, 1, 2, 3]
+    # Budget exhausted: with only 1 allowed failure, 2 injected faults on
+    # the same job CAN fail the operation — prove failures still surface.
+    spec2 = dict(spec, output_table_path="//out2", max_failed_job_count=1,
+                 raise_on_failure=True)
+    with failpoints.active("jobs.start=error:times=8"):
+        with pytest.raises(YtError):
+            client.scheduler.start_operation("map", spec2)

@@ -19,6 +19,12 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils import failpoints
+
+_FP_DECODE = failpoints.register_site(
+    "chunks.erasure.decode",
+    error=lambda s: YtError(f"injected erasure decode failure at {s}",
+                            code=EErrorCode.ChunkFormatError))
 
 # --- GF(2^8) arithmetic (poly 0x11D, generator 2) ----------------------------
 
@@ -175,6 +181,7 @@ class ErasureCodec:
         local parities against erasures concentrated in one group), so
         the decoder picks an invertible row set from EVERYTHING
         available instead of blindly taking the first k."""
+        _FP_DECODE.hit()
         return self._data_matrix(parts).reshape(-1).tobytes()[:size]
 
     def _data_matrix(self, parts: Sequence[Optional[bytes]]) -> np.ndarray:

@@ -13,28 +13,65 @@ redundancy).
 from __future__ import annotations
 
 import hashlib
+import threading
+import time
 from typing import Optional
 
 from ytsaurus_tpu.chunks.columnar import ColumnarChunk
 from ytsaurus_tpu.chunks.encoding import DEFAULT_CODEC
 from ytsaurus_tpu.chunks.store import FsChunkStore, new_chunk_id
+from ytsaurus_tpu.config import retry_policy
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.utils.logging import get_logger, log_event
 
 import logging as _logging
 
 
+def _is_missing(err: Exception) -> bool:
+    """A clean 'this location has no such chunk' — NOT a dying disk."""
+    return isinstance(err, YtError) and err.code == EErrorCode.NoSuchChunk
+
+
 class ReplicatedChunkStore:
     """Drop-in FsChunkStore replacement spanning several directories."""
 
     def __init__(self, roots: list[str], replication_factor: int = 2,
-                 codec: str = DEFAULT_CODEC):
+                 codec: str = DEFAULT_CODEC,
+                 blacklist_ttl: float = 15.0):
         if not roots:
             raise YtError("ReplicatedChunkStore needs at least one location")
         self.locations = [FsChunkStore(root, codec=codec) for root in roots]
         self.replication_factor = min(replication_factor, len(self.locations))
         self.codec = codec
+        self.blacklist_ttl = blacklist_ttl
+        # Location root → monotonic deadline until which reads skip it (a
+        # location that just threw a disk-shaped error is probably still
+        # broken; probing it on every read serializes the ladder on its
+        # failure latency).  Ref: replication_reader.cpp banned peers.
+        self._banned_until: dict[str, float] = {}
+        self._ban_lock = threading.Lock()
         self._log = get_logger("ChunkReplicator")
+
+    # -- location blacklist ----------------------------------------------------
+
+    def _ban(self, store: FsChunkStore) -> None:
+        if self.blacklist_ttl <= 0:
+            return
+        with self._ban_lock:
+            self._banned_until[store.root] = \
+                time.monotonic() + self.blacklist_ttl
+
+    def _usable(self, stores: "list[FsChunkStore]") -> "list[FsChunkStore]":
+        """Non-blacklisted locations — ALL of them when every location is
+        banned (a desperation round beats a guaranteed failure)."""
+        with self._ban_lock:
+            now = time.monotonic()
+            for root, until in list(self._banned_until.items()):
+                if until <= now:
+                    del self._banned_until[root]
+            usable = [s for s in stores
+                      if s.root not in self._banned_until]
+        return usable or list(stores)
 
     # -- placement -------------------------------------------------------------
 
@@ -82,28 +119,77 @@ class ReplicatedChunkStore:
                       target=self.replication_factor)
         return chunk_id
 
-    def read_chunk(self, chunk_id: str) -> ColumnarChunk:
+    def _read_with_ladder(self, chunk_id: str, probe):
+        """Read ladder (ref replication_reader.cpp): rotate across the
+        placement, blacklist locations that threw disk-shaped errors,
+        and retry whole rounds with jittered exponential backoff — a
+        transient fault (node restarting, injected failpoint) must not
+        fail a read that ANY replica can still serve.  Per-location
+        errors aggregate into the final YtError instead of only the
+        last one surviving.  Returns (serving store, probe result,
+        placement) — placement rides along so hot-path callers don't
+        re-run the rendezvous hash."""
+        policy = retry_policy("chunk_read")
         placement = self._placement(chunk_id)
-        last_error: Optional[Exception] = None
+        errors: dict[str, Exception] = {}
+        for attempt in range(policy.attempts):
+            # The blacklist steers the FIRST round (skip known-bad
+            # locations, serve from a healthy replica fast).  Later
+            # rounds re-probe everything: when the only holder was the
+            # banned location, honoring its ban would starve the retry
+            # into a guaranteed failure.
+            stores = self._usable(placement) if attempt == 0 \
+                else list(placement)
+            for store in stores:
+                try:
+                    return store, probe(store), placement
+                except (YtError, OSError) as e:   # missing OR dying
+                    errors[store.root] = e
+                    if not _is_missing(e):
+                        self._ban(store)
+                    continue
+            if len(errors) == len(placement) and \
+                    all(_is_missing(e) for e in errors.values()):
+                break   # cleanly absent everywhere: waiting cannot help
+            if attempt + 1 < policy.attempts:
+                time.sleep(policy.delay(attempt))
+        raise self._aggregate_read_error(chunk_id, placement, errors)
+
+    def read_chunk(self, chunk_id: str) -> ColumnarChunk:
+        store, chunk, placement = self._read_with_ladder(
+            chunk_id, lambda s: s.read_chunk(chunk_id))
+        import os
+        is_erasure = os.path.exists(store._erasure_meta_path(chunk_id))
+        if not is_erasure:
+            # Erasure chunks carry their own redundancy; replicating
+            # them in full would defeat the coding's storage savings.
+            self._maybe_repair(chunk_id, chunk, placement)
+        return chunk
+
+    def _aggregate_read_error(self, chunk_id: str, placement,
+                              errors: "dict[str, Exception]") -> YtError:
+        inner = []
         for store in placement:
-            try:
-                chunk = store.read_chunk(chunk_id)
-            except (YtError, OSError) as e:   # missing OR dying location
-                last_error = e
+            err = errors.get(store.root)
+            if err is None:
                 continue
-            import os
-            is_erasure = os.path.exists(store._erasure_meta_path(chunk_id))
-            if not is_erasure:
-                # Erasure chunks carry their own redundancy; replicating
-                # them in full would defeat the coding's storage savings.
-                self._maybe_repair(chunk_id, chunk, placement)
-            return chunk
-        if isinstance(last_error, YtError):
-            raise last_error
-        raise YtError(f"No such chunk {chunk_id}",
-                      code=EErrorCode.NoSuchChunk,
-                      attributes={"last_error": str(last_error)
-                                  if last_error else None})
+            if isinstance(err, YtError):
+                err.attributes.setdefault("location", store.root)
+                inner.append(err)
+            else:
+                inner.append(YtError(
+                    f"location {store.root}: {err}",
+                    code=EErrorCode.ChunkFormatError,
+                    attributes={"location": store.root}))
+        all_missing = bool(inner) and all(
+            e.code == EErrorCode.NoSuchChunk for e in inner)
+        code = EErrorCode.NoSuchChunk if all_missing or not inner \
+            else next(e.code for e in inner
+                      if e.code != EErrorCode.NoSuchChunk)
+        return YtError(
+            f"No location could serve chunk {chunk_id} "
+            f"({len(inner)}/{len(placement)} failed)",
+            code=code, inner_errors=inner)
 
     def _maybe_repair(self, chunk_id: str, chunk: ColumnarChunk,
                       placement: list[FsChunkStore]) -> None:
@@ -128,13 +214,12 @@ class ReplicatedChunkStore:
                 continue
 
     def read_meta(self, chunk_id: str) -> dict:
-        for store in self._placement(chunk_id):
-            try:
-                return store.read_meta(chunk_id)
-            except (YtError, OSError):
-                continue
-        raise YtError(f"No such chunk {chunk_id}",
-                      code=EErrorCode.NoSuchChunk)
+        # Same ladder as read_chunk: without the round-2 full-placement
+        # re-probe, a ban on the sole holder would make meta reads
+        # report an existing chunk as absent for the whole ban TTL.
+        _, meta, _ = self._read_with_ladder(
+            chunk_id, lambda s: s.read_meta(chunk_id))
+        return meta
 
     def exists(self, chunk_id: str) -> bool:
         return any(store.exists(chunk_id) for store in self.locations)

@@ -24,6 +24,24 @@ from ytsaurus_tpu.chunks.encoding import (
     serialize_chunk,
 )
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils import failpoints
+
+# Fault sites on every disk boundary (ISSUE 2): disk-shaped failures are
+# OSErrors so the replica/read ladders above this layer treat injected
+# faults exactly like a dying location.
+_FP_READ = failpoints.register_site(
+    "chunks.store.read",
+    error=lambda s: OSError(f"injected read failure at {s}"))
+_FP_WRITE = failpoints.register_site(
+    "chunks.store.write",
+    error=lambda s: OSError(f"injected write failure at {s}"))
+_FP_DECODE = failpoints.register_site(
+    "chunks.store.decode",
+    error=lambda s: YtError(f"injected decode failure at {s}",
+                            code=EErrorCode.ChunkFormatError))
+_FP_PART_READ = failpoints.register_site(
+    "chunks.erasure.part_read",
+    error=lambda s: OSError(f"injected part loss at {s}"))
 
 
 def new_chunk_id() -> str:
@@ -58,11 +76,19 @@ class FsChunkStore:
         return self.put_blob(chunk_id, blob, erasure=erasure)
 
     def _atomic_write(self, path: str, blob: bytes) -> None:
+        # torn-write injection truncates the payload AND fails the write
+        # after the torn bytes hit the tmp file: the rename below never
+        # runs, so readers can only ever see the previous complete state
+        # — the atomicity this staging protocol exists to provide.
+        blob, torn = _FP_WRITE.write_hit(blob)
         tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(blob)
             f.flush()
             os.fsync(f.fileno())
+        if torn:
+            raise OSError(f"injected torn write: {path} "
+                          "(torn tmp left unpublished)")
         os.replace(tmp, path)      # atomic publish
 
     def _write_erasure(self, chunk_id: str, blob: bytes,
@@ -96,12 +122,14 @@ class FsChunkStore:
         return self._read_blob(chunk_id)
 
     def read_chunk(self, chunk_id: str) -> ColumnarChunk:
+        _FP_DECODE.hit()
         return deserialize_chunk(self._read_blob(chunk_id), hunk_store=self)
 
     def read_meta(self, chunk_id: str) -> dict:
         return read_chunk_meta(self._read_blob(chunk_id))
 
     def _read_blob(self, chunk_id: str) -> bytes:
+        _FP_READ.hit()
         path = self._path(chunk_id)
         try:
             with open(path, "rb") as f:
@@ -128,17 +156,32 @@ class FsChunkStore:
 
         def read_part(i):
             try:
+                _FP_PART_READ.hit()
                 with open(self._part_path(chunk_id, i), "rb") as f:
                     return f.read()
-            except FileNotFoundError:
+            except OSError:
                 return None            # erased / lost part → repair below
         # Fast path: data parts only; parity reads happen only on damage.
         parts = [read_part(i) for i in range(codec.data_parts)]
         if any(p is None for p in parts):
             parts += [read_part(i) for i in range(codec.data_parts,
                                                   codec.total_parts)]
-        else:
-            parts += [None] * codec.parity_parts
+            blob = codec.decode(parts, meta["size"])
+            # Repair-on-read (ref chunk_replicator.h Repair jobs invoked
+            # from the read ladder): the decode just proved the chunk
+            # reconstructs, so rebuild the lost parts NOW instead of
+            # paying parity reads on every future access.
+            lost = [i for i, part in enumerate(parts) if part is None]
+            if lost:
+                try:
+                    fresh = codec.encode(blob)
+                    for i in lost:
+                        self._atomic_write(self._part_path(chunk_id, i),
+                                           fresh[i])
+                except OSError:
+                    pass    # repair is best-effort; the read succeeded
+            return blob
+        parts += [None] * codec.parity_parts
         return codec.decode(parts, meta["size"])
 
     def exists(self, chunk_id: str) -> bool:

@@ -202,6 +202,73 @@ def _deep_merge(base: dict, patch: dict) -> dict:
 # Daemon configs (static YSON file; every server role loads one of these).
 # ---------------------------------------------------------------------------
 
+class RetryPolicyConfig(YsonStruct):
+    """Jittered-exponential-backoff retry knobs shared by every recovery
+    ladder (RPC channels, replicated chunk reads, per-shard query
+    retries).  Delay for attempt i is
+    `min(backoff * 2^i, backoff_cap) * (1 - jitter * U[0,1))` — the
+    jitter decorrelates retry storms after a common-cause failure."""
+
+    attempts = param(5, type=int, ge=1)
+    backoff = param(0.2, type=float, ge=0.0)
+    backoff_cap = param(3.0, type=float, ge=0.0)
+    jitter = param(0.2, type=float, ge=0.0, le=1.0)
+
+    def delay(self, attempt: int, rng=None) -> float:
+        base = min(self.backoff * (2 ** attempt), self.backoff_cap)
+        if self.jitter <= 0.0 or base <= 0.0:
+            return base
+        import random as _random
+        u = (rng or _random).random()
+        return base * (1.0 - self.jitter * u)
+
+
+# Process-wide retry policies, keyed by ladder.  Call sites read these
+# instead of hardcoding attempts/backoff (ISSUE 2 satellite); tests and
+# daemons override via set_retry_policy.
+_RETRY_POLICIES: dict[str, RetryPolicyConfig] = {}
+_RETRY_DEFAULTS: dict[str, dict] = {
+    # General RPC transport retries (RetryingChannel's historical 5/0.2).
+    "rpc": {},
+    # Remote job start/poll: fail fast so the job revives on another node.
+    "job_rpc": dict(attempts=2, backoff=0.1, backoff_cap=1.0),
+    # Replicated chunk read ladder: rotate fast, short waits.
+    "chunk_read": dict(attempts=3, backoff=0.05, backoff_cap=1.0,
+                       jitter=0.5),
+    # Per-shard retry inside coordinate_and_execute.
+    "query_shard": dict(attempts=3, backoff=0.05, backoff_cap=0.5,
+                        jitter=0.5),
+}
+
+
+def retry_policy(name: str) -> RetryPolicyConfig:
+    policy = _RETRY_POLICIES.get(name)
+    if policy is None:
+        defaults = _RETRY_DEFAULTS.get(name)
+        if defaults is None:
+            raise YtError(f"Unknown retry policy {name!r}",
+                          code=EErrorCode.InvalidConfig)
+        policy = _RETRY_POLICIES[name] = RetryPolicyConfig(**defaults)
+    return policy
+
+
+def set_retry_policy(name: str, policy: RetryPolicyConfig) -> None:
+    if name not in _RETRY_DEFAULTS:
+        raise YtError(f"Unknown retry policy {name!r}",
+                      code=EErrorCode.InvalidConfig)
+    _RETRY_POLICIES[name] = policy
+
+
+class FailpointsConfig(YsonStruct):
+    """Deterministic fault-injection schedule (utils/failpoints.py):
+    `spec` uses the YT_FAILPOINTS syntax, `seed` fixes p-based rolls.
+    Applied with `failpoints.configure(cfg)`; spawned daemons arm from
+    the YT_FAILPOINTS / YT_FAILPOINTS_SEED environment instead."""
+
+    spec = param("", type=str)
+    seed = param(0, type=int)
+
+
 class RpcConfig(YsonStruct):
     bind_host = param("127.0.0.1", type=str)
     port = param(0, type=int, ge=0, le=65535)

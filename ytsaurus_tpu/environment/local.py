@@ -97,10 +97,6 @@ class LocalCluster:
                 port = self._wait_port(primary_root, "primary", deadline)
                 self.master_addresses.append(f"127.0.0.1:{port}")
             self.primary_address = self.master_addresses[0]
-            if self.kafka_proxy:
-                primary_root = os.path.join(self.root_dir, "primary")
-                port = self._wait_port(primary_root, "kafka", deadline)
-                self.kafka_address = f"127.0.0.1:{port}"
             primaries = ",".join(self.master_addresses)
             for i in range(self.n_nodes):
                 node_root = os.path.join(self.root_dir, f"node{i}")
@@ -111,6 +107,16 @@ class LocalCluster:
                 node_root = os.path.join(self.root_dir, f"node{i}")
                 port = self._wait_port(node_root, "node", deadline)
                 self.node_addresses.append(f"127.0.0.1:{port}")
+            if self.kafka_proxy:
+                # The kafka listener comes up AFTER the primary's WAL
+                # bootstrap, which itself waits for journal NODES to
+                # register — so this wait must sit after the node spawn
+                # loop, or startup deadlocks until the primary's
+                # bootstrap timeout expires (~60s) and it falls back to
+                # a local-only WAL.
+                primary_root = os.path.join(self.root_dir, "primary")
+                port = self._wait_port(primary_root, "kafka", deadline)
+                self.kafka_address = f"127.0.0.1:{port}"
             if self.n_clocks:
                 # Journal plane is up: hand its addresses to the waiting
                 # clock daemons (atomic publish), and restore the

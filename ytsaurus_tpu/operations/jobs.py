@@ -36,6 +36,7 @@ from ytsaurus_tpu.operations.fair_share import (
     find_preemptable,
     pick_pool,
 )
+from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.logging import get_logger
 from ytsaurus_tpu.utils.profiling import Profiler
 
@@ -43,6 +44,22 @@ logger = get_logger("Jobs")
 _profiler = Profiler("/jobs")
 
 STDERR_TAIL_BYTES = 16 << 10
+
+
+def _job_error(site: str) -> YtError:
+    return YtError(f"injected job fault at {site}",
+                   code=EErrorCode.OperationFailed,
+                   attributes={"failpoint": site})
+
+
+# Execution-plane fault sites: start/finish bracket the user code (an
+# injected error is a job failure, exercising the retry quarantine);
+# worker_death in crash-once mode kills the slot THREAD mid-job — the
+# manager must requeue the orphaned job and respawn the slot.
+_FP_START = failpoints.register_site("jobs.start", error=_job_error)
+_FP_FINISH = failpoints.register_site("jobs.finish", error=_job_error)
+_FP_WORKER_DEATH = failpoints.register_site("jobs.worker_death",
+                                            error=_job_error)
 
 
 @dataclass
@@ -74,6 +91,14 @@ class Job:
     # Split children run half-sized inputs: their durations must not feed
     # the straggler median, or healthy full-size jobs start "straggling".
     record_duration: bool = True
+    # Failure quarantine (ref max_failed_job_count): a failing run with
+    # failures < max_failures requeues instead of settling failed, so
+    # transient faults (node death, injected error) don't fail the
+    # operation on the first casualty.  `failures` counts GENUINE failed
+    # runs only — preemption/worker-death/split requeues bump `attempt`
+    # (address rotation) but must not burn the failure budget.
+    max_failures: int = 1
+    failures: int = 0
     _split_pending: bool = False     # chosen for split; blocks speculation
     # live process handle for kill-based preemption/speculation-loss
     _proc: Optional[subprocess.Popen] = None
@@ -94,10 +119,22 @@ class JobManager:
     def __init__(self, slots: int = 4,
                  speculation_factor: float = 3.0,
                  min_speculation_seconds: float = 5.0,
-                 pool_config: Optional[Callable[[str], dict]] = None):
+                 pool_config: Optional[Callable[[str], dict]] = None,
+                 slot_ban_after: int = 5,
+                 slot_ban_seconds: float = 2.0):
         self.slots = slots
         self.speculation_factor = speculation_factor
         self.min_speculation_seconds = min_speculation_seconds
+        # Slot quarantine: a slot whose last `slot_ban_after` runs ALL
+        # failed is probably sitting on broken local state (bad disk,
+        # leaked cgroup) — it cools off for `slot_ban_seconds` before
+        # taking more work instead of chewing through the queue.  The
+        # signal can't distinguish a poisoned slot from a poisoned queue
+        # (one op mass-failing); that's accepted: the short cooldown then
+        # acts as failure-storm throttling, bounded at slot_ban_seconds
+        # per slot_ban_after failures per slot.
+        self.slot_ban_after = slot_ban_after
+        self.slot_ban_seconds = slot_ban_seconds
         self._pool_config = pool_config or (lambda name: {})
         # Config lookups may be Cypress RPCs; they run OUTSIDE the lock
         # (submit + monitor refresh this cache; scheduling reads it).
@@ -192,6 +229,11 @@ class JobManager:
     # -- scheduling ------------------------------------------------------------
 
     def _ensure_workers(self) -> None:
+        # Prune dead slots (worker-death crashes) before topping up, or a
+        # crashed slot would count against the budget forever.
+        self._workers = [w for w in self._workers
+                         if w.is_alive() or w is threading.current_thread()
+                         or not w.ident]
         while len(self._workers) < self.slots:
             worker = threading.Thread(target=self._worker_loop, daemon=True,
                                       name=f"job-slot-{len(self._workers)}")
@@ -220,6 +262,7 @@ class JobManager:
             settled: list[Job] = []
             with self._lock:
                 try:
+                    self._ensure_workers()   # heal crash-killed slots
                     to_split = self._split_candidates_locked()
                     self._maybe_speculate_locked()
                     self._maybe_preempt_locked()
@@ -271,6 +314,7 @@ class JobManager:
         return None
 
     def _worker_loop(self) -> None:
+        consecutive_failures = 0
         while True:
             try:
                 with self._lock:
@@ -283,18 +327,70 @@ class JobManager:
                     job.state = "running"
                     job.started_at = time.monotonic()
                     self._running.append(job)
-                self._execute(job)
+                try:
+                    ok = self._execute(job)
+                except failpoints.InjectedCrash:
+                    # Simulated slot death mid-job: requeue the orphan
+                    # and let this thread die (the monitor respawns a
+                    # replacement) — the worker-death recovery path.
+                    self._on_worker_death(job)
+                    return
+                if ok:
+                    consecutive_failures = 0
+                else:
+                    consecutive_failures += 1
+                    if consecutive_failures >= self.slot_ban_after:
+                        logger.warning(
+                            "job slot banned for %.1fs after %d "
+                            "consecutive failures",
+                            self.slot_ban_seconds, consecutive_failures)
+                        _profiler.counter("slot_banned").increment()
+                        consecutive_failures = 0
+                        time.sleep(self.slot_ban_seconds)
             except Exception:   # noqa: BLE001 — a slot must never die
                 logger.exception("job slot scheduling pass failed")
                 time.sleep(0.1)
 
+    def _on_worker_death(self, job: Job) -> None:
+        """This slot thread is dying with `job` claimed: hand the job
+        back (attempt+1) and drop the thread from the slot roster so
+        _ensure_workers spawns a replacement."""
+        with self._lock:
+            if job in self._running:
+                self._running.remove(job)
+            if not job._done.is_set() and not job._lost:
+                job._proc = None
+                job.state = "pending"
+                job.attempt += 1
+                self._pending.append(job)
+            me = threading.current_thread()
+            if me in self._workers:
+                self._workers.remove(me)
+            _profiler.counter("worker_died").increment()
+            self._ensure_workers()
+            self._lock.notify_all()
+        logger.warning("job slot died (injected crash); job %s requeued",
+                       job.id)
+
     # -- execution -------------------------------------------------------------
 
-    def _execute(self, job: Job) -> None:
+    def _execute(self, job: Job) -> bool:
+        """Run one claimed job to a settled (or requeued) state.  Returns
+        False iff the job GENUINELY failed (the run raised and the job
+        was not killed on purpose) — the slot's consecutive-failure
+        quarantine counts on it, so preemption/speculation-loss/abort
+        kills must not read as slot faults.  May raise InjectedCrash
+        (worker-death failpoint); the caller owns that recovery."""
         prof = _profiler.with_tags(pool=job.pool)
         prof.counter("started").increment()
         try:
+            # worker_death is meaningful as crash-once (InjectedCrash is
+            # a BaseException, so it pierces this try); its error mode
+            # degrades to an ordinary job failure.
+            _FP_WORKER_DEATH.hit()
+            _FP_START.hit()
             result = job.run(job)
+            _FP_FINISH.hit()
             ok = True
         except YtError as err:
             ok = False
@@ -312,35 +408,54 @@ class JobManager:
                 # copied, waiters woken) — this unwinding run must not
                 # clobber the settled state or re-queue a delivered job.
                 job._proc = None
-                return
+                return True
             job.duration = duration
             if job._preempted:
                 # Same object re-queues (waiters hold it); don't signal.
+                # A preemption kill is not a slot fault.
                 job._preempted = False
                 job._proc = None
                 job.state = "pending"
                 job.attempt += 1
                 self._pending.append(job)
                 self._lock.notify_all()
-                return
+                return True
             if job._lost and job.split_children is not None:
                 # Killed FOR the split: stays unsettled until the children
                 # deliver (the monitor's settle pass owns it now).
                 job._proc = None
-                return
+                return True
+            slot_ok = True
             if job._lost:
-                job.state = "aborted"
+                job.state = "aborted"   # deliberate kill: not a slot fault
             elif ok:
                 job.state = "completed"
                 job.result = result
+                job.error = None    # a quarantine-absorbed earlier failure
+                # must not read as this (completed) job's error upstream.
                 if job.record_duration:
                     self._completed_durations.setdefault(
                         job.op_id, []).append(duration)
                 self._settle_speculation_locked(job)
+            elif job.failures + 1 < job.max_failures:
+                # Failure quarantine (ref max_failed_job_count): the
+                # failure budget absorbs transient faults; waiters keep
+                # their handle and only the LAST failure settles.
+                prof.counter("retried").increment()
+                job._proc = None
+                job.state = "pending"
+                job.attempt += 1
+                job.failures += 1
+                job.error = error
+                self._pending.append(job)
+                self._lock.notify_all()
+                return False
             else:
                 job.state = "failed"
+                job.failures += 1
                 job.error = error
                 prof.counter("failed").increment()
+                slot_ok = False
             job._done.set()
             self._lock.notify_all()
         if job.on_done is not None:
@@ -348,6 +463,7 @@ class JobManager:
                 job.on_done(job)
             except Exception:      # noqa: BLE001 — observer boundary
                 pass
+        return slot_ok
 
     def _kill(self, job: Job) -> None:
         job._lost = True
@@ -567,8 +683,11 @@ def run_remote_command_job(job: Job, address: str, body: dict,
     from ytsaurus_tpu.rpc.wire import wire_text as _text
     if job._lost or job._preempted:
         raise YtError("job canceled before start", code=EErrorCode.Canceled)
+    # Attempts/backoff come from the process retry policy (config.py
+    # "job_rpc"), not per-call-site constants: fail fast so the job
+    # revives on another node.
     channel = RetryingChannel(Channel(address, timeout=30),
-                              attempts=2, backoff=0.1)
+                              policy="job_rpc")
     remote_id = None
     delivered = False
     # Dedup key: a transport retry of start_job must not double-start

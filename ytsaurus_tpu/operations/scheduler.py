@@ -27,6 +27,13 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils import failpoints
+
+# Crash-once at `scheduler.publish` simulates a controller dying between
+# the last snapshot record and the output publish — the revival window
+# the snapshot subsystem exists for (tests/test_revival.py).
+_FP_SNAPSHOT_RECORD = failpoints.register_site("scheduler.snapshot_record")
+_FP_PUBLISH = failpoints.register_site("scheduler.publish")
 
 
 @dataclass
@@ -245,6 +252,7 @@ class _Snapshot:
     def record(self, index: int, rows: list) -> None:
         from ytsaurus_tpu.chunks.columnar import ColumnarChunk
         from ytsaurus_tpu.client import infer_schema
+        _FP_SNAPSHOT_RECORD.hit()
         chunk_id = ""
         if rows:
             chunk = ColumnarChunk.from_rows(infer_schema(rows), rows)
@@ -604,6 +612,9 @@ def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
         if snap is not None:
             snap.record(job.index, job.result or [])
 
+    # Per-job failure budget (ref max_failed_job_count): transient
+    # failures requeue the job until the budget runs out.
+    max_failures = max(int(spec.get("max_failed_job_count", 1)), 1)
     jobs = []
     for i, item in enumerate(work_items):
         if i in completed:
@@ -611,6 +622,7 @@ def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
         run, preemptible = make_runner(item)
         jobs.append(Job(op_id=op_id, index=i, run=run, pool=pool,
                         preemptible=preemptible, on_done=on_done,
+                        max_failures=max_failures,
                         splitter=make_splitter(item)
                         if make_splitter is not None else None))
     job_manager.submit(jobs)
@@ -635,6 +647,11 @@ def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
             outputs.append(by_index[i])
         else:
             outputs.append(snap.read_output(completed[i]))
+    # crash-once HERE = controller death after every stripe recorded but
+    # before the output exists: revival must replay purely from the
+    # snapshot.  (The site sits before publish on purpose — after
+    # publish the operation is observably complete.)
+    _FP_PUBLISH.hit()
     if publish is not None:
         publish(outputs)
     if snap is not None:

@@ -35,6 +35,25 @@ from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.coordinator import split_plan
 from ytsaurus_tpu.query.engine.lowering import prepare
 from ytsaurus_tpu.schema import EValueType, TableSchema
+from ytsaurus_tpu.utils import failpoints
+from ytsaurus_tpu.utils.logging import get_logger
+
+_ladder_log = get_logger("Distributed")
+
+
+def _exchange_error(site: str) -> YtError:
+    return YtError(f"injected collective failure at {site}",
+                   code=EErrorCode.QueryExecutionError,
+                   attributes={"failpoint": site})
+
+
+# Shuffle-boundary fault sites: all_to_all guards the co-partition
+# exchange, gather the all_gather merge.  coordinate_distributed's
+# degradation ladder steps down a rung when one of them fails.
+_FP_ALL_TO_ALL = failpoints.register_site("parallel.all_to_all",
+                                          error=_exchange_error)
+_FP_GATHER = failpoints.register_site("parallel.gather",
+                                      error=_exchange_error)
 
 
 @dataclass
@@ -266,6 +285,7 @@ class DistributedEvaluator:
         shapes, reusable after a partitioned join has replaced the table
         planes.  With join_setup, the broadcast probe runs as a traced
         step ahead of the bottom query inside the same program."""
+        _FP_GATHER.hit()
         n = self.mesh.devices.size
         bottom, front = split_plan(plan)
         rep = _RepChunk(capacity=cap, columns=dict(rep_columns))
@@ -567,6 +587,7 @@ class DistributedEvaluator:
         Only order/project/offset/limit merge at the front.  Operates on
         bare sharded planes so it also finishes partitioned-join
         outputs."""
+        _FP_ALL_TO_ALL.hit()
         from dataclasses import replace as dc_replace
 
         import numpy as np
@@ -856,3 +877,65 @@ class DistributedEvaluator:
             + (P(),) * n_extra,
             out_specs=P(), check_vma=False)
         return jax.jit(mapped)
+
+
+def coordinate_distributed(plan: ir.Query, mesh: Mesh,
+                           chunks: Sequence[ColumnarChunk],
+                           foreign_chunks: Optional[dict] = None,
+                           evaluator: Optional[DistributedEvaluator] = None,
+                           host_evaluator=None,
+                           prefer_shuffle: bool = True) -> ColumnarChunk:
+    """Distributed execution with a graceful-degradation ladder (ISSUE 2):
+
+        all_to_all co-partition  →  gather-merge SPMD  →  host coordinator
+
+    Each rung trades throughput for fewer moving parts: the shuffle path
+    needs every device link healthy, gather-merge only the all_gather
+    collective, and the host coordinator nothing but per-shard programs
+    (which carry their own per-shard retry — query/coordinator.py).  A
+    YtError on one rung degrades to the next instead of failing the
+    query; the final error (if every rung fails) aggregates the rungs'
+    errors.  Ref: the coordinator falling back from
+    CoordinateAndExecuteWithShuffle to plain CoordinateAndExecute when a
+    tablet cell cannot serve the shuffle (engine_api/coordinator.h:92).
+    """
+    import logging as _logging
+
+    from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+    from ytsaurus_tpu.utils.logging import log_event
+
+    errors: "list[YtError]" = []
+    de = evaluator if evaluator is not None else DistributedEvaluator(mesh)
+    table = None
+    if len(chunks) == mesh.devices.size and \
+            all(not callable(c) for c in chunks):
+        try:
+            table = ShardedTable.from_chunks(mesh, list(chunks))
+        except YtError:
+            table = None        # ragged shards: host path handles them
+    if table is not None:
+        shuffled_shape = (plan.group is not None and not plan.group.totals) \
+            or (plan.window is not None and plan.window.partition_items)
+        if prefer_shuffle and shuffled_shape and not plan.joins:
+            try:
+                return de.run(plan, table, foreign_chunks, shuffle=True)
+            except YtError as err:
+                errors.append(err)
+                log_event(_ladder_log, _logging.WARNING,
+                          "degrade_to_gather", error=str(err))
+        try:
+            return de.run(plan, table, foreign_chunks, shuffle=False)
+        except YtError as err:
+            errors.append(err)
+            log_event(_ladder_log, _logging.WARNING,
+                      "degrade_to_host", error=str(err))
+    try:
+        return coordinate_and_execute(plan, list(chunks), foreign_chunks,
+                                      evaluator=host_evaluator)
+    except YtError as err:
+        if not errors:
+            raise
+        raise YtError(
+            "distributed query failed on every rung of the degradation "
+            "ladder", code=EErrorCode.QueryExecutionError,
+            inner_errors=[*errors, err]) from err

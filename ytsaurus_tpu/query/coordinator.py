@@ -11,18 +11,67 @@ offset/limit apply only at the front.
 
 from __future__ import annotations
 
+import time
 from dataclasses import replace
 from typing import Mapping, Optional, Sequence
 
 from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
+from ytsaurus_tpu.config import retry_policy
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.engine.evaluator import Evaluator
 from ytsaurus_tpu.schema import EValueType
+from ytsaurus_tpu.utils import failpoints
 
 # How each aggregate's partial state is merged at the front.
 _MERGE_FN = {"sum": "sum", "count": "sum", "min": "min", "max": "max",
              "first": "first"}
+
+# Per-shard fault sites: materialize covers staging (chunk fetch/decode,
+# tablet snapshot), execute covers the shard's bottom-query program.
+_FP_MATERIALIZE = failpoints.register_site(
+    "query.shard_materialize",
+    error=lambda s: YtError(f"injected shard staging failure at {s}",
+                            code=EErrorCode.TransportError))
+_FP_EXECUTE = failpoints.register_site(
+    "query.shard_execute",
+    error=lambda s: YtError(f"injected shard execution failure at {s}",
+                            code=EErrorCode.TransportError))
+
+# Errors worth a per-shard retry: transport-shaped (a remote read hiccup,
+# a dying location).  Application errors (type/parse/execution bugs) are
+# deterministic and must surface unchanged.
+_TRANSIENT_CODES = frozenset({EErrorCode.TransportError,
+                              EErrorCode.RpcTimeout,
+                              EErrorCode.PeerUnavailable})
+
+
+def _is_transient(err: Exception) -> bool:
+    return isinstance(err, OSError) or (
+        isinstance(err, YtError) and err.code in _TRANSIENT_CODES)
+
+
+def _retry_transient(fn, site: "Optional[failpoints.FailpointSite]" = None):
+    """Jittered-exponential-backoff retry of transient failures (policy
+    `query_shard` in config.py) around one shard-granular step."""
+    policy = retry_policy("query_shard")
+    for attempt in range(policy.attempts):
+        try:
+            if site is not None:
+                site.hit()
+            return fn()
+        except (OSError, YtError) as err:
+            if not _is_transient(err) or attempt + 1 >= policy.attempts:
+                raise
+            time.sleep(policy.delay(attempt))
+
+
+def _wrap_lazy_shard(shard):
+    """Lazy shards retry their own staging so one transient chunk-read
+    failure doesn't sink the whole scan."""
+    if not callable(shard):
+        return shard
+    return lambda: _retry_transient(shard, site=_FP_MATERIALIZE)
 
 
 def split_plan(plan: ir.Query) -> tuple[ir.Query, ir.FrontQuery]:
@@ -333,6 +382,8 @@ def coordinate_and_execute(
         raise YtError("coordinate_and_execute: no input shards",
                       code=EErrorCode.QueryExecutionError)
     lazy = any(callable(c) for c in chunks)
+    if lazy:
+        chunks = [_wrap_lazy_shard(c) for c in chunks]
     # Early-exit budget, decided BEFORE any shard coalescing: when a
     # LIMIT scan can stop after the first shard or two, merging every
     # shard into one big program would do strictly more work than the
@@ -374,8 +425,10 @@ def coordinate_and_execute(
         if lazy and stats is not None:
             stats.shards_staged += 1
             stats.rows_read += chunk.row_count
-        result = evaluator.run_plan(plan, chunk, foreign_chunks,
-                                    stats=stats)
+        result = _retry_transient(
+            lambda: evaluator.run_plan(plan, chunk, foreign_chunks,
+                                       stats=stats),
+            site=_FP_EXECUTE)
     else:
         bottom, front = split_plan(plan)
         # LIMIT early-exit (ref: pull-model readers stop at the limit,
@@ -425,8 +478,10 @@ def coordinate_and_execute(
                     chunk = concat_chunks(group) if len(group) > 1 \
                         else group[0]
                     group, group_rows = [], 0
-                partial = evaluator.run_plan(bottom, chunk,
-                                             foreign_chunks, stats=stats)
+                partial = _retry_transient(
+                    lambda c=chunk: evaluator.run_plan(
+                        bottom, c, foreign_chunks, stats=stats),
+                    site=_FP_EXECUTE)
                 partials.append(partial)
                 collected += partial.row_count
                 if needed is not None and collected >= needed:

@@ -16,13 +16,23 @@ import threading
 import time
 
 from ytsaurus_tpu import yson
+from ytsaurus_tpu.config import retry_policy
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
 from ytsaurus_tpu.rpc.server import error_from_wire
 from ytsaurus_tpu.rpc.wire import decode_body, encode_body
+from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.logging import get_logger
 
 logger = get_logger("rpc")
+
+# Injected send failures look exactly like a dropped connection (a
+# dispatched transport error), so the retrying/failover/hedging wrappers
+# exercise their real mid-call recovery ladders.
+_FP_SEND = failpoints.register_site(
+    "rpc.channel.send",
+    error=lambda s: YtError(f"injected transport failure at {s}",
+                            code=EErrorCode.TransportError))
 
 _loop_lock = threading.Lock()
 _loop: asyncio.AbstractEventLoop | None = None
@@ -168,6 +178,7 @@ class Channel:
         one call signature — a bare Channel never resends, so the flag
         only matters to the retrying/failover/hedging wrappers."""
         timeout = timeout if timeout is not None else self.timeout
+        _FP_SEND.hit()
         # Trace context is captured HERE, on the calling thread — contextvars
         # do not flow into the shared loop thread.
         from ytsaurus_tpu.utils.tracing import current_trace
@@ -211,23 +222,40 @@ def _never_dispatched(err: "YtError") -> bool:
 
 class RetryingChannel:
     """Retries TRANSPORT failures (peer restarting, dropped connection);
-    application YtErrors pass through untouched."""
+    application YtErrors pass through untouched.
 
-    def __init__(self, channel: Channel, attempts: int = 5,
-                 backoff: float = 0.2):
+    Attempts/backoff default to the process-wide retry policy
+    (`config.retry_policy(policy)`) instead of per-call-site constants;
+    backoff is exponential with a cap and decorrelating jitter
+    (RetryPolicyConfig.delay)."""
+
+    def __init__(self, channel: Channel, attempts: int | None = None,
+                 backoff: float | None = None, policy: str = "rpc"):
+        from ytsaurus_tpu.config import RetryPolicyConfig
+        cfg = retry_policy(policy)
+        if attempts is not None or backoff is not None:
+            # Caller overrides ride on a copy; the shared policy object
+            # stays untouched.
+            cfg = RetryPolicyConfig(
+                attempts=attempts if attempts is not None else cfg.attempts,
+                backoff=backoff if backoff is not None else cfg.backoff,
+                backoff_cap=cfg.backoff_cap, jitter=cfg.jitter)
         self.channel = channel
-        self.attempts = attempts
-        self.backoff = backoff
+        self._policy = cfg
 
     @property
     def address(self) -> str:
         return self.channel.address
 
+    @property
+    def attempts(self) -> int:
+        return self._policy.attempts
+
     def call(self, service: str, method: str, body=None,
              attachments=(), timeout: float | None = None,
              idempotent: bool = True):
         last: YtError | None = None
-        for attempt in range(self.attempts):
+        for attempt in range(self._policy.attempts):
             try:
                 return self.channel.call(service, method, body,
                                          attachments, timeout)
@@ -245,10 +273,13 @@ class RetryingChannel:
                 if not retryable:
                     raise
                 last = err
-                time.sleep(self.backoff * (2 ** attempt))
+                if attempt + 1 < self._policy.attempts:
+                    # No sleep after the FINAL attempt: the failure is
+                    # already decided, the caller shouldn't wait for it.
+                    time.sleep(self._policy.delay(attempt))
         raise YtError(
             f"RPC to {self.channel.address} failed after "
-            f"{self.attempts} attempts",
+            f"{self._policy.attempts} attempts",
             code=EErrorCode.PeerUnavailable, inner_errors=[last])
 
     def close(self) -> None:

@@ -19,12 +19,18 @@ from ytsaurus_tpu import yson
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc.packet import PacketError, read_packet, write_packet
 from ytsaurus_tpu.rpc.wire import decode_body, encode_body
+from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.logging import get_logger
 from ytsaurus_tpu.utils.profiling import Profiler
 from ytsaurus_tpu.utils.tracing import TraceContext
 
 logger = get_logger("rpc")
 _profiler = Profiler("/rpc/server")
+
+# Server-side receive fault: `error`/`crash-once` drop the connection
+# (the client sees exactly what a dying peer produces — a reset with no
+# reply), `delay` stalls the reply (straggler server).
+_FP_RECV = failpoints.register_site("rpc.server.recv")
 
 
 def rpc_method(name: str | None = None, concurrency: int = 16):
@@ -177,6 +183,15 @@ class RpcServer:
             writer.close()
 
     async def _dispatch(self, parts, writer, write_lock) -> None:
+        act = _FP_RECV.fire()
+        if act is not None:
+            mode, ms = act
+            if mode == "delay":
+                await asyncio.sleep(ms / 1000.0)
+            else:
+                # Simulated peer death mid-request: no reply, reset.
+                writer.close()
+                return
         try:
             envelope = yson.loads(parts[0], encoding=None)
             rid = int(envelope["rid"])

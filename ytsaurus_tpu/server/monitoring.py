@@ -72,6 +72,17 @@ class MonitoringServer:
         path = request.path.split("?", 1)[0]
         if path == "/healthz":
             self._reply(request, 200, b"ok", "text/plain")
+        elif path == "/failpoints":
+            # Fault-injection observability (utils/failpoints.py): the
+            # active schedule + cumulative per-site hit/trigger counters
+            # (triggers also mirror into /metrics as failpoints_*).
+            from ytsaurus_tpu.utils import failpoints
+            body = json.dumps({
+                "active_spec": failpoints.active_spec(),
+                "schedule": failpoints.schedule_snapshot(),
+                "sites": failpoints.counters(),
+            }, indent=2).encode()
+            self._reply(request, 200, body, "application/json")
         elif path in ("/metrics", "/solomon"):
             body = self.registry.render_prometheus().encode()
             self._reply(request, 200, body, "text/plain; version=0.0.4")
