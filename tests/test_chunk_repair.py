@@ -7,6 +7,8 @@ NO read on the chunk's path (ref chunk_replicator.h Replicate jobs).
 
 import time
 
+import pytest
+
 from ytsaurus_tpu.remote_client import connect_remote
 from ytsaurus_tpu.rpc import Channel
 
@@ -21,6 +23,8 @@ def _node_chunks(address: str) -> set[str]:
         ch.close()
 
 
+@pytest.mark.slow   # ~17s; tier-1 keeps replicator-healing coverage via
+# test_scrub_quarantines_and_replicator_heals + test_replicator_scan_unit
 def test_dead_node_chunks_re_replicate_without_reads(tmp_path):
     from ytsaurus_tpu.environment import LocalCluster
 

@@ -195,6 +195,8 @@ def test_primary_restart_recovers_metadata(tmp_path):
         client.close()
 
 
+@pytest.mark.slow   # ~22s; tier-1 keeps WAL recovery coverage via
+# test_primary_restart_recovers_metadata + the test_quorum_wal suite
 def test_quorum_wal_survives_primary_disk_loss(tmp_path):
     """The master's metadata must recover from node journal replicas after
     the primary's local changelog is destroyed (quorum-of-3 WAL)."""

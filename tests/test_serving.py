@@ -1,0 +1,596 @@
+"""Query serving plane tests (ISSUE 3): admission control (weighted
+slots, bounded queue, ThrottledError with retry_after), deadline
+propagation and mid-plan cancellation, continuous lookup micro-batching
+(correctness under concurrency: no lost/duplicated/misordered
+responses), throttle-aware retry channels, serving metrics on /metrics,
+and a seeded failpoint soak over the `serving.admit` /
+`serving.batch_flush` sites."""
+
+import threading
+import time
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.config import ServingConfig
+from ytsaurus_tpu.errors import (
+    EErrorCode,
+    ThrottledError,
+    YtError,
+    retry_after_hint,
+)
+from ytsaurus_tpu.query.serving import CancellationToken, QueryGateway
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.utils import failpoints
+
+N_ROWS = 240
+
+
+# Module-scoped: one shared cluster keeps the quick pass inside the
+# tier-1 budget (tests only read //serve, and counter assertions use
+# deltas).  The remount test re-mounts the same table, which is safe.
+@pytest.fixture(scope="module")
+def client(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("serving")
+    c = connect(str(tmp_path / "cluster"))
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("v", "int64"), ("s", "string")],
+        unique_keys=True)
+    c.create("table", "//serve",
+             attributes={"schema": schema, "dynamic": True,
+                         "pivot_keys": [[80], [160]]}, recursive=True)
+    c.mount_table("//serve")
+    c.insert_rows("//serve", [{"k": i, "v": i * 7, "s": f"s{i}"}
+                              for i in range(N_ROWS)])
+    return c
+
+
+# --- cancellation tokens ------------------------------------------------------
+
+
+def test_token_deadline_and_cancel():
+    token = CancellationToken.with_timeout(None)
+    token.check()                          # no deadline: never raises
+    assert token.remaining() is None
+
+    token = CancellationToken.with_timeout(30.0, pool="prod")
+    token.check()
+    assert 0 < token.remaining() <= 30.0
+
+    token = CancellationToken.with_timeout(1e-9)
+    time.sleep(0.001)
+    with pytest.raises(YtError) as err:
+        token.check()
+    assert err.value.code == EErrorCode.DeadlineExceeded
+
+    token = CancellationToken.with_timeout(30.0)
+    token.cancel("user abort")
+    with pytest.raises(YtError) as err:
+        token.check()
+    assert err.value.code == EErrorCode.Canceled
+
+
+# --- admission control --------------------------------------------------------
+
+
+def _held_slot(gateway, pool=None):
+    """Occupy one slot on a background thread; returns (release, thread)."""
+    hold = threading.Event()
+    entered = threading.Event()
+
+    def busy(token):
+        entered.set()
+        hold.wait(5.0)
+        return None
+
+    thread = threading.Thread(
+        target=lambda: gateway.run_select(busy, pool=pool), daemon=True)
+    thread.start()
+    assert entered.wait(5.0)
+    return hold.set, thread
+
+
+def test_admission_overflow_throttles_with_retry_after():
+    gateway = QueryGateway(ServingConfig(slots=1, max_queue=0))
+    release, thread = _held_slot(gateway)
+    try:
+        with pytest.raises(ThrottledError) as err:
+            gateway.run_select(lambda token: None)
+        assert err.value.code == EErrorCode.RequestThrottled
+        assert err.value.retry_after > 0
+        assert retry_after_hint(err.value) == err.value.retry_after
+    finally:
+        release()
+        thread.join(timeout=5)
+    snap = gateway.snapshot()["pools"]["default"]
+    assert snap["rejected"] == 1
+
+
+def test_admission_queue_waits_for_slot():
+    gateway = QueryGateway(ServingConfig(slots=1, max_queue=4))
+    release, thread = _held_slot(gateway)
+    results = []
+    waiter = threading.Thread(
+        target=lambda: results.append(
+            gateway.run_select(lambda token: "ran")), daemon=True)
+    waiter.start()
+    time.sleep(0.05)
+    assert not results               # queued behind the held slot
+    release()
+    waiter.join(timeout=5)
+    thread.join(timeout=5)
+    assert results == ["ran"]
+    assert gateway.snapshot()["pools"]["default"]["admitted"] == 2
+
+
+def test_admission_deadline_expires_in_queue():
+    gateway = QueryGateway(ServingConfig(slots=1, max_queue=4))
+    release, thread = _held_slot(gateway)
+    try:
+        with pytest.raises(YtError) as err:
+            gateway.run_select(lambda token: None, timeout=0.05)
+        assert err.value.code == EErrorCode.DeadlineExceeded
+    finally:
+        release()
+        thread.join(timeout=5)
+    assert gateway.snapshot()["pools"]["default"]["expired"] == 1
+
+
+def test_weighted_pools_and_unknown_pool_falls_back():
+    config = ServingConfig(slots=8, pools={"default": 1.0, "heavy": 3.0})
+    gateway = QueryGateway(config)
+    pools = gateway.snapshot()["pools"]
+    assert pools["heavy"]["slots"] == 6
+    assert pools["default"]["slots"] == 2
+    # Unknown pool name routes to default_pool instead of failing.
+    assert gateway.run_select(lambda token: "ok", pool="nope") == "ok"
+    assert gateway.snapshot()["pools"]["default"]["admitted"] == 1
+
+
+def test_serving_config_validation():
+    with pytest.raises(YtError):
+        ServingConfig(pools={"default": -1.0})
+    with pytest.raises(YtError):
+        ServingConfig(pools={"a": 1.0}, default_pool="b")
+
+
+# --- lookup micro-batching ----------------------------------------------------
+
+
+def test_batch_probe_covers_keys_evicted_mid_call(tmp_path):
+    """Regression: a key that was a row-cache HIT when the batched
+    chunk probe was computed can be EVICTED by the same call's own
+    cache insertions; reaching it later must fall back to the per-key
+    chunk read, not treat the (unprobed) batch result as 'no rows'."""
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.tablet.tablet import Tablet
+    from ytsaurus_tpu.tablet.transactions import TransactionManager
+
+    schema = TableSchema.make([("k", "int64", "ascending"),
+                               ("v", "int64")], unique_keys=True)
+    tablet = Tablet(schema, FsChunkStore(str(tmp_path / "chunks")))
+    txm = TransactionManager()
+    tx = txm.start()
+    txm.write_rows(tx, tablet, [{"k": i, "v": i} for i in range(32)])
+    txm.commit(tx)
+    tablet.flush()                       # rows live in chunks
+    tablet.row_cache_capacity = 4
+    tablet.lookup_rows([(0,), (1,), (2,), (3,)])      # K=0 cached (LRU)
+    rows = tablet.lookup_rows([(10,), (11,), (12,), (13,), (14,), (0,)])
+    assert rows[-1] == {"k": 0, "v": 0}
+    assert tablet.lookup_rows([(0,)]) == [{"k": 0, "v": 0}]
+
+
+def test_pad_needles_pow2_buckets():
+    from ytsaurus_tpu.tablet.tablet import _pad_needles
+    assert _pad_needles([1, 2, 3], 8) == [1, 2, 3, 3, 3, 3, 3, 3]
+    assert _pad_needles([1] * 8, 8) == [1] * 8
+    assert len(_pad_needles(list(range(9)), 8)) == 16
+    assert _pad_needles([5], 1) == [5]
+
+
+def test_replica_fallback_surfaces_serving_errors(client):
+    """A throttle / lapsed deadline is a serving-plane verdict, not
+    primary unavailability: replica_fallback must surface it instead of
+    hedging every replica."""
+    with failpoints.active("serving.admit=error", seed=1):
+        with pytest.raises(ThrottledError):
+            client.lookup_rows("//serve", [(1,)], replica_fallback=True)
+
+
+def test_lookup_duplicates_missing_and_column_filter(client):
+    rows = client.lookup_rows(
+        "//serve", [(3,), (9999,), (3,), (7,)], column_names=["v"])
+    assert rows[0] == {"v": 21}
+    assert rows[1] is None
+    assert rows[2] == {"v": 21}
+    assert rows[3] == {"v": 49}
+    # Callers get private row copies (a shared batch result must not
+    # leak mutations across requests).
+    a = client.lookup_rows("//serve", [(5,)])[0]
+    a["v"] = -1
+    assert client.lookup_rows("//serve", [(5,)])[0]["v"] == 35
+
+
+def test_concurrent_lookups_coalesce_and_stay_ordered(client):
+    gateway = client.cluster.gateway
+    before = gateway.snapshot()["lookup"]
+    errors = []
+
+    def worker(seed):
+        try:
+            for i in range(10):
+                ks = [((seed * 31 + i * 7 + j) % N_ROWS,)
+                      for j in range(1 + (seed + i) % 5)]
+                rows = client.lookup_rows("//serve", ks)
+                assert len(rows) == len(ks)
+                for key, row in zip(ks, rows):
+                    assert row["k"] == key[0] and row["v"] == key[0] * 7
+        except Exception as exc:   # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    after = gateway.snapshot()["lookup"]
+    requests = after["requests"] - before["requests"]
+    batches = after["batches"] - before["batches"]
+    assert requests == 120
+    # Coalescing happened: strictly fewer flushes than requests.
+    assert 0 < batches < requests
+
+
+def test_lookup_respects_remount(client):
+    assert client.lookup_rows("//serve", [(1,)])[0]["v"] == 7
+    client.unmount_table("//serve")
+    client.mount_table("//serve")
+    # The batcher's cached path context must notice the new tablets.
+    assert client.lookup_rows("//serve", [(1,)])[0]["v"] == 7
+
+
+def test_lookup_disabled_gateway_uses_direct_path(tmp_path):
+    c = connect(str(tmp_path / "c2"))
+    c.cluster.serving_config = ServingConfig(enabled=False)
+    schema = TableSchema.make([("k", "int64", "ascending"),
+                               ("v", "int64")], unique_keys=True)
+    c.create("table", "//t", attributes={"schema": schema,
+                                         "dynamic": True}, recursive=True)
+    c.mount_table("//t")
+    c.insert_rows("//t", [{"k": 1, "v": 10}])
+    assert c.lookup_rows("//t", [(1,), (2,)]) == [{"k": 1, "v": 10}, None]
+    assert c.cluster.gateway.snapshot()["lookup"]["requests"] == 0
+
+
+# --- deadline propagation through execution -----------------------------------
+
+
+class _CountingEvaluator:
+    """Counts bottom-plan executions that actually ran (token passed)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.executed = 0
+
+    def run_plan(self, plan, chunk, foreign_chunks=None, stats=None,
+                 token=None):
+        out = self.inner.run_plan(plan, chunk, foreign_chunks,
+                                  stats=stats, token=token)
+        self.executed += 1
+        return out
+
+
+def test_deadline_aborts_before_remaining_shards():
+    """Acceptance: a query past its deadline stops mid-plan — the
+    remaining shards never execute (failpoint-injected delay makes the
+    first shard consume the budget)."""
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+
+    schema = TableSchema.make([("k", "int64"), ("v", "int64")])
+    shards = [ColumnarChunk.from_rows(
+        schema, [{"k": s * 10 + i, "v": i} for i in range(10)])
+        for s in range(4)]
+    plan = build_query("k, v FROM [//t] WHERE v >= 0", {"//t": schema})
+    warm = Evaluator()
+    coordinate_and_execute(plan, shards, evaluator=warm)   # compile once
+    counting = _CountingEvaluator(warm)
+    token = CancellationToken.with_timeout(0.15)
+    with failpoints.active("query.shard_execute=delay:ms=120", seed=3):
+        with pytest.raises(YtError) as err:
+            coordinate_and_execute(plan, shards, evaluator=counting,
+                                   token=token)
+    assert err.value.code == EErrorCode.DeadlineExceeded
+    assert counting.executed < len(shards)
+
+
+def test_select_deadline_and_select_through_gateway(client):
+    # Warm the compile cache so the timed run measures the deadline,
+    # not XLA compilation.
+    out = client.select_rows("k, v FROM [//serve] WHERE k < 5")
+    assert len(out) == 5
+    with failpoints.active("query.shard_execute=delay:ms=200", seed=1):
+        t0 = time.monotonic()
+        with pytest.raises(YtError) as err:
+            client.select_rows("k, v FROM [//serve] WHERE k < 5",
+                               timeout=0.08)
+        elapsed = time.monotonic() - t0
+    assert err.value.code == EErrorCode.DeadlineExceeded
+    assert elapsed < 5.0          # aborted cooperatively, not run-out
+
+
+def test_lookup_deadline_with_delayed_flush(client):
+    client.lookup_rows("//serve", [(1,)])        # warm path context
+    with failpoints.active("serving.batch_flush=delay:ms=400", seed=2):
+        t0 = time.monotonic()
+        with pytest.raises(YtError) as err:
+            client.lookup_rows("//serve", [(2,)], timeout=0.1)
+        elapsed = time.monotonic() - t0
+    assert err.value.code == EErrorCode.DeadlineExceeded
+    # Honored within tolerance: well before the injected 400ms delay
+    # plus slack, and not before the deadline itself.
+    assert 0.05 <= elapsed < 2.0
+
+
+# --- throttle-aware retry channels --------------------------------------------
+
+
+class _ScriptedChannel:
+    """Stub channel: raises the scripted errors in order, then succeeds."""
+
+    address = "stub:0"
+
+    def __init__(self, errors):
+        self.errors = list(errors)
+        self.calls = 0
+
+    def call(self, service, method, body=None, attachments=(),
+             timeout=None, idempotent=True):
+        self.calls += 1
+        if self.errors:
+            raise self.errors.pop(0)
+        return {"ok": True}, []
+
+    def close(self):
+        pass
+
+
+def test_retrying_channel_honors_retry_after():
+    from ytsaurus_tpu.rpc.channel import RetryingChannel
+    stub = _ScriptedChannel([ThrottledError(retry_after=0.12)])
+    channel = RetryingChannel(stub)
+    t0 = time.monotonic()
+    body, _ = channel.call("svc", "m", idempotent=False)
+    elapsed = time.monotonic() - t0
+    assert body == {"ok": True}
+    # Throttles retry even non-idempotent calls (never executed), and
+    # the wait follows the hint, not the generic backoff curve.
+    assert stub.calls == 2
+    assert elapsed >= 0.1
+
+
+def test_retrying_channel_deadline_exceeded_is_terminal():
+    from ytsaurus_tpu.rpc.channel import RetryingChannel
+    stub = _ScriptedChannel([
+        YtError("deadline exceeded",
+                code=EErrorCode.DeadlineExceeded)] * 5)
+    channel = RetryingChannel(stub)
+    with pytest.raises(YtError) as err:
+        channel.call("svc", "m")
+    assert err.value.code == EErrorCode.DeadlineExceeded
+    assert stub.calls == 1
+
+
+def test_retrying_channel_throttle_exhaustion_keeps_code():
+    from ytsaurus_tpu.rpc.channel import RetryingChannel
+    stub = _ScriptedChannel([ThrottledError(retry_after=0.001)] * 10)
+    channel = RetryingChannel(stub, attempts=3, backoff=0.001)
+    with pytest.raises(YtError) as err:
+        channel.call("svc", "m")
+    assert stub.calls == 3
+    assert err.value.contains(EErrorCode.RequestThrottled)
+    assert retry_after_hint(err.value) == 0.001
+
+
+# --- exec node admission ------------------------------------------------------
+
+
+def test_exec_node_throttles_full_queue():
+    from ytsaurus_tpu.server.exec_service import (
+        MAX_PENDING_PER_SLOT,
+        ExecNodeService,
+    )
+    service = ExecNodeService(store=None, slots=1)
+    try:
+        throttled = []
+        for i in range(2 + MAX_PENDING_PER_SLOT * 2):
+            try:
+                service.start_job({"command": b"sleep 0.2"}, [b""])
+            except ThrottledError as err:
+                throttled.append(err)
+        assert throttled, "queue never throttled"
+        assert throttled[0].retry_after > 0
+        stats = service.exec_stats({}, [])
+        assert stats["throttled_total"] == len(throttled)
+        assert stats["pending"] <= 1 + MAX_PENDING_PER_SLOT
+    finally:
+        service.close()
+
+
+# --- http proxy error mapping -------------------------------------------------
+
+
+class _FakeRequest:
+    def __init__(self):
+        self.status = None
+        self.headers = {}
+        self.body = b""
+        import io
+        self.wfile = io.BytesIO()
+
+    def send_response(self, status):
+        self.status = status
+
+    def send_header(self, name, value):
+        self.headers[name] = value
+
+    def end_headers(self):
+        pass
+
+
+def test_http_proxy_maps_throttle_and_deadline():
+    from ytsaurus_tpu.server.http_proxy import HttpProxy
+    proxy = HttpProxy.__new__(HttpProxy)     # no sockets needed
+    request = _FakeRequest()
+    proxy._reply_error(request, ThrottledError(retry_after=0.25))
+    assert request.status == 429
+    assert request.headers["Retry-After"] == "0.250"
+    request = _FakeRequest()
+    proxy._reply_error(request, YtError(
+        "deadline", code=EErrorCode.DeadlineExceeded))
+    assert request.status == 504
+
+
+# --- observability ------------------------------------------------------------
+
+
+def test_serving_metrics_move_under_load(client):
+    import json
+    import urllib.request
+
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+
+    gateway = client.cluster.gateway
+    before = gateway.snapshot()
+    client.select_rows("sum(v) AS t FROM [//serve] GROUP BY k > 100")
+    client.lookup_rows("//serve", [(1,), (2,), (3,)])
+    after = gateway.snapshot()
+    assert after["pools"]["default"]["admitted"] > \
+        before["pools"]["default"]["admitted"]
+    assert after["lookup"]["requests"] > before["lookup"]["requests"]
+
+    server = MonitoringServer()
+    server.start()
+    try:
+        base = f"http://{server.address}"
+        metrics = urllib.request.urlopen(base + "/metrics",
+                                         timeout=5).read().decode()
+        # Admission counters, batching counters, query statistics
+        # aggregates, and the evaluator cache gauge all export.
+        assert "serving_admission_admitted" in metrics
+        assert "serving_lookup_requests" in metrics
+        assert "serving_lookup_batch_size_bucket" in metrics
+        assert "serving_query_stats_rows_read" in metrics
+        assert "serving_evaluator_cache_size" in metrics
+        assert "serving_select_latency_seconds_bucket" in metrics
+        snapshot = json.loads(urllib.request.urlopen(
+            base + "/serving", timeout=5).read())
+        assert any(g["pools"]["default"]["admitted"] > 0
+                   for g in snapshot["gateways"])
+    finally:
+        server.stop()
+
+
+# --- soak ---------------------------------------------------------------------
+
+
+SOAK_THREADS = 8
+SOAK_OPS = 18
+QUICK_SOAK_THREADS = 6
+QUICK_SOAK_OPS = 8
+
+
+def _soak_round(client, spec, seed, accept_throttle,
+                n_threads=SOAK_THREADS, n_ops=SOAK_OPS):
+    """Mixed lookups/selects under a seeded failpoint schedule; returns
+    (responses, throttles).  Asserts every successful response is
+    correct and complete — nothing lost, duplicated, or misordered."""
+    errors = []
+    throttles = []
+    responses = [0] * n_threads
+
+    def worker(tid):
+        try:
+            for i in range(n_ops):
+                try:
+                    if i % 4 == 3:
+                        rows = client.select_rows(
+                            "k, v FROM [//serve] WHERE k < 10",
+                            timeout=30.0)
+                        assert len(rows) == 10
+                    else:
+                        width = 1 + (tid * n_ops + i) % 17
+                        ks = [((tid * 97 + i * 13 + j) % N_ROWS,)
+                              for j in range(width)]
+                        rows = client.lookup_rows("//serve", ks,
+                                                  timeout=30.0)
+                        assert len(rows) == len(ks)
+                        for key, row in zip(ks, rows):
+                            assert row["k"] == key[0]
+                            assert row["v"] == key[0] * 7
+                    responses[tid] += 1
+                except YtError as err:
+                    if accept_throttle and err.contains(
+                            EErrorCode.RequestThrottled):
+                        # Throttles surface WITH their retry hint.
+                        assert retry_after_hint(err) is not None
+                        throttles.append(err)
+                    else:
+                        raise
+        except Exception as exc:   # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    with failpoints.active(spec, seed=seed):
+        threads = [threading.Thread(target=worker, args=(t,),
+                                    daemon=True)
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    assert not errors, errors
+    # Every op is accounted for: a success or an accepted throttle.
+    assert sum(responses) + len(throttles) == n_threads * n_ops
+    return responses, throttles
+
+
+def _soak(client, n_threads, n_ops):
+    # Warm compiles so the soak exercises serving, not XLA.
+    client.select_rows("k, v FROM [//serve] WHERE k < 10")
+    client.lookup_rows("//serve", [(0,)])
+    cache0 = client.cluster.evaluator.cache_size()
+
+    # Delay schedule: admission and flushes straggle, nothing fails.
+    _soak_round(client, "serving.admit=delay:ms=2:p=0.4;"
+                        "serving.batch_flush=delay:ms=2:p=0.4",
+                seed=7, accept_throttle=False,
+                n_threads=n_threads, n_ops=n_ops)
+    # Error schedule: every 6th admission throttles; callers see
+    # ThrottledError with retry_after, everyone else is unaffected.
+    _, throttles = _soak_round(
+        client, "serving.admit=error:1in=6", seed=11,
+        accept_throttle=True, n_threads=n_threads, n_ops=n_ops)
+    assert throttles, "error schedule never throttled"
+
+    # Compile-cache discipline: varied lookup batch sizes + repeated
+    # selects must NOT mint new programs (bucketed shapes keep
+    # compile_count flat across the soak).
+    assert client.cluster.evaluator.cache_size() == cache0
+    counters = failpoints.counters()
+    assert counters["serving.admit"]["triggers"] > 0
+    assert counters["serving.batch_flush"]["triggers"] > 0
+
+
+def test_serving_soak_quick(client):
+    """Tier-1 sibling of the full soak (same schedules, smaller mix)."""
+    _soak(client, QUICK_SOAK_THREADS, QUICK_SOAK_OPS)
+
+
+@pytest.mark.slow
+def test_serving_soak_under_failpoints(client):
+    _soak(client, SOAK_THREADS, SOAK_OPS)

@@ -32,6 +32,9 @@ def test_tcp_proxy_routes_thin_client(tmp_path):
             proxy.stop()
 
 
+@pytest.mark.slow   # ~12s; tier-1 keeps proxy routing coverage via
+# test_tcp_proxy_routes_thin_client, and leader failover via the
+# election/clock failover suites
 def test_tcp_proxy_follows_leader(tmp_path):
     from ytsaurus_tpu.environment import LocalCluster
 

@@ -55,9 +55,25 @@ class YtCluster:
         self.transactions = TransactionManager()
         self.evaluator = Evaluator()
         self.tablets: dict[str, list[Tablet]] = {}   # node id → tablets
+        # Query serving plane (query/serving.py): set serving_config
+        # BEFORE the first query to override the defaults; the gateway
+        # is cluster-scoped so every client of this cluster shares
+        # admission slots and coalesces lookups into common batches.
+        self.serving_config = None
+        self._gateway = None
+        self._gateway_lock = threading.Lock()
         from ytsaurus_tpu.cypress.security import SecurityManager
         self.security = SecurityManager(self.master)
         self.security.ensure_defaults()
+
+    @property
+    def gateway(self):
+        if self._gateway is None:
+            from ytsaurus_tpu.query.serving import QueryGateway
+            with self._gateway_lock:
+                if self._gateway is None:
+                    self._gateway = QueryGateway(self.serving_config)
+        return self._gateway
 
 
 def publish_table_chunks(client, chunk_store, path, chunks,
@@ -1093,9 +1109,17 @@ class YtClient:
     def lookup_rows(self, path: str, keys: Sequence[tuple],
                     timestamp: int = MAX_TIMESTAMP,
                     column_names: Optional[Sequence[str]] = None,
-                    replica_fallback: bool = False
+                    replica_fallback: bool = False,
+                    timeout: Optional[float] = None,
+                    pool: Optional[str] = None
                     ) -> list[Optional[dict]]:
-        """Point reads.  replica_fallback=True: when the upstream table is
+        """Point reads.  Routed through the cluster's QueryGateway
+        (query/serving.py): concurrent lookups against one table
+        coalesce into micro-batches with parallel per-tablet fan-out,
+        under per-pool admission control and a deadline (`timeout`
+        seconds, default ServingConfig.default_timeout).
+
+        replica_fallback=True: when the upstream table is
         unavailable, read from the replicas — HEDGED, not sequential
         (core/rpc/hedging_channel.h): the best replica (sync first, then
         freshest) starts immediately and each further replica is armed
@@ -1105,8 +1129,17 @@ class YtClient:
         if replica_fallback:
             try:
                 return self.lookup_rows(path, keys, timestamp=timestamp,
-                                        column_names=column_names)
+                                        column_names=column_names,
+                                        timeout=timeout, pool=pool)
             except YtError as primary_err:
+                if primary_err.code in (EErrorCode.RequestThrottled,
+                                        EErrorCode.DeadlineExceeded):
+                    # Serving-plane verdicts are NOT unavailability: a
+                    # throttle means back off (retry_after), a lapsed
+                    # deadline is terminal — hedging every replica here
+                    # would both bust the caller's deadline and multiply
+                    # load exactly when the cluster asked for less.
+                    raise
                 from ytsaurus_tpu.tablet import replication as repl
                 replicas = repl.replica_descriptors(self, path)
                 ranked = [
@@ -1128,6 +1161,22 @@ class YtClient:
                     [lambda info=info: from_replica(info)
                      for info in ranked],
                     self.lookup_hedging_delay, primary_err)
+        gateway = self.cluster.gateway
+        if gateway.enabled and keys:
+            return gateway.lookup_rows(self, path, keys, timestamp,
+                                       column_names=column_names,
+                                       pool=pool, timeout=timeout)
+        return self._lookup_rows_direct(path, keys, timestamp,
+                                        column_names)
+
+    def _lookup_rows_direct(self, path: str, keys: Sequence[tuple],
+                            timestamp: int = MAX_TIMESTAMP,
+                            column_names: Optional[Sequence[str]] = None
+                            ) -> list[Optional[dict]]:
+        """The pre-gateway path (serving disabled): sequential per-tablet
+        reads, no batching, no admission.  Kept separate so the bench
+        can measure batched vs. unbatched and the gateway stays
+        bypassable."""
         tablets = self._mounted_tablets(path)
         self._require_sorted(tablets[0], path)
         keys = self._fill_computed_keys(tablets[0].schema,
@@ -1135,23 +1184,51 @@ class YtClient:
         routed = self._route_rows(path, tablets, keys)
         results: dict[tuple, Optional[dict]] = {}
         for idx, part in routed.items():
-            normalized = [tablets[idx].normalize_key(k) for k in part]
-            for nk, row in zip(normalized,
-                               tablets[idx].lookup_rows(
-                                   part, timestamp=timestamp,
-                                   column_names=column_names)):
+            for nk, row in zip(
+                    [tablets[idx].normalize_key(k) for k in part],
+                    tablets[idx].lookup_rows(
+                        part, timestamp=timestamp,
+                        column_names=column_names)):
                 results[nk] = row
-    # preserve request order
+        # preserve request order
         return [results[tablets[0].normalize_key(k)] for k in keys]
 
     # --------------------------------------------------------------------- query
 
     def select_rows(self, query: str,
-                    timestamp: int = MAX_TIMESTAMP) -> list[dict]:
-        """Distributed QL over static and mounted dynamic tables.
+                    timestamp: int = MAX_TIMESTAMP,
+                    timeout: Optional[float] = None,
+                    pool: Optional[str] = None) -> list[dict]:
+        """Distributed QL over static and mounted dynamic tables, routed
+        through the cluster's QueryGateway (query/serving.py): admission
+        against the per-pool concurrency slots (overflow raises
+        ThrottledError with a retry_after hint) and a deadline
+        (`timeout` seconds, default ServingConfig.default_timeout)
+        cooperatively checked between shard programs.
 
         Per-query statistics land in `self.last_query_statistics` (ref
         TQueryStatistics) and in the structured Query log."""
+        gateway = self.cluster.gateway
+        if not gateway.enabled:
+            return self._select_rows_impl(query, timestamp, None)
+        return gateway.run_select(
+            lambda token: self._select_rows_impl(query, timestamp,
+                                                 token),
+            pool=pool, timeout=timeout)
+
+    def _select_rows_system(self, query: str,
+                            timestamp: int = MAX_TIMESTAMP) -> list[dict]:
+        """System-plane select: NO admission, NO deadline.  For internal
+        metadata/bookkeeping reads (sequoia resolution, secondary-index
+        maintenance, queue offsets) that must not queue behind — or
+        nest inside — user admission: a write transaction must not fail
+        because the read pool is saturated, and a lookup issued while
+        the caller already holds an admission slot would deadlock a
+        saturated pool."""
+        return self._select_rows_impl(query, timestamp, None)
+
+    def _select_rows_impl(self, query: str, timestamp: int,
+                          token) -> list[dict]:
         import logging as _logging
 
         from ytsaurus_tpu.query.statistics import QueryStatistics
@@ -1191,6 +1268,8 @@ class YtClient:
                 pass
         foreign = {}
         for join in plan.joins:
+            if token is not None:
+                token.check()
             shards = self._query_shards(join.foreign_table, timestamp)
             foreign[join.foreign_table] = (
                 concat_chunks(shards) if len(shards) > 1 else shards[0])
@@ -1198,7 +1277,10 @@ class YtClient:
                                      evaluator=self.cluster.evaluator,
                                      merge_shards_below=4_000_000,
                                      range_ordered_by=range_ordered_by,
-                                     stats=stats)
+                                     stats=stats, token=token)
+        if self.cluster._gateway is not None:
+            self.cluster.gateway.record_statistics(
+                stats, self.cluster.evaluator.cache_size())
         log_event(get_logger("Query"), _logging.INFO, "select_rows",
                   query=query[:200], **stats.to_dict())
         return out.to_rows()

@@ -297,6 +297,53 @@ class SchedulerConfig(YsonStruct):
     speculative_after = param(5.0, type=float, ge=0.0)
 
 
+class ServingConfig(YsonStruct):
+    """Query serving plane knobs (query/serving.py QueryGateway):
+    admission control (weighted per-pool concurrency slots over a bounded
+    wait queue), deadline propagation, and continuous micro-batching of
+    lookups.  Ref shape: the reference query service's in-flight window
+    + lookup sessions (query_agent/query_service.cpp)."""
+
+    enabled = param(True, type=bool)
+    # Total concurrent query slots, split across pools by weight.
+    slots = param(16, type=int, ge=1)
+    # pool name -> weight; pools not listed here use default_pool's slots.
+    pools = param(default_factory=lambda: {"default": 1.0}, type=dict)
+    default_pool = param("default", type=str)
+    # Admitted-but-waiting requests per pool; overflow => ThrottledError.
+    max_queue = param(128, type=int, ge=0)
+    # Deadline applied when the caller passes none (0 = no deadline).
+    default_timeout = param(30.0, type=float, ge=0.0)
+    # Lookup micro-batching: requests against one (table, timestamp)
+    # coalesce inside this window, up to max_batch_size keys.
+    flush_window_ms = param(2.0, type=float, ge=0.0)
+    max_batch_size = param(1024, type=int, ge=1)
+    # Pow2 floor for the batched chunk probe's key (needle) arrays
+    # (tablet._pad_needles): bounds the spectrum of gather shapes so a
+    # shape-keyed compiled-gather cache stays bounded.
+    min_bucket = param(8, type=int, ge=1)
+    # Parallel per-tablet fan-out width for one batched read.
+    max_tablet_fanout = param(8, type=int, ge=1)
+
+    def postprocess(self):
+        # YSON-loaded maps may carry bytes keys; pool names are strings.
+        self.pools = {
+            (k.decode("utf-8") if isinstance(k, bytes) else k): v
+            for k, v in (self.pools or {}).items()}
+        for name, weight in self.pools.items():
+            if isinstance(weight, bool) or \
+                    not isinstance(weight, (int, float)) or weight < 0:
+                raise YtError(
+                    f"Serving pool {name!r}: weight must be a "
+                    f"non-negative number, got {weight!r}",
+                    code=EErrorCode.InvalidConfig)
+        if self.default_pool not in self.pools:
+            raise YtError(
+                f"Serving default_pool {self.default_pool!r} is not in "
+                f"pools {sorted(self.pools)!r}",
+                code=EErrorCode.InvalidConfig)
+
+
 class DaemonConfig(YsonStruct):
     """Top-level daemon config (`--config file.yson`)."""
 
@@ -306,6 +353,7 @@ class DaemonConfig(YsonStruct):
     chunk_store = param(type=ChunkStoreConfig)
     master = param(type=MasterConfig)
     scheduler = param(type=SchedulerConfig)
+    serving = param(type=ServingConfig)
 
     def postprocess(self):
         if self.role == "node" and self.chunk_store.replication_factor < 1:

@@ -203,7 +203,7 @@ class SequoiaResolver:
                                 (NODES_PATH, ("node_id",)),
                                 (CHILDREN_PATH, ("parent_id",
                                                  "child_key"))):
-            existing = self.client.select_rows(
+            existing = self.client._select_rows_system(
                 f"{', '.join(key_cols)} FROM [{table}]")
             if existing:
                 self.client.delete_rows(
@@ -363,7 +363,7 @@ class SequoiaResolver:
         path = _canon(path)
         if path is None:
             return None
-        (row,) = self.client.lookup_rows(RESOLVE_PATH, [(path,)])
+        (row,) = self.client._lookup_rows_direct(RESOLVE_PATH, [(path,)])
         if row is None:
             return None
         return {"node_id": _text(row["node_id"]),
@@ -375,7 +375,7 @@ class SequoiaResolver:
         return self.resolve(path) is not None
 
     def _node_record(self, node_id: str) -> "Optional[dict]":
-        (row,) = self.client.lookup_rows(NODES_PATH, [(node_id,)])
+        (row,) = self.client._lookup_rows_direct(NODES_PATH, [(node_id,)])
         if row is None:
             return None
         return {"node_type": _text(row["node_type"]),
@@ -384,7 +384,7 @@ class SequoiaResolver:
                 "value": yson_loads(row["value"])}
 
     def _children(self, node_id: str) -> "list[tuple[str, str]]":
-        rows = self.client.select_rows(
+        rows = self.client._select_rows_system(
             f"child_key, child_id FROM [{CHILDREN_PATH}] "
             f"WHERE parent_id = '{_check_id(node_id)}'")
         return sorted((_text(r["child_key"]), _text(r["child_id"]))
@@ -449,11 +449,11 @@ class SequoiaResolver:
         time because both sides coexist."""
         divergent: set = set()
         table_ids: dict[str, str] = {}
-        for row in self.client.select_rows(
+        for row in self.client._select_rows_system(
                 f"path, node_id FROM [{RESOLVE_PATH}]"):
             table_ids[_text(row["path"])] = _text(row["node_id"])
         node_records: dict[str, dict] = {}
-        for row in self.client.select_rows(
+        for row in self.client._select_rows_system(
                 f"node_id, node_type, path, attrs, value "
                 f"FROM [{NODES_PATH}]"):
             node_records[_text(row["node_id"])] = {
@@ -461,7 +461,7 @@ class SequoiaResolver:
                 "path": _text(row["path"]),
                 "attrs": row["attrs"], "value": row["value"]}
         children_rows: dict[str, dict[str, str]] = {}
-        for row in self.client.select_rows(
+        for row in self.client._select_rows_system(
                 f"parent_id, child_key, child_id FROM [{CHILDREN_PATH}]"):
             children_rows.setdefault(_text(row["parent_id"]), {})[
                 _text(row["child_key"])] = _text(row["child_id"])

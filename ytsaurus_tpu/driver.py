@@ -116,14 +116,19 @@ def _registry() -> dict[str, CommandDescriptor]:
                                         update=p.get("update", False))),
         _d("delete_rows", ("path", "keys"), (), True,
            lambda cl, p: cl.delete_rows(p["path"], p["keys"])),
-        _d("lookup_rows", ("path", "keys"), ("column_names", "timestamp"),
-           False,
+        _d("lookup_rows", ("path", "keys"),
+           ("column_names", "timestamp", "timeout", "pool"), False,
            lambda cl, p: cl.lookup_rows(
                p["path"], p["keys"],
                **({"timestamp": p["timestamp"]} if "timestamp" in p else {}),
+               **({"timeout": p["timeout"]} if "timeout" in p else {}),
+               **({"pool": p["pool"]} if "pool" in p else {}),
                column_names=p.get("column_names"))),
-        _d("select_rows", ("query",), (), False,
-           lambda cl, p: cl.select_rows(p["query"])),
+        _d("select_rows", ("query",), ("timeout", "pool"), False,
+           lambda cl, p: cl.select_rows(
+               p["query"],
+               **({"timeout": p["timeout"]} if "timeout" in p else {}),
+               **({"pool": p["pool"]} if "pool" in p else {}))),
         _d("trim_rows", ("path", "trimmed_row_count"), (), True,
            lambda cl, p: cl.trim_rows(p["path"], p["trimmed_row_count"])),
         _d("push_queue", ("path", "rows"), (), True,

@@ -70,6 +70,11 @@ class EErrorCode(enum.IntEnum):
     RpcTimeout = 1903
     PeerUnavailable = 1904
 
+    # Query serving plane (ref: NRpc::EErrorCode::RequestQueueSizeLimit-
+    # Exceeded + the request deadline propagated by TServiceContext).
+    RequestThrottled = 1910
+    DeadlineExceeded = 1911
+
 
 class YtError(Exception):
     """An error with a code, attributes and nested inner errors."""
@@ -129,3 +134,33 @@ class YtError(Exception):
 
 class YtResponseError(YtError):
     """Error returned from a service call."""
+
+
+class ThrottledError(YtError):
+    """Admission rejection from the query serving plane (or any bounded
+    queue): the request was NEVER executed, so resending it — even a
+    mutation — is safe.  Carries a `retry_after` hint (seconds) computed
+    from the rejecting queue's observed drain rate; retry wrappers honor
+    it instead of their generic backoff curve."""
+
+    def __init__(self, message: str = "request throttled",
+                 retry_after: float = 0.1, **kwargs):
+        attributes = dict(kwargs.pop("attributes", None) or {})
+        attributes.setdefault("retry_after", float(retry_after))
+        super().__init__(message, code=EErrorCode.RequestThrottled,
+                         attributes=attributes, **kwargs)
+
+    @property
+    def retry_after(self) -> float:
+        return float(self.attributes.get("retry_after", 0.0))
+
+
+def retry_after_hint(err: YtError) -> "float | None":
+    """The `retry_after` hint carried by a throttled error anywhere in
+    the tree (wire round-trips reconstruct plain YtErrors, so the hint
+    must be read from attributes, not the ThrottledError type)."""
+    throttled = err.find(EErrorCode.RequestThrottled)
+    if throttled is None:
+        return None
+    hint = throttled.attributes.get("retry_after")
+    return float(hint) if hint is not None else None

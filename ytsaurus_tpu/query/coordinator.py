@@ -51,12 +51,17 @@ def _is_transient(err: Exception) -> bool:
         isinstance(err, YtError) and err.code in _TRANSIENT_CODES)
 
 
-def _retry_transient(fn, site: "Optional[failpoints.FailpointSite]" = None):
+def _retry_transient(fn, site: "Optional[failpoints.FailpointSite]" = None,
+                     token=None):
     """Jittered-exponential-backoff retry of transient failures (policy
-    `query_shard` in config.py) around one shard-granular step."""
+    `query_shard` in config.py) around one shard-granular step.  A token
+    past its deadline stops the ladder — retries must not keep a dead
+    query alive past its budget."""
     policy = retry_policy("query_shard")
     for attempt in range(policy.attempts):
         try:
+            if token is not None:
+                token.check()
             if site is not None:
                 site.hit()
             return fn()
@@ -66,12 +71,13 @@ def _retry_transient(fn, site: "Optional[failpoints.FailpointSite]" = None):
             time.sleep(policy.delay(attempt))
 
 
-def _wrap_lazy_shard(shard):
+def _wrap_lazy_shard(shard, token=None):
     """Lazy shards retry their own staging so one transient chunk-read
     failure doesn't sink the whole scan."""
     if not callable(shard):
         return shard
-    return lambda: _retry_transient(shard, site=_FP_MATERIALIZE)
+    return lambda: _retry_transient(shard, site=_FP_MATERIALIZE,
+                                    token=token)
 
 
 def split_plan(plan: ir.Query) -> tuple[ir.Query, ir.FrontQuery]:
@@ -353,7 +359,7 @@ def coordinate_and_execute(
         evaluator: Optional[Evaluator] = None,
         merge_shards_below: int = 0,
         range_ordered_by: Optional[Sequence[str]] = None,
-        stats=None) -> ColumnarChunk:
+        stats=None, token=None) -> ColumnarChunk:
     """Host-coordinated fan-out: run the bottom query per shard (tablet),
     concatenate partial results, run the front merge.
 
@@ -376,14 +382,21 @@ def coordinate_and_execute(
     BY <key prefix> LIMIT scan shards from the matching end and stop
     once offset+limit rows passed the filter — the reference's ordered
     scan with scanOrder (engine_api/coordinator.h:81-90).
+
+    `token` (query/serving.CancellationToken): checked before each
+    shard's staging and execution, so a query past its deadline aborts
+    mid-plan — remaining shards never stage and never launch device
+    programs — instead of running to completion.
     """
     evaluator = evaluator or Evaluator()
     if not chunks:
         raise YtError("coordinate_and_execute: no input shards",
                       code=EErrorCode.QueryExecutionError)
+    if token is not None:
+        token.check()
     lazy = any(callable(c) for c in chunks)
     if lazy:
-        chunks = [_wrap_lazy_shard(c) for c in chunks]
+        chunks = [_wrap_lazy_shard(c, token=token) for c in chunks]
     # Early-exit budget, decided BEFORE any shard coalescing: when a
     # LIMIT scan can stop after the first shard or two, merging every
     # shard into one big program would do strictly more work than the
@@ -427,8 +440,8 @@ def coordinate_and_execute(
             stats.rows_read += chunk.row_count
         result = _retry_transient(
             lambda: evaluator.run_plan(plan, chunk, foreign_chunks,
-                                       stats=stats),
-            site=_FP_EXECUTE)
+                                       stats=stats, token=token),
+            site=_FP_EXECUTE, token=token)
     else:
         bottom, front = split_plan(plan)
         # LIMIT early-exit (ref: pull-model readers stop at the limit,
@@ -466,6 +479,11 @@ def coordinate_and_execute(
             group: list = []
             group_rows = 0
             for i in range(len(scan_chunks)):
+                if token is not None:
+                    # Deadline/cancel gate per shard: an expired query
+                    # stops HERE — unscanned shards are never staged,
+                    # their programs never launch.
+                    token.check()
                 chunk = scanner.get(i)
                 if group_threshold > 0:
                     group.append(chunk)
@@ -480,8 +498,9 @@ def coordinate_and_execute(
                     group, group_rows = [], 0
                 partial = _retry_transient(
                     lambda c=chunk: evaluator.run_plan(
-                        bottom, c, foreign_chunks, stats=stats),
-                    site=_FP_EXECUTE)
+                        bottom, c, foreign_chunks, stats=stats,
+                        token=token),
+                    site=_FP_EXECUTE, token=token)
                 partials.append(partial)
                 collected += partial.row_count
                 if needed is not None and collected >= needed:
@@ -494,7 +513,8 @@ def coordinate_and_execute(
             scanner.close()
         merged = concat_chunks(
             [p.slice_rows(0, p.row_count) for p in partials])
-        result = evaluator.run_plan(front, merged, stats=stats)
+        result = evaluator.run_plan(front, merged, stats=stats,
+                                    token=token)
     if stats is not None:
         stats.rows_written += result.row_count
     return result

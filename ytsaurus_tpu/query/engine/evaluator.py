@@ -39,12 +39,18 @@ class Evaluator:
     def run_plan(self, plan: "ir.Query | ir.FrontQuery",
                  chunk: ColumnarChunk,
                  foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None,
-                 stats: Optional[QueryStatistics] = None
-                 ) -> ColumnarChunk:
-        """Execute a plan over one input chunk (plus join tables)."""
+                 stats: Optional[QueryStatistics] = None,
+                 token=None) -> ColumnarChunk:
+        """Execute a plan over one input chunk (plus join tables).
+
+        `token` (query/serving.CancellationToken) is checked BEFORE any
+        device program launches: a query past its deadline stops here
+        instead of consuming device time on a result nobody will read."""
         import time as _time
 
         from ytsaurus_tpu.utils.tracing import start_span
+        if token is not None:
+            token.check()
         t0 = _time.perf_counter()
         # Span per plan execution, tagged with the plan fingerprint (ref:
         # evaluator.cpp:67-75 annotates spans with query fingerprints);

@@ -1,0 +1,661 @@
+"""Query serving plane: admission control, deadline propagation, and
+continuous micro-batching for lookups and selects (ISSUE 3 tentpole).
+
+Ref shape: the reference serves interactive reads through a dedicated
+query service with bounded in-flight windows and lookup sessions
+(yt/yt/server/node/query_agent/query_service.cpp — TQueryService's
+in-flight budget, TLookupSession batching concurrent reads against one
+tablet).  On the XLA backbone the same idea doubles as inference-style
+continuous batching: concurrent point lookups against one table coalesce
+inside a flush window into one batched, order-preserving tablet read,
+and the batched chunk probe buckets its key (needle) arrays to powers
+of two, so gather shapes stay a bounded spectrum instead of one per
+batch size — the bounded-shape discipline that keeps a JIT engine's
+program cache from exploding (selects get the same guarantee from the
+evaluator's capacity-bucketed compile cache) ("An Empirical Analysis of
+Just-in-Time Compilation in Modern Databases", PAPERS.md).
+
+Three pieces, one facade (`QueryGateway`, one per YtCluster):
+
+  AdmissionController   per-pool weighted concurrency slots over a
+                        bounded wait queue; overflow raises
+                        `errors.ThrottledError` carrying a `retry_after`
+                        hint derived from the observed slot drain rate.
+  CancellationToken     deadline + cooperative cancellation, checked in
+                        `coordinator.coordinate_and_execute`'s staging/
+                        execution loop and in the evaluator, so a
+                        timed-out query stops consuming device time
+                        mid-plan instead of running to completion.
+  LookupBatcher         continuous micro-batching of `lookup_rows`:
+                        requests enqueue and a dedicated flusher thread
+                        accumulates each arriving cohort (growth-stable
+                        poll bounded by `flush_window_ms`), then runs
+                        ONE batched read per (table, timestamp) with
+                        parallel per-tablet fan-out, scattering rows
+                        back in each caller's request order.
+
+Serving metrics (queue depth, admitted/rejected/expired, batch size and
+latency histograms) publish through `utils/profiling` under `/serving`,
+so every daemon's monitoring `/metrics` endpoint exports them; the
+`/serving` endpoint serves a structured snapshot.
+
+Failpoint sites: `serving.admit` (admission decision; error mode injects
+a ThrottledError) and `serving.batch_flush` (batched read execution).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional, Sequence
+
+from ytsaurus_tpu.config import ServingConfig
+from ytsaurus_tpu.errors import EErrorCode, ThrottledError, YtError
+from ytsaurus_tpu.utils import failpoints
+from ytsaurus_tpu.utils.profiling import Profiler
+
+_FP_ADMIT = failpoints.register_site(
+    "serving.admit",
+    error=lambda s: ThrottledError(
+        f"injected admission rejection at {s}", retry_after=0.05))
+_FP_BATCH_FLUSH = failpoints.register_site(
+    "serving.batch_flush",
+    error=lambda s: YtError(f"injected batch flush failure at {s}",
+                            code=EErrorCode.TransportError))
+
+# Sub-millisecond latency buckets: point lookups sit well under the
+# profiling default's 1ms floor.
+_LATENCY_BOUNDS = (0.0002, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.025,
+                   0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
+_BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096)
+
+
+class CancellationToken:
+    """Deadline + cooperative cancellation, threaded through execution.
+
+    `check()` is the probe the coordinator/evaluator call between units
+    of work; it raises `DeadlineExceeded` (terminal — never retried) or
+    `Canceled`.  Tokens are cheap and thread-safe; `None` everywhere
+    means "no deadline" so non-gateway callers pay nothing."""
+
+    __slots__ = ("deadline", "pool", "_cancelled", "_reason")
+
+    def __init__(self, deadline: Optional[float] = None,
+                 pool: Optional[str] = None):
+        self.deadline = deadline          # time.monotonic() timestamp
+        self.pool = pool
+        self._cancelled = False
+        self._reason: Optional[str] = None
+
+    @classmethod
+    def with_timeout(cls, timeout: Optional[float],
+                     pool: Optional[str] = None) -> "CancellationToken":
+        deadline = time.monotonic() + timeout \
+            if timeout is not None and timeout > 0 else None
+        return cls(deadline, pool=pool)
+
+    def cancel(self, reason: str = "query cancelled") -> None:
+        self._reason = reason
+        self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and \
+            time.monotonic() >= self.deadline
+
+    def remaining(self) -> Optional[float]:
+        """Seconds until the deadline (>= 0), or None without one."""
+        if self.deadline is None:
+            return None
+        return max(self.deadline - time.monotonic(), 0.0)
+
+    def check(self) -> None:
+        if self._cancelled:
+            raise YtError(self._reason or "query cancelled",
+                          code=EErrorCode.Canceled,
+                          attributes={"pool": self.pool}
+                          if self.pool else {})
+        if self.expired:
+            raise YtError(
+                "query deadline exceeded",
+                code=EErrorCode.DeadlineExceeded,
+                attributes={"pool": self.pool} if self.pool else {})
+
+
+class _PoolState:
+    # Plain-int tallies back the per-gateway snapshot (the profiler
+    # counters are PROCESS-wide: every gateway shares one registry
+    # sensor per (name, pool) tag, which is right for /metrics but
+    # wrong for one gateway's view).
+    __slots__ = ("name", "slots", "in_flight", "waiting",
+                 "admitted_n", "rejected_n", "expired_n",
+                 "admitted", "rejected", "expired",
+                 "queue_gauge", "in_flight_gauge", "wait_hist")
+
+    def __init__(self, name: str, slots: int, profiler: Profiler):
+        self.name = name
+        self.slots = slots
+        self.in_flight = 0
+        self.waiting = 0
+        self.admitted_n = 0
+        self.rejected_n = 0
+        self.expired_n = 0
+        prof = profiler.with_tags(pool=name)
+        self.admitted = prof.counter("admitted")
+        self.rejected = prof.counter("rejected")
+        self.expired = prof.counter("expired")
+        self.queue_gauge = prof.gauge("queue_depth")
+        self.in_flight_gauge = prof.gauge("in_flight")
+        self.wait_hist = prof.histogram("admission_wait_seconds",
+                                        bounds=_LATENCY_BOUNDS)
+
+
+class AdmissionController:
+    """Weighted per-pool concurrency slots with a bounded wait queue.
+
+    Total `slots` split across pools proportionally to weight (every
+    pool keeps at least one).  A request whose pool is saturated waits
+    on the shared condition until a slot frees or its deadline lapses;
+    once `max_queue` requests are already waiting the request is
+    rejected immediately with a `retry_after` hint estimated from the
+    EWMA slot hold time and the backlog ahead of it."""
+
+    def __init__(self, config: ServingConfig):
+        self.config = config
+        self._cond = threading.Condition()
+        profiler = Profiler("/serving/admission")
+        pools = config.pools or {config.default_pool: 1.0}
+        total_weight = sum(w for w in pools.values()) or 1.0
+        self._pools: dict[str, _PoolState] = {}
+        for name, weight in pools.items():
+            slots = max(1, round(config.slots * float(weight)
+                                 / total_weight))
+            self._pools[name] = _PoolState(name, slots, profiler)
+        # EWMA of slot hold time, seeded pessimistically; feeds the
+        # retry_after hint so clients back off proportionally to the
+        # actual drain rate instead of a blind constant.
+        self._hold_ewma = 0.05
+
+    def _resolve(self, pool: Optional[str]) -> _PoolState:
+        return self._pools.get(pool or self.config.default_pool) or \
+            self._pools[self.config.default_pool]
+
+    def _retry_after(self, state: _PoolState) -> float:
+        backlog = state.waiting + state.in_flight
+        hint = self._hold_ewma * max(backlog, 1) / max(state.slots, 1)
+        return round(min(max(hint, 0.01), 5.0), 4)
+
+    def admit(self, token: CancellationToken,
+              pool: Optional[str] = None) -> _PoolState:
+        _FP_ADMIT.hit()
+        t0 = time.monotonic()
+        with self._cond:
+            state = self._resolve(pool)
+            if state.in_flight >= state.slots and \
+                    state.waiting >= self.config.max_queue:
+                state.rejected_n += 1
+                state.rejected.increment()
+                raise ThrottledError(
+                    f"serving pool {state.name!r} is saturated "
+                    f"({state.slots} slots, {state.waiting} queued)",
+                    retry_after=self._retry_after(state),
+                    attributes={"pool": state.name})
+            state.waiting += 1
+            state.queue_gauge.set(state.waiting)
+            try:
+                while state.in_flight >= state.slots:
+                    if not self._cond.wait(timeout=token.remaining()):
+                        # Deadline lapsed while queued: the request
+                        # expires without ever consuming a slot.
+                        state.expired_n += 1
+                        state.expired.increment()
+                        raise YtError(
+                            f"deadline exceeded while queued in serving "
+                            f"pool {state.name!r}",
+                            code=EErrorCode.DeadlineExceeded,
+                            attributes={"pool": state.name})
+                state.in_flight += 1
+            finally:
+                state.waiting -= 1
+                state.queue_gauge.set(state.waiting)
+            state.admitted_n += 1
+            state.admitted.increment()
+            state.in_flight_gauge.set(state.in_flight)
+        state.wait_hist.record(time.monotonic() - t0)
+        return state
+
+    def release(self, state: _PoolState, held_seconds: float) -> None:
+        with self._cond:
+            state.in_flight -= 1
+            state.in_flight_gauge.set(state.in_flight)
+            self._hold_ewma += 0.2 * (held_seconds - self._hold_ewma)
+            # notify_all, NOT notify: the condition is shared by every
+            # pool, and a single notify could wake a waiter of a still-
+            # saturated OTHER pool — it would re-wait, consuming the
+            # wakeup, and this pool's rightful waiter would sleep
+            # through its free slot.
+            self._cond.notify_all()
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {name: {"slots": s.slots, "in_flight": s.in_flight,
+                           "waiting": s.waiting,
+                           "admitted": s.admitted_n,
+                           "rejected": s.rejected_n,
+                           "expired": s.expired_n}
+                    for name, s in sorted(self._pools.items())}
+
+
+class _PathContext:
+    """Cached lookup context for one mounted table: tablet list, key
+    normalization types, and normalized routing pivots — the per-request
+    tree resolve + per-call pivot renormalization of the generic path
+    (client._route_rows) is pure overhead at point-lookup rates.
+    Freshness is an identity check: a remount replaces the cluster's
+    tablet list object, which invalidates the context."""
+
+    __slots__ = ("node_id", "tablets", "schema", "normalize",
+                 "safe_pivots", "has_computed")
+
+    def __init__(self, node_id, tablets, schema):
+        from ytsaurus_tpu.tablet.dynamic_store import _null_safe
+        self.node_id = node_id
+        self.tablets = tablets
+        self.schema = schema
+        # THE key canonicalizer — one implementation (the tablet's,
+        # which caches its key columns) so batched and direct lookups
+        # can never disagree on result-map keys.
+        self.normalize = tablets[0].normalize_key
+        self.has_computed = any(c.expression for c in schema.key_columns)
+        self.safe_pivots = [
+            _null_safe(self.normalize(tuple(t.pivot_key)))
+            for t in tablets[1:]]
+
+    def route(self, nkeys) -> "dict[int, list]":
+        """Normalized keys → owning tablet index (pivot bisect)."""
+        import bisect
+
+        from ytsaurus_tpu.tablet.dynamic_store import _null_safe
+        if not self.safe_pivots:
+            return {0: list(nkeys)}
+        out: dict[int, list] = {}
+        for nk in nkeys:
+            idx = bisect.bisect_right(self.safe_pivots, _null_safe(nk))
+            out.setdefault(idx, []).append(nk)
+        return out
+
+
+class _Batch:
+    """One micro-batch: the key lists of every joined request plus the
+    shared completion state.  Waiters block on `done` and scatter from
+    `results` through their OWN normalized-key order, so one event wakes
+    the whole cohort at once (per-entry futures would wake them one by
+    one).
+
+    `deadline` is the COHORT maximum (None once any member has no
+    deadline): the flush runs on behalf of every member, so one
+    short-deadline caller must not fail co-batched callers with budget
+    left — members whose own deadline lapses time out individually in
+    `lookup()`.  `pool` is the first member's pool (admission is one
+    slot per flush; mixed-pool cohorts charge the pool that opened the
+    batch)."""
+
+    __slots__ = ("key_lists", "deadline", "pool", "client", "created",
+                 "done", "results", "error")
+
+    def __init__(self, token: CancellationToken, client):
+        self.key_lists: list = []       # list[list[nkey]] per request
+        self.deadline = token.deadline
+        self.pool = token.pool
+        self.client = client
+        self.created = time.monotonic()
+        self.done = threading.Event()
+        self.results: dict = {}
+        self.error: Optional[BaseException] = None
+
+    def join(self, token: CancellationToken) -> None:
+        if self.deadline is not None:
+            self.deadline = None if token.deadline is None \
+                else max(self.deadline, token.deadline)
+
+    def flush_token(self) -> CancellationToken:
+        return CancellationToken(self.deadline, pool=self.pool)
+
+
+class LookupBatcher:
+    """Continuous micro-batching of point lookups (lookup sessions).
+
+    Requests enqueue their normalized keys into the pending batch for
+    their (table, timestamp) and block on the batch's completion event;
+    a dedicated FLUSHER thread per gateway drains pending batches in a
+    loop: it waits for work, lets the arriving cohort accumulate until
+    the batch stops growing across one poll (bounded by
+    `flush_window_ms`), then takes every pending batch and executes
+    each as ONE admitted, batched read — keys deduplicated, padded to a
+    power-of-two bucket, fanned out per tablet in parallel — and wakes
+    the whole cohort with one event.  The explicit accumulation matters
+    under the GIL: compute-bound requests barely overlap on their own,
+    so without it every request would flush alone and amortize nothing.
+    `max_batch_size` caps the keys per tablet read (bigger unions are
+    read in slices inside the same flush).
+
+    Responses are never lost, duplicated, or misordered regardless of
+    how requests interleave: a batch resolves exactly once (rows or the
+    flush's error) and each caller scatters from the shared result map
+    through its OWN request-order key list."""
+
+    # Growth-stability poll while a cohort accumulates; the sleep is
+    # the yield that lets cohort threads actually enqueue.
+    _POLL_SECONDS = 0.0002
+
+    def __init__(self, config: ServingConfig, admission:
+                 AdmissionController, executor: ThreadPoolExecutor):
+        self.config = config
+        self.admission = admission
+        self._executor = executor
+        # Flushes run on their own small pool, SEPARATE from the
+        # per-tablet read executor: flushes submit reads to `executor`
+        # and wait, so sharing one pool could fill every worker with
+        # flushes waiting on reads that can never start.
+        self._flush_executor = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="serving-flush")
+        self._cond = threading.Condition()
+        self._batches: "dict[tuple, _Batch]" = {}
+        self._contexts: dict[str, _PathContext] = {}
+        self._flusher: Optional[threading.Thread] = None
+        # Instance tallies for snapshot(); profiler counters mirror
+        # them process-wide for /metrics.
+        self.requests_n = 0
+        self.batches_n = 0
+        self.batched_keys_n = 0
+        prof = Profiler("/serving/lookup")
+        self.requests = prof.counter("requests")
+        self.batches = prof.counter("batches")
+        self.batched_keys = prof.counter("batched_keys")
+        self.batch_size_hist = prof.histogram("batch_size",
+                                              bounds=_BATCH_BOUNDS)
+        self.latency_hist = prof.histogram("latency_seconds",
+                                           bounds=_LATENCY_BOUNDS)
+
+    def _context(self, client, path: str) -> _PathContext:
+        ctx = self._contexts.get(path)
+        if ctx is not None and \
+                client.cluster.tablets.get(ctx.node_id) is ctx.tablets:
+            return ctx
+        tablets = client._mounted_tablets(path)
+        client._require_sorted(tablets[0], path)
+        node = client._table_node(path)
+        ctx = _PathContext(node.id, tablets, tablets[0].schema)
+        for tablet in tablets:
+            # Shape-bucketing floor for the tablets' batched chunk
+            # probes (tablet._pad_needles pow2 buckets).
+            tablet.probe_bucket_min = self.config.min_bucket
+        if len(self._contexts) > 256:
+            self._contexts.clear()
+        self._contexts[path] = ctx
+        return ctx
+
+    def lookup(self, client, path: str, keys: Sequence[tuple],
+               timestamp: int, column_names, token: CancellationToken,
+               pool: Optional[str] = None):
+        t0 = time.monotonic()
+        self.requests_n += 1
+        self.requests.increment()
+        ctx = self._context(client, path)
+        if ctx.has_computed:
+            keys = client._fill_computed_keys(
+                ctx.schema, [tuple(k) for k in keys])
+        nkeys = [ctx.normalize(tuple(k)) for k in keys]
+        bkey = (path, timestamp)
+        with self._cond:
+            batch = self._batches.get(bkey)
+            if batch is None:
+                batch = self._batches[bkey] = _Batch(token, client)
+            else:
+                batch.join(token)
+            batch.key_lists.append(nkeys)
+            if self._flusher is None or not self._flusher.is_alive():
+                self._flusher = threading.Thread(
+                    target=self._flusher_loop, daemon=True,
+                    name="serving-flusher")
+                self._flusher.start()
+            self._cond.notify()
+        if not batch.done.wait(timeout=token.remaining()):
+            raise YtError(
+                "deadline exceeded waiting for the lookup batch",
+                code=EErrorCode.DeadlineExceeded,
+                attributes={"table": path})
+        if batch.error is not None:
+            raise batch.error
+        results = batch.results
+        out = []
+        for nk in nkeys:
+            row = results.get(nk)
+            if row is not None:
+                # Copy per caller: one merged row may serve several
+                # concurrent requests, and callers may mutate.
+                row = {name: row.get(name) for name in column_names} \
+                    if column_names is not None else dict(row)
+            out.append(row)
+        self.latency_hist.record(time.monotonic() - t0)
+        return out
+
+    # -- the flusher thread ----------------------------------------------------
+
+    # Idle flusher threads exit (lookup() restarts them on demand) so
+    # processes juggling many short-lived clusters don't accumulate
+    # parked threads.
+    _IDLE_EXIT_SECONDS = 30.0
+
+    def _flusher_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._batches:
+                    if not self._cond.wait(
+                            timeout=self._IDLE_EXIT_SECONDS) \
+                            and not self._batches:
+                        self._flusher = None
+                        return
+            self._accumulate()
+            with self._cond:
+                taken, self._batches = self._batches, {}
+            for (path, timestamp), batch in taken.items():
+                # Dispatch, don't run inline: a flush can park inside
+                # admission when its pool is saturated, and an inline
+                # flush would head-of-line-block every other table's
+                # batches behind it.  (_flush relays any failure —
+                # including InjectedCrash — to its cohort itself.)
+                self._flush_executor.submit(self._flush, path,
+                                            timestamp, batch)
+
+    def _accumulate(self) -> None:
+        """Let the arriving cohort join: poll until no pending batch
+        grew across one interval, capped by flush_window_ms (and cut
+        short once any batch holds max_batch_size keys)."""
+        window = self.config.flush_window_ms / 1000.0
+        if window <= 0:
+            return
+        deadline = time.monotonic() + window
+        prev = -1
+        while time.monotonic() < deadline:
+            with self._cond:
+                n = sum(len(b.key_lists) for b in self._batches.values())
+                full = any(
+                    sum(len(ks) for ks in b.key_lists) >=
+                    self.config.max_batch_size
+                    for b in self._batches.values())
+            if n == prev or full:
+                return
+            prev = n
+            time.sleep(self._POLL_SECONDS)
+
+    # -- batch execution -------------------------------------------------------
+
+    def _flush(self, path, timestamp, batch: _Batch) -> None:
+        token = batch.flush_token()      # cohort-max deadline
+        try:
+            state = self.admission.admit(token, batch.pool)
+        except BaseException as exc:
+            self._fail(batch, exc)
+            return
+        t0 = time.monotonic()
+        try:
+            _FP_BATCH_FLUSH.hit()
+            token.check()
+            client = batch.client
+            ctx = self._context(client, path)
+            # Union of the batch's keys, deduplicated (two callers
+            # asking for the same row share one read); normalized keys
+            # ARE canonical keys, so they feed the tablets directly.
+            union = dict.fromkeys(
+                nk for ks in batch.key_lists for nk in ks)
+            self.batches_n += 1
+            self.batched_keys_n += len(union)
+            self.batches.increment()
+            self.batched_keys.increment(len(union))
+            self.batch_size_hist.record(len(union))
+            results: dict[tuple, Optional[dict]] = {}
+            items = list(ctx.route(union).items())
+            if len(items) > 1 and len(union) >= 32:
+                # Parallel per-tablet fan-out (the sequential per-tablet
+                # loop was the pre-gateway bottleneck, client.py:1136);
+                # small batches stay inline — dispatch overhead would
+                # exceed the read.
+                futures = [
+                    self._executor.submit(self._read_tablet,
+                                          ctx.tablets, idx, part,
+                                          timestamp)
+                    for idx, part in items]
+                for fut in futures:
+                    results.update(fut.result())
+            else:
+                for idx, part in items:
+                    results.update(self._read_tablet(
+                        ctx.tablets, idx, part, timestamp))
+            batch.results = results
+            batch.done.set()
+        except BaseException as exc:  # noqa: BLE001 — relayed to waiters
+            self._fail(batch, exc)
+            if not isinstance(exc, Exception):
+                raise      # InjectedCrash still pierces this flush
+        finally:
+            self.admission.release(state, time.monotonic() - t0)
+
+    def _read_tablet(self, tablets, idx: int, part: list,
+                     timestamp: int) -> dict:
+        """One tablet's slice of the batch, capped at max_batch_size
+        keys per read; the tablet's batched chunk probe buckets its
+        needle shapes to powers of two (min_bucket)."""
+        out: dict = {}
+        cap = self.config.max_batch_size
+        for lo in range(0, len(part), cap):
+            piece = part[lo:lo + cap]
+            rows = tablets[idx].lookup_rows(piece, timestamp=timestamp,
+                                            normalized=True)
+            out.update(zip(piece, rows))
+        return out
+
+    @staticmethod
+    def _fail(batch: _Batch, exc: BaseException) -> None:
+        batch.error = exc
+        batch.done.set()
+
+    def snapshot(self) -> dict:
+        return {"requests": self.requests_n,
+                "batches": self.batches_n,
+                "batched_keys": self.batched_keys_n}
+
+# Live gateways of this process (the monitoring /serving endpoint).
+_GATEWAYS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class QueryGateway:
+    """The serving-plane facade every query entry point routes through.
+
+    `run_select(fn)` admits, mints a CancellationToken, calls
+    `fn(token)`, and releases; `lookup_rows(...)` goes through the
+    micro-batcher (which admits per batch flush).  One gateway per
+    YtCluster so concurrent clients of one cluster share slots and
+    coalesce lookups."""
+
+    def __init__(self, config: Optional[ServingConfig] = None):
+        self.config = config or ServingConfig()
+        self.admission = AdmissionController(self.config)
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(self.config.max_tablet_fanout, 1),
+            thread_name_prefix="serving")
+        self.batcher = LookupBatcher(self.config, self.admission,
+                                     self._executor)
+        prof = Profiler("/serving")
+        self.select_latency = prof.histogram("select_latency_seconds",
+                                             bounds=_LATENCY_BOUNDS)
+        self._stat_profiler = Profiler("/serving/query_stats")
+        self._cache_gauge = prof.gauge("evaluator_cache_size")
+        _GATEWAYS.add(self)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.config.enabled)
+
+    def make_token(self, timeout: Optional[float],
+                   pool: Optional[str] = None) -> CancellationToken:
+        if timeout is None:
+            timeout = self.config.default_timeout or None
+        return CancellationToken.with_timeout(
+            timeout, pool=pool or self.config.default_pool)
+
+    # -- selects ---------------------------------------------------------------
+
+    def run_select(self, fn: Callable[[Optional[CancellationToken]],
+                                      object],
+                   pool: Optional[str] = None,
+                   timeout: Optional[float] = None):
+        if not self.enabled:
+            return fn(None)
+        token = self.make_token(timeout, pool)
+        state = self.admission.admit(token, pool)
+        t0 = time.monotonic()
+        try:
+            return fn(token)
+        finally:
+            held = time.monotonic() - t0
+            self.admission.release(state, held)
+            self.select_latency.record(held)
+
+    # -- lookups ---------------------------------------------------------------
+
+    def lookup_rows(self, client, path: str, keys: Sequence[tuple],
+                    timestamp: int, column_names=None,
+                    pool: Optional[str] = None,
+                    timeout: Optional[float] = None):
+        token = self.make_token(timeout, pool)
+        return self.batcher.lookup(client, path, keys, timestamp,
+                                   column_names, token, pool=pool)
+
+    # -- observability ---------------------------------------------------------
+
+    def record_statistics(self, stats,
+                          cache_size: Optional[int] = None) -> None:
+        """Fold one query's TQueryStatistics into the cumulative serving
+        counters (`serving_query_stats_* ` on /metrics)."""
+        for field, value in stats.to_dict().items():
+            if value:
+                self._stat_profiler.counter(field).increment(value)
+        if cache_size is not None:
+            self._cache_gauge.set(cache_size)
+
+    def snapshot(self) -> dict:
+        return {"enabled": self.enabled,
+                "pools": self.admission.snapshot(),
+                "lookup": self.batcher.snapshot()}
+
+
+def serving_snapshot() -> list:
+    """Snapshots of every live gateway in this process (monitoring)."""
+    return [g.snapshot() for g in list(_GATEWAYS)]

@@ -279,16 +279,32 @@ class RemoteYtClient:
 
     def lookup_rows(self, path: str, keys: Sequence[tuple],
                     timestamp: int = MAX_TIMESTAMP,
-                    column_names: Optional[Sequence[str]] = None):
+                    column_names: Optional[Sequence[str]] = None,
+                    timeout: Optional[float] = None,
+                    pool: Optional[str] = None):
+        """Server-side lookups go through the primary's QueryGateway:
+        a throttled request comes back as a RequestThrottled-coded error
+        whose retry_after hint the RetryingChannel honors; a
+        DeadlineExceeded answer is terminal (never retried)."""
         params: dict = {"path": path, "keys": [list(k) for k in keys]}
         if timestamp != MAX_TIMESTAMP:
             params["timestamp"] = timestamp
         if column_names is not None:
             params["column_names"] = list(column_names)
+        if timeout is not None:
+            params["timeout"] = timeout
+        if pool is not None:
+            params["pool"] = pool
         return self._execute("lookup_rows", params)
 
-    def select_rows(self, query: str) -> list[dict]:
-        return self._execute("select_rows", {"query": query})
+    def select_rows(self, query: str, timeout: Optional[float] = None,
+                    pool: Optional[str] = None) -> list[dict]:
+        params: dict = {"query": query}
+        if timeout is not None:
+            params["timeout"] = timeout
+        if pool is not None:
+            params["pool"] = pool
+        return self._execute("select_rows", params)
 
     def push_queue(self, path: str, rows: Sequence[dict]) -> int:
         return int(self._execute(

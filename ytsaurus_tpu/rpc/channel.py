@@ -227,7 +227,14 @@ class RetryingChannel:
     Attempts/backoff default to the process-wide retry policy
     (`config.retry_policy(policy)`) instead of per-call-site constants;
     backoff is exponential with a cap and decorrelating jitter
-    (RetryPolicyConfig.delay)."""
+    (RetryPolicyConfig.delay).
+
+    Serving-plane codes (ISSUE 3 satellite): RequestThrottled is
+    retried even for non-idempotent calls — admission rejection means
+    the request was NEVER executed — and the wait honors the error's
+    `retry_after` hint instead of the generic backoff curve.
+    DeadlineExceeded is TERMINAL: the deadline belongs to the caller's
+    query, and a retry could not possibly land inside it."""
 
     def __init__(self, channel: Channel, attempts: int | None = None,
                  backoff: float | None = None, policy: str = "rpc"):
@@ -254,18 +261,29 @@ class RetryingChannel:
     def call(self, service: str, method: str, body=None,
              attachments=(), timeout: float | None = None,
              idempotent: bool = True):
+        from ytsaurus_tpu.errors import retry_after_hint
         last: YtError | None = None
         for attempt in range(self._policy.attempts):
             try:
                 return self.channel.call(service, method, body,
                                          attachments, timeout)
             except YtError as err:
+                if err.contains(EErrorCode.DeadlineExceeded):
+                    # Terminal: the caller's query deadline already
+                    # passed on the server; a retry cannot beat it.
+                    raise
+                throttled = err.code == EErrorCode.RequestThrottled or \
+                    err.contains(EErrorCode.RequestThrottled)
                 # Neither a timeout NOR a dropped connection proves
                 # non-execution (the mutation may have run on a dying
                 # peer): a non-idempotent call is resent only when the
                 # transport failure happened before dispatch (connect
-                # refused — the request never left this process).
-                if idempotent:
+                # refused — the request never left this process).  A
+                # THROTTLE is always safe to resend: admission rejected
+                # the request before anything executed.
+                if throttled:
+                    retryable = True
+                elif idempotent:
                     retryable = err.code in (EErrorCode.TransportError,
                                              EErrorCode.RpcTimeout)
                 else:
@@ -276,7 +294,10 @@ class RetryingChannel:
                 if attempt + 1 < self._policy.attempts:
                     # No sleep after the FINAL attempt: the failure is
                     # already decided, the caller shouldn't wait for it.
-                    time.sleep(self._policy.delay(attempt))
+                    hint = retry_after_hint(err) if throttled else None
+                    time.sleep(min(hint, self._policy.backoff_cap)
+                               if hint is not None
+                               else self._policy.delay(attempt))
         raise YtError(
             f"RPC to {self.channel.address} failed after "
             f"{self._policy.attempts} attempts",

@@ -21,7 +21,7 @@ import uuid
 import weakref
 from typing import Optional
 
-from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.errors import EErrorCode, ThrottledError, YtError
 from ytsaurus_tpu.rpc import Service, rpc_method
 from ytsaurus_tpu.rpc.wire import wire_text as _text
 from ytsaurus_tpu.utils.logging import get_logger
@@ -29,6 +29,12 @@ from ytsaurus_tpu.utils.logging import get_logger
 logger = get_logger("exec_node")
 
 STDERR_TAIL_BYTES = 16 << 10
+# Jobs admitted but not yet holding a slot, per slot.  Past this the node
+# answers RequestThrottled with a retry_after hint instead of queueing
+# unboundedly — the scheduler's RetryingChannel honors the hint, and a
+# persistent throttle surfaces as a dispatch failure the job quarantine
+# can requeue elsewhere (serving-plane admission, ISSUE 3).
+MAX_PENDING_PER_SLOT = 4
 RESULT_TTL_SECONDS = 600.0
 # Once the stdout blob has been handed to a poll, it is kept only this
 # long (a lost poll RESPONSE can still be re-polled within the grace);
@@ -62,6 +68,8 @@ class ExecNodeService(Service):
         self._by_key: dict[str, str] = {}     # dedup: job_key -> job_id
         self._lock = threading.Lock()
         self._started_total = 0
+        self._throttled_total = 0
+        self._pending = 0          # admitted jobs not yet holding a slot
         # Timer-driven sweep: a burst of large-output jobs followed by
         # idle time must not pin the blobs until the next start_job.
         # The thread holds only a weakref (a dropped service instance
@@ -117,7 +125,17 @@ class ExecNodeService(Service):
                 existing = self._by_key.get(job_key)
                 if existing is not None and existing in self._jobs:
                     return {"job_id": existing}
+            if self._pending >= self.slots * MAX_PENDING_PER_SLOT:
+                self._throttled_total += 1
+                raise ThrottledError(
+                    f"exec node job queue full ({self._pending} pending "
+                    f"over {self.slots} slots)",
+                    retry_after=round(min(max(
+                        0.1 * self._pending / max(self.slots, 1), 0.05),
+                        5.0), 3))
+            if job_key:
                 self._by_key[job_key] = job_id
+            self._pending += 1
             self._jobs[job_id] = entry
             self._started_total += 1
         thread = threading.Thread(
@@ -161,7 +179,9 @@ class ExecNodeService(Service):
             running = sum(1 for e in self._jobs.values()
                           if e["state"] == "running")
             return {"slots": self.slots, "running": running,
-                    "started_total": self._started_total}
+                    "pending": self._pending,
+                    "started_total": self._started_total,
+                    "throttled_total": self._throttled_total}
 
     # -- execution -------------------------------------------------------------
 
@@ -209,6 +229,8 @@ class ExecNodeService(Service):
              input_blob: Optional[bytes]) -> None:
         import os
         with self._sem:
+            with self._lock:
+                self._pending -= 1      # holding a slot now, not queued
             try:
                 if entry["aborted"]:
                     raise YtError("job aborted before start",

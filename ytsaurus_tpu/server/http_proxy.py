@@ -158,6 +158,14 @@ class HttpProxy:
         user = request.headers.get("X-YT-User", "root")
         params, data_body = self._parse_parameters(request, parsed,
                                                    raw_body)
+        # Serving-plane deadline: X-YT-Timeout (seconds) maps onto the
+        # query gateway's deadline for lookup/select commands.
+        header_timeout = request.headers.get("X-YT-Timeout")
+        if header_timeout and command in ("select_rows", "lookup_rows"):
+            try:
+                params.setdefault("timeout", float(header_timeout))
+            except ValueError:
+                pass
         try:
             result = self._execute(command, params, data_body, user)
         except YtError as err:
@@ -225,9 +233,20 @@ class HttpProxy:
 
     def _reply_error(self, request, err: YtError,
                      status: int = 400) -> None:
+        from ytsaurus_tpu.errors import retry_after_hint
+        retry_after = None
+        if err.contains(EErrorCode.RequestThrottled):
+            # Admission rejection → 429 + Retry-After, the HTTP shape of
+            # the serving plane's retry_after hint.
+            status = 429
+            retry_after = retry_after_hint(err)
+        elif err.contains(EErrorCode.DeadlineExceeded):
+            status = 504
         body = json.dumps(err.to_dict(), default=_json_default).encode()
         request.send_response(status)
         request.send_header("Content-Type", "application/json")
+        if retry_after is not None:
+            request.send_header("Retry-After", f"{retry_after:.3f}")
         request.send_header("X-YT-Error", json.dumps(
             {"code": err.code, "message": err.message},
             default=_json_default)[:1024])

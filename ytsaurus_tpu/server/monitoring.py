@@ -83,6 +83,14 @@ class MonitoringServer:
                 "sites": failpoints.counters(),
             }, indent=2).encode()
             self._reply(request, 200, body, "application/json")
+        elif path == "/serving":
+            # Query serving plane (query/serving.py): per-pool admission
+            # state + lookup batching counters of every live gateway in
+            # this process (histograms export via /metrics serving_*).
+            from ytsaurus_tpu.query.serving import serving_snapshot
+            body = json.dumps({"gateways": serving_snapshot()},
+                              indent=2).encode()
+            self._reply(request, 200, body, "application/json")
         elif path in ("/metrics", "/solomon"):
             body = self.registry.render_prometheus().encode()
             self._reply(request, 200, body, "text/plain; version=0.0.4")

@@ -41,8 +41,10 @@ def is_consumer_schema(schema: TableSchema) -> bool:
 
 def _consumer_offset(client, consumer_path: str, queue_path: str,
                      partition_index: int = 0) -> int:
-    rows = client.lookup_rows(consumer_path,
-                              [(queue_path, partition_index)])
+    # System path: consumer-offset bookkeeping must not queue behind
+    # user read admission.
+    rows = client._lookup_rows_direct(consumer_path,
+                                      [(queue_path, partition_index)])
     return int(rows[0]["offset"]) if rows[0] is not None else 0
 
 

@@ -101,7 +101,7 @@ def create_secondary_index(client, table_path: str, index_path: str,
     # Backfill from the current committed state.
     key_names = schema.key_column_names
     desc = {"columns": columns, "path": index_path}
-    existing = client.select_rows(
+    existing = client._select_rows_system(
         ", ".join(c.name for c in schema) + f" FROM [{table_path}]")
     if existing:
         client.insert_rows(index_path, [
@@ -169,7 +169,9 @@ def finalize_index_mutations(client, txm, tx) -> None:
                      for r in new_rows]
         keys = [k for k, _ in items]
         need_committed = [k for k in keys if norm(k) not in per_path]
-        committed = client.lookup_rows(path, need_committed) \
+        # System path: this runs on the WRITE commit path and must not
+        # queue behind (or deadlock inside) user read admission.
+        committed = client._lookup_rows_direct(path, need_committed) \
             if need_committed else []
         for k, row in zip(need_committed, committed):
             per_path[norm(k)] = (k, row, row)
@@ -280,7 +282,10 @@ def fetch_via_index(client, table_path: str, schema, desc: dict,
     # The index table is keyed by the indexed columns, so the bound lands
     # on its key prefix (range pruning); the caller's plan re-applies the
     # full WHERE over the fetched rows.
-    index_rows = client.select_rows(
+    # System path: fetch_via_index runs INSIDE an already-admitted
+    # select — re-entering admission here could deadlock a saturated
+    # pool (every slot holder waiting for one more slot).
+    index_rows = client._select_rows_system(
         f"{index_cols} FROM [{desc['path']}] WHERE {predicate}",
         timestamp=timestamp)
     # Dedup: duplicated index entries (or several matching index rows per
@@ -289,5 +294,6 @@ def fetch_via_index(client, table_path: str, schema, desc: dict,
         tuple(r[n] for n in key_names) for r in index_rows))
     if not keys:
         return []
-    rows = client.lookup_rows(table_path, keys, timestamp=timestamp)
+    rows = client._lookup_rows_direct(table_path, keys,
+                                      timestamp=timestamp)
     return [r for r in rows if r is not None]

@@ -63,6 +63,9 @@ class Tablet:
             raise YtError("Dynamic tables require a sorted schema",
                           code=EErrorCode.TabletNotMounted)
         self.schema = schema
+        # Cached: schema.key_columns is a rebuilding property, and
+        # normalize_key sits on the per-key serving hot path.
+        self._key_columns = schema.key_columns
         self.tablet_id = tablet_id
         self.pivot_key = pivot_key
         self.chunk_store = chunk_store
@@ -82,6 +85,9 @@ class Tablet:
         self.row_cache_capacity = 4096
         self.row_cache_hits = 0
         self.row_cache_misses = 0
+        # Pow2 floor for batched-probe needle buckets (_pad_needles);
+        # the serving gateway overrides it from ServingConfig.min_bucket.
+        self.probe_bucket_min = 8
 
     # -- write path (called under the transaction manager) ---------------------
 
@@ -98,7 +104,7 @@ class Tablet:
         return out
 
     def normalize_key(self, key: tuple) -> tuple:
-        key_cols = self.schema.key_columns
+        key_cols = self._key_columns
         if len(key) != len(key_cols):
             raise YtError(f"Key width {len(key)} != {len(key_cols)}")
         return tuple(_normalize_value(v, c.type)
@@ -278,13 +284,25 @@ class Tablet:
 
     def lookup_rows(self, keys: Sequence[tuple],
                     timestamp: int = MAX_TIMESTAMP,
-                    column_names: Optional[Sequence[str]] = None
-                    ) -> list[Optional[dict]]:
-        """Point reads at a timestamp (ref tablet_node/lookup.cpp)."""
+                    column_names: Optional[Sequence[str]] = None,
+                    normalized: bool = False) -> list[Optional[dict]]:
+        """Point reads at a timestamp (ref tablet_node/lookup.cpp).
+
+        normalized=True: the caller already holds canonical keys
+        (normalize_key output) — the serving-plane batcher normalizes
+        once per request and must not pay it again per batch.
+
+        Batched chunk probe: keys missing the row cache are matched
+        against each versioned chunk in ONE vectorized pass (np.isin
+        over the key planes) instead of one full-plane mask per key —
+        the per-chunk cost drops from O(rows x keys) to O(rows +
+        matches), which is what makes the serving plane's micro-batches
+        pay off (ref tablet_node/lookup.cpp batched lookup sessions)."""
         with self._lock:
             key_names = self.schema.key_column_names
             out: list[Optional[dict]] = []
-            keys = [self.normalize_key(tuple(k)) for k in keys]
+            if not normalized:
+                keys = [self.normalize_key(tuple(k)) for k in keys]
             # The cache only serves latest-timestamp reads and resets when
             # any store or chunk set changes.
             generation = (self.active_store.store_row_count,
@@ -293,6 +311,19 @@ class Tablet:
             if self._row_cache_gen != generation:
                 self._row_cache.clear()
                 self._row_cache_gen = generation
+            misses = dict.fromkeys(
+                k for k in keys
+                if not (cacheable and k in self._row_cache))
+            chunk_rows: "Optional[dict[tuple, list[dict]]]" = None
+            if len(misses) >= 4 and self.chunk_ids:
+                chunk_rows = {}
+                miss_list = list(misses)
+                for cid in self.chunk_ids:
+                    for key, rows in _chunk_batch_key_rows(
+                            self._decode(cid), self.schema, miss_list,
+                            self._chunk_host_planes(cid),
+                            bucket_min=self.probe_bucket_min).items():
+                        chunk_rows.setdefault(key, []).extend(rows)
             for key in keys:
                 if cacheable and key in self._row_cache:
                     self.row_cache_hits += 1
@@ -305,10 +336,20 @@ class Tablet:
                     versions: list[tuple[int, Optional[dict]]] = []
                     for store in [self.active_store] + self.passive_stores:
                         versions.extend(store.lookup_versions(key))
-                    for cid in self.chunk_ids:
-                        versions.extend(_chunk_lookup_versions(
-                            self._decode(cid), self.schema, key,
-                            self._chunk_host_planes(cid)))
+                    if chunk_rows is not None and key in misses:
+                        # The batch probe is authoritative ONLY for the
+                        # keys it covered: a key that was a cache HIT at
+                        # call start can be evicted by THIS loop's own
+                        # insertions and reach here unprobed — treating
+                        # its absence from chunk_rows as "no versions"
+                        # would return (and cache) a wrong None.
+                        versions.extend(_versions_from_chunk_rows(
+                            chunk_rows.get(key, ()), self.schema))
+                    else:
+                        for cid in self.chunk_ids:
+                            versions.extend(_chunk_lookup_versions(
+                                self._decode(cid), self.schema, key,
+                                self._chunk_host_planes(cid)))
                     merged = _merge_versions(versions, timestamp)
                     if merged is None:
                         row = None
@@ -482,10 +523,9 @@ def _merge_versions(versions: list[tuple[int, Optional[dict]]],
     return merged
 
 
-def _chunk_lookup_versions(chunk: ColumnarChunk, schema: TableSchema,
-                           key: tuple, host_planes: dict
-                           ) -> list[tuple[int, Optional[dict]]]:
-    rows = _chunk_key_rows(chunk, schema, key, host_planes)
+def _versions_from_chunk_rows(rows, schema: TableSchema
+                              ) -> list[tuple[int, Optional[dict]]]:
+    """Versioned chunk rows of one key → (timestamp, state) pairs."""
     out = []
     value_names = [c.name for c in schema if c.sort_order is None]
     for row in rows:
@@ -498,6 +538,13 @@ def _chunk_lookup_versions(chunk: ColumnarChunk, schema: TableSchema,
                         {name: row.get(name) for name in value_names
                          if _written(row, name)}))
     return out
+
+
+def _chunk_lookup_versions(chunk: ColumnarChunk, schema: TableSchema,
+                           key: tuple, host_planes: dict
+                           ) -> list[tuple[int, Optional[dict]]]:
+    return _versions_from_chunk_rows(
+        _chunk_key_rows(chunk, schema, key, host_planes), schema)
 
 
 def _chunk_last_timestamp(chunk: ColumnarChunk, schema: TableSchema,
@@ -537,7 +584,13 @@ def _chunk_key_rows(chunk: ColumnarChunk, schema: TableSchema,
         if not mask.any():
             return []
     idx = np.nonzero(mask)[0]
-    # Decode only the matched rows (idx is usually tiny vs n).
+    return _decode_chunk_rows(chunk, host_planes, idx)
+
+
+def _decode_chunk_rows(chunk: ColumnarChunk, host_planes: dict,
+                       idx) -> list[dict]:
+    """Decode only the rows at `idx` (usually tiny vs the chunk)."""
+    n = chunk.row_count
     rows = []
     cols = {name: chunk.columns[name] for name in chunk.schema.column_names}
     host = host_planes
@@ -559,3 +612,79 @@ def _chunk_key_rows(chunk: ColumnarChunk, schema: TableSchema,
                 row[name] = int(data[i])
         rows.append(row)
     return rows
+
+
+def _pad_needles(values: list, bucket_min: int) -> list:
+    """Pad a probe (needle) array to the next power-of-two bucket by
+    repeating the last element (duplicate needles don't change an isin
+    mask).  Bucketing bounds the SPECTRUM of probe shapes to O(log
+    max_batch) variants — the discipline that keeps a shape-keyed
+    compiled-gather cache bounded when this probe lowers to a device
+    gather (and what the serving plane's micro-batches rely on)."""
+    n = len(values)
+    cap = max(1, bucket_min)
+    while cap < n:
+        cap <<= 1
+    if cap == n:
+        return values
+    return values + [values[-1]] * (cap - n)
+
+
+def _chunk_batch_key_rows(chunk: ColumnarChunk, schema: TableSchema,
+                          keys: "list[tuple]", host_planes: dict,
+                          bucket_min: int = 8
+                          ) -> "dict[tuple, list[dict]]":
+    """Rows matching ANY of `keys`, grouped by exact key — ONE vectorized
+    pass over the key planes for the whole batch (np.isin over a
+    pow2-bucketed needle array), instead of one full-plane mask per key
+    (`_chunk_key_rows`).  For multi-column keys the per-column
+    membership intersection is a SUPERSET (cross products); the exact
+    grouping below discards false positives after decoding only the
+    candidate rows."""
+    n = chunk.row_count
+    if n == 0 or not keys:
+        return {}
+    key_names = schema.key_column_names
+    mask = np.ones(n, dtype=bool)
+    for ci, name in enumerate(key_names):
+        col = chunk.columns[name]
+        data, valid = host_planes[name]
+        values = {k[ci] for k in keys}
+        has_null = None in values
+        values.discard(None)
+        if col.type is EValueType.string:
+            codes = []
+            if col.dictionary is not None and len(col.dictionary) \
+                    and values:
+                targets = sorted(
+                    v if isinstance(v, bytes) else str(v).encode()
+                    for v in values)
+                pos = np.searchsorted(col.dictionary, targets)
+                for t, i in zip(targets, pos):
+                    if i < len(col.dictionary) and \
+                            col.dictionary[i] == t:
+                        codes.append(i)
+            col_mask = (valid & np.isin(data, np.asarray(
+                _pad_needles(codes, bucket_min), dtype=data.dtype))) \
+                if codes else np.zeros(n, dtype=bool)
+        elif values:
+            col_mask = valid & np.isin(
+                data, np.asarray(_pad_needles(sorted(values),
+                                              bucket_min),
+                                 dtype=data.dtype))
+        else:
+            col_mask = np.zeros(n, dtype=bool)
+        if has_null:
+            col_mask = col_mask | ~valid
+        mask &= col_mask
+        if not mask.any():
+            return {}
+    idx = np.nonzero(mask)[0]
+    out: "dict[tuple, list[dict]]" = {}
+    for row in _decode_chunk_rows(chunk, host_planes, idx):
+        key = tuple(row[name] for name in key_names)
+        if key in out:
+            out[key].append(row)
+        else:
+            out[key] = [row]
+    return out
