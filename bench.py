@@ -19,6 +19,10 @@ config, printing the headline (TPC-H Q1, config 1) last:
           (continuous micro-batching, ISSUE 3) vs the pre-gateway
           sequential path; metric is the batched throughput, the
           speedup + p99s print on stderr
+  scan    versioned MVCC snapshot read over a multi-chunk tablet with
+          version churn (ISSUE 4): warm snapshot-cache select path is
+          the metric; cold vectorized + pre-PR Python reference merge
+          timings and speedups print on stderr
   all     run every config, one JSON line each (headline line printed last)
 
 Row counts are scaled to the ACTUAL platform after backend probing: a CPU
@@ -413,6 +417,100 @@ def bench_serving(n_rows, iters):
     return "serving_lookup_rows_per_sec", best_tput, best_elapsed
 
 
+def bench_scan(n_rows, iters):
+    """Versioned MVCC read path (ISSUE 4): snapshot reads over a tablet
+    with three flushed version generations (overwrites, deletes, partial
+    writes) plus live store churn.  The emitted metric is the WARM
+    snapshot-cache path (repeated selects at the current timestamp);
+    the cold vectorized merge and the retained pre-PR Python reference
+    merge print on stderr with speedups.  n_rows sizes the key space;
+    total versions ≈ 1.55×."""
+    import tempfile
+
+    import numpy as np
+
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.schema import TableSchema
+    from ytsaurus_tpu.tablet.tablet import Tablet, versioned_schema
+
+    schema = TableSchema.make([("k", "int64", "ascending"),
+                               ("g", "int64"), ("v", "int64")],
+                              unique_keys=True)
+    tablet = Tablet(schema, FsChunkStore(
+        tempfile.mkdtemp(prefix="bench-scan-")))
+    vschema = versioned_schema(schema)
+    rng = np.random.default_rng(7)
+
+    def publish(arrays, valids):
+        chunk = ColumnarChunk.from_arrays(vschema, arrays, valids=valids)
+        tablet.chunk_ids.append(tablet.chunk_store.write_chunk(chunk))
+
+    n = n_rows
+    keys0 = np.arange(n, dtype=np.int64)
+    ones = np.ones(n, dtype=bool)
+    publish({"k": keys0, "$timestamp": np.full(n, 100, np.int64),
+             "$tombstone": np.zeros(n, dtype=bool),
+             "g": keys0 % 1000, "$w:g": ones,
+             "v": keys0 * 3, "$w:v": ones},
+            valids={})
+    # Generation 2: a third of the keys overwritten, a fifth of THOSE
+    # deleted (tombstones bound the merge for their keys).
+    m1 = max(n // 3, 1)
+    k1 = np.sort(rng.choice(n, size=m1, replace=False)).astype(np.int64)
+    tomb = np.zeros(m1, dtype=bool)
+    tomb[:: 5] = True
+    publish({"k": k1, "$timestamp": np.full(m1, 200, np.int64),
+             "$tombstone": tomb,
+             "g": k1 % 500, "$w:g": ~tomb,
+             "v": k1 * 7, "$w:v": ~tomb},
+            valids={"g": ~tomb, "v": ~tomb})
+    # Generation 3: partial writes — only `v` stated, `g` merges from
+    # older generations per column.
+    m2 = max(n // 5, 1)
+    k2 = np.sort(rng.choice(n, size=m2, replace=False)).astype(np.int64)
+    publish({"k": k2, "$timestamp": np.full(m2, 300, np.int64),
+             "$tombstone": np.zeros(m2, dtype=bool),
+             "g": np.zeros(m2, np.int64),
+             "$w:g": np.zeros(m2, dtype=bool),
+             "v": k2 * 11, "$w:v": np.ones(m2, dtype=bool)},
+            valids={"g": np.zeros(m2, dtype=bool)})
+    # Live store churn on top of the sealed chunks.
+    for i in range(1024):
+        tablet.write_row({"k": int(n + i), "g": i, "v": i}, timestamp=400)
+
+    t0 = time.perf_counter()
+    ref = tablet.read_snapshot_reference()
+    ref_time = time.perf_counter() - t0
+    versions = n + m1 + m2 + 1024
+
+    def timed_read(invalidate):
+        times = []
+        while _iters_left(times, iters):
+            if invalidate:
+                tablet._snapshot_cache = None
+            t0 = time.perf_counter()
+            out = tablet.read_snapshot()
+            _sync(out.columns["k"].data)
+            times.append(time.perf_counter() - t0)
+        return min(times), out
+
+    cold_time, out = timed_read(invalidate=True)
+    assert out.row_count == ref.row_count, (out.row_count, ref.row_count)
+    tablet.read_snapshot()                        # prime the cache
+    warm_time, _ = timed_read(invalidate=False)
+    ref_rps = versions / ref_time
+    print(f"# scan: warm cache {versions / warm_time:.0f} rows/s "
+          f"({warm_time * 1e3:.2f}ms), cold vectorized "
+          f"{versions / cold_time:.0f} rows/s ({cold_time * 1e3:.1f}ms), "
+          f"reference {ref_rps:.0f} rows/s ({ref_time * 1e3:.0f}ms); "
+          f"warm {ref_time / warm_time:.0f}x, cold "
+          f"{ref_time / cold_time:.1f}x vs pre-PR merge "
+          f"({versions} versions, {out.row_count} visible)",
+          file=sys.stderr)
+    return "scan_rows_per_sec", versions / warm_time, warm_time
+
+
 # config -> (fn, default rows on an accelerator, default rows on CPU)
 _CONFIGS = {
     "q1": (bench_q1, 64_000_000, 2_000_000),
@@ -424,6 +522,7 @@ _CONFIGS = {
     "window": (bench_window, 2_000_000, 500_000),
     "select": (bench_select, 16_000_000, 1_000_000),
     "serving": (bench_serving, 200_000, 100_000),
+    "scan": (bench_scan, 500_000, 100_000),
 }
 
 
@@ -538,6 +637,7 @@ _METRIC_NAMES = {
     "window": "window_rows_per_sec",
     "select": "select_rows_per_sec",
     "serving": "serving_lookup_rows_per_sec",
+    "scan": "scan_rows_per_sec",
 }
 
 
@@ -587,7 +687,7 @@ def main():
 
     config = args.config
     names = ("groupby", "topk", "q3", "sort", "strings", "window",
-             "select", "serving", "q1") \
+             "select", "serving", "scan", "q1") \
         if config == "all" else (config,)
 
     def _emit_fallback(name):
@@ -609,6 +709,12 @@ def main():
         for name in names:
             _emit_fallback(name)
         return
+    # Cache the probe verdict for the WHOLE bench invocation: every
+    # spawned config child inherits it (ensure_backend honors the env)
+    # instead of re-probing — a dead tunnel costs one fallback window
+    # total, not one per config family (BENCH_r05 probe-hang log).
+    os.environ["YT_TPU_PROBE_VERDICT"] = \
+        "cpu" if platform == "cpu" else "accel"
     if config == "all":
         _run_all(names, args, platform, _emit_fallback)
         return

@@ -1,0 +1,396 @@
+"""Vectorized MVCC read pipeline (ISSUE 4): property tests proving the
+columnar merge/flush/compaction are row-exact against the retained
+Python reference implementations, plus the snapshot cache, the
+host-plane LRU, chunk-meta pruning stats, and the coordinator's
+single-sync shard fan-out.
+"""
+
+import random
+import tempfile
+
+import pytest
+
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.chunks.store import FsChunkStore
+from ytsaurus_tpu.config import TabletConfig, set_tablet_config
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.tablet.tablet import (
+    Tablet,
+    _drop_superseded,
+    _versioned_sort_key,
+    _written,
+)
+from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+
+
+@pytest.fixture(autouse=True)
+def _force_vectorized():
+    """Route every MVCC merge through the columnar pipeline (the
+    dispatch threshold would keep tiny test tablets on the Python
+    path), restoring defaults afterwards."""
+    set_tablet_config(TabletConfig(vectorized_scan_min_rows=0))
+    yield
+    set_tablet_config(None)
+
+
+SCHEMAS = {
+    "int_key": TableSchema.make([
+        ("k", "int64", "ascending"), ("a", "int64"), ("b", "string"),
+        ("c", "double")]),
+    "multi_key": TableSchema.make([
+        ("k1", "int64", "ascending"), ("k2", "string", "ascending"),
+        ("x", "int64"), ("y", "boolean")]),
+}
+
+
+def _tablet(schema) -> Tablet:
+    return Tablet(schema, FsChunkStore(tempfile.mkdtemp(prefix="mvcc-")))
+
+
+def _random_value(rng, col):
+    if rng.random() < 0.2:
+        return None
+    ty = col.type.value
+    if ty == "int64":
+        return rng.randrange(-50, 50)
+    if ty == "string":
+        return rng.choice(["", "a", "bb", "zz", "édgé"])
+    if ty == "double":
+        return rng.choice([-1.5, 0.0, 2.25, 1e6])
+    if ty == "boolean":
+        return rng.random() < 0.5
+    raise AssertionError(ty)
+
+
+def _random_key(rng, schema):
+    key = []
+    for col in schema.key_columns:
+        if col.type.value == "int64":
+            key.append(rng.randrange(6) if rng.random() > 0.1 else None)
+        else:
+            key.append(rng.choice([b"p", b"q", None]))
+    return tuple(key)
+
+
+def _apply_workload(t, schema, rng, n_ops=120, allow_duplicates=True):
+    """Random writes/partial writes/deletes with interleaved flushes.
+    Timestamps mostly advance but REUSE an old (key, ts) once it is
+    sealed in a chunk (duplicate-timestamp versions across sources);
+    within one store they stay unique (the flush invariant).
+    allow_duplicates=False for compaction workloads: merging every
+    chunk into one surfaces cross-chunk duplicates to the
+    versioned_rows invariant, in both implementations."""
+    ts = 10
+    key_names = schema.key_column_names
+    value_cols = [c for c in schema if c.sort_order is None]
+    store_seen: set = set()
+    flushed: list = []      # (key, ts) sealed in chunks
+    for _ in range(n_ops):
+        key = _random_key(rng, schema)
+        ts += rng.randrange(1, 4)
+        use_ts = ts
+        if allow_duplicates and flushed and rng.random() < 0.15:
+            # Duplicate timestamp for a key whose twin is already sealed.
+            key, use_ts = rng.choice(flushed)
+        if (key, use_ts) in store_seen:
+            use_ts = ts
+        if (key, use_ts) in store_seen:
+            continue
+        store_seen.add((key, use_ts))
+        roll = rng.random()
+        if roll < 0.2:
+            t.delete_row(key, timestamp=use_ts)
+        else:
+            row = dict(zip(key_names, key))
+            update = roll < 0.5
+            cols = value_cols if not update else \
+                rng.sample(value_cols, rng.randrange(1, len(value_cols) + 1))
+            for col in cols:
+                row[col.name] = _random_value(rng, col)
+            t.write_row(row, timestamp=use_ts, update=update)
+        if rng.random() < 0.12:
+            t.flush()
+            flushed.extend(store_seen)
+            store_seen.clear()
+    return ts
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("schema_name", sorted(SCHEMAS))
+def test_vectorized_select_matches_reference(schema_name, seed):
+    schema = SCHEMAS[schema_name]
+    rng = random.Random(1000 * seed + hash(schema_name) % 97)
+    t = _tablet(schema)
+    max_ts = _apply_workload(t, schema, rng)
+    read_points = [5, max_ts // 3, max_ts // 2, max_ts - 1, max_ts,
+                   MAX_TIMESTAMP]
+    for ts in read_points:
+        ref = t.read_snapshot_reference(ts).to_rows()
+        vec = t.read_snapshot(ts).to_rows()
+        assert vec == ref, f"ts={ts} seed={seed}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_vectorized_flush_matches_reference(seed):
+    schema = SCHEMAS["int_key"]
+    rng = random.Random(7000 + seed)
+    t = _tablet(schema)
+    _apply_workload(t, schema, rng, n_ops=60)
+    # Expected flush output: ALL store rows (rotation folds the active
+    # store in) under the Python sort oracle.
+    rows = []
+    for store in t.passive_stores + [t.active_store]:
+        rows.extend(store.versioned_rows())
+    rows.sort(key=_versioned_sort_key(schema))
+    cid = t.flush()
+    if not rows:
+        assert cid is None
+        return
+    got = t.chunk_store.read_chunk(cid).to_rows()
+    assert got == rows
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("cut", ["low", "mid", "high"])
+def test_vectorized_compaction_matches_reference(seed, cut):
+    schema = SCHEMAS["int_key"]
+    rng = random.Random(9000 + seed)
+    t = _tablet(schema)
+    max_ts = _apply_workload(t, schema, rng, n_ops=80,
+                             allow_duplicates=False)
+    t.flush()
+    retention = {"low": 5, "mid": max_ts // 2, "high": max_ts + 10}[cut]
+    value_names = [c.name for c in schema if c.sort_order is None]
+    rows = []
+    for cid in t.chunk_ids:
+        for row in t.chunk_store.read_chunk(cid).to_rows():
+            for name in value_names:
+                row[f"$w:{name}"] = _written(row, name)
+            rows.append(row)
+    rows.sort(key=_versioned_sort_key(schema))
+    expected = _drop_superseded(rows, schema, retention)
+    new_id = t.compact(retention_timestamp=retention)
+    if not expected:
+        assert new_id is None and t.chunk_ids == []
+        return
+    got = t.chunk_store.read_chunk(new_id).to_rows()
+    assert got == expected
+    # And the post-compaction visible state still matches the oracle.
+    assert t.read_snapshot().to_rows() == \
+        t.read_snapshot_reference().to_rows()
+
+
+def test_duplicate_timestamp_across_chunk_and_store():
+    """The same (key, ts) sealed in a chunk AND rewritten in the store:
+    source concatenation order (chunks first) breaks the tie, in both
+    implementations."""
+    schema = SCHEMAS["int_key"]
+    t = _tablet(schema)
+    t.write_row({"k": 1, "a": 1, "b": "chunk", "c": 0.5}, timestamp=100)
+    t.flush()
+    t.write_row({"k": 1, "a": 2, "b": "store", "c": 0.5}, timestamp=100)
+    assert t.read_snapshot().to_rows() == \
+        t.read_snapshot_reference().to_rows()
+
+
+def test_select_path_performs_zero_to_rows(monkeypatch):
+    """Regression guard: the vectorized select path must never fall back
+    to row materialization — no chunk.to_rows() anywhere under
+    read_snapshot."""
+    schema = SCHEMAS["int_key"]
+    t = _tablet(schema)
+    for i in range(30):
+        t.write_row({"k": i % 7, "a": i, "b": f"v{i}", "c": i / 2},
+                    timestamp=10 + i)
+    t.flush()
+    t.write_row({"k": 3, "a": 99}, timestamp=100, update=True)
+    t.delete_row((5,), timestamp=101)
+
+    def _boom(self):
+        raise AssertionError("to_rows() on the select path")
+    monkeypatch.setattr(ColumnarChunk, "to_rows", _boom)
+    out = t.read_snapshot()
+    assert out.row_count > 0
+    out = t.read_snapshot(timestamp=50)      # historical reads too
+    assert out.row_count > 0
+
+
+# --- snapshot cache -----------------------------------------------------------
+
+
+def test_snapshot_cache_hit_and_invalidation():
+    from ytsaurus_tpu.tablet import tablet as tablet_mod
+    schema = SCHEMAS["int_key"]
+    t = _tablet(schema)
+    for i in range(10):
+        t.write_row({"k": i, "a": i, "b": "x", "c": 0.0}, timestamp=10 + i)
+    t.flush()
+    hits0 = tablet_mod._SNAP_HITS.get()
+    c1 = t.read_snapshot()
+    c2 = t.read_snapshot()
+    assert c2 is c1                     # memoized chunk object
+    assert tablet_mod._SNAP_HITS.get() == hits0 + 1
+    # A timestamp at/above the newest committed version is latest-class
+    # and shares the cached snapshot (pinned "now" timestamps hit).
+    assert t.read_snapshot(timestamp=10_000) is c1
+    # Historical reads below the newest version bypass the cache.
+    assert t.read_snapshot(timestamp=12) is not c1
+    # Write → invalidated.
+    t.write_row({"k": 99, "a": 1, "b": "y", "c": 1.0}, timestamp=200)
+    c3 = t.read_snapshot()
+    assert c3 is not c1
+    assert any(r["k"] == 99 for r in c3.to_rows())
+    # Flush → invalidated (generation bump), contents unchanged.
+    t.flush()
+    c4 = t.read_snapshot()
+    assert c4 is not c3 and c4.to_rows() == c3.to_rows()
+    # Compact → invalidated.
+    t.compact()
+    c5 = t.read_snapshot()
+    assert c5 is not c4 and c5.to_rows() == c4.to_rows()
+    stats = tablet_mod.snapshot_cache_stats()
+    assert stats["evictions"] >= 2 and stats["bytes_pinned"] > 0
+
+
+def test_snapshot_cache_disabled_via_config():
+    set_tablet_config(TabletConfig(vectorized_scan_min_rows=0,
+                                   snapshot_cache_enabled=False))
+    t = _tablet(SCHEMAS["int_key"])
+    t.write_row({"k": 1, "a": 1, "b": "x", "c": 0.0}, timestamp=10)
+    assert t.read_snapshot() is not t.read_snapshot()
+
+
+def test_snapshot_cache_on_monitoring_endpoints():
+    import json
+    import urllib.request
+
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    t = _tablet(SCHEMAS["int_key"])
+    t.write_row({"k": 1, "a": 1, "b": "x", "c": 0.0}, timestamp=10)
+    t.read_snapshot()
+    t.read_snapshot()
+    server = MonitoringServer()
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{server.address}/tablet", timeout=5) as resp:
+            snap = json.loads(resp.read())["snapshot_cache"]
+        assert snap["hits"] >= 1 and snap["misses"] >= 1
+        with urllib.request.urlopen(
+                f"http://{server.address}/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+        assert "tablet_snapshot_cache_hits" in body
+        assert "tablet_snapshot_cache_bytes_pinned" in body
+    finally:
+        server.stop()
+
+
+# --- host-plane LRU -----------------------------------------------------------
+
+
+def test_host_planes_lru_promotes_on_hit():
+    set_tablet_config(TabletConfig(host_plane_cache_capacity=2))
+    t = _tablet(SCHEMAS["int_key"])
+    cids = []
+    for i in range(3):
+        t.write_row({"k": i, "a": i, "b": "x", "c": 0.0}, timestamp=10 + i)
+        cids.append(t.flush())
+    t._host_planes.clear()
+    t._chunk_host_planes(cids[0])
+    t._chunk_host_planes(cids[1])
+    t._chunk_host_planes(cids[0])        # promote: [1, 0]
+    t._chunk_host_planes(cids[2])        # evicts 1, NOT the promoted 0
+    assert cids[0] in t._host_planes
+    assert cids[1] not in t._host_planes
+    assert cids[2] in t._host_planes
+
+
+# --- chunk-meta pruning stats ---------------------------------------------
+
+
+def test_stats_sealed_into_chunk_meta(tmp_path):
+    store = FsChunkStore(str(tmp_path))
+    schema = TableSchema.make([("k", "int64"), ("s", "string")])
+    chunk = ColumnarChunk.from_rows(
+        schema, [{"k": 3, "s": "b"}, {"k": -1, "s": "a"},
+                 {"k": 7, "s": None}])
+    cid = store.write_chunk(chunk)
+    meta = store.read_meta(cid)
+    assert meta["column_stats"]["k"] == {"min": -1, "max": 7,
+                                         "has_null": False}
+    stats = store.read_stats(cid)
+    assert stats["k"]["max"] == 7 and stats["$row_count"] == 3
+    assert stats["s"]["has_null"] is True
+
+
+def test_stats_backfill_for_pre_stats_chunks(tmp_path):
+    """Chunks written before stats persisted (no column_stats in meta)
+    decode once and compute host-side."""
+    from ytsaurus_tpu import yson
+    from ytsaurus_tpu.chunks.encoding import (
+        MAGIC,
+        read_chunk_meta,
+        serialize_chunk,
+    )
+    from ytsaurus_tpu.utils.varint import encode_varint_u
+
+    store = FsChunkStore(str(tmp_path))
+    schema = TableSchema.make([("k", "int64")])
+    chunk = ColumnarChunk.from_rows(schema, [{"k": 5}, {"k": 9}])
+    blob = serialize_chunk(chunk)
+    meta = read_chunk_meta(blob)
+    data_start = meta.pop("_data_start")
+    del meta["column_stats"]
+    meta_blob = yson.dumps(meta, binary=True)
+    legacy = b"".join([MAGIC, encode_varint_u(len(meta_blob)), meta_blob,
+                       blob[data_start:]])
+    cid = store.put_blob("ab" + "0" * 30, legacy)
+    assert store.read_meta(cid).get("column_stats") is None
+    stats = store.read_stats(cid)
+    assert stats["k"] == {"min": 5, "max": 9, "has_null": False}
+    # Memoized: a second read serves from memory.
+    assert store.read_stats(cid) is stats
+
+
+# --- coordinator single-sync fan-out -------------------------------------------
+
+
+def test_deferred_shard_dispatch_matches_sync():
+    from ytsaurus_tpu.chunks.columnar import concat_chunks
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+
+    schema = TableSchema.make([("k", "int64"), ("g", "int64"),
+                               ("v", "int64")])
+    rng = random.Random(3)
+    shards = [ColumnarChunk.from_rows(
+        schema, [{"k": s * 100 + i, "g": rng.randrange(5),
+                  "v": rng.randrange(100)} for i in range(40)])
+        for s in range(5)]
+    plan = build_query(
+        "g, sum(v) AS s, count(*) AS c FROM [//t] WHERE v < 90 GROUP BY g",
+        {"//t": schema})
+    # No LIMIT/early-exit → the deferred path dispatches all five shard
+    # programs before the single synchronization.
+    out = coordinate_and_execute(plan, shards, evaluator=Evaluator())
+    want = Evaluator().run_plan(plan, concat_chunks(shards))
+    key = lambda r: r["g"]
+    assert sorted(out.to_rows(), key=key) == sorted(want.to_rows(), key=key)
+
+
+def test_finish_all_single_transfer():
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator, finish_all
+
+    schema = TableSchema.make([("k", "int64"), ("v", "int64")])
+    chunks = [ColumnarChunk.from_rows(
+        schema, [{"k": i, "v": i * j} for i in range(10)])
+        for j in range(1, 4)]
+    plan = build_query("k, v FROM [//t] WHERE v >= 0", {"//t": schema})
+    ev = Evaluator()
+    pendings = [ev.run_plan_async(plan, c) for c in chunks]
+    results = finish_all(pendings)
+    assert [r.row_count for r in results] == [10, 10, 10]
+    # finish() after finish_all returns the same chunk (idempotent).
+    assert pendings[0].finish() is results[0]

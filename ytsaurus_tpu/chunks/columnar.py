@@ -388,6 +388,45 @@ def unify_dictionaries(columns: Sequence[Column]) -> tuple[list[Column], np.ndar
     return out, merged
 
 
+def chunk_column_stats(chunk: ColumnarChunk) -> dict:
+    """Per-column min/max/has_null pruning statistics (+ `$row_count`).
+
+    THE single implementation: embedded into chunk meta at serialize
+    time (`chunks/encoding.py`), surfaced by `FsChunkStore.read_stats`,
+    and re-exported as `query/pruning.compute_column_stats` for the
+    host-side backfill of chunks written before stats persisted."""
+    out: dict[str, dict] = {}
+    n = chunk.row_count
+    for name, col in chunk.columns.items():
+        if col.type in (EValueType.any, EValueType.null):
+            continue
+        valid = np.asarray(col.valid[:n])
+        entry: dict = {"has_null": bool((~valid).any()) if n else True,
+                       "min": None, "max": None}
+        if n and valid.any():
+            data = np.asarray(col.data[:n])[valid]
+            if col.type is EValueType.string:
+                codes = data
+                entry["min"] = bytes(col.dictionary[int(codes.min())])
+                entry["max"] = bytes(col.dictionary[int(codes.max())])
+            elif col.type is EValueType.boolean:
+                entry["min"] = bool(data.min())
+                entry["max"] = bool(data.max())
+            elif col.type is EValueType.double:
+                entry["min"] = float(data.min())
+                entry["max"] = float(data.max())
+            else:
+                entry["min"] = int(data.min())
+                entry["max"] = int(data.max())
+        out[name] = entry
+    # Not a column: per-chunk row count rides the stats so metadata-only
+    # consumers (chunk merger sizing) never decode the chunk.  "$" can
+    # never collide with a column name, and chunk_may_match looks
+    # columns up by name so it skips this key.
+    out["$row_count"] = n
+    return out
+
+
 def concat_chunks(chunks: Sequence[ColumnarChunk]) -> ColumnarChunk:
     """Concatenate chunks of identical schema into one (device concat + repad)."""
     if not chunks:

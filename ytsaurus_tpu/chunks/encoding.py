@@ -186,6 +186,7 @@ def serialize_chunk(chunk: ColumnarChunk, codec: str = DEFAULT_CODEC,
             "valid": add_block(valid_block),
         })
 
+    from ytsaurus_tpu.chunks.columnar import chunk_column_stats
     meta = {
         # v2: tagged string-vocab entries (inline | hunk ref); v1 readable.
         "format_version": 2,
@@ -193,6 +194,10 @@ def serialize_chunk(chunk: ColumnarChunk, codec: str = DEFAULT_CODEC,
         "row_count": n,
         "schema": chunk.schema.to_dict(),
         "columns": columns_meta,
+        # Per-column min/max/has_null computed ONCE at seal time; scan
+        # pruning and tablet snapshot-cache keying read them from the
+        # meta header (no block decompress, no host recompute).
+        "column_stats": chunk_column_stats(chunk),
     }
     if hunk_chunk_ids:
         meta["hunk_chunk_ids"] = sorted(hunk_chunk_ids)

@@ -221,6 +221,14 @@ class ReplicatedChunkStore:
             chunk_id, lambda s: s.read_meta(chunk_id))
         return meta
 
+    def read_stats(self, chunk_id: str) -> dict:
+        """Seal-time column stats through the replica read ladder (each
+        location's FsChunkStore memoizes, incl. the pre-stats decode
+        backfill)."""
+        _, stats, _ = self._read_with_ladder(
+            chunk_id, lambda s: s.read_stats(chunk_id))
+        return stats
+
     def exists(self, chunk_id: str) -> bool:
         return any(store.exists(chunk_id) for store in self.locations)
 

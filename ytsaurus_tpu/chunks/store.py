@@ -51,11 +51,17 @@ def new_chunk_id() -> str:
 class FsChunkStore:
     """Chunks as files under root/<id[:2]>/<id>.chunk."""
 
+    # Bounded FIFO memo of per-chunk column stats: chunks are immutable,
+    # so an entry never goes stale; removal just leaves a dead key that
+    # ages out.
+    _STATS_MEMO_LIMIT = 4096
+
     def __init__(self, root: str, codec: str = DEFAULT_CODEC):
         self.root = root
         self.codec = codec
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
+        self._stats_memo: "OrderedDict[str, dict]" = OrderedDict()
 
     def _path(self, chunk_id: str) -> str:
         return os.path.join(self.root, chunk_id[:2], f"{chunk_id}.chunk")
@@ -127,6 +133,28 @@ class FsChunkStore:
 
     def read_meta(self, chunk_id: str) -> dict:
         return read_chunk_meta(self._read_blob(chunk_id))
+
+    def read_stats(self, chunk_id: str) -> dict:
+        """Per-column min/max/has_null pruning stats for a chunk.
+
+        Written-at-seal chunks carry them in the meta header (one blob
+        read, no block decompress).  BACKFILL: chunks persisted before
+        stats existed decode once, compute host-side, and memoize — the
+        pre-stats cost paid once per chunk instead of per scan."""
+        with self._lock:
+            stats = self._stats_memo.get(chunk_id)
+            if stats is not None:
+                return stats
+        meta = self.read_meta(chunk_id)
+        stats = meta.get("column_stats")
+        if stats is None:
+            from ytsaurus_tpu.chunks.columnar import chunk_column_stats
+            stats = chunk_column_stats(self.read_chunk(chunk_id))
+        with self._lock:
+            self._stats_memo[chunk_id] = stats
+            while len(self._stats_memo) > self._STATS_MEMO_LIMIT:
+                self._stats_memo.popitem(last=False)
+        return stats
 
     def _read_blob(self, chunk_id: str) -> bytes:
         _FP_READ.hit()

@@ -82,14 +82,15 @@ def publish_table_chunks(client, chunk_store, path, chunks,
     @chunk_stats/@row_count/@sorted_by) — one implementation shared by the
     in-process client and the remote thin client, so tables stay
     cross-readable whichever path wrote them."""
-    from ytsaurus_tpu.query.pruning import compute_column_stats
     chunk_ids = [chunk_store.write_chunk(c) for c in chunks]
     total = sum(c.row_count for c in chunks)
     if schema is not None:
         client.set(path + "/@schema", schema.to_dict())
     client.set(path + "/@chunk_ids", chunk_ids)
+    # Stats were computed ONCE at seal time (chunk meta header); reading
+    # them back is a meta parse, not a host-side min/max recompute.
     client.set(path + "/@chunk_stats",
-               [compute_column_stats(c) for c in chunks])
+               [chunk_store.read_stats(cid) for cid in chunk_ids])
     client.set(path + "/@row_count", total)
     if sorted_by:
         client.set(path + "/@sorted_by", list(sorted_by))
@@ -597,12 +598,12 @@ class YtClient:
             stats.append({})
         row_count = int(node.attributes.get("row_count", 0)) if append else 0
         if rows:
-            from ytsaurus_tpu.query.pruning import compute_column_stats
             chunk = ColumnarChunk.from_rows(table_schema, list(rows))
             self._meter_table(path, node, chunk_delta=1,
                               disk_delta=_chunk_bytes(chunk))
-            chunks.append(self.cluster.chunk_store.write_chunk(chunk))
-            stats.append(compute_column_stats(chunk))
+            cid = self.cluster.chunk_store.write_chunk(chunk)
+            chunks.append(cid)
+            stats.append(self.cluster.chunk_store.read_stats(cid))
             row_count += chunk.row_count
         self.set(path + "/@chunk_ids", chunks)
         self.set(path + "/@chunk_stats", stats)

@@ -259,6 +259,43 @@ def set_retry_policy(name: str, policy: RetryPolicyConfig) -> None:
     _RETRY_POLICIES[name] = policy
 
 
+class TabletConfig(YsonStruct):
+    """Tablet read-path knobs (tablet/tablet.py):
+
+    - `host_plane_cache_capacity`: entries in the per-tablet LRU of
+      host-side numpy plane views (promote-on-hit; the lookup probe's
+      device→host staging cache).
+    - `snapshot_cache_enabled`: memoize the materialized visible chunk
+      per (flush generation, store mutation count) for latest-timestamp
+      reads; invalidated by any write/flush/compact.
+    - `vectorized_scan_min_rows`: version count at/above which the MVCC
+      merge (read_snapshot/flush/compact) runs as the columnar XLA
+      pipeline; below it the Python reference merge wins (per-program
+      dispatch overhead dominates tiny stores — the same dispatch
+      economics as coordinator shard coalescing).  0 forces the
+      vectorized path always (parity tests use this)."""
+
+    host_plane_cache_capacity = param(64, type=int, ge=1)
+    snapshot_cache_enabled = param(True, type=bool)
+    vectorized_scan_min_rows = param(1024, type=int, ge=0)
+
+
+_TABLET_CONFIG: "Optional[TabletConfig]" = None
+
+
+def tablet_config() -> TabletConfig:
+    global _TABLET_CONFIG
+    if _TABLET_CONFIG is None:
+        _TABLET_CONFIG = TabletConfig()
+    return _TABLET_CONFIG
+
+
+def set_tablet_config(config: "Optional[TabletConfig]") -> None:
+    """Install a process-wide tablet config (None restores defaults)."""
+    global _TABLET_CONFIG
+    _TABLET_CONFIG = config
+
+
 class FailpointsConfig(YsonStruct):
     """Deterministic fault-injection schedule (utils/failpoints.py):
     `spec` uses the YT_FAILPOINTS syntax, `seed` fixes p-based rolls.
@@ -354,6 +391,7 @@ class DaemonConfig(YsonStruct):
     master = param(type=MasterConfig)
     scheduler = param(type=SchedulerConfig)
     serving = param(type=ServingConfig)
+    tablet = param(type=TabletConfig)
 
     def postprocess(self):
         if self.role == "node" and self.chunk_store.replication_factor < 1:

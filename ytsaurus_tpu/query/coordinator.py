@@ -19,7 +19,7 @@ from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
 from ytsaurus_tpu.config import retry_policy
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.query import ir
-from ytsaurus_tpu.query.engine.evaluator import Evaluator
+from ytsaurus_tpu.query.engine.evaluator import Evaluator, finish_all
 from ytsaurus_tpu.schema import EValueType
 from ytsaurus_tpu.utils import failpoints
 
@@ -473,6 +473,13 @@ def coordinate_and_execute(
             scan_chunks,
             window=1 if needed is not None else 2,
             stats=stats, count_rows=lazy)
+        # With no early exit, the per-shard row count never gates control
+        # flow — so shard programs DISPATCH without synchronizing (the
+        # round-5 hot spot: one blocking int(count) host read per shard
+        # serialized the whole fan-out) and the counts cross the host
+        # boundary once, after every program is enqueued.  Early-exit
+        # scans keep the per-shard sync: the count IS the exit signal.
+        deferred = needed is None and hasattr(evaluator, "run_plan_async")
         partials = []
         try:
             collected = 0
@@ -496,6 +503,14 @@ def coordinate_and_execute(
                     chunk = concat_chunks(group) if len(group) > 1 \
                         else group[0]
                     group, group_rows = [], 0
+                if deferred:
+                    partials.append(_retry_transient(
+                        lambda c=chunk: evaluator.run_plan_async(
+                            bottom, c, foreign_chunks, stats=stats,
+                            token=token),
+                        site=_FP_EXECUTE, token=token))
+                    scanner.feedback()
+                    continue
                 partial = _retry_transient(
                     lambda c=chunk: evaluator.run_plan(
                         bottom, c, foreign_chunks, stats=stats,
@@ -511,6 +526,8 @@ def coordinate_and_execute(
                 scanner.feedback()
         finally:
             scanner.close()
+        if deferred:
+            partials = finish_all(partials)
         merged = concat_chunks(
             [p.slice_rows(0, p.row_count) for p in partials])
         result = evaluator.run_plan(front, merged, stats=stats,

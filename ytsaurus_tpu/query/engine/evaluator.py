@@ -24,6 +24,70 @@ from ytsaurus_tpu.query.statistics import QueryStatistics
 from ytsaurus_tpu.schema import EValueType, TableSchema
 
 
+class _PendingResult:
+    """A DISPATCHED (not yet synchronized) plan execution: the output
+    planes and the device-resident row count.  `finish()` performs the
+    one device→host sync (`int(count)`) and wraps the chunk — callers
+    fanning out over many shards dispatch every program first and
+    synchronize once (`finish_all`), instead of stalling the dispatch
+    queue on a host read per shard."""
+
+    __slots__ = ("planes", "count", "output", "stats", "_t0", "_chunk")
+
+    def __init__(self, planes, count, output, stats=None, t0=None):
+        self.planes = planes
+        self.count = count
+        self.output = output
+        self.stats = stats
+        self._t0 = t0
+        self._chunk: Optional[ColumnarChunk] = None
+
+    def finish(self, host_count: Optional[int] = None) -> ColumnarChunk:
+        import time as _time
+        if self._chunk is None:
+            n = int(self.count if host_count is None else host_count)
+            out_columns: dict[str, Column] = {}
+            out_schema_cols = []
+            for out_col, (data, valid) in zip(self.output, self.planes):
+                out_schema_cols.append((out_col.name, out_col.type.value))
+                out_columns[out_col.name] = Column(
+                    type=out_col.type, data=data, valid=valid,
+                    dictionary=out_col.vocab)
+            out_schema = TableSchema.make(out_schema_cols)
+            self._chunk = ColumnarChunk(schema=out_schema, row_count=n,
+                                        columns=out_columns)
+            if self.stats is not None and self._t0 is not None:
+                self.stats.execute_time += _time.perf_counter() - self._t0
+        return self._chunk
+
+
+class _ReadyResult:
+    """Already-materialized result (totals plans sync internally)."""
+
+    __slots__ = ("_chunk",)
+    count = None
+
+    def __init__(self, chunk: ColumnarChunk):
+        self._chunk = chunk
+
+    def finish(self, host_count: Optional[int] = None) -> ColumnarChunk:
+        return self._chunk
+
+
+def finish_all(pendings: Sequence) -> list[ColumnarChunk]:
+    """Synchronize a batch of dispatched plans with ONE host transfer:
+    the per-shard row counts cross device→host as a single stacked
+    array instead of one blocking read per shard."""
+    import jax.numpy as jnp
+    open_ = [p for p in pendings
+             if isinstance(p, _PendingResult) and p._chunk is None]
+    host: dict[int, int] = {}
+    if len(open_) > 1:
+        counts = np.asarray(jnp.stack([p.count for p in open_]))
+        host = {id(p): int(c) for p, c in zip(open_, counts)}
+    return [p.finish(host_count=host.get(id(p))) for p in pendings]
+
+
 class Evaluator:
     """Caches compiled query programs and executes plans over chunks."""
 
@@ -46,6 +110,18 @@ class Evaluator:
         `token` (query/serving.CancellationToken) is checked BEFORE any
         device program launches: a query past its deadline stops here
         instead of consuming device time on a result nobody will read."""
+        return self.run_plan_async(plan, chunk, foreign_chunks, stats,
+                                   token).finish()
+
+    def run_plan_async(self, plan: "ir.Query | ir.FrontQuery",
+                       chunk: ColumnarChunk,
+                       foreign_chunks: Optional[Mapping[str, ColumnarChunk]] = None,
+                       stats: Optional[QueryStatistics] = None,
+                       token=None):
+        """Dispatch a plan's device program WITHOUT synchronizing;
+        returns a pending handle whose `.finish()` yields the chunk.
+        The coordinator's shard fan-out uses this to enqueue every
+        shard's program before the first host sync."""
         import time as _time
 
         from ytsaurus_tpu.utils.tracing import start_span
@@ -59,10 +135,10 @@ class Evaluator:
         span = start_span("Evaluator.run_plan", fingerprint=fp,
                           rows=chunk.row_count)
         with span:
-            return self._run_plan_traced(plan, chunk, foreign_chunks,
+            return self._dispatch_traced(plan, chunk, foreign_chunks,
                                          stats, t0, fp)
 
-    def _run_plan_traced(self, plan, chunk, foreign_chunks, stats, t0,
+    def _dispatch_traced(self, plan, chunk, foreign_chunks, stats, t0,
                          fp=None):
         import time as _time
         if isinstance(plan, ir.Query) and plan.joins:
@@ -85,23 +161,29 @@ class Evaluator:
         elif isinstance(plan, ir.Query):
             chunk = _project_chunk(chunk, plan.schema)
 
-        result = self._execute(plan, chunk, stats, fp=fp)
-
         # GROUP BY ... WITH TOTALS: one extra grand-total row (null keys)
         # aggregated over the same filtered input, appended after the groups
         # (ref: totals handling in GroupOpHelper/GroupTotalsOpHelper,
         # cg_routines/registry.cpp:1920; totals_mode=before_having).
+        # The concat needs both row counts, so totals plans materialize
+        # eagerly.
         if plan.group is not None and plan.group.totals:
+            result = self._dispatch(plan, chunk, stats, fp=fp).finish()
             totals_plan = _make_totals_plan(plan)
-            totals = self._execute(totals_plan, chunk, stats)
+            totals = self._dispatch(totals_plan, chunk, stats).finish()
             result = concat_chunks([result, totals])
-        if stats is not None:
-            stats.execute_time += _time.perf_counter() - t0
-        return result
+            if stats is not None:
+                stats.execute_time += _time.perf_counter() - t0
+            return _ReadyResult(result)
 
-    def _execute(self, plan, chunk: ColumnarChunk,
-                 stats: Optional[QueryStatistics] = None,
-                 fp: Optional[str] = None) -> ColumnarChunk:
+        pending = self._dispatch(plan, chunk, stats, fp=fp)
+        pending.stats = stats
+        pending._t0 = t0
+        return pending
+
+    def _dispatch(self, plan, chunk: ColumnarChunk,
+                  stats: Optional[QueryStatistics] = None,
+                  fp: Optional[str] = None) -> _PendingResult:
         prepared = prepare(plan, chunk)
         key = (fp or ir.fingerprint(plan), chunk.capacity,
                prepared.binding_shapes())
@@ -118,18 +200,12 @@ class Evaluator:
                    for c in plan.schema}
         planes, count = jitted(columns, chunk.row_valid,
                                tuple(prepared.bindings))
-        n = int(count)
+        return _PendingResult(planes, count, prepared.output)
 
-        out_columns: dict[str, Column] = {}
-        out_schema_cols = []
-        for out_col, (data, valid) in zip(prepared.output, planes):
-            out_schema_cols.append((out_col.name, out_col.type.value))
-            out_columns[out_col.name] = Column(
-                type=out_col.type, data=data, valid=valid,
-                dictionary=out_col.vocab)
-        out_schema = TableSchema.make(out_schema_cols)
-        return ColumnarChunk(schema=out_schema, row_count=n,
-                             columns=out_columns)
+    def _execute(self, plan, chunk: ColumnarChunk,
+                 stats: Optional[QueryStatistics] = None,
+                 fp: Optional[str] = None) -> ColumnarChunk:
+        return self._dispatch(plan, chunk, stats, fp=fp).finish()
 
 
 def _initial_namespace(plan: ir.Query) -> list[tuple[str, str]]:

@@ -147,38 +147,11 @@ def chunk_may_match(stats: dict, intervals: dict[str, Interval]) -> bool:
 
 
 def compute_column_stats(chunk) -> dict:
-    """Host-side per-column min/max/has_null for pruning metadata."""
-    import numpy as np
+    """Host-side per-column min/max/has_null for pruning metadata.
 
-    from ytsaurus_tpu.schema import EValueType
-
-    out: dict[str, dict] = {}
-    n = chunk.row_count
-    for name, col in chunk.columns.items():
-        if col.type in (EValueType.any, EValueType.null):
-            continue
-        valid = np.asarray(col.valid[:n])
-        entry: dict = {"has_null": bool((~valid).any()) if n else True,
-                       "min": None, "max": None}
-        if n and valid.any():
-            data = np.asarray(col.data[:n])[valid]
-            if col.type is EValueType.string:
-                codes = data
-                entry["min"] = bytes(col.dictionary[int(codes.min())])
-                entry["max"] = bytes(col.dictionary[int(codes.max())])
-            elif col.type is EValueType.boolean:
-                entry["min"] = bool(data.min())
-                entry["max"] = bool(data.max())
-            elif col.type is EValueType.double:
-                entry["min"] = float(data.min())
-                entry["max"] = float(data.max())
-            else:
-                entry["min"] = int(data.min())
-                entry["max"] = int(data.max())
-        out[name] = entry
-    # Not a column: per-chunk row count rides the stats so metadata-only
-    # consumers (chunk merger sizing) never decode the chunk.  "$" can
-    # never collide with a column name, and chunk_may_match looks
-    # columns up by name so it skips this key.
-    out["$row_count"] = n
-    return out
+    Since the stats moved into the chunk wire format (written once at
+    serialize/seal time, read via `FsChunkStore.read_stats`), this is
+    the BACKFILL path for already-decoded chunks — the implementation
+    lives with the chunk layout in `chunks/columnar.py`."""
+    from ytsaurus_tpu.chunks.columnar import chunk_column_stats
+    return chunk_column_stats(chunk)

@@ -157,7 +157,6 @@ class ChunkMerger:
 
     def _merge_table(self, path: str) -> bool:
         from ytsaurus_tpu.chunks.columnar import concat_chunks
-        from ytsaurus_tpu.query.pruning import compute_column_stats
 
         client = self.client
         master = client.cluster.master
@@ -183,11 +182,12 @@ class ChunkMerger:
                 merged = concat_chunks(
                     [client.cluster.chunk_cache.get(cid)
                      for cid in snapshot_ids[start:end]])
-                # Stats BEFORE the store write: the unprotected window
-                # is then just write→add, not the whole stats pass.
-                stats = compute_column_stats(merged)
                 new_id = client.cluster.chunk_store.write_chunk(merged)
                 protected.add(new_id)
+                # Stats were computed inside the serialize pass (chunk
+                # meta); read_stats is a meta parse, so the unprotected
+                # window stays write→add sized.
+                stats = client.cluster.chunk_store.read_stats(new_id)
                 replacements.append((start, end, new_id, stats))
         except BaseException:
             protected.difference_update(

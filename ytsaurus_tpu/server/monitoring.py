@@ -91,6 +91,15 @@ class MonitoringServer:
             body = json.dumps({"gateways": serving_snapshot()},
                               indent=2).encode()
             self._reply(request, 200, body, "application/json")
+        elif path == "/tablet":
+            # Tablet read-path caches (tablet/tablet.py): process-wide
+            # snapshot-cache hit/miss/evict counters + bytes pinned
+            # (the raw sensors also render on /metrics as
+            # tablet_snapshot_cache_*).
+            from ytsaurus_tpu.tablet.tablet import snapshot_cache_stats
+            body = json.dumps({"snapshot_cache": snapshot_cache_stats()},
+                              indent=2).encode()
+            self._reply(request, 200, body, "application/json")
         elif path in ("/metrics", "/solomon"):
             body = self.registry.render_prometheus().encode()
             self._reply(request, 200, body, "text/plain; version=0.0.4")

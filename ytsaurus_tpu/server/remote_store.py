@@ -132,6 +132,17 @@ class RpcChunkStore:
     def read_meta(self, chunk_id: str) -> dict:
         return read_chunk_meta(self.get_blob(chunk_id))
 
+    def read_stats(self, chunk_id: str) -> dict:
+        """Seal-time column stats from the chunk meta header; pre-stats
+        chunks backfill by decoding once (one blob fetch either way)."""
+        blob = self.get_blob(chunk_id)
+        stats = read_chunk_meta(blob).get("column_stats")
+        if stats is None:
+            from ytsaurus_tpu.chunks.columnar import chunk_column_stats
+            stats = chunk_column_stats(
+                deserialize_chunk(blob, hunk_store=self))
+        return stats
+
     def exists(self, chunk_id: str) -> bool:
         for address in placement_rank(chunk_id, self._nodes()):
             try:

@@ -33,6 +33,9 @@ class SortedDynamicStore:
         self.store_row_count = 0          # versions stored
         self.min_timestamp = MAX_TIMESTAMP
         self.max_timestamp = 0
+        # (store_row_count, chunk): versioned planes ingested once per
+        # mutation generation for the vectorized read path.
+        self._versioned_chunk_cache: "Optional[tuple[int, object]]" = None
 
     # -- write path ------------------------------------------------------------
 
@@ -100,6 +103,23 @@ class SortedDynamicStore:
     @property
     def key_count(self) -> int:
         return len(self._rows)
+
+    def to_versioned_chunk(self, versioned_schema):
+        """This store's versions as device planes (versioned-schema
+        ColumnarChunk, key-ordered, newest-first per key) — the
+        ingestion step of the vectorized MVCC read path.  Memoized per
+        mutation generation (store_row_count): repeated snapshots of an
+        unchanged store never re-walk its Python rows."""
+        with self._lock:
+            count = self.store_row_count
+        cached = self._versioned_chunk_cache
+        if cached is not None and cached[0] == count:
+            return cached[1]
+        from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+        chunk = ColumnarChunk.from_rows(versioned_schema,
+                                        self.versioned_rows())
+        self._versioned_chunk_cache = (count, chunk)
+        return chunk
 
     def versioned_rows(self) -> list[dict]:
         """Flatten to versioned row dicts (newest first per key) for

@@ -24,11 +24,50 @@ import numpy as np
 
 from ytsaurus_tpu.chunks.columnar import ColumnarChunk, concat_chunks
 from ytsaurus_tpu.chunks.store import ChunkCache, FsChunkStore
+from ytsaurus_tpu.config import tablet_config
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils import invariants
 from ytsaurus_tpu.utils.invariants import check as _invariant_check
+from ytsaurus_tpu.utils.profiling import Profiler
 from ytsaurus_tpu.schema import EValueType, SortOrder, TableSchema
+from ytsaurus_tpu.tablet import mvcc
 from ytsaurus_tpu.tablet.dynamic_store import SortedDynamicStore
 from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+
+# Process-wide snapshot-cache sensors (rendered on /metrics as
+# tablet_snapshot_cache_*; the structured view is monitoring /tablet).
+_snap_profiler = Profiler("tablet/snapshot_cache")
+_SNAP_HITS = _snap_profiler.counter("hits")
+_SNAP_MISSES = _snap_profiler.counter("misses")
+_SNAP_EVICTIONS = _snap_profiler.counter("evictions")
+_SNAP_BYTES = _snap_profiler.gauge("bytes_pinned")
+_snap_lock = threading.Lock()
+_snap_bytes_pinned = 0
+
+
+def _snap_bytes_add(delta: int) -> None:
+    global _snap_bytes_pinned
+    with _snap_lock:
+        _snap_bytes_pinned += delta
+        _SNAP_BYTES.set(_snap_bytes_pinned)
+
+
+def snapshot_cache_stats() -> dict:
+    """Live snapshot-cache counters (monitoring /tablet data source)."""
+    return {
+        "hits": int(_SNAP_HITS.get()),
+        "misses": int(_SNAP_MISSES.get()),
+        "evictions": int(_SNAP_EVICTIONS.get()),
+        "bytes_pinned": _snap_bytes_pinned,
+    }
+
+
+def _chunk_nbytes(chunk: ColumnarChunk) -> int:
+    total = 0
+    for col in chunk.columns.values():
+        total += col.data.size * col.data.dtype.itemsize
+        total += col.valid.size
+    return total
 
 
 def versioned_schema(schema: TableSchema) -> TableSchema:
@@ -77,7 +116,18 @@ class Tablet:
         self.in_memory = False          # pin chunks in the cache when True
         self.flush_generation = 0
         self._lock = threading.RLock()
-        self._host_planes: dict[str, dict] = {}
+        # Host numpy views of chunk planes: a real LRU (promote on hit,
+        # capacity from TabletConfig.host_plane_cache_capacity).
+        self._host_planes: "OrderedDict[str, dict]" = OrderedDict()
+        self._versioned_schema = versioned_schema(schema)
+        # Snapshot cache: (generation, visible chunk) for latest-class
+        # reads; invalidated by any write/flush/compact via the
+        # generation key.  Counters are process-wide (/metrics).
+        self._snapshot_cache: "Optional[tuple[tuple, ColumnarChunk]]" = None
+        # Max committed version timestamp of the sealed chunks, memoized
+        # per flush generation (read from chunk meta stats).
+        self._chunk_max_ts = 0
+        self._chunk_max_ts_gen = -1
         # Lookup row cache (ref tablet_node/row_cache.h): key → merged row,
         # valid for one (write, flush) generation only.
         self._row_cache: "OrderedDict[tuple, Optional[dict]]" = OrderedDict()
@@ -181,19 +231,41 @@ class Tablet:
             self.passive_stores.append(self.active_store)
             self.active_store = SortedDynamicStore(self.schema)
 
+    def _vectorize(self, version_count: int) -> bool:
+        """Columnar-pipeline dispatch: per-program overhead dominates
+        tiny stores, so small version counts keep the Python merge
+        (TabletConfig.vectorized_scan_min_rows; 0 forces columnar)."""
+        return mvcc.supports(self.schema) and \
+            version_count >= tablet_config().vectorized_scan_min_rows
+
     def flush(self) -> Optional[str]:
-        """Rotate + write all passive stores into one versioned chunk."""
+        """Rotate + write all passive stores into one versioned chunk.
+        The merge sort runs as one device program over concatenated
+        store planes (tablet/mvcc.py); tiny stores keep the host sort."""
         with self._lock:
             self.rotate_store()
             if not self.passive_stores:
                 return None
-            rows: list[dict] = []
-            for store in self.passive_stores:
-                rows.extend(store.versioned_rows())
-            rows.sort(key=_versioned_sort_key(self.schema))
-            _invariant_check("versioned_rows",
-                             (self.schema.key_column_names, rows))
-            chunk = ColumnarChunk.from_rows(versioned_schema(self.schema), rows)
+            total = sum(s.store_row_count for s in self.passive_stores)
+            if self._vectorize(total):
+                parts = [s.to_versioned_chunk(self._versioned_schema)
+                         for s in self.passive_stores
+                         if s.store_row_count]
+                chunk = mvcc.sorted_versioned_chunk(
+                    concat_chunks(parts), self.schema)
+                if invariants.enabled():
+                    _invariant_check(
+                        "versioned_rows",
+                        (self.schema.key_column_names, chunk.to_rows()))
+            else:
+                rows: list[dict] = []
+                for store in self.passive_stores:
+                    rows.extend(store.versioned_rows())
+                rows.sort(key=_versioned_sort_key(self.schema))
+                _invariant_check("versioned_rows",
+                                 (self.schema.key_column_names, rows))
+                chunk = ColumnarChunk.from_rows(self._versioned_schema,
+                                                rows)
             chunk_id = self.chunk_store.write_chunk(chunk)
             self.chunk_ids.append(chunk_id)
             if self.in_memory:
@@ -211,22 +283,38 @@ class Tablet:
             if len(self.chunk_ids) <= 0:
                 return None
             chunks = [self._decode(cid) for cid in self.chunk_ids]
-            rows: list[dict] = []
-            value_names = [c.name for c in self.schema
-                           if c.sort_order is None]
-            for chunk in chunks:
-                for row in chunk.to_rows():
-                    for name in value_names:
-                        row[f"$w:{name}"] = _written(row, name)
-                    rows.append(row)
-            rows.sort(key=_versioned_sort_key(self.schema))
-            rows = _drop_superseded(rows, self.schema, retention_timestamp)
-            _invariant_check("versioned_rows",
-                             (self.schema.key_column_names, rows))
+            total = sum(c.row_count for c in chunks)
+            chunk: Optional[ColumnarChunk] = None
+            if self._vectorize(total):
+                merged = concat_chunks(
+                    [self._normalize_versioned(c) for c in chunks])
+                out = mvcc.retained_chunk(merged, self.schema,
+                                          retention_timestamp)
+                if out.row_count:
+                    chunk = out
+                if invariants.enabled() and chunk is not None:
+                    _invariant_check(
+                        "versioned_rows",
+                        (self.schema.key_column_names, chunk.to_rows()))
+            else:
+                rows: list[dict] = []
+                value_names = [c.name for c in self.schema
+                               if c.sort_order is None]
+                for c in chunks:
+                    for row in c.to_rows():
+                        for name in value_names:
+                            row[f"$w:{name}"] = _written(row, name)
+                        rows.append(row)
+                rows.sort(key=_versioned_sort_key(self.schema))
+                rows = _drop_superseded(rows, self.schema,
+                                        retention_timestamp)
+                _invariant_check("versioned_rows",
+                                 (self.schema.key_column_names, rows))
+                if rows:
+                    chunk = ColumnarChunk.from_rows(self._versioned_schema,
+                                                    rows)
             old_ids = list(self.chunk_ids)
-            if rows:
-                chunk = ColumnarChunk.from_rows(versioned_schema(self.schema),
-                                                rows)
+            if chunk is not None:
                 new_id = self.chunk_store.write_chunk(chunk)
                 self.chunk_ids = [new_id]
                 if self.in_memory:
@@ -248,7 +336,10 @@ class Tablet:
         return self.chunk_cache.get(chunk_id)
 
     def _chunk_host_planes(self, chunk_id: str) -> dict:
-        """numpy views of a chunk's planes (device->host once per chunk)."""
+        """numpy views of a chunk's planes (device->host once per chunk).
+        LRU: hits promote (a hot chunk probed by every lookup batch must
+        not be evicted because it was decoded first), capacity from
+        TabletConfig.host_plane_cache_capacity."""
         planes = self._host_planes.get(chunk_id)
         if planes is None:
             chunk = self._decode(chunk_id)
@@ -256,8 +347,11 @@ class Tablet:
             planes = {name: (np.asarray(col.data[:n]), np.asarray(col.valid[:n]))
                       for name, col in chunk.columns.items()}
             self._host_planes[chunk_id] = planes
-            if len(self._host_planes) > 64:
-                self._host_planes.pop(next(iter(self._host_planes)))
+            capacity = tablet_config().host_plane_cache_capacity
+            while len(self._host_planes) > capacity:
+                self._host_planes.popitem(last=False)
+        else:
+            self._host_planes.move_to_end(chunk_id)
         return planes
 
     def _decoded_chunks(self) -> list[ColumnarChunk]:
@@ -274,9 +368,126 @@ class Tablet:
             rows.sort(key=_versioned_sort_key(self.schema))
             return rows
 
+    def _generation(self) -> tuple:
+        """Identity of the tablet's visible state: any write, rotation,
+        flush or compaction changes it.  Keys the row cache AND the
+        snapshot cache."""
+        return (self.active_store.store_row_count,
+                len(self.passive_stores), self.flush_generation)
+
+    def _chunk_max_timestamp(self, chunk_id: str) -> int:
+        """Newest version timestamp in a sealed chunk — from the chunk
+        meta stats when present (one header parse), else from the host
+        planes (pre-stats chunks)."""
+        if hasattr(self.chunk_store, "read_stats"):
+            try:
+                stats = self.chunk_store.read_stats(chunk_id)
+                entry = (stats or {}).get("$timestamp") or {}
+                if entry.get("max") is not None:
+                    return int(entry["max"])
+            except (YtError, OSError):
+                pass
+        data, valid = self._chunk_host_planes(chunk_id)["$timestamp"]
+        return int(data[valid].max()) if valid.any() else 0
+
+    def _latest_ts_floor(self) -> int:
+        """Smallest timestamp that reads "latest": any read at/above the
+        newest committed version sees the same visible state, so it can
+        share the cached snapshot (the timestamp-class in the cache
+        key)."""
+        if self._chunk_max_ts_gen != self.flush_generation:
+            best = 0
+            for cid in self.chunk_ids:
+                best = max(best, self._chunk_max_timestamp(cid))
+            self._chunk_max_ts = best
+            self._chunk_max_ts_gen = self.flush_generation
+        floor = self._chunk_max_ts
+        for store in [self.active_store] + self.passive_stores:
+            floor = max(floor, store.max_timestamp)
+        return floor
+
+    def _normalize_versioned(self, chunk: ColumnarChunk) -> ColumnarChunk:
+        """Adapt a persisted versioned chunk to THE versioned schema so
+        chunk planes concatenate: chunks from before the per-column $w:
+        layout gain explicit written=True planes (whole-row semantics,
+        matching `_written`), missing value columns read as stated
+        nulls."""
+        vschema = self._versioned_schema
+        if chunk.schema == vschema:
+            return chunk
+        import jax.numpy as jnp
+
+        from ytsaurus_tpu.chunks.columnar import Column, _plane_dtype
+        cap = chunk.capacity
+        n = chunk.row_count
+        row_valid = jnp.arange(cap) < n
+        columns: dict[str, Column] = {}
+        for c in vschema:
+            col = chunk.columns.get(c.name)
+            if col is not None:
+                columns[c.name] = col
+            elif c.name.startswith("$w:"):
+                columns[c.name] = Column(
+                    type=c.type, data=jnp.ones(cap, dtype=bool),
+                    valid=row_valid)
+            else:
+                columns[c.name] = Column(
+                    type=c.type,
+                    data=jnp.zeros(cap, dtype=_plane_dtype(c.type)),
+                    valid=jnp.zeros(cap, dtype=bool))
+        return ColumnarChunk(schema=vschema, row_count=n, columns=columns)
+
     def read_snapshot(self, timestamp: int = MAX_TIMESTAMP) -> ColumnarChunk:
         """Materialize the tablet contents as of `timestamp` into a plain
-        columnar chunk (the select_rows input)."""
+        columnar chunk (the select_rows input).
+
+        Columnar MVCC pipeline (tablet/mvcc.py): versioned chunk planes
+        and store-ingested planes concatenate on device, one packed
+        (key, -ts) sort, visibility as segmented scans — no to_rows().
+        Latest-class reads (timestamp at/above the newest committed
+        version) memoize the materialized chunk per generation, so
+        repeated selects skip the merge entirely until the next
+        write/flush/compact."""
+        with self._lock:
+            generation = self._generation()
+            latest = timestamp >= self._latest_ts_floor()
+            if latest:
+                cached = self._snapshot_cache
+                if cached is not None and cached[0] == generation:
+                    _SNAP_HITS.increment()
+                    return cached[1]
+                _SNAP_MISSES.increment()
+            chunk = self._read_snapshot_uncached(timestamp)
+            if latest and tablet_config().snapshot_cache_enabled:
+                if self._snapshot_cache is not None:
+                    _SNAP_EVICTIONS.increment()
+                    _snap_bytes_add(-_chunk_nbytes(self._snapshot_cache[1]))
+                self._snapshot_cache = (generation, chunk)
+                _snap_bytes_add(_chunk_nbytes(chunk))
+            return chunk
+
+    def _read_snapshot_uncached(self, timestamp: int) -> ColumnarChunk:
+        total = sum(s.store_row_count for s in
+                    [self.active_store] + self.passive_stores)
+        for cid in self.chunk_ids:
+            total += self._decode(cid).row_count
+        if not self._vectorize(total):
+            return self.read_snapshot_reference(timestamp)
+        sources = [self._normalize_versioned(self._decode(cid))
+                   for cid in self.chunk_ids]
+        sources += [s.to_versioned_chunk(self._versioned_schema)
+                    for s in self.passive_stores + [self.active_store]
+                    if s.store_row_count]
+        if not sources:
+            return ColumnarChunk.from_rows(self.schema.to_unsorted(), [])
+        return mvcc.visible_chunk(concat_chunks(sources), self.schema,
+                                  timestamp)
+
+    def read_snapshot_reference(self,
+                                timestamp: int = MAX_TIMESTAMP
+                                ) -> ColumnarChunk:
+        """The retained Python MVCC merge (pre-columnar read path):
+        the property-test oracle and the small-store fast path."""
         with self._lock:
             rows = self.versioned_rows_snapshot()
             visible = _mvcc_select(rows, self.schema, timestamp)
@@ -305,8 +516,7 @@ class Tablet:
                 keys = [self.normalize_key(tuple(k)) for k in keys]
             # The cache only serves latest-timestamp reads and resets when
             # any store or chunk set changes.
-            generation = (self.active_store.store_row_count,
-                          len(self.passive_stores), self.flush_generation)
+            generation = self._generation()
             cacheable = timestamp == MAX_TIMESTAMP
             if self._row_cache_gen != generation:
                 self._row_cache.clear()

@@ -65,6 +65,19 @@ def ensure_backend(timeout: float = 120.0, window: float | None = None):
         jax.config.update("jax_platforms", "cpu")
         jax.devices()
         return jax
+    # A parent bench process already probed this tunnel and exported its
+    # verdict: honor it instead of re-probing — a dead tunnel then costs
+    # ONE fallback window for the whole bench invocation, not one per
+    # spawned config child (BENCH_r05 probe-hang lesson).
+    verdict = os.environ.get("YT_TPU_PROBE_VERDICT", "")
+    if verdict == "cpu":
+        print("# accelerator probe verdict inherited from parent: cpu",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+        return jax
+    if verdict == "accel":
+        _PROBED = True
     if not _PROBED:
         _PROBED = True
         if window is None:
