@@ -23,6 +23,10 @@ config, printing the headline (TPC-H Q1, config 1) last:
           version churn (ISSUE 4): warm snapshot-cache select path is
           the metric; cold vectorized + pre-PR Python reference merge
           timings and speedups print on stderr
+  trace_overhead  query flight recorder (ISSUE 5): asserts the untraced
+          span-site fast path ≲1µs, reports sampled-mode tracing
+          overhead on the select and warm-scan shapes; metric is the
+          traced select throughput
   all     run every config, one JSON line each (headline line printed last)
 
 Row counts are scaled to the ACTUAL platform after backend probing: a CPU
@@ -417,6 +421,129 @@ def bench_serving(n_rows, iters):
     return "serving_lookup_rows_per_sec", best_tput, best_elapsed
 
 
+def bench_trace_overhead(n_rows, iters):
+    """Query flight recorder (ISSUE 5): the UNTRACED span-site fast path
+    must stay ≲1µs/site (one contextvar read + a singleton return —
+    mirror of the failpoints fast-path assert: the query/operation planes
+    thread ~20 sites through their hot paths, and fault-free untraced
+    production must not pay for them), and sampled tracing must tax the
+    select/scan pipelines only marginally.  The emitted metric is the
+    TRACED select throughput; the per-site costs and the traced-vs-
+    untraced deltas for the select and scan shapes go to stderr."""
+    from ytsaurus_tpu import config as _config
+    from ytsaurus_tpu.models import tpch
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.coordinator import coordinate_and_execute
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.schema import TableSchema
+    from ytsaurus_tpu.utils import tracing
+
+    def per_site(site):
+        """min-of-rounds mean: stable against scheduler noise."""
+        n_round, best = 40_000, float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n_round):
+                with site("bench.trace.site"):
+                    pass
+            best = min(best, (time.perf_counter() - t0) / n_round)
+        return best
+
+    # (a) interior site with NO ambient trace — the path every span site
+    # in an untraced query takes.
+    null_cost = per_site(tracing.child_span)
+    # (b) entry-point site with tracing DISABLED outright.
+    _config.set_tracing_config(_config.TracingConfig(enabled=False))
+    try:
+        disabled_cost = per_site(tracing.start_query_span)
+    finally:
+        _config.set_tracing_config(None)
+    # (c) reference: a live recorded span (allocation + collector add).
+    def _recorded(name):
+        return tracing.TraceContext(name)
+    recorded_cost = per_site(_recorded)
+    print(f"# trace sites: untraced child_span {null_cost * 1e9:.0f} "
+          f"ns/site, disabled entry {disabled_cost * 1e9:.0f} ns/site, "
+          f"recorded span {recorded_cost * 1e9:.0f} ns/site",
+          file=sys.stderr)
+    assert null_cost < 1.5e-6, \
+        f"untraced span site too slow: {null_cost * 1e9:.0f} ns"
+    assert disabled_cost < 1.5e-6, \
+        f"disabled entry span site too slow: {disabled_cost * 1e9:.0f} ns"
+
+    # Sampled-mode overhead, select shape: the bench_select pipeline
+    # (8-shard coordinate_and_execute) untraced vs under a sampled root.
+    schema = TableSchema.make([("k", "int64", "ascending"), ("g", "int64"),
+                               ("v", "int64")])
+    chunk = tpch.device_chunk(schema, tpch.device_planes({
+        "k": ("arange",), "g": ("randint", 0, 10_000),
+        "v": ("randint", 0, 1000)}, n_rows), n_rows)
+    n_shards = 8
+    per = max(n_rows // n_shards, 1)
+    shards = [chunk.slice_rows(i * per, min((i + 1) * per, n_rows))
+              for i in range(n_shards) if i * per < n_rows]
+    plan = build_query(
+        "g, sum(v) AS s, count(*) AS c FROM [//t] WHERE v < 900 GROUP BY g",
+        {"//t": schema})
+    ev = Evaluator()
+
+    def timed_select(traced):
+        out = coordinate_and_execute(plan, shards, evaluator=ev)  # warm
+        _sync(out.columns[out.schema.column_names[0]].data)
+        times = []
+        while _iters_left(times, iters):
+            t0 = time.perf_counter()
+            if traced:
+                with tracing.start_query_span("bench.trace.select"):
+                    out = coordinate_and_execute(plan, shards,
+                                                 evaluator=ev)
+            else:
+                out = coordinate_and_execute(plan, shards, evaluator=ev)
+            _sync(out.columns[out.schema.column_names[0]].data)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    plain = timed_select(traced=False)
+    traced = timed_select(traced=True)
+
+    # Sampled-mode overhead, scan shape: warm snapshot-cache tablet reads.
+    import tempfile
+
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.tablet.tablet import Tablet
+    tablet_schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")],
+        unique_keys=True)
+    tablet = Tablet(tablet_schema,
+                    FsChunkStore(tempfile.mkdtemp(prefix="bench-trace-")))
+    for i in range(2048):
+        tablet.write_row({"k": i, "g": i % 7, "v": i}, timestamp=100)
+    tablet.read_snapshot()                        # prime the cache
+
+    def timed_scan(do_trace):
+        times = []
+        while _iters_left(times, max(iters, 3)):
+            t0 = time.perf_counter()
+            for _ in range(100):
+                if do_trace:
+                    with tracing.start_query_span("bench.trace.scan"):
+                        tablet.read_snapshot()
+                else:
+                    tablet.read_snapshot()
+            times.append((time.perf_counter() - t0) / 100)
+        return min(times)
+
+    scan_plain = timed_scan(False)
+    scan_traced = timed_scan(True)
+    print(f"# sampled tracing overhead: select {plain * 1e3:.2f}ms -> "
+          f"{traced * 1e3:.2f}ms "
+          f"(+{(traced / plain - 1) * 100:.1f}%), warm scan "
+          f"{scan_plain * 1e6:.0f}µs -> {scan_traced * 1e6:.0f}µs "
+          f"(+{(scan_traced / scan_plain - 1) * 100:.1f}%)",
+          file=sys.stderr)
+    return "trace_overhead_rows_per_sec", n_rows / traced, traced
+
+
 def bench_scan(n_rows, iters):
     """Versioned MVCC read path (ISSUE 4): snapshot reads over a tablet
     with three flushed version generations (overwrites, deletes, partial
@@ -523,6 +650,7 @@ _CONFIGS = {
     "select": (bench_select, 16_000_000, 1_000_000),
     "serving": (bench_serving, 200_000, 100_000),
     "scan": (bench_scan, 500_000, 100_000),
+    "trace_overhead": (bench_trace_overhead, 2_000_000, 500_000),
 }
 
 
@@ -638,6 +766,7 @@ _METRIC_NAMES = {
     "select": "select_rows_per_sec",
     "serving": "serving_lookup_rows_per_sec",
     "scan": "scan_rows_per_sec",
+    "trace_overhead": "trace_overhead_rows_per_sec",
 }
 
 

@@ -118,6 +118,9 @@ def test_clock_leader_failover_stays_monotone(tmp_path):
         provider.close()
 
 
+@pytest.mark.slow   # ~15s; tier-1 keeps clock-quorum coverage via
+# test_clock_leader_failover_stays_monotone (real processes) and the
+# provider failover units above.
 def test_tablet_commits_use_quorum_with_primary_down(tmp_path):
     """The VERDICT done-criterion: with the primary master KILLED, the
     successor keeps committing tablet transactions, and their

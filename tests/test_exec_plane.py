@@ -6,6 +6,8 @@ input chunks local-first, surviving node death via revival.
 import threading
 import time
 
+import pytest
+
 from ytsaurus_tpu.environment import LocalCluster
 from ytsaurus_tpu.remote_client import connect_remote
 from ytsaurus_tpu.rpc import Channel
@@ -39,6 +41,9 @@ def test_map_command_jobs_run_on_data_nodes(tmp_path):
         assert sum(1 for s in started if s > 0) >= 2, stats
 
 
+@pytest.mark.slow   # ~16s; tier-1 keeps node-death revival coverage via
+# test_scheduler_daemon::test_kill9_mid_operation_revives_and_completes and
+# exec-plane E2E via test_map_command_jobs_run_on_data_nodes.
 def test_node_kill_mid_operation_revives_jobs(tmp_path):
     with LocalCluster(str(tmp_path / "c"), n_nodes=3,
                       replication_factor=2) as cluster:

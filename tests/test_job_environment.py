@@ -68,6 +68,9 @@ def test_limited_job_within_budget_unaffected(client):
     assert len(client.read_table("//out")) == 20
 
 
+@pytest.mark.slow   # ~25s; tier-1 keeps limit-enforcement coverage via the
+# in-process memory/cpu kill + within-budget tests above — this is the
+# spawned-exec-node E2E variant of the same ladder.
 def test_limits_enforced_on_exec_nodes(tmp_path):
     """The distributed path: limits ride the start_job RPC and the exec
     NODE applies them to the user process."""

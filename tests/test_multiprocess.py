@@ -178,6 +178,9 @@ def test_node_failure_read_fallback(tmp_path):
         client.close()
 
 
+@pytest.mark.slow   # ~28s; tier-1 keeps restart/revival coverage via
+# test_scheduler_daemon::test_kill9_mid_operation_revives_and_completes and
+# the quorum-WAL recovery suite (test_quorum_wal).
 def test_primary_restart_recovers_metadata(tmp_path):
     from ytsaurus_tpu.environment import LocalCluster
     root = str(tmp_path / "restartable")

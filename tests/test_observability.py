@@ -1,6 +1,7 @@
 """Observability: sensors, tracing, Orchid, monitoring endpoint, RPC wiring."""
 
 import json
+import re
 import urllib.request
 
 import pytest
@@ -103,6 +104,135 @@ def test_unsampled_spans_not_collected():
     with ctx:
         pass
     assert not get_collector().find(ctx.trace_id)
+
+
+# -- prometheus exposition validator (ISSUE 5 satellite) -----------------------
+
+_METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+
+
+def parse_prometheus_exposition(text: str) -> list:
+    """STRICT parse of the text exposition format: returns
+    [(metric, labels_dict, value)] or raises ValueError on any grammar
+    violation (bad names, unescaped label values, trailing garbage,
+    duplicate series).  New sensors that would break a Prometheus scrape
+    must fail HERE, in tests, not in production scrapes."""
+    series = []
+    seen = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line or line.startswith("#"):
+            continue
+
+        def fail(reason):
+            raise ValueError(f"line {lineno}: {reason}: {line!r}")
+
+        i = line.find("{")
+        labels = {}
+        if i == -1:
+            name, _, value_str = line.partition(" ")
+        else:
+            name = line[:i]
+            # Label block: char-by-char so escapes inside quoted values
+            # are honored (\\ \" \n are the ONLY legal escapes).
+            pos = i + 1
+            while True:
+                j = line.find("=", pos)
+                if j == -1:
+                    fail("label without '='")
+                label_name = line[pos:j]
+                if not _LABEL_NAME_RE.match(label_name):
+                    fail(f"bad label name {label_name!r}")
+                if line[j + 1] != '"':
+                    fail("unquoted label value")
+                value_chars = []
+                k = j + 2
+                while k < len(line) and line[k] != '"':
+                    ch = line[k]
+                    if ch == "\\":
+                        esc = line[k + 1] if k + 1 < len(line) else ""
+                        if esc not in ("\\", '"', "n"):
+                            fail(f"illegal escape \\{esc}")
+                        value_chars.append(
+                            {"\\": "\\", '"': '"', "n": "\n"}[esc])
+                        k += 2
+                    else:
+                        value_chars.append(ch)
+                        k += 1
+                if k >= len(line):
+                    fail("unterminated label value")
+                if label_name in labels:
+                    fail(f"duplicate label {label_name!r}")
+                labels[label_name] = "".join(value_chars)
+                k += 1
+                if k < len(line) and line[k] == ",":
+                    pos = k + 1
+                    continue
+                if k < len(line) and line[k] == "}":
+                    break
+                fail("expected ',' or '}' after label value")
+            rest = line[k + 1:]
+            if not rest.startswith(" "):
+                fail("missing space before value")
+            value_str = rest[1:]
+        if not _METRIC_NAME_RE.match(name):
+            fail(f"bad metric name {name!r}")
+        if " " in value_str:
+            fail("trailing garbage after value")
+        try:
+            value = float(value_str)
+        except ValueError:
+            if value_str not in ("+Inf", "-Inf", "NaN"):
+                fail(f"bad sample value {value_str!r}")
+            value = float(value_str.replace("Inf", "inf"))
+        key = (name, tuple(sorted(labels.items())))
+        if key in seen:
+            fail(f"duplicate series {key!r}")
+        seen.add(key)
+        series.append((name, labels, value))
+    return series
+
+
+def test_exposition_validator_rejects_bad_lines():
+    for bad in ("1metric 2", "m{x=1} 2", 'm{x="a} 2', 'm{x="a\\q"} 2',
+                'm{x="a"}2', "m two", "m 1 extra", 'm{x="a",} 2',
+                "m 1\nm 1"):
+        with pytest.raises(ValueError):
+            parse_prometheus_exposition(bad)
+    ok = parse_prometheus_exposition('m{x="a\\"b\\\\c\\nd"} 1.5')
+    assert ok == [("m", {"x": 'a"b\\c\nd'}, 1.5)]
+
+
+def test_render_prometheus_survives_hostile_label_values():
+    reg = ProfilerRegistry()
+    prof = Profiler("/evil", registry=reg)
+    prof.with_tags(q='say "hi"\nback\\slash').counter("n").increment()
+    prof.with_tags(name="a.b/c-d").histogram(
+        "lat", bounds=(0.1, 1.0)).record(0.5)
+    prof.summary("s").record(2.0)
+    series = parse_prometheus_exposition(reg.render_prometheus())
+    (evil,) = [(n, l, v) for n, l, v in series if n == "evil_n"]
+    assert evil[1] == {"q": 'say "hi"\nback\\slash'} and evil[2] == 1
+    buckets = {l["le"]: v for n, l, v in series
+               if n == "evil_lat_bucket"}
+    assert buckets == {"0.1": 0, "1.0": 1, "+Inf": 1}
+
+
+def test_live_registry_exposition_is_valid():
+    """The GLOBAL registry — after real spans/sensors from other tests
+    have landed in it — must render a grammatically valid exposition
+    with no duplicate series."""
+    from ytsaurus_tpu.utils.profiling import get_registry
+    from ytsaurus_tpu.utils.tracing import TraceContext
+
+    # Make sure at least one span-duration histogram (dotted span name
+    # as a label value) is present.
+    with TraceContext("exposition.check"):
+        pass
+    series = parse_prometheus_exposition(get_registry().render_prometheus())
+    assert any(n == "tracing_span_seconds_count" and
+               l.get("name") == "exposition.check"
+               for n, l, v in series)
 
 
 # -- orchid --------------------------------------------------------------------

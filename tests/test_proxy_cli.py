@@ -84,6 +84,51 @@ def test_rest_table_write_read_select(cluster):
     assert result["value"][0]["s"] == sum(i * i for i in range(50))
 
 
+def test_rest_trace_header_and_explain_analyze(cluster):
+    """ISSUE 5: X-YT-Trace-Id pins (and force-samples) the query trace;
+    the id is echoed on the response, and explain_analyze returns the
+    ExecutionProfile dict with the compile/execute split + span tree."""
+    _post(cluster, "create", {"type": "table", "path": "//rest/tr",
+                              "recursive": True,
+                              "attributes": {"schema": [
+                                  {"name": "k", "type": "int64",
+                                   "sort_order": "ascending"},
+                                  {"name": "v", "type": "int64"}]}})
+    rows = "".join(json.dumps({"k": i, "v": i}) + "\n" for i in range(20))
+    req = urllib.request.Request(
+        _url(cluster, "/api/v4/write_table"),
+        data=rows.encode(),
+        headers={"X-YT-Parameters": json.dumps({"path": "//rest/tr",
+                                                "format": "json"})},
+        method="PUT")
+    urllib.request.urlopen(req)
+
+    trace_id = "ab" * 16
+    req = urllib.request.Request(
+        _url(cluster, "/api/v4/select_rows"),
+        data=json.dumps({"query": "sum(v) AS s FROM [//rest/tr] GROUP BY 1",
+                         "explain_analyze": True}).encode(),
+        headers={"Content-Type": "application/json", "X-YT-User": "root",
+                 "X-YT-Trace-Id": trace_id},
+        method="POST")
+    with urllib.request.urlopen(req) as resp:
+        assert resp.headers.get("X-YT-Trace-Id") == trace_id
+        profile = json.loads(resp.read())["value"]
+    assert profile["trace_id"] == trace_id
+    assert profile["wall_time"] > 0
+    assert "compile_time" in profile and "execute_time" in profile
+    names = set()
+
+    def walk(nodes):
+        for node in nodes:
+            names.add(node["name"])
+            walk(node.get("children") or [])
+
+    walk(profile["span_tree"])
+    assert "query.select" in names
+    assert profile["statistics"]["rows_read"] == 20
+
+
 def test_rest_error_shape(cluster):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(cluster, "get", {"path": "//no/such/node"})

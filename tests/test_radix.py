@@ -141,6 +141,8 @@ def test_group_order_exact_null_vs_zero(monkeypatch, engine):
     assert pos[1] == pos[3]
 
 
+@pytest.mark.slow   # ~13s property sweep; tier-1 keeps radix/group-order
+# coverage via the single/multi-word, stability, and null-vs-zero tests.
 def test_group_order_multi_key_adjacency():
     rng = np.random.default_rng(17)
     n = 30_000

@@ -108,6 +108,8 @@ def test_kill9_mid_operation_revives_and_completes(cluster, clients):
     ops2.close()
 
 
+@pytest.mark.slow   # ~16s latency-under-load guard; tier-1 keeps scheduler
+# daemon coverage via the four operation tests above.
 def test_master_mutations_stay_fast_under_operation_load(clients):
     """The split's point: an operation storm on the daemon leaves the
     master's mutation path responsive (measured)."""

@@ -388,6 +388,25 @@ def unify_dictionaries(columns: Sequence[Column]) -> tuple[list[Column], np.ndar
     return out, merged
 
 
+# Bound on string min/max stat values stored in chunk meta.  chunk_may_match
+# treats a None bound as unprunable, so widening/dropping bounds is always
+# safe — it only costs pruning power on pathological columns.
+_STAT_STRING_CAP = 64
+
+
+def _string_stat_upper(value: bytes) -> "bytes | None":
+    """An upper bound for `value` no longer than the cap: the value itself
+    when short, else the successor of its cap-length prefix (strictly
+    greater than EVERY string starting with that prefix).  None when no
+    bounded successor exists (prefix is all 0xFF)."""
+    if len(value) <= _STAT_STRING_CAP:
+        return value
+    prefix = value[:_STAT_STRING_CAP].rstrip(b"\xff")
+    if not prefix:
+        return None
+    return prefix[:-1] + bytes([prefix[-1] + 1])
+
+
 def chunk_column_stats(chunk: ColumnarChunk) -> dict:
     """Per-column min/max/has_null pruning statistics (+ `$row_count`).
 
@@ -407,8 +426,15 @@ def chunk_column_stats(chunk: ColumnarChunk) -> dict:
             data = np.asarray(col.data[:n])[valid]
             if col.type is EValueType.string:
                 codes = data
-                entry["min"] = bytes(col.dictionary[int(codes.min())])
-                entry["max"] = bytes(col.dictionary[int(codes.max())])
+                # Long payloads (hunk-bound blobs) must not ride into the
+                # meta verbatim — a 2KB value would re-inline what the
+                # hunk store just externalized.  min truncates to a prefix
+                # (a prefix is ≤ the value, still a lower bound); max
+                # needs a prefix SUCCESSOR to stay an upper bound.
+                entry["min"] = bytes(
+                    col.dictionary[int(codes.min())])[:_STAT_STRING_CAP]
+                entry["max"] = _string_stat_upper(
+                    bytes(col.dictionary[int(codes.max())]))
             elif col.type is EValueType.boolean:
                 entry["min"] = bool(data.min())
                 entry["max"] = bool(data.max())

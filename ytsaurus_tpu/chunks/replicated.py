@@ -129,31 +129,39 @@ class ReplicatedChunkStore:
         last one surviving.  Returns (serving store, probe result,
         placement) — placement rides along so hot-path callers don't
         re-run the rendezvous hash."""
+        from ytsaurus_tpu.utils.tracing import child_span
         policy = retry_policy("chunk_read")
         placement = self._placement(chunk_id)
         errors: dict[str, Exception] = {}
-        for attempt in range(policy.attempts):
-            # The blacklist steers the FIRST round (skip known-bad
-            # locations, serve from a healthy replica fast).  Later
-            # rounds re-probe everything: when the only holder was the
-            # banned location, honoring its ban would starve the retry
-            # into a guaranteed failure.
-            stores = self._usable(placement) if attempt == 0 \
-                else list(placement)
-            for store in stores:
-                try:
-                    return store, probe(store), placement
-                except (YtError, OSError) as e:   # missing OR dying
-                    errors[store.root] = e
-                    if not _is_missing(e):
-                        self._ban(store)
-                    continue
-            if len(errors) == len(placement) and \
-                    all(_is_missing(e) for e in errors.values()):
-                break   # cleanly absent everywhere: waiting cannot help
-            if attempt + 1 < policy.attempts:
-                time.sleep(policy.delay(attempt))
-        raise self._aggregate_read_error(chunk_id, placement, errors)
+        with child_span("chunk.replicated_read",
+                        chunk_id=chunk_id) as span:
+            for attempt in range(policy.attempts):
+                # The blacklist steers the FIRST round (skip known-bad
+                # locations, serve from a healthy replica fast).  Later
+                # rounds re-probe everything: when the only holder was
+                # the banned location, honoring its ban would starve the
+                # retry into a guaranteed failure.
+                stores = self._usable(placement) if attempt == 0 \
+                    else list(placement)
+                for store in stores:
+                    try:
+                        result = probe(store)
+                        span.add_tag("location", store.root)
+                        span.add_tag("round", attempt)
+                        span.add_tag("probes_failed", len(errors))
+                        return store, result, placement
+                    except (YtError, OSError) as e:   # missing OR dying
+                        errors[store.root] = e
+                        if not _is_missing(e):
+                            self._ban(store)
+                        continue
+                if len(errors) == len(placement) and \
+                        all(_is_missing(e) for e in errors.values()):
+                    break   # cleanly absent everywhere: waiting cannot
+                    # help
+                if attempt + 1 < policy.attempts:
+                    time.sleep(policy.delay(attempt))
+            raise self._aggregate_read_error(chunk_id, placement, errors)
 
     def read_chunk(self, chunk_id: str) -> ColumnarChunk:
         store, chunk, placement = self._read_with_ladder(

@@ -128,8 +128,12 @@ class FsChunkStore:
         return self._read_blob(chunk_id)
 
     def read_chunk(self, chunk_id: str) -> ColumnarChunk:
-        _FP_DECODE.hit()
-        return deserialize_chunk(self._read_blob(chunk_id), hunk_store=self)
+        from ytsaurus_tpu.utils.tracing import child_span
+        with child_span("chunk.read", chunk_id=chunk_id,
+                        location=self.root):
+            _FP_DECODE.hit()
+            return deserialize_chunk(self._read_blob(chunk_id),
+                                     hunk_store=self)
 
     def read_meta(self, chunk_id: str) -> dict:
         return read_chunk_meta(self._read_blob(chunk_id))
@@ -192,22 +196,26 @@ class FsChunkStore:
         # Fast path: data parts only; parity reads happen only on damage.
         parts = [read_part(i) for i in range(codec.data_parts)]
         if any(p is None for p in parts):
+            from ytsaurus_tpu.utils.tracing import child_span
             parts += [read_part(i) for i in range(codec.data_parts,
                                                   codec.total_parts)]
-            blob = codec.decode(parts, meta["size"])
-            # Repair-on-read (ref chunk_replicator.h Repair jobs invoked
-            # from the read ladder): the decode just proved the chunk
-            # reconstructs, so rebuild the lost parts NOW instead of
-            # paying parity reads on every future access.
             lost = [i for i, part in enumerate(parts) if part is None]
-            if lost:
-                try:
-                    fresh = codec.encode(blob)
-                    for i in lost:
-                        self._atomic_write(self._part_path(chunk_id, i),
-                                           fresh[i])
-                except OSError:
-                    pass    # repair is best-effort; the read succeeded
+            with child_span("chunk.erasure_repair", chunk_id=chunk_id,
+                            lost_parts=len(lost)):
+                blob = codec.decode(parts, meta["size"])
+                # Repair-on-read (ref chunk_replicator.h Repair jobs
+                # invoked from the read ladder): the decode just proved
+                # the chunk reconstructs, so rebuild the lost parts NOW
+                # instead of paying parity reads on every future access.
+                if lost:
+                    try:
+                        fresh = codec.encode(blob)
+                        for i in lost:
+                            self._atomic_write(
+                                self._part_path(chunk_id, i), fresh[i])
+                    except OSError:
+                        pass   # repair is best-effort; the read
+                        # succeeded
             return blob
         parts += [None] * codec.parity_parts
         return codec.decode(parts, meta["size"])

@@ -52,6 +52,44 @@ def _rows_arg(rows: Optional[str]):
     return blob
 
 
+def _decode_deep(value):
+    """Bytes → str recursively (orchid values round-tripped through the
+    YSON wire carry byte strings)."""
+    if isinstance(value, bytes):
+        return value.decode("utf-8", "replace")
+    if isinstance(value, dict):
+        return {_decode_deep(k): _decode_deep(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_decode_deep(v) for v in value]
+    return value
+
+
+def _fetch_trace(cl, trace_id: str):
+    """Span tree of one trace: the remote orchid (`/tracing/traces/<id>`
+    — what the monitoring /traces endpoint also renders) when the client
+    has one, else this process's own collector."""
+    tree = None
+    if hasattr(cl, "get_orchid"):
+        try:
+            tree = cl.get_orchid(f"/tracing/traces/{trace_id}")
+        except YtError:
+            tree = None
+    if not tree:
+        from ytsaurus_tpu.utils.tracing import span_tree
+        tree = span_tree(trace_id)
+    return _decode_deep(tree) if tree else None
+
+
+def _format_profile(profile) -> str:
+    """ExecutionProfile object (in-process client) OR its dict form
+    (remote client / HTTP proxy) → the pretty EXPLAIN ANALYZE text, via
+    the one shared renderer in query/profile.py."""
+    if hasattr(profile, "format"):
+        return profile.format()
+    from ytsaurus_tpu.query.profile import format_profile_dict
+    return format_profile_dict(_decode_deep(dict(profile)))
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="yt")
     parser.add_argument("--proxy", default=os.environ.get("YT_PROXY"),
@@ -85,7 +123,16 @@ def build_parser() -> argparse.ArgumentParser:
         (("--append",), {"action": "store_true"}),
         (("--rows",), {"default": None, "help": "inline rows (else stdin)"}))
     cmd("read-table", (("path",), {}), (("--format",), {"default": "json"}))
-    cmd("select-rows", (("query",), {}))
+    cmd("select-rows", (("query",), {}),
+        (("--explain-analyze",), {"action": "store_true",
+                                  "help": "print the per-query "
+                                          "ExecutionProfile (wall/"
+                                          "compile/execute split + span "
+                                          "tree) instead of rows"}))
+    cmd("trace", (("trace_id",), {}),
+        (("--json",), {"action": "store_true",
+                       "help": "raw span tree instead of the pretty "
+                               "rendering"}))
     cmd("insert-rows", (("path",), {}),
         (("--rows",), {"default": None}))
     cmd("lookup-rows", (("path",), {}), (("--keys",), {"required": True}))
@@ -201,7 +248,22 @@ def _dispatch(cl, a):
     if c == "read-table":
         return cl.read_table(a.path, format=a.format)
     if c == "select-rows":
+        if a.explain_analyze:
+            profile = cl.select_rows(a.query, explain_analyze=True)
+            print(_format_profile(profile))
+            return None
         return cl.select_rows(a.query)
+    if c == "trace":
+        tree = _fetch_trace(cl, a.trace_id)
+        if not tree:
+            raise YtError(f"no such trace {a.trace_id!r} "
+                          "(unsampled, evicted, or wrong cluster)")
+        if a.json:
+            return tree
+        from ytsaurus_tpu.query.profile import format_span_tree
+        print(f"trace {a.trace_id}")
+        print("\n".join(format_span_tree(tree)))
+        return None
     if c == "insert-rows":
         rows = json.loads(_rows_arg(a.rows))
         return cl.insert_rows(a.path, rows)

@@ -1164,9 +1164,15 @@ class YtClient:
                     self.lookup_hedging_delay, primary_err)
         gateway = self.cluster.gateway
         if gateway.enabled and keys:
-            return gateway.lookup_rows(self, path, keys, timestamp,
-                                       column_names=column_names,
-                                       pool=pool, timeout=timeout)
+            from ytsaurus_tpu.utils.tracing import start_query_span
+            # Entry-point span: roots a (sampled) trace for this lookup,
+            # or continues the ambient one (RPC handler / batched
+            # caller) — the cohort's batch-flush span parents here.
+            with start_query_span("query.lookup", table=path,
+                                  keys=len(keys)):
+                return gateway.lookup_rows(self, path, keys, timestamp,
+                                           column_names=column_names,
+                                           pool=pool, timeout=timeout)
         return self._lookup_rows_direct(path, keys, timestamp,
                                         column_names)
 
@@ -1199,7 +1205,8 @@ class YtClient:
     def select_rows(self, query: str,
                     timestamp: int = MAX_TIMESTAMP,
                     timeout: Optional[float] = None,
-                    pool: Optional[str] = None) -> list[dict]:
+                    pool: Optional[str] = None,
+                    explain_analyze: bool = False) -> "list[dict]":
         """Distributed QL over static and mounted dynamic tables, routed
         through the cluster's QueryGateway (query/serving.py): admission
         against the per-pool concurrency slots (overflow raises
@@ -1207,15 +1214,55 @@ class YtClient:
         (`timeout` seconds, default ServingConfig.default_timeout)
         cooperatively checked between shard programs.
 
+        Every query runs under a root trace span (sampled per
+        config.TracingConfig) covering admission, per-shard staging/
+        execution, evaluator compile-vs-execute, and tablet/chunk reads;
+        finished queries fold into an ExecutionProfile retained by the
+        flight recorder (slow-query log + sampled recent log, monitoring
+        `/traces`).  `explain_analyze=True` forces sampling and returns
+        the ExecutionProfile (with `.rows` carrying the result) instead
+        of the bare row list — EXPLAIN ANALYZE with the compile/execute
+        split reported separately.
+
         Per-query statistics land in `self.last_query_statistics` (ref
         TQueryStatistics) and in the structured Query log."""
+        import time as _time
+
+        from ytsaurus_tpu.query.profile import (
+            ExecutionProfile,
+            get_flight_recorder,
+        )
+        from ytsaurus_tpu.query.statistics import QueryStatistics
+        from ytsaurus_tpu.utils.tracing import start_query_span
         gateway = self.cluster.gateway
-        if not gateway.enabled:
-            return self._select_rows_impl(query, timestamp, None)
-        return gateway.run_select(
-            lambda token: self._select_rows_impl(query, timestamp,
-                                                 token),
-            pool=pool, timeout=timeout)
+        root = start_query_span("query.select", force=explain_analyze,
+                                query=query[:200],
+                                pool=pool or "default")
+        # Statistics object threaded explicitly: `last_query_statistics`
+        # is a shared attribute a concurrent select on the same client
+        # (HTTP proxy / driver thread pools) would overwrite between our
+        # impl finishing and the profile capture reading it.
+        stats = QueryStatistics()
+        t0 = _time.perf_counter()
+        with root:
+            if not gateway.enabled:
+                rows = self._select_rows_impl(query, timestamp, None,
+                                              stats=stats)
+            else:
+                rows = gateway.run_select(
+                    lambda token: self._select_rows_impl(query, timestamp,
+                                                         token,
+                                                         stats=stats),
+                    pool=pool, timeout=timeout)
+        profile = ExecutionProfile.capture(
+            root, query, stats, _time.perf_counter() - t0, pool=pool)
+        if explain_analyze:
+            # Attach BEFORE observe: the recorder strips rows from what
+            # it retains (without_rows copy), so attaching afterwards
+            # would mutate the stored object and pin the result set.
+            profile.rows = rows
+        get_flight_recorder().observe(profile)
+        return profile if explain_analyze else rows
 
     def _select_rows_system(self, query: str,
                             timestamp: int = MAX_TIMESTAMP) -> list[dict]:
@@ -1229,12 +1276,13 @@ class YtClient:
         return self._select_rows_impl(query, timestamp, None)
 
     def _select_rows_impl(self, query: str, timestamp: int,
-                          token) -> list[dict]:
+                          token, stats=None) -> list[dict]:
         import logging as _logging
 
         from ytsaurus_tpu.query.statistics import QueryStatistics
         from ytsaurus_tpu.utils.logging import get_logger, log_event
-        stats = QueryStatistics()
+        if stats is None:
+            stats = QueryStatistics()
         self.last_query_statistics = stats   # visible even if the query fails
         plan = build_query(query, _SchemaResolver(self))
         # Every source table requires read permission (ref: query agent

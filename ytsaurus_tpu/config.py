@@ -296,6 +296,49 @@ def set_tablet_config(config: "Optional[TabletConfig]") -> None:
     _TABLET_CONFIG = config
 
 
+class TracingConfig(YsonStruct):
+    """Query flight recorder knobs (utils/tracing.py + query/profile.py):
+
+    - `enabled`: master switch; False turns every span site into the
+      NULL fast path (one contextvar read, ≲1µs — asserted by
+      `bench.py --config trace_overhead`).
+    - `sample_rate`: probability a new ROOT trace records its spans
+      (entry points: gateway select/lookup, scheduler operations, HTTP
+      proxy).  explain_analyze and X-YT-Trace-Id requests always sample.
+    - `slow_query_threshold`: queries at/above this wall time (seconds)
+      are ALWAYS retained in the flight recorder's slow-query log;
+      faster queries are retained at `sample_rate`.
+    - `slow_log_capacity` / `recent_log_capacity`: bounded profile logs.
+    - `ring_capacity`: finished-span ring buffer size (bounded memory).
+    """
+
+    enabled = param(True, type=bool)
+    sample_rate = param(1.0, type=float, ge=0.0, le=1.0)
+    slow_query_threshold = param(0.5, type=float, ge=0.0)
+    slow_log_capacity = param(128, type=int, ge=1)
+    recent_log_capacity = param(128, type=int, ge=1)
+    ring_capacity = param(4096, type=int, ge=1)
+
+
+_TRACING_CONFIG: "Optional[TracingConfig]" = None
+
+
+def tracing_config() -> TracingConfig:
+    global _TRACING_CONFIG
+    if _TRACING_CONFIG is None:
+        _TRACING_CONFIG = TracingConfig()
+    return _TRACING_CONFIG
+
+
+def set_tracing_config(config: "Optional[TracingConfig]") -> None:
+    """Install a process-wide tracing config (None restores defaults);
+    pushes the fast-path mirrors into utils/tracing."""
+    global _TRACING_CONFIG
+    _TRACING_CONFIG = config
+    from ytsaurus_tpu.utils import tracing
+    tracing.configure(config)
+
+
 class FailpointsConfig(YsonStruct):
     """Deterministic fault-injection schedule (utils/failpoints.py):
     `spec` uses the YT_FAILPOINTS syntax, `seed` fixes p-based rolls.
@@ -392,6 +435,7 @@ class DaemonConfig(YsonStruct):
     scheduler = param(type=SchedulerConfig)
     serving = param(type=ServingConfig)
     tablet = param(type=TabletConfig)
+    tracing = param(type=TracingConfig)
 
     def postprocess(self):
         if self.role == "node" and self.chunk_store.replication_factor < 1:

@@ -29,6 +29,19 @@ def _d(name, required, optional, mutating, invoke):
                              invoke=invoke)
 
 
+def _select_rows_command(cl, p: dict):
+    """select_rows with the EXPLAIN ANALYZE shape: explain_analyze=True
+    returns the ExecutionProfile as a plain dict (wire/JSON safe — this
+    registry feeds the RPC driver service and the HTTP proxy)."""
+    kwargs = {k: p[k] for k in ("timeout", "pool") if k in p}
+    if p.get("explain_analyze"):
+        profile = cl.select_rows(p["query"], explain_analyze=True,
+                                 **kwargs)
+        return profile.to_dict() if hasattr(profile, "to_dict") \
+            else profile
+    return cl.select_rows(p["query"], **kwargs)
+
+
 def _registry() -> dict[str, CommandDescriptor]:
     c: dict[str, CommandDescriptor] = {}
     for d in [
@@ -124,11 +137,9 @@ def _registry() -> dict[str, CommandDescriptor]:
                **({"timeout": p["timeout"]} if "timeout" in p else {}),
                **({"pool": p["pool"]} if "pool" in p else {}),
                column_names=p.get("column_names"))),
-        _d("select_rows", ("query",), ("timeout", "pool"), False,
-           lambda cl, p: cl.select_rows(
-               p["query"],
-               **({"timeout": p["timeout"]} if "timeout" in p else {}),
-               **({"pool": p["pool"]} if "pool" in p else {}))),
+        _d("select_rows", ("query",),
+           ("timeout", "pool", "explain_analyze"), False,
+           lambda cl, p: _select_rows_command(cl, p)),
         _d("trim_rows", ("path", "trimmed_row_count"), (), True,
            lambda cl, p: cl.trim_rows(p["path"], p["trimmed_row_count"])),
         _d("push_queue", ("path", "rows"), (), True,

@@ -28,6 +28,11 @@ from typing import Any, Callable, Optional, Sequence
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.utils import failpoints
+from ytsaurus_tpu.utils.tracing import (
+    child_span,
+    current_trace,
+    start_query_span,
+)
 
 # Crash-once at `scheduler.publish` simulates a controller dying between
 # the last snapshot record and the output publish — the revival window
@@ -173,8 +178,12 @@ class OperationScheduler:
             if controller is None:
                 raise YtError(f"Unknown operation type {op.type!r}",
                               code=EErrorCode.OperationFailed)
-            result = controller(self.client, op.spec, op=op,
-                                job_manager=self.job_manager)
+            # Operation ROOT span (the operations-plane trace entry):
+            # controller phases and per-job spans nest under it.
+            with start_query_span("operation.run", type=op.type,
+                                  operation_id=op.id):
+                result = controller(self.client, op.spec, op=op,
+                                    job_manager=self.job_manager)
             with self._lock:
                 if op.state != "aborted":
                     op.result = result or {}
@@ -324,15 +333,20 @@ def _sort_controller(client, spec: dict, op=None, job_manager=None) -> dict:
     if total_weight * 2 > budget and numeric_only:
         from ytsaurus_tpu.ops.bigsort import SpillStats, external_sort
         stats = SpillStats()
-        outs = list(external_sort(chunks, sort_by, budget_bytes=budget,
-                                  descending=descending, stats=stats))
+        with child_span("sort.external", chunks=len(chunks),
+                        bytes=total_weight):
+            outs = list(external_sort(chunks, sort_by,
+                                      budget_bytes=budget,
+                                      descending=descending, stats=stats))
         client._write_table_chunks(
             output_path, outs, sorted_by=sort_by,
             schema=outs[0].schema if outs else None)
         return {"rows": sum(c.row_count for c in outs),
                 "spill_ranges": stats.ranges,
                 "resplits": stats.resplits}
-    out = sort_chunks(chunks, sort_by, descending=descending)
+    with child_span("sort.device_sort", chunks=len(chunks),
+                    bytes=total_weight):
+        out = sort_chunks(chunks, sort_by, descending=descending)
     client._write_table_chunks(output_path, [out], sorted_by=sort_by,
                                schema=out.schema)
     return {"rows": out.row_count}
@@ -615,24 +629,34 @@ def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
     # Per-job failure budget (ref max_failed_job_count): transient
     # failures requeue the job until the budget runs out.
     max_failures = max(int(spec.get("max_failed_job_count", 1)), 1)
+    # Job runners execute on JobManager worker threads: under a sampled
+    # trace each gets an EXPLICIT contextvars capture so its span links
+    # operation → phase → job; untraced operations skip the wrap.
+    trace = current_trace()
+    traced = trace is not None and trace.sampled
     jobs = []
-    for i, item in enumerate(work_items):
-        if i in completed:
-            continue
-        run, preemptible = make_runner(item)
-        jobs.append(Job(op_id=op_id, index=i, run=run, pool=pool,
-                        preemptible=preemptible, on_done=on_done,
-                        max_failures=max_failures,
-                        splitter=make_splitter(item)
-                        if make_splitter is not None else None))
-    job_manager.submit(jobs)
-    try:
-        job_manager.wait(jobs)
-    except YtError:
-        job_manager.abort_operation(op_id)
-        raise
-    finally:
-        job_manager.finish_operation(op_id)
+    phase_span = child_span("operation.phase", jobs=total,
+                            revived=len(completed))
+    with phase_span:
+        for i, item in enumerate(work_items):
+            if i in completed:
+                continue
+            run, preemptible = make_runner(item)
+            if traced:
+                run = _traced_job_run(run, i)
+            jobs.append(Job(op_id=op_id, index=i, run=run, pool=pool,
+                            preemptible=preemptible, on_done=on_done,
+                            max_failures=max_failures,
+                            splitter=make_splitter(item)
+                            if make_splitter is not None else None))
+        job_manager.submit(jobs)
+        try:
+            job_manager.wait(jobs)
+        except YtError:
+            job_manager.abort_operation(op_id)
+            raise
+        finally:
+            job_manager.finish_operation(op_id)
     # An abort landing during the wait settles its jobs as 'aborted'
     # (empty results) without raising; publishing would then overwrite
     # the destination with partial rows and snap.clear() would destroy
@@ -657,6 +681,23 @@ def _run_user_jobs(client, op, job_manager, spec, work_items, make_runner,
     if snap is not None:
         snap.clear()
     return outputs, len(completed)
+
+
+def _traced_job_run(run, index: int):
+    """Per-job span wrapper: captures the submitting thread's trace
+    context EXPLICITLY (worker threads have empty contextvars) and
+    re-parents each invocation under it.  A fresh child per call keeps
+    speculative/requeued copies of one job distinguishable — and avoids
+    contextvars.Context.run's no-concurrent-reentry restriction."""
+    parent = current_trace()
+
+    def wrapped(job):
+        span = parent.create_child("operation.job")
+        span.add_tag("index", index)
+        with span:
+            return run(job)
+
+    return wrapped
 
 
 def _raise_if_aborted(op) -> None:

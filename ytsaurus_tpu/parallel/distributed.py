@@ -903,6 +903,7 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
 
     from ytsaurus_tpu.query.coordinator import coordinate_and_execute
     from ytsaurus_tpu.utils.logging import log_event
+    from ytsaurus_tpu.utils.tracing import child_span
 
     errors: "list[YtError]" = []
     de = evaluator if evaluator is not None else DistributedEvaluator(mesh)
@@ -918,20 +919,30 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
             or (plan.window is not None and plan.window.partition_items)
         if prefer_shuffle and shuffled_shape and not plan.joins:
             try:
-                return de.run(plan, table, foreign_chunks, shuffle=True)
+                # One span per degradation rung, tagged with its rung
+                # index — a query served off-rung shows WHERE it fell.
+                with child_span("distributed.shuffle", rung=0,
+                                shards=len(chunks)):
+                    return de.run(plan, table, foreign_chunks,
+                                  shuffle=True)
             except YtError as err:
                 errors.append(err)
                 log_event(_ladder_log, _logging.WARNING,
                           "degrade_to_gather", error=str(err))
         try:
-            return de.run(plan, table, foreign_chunks, shuffle=False)
+            with child_span("distributed.gather_merge", rung=1,
+                            shards=len(chunks)):
+                return de.run(plan, table, foreign_chunks, shuffle=False)
         except YtError as err:
             errors.append(err)
             log_event(_ladder_log, _logging.WARNING,
                       "degrade_to_host", error=str(err))
     try:
-        return coordinate_and_execute(plan, list(chunks), foreign_chunks,
-                                      evaluator=host_evaluator)
+        with child_span("distributed.host_coordinate", rung=2,
+                        shards=len(chunks)):
+            return coordinate_and_execute(plan, list(chunks),
+                                          foreign_chunks,
+                                          evaluator=host_evaluator)
     except YtError as err:
         if not errors:
             raise

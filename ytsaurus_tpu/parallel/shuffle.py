@@ -177,6 +177,14 @@ def sort_table(table: ShardedTable, key_columns: Sequence[str],
     if n == 1:
         return _sort_single(table, key_names, descending)
 
+    return _sort_table_sharded(table, key_names, descending)
+
+
+def _sort_table_sharded(table: ShardedTable, key_names: "list[str]",
+                        descending: bool) -> ShardedTable:
+    from ytsaurus_tpu.utils.tracing import child_span
+    mesh = table.mesh
+    n = table.n_shards
     pivots = _sample_pivots(table, key_names)
     # Pivot planes as device constants: [(valid_rank, value)] per key.
     pivot_planes = []
@@ -203,12 +211,13 @@ def sort_table(table: ShardedTable, key_columns: Sequence[str],
 
     key_planes_global = [(table.columns[k].data, table.columns[k].valid)
                          for k in key_names]
-    counts = shard_map(
-        count_pass, mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=P(SHARD_AXIS), check_vma=False)(
-            key_planes_global, table.row_valid)
-    counts_np = np.asarray(counts)              # (n_src, n_dst)
+    with child_span("sort.partition", shards=n):
+        counts = shard_map(
+            count_pass, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            out_specs=P(SHARD_AXIS), check_vma=False)(
+                key_planes_global, table.row_valid)
+        counts_np = np.asarray(counts)          # (n_src, n_dst)
 
     # Skew-robust sizing (ref: the partition tree's multi-level splitting,
     # controllers/sort_controller.cpp:459+, re-expressed for a fixed-shape
@@ -309,13 +318,21 @@ def sort_table(table: ShardedTable, key_columns: Sequence[str],
 
     columns_global = {name: (table.columns[name].data,
                              table.columns[name].valid) for name in names}
-    mapped = shard_map(
-        exchange, mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                  P(SHARD_AXIS)),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), check_vma=False)
-    out_columns_planes, out_counts = jax.jit(mapped)(
-        columns_global, key_planes_global, table.row_valid, prefix_sharded)
+    # all_to_all payload: routed rows x per-row plane bytes (+1 for each
+    # validity bit plane) — the wire cost tag on the shuffle span.
+    bytes_per_row = sum(
+        np.dtype(table.columns[name].data.dtype).itemsize + 1
+        for name in names)
+    with child_span("sort.shuffle", shards=n, rounds=rounds,
+                    all_to_all_bytes=int(counts_np.sum()) * bytes_per_row):
+        mapped = shard_map(
+            exchange, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                      P(SHARD_AXIS)),
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), check_vma=False)
+        out_columns_planes, out_counts = jax.jit(mapped)(
+            columns_global, key_planes_global, table.row_valid,
+            prefix_sharded)
 
     out_counts_np = [int(c) for c in np.asarray(out_counts)]
     lost = table.total_rows - sum(out_counts_np)

@@ -11,6 +11,7 @@ offset/limit apply only at the front.
 
 from __future__ import annotations
 
+import contextvars
 import time
 from dataclasses import replace
 from typing import Mapping, Optional, Sequence
@@ -22,6 +23,7 @@ from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.engine.evaluator import Evaluator, finish_all
 from ytsaurus_tpu.schema import EValueType
 from ytsaurus_tpu.utils import failpoints
+from ytsaurus_tpu.utils.tracing import NULL_SPAN, child_span
 
 # How each aggregate's partial state is merged at the front.
 _MERGE_FN = {"sum": "sum", "count": "sum", "min": "min", "max": "max",
@@ -52,32 +54,45 @@ def _is_transient(err: Exception) -> bool:
 
 
 def _retry_transient(fn, site: "Optional[failpoints.FailpointSite]" = None,
-                     token=None):
+                     token=None, span_name: Optional[str] = None,
+                     **span_tags):
     """Jittered-exponential-backoff retry of transient failures (policy
     `query_shard` in config.py) around one shard-granular step.  A token
     past its deadline stops the ladder — retries must not keep a dead
-    query alive past its budget."""
+    query alive past its budget.  `span_name` opens one child span PER
+    ATTEMPT (same trace, fresh span, tagged `attempt=`), so a retried
+    shard shows every try in the flight recorder."""
     policy = retry_policy("query_shard")
     for attempt in range(policy.attempts):
         try:
-            if token is not None:
-                token.check()
-            if site is not None:
-                site.hit()
-            return fn()
+            with child_span(span_name, attempt=attempt, **span_tags) \
+                    if span_name is not None else NULL_SPAN:
+                if token is not None:
+                    token.check()
+                if site is not None:
+                    site.hit()
+                return fn()
         except (OSError, YtError) as err:
             if not _is_transient(err) or attempt + 1 >= policy.attempts:
                 raise
             time.sleep(policy.delay(attempt))
 
 
-def _wrap_lazy_shard(shard, token=None):
+def _wrap_lazy_shard(shard, token=None, index: Optional[int] = None):
     """Lazy shards retry their own staging so one transient chunk-read
-    failure doesn't sink the whole scan."""
+    failure doesn't sink the whole scan.  The CALLER's trace context is
+    captured explicitly: staging runs on prefetch-executor threads whose
+    contextvars would otherwise be empty, unlinking the stage spans."""
     if not callable(shard):
         return shard
-    return lambda: _retry_transient(shard, site=_FP_MATERIALIZE,
-                                    token=token)
+    captured = contextvars.copy_context()
+
+    def staged():
+        return _retry_transient(shard, site=_FP_MATERIALIZE, token=token,
+                                span_name="coordinator.shard_stage",
+                                shard=index)
+
+    return lambda: captured.run(staged)
 
 
 def split_plan(plan: ir.Query) -> tuple[ir.Query, ir.FrontQuery]:
@@ -396,7 +411,8 @@ def coordinate_and_execute(
         token.check()
     lazy = any(callable(c) for c in chunks)
     if lazy:
-        chunks = [_wrap_lazy_shard(c, token=token) for c in chunks]
+        chunks = [_wrap_lazy_shard(c, token=token, index=i)
+                  for i, c in enumerate(chunks)]
     # Early-exit budget, decided BEFORE any shard coalescing: when a
     # LIMIT scan can stop after the first shard or two, merging every
     # shard into one big program would do strictly more work than the
@@ -441,7 +457,8 @@ def coordinate_and_execute(
         result = _retry_transient(
             lambda: evaluator.run_plan(plan, chunk, foreign_chunks,
                                        stats=stats, token=token),
-            site=_FP_EXECUTE, token=token)
+            site=_FP_EXECUTE, token=token,
+            span_name="coordinator.shard", shard=0)
     else:
         bottom, front = split_plan(plan)
         # LIMIT early-exit (ref: pull-model readers stop at the limit,
@@ -508,14 +525,16 @@ def coordinate_and_execute(
                         lambda c=chunk: evaluator.run_plan_async(
                             bottom, c, foreign_chunks, stats=stats,
                             token=token),
-                        site=_FP_EXECUTE, token=token))
+                        site=_FP_EXECUTE, token=token,
+                        span_name="coordinator.shard", shard=i))
                     scanner.feedback()
                     continue
                 partial = _retry_transient(
                     lambda c=chunk: evaluator.run_plan(
                         bottom, c, foreign_chunks, stats=stats,
                         token=token),
-                    site=_FP_EXECUTE, token=token)
+                    site=_FP_EXECUTE, token=token,
+                    span_name="coordinator.shard", shard=i)
                 partials.append(partial)
                 collected += partial.row_count
                 if needed is not None and collected >= needed:
@@ -528,10 +547,12 @@ def coordinate_and_execute(
             scanner.close()
         if deferred:
             partials = finish_all(partials)
-        merged = concat_chunks(
-            [p.slice_rows(0, p.row_count) for p in partials])
-        result = evaluator.run_plan(front, merged, stats=stats,
-                                    token=token)
+        with child_span("coordinator.front_merge",
+                        partials=len(partials)):
+            merged = concat_chunks(
+                [p.slice_rows(0, p.row_count) for p in partials])
+            result = evaluator.run_plan(front, merged, stats=stats,
+                                        token=token)
     if stats is not None:
         stats.rows_written += result.row_count
     return result

@@ -32,7 +32,8 @@ class _PendingResult:
     synchronize once (`finish_all`), instead of stalling the dispatch
     queue on a host read per shard."""
 
-    __slots__ = ("planes", "count", "output", "stats", "_t0", "_chunk")
+    __slots__ = ("planes", "count", "output", "stats", "_t0", "_chunk",
+                 "compile_seconds")
 
     def __init__(self, planes, count, output, stats=None, t0=None):
         self.planes = planes
@@ -40,6 +41,7 @@ class _PendingResult:
         self.output = output
         self.stats = stats
         self._t0 = t0
+        self.compile_seconds = 0.0
         self._chunk: Optional[ColumnarChunk] = None
 
     def finish(self, host_count: Optional[int] = None) -> ColumnarChunk:
@@ -124,19 +126,25 @@ class Evaluator:
         shard's program before the first host sync."""
         import time as _time
 
-        from ytsaurus_tpu.utils.tracing import start_span
+        from ytsaurus_tpu.utils.tracing import child_span
         if token is not None:
             token.check()
         t0 = _time.perf_counter()
         # Span per plan execution, tagged with the plan fingerprint (ref:
         # evaluator.cpp:67-75 annotates spans with query fingerprints);
-        # computed once and reused as the compile-cache key.
+        # computed once and reused as the compile-cache key.  INTERIOR
+        # site: records only under a live trace (gateway/scheduler root),
+        # so untraced evaluator use stays on the null fast path.
         fp = ir.fingerprint(plan)
-        span = start_span("Evaluator.run_plan", fingerprint=fp,
+        span = child_span("evaluator.run_plan", fingerprint=fp,
                           rows=chunk.row_count)
         with span:
-            return self._dispatch_traced(plan, chunk, foreign_chunks,
-                                         stats, t0, fp)
+            pending = self._dispatch_traced(plan, chunk, foreign_chunks,
+                                            stats, t0, fp)
+            span.add_tag("compile_seconds",
+                         round(getattr(pending, "compile_seconds", 0.0),
+                               6))
+            return pending
 
     def _dispatch_traced(self, plan, chunk, foreign_chunks, stats, t0,
                          fp=None):
@@ -168,39 +176,83 @@ class Evaluator:
         # The concat needs both row counts, so totals plans materialize
         # eagerly.
         if plan.group is not None and plan.group.totals:
-            result = self._dispatch(plan, chunk, stats, fp=fp).finish()
+            main = self._dispatch(plan, chunk, stats, fp=fp)
+            result = main.finish()
             totals_plan = _make_totals_plan(plan)
-            totals = self._dispatch(totals_plan, chunk, stats).finish()
+            totals_pending = self._dispatch(totals_plan, chunk, stats)
+            totals = totals_pending.finish()
             result = concat_chunks([result, totals])
             if stats is not None:
-                stats.execute_time += _time.perf_counter() - t0
+                # Compile time is tallied separately inside _dispatch;
+                # keep it out of the execute bucket.
+                stats.execute_time += _time.perf_counter() - t0 - \
+                    main.compile_seconds - totals_pending.compile_seconds
             return _ReadyResult(result)
 
         pending = self._dispatch(plan, chunk, stats, fp=fp)
         pending.stats = stats
-        pending._t0 = t0
+        # The execute clock starts after compilation: wall = compile +
+        # execute, reported separately (EXPLAIN ANALYZE's first split).
+        pending._t0 = t0 + pending.compile_seconds
         return pending
 
     def _dispatch(self, plan, chunk: ColumnarChunk,
                   stats: Optional[QueryStatistics] = None,
                   fp: Optional[str] = None) -> _PendingResult:
+        import time as _time
+
+        from ytsaurus_tpu.utils.tracing import child_span
         prepared = prepare(plan, chunk)
         key = (fp or ir.fingerprint(plan), chunk.capacity,
                prepared.binding_shapes())
-        jitted = self._cache.get(key)
-        if jitted is None:
-            jitted = jax.jit(prepared.run)
-            self._cache[key] = jitted
-            if stats is not None:
-                stats.compile_count += 1
-        elif stats is not None:
-            stats.cache_hits += 1
         columns = {c.name: (chunk.columns[c.name].data,
                             chunk.columns[c.name].valid)
                    for c in plan.schema}
-        planes, count = jitted(columns, chunk.row_valid,
-                               tuple(prepared.bindings))
-        return _PendingResult(planes, count, prepared.output)
+        args = (columns, chunk.row_valid, tuple(prepared.bindings))
+        fn = self._cache.get(key)
+        compile_seconds = 0.0
+        result = None
+        if fn is None:
+            # Cache miss: build the device program NOW (AOT lower +
+            # compile, the XLA analog of the reference's LLVM codegen
+            # pass) so compile time is measured apart from execution.
+            # Shapes/dtypes are pinned by the cache key (capacity +
+            # binding shapes), which is exactly what AOT requires.
+            with child_span("evaluator.compile", fingerprint=key[0],
+                            capacity=chunk.capacity):
+                t0c = _time.perf_counter()
+                jitted = jax.jit(prepared.run)
+                try:
+                    fn = jitted.lower(*args).compile()
+                except Exception:   # noqa: BLE001 — AOT is an
+                    # optimization; anything it cannot lower falls back
+                    # to the jit wrapper (first call compiles fused).
+                    fn = jitted
+                    result = fn(*args)
+                compile_seconds = _time.perf_counter() - t0c
+            self._cache[key] = fn
+            if stats is not None:
+                stats.compile_count += 1
+                stats.compile_time += compile_seconds
+        elif stats is not None:
+            stats.cache_hits += 1
+        if result is None:
+            try:
+                planes, count = fn(*args)
+            except Exception:
+                if hasattr(fn, "lower"):
+                    raise             # plain jitted fn: a genuine error
+                # AOT-compiled rejects an aval drift the cache key did
+                # not capture: rebuild through the tolerant jit wrapper
+                # (a genuine execution error re-raises identically).
+                fn = jax.jit(prepared.run)
+                self._cache[key] = fn
+                planes, count = fn(*args)
+        else:
+            planes, count = result
+        pending = _PendingResult(planes, count, prepared.output)
+        pending.compile_seconds = compile_seconds
+        return pending
 
     def _execute(self, plan, chunk: ColumnarChunk,
                  stats: Optional[QueryStatistics] = None,

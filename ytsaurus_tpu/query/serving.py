@@ -55,6 +55,7 @@ from ytsaurus_tpu.config import ServingConfig
 from ytsaurus_tpu.errors import EErrorCode, ThrottledError, YtError
 from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.profiling import Profiler
+from ytsaurus_tpu.utils.tracing import NULL_SPAN, child_span, current_trace
 
 _FP_ADMIT = failpoints.register_site(
     "serving.admit",
@@ -304,10 +305,12 @@ class _Batch:
     left — members whose own deadline lapses time out individually in
     `lookup()`.  `pool` is the first member's pool (admission is one
     slot per flush; mixed-pool cohorts charge the pool that opened the
-    batch)."""
+    batch).  `trace` captures the OPENING member's trace context so the
+    flusher thread (which has no ambient context of its own) can parent
+    its batch-flush span into that caller's trace."""
 
     __slots__ = ("key_lists", "deadline", "pool", "client", "created",
-                 "done", "results", "error")
+                 "done", "results", "error", "trace")
 
     def __init__(self, token: CancellationToken, client):
         self.key_lists: list = []       # list[list[nkey]] per request
@@ -318,6 +321,7 @@ class _Batch:
         self.done = threading.Event()
         self.results: dict = {}
         self.error: Optional[BaseException] = None
+        self.trace = current_trace()
 
     def join(self, token: CancellationToken) -> None:
         if self.deadline is not None:
@@ -404,6 +408,14 @@ class LookupBatcher:
     def lookup(self, client, path: str, keys: Sequence[tuple],
                timestamp: int, column_names, token: CancellationToken,
                pool: Optional[str] = None):
+        with child_span("serving.lookup", table=path, keys=len(keys)):
+            return self._lookup_traced(client, path, keys, timestamp,
+                                       column_names, token, pool)
+
+    def _lookup_traced(self, client, path: str, keys: Sequence[tuple],
+                       timestamp: int, column_names,
+                       token: CancellationToken,
+                       pool: Optional[str] = None):
         t0 = time.monotonic()
         self.requests_n += 1
         self.requests.increment()
@@ -499,53 +511,73 @@ class LookupBatcher:
 
     def _flush(self, path, timestamp, batch: _Batch) -> None:
         token = batch.flush_token()      # cohort-max deadline
-        try:
-            state = self.admission.admit(token, batch.pool)
-        except BaseException as exc:
-            self._fail(batch, exc)
-            return
-        t0 = time.monotonic()
-        try:
-            _FP_BATCH_FLUSH.hit()
-            token.check()
-            client = batch.client
-            ctx = self._context(client, path)
-            # Union of the batch's keys, deduplicated (two callers
-            # asking for the same row share one read); normalized keys
-            # ARE canonical keys, so they feed the tablets directly.
-            union = dict.fromkeys(
-                nk for ks in batch.key_lists for nk in ks)
-            self.batches_n += 1
-            self.batched_keys_n += len(union)
-            self.batches.increment()
-            self.batched_keys.increment(len(union))
-            self.batch_size_hist.record(len(union))
-            results: dict[tuple, Optional[dict]] = {}
-            items = list(ctx.route(union).items())
-            if len(items) > 1 and len(union) >= 32:
-                # Parallel per-tablet fan-out (the sequential per-tablet
-                # loop was the pre-gateway bottleneck, client.py:1136);
-                # small batches stay inline — dispatch overhead would
-                # exceed the read.
-                futures = [
-                    self._executor.submit(self._read_tablet,
-                                          ctx.tablets, idx, part,
-                                          timestamp)
-                    for idx, part in items]
-                for fut in futures:
-                    results.update(fut.result())
-            else:
-                for idx, part in items:
-                    results.update(self._read_tablet(
-                        ctx.tablets, idx, part, timestamp))
-            batch.results = results
-            batch.done.set()
-        except BaseException as exc:  # noqa: BLE001 — relayed to waiters
-            self._fail(batch, exc)
-            if not isinstance(exc, Exception):
-                raise      # InjectedCrash still pierces this flush
-        finally:
-            self.admission.release(state, time.monotonic() - t0)
+        # Parent the flush span into the OPENING caller's trace (the
+        # flusher thread has no ambient context): the cohort members see
+        # one shared batch-flush child under the first joiner.
+        parent = batch.trace
+        span = parent.create_child("serving.batch_flush") \
+            if parent is not None and parent.sampled else NULL_SPAN
+        span.add_tag("table", path)
+        span.add_tag("cohort", len(batch.key_lists))
+        with span:
+            try:
+                with child_span("serving.admission", pool=batch.pool):
+                    state = self.admission.admit(token, batch.pool)
+            except BaseException as exc:
+                self._fail(batch, exc)
+                return
+            t0 = time.monotonic()
+            try:
+                self._flush_admitted(path, timestamp, batch, token, span)
+            except BaseException as exc:  # noqa: BLE001 — relayed to
+                # waiters
+                self._fail(batch, exc)
+                if not isinstance(exc, Exception):
+                    raise      # InjectedCrash still pierces this flush
+            finally:
+                self.admission.release(state, time.monotonic() - t0)
+
+    def _flush_admitted(self, path, timestamp, batch: _Batch, token,
+                        span) -> None:
+        _FP_BATCH_FLUSH.hit()
+        token.check()
+        client = batch.client
+        ctx = self._context(client, path)
+        # Union of the batch's keys, deduplicated (two callers
+        # asking for the same row share one read); normalized keys
+        # ARE canonical keys, so they feed the tablets directly.
+        union = dict.fromkeys(
+            nk for ks in batch.key_lists for nk in ks)
+        span.add_tag("keys", len(union))
+        self.batches_n += 1
+        self.batched_keys_n += len(union)
+        self.batches.increment()
+        self.batched_keys.increment(len(union))
+        self.batch_size_hist.record(len(union))
+        results: dict[tuple, Optional[dict]] = {}
+        items = list(ctx.route(union).items())
+        if len(items) > 1 and len(union) >= 32:
+            # Parallel per-tablet fan-out (the sequential per-tablet
+            # loop was the pre-gateway bottleneck, client.py:1136);
+            # small batches stay inline — dispatch overhead would
+            # exceed the read.  Each future carries an explicit
+            # contextvars copy: executor threads have no ambient trace,
+            # and the tablet-read spans must link under this flush.
+            import contextvars as _cv
+            futures = [
+                self._executor.submit(_cv.copy_context().run,
+                                      self._read_tablet,
+                                      ctx.tablets, idx, part,
+                                      timestamp)
+                for idx, part in items]
+            for fut in futures:
+                results.update(fut.result())
+        else:
+            for idx, part in items:
+                results.update(self._read_tablet(
+                    ctx.tablets, idx, part, timestamp))
+        batch.results = results
+        batch.done.set()
 
     def _read_tablet(self, tablets, idx: int, part: list,
                      timestamp: int) -> dict:
@@ -619,7 +651,19 @@ class QueryGateway:
         if not self.enabled:
             return fn(None)
         token = self.make_token(timeout, pool)
-        state = self.admission.admit(token, pool)
+        # The admission wait is its own span: a query that queued 40ms
+        # behind a saturated pool must show that 40ms as admission, not
+        # as mystery execution time.  The wait is ALSO stamped as a tag
+        # on the ambient root so ExecutionProfile.capture reads it with
+        # a dict probe instead of scanning the span ring.
+        t_admit = time.monotonic()
+        with child_span("serving.admission",
+                        pool=pool or self.config.default_pool):
+            state = self.admission.admit(token, pool)
+        root = current_trace()
+        if root is not None:
+            root.add_tag("admission_wait_s",
+                         round(time.monotonic() - t_admit, 6))
         t0 = time.monotonic()
         try:
             return fn(token)

@@ -12,6 +12,7 @@ class QueryStatistics:
     rows_read: int = 0
     rows_written: int = 0
     execute_time: float = 0.0        # seconds, wall, incl. device sync
+    compile_time: float = 0.0        # seconds building device programs
     compile_count: int = 0           # programs compiled (cache misses)
     cache_hits: int = 0
     shards_total: int = 0

@@ -298,12 +298,18 @@ class RemoteYtClient:
         return self._execute("lookup_rows", params)
 
     def select_rows(self, query: str, timeout: Optional[float] = None,
-                    pool: Optional[str] = None) -> list[dict]:
+                    pool: Optional[str] = None,
+                    explain_analyze: bool = False) -> list[dict]:
         params: dict = {"query": query}
         if timeout is not None:
             params["timeout"] = timeout
         if pool is not None:
             params["pool"] = pool
+        if explain_analyze:
+            # Server-side profile, returned as a plain dict (the span
+            # tree lives in the PRIMARY's collector; `yt trace` reads it
+            # back through the orchid).
+            params["explain_analyze"] = True
         return self._execute("select_rows", params)
 
     def push_queue(self, path: str, rows: Sequence[dict]) -> int:

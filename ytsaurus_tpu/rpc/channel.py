@@ -262,11 +262,18 @@ class RetryingChannel:
              attachments=(), timeout: float | None = None,
              idempotent: bool = True):
         from ytsaurus_tpu.errors import retry_after_hint
+        from ytsaurus_tpu.utils.tracing import child_span
         last: YtError | None = None
         for attempt in range(self._policy.attempts):
             try:
-                return self.channel.call(service, method, body,
-                                         attachments, timeout)
+                # Fresh span PER ATTEMPT on the SAME trace (ISSUE 5
+                # satellite): the wire then carries a distinct parent
+                # span id for each try, so retried server work nests
+                # under its own attempt instead of aliasing the first.
+                with child_span("rpc.call", service=service,
+                                method=method, attempt=attempt):
+                    return self.channel.call(service, method, body,
+                                             attachments, timeout)
             except YtError as err:
                 if err.contains(EErrorCode.DeadlineExceeded):
                     # Terminal: the caller's query deadline already

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextvars
 import socket
 import threading
 import traceback
@@ -220,9 +221,15 @@ class RpcServer:
 
             def invoke():
                 # Server span continues the caller's trace (ref: rpc
-                # handlers run under the propagated TTraceContext).
-                with TraceContext.from_wire(trace_wire,
-                                            f"{service}.{method}") as span:
+                # handlers run under the propagated TTraceContext).  An
+                # UNtraced request gets the null span — handlers must
+                # not mint root traces per RPC (the entry points that
+                # own sampling are the gateway/scheduler/proxy).
+                from ytsaurus_tpu.utils.tracing import NULL_SPAN
+                span = TraceContext.from_wire(
+                    trace_wire, f"{service}.{method}") \
+                    if trace_wire else NULL_SPAN
+                with span:
                     span.add_tag("service", service)
                     prof = _profiler.with_tags(service=service,
                                                method=method)
@@ -230,9 +237,18 @@ class RpcServer:
                     with prof.timer("request_time"):
                         return fn(body, attachments)
 
+            # EXPLICIT contextvars capture (ISSUE 5 satellite): the
+            # handler runs on a pooled executor thread whose context is
+            # whatever the PREVIOUS request left behind —
+            # run_in_executor does not propagate or isolate contextvars.
+            # Running inside a fresh copy of the (clean) loop context
+            # both restores the caller's restored-from-wire trace and
+            # guarantees a handler that leaked an ambient context cannot
+            # poison the next request on the same thread.
+            handler_ctx = contextvars.copy_context()
             async with sem:
                 result = await asyncio.get_event_loop().run_in_executor(
-                    self._pool, invoke)
+                    self._pool, lambda: handler_ctx.run(invoke))
             if isinstance(result, tuple):
                 out_body, out_attachments = result
             else:

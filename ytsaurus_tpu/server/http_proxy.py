@@ -166,8 +166,23 @@ class HttpProxy:
                 params.setdefault("timeout", float(header_timeout))
             except ValueError:
                 pass
+        # Distributed tracing over HTTP (ISSUE 5): an X-YT-Trace-Id
+        # header pins (and force-samples) the query's trace id, so the
+        # caller can fetch the span tree from /traces/<id> afterwards;
+        # the id is echoed on the response either way the trace rooted.
+        trace_header = request.headers.get("X-YT-Trace-Id")
+        from ytsaurus_tpu.utils.tracing import NULL_SPAN, start_query_span
+        span = NULL_SPAN
+        if command in ("select_rows", "lookup_rows"):
+            span = start_query_span(f"http.{command}",
+                                    force=trace_header is not None,
+                                    trace_id=trace_header or None,
+                                    user=user)
+        if span.trace_id:
+            request.yt_trace_id = span.trace_id
         try:
-            result = self._execute(command, params, data_body, user)
+            with span:
+                result = self._execute(command, params, data_body, user)
         except YtError as err:
             self._reply_error(request, err)
             return
@@ -245,6 +260,9 @@ class HttpProxy:
         body = json.dumps(err.to_dict(), default=_json_default).encode()
         request.send_response(status)
         request.send_header("Content-Type", "application/json")
+        trace_id = getattr(request, "yt_trace_id", None)
+        if trace_id:
+            request.send_header("X-YT-Trace-Id", trace_id)
         if retry_after is not None:
             request.send_header("Retry-After", f"{retry_after:.3f}")
         request.send_header("X-YT-Error", json.dumps(
@@ -258,6 +276,9 @@ class HttpProxy:
     def _reply(request, status: int, body: bytes, ctype: str) -> None:
         request.send_response(status)
         request.send_header("Content-Type", ctype)
+        trace_id = getattr(request, "yt_trace_id", None)
+        if trace_id:
+            request.send_header("X-YT-Trace-Id", trace_id)
         request.send_header("Content-Length", str(len(body)))
         request.end_headers()
         request.wfile.write(body)

@@ -1,4 +1,5 @@
-"""Monitoring HTTP endpoint: /metrics (Prometheus), /orchid/..., /healthz.
+"""Monitoring HTTP endpoint: /metrics (Prometheus), /orchid/...,
+/healthz, /traces (query flight recorder).
 
 Ref shape: library/profiling/solomon/exporter.h:25 — every daemon hosts a
 pull endpoint the monitoring system scrapes; Orchid doubles as the
@@ -100,6 +101,31 @@ class MonitoringServer:
             body = json.dumps({"snapshot_cache": snapshot_cache_stats()},
                               indent=2).encode()
             self._reply(request, 200, body, "application/json")
+        elif path == "/traces" or path.startswith("/traces/"):
+            # Query flight recorder (ISSUE 5): the listing serves recent
+            # trace summaries + the bounded slow-query/recent profile
+            # logs; /traces/<trace_id> renders that trace's span tree.
+            from ytsaurus_tpu.query.profile import get_flight_recorder
+            from ytsaurus_tpu.utils.tracing import span_tree, trace_summaries
+            if path == "/traces":
+                body = json.dumps({
+                    "recent_traces": trace_summaries(),
+                    **get_flight_recorder().snapshot(),
+                }, indent=2, default=_json_default).encode()
+                self._reply(request, 200, body, "application/json")
+            else:
+                trace_id = path[len("/traces/"):]
+                tree = span_tree(trace_id)
+                if not tree:
+                    self._reply(request, 404, json.dumps(
+                        {"error": f"no such trace {trace_id!r} "
+                                  "(unsampled or evicted)"}).encode(),
+                        "application/json")
+                    return
+                body = json.dumps({"trace_id": trace_id, "spans": tree},
+                                  indent=2,
+                                  default=_json_default).encode()
+                self._reply(request, 200, body, "application/json")
         elif path in ("/metrics", "/solomon"):
             body = self.registry.render_prometheus().encode()
             self._reply(request, 200, body, "text/plain; version=0.0.4")

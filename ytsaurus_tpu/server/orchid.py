@@ -137,4 +137,19 @@ def default_orchid(config=None) -> OrchidTree:
     tree.register("/tracing/recent_spans",
                   lambda: [s.to_dict() for s in
                            get_collector().snapshot()[-64:]])
+    # Flight-recorder views: span trees by trace id (what `yt trace`
+    # reads over the RPC orchid) + the bounded slow-query log.
+    tree.register("/tracing/traces", _traces_producer)
+    tree.register("/tracing/slow_queries", _slow_queries_producer)
     return tree
+
+
+def _traces_producer() -> dict:
+    from ytsaurus_tpu.utils.tracing import all_span_trees
+    return all_span_trees()
+
+
+def _slow_queries_producer() -> list:
+    from ytsaurus_tpu.query.profile import get_flight_recorder
+    return [p.to_dict(include_rows=False)
+            for p in get_flight_recorder().slow_queries()]

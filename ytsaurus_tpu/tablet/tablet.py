@@ -29,6 +29,7 @@ from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.utils import invariants
 from ytsaurus_tpu.utils.invariants import check as _invariant_check
 from ytsaurus_tpu.utils.profiling import Profiler
+from ytsaurus_tpu.utils.tracing import child_span
 from ytsaurus_tpu.schema import EValueType, SortOrder, TableSchema
 from ytsaurus_tpu.tablet import mvcc
 from ytsaurus_tpu.tablet.dynamic_store import SortedDynamicStore
@@ -448,16 +449,21 @@ class Tablet:
         version) memoize the materialized chunk per generation, so
         repeated selects skip the merge entirely until the next
         write/flush/compact."""
-        with self._lock:
+        with child_span("tablet.read_snapshot") as span, self._lock:
             generation = self._generation()
             latest = timestamp >= self._latest_ts_floor()
             if latest:
                 cached = self._snapshot_cache
                 if cached is not None and cached[0] == generation:
                     _SNAP_HITS.increment()
+                    span.add_tag("snapshot_cache", "hit")
+                    span.add_tag("rows", cached[1].row_count)
                     return cached[1]
                 _SNAP_MISSES.increment()
+            span.add_tag("snapshot_cache",
+                         "miss" if latest else "bypass")
             chunk = self._read_snapshot_uncached(timestamp)
+            span.add_tag("rows", chunk.row_count)
             if latest and tablet_config().snapshot_cache_enabled:
                 if self._snapshot_cache is not None:
                     _SNAP_EVICTIONS.increment()
@@ -472,16 +478,21 @@ class Tablet:
         for cid in self.chunk_ids:
             total += self._decode(cid).row_count
         if not self._vectorize(total):
-            return self.read_snapshot_reference(timestamp)
-        sources = [self._normalize_versioned(self._decode(cid))
-                   for cid in self.chunk_ids]
-        sources += [s.to_versioned_chunk(self._versioned_schema)
-                    for s in self.passive_stores + [self.active_store]
-                    if s.store_row_count]
-        if not sources:
-            return ColumnarChunk.from_rows(self.schema.to_unsorted(), [])
-        return mvcc.visible_chunk(concat_chunks(sources), self.schema,
-                                  timestamp)
+            with child_span("tablet.mvcc_merge", vectorized=False,
+                            versions=total):
+                return self.read_snapshot_reference(timestamp)
+        with child_span("tablet.mvcc_merge", vectorized=True,
+                        versions=total):
+            sources = [self._normalize_versioned(self._decode(cid))
+                       for cid in self.chunk_ids]
+            sources += [s.to_versioned_chunk(self._versioned_schema)
+                        for s in self.passive_stores + [self.active_store]
+                        if s.store_row_count]
+            if not sources:
+                return ColumnarChunk.from_rows(
+                    self.schema.to_unsorted(), [])
+            return mvcc.visible_chunk(concat_chunks(sources), self.schema,
+                                      timestamp)
 
     def read_snapshot_reference(self,
                                 timestamp: int = MAX_TIMESTAMP
@@ -509,7 +520,8 @@ class Tablet:
         the per-chunk cost drops from O(rows x keys) to O(rows +
         matches), which is what makes the serving plane's micro-batches
         pay off (ref tablet_node/lookup.cpp batched lookup sessions)."""
-        with self._lock:
+        with child_span("tablet.lookup", keys=len(keys),
+                        chunks=len(self.chunk_ids)), self._lock:
             key_names = self.schema.key_column_names
             out: list[Optional[dict]] = []
             if not normalized:

@@ -20,10 +20,20 @@ import time
 from typing import Optional
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus exposition escaping for label values: backslash,
+    double quote, and newline must be escaped or the scrape line is
+    grammatically invalid (the exposition-validator test enforces it)."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _format_tags(tags: dict[str, str]) -> str:
     if not tags:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    inner = ",".join(
+        f'{_sanitize(str(k))}="{_escape_label_value(v)}"'
+        for k, v in sorted(tags.items()))
     return "{" + inner + "}"
 
 

@@ -1,4 +1,5 @@
-"""Distributed trace contexts with sampling and baggage.
+"""Distributed trace contexts with sampling, baggage, and a flight
+recorder (ISSUE 5 tentpole substrate).
 
 Ref shape: core/tracing/trace_context.h:75 — a TTraceContext carries
 (trace id, span id, parent span id, sampled flag, baggage), is propagated
@@ -7,27 +8,90 @@ spans go to an exporter (Jaeger in the reference).
 
 Redesign: a `contextvars`-based ambient context (survives asyncio + thread
 pools via explicit capture in the RPC layer), spans finished into an
-in-process ring buffer that Orchid/tests read; the wire encoding is a plain
-dict injected into the RPC envelope.
+in-process ring buffer that Orchid/monitoring `/traces` read; the wire
+encoding is a plain dict injected into the RPC envelope.
+
+Span-site discipline (what keeps an untraced hot path ~free):
+
+  start_span(name)        child of the ambient context, or a SAMPLED
+                          fresh root (rate from config.TracingConfig) —
+                          legacy entry-point helper.
+  child_span(name)        INTERIOR site: child of the ambient context,
+                          NULL when there is none (or it is unsampled).
+                          This is the probe threaded through the query/
+                          operation planes; its disabled fast path is one
+                          contextvar read + a singleton return (≲1µs,
+                          asserted by `bench.py --config trace_overhead`,
+                          mirroring the failpoints fast-path assert).
+  start_query_span(name)  ENTRY point (gateway select/lookup, scheduler
+                          operation, HTTP proxy): continues the ambient
+                          trace when one exists, else roots a new trace
+                          subject to `enabled` + `sample_rate` —
+                          `force=True` (explain_analyze) always samples.
+
+The collector is a bounded ring with a CURSOR-based drain: the daemon's
+TraceExporter consumes each span once while `/traces`, `find()`, and the
+flight recorder keep serving from the retained tail.
 """
 
 from __future__ import annotations
 
 import contextvars
+import itertools
+import os
+import random
 import threading
 import time
-import uuid
 from typing import Any, Optional
+
+# Id generation: a per-process random prefix + an atomic counter (the
+# `itertools.count` step is GIL-atomic).  uuid4 costs ~16µs per call in
+# entropy-starved containers — two per span would dwarf every other cost
+# on the sampled path; ids only need uniqueness, not unpredictability.
+_ID_PREFIX = int.from_bytes(os.urandom(8), "big")
+_ID_COUNTER = itertools.count(int.from_bytes(os.urandom(6), "big"))
+_ID_MASK = (1 << 64) - 1
+
+
+def _new_trace_id() -> str:
+    return f"{_ID_PREFIX:016x}{next(_ID_COUNTER) & _ID_MASK:016x}"
+
+
+def _new_span_id() -> str:
+    # Mixed with the process prefix so two processes sharing one trace
+    # cannot collide span ids at similar counter values.
+    return f"{(_ID_PREFIX ^ (next(_ID_COUNTER) * 0x9E3779B97F4A7C15)) & _ID_MASK:016x}"
 
 _current: contextvars.ContextVar[Optional["TraceContext"]] = \
     contextvars.ContextVar("trace_context", default=None)
+
+# Fast-path mirrors of config.TracingConfig (one module-global read per
+# span site, same discipline as utils/failpoints._STATE).
+_ENABLED = True
+_SAMPLE_RATE = 1.0
+
+
+def configure(config) -> None:
+    """Apply a config.TracingConfig process-wide (None → defaults)."""
+    global _ENABLED, _SAMPLE_RATE
+    if config is None:
+        _ENABLED, _SAMPLE_RATE = True, 1.0
+        _collector.set_capacity(4096)
+        return
+    _ENABLED = bool(config.enabled)
+    _SAMPLE_RATE = float(config.sample_rate)
+    _collector.set_capacity(int(config.ring_capacity))
+
+
+def tracing_enabled() -> bool:
+    return _ENABLED
 
 
 class SpanRecord:
     """One finished span (exporter unit)."""
 
     __slots__ = ("trace_id", "span_id", "parent_span_id", "name", "start",
-                 "duration", "tags", "baggage")
+                 "duration", "tags", "baggage", "seq")
 
     def __init__(self, ctx: "TraceContext", duration: float):
         self.trace_id = ctx.trace_id
@@ -38,29 +102,64 @@ class SpanRecord:
         self.duration = duration
         self.tags = dict(ctx.tags)
         self.baggage = dict(ctx.baggage)
+        self.seq = 0                    # stamped by the collector
 
     def to_dict(self) -> dict:
-        return {k: getattr(self, k) for k in self.__slots__}
+        return {k: getattr(self, k) for k in self.__slots__ if k != "seq"}
 
 
 class SpanCollector:
-    """Ring buffer of finished sampled spans."""
+    """Bounded ring of finished sampled spans with a drain cursor.
+
+    `drain()` hands each span to the exporter exactly once; the ring
+    RETAINS everything up to `capacity` so `/traces` and `find()` keep
+    serving after an export cycle (the pre-flight-recorder destructive
+    drain made a daemon's trace views go empty between scrapes)."""
 
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
         self._lock = threading.Lock()
         self._spans: list[SpanRecord] = []
+        self._seq = 0                  # spans ever added
+        self._drained = 0              # seq consumed by drain()
+        self._hists: dict[str, Any] = {}
 
-    def add(self, span: SpanRecord) -> None:
+    def set_capacity(self, capacity: int) -> None:
         with self._lock:
-            self._spans.append(span)
+            self.capacity = max(int(capacity), 1)
             if len(self._spans) > self.capacity:
                 del self._spans[:len(self._spans) - self.capacity]
 
-    def drain(self) -> list[SpanRecord]:
+    def add(self, span: SpanRecord) -> None:
         with self._lock:
-            spans, self._spans = self._spans, []
-            return spans
+            self._seq += 1
+            span.seq = self._seq
+            self._spans.append(span)
+            if len(self._spans) > self.capacity:
+                del self._spans[:len(self._spans) - self.capacity]
+        self._record_duration(span)
+
+    def _record_duration(self, span: SpanRecord) -> None:
+        # Span-duration histograms on /metrics (tracing_span_seconds
+        # {name=...}); per-name sensor cached — the registry lookup is
+        # a lock + dict probe we don't want per span.
+        hist = self._hists.get(span.name)
+        if hist is None:
+            from ytsaurus_tpu.utils.profiling import Profiler
+            hist = Profiler("/tracing").with_tags(
+                name=span.name).histogram(
+                    "span_seconds",
+                    bounds=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
+                            0.1, 0.5, 1.0, 5.0, 30.0))
+            self._hists[span.name] = hist
+        hist.record(span.duration)
+
+    def drain(self) -> list[SpanRecord]:
+        """Spans added since the previous drain (cursor advance)."""
+        with self._lock:
+            fresh = [s for s in self._spans if s.seq > self._drained]
+            self._drained = self._seq
+            return fresh
 
     def snapshot(self) -> list[SpanRecord]:
         with self._lock:
@@ -84,8 +183,8 @@ class TraceContext:
                  parent_span_id: Optional[str] = None, sampled: bool = True,
                  baggage: Optional[dict] = None):
         self.name = name
-        self.trace_id = trace_id or uuid.uuid4().hex
-        self.span_id = uuid.uuid4().hex[:16]
+        self.trace_id = trace_id or _new_trace_id()
+        self.span_id = _new_span_id()
         self.parent_span_id = parent_span_id
         self.sampled = sampled
         self.baggage: dict[str, Any] = dict(baggage or {})
@@ -114,9 +213,11 @@ class TraceContext:
         self._token = _current.set(self)
         return self
 
-    def __exit__(self, *exc) -> bool:
+    def __exit__(self, exc_type, exc, tb) -> bool:
         _current.reset(self._token)
         if self.sampled:
+            if exc is not None and "error" not in self.tags:
+                self.tags["error"] = repr(exc)[:200]
             _collector.add(SpanRecord(self, time.perf_counter() - self._t0))
         return False
 
@@ -141,14 +242,160 @@ class TraceContext:
                             for k, v in (wire.get("baggage") or {}).items()})
 
 
+class _NullSpan:
+    """The no-op span: what an untraced (or sampled-out) site gets.
+    Activation touches NOTHING — not even the contextvar — so nesting
+    under it still sees the real ambient context (or None)."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+    parent_span_id = None
+    name = "<null>"
+    sampled = False
+    tags: dict = {}
+    baggage: dict = {}
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def add_tag(self, key, value) -> None:
+        pass
+
+    def set_baggage(self, key, value) -> None:
+        pass
+
+    def create_child(self, name) -> "_NullSpan":
+        return self
+
+    def to_wire(self) -> None:
+        return None
+
+
+NULL_SPAN = _NullSpan()
+
+
 def current_trace() -> Optional[TraceContext]:
     return _current.get()
 
 
-def start_span(name: str, **tags) -> TraceContext:
-    """Child of the ambient context, or a fresh root."""
+def start_span(name: str, **tags) -> "TraceContext | _NullSpan":
+    """Child of the ambient context, or a (sampled) fresh root."""
     parent = _current.get()
-    ctx = parent.create_child(name) if parent is not None \
-        else TraceContext(name)
+    if parent is not None:
+        if not parent.sampled:
+            return NULL_SPAN
+        ctx = parent.create_child(name)
+        ctx.tags.update(tags)
+        return ctx
+    if not _ENABLED or (_SAMPLE_RATE < 1.0 and
+                        random.random() >= _SAMPLE_RATE):
+        return NULL_SPAN
+    ctx = TraceContext(name)
     ctx.tags.update(tags)
     return ctx
+
+
+def child_span(name: str, **tags) -> "TraceContext | _NullSpan":
+    """INTERIOR span site: records only under a live sampled trace.
+    The no-trace fast path is one contextvar read + a singleton return."""
+    parent = _current.get()
+    if parent is None or not parent.sampled:
+        return NULL_SPAN
+    ctx = parent.create_child(name)
+    if tags:
+        ctx.tags.update(tags)
+    return ctx
+
+
+def start_query_span(name: str, force: bool = False,
+                     trace_id: Optional[str] = None,
+                     **tags) -> "TraceContext | _NullSpan":
+    """ENTRY-point span: continue the ambient trace when one exists
+    (an RPC handler running under the caller's propagated context),
+    else root a new trace subject to `enabled` + `sample_rate`.
+    `force=True` (explain_analyze, explicit X-YT-Trace-Id) always
+    samples; `trace_id` pins the root's trace id."""
+    parent = _current.get()
+    if parent is not None:
+        if not (parent.sampled or force):
+            return NULL_SPAN
+        ctx = TraceContext(name, trace_id=parent.trace_id,
+                           parent_span_id=parent.span_id,
+                           sampled=True, baggage=parent.baggage)
+        ctx.tags.update(tags)
+        return ctx
+    if not force and (not _ENABLED or (_SAMPLE_RATE < 1.0 and
+                                       random.random() >= _SAMPLE_RATE)):
+        return NULL_SPAN
+    ctx = TraceContext(name, trace_id=trace_id)
+    ctx.tags.update(tags)
+    return ctx
+
+
+# -- flight-recorder views -----------------------------------------------------
+
+
+def trace_summaries(limit: int = 64) -> list[dict]:
+    """Recent traces, newest first: one row per trace id with its root
+    span name, start time, total span count, and root duration (the
+    monitoring `/traces` listing)."""
+    spans = _collector.snapshot()
+    by_trace: dict[str, list[SpanRecord]] = {}
+    for span in spans:
+        by_trace.setdefault(span.trace_id, []).append(span)
+    out = []
+    for trace_id, group in by_trace.items():
+        span_ids = {s.span_id for s in group}
+        roots = [s for s in group
+                 if s.parent_span_id is None or
+                 s.parent_span_id not in span_ids]
+        root = max(roots, key=lambda s: s.duration) if roots else group[0]
+        out.append({"trace_id": trace_id, "root": root.name,
+                    "start": root.start, "duration": root.duration,
+                    "spans": len(group),
+                    "last_seq": max(s.seq for s in group)})
+    out.sort(key=lambda r: r["last_seq"], reverse=True)
+    for row in out:
+        del row["last_seq"]
+    return out[:limit]
+
+
+def _build_tree(spans: "list[SpanRecord]") -> list[dict]:
+    nodes = {s.span_id: {**s.to_dict(), "children": []} for s in spans}
+    roots = []
+    for span in spans:
+        node = nodes[span.span_id]
+        parent = nodes.get(span.parent_span_id) \
+            if span.parent_span_id else None
+        if parent is not None:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    def _sort(items):
+        items.sort(key=lambda n: n["start"])
+        for item in items:
+            _sort(item["children"])
+    _sort(roots)
+    return roots
+
+
+def span_tree(trace_id: str) -> list[dict]:
+    """Nested span tree of one trace (children under `children`, sorted
+    by start time); [] when the trace is unknown/evicted."""
+    spans = _collector.find(trace_id)
+    return _build_tree(spans) if spans else []
+
+
+def all_span_trees() -> dict:
+    """{trace_id: span tree} for EVERY trace retained in the ring, built
+    in one snapshot pass (the orchid `/tracing/traces` producer — same
+    retention as the monitoring `/traces/<id>` endpoint, instead of the
+    64-most-recent window with a ring scan per trace)."""
+    by_trace: dict[str, list[SpanRecord]] = {}
+    for span in _collector.snapshot():
+        by_trace.setdefault(span.trace_id, []).append(span)
+    return {tid: _build_tree(group) for tid, group in by_trace.items()}
