@@ -1,0 +1,796 @@
+"""Cluster telemetry plane tests (ISSUE 6): metrics-history rings
+(bounded memory, downsample tiers, window deltas), SLO burn-rate
+alerting (fires on a synthetic SLI step, resolves on recovery),
+per-tenant resource accounting (conservation under concurrent
+mixed-pool traffic, exact reconciliation with gateway counters),
+monitoring endpoints (/metrics/history /accounting /slo /telemetry
+/cluster), the /cluster roll-up over a real 3-daemon LocalCluster,
+the Summary bounded reservoir, the serving routing-signal gauges,
+and the sensor-catalog lint."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.config import (
+    ServingConfig,
+    SloConfig,
+    TelemetryConfig,
+)
+from ytsaurus_tpu.errors import ThrottledError, YtError
+from ytsaurus_tpu.query.accounting import (
+    USAGE_FIELDS,
+    ResourceAccountant,
+    get_accountant,
+)
+from ytsaurus_tpu.query.serving import CancellationToken, QueryGateway
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.server.monitoring import MonitoringServer
+from ytsaurus_tpu.utils.profiling import (
+    MetricsHistory,
+    Profiler,
+    ProfilerRegistry,
+    Summary,
+    TelemetrySampler,
+    get_registry,
+)
+from ytsaurus_tpu.utils.slo import SloTracker
+
+from tests.test_observability import parse_prometheus_exposition
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+# --- summary bounded reservoir ------------------------------------------------
+
+
+def test_summary_reservoir_is_bounded():
+    s = Summary()
+    for i in range(50_000):
+        s.record(float(i))
+    assert s.count == 50_000 and s.max == 49_999.0
+    # The reservoir — the only per-observation storage — stays capped.
+    assert len(s._reservoir) == Summary.RESERVOIR_CAPACITY
+    # Uniform sample of a uniform ramp: the median estimate must land
+    # well inside the middle half.
+    assert 12_500 < s.quantile(0.5) < 37_500
+    assert s.quantile(0.0) < s.quantile(0.99)
+
+
+# --- history rings ------------------------------------------------------------
+
+
+def _make_history(registry, **kw):
+    defaults = dict(fine_capacity=16, coarse_every=4, coarse_capacity=8,
+                    sample_period=10.0)
+    defaults.update(kw)
+    return MetricsHistory(registry=registry, **defaults)
+
+
+def test_history_ring_bounded_and_downsampled():
+    reg = ProfilerRegistry()
+    counter = Profiler("/t", registry=reg).counter("c")
+    hist = _make_history(reg)
+    t0 = 1_000.0
+    for i in range(100):
+        counter.increment()
+        hist.sample_once(t0 + 10.0 * i)
+    (series,) = hist.query(name="/t/c")
+    # Fine tier: exactly fine_capacity newest points survive.
+    assert len(series["points"]) == 16
+    assert series["points"][-1] == [t0 + 990.0, 100.0]
+    assert series["points"][0] == [t0 + 840.0, 85.0]
+    # Coarse tier: every coarse_every-th sample, capacity-bounded.
+    (coarse,) = hist.query(name="/t/c", tier="coarse")
+    assert len(coarse["points"]) == 8
+    stamps = [p[0] for p in coarse["points"]]
+    assert stamps == [t0 + 10.0 * (4 * k - 1) for k in range(18, 26)]
+
+
+def test_history_query_filters_and_since():
+    reg = ProfilerRegistry()
+    prof = Profiler("/q", registry=reg)
+    prof.with_tags(pool="a").counter("n").increment(1)
+    prof.with_tags(pool="b").counter("n").increment(2)
+    prof.gauge("g").set(7.0)
+    hist = _make_history(reg)
+    hist.sample_once(100.0)
+    hist.sample_once(110.0)
+    assert {s["name"] for s in hist.query()} == {"/q/n", "/q/g"}
+    (only_b,) = hist.query(name="/q/n", tags={"pool": "b"})
+    assert only_b["tags"] == {"pool": "b"}
+    assert [p[1] for p in only_b["points"]] == [2.0, 2.0]
+    (late,) = hist.query(name="/q/g", since=100.0)
+    assert [p[0] for p in late["points"]] == [110.0]
+    assert hist.series_names() == ["/q/g", "/q/n"]
+
+
+def test_window_delta_per_kind():
+    reg = ProfilerRegistry()
+    prof = Profiler("/w", registry=reg)
+    counter = prof.counter("c")
+    gauge = prof.gauge("g")
+    summary = prof.summary("s")
+    histo = prof.histogram("h", bounds=(0.1, 1.0))
+    hist = _make_history(reg, fine_capacity=64)
+    for i in range(10):
+        counter.increment(5)
+        gauge.set(float(i))
+        summary.record(2.0)
+        histo.record(0.05 if i < 5 else 5.0)
+        hist.sample_once(100.0 + 10.0 * i)
+    now = 190.0
+    assert hist.window_delta("/w/c", window=50.0, now=now) == 25.0
+    assert hist.window_delta("/w/g", window=50.0, now=now) == 9.0
+    d_count, d_sum = hist.window_delta("/w/s", window=50.0, now=now)
+    assert (d_count, d_sum) == (5, 10.0)
+    d_count, d_sum, d_buckets, bounds = hist.window_delta(
+        "/w/h", window=50.0, now=now)
+    assert d_count == 5 and bounds == (0.1, 1.0)
+    assert d_buckets == [0, 0, 5]          # all five landed above 1.0
+    # Counter deltas SUM over matching tagged series.
+    tagged = Profiler("/w2", registry=reg)
+    tagged.with_tags(pool="a").counter("n").increment(3)
+    tagged.with_tags(pool="b").counter("n").increment(4)
+    hist2 = _make_history(reg)
+    hist2.sample_once(10.0)
+    tagged.with_tags(pool="a").counter("n").increment(3)
+    hist2.sample_once(20.0)
+    assert hist2.window_delta("/w2/n", window=15.0, now=20.0) == 3.0
+    # No matching series / single point -> None.
+    assert hist2.window_delta("/nope", window=15.0, now=20.0) is None
+
+
+def test_sampler_thread_ticks_and_stops():
+    reg = ProfilerRegistry()
+    Profiler("/bg", registry=reg).counter("c").increment()
+    hist = _make_history(reg, sample_period=0.02)
+    ticks = []
+    sampler = TelemetrySampler(hist, period=0.02,
+                               hooks=[ticks.append]).start()
+    deadline = time.monotonic() + 5.0
+    while hist.samples_taken < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sampler.stop()
+    assert hist.samples_taken >= 3 and len(ticks) >= 3
+    taken = hist.samples_taken
+    time.sleep(0.08)
+    assert hist.samples_taken == taken     # stopped means stopped
+
+
+# --- slo burn-rate alerting ---------------------------------------------------
+
+
+def _slo_config(**slos):
+    return TelemetryConfig.from_dict({"slos": slos})
+
+
+def test_burn_rate_alert_fires_and_resolves_on_step():
+    reg = ProfilerRegistry()
+    prof = Profiler("/svc", registry=reg)
+    good, bad = prof.counter("ok"), prof.counter("err")
+    hist = _make_history(reg, fine_capacity=720)
+    cfg = _slo_config(availability={
+        "kind": "availability", "good_sensor": "/svc/ok",
+        "bad_sensor": "/svc/err", "objective": 0.99,
+        "fast_window": 300.0, "slow_window": 3600.0,
+        "burn_threshold": 2.0})
+    tracker = SloTracker(cfg, history=hist)
+    t = 0.0
+    for _ in range(60):                     # healthy baseline
+        good.increment(100)
+        t = hist.sample_once(t + 10.0)
+    snap = tracker.evaluate(now=t)
+    assert snap["slos"]["availability"]["firing"] is False
+    assert snap["active_alerts"] == []
+
+    for _ in range(30):                     # SLI step: 1/3 errors
+        good.increment(100)
+        bad.increment(50)
+        t = hist.sample_once(t + 10.0)
+        tracker.evaluate(now=t)
+    snap = tracker.evaluate(now=t)
+    state = snap["slos"]["availability"]
+    assert state["firing"] is True
+    assert state["burn_fast"] > 2.0 and state["burn_slow"] > 2.0
+    (alert,) = snap["active_alerts"]
+    assert alert["slo"] == "availability" and alert["state"] == "firing"
+    since = alert["since"]
+
+    for _ in range(31):                     # recovery: fast window heals
+        good.increment(100)
+        t = hist.sample_once(t + 10.0)
+        tracker.evaluate(now=t)
+    snap = tracker.evaluate(now=t)
+    assert snap["active_alerts"] == []
+    assert any(a["slo"] == "availability" and a["state"] == "resolved"
+               and a["since"] == since and "resolved_at" in a
+               for a in snap["resolved_alerts"])
+
+
+def test_latency_slo_over_histogram_buckets():
+    reg = ProfilerRegistry()
+    lat = Profiler("/svc", registry=reg).histogram(
+        "latency_seconds", bounds=(0.01, 0.05, 0.5))
+    hist = _make_history(reg, fine_capacity=720)
+    cfg = _slo_config(p99={
+        "kind": "latency", "sensor": "/svc/latency_seconds",
+        "objective": 0.9, "bound_ms": 50.0,
+        "fast_window": 300.0, "slow_window": 600.0,
+        "burn_threshold": 2.0})
+    tracker = SloTracker(cfg, history=hist)
+    t = 0.0
+    for _ in range(60):
+        for _ in range(10):
+            lat.record(0.005)               # all under the 50ms bound
+        t = hist.sample_once(t + 10.0)
+    assert tracker.evaluate(now=t)["slos"]["p99"]["firing"] is False
+    for _ in range(30):                     # regression: half over bound
+        for _ in range(5):
+            lat.record(0.005)
+        for _ in range(5):
+            lat.record(2.0)
+        t = hist.sample_once(t + 10.0)
+    state = tracker.evaluate(now=t)["slos"]["p99"]
+    assert state["firing"] is True
+    assert state["error_rate_fast"] == pytest.approx(0.5)
+
+
+def test_slo_config_validation():
+    with pytest.raises(YtError):
+        SloConfig.from_dict({"kind": "latency", "bound_ms": 0.0})
+    with pytest.raises(YtError):
+        SloConfig.from_dict({"kind": "availability",
+                             "good_sensor": "/a"})
+    with pytest.raises(YtError):
+        TelemetryConfig.from_dict({"slos": {"x": 3}})
+    cfg = _slo_config(ok={"kind": "ratio", "good_sensor": "/g",
+                          "bad_sensor": "/b", "objective": 0.999})
+    assert cfg.slos["ok"].objective == 0.999
+    assert cfg.to_dict()["slos"]["ok"]["good_sensor"] == "/g"
+
+
+# --- per-tenant accounting ----------------------------------------------------
+
+
+def test_accounting_conservation_under_concurrent_folds():
+    reg = ProfilerRegistry()
+    acct = ResourceAccountant(registry=reg)
+    pools = ["p0", "p1", "p2", "p3"]
+    users = ["u0", "u1", "u2"]
+    n_threads, folds_each = 8, 200
+
+    def worker(seed):
+        for i in range(folds_each):
+            acct.fold(pools[(seed + i) % 4], users[i % 3],
+                      queries=1, rows_read=i, bytes_read=2 * i,
+                      wall_seconds=0.001)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = acct.snapshot()
+    n_folds = n_threads * folds_each
+    per_fold_rows = sum(range(folds_each)) * n_threads
+    assert snap["totals"]["queries"] == n_folds
+    assert snap["totals"]["rows_read"] == per_fold_rows
+    assert snap["totals"]["bytes_read"] == 2 * per_fold_rows
+    # Conservation: per-pool and per-user roll-ups both sum to totals.
+    for roll in ("by_pool", "by_user"):
+        for field in USAGE_FIELDS:
+            assert sum(r[field] for r in snap[roll].values()) == \
+                pytest.approx(snap["totals"][field]), (roll, field)
+    # The per-pool sensor mirrors agree exactly with the roll-up.
+    for pool, agg in snap["by_pool"].items():
+        for field in ("queries", "rows_read", "bytes_read"):
+            sensor = Profiler("/accounting/usage",
+                              registry=reg).with_tags(
+                pool=pool).counter(field)
+            assert sensor.get() == pytest.approx(agg[field])
+
+
+def test_admission_throttle_folds_into_accounting():
+    acct = get_accountant()
+    before = (acct.snapshot()["by_pool"].get("default") or
+              {"throttled": 0.0})["throttled"]
+    gateway = QueryGateway(ServingConfig(slots=1, max_queue=0,
+                                         default_timeout=5.0))
+    release = threading.Event()
+    started = threading.Event()
+
+    def hold(_token):
+        started.set()
+        release.wait(10.0)
+        return "held"
+
+    holder = threading.Thread(
+        target=lambda: gateway.run_select(hold, timeout=10.0))
+    holder.start()
+    try:
+        assert started.wait(5.0)
+        with pytest.raises(ThrottledError):
+            gateway.run_select(lambda _t: "nope", timeout=1.0)
+    finally:
+        release.set()
+        holder.join()
+    after = acct.snapshot()["by_pool"]["default"]["throttled"]
+    assert after == before + 1
+
+
+N_ROWS = 120
+
+
+@pytest.fixture(scope="module")
+def client(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("telemetry")
+    c = connect(str(tmp_path / "cluster"))
+    c.cluster.serving_config = ServingConfig(
+        slots=8, pools={"default": 1.0, "gold": 1.0, "silver": 1.0})
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("v", "int64")], unique_keys=True)
+    c.create("table", "//acct",
+             attributes={"schema": schema, "dynamic": True},
+             recursive=True)
+    c.mount_table("//acct")
+    c.insert_rows("//acct", [{"k": i, "v": i * 3}
+                             for i in range(N_ROWS)])
+    return c
+
+
+def _pool_usage(pool):
+    return (get_accountant().snapshot()["by_pool"].get(pool) or
+            {field: 0.0 for field in USAGE_FIELDS})
+
+
+def test_accounting_reconciles_with_gateway_counters(client):
+    """The acceptance invariant: per-pool accounting totals reconcile
+    EXACTLY with the gateway's own admission counters and with the
+    per-query profiles, under concurrent mixed-pool traffic."""
+    gateway = client.cluster.gateway
+    pools = gateway.admission._pools
+    before = {
+        "gold": _pool_usage("gold"), "silver": _pool_usage("silver"),
+        "gold_admitted": pools["gold"].admitted_n,
+        "silver_admitted": pools["silver"].admitted_n,
+    }
+    profiles = {"gold": [], "silver": []}
+    lock = threading.Lock()
+
+    def select_worker(pool, n):
+        for i in range(n):
+            p = client.select_rows(
+                f"select k, v from [//acct] where k < {20 + i}",
+                pool=pool, explain_analyze=True)
+            with lock:
+                profiles[pool].append(p)
+
+    def lookup_worker(pool, n):
+        for i in range(n):
+            rows = client.lookup_rows("//acct", [(i,), (i + 1,)],
+                                      pool=pool)
+            assert rows[0]["v"] == i * 3
+
+    threads = [
+        threading.Thread(target=select_worker, args=("gold", 4)),
+        threading.Thread(target=select_worker, args=("silver", 3)),
+        threading.Thread(target=lookup_worker, args=("gold", 5)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    gold, silver = _pool_usage("gold"), _pool_usage("silver")
+    assert gold["queries"] - before["gold"]["queries"] == 4
+    assert silver["queries"] - before["silver"]["queries"] == 3
+    # Every member REQUEST folds (5 calls), however they coalesced.
+    assert gold["lookups"] - before["gold"]["lookups"] == 5
+    assert gold["lookup_batches"] - before["gold"]["lookup_batches"] >= 1
+    assert gold["lookup_keys"] - before["gold"]["lookup_keys"] == 10
+    assert gold["lookup_rows_found"] - \
+        before["gold"]["lookup_rows_found"] == 10
+    # Exact per-pool reconciliation against the per-query profiles.
+    for pool, n_queries in (("gold", 4), ("silver", 3)):
+        usage, base = _pool_usage(pool), before[pool]
+        for field, attr in (("rows_read", "rows_read"),
+                            ("rows_written", "rows_written")):
+            assert usage[field] - base[field] == sum(
+                p.statistics.get(attr, 0) for p in profiles[pool])
+        assert usage["wall_seconds"] - base["wall_seconds"] == \
+            pytest.approx(sum(p.wall_time for p in profiles[pool]))
+        assert usage["compile_seconds"] - base["compile_seconds"] == \
+            pytest.approx(sum(p.compile_time for p in profiles[pool]))
+        assert all(p.pool == pool for p in profiles[pool])
+    # Gateway-counter reconciliation: every admission in a pool is one
+    # accounted query or one accounted lookup BATCH (the flush holds
+    # the slot; member requests fold as `lookups` under their users).
+    gold_admitted = pools["gold"].admitted_n - before["gold_admitted"]
+    assert gold_admitted == \
+        (gold["queries"] - before["gold"]["queries"]) + \
+        (gold["lookup_batches"] - before["gold"]["lookup_batches"])
+    silver_admitted = pools["silver"].admitted_n - \
+        before["silver_admitted"]
+    assert silver_admitted == silver["queries"] - \
+        before["silver"]["queries"]
+
+
+def test_unknown_pool_resolves_to_default_everywhere(client):
+    """An unconfigured pool name lands on the default pool's slots —
+    accounting, the profile, and the admission counters must all agree
+    on that RESOLVED identity instead of inventing a phantom pool."""
+    pools = client.cluster.gateway.admission._pools
+    usage0 = _pool_usage("default")
+    admitted0 = pools["default"].admitted_n
+    profile = client.select_rows("select k from [//acct] where k < 4",
+                                 pool="no_such_pool",
+                                 explain_analyze=True)
+    assert profile.pool == "default"
+    assert _pool_usage("no_such_pool")["queries"] == 0
+    assert _pool_usage("default")["queries"] - usage0["queries"] == 1
+    assert pools["default"].admitted_n - admitted0 == 1
+
+
+def test_profile_carries_user_and_pool(client):
+    profile = client.select_rows("select k from [//acct] where k < 3",
+                                 pool="gold", explain_analyze=True)
+    assert profile.pool == "gold"
+    assert profile.user == "root"
+    assert profile.to_dict()["user"] == "root"
+    from ytsaurus_tpu.query.profile import format_profile_dict
+    assert "user: root" in format_profile_dict(profile.to_dict())
+
+
+def test_evaluator_pool_tagged_compile_cache_counters(client):
+    hits = Profiler("/query/compile_cache").with_tags(
+        pool="gold").counter("hits")
+    misses = Profiler("/query/compile_cache").with_tags(
+        pool="gold").counter("misses")
+    h0, m0 = hits.get(), misses.get()
+    query = "select k, v from [//acct] where k < 77 order by k limit 5"
+    client.select_rows(query, pool="gold")
+    client.select_rows(query, pool="gold")
+    assert misses.get() > m0                # first run compiled
+    assert hits.get() > h0                  # second run hit the cache
+
+
+def test_serving_routing_signal_gauges(client):
+    client.select_rows("select k from [//acct] where k < 2",
+                       pool="gold")
+    series = parse_prometheus_exposition(
+        get_registry().render_prometheus())
+    by_name = {}
+    for name, labels, value in series:
+        by_name.setdefault(name, []).append((labels, value))
+    # The hold EWMA is a real exported gauge now, seeded > 0.
+    ((labels, value),) = by_name["serving_hold_ewma_seconds"]
+    assert labels == {} and value > 0.0
+    # Per-pool backlog gauges exist for every pool that admitted work.
+    depth_pools = {l["pool"] for l, _v in
+                   by_name.get("serving_queue_depth", [])}
+    assert "gold" in depth_pools
+
+
+def test_lookup_pool_tagged_tablet_counters(client):
+    reads = Profiler("tablet/lookup").with_tags(
+        pool="silver").counter("reads")
+    keys = Profiler("tablet/lookup").with_tags(
+        pool="silver").counter("keys")
+    r0, k0 = reads.get(), keys.get()
+    client.lookup_rows("//acct", [(5,), (6,), (7,)], pool="silver")
+    assert reads.get() > r0
+    assert keys.get() - k0 >= 3
+
+
+# --- prometheus exposition satellites -----------------------------------------
+
+
+def test_histogram_exposition_both_tag_arms():
+    """+Inf bucket, _count and _sum render under the strict grammar for
+    BOTH the tagged and the untagged sensor arm."""
+    reg = ProfilerRegistry()
+    prof = Profiler("/h", registry=reg)
+    prof.histogram("plain", bounds=(0.1, 1.0)).record(0.5)
+    prof.with_tags(pool="p").histogram(
+        "tagged", bounds=(0.1, 1.0)).record(5.0)
+    series = parse_prometheus_exposition(reg.render_prometheus())
+    plain_buckets = {l["le"]: v for n, l, v in series
+                     if n == "h_plain_bucket"}
+    assert plain_buckets == {"0.1": 0, "1.0": 1, "+Inf": 1}
+    tagged_buckets = {l["le"]: v for n, l, v in series
+                      if n == "h_tagged_bucket"}
+    assert tagged_buckets == {"0.1": 0, "1.0": 0, "+Inf": 1}
+    assert all(l["pool"] == "p" for n, l, v in series
+               if n.startswith("h_tagged"))
+    flat = {(n, tuple(sorted(l.items()))): v for n, l, v in series}
+    assert flat[("h_plain_count", ())] == 1
+    assert flat[("h_plain_sum", ())] == 0.5
+    assert flat[("h_tagged_count", (("pool", "p"),))] == 1
+    assert flat[("h_tagged_sum", (("pool", "p"),))] == 5.0
+
+
+# --- monitoring endpoints -----------------------------------------------------
+
+
+def test_monitoring_telemetry_endpoints_roundtrip():
+    reg = ProfilerRegistry()
+    prof = Profiler("/ep", registry=reg)
+    counter = prof.with_tags(pool="a").counter("n")
+    hist = _make_history(reg)
+    cfg = _slo_config(avail={
+        "kind": "availability", "good_sensor": "/ep/n",
+        "bad_sensor": "/ep/err", "objective": 0.99})
+    tracker = SloTracker(cfg, history=hist)
+    acct = ResourceAccountant(registry=reg)
+    acct.fold("a", "alice", queries=2, rows_read=10)
+    for i in range(5):
+        counter.increment()
+        hist.sample_once(100.0 + 10.0 * i)
+    server = MonitoringServer(registry=reg, history=hist,
+                              slo_tracker=tracker, accountant=acct)
+    server.start()
+    try:
+        base = f"http://{server.address}"
+        body = _get_json(f"{base}/metrics/history"
+                         f"?name=/ep/n&tags=pool=a&since=110")
+        (series,) = body["series"]
+        assert series["kind"] == "counter"
+        assert [p[1] for p in series["points"]] == [3.0, 4.0, 5.0]
+        assert body["samples_taken"] == 5
+        coarse = _get_json(f"{base}/metrics/history?tier=coarse")
+        assert all(s["tier"] == "coarse" for s in coarse["series"])
+
+        acct_body = _get_json(f"{base}/accounting")
+        assert acct_body["totals"]["queries"] == 2.0
+        assert acct_body["by_user"]["alice"]["rows_read"] == 10.0
+
+        slo_body = _get_json(f"{base}/slo")
+        assert "avail" in slo_body["slos"]
+
+        summary = _get_json(f"{base}/telemetry")
+        assert summary["address"] == server.address
+        # The accountant's per-pool mirrors share the registry, so the
+        # series list holds /ep/n plus the usage counters.
+        assert "/ep/n" in summary["history"]["series_names"]
+        assert "/accounting/usage/queries" in \
+            summary["history"]["series_names"]
+        assert summary["accounting"]["totals"]["queries"] == 2.0
+    finally:
+        server.stop()
+
+
+def test_cluster_rollup_merges_members_and_tolerates_dead():
+    def make_member(pool, firing):
+        reg = ProfilerRegistry()
+        hist = _make_history(reg)
+        good = Profiler("/m", registry=reg).counter("ok")
+        bad = Profiler("/m", registry=reg).counter("err")
+        cfg = _slo_config(avail={
+            "kind": "availability", "good_sensor": "/m/ok",
+            "bad_sensor": "/m/err", "objective": 0.99,
+            "burn_threshold": 2.0})
+        tracker = SloTracker(cfg, history=hist)
+        t = 0.0
+        for _ in range(40):
+            good.increment(10)
+            if firing:
+                bad.increment(10)
+            t = hist.sample_once(t + 10.0)
+        tracker.evaluate(now=t)
+        acct = ResourceAccountant(registry=reg)
+        acct.fold(pool, "u", queries=3, rows_read=100)
+        server = MonitoringServer(registry=reg, history=hist,
+                                  slo_tracker=tracker, accountant=acct)
+        server.start()
+        return server
+
+    healthy = make_member("pa", firing=False)
+    burning = make_member("pb", firing=True)
+    healthy.cluster_members = lambda: [
+        {"id": "self", "address": healthy.address,
+         "attributes": {"role": "primary"}},
+        {"id": "peer", "address": burning.address,
+         "attributes": {"role": "node"}},
+        {"id": "ghost", "address": "127.0.0.1:1"},
+    ]
+    try:
+        body = _get_json(f"http://{healthy.address}/cluster")
+        assert body["members"]["self"]["reachable"] is True
+        assert body["members"]["peer"]["reachable"] is True
+        assert body["members"]["ghost"]["reachable"] is False
+        assert "ghost" in body["errors"]
+        # Accounting totals sum across reachable members.
+        assert body["accounting_totals"]["queries"] == 6.0
+        assert body["accounting_totals"]["rows_read"] == 200.0
+        # The burning member's alert surfaces fleet-wide, tagged.
+        (alert,) = body["active_alerts"]
+        assert alert["member"] == "peer" and alert["slo"] == "avail"
+    finally:
+        healthy.stop()
+        burning.stop()
+
+
+# --- /cluster over a real LocalCluster ----------------------------------------
+
+
+@pytest.mark.slow
+def test_cluster_rollup_over_three_daemon_cluster(tmp_path):
+    """Full-suite variant: the real 3-daemon fleet (1 primary + 2 data
+    nodes, ~19s spin-up).  Quick-tier sibling:
+    test_cluster_rollup_merges_members_and_tolerates_dead covers the
+    same aggregation logic over in-process members."""
+    from ytsaurus_tpu.environment.local import LocalCluster
+
+    with LocalCluster(str(tmp_path / "c"), n_nodes=2,
+                      replication_factor=2) as cluster:
+        root = os.path.join(str(tmp_path / "c"), "primary")
+        with open(os.path.join(root, "primary.monitoring.port")) as f:
+            base = f"http://127.0.0.1:{int(f.read())}"
+        # Primary registers itself immediately; the two data nodes join
+        # /daemons on their 2s heartbeat cadence.
+        deadline = time.monotonic() + 30.0
+        body = None
+        while time.monotonic() < deadline:
+            body = _get_json(f"{base}/cluster")
+            reachable = [m for m in body["members"].values()
+                         if m.get("reachable")]
+            if len(reachable) >= 3:
+                break
+            time.sleep(0.5)
+        assert body is not None
+        reachable = {mid: m for mid, m in body["members"].items()
+                     if m.get("reachable")}
+        assert len(reachable) >= 3, body["members"].keys()
+        roles = {m["attributes"].get("role")
+                 for m in reachable.values() if m.get("attributes")}
+        assert "primary" in roles and "node" in roles
+        # Every member serves its own telemetry summary.
+        for member in reachable.values():
+            assert "slo" in member and "accounting" in member
+        # The member monitoring endpoints serve history directly too.
+        node = next(m for m in reachable.values()
+                    if m["attributes"].get("role") == "node")
+        hist = _get_json(f"http://{node['address']}/metrics/history")
+        assert "series" in hist
+
+
+# --- orchid + CLI surfaces ----------------------------------------------------
+
+
+def test_orchid_telemetry_mounts():
+    from ytsaurus_tpu.server.orchid import default_orchid
+    get_accountant().fold("orchid_pool", "u", queries=1)
+    from ytsaurus_tpu.utils.profiling import get_history
+    get_history().sample_once()
+    tree = default_orchid()
+    dump = tree.get("/telemetry/history")
+    assert dump["samples_taken"] >= 1 and dump["series"]
+    snap = tree.get("/accounting")
+    assert "orchid_pool" in snap["by_pool"]
+    assert isinstance(tree.get("/telemetry/slo"), dict)
+
+
+def test_yt_top_formatting():
+    from ytsaurus_tpu.cli import _format_top
+    acct = ResourceAccountant(registry=ProfilerRegistry())
+    acct.fold("gold", "alice", queries=5, rows_read=1000,
+              wall_seconds=2.5, bytes_read=5_000_000)
+    acct.fold("silver", "bob", queries=1, rows_read=10,
+              wall_seconds=9.0)
+    text = _format_top(acct.snapshot(), by="pool",
+                       sort_key="wall_seconds", limit=20)
+    lines = text.splitlines()
+    assert lines[0].split()[0] == "pool"
+    # Sorted by wall seconds descending: silver first.
+    assert lines[1].split()[0] == "silver"
+    assert lines[2].split()[0] == "gold"
+    assert lines[-1].split()[0] == "TOTAL"
+    assert "5.0MB" in lines[2]              # bytes render human-readable
+    by_user = _format_top(acct.snapshot(), by="user",
+                          sort_key="queries", limit=1)
+    assert by_user.splitlines()[1].split()[0] == "alice"
+    assert len(by_user.splitlines()) == 3   # header + 1 row + TOTAL
+
+
+# --- global wiring ------------------------------------------------------------
+
+
+def test_set_telemetry_config_rebinds_running_sampler():
+    """Reconfiguring a LIVE daemon must not orphan the sampler thread:
+    the restarted sampler follows the NEW history rings."""
+    from ytsaurus_tpu.config import set_telemetry_config
+    from ytsaurus_tpu.utils import profiling
+
+    def wait_samples(hist, n):
+        deadline = time.monotonic() + 5.0
+        while hist.samples_taken < n and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return hist.samples_taken >= n
+
+    try:
+        set_telemetry_config(TelemetryConfig.from_dict(
+            {"sample_period": 0.02}))
+        assert profiling.start_telemetry() is not None
+        old_hist = profiling.get_history()
+        assert wait_samples(old_hist, 2)
+        set_telemetry_config(TelemetryConfig.from_dict(
+            {"sample_period": 0.02, "fine_capacity": 5}))
+        new_hist = profiling.get_history()
+        assert new_hist is not old_hist
+        assert new_hist.fine_capacity == 5
+        assert wait_samples(new_hist, 2)    # the restarted thread
+    finally:
+        set_telemetry_config(None)
+        sampler = profiling._global_sampler
+        if sampler is not None:
+            sampler.stop()
+            with profiling._history_lock:
+                profiling._global_sampler = None
+
+
+def test_set_telemetry_config_rebuilds_history():
+    from ytsaurus_tpu.config import set_telemetry_config
+    from ytsaurus_tpu.utils.profiling import get_history
+    from ytsaurus_tpu.utils.slo import get_slo_tracker
+    try:
+        cfg = TelemetryConfig.from_dict({
+            "fine_capacity": 7, "coarse_every": 2,
+            "coarse_capacity": 3, "sample_period": 1.0,
+            "slos": {"a": {"kind": "ratio", "good_sensor": "/g",
+                           "bad_sensor": "/b"}}})
+        set_telemetry_config(cfg)
+        hist = get_history()
+        assert hist.fine_capacity == 7 and hist.sample_period == 1.0
+        assert "a" in get_slo_tracker().config.slos
+    finally:
+        set_telemetry_config(None)
+        assert get_history().fine_capacity == 360
+
+
+# --- sensor catalog lint ------------------------------------------------------
+
+
+def _tools_check():
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_sensor_catalog",
+        os.path.join(repo, "tools", "check_sensor_catalog.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, repo
+
+
+def test_sensor_catalog_is_clean():
+    mod, repo = _tools_check()
+    assert mod.check(repo) == []
+
+
+def test_sensor_catalog_catches_renames(tmp_path):
+    """Dropping a sensor from the catalog (≈ renaming it in code
+    without updating the catalog) must fail the lint, as must leaving a
+    stale entry behind."""
+    mod, repo = _tools_check()
+    with open(mod.CATALOG_PATH) as f:
+        catalog = json.load(f)
+    broken = {**catalog, "sensors": dict(catalog["sensors"])}
+    del broken["sensors"]["/serving/hold_ewma_seconds"]
+    broken["sensors"]["/serving/stale_gauge_nobody_creates"] = {
+        "kind": "gauge", "tags": []}
+    path = tmp_path / "catalog.json"
+    path.write_text(json.dumps(broken))
+    errors = mod.check(repo, str(path))
+    assert any("hold_ewma_seconds" in e and "missing" in e
+               for e in errors)
+    assert any("stale" in e and "stale_gauge_nobody_creates" in e
+               for e in errors)

@@ -77,7 +77,17 @@ def test_vanilla_failing_sibling_condemns_long_lived_mate(client, tmp_path):
         }, max_gang_restarts=0)
     if not pidfile.exists():
         return        # mate never got a slot: condemned while pending
-    pid = int(pidfile.read_text().strip())
+    # The shell creates the file before the pid hits it: poll briefly
+    # so a read in that window doesn't ValueError on empty content.
+    content = pidfile.read_text().strip()
+    for _ in range(20):
+        if content:
+            break
+        time.sleep(0.1)
+        content = pidfile.read_text().strip()
+    if not content:
+        return        # condemned mid-write; nothing to verify against
+    pid = int(content)
     # The kill is asynchronous with run_vanilla's raise; poll for the
     # EVENT (process gone) instead of asserting elapsed time.
     for _ in range(600):

@@ -1,0 +1,363 @@
+#!/usr/bin/env python
+"""Sensor-catalog lint (ISSUE 6 satellite).
+
+Every sensor the tree creates must appear in the checked-in catalog
+(`tools/sensor_catalog.json`) with its kind and tag set — dashboards,
+SLO configs (`config.TelemetryConfig.slos` reference sensors BY NAME),
+and the `/metrics/history` consumers all key on sensor names, so a
+rename that skips the catalog silently breaks them.  The lint fails in
+tests instead.
+
+How it finds sensors (static AST walk over ytsaurus_tpu/**/*.py — no
+imports, so a module with heavy deps can't break the lint):
+
+- sensor sites are calls `<recv>.counter("name") / .gauge / .histogram
+  / .summary / .timer` (timer wraps a summary);
+- the receiver's PREFIX is resolved through simple assignment chains:
+  `Profiler("/p")`, `<recv>.with_tags(...)`, `<recv>.with_prefix("/q")`,
+  names and `self.attr` bound in the enclosing function scope first,
+  then module scope (module bindings that conflict are dropped as
+  ambiguous rather than guessed);
+- literal-name sites with a resolved prefix must match the catalog
+  EXACTLY (name + kind); unresolved-prefix sites must match some
+  same-kind entry by leaf name;
+- dynamic-name sites (e.g. per-field usage counters) must sit under a
+  prefix declared in the catalog's `dynamic_prefixes` with the same
+  kind.
+
+The reverse direction holds too: catalog entries no site creates are
+stale and fail the lint, so deletions can't leave dead dashboard rows.
+
+Usage: python tools/check_sensor_catalog.py [--root DIR]
+Exit 0 clean; exit 1 with one line per violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+SENSOR_METHODS = {"counter": "counter", "gauge": "gauge",
+                  "histogram": "histogram", "summary": "summary",
+                  "timer": "summary"}
+
+CATALOG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "sensor_catalog.json")
+
+# Files whose Profiler class DEFINES the sensor methods (their internal
+# `self.summary(name)` plumbing is not a sensor site).
+SKIP_FILES = {os.path.join("utils", "profiling.py")}
+
+
+class _Prefix:
+    """Resolution result: a literal prefix string, or None (unknown)."""
+
+    __slots__ = ("value", "tags")
+
+    def __init__(self, value, tags=()):
+        self.value = value
+        self.tags = tuple(tags)
+
+
+def _literal_str(node):
+    return node.value if isinstance(node, ast.Constant) and \
+        isinstance(node.value, str) else None
+
+
+def _resolve(node, scope: dict, module: dict, depth: int = 0):
+    """Resolve an expression to a _Prefix, or None when unresolvable."""
+    if depth > 16 or node is None:
+        return None
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "Profiler":
+            prefix = _literal_str(node.args[0]) if node.args else ""
+            return _Prefix(prefix) if prefix is not None else None
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "with_tags":
+                base = _resolve(fn.value, scope, module, depth + 1)
+                if base is None:
+                    return None
+                tags = [kw.arg for kw in node.keywords if kw.arg]
+                return _Prefix(base.value, base.tags + tuple(tags))
+            if fn.attr == "with_prefix":
+                base = _resolve(fn.value, scope, module, depth + 1)
+                extra = _literal_str(node.args[0]) if node.args else None
+                if base is None or extra is None:
+                    return None
+                return _Prefix(base.value + extra, base.tags)
+        return None
+    if isinstance(node, ast.IfExp):
+        # `prof.with_tags(pool=p) if p else prof`: both arms must agree
+        # on the prefix; the tag set is the union.
+        left = _resolve(node.body, scope, module, depth + 1)
+        right = _resolve(node.orelse, scope, module, depth + 1)
+        if left is not None and right is not None and \
+                left.value == right.value:
+            return _Prefix(left.value,
+                           dict.fromkeys(left.tags + right.tags))
+        return None
+    if isinstance(node, ast.Name):
+        target = scope.get(node.id, module.get(node.id))
+        if target is None or target is node:
+            return None
+        return _resolve(target, scope, module, depth + 1)
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        key = f"self.{node.attr}"
+        target = scope.get(key, module.get(key))
+        return _resolve(target, scope, module, depth + 1) \
+            if target is not None else None
+    return None
+
+
+def _bindings(body_nodes, deep: bool = False) -> dict:
+    """name -> value-expr for simple assignments in a statement list.
+    `deep` recurses into nested functions/classes (the module-wide flat
+    map); shallow stops at them (one function's own scope).  Conflicting
+    re-binds drop to AMBIGUOUS so resolution never guesses between
+    prefixes."""
+    out: dict = {}
+    ambiguous = object()
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if not deep and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Assign) and \
+                    len(child.targets) == 1:
+                target = child.targets[0]
+                key = None
+                if isinstance(target, ast.Name):
+                    key = target.id
+                elif isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    key = f"self.{target.attr}"
+                if key is not None:
+                    prior = out.get(key)
+                    if prior is None:
+                        out[key] = child.value
+                    elif prior is not ambiguous and \
+                            ast.dump(prior) != ast.dump(child.value):
+                        out[key] = ambiguous
+            visit(child)
+
+    for stmt in body_nodes:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            prior = out.get(stmt.targets[0].id)
+            if prior is None:
+                out[stmt.targets[0].id] = stmt.value
+            elif prior is not ambiguous and \
+                    ast.dump(prior) != ast.dump(stmt.value):
+                out[stmt.targets[0].id] = ambiguous
+        visit(stmt)
+    return {k: v for k, v in out.items() if v is not ambiguous}
+
+
+def scan_file(path: str) -> list[dict]:
+    """Every sensor-creation site in one file:
+    {kind, name (leaf or None), prefix (str or None), line}."""
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    module_scope = _bindings(tree.body, deep=True)
+    sites = []
+
+    def pool_cache_sites(call):
+        """`PoolSensorCache("prefix", ("a", "b"))` declares one counter
+        per name, pool-tagged; a non-literal name set (a runtime field
+        list) is one dynamic site under the prefix."""
+        prefix = _literal_str(call.args[0]) if call.args else None
+        names = None
+        if len(call.args) > 1 and isinstance(call.args[1],
+                                             (ast.Tuple, ast.List)):
+            names = [_literal_str(e) for e in call.args[1].elts]
+            if any(n is None for n in names):
+                names = None
+        if names:
+            return [{"kind": "counter", "name": n, "prefix": prefix,
+                     "tags": ["pool"], "line": call.lineno}
+                    for n in names]
+        return [{"kind": "counter", "name": None, "prefix": prefix,
+                 "tags": ["pool"], "line": call.lineno}]
+
+    # PoolSensorCache constructors carry literal prefixes, so they need
+    # no scope resolution — one whole-tree pass, outside the line-keyed
+    # dedup below (one constructor line declares SEVERAL sensors).
+    cache_sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "PoolSensorCache":
+            cache_sites.extend(pool_cache_sites(node))
+
+    def walk(node, scope):
+        for child in ast.walk(node):
+            if not (isinstance(child, ast.Call) and
+                    isinstance(child.func, ast.Attribute) and
+                    child.func.attr in SENSOR_METHODS):
+                continue
+            kind = SENSOR_METHODS[child.func.attr]
+            leaf = _literal_str(child.args[0]) if child.args else None
+            prefix = _resolve(child.func.value, scope, module_scope)
+            sites.append({
+                "kind": kind, "name": leaf,
+                "prefix": prefix.value if prefix else None,
+                "tags": list(prefix.tags) if prefix else [],
+                "line": child.lineno,
+            })
+
+    # Walk each function with its own scope bindings layered over the
+    # module's; module-level sites use the module scope alone.
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    seen_lines = set()
+    for fn in funcs:
+        scope = _bindings(fn.body)
+        before = len(sites)
+        walk(fn, scope)
+        for site in sites[before:]:
+            seen_lines.add(site["line"])
+    # De-dup: nested functions are walked twice (outer pass includes
+    # inner bodies); keep the innermost (later, more-local) resolution.
+    best: dict[int, dict] = {}
+    for site in sites:
+        prior = best.get(site["line"])
+        if prior is None or (prior["prefix"] is None and
+                             site["prefix"] is not None):
+            best[site["line"]] = site
+    module_sites = []
+    walk_target = [n for n in tree.body
+                   if not isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for stmt in walk_target:
+        before = len(module_sites)
+        for child in ast.walk(stmt):
+            if (isinstance(child, ast.Call) and
+                    isinstance(child.func, ast.Attribute) and
+                    child.func.attr in SENSOR_METHODS and
+                    child.lineno not in best):
+                prefix = _resolve(child.func.value, {}, module_scope)
+                module_sites.append({
+                    "kind": SENSOR_METHODS[child.func.attr],
+                    "name": _literal_str(child.args[0])
+                    if child.args else None,
+                    "prefix": prefix.value if prefix else None,
+                    "tags": list(prefix.tags) if prefix else [],
+                    "line": child.lineno,
+                })
+    return sorted([*best.values(), *module_sites, *cache_sites],
+                  key=lambda s: s["line"])
+
+
+def _full_name(prefix: str, leaf: str) -> str:
+    return f"{prefix}/{leaf}" if prefix else leaf
+
+
+def check(root: str, catalog_path: str = CATALOG_PATH) -> list[str]:
+    with open(catalog_path, "r", encoding="utf-8") as f:
+        catalog = json.load(f)
+    entries: dict = catalog.get("sensors", {})
+    dynamic: dict = catalog.get("dynamic_prefixes", {})
+    errors: list[str] = []
+    used_entries: set = set()
+    used_dynamic: set = set()
+    by_leaf: dict = {}
+    for name, spec in entries.items():
+        by_leaf.setdefault((name.rsplit("/", 1)[-1], spec["kind"]),
+                           []).append(name)
+
+    pkg_root = os.path.join(root, "ytsaurus_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg_root):
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            rel = os.path.relpath(path, pkg_root)
+            if rel in SKIP_FILES:
+                continue
+            try:
+                sites = scan_file(path)
+            except SyntaxError as exc:
+                errors.append(f"{rel}: unparseable: {exc}")
+                continue
+            for site in sites:
+                where = f"{rel}:{site['line']}"
+                kind, leaf = site["kind"], site["name"]
+                prefix = site["prefix"]
+                if leaf is None:
+                    # Dynamic sensor name: its prefix must be declared.
+                    if prefix is None:
+                        errors.append(
+                            f"{where}: dynamic sensor name with "
+                            f"unresolvable prefix — declare it under "
+                            f"dynamic_prefixes in the catalog")
+                    elif prefix not in dynamic:
+                        errors.append(
+                            f"{where}: dynamic {kind} under {prefix!r} "
+                            f"not in catalog dynamic_prefixes")
+                    elif dynamic[prefix]["kind"] != kind:
+                        errors.append(
+                            f"{where}: dynamic {kind} under {prefix!r} "
+                            f"but catalog declares "
+                            f"{dynamic[prefix]['kind']!r}")
+                    else:
+                        used_dynamic.add(prefix)
+                    continue
+                if prefix is not None:
+                    name = _full_name(prefix, leaf)
+                    spec = entries.get(name)
+                    if spec is not None and spec["kind"] == kind:
+                        used_entries.add(name)
+                        continue
+                    if spec is not None:
+                        errors.append(
+                            f"{where}: {name} is a {kind} but the "
+                            f"catalog says {spec['kind']!r}")
+                        continue
+                    errors.append(
+                        f"{where}: {kind} {name!r} missing from "
+                        f"tools/sensor_catalog.json")
+                    continue
+                # Unresolved prefix: leaf+kind must match something.
+                matches = by_leaf.get((leaf, kind), [])
+                if matches:
+                    used_entries.update(matches)
+                else:
+                    errors.append(
+                        f"{where}: {kind} leaf {leaf!r} matches no "
+                        f"catalog entry (prefix unresolved)")
+
+    for name in sorted(set(entries) - used_entries):
+        errors.append(f"catalog: stale entry {name!r} — no code site "
+                      f"creates it")
+    for prefix in sorted(set(dynamic) - used_dynamic):
+        errors.append(f"catalog: stale dynamic_prefix {prefix!r}")
+    return errors
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    parser.add_argument("--catalog", default=CATALOG_PATH)
+    args = parser.parse_args(argv)
+    errors = check(args.root, args.catalog)
+    for error in errors:
+        print(error, file=sys.stderr)
+    if errors:
+        print(f"{len(errors)} sensor-catalog violation(s)",
+              file=sys.stderr)
+        return 1
+    print("sensor catalog clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

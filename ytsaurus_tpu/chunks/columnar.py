@@ -139,6 +139,18 @@ class ColumnarChunk:
         cap = self.capacity
         return jnp.arange(cap) < self.row_count
 
+    @property
+    def nbytes(self) -> int:
+        """Resident bytes of the column planes (capacity-padded) — the
+        bytes-scanned unit per-tenant accounting charges.  `.nbytes` on
+        a device array is metadata; nothing transfers."""
+        total = 0
+        for col in self.columns.values():
+            total += int(getattr(col.data, "nbytes", 0))
+            if col.valid is not None:
+                total += int(getattr(col.valid, "nbytes", 0))
+        return total
+
     def column(self, name: str) -> Column:
         col = self.columns.get(name)
         if col is None:

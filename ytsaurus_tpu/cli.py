@@ -80,6 +80,56 @@ def _fetch_trace(cl, trace_id: str):
     return _decode_deep(tree) if tree else None
 
 
+def _fetch_accounting(cl) -> dict:
+    """The /accounting snapshot: the remote orchid when the client has
+    one (daemon-side usage), else this process's own accountant.  A
+    FAILING remote read propagates — silently falling back to this
+    short-lived process's empty accountant would print an all-zero
+    table and read as "cluster idle" when the daemon is broken."""
+    if hasattr(cl, "get_orchid"):
+        return _decode_deep(cl.get_orchid("/accounting") or {})
+    from ytsaurus_tpu.query.accounting import get_accountant
+    return get_accountant().snapshot()
+
+
+# The `yt top` table columns (a readable subset of USAGE_FIELDS).
+_TOP_COLUMNS = ("queries", "lookups", "rows_read", "bytes_read",
+                "compile_seconds", "execute_seconds", "wall_seconds",
+                "throttled", "jobs")
+
+
+def _format_top(snapshot: dict, by: str, sort_key: str,
+                limit: int) -> str:
+    """`yt top --by pool`: per-tenant resource usage, heaviest first —
+    the serving-plane answer to "who is eating the cluster"."""
+    rollup = snapshot.get(f"by_{by}") or {}
+    rows = sorted(rollup.items(),
+                  key=lambda kv: -float(kv[1].get(sort_key, 0.0)))
+    if limit > 0:
+        rows = rows[:limit]
+    totals = snapshot.get("totals") or {}
+
+    def fmt(record, field):
+        value = float(record.get(field, 0.0))
+        if field.endswith("_seconds"):
+            return f"{value:.3f}"
+        if field == "bytes_read":
+            return f"{value / 1e6:.1f}MB" if value >= 1e6 \
+                else f"{value:.0f}"
+        return f"{value:.0f}"
+
+    header = [by, *_TOP_COLUMNS]
+    table = [[name, *[fmt(record, f) for f in _TOP_COLUMNS]]
+             for name, record in rows]
+    table.append(["TOTAL", *[fmt(totals, f) for f in _TOP_COLUMNS]])
+    widths = [max(len(str(row[i])) for row in [header, *table])
+              for i in range(len(header))]
+    lines = ["  ".join(str(cell).rjust(width)
+                       for cell, width in zip(row, widths))
+             for row in [header, *table]]
+    return "\n".join(lines)
+
+
 def _format_profile(profile) -> str:
     """ExecutionProfile object (in-process client) OR its dict form
     (remote client / HTTP proxy) → the pretty EXPLAIN ANALYZE text, via
@@ -133,6 +183,17 @@ def build_parser() -> argparse.ArgumentParser:
         (("--json",), {"action": "store_true",
                        "help": "raw span tree instead of the pretty "
                                "rendering"}))
+    cmd("top", (("--by",), {"default": "pool",
+                            "choices": ["pool", "user"],
+                            "help": "roll resource usage up by pool "
+                                    "(default) or user"}),
+        (("--sort",), {"default": "wall_seconds",
+                       "help": "usage column to sort by (descending); "
+                               "e.g. rows_read, bytes_read, queries"}),
+        (("--limit",), {"type": int, "default": 20}),
+        (("--json",), {"action": "store_true",
+                       "help": "raw accounting snapshot instead of the "
+                               "table"}))
     cmd("insert-rows", (("path",), {}),
         (("--rows",), {"default": None}))
     cmd("lookup-rows", (("path",), {}), (("--keys",), {"required": True}))
@@ -263,6 +324,12 @@ def _dispatch(cl, a):
         from ytsaurus_tpu.query.profile import format_span_tree
         print(f"trace {a.trace_id}")
         print("\n".join(format_span_tree(tree)))
+        return None
+    if c == "top":
+        snapshot = _fetch_accounting(cl)
+        if a.json:
+            return snapshot
+        print(_format_top(snapshot, a.by, a.sort, a.limit))
         return None
     if c == "insert-rows":
         rows = json.loads(_rows_arg(a.rows))

@@ -1235,6 +1235,12 @@ class YtClient:
         from ytsaurus_tpu.query.statistics import QueryStatistics
         from ytsaurus_tpu.utils.tracing import start_query_span
         gateway = self.cluster.gateway
+        # The admission-resolved pool is the identity every plane shares
+        # (admission counters, per-pool sensors, accounting): capturing
+        # the raw requested name would split a query between an admitted
+        # pool and an invented accounting pool.
+        if gateway.enabled:
+            pool = gateway.resolve_pool(pool)
         root = start_query_span("query.select", force=explain_analyze,
                                 query=query[:200],
                                 pool=pool or "default")
@@ -1262,6 +1268,12 @@ class YtClient:
             # would mutate the stored object and pin the result set.
             profile.rows = rows
         get_flight_recorder().observe(profile)
+        # Per-tenant resource accounting (ISSUE 6): the finished query's
+        # counters fold into cumulative (pool, user) usage — the signal
+        # fair-share serving weighs tenants by, served on /accounting
+        # and `yt top`.
+        from ytsaurus_tpu.query.accounting import get_accountant
+        get_accountant().observe_query(profile)
         return profile if explain_analyze else rows
 
     def _select_rows_system(self, query: str,

@@ -339,6 +339,123 @@ def set_tracing_config(config: "Optional[TracingConfig]") -> None:
     tracing.configure(config)
 
 
+class SloConfig(YsonStruct):
+    """One service-level objective, evaluated over the metrics-history
+    rings (utils/profiling.MetricsHistory) with multi-window burn-rate
+    alerting (utils/slo.SloTracker).
+
+    Two SLI shapes cover the fleet's objectives:
+
+    - `availability`/`ratio`: good/bad event counters.  The SLI over a
+      window is bad/(good+bad) from the counters' history deltas —
+      e.g. admission rejects vs admits, or compile-cache misses vs hits
+      (`compile_cache_hit_rate`, the ROADMAP item 1 acceptance gate).
+    - `latency`: a histogram sensor plus `bound_ms`.  Error events are
+      observations above the bound (from bucket-count deltas), so
+      "`objective` of requests finish within `bound_ms`" — the p99-style
+      objective — needs no per-request log, just the bucket rings.
+      `bound_ms` should align with a bucket bound; the evaluator uses
+      the tightest bucket that contains it (errors only over-count).
+
+    Burn rate = error_rate / (1 - objective): 1.0 burns the whole error
+    budget exactly over the SLO period.  The alert FIRES when both the
+    fast and the slow window exceed `burn_threshold` (the classic
+    multi-window rule: fast catches the regression quickly, slow keeps
+    one blip from paging) and RESOLVES once the fast window recovers."""
+
+    kind = param("availability", type=str,
+                 choices={"availability", "ratio", "latency"})
+    # latency: the histogram series name (registry path, e.g.
+    # "/serving/select_latency_seconds").
+    sensor = param("", type=str)
+    # availability/ratio: counter series names.
+    good_sensor = param("", type=str)
+    bad_sensor = param("", type=str)
+    # Tag filter (subset match): {"pool": "prod"} evaluates one pool's
+    # series; empty sums every tagged series of the sensor.
+    tags = param(default_factory=dict, type=dict)
+    objective = param(0.99, type=float, ge=0.0, le=1.0)
+    bound_ms = param(0.0, type=float, ge=0.0)
+    fast_window = param(300.0, type=float, ge=0.0)
+    slow_window = param(3600.0, type=float, ge=0.0)
+    burn_threshold = param(10.0, type=float, ge=0.0)
+
+    def postprocess(self):
+        if self.kind == "latency":
+            if not self.sensor or self.bound_ms <= 0:
+                raise YtError(
+                    "latency SLO requires `sensor` (a histogram) and a "
+                    "positive `bound_ms`", code=EErrorCode.InvalidConfig)
+        elif not self.good_sensor or not self.bad_sensor:
+            raise YtError(
+                f"{self.kind} SLO requires `good_sensor` and "
+                f"`bad_sensor` counters", code=EErrorCode.InvalidConfig)
+
+
+class TelemetryConfig(YsonStruct):
+    """Cluster telemetry plane knobs (utils/profiling.MetricsHistory +
+    utils/slo.SloTracker + query/accounting.ResourceAccountant):
+
+    - `sample_period`: the sampler thread snapshots every registered
+      sensor this often into the history rings (0 disables sampling;
+      tests drive `sample_once()` manually with synthetic timestamps).
+    - `fine_capacity`/`coarse_every`/`coarse_capacity`: ring tiers.
+      Defaults hold 1h at 10s resolution plus 24h at 5min resolution
+      (10s x 360 + 5min x 288) in bounded memory per sensor.
+    - `slos`: name -> SloConfig, evaluated after every sample."""
+
+    enabled = param(True, type=bool)
+    sample_period = param(10.0, type=float, ge=0.0)
+    fine_capacity = param(360, type=int, ge=1)
+    # Every Nth fine sample is folded into the coarse ring.
+    coarse_every = param(30, type=int, ge=1)
+    coarse_capacity = param(288, type=int, ge=1)
+    slos = param(default_factory=dict, type=dict)
+
+    def postprocess(self):
+        parsed = {}
+        for name, spec in (self.slos or {}).items():
+            if isinstance(name, bytes):
+                name = name.decode("utf-8")
+            if isinstance(spec, SloConfig):
+                parsed[name] = spec
+            elif isinstance(spec, dict):
+                parsed[name] = SloConfig.from_dict(spec,
+                                                   path=f"slos/{name}")
+            else:
+                raise YtError(f"SLO {name!r}: expected map, got {spec!r}",
+                              code=EErrorCode.InvalidConfig)
+        self.slos = parsed
+
+    def to_dict(self) -> dict:
+        out = super().to_dict()
+        out["slos"] = {name: slo.to_dict()
+                       for name, slo in self.slos.items()}
+        return out
+
+
+_TELEMETRY_CONFIG: "Optional[TelemetryConfig]" = None
+
+
+def telemetry_config() -> TelemetryConfig:
+    global _TELEMETRY_CONFIG
+    if _TELEMETRY_CONFIG is None:
+        _TELEMETRY_CONFIG = TelemetryConfig()
+    return _TELEMETRY_CONFIG
+
+
+def set_telemetry_config(config: "Optional[TelemetryConfig]") -> None:
+    """Install a process-wide telemetry config (None restores defaults);
+    rebuilds the global history rings + SLO tracker to the new shape."""
+    global _TELEMETRY_CONFIG
+    _TELEMETRY_CONFIG = config
+    from ytsaurus_tpu.utils import profiling, slo
+    # Tracker first: configure_telemetry restarts a running sampler,
+    # and the restarted thread must hook the NEW tracker's evaluate.
+    slo.configure(config)
+    profiling.configure_telemetry(config)
+
+
 class FailpointsConfig(YsonStruct):
     """Deterministic fault-injection schedule (utils/failpoints.py):
     `spec` uses the YT_FAILPOINTS syntax, `seed` fixes p-based rolls.
@@ -436,6 +553,7 @@ class DaemonConfig(YsonStruct):
     serving = param(type=ServingConfig)
     tablet = param(type=TabletConfig)
     tracing = param(type=TracingConfig)
+    telemetry = param(type=TelemetryConfig)
 
     def postprocess(self):
         if self.role == "node" and self.chunk_store.replication_factor < 1:

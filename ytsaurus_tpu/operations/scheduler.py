@@ -166,6 +166,8 @@ class OperationScheduler:
     # -- lifecycle -------------------------------------------------------------
 
     def _run(self, op: Operation) -> None:
+        import time as _time
+
         # State transitions race with abort_operation (async ops): every
         # transition takes the lock, and aborted is terminal.
         with self._lock:
@@ -173,6 +175,7 @@ class OperationScheduler:
                 return                      # aborted before the thread ran
             op.state = "running"
         self._record(op)
+        t0 = _time.monotonic()
         try:
             controller = _CONTROLLERS.get(op.type)
             if controller is None:
@@ -203,6 +206,19 @@ class OperationScheduler:
                         attributes={
                             "traceback":
                                 traceback.format_exc()}).to_dict()
+        # Per-tenant accounting (ISSUE 6): a terminal operation folds
+        # its wall seconds + completed-job count under its spec pool —
+        # the operations plane shares the usage ledger with the query
+        # plane, so `yt top --by pool` sees both.  Failed/aborted runs
+        # fold too: the slots they held were consumed either way.
+        try:
+            from ytsaurus_tpu.query.accounting import get_accountant
+            get_accountant().observe_operation(
+                op.spec.get("pool", "default"), op.spec.get("user"),
+                wall_seconds=_time.monotonic() - t0,
+                jobs=int(op.progress.get("completed", 0) or 0))
+        except Exception:   # noqa: BLE001 — accounting must never fail
+            pass            # an operation's state transition
         self._record(op)
         if op.state == "failed" and op.spec.get("raise_on_failure", True):
             raise YtError.from_dict(op.error)

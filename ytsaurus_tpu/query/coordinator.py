@@ -55,13 +55,14 @@ def _is_transient(err: Exception) -> bool:
 
 def _retry_transient(fn, site: "Optional[failpoints.FailpointSite]" = None,
                      token=None, span_name: Optional[str] = None,
-                     **span_tags):
+                     stats=None, **span_tags):
     """Jittered-exponential-backoff retry of transient failures (policy
     `query_shard` in config.py) around one shard-granular step.  A token
     past its deadline stops the ladder — retries must not keep a dead
     query alive past its budget.  `span_name` opens one child span PER
     ATTEMPT (same trace, fresh span, tagged `attempt=`), so a retried
-    shard shows every try in the flight recorder."""
+    shard shows every try in the flight recorder; `stats.retries` counts
+    the extra attempts (per-tenant accounting charges them)."""
     policy = retry_policy("query_shard")
     for attempt in range(policy.attempts):
         try:
@@ -75,10 +76,13 @@ def _retry_transient(fn, site: "Optional[failpoints.FailpointSite]" = None,
         except (OSError, YtError) as err:
             if not _is_transient(err) or attempt + 1 >= policy.attempts:
                 raise
+            if stats is not None:
+                stats.retries += 1
             time.sleep(policy.delay(attempt))
 
 
-def _wrap_lazy_shard(shard, token=None, index: Optional[int] = None):
+def _wrap_lazy_shard(shard, token=None, index: Optional[int] = None,
+                     stats=None):
     """Lazy shards retry their own staging so one transient chunk-read
     failure doesn't sink the whole scan.  The CALLER's trace context is
     captured explicitly: staging runs on prefetch-executor threads whose
@@ -90,7 +94,7 @@ def _wrap_lazy_shard(shard, token=None, index: Optional[int] = None):
     def staged():
         return _retry_transient(shard, site=_FP_MATERIALIZE, token=token,
                                 span_name="coordinator.shard_stage",
-                                shard=index)
+                                stats=stats, shard=index)
 
     return lambda: captured.run(staged)
 
@@ -353,6 +357,7 @@ class _PrefetchScanner:
         chunk = self._futures.pop(i).result()
         if self.stats is not None and self.count_rows:
             self.stats.rows_read += chunk.row_count
+            self.stats.bytes_read += chunk.nbytes
         return chunk
 
     def feedback(self) -> None:
@@ -411,7 +416,7 @@ def coordinate_and_execute(
         token.check()
     lazy = any(callable(c) for c in chunks)
     if lazy:
-        chunks = [_wrap_lazy_shard(c, token=token, index=i)
+        chunks = [_wrap_lazy_shard(c, token=token, index=i, stats=stats)
                   for i, c in enumerate(chunks)]
     # Early-exit budget, decided BEFORE any shard coalescing: when a
     # LIMIT scan can stop after the first shard or two, merging every
@@ -449,16 +454,18 @@ def coordinate_and_execute(
         stats.shards_total += len(chunks)
         if not lazy:
             stats.rows_read += sum(c.row_count for c in chunks)
+            stats.bytes_read += sum(c.nbytes for c in chunks)
     if len(chunks) == 1:
         chunk = _materialize(chunks[0])
         if lazy and stats is not None:
             stats.shards_staged += 1
             stats.rows_read += chunk.row_count
+            stats.bytes_read += chunk.nbytes
         result = _retry_transient(
             lambda: evaluator.run_plan(plan, chunk, foreign_chunks,
                                        stats=stats, token=token),
             site=_FP_EXECUTE, token=token,
-            span_name="coordinator.shard", shard=0)
+            span_name="coordinator.shard", stats=stats, shard=0)
     else:
         bottom, front = split_plan(plan)
         # LIMIT early-exit (ref: pull-model readers stop at the limit,
@@ -526,7 +533,8 @@ def coordinate_and_execute(
                             bottom, c, foreign_chunks, stats=stats,
                             token=token),
                         site=_FP_EXECUTE, token=token,
-                        span_name="coordinator.shard", shard=i))
+                        span_name="coordinator.shard", stats=stats,
+                        shard=i))
                     scanner.feedback()
                     continue
                 partial = _retry_transient(
@@ -534,7 +542,7 @@ def coordinate_and_execute(
                         bottom, c, foreign_chunks, stats=stats,
                         token=token),
                     site=_FP_EXECUTE, token=token,
-                    span_name="coordinator.shard", shard=i)
+                    span_name="coordinator.shard", stats=stats, shard=i)
                 partials.append(partial)
                 collected += partial.row_count
                 if needed is not None and collected >= needed:

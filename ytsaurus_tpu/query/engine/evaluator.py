@@ -22,6 +22,14 @@ from ytsaurus_tpu.query.engine.joins import execute_join
 from ytsaurus_tpu.query.engine.lowering import prepare
 from ytsaurus_tpu.query.statistics import QueryStatistics
 from ytsaurus_tpu.schema import EValueType, TableSchema
+from ytsaurus_tpu.utils.profiling import PoolSensorCache
+
+# Process-wide compile-cache counters, tagged by the admitted query's
+# pool (identity rides the CancellationToken): the steady-state
+# compile-cache hit-rate SLO (ROADMAP item 1's acceptance gate, a
+# TIME-SERIES claim) reads these from the telemetry history rings.
+_cache_counters = PoolSensorCache("/query/compile_cache",
+                                  ("hits", "misses"))
 
 
 class _PendingResult:
@@ -140,14 +148,16 @@ class Evaluator:
                           rows=chunk.row_count)
         with span:
             pending = self._dispatch_traced(plan, chunk, foreign_chunks,
-                                            stats, t0, fp)
+                                            stats, t0, fp,
+                                            pool=getattr(token, "pool",
+                                                         None))
             span.add_tag("compile_seconds",
                          round(getattr(pending, "compile_seconds", 0.0),
                                6))
             return pending
 
     def _dispatch_traced(self, plan, chunk, foreign_chunks, stats, t0,
-                         fp=None):
+                         fp=None, pool=None):
         import time as _time
         if isinstance(plan, ir.Query) and plan.joins:
             foreign_chunks = foreign_chunks or {}
@@ -176,10 +186,11 @@ class Evaluator:
         # The concat needs both row counts, so totals plans materialize
         # eagerly.
         if plan.group is not None and plan.group.totals:
-            main = self._dispatch(plan, chunk, stats, fp=fp)
+            main = self._dispatch(plan, chunk, stats, fp=fp, pool=pool)
             result = main.finish()
             totals_plan = _make_totals_plan(plan)
-            totals_pending = self._dispatch(totals_plan, chunk, stats)
+            totals_pending = self._dispatch(totals_plan, chunk, stats,
+                                            pool=pool)
             totals = totals_pending.finish()
             result = concat_chunks([result, totals])
             if stats is not None:
@@ -189,7 +200,7 @@ class Evaluator:
                     main.compile_seconds - totals_pending.compile_seconds
             return _ReadyResult(result)
 
-        pending = self._dispatch(plan, chunk, stats, fp=fp)
+        pending = self._dispatch(plan, chunk, stats, fp=fp, pool=pool)
         pending.stats = stats
         # The execute clock starts after compilation: wall = compile +
         # execute, reported separately (EXPLAIN ANALYZE's first split).
@@ -198,7 +209,8 @@ class Evaluator:
 
     def _dispatch(self, plan, chunk: ColumnarChunk,
                   stats: Optional[QueryStatistics] = None,
-                  fp: Optional[str] = None) -> _PendingResult:
+                  fp: Optional[str] = None,
+                  pool: Optional[str] = None) -> _PendingResult:
         import time as _time
 
         from ytsaurus_tpu.utils.tracing import child_span
@@ -231,11 +243,14 @@ class Evaluator:
                     result = fn(*args)
                 compile_seconds = _time.perf_counter() - t0c
             self._cache[key] = fn
+            _cache_counters.counters(pool)["misses"].increment()
             if stats is not None:
                 stats.compile_count += 1
                 stats.compile_time += compile_seconds
-        elif stats is not None:
-            stats.cache_hits += 1
+        else:
+            _cache_counters.counters(pool)["hits"].increment()
+            if stats is not None:
+                stats.cache_hits += 1
         if result is None:
             try:
                 planes, count = fn(*args)

@@ -28,18 +28,20 @@ from ytsaurus_tpu.utils import tracing
 class ExecutionProfile:
     """One query's structured profile (EXPLAIN ANALYZE payload)."""
 
-    __slots__ = ("query", "trace_id", "pool", "started_at", "wall_time",
-                 "admission_wait", "compile_time", "execute_time",
-                 "statistics", "rows")
+    __slots__ = ("query", "trace_id", "pool", "user", "started_at",
+                 "wall_time", "admission_wait", "compile_time",
+                 "execute_time", "statistics", "rows")
 
     def __init__(self, query: str, trace_id: Optional[str], pool: str,
                  started_at: float, wall_time: float,
                  admission_wait: float, compile_time: float,
                  execute_time: float, statistics: dict,
-                 rows: Optional[list] = None):
+                 rows: Optional[list] = None,
+                 user: Optional[str] = None):
         self.query = query
         self.trace_id = trace_id
         self.pool = pool
+        self.user = user or "root"
         self.started_at = started_at
         self.wall_time = wall_time
         self.admission_wait = admission_wait
@@ -50,19 +52,26 @@ class ExecutionProfile:
 
     @classmethod
     def capture(cls, root_span, query: str, stats, wall_time: float,
-                pool: Optional[str] = None) -> "ExecutionProfile":
+                pool: Optional[str] = None,
+                user: Optional[str] = None) -> "ExecutionProfile":
         """Fold one finished query into a profile.  `root_span` may be
         the NULL span (unsampled query): the profile still carries the
         wall time + statistics, just no trace id / span tree.  Admission
         wait rides as a tag on the root span (stamped by the gateway at
         the admit site) — reading it here costs a dict probe, not a scan
-        of the span ring."""
+        of the span ring.  `user` defaults to the ambient authenticated
+        principal, so per-tenant accounting attributes the query even on
+        proxy paths that never pass identity explicitly."""
         stats_dict = stats.to_dict() if stats is not None else {}
         admission_wait = float(
             getattr(root_span, "tags", {}).get("admission_wait_s", 0.0))
         trace_id = getattr(root_span, "trace_id", None)
+        if user is None:
+            from ytsaurus_tpu.cypress.security import current_user
+            user = current_user()
         return cls(query=query[:500], trace_id=trace_id,
-                   pool=pool or "default", started_at=time.time(),
+                   pool=pool or "default", user=user,
+                   started_at=time.time(),
                    wall_time=wall_time, admission_wait=admission_wait,
                    compile_time=float(stats_dict.get("compile_time", 0.0)),
                    execute_time=float(stats_dict.get("execute_time", 0.0)),
@@ -108,7 +117,7 @@ def format_profile_dict(p: dict) -> str:
     lines = [
         f"query: {p.get('query')}",
         f"trace_id: {p.get('trace_id') or '<unsampled>'}  "
-        f"pool: {p.get('pool')}",
+        f"pool: {p.get('pool')}  user: {p.get('user', 'root')}",
         f"wall {_ms(p.get('wall_time', 0.0))}  "
         f"(admission {_ms(p.get('admission_wait', 0.0))}, "
         f"compile {_ms(p.get('compile_time', 0.0))}, "

@@ -52,7 +52,9 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Sequence
 
 from ytsaurus_tpu.config import ServingConfig
+from ytsaurus_tpu.cypress.security import current_user
 from ytsaurus_tpu.errors import EErrorCode, ThrottledError, YtError
+from ytsaurus_tpu.query.accounting import get_accountant
 from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.profiling import Profiler
 from ytsaurus_tpu.utils.tracing import NULL_SPAN, child_span, current_trace
@@ -79,23 +81,32 @@ class CancellationToken:
     `check()` is the probe the coordinator/evaluator call between units
     of work; it raises `DeadlineExceeded` (terminal — never retried) or
     `Canceled`.  Tokens are cheap and thread-safe; `None` everywhere
-    means "no deadline" so non-gateway callers pay nothing."""
+    means "no deadline" so non-gateway callers pay nothing.
 
-    __slots__ = ("deadline", "pool", "_cancelled", "_reason")
+    The token also carries the admitted request's IDENTITY — (pool,
+    user) — down through `coordinator.coordinate_and_execute`, the
+    evaluator, and the tablet read path, so per-tenant resource
+    accounting (query/accounting.py) can attribute what each layer
+    consumed without a side channel."""
+
+    __slots__ = ("deadline", "pool", "user", "_cancelled", "_reason")
 
     def __init__(self, deadline: Optional[float] = None,
-                 pool: Optional[str] = None):
+                 pool: Optional[str] = None,
+                 user: Optional[str] = None):
         self.deadline = deadline          # time.monotonic() timestamp
         self.pool = pool
+        self.user = user
         self._cancelled = False
         self._reason: Optional[str] = None
 
     @classmethod
     def with_timeout(cls, timeout: Optional[float],
-                     pool: Optional[str] = None) -> "CancellationToken":
+                     pool: Optional[str] = None,
+                     user: Optional[str] = None) -> "CancellationToken":
         deadline = time.monotonic() + timeout \
             if timeout is not None and timeout > 0 else None
-        return cls(deadline, pool=pool)
+        return cls(deadline, pool=pool, user=user)
 
     def cancel(self, reason: str = "query cancelled") -> None:
         self._reason = reason
@@ -139,7 +150,8 @@ class _PoolState:
                  "admitted", "rejected", "expired",
                  "queue_gauge", "in_flight_gauge", "wait_hist")
 
-    def __init__(self, name: str, slots: int, profiler: Profiler):
+    def __init__(self, name: str, slots: int, profiler: Profiler,
+                 serving_profiler: Profiler):
         self.name = name
         self.slots = slots
         self.in_flight = 0
@@ -151,10 +163,15 @@ class _PoolState:
         self.admitted = prof.counter("admitted")
         self.rejected = prof.counter("rejected")
         self.expired = prof.counter("expired")
-        self.queue_gauge = prof.gauge("queue_depth")
         self.in_flight_gauge = prof.gauge("in_flight")
         self.wait_hist = prof.histogram("admission_wait_seconds",
                                         bounds=_LATENCY_BOUNDS)
+        # ISSUE 6 satellite: the per-pool backlog as a REAL routing
+        # signal at the serving root (`serving_queue_depth{pool=}`) —
+        # load-aware replica routing (ROADMAP 3) reads it off /metrics
+        # instead of reaching into gateway internals.
+        self.queue_gauge = serving_profiler.with_tags(
+            pool=name).gauge("queue_depth")
 
 
 class AdmissionController:
@@ -170,18 +187,25 @@ class AdmissionController:
     def __init__(self, config: ServingConfig):
         self.config = config
         self._cond = threading.Condition()
-        profiler = Profiler("/serving/admission")
+        serving_profiler = Profiler("/serving")
+        profiler = serving_profiler.with_prefix("/admission")
         pools = config.pools or {config.default_pool: 1.0}
         total_weight = sum(w for w in pools.values()) or 1.0
         self._pools: dict[str, _PoolState] = {}
         for name, weight in pools.items():
             slots = max(1, round(config.slots * float(weight)
                                  / total_weight))
-            self._pools[name] = _PoolState(name, slots, profiler)
+            self._pools[name] = _PoolState(name, slots, profiler,
+                                           serving_profiler)
         # EWMA of slot hold time, seeded pessimistically; feeds the
         # retry_after hint so clients back off proportionally to the
-        # actual drain rate instead of a blind constant.
+        # actual drain rate instead of a blind constant.  Exported as
+        # `serving_hold_ewma_seconds` (ISSUE 6 satellite): the routing
+        # signal was private to this object, and load-aware replica
+        # routing needs it from /metrics.
         self._hold_ewma = 0.05
+        self._hold_gauge = serving_profiler.gauge("hold_ewma_seconds")
+        self._hold_gauge.set(self._hold_ewma)
 
     def _resolve(self, pool: Optional[str]) -> _PoolState:
         return self._pools.get(pool or self.config.default_pool) or \
@@ -202,6 +226,7 @@ class AdmissionController:
                     state.waiting >= self.config.max_queue:
                 state.rejected_n += 1
                 state.rejected.increment()
+                get_accountant().observe_throttle(state.name, token.user)
                 raise ThrottledError(
                     f"serving pool {state.name!r} is saturated "
                     f"({state.slots} slots, {state.waiting} queued)",
@@ -236,6 +261,7 @@ class AdmissionController:
             state.in_flight -= 1
             state.in_flight_gauge.set(state.in_flight)
             self._hold_ewma += 0.2 * (held_seconds - self._hold_ewma)
+            self._hold_gauge.set(self._hold_ewma)
             # notify_all, NOT notify: the condition is shared by every
             # pool, and a single notify could wake a waiter of a still-
             # saturated OTHER pool — it would re-wait, consuming the
@@ -309,13 +335,16 @@ class _Batch:
     flusher thread (which has no ambient context of its own) can parent
     its batch-flush span into that caller's trace."""
 
-    __slots__ = ("key_lists", "deadline", "pool", "client", "created",
-                 "done", "results", "error", "trace")
+    __slots__ = ("key_lists", "users", "deadline", "pool", "user",
+                 "client", "created", "done", "results", "error",
+                 "trace")
 
     def __init__(self, token: CancellationToken, client):
         self.key_lists: list = []       # list[list[nkey]] per request
+        self.users: list = []           # requesting user, per request
         self.deadline = token.deadline
         self.pool = token.pool
+        self.user = token.user
         self.client = client
         self.created = time.monotonic()
         self.done = threading.Event()
@@ -329,7 +358,8 @@ class _Batch:
                 else max(self.deadline, token.deadline)
 
     def flush_token(self) -> CancellationToken:
-        return CancellationToken(self.deadline, pool=self.pool)
+        return CancellationToken(self.deadline, pool=self.pool,
+                                 user=self.user)
 
 
 class LookupBatcher:
@@ -432,6 +462,7 @@ class LookupBatcher:
             else:
                 batch.join(token)
             batch.key_lists.append(nkeys)
+            batch.users.append(token.user)
             if self._flusher is None or not self._flusher.is_alive():
                 self._flusher = threading.Thread(
                     target=self._flusher_loop, daemon=True,
@@ -555,6 +586,7 @@ class LookupBatcher:
         self.batched_keys.increment(len(union))
         self.batch_size_hist.record(len(union))
         results: dict[tuple, Optional[dict]] = {}
+        pool = batch.pool or self.config.default_pool
         items = list(ctx.route(union).items())
         if len(items) > 1 and len(union) >= 32:
             # Parallel per-tablet fan-out (the sequential per-tablet
@@ -568,19 +600,34 @@ class LookupBatcher:
                 self._executor.submit(_cv.copy_context().run,
                                       self._read_tablet,
                                       ctx.tablets, idx, part,
-                                      timestamp)
+                                      timestamp, pool)
                 for idx, part in items]
             for fut in futures:
                 results.update(fut.result())
         else:
             for idx, part in items:
                 results.update(self._read_tablet(
-                    ctx.tablets, idx, part, timestamp))
+                    ctx.tablets, idx, part, timestamp, pool))
+        # Fold into per-tenant accounting before waking the waiters:
+        # the FLUSH is one `lookup_batches` unit under the cohort
+        # opener's identity (it maps 1:1 onto the admission slot the
+        # flush held — the per-pool reconciliation unit), while each
+        # member request's keys/rows charge ITS OWN user, so a cohort
+        # of mixed tenants doesn't bill everything to whoever opened
+        # the batch window.
+        accountant = get_accountant()
+        accountant.observe_lookup_batch(pool, batch.user)
+        for nkeys, user in zip(batch.key_lists, batch.users):
+            distinct = dict.fromkeys(nkeys)
+            accountant.observe_lookup(
+                pool, user, keys=len(distinct),
+                rows_found=sum(1 for nk in distinct
+                               if results.get(nk) is not None))
         batch.results = results
         batch.done.set()
 
     def _read_tablet(self, tablets, idx: int, part: list,
-                     timestamp: int) -> dict:
+                     timestamp: int, pool: Optional[str] = None) -> dict:
         """One tablet's slice of the batch, capped at max_batch_size
         keys per read; the tablet's batched chunk probe buckets its
         needle shapes to powers of two (min_bucket)."""
@@ -589,7 +636,7 @@ class LookupBatcher:
         for lo in range(0, len(part), cap):
             piece = part[lo:lo + cap]
             rows = tablets[idx].lookup_rows(piece, timestamp=timestamp,
-                                            normalized=True)
+                                            normalized=True, pool=pool)
             out.update(zip(piece, rows))
         return out
 
@@ -635,12 +682,23 @@ class QueryGateway:
     def enabled(self) -> bool:
         return bool(self.config.enabled)
 
+    def resolve_pool(self, pool: Optional[str]) -> str:
+        """The admission-resolved pool name (None/unknown pools land on
+        the default pool's slots) — the ONE identity admission counters,
+        per-pool sensors, and accounting must share, or per-pool
+        reconciliation splits between a requested and an admitted name."""
+        return self.admission._resolve(pool).name
+
     def make_token(self, timeout: Optional[float],
                    pool: Optional[str] = None) -> CancellationToken:
         if timeout is None:
             timeout = self.config.default_timeout or None
+        # Identity rides the token: the ADMISSION-RESOLVED pool plus the
+        # ambient authenticated principal (RPC/HTTP entry points restore
+        # it per request — cypress/security.authenticated_user).
         return CancellationToken.with_timeout(
-            timeout, pool=pool or self.config.default_pool)
+            timeout, pool=self.resolve_pool(pool),
+            user=current_user())
 
     # -- selects ---------------------------------------------------------------
 

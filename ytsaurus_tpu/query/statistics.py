@@ -11,6 +11,7 @@ from dataclasses import dataclass
 class QueryStatistics:
     rows_read: int = 0
     rows_written: int = 0
+    bytes_read: int = 0              # resident bytes of scanned planes
     execute_time: float = 0.0        # seconds, wall, incl. device sync
     compile_time: float = 0.0        # seconds building device programs
     compile_count: int = 0           # programs compiled (cache misses)
@@ -19,6 +20,7 @@ class QueryStatistics:
     shards_pruned: int = 0
     shards_skipped: int = 0          # LIMIT early-exit left these unread
     shards_staged: int = 0           # shards actually fetched/decoded
+    retries: int = 0                 # transient per-shard retry attempts
     joins_executed: int = 0
 
     def to_dict(self) -> dict:

@@ -408,12 +408,24 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     # process can publish into named groups; NodeTracker stays the
     # data-node special case.
     from ytsaurus_tpu.server.discovery import (
+        DAEMONS_GROUP,
         DiscoveryService,
         DiscoveryTracker,
+        announce_daemon,
     )
     discovery = DiscoveryTracker()
     server.add_service(DiscoveryService(discovery))
     orchid.register("/discovery", discovery.list_groups)
+    # Cluster telemetry plane (ISSUE 6): start the sampler that fills
+    # the metrics-history rings + evaluates SLO burn rates, register
+    # this primary's monitoring endpoint in /daemons, and wire the
+    # /cluster roll-up to scrape every registered member.
+    from ytsaurus_tpu.utils.profiling import start_telemetry
+    start_telemetry()
+    announce_daemon(discovery, "primary", monitoring.address,
+                    role="primary")
+    monitoring.cluster_members = \
+        lambda: discovery.list_members(DAEMONS_GROUP)
     if kafka:
         # Kafka wire protocol over queues (ref server/kafka_proxy):
         # in-process with the primary, like the query tracker / queue
@@ -560,6 +572,11 @@ def run_node(root: str, port: int, primary_address: str,
     monitoring = MonitoringServer(orchid)
     monitoring.start()
     _write_port_file(root, "node.monitoring", monitoring.port)
+    # Telemetry plane (ISSUE 6): every daemon samples its own sensors
+    # into bounded history rings; the primary's /cluster scrapes them.
+    from ytsaurus_tpu.server.discovery import DAEMONS_GROUP
+    from ytsaurus_tpu.utils.profiling import start_telemetry
+    start_telemetry()
     print(f"data node {node_id} serving on {server.address}", flush=True)
 
     # Multi-master: heartbeat EVERY primary (comma-separated), each on
@@ -574,6 +591,20 @@ def run_node(root: str, port: int, primary_address: str,
             try:
                 channel.call("node_tracker", "heartbeat",
                              {"id": node_id, "address": address})
+                # Telemetry membership rides the same cadence: the
+                # primary's /cluster roll-up scrapes every /daemons
+                # member's monitoring endpoint.  Own try: the discovery
+                # service only comes up after WAL recovery, and its
+                # absence during bootstrap must not spam the log (the
+                # node_tracker beat above already succeeded).
+                try:
+                    channel.call("discovery", "heartbeat",
+                                 {"group": DAEMONS_GROUP,
+                                  "member_id": node_id,
+                                  "address": monitoring.address,
+                                  "attributes": {"role": "node"}})
+                except Exception:   # noqa: BLE001
+                    pass
             except Exception as exc:  # noqa: BLE001 — keep heartbeating
                 print(f"# heartbeat to {primary} failed: {exc}",
                       file=sys.stderr, flush=True)

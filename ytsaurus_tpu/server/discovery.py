@@ -18,6 +18,31 @@ from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc import Service, rpc_method
 from ytsaurus_tpu.rpc.wire import wire_text as _text
 
+# Every telemetry-bearing daemon self-registers here (member address =
+# its MONITORING endpoint): the primary's /cluster roll-up lists this
+# group and scrapes each member's /telemetry (server/monitoring.py).
+DAEMONS_GROUP = "/daemons"
+
+
+def announce_daemon(tracker: "DiscoveryTracker", member_id: str,
+                    monitoring_address: str, role: str,
+                    period: float = 5.0) -> threading.Thread:
+    """In-process self-registration loop (primary-side daemons): keeps
+    this process's monitoring endpoint alive in the tracker's /daemons
+    group.  Remote daemons (data nodes) heartbeat the same group over
+    the discovery RPC service instead (server/daemon.py beat loop)."""
+    def loop() -> None:
+        while True:
+            tracker.heartbeat(DAEMONS_GROUP, member_id,
+                              address=monitoring_address,
+                              attributes={"role": role})
+            time.sleep(period)
+
+    thread = threading.Thread(target=loop, daemon=True,
+                              name=f"daemon-announce-{member_id}")
+    thread.start()
+    return thread
+
 
 class DiscoveryTracker:
     """Group → member_id → (address, attributes, expiry)."""

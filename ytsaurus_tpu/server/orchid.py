@@ -126,7 +126,8 @@ class OrchidService(Service):
 
 
 def default_orchid(config=None) -> OrchidTree:
-    """Standard daemon mounts: /config, /monitoring/sensors, /tracing."""
+    """Standard daemon mounts: /config, /monitoring/sensors, /tracing,
+    /telemetry (history rings + SLO state), /accounting."""
     from ytsaurus_tpu.utils.profiling import get_registry
     from ytsaurus_tpu.utils.tracing import get_collector
 
@@ -141,6 +142,13 @@ def default_orchid(config=None) -> OrchidTree:
     # reads over the RPC orchid) + the bounded slow-query log.
     tree.register("/tracing/traces", _traces_producer)
     tree.register("/tracing/slow_queries", _slow_queries_producer)
+    # Telemetry plane (ISSUE 6): the bounded metrics-history rings, the
+    # SLO burn-rate state, and per-tenant resource accounting — the RPC
+    # twins of the monitoring /metrics/history, /slo, and /accounting
+    # endpoints (`yt top` reads /accounting through this orchid).
+    tree.register("/telemetry/history", _history_producer)
+    tree.register("/telemetry/slo", _slo_producer)
+    tree.register("/accounting", _accounting_producer)
     return tree
 
 
@@ -153,3 +161,18 @@ def _slow_queries_producer() -> list:
     from ytsaurus_tpu.query.profile import get_flight_recorder
     return [p.to_dict(include_rows=False)
             for p in get_flight_recorder().slow_queries()]
+
+
+def _history_producer() -> dict:
+    from ytsaurus_tpu.utils.profiling import get_history
+    return get_history().dump()
+
+
+def _slo_producer() -> dict:
+    from ytsaurus_tpu.utils.slo import get_slo_tracker
+    return get_slo_tracker().snapshot()
+
+
+def _accounting_producer() -> dict:
+    from ytsaurus_tpu.query.accounting import get_accountant
+    return get_accountant().snapshot()

@@ -28,7 +28,7 @@ from ytsaurus_tpu.config import tablet_config
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.utils import invariants
 from ytsaurus_tpu.utils.invariants import check as _invariant_check
-from ytsaurus_tpu.utils.profiling import Profiler
+from ytsaurus_tpu.utils.profiling import PoolSensorCache, Profiler
 from ytsaurus_tpu.utils.tracing import child_span
 from ytsaurus_tpu.schema import EValueType, SortOrder, TableSchema
 from ytsaurus_tpu.tablet import mvcc
@@ -42,6 +42,11 @@ _SNAP_HITS = _snap_profiler.counter("hits")
 _SNAP_MISSES = _snap_profiler.counter("misses")
 _SNAP_EVICTIONS = _snap_profiler.counter("evictions")
 _SNAP_BYTES = _snap_profiler.gauge("bytes_pinned")
+
+# Per-pool tablet read counters (ISSUE 6): the serving plane threads the
+# admitted cohort's pool down to the tablet read, so per-tenant resource
+# accounting sees tablet-level consumption, not just gateway-level.
+_lookup_counters = PoolSensorCache("tablet/lookup", ("reads", "keys"))
 _snap_lock = threading.Lock()
 _snap_bytes_pinned = 0
 
@@ -507,12 +512,17 @@ class Tablet:
     def lookup_rows(self, keys: Sequence[tuple],
                     timestamp: int = MAX_TIMESTAMP,
                     column_names: Optional[Sequence[str]] = None,
-                    normalized: bool = False) -> list[Optional[dict]]:
+                    normalized: bool = False,
+                    pool: Optional[str] = None) -> list[Optional[dict]]:
         """Point reads at a timestamp (ref tablet_node/lookup.cpp).
 
         normalized=True: the caller already holds canonical keys
         (normalize_key output) — the serving-plane batcher normalizes
         once per request and must not pay it again per batch.
+
+        `pool` is the admitted cohort's identity (serving plane): reads
+        tick per-pool tablet sensors (`tablet_lookup_reads{pool=}`) so
+        accounting attributes tablet consumption to tenants.
 
         Batched chunk probe: keys missing the row cache are matched
         against each versioned chunk in ONE vectorized pass (np.isin
@@ -520,6 +530,9 @@ class Tablet:
         the per-chunk cost drops from O(rows x keys) to O(rows +
         matches), which is what makes the serving plane's micro-batches
         pay off (ref tablet_node/lookup.cpp batched lookup sessions)."""
+        counters = _lookup_counters.counters(pool)
+        counters["reads"].increment()
+        counters["keys"].increment(len(keys))
         with child_span("tablet.lookup", keys=len(keys),
                         chunks=len(self.chunk_ids)), self._lock:
             key_names = self.schema.key_column_names
