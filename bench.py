@@ -27,6 +27,12 @@ config, printing the headline (TPC-H Q1, config 1) last:
           span-site fast path ≲1µs, reports sampled-mode tracing
           overhead on the select and warm-scan shapes; metric is the
           traced select throughput
+  replay  workload recorder + replay harness (ISSUE 8): records a
+          parameterized-query mix, exports/reloads it through the
+          versioned capture format, then replays it open-loop against
+          the live gateway; metric is the achieved replay throughput,
+          p50/p99/p999 + steady-state compile-cache hit rate + slowest
+          trace ids print on stderr
   telemetry_overhead  cluster telemetry plane (ISSUE 6): asserts the
           per-site sensor-recording cost ≲1µs and the per-query
           accounting fold ≲20µs, then runs the serving lookup shape
@@ -719,6 +725,91 @@ def bench_telemetry_overhead(n_rows, iters):
     return "telemetry_overhead_rows_per_sec", best_on, best_on_elapsed
 
 
+def bench_replay(n_rows, iters):
+    """Workload recorder + replay harness (ISSUE 8): record a
+    parameterized-query mix (3 shapes x skewed literal draws — the
+    repeated-shape/varied-literal traffic ROADMAP 1 must compile once)
+    against a flushed dynamic table, export the capture through the
+    versioned workload-log schema, re-load it, and REPLAY it open-loop
+    against the live gateway.  Reports p50/p99/p999, throttle/deadline
+    counts, and the steady-state compile-cache hit rate (second half of
+    the mix) — the measurement substrate the ROADMAP-1 ">=99% hit rate"
+    acceptance will run on.  The emitted metric is the achieved replay
+    query throughput; the latency/hit-rate detail and the slowest
+    queries' trace ids go to stderr.  n_rows sizes the table."""
+    import os as _os
+    import random
+    import tempfile
+
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.query import workload as wl
+    from ytsaurus_tpu.schema import TableSchema
+
+    root = tempfile.mkdtemp(prefix="bench-replay-")
+    client = connect(root)
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")],
+        unique_keys=True)
+    client.create("table", "//bench/replay",
+                  attributes={"schema": schema, "dynamic": True,
+                              "pivot_keys": [[n_rows // 2]]},
+                  recursive=True)
+    client.mount_table("//bench/replay")
+    for lo in range(0, n_rows, 50_000):
+        hi = min(lo + 50_000, n_rows)
+        client.insert_rows("//bench/replay",
+                           [{"k": i, "g": i % 97, "v": i * 3}
+                            for i in range(lo, hi)])
+    client.freeze_table("//bench/replay")
+
+    # Record phase: every select folds into the process workload log
+    # (fresh — configure(None) rebinds it) via the normal client path.
+    wl.configure(None)
+    shapes = [
+        "k, v FROM [//bench/replay] WHERE k = {}",
+        "g, sum(v) AS s FROM [//bench/replay] WHERE v < {} GROUP BY g",
+        "k, v FROM [//bench/replay] WHERE k > {} ORDER BY k LIMIT 10",
+    ]
+    rng = random.Random(7)
+    distinct = [rng.randrange(n_rows) for _ in range(16)]
+    n_queries = 240
+    for i in range(n_queries):
+        client.select_rows(shapes[i % len(shapes)].format(
+            distinct[rng.randrange(4) if rng.random() < 0.5
+                     else rng.randrange(len(distinct))]))
+    capture_path = _os.path.join(root, "capture.json")
+    written = wl.get_workload_log().export_capture(capture_path)
+    records = wl.load_capture(capture_path)   # versioned-schema check
+    assert written == len(records) == n_queries, (written, len(records))
+
+    best = None
+    times = []
+    while _iters_left(times, iters):
+        t0 = time.perf_counter()
+        report = wl.replay(client, records, rate=400.0, max_workers=8)
+        times.append(time.perf_counter() - t0)
+        if best is None or report["achieved_rate"] > \
+                best["achieved_rate"]:
+            best = report
+    lat, cache = best["latency"], best["compile_cache"]
+    slow = best["slowest"][0] if best["slowest"] else {}
+    print(f"# replay: {best['queries']} queries in "
+          f"{best['elapsed_seconds']:.2f}s "
+          f"({best['achieved_rate']:.0f}/s of {best['offered_rate']:.0f}/s "
+          f"offered); p50={lat['p50_ms']:.2f}ms p99={lat['p99_ms']:.2f}ms "
+          f"p999={lat['p999_ms']:.2f}ms; "
+          f"{best['throttled']} throttled, {best['deadline']} deadline, "
+          f"{best['error']} error; compile hit rate "
+          f"{(cache['hit_rate'] or 0) * 100:.1f}% "
+          f"(steady {(cache['steady_hit_rate'] or 0) * 100:.1f}%); "
+          f"slowest {slow.get('wall_ms')}ms trace={slow.get('trace_id')}",
+          file=sys.stderr)
+    assert best["ok"] == best["queries"], best
+    assert cache["steady_hit_rate"] is not None
+    return ("replay_queries_per_sec", best["achieved_rate"],
+            best["elapsed_seconds"])
+
+
 def bench_scan(n_rows, iters):
     """Versioned MVCC read path (ISSUE 4): snapshot reads over a tablet
     with three flushed version generations (overwrites, deletes, partial
@@ -827,6 +918,7 @@ _CONFIGS = {
     "scan": (bench_scan, 500_000, 100_000),
     "trace_overhead": (bench_trace_overhead, 2_000_000, 500_000),
     "telemetry_overhead": (bench_telemetry_overhead, 200_000, 100_000),
+    "replay": (bench_replay, 200_000, 100_000),
 }
 
 
@@ -944,6 +1036,7 @@ _METRIC_NAMES = {
     "scan": "scan_rows_per_sec",
     "trace_overhead": "trace_overhead_rows_per_sec",
     "telemetry_overhead": "telemetry_overhead_rows_per_sec",
+    "replay": "replay_queries_per_sec",
 }
 
 

@@ -1,0 +1,558 @@
+"""Workload recorder + replay harness and the compilation observatory
+(ISSUE 8): query normalization (literal hoisting), the bounded/sampled/
+rotated workload log with versioned capture export/import, recording
+through the select/lookup planes, per-fingerprint compile telemetry
+(miss causes, shape spectrum, evictions, artifacts), the pow2
+capacity-bucket satellite in EXPLAIN ANALYZE, pool-sensor/observatory
+reconciliation under concurrent mixed-pool traffic, the
+recompilation-storm SLO (fires AND resolves), open-loop replay
+reporting (p50/p99/p999 + steady-state hit rate + slowest trace ids),
+the /workload + /compile monitoring endpoints, and the CLI surfaces.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.errors import EErrorCode, ThrottledError, YtError
+from ytsaurus_tpu.query import workload as wl
+from ytsaurus_tpu.query.lexer import tokenize
+from ytsaurus_tpu.schema import TableSchema
+
+
+@pytest.fixture(autouse=True)
+def _workload_defaults():
+    """Every test starts from a fresh workload log + observatory and
+    leaves the process-wide configs restored."""
+    wl.get_workload_log().clear()
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    yield
+    yt_config.set_workload_config(None)
+    wl.configure(None)
+    get_compile_observatory().reset()
+
+
+@pytest.fixture(scope="module")
+def client(tmp_path_factory):
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.config import ServingConfig
+    c = connect(str(tmp_path_factory.mktemp("workload-cluster")))
+    # Two REAL admission pools so mixed-pool traffic lands on distinct
+    # `pool=` sensor arms (the reconciliation satellite's setting).
+    c.cluster.serving_config = ServingConfig(
+        pools={"default": 1.0, "other": 1.0})
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("v", "int64")], unique_keys=True)
+    c.create("table", "//wl/t",
+             attributes={"schema": schema, "dynamic": True},
+             recursive=True)
+    c.mount_table("//wl/t")
+    c.insert_rows("//wl/t", [{"k": i, "v": i * 2} for i in range(100)])
+    return c
+
+
+def _fresh_evaluator_inputs(n_rows=100):
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    schema = TableSchema.make([("k", "int64"), ("v", "int64")])
+    chunk = ColumnarChunk.from_arrays(schema, {
+        "k": np.arange(n_rows, dtype=np.int64),
+        "v": np.arange(n_rows, dtype=np.int64)})
+    return schema, chunk
+
+
+def _plan(query, schema):
+    from ytsaurus_tpu.query.builder import build_query
+    return build_query(query, {"//t": schema})
+
+
+# -- query normalization -------------------------------------------------------
+
+def test_normalize_hoists_literals_and_round_trips():
+    q = ("k, v FROM [//some/table] WHERE k = 42 AND s = 'a\"b' "
+         "AND d < 1.5 AND k IN (1, 2, 3)")
+    normalized, literals = wl.normalize_query(q)
+    assert normalized.count("?") == len(literals) == 6
+    assert [kind for kind, _v in literals] == \
+        ["int64", "string", "double", "int64", "int64", "int64"]
+    assert "42" not in normalized and "a\"b" not in normalized
+    back = wl.substitute_literals(normalized, literals)
+    assert [(t.kind, t.value) for t in tokenize(back)] == \
+        [(t.kind, t.value) for t in tokenize(q)]
+
+
+def test_normalize_is_literal_invariant():
+    a = wl.normalize_query("v FROM [//t] WHERE k = 1 AND s = 'x'")
+    b = wl.normalize_query("v FROM [//t] WHERE k = 999 AND s = 'yyy'")
+    assert a[0] == b[0]
+    assert wl.query_fingerprint(a[0]) == wl.query_fingerprint(b[0])
+    c = wl.normalize_query("v FROM [//t] WHERE k > 1 AND s = 'x'")
+    assert wl.query_fingerprint(c[0]) != wl.query_fingerprint(a[0])
+
+
+def test_substitute_mismatch_fails_loudly():
+    with pytest.raises(YtError):
+        wl.substitute_literals("k = ? AND v = ?", [("int64", 1)])
+
+
+# -- the bounded log -----------------------------------------------------------
+
+def test_log_is_bounded_and_sampled():
+    log = wl.WorkloadLog(yt_config.WorkloadConfig(capacity=8))
+    for i in range(20):
+        log.observe(wl.WorkloadRecord(query=f"q{i}"))
+    assert log.recorded_n == 20 and len(log.records()) == 8
+    dropped = wl.WorkloadLog(yt_config.WorkloadConfig(sample_rate=0.0))
+    assert not dropped.observe(wl.WorkloadRecord(query="q"))
+    assert dropped.sampled_out_n == 1 and not dropped.records()
+    off = wl.WorkloadLog(yt_config.WorkloadConfig(enabled=False))
+    assert not off.observe_select("k FROM [//t]")
+
+
+def test_fingerprint_rollup_is_bounded():
+    log = wl.WorkloadLog(yt_config.WorkloadConfig(
+        fingerprint_capacity=2))
+    for i in range(4):
+        log.observe(wl.WorkloadRecord(query=f"shape{i}",
+                                      fingerprint=f"fp{i}"))
+    assert len(log.fingerprints(top=0)) == 2
+    assert log.fingerprints_dropped_n == 2
+
+
+def test_disk_log_rotates_with_versioned_headers(tmp_path):
+    cfg = yt_config.WorkloadConfig(log_dir=str(tmp_path),
+                                   rotate_bytes=4096, max_files=2)
+    log = wl.WorkloadLog(cfg)
+    for i in range(40):
+        log.observe(wl.WorkloadRecord(query="k FROM [//t] WHERE k = ?",
+                                      literals=[["int64", i]],
+                                      wall_time=0.001 * i))
+    base = tmp_path / wl.WorkloadLog.LOG_NAME
+    assert base.exists() and (tmp_path / (wl.WorkloadLog.LOG_NAME +
+                                          ".1")).exists()
+    header = json.loads(base.read_text().splitlines()[0])
+    assert header["workload_schema"] == wl.WORKLOAD_SCHEMA_VERSION
+    records = log.read_disk_log()
+    assert records and all(r.query == "k FROM [//t] WHERE k = ?"
+                           for r in records)
+    # A version-tampered file refuses to load.
+    lines = base.read_text().splitlines()
+    base.write_text("\n".join([json.dumps({"workload_schema": 999}),
+                               *lines[1:]]) + "\n")
+    with pytest.raises(YtError, match="incompatible"):
+        log.read_disk_log()
+
+
+def test_capture_roundtrip_and_version_check(tmp_path):
+    log = wl.WorkloadLog(yt_config.WorkloadConfig())
+    for i in range(5):
+        log.observe(wl.WorkloadRecord(query="v FROM [//t] WHERE k = ?",
+                                      literals=[["int64", i]],
+                                      pool="p", outcome="ok"))
+    path = tmp_path / "capture.json"
+    assert log.export_capture(str(path)) == 5
+    records = wl.load_capture(str(path))
+    assert len(records) == 5
+    assert records[3].literals == [["int64", 3]]
+    assert records[3].pool == "p"
+    # Incompatible schema version fails loudly BEFORE anything replays
+    # (the versioned workload-log satellite).
+    payload = json.loads(path.read_text())
+    payload["workload_schema"] = wl.WORKLOAD_SCHEMA_VERSION + 1
+    path.write_text(json.dumps(payload))
+    with pytest.raises(YtError, match="incompatible"):
+        wl.load_capture(str(path))
+    with pytest.raises(YtError):
+        wl.load_capture(str(tmp_path / "missing.json"))
+
+
+# -- recording through the planes ----------------------------------------------
+
+def test_select_folds_workload_record(client):
+    log = wl.get_workload_log()
+    client.select_rows("k, v FROM [//wl/t] WHERE k < 7")
+    rec = log.records()[-1]
+    assert rec.kind == "select" and rec.outcome == "ok"
+    assert rec.query == "k, v FROM [//wl/t] WHERE k < ?"
+    assert rec.literals == [["int64", 7]]
+    assert rec.pool == "default" and rec.wall_time > 0
+    assert rec.capacity_buckets, "pow2 buckets must ride the record"
+    assert rec.trace_id, "sampled select must carry its trace id"
+    rollup = log.fingerprints()
+    assert rollup[0]["count"] >= 1 and rollup[0]["ok"] >= 1
+
+
+def test_throttled_select_records_outcome(client):
+    from ytsaurus_tpu.utils import failpoints
+    log = wl.get_workload_log()
+    with failpoints.active("serving.admit=error", seed=1):
+        with pytest.raises(ThrottledError):
+            client.select_rows("k FROM [//wl/t]")
+    rec = log.records()[-1]
+    assert rec.outcome == "throttled"
+    assert log.fingerprints(top=0)[0]["throttled"] >= 1 or any(
+        e["throttled"] >= 1 for e in log.fingerprints(top=0))
+
+
+def test_lookup_folds_workload_record(client):
+    log = wl.get_workload_log()
+    rows = client.lookup_rows("//wl/t", [(3,), (5,)])
+    assert rows[0]["v"] == 6
+    recs = [r for r in log.records() if r.kind == "lookup"]
+    assert recs, "gateway lookups must fold into the workload log"
+    rec = recs[-1]
+    assert rec.table == "//wl/t" and rec.keys == 2
+    assert [tuple(lit[1]) for lit in rec.literals] == [(3,), (5,)]
+    assert rec.outcome == "ok"
+
+
+def test_explain_analyze_reports_capacity_buckets(client):
+    """ISSUE 8 satellite: the pow2 capacity bucket each program
+    compiled against is visible PER QUERY, so bucket churn (a
+    shape-spectrum leak) shows up in EXPLAIN ANALYZE, not just in
+    aggregate."""
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("v", "int64")], unique_keys=True)
+    client.create("table", "//wl/buckets",
+                  attributes={"schema": schema, "dynamic": True},
+                  recursive=True)
+    client.mount_table("//wl/buckets")
+    client.insert_rows("//wl/buckets",
+                       [{"k": i, "v": i} for i in range(10)])
+    p1 = client.select_rows("k FROM [//wl/buckets] WHERE v >= 0",
+                            explain_analyze=True)
+    buckets1 = p1.statistics["capacity_buckets"]
+    assert buckets1 == [128]
+    client.insert_rows("//wl/buckets",
+                       [{"k": i, "v": i} for i in range(10, 200)])
+    p2 = client.select_rows("k FROM [//wl/buckets] WHERE v >= 0",
+                            explain_analyze=True)
+    buckets2 = p2.statistics["capacity_buckets"]
+    assert buckets2 and buckets2 != buckets1, "bucket churn invisible"
+    assert "capacity buckets" in p2.format()
+
+
+# -- compilation observatory ---------------------------------------------------
+
+def test_observatory_miss_causes_and_eviction():
+    from ytsaurus_tpu.query.engine.evaluator import (
+        Evaluator,
+        get_compile_observatory,
+    )
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    obs = get_compile_observatory()
+    obs.reset()
+    yt_config.set_workload_config(
+        yt_config.WorkloadConfig(compile_cache_capacity=1))
+    schema, small = _fresh_evaluator_inputs(100)
+    _schema, big = _fresh_evaluator_inputs(500)
+    plan_a = _plan("k, v FROM [//t] WHERE v < 5", schema)
+    plan_b = _plan("k, sum(v) AS s FROM [//t] GROUP BY k", schema)
+    ev = Evaluator()
+    stats = QueryStatistics()
+    ev.run_plan(plan_a, small, stats=stats)   # never-seen shape
+    assert stats.compile_new_fingerprint == 1
+    ev.run_plan(plan_a, big, stats=stats)     # same shape, new bucket
+    assert stats.compile_new_shape == 1
+    ev.run_plan(plan_b, small, stats=stats)   # evicts plan_a programs
+    stats2 = QueryStatistics()
+    ev.run_plan(plan_a, small, stats=stats2)  # re-miss on evicted key
+    assert stats2.compile_evicted == 1
+    totals = obs.totals()
+    assert totals["misses"] == 4 and totals["evictions"] == 3
+    top = obs.top(5)
+    assert top[0]["compile_seconds"] > 0
+    fp_a = [r for r in top if r["compiles"] == 3][0]
+    assert fp_a["shape_count"] == 2 and fp_a["evictions"] >= 1
+    assert fp_a["last_miss_cause"] == "eviction"
+    # The slow-query-log rendering names the cause (satellite).
+    from ytsaurus_tpu.query.profile import format_profile_dict
+    text = format_profile_dict({"statistics": stats2.to_dict()})
+    assert "evicted 1" in text
+
+
+def test_observatory_captures_artifacts_behind_flag():
+    from ytsaurus_tpu.query.engine.evaluator import (
+        Evaluator,
+        get_compile_observatory,
+    )
+    obs = get_compile_observatory()
+    obs.reset()
+    yt_config.set_workload_config(
+        yt_config.WorkloadConfig(capture_artifacts=True,
+                                 artifact_capacity=4))
+    schema, chunk = _fresh_evaluator_inputs(64)
+    Evaluator().run_plan(_plan("k FROM [//t] WHERE v < 3", schema),
+                         chunk)
+    arts = obs.artifacts()
+    assert len(arts) == 1
+    assert arts[0]["hlo"], "HLO text must be captured"
+    assert arts[0]["compile_seconds"] > 0
+    assert arts[0]["flops"] is not None
+    # /compile payload carries artifact metadata without the HLO blob.
+    snap = obs.snapshot()
+    assert snap["artifacts"] and "hlo" not in snap["artifacts"][0]
+    # Default config captures nothing.
+    yt_config.set_workload_config(None)
+    obs.reset()
+    schema, chunk = _fresh_evaluator_inputs(32)
+    Evaluator().run_plan(_plan("k FROM [//t] WHERE v < 9", schema),
+                         chunk)
+    assert not obs.artifacts()
+
+
+def _compile_sensor_totals():
+    from ytsaurus_tpu.utils.profiling import get_registry
+    registry = get_registry()
+    totals = {"hits": 0.0, "misses": 0.0}
+    with registry._lock:
+        items = list(registry._sensors.items())
+    for (name, _tags), sensor in items:
+        if name == "/query/compile_cache/hits":
+            totals["hits"] += sensor.get()
+        elif name == "/query/compile_cache/misses":
+            totals["misses"] += sensor.get()
+    return totals
+
+
+def test_pool_sensors_reconcile_with_observatory(client):
+    """ISSUE 8 satellite: per-pool `query_compile_cache_{hits,misses}`
+    sensors reconcile EXACTLY with the observatory's per-fingerprint
+    totals under concurrent mixed-pool replay traffic — both count the
+    same dispatch events, or per-pool SLO math silently drifts."""
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    obs = get_compile_observatory()
+    before_sensors = _compile_sensor_totals()
+    before_obs = obs.totals()
+    errors = []
+
+    def worker(seed, pool):
+        try:
+            for i in range(6):
+                client.select_rows(
+                    f"k, v FROM [//wl/t] WHERE k < {10 + (seed + i) % 4}",
+                    pool=pool)
+        except Exception as exc:   # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(s, pool),
+                                daemon=True)
+               for s, pool in enumerate(["default", "default", "other",
+                                         "other"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    after_sensors = _compile_sensor_totals()
+    after_obs = obs.totals()
+    d_sensor_hits = after_sensors["hits"] - before_sensors["hits"]
+    d_sensor_misses = after_sensors["misses"] - before_sensors["misses"]
+    d_obs_hits = after_obs["hits"] - before_obs["hits"]
+    d_obs_misses = after_obs["misses"] - before_obs["misses"]
+    assert d_sensor_hits + d_sensor_misses == 24
+    assert (d_sensor_hits, d_sensor_misses) == (d_obs_hits,
+                                                d_obs_misses)
+    # Both tag arms really took traffic (mixed-pool, not one pool).
+    from ytsaurus_tpu.utils.profiling import get_registry
+    with get_registry()._lock:
+        pool_arms = {dict(tags).get("pool")
+                     for (name, tags), _s in
+                     get_registry()._sensors.items()
+                     if name == "/query/compile_cache/hits"}
+    assert {"default", "other"} <= pool_arms
+    # Per-fingerprint rows sum to the same totals (delta-free check on
+    # the observatory's own books).
+    rows = obs.top(0)
+    assert sum(r["compiles"] for r in rows) == after_obs["misses"]
+    assert sum(r["hits"] for r in rows) == after_obs["hits"]
+
+
+def test_recompilation_storm_slo_fires_and_resolves():
+    """ISSUE 8 acceptance: a synthetic recompilation storm fires the
+    compile-burn SLO alert over the PR 6 history rings and the alert
+    resolves once the cache serves hits again."""
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.utils.profiling import MetricsHistory, get_registry
+    from ytsaurus_tpu.utils.slo import SloTracker
+    slo = dict(wl.COMPILE_STORM_SLO, fast_window=60.0,
+               slow_window=300.0)
+    tcfg = yt_config.TelemetryConfig.from_dict(
+        {"slos": {"compile_storm": slo}})
+    history = MetricsHistory(registry=get_registry())
+    tracker = SloTracker(tcfg, history=history)
+    schema, chunk = _fresh_evaluator_inputs(64)
+    ev = Evaluator()
+    plans = [_plan(f"k FROM [//t] WHERE v < {100 + i}", schema)
+             for i in range(6)]
+    # Warm one dispatch BEFORE the baseline sample: the compile-cache
+    # counters are created lazily, and a series needs a pre-storm point
+    # for window deltas to exist at all.
+    ev.run_plan(_plan("k FROM [//t] WHERE v < 99", schema), chunk)
+    t0 = 1_000_000.0
+    history.sample_once(t0)
+    for plan in plans:                      # storm: all misses
+        ev.run_plan(plan, chunk)
+    history.sample_once(t0 + 400.0)
+    snap = tracker.evaluate(now=t0 + 400.0)
+    assert snap["slos"]["compile_storm"]["firing"]
+    assert [a["slo"] for a in snap["active_alerts"]] == ["compile_storm"]
+    for _ in range(4):                      # recovery: all hits
+        for plan in plans:
+            ev.run_plan(plan, chunk)
+    history.sample_once(t0 + 800.0)
+    snap = tracker.evaluate(now=t0 + 800.0)
+    assert not snap["slos"]["compile_storm"]["firing"]
+    assert not snap["active_alerts"]
+    assert [a["slo"] for a in snap["resolved_alerts"]] == \
+        ["compile_storm"]
+
+
+# -- replay --------------------------------------------------------------------
+
+def test_replay_reports_latency_hit_rate_and_traces(client, tmp_path):
+    log = wl.get_workload_log()
+    for i in range(12):
+        client.select_rows(
+            f"k, v FROM [//wl/t] WHERE k < {5 + i % 3}")
+    client.lookup_rows("//wl/t", [(1,), (2,)])
+    path = tmp_path / "cap.json"
+    log.export_capture(str(path))
+    records = wl.load_capture(str(path))
+    assert len(records) >= 13
+    report = wl.replay(client, records, rate=300.0, max_workers=4)
+    assert report["queries"] == len(records)
+    assert report["ok"] == report["queries"]
+    assert report["error"] == report["throttled"] == \
+        report["deadline"] == 0
+    lat = report["latency"]
+    assert 0 < lat["p50_ms"] <= lat["p99_ms"] <= lat["p999_ms"] <= \
+        lat["max_ms"]
+    cache = report["compile_cache"]
+    # Every shape was compiled during recording: the replay itself is
+    # all hits — the steady-state discipline ROADMAP 1 will gate on.
+    assert cache["hit_rate"] == 1.0
+    assert cache["steady_hit_rate"] == 1.0
+    # Drive-by satellite: slowest queries embed their trace ids so a
+    # bad run is diagnosable via /traces without re-running.
+    assert report["slowest"]
+    slowest = report["slowest"][0]
+    assert slowest["trace_id"]
+    from ytsaurus_tpu.utils.tracing import span_tree
+    assert span_tree(slowest["trace_id"]), \
+        "slowest trace id must resolve in /traces"
+
+
+def test_replay_paces_by_recorded_spacing():
+    schema_recs = wl.synthesize_mix(["x FROM [//t] WHERE x = {}"],
+                                    count=8, interval=0.05, seed=3)
+
+    seen = []
+
+    class FakeClient:
+        def select_rows(self, query, pool=None, timeout=None,
+                        explain_analyze=False):
+            seen.append(query)
+            return {"trace_id": None,
+                    "statistics": {"cache_hits": 1, "compile_count": 0},
+                    "wall_time": 0.0}
+
+    import time as _time
+    t0 = _time.perf_counter()
+    report = wl.replay(FakeClient(), schema_recs, speed=4.0)
+    elapsed = _time.perf_counter() - t0
+    assert len(seen) == 8 and report["ok"] == 8
+    # 7 gaps x 50ms / speed 4 ~= 87ms of pacing.
+    assert elapsed >= 0.07
+    assert report["offered_rate"] == pytest.approx(80.0, rel=0.01)
+    assert report["compile_cache"]["hit_rate"] == 1.0
+    with pytest.raises(YtError):
+        wl.replay(FakeClient(), [])
+
+
+def test_synthesize_mix_shapes():
+    records = wl.synthesize_mix(
+        ["v FROM [//t] WHERE k = {}",
+         "g, sum(v) AS s FROM [//t] WHERE v < {} GROUP BY g"],
+        count=20, distinct=4, seed=1)
+    assert len(records) == 20
+    fps = {r.fingerprint for r in records}
+    assert len(fps) == 2, "one fingerprint per SHAPE, not per literal"
+    q = wl.substitute_literals(records[0].query, records[0].literals)
+    tokenize(q)   # reconstructed text must lex
+
+
+# -- endpoints + CLI -----------------------------------------------------------
+
+def test_monitoring_endpoints_round_trip(client):
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    client.select_rows("k FROM [//wl/t] WHERE v < 4")
+    server = MonitoringServer(port=0)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{server.address}/workload?limit=16") as resp:
+            workload = json.loads(resp.read())
+        assert workload["schema_version"] == wl.WORKLOAD_SCHEMA_VERSION
+        assert workload["records"] and workload["fingerprints"]
+        with urllib.request.urlopen(
+                f"http://{server.address}/compile?top=5") as resp:
+            compile_view = json.loads(resp.read())
+        assert "totals" in compile_view
+        assert len(compile_view["fingerprints"]) <= 5
+    finally:
+        server.stop()
+
+
+def test_orchid_mounts():
+    tree = __import__("ytsaurus_tpu.server.orchid",
+                      fromlist=["default_orchid"]).default_orchid()
+    assert "recorded" in tree.get("/workload")
+    assert "totals" in tree.get("/compile")
+
+
+def test_cli_compile_cache_top(client, capsys):
+    from ytsaurus_tpu.cli import run
+    client.select_rows("k FROM [//wl/t] WHERE v < 2")
+    assert run(["compile-cache", "top", "--limit", "5"],
+               client=client) == 0
+    out = capsys.readouterr().out
+    assert "fingerprint" in out and "compile_seconds" in out
+    assert "totals:" in out
+
+
+def test_cli_workload_and_replay(client, tmp_path, capsys):
+    from ytsaurus_tpu.cli import run
+    client.select_rows("k, v FROM [//wl/t] WHERE k < 9")
+    cap = str(tmp_path / "cli-cap.json")
+    assert run(["workload", "export", "--out", cap],
+               client=client) == 0
+    written = json.loads(capsys.readouterr().out)
+    assert written["written"] >= 1
+    assert run(["replay", "--capture", cap, "--rate", "200",
+                "--json"], client=client) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] == report["queries"] >= 1
+    assert "p999_ms" in report["latency"]
+    # Pretty rendering names the trace ids.
+    assert run(["replay", "--capture", cap, "--rate", "200"],
+               client=client) == 0
+    pretty = capsys.readouterr().out
+    assert "trace=" in pretty and "p999" in pretty
+    # `yt workload show` renders the fingerprint roll-up.
+    assert run(["workload", "show"], client=client) == 0
+    assert "fingerprint" in capsys.readouterr().out
+    # An incompatible capture is refused loudly.
+    payload = json.loads(open(cap).read())
+    payload["workload_schema"] = 999
+    bad = str(tmp_path / "bad-cap.json")
+    open(bad, "w").write(json.dumps(payload))
+    assert run(["replay", "--capture", bad], client=client) == 1
+    assert "incompatible" in capsys.readouterr().err

@@ -130,6 +130,103 @@ def _format_top(snapshot: dict, by: str, sort_key: str,
     return "\n".join(lines)
 
 
+def _fetch_workload(cl) -> dict:
+    """The /workload snapshot: remote orchid when the client has one,
+    else this process's own workload log (same propagate-don't-mask
+    policy as `yt top`)."""
+    if hasattr(cl, "get_orchid"):
+        return _decode_deep(cl.get_orchid("/workload") or {})
+    from ytsaurus_tpu.query.workload import get_workload_log
+    return get_workload_log().snapshot()
+
+
+def _fetch_compile(cl) -> dict:
+    """The /compile snapshot (compilation observatory)."""
+    if hasattr(cl, "get_orchid"):
+        return _decode_deep(cl.get_orchid("/compile") or {})
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    return get_compile_observatory().snapshot()
+
+
+_COMPILE_TOP_COLUMNS = ("compiles", "hits", "compile_seconds",
+                        "shape_count", "evictions", "last_miss_cause")
+
+
+def _format_table(header: list, rows: list) -> str:
+    table = [header, *rows]
+    widths = [max(len(str(row[i])) for row in table)
+              for i in range(len(header))]
+    return "\n".join("  ".join(str(cell).rjust(width)
+                               for cell, width in zip(row, widths))
+                     for row in table)
+
+
+def _format_compile_top(snapshot: dict, sort_key: str,
+                        limit: int) -> str:
+    """`yt compile-cache top`: fingerprints ranked by compile burn —
+    the observability answer to "what is this fleet recompiling"."""
+    rows = list(snapshot.get("fingerprints") or [])
+    rows.sort(key=lambda r: -float(r.get(sort_key) or 0.0))
+    if limit > 0:
+        rows = rows[:limit]
+    totals = snapshot.get("totals") or {}
+
+    def fmt(record, field):
+        value = record.get(field)
+        if field == "compile_seconds":
+            return f"{float(value or 0.0):.3f}"
+        if field == "last_miss_cause":
+            return str(value or "-")
+        return f"{int(value or 0)}"
+
+    body = [[r.get("fingerprint", "?"),
+             *[fmt(r, f) for f in _COMPILE_TOP_COLUMNS]] for r in rows]
+    lines = [_format_table(["fingerprint", *_COMPILE_TOP_COLUMNS],
+                           body)]
+    lines.append(f"totals: {int(totals.get('hits', 0))} hits / "
+                 f"{int(totals.get('misses', 0))} misses / "
+                 f"{int(totals.get('evictions', 0))} evictions over "
+                 f"{int(totals.get('fingerprints', 0))} fingerprints")
+    return "\n".join(lines)
+
+
+def _format_replay_report(report: dict) -> str:
+    lat = report.get("latency") or {}
+    cache = report.get("compile_cache") or {}
+
+    def rate(value):
+        return "n/a" if value is None else f"{value * 100:.2f}%"
+
+    lines = [
+        f"replayed {report.get('queries', 0)} queries in "
+        f"{report.get('elapsed_seconds', 0.0):.3f}s "
+        f"(offered {report.get('offered_rate') or 'max'}/s, achieved "
+        f"{report.get('achieved_rate')}/s)",
+        f"outcomes: {report.get('ok', 0)} ok, "
+        f"{report.get('throttled', 0)} throttled, "
+        f"{report.get('deadline', 0)} deadline, "
+        f"{report.get('error', 0)} error",
+        f"latency: p50 {lat.get('p50_ms', 0)}ms  p99 "
+        f"{lat.get('p99_ms', 0)}ms  p999 {lat.get('p999_ms', 0)}ms  "
+        f"max {lat.get('max_ms', 0)}ms",
+        f"compile cache: {cache.get('hits', 0)} hits / "
+        f"{cache.get('misses', 0)} misses "
+        f"(hit rate {rate(cache.get('hit_rate'))}, steady-state "
+        f"{rate(cache.get('steady_hit_rate'))})",
+    ]
+    slowest = report.get("slowest") or []
+    if slowest:
+        lines.append("slowest (trace ids -> /traces or `yt trace`):")
+        for entry in slowest:
+            lines.append(
+                f"  {entry.get('wall_ms', 0)}ms  "
+                f"trace={entry.get('trace_id') or '<unsampled>'}  "
+                f"[{entry.get('outcome')}] {entry.get('query')}")
+    return "\n".join(lines)
+
+
 def _format_profile(profile) -> str:
     """ExecutionProfile object (in-process client) OR its dict form
     (remote client / HTTP proxy) → the pretty EXPLAIN ANALYZE text, via
@@ -194,6 +291,47 @@ def build_parser() -> argparse.ArgumentParser:
         (("--json",), {"action": "store_true",
                        "help": "raw accounting snapshot instead of the "
                                "table"}))
+    cmd("workload", (("action",), {"choices": ["capture", "export",
+                                               "import", "show"],
+                                   "help": "capture: pull the cluster's "
+                                           "workload log into --out; "
+                                           "export: this process's log; "
+                                           "import: load a capture into "
+                                           "the local log; show: "
+                                           "fingerprint roll-up"}),
+        (("--out",), {"default": None,
+                      "help": "capture file to write (capture/export)"}),
+        (("--file",), {"default": None,
+                       "help": "capture file to read (import)"}),
+        (("--limit",), {"type": int, "default": 0,
+                        "help": "cap records written/shown (0 = all "
+                                "retained)"}),
+        (("--json",), {"action": "store_true"}))
+    cmd("replay", (("--capture",), {"required": True,
+                                    "help": "versioned workload capture "
+                                            "(yt workload capture/"
+                                            "export)"}),
+        (("--speed",), {"type": float, "default": 1.0,
+                        "help": "time-compression of the recorded "
+                                "inter-arrival spacing"}),
+        (("--rate",), {"type": float, "default": None,
+                       "help": "fixed open-loop offered rate (qps); "
+                               "overrides recorded spacing"}),
+        (("--limit",), {"type": int, "default": 0,
+                        "help": "replay only the first N records"}),
+        (("--workers",), {"type": int, "default": 16}),
+        (("--pool",), {"default": None}),
+        (("--timeout",), {"type": float, "default": None}),
+        (("--json",), {"action": "store_true",
+                       "help": "raw report instead of the pretty "
+                               "rendering"}))
+    cmd("compile-cache", (("action",), {"choices": ["top"]}),
+        (("--limit",), {"type": int, "default": 20}),
+        (("--sort",), {"default": "compile_seconds",
+                       "help": "observatory column to rank by "
+                               "(descending); e.g. compiles, "
+                               "shape_count, evictions"}),
+        (("--json",), {"action": "store_true"}))
     cmd("insert-rows", (("path",), {}),
         (("--rows",), {"default": None}))
     cmd("lookup-rows", (("path",), {}), (("--keys",), {"required": True}))
@@ -330,6 +468,57 @@ def _dispatch(cl, a):
         if a.json:
             return snapshot
         print(_format_top(snapshot, a.by, a.sort, a.limit))
+        return None
+    if c == "workload":
+        from ytsaurus_tpu.query import workload as wl
+        if a.action in ("capture", "export"):
+            if not a.out:
+                raise YtError("workload capture/export requires --out")
+            if a.action == "capture":
+                snapshot = _fetch_workload(cl)
+                records = [wl.WorkloadRecord.from_dict(r)
+                           for r in snapshot.get("records") or []]
+            else:
+                records = wl.get_workload_log().records()
+            written = wl.write_capture(a.out, records,
+                                       limit=a.limit or None)
+            return {"written": written, "path": a.out}
+        if a.action == "import":
+            if not a.file:
+                raise YtError("workload import requires --file")
+            return {"imported":
+                    wl.get_workload_log().import_capture(a.file)}
+        snapshot = _fetch_workload(cl)            # show
+        if a.json:
+            return snapshot
+        rows = snapshot.get("fingerprints") or []
+        if a.limit:
+            rows = rows[:a.limit]
+        print(_format_table(
+            ["fingerprint", "kind", "count", "ok", "throttled",
+             "deadline", "errors", "wall_s", "compile_s", "query"],
+            [[r.get("fingerprint"), r.get("kind"), r.get("count"),
+              r.get("ok"), r.get("throttled"), r.get("deadline"),
+              r.get("errors"),
+              f"{float(r.get('wall_seconds') or 0):.3f}",
+              f"{float(r.get('compile_seconds') or 0):.3f}",
+              str(r.get("query"))[:60]] for r in rows]))
+        return None
+    if c == "replay":
+        from ytsaurus_tpu.query import workload as wl
+        records = wl.load_capture(a.capture)   # fails loudly on version
+        report = wl.replay(cl, records, speed=a.speed, rate=a.rate,
+                           max_workers=a.workers, pool=a.pool,
+                           timeout=a.timeout, limit=a.limit or None)
+        if a.json:
+            return report
+        print(_format_replay_report(report))
+        return None
+    if c == "compile-cache":
+        snapshot = _fetch_compile(cl)
+        if a.json:
+            return snapshot
+        print(_format_compile_top(snapshot, a.sort, a.limit))
         return None
     if c == "insert-rows":
         rows = json.loads(_rows_arg(a.rows))

@@ -1250,16 +1250,30 @@ class YtClient:
         # impl finishing and the profile capture reading it.
         stats = QueryStatistics()
         t0 = _time.perf_counter()
-        with root:
-            if not gateway.enabled:
-                rows = self._select_rows_impl(query, timestamp, None,
-                                              stats=stats)
-            else:
-                rows = gateway.run_select(
-                    lambda token: self._select_rows_impl(query, timestamp,
-                                                         token,
-                                                         stats=stats),
-                    pool=pool, timeout=timeout)
+        try:
+            with root:
+                if not gateway.enabled:
+                    rows = self._select_rows_impl(query, timestamp, None,
+                                                  stats=stats)
+                else:
+                    rows = gateway.run_select(
+                        lambda token: self._select_rows_impl(
+                            query, timestamp, token, stats=stats),
+                        pool=pool, timeout=timeout)
+        except YtError as err:
+            # Workload recorder (ISSUE 8): failed queries are part of
+            # the workload too — the record carries the classified
+            # outcome (throttled/deadline/error) so a replayed mix
+            # reproduces the rejection profile, not just the successes.
+            from ytsaurus_tpu.query.workload import (
+                get_workload_log,
+                outcome_of,
+            )
+            get_workload_log().observe_select(
+                query, stats=stats, outcome=outcome_of(err),
+                wall_time=_time.perf_counter() - t0, pool=pool,
+                trace_id=getattr(root, "trace_id", None))
+            raise
         profile = ExecutionProfile.capture(
             root, query, stats, _time.perf_counter() - t0, pool=pool)
         if explain_analyze:
@@ -1274,6 +1288,13 @@ class YtClient:
         # and `yt top`.
         from ytsaurus_tpu.query.accounting import get_accountant
         get_accountant().observe_query(profile)
+        # Workload recorder (ISSUE 8): the finished query folds one
+        # compact record (normalized text + hoisted literals + the
+        # wall/compile/execute split + capacity buckets + trace id)
+        # into the bounded workload log — the capture `yt replay` and
+        # `bench.py --config replay` re-run.
+        from ytsaurus_tpu.query.workload import get_workload_log
+        get_workload_log().observe_select(query, profile=profile)
         return profile if explain_analyze else rows
 
     def _select_rows_system(self, query: str,

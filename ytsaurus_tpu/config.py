@@ -456,6 +456,67 @@ def set_telemetry_config(config: "Optional[TelemetryConfig]") -> None:
     profiling.configure_telemetry(config)
 
 
+class WorkloadConfig(YsonStruct):
+    """Workload recorder + compilation observatory knobs (ISSUE 8,
+    query/workload.py + query/engine/evaluator.py):
+
+    - `enabled`: master switch for the workload recorder; False turns
+      every observe site into one config read.
+    - `sample_rate`: probability an admitted query folds a record into
+      the workload log (1.0 = record everything; high-rate fleets dial
+      this down — the log is a statistical capture, not an audit log).
+    - `capacity`: bounded in-memory record ring (what `/workload` and
+      `yt workload capture` serve).
+    - `fingerprint_capacity`: bounded per-fingerprint roll-up map; new
+      fingerprints past the cap count as dropped instead of growing it.
+    - `log_dir`: when set, sampled records ALSO append to a rotated
+      on-disk JSONL log (`workload.jsonl`, header line carries the
+      schema version) bounded by `rotate_bytes` x `max_files`.
+    - `lookup_keys_per_record`: lookup records retain at most this many
+      key tuples (enough to replay; bounds record size).
+    - `capture_artifacts`: the compilation observatory captures each
+      compiled executable's HLO text + XLA `cost_analysis()`
+      FLOPs/bytes (bounded by `artifact_capacity`, HLO truncated to
+      `hlo_max_chars`).  Off by default: artifacts are debugging
+      payloads, not steady-state telemetry.
+    - `compile_cache_capacity`: LRU bound on the evaluator's compiled
+      program cache (0 = unbounded, the historical behavior).  With a
+      bound, evictions are counted per fingerprint and a re-miss on an
+      evicted key is tagged cause=eviction."""
+
+    enabled = param(True, type=bool)
+    sample_rate = param(1.0, type=float, ge=0.0, le=1.0)
+    capacity = param(4096, type=int, ge=1)
+    fingerprint_capacity = param(1024, type=int, ge=1)
+    log_dir = param(None, type=str)
+    rotate_bytes = param(4 << 20, type=int, ge=4096)
+    max_files = param(4, type=int, ge=1)
+    lookup_keys_per_record = param(16, type=int, ge=0)
+    capture_artifacts = param(False, type=bool)
+    artifact_capacity = param(64, type=int, ge=1)
+    hlo_max_chars = param(20_000, type=int, ge=0)
+    compile_cache_capacity = param(0, type=int, ge=0)
+
+
+_WORKLOAD_CONFIG: "Optional[WorkloadConfig]" = None
+
+
+def workload_config() -> WorkloadConfig:
+    global _WORKLOAD_CONFIG
+    if _WORKLOAD_CONFIG is None:
+        _WORKLOAD_CONFIG = WorkloadConfig()
+    return _WORKLOAD_CONFIG
+
+
+def set_workload_config(config: "Optional[WorkloadConfig]") -> None:
+    """Install a process-wide workload config (None restores defaults);
+    rebinds the global workload log to the new shape."""
+    global _WORKLOAD_CONFIG
+    _WORKLOAD_CONFIG = config
+    from ytsaurus_tpu.query import workload
+    workload.configure(config)
+
+
 class FailpointsConfig(YsonStruct):
     """Deterministic fault-injection schedule (utils/failpoints.py):
     `spec` uses the YT_FAILPOINTS syntax, `seed` fixes p-based rolls.
@@ -554,6 +615,7 @@ class DaemonConfig(YsonStruct):
     tablet = param(type=TabletConfig)
     tracing = param(type=TracingConfig)
     telemetry = param(type=TelemetryConfig)
+    workload = param(type=WorkloadConfig)
 
     def postprocess(self):
         if self.role == "node" and self.chunk_store.replication_factor < 1:

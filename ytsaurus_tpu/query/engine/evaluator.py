@@ -9,6 +9,9 @@ runs the program over the chunk's planes.
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import OrderedDict, deque
 from typing import Mapping, Optional, Sequence
 
 import jax
@@ -22,14 +25,184 @@ from ytsaurus_tpu.query.engine.joins import execute_join
 from ytsaurus_tpu.query.engine.lowering import prepare
 from ytsaurus_tpu.query.statistics import QueryStatistics
 from ytsaurus_tpu.schema import EValueType, TableSchema
-from ytsaurus_tpu.utils.profiling import PoolSensorCache
+from ytsaurus_tpu.utils.profiling import PoolSensorCache, Profiler
 
 # Process-wide compile-cache counters, tagged by the admitted query's
 # pool (identity rides the CancellationToken): the steady-state
 # compile-cache hit-rate SLO (ROADMAP item 1's acceptance gate, a
 # TIME-SERIES claim) reads these from the telemetry history rings.
+# The compilation observatory's per-fingerprint totals reconcile
+# EXACTLY with these (same dispatch event increments both; the
+# reconciliation is test-enforced).
 _cache_counters = PoolSensorCache("/query/compile_cache",
                                   ("hits", "misses"))
+_evictions_counter = Profiler("/query/compile_cache").counter("evictions")
+
+
+class CompileObservatory:
+    """Per-fingerprint compile telemetry (ISSUE 8 tentpole, piece b).
+
+    Every evaluator dispatch folds here: compile count + cumulative
+    compile seconds per plan fingerprint (the "compile burn" `/compile`
+    and `yt compile-cache top` rank by — Flare's adaptive-compilation
+    feedback signal, arxiv 1703.08219), the shape-spectrum cardinality
+    (distinct (capacity, binding-shape) programs one fingerprint
+    compiled — an unbounded spectrum IS the recompilation pathology),
+    evictions, and the LAST MISS CAUSE:
+
+      new_fingerprint   this plan shape never compiled before
+      new_shape         known shape, but a capacity bucket / binding
+                        shape it never met (shape-spectrum growth)
+      eviction          the exact program existed and was LRU-evicted
+                        (the cache is too small for the working set)
+
+    Optionally captures each compiled executable's XLA artifacts (HLO
+    text + cost_analysis() FLOPs/bytes) behind
+    `WorkloadConfig.capture_artifacts` — bounded, for debugging a hot
+    fingerprint, not steady-state telemetry."""
+
+    SHAPE_SET_CAP = 512
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fps: dict[str, dict] = {}
+        self._artifacts: deque = deque(maxlen=64)
+        # Bounded memory of evicted program keys: a re-miss on one is
+        # cause=eviction, not cause=new_shape.
+        self._evicted: "OrderedDict[tuple, None]" = OrderedDict()
+        self.hits_n = 0
+        self.misses_n = 0
+        self.evictions_n = 0
+
+    def _entry(self, fp: str) -> dict:
+        entry = self._fps.get(fp)
+        if entry is None:
+            entry = self._fps[fp] = {
+                "compiles": 0, "hits": 0, "compile_seconds": 0.0,
+                "shapes": set(), "shape_count": 0, "evictions": 0,
+                "last_miss_cause": None, "last_compile_at": 0.0,
+            }
+        return entry
+
+    def classify_miss(self, fp: str, key: tuple) -> str:
+        with self._lock:
+            if key in self._evicted:
+                return "eviction"
+            if fp in self._fps:
+                return "new_shape"
+            return "new_fingerprint"
+
+    def observe_hit(self, fp: str) -> None:
+        with self._lock:
+            self.hits_n += 1
+            self._entry(fp)["hits"] += 1
+
+    def observe_miss(self, fp: str, key: tuple, cause: str,
+                     seconds: float) -> None:
+        shape_sig = key[1:]
+        with self._lock:
+            self.misses_n += 1
+            entry = self._entry(fp)
+            entry["compiles"] += 1
+            entry["compile_seconds"] += seconds
+            entry["last_miss_cause"] = cause
+            entry["last_compile_at"] = time.time()
+            shapes = entry["shapes"]
+            if shape_sig not in shapes:
+                entry["shape_count"] += 1
+                if len(shapes) < self.SHAPE_SET_CAP:
+                    shapes.add(shape_sig)
+            self._evicted.pop(key, None)
+
+    def observe_eviction(self, key: tuple) -> None:
+        with self._lock:
+            self.evictions_n += 1
+            if key[0] in self._fps:
+                self._fps[key[0]]["evictions"] += 1
+            self._evicted[key] = None
+            while len(self._evicted) > 4096:
+                self._evicted.popitem(last=False)
+
+    def capture_artifact(self, fp: str, key: tuple, hlo: str,
+                         cost: Optional[dict],
+                         seconds: float) -> None:
+        from ytsaurus_tpu.config import workload_config
+        cfg = workload_config()
+        cost = cost or {}
+        artifact = {
+            "fingerprint": fp,
+            "capacity": key[1],
+            "binding_shapes": repr(key[2]),
+            "compile_seconds": round(seconds, 6),
+            "flops": cost.get("flops"),
+            "bytes_accessed": cost.get("bytes accessed",
+                                       cost.get("bytes_accessed")),
+            "hlo": hlo[:cfg.hlo_max_chars] if cfg.hlo_max_chars else "",
+            "captured_at": time.time(),
+        }
+        with self._lock:
+            if self._artifacts.maxlen != cfg.artifact_capacity:
+                self._artifacts = deque(self._artifacts,
+                                        maxlen=cfg.artifact_capacity)
+            self._artifacts.append(artifact)
+
+    # -- views -----------------------------------------------------------------
+
+    def totals(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits_n, "misses": self.misses_n,
+                    "evictions": self.evictions_n,
+                    "fingerprints": len(self._fps)}
+
+    def top(self, n: int = 20,
+            by: str = "compile_seconds") -> list[dict]:
+        """Fingerprints ranked by compile burn (or any numeric field)."""
+        with self._lock:
+            rows = [{"fingerprint": fp,
+                     **{k: v for k, v in entry.items() if k != "shapes"}}
+                    for fp, entry in self._fps.items()]
+        for row in rows:
+            row["compile_seconds"] = round(row["compile_seconds"], 6)
+        rows.sort(key=lambda r: (-float(r.get(by) or 0.0),
+                                 r["fingerprint"]))
+        return rows[:n] if n else rows
+
+    def artifacts(self) -> list[dict]:
+        with self._lock:
+            return list(self._artifacts)
+
+    def snapshot(self, top: int = 50) -> dict:
+        return {"totals": self.totals(),
+                "fingerprints": self.top(top),
+                "artifacts": [{k: v for k, v in a.items() if k != "hlo"}
+                              for a in self.artifacts()]}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._fps.clear()
+            self._artifacts.clear()
+            self._evicted.clear()
+            self.hits_n = self.misses_n = self.evictions_n = 0
+
+
+_observatory = CompileObservatory()
+
+
+def get_compile_observatory() -> CompileObservatory:
+    return _observatory
+
+
+def _cost_analysis(compiled) -> Optional[dict]:
+    """Normalized XLA cost analysis of a compiled executable: jax
+    returns a dict on recent versions, a one-element list of dicts on
+    older ones, and some backends return None."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:   # noqa: BLE001 — backend-dependent, optional
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    return dict(cost) if isinstance(cost, dict) else None
 
 
 class _PendingResult:
@@ -102,7 +275,14 @@ class Evaluator:
     """Caches compiled query programs and executes plans over chunks."""
 
     def __init__(self):
-        self._cache: dict = {}
+        # LRU order (promote on hit); bounded when
+        # WorkloadConfig.compile_cache_capacity > 0, with evictions fed
+        # to the compilation observatory.  The lock covers every cache
+        # mutation — concurrent gateway threads share one evaluator, and
+        # an unlocked move_to_end could KeyError against a concurrent
+        # eviction (compiles themselves run outside the lock).
+        self._cache: OrderedDict = OrderedDict()
+        self._cache_lock = threading.Lock()
         self._join_cache: dict = {}
 
     def cache_size(self) -> int:
@@ -221,34 +401,77 @@ class Evaluator:
                             chunk.columns[c.name].valid)
                    for c in plan.schema}
         args = (columns, chunk.row_valid, tuple(prepared.bindings))
-        fn = self._cache.get(key)
+        with self._cache_lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self._cache.move_to_end(key)
         compile_seconds = 0.0
         result = None
+        if stats is not None:
+            # The pow2 capacity bucket this program runs against:
+            # bucket churn (a shape-spectrum leak) becomes visible PER
+            # QUERY in EXPLAIN ANALYZE, not just in aggregate.
+            stats.capacity_buckets.add(int(chunk.capacity))
         if fn is None:
+            from ytsaurus_tpu.config import workload_config
+            cfg = workload_config()
+            # Cache miss, classified for the observatory BEFORE the
+            # entry mutates: never-seen plan shape vs a known shape
+            # meeting a new capacity/binding-shape vs an LRU re-miss.
+            cause = _observatory.classify_miss(key[0], key)
+            lowered = None
             # Cache miss: build the device program NOW (AOT lower +
             # compile, the XLA analog of the reference's LLVM codegen
             # pass) so compile time is measured apart from execution.
             # Shapes/dtypes are pinned by the cache key (capacity +
             # binding shapes), which is exactly what AOT requires.
             with child_span("evaluator.compile", fingerprint=key[0],
-                            capacity=chunk.capacity):
+                            capacity=chunk.capacity, cause=cause):
                 t0c = _time.perf_counter()
                 jitted = jax.jit(prepared.run)
                 try:
-                    fn = jitted.lower(*args).compile()
+                    lowered = jitted.lower(*args)
+                    fn = lowered.compile()
                 except Exception:   # noqa: BLE001 — AOT is an
                     # optimization; anything it cannot lower falls back
                     # to the jit wrapper (first call compiles fused).
                     fn = jitted
+                    lowered = None
                     result = fn(*args)
                 compile_seconds = _time.perf_counter() - t0c
-            self._cache[key] = fn
+            with self._cache_lock:
+                self._cache[key] = fn
+                evicted_keys = []
+                if cfg.compile_cache_capacity:
+                    while len(self._cache) > cfg.compile_cache_capacity:
+                        evicted_keys.append(
+                            self._cache.popitem(last=False)[0])
+            for evicted_key in evicted_keys:
+                _observatory.observe_eviction(evicted_key)
+                _evictions_counter.increment()
             _cache_counters.counters(pool)["misses"].increment()
+            _observatory.observe_miss(key[0], key, cause,
+                                      compile_seconds)
+            if cfg.capture_artifacts and lowered is not None:
+                try:
+                    _observatory.capture_artifact(
+                        key[0], key, lowered.as_text(),
+                        _cost_analysis(fn), compile_seconds)
+                except Exception:   # noqa: BLE001 — artifact capture
+                    # is debugging aid, never an execution hazard.
+                    pass
             if stats is not None:
                 stats.compile_count += 1
                 stats.compile_time += compile_seconds
+                if cause == "eviction":
+                    stats.compile_evicted += 1
+                elif cause == "new_shape":
+                    stats.compile_new_shape += 1
+                else:
+                    stats.compile_new_fingerprint += 1
         else:
             _cache_counters.counters(pool)["hits"].increment()
+            _observatory.observe_hit(key[0])
             if stats is not None:
                 stats.cache_hits += 1
         if result is None:
@@ -261,7 +484,8 @@ class Evaluator:
                 # not capture: rebuild through the tolerant jit wrapper
                 # (a genuine execution error re-raises identically).
                 fn = jax.jit(prepared.run)
-                self._cache[key] = fn
+                with self._cache_lock:
+                    self._cache[key] = fn
                 planes, count = fn(*args)
         else:
             planes, count = result

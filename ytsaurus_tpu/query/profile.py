@@ -130,6 +130,19 @@ def format_profile_dict(p: dict) -> str:
         f"{stats.get('cache_hits', 0)} hits / "
         f"{stats.get('compile_count', 0)} misses",
     ]
+    # ISSUE 8: why those misses happened (new fingerprint vs new shape
+    # vs eviction) and which pow2 capacity buckets the programs ran
+    # against — per-query bucket churn is a shape-spectrum leak.
+    causes = [(label, stats.get(key, 0)) for label, key in
+              (("new_fingerprint", "compile_new_fingerprint"),
+               ("new_shape", "compile_new_shape"),
+               ("evicted", "compile_evicted"))]
+    buckets = stats.get("capacity_buckets") or []
+    if any(n for _label, n in causes) or buckets:
+        cause_str = ", ".join(f"{label} {n}" for label, n in causes
+                              if n) or "none"
+        lines.append(f"compile misses: {cause_str}; capacity buckets "
+                     f"{[int(b) for b in buckets]}")
     tree = p.get("span_tree") or []
     if tree:
         lines.append("spans:")

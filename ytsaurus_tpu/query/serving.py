@@ -737,17 +737,39 @@ class QueryGateway:
                     pool: Optional[str] = None,
                     timeout: Optional[float] = None):
         token = self.make_token(timeout, pool)
-        return self.batcher.lookup(client, path, keys, timestamp,
-                                   column_names, token, pool=pool)
+        # Workload recorder fold (ISSUE 8): each admitted lookup is one
+        # compact record (table + key tuples + outcome + wall) in the
+        # bounded workload log — the replay harness re-runs them.
+        from ytsaurus_tpu.query.workload import (
+            get_workload_log,
+            outcome_of,
+        )
+        t0 = time.monotonic()
+        try:
+            out = self.batcher.lookup(client, path, keys, timestamp,
+                                      column_names, token, pool=pool)
+        except YtError as err:
+            get_workload_log().observe_lookup(
+                path, keys, outcome=outcome_of(err),
+                wall_time=time.monotonic() - t0, pool=token.pool,
+                user=token.user)
+            raise
+        get_workload_log().observe_lookup(
+            path, keys, outcome="ok", wall_time=time.monotonic() - t0,
+            pool=token.pool, user=token.user)
+        return out
 
     # -- observability ---------------------------------------------------------
 
     def record_statistics(self, stats,
                           cache_size: Optional[int] = None) -> None:
         """Fold one query's TQueryStatistics into the cumulative serving
-        counters (`serving_query_stats_* ` on /metrics)."""
+        counters (`serving_query_stats_* ` on /metrics).  Only numeric
+        fields fold — capacity_buckets is a per-query set, not a
+        counter."""
         for field, value in stats.to_dict().items():
-            if value:
+            if isinstance(value, (int, float)) \
+                    and not isinstance(value, bool) and value:
                 self._stat_profiler.counter(field).increment(value)
         if cache_size is not None:
             self._cache_gauge.set(cache_size)

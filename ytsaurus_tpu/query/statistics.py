@@ -4,7 +4,7 @@ flags; aggregated across subqueries by the coordinator)."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field, fields
 
 
 @dataclass
@@ -16,13 +16,28 @@ class QueryStatistics:
     compile_time: float = 0.0        # seconds building device programs
     compile_count: int = 0           # programs compiled (cache misses)
     cache_hits: int = 0
+    # Compile-miss cause split (ISSUE 8): compile_count partitions into
+    # never-seen plan shapes, known shapes meeting a new capacity/
+    # binding shape (shape-spectrum growth), and LRU re-misses — so a
+    # slow-query log entry answers "why did this recompile" directly.
+    compile_new_fingerprint: int = 0
+    compile_new_shape: int = 0
+    compile_evicted: int = 0
     shards_total: int = 0
     shards_pruned: int = 0
     shards_skipped: int = 0          # LIMIT early-exit left these unread
     shards_staged: int = 0           # shards actually fetched/decoded
     retries: int = 0                 # transient per-shard retry attempts
     joins_executed: int = 0
+    # The pow2 capacity buckets this query's programs ran against
+    # (ISSUE 8 satellite): per-query bucket churn is a shape-spectrum
+    # leak EXPLAIN ANALYZE must surface.  A set, serialized sorted.
+    capacity_buckets: set = field(default_factory=set)
 
     def to_dict(self) -> dict:
-        from dataclasses import asdict
-        return asdict(self)
+        out = {}
+        for f in fields(self):
+            value = getattr(self, f.name)
+            out[f.name] = sorted(value) if isinstance(value, set) \
+                else value
+        return out

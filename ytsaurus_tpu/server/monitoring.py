@@ -174,6 +174,28 @@ class MonitoringServer:
                                   indent=2,
                                   default=_json_default).encode()
                 self._reply(request, 200, body, "application/json")
+        elif path == "/workload":
+            # Workload recorder (ISSUE 8): the bounded log of admitted
+            # queries (normalized text, hoisted literals, outcome,
+            # wall/compile/execute split) + per-fingerprint roll-up —
+            # what `yt workload capture` pulls and `yt replay` re-runs.
+            from ytsaurus_tpu.query.workload import get_workload_log
+            limit = int(params.get("limit", 128))
+            body = json.dumps(get_workload_log().snapshot(limit=limit),
+                              indent=2, default=_json_default).encode()
+            self._reply(request, 200, body, "application/json")
+        elif path == "/compile":
+            # Compilation observatory (ISSUE 8): per-fingerprint compile
+            # burn (count, cumulative seconds, shape-spectrum
+            # cardinality, evictions, last-miss cause) + captured XLA
+            # artifacts metadata — `yt compile-cache top`'s data source.
+            from ytsaurus_tpu.query.engine.evaluator import (
+                get_compile_observatory,
+            )
+            top = int(params.get("top", 50))
+            body = json.dumps(get_compile_observatory().snapshot(top=top),
+                              indent=2, default=_json_default).encode()
+            self._reply(request, 200, body, "application/json")
         elif path == "/metrics/history":
             # Telemetry plane (ISSUE 6): bounded time-series rings the
             # sampler thread fills from every registered sensor.

@@ -149,6 +149,11 @@ def default_orchid(config=None) -> OrchidTree:
     tree.register("/telemetry/history", _history_producer)
     tree.register("/telemetry/slo", _slo_producer)
     tree.register("/accounting", _accounting_producer)
+    # Workload recorder + compilation observatory (ISSUE 8): the RPC
+    # twins of the monitoring /workload and /compile endpoints (`yt
+    # workload capture` / `yt compile-cache top` read these remotely).
+    tree.register("/workload", _workload_producer)
+    tree.register("/compile", _compile_producer)
     return tree
 
 
@@ -176,3 +181,18 @@ def _slo_producer() -> dict:
 def _accounting_producer() -> dict:
     from ytsaurus_tpu.query.accounting import get_accountant
     return get_accountant().snapshot()
+
+
+def _workload_producer() -> dict:
+    from ytsaurus_tpu.query.workload import get_workload_log
+    # limit=0 serves EVERY retained record (the log is bounded by
+    # WorkloadConfig.capacity anyway): remote `yt workload capture`
+    # reads through here and must not silently truncate the capture.
+    return get_workload_log().snapshot(limit=0)
+
+
+def _compile_producer() -> dict:
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    return get_compile_observatory().snapshot()
