@@ -128,8 +128,13 @@ def test_map_reduce_soak(tmp_path):
         # respawns, the operation still completes bit-identically.
         "jobs.worker_death=crash-once;jobs.start=delay:ms=1:times=2;"
         "scheduler.publish=delay:ms=1:times=1",
-        # Disk faults under the job phases.
+        # Disk faults under the job phases, including failed chunk
+        # REMOVES (ISSUE 9 satellite): snapshot/intermediate GC hits
+        # `chunks.store.remove`; removal is advisory, so a failed
+        # unlink must leave results bit-identical (garbage files stay
+        # behind for the next sweep, nothing else notices).
         "chunks.store.read=error:times=1;jobs.start=error:times=1;"
+        "chunks.store.remove=error:times=2;"
         "scheduler.publish=delay:ms=1:times=1",
     )
     for seed, spec in zip(SEEDS, schedules):
@@ -162,19 +167,29 @@ def test_rpc_soak():
         channel = RetryingChannel(Channel(server.address, timeout=20))
         baseline = [channel.call("echo", "ping", {"x": i})[0]["x"]
                     for i in range(6)]
+        channel.close()
         schedules = (
             "rpc.channel.send=error:times=2;"
             "rpc.server.recv=delay:ms=2:times=2",
+            # Injected CONNECT refusal (ISSUE 9 satellite): raises
+            # ConnectionError inside _connect, so the never-dispatched
+            # (dispatched=False) resend path is the one that recovers.
+            "rpc.channel.connect=error:times=1;"
             "rpc.server.recv=error:times=1",
             "rpc.channel.send=delay:ms=2:times=2;"
             "rpc.server.recv=error:times=1",
         )
         for seed, spec in zip(SEEDS, schedules):
+            # Fresh channel per schedule: each run CONNECTS under the
+            # active schedule (a pre-connected channel would never hit
+            # the connect site).
             with failpoints.active(spec, seed=seed):
+                channel = RetryingChannel(Channel(server.address,
+                                                  timeout=20))
                 got = [channel.call("echo", "ping", {"x": i})[0]["x"]
                        for i in range(6)]
+                channel.close()
             assert got == baseline
-        channel.close()
     finally:
         server.stop()
     _note_fired()

@@ -189,3 +189,48 @@ def test_retry_policy_delay_shape():
             assert cap * 0.5 <= d <= cap    # jitter only shrinks
     none = RetryPolicyConfig(attempts=1, backoff=0.1, jitter=0.0)
     assert none.delay(0) == 0.1
+
+
+def test_state_write_failpoint_quorum_rides_out_one_failed_put(tmp_path):
+    """`server.state.write` (ISSUE 9 satellite) injects a disk fault
+    into a data node's durable snapshot publish; the quorum ladder in
+    QuorumWal.store_snapshot must ride out ONE failed replica put and
+    fetch_snapshot must still serve the blob from a surviving node."""
+    from ytsaurus_tpu.chunks.store import FsChunkStore
+    from ytsaurus_tpu.cypress.quorum import QuorumWal
+    from ytsaurus_tpu.rpc.channel import Channel
+    from ytsaurus_tpu.rpc.server import RpcServer
+    from ytsaurus_tpu.server.services import DataNodeService
+
+    servers = []
+    channels = []
+    try:
+        for i in range(2):
+            service = DataNodeService(
+                FsChunkStore(str(tmp_path / f"n{i}" / "chunks")),
+                str(tmp_path / f"n{i}" / "j"))
+            server = RpcServer([service], port=0)
+            server.start()
+            servers.append(server)
+            channels.append(Channel(f"127.0.0.1:{server.port}",
+                                    timeout=20))
+        wal = QuorumWal(str(tmp_path / "local.wal"), "j0", channels,
+                        quorum=2)
+        with failpoints.active("server.state.write=error:times=1",
+                               seed=5):
+            wal.store_snapshot(7, b"state-blob")   # one put injected
+        assert failpoints.counters()["server.state.write"][
+            "triggers"] == 1
+        assert wal.fetch_snapshot() == (7, b"state-blob")
+        # Both puts failing breaches the quorum: the ladder refuses
+        # loudly instead of pretending the snapshot is durable.
+        with failpoints.active("server.state.write=error:times=2",
+                               seed=5):
+            with pytest.raises(YtError):
+                wal.store_snapshot(8, b"lost-blob")
+        wal.close()
+    finally:
+        for channel in channels:
+            channel.close()
+        for server in servers:
+            server.stop()

@@ -296,10 +296,10 @@ def test_host_planes_lru_promotes_on_hit():
         t.write_row({"k": i, "a": i, "b": "x", "c": 0.0}, timestamp=10 + i)
         cids.append(t.flush())
     t._host_planes.clear()
-    t._chunk_host_planes(cids[0])
-    t._chunk_host_planes(cids[1])
-    t._chunk_host_planes(cids[0])        # promote: [1, 0]
-    t._chunk_host_planes(cids[2])        # evicts 1, NOT the promoted 0
+    t._chunk_host_planes_locked(cids[0])
+    t._chunk_host_planes_locked(cids[1])
+    t._chunk_host_planes_locked(cids[0])        # promote: [1, 0]
+    t._chunk_host_planes_locked(cids[2])        # evicts 1, NOT the promoted 0
     assert cids[0] in t._host_planes
     assert cids[1] not in t._host_planes
     assert cids[2] in t._host_planes

@@ -1,0 +1,567 @@
+"""`yt analyze` static-analysis suite (ISSUE 9): synthetic fixtures per
+pass, waiver parsing, baseline-ratchet semantics, and the tier-1 gate —
+the whole repo must be clean against the committed baseline (which the
+ratchet then keeps monotone: counts may only decrease)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools import analyze
+from tools.analyze import (
+    coverage,
+    error_taxonomy,
+    jax_hazards,
+    lock_discipline,
+)
+from tools.analyze.core import (
+    SourceFile,
+    aggregate,
+    check_ratchet,
+    load_baseline,
+    load_files,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fixture(tmp_path, rel, source):
+    """Write one fixture module under a synthetic repo root and return
+    its SourceFile (paths matter: the jax/coverage passes scope by
+    repo-relative prefix)."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return SourceFile(rel, path.read_text())
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# --- lock discipline ----------------------------------------------------------
+
+
+GUARDED_OK = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()   # guards: _items, total
+            self._items = {}
+            self.total = 0
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+                self.total += 1
+
+        def _evict_locked(self):
+            self._items.clear()              # caller holds the lock
+
+        def size(self):
+            return len(self._items)          # reads are not flagged
+"""
+
+
+def test_lock_guarded_ok(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_ok.py", GUARDED_OK)
+    assert lock_discipline.run([f]) == []
+
+
+def test_lock_unguarded_mutations_flagged(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_bad.py", """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()   # guards: _items, total
+                self._items = {}
+                self.total = 0
+
+            def put(self, k, v):
+                self._items[k] = v               # unguarded subscript
+                self.total += 1                  # unguarded augassign
+
+            def note(self, v):
+                self._items.setdefault(v, []).append(v)  # mutator call
+    """)
+    findings = lock_discipline.run([f])
+    assert [f_.rule for f_ in findings] == ["lock-guard"] * 3
+    assert {f_.line for f_ in findings} == {11, 12, 15}
+
+
+def test_lock_waiver_and_missing_reason(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_waive.py", """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()   # guards: total
+                self.total = 0
+
+            def bump(self):
+                # analyze: allow(lock-guard): single-writer thread owns this counter
+                self.total += 1
+
+            def bump2(self):
+                self.total += 1   # analyze: allow(lock-guard)
+    """)
+    findings = analyze.run_passes([f], only=["locks"])
+    # bump: properly waived.  bump2: the waiver has no reason — the
+    # lock-guard finding stands AND the bare waiver is itself flagged.
+    assert rules_of(findings) == ["lock-guard", "waiver-reason"]
+
+
+def test_lock_mutator_calls_in_statement_heads_flagged(tmp_path):
+    """Mutator calls buried in return/if/for heads are mutations too —
+    the review-time blind spot: `return self._items.pop(k)` outside the
+    lock must be flagged like a bare-statement pop."""
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_heads.py", """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()   # guards: _items
+                self._items = {}
+
+            def take(self, k):
+                return self._items.pop(k)       # in a return
+
+            def drop_if(self, k):
+                if self._items.pop(k, None):    # in a branch head
+                    return True
+                return False
+
+            def take_ok(self, k):
+                with self._lock:
+                    return self._items.pop(k)
+    """)
+    findings = lock_discipline.run([f])
+    assert rules_of(findings) == ["lock-guard", "lock-guard"]
+    assert {f_.line for f_ in findings} == {10, 13}
+
+
+def test_inline_waiver_does_not_bleed_to_next_line(tmp_path):
+    """A trailing same-line waiver covers ONLY its line: the site on the
+    next line still flags (standalone comment-above waivers are mapped
+    forward at parse time instead)."""
+    f = fixture(tmp_path, "ytsaurus_tpu/ops/fix_bleed.py", """
+        import numpy as np
+
+        def two_syncs(a, b):
+            x = np.asarray(a)  # analyze: allow(host-sync): first is intentional
+            y = np.asarray(b)
+            return x, y
+    """)
+    findings = jax_hazards.run([f])
+    assert rules_of(findings) == ["host-sync"]
+    assert findings[0].line == 6
+
+
+def test_lock_module_level_guard(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_mod.py", """
+        import threading
+
+        _LOCK = threading.Lock()   # guards: _STATE
+        _STATE = None
+
+        def set_state(v):
+            global _STATE
+            _STATE = v             # unguarded
+
+        def set_state_ok(v):
+            global _STATE
+            with _LOCK:
+                _STATE = v
+    """)
+    findings = lock_discipline.run([f])
+    assert rules_of(findings) == ["lock-guard"]
+    assert findings[0].line == 9
+
+
+def test_lock_annotation_typo_flagged(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_typo.py", """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()   # guards: _itemz
+                self._items = {}
+    """)
+    findings = lock_discipline.run([f])
+    assert rules_of(findings) == ["lock-annotation"]
+    assert "_itemz" in findings[0].message
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_cycle.py", """
+        import threading
+
+        _A = threading.Lock()   # guards: _x
+        _B = threading.Lock()   # guards: _y
+        _x = 0
+        _y = 0
+
+        def ab():
+            global _x, _y
+            with _A:
+                _x = 1
+                with _B:
+                    _y = 1
+
+        def ba():
+            global _x, _y
+            with _B:
+                _y = 2
+                with _A:
+                    _x = 2
+    """)
+    findings = lock_discipline.run([f])
+    assert rules_of(findings) == ["lock-order"]
+    assert "potential deadlock" in findings[0].message
+    snapshot = lock_discipline.order_graph_snapshot([f])
+    assert len(snapshot["cycles"]) == 1
+    assert len(snapshot["edges"]) == 2
+
+
+def test_lock_order_acyclic_and_call_propagation(tmp_path):
+    # B is acquired inside a helper CALLED under A: the edge must still
+    # appear (one-level call propagation), and no cycle exists.
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_calls.py", """
+        import threading
+
+        _A = threading.Lock()   # guards: _x
+        _B = threading.Lock()   # guards: _y
+        _x = 0
+        _y = 0
+
+        def inner():
+            global _y
+            with _B:
+                _y = 1
+
+        def outer():
+            global _x
+            with _A:
+                _x = 1
+                inner()
+    """)
+    assert lock_discipline.run([f]) == []
+    snapshot = lock_discipline.order_graph_snapshot([f])
+    assert snapshot["cycles"] == []
+    assert any("_A" in a and "_B" in b
+               for a, b, _site in snapshot["edges"])
+
+
+# --- jax hazards --------------------------------------------------------------
+
+
+def test_host_sync_flagged_in_hot_path(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/ops/fix_hot.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def leak(col, n):
+            host = np.asarray(col.data)          # sync
+            one = col.data.sum().item()          # sync
+            col.data.block_until_ready()         # sync
+            total = jnp.sum(col.data)
+            return host, one, float(total)       # sync (jnp local)
+
+        def fine():
+            return np.asarray([1, 2, 3])         # literal: host already
+    """)
+    findings = jax_hazards.run([f])
+    assert rules_of(findings) == ["host-sync"] * 4
+    assert {f_.line for f_ in findings} == {6, 7, 8, 10}
+
+
+def test_host_sync_cold_module_and_sync_points_exempt(tmp_path):
+    cold = fixture(tmp_path, "ytsaurus_tpu/client_fix.py", """
+        import numpy as np
+
+        def boundary(x):
+            return np.asarray(x)        # client layer: syncs are fine
+    """)
+    hot = fixture(tmp_path, "ytsaurus_tpu/ops/fix_sync_point.py", """
+        import numpy as np
+
+        def finish(self):
+            return int(self.count)       # declared sync point
+    """)
+    assert jax_hazards.run([cold, hot]) == []
+
+
+def test_host_sync_waiver(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/ops/fix_waived.py", """
+        import numpy as np
+
+        def spill(col):
+            # analyze: allow(host-sync): spills to host files by design
+            return np.asarray(col.data)
+    """)
+    assert jax_hazards.run([f]) == []
+
+
+def test_traced_branch_flagged(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/ops/fix_traced.py", """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @jax.jit
+        def bad(x):
+            if x > 0:                    # traced!
+                return x
+            return -x
+
+        @jax.jit
+        def ok_shape(x):
+            if x.shape[0] > 4:           # static structure
+                return x[:4]
+            return x
+
+        @partial(jax.jit, static_argnums=(1,))
+        def ok_static(x, flag):
+            if flag:                     # static argument
+                return x
+            return -x
+    """)
+    findings = jax_hazards.run([f])
+    assert rules_of(findings) == ["traced-branch"]
+    assert findings[0].line == 8
+
+
+def test_dynamic_shape_flagged_and_bucketed_ok(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/ops/fix_shapes.py", """
+        import jax
+
+        def pad_capacity(n):
+            return max(8, 1 << (n - 1).bit_length())
+
+        def kernel(x):
+            return x * 2
+
+        jitted = jax.jit(kernel)
+
+        def run(arr, n):
+            bad = jitted(arr[:n])                  # fresh program per n
+            good = jitted(arr[:pad_capacity(n)])   # pow2-bucketed
+            fixed = jitted(arr[:128])              # constant bound
+            return bad, good, fixed
+    """)
+    findings = jax_hazards.run([f])
+    assert rules_of(findings) == ["dynamic-shape"]
+    assert findings[0].line == 13
+
+
+# --- failpoint & span coverage ------------------------------------------------
+
+
+def test_failpoint_coverage(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/chunks/fix_io.py", """
+        import os
+        from ytsaurus_tpu.utils import failpoints
+
+        _FP = failpoints.register_site("chunks.fix.write")
+
+        def covered(path, blob):
+            _FP.hit()
+            with open(path, "wb") as f:
+                f.write(blob)
+            os.replace(path, path + ".pub")
+
+        def naked(path):
+            os.remove(path)
+
+        # analyze: allow(failpoint): fixture waiver — cleanup helper
+        def waived(path):
+            os.remove(path)
+    """)
+    findings = coverage.run([f])
+    assert rules_of(findings) == ["failpoint"]
+    assert "naked" in findings[0].message
+
+
+def test_failpoint_scope_is_server_chunk_rpc_only(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/cypress/fix_meta.py", """
+        def save(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+    """)
+    assert coverage.run([f]) == []
+
+
+def test_span_root_discipline(tmp_path):
+    interior = fixture(tmp_path, "ytsaurus_tpu/tablet/fix_spans.py", """
+        from ytsaurus_tpu.utils.tracing import child_span, start_query_span
+
+        def good(x):
+            with child_span("tablet.read"):
+                return x
+
+        def bad(x):
+            with start_query_span("tablet.rogue_root"):
+                return x
+    """)
+    entry = fixture(tmp_path, "ytsaurus_tpu/client.py", """
+        from ytsaurus_tpu.utils.tracing import start_query_span
+
+        def select(q):
+            with start_query_span("select"):
+                return q
+    """)
+    findings = coverage.run([interior, entry])
+    assert rules_of(findings) == ["span-root"]
+    assert findings[0].path == "ytsaurus_tpu/tablet/fix_spans.py"
+
+
+# --- error taxonomy -----------------------------------------------------------
+
+
+ERRORS_FIXTURE = """
+    import enum
+
+    class EErrorCode(enum.IntEnum):
+        OK = 0
+        Generic = 1
+        Timeout = 3
+        Waldo = 3          # duplicate: IntEnum silently aliases
+"""
+
+
+def test_duplicate_error_code_flagged(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/errors.py", ERRORS_FIXTURE)
+    findings = error_taxonomy.run([f])
+    assert rules_of(findings) == ["duplicate-code"]
+    assert "Waldo" in findings[0].message
+
+
+def test_raise_site_codes_checked(tmp_path):
+    errors = fixture(tmp_path, "ytsaurus_tpu/errors.py", """
+        import enum
+
+        class EErrorCode(enum.IntEnum):
+            OK = 0
+            Generic = 1
+            Timeout = 3
+    """)
+    raises = fixture(tmp_path, "ytsaurus_tpu/fix_raises.py", """
+        from ytsaurus_tpu.errors import EErrorCode, YtError
+
+        def a():
+            raise YtError("x", code=EErrorCode.Timeout)      # fine
+
+        def b():
+            raise YtError("x", code=9999)                    # unknown
+
+        def c():
+            raise YtError("x", code=3)                       # bare int
+
+        def d():
+            raise YtError("x", code=EErrorCode.Missing)      # no member
+    """)
+    findings = error_taxonomy.run([errors, raises])
+    assert rules_of(findings) == ["literal-code", "unregistered-code",
+                                  "unregistered-code"]
+    literal = next(f_ for f_ in findings if f_.rule == "literal-code")
+    assert literal.severity == "warning"
+    assert "EErrorCode.Timeout" in literal.message
+
+
+# --- baseline ratchet ---------------------------------------------------------
+
+
+def _findings(tmp_path, n):
+    source = "import threading\n\n_L = threading.Lock()   # guards: _s\n_s = 0\n\n"
+    for i in range(n):
+        source += f"def f{i}():\n    global _s\n    _s = {i}\n\n"
+    f = fixture(tmp_path, "ytsaurus_tpu/fix_ratchet.py", source)
+    found = lock_discipline.run([f])
+    assert len(found) == n
+    return found
+
+
+def test_ratchet_decrease_ok_increase_fails(tmp_path):
+    findings = _findings(tmp_path, 2)
+    key = findings[0].key()
+    assert check_ratchet(findings, {key: 2}) == []      # at baseline
+    assert check_ratchet(findings, {key: 3}) == []      # below: ok
+    over = check_ratchet(findings, {key: 1})            # above: fails
+    assert len(over) == 1 and "RATCHET" in over[0]
+
+
+def test_ratchet_new_key_fails_and_update_tightens(tmp_path):
+    findings = _findings(tmp_path, 2)
+    fresh = check_ratchet(findings, {})
+    assert len(fresh) == 2 and all(v.startswith("NEW") for v in fresh)
+    path = str(tmp_path / "baseline.json")
+    counts = write_baseline(findings, path)
+    assert counts == aggregate(findings)
+    assert check_ratchet(findings, load_baseline(path)) == []
+    payload = json.loads(open(path).read())
+    assert "decrease" in payload["comment"]
+
+
+def test_run_passes_rejects_unknown_pass():
+    with pytest.raises(ValueError):
+        analyze.run_passes([], only=["nonsense"])
+
+
+# --- the tier-1 gate ----------------------------------------------------------
+
+
+def test_repo_clean_against_baseline():
+    """THE gate: all five passes over the real tree, checked against the
+    committed baseline.  A new finding (or a count regression) fails
+    tier-1 — fix the code or waive with a reason; growing the baseline
+    is not a fix."""
+    files = load_files(REPO)
+    findings = analyze.run_passes(files, root=REPO)
+    violations = check_ratchet(findings, load_baseline())
+    assert violations == [], "\n".join(violations)
+
+
+def test_repo_lock_order_graph_is_acyclic():
+    """Acceptance: the lock-ordering graph across the annotated modules
+    is cycle-free, and the cross-object propagation is alive (the
+    admission→accountant edge exists — admit() folds throttles into the
+    accountant while holding the admission condition)."""
+    files = load_files(REPO)
+    snapshot = lock_discipline.order_graph_snapshot(files)
+    assert snapshot["cycles"] == []
+    assert len(snapshot["locks"]) >= 20
+    assert any("AdmissionController._cond" in a and
+               "ResourceAccountant._lock" in b
+               for a, b, _site in snapshot["edges"])
+
+
+def test_repo_annotations_cover_the_hot_modules():
+    """The ISSUE 9 annotation sweep: every named hot module carries at
+    least one `# guards:` lock annotation."""
+    files = {f.path: f for f in load_files(REPO)}
+    for rel in ("ytsaurus_tpu/query/serving.py",
+                "ytsaurus_tpu/query/workload.py",
+                "ytsaurus_tpu/query/engine/evaluator.py",
+                "ytsaurus_tpu/utils/profiling.py",
+                "ytsaurus_tpu/utils/tracing.py",
+                "ytsaurus_tpu/rpc/channel.py",
+                "ytsaurus_tpu/tablet/tablet.py",
+                "ytsaurus_tpu/server/discovery.py",
+                "ytsaurus_tpu/query/accounting.py",
+                "ytsaurus_tpu/utils/slo.py",
+                "ytsaurus_tpu/utils/failpoints.py"):
+        locks, _ = lock_discipline.collect_locks(files[rel])
+        assert locks, f"{rel} lost its # guards: annotations"
+
+
+def test_cli_analyze_offline(capsys):
+    """`yt analyze` runs without --proxy (offline subcommand) and
+    reports the ratchet verdict."""
+    from ytsaurus_tpu import cli
+    assert cli.run(["analyze"]) == 0
+    assert "static analysis clean" in capsys.readouterr().out

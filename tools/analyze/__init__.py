@@ -1,0 +1,82 @@
+"""`yt analyze` — the AST-based static-analysis suite (ISSUE 9).
+
+Five passes over one shared parse of the tree (see core.py for the
+framework: finding model, waivers, baseline ratchet):
+
+  locks     lock discipline (`# guards:` annotations) + the global
+            lock-acquisition-order graph, failing on cycles
+  jax       JAX tracing hazards: hidden device→host syncs in hot-path
+            modules, Python branches on traced values, dynamically
+            shaped calls into jitted callees
+  coverage  failpoint coverage of I/O functions in the server/chunk/rpc
+            planes + PR 5's span-site discipline (no interior roots)
+  errors    error-taxonomy soundness: unique EErrorCode values,
+            registered codes at raise sites
+  sensors   PR 6's sensor-catalog lint, folded in as the fifth pass
+
+Entry points: `yt analyze [--pass ...] [--json] [--update-baseline]`,
+`python -m tools.analyze`, and the tier-1 gate in
+tests/test_static_analysis.py (repo clean against the committed
+baseline — the ratchet means findings may only ever decrease).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Iterable, Optional
+
+from tools.analyze import (
+    coverage,
+    error_taxonomy,
+    jax_hazards,
+    lock_discipline,
+    sensors,
+)
+from tools.analyze.core import (
+    BASELINE_PATH,
+    Finding,
+    SourceFile,
+    aggregate,
+    check_ratchet,
+    load_baseline,
+    load_files,
+    waiver_findings,
+    write_baseline,
+)
+
+__all__ = [
+    "PASSES", "Finding", "SourceFile", "load_files", "run_passes",
+    "load_baseline", "write_baseline", "check_ratchet", "aggregate",
+    "BASELINE_PATH",
+]
+
+PASSES = {
+    "locks": lock_discipline.run,
+    "jax": jax_hazards.run,
+    "coverage": coverage.run,
+    "errors": error_taxonomy.run,
+    "sensors": sensors.run,
+}
+
+
+def run_passes(files: "list[SourceFile]",
+               only: Optional[Iterable[str]] = None,
+               root: Optional[str] = None) -> "list[Finding]":
+    """Run the selected passes (all by default) over pre-loaded files;
+    framework-level waiver findings (bare waivers with no reason) are
+    emitted exactly once, not per pass."""
+    names = list(only) if only else list(PASSES)
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        raise ValueError(
+            f"unknown pass(es) {unknown} — available: {sorted(PASSES)}")
+    findings: list[Finding] = []
+    for name in names:
+        fn = PASSES[name]
+        if "root" in inspect.signature(fn).parameters:
+            findings.extend(fn(files, root=root))
+        else:
+            findings.extend(fn(files))
+    findings.extend(waiver_findings("framework", files))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,87 @@
+"""CLI driver: `python -m tools.analyze` (what `yt analyze` wraps).
+
+Exit codes: 0 clean against the committed baseline, 1 findings violate
+the ratchet, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tools import analyze
+    from tools.analyze import lock_discipline
+
+    parser = argparse.ArgumentParser(
+        prog="yt analyze",
+        description="AST-based static analysis: lock discipline, JAX "
+                    "recompile/host-sync hazards, failpoint & span "
+                    "coverage, error taxonomy, sensor catalog.")
+    parser.add_argument("--root", default=repo_root,
+                        help="repo root (contains ytsaurus_tpu/)")
+    parser.add_argument("--pass", dest="passes", action="append",
+                        choices=sorted(analyze.PASSES),
+                        help="run only this pass (repeatable; "
+                             "default: all)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings + ratchet "
+                             "verdict + lock-order graph")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite tools/analyze/baseline.json to "
+                             "the current finding counts (run AFTER "
+                             "fixing findings to tighten the ratchet)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: committed one)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report raw findings; exit 1 if any exist")
+    args = parser.parse_args(argv)
+
+    files = analyze.load_files(args.root)
+    findings = analyze.run_passes(files, only=args.passes,
+                                  root=args.root)
+
+    if args.update_baseline:
+        counts = analyze.write_baseline(
+            findings, args.baseline or analyze.BASELINE_PATH)
+        print(f"baseline updated: {sum(counts.values())} finding(s) "
+              f"across {len(counts)} (pass, rule, path) key(s)")
+        return 0
+
+    if args.no_baseline:
+        violations = [f.format() for f in findings]
+    else:
+        baseline = analyze.load_baseline(args.baseline)
+        violations = analyze.check_ratchet(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "violations": violations,
+            "counts": analyze.aggregate(findings),
+            "lock_order": lock_discipline.order_graph_snapshot(files),
+            "clean": not violations,
+        }, indent=2))
+        return 1 if violations else 0
+
+    for violation in violations:
+        print(violation, file=sys.stderr)
+    if violations:
+        print(f"{len(violations)} static-analysis violation(s) "
+              f"({len(findings)} finding(s) total; baseline ratchet: "
+              f"counts may only decrease)", file=sys.stderr)
+        return 1
+    suffix = f", {len(findings)} baselined finding(s)" if findings else ""
+    print(f"static analysis clean{suffix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,285 @@
+"""Shared substrate of the `yt analyze` static-analysis suite (ISSUE 9).
+
+The pattern PR 6's sensor-catalog lint proved — an AST walk over the
+tree, run from the test suite, keeping a cross-cutting invariant green
+forever — generalized into one framework every pass shares:
+
+  SourceFile        one parsed module: repo-relative path, source lines,
+                    AST, and the waiver table parsed from comments.
+  Finding           one violation with `path:line`, a stable RULE id,
+                    severity, and a message.
+  waivers           `# analyze: allow(<rule>): <reason>` on (or directly
+                    above) the offending line suppresses that rule there;
+                    the reason string is MANDATORY — a bare waiver is
+                    itself a finding (`waiver-reason`).
+  baseline ratchet  findings aggregate per (pass, rule, path) into
+                    counts checked against tools/analyze/baseline.json:
+                    counts may only DECREASE; a new (pass, rule, path)
+                    key or a count increase fails the build.  Fixing
+                    debt then running `yt analyze --update-baseline`
+                    tightens the ratchet.
+
+Passes register in `tools/analyze/__init__.py::PASSES`; each exposes
+`run(files: list[SourceFile]) -> list[Finding]` and is pure AST — no
+module under analysis is ever imported, so heavy-dep modules cannot
+break the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Callable, Iterable, Optional
+
+SEVERITIES = ("error", "warning")
+
+# `# analyze: allow(rule-a, rule-b): why this is fine`
+_WAIVER_RE = re.compile(
+    r"#\s*analyze:\s*allow\(\s*([a-z0-9_\-\s,]+?)\s*\)\s*(?::\s*(.*?))?\s*$")
+
+
+class Finding:
+    """One violation.  `key()` is the baseline-aggregation unit — rule +
+    file, NOT the line number, so unrelated edits shifting lines don't
+    churn the committed baseline."""
+
+    __slots__ = ("pass_name", "rule", "path", "line", "message",
+                 "severity")
+
+    def __init__(self, pass_name: str, rule: str, path: str, line: int,
+                 message: str, severity: str = "error"):
+        assert severity in SEVERITIES, severity
+        self.pass_name = pass_name
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+        self.severity = severity
+
+    def key(self) -> str:
+        return f"{self.pass_name}:{self.rule}:{self.path}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_name}/{self.rule}]"
+                f" {self.message}")
+
+    def to_dict(self) -> dict:
+        return {"pass": self.pass_name, "rule": self.rule,
+                "path": self.path, "line": self.line,
+                "message": self.message, "severity": self.severity}
+
+
+class Waiver:
+    __slots__ = ("rules", "reason", "line")
+
+    def __init__(self, rules: "tuple[str, ...]", reason: str, line: int):
+        self.rules = rules
+        self.reason = reason
+        self.line = line
+
+
+class SourceFile:
+    """One module under analysis, parsed once and shared by every pass."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path                 # repo-relative, '/'-separated
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> [Waiver]: a waiver governs its own line; a waiver on a
+        # comment-only line also governs the next non-blank line (the
+        # statement it sits above).
+        self.waivers: dict[int, list[Waiver]] = {}
+        self._parse_waivers()
+
+    def _parse_waivers(self) -> None:
+        for lineno, text in enumerate(self.lines, start=1):
+            match = _WAIVER_RE.search(text)
+            if match is None:
+                continue
+            rules = tuple(r.strip() for r in match.group(1).split(",")
+                          if r.strip())
+            reason = (match.group(2) or "").strip()
+            waiver = Waiver(rules, reason, lineno)
+            self.waivers.setdefault(lineno, []).append(waiver)
+            if text.lstrip().startswith("#"):
+                # Standalone comment: governs the statement below it.
+                nxt = lineno + 1
+                while nxt <= len(self.lines) and not self.lines[nxt - 1].strip():
+                    nxt += 1
+                self.waivers.setdefault(nxt, []).append(waiver)
+
+    def waived(self, rule: str, line: int) -> bool:
+        # Only THIS line: standalone comment-above waivers were already
+        # mapped forward by _parse_waivers, so a fallback to line-1 here
+        # would let an inline waiver on one line silently suppress the
+        # next line's findings too.
+        for waiver in self.waivers.get(line, ()):
+            if rule in waiver.rules and waiver.reason:
+                return True
+        return False
+
+    def function_waived(self, rule: str, node: ast.AST) -> bool:
+        """A waiver on any line of a def's signature (decorators
+        included, or the comment line directly above them) governs the
+        whole function for function-granular rules (failpoint
+        coverage)."""
+        start = getattr(node, "lineno", 0)
+        for deco in getattr(node, "decorator_list", []) or []:
+            start = min(start, getattr(deco, "lineno", start) - 1)
+        end = getattr(node.body[0], "lineno", start) \
+            if getattr(node, "body", None) else start
+        return any(self.waived(rule, line) for line in range(start, end + 1))
+
+
+def waiver_findings(pass_name: str, files: "list[SourceFile]"
+                    ) -> "list[Finding]":
+    """Bare waivers (no reason string) are findings: a suppression with
+    no recorded justification is unreviewable debt."""
+    out = []
+    for f in files:
+        seen = set()
+        for waivers in f.waivers.values():
+            for w in waivers:
+                if not w.reason and id(w) not in seen:
+                    seen.add(id(w))
+                    out.append(Finding(
+                        pass_name, "waiver-reason", f.path, w.line,
+                        f"waiver for {', '.join(w.rules)} has no reason "
+                        f"string — use `# analyze: allow(rule): why`"))
+    return out
+
+
+def load_files(root: str, package: str = "ytsaurus_tpu",
+               rel_paths: Optional[Iterable[str]] = None
+               ) -> "list[SourceFile]":
+    """Parse every .py module under <root>/<package> (or just
+    `rel_paths`, repo-relative).  Unparseable files surface as a
+    framework finding downstream, not an exception."""
+    files: list[SourceFile] = []
+    if rel_paths is not None:
+        paths = [os.path.join(root, p) for p in rel_paths]
+    else:
+        paths = []
+        pkg_root = os.path.join(root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for filename in sorted(filenames):
+                if filename.endswith(".py"):
+                    paths.append(os.path.join(dirpath, filename))
+    for path in sorted(paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        files.append(SourceFile(rel, source))
+    return files
+
+
+# -- baseline ratchet ----------------------------------------------------------
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def aggregate(findings: "list[Finding]") -> "dict[str, int]":
+    counts: dict[str, int] = {}
+    for finding in findings:
+        counts[finding.key()] = counts.get(finding.key(), 0) + 1
+    return counts
+
+
+def load_baseline(path: Optional[str] = None) -> "dict[str, int]":
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(findings: "list[Finding]",
+                   path: Optional[str] = None) -> "dict[str, int]":
+    counts = aggregate(findings)
+    payload = {
+        "comment": "Ratcheted debt: counts may only decrease. "
+                   "Regenerate with `yt analyze --update-baseline` "
+                   "AFTER fixing findings, never to admit new ones.",
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path or BASELINE_PATH, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return counts
+
+
+def check_ratchet(findings: "list[Finding]",
+                  baseline: "dict[str, int]") -> "list[str]":
+    """Ratchet semantics: per (pass, rule, path) the live count must not
+    exceed the baseline; unknown keys are NEW findings and always fail.
+    Counts below baseline pass (and `--update-baseline` tightens)."""
+    errors = []
+    counts = aggregate(findings)
+    by_key: dict[str, list[Finding]] = {}
+    for finding in findings:
+        by_key.setdefault(finding.key(), []).append(finding)
+    for key in sorted(counts):
+        allowed = baseline.get(key)
+        if allowed is None:
+            for finding in by_key[key]:
+                errors.append(f"NEW {finding.format()}")
+        elif counts[key] > allowed:
+            lines = ", ".join(str(f.line) for f in by_key[key])
+            errors.append(
+                f"RATCHET {key}: {counts[key]} findings > baseline "
+                f"{allowed} (lines {lines}) — fix the regression, do "
+                f"not grow the baseline")
+    return errors
+
+
+# -- shared AST helpers --------------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('jax.jit', 'self._lock.acquire',
+    'open'); '' when the callee is not a plain name/attribute chain."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif not parts:
+        return ""
+    else:
+        parts.append("<expr>")
+    return ".".join(reversed(parts))
+
+
+def walk_functions(tree: ast.AST):
+    """Yield (class_name_or_None, function_node) for every def in a
+    module, including methods (one level of class nesting, the repo
+    idiom)."""
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node.name, sub
+
+
+def iter_calls(node: ast.AST):
+    for child in ast.walk(node):
+        if isinstance(child, ast.Call):
+            yield child
+
+
+def expr_contains_name(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))

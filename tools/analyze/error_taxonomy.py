@@ -1,0 +1,126 @@
+"""Error-taxonomy pass (`yt analyze --pass errors`).
+
+The error-code registry (`errors.EErrorCode`) is the wire contract:
+clients dispatch on codes (`RetryingChannel` retries TransportError,
+treats DeadlineExceeded as terminal, honors RequestThrottled hints), so
+the registry must stay sound:
+
+  duplicate-code      two EErrorCode members share a value.  IntEnum
+                      silently ALIASES duplicates — `EErrorCode.B = 500`
+                      after `A = 500` makes B just another name for A,
+                      every `find(B)` matches A's errors, and nothing
+                      throws.  Only a static check catches this.
+  unregistered-code   a raise site passes `code=<int literal>` that no
+                      EErrorCode member defines — invisible to every
+                      `contains`/`find` dispatch written against the
+                      registry.
+  literal-code        a raise site uses a registered value as a bare
+                      int instead of the EErrorCode member (warning:
+                      greppability + rename safety).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import Finding, SourceFile, dotted_name
+
+PASS_NAME = "errors"
+
+ERRORS_MODULE = "ytsaurus_tpu/errors.py"
+ENUM_CLASS = "EErrorCode"
+
+# Error constructors whose `code=` kwarg is registry-checked.
+_ERROR_CTORS = {"YtError", "YtResponseError", "errors.YtError"}
+
+
+def registry_from(files: "list[SourceFile]"
+                  ) -> "tuple[dict[str, int], list[Finding]]":
+    """name -> value from the EErrorCode class body, plus duplicate
+    findings.  Pure AST — the enum is never imported."""
+    findings: list[Finding] = []
+    values: dict[str, int] = {}
+    errors_file = next((f for f in files if f.path == ERRORS_MODULE or
+                        f.path.endswith("/errors.py")), None)
+    if errors_file is None:
+        return values, findings
+    enum_node = next((n for n in ast.walk(errors_file.tree)
+                      if isinstance(n, ast.ClassDef)
+                      and n.name == ENUM_CLASS), None)
+    if enum_node is None:
+        return values, findings
+    by_value: dict[int, str] = {}
+    for stmt in enum_node.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, int)):
+            continue
+        name = stmt.targets[0].id
+        value = stmt.value.value
+        prior = by_value.get(value)
+        if prior is not None:
+            findings.append(Finding(
+                PASS_NAME, "duplicate-code", errors_file.path,
+                stmt.lineno,
+                f"EErrorCode.{name} = {value} duplicates "
+                f"EErrorCode.{prior} — IntEnum silently aliases them, "
+                f"so every find({name}) would match {prior} errors"))
+        else:
+            by_value[value] = name
+        values[name] = value
+    return values, findings
+
+
+def _check_raise_sites(f: SourceFile, registry: "dict[str, int]",
+                       findings: "list[Finding]") -> None:
+    registered_values = set(registry.values())
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if callee.rsplit(".", 1)[-1] not in {c.rsplit(".", 1)[-1]
+                                             for c in _ERROR_CTORS}:
+            continue
+        for kw in node.keywords:
+            if kw.arg != "code":
+                continue
+            value = kw.value
+            if isinstance(value, ast.Constant) and \
+                    isinstance(value.value, int):
+                if f.waived("error-code", value.lineno):
+                    continue
+                if value.value not in registered_values:
+                    findings.append(Finding(
+                        PASS_NAME, "unregistered-code", f.path,
+                        node.lineno,
+                        f"raise site uses code={value.value} which no "
+                        f"EErrorCode member defines — register it in "
+                        f"errors.py or use an existing member"))
+                else:
+                    member = next(n for n, v in registry.items()
+                                  if v == value.value)
+                    findings.append(Finding(
+                        PASS_NAME, "literal-code", f.path, node.lineno,
+                        f"raise site spells code={value.value} as a "
+                        f"bare int — use EErrorCode.{member}",
+                        severity="warning"))
+            elif isinstance(value, ast.Attribute):
+                name = dotted_name(value)
+                if name.startswith("EErrorCode.") and \
+                        name[len("EErrorCode."):] not in registry and \
+                        registry:
+                    findings.append(Finding(
+                        PASS_NAME, "unregistered-code", f.path,
+                        node.lineno,
+                        f"raise site references {name} but errors.py "
+                        f"defines no such member"))
+
+
+def run(files: "list[SourceFile]") -> "list[Finding]":
+    registry, findings = registry_from(files)
+    if not registry:
+        return findings
+    for f in files:
+        _check_raise_sites(f, registry, findings)
+    return findings

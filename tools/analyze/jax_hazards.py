@@ -1,0 +1,313 @@
+"""JAX tracing-hazard pass (`yt analyze --pass jax`).
+
+The static complement of PR 7's `classify_miss`: the compilation
+observatory explains a recompilation storm AFTER it ships; this pass
+flags the code shapes that cause one — plus the quieter pathology, the
+hidden device→host synchronization that never throws but serializes the
+dispatch queue ("An Empirical Analysis of Just-in-Time Compilation in
+Modern Databases", arxiv 2311.04692).
+
+Scope: the declared HOT-PATH modules (`ops/`, `query/engine/`,
+`tablet/mvcc.py`, `parallel/`) for host-sync; jit-decorated functions
+anywhere for traced-branch.
+
+Rules
+-----
+  host-sync       `.item()`, `block_until_ready`, `np.asarray(x)` of a
+                  potentially device-resident value, and `float()/int()`
+                  on a jax expression — each is a device→host sync; in a
+                  hot path it must be an ALLOWLISTED sync point or carry
+                  `# analyze: allow(host-sync): reason`.
+  traced-branch   Python `if`/`while` on a traced parameter inside a
+                  `@jax.jit` function — a concretization error at best,
+                  a silent per-value recompile via static_argnums at
+                  worst.  Shape/dtype/ndim/size attribute tests are
+                  static and exempt.
+  dynamic-shape   a dynamically-bounded slice (`x[:n]` with non-constant
+                  `n`) passed straight into a locally-jitted callee —
+                  every distinct length compiles a fresh program unless
+                  the bound went through a pow2 bucketing helper
+                  (`pad_capacity`, `next_pow2`, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.analyze.core import Finding, SourceFile, dotted_name
+
+PASS_NAME = "jax"
+
+# Hot-path scope: path prefixes (repo-relative) the host-sync rule
+# polices.  Everything else may sync freely — host boundaries are the
+# POINT of the coordinator/client layers.
+HOT_PREFIXES = (
+    "ytsaurus_tpu/ops/",
+    "ytsaurus_tpu/query/engine/",
+    "ytsaurus_tpu/parallel/",
+    "ytsaurus_tpu/tablet/mvcc.py",
+)
+
+# Functions that ARE the sanctioned host-sync points of the hot modules:
+# the one place a pipeline materializes (every caller funnels through
+# them, so the sync count stays O(1) per query, not O(sites)).
+SYNC_POINT_FUNCTIONS = {
+    "finish", "finish_all", "to_rows",
+}
+
+# Names that neutralize a dynamic slice bound: the repo's pow2
+# capacity-bucketing helpers.
+BUCKET_HELPERS = {"pad_capacity", "next_pow2", "bucket_capacity"}
+
+_JIT_DECORATORS = {"jit", "jax.jit", "partial", "functools.partial"}
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def is_hot(path: str) -> bool:
+    return any(path == p or path.startswith(p) for p in HOT_PREFIXES)
+
+
+def _enclosing_function_name(stack: "list[ast.AST]") -> Optional[str]:
+    for node in reversed(stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node.name
+    return None
+
+
+def _jnp_names(fn: ast.AST) -> "set[str]":
+    """Names bound (directly) from jnp.* expressions within a function —
+    the local inference behind `float(x)`/`int(x)` flagging."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            if any(isinstance(n, ast.Name) and n.id == "jnp"
+                   for n in ast.walk(node.value)):
+                out.add(node.targets[0].id)
+    return out
+
+
+def _is_hostlike(node: ast.AST) -> bool:
+    """Expressions that are clearly ALREADY host values: literals,
+    list/tuple displays, pure-np expressions, and len()/range() calls."""
+    if isinstance(node, (ast.Constant, ast.List, ast.Tuple, ast.Dict,
+                         ast.ListComp, ast.GeneratorExp)):
+        return True
+    name = dotted_name(node)
+    if name.startswith("np."):
+        return True
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func)
+        return callee.startswith("np.") or callee in ("len", "range",
+                                                      "sorted")
+    return False
+
+
+def _check_host_sync(f: SourceFile, findings: "list[Finding]") -> None:
+    # Function-granular allowlist: sites inside a declared sync-point
+    # function are sanctioned.
+    sync_ranges: list[tuple[int, int]] = []
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in SYNC_POINT_FUNCTIONS:
+            sync_ranges.append((node.lineno, node.end_lineno or node.lineno))
+
+    def sanctioned(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in sync_ranges)
+
+    # Per-FUNCTION jnp-name inference, mapped back to line ranges: a
+    # numpy-only helper must not inherit another function's jax names.
+    fn_ranges: list[tuple[int, int, set[str]]] = []
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_ranges.append((node.lineno, node.end_lineno or node.lineno,
+                              _jnp_names(node)))
+
+    def jnp_locals_at(line: int) -> "set[str]":
+        best: set[str] = set()
+        best_span = None
+        for lo, hi, names in fn_ranges:     # innermost enclosing def
+            if lo <= line <= hi and (best_span is None or
+                                     hi - lo < best_span):
+                best, best_span = names, hi - lo
+        return best
+
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = node.lineno
+        if sanctioned(line) or f.waived("host-sync", line):
+            continue
+        callee = dotted_name(node.func)
+        site = None
+        if callee.endswith(".item") and not node.args:
+            site = f"`{callee}()` blocks on a device→host transfer"
+        elif callee.endswith("block_until_ready") or \
+                callee == "jax.block_until_ready":
+            site = "`block_until_ready` is an explicit device sync"
+        elif callee == "np.asarray" and node.args and \
+                not _is_hostlike(node.args[0]):
+            site = ("`np.asarray(...)` of a potentially device-resident "
+                    "value synchronizes and copies to host")
+        elif callee in ("float", "int") and len(node.args) == 1:
+            arg = node.args[0]
+            arg_names = {n.id for n in ast.walk(arg)
+                         if isinstance(n, ast.Name)}
+            if "jnp" in arg_names or (arg_names & jnp_locals_at(line)):
+                site = (f"`{callee}()` on a jax expression forces a "
+                        f"device→host sync")
+        if site is not None:
+            findings.append(Finding(
+                PASS_NAME, "host-sync", f.path, line,
+                f"{site}; hot-path modules must sync only at declared "
+                f"sync points — waive with `# analyze: "
+                f"allow(host-sync): reason` if intentional"))
+
+
+def _jitted_functions(tree: ast.AST):
+    """(fn_node, static_params) for defs decorated with jax.jit (incl.
+    `@partial(jax.jit, static_argnums=...)`)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            static: set[str] = set()
+            target = deco
+            if isinstance(deco, ast.Call):
+                target = deco.func
+            name = dotted_name(target)
+            if name not in _JIT_DECORATORS:
+                continue
+            if name.endswith("partial"):
+                if not (isinstance(deco, ast.Call) and deco.args and
+                        dotted_name(deco.args[0]) in ("jit", "jax.jit")):
+                    continue
+            if isinstance(deco, ast.Call):
+                params = [a.arg for a in node.args.args]
+                for kw in deco.keywords:
+                    if kw.arg == "static_argnums":
+                        for elt in ast.walk(kw.value):
+                            if isinstance(elt, ast.Constant) and \
+                                    isinstance(elt.value, int) and \
+                                    elt.value < len(params):
+                                static.add(params[elt.value])
+                    elif kw.arg == "static_argnames":
+                        for elt in ast.walk(kw.value):
+                            if isinstance(elt, ast.Constant) and \
+                                    isinstance(elt.value, str):
+                                static.add(elt.value)
+            yield node, static
+            break
+
+
+class _StaticStripper(ast.NodeTransformer):
+    """Remove static-structure subtrees (x.shape, len(x), x.dtype,
+    isinstance(...)) before scanning a test for traced names."""
+
+    def visit_Attribute(self, node):
+        if node.attr in _STATIC_ATTRS:
+            return ast.copy_location(ast.Constant(value=0), node)
+        return self.generic_visit(node)
+
+    def visit_Call(self, node):
+        callee = dotted_name(node.func)
+        if callee in ("len", "isinstance", "getattr", "hasattr"):
+            return ast.copy_location(ast.Constant(value=0), node)
+        return self.generic_visit(node)
+
+
+def _check_traced_branches(f: SourceFile,
+                           findings: "list[Finding]") -> None:
+    for fn, static in _jitted_functions(f.tree):
+        params = {a.arg for a in [*fn.args.args, *fn.args.posonlyargs,
+                                  *fn.args.kwonlyargs]} - static
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if f.waived("traced-branch", node.lineno):
+                continue
+            stripped = _StaticStripper().visit(
+                ast.fix_missing_locations(
+                    ast.parse(ast.unparse(node.test), mode="eval")))
+            names = {n.id for n in ast.walk(stripped)
+                     if isinstance(n, ast.Name)}
+            hit = sorted(names & params)
+            if hit:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(Finding(
+                    PASS_NAME, "traced-branch", f.path, node.lineno,
+                    f"Python `{kind}` on traced value(s) "
+                    f"{', '.join(hit)} inside jitted "
+                    f"`{fn.name}` — concretization error under "
+                    f"tracing; use jnp.where/lax.cond or mark the "
+                    f"argument static"))
+
+
+def _locally_jitted_names(tree: ast.AST) -> "set[str]":
+    """Names bound to `jax.jit(...)` results plus jit-decorated defs —
+    the callees the dynamic-shape rule watches."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call) and \
+                dotted_name(node.value.func) in ("jax.jit", "jit"):
+            out.add(node.targets[0].id)
+    for fn, _static in _jitted_functions(tree):
+        out.add(fn.name)
+    return out
+
+
+def _dynamic_slice_bound(arg: ast.AST) -> Optional[str]:
+    """`x[:n]` / `x[a:b]` with a non-constant, non-bucketed bound →
+    the offending bound's source text."""
+    if not (isinstance(arg, ast.Subscript) and
+            isinstance(arg.slice, ast.Slice)):
+        return None
+    for bound in (arg.slice.lower, arg.slice.upper):
+        if bound is None or isinstance(bound, ast.Constant):
+            continue
+        if isinstance(bound, ast.Call) and \
+                dotted_name(bound.func).rsplit(".", 1)[-1] in BUCKET_HELPERS:
+            continue
+        if isinstance(bound, ast.UnaryOp) and \
+                isinstance(bound.operand, ast.Constant):
+            continue
+        return ast.unparse(bound)
+    return None
+
+
+def _check_dynamic_shapes(f: SourceFile,
+                          findings: "list[Finding]") -> None:
+    jitted = _locally_jitted_names(f.tree)
+    if not jitted:
+        return
+    for node in ast.walk(f.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Name) and
+                node.func.id in jitted):
+            continue
+        if f.waived("dynamic-shape", node.lineno):
+            continue
+        for arg in node.args:
+            bound = _dynamic_slice_bound(arg)
+            if bound is not None:
+                findings.append(Finding(
+                    PASS_NAME, "dynamic-shape", f.path, node.lineno,
+                    f"jitted callee {node.func.id!r} receives a "
+                    f"dynamically-bounded slice (bound `{bound}`): "
+                    f"every distinct length compiles a fresh program — "
+                    f"pad through a pow2 bucket helper "
+                    f"({', '.join(sorted(BUCKET_HELPERS))})"))
+
+
+def run(files: "list[SourceFile]") -> "list[Finding]":
+    findings: list[Finding] = []
+    for f in files:
+        if is_hot(f.path):
+            _check_host_sync(f, findings)
+            _check_dynamic_shapes(f, findings)
+        _check_traced_branches(f, findings)
+    return findings

@@ -1,0 +1,517 @@
+"""Lock-discipline + lock-ordering pass (`yt analyze --pass locks`).
+
+Annotation convention: the comment `# guards: attr_a, attr_b` on a lock
+assignment declares what state that lock protects —
+
+    self._lock = threading.Lock()   # guards: _usage, _records
+    _LOCK = threading.Lock()        # guards: _STATE, _SITES
+
+(`@guarded_by` spelled as a comment works too: `# guarded_by: _lock` on
+a state attribute's own assignment line inverts the declaration.)
+
+Rules
+-----
+  lock-guard       annotated state mutated outside a `with <lock>` scope
+                   (methods named `*_locked` are exempt by convention —
+                   they document "caller holds the lock").
+  lock-order       the GLOBAL lock-acquisition-order graph (edges from
+                   nested `with` scopes, propagated one call level deep
+                   through same-file calls and the registered singleton
+                   accessors) contains a cycle — a potential deadlock.
+  lock-annotation  a `# guards:` comment that names state the class
+                   never defines, or is not attached to an assignment
+                   (typo protection: a misspelled guard silently checks
+                   nothing).
+
+Only files carrying at least one annotation are checked for lock-guard
+(opt-in by annotation); the order graph spans every annotated lock in
+the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from tools.analyze.core import (
+    Finding,
+    SourceFile,
+    dotted_name,
+    walk_functions,
+)
+
+PASS_NAME = "locks"
+
+_GUARDS_RE = re.compile(r"#\s*guards:\s*([A-Za-z0-9_,\s]+?)\s*$")
+_GUARDED_BY_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z0-9_]+)\s*$")
+
+# Mutating method names on containers/objects — calling one on guarded
+# state is a write for discipline purposes.
+MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "popleft", "remove", "discard", "clear",
+    "appendleft", "move_to_end",
+}
+
+# Singleton accessors: `get_x().method()` inside a lock scope acquires
+# whatever `method` acquires on the returned class.  (path, ClassName)
+# per accessor; paths are repo-relative.
+ACCESSORS = {
+    "get_accountant": ("ytsaurus_tpu/query/accounting.py",
+                       "ResourceAccountant"),
+    "get_workload_log": ("ytsaurus_tpu/query/workload.py", "WorkloadLog"),
+    "get_collector": ("ytsaurus_tpu/utils/tracing.py", "SpanCollector"),
+    "get_history": ("ytsaurus_tpu/utils/profiling.py", "MetricsHistory"),
+    "get_slo_tracker": ("ytsaurus_tpu/utils/slo.py", "SloTracker"),
+    "get_compile_observatory": ("ytsaurus_tpu/query/engine/evaluator.py",
+                                "CompileObservatory"),
+}
+
+
+class LockInfo:
+    """One annotated lock: identity + the state names it guards."""
+
+    __slots__ = ("path", "cls", "attr", "guards", "line")
+
+    def __init__(self, path: str, cls: Optional[str], attr: str,
+                 guards: "set[str]", line: int):
+        self.path = path
+        self.cls = cls          # None for module-level locks
+        self.attr = attr
+        self.guards = guards
+        self.line = line
+
+    @property
+    def node_id(self) -> str:
+        scope = f"{self.cls}." if self.cls else ""
+        return f"{self.path}::{scope}{self.attr}"
+
+
+def _annotation_lines(f: SourceFile):
+    for lineno, text in enumerate(f.lines, start=1):
+        match = _GUARDS_RE.search(text)
+        if match:
+            yield lineno, "guards", [s.strip() for s in
+                                     match.group(1).split(",") if s.strip()]
+            continue
+        match = _GUARDED_BY_RE.search(text)
+        if match:
+            yield lineno, "guarded_by", [match.group(1)]
+
+
+def _assign_target_name(stmt: ast.stmt) -> "tuple[Optional[str], bool]":
+    """(name, is_self_attr) for a single-target simple assignment."""
+    target = None
+    if isinstance(stmt, (ast.Assign,)) and len(stmt.targets) == 1:
+        target = stmt.targets[0]
+    elif isinstance(stmt, ast.AnnAssign):
+        target = stmt.target
+    if isinstance(target, ast.Name):
+        return target.id, False
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and \
+            target.value.id == "self":
+        return target.attr, True
+    return None, False
+
+
+def collect_locks(f: SourceFile) -> "tuple[list[LockInfo], list[Finding]]":
+    """Parse a file's `# guards:` / `# guarded_by:` annotations into
+    LockInfos, with lock-annotation findings for detached/typo'd ones."""
+    findings: list[Finding] = []
+    # lineno -> (owning class name or None) for every assignment stmt.
+    stmts: dict[int, tuple[Optional[str], ast.stmt]] = {}
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    stmts.setdefault(sub.lineno, (node.name, sub))
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            stmts.setdefault(node.lineno, (None, node))
+
+    locks: dict[tuple, LockInfo] = {}
+    deferred: list[tuple[int, str, str]] = []   # guarded_by: resolve late
+    for lineno, kind, names in _annotation_lines(f):
+        owner = stmts.get(lineno)
+        if owner is None and f.lines[lineno - 1].lstrip().startswith("#"):
+            # Standalone comment: governs the assignment directly below.
+            owner = stmts.get(lineno + 1)
+        if owner is None:
+            findings.append(Finding(
+                PASS_NAME, "lock-annotation", f.path, lineno,
+                f"`# {kind}:` annotation is not attached to an "
+                f"assignment statement"))
+            continue
+        cls, stmt = owner
+        name, _is_self = _assign_target_name(stmt)
+        if name is None:
+            findings.append(Finding(
+                PASS_NAME, "lock-annotation", f.path, lineno,
+                f"`# {kind}:` annotation on an unsupported assignment "
+                f"shape (need `self.x = ...` or `NAME = ...`)"))
+            continue
+        if kind == "guards":
+            key = (cls, name)
+            info = locks.get(key)
+            if info is None:
+                info = locks[key] = LockInfo(f.path, cls, name, set(),
+                                             lineno)
+            info.guards.update(names)
+        else:                                   # guarded_by on state
+            deferred.append((lineno, cls, name, names[0]))
+    for lineno, cls, state_name, lock_name in deferred:
+        key = (cls, lock_name)
+        info = locks.get(key)
+        if info is None:
+            info = locks[key] = LockInfo(f.path, cls, lock_name, set(),
+                                         lineno)
+        info.guards.add(state_name)
+
+    # Typo protection: every guarded name must exist as state in scope.
+    for info in locks.values():
+        present: set[str] = set()
+        if info.cls is not None:
+            cls_node = next((n for n in ast.walk(f.tree)
+                             if isinstance(n, ast.ClassDef)
+                             and n.name == info.cls), None)
+            if cls_node is not None:
+                for node in ast.walk(cls_node):
+                    if isinstance(node, ast.Attribute) and \
+                            isinstance(node.value, ast.Name) and \
+                            node.value.id == "self":
+                        present.add(node.attr)
+        else:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Name):
+                    present.add(node.id)
+        for guard in sorted(info.guards - present):
+            findings.append(Finding(
+                PASS_NAME, "lock-annotation", f.path, info.line,
+                f"lock {info.attr!r} declares guard {guard!r} but "
+                f"{'class ' + info.cls if info.cls else 'the module'} "
+                f"never references it (typo?)"))
+    return list(locks.values()), findings
+
+
+def _with_lock_attrs(item: ast.withitem, cls_locks: "set[str]",
+                     mod_locks: "set[str]") -> Optional[str]:
+    """The annotated lock a `with` item acquires, or None."""
+    expr = item.context_expr
+    name = dotted_name(expr)
+    if name.startswith("self.") and name[5:] in cls_locks:
+        return name[5:]
+    if name in mod_locks:
+        return name
+    return None
+
+
+class _Mutation:
+    __slots__ = ("name", "is_self", "line", "verb")
+
+    def __init__(self, name, is_self, line, verb):
+        self.name = name
+        self.is_self = is_self
+        self.line = line
+        self.verb = verb
+
+
+def _node_mutations(node: ast.AST):
+    """Mutations attributable to THIS node alone (no recursion):
+    assignment/augassign/del of `self.x` / `x` (incl. subscripts), or a
+    mutator-method call on one.  The scope walker visits every node, so
+    per-node attribution covers mutator calls buried anywhere (return
+    values, branch conditions, comprehensions) without double counting."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = [(t, "assigned") for t in node.targets]
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [(node.target, "assigned")]
+    elif isinstance(node, ast.Delete):
+        targets = [(t, "deleted") for t in node.targets]
+    elif isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            targets = [(fn.value, f"mutated via .{fn.attr}()")]
+    for target, verb in targets:
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            yield _Mutation(target.attr, True, node.lineno, verb)
+        elif isinstance(target, ast.Name):
+            yield _Mutation(target.id, False, node.lineno, verb)
+
+
+def _mutations(node: ast.AST):
+    """Every state mutation anywhere in a subtree."""
+    for child in ast.walk(node):
+        yield from _node_mutations(child)
+
+
+def _check_function(f: SourceFile, cls: Optional[str],
+                    fn: ast.AST, locks: "list[LockInfo]",
+                    findings: "list[Finding]") -> None:
+    cls_lock_attrs = {l.attr for l in locks if l.cls == cls}
+    mod_lock_names = {l.attr for l in locks if l.cls is None}
+    guard_map: dict[tuple[str, bool], list[LockInfo]] = {}
+    for lock in locks:
+        for guarded in lock.guards:
+            if lock.cls is None:
+                guard_map.setdefault((guarded, False), []).append(lock)
+            elif lock.cls == cls:
+                guard_map.setdefault((guarded, True), []).append(lock)
+    if not guard_map:
+        return
+
+    held: list[str] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return      # nested defs: separate dynamic scope, skip
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = [
+                a for a in (_with_lock_attrs(i, cls_lock_attrs,
+                                             mod_lock_names)
+                            for i in node.items) if a is not None]
+            held.extend(acquired)
+            for stmt in node.body:
+                visit(stmt)
+            del held[len(held) - len(acquired):len(held)]
+            # with-item expressions themselves can contain mutations
+            # (their subtrees are NOT re-visited below).
+            for item in node.items:
+                check(_mutations(item.context_expr))
+            return
+        # One node's OWN mutations only — children are visited next, so
+        # mutator calls buried in return/if/for heads are still reached
+        # (their Call node is visited itself), without double-counting.
+        check(_node_mutations(node))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    def check(mutations) -> None:
+        for mut in mutations:
+            for lock in guard_map.get((mut.name, mut.is_self), ()):
+                if lock.attr in held:
+                    continue
+                if f.waived("lock-guard", mut.line):
+                    continue
+                owner = "self." if mut.is_self else ""
+                findings.append(Finding(
+                    PASS_NAME, "lock-guard", f.path, mut.line,
+                    f"{owner}{mut.name} is {mut.verb} outside "
+                    f"`with {'self.' if lock.cls else ''}{lock.attr}` "
+                    f"(declared `# guards:` at "
+                    f"{f.path}:{lock.line})"))
+
+    for stmt in fn.body:
+        visit(stmt)
+
+
+def check_discipline(f: SourceFile, locks: "list[LockInfo]",
+                     findings: "list[Finding]") -> None:
+    for cls, fn in walk_functions(f.tree):
+        if fn.name == "__init__" or fn.name.endswith("_locked"):
+            # Construction races with nobody; `_locked` names document
+            # "caller already holds the lock".
+            continue
+        if f.function_waived("lock-guard", fn):
+            continue
+        _check_function(f, cls, fn, locks, findings)
+
+
+# -- lock-acquisition-order graph ----------------------------------------------
+
+
+def _resolve_callee(call: ast.Call, path: str,
+                    cls: Optional[str]) -> "Optional[tuple]":
+    """(path, cls, method) key of a call target we can resolve: a
+    self-method, a same-file module function, or a registered singleton
+    accessor (`get_x().method(...)`)."""
+    fnode = call.func
+    if isinstance(fnode, ast.Attribute) and \
+            isinstance(fnode.value, ast.Call):
+        target = ACCESSORS.get(dotted_name(fnode.value.func))
+        if target is not None:
+            return (target[0], target[1], fnode.attr)
+    name = dotted_name(fnode)
+    if name.startswith("self.") and "." not in name[5:]:
+        return (path, cls, name[5:])
+    if name and "." not in name:
+        return (path, None, name)
+    return None
+
+
+def _direct_acquisitions(fn: ast.AST, cls_locks: "set[str]",
+                         mod_locks: "set[str]"):
+    """(lock_attr, line) for every with-acquisition anywhere in fn."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                attr = _with_lock_attrs(item, cls_locks, mod_locks)
+                if attr is not None:
+                    yield attr, node.lineno
+
+
+def build_order_graph(files: "list[SourceFile]",
+                      locks_by_file: "dict[str, list[LockInfo]]"):
+    """Edges A→B: lock B acquired while A is held — from syntactic
+    nesting, plus ONE level of call propagation (self-methods and
+    module functions in the same file, and the ACCESSORS singletons)."""
+    # (path, cls, fn_name) -> [(lock_node_id, line)]; closure over
+    # same-class self-calls so `get_x().outer()` sees inner locks too.
+    fn_locks: dict[tuple, list] = {}
+    fn_calls: dict[tuple, list] = {}
+    for f in files:
+        locks = locks_by_file.get(f.path, [])
+        for cls, fn in walk_functions(f.tree):
+            cls_lock_attrs = {l.attr for l in locks if l.cls == cls}
+            mod_lock_names = {l.attr for l in locks if l.cls is None}
+            key = (f.path, cls, fn.name)
+            acquired = []
+            for attr, line in _direct_acquisitions(
+                    fn, cls_lock_attrs, mod_lock_names):
+                lock = next(l for l in locks
+                            if l.attr == attr and
+                            (l.cls == cls or l.cls is None))
+                acquired.append((lock.node_id, line))
+            fn_locks[key] = acquired
+            fn_calls[key] = [
+                callee for callee in
+                (_resolve_callee(c, f.path, cls)
+                 for c in ast.walk(fn) if isinstance(c, ast.Call))
+                if callee is not None]
+
+    # Fixpoint: a function's lock set includes its callees' (bounded).
+    closure: dict[tuple, set] = {k: {l for l, _ in v}
+                                 for k, v in fn_locks.items()}
+    for _ in range(4):
+        changed = False
+        for key, calls in fn_calls.items():
+            mine = closure[key]
+            before = len(mine)
+            for callee in calls:
+                mine |= closure.get(callee, set())
+            changed |= len(mine) != before
+        if not changed:
+            break
+
+    edges: dict[tuple, tuple] = {}    # (A, B) -> (path, line)
+    for f in files:
+        locks = locks_by_file.get(f.path, [])
+        for cls, fn in walk_functions(f.tree):
+            cls_lock_attrs = {l.attr for l in locks if l.cls == cls}
+            mod_lock_names = {l.attr for l in locks if l.cls is None}
+
+            def lock_id(attr: str) -> str:
+                return next(l.node_id for l in locks
+                            if l.attr == attr and
+                            (l.cls == cls or l.cls is None))
+
+            held: list[str] = []
+
+            def visit(node: ast.AST) -> None:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)) \
+                        and node is not fn:
+                    return
+                acquired: list[str] = []
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        attr = _with_lock_attrs(item, cls_lock_attrs,
+                                                mod_lock_names)
+                        if attr is None:
+                            continue
+                        nid = lock_id(attr)
+                        for h in held:
+                            if h != nid:
+                                edges.setdefault((h, nid),
+                                                 (f.path, node.lineno))
+                        acquired.append(nid)
+                        held.append(nid)
+                elif isinstance(node, ast.Call) and held:
+                    callee = _resolve_callee(node, f.path, cls)
+                    for nid in closure.get(callee, ()) if callee else ():
+                        for h in held:
+                            if h != nid:
+                                edges.setdefault((h, nid),
+                                                 (f.path, node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                del held[len(held) - len(acquired):len(held)]
+
+            visit(fn)
+    return edges
+
+
+def find_cycles(edges: "dict[tuple, tuple]") -> "list[list[str]]":
+    graph: dict[str, list[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    cycles: list[list[str]] = []
+    seen_cycles: set = set()
+    color: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(node: str) -> None:
+        color[node] = 1
+        stack.append(node)
+        for nxt in graph[node]:
+            if color.get(nxt, 0) == 0:
+                dfs(nxt)
+            elif color.get(nxt) == 1:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cycle)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cycle)
+        stack.pop()
+        color[node] = 2
+
+    for node in sorted(graph):
+        if color.get(node, 0) == 0:
+            dfs(node)
+    return cycles
+
+
+def order_graph_snapshot(files: "list[SourceFile]") -> dict:
+    """The acquisition-order graph as plain data (`yt analyze` --json
+    consumers + tests)."""
+    locks_by_file: dict[str, list[LockInfo]] = {}
+    for f in files:
+        locks, _ = collect_locks(f)
+        if locks:
+            locks_by_file[f.path] = locks
+    edges = build_order_graph(files, locks_by_file)
+    return {
+        "locks": sorted(l.node_id for ls in locks_by_file.values()
+                        for l in ls),
+        "edges": sorted([a, b, f"{p}:{line}"]
+                        for (a, b), (p, line) in edges.items()),
+        "cycles": find_cycles(edges),
+    }
+
+
+def run(files: "list[SourceFile]") -> "list[Finding]":
+    findings: list[Finding] = []
+    locks_by_file: dict[str, list[LockInfo]] = {}
+    for f in files:
+        locks, annotation_findings = collect_locks(f)
+        findings.extend(annotation_findings)
+        if locks:
+            locks_by_file[f.path] = locks
+            check_discipline(f, locks, findings)
+    edges = build_order_graph(files, locks_by_file)
+    for cycle in find_cycles(edges):
+        first_edge = (cycle[0], cycle[1])
+        path, line = edges.get(first_edge, (cycle[0].split("::")[0], 1))
+        findings.append(Finding(
+            PASS_NAME, "lock-order", path, line,
+            "lock-acquisition-order cycle (potential deadlock): "
+            + " -> ".join(cycle)))
+    return findings

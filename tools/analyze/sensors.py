@@ -1,0 +1,48 @@
+"""Sensor-catalog pass: PR 6's `tools/check_sensor_catalog.py` folded
+into the analyzer framework (fifth pass), so `yt analyze` is the ONE
+static-analysis entry point.  The standalone script keeps working — this
+module adapts its `check()` output into the shared finding model."""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+from tools.analyze.core import Finding, SourceFile
+
+PASS_NAME = "sensors"
+
+_LINE_RE = re.compile(r"^(?P<rel>[^:]+):(?P<line>\d+): (?P<msg>.*)$")
+
+
+def _load_checker():
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "check_sensor_catalog.py")
+    spec = importlib.util.spec_from_file_location("check_sensor_catalog",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run(files: "list[SourceFile]",
+        root: Optional[str] = None) -> "list[Finding]":
+    if root is None:
+        return []     # fixture runs carry no catalog; repo runs pass root
+    checker = _load_checker()
+    findings: list[Finding] = []
+    for error in checker.check(root):
+        match = _LINE_RE.match(error)
+        if match:
+            findings.append(Finding(
+                PASS_NAME, "sensor-catalog",
+                "ytsaurus_tpu/" + match.group("rel").replace(os.sep, "/"),
+                int(match.group("line")), match.group("msg")))
+        else:
+            findings.append(Finding(
+                PASS_NAME, "sensor-catalog", "tools/sensor_catalog.json",
+                1, error))
+    return findings

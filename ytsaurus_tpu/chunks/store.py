@@ -42,6 +42,9 @@ _FP_DECODE = failpoints.register_site(
 _FP_PART_READ = failpoints.register_site(
     "chunks.erasure.part_read",
     error=lambda s: OSError(f"injected part loss at {s}"))
+_FP_REMOVE = failpoints.register_site(
+    "chunks.store.remove",
+    error=lambda s: OSError(f"injected remove failure at {s}"))
 
 
 def new_chunk_id() -> str:
@@ -237,6 +240,7 @@ class FsChunkStore:
             # every decode failure means the stored bytes are bad.
             return False
 
+    # analyze: allow(failpoint): enumeration helper — read faults inject at chunks.store.read / chunks.erasure.part_read
     def _chunk_paths(self, chunk_id: str) -> "list[str]":
         """Every file that can belong to this chunk (blob, erasure meta
         + parts) — THE enumeration shared by remove and quarantine, so a
@@ -259,6 +263,7 @@ class FsChunkStore:
                          for i in range(total))
         return paths
 
+    # analyze: allow(failpoint): per-file os.replace already tolerates races; the scrub is DRIVEN by the decode failpoints
     def quarantine_chunk(self, chunk_id: str) -> None:
         """Move a corrupt chunk's files aside (`.quarantine` suffix) so
         the store stops advertising it while the bytes stay on disk for
@@ -270,6 +275,7 @@ class FsChunkStore:
             except FileNotFoundError:
                 continue            # raced with remove/another scrub
 
+    # analyze: allow(failpoint): metadata peek on the replicate path; part faults inject at chunks.erasure.part_read
     def erasure_codec_of(self, chunk_id: str) -> Optional[str]:
         """Codec name when the chunk is stored erasure-coded, else None
         (lets the replicator preserve the encoding on the target)."""
@@ -283,11 +289,23 @@ class FsChunkStore:
         return codec.decode() if isinstance(codec, bytes) else codec
 
     def remove_chunk(self, chunk_id: str) -> None:
+        """Dispose a chunk's files.  Removal is ADVISORY GC: flush,
+        compaction, resharding, and intermediate-cleanup all call this
+        on their success path, so a disk error here must never fail the
+        operation that already committed — a failed unlink leaves a
+        garbage file for the next sweep (the `chunks.store.remove`
+        failpoint injects exactly that, fired by the chaos soak)."""
+        try:
+            _FP_REMOVE.hit()
+        except OSError:
+            return
         for path in self._chunk_paths(chunk_id):
             try:
                 os.unlink(path)
             except FileNotFoundError:
                 pass
+            except OSError:
+                continue        # garbage file stays; next GC retries
 
     def list_chunks(self) -> list[str]:
         out = set()

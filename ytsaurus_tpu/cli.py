@@ -325,6 +325,29 @@ def build_parser() -> argparse.ArgumentParser:
         (("--json",), {"action": "store_true",
                        "help": "raw report instead of the pretty "
                                "rendering"}))
+    cmd("analyze",
+        # No `choices` here: the pass registry lives in tools/analyze
+        # (PASSES); the driver validates, so a new pass needs no CLI
+        # lockstep edit.
+        (("--pass",), {"dest": "passes", "action": "append",
+                       "default": None,
+                       "help": "run only this pass (repeatable; "
+                               "default: all — locks, jax, coverage, "
+                               "errors, sensors)"}),
+        (("--json",), {"action": "store_true",
+                       "help": "machine-readable findings + ratchet "
+                               "verdict + lock-order graph"}),
+        (("--update-baseline",), {"action": "store_true",
+                                  "help": "rewrite tools/analyze/"
+                                          "baseline.json to the current "
+                                          "counts (tighten the ratchet "
+                                          "AFTER fixing findings)"}),
+        (("--no-baseline",), {"action": "store_true",
+                              "help": "report raw findings instead of "
+                                      "the ratchet verdict"}),
+        (("--analyze-root",), {"default": None,
+                               "help": "repo root to analyze (default: "
+                                       "the installed tree)"}))
     cmd("compile-cache", (("action",), {"choices": ["top"]}),
         (("--limit",), {"type": int, "default": 20}),
         (("--sort",), {"default": "compile_seconds",
@@ -388,9 +411,45 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _run_analyze(a) -> int:
+    """`yt analyze`: the static-analysis suite (tools/analyze), run
+    OFFLINE — no proxy, no cluster, no jax import.  The analyzer is
+    loaded from the repo checkout next to this package."""
+    import importlib.util
+    repo = a.analyze_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    driver = os.path.join(repo, "tools", "analyze", "__main__.py")
+    if not os.path.exists(driver):
+        print(f"error: analyzer not found at {driver} (run from a "
+              f"repo checkout, or pass --analyze-root)", file=sys.stderr)
+        return 2
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    spec = importlib.util.spec_from_file_location("yt_analyze_main",
+                                                  driver)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    argv = ["--root", repo]
+    for name in a.passes or []:
+        argv += ["--pass", name]
+    if a.json:
+        argv.append("--json")
+    if a.update_baseline:
+        argv.append("--update-baseline")
+    if a.no_baseline:
+        argv.append("--no-baseline")
+    return mod.main(argv)
+
+
+# Subcommands that run locally, without a cluster connection.
+_OFFLINE_COMMANDS = {"analyze"}
+
+
 def run(argv: "list[str] | None" = None,
         client=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.subcommand in _OFFLINE_COMMANDS:
+        return _run_analyze(args)
     caller_owns_client = client is not None
     if client is None:
         if not args.proxy:

@@ -86,6 +86,7 @@ def _host_planes(chunk: ColumnarChunk) -> dict:
                 f"external sort supports numeric columns only; {name!r} "
                 f"is string/any (route those through the mesh shuffle "
                 f"path or sort_chunks)", code=EErrorCode.QueryUnsupported)
+        # analyze: allow(host-sync): external sort merges on host — one materialization per block at ingest
         out[name] = (np.asarray(col.data[:n]), np.asarray(col.valid[:n]))
     return out
 
@@ -100,6 +101,7 @@ def _sample_keys(planes: dict, key_names: Sequence[str],
     rows = []
     for i in idx:
         rows.append(tuple(
+            # analyze: allow(host-sync): planes are host numpy here (materialized at ingest); .item() is a scalar read
             (bool(planes[name][1][i]), planes[name][0][i].item())
             for name in key_names))
     return rows
@@ -132,6 +134,7 @@ def _partition_block(planes: dict, key_names: Sequence[str],
                          dtype=np.int8)
         pivot_planes.append(
             (jnp.asarray(ranks),
+             # analyze: allow(host-sync): dtype probe of a host numpy plane, no transfer
              jnp.asarray(vals.astype(np.asarray(planes[name][0]).dtype))))
     row_planes = [_encode_key_plane(dev[name][0], dev[name][1])
                   for name in key_names]
@@ -140,10 +143,12 @@ def _partition_block(planes: dict, key_names: Sequence[str],
         pid = (n_ranges - 1) - pid
     pid = jnp.where(live, pid, n_ranges)        # padding → tail
     order = jnp.argsort(pid, stable=True)
+    # analyze: allow(host-sync): range boundaries are a host decision — one counts transfer per block
     counts = np.asarray(
         jnp.bincount(pid, length=n_ranges + 1))[:n_ranges]
     out: list[dict] = []
     starts = np.concatenate([[0], np.cumsum(counts)])
+    # analyze: allow(host-sync): partitioned blocks spill to host files — the materialization IS the operation
     permuted = {name: (np.asarray(d[order]), np.asarray(v[order]))
                 for name, (d, v) in dev.items()}
     for r in range(n_ranges):

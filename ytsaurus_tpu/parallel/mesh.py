@@ -29,6 +29,7 @@ def make_mesh(n_devices: Optional[int] = None,
             raise ValueError(
                 f"Need {n_devices} devices, have {len(devices)}")
         devices = devices[:n_devices]
+    # analyze: allow(host-sync): device HANDLES, not array data — no transfer
     return Mesh(np.asarray(devices).reshape(len(devices)), (axis,))
 
 

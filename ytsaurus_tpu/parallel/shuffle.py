@@ -108,10 +108,12 @@ def _sample_pivots(table: ShardedTable, key_names: list[str],
     key_data = {}
     for name in key_names:
         col = table.columns[name]
+        # analyze: allow(host-sync): pivot sampling reads O(shards*samples) gathered keys once per sort
         key_data[name] = (np.asarray(col.data[idx]), np.asarray(col.valid[idx]))
     sample_rows: list[tuple] = []
     for i in range(len(idx)):
         sample_rows.append(tuple(
+            # analyze: allow(host-sync): key_data is host numpy (gathered above); .item() is a scalar read
             (bool(key_data[name][1][i]), key_data[name][0][i].item())
             for name in key_names))
     return quantile_pivots(sample_rows, n, len(key_names))
@@ -217,6 +219,7 @@ def _sort_table_sharded(table: ShardedTable, key_names: "list[str]",
             in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
             out_specs=P(SHARD_AXIS), check_vma=False)(
                 key_planes_global, table.row_valid)
+        # analyze: allow(host-sync): receive quotas are a host decision — one transfer-matrix read per shuffle
         counts_np = np.asarray(counts)          # (n_src, n_dst)
 
     # Skew-robust sizing (ref: the partition tree's multi-level splitting,
@@ -334,6 +337,7 @@ def _sort_table_sharded(table: ShardedTable, key_names: "list[str]",
             columns_global, key_planes_global, table.row_valid,
             prefix_sharded)
 
+    # analyze: allow(host-sync): conservation check — one stacked counts transfer per shuffle
     out_counts_np = [int(c) for c in np.asarray(out_counts)]
     lost = table.total_rows - sum(out_counts_np)
     if lost != 0:

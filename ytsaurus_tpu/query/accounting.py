@@ -65,7 +65,7 @@ class ResourceAccountant:
     per-query/per-flush cost the `telemetry_overhead` bench bounds."""
 
     def __init__(self, registry: Optional[ProfilerRegistry] = None):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # guards: _usage
         self._usage: dict[tuple[str, str], UsageRecord] = {}
         self._pool_sensors = PoolSensorCache(
             "/accounting/usage", USAGE_FIELDS, registry=registry)
@@ -168,7 +168,7 @@ class ResourceAccountant:
 
 
 _global_accountant: Optional[ResourceAccountant] = None
-_lock = threading.Lock()
+_lock = threading.Lock()   # guards: _global_accountant
 
 
 def get_accountant() -> ResourceAccountant:

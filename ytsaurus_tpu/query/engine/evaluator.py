@@ -64,6 +64,7 @@ class CompileObservatory:
     SHAPE_SET_CAP = 512
 
     def __init__(self):
+        # guards: _fps, _artifacts, _evicted, hits_n, misses_n, evictions_n
         self._lock = threading.Lock()
         self._fps: dict[str, dict] = {}
         self._artifacts: deque = deque(maxlen=64)
@@ -74,7 +75,7 @@ class CompileObservatory:
         self.misses_n = 0
         self.evictions_n = 0
 
-    def _entry(self, fp: str) -> dict:
+    def _entry_locked(self, fp: str) -> dict:
         entry = self._fps.get(fp)
         if entry is None:
             entry = self._fps[fp] = {
@@ -95,14 +96,14 @@ class CompileObservatory:
     def observe_hit(self, fp: str) -> None:
         with self._lock:
             self.hits_n += 1
-            self._entry(fp)["hits"] += 1
+            self._entry_locked(fp)["hits"] += 1
 
     def observe_miss(self, fp: str, key: tuple, cause: str,
                      seconds: float) -> None:
         shape_sig = key[1:]
         with self._lock:
             self.misses_n += 1
-            entry = self._entry(fp)
+            entry = self._entry_locked(fp)
             entry["compiles"] += 1
             entry["compile_seconds"] += seconds
             entry["last_miss_cause"] = cause
@@ -282,7 +283,7 @@ class Evaluator:
         # an unlocked move_to_end could KeyError against a concurrent
         # eviction (compiles themselves run outside the lock).
         self._cache: OrderedDict = OrderedDict()
-        self._cache_lock = threading.Lock()
+        self._cache_lock = threading.Lock()   # guards: _cache
         self._join_cache: dict = {}
 
     def cache_size(self) -> int:

@@ -221,6 +221,7 @@ def execute_join(chunk: ColumnarChunk, combined_schema: TableSchema,
         host_values = None
         if col.host_values is not None:
             if self_row_np is None:
+                # analyze: allow(host-sync): string/any columns live on host — the gather index must cross once
                 self_row_np = np.asarray(self_row)
             host_values = _gather_host(col, self_row_np, out_cap)
         columns[name] = replace(col, data=data, valid=valid,
@@ -233,6 +234,7 @@ def execute_join(chunk: ColumnarChunk, combined_schema: TableSchema,
         host_values = None
         if fcol.host_values is not None:
             if foreign_row_np is None:
+                # analyze: allow(host-sync): string/any columns live on host — the gather index must cross once
                 foreign_row_np = np.asarray(foreign_row)
             host_values = _gather_host(fcol, foreign_row_np, out_cap)
         columns[flat] = replace(fcol, data=data, valid=valid,

@@ -186,7 +186,7 @@ class AdmissionController:
 
     def __init__(self, config: ServingConfig):
         self.config = config
-        self._cond = threading.Condition()
+        self._cond = threading.Condition()  # guards: _pools, _hold_ewma
         serving_profiler = Profiler("/serving")
         profiler = serving_profiler.with_prefix("/admission")
         pools = config.pools or {config.default_pool: 1.0}
@@ -399,6 +399,7 @@ class LookupBatcher:
         # flushes waiting on reads that can never start.
         self._flush_executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="serving-flush")
+        # guards: _batches, _contexts, _flusher, requests_n, batches_n, batched_keys_n
         self._cond = threading.Condition()
         self._batches: "dict[tuple, _Batch]" = {}
         self._contexts: dict[str, _PathContext] = {}
@@ -418,7 +419,12 @@ class LookupBatcher:
                                            bounds=_LATENCY_BOUNDS)
 
     def _context(self, client, path: str) -> _PathContext:
-        ctx = self._contexts.get(path)
+        # The memo is shared by caller threads and the flusher: reads
+        # and writes both go under the cond (the lock pass flagged the
+        # bare-dict mutation; a clear() racing a get could hand a
+        # half-installed context to a flush).
+        with self._cond:
+            ctx = self._contexts.get(path)
         if ctx is not None and \
                 client.cluster.tablets.get(ctx.node_id) is ctx.tablets:
             return ctx
@@ -430,9 +436,10 @@ class LookupBatcher:
             # Shape-bucketing floor for the tablets' batched chunk
             # probes (tablet._pad_needles pow2 buckets).
             tablet.probe_bucket_min = self.config.min_bucket
-        if len(self._contexts) > 256:
-            self._contexts.clear()
-        self._contexts[path] = ctx
+        with self._cond:
+            if len(self._contexts) > 256:
+                self._contexts.clear()
+            self._contexts[path] = ctx
         return ctx
 
     def lookup(self, client, path: str, keys: Sequence[tuple],
@@ -447,8 +454,6 @@ class LookupBatcher:
                        token: CancellationToken,
                        pool: Optional[str] = None):
         t0 = time.monotonic()
-        self.requests_n += 1
-        self.requests.increment()
         ctx = self._context(client, path)
         if ctx.has_computed:
             keys = client._fill_computed_keys(
@@ -456,6 +461,13 @@ class LookupBatcher:
         nkeys = [ctx.normalize(tuple(k)) for k in keys]
         bkey = (path, timestamp)
         with self._cond:
+            # Tally under the cond with the enqueue (the lock pass
+            # flagged the bare `+= 1`: two racing requests could lose
+            # an increment and snapshot() would under-report).  The
+            # profiler mirror increments HERE too, so the /metrics
+            # sensor and snapshot() count the same events — a request
+            # that fails context resolution above counts in neither.
+            self.requests_n += 1
             batch = self._batches.get(bkey)
             if batch is None:
                 batch = self._batches[bkey] = _Batch(token, client)
@@ -463,6 +475,7 @@ class LookupBatcher:
                 batch.join(token)
             batch.key_lists.append(nkeys)
             batch.users.append(token.user)
+            self.requests.increment()
             if self._flusher is None or not self._flusher.is_alive():
                 self._flusher = threading.Thread(
                     target=self._flusher_loop, daemon=True,
@@ -580,8 +593,11 @@ class LookupBatcher:
         union = dict.fromkeys(
             nk for ks in batch.key_lists for nk in ks)
         span.add_tag("keys", len(union))
-        self.batches_n += 1
-        self.batched_keys_n += len(union)
+        with self._cond:
+            # Concurrent flushes race these tallies (4-worker flush
+            # pool); the profiler counters already lock internally.
+            self.batches_n += 1
+            self.batched_keys_n += len(union)
         self.batches.increment()
         self.batched_keys.increment(len(union))
         self.batch_size_hist.record(len(union))

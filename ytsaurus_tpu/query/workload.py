@@ -239,6 +239,7 @@ class WorkloadLog:
 
     def __init__(self, config=None):
         self._config = config
+        # guards: _records, _fingerprints, recorded_n, sampled_out_n, fingerprints_dropped_n
         self._lock = threading.Lock()
         # Disk appends take their own lock: the in-memory fold must
         # never queue behind rotation/write I/O of the on-disk tier.
@@ -266,7 +267,11 @@ class WorkloadLog:
         pre-sample pass presampled=True to observe() so a record is
         never drawn twice."""
         if cfg.sample_rate < 1.0 and random.random() >= cfg.sample_rate:
-            self.sampled_out_n += 1
+            # Callers draw OUTSIDE the record lock; the tally still
+            # needs it (the lock pass flagged the bare increment —
+            # concurrent sampled-out draws would lose counts).
+            with self._lock:
+                self.sampled_out_n += 1
             self._dropped.increment()
             return False
         return True
@@ -283,13 +288,13 @@ class WorkloadLog:
                 self._records = deque(self._records, maxlen=cfg.capacity)
             self._records.append(record)
             self.recorded_n += 1
-            self._fold_fingerprint(record, cfg)
+            self._fold_fingerprint_locked(record, cfg)
         self._recorded.increment()
         if cfg.log_dir:
             self._append_disk(record, cfg)
         return True
 
-    def _fold_fingerprint(self, record: WorkloadRecord, cfg) -> None:
+    def _fold_fingerprint_locked(self, record: WorkloadRecord, cfg) -> None:
         entry = self._fingerprints.get(record.fingerprint)
         if entry is None:
             if len(self._fingerprints) >= cfg.fingerprint_capacity:
@@ -746,7 +751,7 @@ def replay(client, records: Sequence[WorkloadRecord],
 # -- globals -------------------------------------------------------------------
 
 _global_log: Optional[WorkloadLog] = None
-_log_lock = threading.Lock()
+_log_lock = threading.Lock()     # guards: _global_log
 
 
 def get_workload_log() -> WorkloadLog:

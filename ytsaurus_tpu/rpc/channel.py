@@ -33,8 +33,14 @@ _FP_SEND = failpoints.register_site(
     "rpc.channel.send",
     error=lambda s: YtError(f"injected transport failure at {s}",
                             code=EErrorCode.TransportError))
+# Raises ConnectionError, NOT YtError: the injected fault must walk the
+# same never-dispatched path a real refused connect takes (the caller
+# wraps it with dispatched=False, so even non-idempotent calls resend).
+_FP_CONNECT = failpoints.register_site(
+    "rpc.channel.connect",
+    error=lambda s: ConnectionError(f"injected connect failure at {s}"))
 
-_loop_lock = threading.Lock()
+_loop_lock = threading.Lock()   # guards: _loop
 _loop: asyncio.AbstractEventLoop | None = None
 
 
@@ -70,13 +76,14 @@ class Channel:
         self._host, self._port = host, int(port)
         self.timeout = timeout
         self._rid = itertools.count(1)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # guards: _conn
         self._connect_lock: asyncio.Lock | None = None
         self._conn: _ConnState | None = None
 
     # -- wire ------------------------------------------------------------------
 
     async def _connect(self) -> "_ConnState":
+        _FP_CONNECT.hit()
         reader, writer = await asyncio.open_connection(self._host, self._port)
         state = _ConnState(reader=reader, writer=writer)
 

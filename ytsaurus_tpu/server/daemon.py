@@ -22,6 +22,7 @@ import threading
 import time
 
 
+# analyze: allow(failpoint): bootstrap plumbing — a failed port write kills the spawn, surfaced by the cluster-start timeout
 def _write_port_file(root: str, role: str, port: int) -> None:
     path = os.path.join(root, f"{role}.port")
     tmp = path + ".tmp"
@@ -30,6 +31,7 @@ def _write_port_file(root: str, role: str, port: int) -> None:
     os.replace(tmp, path)
 
 
+# analyze: allow(failpoint): daemon entry point — its I/O is bootstrap plumbing; fault sites live in the planes it hosts
 def run_primary(root: str, port: int, replication_factor: int = 2,
                 journal_nodes: int = 3,
                 bootstrap_timeout: float = 60.0,
@@ -618,6 +620,7 @@ def run_node(root: str, port: int, primary_address: str,
     beat(primaries[0])
 
 
+# analyze: allow(failpoint): daemon entry point — bootstrap plumbing; clock-quorum faults are injected via journal sites
 def run_clock(root: str, port: int, journals: "str | None", index: int,
               lease_ttl: float,
               journals_file: "str | None" = None) -> None:

@@ -50,7 +50,7 @@ class DiscoveryTracker:
     def __init__(self, member_ttl: float = 15.0):
         self.member_ttl = member_ttl
         self._groups: dict[str, dict[str, dict]] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # guards: _groups
 
     @staticmethod
     def _check_group(group: str) -> str:

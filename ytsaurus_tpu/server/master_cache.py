@@ -117,6 +117,7 @@ def _is_mutating(command: str) -> bool:
     return bool(descriptor and descriptor.is_mutating)
 
 
+# analyze: allow(failpoint): daemon entry point — bootstrap plumbing; cache-miss faults inject at rpc.channel sites
 def run_master_cache(root: str, port: int, primary_address: str,
                      ttl: float = 2.0) -> None:
     """Daemon entry (--role master_cache)."""

@@ -16,7 +16,15 @@ from typing import Optional
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc import Service, rpc_method
 from ytsaurus_tpu.rpc.wire import wire_text as _text
+from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.logging import get_logger
+
+# Injects a disk fault into the data node's DURABLE-state publishes
+# (journal membership + replicated snapshots, both tmp+fsync+rename):
+# the writer sees a failed put and the quorum ladder must ride it out.
+_FP_STATE_WRITE = failpoints.register_site(
+    "server.state.write",
+    error=lambda s: OSError(f"injected state write failure at {s}"))
 
 logger = get_logger("server")
 
@@ -279,6 +287,7 @@ class DataNodeService(Service):
 
         from ytsaurus_tpu import yson
         name = self._check_name(_text(body["journal"]))
+        _FP_STATE_WRITE.hit()
         with self._journal_lock:
             self._check_writer(name, body.get("epoch"),
                                body.get("writer"))
@@ -295,6 +304,7 @@ class DataNodeService(Service):
             os.replace(tmp, path)
         return {}
 
+    # analyze: allow(failpoint): read side — a missing/torn file already reads as defaults; recovery is quorum-WAL tested
     @rpc_method()
     def journal_membership_get(self, body, attachments):
         import os
@@ -377,6 +387,7 @@ class DataNodeService(Service):
         return {"count": entry["count"], "initialized": True,
                 "last_epoch": entry["last_epoch"]}
 
+    # analyze: allow(failpoint): unlink of a journal already truncated by fence checks; append faults inject upstream
     @rpc_method(concurrency=1)
     def journal_reset(self, body, attachments):
         """Truncate a journal to empty (after a snapshot, or a divergence
@@ -400,6 +411,7 @@ class DataNodeService(Service):
     def snapshot_put(self, body, attachments):
         import os
         name = self._check_name(_text(body["name"]))
+        _FP_STATE_WRITE.hit()
         with self._journal_lock:
             self._check_writer(name, body.get("epoch"),
                                body.get("writer"))
@@ -416,6 +428,7 @@ class DataNodeService(Service):
         os.replace(tmp, path)
         return {}
 
+    # analyze: allow(failpoint): read side — a missing snapshot reads as seq=None; recovery is quorum-WAL tested
     @rpc_method()
     def snapshot_get(self, body, attachments):
         import os

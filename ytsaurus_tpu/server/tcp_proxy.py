@@ -25,6 +25,7 @@ logger = get_logger("tcp_proxy")
 
 
 class TcpProxy:
+    # analyze: allow(failpoint): backend connect failures already count as probe_failures and rotate; tcp-proxy routing tests cover it
     def __init__(self, backends: "Sequence[str]", host: str = "127.0.0.1",
                  port: int = 0, probe_timeout: float = 5.0):
         self.backends = list(backends)

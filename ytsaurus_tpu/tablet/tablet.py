@@ -47,7 +47,7 @@ _SNAP_BYTES = _snap_profiler.gauge("bytes_pinned")
 # admitted cohort's pool down to the tablet read, so per-tenant resource
 # accounting sees tablet-level consumption, not just gateway-level.
 _lookup_counters = PoolSensorCache("tablet/lookup", ("reads", "keys"))
-_snap_lock = threading.Lock()
+_snap_lock = threading.Lock()   # guards: _snap_bytes_pinned
 _snap_bytes_pinned = 0
 
 
@@ -121,6 +121,7 @@ class Tablet:
         self.mounted = True
         self.in_memory = False          # pin chunks in the cache when True
         self.flush_generation = 0
+        # guards: active_store, passive_stores, chunk_ids, flush_generation, _snapshot_cache, _host_planes, _row_cache, _row_cache_gen
         self._lock = threading.RLock()
         # Host numpy views of chunk planes: a real LRU (promote on hit,
         # capacity from TabletConfig.host_plane_cache_capacity).
@@ -207,7 +208,7 @@ class Tablet:
             for cid in self.chunk_ids:
                 ts = _chunk_last_timestamp(
                     self._decode(cid), self.schema, key,
-                    self._chunk_host_planes(cid))
+                    self._chunk_host_planes_locked(cid))
                 if ts is not None and (best is None or ts > best):
                     best = ts
             return best
@@ -341,7 +342,7 @@ class Tablet:
     def _decode(self, chunk_id: str) -> ColumnarChunk:
         return self.chunk_cache.get(chunk_id)
 
-    def _chunk_host_planes(self, chunk_id: str) -> dict:
+    def _chunk_host_planes_locked(self, chunk_id: str) -> dict:
         """numpy views of a chunk's planes (device->host once per chunk).
         LRU: hits promote (a hot chunk probed by every lookup batch must
         not be evicted because it was decoded first), capacity from
@@ -393,7 +394,7 @@ class Tablet:
                     return int(entry["max"])
             except (YtError, OSError):
                 pass
-        data, valid = self._chunk_host_planes(chunk_id)["$timestamp"]
+        data, valid = self._chunk_host_planes_locked(chunk_id)["$timestamp"]
         return int(data[valid].max()) if valid.any() else 0
 
     def _latest_ts_floor(self) -> int:
@@ -556,7 +557,7 @@ class Tablet:
                 for cid in self.chunk_ids:
                     for key, rows in _chunk_batch_key_rows(
                             self._decode(cid), self.schema, miss_list,
-                            self._chunk_host_planes(cid),
+                            self._chunk_host_planes_locked(cid),
                             bucket_min=self.probe_bucket_min).items():
                         chunk_rows.setdefault(key, []).extend(rows)
             for key in keys:
@@ -584,7 +585,7 @@ class Tablet:
                         for cid in self.chunk_ids:
                             versions.extend(_chunk_lookup_versions(
                                 self._decode(cid), self.schema, key,
-                                self._chunk_host_planes(cid)))
+                                self._chunk_host_planes_locked(cid)))
                     merged = _merge_versions(versions, timestamp)
                     if merged is None:
                         row = None

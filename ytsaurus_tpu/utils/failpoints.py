@@ -110,7 +110,7 @@ class _State:
 
 # The ONE global read on the disabled fast path.
 _STATE: Optional[_State] = None
-_LOCK = threading.Lock()
+_LOCK = threading.Lock()   # guards: _STATE, _SITES
 _SITES: "dict[str, FailpointSite]" = {}
 
 

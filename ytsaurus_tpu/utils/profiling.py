@@ -49,7 +49,7 @@ class Counter:
     kind = "counter"
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # guards: _value
         self._value = 0.0
 
     def increment(self, delta: float = 1.0) -> None:
@@ -102,6 +102,7 @@ class Summary:
     RESERVOIR_CAPACITY = 512
 
     def __init__(self):
+        # guards: count, sum, min, max, last, _reservoir
         self._lock = threading.Lock()
         self.count = 0
         self.sum = 0.0
@@ -153,7 +154,7 @@ class Histogram:
 
     def __init__(self, bounds=None):
         self.bounds = tuple(bounds or self.DEFAULT_BOUNDS)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # guards: buckets, count, sum
         self.buckets = [0] * (len(self.bounds) + 1)
         self.count = 0
         self.sum = 0.0
@@ -199,7 +200,7 @@ class ProfilerRegistry:
     """All sensors of one process, keyed by (name, frozen tags)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # guards: _sensors
         self._sensors: dict[tuple, object] = {}
 
     def _get(self, name: str, tags: dict, factory):
@@ -326,7 +327,7 @@ class MetricsHistory:
         self.coarse_every = max(coarse_every, 1)
         self.coarse_capacity = coarse_capacity
         self.sample_period = sample_period
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # guards: _series, samples_taken
         self._series: dict[tuple, _SeriesRing] = {}
         self.samples_taken = 0
 
@@ -495,7 +496,7 @@ class TelemetrySampler:
 
 _global_history: Optional[MetricsHistory] = None
 _global_sampler: Optional[TelemetrySampler] = None
-_history_lock = threading.Lock()
+_history_lock = threading.Lock()   # guards: _global_history, _global_sampler
 
 
 def get_history() -> MetricsHistory:

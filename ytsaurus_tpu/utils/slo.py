@@ -40,6 +40,7 @@ class SloTracker:
                  history: Optional[MetricsHistory] = None):
         self._config = config
         self._history = history
+        # guards: _active, _resolved, _last_eval
         self._lock = threading.Lock()
         self._active: dict[str, dict] = {}
         self._resolved: deque = deque(maxlen=self.RESOLVED_CAPACITY)
@@ -160,7 +161,7 @@ class SloTracker:
 
 
 _global_tracker: Optional[SloTracker] = None
-_tracker_lock = threading.Lock()
+_tracker_lock = threading.Lock()   # guards: _global_tracker
 
 
 def get_slo_tracker() -> SloTracker:

@@ -118,6 +118,7 @@ class SpanCollector:
 
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
+        # guards: _spans, _seq, _drained, _hists, capacity
         self._lock = threading.Lock()
         self._spans: list[SpanRecord] = []
         self._seq = 0                  # spans ever added
@@ -151,7 +152,12 @@ class SpanCollector:
                     "span_seconds",
                     bounds=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
                             0.1, 0.5, 1.0, 5.0, 30.0))
-            self._hists[span.name] = hist
+            # Install under the lock (the lock pass flagged the bare
+            # dict write): setdefault keeps the winner if two threads
+            # race the first span of a name — the registry already
+            # dedups the sensor, so both hists ARE the same object.
+            with self._lock:
+                hist = self._hists.setdefault(span.name, hist)
         hist.record(span.duration)
 
     def drain(self) -> list[SpanRecord]:
