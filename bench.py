@@ -33,6 +33,13 @@ config, printing the headline (TPC-H Q1, config 1) last:
           the live gateway; metric is the achieved replay throughput,
           p50/p99/p999 + steady-state compile-cache hit rate + slowest
           trace ids print on stderr
+  serving_steady  compile-once serving (ISSUE 10): replays a skewed-
+          literal parameterized mix three ways — pre-PR per-constant
+          fingerprints (baseline), auto-parameterized + persistent AOT
+          disk cache (asserts steady-state compile-cache hit rate
+          >=99%), and a restart-warm-start leg in a SECOND process on
+          the same artifact dir (asserts ~0 fresh compiles, disk hits
+          only); metric is the parameterized replay throughput
   telemetry_overhead  cluster telemetry plane (ISSUE 6): asserts the
           per-site sensor-recording cost ≲1µs and the per-query
           accounting fold ≲20µs, then runs the serving lookup shape
@@ -810,6 +817,194 @@ def bench_replay(n_rows, iters):
             best["elapsed_seconds"])
 
 
+def bench_serving_steady(n_rows, iters):
+    """Compile-once serving (ISSUE 10): three legs over one fresh-
+    constant parameterized mix (3 shapes x skewed draws over the FULL
+    key domain, so constants essentially never repeat — the
+    million-users `WHERE user_id = ?` traffic ROADMAP 1 names, which
+    the pre-PR per-constant fingerprints recompile on every query).
+
+      baseline   auto-parameterization OFF (the pre-PR discipline) on
+                 a 60-query slice — recorded so BENCH_NOTES shows what
+                 the fix buys (expected: every fresh constant is a
+                 fresh fingerprint, hit rate collapses);
+      steady     parameterization ON + persistent AOT disk cache, a
+                 60-query warmup then the full measured replay —
+                 acceptance: steady-state compile-cache hit rate >=99%
+                 and CompileObservatory shape-spectrum cardinality
+                 bounded (<= pow2 bucket count) despite ~240 distinct
+                 constants;
+      restart    a SECOND PROCESS builds the same table, points at the
+                 same artifact directory, replays the same capture —
+                 acceptance: ~0 fresh compiles (disk hits only), the
+                 rolling-restart warm start.
+
+    Metric is the parameterized leg's achieved replay throughput."""
+    import os as _os
+    import random
+    import subprocess as _subprocess
+    import tempfile
+
+    from ytsaurus_tpu import config as yt_config
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.query import workload as wl
+    from ytsaurus_tpu.schema import TableSchema
+
+    root = tempfile.mkdtemp(prefix="bench-serving-steady-")
+    aot_dir = _os.path.join(root, "aot")
+
+    def build_client(base):
+        client = connect(base)
+        schema = TableSchema.make(
+            [("k", "int64", "ascending"), ("g", "int64"),
+             ("v", "int64")], unique_keys=True)
+        client.create("table", "//bench/steady",
+                      attributes={"schema": schema, "dynamic": True,
+                                  "pivot_keys": [[n_rows // 2]]},
+                      recursive=True)
+        client.mount_table("//bench/steady")
+        for lo in range(0, n_rows, 50_000):
+            hi = min(lo + 50_000, n_rows)
+            client.insert_rows("//bench/steady",
+                               [{"k": i, "g": i % 97, "v": i * 3}
+                                for i in range(lo, hi)])
+        client.freeze_table("//bench/steady")
+        return client
+
+    client = build_client(root)
+    shapes = [
+        "k, v FROM [//bench/steady] WHERE k = {}",
+        "g, sum(v) AS s FROM [//bench/steady] WHERE v < {} GROUP BY g",
+        "k, v FROM [//bench/steady] WHERE k > {} "
+        "ORDER BY k LIMIT 10",
+    ]
+    # Fresh-constant mix: drawn over the whole key domain (Zipf-ish
+    # skew via synthesize_mix), so with n_rows >> count virtually every
+    # query carries a constant the fleet has never seen — the traffic
+    # shape that makes per-constant fingerprints recompile forever.
+    records = wl.synthesize_mix(shapes, count=240, distinct=n_rows,
+                                seed=11, interval=0.0)
+    capture_path = _os.path.join(root, "capture.json")
+    wl.write_capture(capture_path, records)
+    records = wl.load_capture(capture_path)
+
+    # Leg 0 — pre-PR baseline: per-constant fingerprints (60-query
+    # slice; every fresh constant compiles, so keep the burn bounded).
+    yt_config.set_compile_config(
+        yt_config.CompileConfig(parameterize=False))
+    base_report = wl.replay(client, records[:60], rate=400.0,
+                            max_workers=8)
+    base_cache = base_report["compile_cache"]
+
+    # Leg 1 — parameterized + persistent artifact tier (the metric).
+    # One warmup slice compiles the bounded program set (shape x pow2
+    # buckets); the measured replay then serves ~240 distinct constants
+    # from it.
+    yt_config.set_compile_config(yt_config.CompileConfig(
+        parameterize=True, disk_cache_dir=aot_dir))
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    obs = get_compile_observatory()
+    obs.reset()
+    wl.replay(client, records[:60], rate=400.0, max_workers=8)
+    best = None
+    times = []
+    while _iters_left(times, iters):
+        t0 = time.perf_counter()
+        report = wl.replay(client, records, rate=400.0, max_workers=8)
+        times.append(time.perf_counter() - t0)
+        if best is None or report["achieved_rate"] > \
+                best["achieved_rate"]:
+            best = report
+    cache = best["compile_cache"]
+    steady_rate = cache["steady_hit_rate"] or 0.0
+    assert best["ok"] == best["queries"], best
+    assert steady_rate >= 0.99, \
+        f"steady-state hit rate {steady_rate:.4f} < 0.99"
+    # Shape-spectrum acceptance: per fingerprint, the distinct
+    # (capacity, binding-shape) programs stay pow2-bounded — 240
+    # distinct constants must NOT widen the spectrum.
+    spectrum = {r["fingerprint"]: r["shape_count"] for r in obs.top(0)}
+    assert spectrum and max(spectrum.values()) <= 8, spectrum
+
+    # Leg 2 — restart warm start: a fresh PROCESS, same artifacts.
+    child_src = f"""
+import json, sys
+from ytsaurus_tpu import config as yt_config
+yt_config.set_compile_config(yt_config.CompileConfig(
+    parameterize=True, disk_cache_dir={aot_dir!r}))
+sys.argv = ["child"]
+import bench
+client = bench.bench_serving_steady_child({root!r}, {n_rows})
+"""
+    env = dict(_os.environ, JAX_PLATFORMS=_os.environ.get(
+        "JAX_PLATFORMS", "cpu"), BENCH_CHILD="1")
+    proc = _subprocess.run(
+        [sys.executable, "-c", child_src],
+        cwd=_os.path.dirname(_os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    child = json.loads(
+        [ln for ln in proc.stdout.splitlines()
+         if ln.startswith("{")][-1])
+    print(f"# serving_steady: baseline steady hit rate "
+          f"{(base_cache['steady_hit_rate'] or 0) * 100:.1f}% "
+          f"({base_cache['misses']} compiles) -> parameterized "
+          f"{steady_rate * 100:.1f}% ({cache['misses']} misses, "
+          f"{cache['fresh_compiles']} fresh); restart leg: "
+          f"{child['disk_hits']} disk hits, "
+          f"{child['fresh_compiles']} fresh compiles, hit rate "
+          f"{(child['hit_rate'] or 0) * 100:.1f}%; "
+          f"p99 {best['latency']['p99_ms']:.2f}ms",
+          file=sys.stderr)
+    assert child["fresh_compiles"] <= 1, child
+    assert child["disk_hits"] >= 1, child
+    return ("serving_steady_queries_per_sec", best["achieved_rate"],
+            best["elapsed_seconds"])
+
+
+def bench_serving_steady_child(parent_root, n_rows):
+    """Restart-warm-start leg of bench_serving_steady, run in a FRESH
+    process: rebuild the same table from the same row recipe, replay
+    the same capture against the same AOT artifact directory, report
+    the compile-cache split as one JSON line."""
+    import os as _os
+    import tempfile
+
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.query import workload as wl
+    from ytsaurus_tpu.schema import TableSchema
+
+    base = tempfile.mkdtemp(prefix="bench-steady-child-")
+    client = connect(base)
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("g", "int64"), ("v", "int64")],
+        unique_keys=True)
+    client.create("table", "//bench/steady",
+                  attributes={"schema": schema, "dynamic": True,
+                              "pivot_keys": [[n_rows // 2]]},
+                  recursive=True)
+    client.mount_table("//bench/steady")
+    for lo in range(0, n_rows, 50_000):
+        hi = min(lo + 50_000, n_rows)
+        client.insert_rows("//bench/steady",
+                           [{"k": i, "g": i % 97, "v": i * 3}
+                            for i in range(lo, hi)])
+    client.freeze_table("//bench/steady")
+    records = wl.load_capture(_os.path.join(parent_root,
+                                            "capture.json"))
+    report = wl.replay(client, records, rate=400.0, max_workers=8)
+    cache = report["compile_cache"]
+    print(json.dumps({
+        "disk_hits": cache["disk_hits"],
+        "fresh_compiles": cache["fresh_compiles"],
+        "hit_rate": cache["hit_rate"],
+        "ok": report["ok"], "queries": report["queries"],
+    }), flush=True)
+    return client
+
+
 def bench_scan(n_rows, iters):
     """Versioned MVCC read path (ISSUE 4): snapshot reads over a tablet
     with three flushed version generations (overwrites, deletes, partial
@@ -919,6 +1114,7 @@ _CONFIGS = {
     "trace_overhead": (bench_trace_overhead, 2_000_000, 500_000),
     "telemetry_overhead": (bench_telemetry_overhead, 200_000, 100_000),
     "replay": (bench_replay, 200_000, 100_000),
+    "serving_steady": (bench_serving_steady, 200_000, 100_000),
 }
 
 
@@ -1037,6 +1233,7 @@ _METRIC_NAMES = {
     "trace_overhead": "trace_overhead_rows_per_sec",
     "telemetry_overhead": "telemetry_overhead_rows_per_sec",
     "replay": "replay_queries_per_sec",
+    "serving_steady": "serving_steady_queries_per_sec",
 }
 
 

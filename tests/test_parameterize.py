@@ -1,0 +1,342 @@
+"""Plan auto-parameterization (ISSUE 10 tentpole, piece a + b):
+literal-hoisted shape fingerprints agree with workload normalization,
+parameterized execution is bit-identical to literal-baked execution
+over seeded query corpora (NULL/string/float/negative literals
+included), one program serves every constant of a shape (compile-once),
+LIMIT/OFFSET pow2-bucket instead of hoisting, IN-lists bucket pow2,
+and the shape spectrum per fingerprint stays O(log) bounded.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.query import ir
+from ytsaurus_tpu.query import parameterize as pz
+from ytsaurus_tpu.query import workload as wl
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.schema import TableSchema
+
+
+@pytest.fixture(autouse=True)
+def _fresh_configs():
+    yield
+    yt_config.set_compile_config(None)
+    yt_config.set_workload_config(None)
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    get_compile_observatory().reset()
+
+
+SCHEMA = TableSchema.make(
+    [("k", "int64"), ("v", "int64"), ("d", "double"), ("s", "string")])
+
+
+def _chunk(n=64, seed=0, with_nulls=True):
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    rng = random.Random(seed)
+    rows = []
+    for i in range(n):
+        rows.append({
+            "k": i,
+            "v": None if (with_nulls and rng.random() < 0.1)
+            else rng.randrange(-50, 50),
+            "d": None if (with_nulls and rng.random() < 0.1)
+            else rng.uniform(-5.0, 5.0),
+            "s": None if (with_nulls and rng.random() < 0.1)
+            else rng.choice(["alpha", "beta", "gamma", "x'y", ""]),
+        })
+    return ColumnarChunk.from_rows(SCHEMA, rows)
+
+
+def _plan(q):
+    return build_query(q, {"//t": SCHEMA})
+
+
+# -- fingerprint agreement (satellite: one hoisting implementation) ------------
+
+AGREEMENT_PAIRS = [
+    ("k FROM [//t] WHERE v = 1", "k FROM [//t] WHERE v = 999"),
+    ("k FROM [//t] WHERE s = 'a'", "k FROM [//t] WHERE s = 'zzz'"),
+    ("k FROM [//t] WHERE d < 1.5", "k FROM [//t] WHERE d < 2.25"),
+    # Negative literals are unary minus in BOTH planes (the lexer emits
+    # `- ?`, the builder TUnary(-)) — consistently one shape.
+    ("k FROM [//t] WHERE d < -1.5", "k FROM [//t] WHERE d < -2.25"),
+    ("k FROM [//t] WHERE v IN (1, 2, 3)",
+     "k FROM [//t] WHERE v IN (7, 8, 9)"),
+    ("k FROM [//t] WHERE v BETWEEN 1 AND 5",
+     "k FROM [//t] WHERE v BETWEEN 9 AND 40"),
+    ("k FROM [//t] WHERE substr(s, 0, 2) = 'al'",
+     "k FROM [//t] WHERE substr(s, 0, 2) = 'be'"),
+    ("k, sum(v) AS t FROM [//t] GROUP BY k HAVING sum(v) > 10",
+     "k, sum(v) AS t FROM [//t] GROUP BY k HAVING sum(v) > 77"),
+]
+
+
+def test_workload_and_evaluator_fingerprints_agree():
+    """THE dedup satellite: queries that normalize to one workload text
+    must share one evaluator (plan shape) fingerprint — the two planes
+    can no longer silently diverge.  Before ISSUE 10 the evaluator
+    fingerprint varied per literal while the workload one did not."""
+    for qa, qb in AGREEMENT_PAIRS:
+        na, _ = wl.normalize_query(qa)
+        nb, _ = wl.normalize_query(qb)
+        assert na == nb, (qa, qb)
+        assert wl.query_fingerprint(na) == wl.query_fingerprint(nb)
+        fa = pz.plan_fingerprint(_plan(qa))
+        fb = pz.plan_fingerprint(_plan(qb))
+        assert fa == fb, f"plan shape fingerprints diverge: {qa} / {qb}"
+        # The historical per-constant fingerprint DID diverge — the
+        # recompile pathology the parameterized one removes.
+        assert ir.fingerprint(_plan(qa)) != ir.fingerprint(_plan(qb))
+
+
+def test_different_shapes_keep_different_fingerprints():
+    pairs = [
+        ("k FROM [//t] WHERE v = 1", "k FROM [//t] WHERE v > 1"),
+        ("k FROM [//t] WHERE v = 1", "k FROM [//t] WHERE d = 1.0"),
+        ("k FROM [//t] WHERE v IN (1, 2)",
+         "k FROM [//t] WHERE v IN (1, 2, 3, 4, 5)"),   # bucket 2 vs 8
+        ("k FROM [//t] WHERE v = 1", "k FROM [//t] WHERE v = null"),
+        ("k FROM [//t] WHERE v = 1 LIMIT 4",
+         "k FROM [//t] WHERE v = 1 LIMIT 9"),          # bucket 4 vs 16
+    ]
+    for qa, qb in pairs:
+        assert pz.plan_fingerprint(_plan(qa)) != \
+            pz.plan_fingerprint(_plan(qb)), (qa, qb)
+
+
+def test_normalize_query_is_the_shared_implementation():
+    assert wl.normalize_query is pz.hoist_literals
+
+
+def test_hoisted_parameters_walk():
+    params = pz.hoisted_parameters(
+        _plan("k FROM [//t] WHERE v = 7 AND s = 'abc' "
+              "AND k IN (1, 2) ORDER BY k LIMIT 3"))
+    values = [v for _kind, v in params]
+    assert 7 in values and b"abc" in values
+    assert 1 in values and 2 in values
+
+
+# -- correctness property tests ------------------------------------------------
+
+CORPUS_SHAPES = [
+    "k, v FROM [//t] WHERE v = {i}",
+    "k FROM [//t] WHERE v < {i} AND d >= {f}",
+    "k FROM [//t] WHERE v IN ({i}, {j}, null)",
+    "k FROM [//t] WHERE v BETWEEN {j} AND {i}",
+    "k, s FROM [//t] WHERE s = '{s}'",
+    "k FROM [//t] WHERE s LIKE '%{s}%'",
+    "k FROM [//t] WHERE substr(s, 0, {u}) = '{s}'",
+    "k FROM [//t] WHERE if_null(v, {i}) > {j}",
+    "k, v * {i} AS scaled FROM [//t] WHERE v % {u2} = 0",
+    "g2, sum(v) AS t FROM [//t] WHERE d < {f} "
+    "GROUP BY k % {u2} AS g2 HAVING sum(v) > {j}",
+    "k, v FROM [//t] WHERE v > {j} ORDER BY v, k LIMIT {u}",
+    "k FROM [//t] WHERE v != {i} ORDER BY k OFFSET {u} LIMIT {u}",
+    "k FROM [//t] WHERE transform(v, ({i}, {j}), (1, 2), 0) = {one}",
+]
+
+
+def _draw(rng):
+    return {
+        "i": rng.randrange(-60, 60),
+        "j": rng.randrange(-60, 60),
+        "f": round(rng.uniform(-5.0, 5.0), 3),
+        "s": rng.choice(["alpha", "beta", "x", ""]),
+        "u": rng.randrange(1, 9),
+        "u2": rng.randrange(2, 6),
+        "one": rng.choice([0, 1, 2]),
+    }
+
+
+def test_parameterized_results_bit_identical_to_literal_baked():
+    """ISSUE 10 acceptance property: for seeded corpora over shapes
+    with NULL/string/float/negative literals, evaluating through the
+    SHARED parameterized program (queries 2..n reuse query 1's compiled
+    executable) is bit-identical to literal-baked evaluation with a
+    per-query fresh compile."""
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    chunk = _chunk(96, seed=3)
+    param_ev = Evaluator()          # shared: shapes hit its cache
+    for shape_i, shape in enumerate(CORPUS_SHAPES):
+        rng = random.Random(100 + shape_i)
+        for draw in range(4):
+            q = shape.format(**_draw(rng))
+            plan = _plan(q)
+            yt_config.set_compile_config(
+                yt_config.CompileConfig(parameterize=True))
+            got = param_ev.run_plan(plan, chunk).to_rows()
+            # Literal-baked oracle: parameterization off, cold cache.
+            yt_config.set_compile_config(
+                yt_config.CompileConfig(parameterize=False))
+            want = Evaluator().run_plan(plan, chunk).to_rows()
+            assert got == want, f"diverged on {q!r}"
+
+
+def test_compile_once_across_constants():
+    """The steady-state promise: N same-shape queries with different
+    constants compile exactly ONE program; queries 2..N are hits."""
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    chunk = _chunk(64, seed=5)
+    ev = Evaluator()
+    stats = QueryStatistics()
+    for i in range(12):
+        ev.run_plan(_plan(f"k FROM [//t] WHERE v < {i * 7 - 30}"),
+                    chunk, stats=stats)
+    assert stats.compile_count == 1
+    assert stats.cache_hits == 11
+
+
+def test_limit_buckets_share_programs_within_pow2():
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    chunk = _chunk(64, seed=7, with_nulls=False)
+    ev = Evaluator()
+    stats = QueryStatistics()
+    rows_by_limit = {}
+    for limit in (5, 6, 7, 8):       # one pow2 bucket (8)
+        out = ev.run_plan(
+            _plan(f"k, v FROM [//t] ORDER BY v, k LIMIT {limit}"),
+            chunk, stats=stats)
+        rows_by_limit[limit] = out.to_rows()
+    assert stats.compile_count == 1, "limits 5..8 must share a program"
+    for limit, rows in rows_by_limit.items():
+        assert len(rows) == limit
+    # Exactness: each limit's rows prefix the next's.
+    assert rows_by_limit[5] == rows_by_limit[8][:5]
+    # A different bucket compiles separately but stays correct.
+    out9 = ev.run_plan(_plan("k, v FROM [//t] ORDER BY v, k LIMIT 9"),
+                       chunk, stats=stats)
+    assert stats.compile_count == 2
+    assert out9.to_rows()[:8] == rows_by_limit[8]
+
+
+def test_in_list_pow2_bucketing_shares_programs():
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    chunk = _chunk(64, seed=9, with_nulls=False)
+    ev = Evaluator()
+    stats = QueryStatistics()
+    r3 = ev.run_plan(_plan("k FROM [//t] WHERE k IN (3, 4, 5)"),
+                     chunk, stats=stats).to_rows()
+    r4 = ev.run_plan(_plan("k FROM [//t] WHERE k IN (1, 2, 3, 4)"),
+                     chunk, stats=stats).to_rows()
+    assert stats.compile_count == 1, "len 3 and 4 share the 4-bucket"
+    assert [r["k"] for r in r3] == [3, 4, 5]
+    assert [r["k"] for r in r4] == [1, 2, 3, 4]
+
+
+def test_shape_spectrum_stays_pow2_bounded():
+    """Acceptance: the observatory's shape-spectrum cardinality for one
+    fingerprint is bounded by the pow2 bucket count, not by the number
+    of distinct constants/limits thrown at it."""
+    from ytsaurus_tpu.query.engine.evaluator import (
+        Evaluator,
+        get_compile_observatory,
+    )
+    obs = get_compile_observatory()
+    obs.reset()
+    chunk = _chunk(64, seed=11, with_nulls=False)
+    ev = Evaluator()
+    for limit in range(1, 33):       # 32 distinct limits
+        ev.run_plan(
+            _plan(f"k FROM [//t] ORDER BY k LIMIT {limit}"), chunk)
+    rows = [r for r in obs.top(0)]
+    assert len(rows) >= 1
+    # 32 limits span buckets {1,2,4,8,16,32}: <= 6 fingerprints, each
+    # with ONE shape — against 32 programs pre-parameterization.
+    assert len(rows) <= 6
+    assert all(r["shape_count"] == 1 for r in rows)
+
+
+def test_parameterize_off_restores_per_constant_fingerprints():
+    yt_config.set_compile_config(
+        yt_config.CompileConfig(parameterize=False))
+    fa = pz.plan_fingerprint(_plan("k FROM [//t] WHERE v = 1"))
+    fb = pz.plan_fingerprint(_plan("k FROM [//t] WHERE v = 2"))
+    assert fa != fb
+
+
+def test_join_cache_keys_carry_baked_concat_widths():
+    """Sharing-contract regression (review finding): concat's pair
+    multiplier `nb` bakes into the join phase programs, and two join
+    shapes with SWAPPED operand vocab sizes (2x3 vs 3x2) agree on
+    fingerprint, capacities, merged-vocab length and padded binding
+    shapes — only the bind-phase structure notebook distinguishes
+    them.  Both must produce correct matches from one shared cache."""
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    from ytsaurus_tpu.query.engine.joins import execute_join
+    from ytsaurus_tpu.schema import EValueType
+
+    self_schema = TableSchema.make([("a", "string"), ("b", "string")])
+
+    def side(avals, bvals):
+        return ColumnarChunk.from_rows(
+            self_schema,
+            [{"a": x, "b": y} for x in avals for y in bvals])
+
+    chunk1 = side(["x", "y"], ["p", "q", "r"])        # na=2, nb=3
+    chunk2 = side(["x", "y", "z"], ["p", "q"])        # na=3, nb=2
+    foreign_schema = TableSchema.make([("k", "string"), ("v", "int64")])
+    pairs = sorted({x + y for x in ["x", "y", "z"]
+                    for y in ["p", "q", "r"]})
+    foreign = ColumnarChunk.from_rows(
+        foreign_schema, [{"k": k, "v": i} for i, k in enumerate(pairs)])
+    v_of = {k.encode(): i for i, k in enumerate(pairs)}
+    join = ir.JoinClause(
+        foreign_table="//d", foreign_schema=foreign_schema, alias=None,
+        self_equations=(ir.TFunction(
+            type=EValueType.string, name="concat",
+            args=(ir.TReference(type=EValueType.string, name="a"),
+                  ir.TReference(type=EValueType.string, name="b"))),),
+        foreign_equations=(
+            ir.TReference(type=EValueType.string, name="k"),),
+        foreign_columns=("v",), is_left=False)
+    combined = TableSchema.make(
+        [("a", "string"), ("b", "string"), ("v", "int64")])
+    cache: dict = {}
+    for chunk in (chunk1, chunk2):
+        out = execute_join(chunk, combined, join, foreign, cache)
+        rows = out.to_rows()
+        assert len(rows) == chunk.row_count
+        for row in rows:
+            assert row["v"] == v_of[row["a"] + row["b"]], rows
+    assert len(cache) == 2, "swapped concat widths must not share"
+
+
+def test_distributed_shape_fingerprints(tpu_mesh=None):
+    """The SPMD evaluator keys on the shape fingerprint too: same-shape
+    plans reuse one cached exchange program (cache size stays flat)."""
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    from ytsaurus_tpu.parallel.distributed import (
+        DistributedEvaluator,
+        ShardedTable,
+    )
+    from ytsaurus_tpu.parallel.mesh import make_mesh
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    mesh = make_mesh()
+    n = mesh.devices.size
+    chunks = [ColumnarChunk.from_rows(
+        TableSchema.make([("k", "int64"), ("v", "int64")]),
+        [{"k": i * 10 + j, "v": j} for j in range(8)])
+        for i in range(n)]
+    table = ShardedTable.from_chunks(mesh, chunks)
+    ev = DistributedEvaluator(mesh)
+    schema = {"//t": chunks[0].schema}
+    r1 = ev.run(build_query("k, v FROM [//t] WHERE v < 3", schema),
+                table)
+    size_after_first = len(ev._cache)
+    r2 = ev.run(build_query("k, v FROM [//t] WHERE v < 6", schema),
+                table)
+    assert len(ev._cache) == size_after_first, \
+        "second constant must not grow the SPMD program cache"
+    assert {r["v"] for r in r2.to_rows()} == {0, 1, 2, 3, 4, 5}
+    assert {r["v"] for r in r1.to_rows()} == {0, 1, 2}

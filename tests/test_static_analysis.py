@@ -359,6 +359,28 @@ def test_dynamic_shape_flagged_and_bucketed_ok(tmp_path):
     assert findings[0].line == 13
 
 
+def test_dynamic_shape_tree_wide_on_run_plan_callees(tmp_path):
+    """ISSUE 10 satellite: with capacity bucketing universal, an
+    unbucketed dynamically-sized plane flowing into an evaluator
+    dispatch (`run_plan`/`run_plan_async`, incl. METHOD calls) is a
+    finding in ANY module — not just the declared hot paths."""
+    f = fixture(tmp_path, "ytsaurus_tpu/server/fix_cold_module.py", """
+        from ytsaurus_tpu.chunks.columnar import next_pow2
+
+        def serve(evaluator, plan, planes, n):
+            bad = evaluator.run_plan(plan, planes[:n])
+            good = evaluator.run_plan(plan, planes[:next_pow2(n)])
+            also_bad = evaluator.run_plan_async(plan, planes[:n])
+            return bad, good, also_bad
+    """)
+    findings = jax_hazards.run([f])
+    assert rules_of(findings) == ["dynamic-shape", "dynamic-shape"]
+    assert [fd.line for fd in findings] == [5, 7]
+    assert not jax_hazards.is_hot(f.path), \
+        "the fixture must live OUTSIDE the hot prefixes to prove " \
+        "tree-wide scope"
+
+
 # --- failpoint & span coverage ------------------------------------------------
 
 

@@ -390,7 +390,13 @@ def test_recompilation_storm_slo_fires_and_resolves():
     tracker = SloTracker(tcfg, history=history)
     schema, chunk = _fresh_evaluator_inputs(64)
     ev = Evaluator()
-    plans = [_plan(f"k FROM [//t] WHERE v < {100 + i}", schema)
+    # Distinct plan SHAPES (conjunct count varies): since ISSUE 10's
+    # auto-parameterization, plans differing only in literal values
+    # share one fingerprint and can no longer storm — exactly the fix
+    # this SLO was built to watch land.
+    plans = [_plan("k FROM [//t] WHERE " +
+                   " AND ".join(f"v < {100 + j}" for j in range(i + 1)),
+                   schema)
              for i in range(6)]
     # Warm one dispatch BEFORE the baseline sample: the compile-cache
     # counters are created lazily, and a series needs a pre-storm point

@@ -9,7 +9,10 @@ Modern Databases", arxiv 2311.04692).
 
 Scope: the declared HOT-PATH modules (`ops/`, `query/engine/`,
 `tablet/mvcc.py`, `parallel/`) for host-sync; jit-decorated functions
-anywhere for traced-branch.
+anywhere for traced-branch; dynamic-shape TREE-WIDE (ISSUE 10: with
+capacity bucketing universal, an unbucketed dynamic capacity flowing
+into `run_plan`/`run_plan_async` or any jitted callee is a compile-
+storm seed no matter which layer it lives in).
 
 Rules
 -----
@@ -24,10 +27,12 @@ Rules
                   worst.  Shape/dtype/ndim/size attribute tests are
                   static and exempt.
   dynamic-shape   a dynamically-bounded slice (`x[:n]` with non-constant
-                  `n`) passed straight into a locally-jitted callee —
+                  `n`) passed straight into a locally-jitted callee OR
+                  an evaluator dispatch (`run_plan`/`run_plan_async`) —
                   every distinct length compiles a fresh program unless
                   the bound went through a pow2 bucketing helper
-                  (`pad_capacity`, `next_pow2`, ...).
+                  (`pad_capacity`, `next_pow2`, ...).  Checked
+                  tree-wide.
 """
 
 from __future__ import annotations
@@ -59,6 +64,11 @@ SYNC_POINT_FUNCTIONS = {
 # Names that neutralize a dynamic slice bound: the repo's pow2
 # capacity-bucketing helpers.
 BUCKET_HELPERS = {"pad_capacity", "next_pow2", "bucket_capacity"}
+
+# Compiled-dispatch entry points the dynamic-shape rule watches in
+# EVERY module (method calls included): feeding them an unbucketed
+# dynamically-sized plane compiles one program per distinct length.
+PLAN_CALLEES = {"run_plan", "run_plan_async"}
 
 _JIT_DECORATORS = {"jit", "jax.jit", "partial", "functools.partial"}
 
@@ -282,12 +292,18 @@ def _dynamic_slice_bound(arg: ast.AST) -> Optional[str]:
 def _check_dynamic_shapes(f: SourceFile,
                           findings: "list[Finding]") -> None:
     jitted = _locally_jitted_names(f.tree)
-    if not jitted:
-        return
     for node in ast.walk(f.tree):
-        if not (isinstance(node, ast.Call) and
-                isinstance(node.func, ast.Name) and
-                node.func.id in jitted):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name) and node.func.id in jitted:
+            callee, kind = node.func.id, "jitted callee"
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in PLAN_CALLEES:
+            callee, kind = node.func.id, "compiled dispatch"
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in PLAN_CALLEES:
+            callee, kind = node.func.attr, "compiled dispatch"
+        else:
             continue
         if f.waived("dynamic-shape", node.lineno):
             continue
@@ -296,7 +312,7 @@ def _check_dynamic_shapes(f: SourceFile,
             if bound is not None:
                 findings.append(Finding(
                     PASS_NAME, "dynamic-shape", f.path, node.lineno,
-                    f"jitted callee {node.func.id!r} receives a "
+                    f"{kind} {callee!r} receives a "
                     f"dynamically-bounded slice (bound `{bound}`): "
                     f"every distinct length compiles a fresh program — "
                     f"pad through a pow2 bucket helper "
@@ -308,6 +324,8 @@ def run(files: "list[SourceFile]") -> "list[Finding]":
     for f in files:
         if is_hot(f.path):
             _check_host_sync(f, findings)
-            _check_dynamic_shapes(f, findings)
+        # Dynamic-shape is TREE-WIDE (ISSUE 10): bucketing is universal
+        # now, so an unbucketed capacity is a finding wherever it lives.
+        _check_dynamic_shapes(f, findings)
         _check_traced_branches(f, findings)
     return findings

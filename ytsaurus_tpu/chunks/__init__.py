@@ -2,6 +2,7 @@ from ytsaurus_tpu.chunks.columnar import (
     Column,
     ColumnarChunk,
     concat_chunks,
+    next_pow2,
     pad_capacity,
     unify_dictionaries,
 )

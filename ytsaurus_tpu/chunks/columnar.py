@@ -34,6 +34,19 @@ from ytsaurus_tpu.schema import EValueType, TableSchema, device_dtype
 LANE = 128  # last-dim tiling unit on TPU; capacities are multiples of this
 
 
+def next_pow2(n: int, floor: int = 1) -> int:
+    """THE pow2 bucketing primitive: smallest power-of-two multiple of
+    `floor` that is >= n (floor itself for n <= floor).  Every bucketed
+    shape in the tree — chunk capacities, lookup-probe needle arrays,
+    vocabulary-table paddings, IN-list bindings, LIMIT fingerprint
+    buckets — derives from this one implementation, so the compile-cache
+    key spectrum is O(log max) everywhere by construction."""
+    cap = max(floor, 1)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
 def pad_capacity(n: int) -> int:
     """Round a row count up to a static capacity bucket.
 
@@ -42,10 +55,7 @@ def pad_capacity(n: int) -> int:
     keyed by query fingerprint only (engine_api/cg_cache.h): we additionally
     key by capacity bucket, so bucketing bounds the number of recompiles.
     """
-    cap = LANE
-    while cap < n:
-        cap *= 2
-    return cap
+    return next_pow2(n, floor=LANE)
 
 
 def _encode_strings(values: Sequence[Optional[bytes]]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

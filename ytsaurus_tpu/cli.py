@@ -150,8 +150,9 @@ def _fetch_compile(cl) -> dict:
     return get_compile_observatory().snapshot()
 
 
-_COMPILE_TOP_COLUMNS = ("compiles", "hits", "compile_seconds",
-                        "shape_count", "evictions", "last_miss_cause")
+_COMPILE_TOP_COLUMNS = ("compiles", "hits", "disk_hits",
+                        "compile_seconds", "shape_count", "evictions",
+                        "last_miss_cause")
 
 
 def _format_table(header: list, rows: list) -> str:
@@ -189,6 +190,16 @@ def _format_compile_top(snapshot: dict, sort_key: str,
                  f"{int(totals.get('misses', 0))} misses / "
                  f"{int(totals.get('evictions', 0))} evictions over "
                  f"{int(totals.get('fingerprints', 0))} fingerprints")
+    disk = snapshot.get("disk")
+    if disk:
+        lines.append(
+            f"disk tier: {int(disk.get('hits', 0))} hits / "
+            f"{int(disk.get('misses', 0))} misses / "
+            f"{int(disk.get('errors', 0))} errors; "
+            f"{int(disk.get('files', 0))} artifacts, "
+            f"{int(disk.get('bytes', 0))} bytes "
+            f"(cap {int(disk.get('capacity_bytes', 0))}) "
+            f"at {disk.get('dir')}")
     return "\n".join(lines)
 
 
@@ -213,7 +224,9 @@ def _format_replay_report(report: dict) -> str:
         f"max {lat.get('max_ms', 0)}ms",
         f"compile cache: {cache.get('hits', 0)} hits / "
         f"{cache.get('misses', 0)} misses "
-        f"(hit rate {rate(cache.get('hit_rate'))}, steady-state "
+        f"({cache.get('disk_hits', 0)} disk hits, "
+        f"{cache.get('fresh_compiles', 0)} fresh compiles; "
+        f"hit rate {rate(cache.get('hit_rate'))}, steady-state "
         f"{rate(cache.get('steady_hit_rate'))})",
     ]
     slowest = report.get("slowest") or []

@@ -517,6 +517,56 @@ def set_workload_config(config: "Optional[WorkloadConfig]") -> None:
     workload.configure(config)
 
 
+class CompileConfig(YsonStruct):
+    """Compile-once serving knobs (ISSUE 10, query/parameterize.py +
+    query/engine/evaluator.py + query/engine/aot_cache.py):
+
+    - `parameterize`: auto-parameterize plans — the evaluator (and the
+      distributed SPMD evaluator) key their compiled-program caches on
+      the SHAPE fingerprint (hoistable literal values and bucketed
+      LIMIT/OFFSET collapsed; see ir.fingerprint(omit_values=True)),
+      and the lowering feeds literals/limits to the program as runtime
+      bindings, so `WHERE user_id = ?` traffic compiles ONCE per shape
+      instead of once per constant.  Off restores the historical
+      per-constant fingerprints (bench A/B leg).
+    - `disk_cache_dir`: when set, AOT-compiled executables ALSO persist
+      to this directory (jax serialize_executable of lower().compile()
+      products), keyed (fingerprint, capacity bucket, binding shapes,
+      backend, jax version).  A fresh process warm-starts from disk
+      instead of cold-compiling the fleet after a rolling restart.
+      None (default) disables the disk tier.
+    - `disk_cache_capacity_bytes`: size cap on the artifact directory;
+      the writer evicts oldest-mtime files past it (loads touch mtime,
+      so eviction is LRU-ish).
+    - `disk_cache_min_compile_seconds`: programs that compiled faster
+      than this are not worth a disk round-trip; 0 persists everything
+      (tests)."""
+
+    parameterize = param(True, type=bool)
+    disk_cache_dir = param(None, type=str)
+    disk_cache_capacity_bytes = param(256 << 20, type=int, ge=0)
+    disk_cache_min_compile_seconds = param(0.0, type=float, ge=0.0)
+
+
+_COMPILE_CONFIG: "Optional[CompileConfig]" = None
+
+
+def compile_config() -> CompileConfig:
+    global _COMPILE_CONFIG
+    if _COMPILE_CONFIG is None:
+        _COMPILE_CONFIG = CompileConfig()
+    return _COMPILE_CONFIG
+
+
+def set_compile_config(config: "Optional[CompileConfig]") -> None:
+    """Install a process-wide compile config (None restores defaults);
+    rebinds the global disk compile-artifact cache to the new shape."""
+    global _COMPILE_CONFIG
+    _COMPILE_CONFIG = config
+    from ytsaurus_tpu.query.engine import aot_cache
+    aot_cache.configure(config)
+
+
 class FailpointsConfig(YsonStruct):
     """Deterministic fault-injection schedule (utils/failpoints.py):
     `spec` uses the YT_FAILPOINTS syntax, `seed` fixes p-based rolls.
@@ -616,6 +666,7 @@ class DaemonConfig(YsonStruct):
     tracing = param(type=TracingConfig)
     telemetry = param(type=TelemetryConfig)
     workload = param(type=WorkloadConfig)
+    compile = param(type=CompileConfig)
 
     def postprocess(self):
         if self.role == "node" and self.chunk_store.replication_factor < 1:

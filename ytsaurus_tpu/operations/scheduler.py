@@ -302,10 +302,17 @@ class _Snapshot:
             ROOT_USER,
             authenticated_user,
         )
-        if not self.client.exists(self.path):
-            return
-        snap = self.client.get(self.path)
-        for chunk_id in (snap.get("completed") or {}).values():
+        # Serialize against record(): a straggler job thread (speculative
+        # or respawned after an injected worker death) can still be
+        # folding its stripe into the live snapshot dict while the
+        # controller publishes — iterating it unlocked crashed the
+        # operation with "dictionary changed size during iteration".
+        with self._lock:
+            if not self.client.exists(self.path):
+                return
+            snap = self.client.get(self.path)
+            chunk_ids = list((snap.get("completed") or {}).values())
+        for chunk_id in chunk_ids:
             if chunk_id:
                 try:
                     self.client.cluster.chunk_store.remove_chunk(chunk_id)

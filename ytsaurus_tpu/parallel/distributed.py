@@ -33,6 +33,7 @@ from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.parallel.mesh import SHARD_AXIS
 from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.coordinator import split_plan
+from ytsaurus_tpu.query.parameterize import plan_fingerprint
 from ytsaurus_tpu.query.engine.lowering import prepare
 from ytsaurus_tpu.schema import EValueType, TableSchema
 from ytsaurus_tpu.utils import failpoints
@@ -295,7 +296,11 @@ class DistributedEvaluator:
             columns={c.name: _RepColumn(type=c.type, dictionary=c.vocab)
                      for c in prepared_b.output})
         prepared_f = prepare(front, inter_rep)
-        key = ("finish", ir.fingerprint(bottom), ir.fingerprint(front), n,
+        # Compiled-program caches key on the PARAMETERIZED shape
+        # fingerprint (ISSUE 10): the emit paths are literal-value-
+        # independent (values ride the bindings tuple, passed as args
+        # per dispatch), so one SPMD program serves every constant.
+        key = ("finish", plan_fingerprint(bottom), plan_fingerprint(front), n,
                cap, prepared_b.binding_shapes(),
                prepared_f.binding_shapes(),
                join_setup.fingerprint if join_setup else None)
@@ -370,12 +375,15 @@ class DistributedEvaluator:
                     f"{join.foreign_table!r}",
                     code=EErrorCode.QueryExecutionError)
             bindings: list = []
+            bind_structure: list = []
             bind_ctx = BindContext(columns=dict(namespace),
-                                   bindings=bindings)
+                                   bindings=bindings,
+                                   structure=bind_structure)
             binder = ExprBinder(bind_ctx)
             self_bound = [binder.bind(e) for e in join.self_equations]
             f_bound = _bind_keys(foreign, join.foreign_schema,
-                                 join.foreign_equations, bindings)
+                                 join.foreign_equations, bindings,
+                                 structure=bind_structure)
             self_slots, foreign_slots = _vocab_remap_slots(
                 self_bound, f_bound, bindings)
             bnd = tuple(bindings)
@@ -449,8 +457,12 @@ class DistributedEvaluator:
                 return (transfer_counts(pid_s, pid_s < n, n),
                         transfer_counts(pid_f, pid_f < n, n))
 
-            key_base = ("pjoin", ir.fingerprint(plan), join_index, n,
+            key_base = ("pjoin", plan_fingerprint(plan), join_index, n,
                         s_cap, f_slice, f_count > 0,
+                        # Bind-phase structure notebook: baked host
+                        # constants (concat widths) binding shapes
+                        # alone cannot distinguish (ISSUE 10).
+                        tuple(bind_structure),
                         tuple((tuple(b.shape), str(b.dtype))
                               for b in bindings))
             cfn = self._cache.get(key_base + ("count",))
@@ -699,7 +711,10 @@ class DistributedEvaluator:
             g_mask = jax.lax.all_gather(shard_mask, SHARD_AXIS).reshape(-1)
             return prepared_front.run(gathered, g_mask, front_bnd)
 
-        key = ("shuffled", ir.fingerprint(plan), n, cap, quota,
+        key = ("shuffled", plan_fingerprint(plan), n, cap, quota,
+               # dest_ids' where/key binds can bake host constants
+               # (concat widths) — fold their structure notebook in.
+               tuple(bind_ctx.structure),
                prepared_local.binding_shapes(),
                prepared_front.binding_shapes())
         fn = self._cache.get(key)
@@ -752,12 +767,15 @@ class DistributedEvaluator:
                     f"{join.foreign_table!r}",
                     code=EErrorCode.QueryExecutionError)
             # Bind self keys against the namespace accumulated so far.
+            bind_structure: list = []
             bind_ctx = BindContext(columns=dict(namespace),
-                                   bindings=bindings)
+                                   bindings=bindings,
+                                   structure=bind_structure)
             binder = ExprBinder(bind_ctx)
             self_bound = [binder.bind(e) for e in join.self_equations]
             f_bound = _bind_keys(foreign, join.foreign_schema,
-                                 join.foreign_equations, bindings)
+                                 join.foreign_equations, bindings,
+                                 structure=bind_structure)
             self_slots, foreign_slots = _vocab_remap_slots(
                 self_bound, f_bound, bindings)
             # Host phase: encode + sort the foreign keys, verify unique.
@@ -771,6 +789,9 @@ class DistributedEvaluator:
             # Host phase cached per (join shape, foreign chunk identity):
             # repeated queries against an unchanged dimension table must
             # not re-sort it or pay the uniqueness-check device sync.
+            # Deliberately the VALUE-CARRYING fingerprint (not the
+            # parameterized one): this cache holds computed key planes,
+            # not a program, so equation literals must distinguish.
             host_key = ("join-host", ir.fingerprint(ir.Query(
                 schema=join.foreign_schema, source=join.foreign_table,
                 joins=(join,))), id(foreign), foreign.capacity, n_foreign,
@@ -818,10 +839,13 @@ class DistributedEvaluator:
                           join.is_left, flat_names, (arg_start, len(args)),
                           foreign.capacity))
             fingerprint_parts.append(
-                (ir.fingerprint(ir.Query(schema=join.foreign_schema,
-                                         source=join.foreign_table,
-                                         joins=(join,))),
+                (plan_fingerprint(ir.Query(schema=join.foreign_schema,
+                                           source=join.foreign_table,
+                                           joins=(join,))),
                  foreign.capacity, n_foreign > 0,
+                 # Exact vocab lens + the bind-phase structure notebook
+                 # (baked concat widths etc., ISSUE 10).
+                 tuple(bind_structure),
                  tuple(len(b.vocab) if b.vocab is not None else -1
                        for b in list(self_bound) + list(f_bound))))
 

@@ -1,0 +1,287 @@
+"""Persistent AOT compile-artifact cache (ISSUE 10 tentpole, piece c).
+
+The disk tier of the compile ladder: memory LRU → THIS → fresh compile.
+AOT-compiled executables (jax serialize_executable products of the
+evaluator's `lower().compile()`) persist to a bounded directory keyed
+by (plan shape fingerprint, capacity bucket, binding shapes/structure,
+backend, jax version), so a rolling restart of query daemons
+WARM-STARTS: the first query of each shape deserializes a ready
+executable in milliseconds instead of cold-compiling it — the XLA
+analog of the reference's on-disk LLVM image cache discipline
+(engine_api/cg_cache.h keyed by llvm::FoldingSet fingerprint).
+
+Safety posture is LOUD-BUT-SAFE: every artifact carries a versioned
+JSON header that is refused loudly (warning log + `disk_errors`
+sensor) on an aot-schema / jax-version / backend mismatch — the same
+versioned-capture discipline as the workload log — and ANY load
+failure (truncated file, pickle corruption, deserialize error) falls
+back to a fresh compile; a query can never fail because the disk tier
+rotted.  The directory is size-capped with oldest-mtime eviction
+(loads touch mtime, so eviction is LRU-ish across processes sharing
+the cache dir).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Optional
+
+import jax
+
+from ytsaurus_tpu.utils.logging import get_logger
+from ytsaurus_tpu.utils.profiling import Profiler
+
+logger = get_logger("AotCache")
+
+# Bump when the on-disk artifact layout changes incompatibly: readers
+# refuse mismatched headers loudly instead of unpickling garbage.
+AOT_SCHEMA_VERSION = 1
+
+_SUFFIX = ".aot"
+
+
+def _backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:   # noqa: BLE001 — backend probe must never raise
+        return "unknown"
+
+
+class DiskCompileCache:
+    """One process's view of an on-disk compile-artifact directory."""
+
+    def __init__(self, config):
+        self._dir = config.disk_cache_dir
+        self._capacity_bytes = config.disk_cache_capacity_bytes
+        self._min_seconds = config.disk_cache_min_compile_seconds
+        # guards: bytes_n, files_n (gauge mirrors), eviction scans;
+        # load/store file I/O itself is atomic-per-file (tmp+replace)
+        self._lock = threading.Lock()
+        self.hits_n = 0
+        self.misses_n = 0
+        self.errors_n = 0
+        self.stores_n = 0
+        self.evictions_n = 0
+        prof = Profiler("/query/compile_cache")
+        self._hits = prof.counter("disk_hits")
+        self._misses = prof.counter("disk_misses")
+        self._errors = prof.counter("disk_errors")
+        self._bytes = prof.gauge("disk_bytes")
+        self._files = prof.gauge("disk_files")
+        self._refresh_gauges()
+
+    # -- keying ----------------------------------------------------------------
+
+    def _path(self, key: tuple) -> str:
+        """Artifact path for one full compile-cache key.  The digest
+        covers the key (fingerprint, capacity, binding shapes +
+        structure — all plain ints/strings, stable across processes)
+        plus backend and jax version, so an upgraded daemon simply sees
+        a cold cache rather than refusing every file."""
+        text = repr((key, _backend(), jax.__version__,
+                     AOT_SCHEMA_VERSION))
+        digest = hashlib.sha256(text.encode()).hexdigest()[:40]
+        return os.path.join(self._dir, digest + _SUFFIX)
+
+    # -- load ------------------------------------------------------------------
+
+    def load(self, key: tuple):
+        """Deserialize the executable for `key`, or None (counted as a
+        disk miss / error).  Never raises."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                header_line = f.readline()
+                header = json.loads(header_line or b"{}")
+                problem = self._header_problem(header)
+                if problem is not None:
+                    logger.warning(
+                        "refusing compile artifact %s: %s", path, problem)
+                    self._count_error()
+                    return None
+                payload, in_tree, out_tree = pickle.loads(f.read())
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+            fn = deserialize_and_load(payload, in_tree, out_tree)
+        except FileNotFoundError:
+            self._count_miss()
+            return None
+        except Exception as exc:   # noqa: BLE001 — loud-but-safe: a
+            # rotted artifact (truncation, pickle/deserialize failure)
+            # must fall back to a fresh compile, never fail the query.
+            logger.warning("compile artifact %s unreadable (%r); "
+                           "falling back to fresh compile", path, exc)
+            self._count_error()
+            return None
+        try:
+            os.utime(path)           # LRU touch for mtime eviction
+        except OSError:
+            pass
+        with self._lock:
+            self.hits_n += 1
+        self._hits.increment()
+        return fn
+
+    def _header_problem(self, header: dict) -> Optional[str]:
+        if not isinstance(header, dict):
+            return "missing header"
+        if header.get("aot_schema") != AOT_SCHEMA_VERSION:
+            return (f"aot schema {header.get('aot_schema')!r}, this "
+                    f"build speaks {AOT_SCHEMA_VERSION}")
+        if header.get("jax") != jax.__version__:
+            return (f"compiled under jax {header.get('jax')!r}, this "
+                    f"process runs {jax.__version__}")
+        if header.get("backend") != _backend():
+            return (f"compiled for backend {header.get('backend')!r}, "
+                    f"this process runs {_backend()!r}")
+        return None
+
+    # -- store -----------------------------------------------------------------
+
+    def store(self, key: tuple, compiled, fingerprint: str,
+              compile_seconds: float) -> bool:
+        """Serialize one freshly AOT-compiled executable.  Best-effort:
+        failures are counted + logged, never raised."""
+        if compile_seconds < self._min_seconds:
+            return False
+        path = self._path(key)
+        try:
+            from jax.experimental.serialize_executable import serialize
+            payload, in_tree, out_tree = serialize(compiled)
+            header = json.dumps({
+                "aot_schema": AOT_SCHEMA_VERSION,
+                "jax": jax.__version__,
+                "backend": _backend(),
+                "fingerprint": fingerprint,
+                "compile_seconds": round(compile_seconds, 6),
+                "created_at": time.time(),
+            }).encode() + b"\n"
+            blob = pickle.dumps((payload, in_tree, out_tree))
+            os.makedirs(self._dir, exist_ok=True)
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(header)
+                f.write(blob)
+            os.replace(tmp, path)
+        except Exception as exc:   # noqa: BLE001 — persistence is an
+            # optimization; a full disk or an unserializable executable
+            # (callbacks, donated buffers) must not fail the query.
+            logger.warning("cannot persist compile artifact %s: %r",
+                           path, exc)
+            self._count_error()
+            return False
+        with self._lock:
+            self.stores_n += 1
+            self._evict_locked()
+        return True
+
+    # -- bounds ----------------------------------------------------------------
+
+    def _scan_locked(self) -> list[tuple[float, int, str]]:
+        """(mtime, size, path) per artifact; unreadable entries skipped."""
+        out = []
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(_SUFFIX):
+                continue
+            path = os.path.join(self._dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            out.append((st.st_mtime, st.st_size, path))
+        return out
+
+    def _evict_locked(self) -> None:
+        entries = self._scan_locked()
+        total = sum(size for _mt, size, _p in entries)
+        if self._capacity_bytes and total > self._capacity_bytes:
+            for _mtime, size, path in sorted(entries):
+                try:
+                    os.remove(path)
+                except OSError:
+                    continue
+                self.evictions_n += 1
+                total -= size
+                if total <= self._capacity_bytes:
+                    break
+            entries = self._scan_locked()
+            total = sum(size for _mt, size, _p in entries)
+        self._bytes.set(float(total))
+        self._files.set(float(len(entries)))
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            entries = self._scan_locked()
+            self._bytes.set(float(sum(s for _m, s, _p in entries)))
+            self._files.set(float(len(entries)))
+
+    def _count_miss(self) -> None:
+        with self._lock:
+            self.misses_n += 1
+        self._misses.increment()
+
+    def _count_error(self) -> None:
+        with self._lock:
+            self.errors_n += 1
+        self._errors.increment()
+
+    # -- views -----------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            entries = self._scan_locked()
+            return {
+                "dir": self._dir,
+                "hits": self.hits_n,
+                "misses": self.misses_n,
+                "errors": self.errors_n,
+                "stores": self.stores_n,
+                "evictions": self.evictions_n,
+                "files": len(entries),
+                "bytes": sum(s for _m, s, _p in entries),
+                "capacity_bytes": self._capacity_bytes,
+            }
+
+
+# -- globals -------------------------------------------------------------------
+
+_cache: Optional[DiskCompileCache] = None
+_cache_dir: Optional[str] = None
+_cache_lock = threading.Lock()     # guards: _cache, _cache_dir
+
+
+def get_disk_cache() -> Optional[DiskCompileCache]:
+    """The process disk tier, or None when CompileConfig.disk_cache_dir
+    is unset (the default — tests and serving opt in explicitly)."""
+    global _cache, _cache_dir
+    from ytsaurus_tpu.config import compile_config
+    cfg = compile_config()
+    if not cfg.disk_cache_dir:
+        return None
+    with _cache_lock:
+        if _cache is None or _cache_dir != cfg.disk_cache_dir:
+            _cache = DiskCompileCache(cfg)
+            _cache_dir = cfg.disk_cache_dir
+        return _cache
+
+
+def configure(cfg) -> None:
+    """Rebind the global disk cache (called by config.set_compile_config;
+    None restores the lazy default)."""
+    global _cache, _cache_dir
+    with _cache_lock:
+        if cfg is None or not cfg.disk_cache_dir:
+            _cache, _cache_dir = None, None
+        else:
+            _cache = DiskCompileCache(cfg)
+            _cache_dir = cfg.disk_cache_dir

@@ -21,6 +21,7 @@ from ytsaurus_tpu.chunks.columnar import Column, ColumnarChunk, concat_chunks
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.query import ir
 from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.parameterize import plan_fingerprint
 from ytsaurus_tpu.query.engine.joins import execute_join
 from ytsaurus_tpu.query.engine.lowering import prepare
 from ytsaurus_tpu.query.statistics import QueryStatistics
@@ -74,12 +75,14 @@ class CompileObservatory:
         self.hits_n = 0
         self.misses_n = 0
         self.evictions_n = 0
+        self.disk_hits_n = 0
 
     def _entry_locked(self, fp: str) -> dict:
         entry = self._fps.get(fp)
         if entry is None:
             entry = self._fps[fp] = {
-                "compiles": 0, "hits": 0, "compile_seconds": 0.0,
+                "compiles": 0, "hits": 0, "disk_hits": 0,
+                "compile_seconds": 0.0,
                 "shapes": set(), "shape_count": 0, "evictions": 0,
                 "last_miss_cause": None, "last_compile_at": 0.0,
             }
@@ -104,8 +107,15 @@ class CompileObservatory:
         with self._lock:
             self.misses_n += 1
             entry = self._entry_locked(fp)
-            entry["compiles"] += 1
-            entry["compile_seconds"] += seconds
+            if cause == "disk_hit":
+                # A memory miss served by the persistent tier: no fresh
+                # compile burn — count it apart so `compiles` stays the
+                # honest "programs actually built here" number.
+                self.disk_hits_n += 1
+                entry["disk_hits"] += 1
+            else:
+                entry["compiles"] += 1
+                entry["compile_seconds"] += seconds
             entry["last_miss_cause"] = cause
             entry["last_compile_at"] = time.time()
             shapes = entry["shapes"]
@@ -153,6 +163,7 @@ class CompileObservatory:
         with self._lock:
             return {"hits": self.hits_n, "misses": self.misses_n,
                     "evictions": self.evictions_n,
+                    "disk_hits": self.disk_hits_n,
                     "fingerprints": len(self._fps)}
 
     def top(self, n: int = 20,
@@ -173,8 +184,13 @@ class CompileObservatory:
             return list(self._artifacts)
 
     def snapshot(self, top: int = 50) -> dict:
+        from ytsaurus_tpu.query.engine.aot_cache import get_disk_cache
+        disk = get_disk_cache()
         return {"totals": self.totals(),
                 "fingerprints": self.top(top),
+                # The persistent artifact tier's view (ISSUE 10): None
+                # when the disk cache is disabled.
+                "disk": disk.snapshot() if disk is not None else None,
                 "artifacts": [{k: v for k, v in a.items() if k != "hlo"}
                               for a in self.artifacts()]}
 
@@ -184,6 +200,7 @@ class CompileObservatory:
             self._artifacts.clear()
             self._evicted.clear()
             self.hits_n = self.misses_n = self.evictions_n = 0
+            self.disk_hits_n = 0
 
 
 _observatory = CompileObservatory()
@@ -283,11 +300,44 @@ class Evaluator:
         # an unlocked move_to_end could KeyError against a concurrent
         # eviction (compiles themselves run outside the lock).
         self._cache: OrderedDict = OrderedDict()
-        self._cache_lock = threading.Lock()   # guards: _cache
+        # guards: _cache, _inflight
+        self._cache_lock = threading.Lock()
+        # Single-flight compilation (ISSUE 10): concurrent dispatches
+        # missing on the SAME key elect one compiler; the rest wait on
+        # its event and take the cached program — a cold shape under an
+        # 8-thread replay burst used to compile 4-8 identical programs
+        # (thundering herd), each counted as a miss against the
+        # steady-state hit-rate SLO.
+        self._inflight: dict = {}
         self._join_cache: dict = {}
 
     def cache_size(self) -> int:
         return len(self._cache)
+
+    def _acquire_inflight(self, key: tuple):
+        """Single-flight gate for one cache key: returns the compiled
+        program if a concurrent leader finished it, or None with THIS
+        caller elected leader (it must call _release_inflight)."""
+        while True:
+            with self._cache_lock:
+                fn = self._cache.get(key)
+                if fn is not None:
+                    self._cache.move_to_end(key)
+                    return fn
+                event = self._inflight.get(key)
+                if event is None:
+                    self._inflight[key] = threading.Event()
+                    return None
+            # A leader is compiling this key: wait, then re-check (the
+            # loop re-elects if the leader failed or the entry was
+            # evicted before we woke).
+            event.wait(timeout=600)
+
+    def _release_inflight(self, key: tuple) -> None:
+        with self._cache_lock:
+            event = self._inflight.pop(key, None)
+        if event is not None:
+            event.set()
 
     # -- plan execution --------------------------------------------------------
 
@@ -321,10 +371,13 @@ class Evaluator:
         t0 = _time.perf_counter()
         # Span per plan execution, tagged with the plan fingerprint (ref:
         # evaluator.cpp:67-75 annotates spans with query fingerprints);
-        # computed once and reused as the compile-cache key.  INTERIOR
+        # computed once and reused as the compile-cache key.  With
+        # CompileConfig.parameterize this is the SHAPE fingerprint —
+        # literal values hoisted, limits bucketed (ISSUE 10) — so one
+        # cache entry serves every constant of a query shape.  INTERIOR
         # site: records only under a live trace (gateway/scheduler root),
         # so untraced evaluator use stays on the null fast path.
-        fp = ir.fingerprint(plan)
+        fp = plan_fingerprint(plan)
         span = child_span("evaluator.run_plan", fingerprint=fp,
                           rows=chunk.row_count)
         with span:
@@ -392,11 +445,8 @@ class Evaluator:
                   stats: Optional[QueryStatistics] = None,
                   fp: Optional[str] = None,
                   pool: Optional[str] = None) -> _PendingResult:
-        import time as _time
-
-        from ytsaurus_tpu.utils.tracing import child_span
         prepared = prepare(plan, chunk)
-        key = (fp or ir.fingerprint(plan), chunk.capacity,
+        key = (fp or plan_fingerprint(plan), chunk.capacity,
                prepared.binding_shapes())
         columns = {c.name: (chunk.columns[c.name].data,
                             chunk.columns[c.name].valid)
@@ -414,62 +464,16 @@ class Evaluator:
             # QUERY in EXPLAIN ANALYZE, not just in aggregate.
             stats.capacity_buckets.add(int(chunk.capacity))
         if fn is None:
-            from ytsaurus_tpu.config import workload_config
-            cfg = workload_config()
-            # Cache miss, classified for the observatory BEFORE the
-            # entry mutates: never-seen plan shape vs a known shape
-            # meeting a new capacity/binding-shape vs an LRU re-miss.
-            cause = _observatory.classify_miss(key[0], key)
-            lowered = None
-            # Cache miss: build the device program NOW (AOT lower +
-            # compile, the XLA analog of the reference's LLVM codegen
-            # pass) so compile time is measured apart from execution.
-            # Shapes/dtypes are pinned by the cache key (capacity +
-            # binding shapes), which is exactly what AOT requires.
-            with child_span("evaluator.compile", fingerprint=key[0],
-                            capacity=chunk.capacity, cause=cause):
-                t0c = _time.perf_counter()
-                jitted = jax.jit(prepared.run)
-                try:
-                    lowered = jitted.lower(*args)
-                    fn = lowered.compile()
-                except Exception:   # noqa: BLE001 — AOT is an
-                    # optimization; anything it cannot lower falls back
-                    # to the jit wrapper (first call compiles fused).
-                    fn = jitted
-                    lowered = None
-                    result = fn(*args)
-                compile_seconds = _time.perf_counter() - t0c
-            with self._cache_lock:
-                self._cache[key] = fn
-                evicted_keys = []
-                if cfg.compile_cache_capacity:
-                    while len(self._cache) > cfg.compile_cache_capacity:
-                        evicted_keys.append(
-                            self._cache.popitem(last=False)[0])
-            for evicted_key in evicted_keys:
-                _observatory.observe_eviction(evicted_key)
-                _evictions_counter.increment()
-            _cache_counters.counters(pool)["misses"].increment()
-            _observatory.observe_miss(key[0], key, cause,
-                                      compile_seconds)
-            if cfg.capture_artifacts and lowered is not None:
-                try:
-                    _observatory.capture_artifact(
-                        key[0], key, lowered.as_text(),
-                        _cost_analysis(fn), compile_seconds)
-                except Exception:   # noqa: BLE001 — artifact capture
-                    # is debugging aid, never an execution hazard.
-                    pass
-            if stats is not None:
-                stats.compile_count += 1
-                stats.compile_time += compile_seconds
-                if cause == "eviction":
-                    stats.compile_evicted += 1
-                elif cause == "new_shape":
-                    stats.compile_new_shape += 1
-                else:
-                    stats.compile_new_fingerprint += 1
+            # Single-flight: either a concurrent leader hands us the
+            # finished program (counted as a hit below), or WE are
+            # elected leader (None back) and must release the gate.
+            fn = self._acquire_inflight(key)
+        if fn is None:
+            try:
+                fn, compile_seconds, result = self._compile_miss(
+                    key, prepared, chunk, args, stats, pool)
+            finally:
+                self._release_inflight(key)
         else:
             _cache_counters.counters(pool)["hits"].increment()
             _observatory.observe_hit(key[0])
@@ -493,6 +497,92 @@ class Evaluator:
         pending = _PendingResult(planes, count, prepared.output)
         pending.compile_seconds = compile_seconds
         return pending
+
+    def _compile_miss(self, key, prepared, chunk, args, stats, pool):
+        """The memory-miss slow path (single-flight leader only):
+        disk-tier load or fresh AOT compile, cache insert + eviction,
+        counters/observatory/artifact bookkeeping.  Returns
+        (fn, compile_seconds, eager_result_or_None)."""
+        import time as _time
+
+        from ytsaurus_tpu.config import workload_config
+        from ytsaurus_tpu.query.engine.aot_cache import get_disk_cache
+        from ytsaurus_tpu.utils.tracing import child_span
+        cfg = workload_config()
+        result = None
+        # Cache miss, classified for the observatory BEFORE the
+        # entry mutates: never-seen plan shape vs a known shape
+        # meeting a new capacity/binding-shape vs an LRU re-miss —
+        # or a DISK HIT, when the persistent artifact tier serves a
+        # ready executable (the warm-restart arm, ISSUE 10).
+        cause = _observatory.classify_miss(key[0], key)
+        lowered = None
+        fn = None
+        disk = get_disk_cache()
+        # Memory miss: try the disk tier (lazily, only on miss), else
+        # build the device program NOW (AOT lower + compile, the XLA
+        # analog of the reference's LLVM codegen pass) so compile time
+        # is measured apart from execution.  Shapes/dtypes are pinned
+        # by the cache key (capacity + binding shapes), which is
+        # exactly what AOT requires — and exactly what makes the
+        # executables serializable across processes.
+        span = child_span("evaluator.compile", fingerprint=key[0],
+                          capacity=chunk.capacity)
+        with span:
+            t0c = _time.perf_counter()
+            if disk is not None:
+                fn = disk.load(key)
+            if fn is not None:
+                cause = "disk_hit"
+            else:
+                jitted = jax.jit(prepared.run)
+                try:
+                    lowered = jitted.lower(*args)
+                    fn = lowered.compile()
+                except Exception:   # noqa: BLE001 — AOT is an
+                    # optimization; anything it cannot lower falls back
+                    # to the jit wrapper (first call compiles fused).
+                    fn = jitted
+                    lowered = None
+                    result = fn(*args)
+            compile_seconds = _time.perf_counter() - t0c
+            span.add_tag("cause", cause)
+        if disk is not None and lowered is not None:
+            # Persist the fresh AOT product so the NEXT process
+            # (rolling restart) warm-starts this shape from disk.
+            disk.store(key, fn, key[0], compile_seconds)
+        with self._cache_lock:
+            self._cache[key] = fn
+            evicted_keys = []
+            if cfg.compile_cache_capacity:
+                while len(self._cache) > cfg.compile_cache_capacity:
+                    evicted_keys.append(
+                        self._cache.popitem(last=False)[0])
+        for evicted_key in evicted_keys:
+            _observatory.observe_eviction(evicted_key)
+            _evictions_counter.increment()
+        _cache_counters.counters(pool)["misses"].increment()
+        _observatory.observe_miss(key[0], key, cause, compile_seconds)
+        if cfg.capture_artifacts and lowered is not None:
+            try:
+                _observatory.capture_artifact(
+                    key[0], key, lowered.as_text(),
+                    _cost_analysis(fn), compile_seconds)
+            except Exception:   # noqa: BLE001 — artifact capture is a
+                # debugging aid, never an execution hazard.
+                pass
+        if stats is not None:
+            stats.compile_count += 1
+            stats.compile_time += compile_seconds
+            if cause == "disk_hit":
+                stats.compile_disk_hit += 1
+            elif cause == "eviction":
+                stats.compile_evicted += 1
+            elif cause == "new_shape":
+                stats.compile_new_shape += 1
+            else:
+                stats.compile_new_fingerprint += 1
+        return fn, compile_seconds, result
 
     def _execute(self, plan, chunk: ColumnarChunk,
                  stats: Optional[QueryStatistics] = None,

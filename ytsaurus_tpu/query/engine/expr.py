@@ -56,13 +56,24 @@ class ColumnBinding:
 
 @dataclass
 class BindContext:
-    """Per-chunk bind state: column vocabs in, bound host arrays out."""
+    """Per-chunk bind state: column vocabs in, bound host arrays out.
+
+    `structure` is the bind-phase STRUCTURE NOTEBOOK: any host constant
+    a bind method bakes into the traced program (concat's pair-table
+    width, order-key bit widths, ...) must be noted here — it folds into
+    PreparedQuery.structure_key and hence the compile-cache key, so two
+    plans that share a (parameterized) fingerprint and binding shapes
+    but differ in a baked constant can never share a program."""
     columns: dict[str, ColumnBinding]
     bindings: list = field(default_factory=list)
+    structure: list = field(default_factory=list)
 
     def add(self, value) -> int:
         self.bindings.append(value)
         return len(self.bindings) - 1
+
+    def note(self, *entry) -> None:
+        self.structure.append(entry)
 
 
 @dataclass
@@ -84,10 +95,8 @@ class BoundExpr:
 def _vocab_bucket(n: int) -> int:
     """Pad vocab-indexed bound arrays to power-of-two buckets ≥ 8 so binding
     shapes (and hence compiled programs) are reused across chunks."""
-    cap = 8
-    while cap < n:
-        cap *= 2
-    return cap
+    from ytsaurus_tpu.chunks.columnar import next_pow2
+    return next_pow2(n, floor=8)
 
 
 def _pad_np(arr: np.ndarray, size: int, fill) -> np.ndarray:
@@ -163,6 +172,9 @@ class ExprBinder:
                 return zeros, jnp.zeros(ctx.capacity, dtype=bool)
             return BoundExpr(type=ty, vocab=None, emit=emit_null)
         if ty is EValueType.string:
+            # Value-independent on device already: the literal is code 0
+            # of its own one-entry vocabulary; every consumer reads the
+            # actual bytes through bound remap/predicate tables.
             vocab = np.array([node.value], dtype=object)
 
             def emit_str(ctx: EmitContext):
@@ -171,9 +183,24 @@ class ExprBinder:
             return BoundExpr(type=ty, vocab=vocab, emit=emit_str)
         value = node.value
         dt = _dtype_for(ty)
+        if ty is EValueType.boolean:
+            # Static residue: true/false are keywords to the lexer and
+            # stay in the (parameterized) fingerprint, so baking the
+            # value cannot grow a shape spectrum.
+            def emit_bool(ctx: EmitContext):
+                return (jnp.full(ctx.capacity, bool(value), dtype=dt),
+                        jnp.ones(ctx.capacity, dtype=bool))
+            return BoundExpr(type=ty, vocab=None, emit=emit_bool)
+        # Numeric literals ride as a 0-d BINDING, not a trace constant:
+        # the compiled program is literal-value-independent, which is
+        # what lets the parameterized fingerprint (ir.fingerprint with
+        # omit_values=True) key one program for every constant.
+        # analyze: allow(host-sync): `value` is a host python scalar (bind phase), not a device plane
+        slot = self.ctx.add(jnp.asarray(np.asarray(value, dtype=dt)))
 
         def emit(ctx: EmitContext):
-            return (jnp.full(ctx.capacity, value, dtype=dt),
+            return (jnp.broadcast_to(ctx.bindings[slot].astype(dt),
+                                     (ctx.capacity,)),
                     jnp.ones(ctx.capacity, dtype=bool))
         return BoundExpr(type=ty, vocab=None, emit=emit)
 
@@ -535,6 +562,9 @@ class ExprBinder:
         va = a.vocab if a.vocab is not None else _EMPTY_VOCAB
         vb = b.vocab if b.vocab is not None else _EMPTY_VOCAB
         na, nb = max(len(va), 1), max(len(vb), 1)
+        # nb bakes into the pair-index arithmetic below (a trace
+        # constant the padded table shape alone cannot distinguish).
+        self.ctx.note("concat", na, nb)
         if na * nb > 1 << 16:
             raise YtError(
                 f"concat() vocabulary cross product too large "
@@ -626,15 +656,27 @@ class ExprBinder:
     # -- membership / ranges / transform --------------------------------------
 
     def _bind_TIn(self, node: ir.TIn) -> BoundExpr:
+        from ytsaurus_tpu.chunks.columnar import next_pow2
         operands = [self.bind(o) for o in node.operands]
-        value_planes, value_valids = self._bind_value_tuples(operands,
-                                                             node.values)
+        # IN lists trace a membership loop per tuple, so the list LENGTH
+        # bakes into the program.  Bucket it pow2 (same discipline as
+        # chunk capacities / lookup needles): padded slots carry
+        # present=False so they match nothing, and `user_id IN (...)`
+        # traffic with drifting list sizes compiles O(log max) programs
+        # instead of one per length.
+        n_bucket = next_pow2(len(node.values))
+        self.ctx.note("in", n_bucket)
+        value_planes, value_valids = self._bind_value_tuples(
+            operands, node.values, pad_to=n_bucket)
+        present_np = np.zeros(n_bucket, dtype=bool)
+        present_np[: len(node.values)] = True
+        present_slot = self.ctx.add(jnp.asarray(present_np))
 
         def emit(ctx):
             op_planes = [o.emit(ctx) for o in operands]
             match_any = jnp.zeros(ctx.capacity, dtype=bool)
-            n_values = len(node.values)
-            for vi in range(n_values):
+            present = ctx.bindings[present_slot]
+            for vi in range(n_bucket):
                 row_match = jnp.ones(ctx.capacity, dtype=bool)
                 for oi, (data, valid) in enumerate(op_planes):
                     const = ctx.bindings[value_planes[oi]][vi]
@@ -643,7 +685,7 @@ class ExprBinder:
                     # valid rows (null == null per CompareRowValues).
                     row_match = row_match & jnp.where(
                         cvalid, valid & (data == const), ~valid)
-                match_any = match_any | row_match
+                match_any = match_any | (row_match & present[vi])
             return match_any, jnp.ones(ctx.capacity, dtype=bool)
         return BoundExpr(type=EValueType.boolean, vocab=None, emit=emit)
 
@@ -744,7 +786,8 @@ class ExprBinder:
         return BoundExpr(type=node.type, vocab=out_vocab, emit=emit)
 
     def _bind_value_tuples(self, operands: list[BoundExpr],
-                           values, range_encode: bool = False
+                           values, range_encode: bool = False,
+                           pad_to: Optional[int] = None
                            ) -> tuple[list[int], list[int]]:
         """Bind literal tuples column-wise; returns (value_slots, valid_slots)
         — one binding slot per operand holding the per-tuple constants
@@ -785,6 +828,11 @@ class ExprBinder:
             if len(arr) == 0:
                 arr = np.zeros(1, dtype=arr.dtype)
                 ok = np.zeros(1, dtype=bool)
+            if pad_to is not None and len(arr) < pad_to:
+                # pow2-bucketed value list (TIn): padded slots are
+                # masked off by the caller's `present` binding.
+                arr = _pad_np(arr, pad_to, 0)
+                ok = _pad_np(ok, pad_to, False)
             slots.append(self.ctx.add(jnp.asarray(arr)))
             valid_slots.append(self.ctx.add(jnp.asarray(ok)))
         return slots, valid_slots

@@ -38,13 +38,18 @@ from ytsaurus_tpu.schema import TableSchema
 
 
 def _bind_keys(chunk: ColumnarChunk, schema: TableSchema,
-               equations: tuple[ir.TExpr, ...], shared_bindings: list):
+               equations: tuple[ir.TExpr, ...], shared_bindings: list,
+               structure: "list | None" = None):
     """Host phase: bind join-key expressions against a chunk's vocabularies.
     All slots index into ONE shared bindings list so both sides' emit
-    closures can run under the same traced tuple."""
+    closures can run under the same traced tuple.  `structure` (when
+    given) collects the bind-phase structure notebook — baked host
+    constants like concat's pair width — which the CALLER must fold
+    into its program-cache key (ISSUE 10 sharing contract)."""
     bind_ctx = BindContext(columns={
         c.name: ColumnBinding(type=c.type, vocab=chunk.columns[c.name].dictionary)
-        for c in schema}, bindings=shared_bindings)
+        for c in schema}, bindings=shared_bindings,
+        structure=structure if structure is not None else [])
     binder = ExprBinder(bind_ctx)
     return [binder.bind(e) for e in equations]
 
@@ -129,9 +134,13 @@ def null_key_mask(self_keys):
 
 
 def _join_fingerprint(join: ir.JoinClause) -> str:
-    # ir.fingerprint serializes the full JoinClause (equations, alias,
-    # is_left, pulled columns).
-    return ir.fingerprint(ir.Query(
+    # The full JoinClause serialized (equations, alias, is_left, pulled
+    # columns) as a SHAPE fingerprint (ISSUE 10): the phase programs
+    # read equation literals from the shared bindings tuple per call,
+    # and the cache key already carries binding shapes + exact vocab
+    # structure, so one program serves every equation constant.
+    from ytsaurus_tpu.query.parameterize import plan_fingerprint
+    return plan_fingerprint(ir.Query(
         schema=join.foreign_schema, source=join.foreign_table,
         joins=(join,)))
 
@@ -147,10 +156,12 @@ def execute_join(chunk: ColumnarChunk, combined_schema: TableSchema,
     """
     self_schema = chunk.schema
     all_bindings: list = []
+    bind_structure: list = []
     self_bound = _bind_keys(chunk, self_schema, join.self_equations,
-                            all_bindings)
+                            all_bindings, structure=bind_structure)
     f_bound = _bind_keys(foreign_chunk, join.foreign_schema,
-                         join.foreign_equations, all_bindings)
+                         join.foreign_equations, all_bindings,
+                         structure=bind_structure)
     # String keys: remap both sides onto merged vocabularies (host).
     self_slots: list = []
     foreign_slots: list = []
@@ -188,6 +199,11 @@ def execute_join(chunk: ColumnarChunk, combined_schema: TableSchema,
                  foreign_chunk.capacity,
                  tuple(c.name for c in self_schema),
                  vocab_structure,
+                 # Bind-phase structure notebook (ISSUE 10): host
+                 # constants the equation binds BAKE (concat's nb
+                 # multiplier) that neither vocab lengths nor padded
+                 # binding shapes can distinguish.
+                 tuple(bind_structure),
                  tuple((tuple(b.shape), str(b.dtype)) for b in all_bindings))
     entry = cache.get(cache_key)
     if entry is None:

@@ -149,7 +149,8 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                                   agg.type is EValueType.string) else None
             post_columns[agg.name] = ColumnBinding(type=agg.type, vocab=vocab)
         post_binder = ExprBinder(BindContext(columns=post_columns,
-                                             bindings=bind_ctx.bindings))
+                                             bindings=bind_ctx.bindings,
+                                             structure=bind_ctx.structure))
         if plan.having is not None:
             having_b = post_binder.bind(plan.having)
     final_binder = post_binder if post_binder is not None else binder
@@ -204,6 +205,13 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
     offset = plan.offset
     limit = plan.limit
 
+    # Packed-key bit widths per ORDER BY item bake into the sort
+    # program (vocab-length-derived: a trace constant binding shapes
+    # cannot see) — computed once here and noted into the structure key.
+    order_bits = [_order_key_bits(bound) for bound, _desc in order_b]
+    if order_bits:
+        bind_ctx.note("obits", *order_bits)
+
     # --- direct-aggregation fast path ----------------------------------------
     # When every group key has a small known value domain (dictionary codes,
     # booleans), segment ids are computed arithmetically — no sort.  This is
@@ -253,14 +261,37 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
                 fast_group = (tuple(sizes_offsets), tuple(strides), dims,
                               pad_capacity(dims + 1))
 
+    # Plan auto-parameterization (ISSUE 10): OFFSET/LIMIT are static
+    # residue that BUCKETS instead of hoisting — the top-k candidate
+    # count must be a trace constant, so static decisions use the pow2
+    # bucket (>= the actual value) while the exact offset/limit ride as
+    # runtime bindings.  One program then serves every LIMIT within a
+    # bucket, matching the parameterized fingerprint
+    # (ir.fingerprint(omit_values=True) buckets limits the same way).
+    from ytsaurus_tpu.chunks.columnar import next_pow2
+    from ytsaurus_tpu.config import compile_config
+    parameterized = compile_config().parameterize
+    if parameterized:
+        k_static = ((next_pow2(offset) if offset > 0 else 0)
+                    + next_pow2(max(limit, 1))) if limit is not None \
+            else None
+    else:
+        k_static = (offset + limit) if limit is not None else None
+
     # Single-key ORDER BY ... LIMIT k fast path decision (static): full
     # sorts collapse on TPU beyond a few million rows, so select ~2k
     # candidates with lax.top_k and only sort those.
-    k_limit = (offset + limit) if limit is not None else None
+    k_limit = k_static
     group_stage_cap = fast_group[3] if fast_group else capacity
     use_topk = (len(order_b) == 1 and k_limit is not None
                 and 0 < k_limit <= 1024 and group_stage_cap > 4 * k_limit)
     topk_cand_cap = 3 * k_limit if use_topk else None
+
+    offset_slot = limit_slot = None
+    if parameterized:
+        offset_slot = bind_ctx.add(jnp.asarray(np.int64(offset)))
+        if limit is not None:
+            limit_slot = bind_ctx.add(jnp.asarray(np.int64(limit)))
 
     def run(columns: dict, row_valid: jax.Array, bindings: tuple):
         ctx = EmitContext(columns=columns, bindings=bindings, capacity=capacity)
@@ -486,10 +517,9 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             # few u64 words as possible — minimum operands through the
             # device sort network (payload columns are gathered after).
             items = [((~mask), jnp.ones_like(mask), False, 1)]
-            for bound, descending in order_b:
+            for (bound, descending), bits in zip(order_b, order_bits):
                 data, valid = bound.emit(ctx)
-                items.append((data, valid, descending,
-                              _order_key_bits(bound)))
+                items.append((data, valid, descending, bits))
             order_idx = packed_sort_indices(items)
             ctx = EmitContext(
                 columns={name: (d[order_idx], v[order_idx])
@@ -504,12 +534,22 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
 
         # Compact valid rows to the front (stable → preserves sort order).
         comp_idx, total = compact_mask(mask)
-        count = total - offset
+        if offset_slot is not None:
+            # Dynamic offset/limit (read from bindings): clamped to the
+            # stage capacity so the downstream int32 arithmetic is safe.
+            off = jnp.minimum(bindings[offset_slot],
+                              stage_cap).astype(total.dtype)
+        else:
+            off = offset
+        count = total - off
         if limit is not None:
-            count = jnp.minimum(count, limit)
+            lim = jnp.minimum(bindings[limit_slot],
+                              stage_cap).astype(total.dtype) \
+                if limit_slot is not None else limit
+            count = jnp.minimum(count, lim)
         count = jnp.maximum(count, 0)
         out_planes = []
-        shift = jnp.clip(jnp.arange(stage_cap) + offset, 0, stage_cap - 1)
+        shift = jnp.clip(jnp.arange(stage_cap) + off, 0, stage_cap - 1)
         for d, v in planes:
             d = d[comp_idx][shift]
             v = v[comp_idx][shift] & (jnp.arange(stage_cap) < count)
@@ -520,7 +560,9 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
         run=run, bindings=bind_ctx.bindings, output=output, capacity=capacity,
         out_capacity=topk_cand_cap if use_topk else group_stage_cap,
         structure_key=((("fastgrp",) + fast_group[0] if fast_group else ())
-                       + (("topk", k_limit) if use_topk else ())))
+                       + (("topk", k_limit) if use_topk else ())
+                       + (("param", k_static) if parameterized else ())
+                       + tuple(bind_ctx.structure)))
 
 
 def _order_key_bits(bound: BoundExpr) -> int:

@@ -352,36 +352,83 @@ def map_expr(expr, fn):
 # --- fingerprinting -----------------------------------------------------------
 
 
-def _repr_expr(e) -> str:
-    # Deterministic structural serialization; literal VALUES are included
-    # (unlike InferName(omitValues) — capacity bucketing handles shape reuse,
-    # literals change generated code here because they bind vocab lookups).
+# Literal types whose VALUES may be hoisted out of a parameterized
+# fingerprint (query/parameterize.py): the lowering binds these values
+# as runtime binding slots, so the traced program is value-independent.
+# booleans and nulls are STATIC RESIDUE — the lexer keeps true/false/
+# null as keywords (workload.normalize_query never hoists them), and
+# their two-or-one-value domains cannot grow a shape spectrum anyway.
+HOISTABLE_LITERAL_TYPES = frozenset(
+    (EValueType.int64, EValueType.uint64, EValueType.double,
+     EValueType.string))
+
+
+def _repr_expr(e, omit_values: bool = False) -> str:
+    # Deterministic structural serialization.  With omit_values=False
+    # literal VALUES are included (the historical per-constant
+    # fingerprint).  With omit_values=True (the parameterized shape
+    # fingerprint — the analog of InferName(omitValues) feeding the
+    # reference's llvm::FoldingSet profiler) hoistable literal values
+    # collapse to `?`: the lowering passes them as runtime bindings, so
+    # one compiled program serves every constant of the shape.  Counts
+    # stay structural — IN-list membership loops, BETWEEN range lists
+    # and TRANSFORM tables trace a fixed iteration count (IN bucketed
+    # pow2 by the binder; the others exact).
+    def rec(x):
+        return _repr_expr(x, omit_values)
+
     if isinstance(e, TLiteral):
+        if omit_values and e.type in HOISTABLE_LITERAL_TYPES:
+            return f"L({e.type.value},?)"
         return f"L({e.type.value},{e.value!r})"
     if isinstance(e, TReference):
         return f"R({e.name})"
     if isinstance(e, TFunction):
-        return f"F({e.name};{','.join(map(_repr_expr, e.args))})"
+        return f"F({e.name};{','.join(map(rec, e.args))})"
     if isinstance(e, TUnary):
-        return f"U({e.op};{_repr_expr(e.operand)})"
+        return f"U({e.op};{rec(e.operand)})"
     if isinstance(e, TBinary):
-        return f"B({e.op};{_repr_expr(e.lhs)};{_repr_expr(e.rhs)})"
+        return f"B({e.op};{rec(e.lhs)};{rec(e.rhs)})"
     if isinstance(e, TIn):
-        return f"I({','.join(map(_repr_expr, e.operands))};{e.values!r})"
+        if omit_values:
+            from ytsaurus_tpu.chunks.columnar import next_pow2
+            return (f"I({','.join(map(rec, e.operands))};"
+                    f"#{next_pow2(len(e.values))})")
+        return f"I({','.join(map(rec, e.operands))};{e.values!r})"
     if isinstance(e, TBetween):
-        return f"W({','.join(map(_repr_expr, e.operands))};{e.ranges!r};{e.negated})"
+        if omit_values:
+            lens = tuple((len(lo), len(hi)) for lo, hi in e.ranges)
+            return (f"W({','.join(map(rec, e.operands))};#{lens!r};"
+                    f"{e.negated})")
+        return f"W({','.join(map(rec, e.operands))};{e.ranges!r};{e.negated})"
     if isinstance(e, TTransform):
-        return (f"T({','.join(map(_repr_expr, e.operands))};{e.from_values!r};"
-                f"{e.to_values!r};{_repr_expr(e.default) if e.default else ''})")
+        if omit_values:
+            widths = tuple(len(t) for t in e.from_values)
+            return (f"T({','.join(map(rec, e.operands))};#{widths!r};"
+                    f"{rec(e.default) if e.default else ''})")
+        return (f"T({','.join(map(rec, e.operands))};{e.from_values!r};"
+                f"{e.to_values!r};{rec(e.default) if e.default else ''})")
     if isinstance(e, TStringPredicate):
-        return (f"S({e.kind};{_repr_expr(e.operand)};{e.pattern!r};"
+        pattern = "?" if omit_values else repr(e.pattern)
+        return (f"S({e.kind};{rec(e.operand)};{pattern};"
                 f"{e.case_insensitive};{e.negated})")
     if e is None:
         return "-"
     raise TypeError(f"Unknown expr node {type(e).__name__}")
 
 
-def fingerprint(query: "Query | FrontQuery") -> str:
+def fingerprint(query: "Query | FrontQuery",
+                omit_values: bool = False) -> str:
+    """Stable plan fingerprint.  omit_values=True produces the
+    PARAMETERIZED shape fingerprint: hoistable literal values and the
+    exact OFFSET/LIMIT collapse (limits to their pow2 bucket — they
+    shape the compiled program's top-k candidate count, so they are
+    static residue that buckets instead of hoisting).  Callers should
+    normally go through query/parameterize.plan_fingerprint, which
+    consults CompileConfig."""
+    def rec(e):
+        return _repr_expr(e, omit_values)
+
     parts: list[str] = [type(query).__name__]
     parts.append(",".join(f"{c.name}:{c.type.value}" for c in query.schema))
     if isinstance(query, Query):
@@ -389,33 +436,40 @@ def fingerprint(query: "Query | FrontQuery") -> str:
         for j in query.joins:
             parts.append(
                 f"J({j.foreign_table};{j.alias};{j.is_left};"
-                f"{','.join(map(_repr_expr, j.self_equations))};"
-                f"{','.join(map(_repr_expr, j.foreign_equations))};"
+                f"{','.join(map(rec, j.self_equations))};"
+                f"{','.join(map(rec, j.foreign_equations))};"
                 f"{','.join(j.foreign_columns)})")
-        parts.append(_repr_expr(query.where))
+        parts.append(rec(query.where))
     if query.group:
         parts.append("G(" + ";".join(
-            f"{i.name}={_repr_expr(i.expr)}" for i in query.group.group_items) + ")")
+            f"{i.name}={rec(i.expr)}" for i in query.group.group_items) + ")")
         parts.append("A(" + ";".join(
-            f"{a.name}={a.function}({_repr_expr(a.argument) if a.argument else ''}"
-            f";{_repr_expr(a.by_argument) if a.by_argument else ''})"
+            f"{a.name}={a.function}({rec(a.argument) if a.argument else ''}"
+            f";{rec(a.by_argument) if a.by_argument else ''})"
             for a in query.group.aggregate_items) + f";{query.group.totals})")
     if query.window:
         parts.append("WIN(" + ";".join(
-            f"{i.name}={_repr_expr(i.expr)}"
+            f"{i.name}={rec(i.expr)}"
             for i in query.window.partition_items) + "|" + ";".join(
-            f"{_repr_expr(i.expr)}:{i.descending}"
+            f"{rec(i.expr)}:{i.descending}"
             for i in query.window.order_items) + "|" + ";".join(
-            f"{w.name}={w.function}({_repr_expr(w.argument) if w.argument else ''}"
+            f"{w.name}={w.function}({rec(w.argument) if w.argument else ''}"
             f";{w.frame};{w.offset};"
-            f"{_repr_expr(w.default) if w.default else ''})"
+            f"{rec(w.default) if w.default else ''})"
             for w in query.window.items) + ")")
-    parts.append(_repr_expr(query.having))
+    parts.append(rec(query.having))
     if query.order:
         parts.append("O(" + ";".join(
-            f"{_repr_expr(i.expr)}:{i.descending}" for i in query.order.items) + ")")
+            f"{rec(i.expr)}:{i.descending}" for i in query.order.items) + ")")
     if query.project:
         parts.append("P(" + ";".join(
-            f"{i.name}={_repr_expr(i.expr)}" for i in query.project.items) + ")")
-    parts.append(f"{query.offset}/{query.limit}")
+            f"{i.name}={rec(i.expr)}" for i in query.project.items) + ")")
+    if omit_values:
+        from ytsaurus_tpu.chunks.columnar import next_pow2
+        off_b = next_pow2(query.offset) if query.offset > 0 else 0
+        lim_b = next_pow2(max(query.limit, 1)) \
+            if query.limit is not None else None
+        parts.append(f"{off_b}/{lim_b}")
+    else:
+        parts.append(f"{query.offset}/{query.limit}")
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:24]

@@ -1,0 +1,205 @@
+"""Plan auto-parameterization (ISSUE 10 tentpole, piece a).
+
+The JIT pathology this kills: the evaluator's compiled-program cache
+keys on the plan fingerprint, and the historical fingerprint included
+literal VALUES — so a million-users traffic mix of `WHERE user_id = ?`
+with different constants recompiled once per constant ("An Empirical
+Analysis of Just-in-Time Compilation in Modern Databases", arxiv
+2311.04692, measures exactly this; Flare, arxiv 1703.08219, builds the
+compile-caching discipline to escape it).  The reference engine solves
+it with InferName(omitValues) feeding the llvm::FoldingSet profiler
+(folding_profiler.cpp) so one LLVM image serves every constant; this
+module is the XLA analog.
+
+Two cooperating passes share ONE definition of "a hoistable literal":
+
+  text level   `hoist_literals(query_text)` — the lexer pass (THE
+               implementation behind workload.normalize_query): every
+               int/uint/double/string literal TOKEN becomes a `?`
+               placeholder.  true/false/null are keywords, never
+               hoisted.  Workload-log fingerprints hash this text.
+  plan level   `plan_fingerprint(plan)` — ir.fingerprint with
+               omit_values=True: TLiteral values of the same four types
+               (ir.HOISTABLE_LITERAL_TYPES) collapse to `?`, IN-list
+               values to their pow2-bucketed count, BETWEEN/TRANSFORM
+               value lists to their lengths, string-predicate patterns
+               to `?`.  The evaluator caches keyed on this.
+
+Because both hoist the same literal classes, two query texts that
+normalize identically always build plans with identical shape
+fingerprints (test-enforced: the workload plane and the evaluator can
+no longer silently disagree about what "the same query shape" means).
+
+STATIC RESIDUE — values that stay in the shape fingerprint because they
+shape the traced program:
+
+  * boolean / null literals (keywords to the lexer; domains of size
+    <= 2 cannot grow a spectrum);
+  * OFFSET / LIMIT, which bucket pow2 instead of hoisting: the top-k
+    candidate count must be a trace constant, so the lowering sizes it
+    by the bucket and applies the exact offset/limit through runtime
+    bindings (query/engine/lowering.py);
+  * structural counts (IN-list bucket, BETWEEN range lengths,
+    TRANSFORM table widths) — membership loops trace a fixed iteration
+    count.
+
+Correctness contract: the lowering is literal-value-INDEPENDENT — every
+hoisted value reaches the program as a runtime binding (numeric
+literals as 0-d binding slots, strings through bound vocabulary
+tables), and any host constant a bind method does bake is noted into
+the bind-phase structure notebook (expr.BindContext.note), which folds
+into PreparedQuery.structure_key and hence the full cache key
+(fingerprint, capacity bucket, binding shapes, structure).  Two plans
+sharing a cache entry therefore compute the same function of their
+bindings by construction.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ytsaurus_tpu.query import ir
+from ytsaurus_tpu.query.lexer import TokenKind, tokenize
+
+_PLAIN_IDENT = re.compile(r"[A-Za-z_$][A-Za-z0-9_$]*")
+
+_LITERAL_KINDS = {TokenKind.INT: "int64", TokenKind.UINT: "uint64",
+                  TokenKind.DOUBLE: "double", TokenKind.STRING: "string"}
+
+# No space BEFORE these rendered tokens / AFTER these suffixes: purely
+# cosmetic (the token stream is identical either way), but it keeps
+# normalized text readable and fingerprint-stable.
+_NO_SPACE_BEFORE = {",", ")", ".", "]"}
+_NO_SPACE_AFTER = ("(", ".", "[")
+
+
+def hoist_literals(query: str) -> tuple[str, list]:
+    """Hoist literals out of a query text: `(normalized_text, literals)`.
+
+    Literal tokens (int/uint/double/string) become `?` placeholders and
+    land in `literals` as (kind, value) in appearance order — the
+    binding shapes/dtypes of the record.  Keywords upper-case and
+    identifiers re-bracket when exotic, so two queries differing only
+    in constants normalize to ONE text (= one workload fingerprint and,
+    via the matching plan-level pass, one evaluator fingerprint)."""
+    parts: list[str] = []
+    literals: list[tuple[str, object]] = []
+    for tok in tokenize(query):
+        if tok.kind is TokenKind.EOF:
+            break
+        kind = _LITERAL_KINDS.get(tok.kind)
+        if kind is not None:
+            literals.append((kind, tok.value))
+            parts.append("?")
+        elif tok.kind is TokenKind.KEYWORD:
+            parts.append(str(tok.value).upper())
+        elif tok.kind is TokenKind.IDENT:
+            name = str(tok.value)
+            plain = all(_PLAIN_IDENT.fullmatch(seg)
+                        for seg in name.split(".")) if name else False
+            parts.append(name if plain else f"[{name}]")
+        else:
+            parts.append(str(tok.value))
+    text = ""
+    for part in parts:
+        if text and part not in _NO_SPACE_BEFORE \
+                and not text.endswith(_NO_SPACE_AFTER):
+            text += " "
+        text += part
+    return text, literals
+
+
+def plan_fingerprint(plan: "ir.Query | ir.FrontQuery") -> str:
+    """THE compile-cache fingerprint: parameterized (shape) when
+    CompileConfig.parameterize is on, the historical per-constant
+    fingerprint otherwise.  Every compiled-program cache (local
+    evaluator, distributed SPMD evaluator) keys through here so an
+    operator toggling the config reasons about ONE discipline."""
+    from ytsaurus_tpu.config import compile_config
+    return ir.fingerprint(plan,
+                          omit_values=compile_config().parameterize)
+
+
+def hoisted_parameters(plan: "ir.Query | ir.FrontQuery") -> list:
+    """The literal values the parameterized fingerprint hoisted out of
+    `plan`, in deterministic walk order — the plan-level counterpart of
+    hoist_literals()' `literals` (observability/tests; execution reads
+    values straight from the original plan at bind time)."""
+    params: list = []
+
+    def visit(expr) -> None:
+        if expr is None:
+            return
+        if isinstance(expr, ir.TLiteral):
+            if expr.type in ir.HOISTABLE_LITERAL_TYPES:
+                params.append((expr.type.value, expr.value))
+            return
+        if isinstance(expr, ir.TIn):
+            for o in expr.operands:
+                visit(o)
+            for tup in expr.values:
+                for v in tup:
+                    params.append(("in", v))
+            return
+        if isinstance(expr, ir.TBetween):
+            for o in expr.operands:
+                visit(o)
+            for lo, hi in expr.ranges:
+                for v in (*lo, *hi):
+                    params.append(("between", v))
+            return
+        if isinstance(expr, ir.TTransform):
+            for o in expr.operands:
+                visit(o)
+            for tup in expr.from_values:
+                for v in tup:
+                    params.append(("transform", v))
+            for v in expr.to_values:
+                params.append(("transform_to", v))
+            visit(expr.default)
+            return
+        if isinstance(expr, ir.TStringPredicate):
+            visit(expr.operand)
+            params.append(("pattern", expr.pattern))
+            return
+        import dataclasses as _dc
+        if not isinstance(expr, ir.TExpr):
+            return
+        for f in _dc.fields(expr):
+            value = getattr(expr, f.name)
+            if isinstance(value, ir.TExpr):
+                visit(value)
+            elif isinstance(value, (tuple, list)):
+                for item in value:
+                    if isinstance(item, ir.TExpr):
+                        visit(item)
+
+    def visit_named(items) -> None:
+        for item in items:
+            visit(item.expr)
+
+    if isinstance(plan, ir.Query):
+        for j in plan.joins:
+            for e in (*j.self_equations, *j.foreign_equations):
+                visit(e)
+        visit(plan.where)
+    if plan.group is not None:
+        visit_named(plan.group.group_items)
+        for agg in plan.group.aggregate_items:
+            visit(agg.argument)
+            visit(agg.by_argument)
+    if plan.window is not None:
+        visit_named(plan.window.partition_items)
+        for oi in plan.window.order_items:
+            visit(oi.expr)
+        for w in plan.window.items:
+            visit(w.argument)
+            visit(w.default)
+    visit(plan.having)
+    if plan.order is not None:
+        for oi in plan.order.items:
+            visit(oi.expr)
+    if plan.project is not None:
+        visit_named(plan.project.items)
+    return params

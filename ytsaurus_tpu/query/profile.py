@@ -136,7 +136,8 @@ def format_profile_dict(p: dict) -> str:
     causes = [(label, stats.get(key, 0)) for label, key in
               (("new_fingerprint", "compile_new_fingerprint"),
                ("new_shape", "compile_new_shape"),
-               ("evicted", "compile_evicted"))]
+               ("evicted", "compile_evicted"),
+               ("disk_hit", "compile_disk_hit"))]
     buckets = stats.get("capacity_buckets") or []
     if any(n for _label, n in causes) or buckets:
         cause_str = ", ".join(f"{label} {n}" for label, n in causes

@@ -23,6 +23,10 @@ class QueryStatistics:
     compile_new_fingerprint: int = 0
     compile_new_shape: int = 0
     compile_evicted: int = 0
+    # Memory misses served by the persistent artifact tier (ISSUE 10):
+    # deserialized ready executables, no fresh compile burn.  Fresh
+    # compiles for a query = compile_count - compile_disk_hit.
+    compile_disk_hit: int = 0
     shards_total: int = 0
     shards_pruned: int = 0
     shards_skipped: int = 0          # LIMIT early-exit left these unread

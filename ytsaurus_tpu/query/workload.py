@@ -44,14 +44,13 @@ import hashlib
 import json
 import os
 import random
-import re
 import threading
 import time
 from collections import deque
 from typing import Optional, Sequence
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
-from ytsaurus_tpu.query.lexer import TokenKind, tokenize
+from ytsaurus_tpu.query.parameterize import hoist_literals
 from ytsaurus_tpu.utils.profiling import Profiler
 
 # Bump when the record shape changes incompatibly: `load_capture` (and
@@ -76,51 +75,11 @@ COMPILE_STORM_SLO = {
 
 # -- query normalization -------------------------------------------------------
 
-_PLAIN_IDENT = re.compile(r"[A-Za-z_$][A-Za-z0-9_$]*")
-
-_LITERAL_KINDS = {TokenKind.INT: "int64", TokenKind.UINT: "uint64",
-                  TokenKind.DOUBLE: "double", TokenKind.STRING: "string"}
-
-# No space BEFORE these rendered tokens / AFTER these suffixes: purely
-# cosmetic (the token stream is identical either way), but it keeps
-# normalized text readable and fingerprint-stable.
-_NO_SPACE_BEFORE = {",", ")", ".", "]"}
-_NO_SPACE_AFTER = ("(", ".", "[")
-
-
-def normalize_query(query: str) -> tuple[str, list]:
-    """Hoist literals out of a query: `(normalized_text, literals)`.
-
-    Literal tokens (int/uint/double/string) become `?` placeholders and
-    land in `literals` as (kind, value) in appearance order — the
-    binding shapes/dtypes of the record.  Keywords upper-case and
-    identifiers re-bracket when exotic, so two queries differing only
-    in constants normalize to ONE text (= one workload fingerprint)."""
-    parts: list[str] = []
-    literals: list[tuple[str, object]] = []
-    for tok in tokenize(query):
-        if tok.kind is TokenKind.EOF:
-            break
-        kind = _LITERAL_KINDS.get(tok.kind)
-        if kind is not None:
-            literals.append((kind, tok.value))
-            parts.append("?")
-        elif tok.kind is TokenKind.KEYWORD:
-            parts.append(str(tok.value).upper())
-        elif tok.kind is TokenKind.IDENT:
-            name = str(tok.value)
-            plain = all(_PLAIN_IDENT.fullmatch(seg)
-                        for seg in name.split(".")) if name else False
-            parts.append(name if plain else f"[{name}]")
-        else:
-            parts.append(str(tok.value))
-    text = ""
-    for part in parts:
-        if text and part not in _NO_SPACE_BEFORE \
-                and not text.endswith(_NO_SPACE_AFTER):
-            text += " "
-        text += part
-    return text, literals
+# THE literal-hoisting implementation lives in query/parameterize.py
+# (ISSUE 10 satellite): the workload recorder's text normalization and
+# the evaluator's plan parameterization share it, so the two planes
+# can never silently disagree about what "the same query shape" means.
+normalize_query = hoist_literals
 
 
 def render_literal(kind: str, value) -> str:
@@ -643,8 +602,8 @@ def replay(client, records: Sequence[WorkloadRecord],
     lock = threading.Lock()
     latencies: list[float] = []
     outcomes = {"ok": 0, "error": 0, "throttled": 0, "deadline": 0}
-    steady = {"hits": 0, "misses": 0}
-    total = {"hits": 0, "misses": 0}
+    steady = {"hits": 0, "misses": 0, "disk_hits": 0}
+    total = {"hits": 0, "misses": 0, "disk_hits": 0}
     slow_heap: list[tuple[float, dict]] = []
     steady_from = len(records) // 2
 
@@ -681,11 +640,14 @@ def replay(client, records: Sequence[WorkloadRecord],
             latencies.append(elapsed)
             hits = int(stats.get("cache_hits", 0))
             misses = int(stats.get("compile_count", 0))
+            disk_hits = int(stats.get("compile_disk_hit", 0))
             total["hits"] += hits
             total["misses"] += misses
+            total["disk_hits"] += disk_hits
             if idx >= steady_from:
                 steady["hits"] += hits
                 steady["misses"] += misses
+                steady["disk_hits"] += disk_hits
             slow_heap.append((elapsed, {
                 "query": query_text[:200],
                 "fingerprint": rec.fingerprint,
@@ -739,9 +701,16 @@ def replay(client, records: Sequence[WorkloadRecord],
         },
         "compile_cache": {
             **{k: v for k, v in total.items()},
+            # Misses the persistent tier served (deserialize, no
+            # compile) vs programs actually built: the restart-warm-
+            # start acceptance reads fresh_compiles ~ 0 (ISSUE 10).
+            "fresh_compiles": total["misses"] - total["disk_hits"],
             "hit_rate": hit_rate(total),
             "steady_hits": steady["hits"],
             "steady_misses": steady["misses"],
+            "steady_disk_hits": steady["disk_hits"],
+            "steady_fresh_compiles":
+                steady["misses"] - steady["disk_hits"],
             "steady_hit_rate": hit_rate(steady),
         },
         "slowest": [entry for _t, entry in slow_heap[:max(slowest, 1)]],

@@ -856,11 +856,12 @@ def _pad_needles(values: list, bucket_min: int) -> list:
     mask).  Bucketing bounds the SPECTRUM of probe shapes to O(log
     max_batch) variants — the discipline that keeps a shape-keyed
     compiled-gather cache bounded when this probe lowers to a device
-    gather (and what the serving plane's micro-batches rely on)."""
+    gather (and what the serving plane's micro-batches rely on).
+    Buckets come from chunks.columnar.next_pow2 — the ONE pow2
+    implementation chunk capacities and vocab paddings also use."""
+    from ytsaurus_tpu.chunks.columnar import next_pow2
     n = len(values)
-    cap = max(1, bucket_min)
-    while cap < n:
-        cap <<= 1
+    cap = next_pow2(n, floor=bucket_min)
     if cap == n:
         return values
     return values + [values[-1]] * (cap - n)
