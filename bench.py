@@ -40,6 +40,12 @@ config, printing the headline (TPC-H Q1, config 1) last:
           >=99%), and a restart-warm-start leg in a SECOND process on
           the same artifact dir (asserts ~0 fresh compiles, disk hits
           only); metric is the parameterized replay throughput
+  whole_plan  whole-plan fused SPMD execution (ISSUE 12): q1/groupby-
+          class plans on the virtual 8-device CPU mesh, fused
+          one-program lowering vs BOTH stitched rungs (shuffle +
+          gather), asserting fused >=2x the best stitched rung and
+          exactly one host sync per fused query; metric is the fused
+          groupby-class throughput
   telemetry_overhead  cluster telemetry plane (ISSUE 6): asserts the
           per-site sensor-recording cost ≲1µs and the per-query
           accounting fold ≲20µs, then runs the serving lookup shape
@@ -1005,6 +1011,137 @@ def bench_serving_steady_child(parent_root, n_rows):
     return client
 
 
+def bench_whole_plan(n_rows, iters):
+    """Whole-plan fused SPMD execution (ISSUE 12): q1/groupby-class
+    plans on the virtual 8-device CPU mesh, three legs per plan —
+
+      stitched-shuffle  CompileConfig.whole_plan OFF, prefer_shuffle
+                        (the pre-PR default ladder rung: count program
+                        + quota host-sync + exchange program)
+      stitched-gather   whole_plan OFF, gather-merge rung
+      fused             whole_plan ON: ONE jit(shard_map) program, one
+                        final stacked host transfer
+
+    The mesh legs run in a CHILD process (the bench parent is a
+    single-device backend; the child forces 8 virtual CPU devices).
+    Acceptance: fused ≥2× the BEST stitched rung for both plan classes
+    and exactly 1 host sync per fused query (the stitched rungs pay 2).
+    Metric is the fused groupby-class throughput."""
+    import subprocess as _subprocess
+
+    child_src = f"""
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json, time
+import numpy as np
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.parallel.mesh import make_mesh
+from ytsaurus_tpu.parallel.distributed import (
+    DistributedEvaluator, coordinate_distributed, host_sync_count)
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.statistics import QueryStatistics
+from ytsaurus_tpu.schema import TableSchema
+
+N = {n_rows}
+ITERS = {max(int(iters), 3)}
+mesh = make_mesh(8)
+rng = np.random.default_rng(1)
+per = N // 8
+
+gb_schema = TableSchema.make([("k", "int64", "ascending"),
+                              ("g", "int64"), ("v", "int64")])
+# Group domain scales with N (~100 rows per group) so smoke-sized runs
+# keep the same rows:groups ratio as the default config.
+n_groups = max(64, N // 100)
+gb_chunks = [ColumnarChunk.from_arrays(gb_schema, {{
+    "k": np.arange(per) + s * per,
+    "g": rng.integers(0, n_groups, per),
+    "v": rng.integers(0, 1000, per)}}) for s in range(8)]
+gb_plan = build_query(
+    "g, sum(v) AS s, count(*) AS c FROM [//t] GROUP BY g",
+    {{"//t": gb_schema}})
+
+q1_schema = TableSchema.make([("rf", "int64"), ("ls", "int64"),
+                              ("qty", "double"), ("price", "double")])
+q1_chunks = [ColumnarChunk.from_arrays(q1_schema, {{
+    "rf": rng.integers(0, 3, per), "ls": rng.integers(0, 2, per),
+    "qty": rng.uniform(1, 50, per),
+    "price": rng.uniform(1, 1e5, per)}}) for s in range(8)]
+q1_plan = build_query(
+    "rf, ls, sum(qty) AS sq, sum(price) AS sp, avg(qty) AS aq, "
+    "avg(price) AS ap, count(*) AS c FROM [//t] GROUP BY rf, ls",
+    {{"//t": q1_schema}})
+
+
+def leg(plan, chunks, whole, prefer_shuffle=True):
+    yt_config.set_compile_config(
+        yt_config.CompileConfig(whole_plan=whole))
+    de = DistributedEvaluator(mesh)
+    stats = QueryStatistics()
+    out = coordinate_distributed(plan, mesh, chunks, evaluator=de,
+                                 prefer_shuffle=prefer_shuffle,
+                                 stats=stats)                  # warm-up
+    times = []
+    s0 = host_sync_count()
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = coordinate_distributed(plan, mesh, chunks, evaluator=de,
+                                     prefer_shuffle=prefer_shuffle)
+        np.asarray(next(iter(out.columns.values())).data[:1])
+        times.append(time.perf_counter() - t0)
+    return {{"best_s": min(times),
+             "syncs_per_query": (host_sync_count() - s0) / ITERS,
+             "whole_plan": stats.whole_plan, "rows": out.row_count}}
+
+
+report = {{}}
+for name, plan, chunks in (("groupby", gb_plan, gb_chunks),
+                           ("q1", q1_plan, q1_chunks)):
+    report[name] = {{
+        "stitched_shuffle": leg(plan, chunks, False, True),
+        "stitched_gather": leg(plan, chunks, False, False),
+        "fused": leg(plan, chunks, True),
+    }}
+print("REPORT " + json.dumps(report), flush=True)
+"""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = _subprocess.run(
+        [sys.executable, "-c", child_src],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=3000, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    report = json.loads(
+        [ln for ln in proc.stdout.splitlines()
+         if ln.startswith("REPORT ")][-1][len("REPORT "):])
+    for name, legs in report.items():
+        fused = legs["fused"]
+        best_stitched = min(legs["stitched_shuffle"]["best_s"],
+                            legs["stitched_gather"]["best_s"])
+        speedup = best_stitched / fused["best_s"]
+        print(f"# whole_plan {name}: stitched-shuffle "
+              f"{legs['stitched_shuffle']['best_s']*1e3:.0f}ms "
+              f"({legs['stitched_shuffle']['syncs_per_query']:.0f} "
+              f"syncs/query), stitched-gather "
+              f"{legs['stitched_gather']['best_s']*1e3:.0f}ms, fused "
+              f"{fused['best_s']*1e3:.0f}ms "
+              f"({fused['syncs_per_query']:.0f} sync/query, "
+              f"{n_rows / fused['best_s']:.0f} rows/s) -> "
+              f"{speedup:.2f}x vs best stitched rung", file=sys.stderr)
+        assert fused["whole_plan"] == 1, name
+        assert fused["syncs_per_query"] == 1.0, \
+            f"{name}: fused path must host-sync exactly once per query"
+        assert legs["stitched_shuffle"]["syncs_per_query"] >= 2.0, name
+        assert speedup >= 2.0, \
+            (f"{name}: fused {fused['best_s']:.3f}s not >=2x best "
+             f"stitched {best_stitched:.3f}s")
+    best = report["groupby"]["fused"]["best_s"]
+    return "whole_plan_rows_per_sec", n_rows / best, best
+
+
 def bench_scan(n_rows, iters):
     """Versioned MVCC read path (ISSUE 4): snapshot reads over a tablet
     with three flushed version generations (overwrites, deletes, partial
@@ -1115,6 +1252,7 @@ _CONFIGS = {
     "telemetry_overhead": (bench_telemetry_overhead, 200_000, 100_000),
     "replay": (bench_replay, 200_000, 100_000),
     "serving_steady": (bench_serving_steady, 200_000, 100_000),
+    "whole_plan": (bench_whole_plan, 8_000_000, 1_000_000),
 }
 
 
@@ -1234,6 +1372,7 @@ _METRIC_NAMES = {
     "telemetry_overhead": "telemetry_overhead_rows_per_sec",
     "replay": "replay_queries_per_sec",
     "serving_steady": "serving_steady_queries_per_sec",
+    "whole_plan": "whole_plan_rows_per_sec",
 }
 
 

@@ -307,6 +307,59 @@ def test_host_sync_waiver(tmp_path):
     assert jax_hazards.run([f]) == []
 
 
+def test_whole_plan_sync_rule(tmp_path):
+    """ISSUE 12: inside the whole-plan module, any host sync outside the
+    sanctioned final count read is a `whole-plan-sync` finding (the
+    stricter rule REPLACES host-sync there — `finish`-style sync-point
+    names are no escape hatch)."""
+    f = fixture(tmp_path, "ytsaurus_tpu/parallel/whole_plan.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def _run_exchange(columns, quota):
+            counts = jnp.stack([c.sum() for c in columns])
+            quota = int(counts.max())            # mid-plan sync: finding
+            return quota
+
+        def finish(pending):
+            return np.asarray(pending.count)     # NOT sanctioned here
+
+        def _read_counts(final):
+            vals = np.asarray(final)             # THE sanctioned sync
+            return int(vals[0]), int(vals[1])
+    """)
+    findings = jax_hazards.run([f])
+    assert rules_of(findings) == ["whole-plan-sync"] * 2
+    assert {f_.line for f_ in findings} == {7, 11}
+    assert all("host-sync" not in f_.rule for f_ in findings)
+
+
+def test_whole_plan_sync_waiver_and_clean(tmp_path):
+    f = fixture(tmp_path, "ytsaurus_tpu/parallel/whole_plan.py", """
+        import numpy as np
+
+        def _prepare(pivots):
+            # analyze: allow(whole-plan-sync): pivot sampling happens at prepare time, not between stages
+            return np.asarray(pivots.data)
+
+        def _read_counts(final):
+            vals = np.asarray(final)
+            return int(vals[0])
+    """)
+    assert jax_hazards.run([f]) == []
+
+
+def test_whole_plan_module_baseline_is_empty():
+    """The REAL whole-plan module carries zero mid-plan syncs (the
+    acceptance gate: the only transfer is the final stacked count
+    read)."""
+    files = load_files(REPO, rel_paths=["ytsaurus_tpu/parallel/"
+                                        "whole_plan.py"])
+    findings = [f for f in jax_hazards.run(files)
+                if f.rule == "whole-plan-sync"]
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_traced_branch_flagged(tmp_path):
     f = fixture(tmp_path, "ytsaurus_tpu/ops/fix_traced.py", """
         import jax

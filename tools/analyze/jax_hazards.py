@@ -33,6 +33,11 @@ Rules
                   the bound went through a pow2 bucketing helper
                   (`pad_capacity`, `next_pow2`, ...).  Checked
                   tree-wide.
+  whole-plan-sync in the whole-plan SPMD modules (ISSUE 12) the fused
+                  program permits exactly ONE device→host transfer —
+                  the final stacked count read (`_read_counts`); any
+                  other sync site is a finding (it would re-stitch the
+                  plan).  Replaces the generic host-sync rule there.
 """
 
 from __future__ import annotations
@@ -60,6 +65,14 @@ HOT_PREFIXES = (
 SYNC_POINT_FUNCTIONS = {
     "finish", "finish_all", "to_rows",
 }
+
+# Whole-plan SPMD modules (ISSUE 12): the fused program must not sync
+# BETWEEN stages — the one permitted device→host transfer is the final
+# stacked count read.  These modules get the stricter `whole-plan-sync`
+# rule (one sanctioned function, empty baseline) instead of the generic
+# hot-path host-sync rule.
+WHOLE_PLAN_MODULES = ("ytsaurus_tpu/parallel/whole_plan.py",)
+WHOLE_PLAN_SYNC_FUNCTIONS = {"_read_counts"}
 
 # Names that neutralize a dynamic slice bound: the repo's pow2
 # capacity-bucketing helpers.
@@ -115,18 +128,10 @@ def _is_hostlike(node: ast.AST) -> bool:
     return False
 
 
-def _check_host_sync(f: SourceFile, findings: "list[Finding]") -> None:
-    # Function-granular allowlist: sites inside a declared sync-point
-    # function are sanctioned.
-    sync_ranges: list[tuple[int, int]] = []
-    for node in ast.walk(f.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name in SYNC_POINT_FUNCTIONS:
-            sync_ranges.append((node.lineno, node.end_lineno or node.lineno))
-
-    def sanctioned(line: int) -> bool:
-        return any(lo <= line <= hi for lo, hi in sync_ranges)
-
+def _sync_sites(f: SourceFile):
+    """Yield (line, description) for every device→host sync site in a
+    module — the shared detector behind the host-sync and
+    whole-plan-sync rules."""
     # Per-FUNCTION jnp-name inference, mapped back to line ranges: a
     # numpy-only helper must not inherit another function's jax names.
     fn_ranges: list[tuple[int, int, set[str]]] = []
@@ -148,8 +153,6 @@ def _check_host_sync(f: SourceFile, findings: "list[Finding]") -> None:
         if not isinstance(node, ast.Call):
             continue
         line = node.lineno
-        if sanctioned(line) or f.waived("host-sync", line):
-            continue
         callee = dotted_name(node.func)
         site = None
         if callee.endswith(".item") and not node.args:
@@ -169,11 +172,59 @@ def _check_host_sync(f: SourceFile, findings: "list[Finding]") -> None:
                 site = (f"`{callee}()` on a jax expression forces a "
                         f"device→host sync")
         if site is not None:
-            findings.append(Finding(
-                PASS_NAME, "host-sync", f.path, line,
-                f"{site}; hot-path modules must sync only at declared "
-                f"sync points — waive with `# analyze: "
-                f"allow(host-sync): reason` if intentional"))
+            yield line, site
+
+
+def _function_ranges(tree: ast.AST, names: "set[str]"
+                     ) -> "list[tuple[int, int]]":
+    out: list[tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names:
+            out.append((node.lineno, node.end_lineno or node.lineno))
+    return out
+
+
+def _check_host_sync(f: SourceFile, findings: "list[Finding]") -> None:
+    # Function-granular allowlist: sites inside a declared sync-point
+    # function are sanctioned.
+    sync_ranges = _function_ranges(f.tree, SYNC_POINT_FUNCTIONS)
+
+    def sanctioned(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in sync_ranges)
+
+    for line, site in _sync_sites(f):
+        if sanctioned(line) or f.waived("host-sync", line):
+            continue
+        findings.append(Finding(
+            PASS_NAME, "host-sync", f.path, line,
+            f"{site}; hot-path modules must sync only at declared "
+            f"sync points — waive with `# analyze: "
+            f"allow(host-sync): reason` if intentional"))
+
+
+def _check_whole_plan_sync(f: SourceFile,
+                           findings: "list[Finding]") -> None:
+    """ISSUE 12: the fused SPMD program body must not synchronize
+    between stages — the single sanctioned transfer is the final
+    stacked count read (`_read_counts`).  Stricter than host-sync: no
+    function-name escape hatch beyond that one reader; anything else
+    needs a reasoned waiver."""
+    sanctioned_ranges = _function_ranges(f.tree, WHOLE_PLAN_SYNC_FUNCTIONS)
+
+    def sanctioned(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in sanctioned_ranges)
+
+    for line, site in _sync_sites(f):
+        if sanctioned(line) or f.waived("whole-plan-sync", line):
+            continue
+        findings.append(Finding(
+            PASS_NAME, "whole-plan-sync", f.path, line,
+            f"{site}; the whole-plan fused program permits exactly ONE "
+            f"host sync — the final stacked count transfer in "
+            f"{', '.join(sorted(WHOLE_PLAN_SYNC_FUNCTIONS))} — waive "
+            f"with `# analyze: allow(whole-plan-sync): reason` if "
+            f"intentional"))
 
 
 def _jitted_functions(tree: ast.AST):
@@ -322,7 +373,11 @@ def _check_dynamic_shapes(f: SourceFile,
 def run(files: "list[SourceFile]") -> "list[Finding]":
     findings: list[Finding] = []
     for f in files:
-        if is_hot(f.path):
+        if f.path in WHOLE_PLAN_MODULES:
+            # The stricter whole-plan rule REPLACES the generic hot-path
+            # rule here (one sanctioned sync, not a function set).
+            _check_whole_plan_sync(f, findings)
+        elif is_hot(f.path):
             _check_host_sync(f, findings)
         # Dynamic-shape is TREE-WIDE (ISSUE 10): bucketing is universal
         # now, so an unbucketed capacity is a finding wherever it lives.

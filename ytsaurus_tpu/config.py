@@ -540,12 +540,23 @@ class CompileConfig(YsonStruct):
       so eviction is LRU-ish).
     - `disk_cache_min_compile_seconds`: programs that compiled faster
       than this are not worth a disk round-trip; 0 persists everything
-      (tests)."""
+      (tests).
+    - `whole_plan`: lower fusable distributed plans as ONE
+      jit(shard_map) program (parallel/whole_plan.py, ISSUE 12) — the
+      top rung of the degradation ladder.  Off forces the stitched
+      rungs (bench A/B leg, escape hatch).
+    - `whole_plan_headroom`: multiplier on the observed/estimated
+      exchange transfer-matrix maximum when sizing the fused program's
+      static all_to_all quota; larger values absorb more demand jitter
+      per compiled quota rung, smaller values keep the exchange
+      buffers tighter."""
 
     parameterize = param(True, type=bool)
     disk_cache_dir = param(None, type=str)
     disk_cache_capacity_bytes = param(256 << 20, type=int, ge=0)
     disk_cache_min_compile_seconds = param(0.0, type=float, ge=0.0)
+    whole_plan = param(True, type=bool)
+    whole_plan_headroom = param(1.5, type=float, ge=1.0)
 
 
 _COMPILE_CONFIG: "Optional[CompileConfig]" = None

@@ -56,6 +56,22 @@ _FP_ALL_TO_ALL = failpoints.register_site("parallel.all_to_all",
 _FP_GATHER = failpoints.register_site("parallel.gather",
                                       error=_exchange_error)
 
+# Mid-plan host-sync accounting (ISSUE 12): every blocking device→host
+# read a distributed query performs notes here — the stitched rungs pay
+# one per exchange-quota decision plus the final count; the whole-plan
+# path pays exactly one (the final stacked transfer).  A plain counter
+# (not a sensor): `bench.py --config whole_plan` reads deltas.
+_host_syncs_n = 0
+
+
+def _note_host_sync() -> None:
+    global _host_syncs_n
+    _host_syncs_n += 1
+
+
+def host_sync_count() -> int:
+    return _host_syncs_n
+
 
 @dataclass
 class _RepColumn:
@@ -216,6 +232,69 @@ class DistributedEvaluator:
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
         self._cache: dict = {}
+        # Settled exchange quotas per whole-plan shape (parallel/
+        # whole_plan.py): the data-dependent decision the stitched path
+        # host-syncs for, memoized instead of measured per query.
+        self._quota_memo: dict = {}
+        # Per-process compile split for the restart acceptance leg: a
+        # warm-started daemon serves SPMD plans with fresh_compiles == 0.
+        self.fresh_compiles = 0
+        self.disk_hits = 0
+
+    def _dispatch_spmd(self, key: tuple, build, args):
+        """Run one SPMD program through the compile-once ladder (ISSUE
+        10, extended to the distributed plane): memory cache → AOT disk
+        tier (`aot_cache.py` — serialize_executable products of
+        `lower().compile()`, so a rolling restart or a mesh resize is a
+        cache fill) → fresh compile.  `build()` returns the un-jitted
+        program; `args` are the concrete call arguments AOT lowering
+        pins shapes from."""
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._compile_spmd(key, build, args)
+        try:
+            return fn(*args)
+        except Exception:
+            if hasattr(fn, "lower"):
+                raise             # plain jitted fn: a genuine error
+            # AOT-compiled executable rejects an aval drift the cache
+            # key did not capture: rebuild through the tolerant jit
+            # wrapper (a genuine execution error re-raises identically).
+            # This IS a fresh compile — count it, or a rotten disk tier
+            # could report a perfect warm start while recompiling
+            # everything.
+            fn = jax.jit(build())
+            self.fresh_compiles += 1
+            self._cache[key] = fn
+            return fn(*args)
+
+    def _compile_spmd(self, key: tuple, build, args):
+        import time as _time
+
+        from ytsaurus_tpu.query.engine.aot_cache import get_disk_cache
+        disk = get_disk_cache()
+        fn = disk.load(key) if disk is not None else None
+        if fn is not None:
+            self.disk_hits += 1
+        else:
+            jitted = jax.jit(build())
+            t0 = _time.perf_counter()
+            lowered = None
+            try:
+                lowered = jitted.lower(*args)
+                fn = lowered.compile()
+            except Exception:   # noqa: BLE001 — AOT is an optimization;
+                # anything it cannot lower OR compile falls back to the
+                # jit wrapper (first call compiles fused); lowered must
+                # reset or the store below would serialize the wrapper.
+                fn = jitted
+                lowered = None
+            self.fresh_compiles += 1
+            if disk is not None and lowered is not None:
+                disk.store(key, fn, str(key[0]),
+                           _time.perf_counter() - t0)
+        self._cache[key] = fn
+        return fn
 
     def run(self, plan: ir.Query, table: ShardedTable,
             foreign_chunks: Optional[dict] = None,
@@ -304,17 +383,16 @@ class DistributedEvaluator:
                cap, prepared_b.binding_shapes(),
                prepared_f.binding_shapes(),
                join_setup.fingerprint if join_setup else None)
-        fn = self._cache.get(key)
-        if fn is None:
-            fn = self._build(prepared_b, prepared_f, cap, join_setup)
-            self._cache[key] = fn
         columns = {c.name: columns_global[c.name]
                    for c in bottom.schema if c.name in columns_global}
         extra = (join_setup.args, tuple(join_setup.bindings)) \
             if join_setup else ()
-        out_planes, out_count = fn(columns, row_valid,
-                                   tuple(prepared_b.bindings),
-                                   tuple(prepared_f.bindings), *extra)
+        out_planes, out_count = self._dispatch_spmd(
+            key, lambda: self._build(prepared_b, prepared_f, cap,
+                                     join_setup),
+            (columns, row_valid, tuple(prepared_b.bindings),
+             tuple(prepared_f.bindings), *extra))
+        _note_host_sync()
         return _assemble_chunk(prepared_f.output, out_planes, out_count)
 
     def _run_partitioned(self, plan: ir.Query, table: ShardedTable,
@@ -465,16 +543,15 @@ class DistributedEvaluator:
                         tuple(bind_structure),
                         tuple((tuple(b.shape), str(b.dtype))
                               for b in bindings))
-            cfn = self._cache.get(key_base + ("count",))
-            if cfn is None:
-                cfn = jax.jit(shard_map(
+            counts_s, counts_f = self._dispatch_spmd(
+                key_base + ("count",),
+                lambda: shard_map(
                     count_pass, mesh=mesh,
                     in_specs=(P(SHARD_AXIS),) * 4 + (P(),),
                     out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-                    check_vma=False))
-                self._cache[key_base + ("count",)] = cfn
-            counts_s, counts_f = cfn(columns_global, row_valid, f_global,
-                                     f_row_valid, bnd)
+                    check_vma=False),
+                (columns_global, row_valid, f_global, f_row_valid, bnd))
+            _note_host_sync()
             # One stacked device→host transfer for both quotas (the
             # `yt analyze` jax pass flagged the original pair of
             # np.asarray reads — the self and foreign counts each
@@ -508,16 +585,15 @@ class DistributedEvaluator:
                 return (recv_s, mask_s, recv_f, f_order, lo, counts,
                         per_row.sum()[None])
 
-            pfn = self._cache.get(key_base + ("probe", quota_s, quota_f))
-            if pfn is None:
-                pfn = jax.jit(shard_map(
+            (recv_s, mask_s, recv_f, f_order, lo, counts,
+             totals) = self._dispatch_spmd(
+                key_base + ("probe", quota_s, quota_f),
+                lambda: shard_map(
                     route_probe, mesh=mesh,
                     in_specs=(P(SHARD_AXIS),) * 4 + (P(),),
-                    out_specs=(P(SHARD_AXIS),) * 7, check_vma=False))
-                self._cache[key_base + ("probe", quota_s, quota_f)] = pfn
-            (recv_s, mask_s, recv_f, f_order, lo, counts,
-             totals) = pfn(columns_global, row_valid, f_global,
-                           f_row_valid, bnd)
+                    out_specs=(P(SHARD_AXIS),) * 7, check_vma=False),
+                (columns_global, row_valid, f_global, f_row_valid, bnd))
+            _note_host_sync()
             # analyze: allow(host-sync): join output capacity is a host decision — one totals transfer
             out_cap = pad_capacity(max(int(np.asarray(totals).max()), 1))
             self_names = sorted(columns_global)
@@ -547,17 +623,13 @@ class DistributedEvaluator:
                     out[flat] = (d[f_row], v[f_row] & live & matched)
                 return out, live
 
-            efn = self._cache.get(
-                key_base + ("expand", quota_s, quota_f, out_cap))
-            if efn is None:
-                efn = jax.jit(shard_map(
+            columns_global, row_valid = self._dispatch_spmd(
+                key_base + ("expand", quota_s, quota_f, out_cap),
+                lambda: shard_map(
                     expand, mesh=mesh,
                     in_specs=(P(SHARD_AXIS),) * 6,
-                    out_specs=P(SHARD_AXIS), check_vma=False))
-                self._cache[
-                    key_base + ("expand", quota_s, quota_f, out_cap)] = efn
-            columns_global, row_valid = efn(recv_s, mask_s, recv_f,
-                                            f_order, lo, counts)
+                    out_specs=P(SHARD_AXIS), check_vma=False),
+                (recv_s, mask_s, recv_f, f_order, lo, counts))
             cur_cap = out_cap
             for flat, fname in flat_names:
                 fcol = foreign.columns[fname]
@@ -659,16 +731,26 @@ class DistributedEvaluator:
             pid = (acc % np.uint64(n)).astype(jnp.int32)
             return jnp.where(mask, pid, n), mask
 
-        # Pass 1: transfer matrix → exact quota.
+        # Pass 1: transfer matrix → exact quota.  Cached + AOT-tiered
+        # like every SPMD program (a fresh closure per query used to
+        # defeat jax.jit's identity cache — the count pass silently
+        # recompiled on every shuffled query).
         def count_pass(columns, row_valid, bnd):
             pid, mask = dest_ids(columns, row_valid, bnd)
             return transfer_counts(pid, mask, n)
 
-        counts = jax.jit(shard_map(
-            count_pass, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
-            out_specs=P(SHARD_AXIS), check_vma=False))(
-                columns_global, row_valid, bindings)
+        count_key = ("shuffled-count", plan_fingerprint(plan), n, cap,
+                     tuple(bind_ctx.structure),
+                     tuple((tuple(b.shape), str(b.dtype))
+                           for b in bindings))
+        counts = self._dispatch_spmd(
+            count_key,
+            lambda: shard_map(
+                count_pass, mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+                out_specs=P(SHARD_AXIS), check_vma=False),
+            (columns_global, row_valid, bindings))
+        _note_host_sync()
         # analyze: allow(host-sync): all_to_all quota is a host decision — one transfer-matrix read
         quota = pad_capacity(max(int(np.asarray(counts).max()), 1))
         recv_cap = quota * n
@@ -717,16 +799,16 @@ class DistributedEvaluator:
                tuple(bind_ctx.structure),
                prepared_local.binding_shapes(),
                prepared_front.binding_shapes())
-        fn = self._cache.get(key)
-        if fn is None:
-            fn = jax.jit(shard_map(
+        out_planes, out_count = self._dispatch_spmd(
+            key,
+            lambda: shard_map(
                 exchange_group_front, mesh=mesh,
                 in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P(), P()),
-                out_specs=P(), check_vma=False))
-            self._cache[key] = fn
-        out_planes, out_count = fn(columns_global, row_valid, bindings,
-                                   tuple(prepared_local.bindings),
-                                   tuple(prepared_front.bindings))
+                out_specs=P(), check_vma=False),
+            (columns_global, row_valid, bindings,
+             tuple(prepared_local.bindings),
+             tuple(prepared_front.bindings)))
+        _note_host_sync()
         return _assemble_chunk(prepared_front.output, out_planes,
                                out_count)
 
@@ -905,12 +987,11 @@ class DistributedEvaluator:
         # same front merge over the all_gathered states), but the checker
         # can't infer that through the gather+sort pipeline.
         n_extra = 2 if join_apply is not None else 0
-        mapped = shard_map(
+        return shard_map(
             spmd, mesh=mesh,
             in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P())
             + (P(),) * n_extra,
             out_specs=P(), check_vma=False)
-        return jax.jit(mapped)
 
 
 def coordinate_distributed(plan: ir.Query, mesh: Mesh,
@@ -918,20 +999,27 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
                            foreign_chunks: Optional[dict] = None,
                            evaluator: Optional[DistributedEvaluator] = None,
                            host_evaluator=None,
-                           prefer_shuffle: bool = True) -> ColumnarChunk:
-    """Distributed execution with a graceful-degradation ladder (ISSUE 2):
+                           prefer_shuffle: bool = True,
+                           stats=None) -> ColumnarChunk:
+    """Distributed execution with a graceful-degradation ladder (ISSUE 2,
+    extended by ISSUE 12's whole-plan rung):
 
-        all_to_all co-partition  →  gather-merge SPMD  →  host coordinator
+        whole-plan fused SPMD  →  all_to_all co-partition  →
+        gather-merge SPMD  →  host coordinator
 
-    Each rung trades throughput for fewer moving parts: the shuffle path
-    needs every device link healthy, gather-merge only the all_gather
-    collective, and the host coordinator nothing but per-shard programs
-    (which carry their own per-shard retry — query/coordinator.py).  A
-    YtError on one rung degrades to the next instead of failing the
-    query; the final error (if every rung fails) aggregates the rungs'
-    errors.  Ref: the coordinator falling back from
-    CoordinateAndExecuteWithShuffle to plain CoordinateAndExecute when a
-    tablet cell cannot serve the shuffle (engine_api/coordinator.h:92).
+    Each rung trades throughput for fewer moving parts: the whole-plan
+    rung fuses every stage (and its exchange) into ONE program with one
+    final host sync (parallel/whole_plan.py — gated per plan by
+    `can_fuse` and `CompileConfig.whole_plan`), the stitched shuffle
+    path needs every device link healthy, gather-merge only the
+    all_gather collective, and the host coordinator nothing but
+    per-shard programs (which carry their own per-shard retry —
+    query/coordinator.py).  A YtError on one rung degrades to the next
+    instead of failing the query; the final error (if every rung fails)
+    aggregates the rungs' errors.  Ref: the coordinator falling back
+    from CoordinateAndExecuteWithShuffle to plain CoordinateAndExecute
+    when a tablet cell cannot serve the shuffle
+    (engine_api/coordinator.h:92).
     """
     import logging as _logging
 
@@ -949,13 +1037,32 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
         except YtError:
             table = None        # ragged shards: host path handles them
     if table is not None:
+        from ytsaurus_tpu.config import compile_config
+        from ytsaurus_tpu.parallel.whole_plan import can_fuse, \
+            run_whole_plan
+        if compile_config().whole_plan and can_fuse(plan) is None:
+            try:
+                # One span per degradation rung, tagged with its rung
+                # index — a query served off-rung shows WHERE it fell.
+                with child_span("distributed.whole_plan", rung=0,
+                                shards=len(chunks)):
+                    return run_whole_plan(de, plan, table, stats=stats)
+            except Exception as err:   # noqa: BLE001 — the fused rung
+                # degrades on ANY fault (whole_plan.py's contract): a
+                # plan shape whose fused lowering trips an XLA/dtype
+                # error must still be served by the stitched rungs, not
+                # fail a query that worked before this rung existed.
+                if not isinstance(err, YtError):
+                    err = YtError(f"whole-plan lowering failed: {err!r}",
+                                  code=EErrorCode.QueryExecutionError)
+                errors.append(err)
+                log_event(_ladder_log, _logging.WARNING,
+                          "degrade_to_stitched", error=str(err))
         shuffled_shape = (plan.group is not None and not plan.group.totals) \
             or (plan.window is not None and plan.window.partition_items)
         if prefer_shuffle and shuffled_shape and not plan.joins:
             try:
-                # One span per degradation rung, tagged with its rung
-                # index — a query served off-rung shows WHERE it fell.
-                with child_span("distributed.shuffle", rung=0,
+                with child_span("distributed.shuffle", rung=1,
                                 shards=len(chunks)):
                     return de.run(plan, table, foreign_chunks,
                                   shuffle=True)
@@ -964,7 +1071,7 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
                 log_event(_ladder_log, _logging.WARNING,
                           "degrade_to_gather", error=str(err))
         try:
-            with child_span("distributed.gather_merge", rung=1,
+            with child_span("distributed.gather_merge", rung=2,
                             shards=len(chunks)):
                 return de.run(plan, table, foreign_chunks, shuffle=False)
         except YtError as err:
@@ -972,11 +1079,12 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
             log_event(_ladder_log, _logging.WARNING,
                       "degrade_to_host", error=str(err))
     try:
-        with child_span("distributed.host_coordinate", rung=2,
+        with child_span("distributed.host_coordinate", rung=3,
                         shards=len(chunks)):
             return coordinate_and_execute(plan, list(chunks),
                                           foreign_chunks,
-                                          evaluator=host_evaluator)
+                                          evaluator=host_evaluator,
+                                          stats=stats)
     except YtError as err:
         if not errors:
             raise

@@ -1,0 +1,546 @@
+"""Whole-plan SPMD execution: the entire distributed query as ONE program.
+
+The stitched rungs of `coordinate_distributed` re-enter Python between
+phases — `_finish_shuffled` runs a count program, blocks on a host read
+to size the exchange quota, then runs the exchange program; the host
+coordinator stitches N per-shard programs with Python glue.  Flare
+(arxiv 1703.08219) and the JIT-in-databases survey (arxiv 2311.04692)
+both locate the payoff of native compilation in the WHOLE-QUERY unit:
+collapsing the interpretive glue between stages, not the operators.
+This module is that collapse for the mesh: scan→filter→[partial
+aggregate]→shuffle→aggregate/window→order/topk/project lowers as ONE
+`jit(shard_map(...))` program over the `'shard'` axis, with
+`with_sharding_constraint` pinning the inputs to the partition-rule
+registry's placement and in-program collectives (all_to_all routing,
+all_gather merge) replacing the Python-stitched exchanges.
+
+Stage placement is driven by a partition-rule registry (the
+`match_partition_rules` idiom of SNIPPETS.md [2]: stage-name regex →
+PartitionSpec): `scan/<column>`, `filter`, `bottom/*`, `shuffle/*` and
+`local/*` stages map onto `P('shard')`; `front`, `order`, `topk`,
+`project`, `limit` are replicated (they run over the all_gathered
+rowset on every device).  The registry digest folds into the program
+cache key, so a placement change can never serve a stale executable.
+
+The data-dependent decision the stitched path syncs for — the exchange
+quota — moves from a per-query host read to a CACHED decision: the
+fused program runs with a static pow2 quota, computes the true
+transfer-matrix maximum on device, and returns it (with an overflow
+flag) stacked WITH the result count — one final device→host transfer,
+the only host sync in the whole plan.  On overflow the query re-runs
+at the demanded quota (a fresh pow2 rung of the same compile-once
+ladder) and the settled quota is memoized per plan shape, so steady
+serving never syncs mid-plan and never overflows.  Unfusable plans
+(joins, WITH TOTALS) and any in-program fault degrade to the stitched
+ladder in `coordinate_distributed`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import replace as dc_replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ytsaurus_tpu.parallel.compat import shard_map
+
+from ytsaurus_tpu.chunks.columnar import pad_capacity
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.parallel.mesh import SHARD_AXIS
+from ytsaurus_tpu.parallel.shuffle import route_rows, transfer_counts
+from ytsaurus_tpu.query import ir
+from ytsaurus_tpu.query.coordinator import split_plan
+from ytsaurus_tpu.query.engine.lowering import prepare
+from ytsaurus_tpu.query.parameterize import plan_fingerprint
+
+# -- partition-rule registry ---------------------------------------------------
+
+# Stage-name regex → PartitionSpec (the match_partition_rules idiom,
+# SNIPPETS.md [2]).  Sharded stages run inside the shard_map body on the
+# per-device slice; replicated stages run after the in-program
+# all_gather (every device computes the same merge).  Rules are matched
+# first-hit, so a custom registry can pin one stage or column family
+# ("scan/l_.*") ahead of the defaults.
+DEFAULT_PARTITION_RULES: "tuple[tuple[str, P], ...]" = (
+    (r"^(scan|filter|bottom|shuffle|local)(/|$)", P(SHARD_AXIS)),
+    (r"^(front|merge|order|topk|project|limit)(/|$)", P()),
+)
+
+
+def match_partition_rules(rules, name: str) -> P:
+    """First rule whose regex matches `name` wins; no match is an error
+    (an unplaceable stage must fail loudly, not silently replicate)."""
+    for pattern, spec in rules:
+        if re.search(pattern, name) is not None:
+            return spec
+    raise YtError(f"No partition rule matches stage {name!r}",
+                  code=EErrorCode.QueryExecutionError)
+
+
+def rules_fingerprint(rules) -> str:
+    """Stable digest of a rule set — a cache-key axis, so editing the
+    registry can never serve a program compiled under the old placement."""
+    text = repr([(pattern, tuple(spec)) for pattern, spec in rules])
+    return hashlib.sha256(text.encode()).hexdigest()[:16]
+
+
+def _validate_stages(rules, stages: "list[tuple[str, bool]]") -> None:
+    """Check the registry places every stage where the fused program can
+    execute it: (name, wants_sharded) pairs."""
+    for name, want_sharded in stages:
+        spec = match_partition_rules(rules, name)
+        sharded = tuple(spec) == (SHARD_AXIS,)
+        if sharded != want_sharded:
+            where = "on the shard axis" if want_sharded else "replicated"
+            raise YtError(
+                f"partition rules place stage {name!r} as {tuple(spec)!r} "
+                f"but the fused program runs it {where}",
+                code=EErrorCode.QueryExecutionError)
+
+
+# -- fusion gate ---------------------------------------------------------------
+
+
+def can_fuse(plan: ir.Query) -> Optional[str]:
+    """None when the whole plan lowers as one SPMD program; otherwise
+    the reason it stays on the stitched ladder."""
+    if plan.joins:
+        return "join plans run the stitched broadcast/partitioned paths"
+    if plan.group is not None and plan.group.totals:
+        return "WITH TOTALS concatenates two materialized rowsets"
+    return None
+
+
+def _shape_of(plan: ir.Query) -> str:
+    """Which fused shape serves this plan:
+
+    exchange-states  GROUP BY without cardinality: partial aggregate
+                     states per shard, then the states (not the rows)
+                     ride the all_to_all — the in-program combiner.
+    exchange-rows    cardinality GROUP BY / windowed plans: complete
+                     groups (partitions) need the raw rows co-located.
+    gather           everything else: bottom per shard, all_gather,
+                     replicated front.
+    """
+    if plan.group is not None and not plan.group.totals:
+        if any(a.function == "cardinality"
+               for a in plan.group.aggregate_items):
+            return "exchange-rows"
+        return "exchange-states"
+    if plan.window is not None and plan.window.partition_items:
+        return "exchange-rows"
+    return "gather"
+
+
+# -- entry ---------------------------------------------------------------------
+
+
+def run_whole_plan(evaluator, plan: ir.Query, table, stats=None,
+                   rules=None):
+    """Execute `plan` over a ShardedTable as ONE fused SPMD program.
+
+    `evaluator` is the DistributedEvaluator owning the compile ladder
+    (memory cache → AOT disk tier → fresh compile) and the quota memo.
+    Raises YtError for unfusable plans or in-program faults — the
+    caller's degradation ladder steps down to the stitched rungs.
+    """
+    reason = can_fuse(plan)
+    if reason is not None:
+        raise YtError(f"plan is not whole-plan fusable: {reason}",
+                      code=EErrorCode.QueryUnsupported)
+    rules = DEFAULT_PARTITION_RULES if rules is None else tuple(rules)
+    shape = _shape_of(plan)
+    if shape == "gather":
+        chunk = _run_gather(evaluator, plan, table, rules)
+    else:
+        chunk = _run_exchange(evaluator, plan, table, rules, shape,
+                              stats)
+    if stats is not None:
+        stats.whole_plan = 1
+    return chunk
+
+
+def _read_counts(final) -> "tuple[int, int, int]":
+    """THE whole-plan host sync: ONE stacked device→host transfer
+    carrying (result row count, overflow flag, max transfer cell).
+    Gather-shape programs return a bare count (no exchange — overflow
+    impossible)."""
+    vals = np.asarray(final)
+    if vals.ndim == 0:
+        return int(vals), 0, 0
+    return int(vals[0]), int(vals[1]), int(vals[2])
+
+
+def _scan_shardings(rules, mesh, names: "list[str]"):
+    """NamedShardings for the input planes per the registry ("scan/<col>"
+    rules must keep scan columns on the shard axis — the planes ARE
+    sharded)."""
+    shardings = {}
+    stages = []
+    for name in names:
+        stage = f"scan/{name}"
+        stages.append((stage, True))
+        shardings[name] = NamedSharding(mesh,
+                                        match_partition_rules(rules, stage))
+    _validate_stages(rules, stages)
+    return shardings
+
+
+def _constrain_inputs(mesh, shardings, columns: dict, row_valid):
+    """`with_sharding_constraint` at the jit boundary: pins the scan
+    planes to the registry's placement before the shard_map body (the
+    GSPMD spelling of "this stage lives on the shard axis")."""
+    out = {}
+    for name, (data, valid) in columns.items():
+        sh = shardings[name]
+        out[name] = (jax.lax.with_sharding_constraint(data, sh),
+                     jax.lax.with_sharding_constraint(valid, sh))
+    rv = jax.lax.with_sharding_constraint(
+        row_valid, NamedSharding(mesh, P(SHARD_AXIS)))
+    return out, rv
+
+
+def _gathered(planes_with_cols, shard_mask, out_cap: int):
+    """In-program all_gather of a stage's output planes + mask."""
+    gathered = {}
+    for out_col, (d, v) in planes_with_cols:
+        gathered[out_col.name] = (
+            jax.lax.all_gather(d, SHARD_AXIS).reshape(-1),
+            jax.lax.all_gather(v, SHARD_AXIS).reshape(-1))
+    g_mask = jax.lax.all_gather(shard_mask, SHARD_AXIS).reshape(-1)
+    return gathered, g_mask
+
+
+# -- gather shape --------------------------------------------------------------
+
+
+def _run_gather(evaluator, plan: ir.Query, table, rules):
+    """bottom per shard → all_gather → replicated front, fused.  The
+    same dataflow as the stitched gather rung, but compiled through the
+    whole-plan ladder (AOT-serializable, registry-placed)."""
+    from ytsaurus_tpu.parallel import distributed as dist
+    dist._FP_GATHER.hit()
+    mesh = table.mesh
+    n = mesh.devices.size
+    cap = table.capacity
+    bottom, front = split_plan(plan)
+    prepared_b = prepare(bottom, table.rep_chunk())
+    inter_rep = dist._RepChunk(
+        capacity=n * prepared_b.out_capacity,
+        columns={c.name: dist._RepColumn(type=c.type, dictionary=c.vocab)
+                 for c in prepared_b.output})
+    prepared_f = prepare(front, inter_rep)
+    names = [c.name for c in bottom.schema if c.name in table.columns]
+    shardings = _scan_shardings(rules, mesh, names)
+    stages = [("bottom", True), ("front", False)]
+    if plan.order is not None:
+        stages.append(("order", False))
+    if plan.project is not None:
+        stages.append(("project", False))
+    _validate_stages(rules, stages)
+    out_cap = prepared_b.out_capacity
+
+    def build():
+        def fused(columns, row_valid, b_bnd, f_bnd):
+            planes, count = prepared_b.run(columns, row_valid, b_bnd)
+            shard_mask = jnp.arange(out_cap) < count
+            gathered, g_mask = _gathered(
+                list(zip(prepared_b.output, planes)), shard_mask, out_cap)
+            return prepared_f.run(gathered, g_mask, f_bnd)
+
+        mapped = shard_map(
+            fused, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+            out_specs=P(), check_vma=False)
+
+        def program(columns, row_valid, b_bnd, f_bnd):
+            columns, row_valid = _constrain_inputs(mesh, shardings,
+                                                   columns, row_valid)
+            return mapped(columns, row_valid, b_bnd, f_bnd)
+
+        return program
+
+    key = ("whole", "gather", plan_fingerprint(bottom),
+           plan_fingerprint(front), n, cap,
+           prepared_b.binding_shapes(), prepared_f.binding_shapes(),
+           rules_fingerprint(rules))
+    columns = {name: (table.columns[name].data, table.columns[name].valid)
+               for name in names}
+    out_planes, out_count = evaluator._dispatch_spmd(
+        key, build, (columns, table.row_valid,
+                     tuple(prepared_b.bindings),
+                     tuple(prepared_f.bindings)))
+    dist._note_host_sync()            # the final count read
+    count, _over, _cell = _read_counts(out_count)
+    return dist._assemble_chunk(prepared_f.output, out_planes, count)
+
+
+# -- exchange shapes -----------------------------------------------------------
+
+
+def _bind_route_keys(rep_columns, key_refs, where_expr):
+    """Bind routing-key expressions (+ optional WHERE) against a
+    namespace of _RepColumn-like carriers.  Returns (bind_ctx, where_b,
+    key_b)."""
+    from ytsaurus_tpu.query.engine.expr import BindContext, ColumnBinding, \
+        ExprBinder
+    bind_ctx = BindContext(columns={
+        name: ColumnBinding(type=rc.type, vocab=rc.dictionary)
+        for name, rc in rep_columns.items()})
+    binder = ExprBinder(bind_ctx)
+    where_b = binder.bind(where_expr) if where_expr is not None else None
+    key_b = [binder.bind(expr) for expr in key_refs]
+    return bind_ctx, where_b, key_b
+
+
+def _dest_hash(key_b, ctx, mask, cap: int, n: int):
+    """Destination device by canonical key hash (mirrors the stitched
+    shuffle's routing so both paths co-locate identical key sets)."""
+    from ytsaurus_tpu.query.engine.expr import _combine_u64, _mix_u64
+    from ytsaurus_tpu.parallel.distributed import _canonical_hash_plane
+    acc = jnp.full(cap, np.uint64(0x9E3779B97F4A7C15), dtype=jnp.uint64)
+    for kb in key_b:
+        data, valid = kb.emit(ctx)
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int8)
+        h = _mix_u64(_canonical_hash_plane(data))
+        h = jnp.where(valid, h, jnp.zeros_like(h))
+        acc = _combine_u64(acc, h)
+    pid = (acc % np.uint64(n)).astype(jnp.int32)
+    return jnp.where(mask, pid, n)
+
+
+def _initial_quota(memo: dict, memo_key, bound_cap: int, n: int,
+                   headroom: float) -> "tuple[int, int]":
+    """(starting quota, hard bound).  The bound is the per-source live
+    capacity — a source cannot send more rows than it holds to one
+    destination, so a program at the bound can never overflow."""
+    bound = pad_capacity(bound_cap)
+    start = memo.get(memo_key)
+    if start is None:
+        start = min(bound,
+                    pad_capacity(max(64, int(bound_cap * headroom) // n)))
+    return start, bound
+
+
+def _settle_quota(memo: dict, memo_key, demand: int,
+                  bound: int, headroom: float) -> None:
+    """Memoize the demand-sized quota for the next query of this shape.
+    Hysteresis: only shrink past a 4x gap (pow2 + headroom already give
+    ~2x slack), so per-query demand jitter cannot thrash the compile
+    cache with alternating quota rungs."""
+    settled = min(bound, pad_capacity(max(int(demand * headroom), 64)))
+    prev = memo.get(memo_key)
+    if prev is None or settled > prev or settled * 4 <= prev:
+        memo[memo_key] = settled
+
+
+def _run_exchange(evaluator, plan: ir.Query, table, rules, shape: str,
+                  stats):
+    """The co-partitioned shapes, fused end to end:
+
+    exchange-states  scan→filter→partial group (per shard) → all_to_all
+                     of the GROUP STATES by key hash → merge group +
+                     having (complete groups per device) → all_gather →
+                     order/project/offset/limit.  The exchange moves
+                     aggregate states, not rows — the in-program
+                     combiner.
+    exchange-rows    scan→filter → all_to_all of the surviving ROWS by
+                     group/PARTITION BY hash → full local stage
+                     (complete groups: cardinality; complete partitions:
+                     window) → all_gather → front.
+
+    One static pow2 quota sizes the exchange; the program returns the
+    true transfer max + overflow flag WITH the count (one stacked final
+    transfer).  Overflow re-runs at the demanded quota and memoizes it.
+    """
+    from ytsaurus_tpu.config import compile_config
+    from ytsaurus_tpu.parallel import distributed as dist
+    from ytsaurus_tpu.query.engine.expr import EmitContext
+
+    dist._FP_ALL_TO_ALL.hit()
+    mesh = table.mesh
+    n = mesh.devices.size
+    cap = table.capacity
+    headroom = compile_config().whole_plan_headroom
+
+    if shape == "exchange-states":
+        bottom, front = split_plan(plan)
+        prepared_s1 = prepare(bottom, table.rep_chunk())
+        bound_cap = prepared_s1.out_capacity
+        route_rep = {c.name: dist._RepColumn(type=c.type, dictionary=c.vocab)
+                     for c in prepared_s1.output}
+        route_names = [c.name for c in prepared_s1.output]
+        # Routing keys: the group-key slots of the state rowset (bare
+        # references — the bottom already evaluated the expressions).
+        key_refs = [ir.TReference(type=item.expr.type, name=item.name)
+                    for item in bottom.group.group_items]
+        where_expr = None                 # consumed by the bottom
+        local_plan = ir.FrontQuery(schema=front.schema, group=front.group,
+                                   having=front.having)
+        front_final = ir.FrontQuery(
+            schema=local_plan.output_schema(), order=front.order,
+            project=front.project, offset=front.offset, limit=front.limit)
+        stage_names = [("bottom/group", True), ("shuffle/group", True),
+                       ("local/group", True), ("front", False)]
+    else:
+        bottom = None
+        prepared_s1 = None
+        bound_cap = cap
+        route_rep = {name: dist._RepColumn(type=col.type,
+                                           dictionary=col.dictionary)
+                     for name, col in table.columns.items()}
+        route_names = [c.name for c in plan.schema
+                       if c.name in table.columns]
+        route_rep = {name: route_rep[name] for name in route_names}
+        key_items = plan.window.partition_items \
+            if plan.window is not None else plan.group.group_items
+        key_refs = [item.expr for item in key_items]
+        where_expr = plan.where
+        local_plan = dc_replace(plan, order=None, project=None, offset=0,
+                                limit=None)
+        front_final = None                # built per quota below
+        kind = "window" if plan.window is not None else "group"
+        stage_names = [(f"shuffle/{kind}", True), (f"local/{kind}", True),
+                       ("front", False)]
+    if plan.order is not None:
+        stage_names.append(("order", False))
+    if plan.project is not None:
+        stage_names.append(("project", False))
+    _validate_stages(rules, stage_names)
+
+    key_ctx, where_b, key_b = _bind_route_keys(route_rep, key_refs,
+                                               where_expr)
+    key_bindings = tuple(key_ctx.bindings)
+    if shape == "exchange-states":
+        columns = {name: (table.columns[name].data,
+                          table.columns[name].valid)
+                   for name in [c.name for c in bottom.schema
+                                if c.name in table.columns]}
+        scan_names = sorted(columns)
+    else:
+        columns = {name: (table.columns[name].data,
+                          table.columns[name].valid)
+                   for name in route_names}
+        scan_names = route_names
+    shardings = _scan_shardings(rules, mesh, scan_names)
+
+    memo_key = (shape, plan_fingerprint(plan), n, bound_cap)
+    quota, bound = _initial_quota(evaluator._quota_memo, memo_key,
+                                  bound_cap, n, headroom)
+
+    while True:
+        recv_cap = n * quota
+        local_rep = dist._RepChunk(
+            capacity=recv_cap, columns=dict(route_rep))
+        prepared_local = prepare(local_plan, local_rep)
+        out_cap = prepared_local.out_capacity
+        if shape == "exchange-states":
+            final_plan = front_final
+        else:
+            final_plan = ir.FrontQuery(
+                schema=local_plan.output_schema(), order=plan.order,
+                project=plan.project, offset=plan.offset,
+                limit=plan.limit)
+        front_rep = dist._RepChunk(
+            capacity=n * out_cap,
+            columns={c.name: dist._RepColumn(type=c.type,
+                                             dictionary=c.vocab)
+                     for c in prepared_local.output})
+        prepared_front = prepare(final_plan, front_rep)
+
+        def build(quota=quota, prepared_local=prepared_local,
+                  prepared_front=prepared_front, out_cap=out_cap):
+            def fused(columns, row_valid, s1_bnd, key_bnd, l_bnd, f_bnd):
+                if prepared_s1 is not None:
+                    planes, cnt = prepared_s1.run(columns, row_valid,
+                                                  s1_bnd)
+                    routed = {c.name: plane for c, plane in
+                              zip(prepared_s1.output, planes)}
+                    mask = jnp.arange(bound_cap) < cnt
+                else:
+                    routed = {name: columns[name] for name in route_names}
+                    mask = row_valid
+                ctx = EmitContext(columns=routed, bindings=key_bnd,
+                                  capacity=bound_cap)
+                if where_b is not None:
+                    d, v = where_b.emit(ctx)
+                    mask = mask & v & d.astype(bool)
+                pid = _dest_hash(key_b, ctx, mask, bound_cap, n)
+                cell_counts = transfer_counts(pid, mask, n)
+                recv, recv_mask = route_rows(routed, pid, n, quota,
+                                             bound_cap)
+                planes2, cnt2 = prepared_local.run(recv, recv_mask,
+                                                   l_bnd)
+                shard_mask = jnp.arange(out_cap) < cnt2
+                gathered, g_mask = _gathered(
+                    list(zip(prepared_local.output, planes2)),
+                    shard_mask, out_cap)
+                out_planes, out_count = prepared_front.run(gathered,
+                                                           g_mask, f_bnd)
+                # Replicated exchange telemetry riding the result: the
+                # true transfer-matrix max (quota demand) + overflow.
+                all_cells = jax.lax.all_gather(
+                    cell_counts, SHARD_AXIS).reshape(-1)
+                max_cell = all_cells.max().astype(jnp.int64)
+                over = (max_cell > quota).astype(jnp.int64)
+                final = jnp.stack(
+                    [out_count.astype(jnp.int64), over, max_cell])
+                return out_planes, final
+
+            mapped = shard_map(
+                fused, mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P(), P(),
+                          P()),
+                out_specs=P(), check_vma=False)
+
+            def program(columns, row_valid, s1_bnd, key_bnd, l_bnd,
+                        f_bnd):
+                columns, row_valid = _constrain_inputs(
+                    mesh, shardings, columns, row_valid)
+                return mapped(columns, row_valid, s1_bnd, key_bnd,
+                              l_bnd, f_bnd)
+
+            return program
+
+        key = ("whole", shape, plan_fingerprint(plan), n, cap, quota,
+               bound_cap,
+               prepared_s1.binding_shapes() if prepared_s1 is not None
+               else None,
+               tuple(key_ctx.structure),
+               tuple((tuple(b.shape), str(b.dtype))
+                     for b in key_bindings),
+               prepared_local.binding_shapes(),
+               prepared_front.binding_shapes(),
+               rules_fingerprint(rules))
+        args = (columns, table.row_valid,
+                tuple(prepared_s1.bindings) if prepared_s1 is not None
+                else (),
+                key_bindings, tuple(prepared_local.bindings),
+                tuple(prepared_front.bindings))
+        out_planes, final = evaluator._dispatch_spmd(key, build, args)
+        # Noted PER read: an overflow retry performs a real second
+        # stacked transfer and the counter must say so (steady state
+        # stays at exactly one).
+        dist._note_host_sync()
+        count, over, demand = _read_counts(final)
+        if not over:
+            break
+        if quota >= bound:
+            raise YtError(
+                "whole-plan exchange overflowed at the maximal quota "
+                f"(quota={quota}, demand={demand})",
+                code=EErrorCode.QueryExecutionError)
+        if stats is not None:
+            stats.whole_plan_retries += 1
+        quota = min(bound,
+                    max(pad_capacity(max(int(demand * headroom), 1)),
+                        quota * 2))
+    _settle_quota(evaluator._quota_memo, memo_key, demand, bound,
+                  headroom)
+    return dist._assemble_chunk(prepared_front.output, out_planes, count)

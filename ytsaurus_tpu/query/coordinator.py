@@ -502,9 +502,20 @@ def coordinate_and_execute(
         # round-5 hot spot: one blocking int(count) host read per shard
         # serialized the whole fan-out) and the counts cross the host
         # boundary once, after every program is enqueued.  Early-exit
-        # scans keep the per-shard sync: the count IS the exit signal.
-        deferred = needed is None and hasattr(evaluator, "run_plan_async")
+        # scans still need the count (it IS the exit signal) but batch
+        # it in WAVES: a window of shard programs dispatches without
+        # synchronizing, then the wave's counts cross as ONE stacked
+        # finish_all transfer.  The wave doubles while the scan keeps
+        # going (mirroring the prefetch window), so a stop-at-shard-0
+        # query pays a single-program wave and a scan that runs long
+        # converges to pipelined dispatch.  Duck-typed evaluators
+        # without run_plan_async keep the per-shard sync path.
+        deferred = hasattr(evaluator, "run_plan_async")
+        early_async = deferred and needed is not None
         partials = []
+        wave: list = []
+        wave_budget = 1
+        waves_done = 0
         try:
             collected = 0
             group: list = []
@@ -528,13 +539,36 @@ def coordinate_and_execute(
                         else group[0]
                     group, group_rows = [], 0
                 if deferred:
-                    partials.append(_retry_transient(
+                    pending = _retry_transient(
                         lambda c=chunk: evaluator.run_plan_async(
                             bottom, c, foreign_chunks, stats=stats,
                             token=token),
                         site=_FP_EXECUTE, token=token,
                         span_name="coordinator.shard", stats=stats,
-                        shard=i))
+                        shard=i)
+                if deferred and needed is None:
+                    partials.append(pending)
+                    scanner.feedback()
+                    continue
+                if early_async:
+                    wave.append(pending)
+                    if len(wave) < wave_budget and \
+                            i + 1 < len(scan_chunks):
+                        continue
+                    finished = finish_all(wave)
+                    wave = []
+                    waves_done += 1
+                    if waves_done >= 2:
+                        # Two waves declined to exit: the scan is
+                        # probably running long — start pipelining.
+                        wave_budget = min(wave_budget * 2, 4)
+                    partials.extend(finished)
+                    collected += sum(p.row_count for p in finished)
+                    if collected >= needed:
+                        if stats is not None:
+                            stats.shards_skipped += \
+                                len(scan_chunks) - (i + 1)
+                        break
                     scanner.feedback()
                     continue
                 partial = _retry_transient(
@@ -553,7 +587,7 @@ def coordinate_and_execute(
                 scanner.feedback()
         finally:
             scanner.close()
-        if deferred:
+        if deferred and needed is None:
             partials = finish_all(partials)
         with child_span("coordinator.front_merge",
                         partials=len(partials)):

@@ -144,6 +144,12 @@ def format_profile_dict(p: dict) -> str:
                               if n) or "none"
         lines.append(f"compile misses: {cause_str}; capacity buckets "
                      f"{[int(b) for b in buckets]}")
+    # ISSUE 12: which distributed lowering served the query — the fused
+    # whole-plan program (one host sync) or the stitched ladder.
+    if stats.get("whole_plan"):
+        lines.append(
+            f"distributed: whole-plan fused SPMD (overflow retries "
+            f"{stats.get('whole_plan_retries', 0)})")
     tree = p.get("span_tree") or []
     if tree:
         lines.append("spans:")

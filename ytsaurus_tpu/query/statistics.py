@@ -33,6 +33,12 @@ class QueryStatistics:
     shards_staged: int = 0           # shards actually fetched/decoded
     retries: int = 0                 # transient per-shard retry attempts
     joins_executed: int = 0
+    # Whole-plan SPMD execution (ISSUE 12): 1 when the query was served
+    # by the fused one-program rung (parallel/whole_plan.py); retries
+    # count exchange-quota overflow re-runs (each a fresh pow2 rung of
+    # the compile-once ladder, not a host sync).
+    whole_plan: int = 0
+    whole_plan_retries: int = 0
     # The pow2 capacity buckets this query's programs ran against
     # (ISSUE 8 satellite): per-query bucket churn is a shape-spectrum
     # leak EXPLAIN ANALYZE must surface.  A set, serialized sorted.
