@@ -1237,6 +1237,195 @@ def bench_scan(n_rows, iters):
 
 
 # config -> (fn, default rows on an accelerator, default rows on CPU)
+def bench_matview(n_rows, iters):
+    """Continuous queries (ISSUE 13): sustained ordered-table ingest
+    with an incrementally maintained GROUP BY view (sum/count/avg by a
+    97-ary key), exactly-once refresh per micro-batch.
+
+      ingest     the metric: source rows/s through push + incremental
+                 refresh (delta-merge into the sorted target), with
+                 end-to-end freshness lag (push → committed visibility)
+                 reported p50/p99 over the waves;
+      steady     fresh-compile count across the measured waves must be
+                 ZERO after warmup — one parameterized plan per view,
+                 fixed pow2 batch capacity (the ISSUE 13 acceptance);
+      restart    (a) in-process daemon-restart analog: a FRESH
+                 evaluator + refresher resumes from committed offsets
+                 with 0 fresh compiles (AOT disk tier), (b) a fresh
+                 CHILD PROCESS builds the same view against the same
+                 artifact dir and also refreshes with 0 fresh compiles.
+
+    Correctness is asserted against the full-recompute oracle at the
+    end of every leg."""
+    import os as _os
+    import subprocess as _subprocess
+    import tempfile
+
+    from ytsaurus_tpu import config as yt_config
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.query.engine.evaluator import (
+        Evaluator,
+        get_compile_observatory,
+    )
+    from ytsaurus_tpu.query.views import ViewRefresher, load_view
+    from ytsaurus_tpu.schema import TableSchema
+
+    root = tempfile.mkdtemp(prefix="bench-matview-")
+    aot_dir = _os.path.join(root, "aot")
+    yt_config.set_compile_config(yt_config.CompileConfig(
+        parameterize=True, disk_cache_dir=aot_dir))
+    batch_rows = 16_384
+    wave_rows = max(min(n_rows // 8, 4 * batch_rows), batch_rows)
+
+    def make_rows(lo, n):
+        return [{"k": lo + i, "g": (lo + i) % 97,
+                 "v": float((lo + i) % 1013)} for i in range(n)]
+
+    client = connect(root)
+    schema = TableSchema.make([("k", "int64"), ("g", "int64"),
+                               ("v", "double")])
+    client.create("table", "//bench/stream", recursive=True,
+                  attributes={"schema": schema, "dynamic": True})
+    client.mount_table("//bench/stream")
+    query = ("g, sum(v) AS s, count(*) AS c, avg(v) AS a "
+             "FROM [//bench/stream] GROUP BY g")
+    client.create_materialized_view("agg", query,
+                                    batch_rows=batch_rows)
+    refresher = ViewRefresher(client, load_view(client, "agg"))
+    obs = get_compile_observatory()
+
+    # Warmup: full and partial batches cover the (fixed) batch capacity
+    # and the merge-combine shapes; everything compiles here (and lands
+    # in the AOT disk tier for the restart legs).
+    client.push_queue("//bench/stream", make_rows(0, batch_rows))
+    refresher.refresh()
+    client.push_queue("//bench/stream",
+                      make_rows(batch_rows, batch_rows // 3))
+    refresher.refresh()
+    pushed = batch_rows + batch_rows // 3
+
+    def canon(rows):
+        return sorted(tuple((k, round(v, 6) if isinstance(v, float)
+                             else v) for k, v in sorted(r.items()))
+                      for r in rows)
+
+    def check_oracle():
+        got = canon(client.select_rows(
+            "g, s, c, a FROM [//sys/views/agg/target]"))
+        want = canon(client.select_rows(query))
+        assert got == want, "view diverged from the recompute oracle"
+
+    # Measured leg: sustained ingest waves; steady state must be
+    # compile-free.
+    before = obs.totals()
+    waves = []
+    ingested = 0
+    n_waves = max(4, n_rows // wave_rows)
+    t_leg = time.perf_counter()
+    while len(waves) < n_waves and _iters_left(waves, n_waves):
+        t0 = time.perf_counter()
+        client.push_queue("//bench/stream", make_rows(pushed, wave_rows))
+        pushed += wave_rows
+        ingested += wave_rows
+        report = refresher.refresh()
+        assert report["lag_rows"] == 0
+        waves.append(time.perf_counter() - t0)
+    elapsed = time.perf_counter() - t_leg
+    after = obs.totals()
+    assert after["misses"] == before["misses"], \
+        f"steady-state refresh compiled: {before} -> {after}"
+    check_oracle()
+    lags = sorted(waves)
+    p50 = lags[len(lags) // 2]
+    p99 = lags[min(len(lags) - 1, int(len(lags) * 0.99))]
+
+    # Restart leg (in-process): a fresh evaluator = an empty in-memory
+    # compile cache, i.e. a restarted daemon.  It must resume from the
+    # committed offsets and serve every program from the AOT disk tier.
+    client.cluster.evaluator = Evaluator()
+    restarted = ViewRefresher(client, load_view(client, "agg"))
+    before = obs.totals()
+    client.push_queue("//bench/stream", make_rows(pushed, wave_rows))
+    pushed += wave_rows
+    report = restarted.refresh()
+    after = obs.totals()
+    restart_misses = after["misses"] - before["misses"]
+    restart_disk = after["disk_hits"] - before["disk_hits"]
+    assert restart_misses == restart_disk, \
+        f"restart compiled fresh: {restart_misses} misses, " \
+        f"{restart_disk} disk hits"
+    assert report["rows_in"] == wave_rows, report
+    check_oracle()
+
+    # Restart leg (cross-process): same artifacts, fresh interpreter.
+    child_src = f"""
+import json, sys
+from ytsaurus_tpu import config as yt_config
+yt_config.set_compile_config(yt_config.CompileConfig(
+    parameterize=True, disk_cache_dir={aot_dir!r}))
+sys.argv = ["child"]
+import bench
+bench.bench_matview_child({batch_rows})
+"""
+    env = dict(_os.environ, JAX_PLATFORMS=_os.environ.get(
+        "JAX_PLATFORMS", "cpu"), BENCH_CHILD="1")
+    proc = _subprocess.run(
+        [sys.executable, "-c", child_src],
+        cwd=_os.path.dirname(_os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    child = json.loads([ln for ln in proc.stdout.splitlines()
+                        if ln.startswith("{")][-1])
+    assert child["fresh_compiles"] == 0, child
+    assert child["disk_hits"] >= 1, child
+
+    rate = (len(waves) * wave_rows) / elapsed
+    print(f"# matview: {len(waves)} waves x {wave_rows} rows, "
+          f"freshness p50 {p50 * 1e3:.1f}ms p99 {p99 * 1e3:.1f}ms, "
+          f"steady fresh compiles 0 (asserted); restart leg "
+          f"{restart_misses} misses all from disk; child process "
+          f"{child['disk_hits']} disk hits, "
+          f"{child['fresh_compiles']} fresh",
+          file=sys.stderr)
+    return "matview_rows_per_sec", rate, min(waves)
+
+
+def bench_matview_child(batch_rows):
+    """Cross-process restart leg of bench_matview: rebuild an identical
+    view in a FRESH interpreter against the SAME AOT artifact directory;
+    every program must come back from disk (0 fresh compiles)."""
+    import tempfile
+
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    from ytsaurus_tpu.query.views import ViewRefresher, load_view
+    from ytsaurus_tpu.schema import TableSchema
+
+    client = connect(tempfile.mkdtemp(prefix="bench-matview-child-"))
+    schema = TableSchema.make([("k", "int64"), ("g", "int64"),
+                               ("v", "double")])
+    client.create("table", "//bench/stream", recursive=True,
+                  attributes={"schema": schema, "dynamic": True})
+    client.mount_table("//bench/stream")
+    client.create_materialized_view(
+        "agg", "g, sum(v) AS s, count(*) AS c, avg(v) AS a "
+               "FROM [//bench/stream] GROUP BY g",
+        batch_rows=batch_rows)
+    client.push_queue("//bench/stream", [
+        {"k": i, "g": i % 97, "v": float(i % 1013)}
+        for i in range(batch_rows + batch_rows // 3)])
+    obs = get_compile_observatory()
+    obs.reset()
+    ViewRefresher(client, load_view(client, "agg")).refresh()
+    totals = obs.totals()
+    print(json.dumps({
+        "disk_hits": totals["disk_hits"],
+        "fresh_compiles": totals["misses"] - totals["disk_hits"],
+    }), flush=True)
+
+
 _CONFIGS = {
     "q1": (bench_q1, 64_000_000, 2_000_000),
     "groupby": (bench_groupby, 64_000_000, 2_000_000),
@@ -1253,6 +1442,7 @@ _CONFIGS = {
     "replay": (bench_replay, 200_000, 100_000),
     "serving_steady": (bench_serving_steady, 200_000, 100_000),
     "whole_plan": (bench_whole_plan, 8_000_000, 1_000_000),
+    "matview": (bench_matview, 2_000_000, 500_000),
 }
 
 
@@ -1373,6 +1563,7 @@ _METRIC_NAMES = {
     "replay": "replay_queries_per_sec",
     "serving_steady": "serving_steady_queries_per_sec",
     "whole_plan": "whole_plan_rows_per_sec",
+    "matview": "matview_rows_per_sec",
 }
 
 

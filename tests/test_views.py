@@ -1,0 +1,733 @@
+"""Continuous queries (ISSUE 13): incremental materialized views over
+ordered tablets.
+
+Covers: incremental delta-merge correctness vs a full-recompute oracle
+(aggregates incl. avg/argmin state decomposition, DISTINCT, plain
+selects keyed by $row_index), the exactly-once 2PC protocol under seeded
+crash-once schedules + daemon restarts, ordered-cursor edge cases the
+tail loop surfaced (empty micro-batches, cursor at/below the trim
+boundary, concurrent trim-vs-read), daemon lifecycle + dynamic-config
+pause/resume, compile-once steady state, per-view accounting + the
+view-lag burn-rate SLO, and the driver/CLI/monitoring surfaces.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.client import connect
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.query.views import (
+    ViewRefresher,
+    load_view,
+    prepare_incremental,
+    build_view_plan,
+)
+from ytsaurus_tpu.schema import TableSchema
+from ytsaurus_tpu.server.view_daemon import ViewDaemon, views_snapshot
+from ytsaurus_tpu.utils import failpoints
+from ytsaurus_tpu.utils.failpoints import InjectedCrash
+
+SRC_SCHEMA = TableSchema.make([("k", "int64"), ("g", "int64"),
+                               ("v", "double")])
+
+AGG_QUERY = ("g, sum(v) AS s, count(*) AS c, avg(v) AS a "
+             "FROM [{src}] GROUP BY g")
+
+
+@pytest.fixture
+def client(tmp_path):
+    return connect(str(tmp_path))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_views_config():
+    yield
+    yt_config.set_views_config(None)
+
+
+def make_source(client, path="//src", n_rows=0):
+    client.create("table", path, recursive=True,
+                  attributes={"schema": SRC_SCHEMA, "dynamic": True})
+    client.mount_table(path)
+    if n_rows:
+        push(client, path, range(n_rows))
+    return path
+
+
+def push(client, path, ks):
+    client.push_queue(path, [
+        {"k": k, "g": k % 5, "v": float((k * 7) % 23)} for k in ks])
+
+
+def canon(rows):
+    def norm(v):
+        return round(v, 9) if isinstance(v, float) else v
+    return sorted(tuple((name, norm(value)) for name, value in
+                        sorted(r.items())) for r in rows)
+
+
+def view_rows(client, spec, columns):
+    return client.select_rows(f"{columns} FROM [{spec['target']}]")
+
+
+# --- incremental correctness --------------------------------------------------
+
+
+def test_agg_view_delta_merge_matches_oracle(client):
+    src = make_source(client, n_rows=23)
+    spec = client.create_materialized_view(
+        "agg", AGG_QUERY.format(src=src), batch_rows=7)
+    report = client.refresh_view("agg")
+    assert report["rows_in"] == 23 and report["lag_rows"] == 0
+    oracle_q = AGG_QUERY.format(src=src)
+    assert canon(view_rows(client, spec, "g, s, c, a")) == \
+        canon(client.select_rows(oracle_q))
+    # Incremental: a second ingest delta-merges into the stored states
+    # (avg via its (sum, count) decomposition), not a recompute.
+    push(client, src, range(100, 137))
+    report = client.refresh_view("agg")
+    assert report["rows_in"] == 37
+    assert canon(view_rows(client, spec, "g, s, c, a")) == \
+        canon(client.select_rows(oracle_q))
+
+
+def test_argminmax_view_keeps_by_state(client):
+    src = make_source(client, n_rows=19)
+    q = ("g, argmin(k, v) AS ak, argmax(k, v) AS xk, min(v) AS mv "
+         f"FROM [{src}] GROUP BY g")
+    spec = client.create_materialized_view("am", q, batch_rows=6)
+    client.refresh_view("am")
+    push(client, src, range(200, 231))
+    client.refresh_view("am")
+    assert canon(view_rows(client, spec, "g, ak, xk, mv")) == \
+        canon(client.select_rows(q))
+    # The `__b` comparison state is persisted alongside the value, so
+    # later merges could still compare.
+    stored = client.select_rows(f"ak__b, xk__b FROM [{spec['target']}]")
+    assert all(r["ak__b"] is not None for r in stored)
+
+
+def test_distinct_view(client):
+    src = make_source(client, n_rows=17)
+    q = f"g FROM [{src}] GROUP BY g"
+    spec = client.create_materialized_view("dst", q, batch_rows=4)
+    client.refresh_view("dst")
+    push(client, src, range(40, 53))
+    client.refresh_view("dst")
+    assert canon(view_rows(client, spec, "g")) == \
+        canon(client.select_rows(q))
+
+
+def test_plain_view_filters_and_projects(client):
+    src = make_source(client, n_rows=29)
+    q = f"k, v, v * 2.0 AS v2 FROM [{src}] WHERE v > 5.0"
+    spec = client.create_materialized_view("plain", q, batch_rows=8)
+    client.refresh_view("plain")
+    push(client, src, range(300, 321))
+    client.refresh_view("plain")
+    assert canon(view_rows(client, spec, "k, v, v2")) == \
+        canon(client.select_rows(q))
+    # Upserts key on the source $row_index: replaying the same batch
+    # (simulated by a manual re-insert) cannot duplicate rows.
+    assert spec["target"].startswith("//sys/views/plain/")
+
+
+def test_all_filtered_batch_still_advances_cursor(client):
+    src = make_source(client, n_rows=9)
+    # v is in [0, 23); nothing matches.
+    spec = client.create_materialized_view(
+        "nil", f"k, v FROM [{src}] WHERE v > 1000.0", batch_rows=4)
+    report = client.refresh_view("nil")
+    assert report["rows_in"] == 9 and report["rows_out"] == 0
+    assert client.get_view("nil")["offset"] == 9
+    assert view_rows(client, spec, "k, v") == []
+
+
+def test_view_query_validation(client):
+    src = make_source(client)
+    other = make_source(client, "//dim")
+    cases = [
+        f"k FROM [{src}] ORDER BY k LIMIT 5",
+        f"k FROM [{src}] LIMIT 5",
+        f"g, cardinality(k) AS d FROM [{src}] GROUP BY g",
+        f"g, sum(v) AS s FROM [{src}] GROUP BY g HAVING sum(v) > 1.0",
+        f"k, sum(v) OVER (PARTITION BY g) AS w FROM [{src}]",
+        f"g, sum(v) / count(*) AS r FROM [{src}] GROUP BY g",
+        f"k, g FROM [{src}] JOIN [{other}] USING k",
+    ]
+    for query in cases:
+        with pytest.raises(YtError) as err:
+            client.create_materialized_view("bad", query)
+        # Joins of two ordered tables may already die in the builder
+        # (both sides carry $row_index) — any rejection is fine.
+        assert err.value.code in (EErrorCode.QueryUnsupported,
+                                  EErrorCode.QueryParseError,
+                                  EErrorCode.QueryTypeError), query
+    # Sorted (non-queue) source is rejected.
+    client.create("table", "//sorted", recursive=True, attributes={
+        "schema": TableSchema.make([("k", "int64", "ascending"),
+                                    ("v", "int64")], unique_keys=True),
+        "dynamic": True})
+    client.mount_table("//sorted")
+    with pytest.raises(YtError):
+        client.create_materialized_view("bad", "k, v FROM [//sorted]")
+    # Duplicate names are rejected.
+    client.create_materialized_view("dup", f"k, v FROM [{src}]")
+    with pytest.raises(YtError):
+        client.create_materialized_view("dup", f"k, v FROM [{src}]")
+
+
+# --- exactly-once under injected crashes --------------------------------------
+
+
+def _drive_until_drained(client, name, max_crashes=64):
+    """Run the refresh loop like a crashy daemon would: every
+    InjectedCrash kills the 'process' (the refresher) and a fresh one
+    resumes from the committed offsets."""
+    crashes = 0
+    refresher = ViewRefresher(client, load_view(client, name))
+    while True:
+        try:
+            result = refresher.refresh_once()
+            if result.empty:
+                return crashes
+        except InjectedCrash:
+            crashes += 1
+            assert crashes <= max_crashes, "crash loop did not converge"
+            refresher = ViewRefresher(client, load_view(client, name))
+
+
+@pytest.mark.parametrize("site", ["views.batch_execute", "views.commit"])
+@pytest.mark.parametrize("seed", [11, 22])
+def test_exactly_once_across_crashes(client, site, seed):
+    """The chaos soak: crash-once schedules at both failpoint sites —
+    including BETWEEN the staged target write and the offset commit —
+    must leave both an aggregate and a plain view bit-identical to the
+    full-recompute oracle after restarts."""
+    src = make_source(client, n_rows=31)
+    agg = client.create_materialized_view(
+        "agg", AGG_QUERY.format(src=src), batch_rows=6)
+    plain_q = f"k, v FROM [{src}] WHERE v > 4.0"
+    plain = client.create_materialized_view("plain", plain_q,
+                                            batch_rows=6)
+    crashes = 0
+    with failpoints.active(f"{site}=crash-once:times=2", seed=seed):
+        crashes += _drive_until_drained(client, "agg")
+        crashes += _drive_until_drained(client, "plain")
+    push(client, src, range(500, 541))
+    with failpoints.active(f"{site}=crash-once:times=2", seed=seed + 1):
+        crashes += _drive_until_drained(client, "agg")
+        crashes += _drive_until_drained(client, "plain")
+    assert crashes >= 2, "the schedule never fired — proves nothing"
+    assert canon(view_rows(client, agg, "g, s, c, a")) == \
+        canon(client.select_rows(AGG_QUERY.format(src=src)))
+    assert canon(view_rows(client, plain, "k, v")) == \
+        canon(client.select_rows(plain_q))
+
+
+def test_view_failpoint_sites_fired():
+    """Coverage gate (mirrors test_chaos_soak's): both view sites must
+    actually TRIGGER in the chaos runs above — dead sites prove
+    nothing."""
+    counters = failpoints.counters()
+    triggered = {site: counters.get(site, {}).get("triggers", 0)
+                 for site in ("views.batch_execute", "views.commit")}
+    if not any(triggered.values()):
+        pytest.skip("chaos tests did not run in this session")
+    assert all(triggered.values()), \
+        f"view failpoint sites never fired: {triggered}"
+
+
+def test_refresher_restart_resumes_from_committed_offset(client):
+    src = make_source(client, n_rows=12)
+    client.create_materialized_view(
+        "r", AGG_QUERY.format(src=src), batch_rows=5)
+    first = ViewRefresher(client, load_view(client, "r"))
+    first.refresh_once()            # one batch of 5, then "die"
+    assert client.get_view("r")["offset"] == 5
+    second = ViewRefresher(client, load_view(client, "r"))
+    report = second.refresh()
+    assert report["rows_in"] == 7   # resumed at 5, not 0
+    assert client.get_view("r")["lag_rows"] == 0
+
+
+def test_create_rejects_zero_batch_rows_and_recovers_wedged_names(client):
+    src = make_source(client, n_rows=2)
+    with pytest.raises(YtError) as err:
+        client.create_materialized_view("z", f"k, v FROM [{src}]",
+                                        batch_rows=0)
+    assert err.value.code == EErrorCode.InvalidConfig
+    # A half-created registry node (failure before @view_spec landed)
+    # must not wedge the name.
+    client.create("map_node", "//sys/views/z", recursive=True)
+    spec = client.create_materialized_view("z", f"k, v FROM [{src}]")
+    assert spec["state"] == "running"
+    # A failed create rolls its target back (name AND target reusable).
+    with pytest.raises(YtError):
+        client.create_materialized_view(
+            "z2", f"k FROM [{src}] LIMIT 1", target="//z2target")
+    assert not client.exists("//z2target")
+    assert not client.exists("//sys/views/z2/@view_spec")
+
+
+def test_daemon_and_cli_survive_one_broken_view(client, capsys):
+    from ytsaurus_tpu.cli import run
+    src = make_source(client, n_rows=6)
+    client.create_materialized_view("ok", f"k, v FROM [{src}]",
+                                    batch_rows=4)
+    client.create_materialized_view("broken", f"k, v FROM [{src}]")
+    # Corrupt the broken view's spec (hand-edited Cypress) so loading
+    # it raises a NON-YtError (KeyError): the daemon pass must record
+    # it and still refresh the healthy view; the CLI listing must still
+    # render the registry.
+    client.set("//sys/views/broken/@view_spec", {"name": "broken"})
+    daemon = ViewDaemon(client)
+    report = daemon.step()
+    assert "error" in report["broken"]
+    assert report["ok"]["lag_rows"] == 0 and report["ok"]["rows_in"] == 6
+    assert run(["view", "list"], client=client) == 0
+    out = capsys.readouterr().out
+    assert "ok" in out and "broken" in out
+
+
+def test_stale_concurrent_writer_cannot_rewind_cursor(client):
+    """Review finding: a second writer holding an already-superseded
+    batch must NOT commit it — the optimistic cursor check inside the
+    commit window rejects the stale delta, so the view never
+    double-applies rows."""
+    src = make_source(client, n_rows=20)
+    spec = client.create_materialized_view(
+        "race", f"g, sum(v) AS s, count(*) AS c FROM [{src}] GROUP BY g",
+        batch_rows=4)
+    stale = ViewRefresher(client, load_view(client, "race"))
+    # The stale writer computes its first batch's delta... then stalls.
+    rows = client.pull_queue(src, offset=0, limit=4)
+    upserts = stale._compute_upserts(rows)
+    # Meanwhile the live refresher drains the whole queue.
+    ViewRefresher(client, load_view(client, "race")).refresh()
+    assert client.get_view("race")["offset"] == 20
+    # The stale commit must be rejected (and counted as a conflict),
+    # leaving the view on the oracle.
+    with pytest.raises(YtError) as err:
+        stale._commit(upserts, 4, base_offset=0)
+    assert err.value.code == EErrorCode.TransactionLockConflict
+    assert client.get_view("race")["offset"] == 20
+    assert canon(view_rows(client, spec, "g, s, c")) == \
+        canon(client.select_rows(
+            f"g, sum(v) AS s, count(*) AS c FROM [{src}] GROUP BY g"))
+
+
+def test_remove_view_keeps_external_target_and_survives_dead_source(client):
+    """Review findings: an EXTERNAL target must outlive the view unless
+    drop_target; removing a view whose source table was already dropped
+    must succeed (best-effort unregister)."""
+    src = make_source(client, n_rows=6)
+    client.create_materialized_view(
+        "ext", f"k, v FROM [{src}]", target="//kept/target")
+    client.refresh_view("ext")
+    client.remove_view("ext")                 # drop_target=False
+    assert client.exists("//kept/target")
+    assert client.select_rows("k, v FROM [//kept/target]")
+    # Source dropped out from under the second view: removal still works.
+    client.create_materialized_view("orphan", f"k, v FROM [{src}]")
+    client.unmount_table(src)
+    client.remove(src, recursive=True)
+    client.remove_view("orphan")
+    assert client.list_views() == []
+
+
+# --- ordered-cursor edge cases (ISSUE 13 satellite) ---------------------------
+
+
+def test_empty_micro_batches_are_cheap_noops(client):
+    src = make_source(client, n_rows=4)
+    client.create_materialized_view(
+        "e", AGG_QUERY.format(src=src), batch_rows=8)
+    refresher = ViewRefresher(client, load_view(client, "e"))
+    assert refresher.refresh_once().rows_in == 4
+    for _ in range(3):
+        result = refresher.refresh_once()
+        assert result.empty and result.offset == 4
+    assert client.get_view("e")["offset"] == 4
+
+
+def test_cursor_at_trim_boundary(client):
+    src = make_source(client, n_rows=20)
+    client.create_materialized_view(
+        "t", AGG_QUERY.format(src=src), batch_rows=5)
+    refresher = ViewRefresher(client, load_view(client, "t"))
+    refresher.refresh_once()                 # cursor at 5
+    client.trim_rows(src, 5)                 # trim EXACTLY to the cursor
+    result = refresher.refresh_once()
+    assert result.rows_in == 5 and result.trim_skipped == 0
+    refresher.refresh()
+    assert client.get_view("t")["lag_rows"] == 0
+
+
+def test_cursor_below_trim_boundary_skips_forward(client):
+    src = make_source(client, n_rows=20)
+    client.create_materialized_view(
+        "skip", f"k, v FROM [{src}]", batch_rows=5)
+    refresher = ViewRefresher(client, load_view(client, "skip"))
+    refresher.refresh_once()                 # cursor at 5
+    client.trim_rows(src, 12)                # operator trim past cursor
+    result = refresher.refresh_once()
+    assert result.trim_skipped == 7
+    assert result.rows_in == 5 and result.offset == 17
+    refresher.refresh()
+    status = client.get_view("skip")
+    assert status["offset"] == 20 and status["lag_rows"] == 0
+
+
+def test_pull_consumer_trim_gap_regression(client):
+    """pull_consumer used to return the STALE offset when the trim
+    boundary passed it and nothing was live — parking the consumer
+    below trimmed_count forever (surfaced by the view tail loop)."""
+    src = make_source(client, n_rows=6)
+    client.register_queue_consumer(src, "//c")
+    rows, next_off = client.pull_consumer("//c", src)
+    assert next_off == 6
+    client.advance_consumer("//c", src, 2)
+    client.trim_rows(src, 6)                 # everything trimmed
+    rows, next_off = client.pull_consumer("//c", src)
+    assert rows == []
+    assert next_off == 6, "cursor must land on the trim boundary"
+    client.advance_consumer("//c", src, next_off)   # and be committable
+
+
+def test_concurrent_trim_vs_tail(client):
+    """Agent-style trimming (gated on the view's VITAL consumer) racing
+    the tail loop: no errors, no lost rows, view == python oracle."""
+    from ytsaurus_tpu.server.queue_agent import QueueAgent
+    src = make_source(client)
+    client.create_materialized_view(
+        "ct", f"g, sum(v) AS s, count(*) AS c FROM [{src}] GROUP BY g",
+        batch_rows=16)
+    refresher = ViewRefresher(client, load_view(client, "ct"))
+    agent = QueueAgent(client)
+    stop = threading.Event()
+    errors = []
+
+    def trimmer():
+        while not stop.is_set():
+            try:
+                agent.trim_queue(src)
+            except YtError as err:           # pragma: no cover
+                errors.append(err)
+
+    thread = threading.Thread(target=trimmer)
+    thread.start()
+    try:
+        expected_s: dict = {}
+        expected_c: dict = {}
+        for wave in range(6):
+            ks = range(wave * 50, wave * 50 + 50)
+            push(client, src, ks)
+            for k in ks:
+                g = k % 5
+                expected_s[g] = expected_s.get(g, 0.0) + \
+                    float((k * 7) % 23)
+                expected_c[g] = expected_c.get(g, 0) + 1
+            refresher.refresh()
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    assert not errors
+    got = {r["g"]: r for r in view_rows(client, {"target":
+           load_view(client, "ct").target}, "g, s, c")}
+    assert {g: (round(r["s"], 6), r["c"]) for g, r in got.items()} == \
+        {g: (round(expected_s[g], 6), expected_c[g]) for g in expected_s}
+    # The vital consumer gates trimming: nothing was trimmed past the
+    # committed cursor, so nothing was lost.
+    (tablet,) = client._mounted_tablets(src)
+    assert tablet.trimmed_count <= client.get_view("ct")["offset"]
+
+
+# --- 8-device mesh dual-check -------------------------------------------------
+
+
+def test_view_dual_checked_against_mesh_oracle(client, mesh8):
+    """The recompute oracle for an aggregate view, executed BOTH as the
+    local single-chunk plan and as the 8-device SPMD distributed plan
+    (the whole-plan/shuffle ladder), must match the incrementally
+    maintained target."""
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    from ytsaurus_tpu.parallel.distributed import (
+        DistributedEvaluator,
+        coordinate_distributed,
+    )
+    src = make_source(client, n_rows=64)
+    q = AGG_QUERY.format(src=src)
+    spec = client.create_materialized_view("m", q, batch_rows=16)
+    client.refresh_view("m")
+    push(client, src, range(1000, 1041))
+    client.refresh_view("m")
+
+    plan = build_view_plan(client, q)
+    rows = client.pull_queue(src, 0)
+    shards = [rows[i::8] for i in range(8)]
+    chunks = [ColumnarChunk.from_rows(plan.schema, part)
+              for part in shards if part]
+    mesh_oracle = coordinate_distributed(
+        plan, mesh8, chunks,
+        evaluator=DistributedEvaluator(mesh8)).to_rows()
+    local_oracle = client.select_rows(q)
+    got = canon(view_rows(client, spec, "g, s, c, a"))
+    assert got == canon(local_oracle)
+    assert got == canon(mesh_oracle)
+
+
+# --- compile-once steady state ------------------------------------------------
+
+
+def test_steady_state_refresh_is_compile_free(client):
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    src = make_source(client, n_rows=96)
+    client.create_materialized_view(
+        "cc", AGG_QUERY.format(src=src), batch_rows=32)
+    refresher = ViewRefresher(client, load_view(client, "cc"))
+    refresher.refresh()                      # warmup: compiles happen here
+    obs = get_compile_observatory()
+    before = obs.totals()
+    for wave in range(3):
+        push(client, src, range(2000 + wave * 32, 2000 + wave * 32 + 32))
+        refresher.refresh()
+    after = obs.totals()
+    assert after["misses"] == before["misses"], \
+        "steady-state refresh must replay cached programs only"
+    assert after["hits"] > before["hits"]
+
+
+# --- daemon lifecycle + dynamic config ----------------------------------------
+
+
+def _wait(predicate, timeout=30.0):
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_view_daemon_tails_pauses_and_resumes(client):
+    src = make_source(client, n_rows=10)
+    client.create_materialized_view(
+        "d", AGG_QUERY.format(src=src), batch_rows=8)
+    daemon = ViewDaemon(client).start()
+    try:
+        assert _wait(lambda: client.get_view("d")["lag_rows"] == 0)
+        # Registry pause (yt view pause): the daemon skips the view.
+        client.pause_view("d")
+        # Two FULL passes after the pause: a pass in flight when the
+        # attribute landed may still run with the pre-pause spec.
+        settled = daemon.passes + 2
+        assert _wait(lambda: daemon.passes >= settled)
+        assert daemon.snapshot()["views"]["d"]["paused"]
+        push(client, src, range(50, 60))
+        assert client.get_view("d")["lag_rows"] == 10
+        client.resume_view("d")
+        assert _wait(lambda: client.get_view("d")["lag_rows"] == 0)
+
+        # Dynamic-config pause: a config document patch flips `paused`
+        # through the DynamicConfigManager subscriber path.
+        patches = [{"paused": ["d"]}]
+        manager = yt_config.DynamicConfigManager(
+            fetch=lambda: patches[0],
+            base_config=yt_config.ViewsConfig())
+        manager.subscribe(daemon.apply_config)
+        assert manager.poll_once()
+        settled = daemon.passes + 2
+        assert _wait(lambda: daemon.passes >= settled)
+        assert daemon.snapshot()["views"]["d"]["paused"]
+        push(client, src, range(70, 76))
+        assert client.get_view("d")["lag_rows"] == 6
+        patches[0] = {"paused": []}
+        assert manager.poll_once()
+        assert _wait(lambda: client.get_view("d")["lag_rows"] == 0)
+    finally:
+        daemon.stop()
+    assert canon(client.select_rows(
+        f"g, s, c, a FROM [{load_view(client, 'd').target}]")) == \
+        canon(client.select_rows(AGG_QUERY.format(src=src)))
+
+
+def test_daemon_restart_resumes_from_committed_offsets(client):
+    src = make_source(client, n_rows=40)
+    client.create_materialized_view(
+        "dr", AGG_QUERY.format(src=src), batch_rows=16)
+    first = ViewDaemon(client)
+    first.step()
+    assert client.get_view("dr")["lag_rows"] == 0
+    push(client, src, range(600, 625))
+    # A brand-new daemon (fresh process analog) sees only the delta.
+    second = ViewDaemon(client)
+    report = second.step()
+    assert report["dr"]["rows_in"] == 25
+    assert canon(client.select_rows(
+        f"g, s, c, a FROM [{load_view(client, 'dr').target}]")) == \
+        canon(client.select_rows(AGG_QUERY.format(src=src)))
+
+
+# --- accounting + SLO ---------------------------------------------------------
+
+
+def test_refresh_folds_into_pool_accounting(client):
+    from ytsaurus_tpu.query.accounting import ResourceAccountant
+    src = make_source(client, n_rows=12)
+    spec = client.create_materialized_view(
+        "acct", AGG_QUERY.format(src=src), pool="analytics",
+        batch_rows=6)
+    accountant = ResourceAccountant()
+    refresher = ViewRefresher(client, load_view(client, "acct"),
+                              accountant=accountant)
+    refresher.refresh()
+    snapshot = accountant.snapshot()
+    usage = snapshot["by_pool"]["analytics"]
+    assert usage["view_batches"] == 2
+    assert usage["view_rows"] == 12 and usage["rows_read"] == 12
+    assert usage["wall_seconds"] > 0
+    (record,) = snapshot["records"]
+    assert (record["pool"], record["user"]) == ("analytics",
+                                                "view-daemon")
+    assert spec["pool"] == "analytics"
+
+
+def test_view_lag_slo_burn_rate_alert(client):
+    """The view-lag SLO spec over the telemetry rings: sustained
+    freshness-lag breaches fire the burn-rate alert; draining the
+    backlog resolves it."""
+    from ytsaurus_tpu.utils.profiling import MetricsHistory, get_registry
+    from ytsaurus_tpu.utils.slo import SloTracker
+    yt_config.set_views_config(yt_config.ViewsConfig(lag_slo_rows=4))
+    src = make_source(client, n_rows=0)
+    client.create_materialized_view(
+        "slo", f"k, v FROM [{src}]", batch_rows=2)
+    refresher = ViewRefresher(client, load_view(client, "slo"))
+    hist = MetricsHistory(registry=get_registry(), fine_capacity=720,
+                          sample_period=10.0)
+    tracker = SloTracker(
+        yt_config.TelemetryConfig(slos={
+            "view_lag": yt_config.view_lag_slo(
+                view="slo", objective=0.9, burn_threshold=2.0)}),
+        history=hist)
+    t = 0.0
+    for _ in range(40):                      # healthy: drained each tick
+        push(client, src, range(2))
+        refresher.refresh()
+        t = hist.sample_once(t + 10.0)
+        tracker.evaluate(now=t)
+    assert tracker.evaluate(now=t)["active_alerts"] == []
+    push(client, src, range(400))            # backlog storm
+    for _ in range(30):                      # one 2-row batch per tick:
+        refresher.refresh_once()             # lag stays >> objective
+        t = hist.sample_once(t + 10.0)
+        tracker.evaluate(now=t)
+    snap = tracker.evaluate(now=t)
+    (alert,) = snap["active_alerts"]
+    assert alert["slo"] == "view_lag" and alert["state"] == "firing"
+    refresher.refresh()                      # drain fully
+    for _ in range(40):
+        push(client, src, range(2))
+        refresher.refresh()
+        t = hist.sample_once(t + 10.0)
+        tracker.evaluate(now=t)
+    assert tracker.evaluate(now=t)["active_alerts"] == []
+
+
+# --- driver / CLI / monitoring ------------------------------------------------
+
+
+def test_driver_and_cli_verbs(client, capsys):
+    from ytsaurus_tpu.cli import run
+    from ytsaurus_tpu.driver import Driver
+    src = make_source(client, n_rows=8)
+    driver = Driver(client)
+    spec = driver.execute("create_materialized_view", {
+        "name": "cli", "query": AGG_QUERY.format(src=src),
+        "batch_rows": 4})
+    assert spec["state"] == "running"
+    assert driver.execute("list_views", {}) == ["cli"]
+    report = driver.execute("refresh_view", {"name": "cli"})
+    assert report["rows_in"] == 8
+    status = driver.execute("get_view", {"name": "cli"})
+    assert status["lag_rows"] == 0
+    assert driver.execute("pause_view",
+                          {"name": "cli"})["state"] == "paused"
+    assert driver.execute("resume_view",
+                          {"name": "cli"})["state"] == "running"
+
+    assert run(["view", "list"], client=client) == 0
+    out = capsys.readouterr().out
+    assert "cli" in out and "running" in out
+    assert run(["view", "show", "cli"], client=client) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["offset"] == 8
+    assert run(["view", "pause", "cli"], client=client) == 0
+    capsys.readouterr()
+    assert load_view(client, "cli").state == "paused"
+    assert run(["view", "resume", "cli"], client=client) == 0
+    capsys.readouterr()
+    assert run(["view", "refresh", "cli"], client=client) == 0
+    capsys.readouterr()
+    # remove drops the registry node and unregisters the consumer.
+    driver.execute("remove_view", {"name": "cli", "drop_target": True})
+    assert driver.execute("list_views", {}) == []
+    regs = client.get(src + "/@registrations")
+    assert regs == {}
+
+
+def test_views_monitoring_endpoint_and_orchid(client):
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    from ytsaurus_tpu.server.orchid import default_orchid
+    src = make_source(client, n_rows=6)
+    client.create_materialized_view(
+        "mon", AGG_QUERY.format(src=src), batch_rows=4)
+    daemon = ViewDaemon(client)
+    daemon.step()
+    snapshots = [s for s in views_snapshot() if "mon" in s["views"]]
+    assert snapshots and snapshots[0]["views"]["mon"]["lag_rows"] == 0
+    assert snapshots[0]["views"]["mon"]["daemon"]["rows_in"] == 6
+
+    server = MonitoringServer(orchid=default_orchid())
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{server.address}/views", timeout=10) as resp:
+            payload = json.loads(resp.read())
+        ours = [d for d in payload["daemons"] if "mon" in d["views"]]
+        assert ours and ours[0]["views"]["mon"]["offset"] == 6
+        orchid_view = server.orchid.get("/views")
+        assert any("mon" in d["views"] for d in orchid_view["daemons"])
+    finally:
+        server.stop()
+    # Freshness rides the target node for plain readers.
+    freshness = client.get(
+        load_view(client, "mon").target + "/@view_freshness")
+    assert freshness["offset"] == 6
+
+
+def test_incremental_plan_shapes(client):
+    """White-box: the decomposition persists exactly the states the
+    merge needs."""
+    src = make_source(client)
+    plan = build_view_plan(
+        client, f"g, avg(v) AS a FROM [{src}] GROUP BY g")
+    inc = prepare_incremental(plan)
+    assert inc.aggregating
+    assert [c.name for c in inc.target_schema] == ["g", "a", "a__s",
+                                                   "a__c"]
+    assert inc.target_schema.key_column_names == ["g"]
+    state_names = [c.name for c in inc.state_schema]
+    assert state_names == ["g", "a__s", "a__c"]
+    plain = prepare_incremental(
+        build_view_plan(client, f"k, v FROM [{src}] WHERE v > 1.0"))
+    assert not plain.aggregating
+    assert plain.target_schema.key_column_names == ["$row_index"]

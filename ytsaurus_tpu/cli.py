@@ -361,6 +361,38 @@ def build_parser() -> argparse.ArgumentParser:
         (("--analyze-root",), {"default": None,
                                "help": "repo root to analyze (default: "
                                        "the installed tree)"}))
+    cmd("view", (("action",), {"choices": ["create", "list", "show",
+                                           "pause", "resume", "remove",
+                                           "refresh"],
+                               "help": "continuous queries (ISSUE 13): "
+                                       "create registers an incremental "
+                                       "materialized view over an "
+                                       "ordered table; list/show read "
+                                       "the registry + lag/freshness; "
+                                       "pause/resume gate the daemon; "
+                                       "refresh drains the cursor "
+                                       "inline"}),
+        (("name",), {"nargs": "?", "default": None}),
+        (("--query",), {"default": None,
+                        "help": "view QL (create), e.g. 'g, sum(v) AS "
+                                "s FROM [//q] GROUP BY g'"}),
+        (("--source",), {"default": None,
+                         "help": "ordered source table (defaults to "
+                                 "the query's FROM table)"}),
+        (("--target",), {"default": None,
+                         "help": "sorted target table (default: "
+                                 "//sys/views/<name>/target)"}),
+        (("--pool",), {"default": "views",
+                       "help": "resource pool the refresh work is "
+                               "accounted under"}),
+        (("--batch-rows",), {"type": int, "default": None}),
+        (("--max-batches",), {"type": int, "default": 0,
+                              "help": "refresh: cap drained batches "
+                                      "(0 = to the head)"}),
+        (("--drop-target",), {"action": "store_true",
+                              "help": "remove: also drop the target "
+                                      "table"}),
+        (("--json",), {"action": "store_true"}))
     cmd("compile-cache", (("action",), {"choices": ["top"]}),
         (("--limit",), {"type": int, "default": 20}),
         (("--sort",), {"default": "compile_seconds",
@@ -586,6 +618,8 @@ def _dispatch(cl, a):
             return report
         print(_format_replay_report(report))
         return None
+    if c == "view":
+        return _dispatch_view(cl, a)
     if c == "compile-cache":
         snapshot = _fetch_compile(cl)
         if a.json:
@@ -667,6 +701,56 @@ def _dispatch(cl, a):
     if c == "orchid":
         return cl.get_orchid(a.path)
     raise AssertionError(c)
+
+
+def _dispatch_view(cl, a):
+    """`yt view <action>` — the continuous-query verbs."""
+    def require_name():
+        if not a.name:
+            raise YtError(f"view {a.action} requires a view name")
+        return a.name
+
+    if a.action == "create":
+        if not a.query:
+            raise YtError("view create requires --query")
+        return cl.create_materialized_view(
+            require_name(), a.query, source=a.source, target=a.target,
+            pool=a.pool, batch_rows=a.batch_rows)
+    if a.action == "list":
+        statuses = []
+        for name in cl.list_views():
+            try:
+                statuses.append(cl.get_view(name))
+            except YtError as err:
+                # One broken view (dropped source, unmounted tablet)
+                # must not hide the registry — least of all the entry
+                # the operator wants to remove.  JSON keeps the error
+                # in its own field; placeholders are render-only.
+                statuses.append({"name": name, "error": str(err)})
+        if a.json:
+            return statuses
+        print(_format_table(
+            ["view", "state", "source", "target", "offset", "lag",
+             "pool"],
+            [[s["name"], s.get("state", "error"),
+              s.get("source", s.get("error", "")[:60]),
+              s.get("target", "-"), s.get("offset", "-"),
+              s.get("lag_rows", "-"), s.get("pool", "-")]
+             for s in statuses]))
+        return None
+    if a.action == "show":
+        return cl.get_view(require_name())
+    if a.action == "pause":
+        return cl.pause_view(require_name())
+    if a.action == "resume":
+        return cl.resume_view(require_name())
+    if a.action == "remove":
+        cl.remove_view(require_name(), drop_target=a.drop_target)
+        return {"removed": a.name}
+    if a.action == "refresh":
+        return cl.refresh_view(require_name(),
+                               max_batches=a.max_batches)
+    raise AssertionError(a.action)
 
 
 def main() -> None:

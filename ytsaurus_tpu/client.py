@@ -858,6 +858,48 @@ class YtClient:
         from ytsaurus_tpu.server.queue_agent import pull_consumer
         return pull_consumer(self, consumer_path, queue_path, limit=limit)
 
+    # ------------------------------------------------- materialized views
+
+    def create_materialized_view(self, name: str, query: str,
+                                 source: Optional[str] = None,
+                                 target: Optional[str] = None,
+                                 pool: str = "views",
+                                 batch_rows: Optional[int] = None) -> dict:
+        """Register a continuous query (ISSUE 13): a daemon-tailed
+        incremental view over an ordered table, exactly-once into a
+        sorted target readable by normal selects (query/views.py)."""
+        from ytsaurus_tpu.query.views import create_materialized_view
+        return create_materialized_view(
+            self, name, query, source=source, target=target, pool=pool,
+            batch_rows=batch_rows)
+
+    def list_views(self) -> list[str]:
+        from ytsaurus_tpu.query.views import list_views
+        return list_views(self)
+
+    def get_view(self, name: str) -> dict:
+        from ytsaurus_tpu.query.views import view_status
+        return view_status(self, name)
+
+    def pause_view(self, name: str) -> dict:
+        from ytsaurus_tpu.query.views import set_view_state
+        return set_view_state(self, name, "paused")
+
+    def resume_view(self, name: str) -> dict:
+        from ytsaurus_tpu.query.views import set_view_state
+        return set_view_state(self, name, "running")
+
+    def remove_view(self, name: str, drop_target: bool = False) -> None:
+        from ytsaurus_tpu.query.views import remove_view
+        remove_view(self, name, drop_target=drop_target)
+
+    def refresh_view(self, name: str, max_batches: int = 0) -> dict:
+        """Drain one view's cursor inline (no daemon): the CLI/driver
+        verb behind `yt view refresh` and the test/bench loop."""
+        from ytsaurus_tpu.query.views import ViewRefresher, load_view
+        refresher = ViewRefresher(self, load_view(self, name))
+        return refresher.refresh(max_batches=max_batches)
+
     @staticmethod
     def _require_ordered(tablet, path: str) -> None:
         from ytsaurus_tpu.tablet.ordered import OrderedTablet

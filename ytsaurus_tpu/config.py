@@ -578,6 +578,70 @@ def set_compile_config(config: "Optional[CompileConfig]") -> None:
     aot_cache.configure(config)
 
 
+class ViewsConfig(YsonStruct):
+    """Continuous-query (materialized view) plane knobs (ISSUE 13,
+    query/views.py + server/view_daemon.py):
+
+    - `enable`: master switch for the view daemon's refresh loop — off
+      pauses EVERY view (dynamic-config brown-out lever; the committed
+      offset cursors make resume lossless).
+    - `poll_interval`: daemon sleep between passes over the registry
+      when every view is drained.
+    - `default_batch_rows`: micro-batch size for views created without
+      an explicit one.  Batches pad to the pow2 capacity bucket, so the
+      steady-state loop replays one compiled program per view.
+    - `max_batches_per_pass`: per-view cap on batches drained in one
+      daemon pass (fairness across views; 0 = drain to the head).
+    - `lag_slo_rows`: the freshness-lag objective — each refresh pass
+      votes the per-view `/views/lag_ok` vs `/views/lag_breach`
+      counters against it, the SLI pair the view-lag burn-rate SLO
+      (`view_lag_slo()`) evaluates over the history rings.
+    - `paused`: view names force-paused by dynamic config (additive to
+      per-view `yt view pause` registry state)."""
+
+    enable = param(True, type=bool)
+    poll_interval = param(0.05, type=float, ge=0.0)
+    default_batch_rows = param(1024, type=int, ge=1)
+    max_batches_per_pass = param(64, type=int, ge=0)
+    lag_slo_rows = param(65536, type=int, ge=0)
+    paused = param(default_factory=list, type=list)
+
+
+_VIEWS_CONFIG: "Optional[ViewsConfig]" = None
+
+
+def views_config() -> ViewsConfig:
+    global _VIEWS_CONFIG
+    if _VIEWS_CONFIG is None:
+        _VIEWS_CONFIG = ViewsConfig()
+    return _VIEWS_CONFIG
+
+
+def set_views_config(config: "Optional[ViewsConfig]") -> None:
+    """Install a process-wide views config (None restores defaults)."""
+    global _VIEWS_CONFIG
+    _VIEWS_CONFIG = config
+
+
+def view_lag_slo(view: "Optional[str]" = None,
+                 objective: float = 0.99,
+                 burn_threshold: float = 10.0,
+                 fast_window: float = 300.0,
+                 slow_window: float = 3600.0) -> SloConfig:
+    """The view-freshness SLO spec (ISSUE 13 satellite): a ratio SLI
+    over the per-view lag vote counters — `objective` of refresh passes
+    must meet the configured `lag_slo_rows` freshness bound.  Evaluated
+    by utils/slo.SloTracker over the telemetry history rings with the
+    standard fast+slow burn-rate windows; `view=None` sums every view's
+    series (the fleet-wide objective)."""
+    return SloConfig(
+        kind="ratio", good_sensor="/views/lag_ok",
+        bad_sensor="/views/lag_breach",
+        tags={"view": view} if view else {},
+        objective=objective, burn_threshold=burn_threshold,
+        fast_window=fast_window, slow_window=slow_window)
+
+
 class FailpointsConfig(YsonStruct):
     """Deterministic fault-injection schedule (utils/failpoints.py):
     `spec` uses the YT_FAILPOINTS syntax, `seed` fixes p-based rolls.

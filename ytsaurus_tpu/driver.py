@@ -196,6 +196,26 @@ def _registry() -> dict[str, CommandDescriptor]:
                                              "next_offset": off})(
                *cl.pull_consumer(p["consumer_path"], p["queue_path"],
                                  limit=p.get("limit")))),
+        # materialized views (ISSUE 13: continuous queries)
+        _d("create_materialized_view", ("name", "query"),
+           ("source", "target", "pool", "batch_rows"), True,
+           lambda cl, p: cl.create_materialized_view(
+               p["name"], p["query"], source=p.get("source"),
+               target=p.get("target"), pool=p.get("pool", "views"),
+               batch_rows=p.get("batch_rows"))),
+        _d("list_views", (), (), False, lambda cl, p: cl.list_views()),
+        _d("get_view", ("name",), (), False,
+           lambda cl, p: cl.get_view(p["name"])),
+        _d("pause_view", ("name",), (), True,
+           lambda cl, p: cl.pause_view(p["name"])),
+        _d("resume_view", ("name",), (), True,
+           lambda cl, p: cl.resume_view(p["name"])),
+        _d("remove_view", ("name",), ("drop_target",), True,
+           lambda cl, p: cl.remove_view(
+               p["name"], drop_target=p.get("drop_target", False))),
+        _d("refresh_view", ("name",), ("max_batches",), True,
+           lambda cl, p: cl.refresh_view(
+               p["name"], max_batches=p.get("max_batches", 0))),
         # query tracker (ref server/query_tracker verbs)
         _d("start_query", ("query",), ("engine", "annotations"), True,
            lambda cl, p: cl.query_tracker.start_query(

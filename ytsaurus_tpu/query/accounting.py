@@ -19,6 +19,10 @@ usage:
   admission        rejects fold as `throttled` (AdmissionController).
   operations/jobs  each finished operation folds wall seconds + job
                    counts under its spec pool (operations/scheduler).
+  views            each committed materialized-view micro-batch folds
+                   rows + wall seconds under the view's pool
+                   (query/views.ViewRefresher), so continuous-query
+                   daemon load shows up in `yt top` like any tenant.
 
 Cumulative per-POOL sensors mirror the fold into the profiler registry
 (`accounting_usage_*{pool=}` on /metrics — bounded tag cardinality:
@@ -41,7 +45,7 @@ USAGE_FIELDS = (
     "compile_seconds", "execute_seconds", "admission_wait_seconds",
     "wall_seconds", "cache_hits", "compile_count", "retries",
     "throttled", "lookup_keys", "lookup_rows_found", "lookup_batches",
-    "operations", "jobs",
+    "operations", "jobs", "view_batches", "view_rows",
 )
 
 
@@ -121,6 +125,18 @@ class ResourceAccountant:
     def observe_throttle(self, pool: Optional[str],
                          user: Optional[str] = None) -> None:
         self.fold(pool, user, throttled=1)
+
+    def observe_view_batch(self, pool: Optional[str],
+                           rows_read: int = 0, rows_written: int = 0,
+                           wall_seconds: float = 0.0,
+                           user: str = "view-daemon") -> None:
+        """One committed materialized-view micro-batch (ISSUE 13): the
+        refresh work lands under the VIEW's pool in the same rows/wall
+        fields selects use, so `yt top` ranks a pool by its continuous-
+        query load alongside its interactive traffic."""
+        self.fold(pool, user, view_batches=1, view_rows=rows_read,
+                  rows_read=rows_read, rows_written=rows_written,
+                  wall_seconds=wall_seconds)
 
     def observe_operation(self, pool: Optional[str],
                           user: Optional[str], wall_seconds: float,

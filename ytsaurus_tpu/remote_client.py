@@ -329,6 +329,47 @@ class RemoteYtClient:
                                     "trimmed_row_count": trimmed_count},
                       idempotent=False)
 
+    # -- materialized views (ISSUE 13) -----------------------------------------
+
+    def create_materialized_view(self, name: str, query: str,
+                                 source: Optional[str] = None,
+                                 target: Optional[str] = None,
+                                 pool: str = "views",
+                                 batch_rows: Optional[int] = None) -> dict:
+        params: dict = {"name": name, "query": query, "pool": pool}
+        if source is not None:
+            params["source"] = source
+        if target is not None:
+            params["target"] = target
+        if batch_rows is not None:
+            params["batch_rows"] = batch_rows
+        return self._execute("create_materialized_view", params,
+                             idempotent=False)
+
+    def list_views(self) -> list[str]:
+        return self._execute("list_views", {})
+
+    def get_view(self, name: str) -> dict:
+        return self._execute("get_view", {"name": name})
+
+    def pause_view(self, name: str) -> dict:
+        return self._execute("pause_view", {"name": name},
+                             idempotent=False)
+
+    def resume_view(self, name: str) -> dict:
+        return self._execute("resume_view", {"name": name},
+                             idempotent=False)
+
+    def remove_view(self, name: str, drop_target: bool = False) -> None:
+        self._execute("remove_view",
+                      {"name": name, "drop_target": drop_target},
+                      idempotent=False)
+
+    def refresh_view(self, name: str, max_batches: int = 0) -> dict:
+        return self._execute("refresh_view",
+                             {"name": name, "max_batches": max_batches},
+                             idempotent=False)
+
     # -- transactions ----------------------------------------------------------
 
     def start_transaction(self) -> RemoteTransaction:

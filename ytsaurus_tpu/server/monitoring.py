@@ -140,6 +140,15 @@ class MonitoringServer:
             body = json.dumps({"gateways": serving_snapshot()},
                               indent=2).encode()
             self._reply(request, 200, body, "application/json")
+        elif path == "/views":
+            # Continuous-query plane (ISSUE 13): every live view
+            # daemon's registry walk — per-view cursor offset, lag,
+            # freshness, pause state, and daemon roll-ups (the raw
+            # sensors also render on /metrics as views_*).
+            from ytsaurus_tpu.server.view_daemon import views_snapshot
+            body = json.dumps({"daemons": views_snapshot()},
+                              indent=2, default=_json_default).encode()
+            self._reply(request, 200, body, "application/json")
         elif path == "/tablet":
             # Tablet read-path caches (tablet/tablet.py): process-wide
             # snapshot-cache hit/miss/evict counters + bytes pinned

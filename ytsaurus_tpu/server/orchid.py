@@ -154,6 +154,10 @@ def default_orchid(config=None) -> OrchidTree:
     # workload capture` / `yt compile-cache top` read these remotely).
     tree.register("/workload", _workload_producer)
     tree.register("/compile", _compile_producer)
+    # Continuous queries (ISSUE 13): live view-daemon state — the RPC
+    # twin of the monitoring /views endpoint (`yt view list` could read
+    # this remotely when no driver is reachable).
+    tree.register("/views", _views_producer)
     return tree
 
 
@@ -196,3 +200,8 @@ def _compile_producer() -> dict:
         get_compile_observatory,
     )
     return get_compile_observatory().snapshot()
+
+
+def _views_producer() -> dict:
+    from ytsaurus_tpu.server.view_daemon import views_snapshot
+    return {"daemons": views_snapshot()}

@@ -197,6 +197,16 @@ def pull_consumer(client, consumer_path: str, queue_path: str,
                               partition_index)
     rows = client.pull_queue(queue_path, offset=offset, limit=limit)
     # Trimming may have advanced past the stored offset: next_offset comes
-    # from the actual row indexes served, not offset + len(rows).
-    next_offset = (rows[-1]["$row_index"] + 1) if rows else offset
+    # from the actual row indexes served, not offset + len(rows).  When
+    # the trim passed the offset AND nothing is live (rows == []), the
+    # cursor must still land on the trim boundary — returning the stale
+    # offset would park the consumer below trimmed_count forever (its
+    # lag never drains, and a later advance_consumer(next_offset) would
+    # be a no-op loop).  Surfaced by the view-daemon tail loop
+    # (ISSUE 13 satellite); regression-tested in tests/test_views.py.
+    if rows:
+        next_offset = rows[-1]["$row_index"] + 1
+    else:
+        (tablet,) = client._mounted_tablets(queue_path)
+        next_offset = max(offset, tablet.trimmed_count)
     return rows, next_offset
