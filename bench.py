@@ -1142,6 +1142,170 @@ print("REPORT " + json.dumps(report), flush=True)
     return "whole_plan_rows_per_sec", n_rows / best, best
 
 
+def bench_multiway_join(n_rows, iters):
+    """Fused multiway join + cost-based planner (ISSUE 14): TPC-H
+    Q5/Q7-class 3-way join plans on the virtual 8-device CPU mesh,
+    two legs per plan —
+
+      cascade  CompileConfig.whole_plan OFF, the stitched binary
+               cascade (`_run_partitioned`: per join a count program +
+               quota host sync, a route+probe program + totals host
+               sync, an expand program; then the stitched finish)
+      fused    whole_plan ON: planner-ordered broadcast/partition joins
+               inside ONE jit(shard_map) program — one host sync, the
+               exchange/expansion quotas memoized
+
+    Acceptance: fused ≥2× the cascade on both plans, exactly 1 host
+    sync per fused query.  Metric is the fused Q5-class throughput
+    (fact rows/s)."""
+    import subprocess as _subprocess
+
+    child_src = f"""
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json, time
+import numpy as np
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.parallel.mesh import make_mesh
+from ytsaurus_tpu.parallel.distributed import (
+    DistributedEvaluator, coordinate_distributed, host_sync_count)
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.statistics import QueryStatistics
+from ytsaurus_tpu.schema import TableSchema
+
+N = {n_rows}
+ITERS = {max(int(iters), 3)}
+mesh = make_mesh(8)
+rng = np.random.default_rng(14)
+per = N // 8
+
+# TPC-H-class star: lineitem fact, orders (fact-adjacent, too big to
+# broadcast -> partition exchange), customer + nation (broadcast dims).
+n_orders = max(N // 4, 70_000)      # above broadcast_join_rows
+n_cust = 10_000
+nations = [f"nation{{i:02d}}" for i in range(25)]
+li_schema = TableSchema.make([("l_ok", "int64"), ("l_sk", "int64"),
+                              ("price", "double")])
+o_schema = TableSchema.make([("o_ok", "int64"), ("o_ck", "int64")])
+c_schema = TableSchema.make([("c_ck", "int64"), ("c_nk", "int64")])
+n_schema = TableSchema.make([("n_nk", "int64"), ("n_name", "string")])
+s_schema = TableSchema.make([("s_sk", "int64"), ("s_nk", "int64")])
+
+li_chunks = [ColumnarChunk.from_arrays(li_schema, {{
+    "l_ok": rng.integers(0, n_orders, per),
+    "l_sk": rng.integers(0, 1000, per),
+    "price": rng.uniform(1, 1e4, per)}}) for s in range(8)]
+orders = ColumnarChunk.from_arrays(o_schema, {{
+    "o_ok": np.arange(n_orders),
+    "o_ck": rng.integers(0, n_cust, n_orders)}})
+customer = ColumnarChunk.from_arrays(c_schema, {{
+    "c_ck": np.arange(n_cust), "c_nk": rng.integers(0, 25, n_cust)}})
+nation = ColumnarChunk.from_rows(
+    n_schema, [(i, nations[i]) for i in range(25)])
+supplier = ColumnarChunk.from_arrays(s_schema, {{
+    "s_sk": np.arange(1000), "s_nk": rng.integers(0, 25, 1000)}})
+schemas = {{"//li": li_schema, "//o": o_schema, "//c": c_schema,
+           "//n": n_schema, "//s": s_schema}}
+foreign = {{"//o": orders, "//c": customer, "//n": nation,
+           "//s": supplier}}
+
+# Q5 class: 4-way chain through orders -> customer -> nation.
+q5 = build_query(
+    "n_name, sum(price) AS rev, count(*) AS c FROM [//li] "
+    "JOIN [//o] ON l_ok = o_ok JOIN [//c] ON o_ck = c_ck "
+    "JOIN [//n] ON c_nk = n_nk GROUP BY n_name "
+    "ORDER BY n_name LIMIT 32", schemas)
+# Q7 class: supplier-side 3-way.
+q7 = build_query(
+    "n_name, sum(price) AS rev FROM [//li] "
+    "JOIN [//s] ON l_sk = s_sk JOIN [//n] ON s_nk = n_nk "
+    "GROUP BY n_name ORDER BY n_name LIMIT 32", schemas)
+
+
+from ytsaurus_tpu.parallel.distributed import ShardedTable
+table = ShardedTable.from_chunks(mesh, li_chunks)
+
+
+def leg(plan, mode):
+    # cascade   the stitched binary cascade (_run_partitioned: count/
+    #           probe/expand programs + 2 host syncs PER join) — the
+    #           pre-ISSUE-14 multiway shape the acceptance compares to
+    # stitched  whole_plan OFF through the ladder (broadcast-gather
+    #           rung when every dim proves unique keys)
+    # fused     whole_plan ON: one program, one sync
+    yt_config.set_compile_config(
+        yt_config.CompileConfig(whole_plan=(mode == "fused")))
+    de = DistributedEvaluator(mesh)
+    stats = QueryStatistics()
+
+    def run_once(stats=None):
+        if mode == "cascade":
+            return de.run(plan, table, foreign, shuffle=True)
+        return coordinate_distributed(plan, mesh, li_chunks, foreign,
+                                      evaluator=de, stats=stats)
+
+    out = run_once(stats)                                    # warm-up
+    times = []
+    s0 = host_sync_count()
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = run_once()
+        np.asarray(next(iter(out.columns.values())).data[:1])
+        times.append(time.perf_counter() - t0)
+    return {{"best_s": min(times),
+             "syncs_per_query": (host_sync_count() - s0) / ITERS,
+             "whole_plan": stats.whole_plan, "rows": out.row_count,
+             "join_plan": stats.join_plan}}
+
+
+report = {{}}
+for name, plan in (("q5", q5), ("q7", q7)):
+    report[name] = {{"cascade": leg(plan, "cascade"),
+                     "stitched": leg(plan, "stitched"),
+                     "fused": leg(plan, "fused")}}
+print("REPORT " + json.dumps(report), flush=True)
+"""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = _subprocess.run(
+        [sys.executable, "-c", child_src],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=3000, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    report = json.loads(
+        [ln for ln in proc.stdout.splitlines()
+         if ln.startswith("REPORT ")][-1][len("REPORT "):])
+    for name, legs in report.items():
+        fused = legs["fused"]
+        cascade = legs["cascade"]
+        stitched = legs["stitched"]
+        speedup = cascade["best_s"] / fused["best_s"]
+        strategies = [e["strategy"] for e in fused["join_plan"] if e]
+        print(f"# multiway_join {name}: cascade "
+              f"{cascade['best_s']*1e3:.0f}ms "
+              f"({cascade['syncs_per_query']:.0f} syncs/query), "
+              f"stitched-gather {stitched['best_s']*1e3:.0f}ms "
+              f"({stitched['syncs_per_query']:.0f}), fused "
+              f"{fused['best_s']*1e3:.0f}ms "
+              f"({fused['syncs_per_query']:.0f} sync/query, "
+              f"strategies {strategies}, "
+              f"{n_rows / fused['best_s']:.0f} rows/s) -> "
+              f"{speedup:.2f}x vs stitched cascade", file=sys.stderr)
+        assert fused["whole_plan"] == 1, name
+        assert fused["syncs_per_query"] == 1.0, \
+            f"{name}: fused multiway join must host-sync exactly once"
+        assert cascade["syncs_per_query"] >= 3.0, name
+        assert fused["rows"] == cascade["rows"] == stitched["rows"], name
+        assert speedup >= 2.0, \
+            (f"{name}: fused {fused['best_s']:.3f}s not >=2x cascade "
+             f"{cascade['best_s']:.3f}s")
+    best = report["q5"]["fused"]["best_s"]
+    return "multiway_join_rows_per_sec", n_rows / best, best
+
+
 def bench_scan(n_rows, iters):
     """Versioned MVCC read path (ISSUE 4): snapshot reads over a tablet
     with three flushed version generations (overwrites, deletes, partial
@@ -1442,6 +1606,7 @@ _CONFIGS = {
     "replay": (bench_replay, 200_000, 100_000),
     "serving_steady": (bench_serving_steady, 200_000, 100_000),
     "whole_plan": (bench_whole_plan, 8_000_000, 1_000_000),
+    "multiway_join": (bench_multiway_join, 4_000_000, 400_000),
     "matview": (bench_matview, 2_000_000, 500_000),
 }
 
@@ -1563,6 +1728,7 @@ _METRIC_NAMES = {
     "replay": "replay_queries_per_sec",
     "serving_steady": "serving_steady_queries_per_sec",
     "whole_plan": "whole_plan_rows_per_sec",
+    "multiway_join": "multiway_join_rows_per_sec",
     "matview": "matview_rows_per_sec",
 }
 

@@ -316,8 +316,13 @@ def test_stats_sealed_into_chunk_meta(tmp_path):
                  {"k": 7, "s": None}])
     cid = store.write_chunk(chunk)
     meta = store.read_meta(cid)
-    assert meta["column_stats"]["k"] == {"min": -1, "max": 7,
-                                         "has_null": False}
+    k_stats = dict(meta["column_stats"]["k"])
+    # The NDV sketch (ISSUE 14) rides next to the bounds — fixed 64
+    # registers, never data-sized.
+    sketch = k_stats.pop("ndv_sketch")
+    assert len(sketch.encode("utf-8") if isinstance(sketch, str)
+               else sketch) == 64
+    assert k_stats == {"min": -1, "max": 7, "has_null": False}
     stats = store.read_stats(cid)
     assert stats["k"]["max"] == 7 and stats["$row_count"] == 3
     assert stats["s"]["has_null"] is True
@@ -347,7 +352,10 @@ def test_stats_backfill_for_pre_stats_chunks(tmp_path):
     cid = store.put_blob("ab" + "0" * 30, legacy)
     assert store.read_meta(cid).get("column_stats") is None
     stats = store.read_stats(cid)
-    assert stats["k"] == {"min": 5, "max": 9, "has_null": False}
+    assert {k: stats["k"][k] for k in ("min", "max", "has_null")} == \
+        {"min": 5, "max": 9, "has_null": False}
+    # The backfill computes the full payload, sketch included.
+    assert stats["k"].get("ndv_sketch") is not None
     # Memoized: a second read serves from memory.
     assert store.read_stats(cid) is stats
 

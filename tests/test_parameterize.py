@@ -310,6 +310,124 @@ def test_join_cache_keys_carry_baked_concat_widths():
     assert len(cache) == 2, "swapped concat widths must not share"
 
 
+# -- cost-based join planning vs the compile-once ladder (ISSUE 14) -----------
+#
+# Planner DECISIONS (join order, side strategy, pushdown column sets)
+# must fold into the compile cache key; estimates and pushdown VALUES
+# must not.  Stats drift that flips a decision → NEW fingerprint (a
+# stale program can never serve); drift that flips nothing → the same
+# key (100% cache hit).
+
+JFACT = TableSchema.make(
+    [("k", "int64"), ("ok", "int64"), ("sk", "int64")])
+JDA = TableSchema.make([("a_k", "int64"), ("a_v", "int64")])
+JDB = TableSchema.make([("b_k", "int64"), ("b_v", "int64")])
+JSCHEMAS = {"//t": JFACT, "//a": JDA, "//b": JDB}
+JQUERY = ("a_v, b_v, k FROM [//t] JOIN [//a] ON ok = a_k "
+          "JOIN [//b] ON sk = b_k")
+
+
+def _jfact_chunk(n=64):
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    return ColumnarChunk.from_rows(JFACT, [
+        {"k": i, "ok": i % 16, "sk": i % 8} for i in range(n)])
+
+
+def _dim(schema, kname, vname, keys, dup=1, base=0):
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    return ColumnarChunk.from_rows(schema, [
+        {kname: base + key, vname: key * 10 + r}
+        for key in keys for r in range(dup)])
+
+
+def test_stats_drift_flips_join_order_new_fingerprint():
+    """Foreign-side duplication drifting (unique dim ↔ expanding dim)
+    flips the planner's greedy order; the reordered plan's fingerprint
+    — every compile cache's key — must move with it, while decision-
+    neutral drift (key bounds shifting) keeps key AND token stable."""
+    from ytsaurus_tpu.query import planner
+    plan = _plan_joins()
+    # //a unique (expansion 1.0), //b 4x duplicated (expansion 4.0):
+    # the planner runs //a first regardless of declared order.
+    f1 = {"//a": _dim(JDA, "a_k", "a_v", range(16)),
+          "//b": _dim(JDB, "b_k", "b_v", range(8), dup=4)}
+    ordered1, jp1 = planner.reorder_for_chunks(plan, 64, f1)
+    assert jp1.order == (0, 1)
+    # Drifted: duplication swaps sides — order flips, fingerprint flips.
+    f2 = {"//a": _dim(JDA, "a_k", "a_v", range(16), dup=4),
+          "//b": _dim(JDB, "b_k", "b_v", range(8))}
+    ordered2, jp2 = planner.reorder_for_chunks(plan, 64, f2)
+    assert jp2.order == (1, 0)
+    assert jp1.token() != jp2.token()
+    assert pz.plan_fingerprint(ordered1) != pz.plan_fingerprint(ordered2)
+    # Stable stats (fresh chunk objects, same shape of data): the same
+    # order, token, and fingerprint — nothing recompiles.
+    f3 = {"//a": _dim(JDA, "a_k", "a_v", range(16)),
+          "//b": _dim(JDB, "b_k", "b_v", range(8), dup=4)}
+    ordered3, jp3 = planner.reorder_for_chunks(plan, 64, f3)
+    assert jp3.order == jp1.order and jp3.token() == jp1.token()
+    assert pz.plan_fingerprint(ordered3) == pz.plan_fingerprint(ordered1)
+    # Decision-neutral drift: the dim's key RANGE moves (pushdown
+    # bounds shift) but no decision changes — token identical, so the
+    # bounds ride runtime bindings, not the cache key.
+    f4 = {"//a": _dim(JDA, "a_k", "a_v", range(16), base=100),
+          "//b": _dim(JDB, "b_k", "b_v", range(8), dup=4)}
+    _ordered4, jp4 = planner.reorder_for_chunks(plan, 64, f4)
+    assert jp4.token() == jp1.token()
+
+
+def _plan_joins():
+    return build_query(JQUERY, JSCHEMAS)
+
+
+def test_broadcast_flip_changes_token_order_does_not():
+    """The side-strategy decision is part of the token: a foreign table
+    growing past `broadcast_join_rows` flips broadcast → partition and
+    the token (hence every fused-program cache key) must differ."""
+    from ytsaurus_tpu.query import planner
+    plan = _plan_joins()
+    yt_config.set_compile_config(
+        yt_config.CompileConfig(broadcast_join_rows=20))
+    f_small = {"//a": _dim(JDA, "a_k", "a_v", range(16)),
+               "//b": _dim(JDB, "b_k", "b_v", range(8))}
+    jp_small = planner.plan_for_chunks(plan, 64, f_small)
+    assert [d.strategy for d in jp_small.decisions] == \
+        ["broadcast", "broadcast"]
+    f_grown = {"//a": _dim(JDA, "a_k", "a_v", range(32)),
+               "//b": _dim(JDB, "b_k", "b_v", range(8))}
+    jp_grown = planner.plan_for_chunks(plan, 64, f_grown)
+    grown_a = [d for d in jp_grown.decisions if d.index == 0][0]
+    assert grown_a.strategy == "partition"
+    assert jp_small.token() != jp_grown.token()
+
+
+def test_local_cascade_stable_stats_cache_hit_drift_recompiles():
+    """End to end through the local evaluator: repeated queries at
+    stable stats grow NO cache entries (100% hit); an order-flipping
+    drift compiles fresh programs and still answers correctly."""
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    plan = _plan_joins()
+    chunk = _jfact_chunk()
+    f1 = {"//a": _dim(JDA, "a_k", "a_v", range(16)),
+          "//b": _dim(JDB, "b_k", "b_v", range(8), dup=4)}
+    ev = Evaluator()
+    want = ev.run_plan(plan, chunk, f1).to_rows()
+    size1 = ev.cache_size()
+    assert ev.run_plan(plan, chunk, f1).to_rows() == want
+    assert ev.cache_size() == size1, \
+        "stable stats must serve the cached program"
+    # Drift flips the order: new programs (cache grows), right answer
+    # (INNER reorder is semantics-preserving — same multiset of rows).
+    f2 = {"//a": _dim(JDA, "a_k", "a_v", range(16), dup=4),
+          "//b": _dim(JDB, "b_k", "b_v", range(8))}
+    got = ev.run_plan(plan, chunk, f2).to_rows()
+    assert ev.cache_size() > size1, \
+        "an order-flipping drift must not reuse the stale program"
+    fresh = Evaluator().run_plan(plan, chunk, f2).to_rows()
+    key = lambda r: sorted(tuple(sorted(x.items())) for x in r)  # noqa: E731
+    assert key(got) == key(fresh)
+
+
 def test_distributed_shape_fingerprints(tpu_mesh=None):
     """The SPMD evaluator keys on the shape fingerprint too: same-shape
     plans reuse one cached exchange program (cache size stays flat)."""

@@ -349,6 +349,29 @@ def test_whole_plan_sync_waiver_and_clean(tmp_path):
     assert jax_hazards.run([f]) == []
 
 
+def test_whole_plan_sync_covers_fused_join_path(tmp_path):
+    """ISSUE 14: the fused multiway-join path rides the same one-sync
+    contract — a quota or count read inside `_run_join`-shaped code is
+    a finding; the stacked telemetry read through `_read_counts` stays
+    the single sanctioned transfer."""
+    f = fixture(tmp_path, "ytsaurus_tpu/parallel/whole_plan.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def _run_join(evaluator, plan, table):
+            demand = jnp.stack([table.counts.max()])
+            quota = int(demand.max())            # mid-join sync: finding
+            return quota
+
+        def _read_counts(final):
+            vals = np.asarray(final)             # THE sanctioned sync
+            return int(vals[0]), int(vals[1])
+    """)
+    findings = jax_hazards.run([f])
+    assert rules_of(findings) == ["whole-plan-sync"]
+    assert findings[0].line == 7
+
+
 def test_whole_plan_module_baseline_is_empty():
     """The REAL whole-plan module carries zero mid-plan syncs (the
     acceptance gate: the only transfer is the final stacked count

@@ -166,8 +166,9 @@ def test_repeat_query_compiles_nothing(table8):
 
 
 def test_unfusable_plans_fall_to_stitched_ladder(table8):
-    """Join plans (and WITH TOTALS) stay on the stitched rungs with
-    identical results; the whole_plan stat flag stays unset."""
+    """WITH TOTALS stays on the stitched rungs; join plans fuse since
+    ISSUE 14 — but one with NO foreign data still degrades cleanly, and
+    the fused join result matches the local evaluator."""
     from dataclasses import replace as dc_replace
 
     from ytsaurus_tpu.parallel.distributed import (
@@ -184,16 +185,18 @@ def test_unfusable_plans_fall_to_stitched_ladder(table8):
     plan = build_query("g, name, sum(v) AS sv FROM [//t] "
                        "JOIN [//d] ON g = dk GROUP BY g, name",
                        {T: SCHEMA, "//d": dim_schema})
-    assert can_fuse(plan) is not None
+    # Joins fuse now (ISSUE 14) — missing foreign data raises, and the
+    # ladder serves the query off-rung.
+    assert can_fuse(plan) is None
     de = DistributedEvaluator(mesh)
     with pytest.raises(YtError):
-        run_whole_plan(de, plan, table)
+        run_whole_plan(de, plan, table)         # no foreign chunks
     stats = QueryStatistics()
     got = coordinate_distributed(plan, mesh, chunks, {"//d": dim},
                                  evaluator=de, stats=stats)
     want = Evaluator().run_plan(plan, merged, {"//d": dim})
     assert _canon(got.to_rows()) == _canon(want.to_rows())
-    assert stats.whole_plan == 0
+    assert stats.whole_plan == 1               # fused join rung served it
     # WITH TOTALS: gated (eager two-rowset concat), reason names it.
     gplan = build_query("g, sum(v) AS sv FROM [//t] GROUP BY g",
                         {T: SCHEMA})

@@ -38,6 +38,10 @@ Rules
                   the final stacked count read (`_read_counts`); any
                   other sync site is a finding (it would re-stitch the
                   plan).  Replaces the generic host-sync rule there.
+                  The fused multiway-join path (ISSUE 14, `_run_join`)
+                  rides the same contract: its quota demands and join
+                  telemetry return stacked WITH the count through that
+                  one read, never as separate transfers.
 """
 
 from __future__ import annotations

@@ -415,6 +415,212 @@ def unify_dictionaries(columns: Sequence[Column]) -> tuple[list[Column], np.ndar
 # safe — it only costs pruning power on pathological columns.
 _STAT_STRING_CAP = 64
 
+# --- distinct-count sketch ----------------------------------------------------
+#
+# A fixed 64-register hash-max sketch (the HLL register layout) per
+# column, sealed into chunk meta next to min/max/has_null: the cost-based
+# join planner (query/planner.py) reads NDV off chunk metadata instead of
+# decoding data, and sketches MERGE across chunks by elementwise register
+# max — so a table-level NDV is a fold over per-chunk meta, never a scan.
+# 64 one-byte registers keep the meta payload bounded (the PR 5 hunk-
+# externalization lesson: stats must never re-inline data-sized payloads).
+
+NDV_SKETCH_SLOTS = 64
+_NDV_SLOT_BITS = 6
+_NDV_MAX_RANK = 58              # 64 - slot bits: ranks fit one byte
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over a uint64 array (wrapping arithmetic)."""
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def _ndv_sketch_from_hashes(hashes: np.ndarray) -> bytes:
+    """Fold uniform uint64 hashes into the 64-register sketch: low bits
+    pick the register, the rank is 1 + trailing-zero count of the rest
+    (the classic stochastic-averaging split)."""
+    regs = np.zeros(NDV_SKETCH_SLOTS, dtype=np.uint8)
+    if len(hashes):
+        h = hashes.astype(np.uint64)
+        slots = (h & np.uint64(NDV_SKETCH_SLOTS - 1)).astype(np.int64)
+        rest = h >> np.uint64(_NDV_SLOT_BITS)
+        with np.errstate(over="ignore"):
+            lsb = rest & (~rest + np.uint64(1))
+        # log2 of an exact power of two is exact in float64 up to 2^58.
+        rank = np.where(rest == 0, _NDV_MAX_RANK,
+                        1 + np.log2(np.maximum(lsb, 1).astype(np.float64))
+                        ).astype(np.uint8)
+        np.maximum.at(regs, slots, rank)
+    return regs.tobytes()
+
+
+def _hash_string_vocab(vocab: np.ndarray) -> np.ndarray:
+    """Deterministic (cross-process stable) uint64 content hash per
+    vocab entry, vectorized: one concatenated byte buffer, a wrapping
+    polynomial fold per segment (`np.add.reduceat` over byte·p^pos),
+    the length folded in, then splitmix.  Entries that are hunk refs
+    hash their id (the same identity the store dedups by).  This runs
+    on the chunk SEAL path — a per-entry digest loop would be
+    O(distinct) interpreter-speed work on exactly the high-NDV columns
+    the sketch exists for."""
+    from ytsaurus_tpu.chunks.hunks import HunkRef
+    n = len(vocab)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    entries = [v.hunk_id.encode() if isinstance(v, HunkRef) else bytes(v)
+               for v in vocab]
+    lengths = np.fromiter((len(e) for e in entries), count=n,
+                          dtype=np.int64)
+    # One leading sentinel byte per entry keeps every reduceat segment
+    # non-empty (reduceat over an empty segment would leak a neighbor's
+    # byte) and distinguishes b"" from absent.
+    data = np.frombuffer(b"\x01" + b"\x01".join(entries),
+                         dtype=np.uint8).astype(np.uint64)
+    seg_lengths = lengths + 1
+    starts = np.zeros(n, dtype=np.int64)
+    np.cumsum(seg_lengths[:-1], out=starts[1:])
+    p = np.uint64(0x9E3779B97F4A7C15 | 1)
+    with np.errstate(over="ignore"):
+        powers = np.empty(int(seg_lengths.max()), dtype=np.uint64)
+        powers[0] = 1
+        np.cumprod(np.full(len(powers) - 1, p, dtype=np.uint64),
+                   out=powers[1:])
+        pos = np.arange(len(data), dtype=np.int64) - \
+            np.repeat(starts, seg_lengths)
+        h = np.add.reduceat(data * powers[pos], starts)
+        h = h ^ (lengths.astype(np.uint64) *
+                 np.uint64(0xBF58476D1CE4E5B9))
+    return _splitmix64(h)
+
+
+def column_ndv_sketch(col: Column, row_count: int) -> "bytes | None":
+    """The column's distinct-count sketch over its valid values, or None
+    for types with no meaningful NDV (any/null)."""
+    if col.type in (EValueType.any, EValueType.null):
+        return None
+    n = row_count
+    valid = np.asarray(col.valid[:n]) if n else np.zeros(0, dtype=bool)
+    if not n or not valid.any():
+        return _ndv_sketch_from_hashes(np.zeros(0, dtype=np.uint64))
+    data = np.asarray(col.data[:n])[valid]
+    if col.type is EValueType.string:
+        vocab = col.dictionary if col.dictionary is not None \
+            else np.array([], dtype=object)
+        entry_hashes = _hash_string_vocab(vocab)
+        if len(entry_hashes) == 0:
+            hashes = np.zeros(0, dtype=np.uint64)
+        else:
+            hashes = entry_hashes[
+                np.clip(data.astype(np.int64), 0, len(entry_hashes) - 1)]
+    elif col.type is EValueType.double:
+        canon = np.where(data == 0.0, 0.0, data)   # -0.0 == +0.0
+        hashes = _splitmix64(canon.view(np.uint64))
+    else:
+        hashes = _splitmix64(data.astype(np.int64).view(np.uint64)
+                             if col.type is not EValueType.uint64
+                             else data.astype(np.uint64))
+    return _ndv_sketch_from_hashes(hashes)
+
+
+def _sketch_regs(sketch) -> "np.ndarray | None":
+    """Registers from a sketch payload.  Binary YSON round-trips bytes
+    that happen to be valid utf-8 as str — re-encoding restores the
+    exact original bytes, so both spellings parse."""
+    if sketch is None:
+        return None
+    if isinstance(sketch, str):
+        sketch = sketch.encode("utf-8")
+    regs = np.frombuffer(bytes(sketch), dtype=np.uint8)
+    if len(regs) != NDV_SKETCH_SLOTS:
+        return None                    # corrupt payload: unusable, not fatal
+    return regs
+
+
+def merge_ndv_sketches(sketches: "Iterable[bytes]") -> "bytes | None":
+    """Elementwise register max — the sketch of the UNION of the inputs."""
+    merged = None
+    for s in sketches:
+        regs = _sketch_regs(s)
+        if regs is None:
+            continue
+        merged = regs.copy() if merged is None else np.maximum(merged, regs)
+    return None if merged is None else merged.tobytes()
+
+
+def ndv_estimate(sketch: "bytes | None") -> int:
+    """Distinct-count estimate off the registers (HLL harmonic mean with
+    the linear-counting small-range correction).  >= 1 for a non-empty
+    sketch so selectivity divisions are always safe; 0 for no data."""
+    regs = _sketch_regs(sketch)
+    if regs is None:
+        return 0
+    regs = regs.astype(np.float64)
+    if not regs.any():
+        return 0
+    m = float(NDV_SKETCH_SLOTS)
+    est = 0.709 * m * m / np.sum(np.exp2(-regs))
+    zeros = int((regs == 0).sum())
+    if est <= 2.5 * m and zeros:
+        est = m * np.log(m / zeros)
+    return max(int(round(est)), 1)
+
+
+def merge_column_stats(stats_list: "Sequence[dict]") -> dict:
+    """Fold per-chunk column stats into table-level stats: min of mins,
+    max of maxes (None = unbounded wins), has_null ORs, `$row_count`
+    sums, sketches merge.  The planner's one-stop table cardinality
+    view over chunk metadata."""
+    def bound(v):
+        # Binary YSON round-trips utf-8-clean bytes as str; normalize so
+        # bounds from sealed meta and fresh host stats compare.
+        return v.encode("utf-8") if isinstance(v, str) else v
+
+    out: dict = {"$row_count": 0}
+    for stats in stats_list:
+        for name, entry in stats.items():
+            if name == "$row_count":
+                out["$row_count"] += int(entry)
+                continue
+            if not isinstance(entry, dict):
+                continue
+            entry = {**entry, "min": bound(entry.get("min")),
+                     "max": bound(entry.get("max"))}
+            cur = out.get(name)
+            if cur is None:
+                cur = {"min": entry.get("min"), "max": entry.get("max"),
+                       "has_null": bool(entry.get("has_null")),
+                       "ndv_sketch": entry.get("ndv_sketch"),
+                       "_empty": entry.get("min") is None
+                       and entry.get("max") is None}
+                out[name] = cur
+                continue
+            # A chunk with no valid rows (min AND max None) contributes
+            # nothing to the bounds; a lone None bound (the string-cap
+            # overflow) is genuinely unbounded and must win the merge.
+            entry_empty = entry.get("min") is None and \
+                entry.get("max") is None
+            if not entry_empty:
+                if cur.pop("_empty", False):
+                    cur["min"], cur["max"] = entry.get("min"), \
+                        entry.get("max")
+                else:
+                    for key, pick in (("min", min), ("max", max)):
+                        a, b = cur.get(key), entry.get(key)
+                        cur[key] = None if a is None or b is None \
+                            else pick(a, b)
+                cur["_empty"] = False
+            cur["has_null"] = cur["has_null"] or bool(entry.get("has_null"))
+            cur["ndv_sketch"] = merge_ndv_sketches(
+                [cur.get("ndv_sketch"), entry.get("ndv_sketch")])
+    for entry in out.values():
+        if isinstance(entry, dict):
+            entry.pop("_empty", None)
+    return out
+
 
 def _string_stat_upper(value: bytes) -> "bytes | None":
     """An upper bound for `value` no longer than the cap: the value itself
@@ -466,6 +672,10 @@ def chunk_column_stats(chunk: ColumnarChunk) -> dict:
             else:
                 entry["min"] = int(data.min())
                 entry["max"] = int(data.max())
+        # Bounded 64-byte distinct-count sketch (cost-based join
+        # planning reads NDV off metadata; merges across chunks by
+        # register max — merge_column_stats).
+        entry["ndv_sketch"] = column_ndv_sketch(col, n)
         out[name] = entry
     # Not a column: per-chunk row count rides the stats so metadata-only
     # consumers (chunk merger sizing) never decode the chunk.  "$" can

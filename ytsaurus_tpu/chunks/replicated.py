@@ -229,12 +229,15 @@ class ReplicatedChunkStore:
             chunk_id, lambda s: s.read_meta(chunk_id))
         return meta
 
-    def read_stats(self, chunk_id: str) -> dict:
+    def read_stats(self, chunk_id: str,
+                   backfill_sketch: bool = False) -> dict:
         """Seal-time column stats through the replica read ladder (each
         location's FsChunkStore memoizes, incl. the pre-stats decode
         backfill)."""
         _, stats, _ = self._read_with_ladder(
-            chunk_id, lambda s: s.read_stats(chunk_id))
+            chunk_id,
+            lambda s: s.read_stats(chunk_id,
+                                   backfill_sketch=backfill_sketch))
         return stats
 
     def exists(self, chunk_id: str) -> bool:

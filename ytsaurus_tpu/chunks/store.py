@@ -47,6 +47,13 @@ _FP_REMOVE = failpoints.register_site(
     error=lambda s: OSError(f"injected remove failure at {s}"))
 
 
+def _stats_missing_sketch(stats: dict) -> bool:
+    """True when a sealed column_stats payload predates the NDV sketch
+    (read_stats then decode-backfills it like the pre-stats path)."""
+    return any(isinstance(entry, dict) and "ndv_sketch" not in entry
+               for name, entry in stats.items() if name != "$row_count")
+
+
 def new_chunk_id() -> str:
     return uuid.uuid4().hex
 
@@ -141,20 +148,27 @@ class FsChunkStore:
     def read_meta(self, chunk_id: str) -> dict:
         return read_chunk_meta(self._read_blob(chunk_id))
 
-    def read_stats(self, chunk_id: str) -> dict:
-        """Per-column min/max/has_null pruning stats for a chunk.
+    def read_stats(self, chunk_id: str,
+                   backfill_sketch: bool = False) -> dict:
+        """Per-column min/max/has_null (+ NDV sketch) pruning stats.
 
         Written-at-seal chunks carry them in the meta header (one blob
         read, no block decompress).  BACKFILL: chunks persisted before
         stats existed decode once, compute host-side, and memoize — the
-        pre-stats cost paid once per chunk instead of per scan."""
+        pre-stats cost paid once per chunk instead of per scan.  Chunks
+        sealed WITH stats but before the NDV sketch joined them
+        decode-backfill the same way only when `backfill_sketch` asks
+        for it (the planner's stats fold) — metadata-only consumers
+        ($timestamp reads, bounds pruning) must never pay a full chunk
+        decode for a sketch they do not read."""
         with self._lock:
             stats = self._stats_memo.get(chunk_id)
-            if stats is not None:
+            if stats is not None and not (backfill_sketch
+                                          and _stats_missing_sketch(stats)):
                 return stats
-        meta = self.read_meta(chunk_id)
-        stats = meta.get("column_stats")
-        if stats is None:
+        stats = self.read_meta(chunk_id).get("column_stats")
+        if stats is None or (backfill_sketch
+                             and _stats_missing_sketch(stats)):
             from ytsaurus_tpu.chunks.columnar import chunk_column_stats
             stats = chunk_column_stats(self.read_chunk(chunk_id))
         with self._lock:

@@ -1368,6 +1368,43 @@ class YtClient:
                 "read", join.foreign_table)
         from ytsaurus_tpu.query.pruning import extract_column_intervals
         intervals = extract_column_intervals(plan.where)
+        if plan.joins:
+            # Semi-join pushdown (ISSUE 14): a selective INNER side's
+            # key [min, max] — merged off the foreign chunks' sealed
+            # metadata stats, no decode — narrows the scan intervals, so
+            # whole source shards whose key range cannot join anything
+            # prune before staging.
+            from ytsaurus_tpu.chunks.columnar import merge_column_stats
+            from ytsaurus_tpu.query import planner as query_planner
+            from ytsaurus_tpu.query.pruning import Interval
+            foreign_meta_stats = {}
+            for join in plan.joins:
+                try:
+                    fnode = self._table_node(join.foreign_table)
+                except YtError:
+                    continue
+                per_chunk = fnode.attributes.get("chunk_stats") or []
+                # A placeholder entry ({} — a chunk sealed before stats
+                # existed) means that chunk's key range is UNKNOWN:
+                # merging the OTHER chunks' bounds and pushing them
+                # would prune source rows that join the legacy chunk.
+                # Same per column: a column absent from any entry is
+                # unbounded for this table.
+                if not per_chunk or not all(isinstance(e, dict) and e
+                                            for e in per_chunk):
+                    continue
+                merged = merge_column_stats(per_chunk)
+                for cname in list(merged):
+                    if cname != "$row_count" and \
+                            not all(cname in e for e in per_chunk):
+                        merged.pop(cname)
+                foreign_meta_stats[join.foreign_table] = merged
+            if foreign_meta_stats:
+                pushed = query_planner.pushdown_intervals(
+                    plan, foreign_meta_stats)
+                for name, iv in pushed.items():
+                    intervals[name] = intervals.get(
+                        name, Interval()).narrow(iv)
         range_ordered_by = None
         source_chunks = self._indexed_source_chunks(plan, intervals,
                                                     timestamp)

@@ -545,11 +545,13 @@ class CompileConfig(YsonStruct):
       jit(shard_map) program (parallel/whole_plan.py, ISSUE 12) — the
       top rung of the degradation ladder.  Off forces the stitched
       rungs (bench A/B leg, escape hatch).
-    - `whole_plan_headroom`: multiplier on the observed/estimated
-      exchange transfer-matrix maximum when sizing the fused program's
-      static all_to_all quota; larger values absorb more demand jitter
-      per compiled quota rung, smaller values keep the exchange
-      buffers tighter."""
+    - `whole_plan_headroom`: multiplier applied when an OVERFLOW
+      escalates a fused program's static exchange/expansion quota (the
+      estimate has proven short, so the re-run takes extra slack).
+      First guesses and settled steady-state quotas round the
+      estimate/measured demand to pow2 WITHOUT it — the rounding is
+      the slack, and doubling accurate capacities taxes every
+      downstream stage."""
 
     parameterize = param(True, type=bool)
     disk_cache_dir = param(None, type=str)
@@ -557,6 +559,16 @@ class CompileConfig(YsonStruct):
     disk_cache_min_compile_seconds = param(0.0, type=float, ge=0.0)
     whole_plan = param(True, type=bool)
     whole_plan_headroom = param(1.5, type=float, ge=1.0)
+    # Cost-based join planning (query/planner.py, ISSUE 14): reorder
+    # multiway equi-joins by estimated cardinality (chunk-stats NDV
+    # sketches), choose broadcast-vs-partition per side, and push
+    # semi-join key ranges from selective sides into the scan stage.
+    # Off restores the declared left-to-right cascade (bench A/B leg,
+    # escape hatch).  `broadcast_join_rows`: foreign sides at or below
+    # this row count replicate to every device instead of riding the
+    # co-partition exchange (they must also prove unique join keys).
+    cost_join_planner = param(True, type=bool)
+    broadcast_join_rows = param(65536, type=int, ge=0)
 
 
 _COMPILE_CONFIG: "Optional[CompileConfig]" = None

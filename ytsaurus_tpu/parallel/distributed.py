@@ -225,6 +225,65 @@ class _JoinSetup:
     fingerprint: tuple
 
 
+def _chunk_memo(cache: dict, key: tuple, chunk, build):
+    """id()-keyed per-chunk memo with a weakref liveness guard and
+    finalizer eviction (the stats_for_chunk discipline): a recycled
+    object id can never serve a DEAD chunk's staged planes, and a dead
+    chunk's device buffers do not outlive it in the cache."""
+    import weakref
+    entry = cache.get(key)
+    if entry is not None and entry[0]() is chunk:
+        return entry[1]
+    value = build()
+    cache[key] = (weakref.ref(chunk), value)
+    weakref.finalize(chunk, cache.pop, key, None)
+    return value
+
+
+def _foreign_host_order(cache: dict, join: ir.JoinClause, foreign,
+                        self_bound, f_bound, foreign_slots, bindings):
+    """Host phase shared by the stitched broadcast join and the fused
+    whole-plan join: encode + sort the foreign keys once, verify
+    uniqueness, memoize per (join shape, foreign chunk identity, vocab
+    identities).  Returns (f_order, f_sorted, unique)."""
+    from ytsaurus_tpu.query.engine.expr import EmitContext
+    from ytsaurus_tpu.query.engine.joins import (
+        _emit_encoded_keys, sort_foreign_keys,
+    )
+
+    f_ctx = EmitContext(columns={
+        name: (foreign.columns[name].data, foreign.columns[name].valid)
+        for name in foreign.schema.column_names},
+        bindings=tuple(bindings), capacity=foreign.capacity)
+    f_keys = _emit_encoded_keys(f_bound, foreign_slots, f_ctx)
+    n_foreign = foreign.row_count
+    # Deliberately the VALUE-CARRYING fingerprint (not the parameterized
+    # one): this cache holds computed key planes, not a program, so
+    # equation literals must distinguish.  Remapped codes depend on BOTH
+    # sides' vocabularies (the merged space): key on their identities.
+    host_key = ("join-host", ir.fingerprint(ir.Query(
+        schema=join.foreign_schema, source=join.foreign_table,
+        joins=(join,))), id(foreign), foreign.capacity, n_foreign,
+        tuple(id(b.vocab) if b.vocab is not None else None
+              for b in list(self_bound) + list(f_bound)))
+
+    def build():
+        f_order, f_sorted = sort_foreign_keys(f_keys, foreign.row_valid)
+        # Unique-key check over adjacent sorted pairs.  Null-keyed rows
+        # match nothing, so duplicates among them are fine.
+        live = jnp.arange(foreign.capacity) < (n_foreign - 1)
+        same = jnp.ones(foreign.capacity, dtype=bool)
+        non_null = jnp.ones(foreign.capacity, dtype=bool)
+        for v, d in f_sorted:
+            same = same & (v == jnp.roll(v, -1)) & \
+                (d == jnp.roll(d, -1))
+            non_null = non_null & (v > 0)
+        unique = not bool(jnp.any(same & live & non_null))
+        return f_order, f_sorted, unique
+
+    return _chunk_memo(cache, host_key, foreign, build)
+
+
 class DistributedEvaluator:
     """Compiles and caches SPMD (join ∘ bottom ∘ all_gather ∘ front)
     programs."""
@@ -321,6 +380,14 @@ class DistributedEvaluator:
         String keys work on both paths via merged vocabularies."""
         join_setup = None
         if plan.joins:
+            # Cost-based execution order (query/planner.py): the same
+            # decisions the fused rung makes, so a query degrading off
+            # the whole-plan rung runs the SAME join order — and the
+            # reordered plan's fingerprint keys every stitched program
+            # cache (a stats-driven order flip never reuses stale).
+            from ytsaurus_tpu.query import planner
+            plan, _jplan = planner.reorder_for_chunks(
+                plan, table.total_rows, foreign_chunks or {})
             join_setup = None if shuffle else self._prepare_joins(
                 plan, table, foreign_chunks or {})
             if join_setup is None:
@@ -825,8 +892,7 @@ class DistributedEvaluator:
             BindContext, ColumnBinding, EmitContext, ExprBinder,
         )
         from ytsaurus_tpu.query.engine.joins import (
-            _bind_keys, _emit_encoded_keys, _lex_searchsorted,
-            null_key_mask, sort_foreign_keys,
+            _bind_keys, _emit_encoded_keys, probe_replicated,
         )
 
         cap = table.capacity
@@ -860,44 +926,13 @@ class DistributedEvaluator:
                                  structure=bind_structure)
             self_slots, foreign_slots = _vocab_remap_slots(
                 self_bound, f_bound, bindings)
-            # Host phase: encode + sort the foreign keys, verify unique.
-            f_ctx = EmitContext(columns={
-                name: (foreign.columns[name].data,
-                       foreign.columns[name].valid)
-                for name in foreign.schema.column_names},
-                bindings=tuple(bindings), capacity=foreign.capacity)
-            f_keys = _emit_encoded_keys(f_bound, foreign_slots, f_ctx)
-            n_foreign = foreign.row_count
             # Host phase cached per (join shape, foreign chunk identity):
             # repeated queries against an unchanged dimension table must
             # not re-sort it or pay the uniqueness-check device sync.
-            # Deliberately the VALUE-CARRYING fingerprint (not the
-            # parameterized one): this cache holds computed key planes,
-            # not a program, so equation literals must distinguish.
-            host_key = ("join-host", ir.fingerprint(ir.Query(
-                schema=join.foreign_schema, source=join.foreign_table,
-                joins=(join,))), id(foreign), foreign.capacity, n_foreign,
-                # Remapped codes depend on BOTH sides' vocabularies (the
-                # merged space): key the cache on their identities.
-                tuple(id(b.vocab) if b.vocab is not None else None
-                      for b in list(self_bound) + list(f_bound)))
-            cached = self._cache.get(host_key)
-            if cached is None:
-                f_order, f_sorted = sort_foreign_keys(f_keys,
-                                                      foreign.row_valid)
-                # Unique-key check over adjacent sorted pairs.  Null-keyed
-                # rows match nothing, so duplicates among them are fine.
-                live = jnp.arange(foreign.capacity) < (n_foreign - 1)
-                same = jnp.ones(foreign.capacity, dtype=bool)
-                non_null = jnp.ones(foreign.capacity, dtype=bool)
-                for v, d in f_sorted:
-                    same = same & (v == jnp.roll(v, -1)) & \
-                        (d == jnp.roll(d, -1))
-                    non_null = non_null & (v > 0)
-                unique = not bool(jnp.any(same & live & non_null))
-                cached = (f_order, f_sorted, unique)
-                self._cache[host_key] = cached
-            f_order, f_sorted, unique = cached
+            f_order, f_sorted, unique = _foreign_host_order(
+                self._cache, join, foreign, self_bound, f_bound,
+                foreign_slots, bindings)
+            n_foreign = foreign.row_count
             if not unique:
                 return None     # fact-to-fact: partitioned exchange path
             # Replicated args: sorted key planes + gathered foreign columns.
@@ -917,7 +952,7 @@ class DistributedEvaluator:
                 rep_columns[flat] = _RepColumn(type=fcol.type,
                                                dictionary=fcol.dictionary)
             args.append(jnp.asarray(n_foreign, dtype=jnp.int64))
-            steps.append((self_bound, self_slots, len(f_keys),
+            steps.append((self_bound, self_slots, len(f_bound),
                           join.is_left, flat_names, (arg_start, len(args)),
                           foreign.capacity))
             fingerprint_parts.append(
@@ -936,28 +971,16 @@ class DistributedEvaluator:
         def apply(columns, mask, bnd, join_args):
             for (self_bound, self_slots, n_keys, is_left, flat_names,
                  (a0, a1), f_cap) in steps:
-                sl = join_args[a0:a1]
-                f_sorted = [(sl[2 * i], sl[2 * i + 1])
-                            for i in range(n_keys)]
-                n_foreign = sl[-1]
                 ctx = EmitContext(columns=columns, bindings=bnd,
                                   capacity=cap)
                 self_keys = _emit_encoded_keys(
                     self_bound, self_slots, ctx)
-                lo = _lex_searchsorted(f_sorted, n_foreign, f_cap,
-                                       self_keys, "left")
-                hi = _lex_searchsorted(f_sorted, n_foreign, f_cap,
-                                       self_keys, "right")
-                matched = mask & ~null_key_mask(self_keys) & (hi > lo)
-                pos = jnp.clip(lo, 0, f_cap - 1)
+                pulled, mask = probe_replicated(
+                    join_args[a0:a1], n_keys, f_cap, self_keys, mask,
+                    is_left)
                 columns = dict(columns)
-                base = 2 * n_keys
-                for i, flat in enumerate(flat_names):
-                    fd = sl[base + 2 * i]
-                    fv = sl[base + 2 * i + 1]
-                    columns[flat] = (fd[pos], fv[pos] & matched)
-                if not is_left:
-                    mask = matched
+                for flat, plane in zip(flat_names, pulled):
+                    columns[flat] = plane
             return columns, mask
 
         return _JoinSetup(apply=apply, bindings=join_bindings,
@@ -1046,7 +1069,8 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
                 # index — a query served off-rung shows WHERE it fell.
                 with child_span("distributed.whole_plan", rung=0,
                                 shards=len(chunks)):
-                    return run_whole_plan(de, plan, table, stats=stats)
+                    return run_whole_plan(de, plan, table, stats=stats,
+                                          foreign_chunks=foreign_chunks)
             except Exception as err:   # noqa: BLE001 — the fused rung
                 # degrades on ANY fault (whole_plan.py's contract): a
                 # plan shape whose fused lowering trips an XLA/dtype

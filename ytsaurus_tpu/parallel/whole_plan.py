@@ -67,7 +67,7 @@ from ytsaurus_tpu.query.parameterize import plan_fingerprint
 # first-hit, so a custom registry can pin one stage or column family
 # ("scan/l_.*") ahead of the defaults.
 DEFAULT_PARTITION_RULES: "tuple[tuple[str, P], ...]" = (
-    (r"^(scan|filter|bottom|shuffle|local)(/|$)", P(SHARD_AXIS)),
+    (r"^(scan|filter|bottom|shuffle|local|join)(/|$)", P(SHARD_AXIS)),
     (r"^(front|merge|order|topk|project|limit)(/|$)", P()),
 )
 
@@ -108,9 +108,10 @@ def _validate_stages(rules, stages: "list[tuple[str, bool]]") -> None:
 
 def can_fuse(plan: ir.Query) -> Optional[str]:
     """None when the whole plan lowers as one SPMD program; otherwise
-    the reason it stays on the stitched ladder."""
-    if plan.joins:
-        return "join plans run the stitched broadcast/partitioned paths"
+    the reason it stays on the stitched ladder.  Multiway equi-join
+    plans fuse since ISSUE 14 (planner-ordered broadcast/partition
+    joins ride inside the one program — `_run_join`); WITH TOTALS stays
+    stitched (it concatenates two materialized rowsets)."""
     if plan.group is not None and plan.group.totals:
         return "WITH TOTALS concatenates two materialized rowsets"
     return None
@@ -141,39 +142,47 @@ def _shape_of(plan: ir.Query) -> str:
 
 
 def run_whole_plan(evaluator, plan: ir.Query, table, stats=None,
-                   rules=None):
+                   rules=None, foreign_chunks=None):
     """Execute `plan` over a ShardedTable as ONE fused SPMD program.
 
     `evaluator` is the DistributedEvaluator owning the compile ladder
     (memory cache → AOT disk tier → fresh compile) and the quota memo.
-    Raises YtError for unfusable plans or in-program faults — the
-    caller's degradation ladder steps down to the stitched rungs.
+    `foreign_chunks` maps join table path → replicated ColumnarChunk
+    (multiway join plans fuse through `_run_join`).  Raises YtError for
+    unfusable plans or in-program faults — the caller's degradation
+    ladder steps down to the stitched rungs.
     """
     reason = can_fuse(plan)
     if reason is not None:
         raise YtError(f"plan is not whole-plan fusable: {reason}",
                       code=EErrorCode.QueryUnsupported)
     rules = DEFAULT_PARTITION_RULES if rules is None else tuple(rules)
-    shape = _shape_of(plan)
-    if shape == "gather":
-        chunk = _run_gather(evaluator, plan, table, rules)
+    if plan.joins:
+        chunk = _run_join(evaluator, plan, table, rules, stats,
+                          foreign_chunks or {})
     else:
-        chunk = _run_exchange(evaluator, plan, table, rules, shape,
-                              stats)
+        shape = _shape_of(plan)
+        if shape == "gather":
+            chunk = _run_gather(evaluator, plan, table, rules)
+        else:
+            chunk = _run_exchange(evaluator, plan, table, rules, shape,
+                                  stats)
     if stats is not None:
         stats.whole_plan = 1
     return chunk
 
 
-def _read_counts(final) -> "tuple[int, int, int]":
-    """THE whole-plan host sync: ONE stacked device→host transfer
-    carrying (result row count, overflow flag, max transfer cell).
-    Gather-shape programs return a bare count (no exchange — overflow
-    impossible)."""
+def _read_counts(final) -> np.ndarray:
+    """THE whole-plan host sync: ONE stacked device→host transfer.
+    Every fused shape funnels its single blocking read through here —
+    gather programs return a bare count, exchange programs a (count,
+    overflow, max-cell) triple, fused-join programs the count plus the
+    per-join quota-demand/actual telemetry block.  Returns a 1-D int64
+    vector; callers index their layout."""
     vals = np.asarray(final)
     if vals.ndim == 0:
-        return int(vals), 0, 0
-    return int(vals[0]), int(vals[1]), int(vals[2])
+        return np.array([int(vals)], dtype=np.int64)
+    return vals.astype(np.int64).reshape(-1)
 
 
 def _scan_shardings(rules, mesh, names: "list[str]"):
@@ -276,7 +285,7 @@ def _run_gather(evaluator, plan: ir.Query, table, rules):
                      tuple(prepared_b.bindings),
                      tuple(prepared_f.bindings)))
     dist._note_host_sync()            # the final count read
-    count, _over, _cell = _read_counts(out_count)
+    count = int(_read_counts(out_count)[0])
     return dist._assemble_chunk(prepared_f.output, out_planes, count)
 
 
@@ -329,12 +338,16 @@ def _initial_quota(memo: dict, memo_key, bound_cap: int, n: int,
 
 
 def _settle_quota(memo: dict, memo_key, demand: int,
-                  bound: int, headroom: float) -> None:
+                  bound: int) -> None:
     """Memoize the demand-sized quota for the next query of this shape.
-    Hysteresis: only shrink past a 4x gap (pow2 + headroom already give
-    ~2x slack), so per-query demand jitter cannot thrash the compile
-    cache with alternating quota rungs."""
-    settled = min(bound, pad_capacity(max(int(demand * headroom), 64)))
+    pow2 rounding of the MEASURED demand is the steady-state slack
+    (multiplying by the configured headroom first would double most
+    capacities for nothing — headroom belongs to the overflow
+    escalation, where the estimate has proven short).  Hysteresis: only
+    shrink past a 4x gap, and upward moves always apply, so per-query
+    demand jitter cannot thrash the compile cache with alternating
+    quota rungs."""
+    settled = min(bound, pad_capacity(max(int(demand), 64)))
     prev = memo.get(memo_key)
     if prev is None or settled > prev or settled * 4 <= prev:
         memo[memo_key] = settled
@@ -528,7 +541,8 @@ def _run_exchange(evaluator, plan: ir.Query, table, rules, shape: str,
         # stacked transfer and the counter must say so (steady state
         # stays at exactly one).
         dist._note_host_sync()
-        count, over, demand = _read_counts(final)
+        vals = _read_counts(final)
+        count, over, demand = int(vals[0]), int(vals[1]), int(vals[2])
         if not over:
             break
         if quota >= bound:
@@ -541,6 +555,623 @@ def _run_exchange(evaluator, plan: ir.Query, table, rules, shape: str,
         quota = min(bound,
                     max(pad_capacity(max(int(demand * headroom), 1)),
                         quota * 2))
-    _settle_quota(evaluator._quota_memo, memo_key, demand, bound,
-                  headroom)
+    _settle_quota(evaluator._quota_memo, memo_key, demand, bound)
     return dist._assemble_chunk(prepared_front.output, out_planes, count)
+
+
+# -- fused multiway join (ISSUE 14) --------------------------------------------
+
+
+_OUT_CAP_UNBOUNDED = 1 << 40      # join expansion has no per-source bound
+
+
+def _join_flat_names(join: ir.Query, needed) -> "list[tuple[str, str]]":
+    """(flat output name, foreign column) pairs this join pulls, pruned
+    to what the plan reads."""
+    pairs = [(f"{join.alias}.{f}" if join.alias else f, f)
+             for f in join.foreign_columns]
+    if needed is not None:
+        pairs = [(flat, f) for flat, f in pairs if flat in needed]
+    return pairs
+
+
+def _gate_fusable_join(join, foreign) -> None:
+    """Foreign sides with host-resident payloads (`any` columns) cannot
+    ride a device program — degrade to the stitched/host rungs."""
+    from ytsaurus_tpu.schema import EValueType
+    for fname in join.foreign_columns:
+        fcol = foreign.columns.get(fname)
+        if fcol is None:
+            raise YtError(f"Join table {join.foreign_table!r} has no "
+                          f"column {fname!r}",
+                          code=EErrorCode.QueryExecutionError)
+        if fcol.type is EValueType.any or fcol.host_values is not None:
+            raise YtError(
+                f"join column {fname!r} carries host payloads — "
+                "not whole-plan fusable",
+                code=EErrorCode.QueryUnsupported)
+
+
+def _fallback_decisions(plan_x: ir.Query, foreign_chunks) -> tuple:
+    """Planner-off decisions: declared order, broadcast only for small
+    sides (same threshold), no pushdown."""
+    from ytsaurus_tpu.config import compile_config
+    from ytsaurus_tpu.query.planner import JoinDecision
+    cap = compile_config().broadcast_join_rows
+    out = []
+    for i, join in enumerate(plan_x.joins):
+        foreign = foreign_chunks.get(join.foreign_table)
+        f_rows = foreign.row_count if foreign is not None else 0
+        out.append(JoinDecision(
+            index=i, strategy="broadcast" if 0 < f_rows <= cap
+            else "partition", est_in=0, est_out=0, foreign_rows=f_rows))
+    return tuple(out)
+
+
+class _BroadcastSetup:
+    """Replicated probe: sorted foreign key planes + pulled columns ride
+    as P() args; per-shard lexicographic search, no exchange."""
+
+    def __init__(self, join, self_bound, self_slots, n_keys,
+                 arg_slice, f_cap, flat_names):
+        self.join = join
+        self.self_bound = self_bound
+        self.self_slots = self_slots
+        self.n_keys = n_keys
+        self.arg_slice = arg_slice
+        self.f_cap = f_cap
+        self.flat_names = flat_names
+        self.strategy = "broadcast"
+
+
+class _PartitionSetup:
+    """Co-partition exchange: both sides route by key hash over the
+    in-program all_to_all, then probe + expand per device."""
+
+    def __init__(self, join, self_bound, self_slots, f_bound,
+                 foreign_slots, f_shard_index, f_slice, f_count,
+                 flat_names):
+        self.join = join
+        self.self_bound = self_bound
+        self.self_slots = self_slots
+        self.f_bound = f_bound
+        self.foreign_slots = foreign_slots
+        self.f_shard_index = f_shard_index
+        self.f_slice = f_slice
+        self.f_count = f_count
+        self.flat_names = flat_names
+        self.strategy = "partition"
+
+
+def _stage_foreign_shards(evaluator, foreign, f_names, n, mesh):
+    """Shard a foreign chunk 1/n per device (the partition-join staging
+    of the stitched path), memoized per (chunk identity, mesh shape):
+    repeated queries against an unchanged dimension table must not
+    re-transfer it."""
+    from ytsaurus_tpu.chunks.columnar import pad_capacity as _pad
+    from ytsaurus_tpu.parallel import distributed as dist
+    f_count = foreign.row_count
+    f_slice = _pad(max((f_count + n - 1) // n, 1))
+    key = ("join-fshard", id(foreign), n, f_slice, tuple(f_names))
+
+    def build():
+        shard_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        f_total = n * f_slice
+        pad = f_total - f_count
+        f_global = {}
+        for fname in f_names:
+            fcol = foreign.columns[fname]
+            data = jnp.concatenate(
+                [fcol.data[:f_count],
+                 jnp.zeros(pad, dtype=fcol.data.dtype)])
+            valid = jnp.concatenate(
+                [fcol.valid[:f_count], jnp.zeros(pad, dtype=bool)])
+            f_global[fname] = (jax.device_put(data, shard_sharding),
+                               jax.device_put(valid, shard_sharding))
+        f_row_valid = jax.device_put(jnp.arange(f_total) < f_count,
+                                     shard_sharding)
+        return f_global, f_row_valid, f_slice
+
+    return dist._chunk_memo(evaluator._cache, key, foreign, build)
+
+
+def _broadcast_args(evaluator, join, foreign, f_order, f_sorted,
+                    flat_names):
+    """Replicated probe args for one broadcast join (sorted key planes,
+    f_order-gathered pulled columns, live count), memoized with the
+    host-order phase's identity discipline."""
+    from ytsaurus_tpu.parallel import distributed as dist
+    key = ("join-bargs", id(foreign), id(f_order),
+           tuple(f for _flat, f in flat_names))
+
+    def build():
+        args: list = []
+        for v, d in f_sorted:
+            args.append(v)
+            args.append(d)
+        for _flat, fname in flat_names:
+            fcol = foreign.columns[fname]
+            args.append(fcol.data[f_order])
+            args.append(fcol.valid[f_order])
+        args.append(jnp.asarray(foreign.row_count, dtype=jnp.int64))
+        return tuple(args)
+
+    return dist._chunk_memo(evaluator._cache, key, foreign, build)
+
+
+def _join_pid(keys, mask, n: int, keep_null_local: bool):
+    """Destination device by encoded-key hash (the partitioned-join
+    routing of distributed.py): null-keyed live rows stay local for
+    LEFT joins (they still emit an unmatched row) and are discarded
+    otherwise."""
+    from ytsaurus_tpu.query.engine.expr import _combine_u64, _mix_u64
+    from ytsaurus_tpu.parallel.distributed import _canonical_hash_plane
+    from ytsaurus_tpu.query.engine.joins import null_key_mask
+    acc = jnp.full(mask.shape, np.uint64(0x9E3779B97F4A7C15),
+                   dtype=jnp.uint64)
+    for v, d in keys:
+        h = _mix_u64(_canonical_hash_plane(d))
+        h = jnp.where(v > 0, h, jnp.zeros_like(h))
+        acc = _combine_u64(acc, h)
+    pid = (acc % np.uint64(n)).astype(jnp.int32)
+    null = null_key_mask(keys)
+    if keep_null_local:
+        me = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32)
+        pid = jnp.where(null, me, pid)
+    else:
+        pid = jnp.where(null, n, pid)
+    return jnp.where(mask, pid, n)
+
+
+def _run_join(evaluator, plan: ir.Query, table, rules, stats,
+              foreign_chunks: dict):
+    """Multiway equi-join plans as ONE fused SPMD program (the ISSUE 14
+    tentpole): the cost-based planner (query/planner.py) orders the
+    joins and picks broadcast-vs-partition per side off chunk-stats
+    cardinalities; broadcast sides replicate their sorted key planes
+    (the joins.py lexicographic-search backbone probes them per shard),
+    partition sides co-partition BOTH inputs by join-key hash through
+    the same in-program all_to_all the GROUP BY shapes use; the joined
+    rowset then runs bottom → all_gather → front without leaving the
+    program.  The PR 10 memoized quota/overflow protocol covers every
+    data-dependent capacity (two exchange quotas + the match-expansion
+    output capacity per partition join): static pow2 sizes, true
+    demands computed on device and returned stacked WITH the final
+    count — one host sync — and an overflow re-runs at the demanded
+    rung then memoizes it.  Planner decisions (order, strategies,
+    pushdown columns) fold into the program cache key, so a stats-
+    driven plan change can never serve a stale program."""
+    from dataclasses import replace as dc_replace
+
+    from ytsaurus_tpu.config import compile_config
+    from ytsaurus_tpu.parallel import distributed as dist
+    from ytsaurus_tpu.query import planner
+    from ytsaurus_tpu.query.engine.expr import (
+        BindContext, ColumnBinding, EmitContext, ExprBinder,
+    )
+    from ytsaurus_tpu.query.engine.joins import (
+        _bind_keys, _emit_encoded_keys, _lex_searchsorted, null_key_mask,
+        probe_replicated, sort_foreign_keys,
+    )
+    from ytsaurus_tpu.schema import EValueType, TableSchema
+
+    mesh = table.mesh
+    n = mesh.devices.size
+    cap = table.capacity
+    headroom = compile_config().whole_plan_headroom
+
+    # -- plan: order + strategies + pushdown off the chunk stats -------
+    jplan = planner.plan_for_chunks(plan, table.total_rows,
+                                    foreign_chunks)
+    plan_x = planner.apply_order(plan, jplan)
+    decisions = jplan.decisions if jplan is not None else \
+        _fallback_decisions(plan_x, foreign_chunks)
+    needed = ir.referenced_columns(plan_x)
+
+    # -- host phase: bind every join against the widening namespace ----
+    bindings: list = []
+    bind_structure: list = []
+    namespace: dict = {
+        name: ColumnBinding(type=col.type, vocab=col.dictionary)
+        for name, col in table.columns.items()}
+    rep_columns: dict = {
+        name: dist._RepColumn(type=col.type, dictionary=col.dictionary)
+        for name, col in table.columns.items()}
+    setups: list = []
+    rep_args: list = []             # replicated broadcast-probe args
+    f_shards: list = []             # per-partition-join sharded planes
+    fingerprint_parts: list = []
+    for join, decision in zip(plan_x.joins, decisions):
+        foreign = foreign_chunks.get(join.foreign_table)
+        if foreign is None:
+            raise YtError(
+                f"No data provided for join table {join.foreign_table!r}",
+                code=EErrorCode.QueryExecutionError)
+        _gate_fusable_join(join, foreign)
+        bind_ctx = BindContext(columns=dict(namespace),
+                               bindings=bindings,
+                               structure=bind_structure)
+        binder = ExprBinder(bind_ctx)
+        self_bound = [binder.bind(e) for e in join.self_equations]
+        f_bound = _bind_keys(foreign, join.foreign_schema,
+                             join.foreign_equations, bindings,
+                             structure=bind_structure)
+        self_slots, foreign_slots = dist._vocab_remap_slots(
+            self_bound, f_bound, bindings)
+        flat_names = _join_flat_names(join, needed)
+        strategy = decision.strategy
+        if strategy == "broadcast":
+            # Broadcast needs provably unique foreign keys (the probe
+            # gathers a single match row); the host-order phase verifies
+            # and memoizes per chunk — non-unique sides fall back to the
+            # partition exchange, and the RESOLVED strategy keys caches.
+            f_order, f_sorted, unique = dist._foreign_host_order(
+                evaluator._cache, join, foreign, self_bound, f_bound,
+                foreign_slots, bindings)
+            if not unique:
+                strategy = "partition"
+        if strategy == "broadcast":
+            a0 = len(rep_args)
+            rep_args.extend(_broadcast_args(evaluator, join, foreign,
+                                            f_order, f_sorted,
+                                            flat_names))
+            setups.append(_BroadcastSetup(
+                join, self_bound, self_slots, len(f_bound),
+                (a0, len(rep_args)), foreign.capacity, flat_names))
+            fingerprint_parts.append(
+                ("broadcast", foreign.capacity, foreign.row_count > 0))
+        else:
+            f_key_refs: set = set()
+            for eq in join.foreign_equations:
+                f_key_refs.update(ir.expr_references(eq))
+            f_names = sorted(f_key_refs | {f for _flat, f in flat_names})
+            f_global, f_row_valid, f_slice = _stage_foreign_shards(
+                evaluator, foreign, f_names, n, mesh)
+            f_shards.append((f_global, f_row_valid))
+            setups.append(_PartitionSetup(
+                join, self_bound, self_slots, f_bound, foreign_slots,
+                len(f_shards) - 1, f_slice, foreign.row_count,
+                flat_names))
+            fingerprint_parts.append(
+                ("partition", f_slice, foreign.row_count > 0))
+        for flat, fname in flat_names:
+            fcol = foreign.columns[fname]
+            namespace[flat] = ColumnBinding(type=fcol.type,
+                                            vocab=fcol.dictionary)
+            rep_columns[flat] = dist._RepColumn(type=fcol.type,
+                                                dictionary=fcol.dictionary)
+        fingerprint_parts.append(tuple(
+            len(b.vocab) if b.vocab is not None else -1
+            for b in list(self_bound) + list(f_bound)))
+
+    # Semi-join pushdown: selective INNER sides' key ranges mask self
+    # rows BEFORE the first exchange (values ride 0-d bindings so stats
+    # drift that moves a bound recompiles nothing; the pushed COLUMN set
+    # is a planner decision and folds into the key via the token).
+    push_slots: list = []
+    if jplan is not None:
+        pushable = {EValueType.int64, EValueType.uint64, EValueType.double}
+        for name, lo, hi in jplan.pushdown_ranges():
+            col = table.columns.get(name)
+            if col is None or col.type not in pushable:
+                continue
+            dt = col.data.dtype
+            lo_slot = len(bindings)
+            bindings.append(jnp.asarray(lo, dtype=dt))
+            hi_slot = len(bindings)
+            bindings.append(jnp.asarray(hi, dtype=dt))
+            push_slots.append((name, lo_slot, hi_slot))
+    join_bindings = tuple(bindings)
+
+    # Shuffle-boundary fault sites (the chaos-soak contract): the fused
+    # join program ends in an all_gather, and partition joins ride the
+    # in-program all_to_all — an injected collective fault knocks this
+    # rung out and the ladder serves the query stitched.
+    dist._FP_GATHER.hit()
+    if any(s.strategy == "partition" for s in setups):
+        dist._FP_ALL_TO_ALL.hit()
+
+    scan_names = sorted(name for name in table.columns
+                        if needed is None or name in needed)
+    columns = {name: (table.columns[name].data, table.columns[name].valid)
+               for name in scan_names}
+    shardings = _scan_shardings(rules, mesh, scan_names)
+    stage_names = [(f"join/{i}", True) for i in range(len(setups))]
+    stage_names += [(f"shuffle/join/{i}", True)
+                    for i, s in enumerate(setups)
+                    if s.strategy == "partition"]
+    stage_names += [("bottom", True), ("front", False)]
+    _validate_stages(rules, stage_names)
+
+    # -- the post-join plan (bottom per device, all_gather, front) -----
+    plan_nojoin = dc_replace(plan_x, joins=())
+    if needed is not None:
+        plan_nojoin = dc_replace(plan_nojoin, schema=TableSchema(
+            columns=tuple(c for c in plan_x.schema if c.name in needed)))
+    bottom, front = split_plan(plan_nojoin)
+
+    token = tuple((d.index, s.strategy) for d, s in zip(decisions, setups)) \
+        + (tuple(name for name, _lo, _hi in push_slots),)
+    memo_base = ("join", plan_fingerprint(plan_x), token, n, cap)
+
+    def initial(kind: str, j: int, est: int, bound: int) -> int:
+        memo_key = memo_base + (j, kind)
+        start = evaluator._quota_memo.get(memo_key)
+        if start is None:
+            # pow2 rounding IS the first-guess headroom (1-2x slack):
+            # multiplying an accurate estimate by the configured
+            # headroom BEFORE rounding doubles every capacity — and the
+            # out capacity sizes all post-join stages.  A rare slight
+            # under-estimate costs one overflow retry (which applies
+            # the headroom) and memoizes; an accurate one runs tight.
+            start = min(bound, pad_capacity(max(64, est)))
+        return min(start, bound)
+
+    quotas: dict = {}
+    for j, (setup, decision) in enumerate(zip(setups, decisions)):
+        if setup.strategy != "partition":
+            continue
+        est_in = max(decision.est_in, 1)
+        est_out = max(decision.est_out, 1)
+        quotas[j] = {
+            # Expected max transfer cell ~ rows-per-device / n under
+            # uniform hashing; the overflow protocol absorbs skew.
+            "qs": initial("qs", j, est_in // (n * n), cap),
+            "qf": initial("qf", j, max(setup.f_count, 1) // (n * n),
+                          setup.f_slice),
+            "out": initial("out", j, max(est_out // n, 128),
+                           _OUT_CAP_UNBOUNDED),
+        }
+
+    while True:
+        # Per-iteration static capacities: each partition join's input
+        # capacity is the previous expansion's output capacity.
+        caps: list = []
+        cur_cap = cap
+        for j, setup in enumerate(setups):
+            caps.append(cur_cap)
+            if setup.strategy == "partition":
+                cur_cap = quotas[j]["out"]
+        final_cap = cur_cap
+
+        local_rep = dist._RepChunk(
+            capacity=final_cap,
+            columns={c.name: rep_columns[c.name]
+                     for c in bottom.schema})
+        prepared_b = prepare(bottom, local_rep)
+        inter_rep = dist._RepChunk(
+            capacity=n * prepared_b.out_capacity,
+            columns={c.name: dist._RepColumn(type=c.type,
+                                             dictionary=c.vocab)
+                     for c in prepared_b.output})
+        prepared_f = prepare(front, inter_rep)
+        out_cap_b = prepared_b.out_capacity
+
+        quota_state = tuple(
+            (j, quotas[j]["qs"], quotas[j]["qf"], quotas[j]["out"])
+            for j in sorted(quotas))
+
+        def build(quota_state=quota_state, caps=tuple(caps),
+                  prepared_b=prepared_b, prepared_f=prepared_f,
+                  out_cap_b=out_cap_b):
+            q = {j: (qs, qf, oc) for j, qs, qf, oc in quota_state}
+
+            def fused(columns, row_valid, jbnd, rep_args_t, f_shards_t,
+                      b_bnd, f_bnd):
+                cur = dict(columns)
+                mask = row_valid
+                for _name, lo_slot, hi_slot in push_slots:
+                    d, v = cur[_name]
+                    mask = mask & v & (d >= jbnd[lo_slot]) & \
+                        (d <= jbnd[hi_slot])
+                telemetry = []
+                for j, setup in enumerate(setups):
+                    cur_cap_j = caps[j]
+                    ctx = EmitContext(columns=cur, bindings=jbnd,
+                                      capacity=cur_cap_j)
+                    self_keys = _emit_encoded_keys(
+                        setup.self_bound, setup.self_slots, ctx)
+                    zero = jnp.zeros((), dtype=jnp.int64)
+                    if setup.strategy == "broadcast":
+                        a0, a1 = setup.arg_slice
+                        pulled, mask = probe_replicated(
+                            rep_args_t[a0:a1], setup.n_keys, setup.f_cap,
+                            self_keys, mask, setup.join.is_left)
+                        for (flat, _f), plane in zip(setup.flat_names,
+                                                     pulled):
+                            cur[flat] = plane
+                        actual = jax.lax.psum(
+                            mask.sum().astype(jnp.int64), SHARD_AXIS)
+                        telemetry.extend([zero, zero, zero, actual])
+                        continue
+                    # -- partition join ------------------------------
+                    qs, qf, oc = q[j]
+                    S, F = n * qs, n * qf
+                    is_left = setup.join.is_left
+                    fcols, fvalid = f_shards_t[setup.f_shard_index]
+                    fctx = EmitContext(columns=fcols, bindings=jbnd,
+                                       capacity=setup.f_slice)
+                    f_keys = _emit_encoded_keys(
+                        setup.f_bound, setup.foreign_slots, fctx)
+                    pid_s = _join_pid(self_keys, mask, n, is_left)
+                    pid_f = _join_pid(f_keys, fvalid, n, False)
+                    cells_s = transfer_counts(pid_s, pid_s < n, n)
+                    cells_f = transfer_counts(pid_f, pid_f < n, n)
+                    recv_s, mask_s = route_rows(cur, pid_s, n, qs,
+                                                cur_cap_j)
+                    recv_f, mask_f = route_rows(fcols, pid_f, n, qf,
+                                                setup.f_slice)
+                    sctx = EmitContext(columns=recv_s, bindings=jbnd,
+                                       capacity=S)
+                    s_keys = _emit_encoded_keys(
+                        setup.self_bound, setup.self_slots, sctx)
+                    rctx = EmitContext(columns=recv_f, bindings=jbnd,
+                                       capacity=F)
+                    r_keys = _emit_encoded_keys(
+                        setup.f_bound, setup.foreign_slots, rctx)
+                    f_order, f_sorted = sort_foreign_keys(r_keys, mask_f)
+                    n_f = mask_f.sum()
+                    lo = _lex_searchsorted(f_sorted, n_f, F, s_keys,
+                                           "left")
+                    hi = _lex_searchsorted(f_sorted, n_f, F, s_keys,
+                                           "right")
+                    s_null = null_key_mask(s_keys)
+                    counts = jnp.where(mask_s & ~s_null, hi - lo, 0)
+                    per_row = jnp.where(mask_s, jnp.maximum(counts, 1),
+                                        0) if is_left else counts
+                    offsets = jnp.cumsum(per_row)
+                    total = offsets[-1]
+                    starts = jnp.concatenate(
+                        [jnp.zeros(1, dtype=offsets.dtype),
+                         offsets[:-1]])
+                    out_idx = jnp.arange(oc)
+                    self_row = jnp.clip(
+                        jnp.searchsorted(offsets, out_idx, side="right"),
+                        0, S - 1)
+                    within = out_idx - starts[self_row]
+                    matched = counts[self_row] > 0
+                    f_pos = jnp.clip(lo[self_row] + within, 0, F - 1)
+                    f_row = f_order[f_pos]
+                    live = out_idx < total
+                    nxt = {}
+                    for name in sorted(cur):
+                        d, v = recv_s[name]
+                        nxt[name] = (d[self_row],
+                                     v[self_row] & live)
+                    for flat, fname in setup.flat_names:
+                        d, v = recv_f[fname]
+                        nxt[flat] = (d[f_row],
+                                     v[f_row] & live & matched)
+                    cur = nxt
+                    mask = live
+                    # Demands (replicated via collectives): true max
+                    # transfer cells + max per-device expansion.
+                    ds = jax.lax.pmax(
+                        cells_s.max().astype(jnp.int64), SHARD_AXIS)
+                    df = jax.lax.pmax(
+                        cells_f.max().astype(jnp.int64), SHARD_AXIS)
+                    dout = jax.lax.pmax(total.astype(jnp.int64),
+                                        SHARD_AXIS)
+                    actual = jax.lax.psum(
+                        live.sum().astype(jnp.int64), SHARD_AXIS)
+                    telemetry.extend([ds, df, dout, actual])
+                # -- bottom per device, all_gather, replicated front --
+                planes, cnt = prepared_b.run(cur, mask, b_bnd)
+                shard_mask = jnp.arange(out_cap_b) < cnt
+                gathered, g_mask = _gathered(
+                    list(zip(prepared_b.output, planes)), shard_mask,
+                    out_cap_b)
+                out_planes, out_count = prepared_f.run(gathered, g_mask,
+                                                       f_bnd)
+                over = jnp.zeros((), dtype=jnp.int64)
+                for j, (_j, qs, qf, oc) in enumerate(quota_state):
+                    base = 4 * _j
+                    over = jnp.maximum(
+                        over, (telemetry[base] > qs).astype(jnp.int64))
+                    over = jnp.maximum(
+                        over,
+                        (telemetry[base + 1] > qf).astype(jnp.int64))
+                    over = jnp.maximum(
+                        over,
+                        (telemetry[base + 2] > oc).astype(jnp.int64))
+                final = jnp.stack(
+                    [out_count.astype(jnp.int64), over] + telemetry)
+                return out_planes, final
+
+            mapped = shard_map(
+                fused, mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P(),
+                          P(SHARD_AXIS), P(), P()),
+                out_specs=P(), check_vma=False)
+
+            def program(columns, row_valid, jbnd, rep_args_t, f_shards_t,
+                        b_bnd, f_bnd):
+                columns, row_valid = _constrain_inputs(
+                    mesh, shardings, columns, row_valid)
+                return mapped(columns, row_valid, jbnd, rep_args_t,
+                              f_shards_t, b_bnd, f_bnd)
+
+            return program
+
+        key = ("whole", "join", plan_fingerprint(plan_x), n, cap, token,
+               quota_state, tuple(fingerprint_parts),
+               tuple(bind_structure),
+               tuple((tuple(b.shape), str(b.dtype))
+                     for b in join_bindings),
+               prepared_b.binding_shapes(), prepared_f.binding_shapes(),
+               rules_fingerprint(rules))
+        args = (columns, table.row_valid, join_bindings, tuple(rep_args),
+                tuple(f_shards), tuple(prepared_b.bindings),
+                tuple(prepared_f.bindings))
+        out_planes, final = evaluator._dispatch_spmd(key, build, args)
+        # Noted PER read: an overflow retry performs a real second
+        # stacked transfer and the counter must say so.
+        dist._note_host_sync()
+        vals = _read_counts(final)
+        count, over = int(vals[0]), int(vals[1])
+        if not over:
+            break
+        if stats is not None:
+            stats.whole_plan_retries += 1
+        escalated = False
+        for j, setup in enumerate(setups):
+            if setup.strategy != "partition":
+                continue
+            dem_s, dem_f, dem_o = (int(vals[2 + 4 * j]),
+                                   int(vals[3 + 4 * j]),
+                                   int(vals[4 + 4 * j]))
+            q = quotas[j]
+            if dem_s > q["qs"]:
+                bound = caps[j]
+                if q["qs"] >= bound:
+                    raise YtError(
+                        "fused join exchange overflowed at the maximal "
+                        f"quota (join {j}, quota={q['qs']}, "
+                        f"demand={dem_s})",
+                        code=EErrorCode.QueryExecutionError)
+                q["qs"] = min(bound,
+                              max(pad_capacity(
+                                  max(int(dem_s * headroom), 1)),
+                                  q["qs"] * 2))
+                escalated = True
+            if dem_f > q["qf"]:
+                bound = setup.f_slice
+                if q["qf"] >= bound:
+                    raise YtError(
+                        "fused join exchange overflowed at the maximal "
+                        f"quota (join {j}, quota={q['qf']}, "
+                        f"demand={dem_f})",
+                        code=EErrorCode.QueryExecutionError)
+                q["qf"] = min(bound,
+                              max(pad_capacity(
+                                  max(int(dem_f * headroom), 1)),
+                                  q["qf"] * 2))
+                escalated = True
+            if dem_o > q["out"]:
+                q["out"] = max(pad_capacity(
+                    max(int(dem_o * headroom), 1)), q["out"] * 2)
+                escalated = True
+        if not escalated:
+            raise YtError("fused join overflow without a demand above "
+                          "quota — telemetry inconsistent",
+                          code=EErrorCode.QueryExecutionError)
+
+    # Settle quotas (hysteresis via _settle_quota) + EXPLAIN telemetry.
+    for j, setup in enumerate(setups):
+        if setup.strategy == "partition":
+            dem_s, dem_f, dem_o = (int(vals[2 + 4 * j]),
+                                   int(vals[3 + 4 * j]),
+                                   int(vals[4 + 4 * j]))
+            _settle_quota(evaluator._quota_memo, memo_base + (j, "qs"),
+                          dem_s, caps[j])
+            _settle_quota(evaluator._quota_memo, memo_base + (j, "qf"),
+                          dem_f, setup.f_slice)
+            _settle_quota(evaluator._quota_memo, memo_base + (j, "out"),
+                          dem_o, _OUT_CAP_UNBOUNDED)
+    if stats is not None:
+        for j, (setup, decision) in enumerate(zip(setups, decisions)):
+            stats.note_join_stage(
+                j, setup.join.foreign_table, setup.strategy,
+                est_rows=decision.est_out,
+                actual_rows=int(vals[5 + 4 * j]))
+    return dist._assemble_chunk(prepared_f.output, out_planes, count)

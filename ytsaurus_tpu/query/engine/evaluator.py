@@ -369,6 +369,17 @@ class Evaluator:
         if token is not None:
             token.check()
         t0 = _time.perf_counter()
+        jplan = None
+        if isinstance(plan, ir.Query) and len(plan.joins) > 1:
+            # Cost-based join order (ISSUE 14, query/planner.py): the
+            # cascade below runs most-selective-first off the foreign
+            # chunks' stats (memoized per chunk).  MUST happen before
+            # the fingerprint: the reordered plan's fingerprint is how
+            # the order reaches the compile cache — stable stats hit the
+            # same program, a stats-driven flip compiles a fresh one.
+            from ytsaurus_tpu.query import planner
+            plan, jplan = planner.reorder_for_chunks(
+                plan, chunk.row_count, foreign_chunks)
         # Span per plan execution, tagged with the plan fingerprint (ref:
         # evaluator.cpp:67-75 annotates spans with query fingerprints);
         # computed once and reused as the compile-cache key.  With
@@ -384,21 +395,25 @@ class Evaluator:
             pending = self._dispatch_traced(plan, chunk, foreign_chunks,
                                             stats, t0, fp,
                                             pool=getattr(token, "pool",
-                                                         None))
+                                                         None),
+                                            jplan=jplan)
             span.add_tag("compile_seconds",
                          round(getattr(pending, "compile_seconds", 0.0),
                                6))
             return pending
 
     def _dispatch_traced(self, plan, chunk, foreign_chunks, stats, t0,
-                         fp=None, pool=None):
+                         fp=None, pool=None, jplan=None):
         import time as _time
         if isinstance(plan, ir.Query) and plan.joins:
             foreign_chunks = foreign_chunks or {}
-            # Materialize joins left-to-right, widening the namespace.
+            # Materialize joins in (planner) execution order, widening
+            # the namespace; each stage's actual cardinality folds into
+            # the EXPLAIN ANALYZE join plan next to the estimate.
             namespace = list(_initial_namespace(plan))
             current = _project_chunk(chunk, TableSchema.make(namespace))
-            for join in plan.joins:
+            decisions = jplan.decisions if jplan is not None else None
+            for pos, join in enumerate(plan.joins):
                 if join.foreign_table not in foreign_chunks:
                     raise YtError(
                         f"No data provided for join table {join.foreign_table!r}",
@@ -409,6 +424,11 @@ class Evaluator:
                     foreign_chunks[join.foreign_table], self._join_cache)
                 if stats is not None:
                     stats.joins_executed += 1
+                    stats.note_join_stage(
+                        pos, join.foreign_table, "local",
+                        est_rows=decisions[pos].est_out
+                        if decisions is not None else 0,
+                        actual_rows=current.row_count)
             chunk = current
         elif isinstance(plan, ir.Query):
             chunk = _project_chunk(chunk, plan.schema)

@@ -133,6 +133,30 @@ def null_key_mask(self_keys):
     return s_null
 
 
+def probe_replicated(sl, n_keys: int, f_cap: int, self_keys, mask,
+                     is_left: bool):
+    """THE broadcast-join probe body, shared by the stitched SPMD join
+    (distributed.py) and the fused whole-plan join (whole_plan.py).
+
+    `sl` is one join's replicated arg slice, laid out as
+    [v_0, d_0, … v_{k-1}, d_{k-1},  pulled (data, valid) pairs …,
+    n_foreign]: lex-search the sorted foreign key planes for each self
+    row, gather every pulled plane at the (unique-key) match row masked
+    to matched, and narrow the row mask for INNER joins.  Returns
+    (pulled_planes, new_mask)."""
+    f_sorted = [(sl[2 * i], sl[2 * i + 1]) for i in range(n_keys)]
+    n_foreign = sl[-1]
+    lo = _lex_searchsorted(f_sorted, n_foreign, f_cap, self_keys, "left")
+    hi = _lex_searchsorted(f_sorted, n_foreign, f_cap, self_keys,
+                           "right")
+    matched = mask & ~null_key_mask(self_keys) & (hi > lo)
+    pos = jnp.clip(lo, 0, f_cap - 1)
+    base = 2 * n_keys
+    pulled = [(sl[base + 2 * i][pos], sl[base + 2 * i + 1][pos] & matched)
+              for i in range((len(sl) - base - 1) // 2)]
+    return pulled, (mask if is_left else matched)
+
+
 def _join_fingerprint(join: ir.JoinClause) -> str:
     # The full JoinClause serialized (equations, alias, is_left, pulled
     # columns) as a SHAPE fingerprint (ISSUE 10): the phase programs

@@ -1,0 +1,346 @@
+"""Cost-based join planning over chunk column stats (ISSUE 14).
+
+The engine executed multiway joins exactly as declared: a left-to-right
+binary cascade, each join paying its own exchange and its own host
+syncs.  "Efficient Multiway Hash Join on Reconfigurable Hardware"
+(arxiv 1905.13376) shows N-way joins fused into one partition pass beat
+binary cascades on accelerator-shaped hardware — but fusing the wrong
+ORDER fuses the wrong amount of data.  This module supplies the order:
+a System-R-shaped greedy planner over REAL cardinalities that the chunk
+layer already seals into metadata — `$row_count`, per-column min/max/
+has_null, and (new) the 64-register distinct-count sketch
+(`chunks/columnar.py::column_ndv_sketch`).
+
+Decisions produced per query:
+
+  join order       inner joins reorder most-selective-first (estimated
+                   output cardinality via |R ⋈ S| = |R|·|S| /
+                   max(ndv_R(k), ndv_S(k))), constrained by column
+                   dependencies (a join whose key reads an earlier
+                   join's pulled column cannot move before it) and by
+                   LEFT-join barriers (outer joins pin their position —
+                   reordering across one changes null-extension
+                   semantics).
+  side strategy    broadcast (small side replicates to every device —
+                   no exchange) vs partition (co-partition both sides
+                   by key hash), by foreign row count against
+                   `CompileConfig.broadcast_join_rows`.  Broadcast
+                   additionally requires unique foreign keys; the
+                   execution layer verifies and falls back, and the
+                   RESOLVED strategy folds into its cache key.
+  semi-join ranges push the [min, max] of a selective INNER side's join
+                   key down into the scan stage: the coordinator prunes
+                   whole shards with it (`chunk_may_match`) and the
+                   fused SPMD path masks rows BEFORE the first
+                   exchange, so non-joining rows never ride all_to_all.
+
+Compile-once contract (ISSUE 10/14): planner DECISIONS — order,
+strategies, pushdown column sets — fold into every compiled-program
+cache key (`JoinPlan.token()`; the reordered plan's fingerprint carries
+the order).  Estimates and pushdown VALUES do not: estimates only rank
+candidates, and pushdown bounds ride runtime bindings, so stats drift
+that changes no decision changes no key (100% cache hit), while a
+drift that flips a decision produces a NEW key (never a stale program).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from dataclasses import dataclass, replace as dc_replace
+from typing import Mapping, Optional, Sequence
+
+from ytsaurus_tpu.query import ir
+
+# Per-chunk stats memo: cost-based planning must not re-scan a chunk it
+# already measured (the join-host memo discipline of distributed.py).
+# Keyed by object identity with a liveness check; finalizers evict.
+_stats_lock = threading.Lock()   # guards: _stats_memo
+_stats_memo: dict = {}
+_STATS_MEMO_LIMIT = 512
+
+
+def stats_for_chunk(chunk) -> dict:
+    """chunk_column_stats(chunk), memoized per chunk identity — the
+    backfill path when no sealed metadata stats are provided (engine
+    entry points hold materialized chunks, not chunk ids)."""
+    from ytsaurus_tpu.chunks.columnar import chunk_column_stats
+    key = id(chunk)
+    with _stats_lock:
+        entry = _stats_memo.get(key)
+        if entry is not None and entry[0]() is chunk:
+            return entry[1]
+    stats = chunk_column_stats(chunk)
+    with _stats_lock:
+        _stats_memo[key] = (weakref.ref(chunk), stats)
+        while len(_stats_memo) > _STATS_MEMO_LIMIT:
+            _stats_memo.pop(next(iter(_stats_memo)))
+    return stats
+
+
+def _stat_entry(stats: Optional[dict], name: str) -> Optional[dict]:
+    if not stats:
+        return None
+    entry = stats.get(name)
+    return entry if isinstance(entry, dict) else None
+
+
+def _key_ndv(stats: Optional[dict], expr: ir.TExpr, rows: int) -> int:
+    """NDV of a join-key expression: the sketch estimate for a bare
+    column reference, else the conservative bound (row count)."""
+    from ytsaurus_tpu.chunks.columnar import ndv_estimate
+    if isinstance(expr, ir.TReference):
+        entry = _stat_entry(stats, expr.name)
+        if entry is not None and entry.get("ndv_sketch") is not None:
+            est = ndv_estimate(entry.get("ndv_sketch"))
+            if est > 0:
+                return min(est, max(rows, 1))
+    return max(rows, 1)
+
+
+@dataclass(frozen=True)
+class JoinDecision:
+    """One join's planned execution."""
+    index: int              # position in the ORIGINAL plan.joins tuple
+    strategy: str           # "broadcast" | "partition"
+    est_in: int             # estimated rows entering the join
+    est_out: int            # estimated rows leaving it
+    foreign_rows: int
+    # INNER-side semi-join ranges pushed into the scan stage:
+    # ((self_column, lo, hi), ...) — values are HOST data for shard
+    # pruning; the fused path re-binds them as runtime bindings.
+    pushdown: tuple = ()
+
+
+@dataclass(frozen=True)
+class JoinPlan:
+    """The planner's answer for one query's join set, in execution
+    order.  `token()` is the cache-key contribution: decisions only,
+    never estimates or pushdown values (see module docstring)."""
+    decisions: tuple
+
+    @property
+    def order(self) -> tuple:
+        return tuple(d.index for d in self.decisions)
+
+    def token(self) -> tuple:
+        return tuple(
+            (d.index, d.strategy,
+             tuple(name for name, _lo, _hi in d.pushdown))
+            for d in self.decisions)
+
+    def pushdown_ranges(self) -> tuple:
+        """Flat ((self_column, lo, hi), ...) across every decision."""
+        out = []
+        for d in self.decisions:
+            out.extend(d.pushdown)
+        return tuple(out)
+
+
+def _base_columns(plan: ir.Query) -> set:
+    """Self-table columns (plan.schema minus join-contributed names)."""
+    joined = set()
+    for join in plan.joins:
+        for fname in join.foreign_columns:
+            joined.add(f"{join.alias}.{fname}" if join.alias else fname)
+    return {c.name for c in plan.schema if c.name not in joined}
+
+
+def _join_outputs(join: ir.JoinClause) -> set:
+    return {f"{join.alias}.{f}" if join.alias else f
+            for f in join.foreign_columns}
+
+
+def _join_inputs(join: ir.JoinClause) -> set:
+    refs: set = set()
+    for eq in join.self_equations:
+        refs.update(ir.expr_references(eq))
+    return refs
+
+
+def _pushdown_for(join: ir.JoinClause, f_stats: Optional[dict],
+                  base_columns: set) -> tuple:
+    """Semi-join scan ranges a selective INNER side implies: only bare
+    column = bare column equations qualify (range semantics need a raw
+    self column, stats lookup needs a raw foreign column), and only
+    bounded stats contribute (None bound = unprunable, PR 5 contract)."""
+    if join.is_left or not f_stats:
+        return ()
+    out = []
+    for self_eq, f_eq in zip(join.self_equations, join.foreign_equations):
+        if not (isinstance(self_eq, ir.TReference)
+                and isinstance(f_eq, ir.TReference)):
+            continue
+        if self_eq.name not in base_columns:
+            continue
+        entry = _stat_entry(f_stats, f_eq.name)
+        if entry is None:
+            continue
+        lo, hi = entry.get("min"), entry.get("max")
+        if lo is None or hi is None:
+            continue
+        out.append((self_eq.name, lo, hi))
+    return tuple(out)
+
+
+def plan_joins(plan: ir.Query, self_rows: int,
+               foreign_stats: Mapping[str, Optional[dict]],
+               self_stats: Optional[dict] = None) -> Optional[JoinPlan]:
+    """Plan `plan.joins` (None when there is nothing to plan or the
+    planner is configured off).
+
+    `foreign_stats` maps foreign table path → merged column stats
+    (sealed chunk metadata via merge_column_stats, or stats_for_chunk
+    over a materialized chunk); missing/None entries degrade that side
+    to conservative estimates.  `self_stats` (optional) sharpens the
+    self-side NDV in the standard |R|·|S|/max(ndv_R, ndv_S) estimate.
+    """
+    from ytsaurus_tpu.config import compile_config
+    if not plan.joins:
+        return None
+    cfg = compile_config()
+    if not cfg.cost_join_planner:
+        return None
+    base = _base_columns(plan)
+    broadcast_cap = cfg.broadcast_join_rows
+
+    # LEFT joins are barriers: blocks of consecutive INNER joins reorder
+    # internally; everything else keeps declared order.
+    blocks: list = []          # list of lists of original indices
+    for i, join in enumerate(plan.joins):
+        if join.is_left:
+            blocks.append([i])
+        elif blocks and not plan.joins[blocks[-1][0]].is_left \
+                and not plan.joins[blocks[-1][-1]].is_left:
+            blocks[-1].append(i)
+        else:
+            blocks.append([i])
+
+    def f_rows_of(join) -> Optional[int]:
+        stats = foreign_stats.get(join.foreign_table)
+        if stats and "$row_count" in stats:
+            return int(stats["$row_count"])
+        return None                 # unknown — not the same as empty
+
+    def est_factor(join, est_in: int) -> float:
+        """Estimated output multiplier of applying `join` to est_in
+        rows: |out| / |in| = |S| / max(ndv_R(k), ndv_S(k)) per standard
+        equi-join selectivity, multiplied across multi-column keys by
+        taking the most selective single column (conservative)."""
+        stats = foreign_stats.get(join.foreign_table)
+        f_rows = f_rows_of(join)
+        if f_rows is None:
+            return 1.0              # no stats: neutral, keep declared rank
+        if f_rows == 0:
+            return 0.0 if not join.is_left else 1.0
+        factor = float(f_rows)
+        best = None
+        for self_eq, f_eq in zip(join.self_equations,
+                                 join.foreign_equations):
+            ndv_f = _key_ndv(stats, f_eq, f_rows)
+            ndv_s = _key_ndv(self_stats, self_eq, max(est_in, 1)) \
+                if self_stats is not None else ndv_f
+            cand = float(f_rows) / float(max(ndv_f, ndv_s, 1))
+            best = cand if best is None else min(best, cand)
+        if best is not None:
+            factor = best
+        if join.is_left:
+            factor = max(factor, 1.0)
+        return factor
+
+    decisions: list = []
+    est = max(self_rows, 1)
+    for block in blocks:
+        remaining = list(block)
+        placed_outputs: set = set(base)
+        for d in decisions:
+            placed_outputs |= _join_outputs(plan.joins[d.index])
+        while remaining:
+            ready = [i for i in remaining
+                     if _join_inputs(plan.joins[i]) <= placed_outputs]
+            if not ready:
+                # Unresolvable dependency inside the block (key reads a
+                # column a LATER block pulls): keep declared order.
+                ready = [remaining[0]]
+            pick = min(ready,
+                       key=lambda i: (est_factor(plan.joins[i], est), i))
+            remaining.remove(pick)
+            join = plan.joins[pick]
+            f_rows = f_rows_of(join)
+            factor = est_factor(join, est)
+            est_out = max(int(est * factor), 1)
+            if join.is_left:
+                est_out = max(est_out, est)
+            strategy = "broadcast" if f_rows is not None \
+                and 0 < f_rows <= broadcast_cap else "partition"
+            f_rows = f_rows if f_rows is not None else 0
+            decisions.append(JoinDecision(
+                index=pick, strategy=strategy, est_in=est,
+                est_out=est_out, foreign_rows=f_rows,
+                pushdown=_pushdown_for(
+                    join, foreign_stats.get(join.foreign_table), base)))
+            placed_outputs |= _join_outputs(join)
+            est = est_out
+    return JoinPlan(decisions=tuple(decisions))
+
+
+def apply_order(plan: ir.Query, jplan: Optional[JoinPlan]) -> ir.Query:
+    """The plan with joins permuted into execution order.  The permuted
+    plan's fingerprint IS how the order reaches every compiled-program
+    cache key (a stats-driven order flip can never serve a stale
+    program)."""
+    if jplan is None:
+        return plan
+    order = jplan.order
+    if order == tuple(range(len(plan.joins))):
+        return plan
+    return dc_replace(plan,
+                      joins=tuple(plan.joins[i] for i in order))
+
+
+def plan_for_chunks(plan: ir.Query, self_rows: int,
+                    foreign_chunks: Optional[Mapping] = None,
+                    foreign_stats: Optional[Mapping] = None
+                    ) -> Optional[JoinPlan]:
+    """plan_joins with stats sourced from materialized foreign chunks
+    (memoized per chunk) unless sealed-metadata stats are supplied."""
+    if not plan.joins:
+        return None
+    stats: dict = dict(foreign_stats or {})
+    for join in plan.joins:
+        if join.foreign_table in stats:
+            continue
+        chunk = (foreign_chunks or {}).get(join.foreign_table)
+        stats[join.foreign_table] = \
+            stats_for_chunk(chunk) if chunk is not None else None
+    return plan_joins(plan, self_rows, stats)
+
+
+def reorder_for_chunks(plan: ir.Query, self_rows: int,
+                       foreign_chunks: Optional[Mapping] = None
+                       ) -> "tuple[ir.Query, Optional[JoinPlan]]":
+    """(execution-ordered plan, JoinPlan) — the one-call form the
+    evaluator's join cascade and the stitched SPMD paths use."""
+    jplan = plan_for_chunks(plan, self_rows, foreign_chunks)
+    return apply_order(plan, jplan), jplan
+
+
+def pushdown_intervals(plan: ir.Query,
+                       foreign_stats: Mapping[str, Optional[dict]]
+                       ) -> dict:
+    """Scan-stage shard-pruning intervals implied by selective INNER
+    join sides: {self_column: pruning.Interval}.  The coordinator
+    intersects these with the WHERE-derived intervals, so shards whose
+    key range cannot join anything are never staged."""
+    from ytsaurus_tpu.config import compile_config
+    from ytsaurus_tpu.query.pruning import Interval
+    if not compile_config().cost_join_planner:
+        return {}
+    base = _base_columns(plan)
+    out: dict = {}
+    for join in plan.joins:
+        for name, lo, hi in _pushdown_for(
+                join, foreign_stats.get(join.foreign_table), base):
+            iv = out.get(name, Interval())
+            out[name] = iv.narrow(Interval(lo=lo, hi=hi))
+    return out

@@ -150,6 +150,19 @@ def format_profile_dict(p: dict) -> str:
         lines.append(
             f"distributed: whole-plan fused SPMD (overflow retries "
             f"{stats.get('whole_plan_retries', 0)})")
+    # ISSUE 14: the cost-based join plan — execution order, per-side
+    # broadcast/partition choice, estimated vs actual cardinality per
+    # stage.  A bad plan (estimate orders of magnitude off the actual)
+    # is diagnosable from the slow log without re-running the query.
+    join_stages = [e for e in (stats.get("join_plan") or []) if e]
+    if join_stages:
+        lines.append("join plan:")
+        for i, entry in enumerate(join_stages):
+            lines.append(
+                f"  {i + 1}. {entry.get('table')} "
+                f"[{entry.get('strategy')}] est rows "
+                f"{entry.get('est_rows', 0)} -> actual "
+                f"{entry.get('actual_rows', 0)}")
     tree = p.get("span_tree") or []
     if tree:
         lines.append("spans:")

@@ -43,6 +43,25 @@ class QueryStatistics:
     # (ISSUE 8 satellite): per-query bucket churn is a shape-spectrum
     # leak EXPLAIN ANALYZE must surface.  A set, serialized sorted.
     capacity_buckets: set = field(default_factory=set)
+    # Cost-based join plan (ISSUE 14): one entry per join stage in
+    # EXECUTION order — chosen side strategy plus estimated-vs-actual
+    # cardinality, so a bad plan is diagnosable from the slow log
+    # without re-running.  Actuals/estimates ACCUMULATE across shard
+    # programs (the host-coordinated cascade runs the stage per shard).
+    join_plan: list = field(default_factory=list)
+
+    def note_join_stage(self, position: int, table: str, strategy: str,
+                        est_rows: int = 0, actual_rows=None) -> None:
+        while len(self.join_plan) <= position:
+            self.join_plan.append(None)
+        entry = self.join_plan[position]
+        if entry is None:
+            entry = {"table": table, "strategy": strategy,
+                     "est_rows": 0, "actual_rows": 0}
+            self.join_plan[position] = entry
+        entry["est_rows"] += int(est_rows)
+        if actual_rows is not None:
+            entry["actual_rows"] += int(actual_rows)
 
     def to_dict(self) -> dict:
         out = {}
