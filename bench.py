@@ -1590,6 +1590,65 @@ def bench_matview_child(batch_rows):
     }), flush=True)
 
 
+def bench_sanitizer_overhead(n_rows, iters):
+    """Concurrency sanitizer (ISSUE 15): the DISABLED path must be a
+    plain-lock no-op — `sanitizers.register_lock()` without
+    YT_TPU_SANITIZE hands back the raw `threading.Lock`, so its
+    per-acquire cost must match a plain lock within noise (asserted
+    ≲0.1µs delta) — and the ENABLED path's per-acquire cost is recorded
+    (held-set bookkeeping + edge probe; tier-1 pays it suite-wide, so
+    the number feeds the 870s-budget arithmetic).  The emitted metric is
+    enabled-path acquires/s with one lock held (the edge-probing case,
+    i.e. the EXPENSIVE one)."""
+    import threading
+
+    from ytsaurus_tpu.utils import sanitizers
+
+    n_round = min(n_rows, 400_000)
+
+    def per_acquire(lock, rounds=7):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(n_round):
+                with lock:
+                    pass
+            best = min(best, (time.perf_counter() - t0) / n_round)
+        return best
+
+    plain_cost = per_acquire(threading.Lock())
+
+    assert not sanitizers.enabled(), \
+        "bench must run with the sanitizer DISABLED (unset " \
+        "YT_TPU_SANITIZE) to measure the production fast path"
+    registered = sanitizers.register_lock("bench.sanitizer._lock")
+    assert type(registered) is type(threading.Lock()), \
+        "disabled register_lock must return the PLAIN lock, no wrapper"
+    disabled_cost = per_acquire(registered)
+
+    san = sanitizers.LockSanitizer()
+    inst = sanitizers.InstrumentedLock(san, "bench.inst._lock")
+    outer = sanitizers.InstrumentedLock(san, "bench.outer._lock")
+    enabled_leaf_cost = per_acquire(inst)
+    with outer:                         # one lock held: edge probe runs
+        enabled_nested_cost = per_acquire(inst)
+
+    delta = disabled_cost - plain_cost
+    print(f"# sanitizer acquire costs: plain {plain_cost * 1e9:.0f} ns, "
+          f"disabled-registered {disabled_cost * 1e9:.0f} ns "
+          f"(delta {delta * 1e9:+.0f} ns), enabled leaf "
+          f"{enabled_leaf_cost * 1e9:.0f} ns, enabled nested "
+          f"{enabled_nested_cost * 1e9:.0f} ns", file=sys.stderr)
+    assert abs(delta) < 0.1e-6, \
+        f"disabled path must be a plain-lock no-op: " \
+        f"{delta * 1e9:+.0f} ns/acquire delta vs plain threading.Lock"
+    assert san.counters()["edges_observed"] == 1    # outer -> inst
+
+    best = enabled_nested_cost * n_round
+    return ("sanitizer_acquires_per_sec", 1.0 / enabled_nested_cost,
+            best)
+
+
 _CONFIGS = {
     "q1": (bench_q1, 64_000_000, 2_000_000),
     "groupby": (bench_groupby, 64_000_000, 2_000_000),
@@ -1608,6 +1667,7 @@ _CONFIGS = {
     "whole_plan": (bench_whole_plan, 8_000_000, 1_000_000),
     "multiway_join": (bench_multiway_join, 4_000_000, 400_000),
     "matview": (bench_matview, 2_000_000, 500_000),
+    "sanitizer_overhead": (bench_sanitizer_overhead, 400_000, 400_000),
 }
 
 
@@ -1730,6 +1790,7 @@ _METRIC_NAMES = {
     "whole_plan": "whole_plan_rows_per_sec",
     "multiway_join": "multiway_join_rows_per_sec",
     "matview": "matview_rows_per_sec",
+    "sanitizer_overhead": "sanitizer_acquires_per_sec",
 }
 
 

@@ -20,6 +20,13 @@ os.environ["XLA_FLAGS"] = (
 # Plain assignment, not setdefault: an inherited =0 from a profiling
 # shell must not silently turn the sanitizer off for the whole suite.
 os.environ["YT_TPU_INVARIANTS"] = "1"
+# ... and "lock-sanitized" (ISSUE 15): utils/sanitizers.py wraps every
+# registered hot lock, recording held-lock sets and acquisition-order
+# edges live.  Must be set BEFORE any ytsaurus_tpu module constructs
+# its locks (registration reads it once per lock creation);
+# pytest_sessionfinish below reconciles the observed dynamic lock-order
+# graph against the static analyzer's superset graph.
+os.environ["YT_TPU_SANITIZE"] = "1"
 
 import jax  # noqa: E402
 
@@ -33,6 +40,43 @@ def pytest_configure(config):
         "markers",
         "slow: minutes-long compile-heavy suites excluded from the tier-1 "
         "quick pass (ROADMAP.md runs -m 'not slow')")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The dynamic⊆static lock-order gate (ISSUE 15): every acquisition
+    edge the runtime sanitizer observed across the WHOLE tier-1 run must
+    exist in the static reconciliation graph — an edge the AST
+    propagation cannot derive fails the build with the acquisition
+    stacks attached (teach tools/analyze, or restructure the locking).
+    Runs only when the suite actually exercised the sanitizer, and only
+    on otherwise-green runs (a red run's report would bury the real
+    failure)."""
+    from ytsaurus_tpu.utils import sanitizers
+
+    san = sanitizers.get_sanitizer()
+    if san is None or exitstatus != 0 or not san.edge_snapshot():
+        return
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.analyze import guard_inference, load_files
+
+    graph = guard_inference.reconciliation_graph(load_files(repo))
+    violations = sanitizers.reconcile(graph["edges"], graph["site_map"])
+    report = san.counters()
+    print(f"\n[sanitizer] {report['acquires']} instrumented acquires, "
+          f"{report['edges_observed']} distinct lock-order edges, "
+          f"{report['inversions']} inversions, "
+          f"{report['sync_under_lock']} blocking-ops-under-lock, "
+          f"{report['hold_violations']} hold-budget violations; "
+          f"dynamic⊆static: "
+          f"{'OK' if not violations else 'VIOLATED'}")
+    if violations:
+        for violation in violations:
+            print(f"[sanitizer] {violation}")
+        session.exitstatus = 1
 
 
 @pytest.fixture(autouse=True)

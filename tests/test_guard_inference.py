@@ -1,0 +1,649 @@
+"""Inferred guard-discipline pass (ISSUE 15 tentpole): fixture
+positives/negatives for the annotation-free inference (lock discovery,
+entry-context propagation, thread-entry roots, init-escape, the
+`*_locked` convention), the atomicity (check-then-act) lint, the
+annotation-drift cross-check, and the reconciliation-graph machinery
+the runtime sanitizer's dynamic⊆static gate runs against."""
+
+import textwrap
+
+from tools import analyze
+from tools.analyze import guard_inference
+from tools.analyze.core import SourceFile
+
+
+def fixture(rel, source):
+    return SourceFile(rel, textwrap.dedent(source))
+
+
+def run(source, rel="ytsaurus_tpu/fix.py"):
+    return guard_inference.run([fixture(rel, source)])
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# --- guard inference: the annotation-free core --------------------------------
+
+
+def test_unguarded_write_flagged_without_annotation():
+    findings = run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def wipe(self):
+                self._items = {}
+    """)
+    assert rules_of(findings) == ["guard-inference"]
+    assert findings[0].line == 14
+    assert "_items" in findings[0].message
+
+
+def test_mutator_call_counts_as_write():
+    findings = run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def note(self, v):
+                self._items.setdefault(v, []).append(v)
+    """)
+    assert rules_of(findings) == ["guard-inference"]
+    assert "setdefault" in findings[0].message
+
+
+def test_no_lock_no_findings():
+    assert run("""
+        class Plain:
+            def __init__(self):
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+    """) == []
+
+
+def test_unguarded_field_next_to_guarded_one_ok():
+    # _stats is never written under the lock: no evidence, no findings —
+    # inference never guesses a guard the code doesn't establish.
+    assert run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+                self._stats = 0
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def bump(self):
+                self._stats += 1
+    """) == []
+
+
+# --- entry-context propagation ------------------------------------------------
+
+
+def test_private_helper_called_under_lock_is_clean():
+    assert run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._put_inner(k, v)
+
+            def _put_inner(self, k, v):
+                self._items[k] = v
+    """) == []
+
+
+def test_helper_with_one_unlocked_call_site_flagged():
+    # The intersection over call sites is empty: _put_inner cannot
+    # assume the lock, so its write is a finding.
+    findings = run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._put_inner(k, v)
+
+            def put_fast(self, k, v):
+                self._put_inner(k, v)
+
+            def _put_inner(self, k, v):
+                self._items[k] = v
+    """)
+    assert rules_of(findings) == ["guard-inference"]
+    assert "_put_inner" in findings[0].message
+
+
+def test_thread_entry_root_cannot_assume_locks():
+    # _run is referenced as a VALUE (Thread target): even though its
+    # only textual reference sits inside the class, it runs on a fresh
+    # thread with no locks held.
+    findings = run("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def _run(self):
+                while True:
+                    self._n += 1
+    """)
+    assert rules_of(findings) == ["guard-inference"]
+    assert "_run" in findings[0].message
+
+
+def test_executor_submit_is_a_thread_entry_root():
+    findings = run("""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pool = ThreadPoolExecutor(2)
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def kick(self):
+                self._pool.submit(self._work)
+
+            def _work(self):
+                self._n += 1
+    """)
+    assert rules_of(findings) == ["guard-inference"]
+    assert "_work" in findings[0].message
+
+
+def test_stored_callback_is_a_thread_entry_root():
+    # Bound-method capture via plain ASSIGNMENT (self._cb = self._run)
+    # escapes too — the callback can run on any thread later, so _run
+    # cannot inherit its direct call sites' locks.
+    findings = run("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._cb = self._run
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+                    self._run()
+
+            def _run(self):
+                self._n += 1
+    """)
+    assert rules_of(findings) == ["guard-inference"]
+    assert "_run" in findings[0].message
+
+
+def test_locked_suffix_convention_assumes_caller_lock():
+    assert run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def evict_locked(self):
+                self._items.clear()
+    """) == []
+
+
+# --- __init__ / pre-publication escape ----------------------------------------
+
+
+def test_init_writes_before_escape_are_exempt():
+    assert run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+                self._n = 0
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+                    self._n += 1
+    """) == []
+
+
+def test_init_write_after_thread_start_flagged():
+    # The thread is LIVE: the post-start write races with _run.
+    findings = run("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self._run).start()
+                self._n = 1
+
+            def _run(self):
+                with self._lock:
+                    self._n += 1
+    """)
+    assert any(f.rule == "guard-inference" and f.line == 9
+               for f in findings), [f.format() for f in findings]
+
+
+def test_reading_self_attrs_in_init_is_not_an_escape():
+    # `tuple(self.DEFAULT)` and `len(self._channels)` read fields —
+    # they do not publish the object.
+    assert run("""
+        import threading
+
+        class Box:
+            DEFAULT = (1, 2, 3)
+
+            def __init__(self, bounds=None):
+                self.bounds = tuple(bounds or self.DEFAULT)
+                self._lock = threading.Lock()
+                self._n = len(self.bounds)
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+    """) == []
+
+
+# --- guard-read ---------------------------------------------------------------
+
+
+def test_unlocked_read_in_locking_method_flagged():
+    findings = run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def peek_and_clear(self):
+                with self._lock:
+                    self._items.clear()
+                return len(self._items)
+    """)
+    assert rules_of(findings) == ["guard-read"]
+    assert findings[0].severity == "warning"
+
+
+def test_double_checked_lazy_init_read_is_exempt():
+    assert run("""
+        import threading
+
+        class Lazy:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._obj = None
+
+            def get(self):
+                if self._obj is None:
+                    with self._lock:
+                        if self._obj is None:
+                            self._obj = object()
+                return self._obj
+    """) == []
+
+
+def test_lock_free_facade_reads_not_flagged():
+    # size() takes no locks and inherits no entry context: lock-free
+    # reads from a non-locking method are the sanctioned snapshot idiom.
+    assert run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def size(self):
+                return len(self._items)
+    """) == []
+
+
+# --- atomicity (check-then-act) -----------------------------------------------
+
+
+def test_check_then_act_across_regions_flagged():
+    findings = run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump_if_small(self):
+                with self._lock:
+                    n = self._n
+                if n < 10:
+                    with self._lock:
+                        self._n = n + 1
+    """)
+    assert rules_of(findings) == ["atomicity"]
+    assert "check-then-act" in findings[0].message
+
+
+def test_single_region_read_modify_write_ok():
+    assert run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump_if_small(self):
+                with self._lock:
+                    n = self._n
+                    if n < 10:
+                        self._n = n + 1
+    """) == []
+
+
+def test_double_checked_second_region_reread_exempt():
+    assert run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cache = {}
+
+            def get_or_make(self, k):
+                with self._lock:
+                    hit = self._cache.get(k)
+                if hit is not None:
+                    return hit
+                value = object()
+                with self._lock:
+                    return self._cache.setdefault(k, value)
+    """) == []
+
+
+def test_reassignment_between_regions_kills_taint():
+    # `state` is rebuilt from a non-guarded source before the second
+    # region: the write is not acting on the stale read.
+    assert run("""
+        import threading
+
+        class Conn:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._conn = None
+
+            def connect(self):
+                with self._lock:
+                    state = self._conn
+                if state is None:
+                    state = object()
+                    with self._lock:
+                        self._conn = state
+                return state
+    """) == []
+
+
+# --- annotation drift ---------------------------------------------------------
+
+
+def test_drift_contradicted_annotation_flagged():
+    findings = run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._a = threading.Lock()   # guards: _x
+                self._b = threading.Lock()
+                self._x = 0
+
+            def bump(self):
+                with self._b:
+                    self._x += 1
+    """)
+    assert "guard-drift" in rules_of(findings)
+    drift = next(f for f in findings if f.rule == "guard-drift")
+    assert "'_b'" in drift.message
+
+
+def test_drift_stale_annotation_flagged():
+    findings = run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()   # guards: _x, _y
+                self._x = 0
+                self._y = 0
+
+            def bump(self):
+                with self._lock:
+                    self._y += 1
+    """)
+    assert rules_of(findings) == ["guard-drift"]
+    assert "stale" in findings[0].message and "_x" in findings[0].message
+
+
+def test_consistent_annotation_no_drift():
+    assert run("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()   # guards: _x
+                self._x = 0
+
+            def bump(self):
+                with self._lock:
+                    self._x += 1
+    """) == []
+
+
+# --- waivers ------------------------------------------------------------------
+
+
+def test_waiver_with_reason_suppresses_and_bare_waiver_flagged():
+    findings = analyze.run_passes([fixture("ytsaurus_tpu/fix.py", """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def reset(self):
+                # analyze: allow(guard-inference): test-scoped reset, callers quiesce first
+                self._n = 0
+
+            def reset2(self):
+                self._n = 0   # analyze: allow(guard-inference)
+    """)], only=["guards"])
+    assert rules_of(findings) == ["guard-inference", "waiver-reason"]
+
+
+# --- sanitizer registration shapes --------------------------------------------
+
+
+def test_register_lock_sites_are_inferred_locks_with_site_names():
+    f = fixture("ytsaurus_tpu/utils/fix_reg.py", """
+        import threading
+        from ytsaurus_tpu.utils import sanitizers
+
+        # guards: _GLOBAL
+        _LOCK = sanitizers.register_lock("fix._LOCK", hot=False)
+        _GLOBAL = None
+
+        class Box:
+            def __init__(self):
+                self._lock = sanitizers.register_lock("fix.Box._lock")
+                self._cond = sanitizers.register_condition(
+                    "fix.Box._cond")
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def wipe(self):
+                self._items = {}
+    """)
+    locks = guard_inference.collect_inferred_locks(f)
+    by_attr = {l.attr: l for l in locks}
+    assert by_attr["_LOCK"].site_name == "fix._LOCK"
+    assert by_attr["_lock"].site_name == "fix.Box._lock"
+    assert by_attr["_cond"].site_name == "fix.Box._cond"
+    site_map = guard_inference.registered_site_map([f])
+    assert site_map["fix.Box._lock"] == \
+        "ytsaurus_tpu/utils/fix_reg.py::Box._lock"
+    # ... and the registered lock still drives inference:
+    findings = guard_inference.run([f])
+    assert rules_of(findings) == ["guard-inference"]
+
+
+# --- reconciliation graph -----------------------------------------------------
+
+
+def test_reconciliation_graph_resolves_cross_file_method_calls():
+    """The superset graph's aggressive closure: holding a lock in one
+    file while calling a method/constructor/function defined in another
+    lock-bearing file produces the edge the runtime sanitizer will
+    observe."""
+    prof = fixture("ytsaurus_tpu/utils/fix_prof.py", """
+        import threading
+        from ytsaurus_tpu.utils import sanitizers
+
+        class Registry:
+            def __init__(self):
+                self._lock = sanitizers.register_lock(
+                    "fix_prof.Registry._lock")
+                self._sensors = {}
+
+            def fetch(self, name):
+                with self._lock:
+                    return self._sensors.setdefault(name, object())
+
+        class View:
+            def __init__(self, registry):
+                self.registry = registry
+
+            def fetch_sensor(self, name):
+                return self.registry.fetch(name)
+    """)
+    user = fixture("ytsaurus_tpu/query/fix_user.py", """
+        import threading
+        from ytsaurus_tpu.utils import sanitizers
+
+        class Log:
+            def __init__(self, view):
+                self._lock = sanitizers.register_lock(
+                    "fix_user.Log._lock")
+                self._view = view
+                self._records = []
+
+            def fold(self, record):
+                with self._lock:
+                    self._records.append(record)
+                    self._view.fetch_sensor("records")
+    """)
+    graph = guard_inference.reconciliation_graph([prof, user])
+    assert "ytsaurus_tpu/query/fix_user.py::Log._lock" in graph["locks"]
+    assert any(
+        a == "ytsaurus_tpu/query/fix_user.py::Log._lock" and
+        b == "ytsaurus_tpu/utils/fix_prof.py::Registry._lock"
+        for a, b, _site in graph["edges"]), graph["edges"]
+    # site_map round-trips both registrations
+    assert graph["site_map"]["fix_user.Log._lock"] == \
+        "ytsaurus_tpu/query/fix_user.py::Log._lock"
+
+
+def test_reconciliation_graph_resolves_constructor_calls():
+    maker = fixture("ytsaurus_tpu/utils/fix_ctor.py", """
+        import threading
+        from ytsaurus_tpu.utils import sanitizers
+
+        _LOCK = sanitizers.register_lock("fix_ctor._LOCK", hot=False)
+        _GLOBAL = None
+
+        class Widget:
+            def __init__(self):
+                self._lock = sanitizers.register_lock(
+                    "fix_ctor.Widget._lock")
+                with self._lock:
+                    self._n = 0
+
+        def get_global():
+            global _GLOBAL
+            with _LOCK:
+                if _GLOBAL is None:
+                    _GLOBAL = Widget()
+                return _GLOBAL
+    """)
+    graph = guard_inference.reconciliation_graph([maker])
+    assert any(
+        a.endswith("::_LOCK") and b.endswith("::Widget._lock")
+        for a, b, _site in graph["edges"]), graph["edges"]

@@ -1,0 +1,397 @@
+"""Runtime concurrency sanitizer (ISSUE 15): instrumented-lock unit
+tests (edges, inversions, hold budget, sync-under-lock, condition
+semantics, re-entrancy), the disabled fast path, and the dynamic⊆static
+reconciliation over the REAL registered lock set (the full-suite gate
+additionally runs in conftest.pytest_sessionfinish)."""
+
+import threading
+import time
+
+import pytest
+
+from ytsaurus_tpu.utils import sanitizers
+from ytsaurus_tpu.utils.sanitizers import (
+    InstrumentedCondition,
+    InstrumentedLock,
+    InstrumentedRLock,
+    LockSanitizer,
+)
+
+
+@pytest.fixture
+def san():
+    """A private sanitizer: deliberate violations in these tests must
+    not pollute the process-global instance the tier-1 gate reads."""
+    return LockSanitizer(hold_budget=0.02)
+
+
+def make(san, name, hot=True):
+    return InstrumentedLock(san, name, hot=hot)
+
+
+# --- edges + inversions -------------------------------------------------------
+
+
+def test_nested_acquire_records_edge(san):
+    a, b = make(san, "A"), make(san, "B")
+    with a:
+        with b:
+            pass
+    assert ("A", "B") in san.edges
+    assert ("B", "A") not in san.edges
+    assert san.counters()["edges_observed"] == 1
+    assert san.counters()["inversions"] == 0
+
+
+def test_lock_order_inversion_detected_with_stacks(san):
+    a, b = make(san, "A"), make(san, "B")
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+    assert san.counters()["inversions"] == 1
+    inv = san.inversions[0]
+    assert inv["holding"] == "B" and inv["acquiring"] == "A"
+    assert inv["stack"], "acquisition stack must be attached"
+    assert inv["prior_order_stack"], "the A->B sighting rides along"
+
+
+def test_held_sets_are_per_thread(san):
+    a, b = make(san, "A"), make(san, "B")
+    started = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with a:
+            started.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    started.wait(5)
+    # This thread holds nothing: acquiring B is edge-free even though
+    # another thread currently holds A.
+    with b:
+        pass
+    release.set()
+    t.join()
+    assert ("A", "B") not in san.edges
+
+
+def test_triple_nesting_records_all_held_edges(san):
+    a, b, c = make(san, "A"), make(san, "B"), make(san, "C")
+    with a:
+        with b:
+            with c:
+                pass
+    assert {("A", "B"), ("A", "C"), ("B", "C")} <= set(san.edges)
+
+
+def test_sibling_instances_of_one_site_are_not_an_edge(san):
+    # Two Counter instances share one site name: nesting them is not an
+    # ordering edge (the static graph has one node for the site).
+    a1, a2 = make(san, "A"), make(san, "A")
+    with a1:
+        with a2:
+            pass
+    assert san.edges == {}
+
+
+def test_rlock_reentrancy_emits_one_span(san):
+    r = InstrumentedRLock(san, "R")
+    b = make(san, "B")
+    with r:
+        with r:
+            with b:
+                pass
+        # Still held here: only the OUTERMOST release pops the frame.
+        assert "R" in san.held_names()
+    assert san.held_names() == []
+    assert ("R", "B") in san.edges and ("R", "R") not in san.edges
+
+
+# --- hold budget --------------------------------------------------------------
+
+
+def test_hold_budget_violation_recorded(san):
+    a = make(san, "A")
+    with a:
+        time.sleep(0.05)
+    assert san.counters()["hold_violations"] == 1
+    violation = san.hold_violations[0]
+    assert violation["lock"] == "A"
+    assert violation["held_seconds"] >= 0.02
+
+
+def test_hold_budget_exempts_cold_locks(san):
+    a = make(san, "A", hot=False)
+    with a:
+        time.sleep(0.05)
+    assert san.counters()["hold_violations"] == 0
+
+
+# --- sync/blocking under lock -------------------------------------------------
+
+
+def test_blocking_io_under_hot_lock_flagged(san):
+    a = make(san, "A")
+    with a:
+        san.note_blocking("io", "chunks.store.write")
+    event = san.sync_under_lock[0]
+    assert event["locks_held"] == ["A"]
+    assert event["detail"] == "chunks.store.write"
+
+
+def test_blocking_without_lock_is_silent(san):
+    san.note_blocking("io", "chunks.store.write")
+    assert san.counters()["sync_under_lock"] == 0
+
+
+def test_blocking_under_cold_lock_exempt(san):
+    a = make(san, "A", hot=False)
+    with a:
+        san.note_blocking("io", "aot.disk.write")
+    assert san.counters()["sync_under_lock"] == 0
+
+
+def test_host_sync_under_lock_via_traced_jnp_op(san):
+    """The jax-shaped repro: materializing a traced computation while a
+    hot lock is held — finish() calls note_host_sync, which must
+    attribute the sync to the held lock."""
+    import jax
+    import jax.numpy as jnp
+
+    a = make(san, "evaluator.fake._lock")
+    fn = jax.jit(lambda x: (x * 2).sum())
+    with a:
+        value = fn(jnp.arange(8))
+        # the sanctioned sync point runs under the lock: flagged
+        import ytsaurus_tpu.utils.sanitizers as global_san
+        san.note_blocking("host-sync", "evaluator.finish")
+        assert int(value) == 56
+    event = san.sync_under_lock[0]
+    assert event["kind"] == "host-sync"
+    assert event["locks_held"] == ["evaluator.fake._lock"]
+
+
+# --- condition semantics ------------------------------------------------------
+
+
+def test_condition_wait_releases_held_set(san):
+    cond = InstrumentedCondition(san, "C")
+    seen_during_wait = []
+    woken = threading.Event()
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            woken.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        # waiter is blocked in wait(): ITS held set must not contain C
+        # (we can observe indirectly: this acquire succeeded, and no
+        # self-edge/inversion was produced)
+        seen_during_wait.append(san.held_names())
+        cond.notify_all()
+    woken.wait(5)
+    t.join()
+    assert seen_during_wait == [["C"]]
+    assert san.counters()["inversions"] == 0
+
+
+def test_condition_hold_time_excludes_wait(san):
+    cond = InstrumentedCondition(san, "C")
+
+    def notifier():
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+
+    t = threading.Thread(target=notifier)
+    t.start()
+    with cond:
+        cond.wait(timeout=1)       # >> budget, but NOT held time
+    t.join()
+    assert san.counters()["hold_violations"] == 0
+
+
+# --- registration + disabled fast path ----------------------------------------
+
+
+def test_register_lock_enabled_returns_instrumented(monkeypatch):
+    monkeypatch.setenv("YT_TPU_SANITIZE", "1")
+    lock = sanitizers.register_lock("test.fixture._lock")
+    assert isinstance(lock, InstrumentedLock)
+    assert "test.fixture._lock" in sanitizers.registered_sites()
+
+
+def test_register_lock_disabled_returns_plain_lock(monkeypatch):
+    monkeypatch.delenv("YT_TPU_SANITIZE", raising=False)
+    monkeypatch.setattr(sanitizers, "_config_enabled", False)
+    lock = sanitizers.register_lock("test.disabled._lock")
+    assert type(lock) is type(threading.Lock()), \
+        "disabled path must hand out the PLAIN lock, not a wrapper"
+    cond = sanitizers.register_condition("test.disabled._cond")
+    assert isinstance(cond, threading.Condition)
+    rlock = sanitizers.register_rlock("test.disabled._rlock")
+    assert type(rlock) is type(threading.RLock())
+
+
+def test_config_gating(monkeypatch):
+    """The full config path: DaemonConfig.sanitizer parses, and
+    set_sanitizer_config applies it (None restores the disabled
+    default, like every other config setter)."""
+    from ytsaurus_tpu import config as cfg
+    monkeypatch.delenv("YT_TPU_SANITIZE", raising=False)
+    monkeypatch.setattr(sanitizers, "_config_enabled", False)
+    assert not sanitizers.enabled()
+    daemon_cfg = cfg.DaemonConfig.from_dict(
+        {"sanitizer": {"enabled": True, "hold_budget_seconds": 1.5}})
+    assert daemon_cfg.sanitizer.enabled
+    try:
+        cfg.set_sanitizer_config(daemon_cfg.sanitizer)
+        assert cfg.sanitizer_config().enabled
+        assert sanitizers.enabled()
+        assert sanitizers.get_sanitizer().hold_budget == 1.5
+        cfg.set_sanitizer_config(None)
+        assert not cfg.sanitizer_config().enabled
+        assert not sanitizers.enabled()
+    finally:
+        cfg.set_sanitizer_config(None)
+        monkeypatch.setattr(sanitizers, "_config_enabled", False)
+        # restore the suite-wide budget for later tests
+        sanitizers.get_sanitizer().hold_budget = \
+            sanitizers.DEFAULT_HOLD_BUDGET
+
+
+def test_snapshot_shape_and_counters():
+    report = sanitizers.snapshot()
+    # conftest arms YT_TPU_SANITIZE for the whole suite
+    assert report["enabled"] is True
+    for key in ("inversions", "hold_violations", "sync_under_lock",
+                "edges_observed"):
+        assert key in report["counters"]
+    assert isinstance(report["edges"], list)
+
+
+def test_monitoring_sanitizer_endpoint():
+    import json
+    import urllib.request
+
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    server = MonitoringServer()
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{server.address}/sanitizer", timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["enabled"] is True
+        assert "edges" in body and "counters" in body
+        # the counters mirror onto /metrics at snapshot time
+        with urllib.request.urlopen(
+                f"http://{server.address}/metrics", timeout=5) as resp:
+            metrics = resp.read().decode()
+        assert "sanitizer_edges_observed" in metrics
+    finally:
+        server.stop()
+
+
+def test_orchid_sanitizer_mount():
+    from ytsaurus_tpu.server.orchid import default_orchid
+    tree = default_orchid()
+    value = tree.get("/sanitizer")
+    assert value["enabled"] is True
+
+
+# --- the dynamic ⊆ static reconciliation gate ---------------------------------
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _reconciliation_inputs():
+    import os
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.analyze import guard_inference, load_files
+    return guard_inference.reconciliation_graph(load_files(repo))
+
+
+def test_dynamic_graph_is_subgraph_of_static_over_real_locks():
+    """Exercise real cross-lock paths on the PROCESS-GLOBAL sanitizer
+    (accounting under admission, sensor creation under the workload log,
+    history sampling over live sensors), then assert every observed
+    edge between registered sites exists in the static reconciliation
+    graph — the same check pytest_sessionfinish runs over the whole
+    tier-1 run, failing with acquisition stacks on any miss."""
+    from ytsaurus_tpu.query.accounting import get_accountant
+    from ytsaurus_tpu.query.workload import get_workload_log
+    from ytsaurus_tpu.utils.profiling import MetricsHistory, Profiler
+
+    # accounting: fold usage (accountant lock -> pool sensor counters)
+    get_accountant().fold("pool-a", "user", lookups=1, lookup_keys=2)
+    # workload: record a lookup (log lock -> sensor creation)
+    get_workload_log().observe_lookup("//tmp/t", [(1,)], pool="pool-a")
+    # telemetry: sample every live sensor under the history lock
+    Profiler("/sanitizer_test").counter("ticks").increment()
+    MetricsHistory(sample_period=10.0).sample_once()
+
+    san = sanitizers.get_sanitizer()
+    assert san is not None, "conftest arms YT_TPU_SANITIZE suite-wide"
+    assert san.edge_snapshot(), "the exercise above must record edges"
+
+    graph = _reconciliation_inputs()
+    violations = sanitizers.reconcile(graph["edges"], graph["site_map"])
+    assert violations == [], "\n".join(violations)
+
+
+def test_site_map_covers_every_registered_site():
+    """Every lock the process registered resolves to a static node —
+    a registration whose site string drifts from the code location
+    would silently fall out of the reconciliation gate."""
+    graph = _reconciliation_inputs()
+    site_map = graph["site_map"]
+    missing = [site for site in sanitizers.registered_sites()
+               if site not in site_map and not site.startswith("test.")]
+    assert missing == [], missing
+
+
+def test_reconcile_reports_missing_edge_with_stack(san):
+    a, b = make(san, "site.a"), make(san, "site.b")
+    with a:
+        with b:
+            pass
+    site_map = {"site.a": "x.py::A._lock", "site.b": "y.py::B._lock"}
+    violations = sanitizers.reconcile(
+        [], site_map, observed=san.edge_snapshot())
+    assert len(violations) == 1
+    assert "site.a -> site.b" in violations[0]
+    assert "MISSING" in violations[0]
+    # the edge is sanctioned once the static graph carries it
+    ok = sanitizers.reconcile(
+        [["x.py::A._lock", "y.py::B._lock", "x.py:1"]], site_map,
+        observed=san.edge_snapshot())
+    assert ok == []
+
+
+def test_reconcile_ignores_unregistered_sites(san):
+    a, b = make(san, "test.only.a"), make(san, "test.only.b")
+    with a:
+        with b:
+            pass
+    violations = sanitizers.reconcile([], {}, observed=san.edge_snapshot())
+    assert violations == []

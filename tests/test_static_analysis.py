@@ -614,7 +614,7 @@ def test_run_passes_rejects_unknown_pass():
 
 
 def test_repo_clean_against_baseline():
-    """THE gate: all five passes over the real tree, checked against the
+    """THE gate: all six passes over the real tree, checked against the
     committed baseline.  A new finding (or a count regression) fails
     tier-1 — fix the code or waive with a reason; growing the baseline
     is not a fix."""

@@ -1,10 +1,16 @@
 """`yt analyze` — the AST-based static-analysis suite (ISSUE 9).
 
-Five passes over one shared parse of the tree (see core.py for the
+Six passes over one shared parse of the tree (see core.py for the
 framework: finding model, waivers, baseline ratchet):
 
   locks     lock discipline (`# guards:` annotations) + the global
             lock-acquisition-order graph, failing on cycles
+  guards    ISSUE 15: annotation-FREE lock-guard inference (RacerD-
+            shaped held-set propagation with thread-entry roots and
+            init-escape), check-then-act atomicity lint, and
+            annotation-drift cross-checks; also exports the superset
+            reconciliation graph the runtime sanitizer
+            (utils/sanitizers.py) asserts its dynamic edges against
   jax       JAX tracing hazards: hidden device→host syncs in hot-path
             modules, Python branches on traced values, dynamically
             shaped calls into jitted callees
@@ -12,7 +18,7 @@ framework: finding model, waivers, baseline ratchet):
             planes + PR 5's span-site discipline (no interior roots)
   errors    error-taxonomy soundness: unique EErrorCode values,
             registered codes at raise sites
-  sensors   PR 6's sensor-catalog lint, folded in as the fifth pass
+  sensors   PR 6's sensor-catalog lint
 
 Entry points: `yt analyze [--pass ...] [--json] [--update-baseline]`,
 `python -m tools.analyze`, and the tier-1 gate in
@@ -28,6 +34,7 @@ from typing import Iterable, Optional
 from tools.analyze import (
     coverage,
     error_taxonomy,
+    guard_inference,
     jax_hazards,
     lock_discipline,
     sensors,
@@ -52,6 +59,7 @@ __all__ = [
 
 PASSES = {
     "locks": lock_discipline.run,
+    "guards": guard_inference.run,
     "jax": jax_hazards.run,
     "coverage": coverage.run,
     "errors": error_taxonomy.run,

@@ -22,9 +22,11 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(
         prog="yt analyze",
-        description="AST-based static analysis: lock discipline, JAX "
-                    "recompile/host-sync hazards, failpoint & span "
-                    "coverage, error taxonomy, sensor catalog.")
+        description="AST-based static analysis: lock discipline, "
+                    "annotation-free guard inference + atomicity lint "
+                    "(guards), JAX recompile/host-sync hazards, "
+                    "failpoint & span coverage, error taxonomy, sensor "
+                    "catalog.")
     parser.add_argument("--root", default=repo_root,
                         help="repo root (contains ytsaurus_tpu/)")
     parser.add_argument("--pass", dest="passes", action="append",
@@ -62,13 +64,22 @@ def main(argv=None) -> int:
         violations = analyze.check_ratchet(findings, baseline)
 
     if args.json:
-        print(json.dumps({
+        payload = {
             "findings": [f.to_dict() for f in findings],
             "violations": violations,
             "counts": analyze.aggregate(findings),
             "lock_order": lock_discipline.order_graph_snapshot(files),
             "clean": not violations,
-        }, indent=2))
+        }
+        if args.passes is None or "guards" in args.passes:
+            # ISSUE 15: the guards pass's superset graph — what the
+            # runtime sanitizer's dynamic⊆static gate checks against —
+            # plus the register_lock site → static-node map.  Scoped to
+            # guards runs: the deep closure is the expensive part.
+            from tools.analyze import guard_inference
+            payload["reconciliation"] = \
+                guard_inference.reconciliation_graph(files)
+        print(json.dumps(payload, indent=2))
         return 1 if violations else 0
 
     for violation in violations:

@@ -1,0 +1,897 @@
+"""Inferred guard-discipline + atomicity pass (`yt analyze --pass guards`).
+
+PR 8's `locks` pass checks only what someone remembered to annotate —
+11 modules carry `# guards:` comments, the other ~180 files are
+invisible to it.  This pass is the annotation-FREE complement
+(RacerD-shaped, after the Facebook Infer analysis): for every class in
+the tree it discovers the lock fields, propagates held-lock sets
+through the intra-class call graph, classifies every `self._field`
+access site as locked/unlocked, and infers the guard relation from the
+evidence — a field written under a lock at one site and mutated without
+it at another is a finding, no annotation required.
+
+Inference model
+---------------
+  locks      `self._x = threading.Lock()/RLock()/Condition()` (plus the
+             sanitizer registration helper `register_lock(...)` and
+             module-level `_LOCK = threading.Lock()`).
+  held sets  syntactic `with <lock>:` scopes, UNIONED with the method's
+             inferred ENTRY context: a private method (leading `_`, only
+             ever invoked as `self.m(...)` inside its class) inherits the
+             INTERSECTION of the lock sets held at its call sites,
+             fixpoint-iterated; a method that escapes — public name, or
+             referenced as a value (`Thread(target=self._run)`, executor
+             `submit(self._work)`, any callback registration: the
+             thread-entry roots) — can assume nothing and enters with
+             the empty set.
+  evidence   the guard set of a field is the union of locks effectively
+             held at its write sites.  Non-empty evidence makes every
+             effectively-unlocked WRITE a `guard-inference` finding.
+  escapes    `__init__` writes BEFORE the object escapes the
+             constructor (self passed to a call, a bound method
+             captured, a thread started) race with nobody and are
+             exempt — and contribute no evidence.  Methods named
+             `*_locked` document "caller holds the lock" (the PR 8
+             convention): they enter with the full class lock set.
+
+Rules
+-----
+  guard-inference  a write to an inferred-guarded field at a site whose
+                   effective held-lock set misses every evidence lock.
+  guard-read       an unlocked read of an inferred-guarded field from a
+                   method that elsewhere USES a lock — the torn-read /
+                   stale-read shape.  (Lock-free reads are sometimes
+                   intentional: waive with a reason.)
+  atomicity        check-then-act: a guarded read's result feeds a
+                   guarded write in a DIFFERENT `with` region of the
+                   same lock in the same function — the lock was
+                   released between the check and the act, so the acted-
+                   on value may be stale (the TOCTOU shape PR 6/8 kept
+                   finding by hand).  Re-reading the field inside the
+                   second region (double-checked locking) is exempt.
+  guard-drift      a declared `# guards:` annotation the inference
+                   contradicts: the annotated field's guarded accesses
+                   all hold a DIFFERENT lock, or the field has no
+                   post-construction access at all (stale annotation).
+
+The runtime complement lives in `ytsaurus_tpu/utils/sanitizers.py`: the
+instrumented-lock layer observes the DYNAMIC acquisition-order graph,
+and tier-1 asserts it is a subgraph of `reconciliation_graph()` below —
+any edge the AST propagation missed fails the build with stacks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.analyze.core import (
+    Finding,
+    SourceFile,
+    dotted_name,
+    walk_functions,
+)
+from tools.analyze.lock_discipline import (
+    MUTATORS,
+    LockInfo,
+    build_order_graph,
+    collect_locks,
+)
+
+PASS_NAME = "guards"
+
+# Constructor shapes that MAKE a lock: `threading.Lock()`,
+# `threading.RLock()`, `Condition(...)`, plus the sanitizer registration
+# helper (`sanitizers.register_lock("site", ...)` returns the lock it
+# registers — plain or instrumented).
+_LOCK_FACTORY_SUFFIXES = ("Lock", "RLock", "Condition", "Semaphore",
+                          "BoundedSemaphore")
+_REGISTER_HELPERS = {"register_lock", "register_rlock",
+                     "register_condition"}
+
+# Dunder methods are externally callable by definition; they get the
+# empty entry context like any public method.
+
+
+def _is_lock_ctor(value: ast.AST) -> "tuple[bool, Optional[str]]":
+    """(is_lock, registered_site_name) for an assignment RHS."""
+    if not isinstance(value, ast.Call):
+        return False, None
+    name = dotted_name(value.func).rsplit(".", 1)[-1]
+    if name in _REGISTER_HELPERS:
+        site = None
+        if value.args and isinstance(value.args[0], ast.Constant) and \
+                isinstance(value.args[0].value, str):
+            site = value.args[0].value
+        return True, site
+    if name in _LOCK_FACTORY_SUFFIXES:
+        return True, None
+    return False, None
+
+
+class InferredLock:
+    """One discovered lock field (no annotation needed)."""
+
+    __slots__ = ("path", "cls", "attr", "line", "site_name")
+
+    def __init__(self, path: str, cls: Optional[str], attr: str,
+                 line: int, site_name: Optional[str] = None):
+        self.path = path
+        self.cls = cls
+        self.attr = attr
+        self.line = line
+        self.site_name = site_name      # sanitizers.register_lock name
+
+    @property
+    def node_id(self) -> str:
+        scope = f"{self.cls}." if self.cls else ""
+        return f"{self.path}::{scope}{self.attr}"
+
+
+def collect_inferred_locks(f: SourceFile) -> "list[InferredLock]":
+    """Every lock-typed field/global in a module, by constructor shape."""
+    out: list[InferredLock] = []
+    seen: set = set()
+
+    def note(cls, attr, line, site):
+        key = (cls, attr)
+        if key not in seen:
+            seen.add(key)
+            out.append(InferredLock(f.path, cls, attr, line, site))
+
+    for node in f.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            is_lock, site = _is_lock_ctor(node.value)
+            if is_lock:
+                note(None, node.targets[0].id, node.lineno, site)
+        elif isinstance(node, ast.ClassDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and \
+                        len(sub.targets) == 1 and \
+                        isinstance(sub.targets[0], ast.Attribute) and \
+                        isinstance(sub.targets[0].value, ast.Name) and \
+                        sub.targets[0].value.id == "self":
+                    is_lock, site = _is_lock_ctor(sub.value)
+                    if is_lock:
+                        note(node.name, sub.targets[0].attr, sub.lineno,
+                             site)
+    return out
+
+
+# -- per-function access walking -----------------------------------------------
+
+
+class _Access:
+    __slots__ = ("field", "kind", "line", "held", "method", "verb")
+
+    def __init__(self, field, kind, line, held, method, verb=""):
+        self.field = field
+        self.kind = kind            # 'read' | 'write'
+        self.line = line
+        self.held = held            # frozenset of SYNTACTIC locks held
+        self.method = method
+        self.verb = verb
+
+
+class _Region:
+    """One `with <lock>:` region inside a function (atomicity lint)."""
+
+    __slots__ = ("lock", "node", "start", "end", "reads", "writes",
+                 "tainted", "cond_names")
+
+    def __init__(self, lock, node, cond_names):
+        self.lock = lock
+        self.node = node
+        self.start = node.lineno
+        self.end = node.end_lineno or node.lineno
+        self.reads: set[str] = set()        # guarded fields read
+        self.writes: list = []              # (field, line, stmt_names)
+        self.tainted: dict[str, set] = {}   # name -> source fields
+        # Names appearing in enclosing if/while tests (with linenos) —
+        # control dependence for the check-then-act detection.
+        self.cond_names = cond_names        # list[(lineno, set[str])]
+
+
+def _mutation_targets(node: ast.AST):
+    """(field, is_self, verb, attr_node) mutations attributable to THIS
+    node alone — assignment/augassign/del targets (subscripts peeled)
+    and mutator-method receivers; mirrors lock_discipline's walker but
+    keeps the node identity so reads can exclude write bases."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = [(t, "assigned") for t in node.targets]
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [(node.target, "assigned")]
+    elif isinstance(node, ast.Delete):
+        targets = [(t, "deleted") for t in node.targets]
+    elif isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            targets = [(fn.value, f"mutated via .{fn.attr}()")]
+    for target, verb in targets:
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            yield target.attr, True, verb, target
+        elif isinstance(target, ast.Name):
+            yield target.id, False, verb, target
+
+
+class _FunctionScan:
+    """One walk of a function body: accesses with syntactic held sets,
+    self-call sites, value-references to methods, and the `with` regions
+    for the atomicity lint."""
+
+    def __init__(self, f: SourceFile, cls: Optional[str], fn: ast.AST,
+                 lock_attrs: "set[str]", mod_locks: "set[str]",
+                 class_fields: "set[str]", mod_fields: "set[str]",
+                 method_names: "set[str]"):
+        self.f = f
+        self.cls = cls
+        self.fn = fn
+        self.lock_attrs = lock_attrs
+        self.mod_locks = mod_locks
+        self.class_fields = class_fields
+        self.mod_fields = mod_fields
+        self.method_names = method_names
+        self.accesses: list[_Access] = []
+        self.call_sites: list[tuple[str, frozenset]] = []
+        self.value_refs: set[str] = set()       # methods that escape here
+        self.regions: list[_Region] = []
+        # Plain assignments (names, line) anywhere in the function —
+        # the atomicity lint's taint-kill set (a name REASSIGNED between
+        # the check region and the act region no longer carries the
+        # stale read).
+        self.assignments: list[tuple[set, int]] = []
+        self.mod_globals: set[str] = {
+            n for node in ast.walk(fn) if isinstance(node, ast.Global)
+            for n in node.names}
+        self._held: list[str] = []
+        self._write_nodes: set[int] = set()
+        self._cond_stack: list[tuple[int, set]] = []
+        self._region_stack: list[_Region] = []
+        # Every call's OWN func node (any nesting depth): a `self.m`
+        # that is some call's callee is a direct invocation, not a
+        # bound-method capture.
+        self._callee_nodes: set[int] = {
+            id(c.func) for c in ast.walk(fn)
+            if isinstance(c, ast.Call)}
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        name = dotted_name(expr)
+        if name.startswith("self.") and name[5:] in self.lock_attrs:
+            return name[5:]
+        if name in self.mod_locks:
+            return name
+        return None
+
+    def run(self) -> "_FunctionScan":
+        for stmt in self.fn.body:
+            self._visit(stmt)
+        return self
+
+    def _note_mutations(self, node: ast.AST) -> None:
+        held = frozenset(self._held)
+        for field, is_self, verb, target in _mutation_targets(node):
+            self._write_nodes.add(id(target))
+            if is_self and field in self.class_fields:
+                acc = _Access(field, "write", node.lineno, held,
+                              self.fn.name, verb)
+            elif not is_self and field in self.mod_fields and \
+                    (field in self.mod_globals or
+                     verb.startswith("mutated")):
+                acc = _Access(field, "write", node.lineno, held,
+                              self.fn.name, verb)
+            else:
+                continue
+            self.accesses.append(acc)
+            if self._region_stack:
+                region = self._region_stack[-1]
+                names = {n.id for n in ast.walk(node)
+                         if isinstance(n, ast.Name)}
+                region.writes.append((field, node.lineno, names))
+
+    def _note_reads(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and \
+                isinstance(node.ctx, ast.Load) and \
+                node.attr in self.class_fields and \
+                id(node) not in self._write_nodes:
+            self.accesses.append(_Access(
+                node.attr, "read", node.lineno, frozenset(self._held),
+                self.fn.name))
+            if self._region_stack:
+                self._region_stack[-1].reads.add(node.attr)
+
+    def _note_region_taint(self, node: ast.AST) -> None:
+        """Inside a region, `x = <expr reading guarded field>` taints x."""
+        if isinstance(node, ast.Assign):
+            names = {t.id for t in node.targets
+                     if isinstance(t, ast.Name)}
+            for t in node.targets:
+                if isinstance(t, ast.Tuple):
+                    names |= {e.id for e in t.elts
+                              if isinstance(e, ast.Name)}
+            if names:
+                self.assignments.append((names, node.lineno))
+        if not self._region_stack or not isinstance(node, ast.Assign):
+            return
+        fields = {n.attr for n in ast.walk(node.value)
+                  if isinstance(n, ast.Attribute) and
+                  isinstance(n.value, ast.Name) and n.value.id == "self"
+                  and n.attr in self.class_fields}
+        fields |= {n.id for n in ast.walk(node.value)
+                   if isinstance(n, ast.Name) and n.id in self.mod_fields}
+        if not fields:
+            return
+        region = self._region_stack[-1]
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                region.tainted.setdefault(target.id, set()).update(fields)
+            elif isinstance(target, ast.Tuple):
+                for elt in target.elts:
+                    if isinstance(elt, ast.Name):
+                        region.tainted.setdefault(elt.id,
+                                                  set()).update(fields)
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not self.fn:
+            # Nested defs are separate dynamic scopes — but a reference
+            # to self.m inside one still escapes m (callback capture).
+            for sub in ast.walk(node):
+                self._note_value_ref(sub)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: list[str] = []
+            opened: list[_Region] = []
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    self._note_mutations(sub)
+                    self._note_reads(sub)
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    acquired.append(lock)
+                    region = _Region(lock, node,
+                                     list(self._cond_stack))
+                    self.regions.append(region)
+                    opened.append(region)
+            self._held.extend(acquired)
+            self._region_stack.extend(opened)
+            for stmt in node.body:
+                self._visit(stmt)
+            del self._held[len(self._held) - len(acquired):]
+            del self._region_stack[len(self._region_stack) - len(opened):]
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            names = {n.id for n in ast.walk(node.test)
+                     if isinstance(n, ast.Name)}
+            for sub in ast.walk(node.test):
+                self._note_mutations(sub)
+                self._note_reads(sub)
+                self._note_call(sub)
+                self._note_value_ref(sub)
+            self._cond_stack.append((node.lineno, names))
+            for stmt in [*node.body, *node.orelse]:
+                self._visit(stmt)
+            self._cond_stack.pop()
+            return
+        self._note_mutations(node)
+        self._note_reads(node)
+        self._note_call(node)
+        self._note_value_ref(node)
+        self._note_region_taint(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _note_call(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name.startswith("self.") and "." not in name[5:] and \
+                    name[5:] in self.method_names:
+                self.call_sites.append((name[5:], frozenset(self._held)))
+
+    def _note_value_ref(self, node: ast.AST) -> None:
+        """`self.m` used as a VALUE (not the callee of a direct call):
+        thread targets, executor submits, stored callbacks — including
+        plain assignment capture (`self._cb = self._run`) — m escapes
+        and can assume no caller-held locks."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and \
+                node.attr in self.method_names and \
+                id(node) not in self._callee_nodes:
+            self.value_refs.add(node.attr)
+
+
+# -- entry-context fixpoint ----------------------------------------------------
+
+
+def _init_escape_line(fn: ast.AST, method_names: "set[str]") -> int:
+    """First line of `__init__` where self ESCAPES the constructor:
+    self passed raw to a call, a bound method captured (thread target),
+    or a thread/executor started on a self attribute.  Writes before
+    this line are pre-publication and race with nobody."""
+    escape = (fn.end_lineno or fn.lineno) + 1
+    for node in ast.walk(fn):
+        line = getattr(node, "lineno", None)
+        if line is None or line >= escape:
+            continue
+        if isinstance(node, ast.Call):
+            for arg in [*node.args, *[k.value for k in node.keywords]]:
+                # `self.x` as an argument reads a field, it does not
+                # leak the object — only a RAW `self` (not the .value of
+                # an attribute access) or a BOUND METHOD escapes.
+                attr_values = {id(sub.value) for sub in ast.walk(arg)
+                               if isinstance(sub, ast.Attribute)}
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id == "self" \
+                            and id(sub) not in attr_values:
+                        escape = line
+                    elif isinstance(sub, ast.Attribute) and \
+                            isinstance(sub.value, ast.Name) and \
+                            sub.value.id == "self" and \
+                            sub.attr in method_names:
+                        escape = line
+            name = dotted_name(node.func)
+            if name.endswith(".start") and name.startswith("self."):
+                escape = min(escape, line)
+    return escape
+
+
+class _ClassModel:
+    """Everything inferred about one class (or the module scope when
+    cls is None): locks, fields, per-method scans, entry contexts."""
+
+    def __init__(self, f: SourceFile, cls: Optional[str],
+                 lock_attrs: "set[str]", mod_locks: "set[str]",
+                 fns: "list[ast.AST]"):
+        self.f = f
+        self.cls = cls
+        self.lock_attrs = lock_attrs
+        self.mod_locks = mod_locks
+        self.fns = {fn.name: fn for fn in fns}
+        method_names = set(self.fns)
+        if cls is not None:
+            class_fields = self._self_fields(fns) - lock_attrs
+            mod_fields = set()
+        else:
+            class_fields = set()
+            mod_fields = self._module_fields(f)
+        self.class_fields = class_fields
+        self.mod_fields = mod_fields
+        self.scans = {
+            fn.name: _FunctionScan(f, cls, fn, lock_attrs, mod_locks,
+                                   class_fields, mod_fields,
+                                   method_names).run()
+            for fn in fns}
+        self.entry = self._entry_contexts()
+
+    @staticmethod
+    def _self_fields(fns) -> "set[str]":
+        out: set[str] = set()
+        for fn in fns:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "self":
+                    out.add(node.attr)
+        return out
+
+    @staticmethod
+    def _module_fields(f: SourceFile) -> "set[str]":
+        out: set[str] = set()
+        for node in f.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+        return out
+
+    def _entry_contexts(self) -> "dict[str, frozenset]":
+        locks = frozenset(self.lock_attrs | self.mod_locks)
+        escaped: set[str] = set()
+        callers: dict[str, list] = {}
+        for name, scan in self.scans.items():
+            escaped |= scan.value_refs
+            for callee, held in scan.call_sites:
+                callers.setdefault(callee, []).append((name, held))
+        entry: dict[str, frozenset] = {}
+        private: set[str] = set()
+        for name in self.scans:
+            if name.endswith("_locked"):
+                # Convention: "caller holds the lock".
+                entry[name] = locks
+            elif not name.startswith("_") or name.startswith("__") or \
+                    name in escaped or name not in callers:
+                entry[name] = frozenset()
+            else:
+                private.add(name)
+                entry[name] = locks         # ⊤: narrowed by fixpoint
+        for _ in range(8):
+            changed = False
+            for name in private:
+                new = None
+                for caller, held in callers[name]:
+                    ctx = held | entry.get(caller, frozenset())
+                    new = ctx if new is None else (new & ctx)
+                new = frozenset(new or ())
+                if new != entry[name]:
+                    entry[name] = new
+                    changed = True
+            if not changed:
+                break
+        # The EVIDENCE context is the dual: the UNION of locks held at
+        # some call site.  A private helper locked at one call site and
+        # bare at another has entry ∅ (it can assume nothing — flag its
+        # accesses) but evidence {lock} (somebody DOES think the field
+        # needs it — the inconsistency is the finding).
+        entry_any = {name: entry[name] for name in self.scans}
+        for _ in range(8):
+            changed = False
+            for name in private:
+                new = frozenset()
+                for caller, held in callers[name]:
+                    new |= held | entry_any.get(caller, frozenset())
+                if new != entry_any[name]:
+                    entry_any[name] = new
+                    changed = True
+            if not changed:
+                break
+        self.entry_any = entry_any
+        return entry
+
+    def effective_accesses(self):
+        """Every access with its EFFECTIVE held set (syntactic ∪ entry
+        context), `__init__` pre-publication accesses dropped."""
+        method_names = set(self.fns)
+        for name, scan in self.scans.items():
+            ctx = self.entry.get(name, frozenset())
+            if name == "__init__":
+                cut = _init_escape_line(scan.fn, method_names)
+                for acc in scan.accesses:
+                    if acc.line >= cut:
+                        yield _Access(acc.field, acc.kind, acc.line,
+                                      acc.held | ctx, name, acc.verb)
+                continue
+            for acc in scan.accesses:
+                yield _Access(acc.field, acc.kind, acc.line,
+                              acc.held | ctx, name, acc.verb)
+
+
+def _class_models(f: SourceFile) -> "list[_ClassModel]":
+    inferred = collect_inferred_locks(f)
+    mod_locks = {l.attr for l in inferred if l.cls is None}
+    # Annotated module locks count as locks too even when their ctor
+    # shape is unusual (they carry explicit `# guards:` intent).
+    annotated, _ = collect_locks(f)
+    mod_locks |= {l.attr for l in annotated if l.cls is None}
+    models: list[_ClassModel] = []
+    by_class: dict[Optional[str], list] = {}
+    for cls, fn in walk_functions(f.tree):
+        by_class.setdefault(cls, []).append(fn)
+    for cls, fns in by_class.items():
+        if cls is None:
+            lock_attrs: set[str] = set()
+        else:
+            lock_attrs = {l.attr for l in inferred if l.cls == cls}
+            lock_attrs |= {l.attr for l in annotated if l.cls == cls}
+        models.append(_ClassModel(f, cls, lock_attrs, mod_locks, fns))
+    return models
+
+
+# -- the pass ------------------------------------------------------------------
+
+
+def _guard_evidence(model: _ClassModel
+                    ) -> "dict[tuple, dict[str, int]]":
+    """(field, scope_is_class) -> {lock: locked-write count}.  Evidence
+    uses the UNION entry context (entry_any): a write in a helper that
+    SOME caller locks counts as intent, even when another call path is
+    bare — that inconsistency is exactly what the pass reports."""
+    lock_universe = model.lock_attrs | model.mod_locks
+    method_names = set(model.fns)
+    evidence: dict[tuple, dict[str, int]] = {}
+    for name, scan in model.scans.items():
+        ctx_any = model.entry_any.get(name, frozenset())
+        cut = _init_escape_line(scan.fn, method_names) \
+            if name == "__init__" else 0
+        for acc in scan.accesses:
+            if acc.kind != "write" or acc.line < cut:
+                continue
+            scope_is_class = acc.field in model.class_fields
+            key = (acc.field, scope_is_class)
+            locks = (acc.held | ctx_any) & lock_universe
+            if locks:
+                slot = evidence.setdefault(key, {})
+                for lock in locks:
+                    slot[lock] = slot.get(lock, 0) + 1
+    return evidence
+
+
+def _check_model(model: _ClassModel,
+                 findings: "list[Finding]") -> None:
+    f = model.f
+    evidence = _guard_evidence(model)
+    if not evidence:
+        _check_atomicity(model, {}, findings)
+        return
+    guards = {key: set(locks) for key, locks in evidence.items()}
+    # Methods that use locks at all — guard-read only fires there (a
+    # class used single-threaded through a lock-free facade would
+    # otherwise drown the report).
+    for acc in model.effective_accesses():
+        scope_is_class = acc.field in model.class_fields
+        key = (acc.field, scope_is_class)
+        inferred = guards.get(key)
+        if not inferred or acc.held & inferred:
+            continue
+        if acc.method.endswith("_locked") or acc.method == "__del__":
+            continue
+        fn = model.fns.get(acc.method)
+        scope = f"{model.cls}." if model.cls else ""
+        lock_names = " or ".join(
+            f"`{'self.' if l in model.lock_attrs else ''}{l}`"
+            for l in sorted(inferred))
+        if acc.kind == "write":
+            if f.waived("guard-inference", acc.line) or \
+                    (fn is not None and
+                     f.function_waived("guard-inference", fn)):
+                continue
+            owner = "self." if scope_is_class else ""
+            findings.append(Finding(
+                PASS_NAME, "guard-inference", f.path, acc.line,
+                f"{owner}{acc.field} is {acc.verb} in "
+                f"{scope}{acc.method} without {lock_names}, but "
+                f"{_evidence_note(evidence[key])} — either lock this "
+                f"site or waive with `# analyze: "
+                f"allow(guard-inference): reason`"))
+        else:
+            scan = model.scans.get(acc.method)
+            if scan is None or not _method_uses_locks(scan, model):
+                continue
+            if _double_checked(scan, model, acc, inferred):
+                continue    # lock-free fast path + locked re-check
+            if f.waived("guard-read", acc.line) or \
+                    (fn is not None and
+                     f.function_waived("guard-read", fn)):
+                continue
+            findings.append(Finding(
+                PASS_NAME, "guard-read", f.path, acc.line,
+                f"self.{acc.field} is read in {scope}{acc.method} "
+                f"without {lock_names} while the method takes locks "
+                f"elsewhere — a torn/stale read; lock it or waive with "
+                f"`# analyze: allow(guard-read): reason`",
+                severity="warning"))
+    _check_atomicity(model, guards, findings)
+
+
+def _method_uses_locks(scan: _FunctionScan, model: _ClassModel) -> bool:
+    return bool(scan.regions) or \
+        bool(model.entry.get(scan.fn.name))
+
+
+def _double_checked(scan: _FunctionScan, model: _ClassModel,
+                    acc: _Access, inferred: "set[str]") -> bool:
+    """The double-checked lazy-init idiom: a lock-free read of a field
+    that the SAME method also RE-READS under one of its guard locks, or
+    conditionally INSTALLS under the lock (plain/setdefault assignment),
+    is the sanctioned fast path.  A locked destructive mutation
+    (.clear()/.pop()) does NOT sanction an unlocked read — that's the
+    stale-read shape, not lazy init."""
+    ctx = model.entry.get(acc.method, frozenset())
+    return any(other.field == acc.field and other.line != acc.line and
+               ((other.held | ctx) & inferred) and
+               (other.kind == "read" or other.verb == "assigned" or
+                "setdefault" in other.verb)
+               for other in scan.accesses)
+
+
+def _evidence_note(locked_writes: "dict[str, int]") -> str:
+    parts = [f"{count} write{'s' if count > 1 else ''} hold "
+             f"`{lock}`" for lock, count in sorted(locked_writes.items())]
+    return "elsewhere " + " and ".join(parts)
+
+
+def _check_atomicity(model: _ClassModel, guards: dict,
+                     findings: "list[Finding]") -> None:
+    """Check-then-act across lock regions of one function: a name bound
+    from a guarded read in region A, feeding (or gating) a guarded write
+    in a LATER region B of the same lock — the lock was dropped between
+    check and act.  Re-reading the field inside B (double-checked
+    locking) exempts."""
+    f = model.f
+    for scan in model.scans.values():
+        regions = sorted(scan.regions, key=lambda r: r.start)
+        for i, ra in enumerate(regions):
+            if not ra.tainted:
+                continue
+            guarded_sources = {
+                field for fields in ra.tainted.values()
+                for field in fields}
+            for rb in regions[i + 1:]:
+                if rb.lock != ra.lock or rb.start <= ra.end:
+                    continue
+                if rb.reads & guarded_sources:
+                    continue        # double-checked: B re-validates
+
+                def alive(names, boundary):
+                    """Tainted names NOT reassigned between the check
+                    region's close and `boundary` — a reassignment
+                    replaces the stale read with a fresh value."""
+                    return {n for n in names
+                            if not any(ra.end < line < boundary and
+                                       n in assigned
+                                       for assigned, line
+                                       in scan.assignments)}
+
+                tainted_names = set(ra.tainted)
+                # Control dependence: B is inside an if/while (opened
+                # after A closed) testing a tainted name.
+                control_alive = alive(tainted_names, rb.start)
+                control = any(
+                    line > ra.end and names & control_alive
+                    for line, names in rb.cond_names)
+                for field, line, stmt_names in rb.writes:
+                    key = (field, field in model.class_fields)
+                    if guards and key not in guards:
+                        continue
+                    if not (control or
+                            stmt_names & alive(tainted_names, line)):
+                        continue
+                    if f.waived("atomicity", line):
+                        continue
+                    sources = ", ".join(sorted(guarded_sources))
+                    findings.append(Finding(
+                        PASS_NAME, "atomicity", f.path, line,
+                        f"check-then-act: `{field}` is written here "
+                        f"under `{rb.lock}` based on a value read from "
+                        f"{sources} in the earlier `with {ra.lock}` "
+                        f"region at line {ra.start} — the lock was "
+                        f"released in between, so the decision may be "
+                        f"stale; merge the regions or re-validate "
+                        f"inside this one (waive with `# analyze: "
+                        f"allow(atomicity): reason`)"))
+                    break
+
+
+def _check_drift(f: SourceFile, models: "list[_ClassModel]",
+                 findings: "list[Finding]") -> None:
+    """Annotation cross-check: declared `# guards:` entries the
+    inference contradicts or finds dead."""
+    annotated, _ = collect_locks(f)
+    by_scope = {m.cls: m for m in models}
+    for info in annotated:
+        model = by_scope.get(info.cls)
+        if model is None:
+            continue
+        accesses = [a for a in model.effective_accesses()]
+        for field in sorted(info.guards):
+            if info.cls is not None and \
+                    field not in model.class_fields and \
+                    field not in model.mod_fields:
+                continue        # lock-annotation typo rule owns this
+            field_accs = [a for a in accesses if a.field == field]
+            writes = [a for a in field_accs if a.kind == "write"]
+            if f.waived("guard-drift", info.line):
+                continue
+            if not field_accs and info.cls is not None:
+                findings.append(Finding(
+                    PASS_NAME, "guard-drift", f.path, info.line,
+                    f"`# guards:` on {info.attr!r} names {field!r} but "
+                    f"the {'class' if info.cls else 'module'} has no "
+                    f"post-construction access to it — stale "
+                    f"annotation; delete or correct it"))
+                continue
+            locked = [a for a in writes if info.attr in a.held]
+            other = sorted({lock for a in writes
+                            for lock in a.held
+                            if lock != info.attr and
+                            lock in (model.lock_attrs |
+                                     model.mod_locks)})
+            if writes and not locked and other:
+                findings.append(Finding(
+                    PASS_NAME, "guard-drift", f.path, info.line,
+                    f"`# guards:` says {info.attr!r} guards {field!r} "
+                    f"but every guarded write of {field!r} holds "
+                    f"{', '.join(repr(o) for o in other)} instead — "
+                    f"annotation drift; correct the annotation"))
+
+
+def run(files: "list[SourceFile]") -> "list[Finding]":
+    findings: list[Finding] = []
+    for f in files:
+        models = _class_models(f)
+        for model in models:
+            _check_model(model, findings)
+        _check_drift(f, models, findings)
+    return findings
+
+
+# -- reconciliation graph (dynamic ⊆ static gate) ------------------------------
+
+# Aggressive call resolution for the SUPERSET graph the runtime
+# sanitizer reconciles against: beyond lock_discipline's self-methods /
+# same-file functions / singleton accessors, resolve METHOD calls by
+# unique name across every lock-bearing class tree-wide (ambiguous
+# names add edges to ALL candidates — over-approximation is sound for a
+# superset graph, which is never used for cycle detection).
+
+
+def all_lock_infos(files: "list[SourceFile]"
+                   ) -> "dict[str, list[LockInfo]]":
+    """Annotated + inferred locks per file, as LockInfos (inferred ones
+    carry empty guard sets) — the node universe of the reconciliation
+    graph."""
+    out: dict[str, list[LockInfo]] = {}
+    for f in files:
+        annotated, _ = collect_locks(f)
+        seen = {(l.cls, l.attr) for l in annotated}
+        locks = list(annotated)
+        for il in collect_inferred_locks(f):
+            if (il.cls, il.attr) not in seen:
+                locks.append(LockInfo(f.path, il.cls, il.attr, set(),
+                                      il.line))
+        if locks:
+            out[f.path] = locks
+    return out
+
+
+def registered_site_map(files: "list[SourceFile]") -> "dict[str, str]":
+    """sanitizers.register_lock site name -> static lock node id, read
+    straight off the registration call sites (the AST is the single
+    source of truth for the mapping the reconciliation test uses)."""
+    out: dict[str, str] = {}
+    for f in files:
+        for il in collect_inferred_locks(f):
+            if il.site_name:
+                out[il.site_name] = il.node_id
+    return out
+
+
+def reconciliation_graph(files: "list[SourceFile]") -> dict:
+    """The superset acquisition-order graph: every annotated + inferred
+    lock, edges from syntactic nesting plus a deep interprocedural
+    closure (self-methods, same-file functions, accessors from
+    lock_discipline, and tree-wide unique/ambiguous method-name
+    resolution into lock-bearing classes)."""
+    locks_by_file = all_lock_infos(files)
+    # Tree-wide method-name index over every class in a LOCK-BEARING
+    # file: name -> [(path, cls)].  Patches cross-file attribute calls
+    # like `self.hits_n.increment()` into profiling.Counter.increment —
+    # and non-lock classes of those files matter too (a Profiler has no
+    # lock itself, but Profiler.counter reaches the registry's).
+    method_index: dict[str, list] = {}
+    fn_index: dict[str, list] = {}
+    ctor_index: dict[str, list] = {}
+    for f in files:
+        if f.path not in locks_by_file:
+            continue
+        for node in f.tree.body:
+            if isinstance(node, ast.ClassDef):
+                ctor_index.setdefault(node.name, []).append(
+                    (f.path, node.name))
+        for cls, fn in walk_functions(f.tree):
+            if cls is not None:
+                method_index.setdefault(fn.name, []).append(
+                    (f.path, cls))
+            else:
+                fn_index.setdefault(fn.name, []).append((f.path, None))
+    edges = build_order_graph(files, locks_by_file,
+                              method_index=method_index,
+                              fn_index=fn_index,
+                              ctor_index=ctor_index)
+    return {
+        "locks": sorted(l.node_id for ls in locks_by_file.values()
+                        for l in ls),
+        "edges": sorted([a, b, f"{p}:{line}"]
+                        for (a, b), (p, line) in edges.items()),
+        "site_map": registered_site_map(files),
+    }

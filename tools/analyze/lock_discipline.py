@@ -346,6 +346,45 @@ def _resolve_callee(call: ast.Call, path: str,
     return None
 
 
+def _resolve_callees(call: ast.Call, path: str, cls: Optional[str],
+                     method_index=None, fn_index=None,
+                     ctor_index=None) -> "list[tuple]":
+    """All plausible call targets.  The precise resolution above, plus —
+    when the reconciliation indexes are supplied (guard_inference's
+    superset graph) — tree-wide METHOD-NAME resolution into lock-bearing
+    classes: `self.hits_n.increment()` maps to every lock-bearing class
+    defining `increment` (over-approximation is sound for a superset
+    graph; the precise cycle-checked graph never passes indexes)."""
+    precise = _resolve_callee(call, path, cls)
+    out = [precise] if precise is not None else []
+    if method_index is None and fn_index is None:
+        return out
+    fnode = call.func
+    name = dotted_name(fnode)
+    if method_index and isinstance(fnode, ast.Attribute) and \
+            precise is None:
+        for tpath, tcls in method_index.get(fnode.attr, ()):
+            out.append((tpath, tcls, fnode.attr))
+    if fn_index:
+        # Always consulted: a bare `get_accountant()` resolves same-file
+        # by the precise rule even when no such function exists there —
+        # the cross-file candidates must still be considered.
+        last = name.rsplit(".", 1)[-1]
+        for tpath, _tcls in fn_index.get(last, ()):
+            key = (tpath, None, last)
+            if key not in out:
+                out.append(key)
+    if ctor_index:
+        # Constructor calls: `WorkloadLog(...)` runs __init__ (which may
+        # create sensors and take the registry lock).
+        last = name.rsplit(".", 1)[-1]
+        for tpath, tcls in ctor_index.get(last, ()):
+            key = (tpath, tcls, "__init__")
+            if key not in out:
+                out.append(key)
+    return out
+
+
 def _direct_acquisitions(fn: ast.AST, cls_locks: "set[str]",
                          mod_locks: "set[str]"):
     """(lock_attr, line) for every with-acquisition anywhere in fn."""
@@ -358,10 +397,14 @@ def _direct_acquisitions(fn: ast.AST, cls_locks: "set[str]",
 
 
 def build_order_graph(files: "list[SourceFile]",
-                      locks_by_file: "dict[str, list[LockInfo]]"):
+                      locks_by_file: "dict[str, list[LockInfo]]",
+                      method_index=None, fn_index=None,
+                      ctor_index=None):
     """Edges A→B: lock B acquired while A is held — from syntactic
-    nesting, plus ONE level of call propagation (self-methods and
-    module functions in the same file, and the ACCESSORS singletons)."""
+    nesting, plus call propagation (self-methods and module functions
+    in the same file, and the ACCESSORS singletons; guard_inference's
+    reconciliation graph additionally passes tree-wide name indexes for
+    a deeper, over-approximate closure)."""
     # (path, cls, fn_name) -> [(lock_node_id, line)]; closure over
     # same-class self-calls so `get_x().outer()` sees inner locks too.
     fn_locks: dict[tuple, list] = {}
@@ -381,15 +424,17 @@ def build_order_graph(files: "list[SourceFile]",
                 acquired.append((lock.node_id, line))
             fn_locks[key] = acquired
             fn_calls[key] = [
-                callee for callee in
-                (_resolve_callee(c, f.path, cls)
-                 for c in ast.walk(fn) if isinstance(c, ast.Call))
-                if callee is not None]
+                callee
+                for c in ast.walk(fn) if isinstance(c, ast.Call)
+                for callee in _resolve_callees(c, f.path, cls,
+                                               method_index, fn_index,
+                                               ctor_index)]
 
-    # Fixpoint: a function's lock set includes its callees' (bounded).
+    # Fixpoint: a function's lock set includes its callees' (bounded —
+    # convergence breaks out early; the bound only caps pathology).
     closure: dict[tuple, set] = {k: {l for l, _ in v}
                                  for k, v in fn_locks.items()}
-    for _ in range(4):
+    for _ in range(16):
         changed = False
         for key, calls in fn_calls.items():
             mine = closure[key]
@@ -434,12 +479,14 @@ def build_order_graph(files: "list[SourceFile]",
                         acquired.append(nid)
                         held.append(nid)
                 elif isinstance(node, ast.Call) and held:
-                    callee = _resolve_callee(node, f.path, cls)
-                    for nid in closure.get(callee, ()) if callee else ():
-                        for h in held:
-                            if h != nid:
-                                edges.setdefault((h, nid),
-                                                 (f.path, node.lineno))
+                    for callee in _resolve_callees(node, f.path, cls,
+                                                   method_index,
+                                                   fn_index, ctor_index):
+                        for nid in closure.get(callee, ()):
+                            for h in held:
+                                if h != nid:
+                                    edges.setdefault(
+                                        (h, nid), (f.path, node.lineno))
                 for child in ast.iter_child_nodes(node):
                     visit(child)
                 del held[len(held) - len(acquired):len(held)]

@@ -345,11 +345,19 @@ def build_parser() -> argparse.ArgumentParser:
         (("--pass",), {"dest": "passes", "action": "append",
                        "default": None,
                        "help": "run only this pass (repeatable; "
-                               "default: all — locks, jax, coverage, "
-                               "errors, sensors)"}),
+                               "default: all — locks, guards, jax, "
+                               "coverage, errors, sensors; guards = "
+                               "annotation-free lock-guard inference + "
+                               "atomicity lint + annotation drift, "
+                               "rules guard-inference/guard-read/"
+                               "atomicity/guard-drift)"}),
         (("--json",), {"action": "store_true",
-                       "help": "machine-readable findings + ratchet "
-                               "verdict + lock-order graph"}),
+                       "help": "machine-readable findings (pass, rule, "
+                               "path, line, message, severity) + "
+                               "ratchet verdict + lock-order graph + "
+                               "the guards reconciliation graph "
+                               "(inferred locks, superset edges, "
+                               "sanitizer site map)"}),
         (("--update-baseline",), {"action": "store_true",
                                   "help": "rewrite tools/analyze/"
                                           "baseline.json to the current "

@@ -664,6 +664,44 @@ class FailpointsConfig(YsonStruct):
     seed = param(0, type=int)
 
 
+class SanitizerConfig(YsonStruct):
+    """Runtime concurrency sanitizer (utils/sanitizers.py): the
+    instrumented-lock layer recording held-lock sets, acquisition-order
+    edges, lock-order inversions, hold-budget violations, and blocking
+    operations under hot-path locks.  Disabled by default — the
+    registration helper then hands out PLAIN `threading.Lock`s (zero
+    wrappers, zero per-acquire cost; `bench.py --config
+    sanitizer_overhead` asserts it).  Enablement applies to locks
+    created AFTER `sanitizers.configure(cfg)` runs (or set
+    YT_TPU_SANITIZE=1 before the process constructs its daemons, the
+    tests/conftest pattern)."""
+
+    enabled = param(False, type=bool)
+    # A registered hot lock held longer than this is a violation
+    # (counted + bounded-reported, never fatal: the serving plane keeps
+    # serving while operators read /sanitizer).
+    hold_budget_seconds = param(0.25, type=float, ge=0.0)
+
+
+def sanitizer_config() -> SanitizerConfig:
+    return _sanitizer_config if _sanitizer_config is not None \
+        else SanitizerConfig()
+
+
+def set_sanitizer_config(config: "Optional[SanitizerConfig]") -> None:
+    """Install + APPLY a sanitizer config (None restores the defaults —
+    disabled — matching the other setters' convention; the env gate
+    YT_TPU_SANITIZE is independent and wins when set)."""
+    global _sanitizer_config
+    _sanitizer_config = config
+    from ytsaurus_tpu.utils import sanitizers
+    sanitizers.configure(config if config is not None
+                         else SanitizerConfig())
+
+
+_sanitizer_config: "Optional[SanitizerConfig]" = None
+
+
 class RpcConfig(YsonStruct):
     bind_host = param("127.0.0.1", type=str)
     port = param(0, type=int, ge=0, le=65535)
@@ -754,6 +792,7 @@ class DaemonConfig(YsonStruct):
     telemetry = param(type=TelemetryConfig)
     workload = param(type=WorkloadConfig)
     compile = param(type=CompileConfig)
+    sanitizer = param(type=SanitizerConfig)
 
     def postprocess(self):
         if self.role == "node" and self.chunk_store.replication_factor < 1:

@@ -290,6 +290,15 @@ class Master:
         return 0, data, {}          # pre-versioning format
 
     def _recover(self) -> None:
+        # Recovery mutates tree/tx state through the same _apply path
+        # as live mutations; holding the (re-entrant) mutation lock
+        # keeps the single-writer discipline uniform — construction is
+        # single-threaded, so this is contention-free, and a subclass
+        # or restart path re-running recovery stays safe.
+        with self._lock:
+            self._recover_locked()
+
+    def _recover_locked(self) -> None:
         local: "tuple[int, dict] | None" = None
         snap_path = os.path.join(self.root_dir, self.SNAPSHOT)
         if os.path.exists(snap_path):

@@ -179,6 +179,8 @@ def _read_counts(final) -> np.ndarray:
     overflow, max-cell) triple, fused-join programs the count plus the
     per-join quota-demand/actual telemetry block.  Returns a 1-D int64
     vector; callers index their layout."""
+    from ytsaurus_tpu.utils import sanitizers
+    sanitizers.note_host_sync("whole_plan._read_counts")
     vals = np.asarray(final)
     if vals.ndim == 0:
         return np.array([int(vals)], dtype=np.int64)

@@ -38,6 +38,7 @@ import threading
 from typing import Optional
 
 from ytsaurus_tpu.utils.profiling import PoolSensorCache, ProfilerRegistry
+from ytsaurus_tpu.utils import sanitizers
 
 # The usage schema: one cumulative float per field per (pool, user).
 USAGE_FIELDS = (
@@ -69,7 +70,9 @@ class ResourceAccountant:
     per-query/per-flush cost the `telemetry_overhead` bench bounds."""
 
     def __init__(self, registry: Optional[ProfilerRegistry] = None):
-        self._lock = threading.Lock()   # guards: _usage
+        # guards: _usage
+        self._lock = sanitizers.register_lock(
+            "accounting.ResourceAccountant._lock")
         self._usage: dict[tuple[str, str], UsageRecord] = {}
         self._pool_sensors = PoolSensorCache(
             "/accounting/usage", USAGE_FIELDS, registry=registry)
@@ -184,7 +187,8 @@ class ResourceAccountant:
 
 
 _global_accountant: Optional[ResourceAccountant] = None
-_lock = threading.Lock()   # guards: _global_accountant
+# guards: _global_accountant
+_lock = sanitizers.register_lock("accounting._lock", hot=False)
 
 
 def get_accountant() -> ResourceAccountant:

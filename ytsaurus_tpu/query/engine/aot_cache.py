@@ -35,6 +35,7 @@ import jax
 
 from ytsaurus_tpu.utils.logging import get_logger
 from ytsaurus_tpu.utils.profiling import Profiler
+from ytsaurus_tpu.utils import sanitizers
 
 logger = get_logger("AotCache")
 
@@ -60,8 +61,10 @@ class DiskCompileCache:
         self._capacity_bytes = config.disk_cache_capacity_bytes
         self._min_seconds = config.disk_cache_min_compile_seconds
         # guards: bytes_n, files_n (gauge mirrors), eviction scans;
-        # load/store file I/O itself is atomic-per-file (tmp+replace)
-        self._lock = threading.Lock()
+        # load/store file I/O itself is atomic-per-file (tmp+replace).
+        # hot=False: this lock intentionally covers disk scans.
+        self._lock = sanitizers.register_lock(
+            "aot_cache.DiskCompileCache._lock", hot=False)
         self.hits_n = 0
         self.misses_n = 0
         self.errors_n = 0
@@ -257,7 +260,9 @@ class DiskCompileCache:
 
 _cache: Optional[DiskCompileCache] = None
 _cache_dir: Optional[str] = None
-_cache_lock = threading.Lock()     # guards: _cache, _cache_dir
+# guards: _cache, _cache_dir
+_cache_lock = sanitizers.register_lock("aot_cache._cache_lock",
+                                       hot=False)
 
 
 def get_disk_cache() -> Optional[DiskCompileCache]:

@@ -27,6 +27,7 @@ from ytsaurus_tpu.query.engine.lowering import prepare
 from ytsaurus_tpu.query.statistics import QueryStatistics
 from ytsaurus_tpu.schema import EValueType, TableSchema
 from ytsaurus_tpu.utils.profiling import PoolSensorCache, Profiler
+from ytsaurus_tpu.utils import sanitizers
 
 # Process-wide compile-cache counters, tagged by the admitted query's
 # pool (identity rides the CancellationToken): the steady-state
@@ -66,7 +67,8 @@ class CompileObservatory:
 
     def __init__(self):
         # guards: _fps, _artifacts, _evicted, hits_n, misses_n, evictions_n
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock(
+            "evaluator.CompileObservatory._lock")
         self._fps: dict[str, dict] = {}
         self._artifacts: deque = deque(maxlen=64)
         # Bounded memory of evicted program keys: a re-miss on one is
@@ -246,6 +248,13 @@ class _PendingResult:
     def finish(self, host_count: Optional[int] = None) -> ColumnarChunk:
         import time as _time
         if self._chunk is None:
+            if host_count is None:
+                # The sanctioned host-sync point (jax pass): int(count)
+                # below blocks on a device→host read — the sanitizer
+                # flags it when it runs under a registered hot lock.
+                # With host_count supplied, finish_all already did ONE
+                # stacked transfer for the batch (noted there).
+                sanitizers.note_host_sync("evaluator.finish")
             n = int(self.count if host_count is None else host_count)
             out_columns: dict[str, Column] = {}
             out_schema_cols = []
@@ -284,6 +293,9 @@ def finish_all(pendings: Sequence) -> list[ColumnarChunk]:
              if isinstance(p, _PendingResult) and p._chunk is None]
     host: dict[int, int] = {}
     if len(open_) > 1:
+        # The one stacked transfer happens HERE; a single open pending
+        # falls through to finish(), which notes its own sync.
+        sanitizers.note_host_sync("evaluator.finish_all")
         counts = np.asarray(jnp.stack([p.count for p in open_]))
         host = {id(p): int(c) for p, c in zip(open_, counts)}
     return [p.finish(host_count=host.get(id(p))) for p in pendings]
@@ -301,7 +313,8 @@ class Evaluator:
         # eviction (compiles themselves run outside the lock).
         self._cache: OrderedDict = OrderedDict()
         # guards: _cache, _inflight
-        self._cache_lock = threading.Lock()
+        self._cache_lock = sanitizers.register_lock(
+            "evaluator.Evaluator._cache_lock")
         # Single-flight compilation (ISSUE 10): concurrent dispatches
         # missing on the SAME key elect one compiler; the rest wait on
         # its event and take the cached program — a cold shape under an

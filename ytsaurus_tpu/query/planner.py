@@ -51,11 +51,13 @@ from dataclasses import dataclass, replace as dc_replace
 from typing import Mapping, Optional, Sequence
 
 from ytsaurus_tpu.query import ir
+from ytsaurus_tpu.utils import sanitizers
 
 # Per-chunk stats memo: cost-based planning must not re-scan a chunk it
 # already measured (the join-host memo discipline of distributed.py).
 # Keyed by object identity with a liveness check; finalizers evict.
-_stats_lock = threading.Lock()   # guards: _stats_memo
+# guards: _stats_memo
+_stats_lock = sanitizers.register_lock("planner._stats_lock")
 _stats_memo: dict = {}
 _STATS_MEMO_LIMIT = 512
 

@@ -58,6 +58,7 @@ from ytsaurus_tpu.query.accounting import get_accountant
 from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.profiling import Profiler
 from ytsaurus_tpu.utils.tracing import NULL_SPAN, child_span, current_trace
+from ytsaurus_tpu.utils import sanitizers
 
 _FP_ADMIT = failpoints.register_site(
     "serving.admit",
@@ -186,7 +187,9 @@ class AdmissionController:
 
     def __init__(self, config: ServingConfig):
         self.config = config
-        self._cond = threading.Condition()  # guards: _pools, _hold_ewma
+        # guards: _pools, _hold_ewma
+        self._cond = sanitizers.register_condition(
+            "serving.AdmissionController._cond")
         serving_profiler = Profiler("/serving")
         profiler = serving_profiler.with_prefix("/admission")
         pools = config.pools or {config.default_pool: 1.0}
@@ -400,7 +403,8 @@ class LookupBatcher:
         self._flush_executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="serving-flush")
         # guards: _batches, _contexts, _flusher, requests_n, batches_n, batched_keys_n
-        self._cond = threading.Condition()
+        self._cond = sanitizers.register_condition(
+            "serving.LookupBatcher._cond")
         self._batches: "dict[tuple, _Batch]" = {}
         self._contexts: dict[str, _PathContext] = {}
         self._flusher: Optional[threading.Thread] = None

@@ -54,6 +54,7 @@ from ytsaurus_tpu.schema import EValueType, TableSchema
 from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.profiling import Profiler
 from ytsaurus_tpu.utils.tracing import child_span
+from ytsaurus_tpu.utils import sanitizers
 
 VIEWS_ROOT = "//sys/views"
 
@@ -529,7 +530,13 @@ class ViewRefresher:
         self._batch_capacity = pad_capacity(spec.batch_rows)
         # The refresher's single-writer discipline: one refresh (the
         # read-merge-write critical section) at a time.
-        self._lock = threading.Lock()   # guards: _last_result
+        # hot=False: this mutex COVERS the read-merge-write refresh
+        # critical section — query execution, 2PC commit, the works —
+        # by design (single-writer per view); hold-budget and
+        # blocking-op rules don't apply to a coarse section lock.
+        # guards: _last_result
+        self._lock = sanitizers.register_lock(
+            "views.ViewRefresher._lock", hot=False)
         self._last_result: Optional[BatchResult] = None
         prof = Profiler("/views").with_tags(view=spec.name)
         self._s_batches = prof.counter("batches")

@@ -52,6 +52,7 @@ from typing import Optional, Sequence
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.query.parameterize import hoist_literals
 from ytsaurus_tpu.utils.profiling import Profiler
+from ytsaurus_tpu.utils import sanitizers
 
 # Bump when the record shape changes incompatibly: `load_capture` (and
 # the on-disk log reader) refuse mismatched captures LOUDLY instead of
@@ -199,7 +200,8 @@ class WorkloadLog:
     def __init__(self, config=None):
         self._config = config
         # guards: _records, _fingerprints, recorded_n, sampled_out_n, fingerprints_dropped_n
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock(
+            "workload.WorkloadLog._lock")
         # Disk appends take their own lock: the in-memory fold must
         # never queue behind rotation/write I/O of the on-disk tier.
         self._io_lock = threading.Lock()
@@ -720,7 +722,8 @@ def replay(client, records: Sequence[WorkloadRecord],
 # -- globals -------------------------------------------------------------------
 
 _global_log: Optional[WorkloadLog] = None
-_log_lock = threading.Lock()     # guards: _global_log
+# guards: _global_log
+_log_lock = sanitizers.register_lock("workload._log_lock", hot=False)
 
 
 def get_workload_log() -> WorkloadLog:

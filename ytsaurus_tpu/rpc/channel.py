@@ -23,6 +23,7 @@ from ytsaurus_tpu.rpc.server import error_from_wire
 from ytsaurus_tpu.rpc.wire import decode_body, encode_body
 from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.logging import get_logger
+from ytsaurus_tpu.utils import sanitizers
 
 logger = get_logger("rpc")
 
@@ -40,7 +41,8 @@ _FP_CONNECT = failpoints.register_site(
     "rpc.channel.connect",
     error=lambda s: ConnectionError(f"injected connect failure at {s}"))
 
-_loop_lock = threading.Lock()   # guards: _loop
+# guards: _loop
+_loop_lock = sanitizers.register_lock("channel._loop_lock", hot=False)
 _loop: asyncio.AbstractEventLoop | None = None
 
 
@@ -76,7 +78,8 @@ class Channel:
         self._host, self._port = host, int(port)
         self.timeout = timeout
         self._rid = itertools.count(1)
-        self._lock = threading.Lock()   # guards: _conn
+        # guards: _conn
+        self._lock = sanitizers.register_lock("channel.Channel._lock")
         self._connect_lock: asyncio.Lock | None = None
         self._conn: _ConnState | None = None
 

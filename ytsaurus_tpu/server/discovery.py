@@ -17,6 +17,7 @@ from typing import Optional
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.rpc import Service, rpc_method
 from ytsaurus_tpu.rpc.wire import wire_text as _text
+from ytsaurus_tpu.utils import sanitizers
 
 # Every telemetry-bearing daemon self-registers here (member address =
 # its MONITORING endpoint): the primary's /cluster roll-up lists this
@@ -50,7 +51,9 @@ class DiscoveryTracker:
     def __init__(self, member_ttl: float = 15.0):
         self.member_ttl = member_ttl
         self._groups: dict[str, dict[str, dict]] = {}
-        self._lock = threading.Lock()   # guards: _groups
+        # guards: _groups
+        self._lock = sanitizers.register_lock(
+            "discovery.DiscoveryTracker._lock")
 
     @staticmethod
     def _check_group(group: str) -> str:

@@ -52,7 +52,11 @@ class MasterCacheService(Service):
     # surface forwards verbatim so a thin client pointed at the cache
     # keeps its full API (the docstring's contract).
     def _forward(self, method: str, body, attachments):
-        self.stats["forwarded"] += 1
+        # RPC methods dispatch concurrently (execute runs at
+        # concurrency=16): the tally must ride the cache lock like the
+        # hit/miss counters, or increments are lost under contention.
+        with self._lock:
+            self.stats["forwarded"] += 1
         return self._channel.call("driver", method, body, attachments,
                                   idempotent=False)
 
@@ -82,7 +86,8 @@ class MasterCacheService(Service):
         parameters = body.get("parameters") or {}
         user = _text(body.get("user") or "root")
         if command not in CACHEABLE_COMMANDS or attachments:
-            self.stats["forwarded"] += 1
+            with self._lock:
+                self.stats["forwarded"] += 1
             return self._channel.call(
                 "driver", "execute", body, attachments,
                 idempotent=not _is_mutating(command))

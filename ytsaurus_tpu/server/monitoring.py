@@ -132,6 +132,17 @@ class MonitoringServer:
                 "sites": failpoints.counters(),
             }, indent=2).encode()
             self._reply(request, 200, body, "application/json")
+        elif path == "/sanitizer":
+            # Concurrency sanitizer (ISSUE 15): the bounded live report
+            # of the instrumented-lock layer — observed acquisition
+            # edges, lock-order inversions, hold-budget violations, and
+            # blocking ops under hot locks (counters mirror on /metrics
+            # as sanitizer_*).  {"enabled": false} when the sanitizer
+            # is off (the production default).
+            from ytsaurus_tpu.utils import sanitizers
+            body = json.dumps(sanitizers.snapshot(), indent=2,
+                              default=_json_default).encode()
+            self._reply(request, 200, body, "application/json")
         elif path == "/serving":
             # Query serving plane (query/serving.py): per-pool admission
             # state + lookup batching counters of every live gateway in

@@ -158,6 +158,10 @@ def default_orchid(config=None) -> OrchidTree:
     # twin of the monitoring /views endpoint (`yt view list` could read
     # this remotely when no driver is reachable).
     tree.register("/views", _views_producer)
+    # Concurrency sanitizer (ISSUE 15): the RPC twin of the monitoring
+    # /sanitizer endpoint — observed lock-order edges + violation
+    # report of the instrumented-lock layer.
+    tree.register("/sanitizer", _sanitizer_producer)
     return tree
 
 
@@ -205,3 +209,8 @@ def _compile_producer() -> dict:
 def _views_producer() -> dict:
     from ytsaurus_tpu.server.view_daemon import views_snapshot
     return {"daemons": views_snapshot()}
+
+
+def _sanitizer_producer() -> dict:
+    from ytsaurus_tpu.utils import sanitizers
+    return sanitizers.snapshot()

@@ -38,6 +38,7 @@ from ytsaurus_tpu.query.views import (
     view_status,
 )
 from ytsaurus_tpu.utils.profiling import Profiler
+from ytsaurus_tpu.utils import sanitizers
 
 _DAEMONS: "weakref.WeakSet[ViewDaemon]" = weakref.WeakSet()
 
@@ -52,7 +53,9 @@ class ViewDaemon:
         self.client = client
         self._config = config
         self._evaluator = evaluator
-        self._lock = threading.Lock()   # guards: _refreshers, _stats
+        # guards: _refreshers, _stats
+        self._lock = sanitizers.register_lock(
+            "view_daemon.ViewDaemon._lock")
         self._refreshers: dict[str, ViewRefresher] = {}
         self._stats: dict[str, dict] = {}
         self._stop = threading.Event()

@@ -98,6 +98,7 @@ class SortedDynamicStore:
             keys = list(self._sorted_keys)
         for sk in keys:
             key = _null_unsafe(sk)
+            # analyze: allow(guard-read): intentional lock-free read — the key list was snapshotted under the lock, version lists are append-only, and MVCC timestamp filtering tolerates a torn tail
             yield key, self._rows[key]
 
     @property

@@ -34,6 +34,7 @@ from ytsaurus_tpu.schema import EValueType, SortOrder, TableSchema
 from ytsaurus_tpu.tablet import mvcc
 from ytsaurus_tpu.tablet.dynamic_store import SortedDynamicStore
 from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+from ytsaurus_tpu.utils import sanitizers
 
 # Process-wide snapshot-cache sensors (rendered on /metrics as
 # tablet_snapshot_cache_*; the structured view is monitoring /tablet).
@@ -47,7 +48,8 @@ _SNAP_BYTES = _snap_profiler.gauge("bytes_pinned")
 # admitted cohort's pool down to the tablet read, so per-tenant resource
 # accounting sees tablet-level consumption, not just gateway-level.
 _lookup_counters = PoolSensorCache("tablet/lookup", ("reads", "keys"))
-_snap_lock = threading.Lock()   # guards: _snap_bytes_pinned
+# guards: _snap_bytes_pinned
+_snap_lock = sanitizers.register_lock("tablet._snap_lock")
 _snap_bytes_pinned = 0
 
 
@@ -122,7 +124,8 @@ class Tablet:
         self.in_memory = False          # pin chunks in the cache when True
         self.flush_generation = 0
         # guards: active_store, passive_stores, chunk_ids, flush_generation, _snapshot_cache, _host_planes, _row_cache, _row_cache_gen
-        self._lock = threading.RLock()
+        self._lock = sanitizers.register_rlock("tablet.Tablet._lock",
+                                               hot=False)
         # Host numpy views of chunk planes: a real LRU (promote on hit,
         # capacity from TabletConfig.host_plane_cache_capacity).
         self._host_planes: "OrderedDict[str, dict]" = OrderedDict()

@@ -55,6 +55,7 @@ import time
 from typing import Callable, Optional
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils import sanitizers
 
 MODES = ("error", "delay", "crash-once", "torn-write")
 
@@ -110,7 +111,8 @@ class _State:
 
 # The ONE global read on the disabled fast path.
 _STATE: Optional[_State] = None
-_LOCK = threading.Lock()   # guards: _STATE, _SITES
+# guards: _STATE, _SITES
+_LOCK = sanitizers.register_lock("failpoints._LOCK", hot=False)
 _SITES: "dict[str, FailpointSite]" = {}
 
 
@@ -186,6 +188,10 @@ class FailpointSite:
     def hit(self) -> None:
         """Generic probe: may sleep (delay), raise the site's error
         (error), or raise InjectedCrash (crash-once)."""
+        # Failpoint sites ARE the statically-enforced I/O boundary list
+        # (the coverage pass): the concurrency sanitizer reuses them as
+        # its blocking-I/O probes — one global read when disabled.
+        sanitizers.note_blocking("io", self.name)
         if _STATE is None:      # disabled fast path: one global read
             return
         act = self.fire()
@@ -204,6 +210,7 @@ class FailpointSite:
         caller must write `payload` (a truncated prefix) to its STAGING
         location and then fail the write WITHOUT publishing — simulating
         a crash mid-write."""
+        sanitizers.note_blocking("io", self.name)
         if _STATE is None:
             return blob, False
         act = self.fire(write=True)
@@ -332,6 +339,7 @@ def active(spec: str, seed: int = 0):
         yield
     finally:
         with _LOCK:
+            # analyze: allow(atomicity): scoped save/restore by design — prev IS the value to restore; concurrent activation scopes are a test-harness misuse, not a race this code defends against
             _STATE = prev
 
 

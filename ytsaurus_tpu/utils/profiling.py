@@ -21,6 +21,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from ytsaurus_tpu.utils import sanitizers
+
 
 def _escape_label_value(value) -> str:
     """Prometheus exposition escaping for label values: backslash,
@@ -49,7 +51,8 @@ class Counter:
     kind = "counter"
 
     def __init__(self):
-        self._lock = threading.Lock()   # guards: _value
+        # guards: _value
+        self._lock = sanitizers.register_lock("profiling.Counter._lock")
         self._value = 0.0
 
     def increment(self, delta: float = 1.0) -> None:
@@ -103,7 +106,7 @@ class Summary:
 
     def __init__(self):
         # guards: count, sum, min, max, last, _reservoir
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock("profiling.Summary._lock")
         self.count = 0
         self.sum = 0.0
         self.min = float("inf")
@@ -154,7 +157,9 @@ class Histogram:
 
     def __init__(self, bounds=None):
         self.bounds = tuple(bounds or self.DEFAULT_BOUNDS)
-        self._lock = threading.Lock()   # guards: buckets, count, sum
+        # guards: buckets, count, sum
+        self._lock = sanitizers.register_lock(
+            "profiling.Histogram._lock")
         self.buckets = [0] * (len(self.bounds) + 1)
         self.count = 0
         self.sum = 0.0
@@ -200,7 +205,9 @@ class ProfilerRegistry:
     """All sensors of one process, keyed by (name, frozen tags)."""
 
     def __init__(self):
-        self._lock = threading.Lock()   # guards: _sensors
+        # guards: _sensors
+        self._lock = sanitizers.register_lock(
+            "profiling.ProfilerRegistry._lock")
         self._sensors: dict[tuple, object] = {}
 
     def _get(self, name: str, tags: dict, factory):
@@ -327,7 +334,9 @@ class MetricsHistory:
         self.coarse_every = max(coarse_every, 1)
         self.coarse_capacity = coarse_capacity
         self.sample_period = sample_period
-        self._lock = threading.Lock()   # guards: _series, samples_taken
+        # guards: _series, samples_taken
+        self._lock = sanitizers.register_lock(
+            "profiling.MetricsHistory._lock")
         self._series: dict[tuple, _SeriesRing] = {}
         self.samples_taken = 0
 
@@ -496,7 +505,9 @@ class TelemetrySampler:
 
 _global_history: Optional[MetricsHistory] = None
 _global_sampler: Optional[TelemetrySampler] = None
-_history_lock = threading.Lock()   # guards: _global_history, _global_sampler
+# guards: _global_history, _global_sampler
+_history_lock = sanitizers.register_lock("profiling._history_lock",
+                                         hot=False)
 
 
 def get_history() -> MetricsHistory:

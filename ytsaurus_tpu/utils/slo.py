@@ -27,6 +27,7 @@ from collections import deque
 from typing import Optional
 
 from ytsaurus_tpu.utils.profiling import MetricsHistory, get_history
+from ytsaurus_tpu.utils import sanitizers
 
 
 class SloTracker:
@@ -41,7 +42,7 @@ class SloTracker:
         self._config = config
         self._history = history
         # guards: _active, _resolved, _last_eval
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock("slo.SloTracker._lock")
         self._active: dict[str, dict] = {}
         self._resolved: deque = deque(maxlen=self.RESOLVED_CAPACITY)
         self._last_eval: dict[str, dict] = {}
@@ -161,7 +162,9 @@ class SloTracker:
 
 
 _global_tracker: Optional[SloTracker] = None
-_tracker_lock = threading.Lock()   # guards: _global_tracker
+# guards: _global_tracker
+_tracker_lock = sanitizers.register_lock("slo._tracker_lock",
+                                         hot=False)
 
 
 def get_slo_tracker() -> SloTracker:

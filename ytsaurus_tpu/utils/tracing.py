@@ -43,6 +43,7 @@ import random
 import threading
 import time
 from typing import Any, Optional
+from ytsaurus_tpu.utils import sanitizers
 
 # Id generation: a per-process random prefix + an atomic counter (the
 # `itertools.count` step is GIL-atomic).  uuid4 costs ~16µs per call in
@@ -119,7 +120,8 @@ class SpanCollector:
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
         # guards: _spans, _seq, _drained, _hists, capacity
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock(
+            "tracing.SpanCollector._lock")
         self._spans: list[SpanRecord] = []
         self._seq = 0                  # spans ever added
         self._drained = 0              # seq consumed by drain()
