@@ -1649,7 +1649,124 @@ def bench_sanitizer_overhead(n_rows, iters):
             best)
 
 
+def bench_vector(n_rows, iters):
+    """Vector similarity serving (ISSUE 16): the batched NEAREST kernel
+    — ONE `(batch, dim) @ (dim, rows)` distance matmul + per-row top-k
+    — swept over (dim × k × batch) on the n_rows-vector corpus, plus an
+    8-device whole-plan NEAREST leg in a child process (the mesh path:
+    per-shard top-k, one gather, exactly one host sync).
+
+    Per-point lines report queries/s and vectors-scanned/s (the batch
+    amortization story: batch=64 should scan ~an order of magnitude
+    more vectors/s than batch=1 because the matmul reuses the corpus
+    plane across the batch dimension).  The emitted metric is
+    vectors-scanned/s at the serving sweet spot (dim=256, k=8,
+    batch=64)."""
+    import subprocess as _subprocess
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ytsaurus_tpu.query.vector import _nearest_jit
+
+    rng = np.random.default_rng(3)
+    valid = jnp.ones(n_rows, dtype=bool)
+    headline = None
+    for dim in (64, 256):
+        plane = jnp.asarray(
+            rng.standard_normal((n_rows, dim), dtype=np.float32))
+        for k in (8, 64):
+            for batch in (1, 16, 64):
+                q = jnp.asarray(rng.standard_normal(
+                    (batch, dim), dtype=np.float32))
+                vals, idx = _nearest_jit(plane, valid, q,
+                                         metric="l2", k_static=k)
+                _sync(vals)              # warm-up / compile
+                times = []
+                while _iters_left(times, iters):
+                    t0 = time.perf_counter()
+                    vals, idx = _nearest_jit(plane, valid, q,
+                                             metric="l2", k_static=k)
+                    _sync(vals)
+                    times.append(time.perf_counter() - t0)
+                best = min(times)
+                qps = batch / best
+                scanned = n_rows * batch / best
+                print(f"# vector dim={dim} k={k} batch={batch}: "
+                      f"{qps:,.0f} queries/s, "
+                      f"{scanned:,.0f} vectors-scanned/s",
+                      file=sys.stderr)
+                if dim == 256 and k == 8 and batch == 64:
+                    headline = (scanned, best)
+
+    # 8-device leg: the fused whole-plan NEAREST (distributed tentpole
+    # path) in a child with a virtual 8-device CPU mesh.
+    n_child = min(n_rows, 200_000)
+    child_src = f"""
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import time
+import numpy as np
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.parallel.mesh import make_mesh
+from ytsaurus_tpu.parallel.distributed import (
+    DistributedEvaluator, ShardedTable, host_sync_count)
+from ytsaurus_tpu.parallel.whole_plan import run_whole_plan
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.schema import TableSchema
+
+DIM = 64
+N = {n_child}
+per = N // 8
+schema = TableSchema.make([("k", "int64"), ("emb", f"vector<float, 64>")])
+rng = np.random.default_rng(5)
+chunks = []
+for s in range(8):
+    rows = [dict(k=s * per + i, emb=[float(x) for x in v])
+            for i, v in enumerate(rng.standard_normal((per, DIM)))]
+    chunks.append(ColumnarChunk.from_rows(schema, rows))
+mesh = make_mesh(8)
+table = ShardedTable.from_chunks(mesh, chunks)
+ev = DistributedEvaluator(mesh)
+plan = build_query("k FROM [//t] NEAREST(emb, ?, 8)", {{"//t": schema}},
+                   params=[[float(x) for x in rng.standard_normal(DIM)]])
+run_whole_plan(ev, plan, table)          # warm-up / compile
+s0 = host_sync_count()
+t0 = time.perf_counter()
+ITERS = 5
+for _ in range(ITERS):
+    out = run_whole_plan(ev, plan, table)
+elapsed = time.perf_counter() - t0
+assert host_sync_count() - s0 == ITERS, "fused NEAREST must be 1 sync/query"
+assert len(out.to_rows()) == 8
+print(f"CHILD {{ITERS / elapsed:.1f}} {{N * ITERS / elapsed:.0f}}")
+"""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    proc = _subprocess.run([sys.executable, "-c", child_src],
+                           capture_output=True, text=True, env=env,
+                           timeout=600)
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHILD "):
+            _, qps8, scanned8 = line.split()
+            print(f"# vector spmd-8dev dim=64 k=8 batch=1: "
+                  f"{float(qps8):,.1f} queries/s, "
+                  f"{float(scanned8):,.0f} vectors-scanned/s "
+                  f"(1 host sync/query, asserted)", file=sys.stderr)
+            break
+    else:
+        raise RuntimeError(
+            f"vector SPMD child failed:\n{proc.stderr[-2000:]}")
+
+    scanned, best = headline
+    return "vector_scan_rows_per_sec", scanned, best
+
+
 _CONFIGS = {
+    "vector": (bench_vector, 4_000_000, 200_000),
     "q1": (bench_q1, 64_000_000, 2_000_000),
     "groupby": (bench_groupby, 64_000_000, 2_000_000),
     "topk": (bench_topk, 64_000_000, 2_000_000),
@@ -1791,6 +1908,7 @@ _METRIC_NAMES = {
     "multiway_join": "multiway_join_rows_per_sec",
     "matview": "matview_rows_per_sec",
     "sanitizer_overhead": "sanitizer_acquires_per_sec",
+    "vector": "vector_scan_rows_per_sec",
 }
 
 

@@ -1,14 +1,18 @@
 """Multiway join fusion + the cost-based planner (ISSUE 14).
 
-Quick tier-1 coverage: the fused-join dual-check corpus (3/4-way plans,
-broadcast + partition strategies, LEFT joins, null and string keys)
-against the local evaluator with exactly one steady host sync; planner
-units (selectivity order, dependency + LEFT barriers, broadcast
-threshold, semi-join pushdown); skew-driven quota overflow escalation +
-memoization; the AOT disk tier across an in-process AND a cross-process
-restart; the NDV sketch (accuracy, merge, bounded payload, decode
-backfill); EXPLAIN ANALYZE join-plan rendering; and client-side shard
-pruning through pushed-down join key ranges.
+Quick tier-1 coverage: the fused-join dual-check over one
+representative per strategy/stage shape (CORPUS_QUICK — the 4-way
+broadcast+partition+string plan, LEFT broadcast, window-after-join,
+cardinality front) against the local evaluator with exactly one steady
+host sync; planner units (selectivity order, dependency + LEFT
+barriers, broadcast threshold, semi-join pushdown); the stats-drift
+recompile; the join degradation ladder; the NDV sketch (accuracy,
+merge, bounded payload, decode backfill); EXPLAIN ANALYZE join-plan
+rendering; and client-side shard pruning through pushed-down join key
+ranges.  The FULL corpus sweep, skew-driven quota overflow escalation,
+and the cross-process AOT restart leg run under `slow` so the quick
+pass fits the tier-1 870s budget (sibling quick coverage: whole-plan
+quota memo + disk-tier tests in test_whole_plan.py).
 """
 
 import os
@@ -65,6 +69,15 @@ CORPUS = [
     "GROUP BY d_w ORDER BY d_w LIMIT 100",
 ]
 
+# Quick-tier subset: one representative per strategy/stage shape — the
+# 4-way plan exercises broadcast + partition + string-broadcast edges
+# in one program, plus LEFT broadcast, window-after-join, and the
+# cardinality exchange-rows front.  Each corpus query costs a full
+# 8-device shard_map compile (~6s on CPU); the 2/3-way and LEFT
+# partition variants those subsume run in the `slow` full sweep
+# (test_multiway_dual_check_corpus_full).
+CORPUS_QUICK = [CORPUS[3], CORPUS[4], CORPUS[6], CORPUS[7]]
+
 
 @pytest.fixture(autouse=True)
 def _fresh_compile_config():
@@ -114,9 +127,7 @@ def _canon(rows):
                   for r in rows)
 
 
-def test_multiway_dual_check_corpus(mw_tables):
-    """Fused multiway joins vs the local evaluator over the corpus,
-    with exactly ONE steady-state host sync per fused query."""
+def _dual_check(mw_tables, corpus):
     from ytsaurus_tpu.parallel.distributed import (
         DistributedEvaluator,
         host_sync_count,
@@ -125,7 +136,7 @@ def test_multiway_dual_check_corpus(mw_tables):
     mesh, _chunks, table, merged, foreign = mw_tables
     de = DistributedEvaluator(mesh)
     local = Evaluator()
-    for query in CORPUS:
+    for query in corpus:
         plan = build_query(query, SCHEMAS)
         assert can_fuse(plan) is None, query
         stats = QueryStatistics()
@@ -139,6 +150,20 @@ def test_multiway_dual_check_corpus(mw_tables):
         got2 = run_whole_plan(de, plan, table, foreign_chunks=foreign)
         assert host_sync_count() - s0 == 1, query
         assert _canon(got2.to_rows()) == _canon(want.to_rows()), query
+
+
+def test_multiway_dual_check_corpus(mw_tables):
+    """Fused multiway joins vs the local evaluator over the quick
+    shape-representative corpus, with exactly ONE steady-state host
+    sync per fused query."""
+    _dual_check(mw_tables, CORPUS_QUICK)
+
+
+@pytest.mark.slow
+def test_multiway_dual_check_corpus_full(mw_tables):
+    """The full strategy-mix corpus — minutes-long variant of
+    test_multiway_dual_check_corpus."""
+    _dual_check(mw_tables, CORPUS)
 
 
 def test_join_ladder_serves_fused_and_degrades(mw_tables):
@@ -246,6 +271,7 @@ def test_planner_broadcast_threshold_and_pushdown():
     assert jp.decisions[0].strategy == "partition"
 
 
+@pytest.mark.slow
 def test_quota_overflow_escalation_and_memo(request):
     """Skewed join keys overflow the optimistic quotas: the query
     re-runs at the demanded rung (correct results) and the settled
@@ -346,6 +372,7 @@ def test_stats_drift_flips_strategy_new_program(request):
         local.run_plan(plan, merged, {"//d": grown}).to_rows())
 
 
+@pytest.mark.slow
 def test_fused_join_cross_process_aot_restart(mw_tables, tmp_path):
     """ISSUE 14 acceptance: compile the fused multiway-join program in
     THIS process; a SECOND process over the same artifact dir serves

@@ -458,3 +458,98 @@ def test_distributed_shape_fingerprints(tpu_mesh=None):
         "second constant must not grow the SPMD program cache"
     assert {r["v"] for r in r2.to_rows()} == {0, 1, 2, 3, 4, 5}
     assert {r["v"] for r in r1.to_rows()} == {0, 1, 2}
+
+
+# -- NEAREST shapes (ISSUE 16 satellite): one program per k-bucket -------------
+
+VDIM = 8
+VSCHEMA = TableSchema.make(
+    [("k", "int64"), ("emb", f"vector<float, {VDIM}>")])
+
+
+def _vchunk(n=64, seed=0):
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    rng = np.random.default_rng(seed)
+    return ColumnarChunk.from_rows(VSCHEMA, [
+        {"k": i, "emb": [float(x) for x in rng.integers(-5, 6, VDIM)]}
+        for i in range(n)])
+
+
+def _vplan(k, vec, metric="l2"):
+    return build_query(
+        f"k FROM [//t] NEAREST(emb, ?, {k}, '{metric}')",
+        {"//t": VSCHEMA}, params=[list(vec)])
+
+
+def test_nearest_fingerprint_stable_across_query_vectors():
+    """The query vector is a hoisted runtime binding: distinct vectors
+    share one plan-shape fingerprint, and k's within one pow2 bucket
+    share it too (k rides the LIMIT bucket)."""
+    fa = pz.plan_fingerprint(_vplan(7, [1.0] * VDIM))
+    fb = pz.plan_fingerprint(_vplan(8, [-3.0, 2.0] * (VDIM // 2)))
+    assert fa == fb
+    # Bucket edge: k=9 is the 16-bucket — a different program.
+    assert fa != pz.plan_fingerprint(_vplan(9, [1.0] * VDIM))
+    # Metric changes the distance fn — a different shape.
+    assert fa != pz.plan_fingerprint(_vplan(7, [1.0] * VDIM, "dot"))
+
+
+def test_nearest_compile_once_across_vectors_and_k():
+    """ISSUE 16 satellite acceptance: NEAREST over distinct query
+    vectors and k in 1..64 compiles ONE program per (table-shape,
+    k-bucket) — 7 buckets, not 64x programs."""
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    rng = np.random.default_rng(42)
+    chunk = _vchunk(64, seed=1)
+    ev = Evaluator()
+    stats = QueryStatistics()
+    for k in range(1, 65):
+        vec = [float(x) for x in rng.integers(-5, 6, VDIM)]
+        out = ev.run_plan(_vplan(k, vec), chunk, stats=stats)
+        assert len(out.to_rows()) == k
+    # k in 1..64 spans buckets {1,2,4,8,16,32,64}: exactly 7 compiles.
+    assert stats.compile_count == 7, stats.compile_count
+    assert stats.cache_hits == 64 - 7
+
+
+def test_nearest_spmd_cache_stays_flat(mesh8):
+    """Distinct query vectors against the fused SPMD path reuse one
+    cached whole-plan program."""
+    from ytsaurus_tpu.parallel.distributed import (
+        DistributedEvaluator,
+        ShardedTable,
+    )
+    from ytsaurus_tpu.parallel.whole_plan import run_whole_plan
+    rng = np.random.default_rng(7)
+    chunks = [_vchunk(32 + 8 * s, seed=10 + s) for s in range(8)]
+    table = ShardedTable.from_chunks(mesh8, chunks)
+    ev = DistributedEvaluator(mesh8)
+    run_whole_plan(ev, _vplan(5, [1.0] * VDIM), table)
+    fc = ev.fresh_compiles
+    for _ in range(3):
+        vec = [float(x) for x in rng.integers(-5, 6, VDIM)]
+        run_whole_plan(ev, _vplan(5, vec), table)
+    assert ev.fresh_compiles == fc, \
+        "new query vectors must not fresh-compile the SPMD program"
+
+
+def test_nearest_aot_restart_zero_fresh_compiles(tmp_path):
+    """AOT restart leg: compile a NEAREST shape once, then a FRESH
+    evaluator over the same disk cache serves a different query vector
+    with zero fresh compiles."""
+    from ytsaurus_tpu.query.engine.evaluator import Evaluator
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    yt_config.set_compile_config(
+        yt_config.CompileConfig(disk_cache_dir=str(tmp_path)))
+    chunk = _vchunk(64, seed=2)
+    s1 = QueryStatistics()
+    Evaluator().run_plan(_vplan(6, [2.0] * VDIM), chunk, stats=s1)
+    assert s1.compile_count == 1
+    s2 = QueryStatistics()
+    out = Evaluator().run_plan(
+        _vplan(6, [-1.0, 4.0] * (VDIM // 2)), chunk, stats=s2)
+    assert len(out.to_rows()) == 6
+    assert s2.compile_disk_hit == 1
+    assert s2.compile_count - s2.compile_disk_hit == 0, \
+        "restart must serve NEAREST from the AOT tier"

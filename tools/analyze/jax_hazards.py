@@ -59,6 +59,7 @@ PASS_NAME = "jax"
 HOT_PREFIXES = (
     "ytsaurus_tpu/ops/",
     "ytsaurus_tpu/query/engine/",
+    "ytsaurus_tpu/query/vector.py",
     "ytsaurus_tpu/parallel/",
     "ytsaurus_tpu/tablet/mvcc.py",
 )
@@ -67,7 +68,7 @@ HOT_PREFIXES = (
 # the one place a pipeline materializes (every caller funnels through
 # them, so the sync count stays O(1) per query, not O(sites)).
 SYNC_POINT_FUNCTIONS = {
-    "finish", "finish_all", "to_rows",
+    "finish", "finish_all", "to_rows", "batched_nearest",
 }
 
 # Whole-plan SPMD modules (ISSUE 12): the fused program must not sync

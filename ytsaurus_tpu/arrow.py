@@ -22,7 +22,7 @@ from typing import Sequence
 import numpy as np
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
-from ytsaurus_tpu.schema import EValueType, TableSchema
+from ytsaurus_tpu.schema import EValueType, TableSchema, VectorType
 
 _ARROW_TYPES = {
     EValueType.int64: "int64",
@@ -52,7 +52,22 @@ def chunk_to_arrow(chunk) -> "pyarrow.Table":
         col = chunk.columns[name]
         valid = np.asarray(col.valid[:n])
         mask = ~valid
-        if col_schema.type in _ARROW_TYPES:
+        if isinstance(col_schema.type, VectorType):
+            # (rows, dim) float32 plane → FixedSizeListArray(float32, dim):
+            # the flat child buffer IS the plane, row-major.
+            dim = col_schema.type.dim
+            flat = np.ascontiguousarray(
+                np.asarray(col.data[:n], dtype=np.float32)).reshape(-1)
+            arr = pa.FixedSizeListArray.from_arrays(
+                pa.array(flat, type=pa.float32()), dim)
+            if mask.any():
+                # from_arrays carries no validity — rebuild with nulls.
+                arr = pa.array(
+                    [None if mask[i] else
+                     [float(x) for x in np.asarray(col.data[i])]
+                     for i in range(n)],
+                    type=pa.list_(pa.float32(), dim))
+        elif col_schema.type in _ARROW_TYPES:
             data = np.asarray(col.data[:n])
             arr = pa.array(data, mask=mask,
                            type=getattr(pa, _ARROW_TYPES[col_schema.type])())
@@ -119,6 +134,10 @@ def arrow_schema_to_table_schema(arrow_schema) -> TableSchema:
         t = field.type
         if pa.types.is_dictionary(t):
             t = t.value_type
+        if pa.types.is_fixed_size_list(t) and \
+                pa.types.is_floating(t.value_type):
+            cols.append((field.name, f"vector<float, {t.list_size}>"))
+            continue
         if pa.types.is_integer(t):
             ty = "uint64" if pa.types.is_unsigned_integer(t) else "int64"
         elif pa.types.is_floating(t):

@@ -29,7 +29,12 @@ import numpy as np
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
 from ytsaurus_tpu.utils.invariants import check as _invariant_check
-from ytsaurus_tpu.schema import EValueType, TableSchema, device_dtype
+from ytsaurus_tpu.schema import (
+    EValueType,
+    TableSchema,
+    VectorType,
+    device_dtype,
+)
 
 LANE = 128  # last-dim tiling unit on TPU; capacities are multiples of this
 
@@ -115,6 +120,8 @@ class Column:
         for i in range(row_count):
             if not valid[i]:
                 out.append(None)
+            elif isinstance(self.type, VectorType):
+                out.append([float(x) for x in data[i]])
             elif self.type is EValueType.string:
                 out.append(bytes(self.dictionary[int(data[i])]))
             elif self.type is EValueType.any:
@@ -208,7 +215,7 @@ class ColumnarChunk:
                         raise YtError(
                             f"Required column {name!r} is null in row {i}",
                             code=EErrorCode.QueryTypeError)
-            columns[name] = _build_column(ty, values, cap)
+            columns[name] = _build_column(ty, values, cap, name=name)
         chunk = ColumnarChunk(schema=schema, row_count=n, columns=columns)
         _invariant_check("chunks", chunk)
         return chunk
@@ -233,6 +240,26 @@ class ColumnarChunk:
             arr = np.asarray(arrays[name])
             if len(arr) != n:
                 raise YtError(f"Column {name!r} length {len(arr)} != {n}")
+            if isinstance(ty, VectorType):
+                if arr.ndim != 2 or arr.shape[1] != ty.dim:
+                    raise YtError(
+                        f"Vector column {name!r} needs a (rows, {ty.dim}) "
+                        f"array, got shape {arr.shape}",
+                        code=EErrorCode.QueryTypeError)
+                if not np.isfinite(arr).all():
+                    raise YtError(
+                        f"Non-finite component in vector column {name!r}",
+                        code=EErrorCode.QueryTypeError)
+                data = np.zeros((cap, ty.dim), dtype=np.float32)
+                data[:n] = arr.astype(np.float32)
+                valid = np.zeros(cap, dtype=bool)
+                if valids is not None and name in valids:
+                    valid[:n] = np.asarray(valids[name], dtype=bool)
+                else:
+                    valid[:n] = True
+                columns[name] = Column(type=ty, data=jnp.asarray(data),
+                                       valid=jnp.asarray(valid))
+                continue
             vocab = None
             if ty is EValueType.string:
                 if dictionaries is not None and name in dictionaries:
@@ -309,7 +336,9 @@ class ColumnarChunk:
         columns = {}
         m = min(capacity, self.capacity)
         for name, col in self.columns.items():
-            data = jnp.zeros(capacity, dtype=col.data.dtype).at[:m].set(col.data[:m])
+            # (capacity,) + trailing dims: vector planes repad along axis 0.
+            data = jnp.zeros((capacity,) + col.data.shape[1:],
+                             dtype=col.data.dtype).at[:m].set(col.data[:m])
             valid = jnp.zeros(capacity, dtype=bool).at[:m].set(col.valid[:m])
             columns[name] = replace(col, data=data, valid=valid)
         return ColumnarChunk(schema=self.schema, row_count=self.row_count,
@@ -322,9 +351,10 @@ class ColumnarChunk:
         cap = pad_capacity(max(n, 1))
         columns = {}
         for name, col in self.columns.items():
-            data = jnp.zeros(cap, dtype=col.data.dtype).at[:n].set(
+            trailing = col.data.shape[1:]
+            data = jnp.zeros((cap,) + trailing, dtype=col.data.dtype).at[:n].set(
                 jax.lax.dynamic_slice_in_dim(col.data, start, n) if n else
-                jnp.zeros(0, dtype=col.data.dtype))
+                jnp.zeros((0,) + trailing, dtype=col.data.dtype))
             valid = jnp.zeros(cap, dtype=bool).at[:n].set(
                 jax.lax.dynamic_slice_in_dim(col.valid, start, n) if n else
                 jnp.zeros(0, dtype=bool))
@@ -343,8 +373,54 @@ def _plane_dtype(ty: EValueType) -> np.dtype:
     return device_dtype(ty)
 
 
-def _build_column(ty: EValueType, values: Sequence[Any], cap: int) -> Column:
+def _build_vector_plane(ty: VectorType, values: Sequence[Any],
+                        cap: int, name: str = "") -> tuple[np.ndarray,
+                                                           np.ndarray]:
+    """Host rows → contiguous (cap, dim) float32 plane + validity.
+
+    The WRITE-path hardening gate: ragged rows, wrong-dim rows and
+    non-finite components are rejected loudly here — a NaN that slipped
+    into a stored plane would silently poison every distance it ever
+    participates in, so it must never seal."""
+    dim = ty.dim
     n = len(values)
+    data_np = np.zeros((cap, dim), dtype=np.float32)
+    valid_np = np.zeros(cap, dtype=bool)
+    label = f" in column {name!r}" if name else ""
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        try:
+            arr = np.asarray(v, dtype=np.float32)
+        except (TypeError, ValueError) as e:
+            raise YtError(f"Bad vector value{label} at row {i}: {e}",
+                          code=EErrorCode.QueryTypeError)
+        if arr.ndim != 1:
+            raise YtError(
+                f"Ragged vector value{label} at row {i}: expected a flat "
+                f"{dim}-component vector, got shape {arr.shape}",
+                code=EErrorCode.QueryTypeError)
+        if arr.shape[0] != dim:
+            raise YtError(
+                f"Vector dim mismatch{label} at row {i}: expected {dim} "
+                f"components, got {arr.shape[0]}",
+                code=EErrorCode.QueryTypeError)
+        if not np.isfinite(arr).all():
+            raise YtError(
+                f"Non-finite vector component{label} at row {i}",
+                code=EErrorCode.QueryTypeError)
+        data_np[i] = arr
+        valid_np[i] = True
+    return data_np, valid_np
+
+
+def _build_column(ty: EValueType, values: Sequence[Any], cap: int,
+                  name: str = "") -> Column:
+    n = len(values)
+    if isinstance(ty, VectorType):
+        data_np, valid_np = _build_vector_plane(ty, values, cap, name)
+        return Column(type=ty, data=jnp.asarray(data_np),
+                      valid=jnp.asarray(valid_np))
     dt = _plane_dtype(ty)
     valid_np = np.zeros(cap, dtype=bool)
     data_np = np.zeros(cap, dtype=dt)
@@ -500,7 +576,8 @@ def _hash_string_vocab(vocab: np.ndarray) -> np.ndarray:
 def column_ndv_sketch(col: Column, row_count: int) -> "bytes | None":
     """The column's distinct-count sketch over its valid values, or None
     for types with no meaningful NDV (any/null)."""
-    if col.type in (EValueType.any, EValueType.null):
+    if col.type in (EValueType.any, EValueType.null) or \
+            isinstance(col.type, VectorType):
         return None
     n = row_count
     valid = np.asarray(col.valid[:n]) if n else np.zeros(0, dtype=bool)
@@ -587,6 +664,29 @@ def merge_column_stats(stats_list: "Sequence[dict]") -> dict:
                 continue
             if not isinstance(entry, dict):
                 continue
+            if "vector_dim" in entry:
+                # Vector columns fold exactly: counts and centroid SUMS
+                # add, norm bounds min/max (None = no valid rows, the
+                # other side wins), has_null ORs.
+                cur = out.get(name)
+                if cur is None:
+                    out[name] = {**entry, "centroid_sum":
+                                 list(entry.get("centroid_sum") or [])}
+                    continue
+                cur["has_null"] = bool(cur.get("has_null")) or \
+                    bool(entry.get("has_null"))
+                cur["count"] = int(cur.get("count", 0)) + \
+                    int(entry.get("count", 0))
+                a = cur.get("centroid_sum") or []
+                b = entry.get("centroid_sum") or []
+                cur["centroid_sum"] = [float(x) + float(y)
+                                       for x, y in zip(a, b)] \
+                    if a and b else list(a or b)
+                for key, pick in (("norm_min", min), ("norm_max", max)):
+                    x, y = cur.get(key), entry.get(key)
+                    cur[key] = y if x is None else \
+                        (x if y is None else pick(x, y))
+                continue
             entry = {**entry, "min": bound(entry.get("min")),
                      "max": bound(entry.get("max"))}
             cur = out.get(name)
@@ -635,6 +735,31 @@ def _string_stat_upper(value: bytes) -> "bytes | None":
     return prefix[:-1] + bytes([prefix[-1] + 1])
 
 
+def vector_column_stats(col: Column, row_count: int) -> dict:
+    """Centroid + L2-norm stats for a vector column, sealed into chunk
+    meta at flush time (the NDV-sketch pattern; the later ANN-pruning
+    hook).  `centroid_sum` is the elementwise SUM over valid rows (not
+    the mean) so the cross-chunk merge fold is an exact addition —
+    readers divide by `count`.  `norm_min`/`norm_max` bracket the L2
+    norms of valid rows: with a query norm they bound any chunk's best
+    possible dot/cosine/L2 score via the triangle inequality."""
+    n = row_count
+    valid = np.asarray(col.valid[:n]) if n else np.zeros(0, dtype=bool)
+    entry: dict = {"has_null": bool((~valid).any()) if n else True,
+                   "vector_dim": int(col.type.dim), "count": 0,
+                   "centroid_sum": [0.0] * int(col.type.dim),
+                   "norm_min": None, "norm_max": None,
+                   "ndv_sketch": None}
+    if n and valid.any():
+        data = np.asarray(col.data[:n])[valid].astype(np.float64)
+        norms = np.sqrt((data * data).sum(axis=1))
+        entry["count"] = int(valid.sum())
+        entry["centroid_sum"] = [float(x) for x in data.sum(axis=0)]
+        entry["norm_min"] = float(norms.min())
+        entry["norm_max"] = float(norms.max())
+    return entry
+
+
 def chunk_column_stats(chunk: ColumnarChunk) -> dict:
     """Per-column min/max/has_null pruning statistics (+ `$row_count`).
 
@@ -646,6 +771,9 @@ def chunk_column_stats(chunk: ColumnarChunk) -> dict:
     n = chunk.row_count
     for name, col in chunk.columns.items():
         if col.type in (EValueType.any, EValueType.null):
+            continue
+        if isinstance(col.type, VectorType):
+            out[name] = vector_column_stats(col, n)
             continue
         valid = np.asarray(col.valid[:n])
         entry: dict = {"has_null": bool((~valid).any()) if n else True,
@@ -710,7 +838,10 @@ def concat_chunks(chunks: Sequence[ColumnarChunk]) -> ColumnarChunk:
             data_parts.append(col.data[: chunk.row_count])
             valid_parts.append(col.valid[: chunk.row_count])
         dt = _plane_dtype(col_schema.type)
-        data = jnp.zeros(cap, dtype=dt).at[:total].set(jnp.concatenate(data_parts))
+        trailing = (col_schema.type.dim,) \
+            if isinstance(col_schema.type, VectorType) else ()
+        data = jnp.zeros((cap,) + trailing, dtype=dt).at[:total].set(
+            jnp.concatenate(data_parts))
         valid = jnp.zeros(cap, dtype=bool).at[:total].set(jnp.concatenate(valid_parts))
         host_values = None
         if col_schema.type is EValueType.any:

@@ -30,7 +30,8 @@ from ytsaurus_tpu.chunks.columnar import Column, ColumnarChunk, pad_capacity
 from ytsaurus_tpu.chunks.compression import get_codec
 from ytsaurus_tpu.chunks.hunks import HunkRef
 from ytsaurus_tpu.errors import EErrorCode, YtError
-from ytsaurus_tpu.schema import EValueType, TableSchema, device_dtype
+from ytsaurus_tpu.schema import (EValueType, TableSchema, VectorType,
+                                 device_dtype)
 
 from ytsaurus_tpu.utils.varint import (  # noqa: E402  (shared varint impl)
     encode_varint_u as _encode_varint_u,
@@ -45,7 +46,11 @@ def _encode_column(col: Column, ty: EValueType, n: int) -> tuple[bytes, bytes]:
     """Returns (data_block, aux_block) raw bytes; aux = vocab/host payload."""
     data = np.asarray(col.data[:n])
     aux = b""
-    if ty in (EValueType.int64, EValueType.uint64):
+    if isinstance(ty, VectorType):
+        # Contiguous raw float32 LE (n, dim) plane — already fixed
+        # width, so no per-row framing; dim rides in the schema.
+        block = data.astype("<f4").tobytes()
+    elif ty in (EValueType.int64, EValueType.uint64):
         block = native.varint_encode(
             native.delta_encode(data.astype(np.int64)))
     elif ty is EValueType.double:
@@ -89,7 +94,13 @@ def _decode_column(ty: EValueType, data_block: bytes, aux_block: bytes,
                    format_version: int = 2) -> Column:
     dictionary = None
     host_values = None
-    if ty in (EValueType.int64, EValueType.uint64):
+    if isinstance(ty, VectorType):
+        flat = np.frombuffer(data_block, dtype="<f4", count=n * ty.dim)
+        plane = flat.reshape(n, ty.dim)
+        if n and not np.isfinite(plane[valid[:n]]).all():
+            raise YtError("Non-finite vector component in chunk block",
+                          code=EErrorCode.ChunkFormatError)
+    elif ty in (EValueType.int64, EValueType.uint64):
         values = native.delta_decode(native.varint_decode(data_block, n))
         plane = values.astype(device_dtype(ty))
     elif ty is EValueType.double:
@@ -133,7 +144,7 @@ def _decode_column(ty: EValueType, data_block: bytes, aux_block: bytes,
     else:
         raise YtError(f"Cannot decode column type {ty.value}",
                       code=EErrorCode.ChunkFormatError)
-    full = np.zeros(cap, dtype=plane.dtype)
+    full = np.zeros((cap,) + plane.shape[1:], dtype=plane.dtype)
     full[:n] = plane
     full_valid = np.zeros(cap, dtype=bool)
     full_valid[:n] = valid

@@ -288,7 +288,17 @@ def build_parser() -> argparse.ArgumentParser:
                                   "help": "print the per-query "
                                           "ExecutionProfile (wall/"
                                           "compile/execute split + span "
-                                          "tree) instead of rows"}))
+                                          "tree) instead of rows"}),
+        (("--param",), {"action": "append", "default": None,
+                        "dest": "params",
+                        "help": "bind the next `?` placeholder (JSON "
+                                "value; a JSON list binds a query "
+                                "vector); repeat per placeholder"}))
+    cmd("nearest-rows", (("path",), {}), (("column",), {}),
+        (("query_vector",), {"help": "JSON list of floats"}),
+        (("k",), {"type": int}),
+        (("--metric",), {"default": "l2",
+                         "choices": ["l2", "cosine", "dot"]}))
     cmd("trace", (("trace_id",), {}),
         (("--json",), {"action": "store_true",
                        "help": "raw span tree instead of the pretty "
@@ -559,11 +569,17 @@ def _dispatch(cl, a):
     if c == "read-table":
         return cl.read_table(a.path, format=a.format)
     if c == "select-rows":
+        params = [json.loads(p) for p in a.params] if a.params else None
         if a.explain_analyze:
-            profile = cl.select_rows(a.query, explain_analyze=True)
+            profile = cl.select_rows(a.query, explain_analyze=True,
+                                     params=params)
             print(_format_profile(profile))
             return None
-        return cl.select_rows(a.query)
+        return cl.select_rows(a.query, params=params)
+    if c == "nearest-rows":
+        return cl.nearest_rows(a.path, a.column,
+                               json.loads(a.query_vector), a.k,
+                               metric=a.metric)
     if c == "trace":
         tree = _fetch_trace(cl, a.trace_id)
         if not tree:

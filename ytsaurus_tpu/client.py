@@ -1248,7 +1248,8 @@ class YtClient:
                     timestamp: int = MAX_TIMESTAMP,
                     timeout: Optional[float] = None,
                     pool: Optional[str] = None,
-                    explain_analyze: bool = False) -> "list[dict]":
+                    explain_analyze: bool = False,
+                    params: Optional[Sequence] = None) -> "list[dict]":
         """Distributed QL over static and mounted dynamic tables, routed
         through the cluster's QueryGateway (query/serving.py): admission
         against the per-pool concurrency slots (overflow raises
@@ -1296,11 +1297,13 @@ class YtClient:
             with root:
                 if not gateway.enabled:
                     rows = self._select_rows_impl(query, timestamp, None,
-                                                  stats=stats)
+                                                  stats=stats,
+                                                  params=params)
                 else:
                     rows = gateway.run_select(
                         lambda token: self._select_rows_impl(
-                            query, timestamp, token, stats=stats),
+                            query, timestamp, token, stats=stats,
+                            params=params),
                         pool=pool, timeout=timeout)
         except YtError as err:
             # Workload recorder (ISSUE 8): failed queries are part of
@@ -1339,6 +1342,48 @@ class YtClient:
         get_workload_log().observe_select(query, profile=profile)
         return profile if explain_analyze else rows
 
+    def nearest_rows(self, path: str, column: str,
+                     query_vector: Sequence[float], k: int,
+                     metric: str = "l2",
+                     timestamp: int = MAX_TIMESTAMP,
+                     timeout: Optional[float] = None,
+                     pool: Optional[str] = None) -> list[dict]:
+        """Top-k vector similarity over `column` (a `vector<float,N>`
+        column) of `path`, served through the vector micro-batcher
+        (query/vector.py): co-admitted NEAREST queries on one
+        (table, column, metric) cohort execute as ONE batched
+        `(batch, dim) @ (dim, rows)` distance matmul.  Returns up to
+        `k` full rows ranked by `metric` ("l2", "cosine", or "dot"),
+        each with a `$distance` field (similarity for "dot").
+
+        The equivalent query-language form —
+        `SELECT ... FROM [t] NEAREST(column, ?, k)` via
+        `select_rows(..., params=[vec])` — runs the same distance
+        kernel through the whole-plan SPMD path instead; this entry
+        point is the serving-plane fast path for high-QPS workloads."""
+        gateway = self.cluster.gateway
+        if gateway.enabled:
+            from ytsaurus_tpu.utils.tracing import start_query_span
+            with start_query_span("query.nearest", table=path, k=k):
+                return gateway.nearest_rows(
+                    self, path, column, query_vector, k, metric=metric,
+                    timestamp=timestamp, pool=pool, timeout=timeout)
+        # Serving disabled: execute the same batched kernel directly
+        # (a cohort of one), no admission, no coalescing window.
+        from ytsaurus_tpu.chunks.columnar import concat_chunks
+        from ytsaurus_tpu.query.vector import batched_nearest
+        chunk = concat_chunks([t.read_snapshot(timestamp)
+                               for t in self._mounted_tablets(path)])
+        ranked = batched_nearest(chunk, column, [query_vector], k,
+                                 metric=metric)
+        rows = chunk.to_rows()
+        out = []
+        for row_idx, measure in ranked[0]:
+            row = dict(rows[row_idx])
+            row["$distance"] = measure
+            out.append(row)
+        return out
+
     def _select_rows_system(self, query: str,
                             timestamp: int = MAX_TIMESTAMP) -> list[dict]:
         """System-plane select: NO admission, NO deadline.  For internal
@@ -1351,7 +1396,7 @@ class YtClient:
         return self._select_rows_impl(query, timestamp, None)
 
     def _select_rows_impl(self, query: str, timestamp: int,
-                          token, stats=None) -> list[dict]:
+                          token, stats=None, params=None) -> list[dict]:
         import logging as _logging
 
         from ytsaurus_tpu.query.statistics import QueryStatistics
@@ -1359,7 +1404,7 @@ class YtClient:
         if stats is None:
             stats = QueryStatistics()
         self.last_query_statistics = stats   # visible even if the query fails
-        plan = build_query(query, _SchemaResolver(self))
+        plan = build_query(query, _SchemaResolver(self), params=params)
         # Every source table requires read permission (ref: query agent
         # checks table read access before executing subqueries).
         self.cluster.security.validate_permission("read", plan.source)

@@ -33,7 +33,7 @@ def _select_rows_command(cl, p: dict):
     """select_rows with the EXPLAIN ANALYZE shape: explain_analyze=True
     returns the ExecutionProfile as a plain dict (wire/JSON safe — this
     registry feeds the RPC driver service and the HTTP proxy)."""
-    kwargs = {k: p[k] for k in ("timeout", "pool") if k in p}
+    kwargs = {k: p[k] for k in ("timeout", "pool", "params") if k in p}
     if p.get("explain_analyze"):
         profile = cl.select_rows(p["query"], explain_analyze=True,
                                  **kwargs)
@@ -138,8 +138,17 @@ def _registry() -> dict[str, CommandDescriptor]:
                **({"pool": p["pool"]} if "pool" in p else {}),
                column_names=p.get("column_names"))),
         _d("select_rows", ("query",),
-           ("timeout", "pool", "explain_analyze"), False,
+           ("timeout", "pool", "explain_analyze", "params"), False,
            lambda cl, p: _select_rows_command(cl, p)),
+        _d("nearest_rows", ("path", "column", "query_vector", "k"),
+           ("metric", "timestamp", "timeout", "pool"), False,
+           lambda cl, p: cl.nearest_rows(
+               p["path"], p["column"], p["query_vector"], int(p["k"]),
+               metric=p.get("metric", "l2"),
+               **({"timestamp": p["timestamp"]} if "timestamp" in p
+                  else {}),
+               **({"timeout": p["timeout"]} if "timeout" in p else {}),
+               **({"pool": p["pool"]} if "pool" in p else {}))),
         _d("trim_rows", ("path", "trimmed_row_count"), (), True,
            lambda cl, p: cl.trim_rows(p["path"], p["trimmed_row_count"])),
         _d("push_queue", ("path", "rows"), (), True,

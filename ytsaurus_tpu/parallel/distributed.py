@@ -855,7 +855,8 @@ class DistributedEvaluator:
             gathered = {}
             for out_col, (d, v) in zip(prepared_local.output, planes):
                 gathered[out_col.name] = (
-                    jax.lax.all_gather(d, SHARD_AXIS).reshape(-1),
+                    jax.lax.all_gather(d, SHARD_AXIS)
+                    .reshape((-1,) + d.shape[1:]),
                     jax.lax.all_gather(v, SHARD_AXIS).reshape(-1))
             g_mask = jax.lax.all_gather(shard_mask, SHARD_AXIS).reshape(-1)
             return prepared_front.run(gathered, g_mask, front_bnd)
@@ -1000,7 +1001,8 @@ class DistributedEvaluator:
             shard_mask = jnp.arange(prepared_b.out_capacity) < count
             gathered = {}
             for out_col, (d, v) in zip(prepared_b.output, planes):
-                gd = jax.lax.all_gather(d, SHARD_AXIS).reshape(-1)
+                gd = jax.lax.all_gather(d, SHARD_AXIS) \
+                    .reshape((-1,) + d.shape[1:])
                 gv = jax.lax.all_gather(v, SHARD_AXIS).reshape(-1)
                 gathered[out_col.name] = (gd, gv)
             g_mask = jax.lax.all_gather(shard_mask, SHARD_AXIS).reshape(-1)

@@ -220,8 +220,10 @@ def _gathered(planes_with_cols, shard_mask, out_cap: int):
     """In-program all_gather of a stage's output planes + mask."""
     gathered = {}
     for out_col, (d, v) in planes_with_cols:
+        # Collapse only the (shards, rows) leading axes: trailing dims
+        # (vector planes are (rows, dim)) ride through the gather.
         gathered[out_col.name] = (
-            jax.lax.all_gather(d, SHARD_AXIS).reshape(-1),
+            jax.lax.all_gather(d, SHARD_AXIS).reshape((-1,) + d.shape[1:]),
             jax.lax.all_gather(v, SHARD_AXIS).reshape(-1))
     g_mask = jax.lax.all_gather(shard_mask, SHARD_AXIS).reshape(-1)
     return gathered, g_mask

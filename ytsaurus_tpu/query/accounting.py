@@ -47,6 +47,7 @@ USAGE_FIELDS = (
     "wall_seconds", "cache_hits", "compile_count", "retries",
     "throttled", "lookup_keys", "lookup_rows_found", "lookup_batches",
     "operations", "jobs", "view_batches", "view_rows",
+    "nearest_queries", "nearest_batches", "nearest_rows_scanned",
 )
 
 
@@ -124,6 +125,20 @@ class ResourceAccountant:
         the per-pool reconciliation unit), charged to the cohort
         opener like the slot itself."""
         self.fold(pool, user, lookup_batches=1)
+
+    def observe_nearest(self, pool: Optional[str], user: Optional[str],
+                        rows_scanned: int = 0) -> None:
+        """One member NEAREST query of a batched cohort flush: the
+        exhaustive-scan row count charges the requesting user (the
+        vector analog of observe_lookup)."""
+        self.fold(pool, user, nearest_queries=1,
+                  nearest_rows_scanned=rows_scanned)
+
+    def observe_nearest_batch(self, pool: Optional[str],
+                              user: Optional[str]) -> None:
+        """One admitted NEAREST cohort flush (one batched matmul, one
+        admission slot), charged to the cohort opener."""
+        self.fold(pool, user, nearest_batches=1)
 
     def observe_throttle(self, pool: Optional[str],
                          user: Optional[str] = None) -> None:

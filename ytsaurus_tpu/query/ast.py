@@ -29,6 +29,14 @@ class Reference(Expr):
 
 
 @dataclass(frozen=True)
+class Placeholder(Expr):
+    """A `?` parameter slot, numbered in appearance order.  Substituted
+    with a literal from the `params` list before type checking (the
+    NEAREST query-vector position and scalar binds both ride this)."""
+    index: int
+
+
+@dataclass(frozen=True)
 class FunctionCall(Expr):
     name: str                # lower-cased
     args: tuple[Expr, ...]

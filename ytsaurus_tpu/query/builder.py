@@ -23,7 +23,7 @@ from ytsaurus_tpu.query.functions import (
     unify,
 )
 from ytsaurus_tpu.query.parser import parse_query
-from ytsaurus_tpu.schema import EValueType, TableSchema
+from ytsaurus_tpu.schema import EValueType, TableSchema, VectorType
 
 _COMPARISONS = ("=", "!=", "<", "<=", ">", ">=")
 _LOGICAL = ("and", "or")
@@ -57,10 +57,12 @@ def render_expr(e: ast.Expr) -> str:
     if isinstance(e, ast.WindowExpr):
         return (f"{e.function}({', '.join(render_expr(a) for a in e.args)})"
                 " over (...)")
+    if isinstance(e, ast.Placeholder):
+        return "?"
     return "expr"
 
 
-def _literal_type(value, is_uint=False) -> EValueType:
+def _literal_type(value, is_uint=False) -> "EValueType | VectorType":
     if value is None:
         return EValueType.null
     if isinstance(value, bool):
@@ -73,6 +75,12 @@ def _literal_type(value, is_uint=False) -> EValueType:
         return EValueType.double
     if isinstance(value, (str, bytes)):
         return EValueType.string
+    if isinstance(value, (list, tuple)) and value and all(
+            isinstance(x, (int, float)) and not isinstance(x, bool)
+            for x in value):
+        # A flat number sequence is a vector literal (the NEAREST query
+        # vector arriving through a `?` param).
+        return VectorType(len(value))
     raise YtError(f"Unsupported literal {value!r}", code=EErrorCode.QueryTypeError)
 
 
@@ -113,8 +121,19 @@ class _ExprBuilder:
     def build(self, e: ast.Expr) -> ir.TExpr:
         if isinstance(e, ast.Literal):
             ty = _literal_type(e.value, e.is_uint)
+            if isinstance(ty, VectorType):
+                value = tuple(float(x) for x in e.value)
+                if not all(v == v and abs(v) != float("inf") for v in value):
+                    raise YtError("Non-finite component in vector literal",
+                                  code=EErrorCode.QueryTypeError)
+                return ir.TLiteral(type=ty, value=value)
             value = _as_bytes(e.value) if ty is EValueType.string else e.value
             return ir.TLiteral(type=ty, value=value)
+
+        if isinstance(e, ast.Placeholder):
+            raise YtError(
+                f"Unbound placeholder ?{e.index}: pass `params` to "
+                "select_rows/build_query", code=EErrorCode.QueryTypeError)
 
         if isinstance(e, ast.Reference):
             name = self.resolve_reference(e)
@@ -150,6 +169,12 @@ class _ExprBuilder:
                                       code=EErrorCode.QueryTypeError)
                 return ir.TBinary(type=EValueType.boolean, op=op, lhs=lhs, rhs=rhs)
             if op in _COMPARISONS:
+                if isinstance(lhs.type, VectorType) or \
+                        isinstance(rhs.type, VectorType):
+                    raise YtError(
+                        f"Vectors are not comparable with {op!r}; use a "
+                        "distance function (l2_distance/cosine_distance/"
+                        "dot_product)", code=EErrorCode.QueryUnsupported)
                 unify(lhs.type, rhs.type, f"comparison {op!r}")
                 return ir.TBinary(type=EValueType.boolean, op=op, lhs=lhs, rhs=rhs)
             if op in _ARITH:
@@ -270,6 +295,10 @@ class _ExprBuilder:
         return ir.TFunction(type=result, name=e.name, args=args)
 
     def _check_tuples(self, operands, tuples, context, allow_prefix=False):
+        for operand in operands:
+            if isinstance(operand.type, VectorType):
+                raise YtError(f"{context} does not accept vector operands",
+                              code=EErrorCode.QueryUnsupported)
         for tup in tuples:
             if allow_prefix:
                 if len(tup) > len(operands):
@@ -526,14 +555,67 @@ class _WindowBuilder(_ExprBuilder):
                                items=tuple(self.items))
 
 
+def _walk_placeholders(node, visit):
+    """Generic AST walk: calls `visit` on every Placeholder; returns the
+    (possibly rebuilt) node when visit returns a replacement, else the
+    original object (identity-preserving so untouched trees stay shared)."""
+    import dataclasses as _dc
+    if isinstance(node, ast.Placeholder):
+        return visit(node)
+    if _dc.is_dataclass(node) and not isinstance(node, type):
+        changes = {}
+        for f in _dc.fields(node):
+            old = getattr(node, f.name)
+            new = _walk_placeholders(old, visit)
+            if new is not old:
+                changes[f.name] = new
+        return _dc.replace(node, **changes) if changes else node
+    if isinstance(node, tuple):
+        rebuilt = tuple(_walk_placeholders(x, visit) for x in node)
+        return rebuilt if any(a is not b for a, b in zip(rebuilt, node)) \
+            else node
+    return node
+
+
+def substitute_params(q: ast.QueryAst,
+                      params: "Optional[Sequence]") -> ast.QueryAst:
+    """Replace `?` placeholders with literals from `params` (positional).
+    A flat number sequence becomes a vector literal; scalars keep their
+    natural literal type.  Loud on arity mismatch either way."""
+    seen: set[int] = set()
+
+    def visit(p: ast.Placeholder):
+        seen.add(p.index)
+        if params is None or p.index >= len(params):
+            raise YtError(
+                f"Query has placeholder ?{p.index} but only "
+                f"{0 if params is None else len(params)} params were given",
+                code=EErrorCode.QueryTypeError)
+        value = params[p.index]
+        if isinstance(value, (list, tuple)):
+            return ast.Literal(tuple(float(x) for x in value))
+        return ast.Literal(value)
+
+    out = _walk_placeholders(q, visit)
+    if params is not None and len(params) > len(seen):
+        raise YtError(
+            f"Got {len(params)} params for {len(seen)} placeholders",
+            code=EErrorCode.QueryTypeError)
+    return out
+
+
 def build_query(source: str | ast.QueryAst,
-                schemas: Mapping[str, TableSchema]) -> ir.Query:
+                schemas: Mapping[str, TableSchema],
+                params: "Optional[Sequence]" = None) -> ir.Query:
     """Parse + build a typed plan.
 
     `schemas` maps table path → schema; the FROM table plus every JOIN table
-    must be present.
+    must be present.  `params` binds `?` placeholders positionally (the
+    NEAREST query vector rides here as a list of floats).
     """
     q = parse_query(source) if isinstance(source, str) else source
+    if params is not None:
+        q = substitute_params(q, params)
     if q.source is None:
         raise YtError("Query has no FROM clause", code=EErrorCode.QueryParseError)
     if q.source not in schemas:
@@ -614,6 +696,9 @@ def build_query(source: str | ast.QueryAst,
         for i, item in enumerate(q.group_by):
             name = item.alias or render_expr(item.expr)
             expr = base_builder.build(item.expr)
+            if isinstance(expr.type, VectorType):
+                raise YtError("GROUP BY does not accept vector expressions",
+                              code=EErrorCode.QueryUnsupported)
             group_items.append(ir.NamedExpr(name=name, expr=expr))
             group_exprs[item.expr] = name
             # An aliased group item is also addressable by its alias.
@@ -640,6 +725,11 @@ def build_query(source: str | ast.QueryAst,
         items = []
         for item in q.select:
             expr = final_builder.build(item.expr)
+            if isinstance(expr.type, VectorType) and \
+                    not isinstance(expr, ir.TReference):
+                raise YtError(
+                    "Vector expressions in SELECT must be plain column "
+                    "references", code=EErrorCode.QueryUnsupported)
             name = item.alias or render_expr(item.expr)
             items.append(ir.NamedExpr(name=name, expr=expr))
         project = ir.ProjectClause(items=tuple(items))
@@ -649,6 +739,11 @@ def build_query(source: str | ast.QueryAst,
         order_items = []
         for oi in q.order_by:
             expr = final_builder.build(oi.expr)
+            if isinstance(expr.type, VectorType):
+                raise YtError(
+                    "ORDER BY does not accept a raw vector (no total "
+                    "order); order by a distance function instead",
+                    code=EErrorCode.QueryUnsupported)
             order_items.append(ir.OrderItem(expr=expr, descending=oi.descending))
         order = ir.OrderClause(items=tuple(order_items))
 

@@ -721,11 +721,13 @@ _global_evaluator = Evaluator()
 def select_rows(query: str,
                 tables: Mapping[str, "ColumnarChunk | Sequence"],
                 schemas: Optional[Mapping[str, TableSchema]] = None,
-                evaluator: Optional[Evaluator] = None) -> ColumnarChunk:
+                evaluator: Optional[Evaluator] = None,
+                params: Optional[Sequence] = None) -> ColumnarChunk:
     """One-shot: parse, plan, and execute a query over in-memory tables.
 
     `tables` maps table path → ColumnarChunk (or row list, requiring `schemas`
-    to carry that table's schema).
+    to carry that table's schema).  `params` binds `?` placeholders (a list
+    of floats binds as a vector — the NEAREST query vector).
     """
     evaluator = evaluator or _global_evaluator
     chunks: dict[str, ColumnarChunk] = {}
@@ -738,7 +740,7 @@ def select_rows(query: str,
             if path not in schemas:
                 raise YtError(f"Row-list table {path!r} requires a schema")
             chunks[path] = ColumnarChunk.from_rows(schemas[path], data)
-    plan = build_query(query, schemas)
+    plan = build_query(query, schemas, params=params)
     source_chunk = chunks[plan.source]
     foreign = {p: c for p, c in chunks.items() if p != plan.source}
     return evaluator.run_plan(plan, source_chunk, foreign)

@@ -181,6 +181,19 @@ class ExprBinder:
                 return (jnp.zeros(ctx.capacity, dtype=jnp.int32),
                         jnp.ones(ctx.capacity, dtype=bool))
             return BoundExpr(type=ty, vocab=vocab, emit=emit_str)
+        if not isinstance(ty, EValueType):
+            # Vector literal (the NEAREST query vector): a (dim,) float32
+            # runtime BINDING.  The binding SHAPE keys the compile cache
+            # per dim; the component values never enter the traced
+            # program, so one program serves every query vector.
+            # analyze: allow(host-sync): node.value is a host python tuple (bind phase), not a device plane
+            slot = self.ctx.add(jnp.asarray(np.asarray(node.value,
+                                                       dtype=np.float32)))
+
+            def emit_vec(ctx: EmitContext):
+                return (ctx.bindings[slot].astype(jnp.float32),
+                        jnp.ones(ctx.capacity, dtype=bool))
+            return BoundExpr(type=ty, vocab=None, emit=emit_vec)
         value = node.value
         dt = _dtype_for(ty)
         if ty is EValueType.boolean:
@@ -334,6 +347,41 @@ class ExprBinder:
 
         if name == "if":
             return self._bind_if(node, args)
+        if name in ("l2_distance", "distance", "cosine_distance",
+                    "dot_product"):
+            a, b = args[0], args[1]
+            metric = name
+
+            def emit_dist(ctx: EmitContext):
+                da, va = a.emit(ctx)
+                db, vb = b.emit(ctx)
+                da = da.astype(jnp.float32)
+                db = db.astype(jnp.float32)
+                if da.ndim == 1 and db.ndim == 2:
+                    da, db = db, da
+                    va, vb = vb, va
+                if da.ndim == 2 and db.ndim == 1:
+                    # THE tiled distance pass: (capacity, dim) @ (dim,)
+                    # — one MXU matmul over the contiguous plane.
+                    dot = da @ db
+                elif da.ndim == 2:
+                    dot = (da * db).sum(axis=1)   # row-wise col vs col
+                else:
+                    dot = da @ db                 # two literals: scalar
+                na2 = (da * da).sum(axis=-1)
+                nb2 = (db * db).sum(axis=-1)
+                if metric == "dot_product":
+                    out = dot
+                elif metric == "cosine_distance":
+                    denom = jnp.sqrt(na2) * jnp.sqrt(nb2)
+                    out = jnp.where(denom > 0.0, 1.0 - dot / denom, 1.0)
+                else:
+                    # L2 via the norm trick off the shared dot pass.
+                    out = jnp.sqrt(jnp.maximum(na2 - 2.0 * dot + nb2, 0.0))
+                out = jnp.broadcast_to(out, (ctx.capacity,))
+                return out.astype(jnp.float64), va & vb
+            return BoundExpr(type=EValueType.double, vocab=None,
+                             emit=emit_dist)
         if name == "is_null":
             a = args[0]
 

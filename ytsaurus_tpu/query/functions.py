@@ -11,7 +11,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ytsaurus_tpu.errors import EErrorCode, YtError
-from ytsaurus_tpu.schema import EValueType
+from ytsaurus_tpu.schema import EValueType, VectorType
 
 _NUMERIC_RANK = {EValueType.int64: 1, EValueType.uint64: 2, EValueType.double: 3}
 
@@ -234,6 +234,31 @@ def _infer_substr(ts):
 
 
 _register("substr", _infer_substr, 2, 3)
+
+
+def _infer_distance(name):
+    """(vector<float,N>, vector<float,N>) -> double: the NEAREST distance
+    family.  Both args must be vectors of the SAME dim (the interned
+    VectorType makes that an identity check)."""
+    def infer(ts):
+        if len(ts) != 2 or not all(isinstance(t, VectorType) for t in ts):
+            raise YtError(
+                f"Function {name!r} expects two vector arguments, got "
+                f"({', '.join(t.value for t in ts)})",
+                code=EErrorCode.QueryTypeError)
+        if ts[0] is not ts[1]:
+            raise YtError(
+                f"Function {name!r} dim mismatch: "
+                f"{ts[0].value} vs {ts[1].value}",
+                code=EErrorCode.QueryTypeError)
+        return EValueType.double
+    return infer
+
+
+_register("l2_distance", _infer_distance("l2_distance"), 2)
+_register("distance", _infer_distance("distance"), 2)
+_register("cosine_distance", _infer_distance("cosine_distance"), 2)
+_register("dot_product", _infer_distance("dot_product"), 2)
 
 
 def _min_of(ts):

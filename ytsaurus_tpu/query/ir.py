@@ -378,6 +378,11 @@ def _repr_expr(e, omit_values: bool = False) -> str:
         return _repr_expr(x, omit_values)
 
     if isinstance(e, TLiteral):
+        if omit_values and not isinstance(e.type, EValueType):
+            # Vector (parametric-type) literal: the query vector is a
+            # runtime binding; the dim stays in the type spelling so one
+            # program serves every query vector of that dim.
+            return f"L({e.type.value},?)"
         if omit_values and e.type in HOISTABLE_LITERAL_TYPES:
             return f"L({e.type.value},?)"
         return f"L({e.type.value},{e.value!r})"

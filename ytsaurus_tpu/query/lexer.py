@@ -37,7 +37,7 @@ KEYWORDS = {
 # Multi-char operators first (longest match wins).
 OPERATORS = [
     "<<", ">>", "!=", "<>", "<=", ">=", "=", "<", ">", "(", ")", ",", "+",
-    "-", "*", "/", "%", "|", "&", "~", "^", ".", "[", "]", "#",
+    "-", "*", "/", "%", "|", "&", "~", "^", ".", "[", "]", "#", "?",
 ]
 
 

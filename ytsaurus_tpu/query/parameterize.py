@@ -132,7 +132,11 @@ def hoisted_parameters(plan: "ir.Query | ir.FrontQuery") -> list:
         if expr is None:
             return
         if isinstance(expr, ir.TLiteral):
-            if expr.type in ir.HOISTABLE_LITERAL_TYPES:
+            if not isinstance(expr.type, ir.EValueType):
+                # Vector literal (parametric type): hoisted as a runtime
+                # binding like the scalar classes.
+                params.append(("vector", expr.value))
+            elif expr.type in ir.HOISTABLE_LITERAL_TYPES:
                 params.append((expr.type.value, expr.value))
             return
         if isinstance(expr, ir.TIn):

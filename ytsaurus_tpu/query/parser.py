@@ -31,12 +31,23 @@ _PRECEDENCE = {
 
 _COMPARISON_LEVEL = 4
 
+# NEAREST(col, q, k [, metric]) desugars to ORDER BY <fn>(col, q) LIMIT k.
+# Similarity metrics (dot) sort descending; distances ascending.
+_NEAREST_METRICS = {
+    "l2": ("l2_distance", False),
+    "euclidean": ("l2_distance", False),
+    "cosine": ("cosine_distance", False),
+    "dot": ("dot_product", True),
+    "inner": ("dot_product", True),
+}
+
 
 class _Parser:
     def __init__(self, source: str):
         self.source = source
         self.tokens = tokenize(source)
         self.pos = 0
+        self._placeholders = 0
 
     # --- token helpers --------------------------------------------------------
 
@@ -214,6 +225,11 @@ class _Parser:
         if tok.is_op("#"):
             self.advance()
             return ast.Literal(None)
+        if tok.is_op("?"):
+            self.advance()
+            index = self._placeholders
+            self._placeholders += 1
+            return ast.Placeholder(index)
         if tok.is_keyword("case"):
             return self.parse_case()
         if tok.is_keyword("transform"):
@@ -455,6 +471,37 @@ class _Parser:
         where = None
         if self.accept_keyword("where"):
             where = self.parse_expression()
+        # NEAREST(col, q, k [, metric]) — contextual word (not a reserved
+        # keyword) so `nearest` stays usable as a column name.  Pure
+        # sugar over ORDER BY <metric_fn>(col, q) LIMIT k.
+        nearest = None
+        if self._at_word("nearest") and \
+                self.tokens[self.pos + 1].is_op("("):
+            self.advance()
+            self.expect_op("(")
+            near_col = self.parse_expression()
+            self.expect_op(",")
+            near_q = self.parse_expression()
+            self.expect_op(",")
+            ktok = self.advance()
+            if ktok.kind not in (TokenKind.INT, TokenKind.UINT):
+                raise self.error("NEAREST expects an integer literal k")
+            near_k = int(ktok.value)
+            metric = "l2"
+            if self.accept_op(","):
+                mtok = self.advance()
+                if mtok.kind not in (TokenKind.IDENT, TokenKind.STRING):
+                    raise self.error(
+                        "NEAREST metric must be an identifier or string")
+                metric = str(mtok.value).lower()
+            self.expect_op(")")
+            if metric not in _NEAREST_METRICS:
+                raise self.error(
+                    f"Unknown NEAREST metric {metric!r}; expected one of "
+                    f"{sorted(set(_NEAREST_METRICS))}")
+            if near_k <= 0:
+                raise self.error("NEAREST expects k >= 1")
+            nearest = (near_col, near_q, near_k, metric)
         group_by: tuple[ast.SelectItem, ...] = ()
         with_totals = False
         if self.accept_keyword("group"):
@@ -496,6 +543,26 @@ class _Parser:
             limit = int(tok.value)
         if self.cur.kind is not TokenKind.EOF:
             raise self.error(f"Unexpected trailing token {self.cur.value!r}")
+        if nearest is not None:
+            if order_by or limit is not None or offset is not None:
+                raise self.error(
+                    "NEAREST cannot be combined with ORDER BY/OFFSET/LIMIT "
+                    "(it IS an ORDER BY ... LIMIT)")
+            near_col, near_q, near_k, metric = nearest
+            fn, desc = _NEAREST_METRICS[metric]
+            order_by = [ast.OrderItem(
+                expr=ast.FunctionCall(fn, (near_col, near_q)),
+                descending=desc)]
+            limit = near_k
+            # NULL vectors have no distance: NEAREST returns only rows
+            # with a stored vector, so the sugar fuses the exclusion
+            # into WHERE (where the predicate pass runs BEFORE the
+            # distance matmul) rather than leaving NULL order keys to
+            # the sort's NULLS-first convention.
+            notnull = ast.UnaryOp(
+                "not", ast.FunctionCall("is_null", (near_col,)))
+            where = notnull if where is None \
+                else ast.BinaryOp("and", where, notnull)
         return ast.QueryAst(
             select=select, source=source, source_alias=source_alias,
             joins=tuple(joins), where=where, group_by=group_by,

@@ -691,6 +691,8 @@ class QueryGateway:
             thread_name_prefix="serving")
         self.batcher = LookupBatcher(self.config, self.admission,
                                      self._executor)
+        from ytsaurus_tpu.query.vector import NearestBatcher
+        self.nearest_batcher = NearestBatcher(self.config, self.admission)
         prof = Profiler("/serving")
         self.select_latency = prof.histogram("select_latency_seconds",
                                              bounds=_LATENCY_BOUNDS)
@@ -779,6 +781,24 @@ class QueryGateway:
             pool=token.pool, user=token.user)
         return out
 
+    # -- vector search ---------------------------------------------------------
+
+    def nearest_rows(self, client, path: str, column: str,
+                     query_vector, k: int, metric: str = "l2",
+                     timestamp: Optional[int] = None,
+                     pool: Optional[str] = None,
+                     timeout: Optional[float] = None):
+        """Serve one NEAREST query through the vector micro-batcher:
+        co-admitted queries on (path, column, metric, timestamp)
+        coalesce into ONE batched distance matmul (query/vector.py)."""
+        token = self.make_token(timeout, pool)
+        if timestamp is None:
+            from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+            timestamp = MAX_TIMESTAMP
+        return self.nearest_batcher.nearest(
+            client, path, column, query_vector, k, metric, timestamp,
+            token)
+
     # -- observability ---------------------------------------------------------
 
     def record_statistics(self, stats,
@@ -797,7 +817,8 @@ class QueryGateway:
     def snapshot(self) -> dict:
         return {"enabled": self.enabled,
                 "pools": self.admission.snapshot(),
-                "lookup": self.batcher.snapshot()}
+                "lookup": self.batcher.snapshot(),
+                "nearest": self.nearest_batcher.snapshot()}
 
 
 def serving_snapshot() -> list:

@@ -299,18 +299,39 @@ class RemoteYtClient:
 
     def select_rows(self, query: str, timeout: Optional[float] = None,
                     pool: Optional[str] = None,
-                    explain_analyze: bool = False) -> list[dict]:
-        params: dict = {"query": query}
+                    explain_analyze: bool = False,
+                    params: Optional[Sequence] = None) -> list[dict]:
+        req: dict = {"query": query}
         if timeout is not None:
-            params["timeout"] = timeout
+            req["timeout"] = timeout
         if pool is not None:
-            params["pool"] = pool
+            req["pool"] = pool
         if explain_analyze:
             # Server-side profile, returned as a plain dict (the span
             # tree lives in the PRIMARY's collector; `yt trace` reads it
             # back through the orchid).
-            params["explain_analyze"] = True
-        return self._execute("select_rows", params)
+            req["explain_analyze"] = True
+        if params is not None:
+            # Placeholder (`?`) bindings; vectors ride as JSON lists.
+            req["params"] = list(params)
+        return self._execute("select_rows", req)
+
+    def nearest_rows(self, path: str, column: str, query_vector, k: int,
+                     metric: str = "l2",
+                     timestamp: int = MAX_TIMESTAMP,
+                     timeout: Optional[float] = None,
+                     pool: Optional[str] = None) -> list[dict]:
+        req: dict = {"path": path, "column": column,
+                     "query_vector": list(query_vector), "k": k}
+        if metric != "l2":
+            req["metric"] = metric
+        if timestamp != MAX_TIMESTAMP:
+            req["timestamp"] = timestamp
+        if timeout is not None:
+            req["timeout"] = timeout
+        if pool is not None:
+            req["pool"] = pool
+        return self._execute("nearest_rows", req)
 
     def push_queue(self, path: str, rows: Sequence[dict]) -> int:
         return int(self._execute(

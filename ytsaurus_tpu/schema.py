@@ -15,6 +15,7 @@ TPU-native analog of the reference's TTableSchema / TColumnSchema / logical type
 from __future__ import annotations
 
 import enum
+import re
 from dataclasses import dataclass, field, replace
 from typing import Any, Iterable, Optional
 
@@ -51,6 +52,89 @@ class EValueType(enum.Enum):
         return self is not EValueType.any
 
 
+class VectorType:
+    """Parametric fixed-width float vector type: `vector<float, N>`.
+
+    Not an EValueType member (an enum cannot carry a per-column dim), but
+    duck-types its API (`value`, `is_numeric`, `is_comparable`) so the flat
+    name→type namespaces, `TableSchema.make((name, ty.value))` rebuilds and
+    schema dict round-trips all preserve the dim without special-casing.
+    Instances are INTERNED per dim so `a is b` works wherever code compares
+    EValueType members by identity; the device plane is a contiguous
+    `(capacity, dim)` float32 matrix plus the usual (capacity,) validity
+    mask — the matmul-ready layout NEAREST distance passes scan.
+    """
+
+    __slots__ = ("dim",)
+    _interned: "dict[int, VectorType]" = {}
+
+    def __new__(cls, dim: int):
+        dim = int(dim)
+        if dim <= 0:
+            raise YtError(f"Vector dim must be positive, got {dim}",
+                          code=EErrorCode.QueryTypeError)
+        cached = cls._interned.get(dim)
+        if cached is None:
+            cached = super().__new__(cls)
+            object.__setattr__(cached, "dim", dim)
+            cls._interned[dim] = cached
+        return cached
+
+    def __setattr__(self, name, value):
+        raise AttributeError("VectorType is immutable")
+
+    def __reduce__(self):
+        return (VectorType, (self.dim,))
+
+    @property
+    def value(self) -> str:
+        return f"vector<float,{self.dim}>"
+
+    @property
+    def name(self) -> str:
+        return "vector"
+
+    @property
+    def is_numeric(self) -> bool:
+        return False
+
+    @property
+    def is_arithmetic(self) -> bool:
+        return False
+
+    @property
+    def is_comparable(self) -> bool:
+        # No total order on vectors: ORDER BY / GROUP BY / key columns
+        # reject them; NEAREST orders by a DISTANCE over them instead.
+        return False
+
+    def __repr__(self) -> str:
+        return f"VectorType({self.dim})"
+
+    def __hash__(self) -> int:
+        return hash(("vector", self.dim))
+
+    def __eq__(self, other) -> bool:
+        return self is other
+
+
+_VECTOR_TYPE_RE = re.compile(r"^vector\s*<\s*float\s*,\s*(\d+)\s*>$")
+
+
+def parse_type(ty: "str | EValueType | VectorType") -> "EValueType | VectorType":
+    """Parse a type spelling: EValueType values plus `vector<float,N>`."""
+    if isinstance(ty, (EValueType, VectorType)):
+        return ty
+    m = _VECTOR_TYPE_RE.match(str(ty).strip())
+    if m:
+        return VectorType(int(m.group(1)))
+    try:
+        return EValueType(ty)
+    except ValueError:
+        raise YtError(f"Unknown column type {ty!r}",
+                      code=EErrorCode.QueryTypeError)
+
+
 _DEVICE_DTYPES = {
     EValueType.int64: np.int64,
     EValueType.uint64: np.uint64,
@@ -63,8 +147,12 @@ _DEVICE_DTYPES = {
 }
 
 
-def device_dtype(ty: EValueType) -> np.dtype:
+def device_dtype(ty: "EValueType | VectorType") -> np.dtype:
     """Physical dtype of the device plane backing a column of logical type `ty`."""
+    if isinstance(ty, VectorType):
+        # Fixed-width (capacity, dim) float32 matrix: the MXU-native
+        # element type for the NEAREST distance matmul.
+        return np.dtype(np.float32)
     if ty not in _DEVICE_DTYPES:
         raise YtError(f"Type {ty.value!r} has no device representation",
                       code=EErrorCode.QueryUnsupported)
@@ -81,7 +169,7 @@ class ColumnSchema:
     """One column (ref: client/table_client/schema.h TColumnSchema)."""
 
     name: str
-    type: EValueType
+    type: "EValueType | VectorType"
     sort_order: Optional[SortOrder] = None
     required: bool = False
     expression: Optional[str] = None  # computed column (key evaluator)
@@ -114,7 +202,7 @@ class ColumnSchema:
     def from_dict(cls, d: dict[str, Any]) -> "ColumnSchema":
         return cls(
             name=d["name"],
-            type=EValueType(d["type"]),
+            type=parse_type(d["type"]),
             sort_order=SortOrder(d["sort_order"]) if d.get("sort_order") else None,
             required=bool(d.get("required", False)),
             expression=d.get("expression"),
@@ -152,6 +240,11 @@ class TableSchema:
             elif seen_non_key:
                 raise YtError(
                     f"Key column {col.name!r} appears after a non-key column")
+            elif isinstance(col.type, VectorType):
+                raise YtError(
+                    f"Column {col.name!r} of type {col.type.value} cannot "
+                    "be a key column (no total order on vectors)",
+                    code=EErrorCode.QueryTypeError)
         object.__setattr__(self, "_by_name", by_name)
 
     # --- construction helpers -------------------------------------------------
@@ -167,7 +260,7 @@ class TableSchema:
                 cols.append(ColumnSchema.from_dict(c))
             else:  # ("name", type[, sort_order])
                 name, ty = c[0], c[1]
-                ty = EValueType(ty) if not isinstance(ty, EValueType) else ty
+                ty = parse_type(ty)
                 so = None
                 if len(c) > 2 and c[2] is not None:
                     so = SortOrder(c[2]) if not isinstance(c[2], SortOrder) else c[2]
