@@ -272,6 +272,81 @@ def test_distributed_ladder_soak(_ladder_setup):
     _note_fired()
 
 
+# --- serving plane + artifact store (ISSUE 17) --------------------------------
+
+
+def test_serving_and_artifact_sites_soak():
+    """Overload-resilience failpoints: an injected routing-scrape
+    failure degrades routing (never fails it), injected artifact-store
+    faults are counted (never raised), and an injected failure INSIDE
+    the brown-out decision falls back to full-fidelity execution."""
+    import threading
+    import time
+
+    from ytsaurus_tpu.config import ServingConfig
+    from ytsaurus_tpu.query.engine.aot_cache import ClusterArtifactStore
+    from ytsaurus_tpu.query.routing import ReplicaRouter
+    from ytsaurus_tpu.query.serving import QueryGateway
+
+    # serving.route_scrape: the scrape fails, the replica degrades to
+    # UNKNOWN (penalized in scoring) — no exception escapes.
+    router = ReplicaRouter([("r0", "r0", "127.0.0.1:1")],
+                           scrape_period=999.0)
+    with failpoints.active("serving.route_scrape=error:p=1", seed=101):
+        assert router.scrape_once() == 0
+    assert router.scrape_errors_n >= 1
+    assert router.replicas()[0].scrape_ok is False
+
+    # aot.fetch / aot.publish: loud-but-safe — a fetch fault is one
+    # more miss, a publish fault is one more error, the caller never
+    # sees either.
+    class _DeadBlobs:
+        def put_blob(self, chunk_id, data):
+            raise AssertionError("put_blob past an injected fault")
+
+        def get_blob(self, chunk_id):
+            raise AssertionError("get_blob past an injected fault")
+
+    store = ClusterArtifactStore(_DeadBlobs())
+    with failpoints.active("aot.fetch=error:p=1;aot.publish=error:p=1",
+                           seed=102):
+        assert store.fetch(("q", "fp")) is None
+        assert store.publish(("q", "fp"), object(), "fp", 1.0) is False
+    snap = store.snapshot()
+    assert snap["misses"] >= 1 and snap["errors"] >= 1
+
+    # serving.brownout: drive a gateway to rung 1 (a queued waiter is
+    # all the pressure a 1e-9 threshold needs), then fail the
+    # degradation decision itself — the admitted query must run at
+    # full fidelity (rung 0 on its token), not die.
+    gateway = QueryGateway(ServingConfig(
+        slots=1, max_queue=8, brownout_rung1_seconds=1e-9,
+        brownout_rung2_seconds=1e9, default_staleness_seconds=5.0))
+    hold, entered = threading.Event(), threading.Event()
+
+    def busy(token):
+        entered.set()
+        hold.wait(5.0)
+
+    holder = threading.Thread(
+        target=lambda: gateway.run_select(busy), daemon=True)
+    holder.start()
+    assert entered.wait(5.0)
+    out = []
+    with failpoints.active("serving.brownout=error:p=1", seed=103):
+        waiter = threading.Thread(
+            target=lambda: out.append(
+                gateway.run_select(lambda token: ("ok", token.rung))),
+            daemon=True)
+        waiter.start()
+        time.sleep(0.1)          # queued waiter -> pressure > rung 1
+        hold.set()
+        waiter.join(timeout=5)
+        holder.join(timeout=5)
+    assert out == [("ok", 0)]
+    _note_fired()
+
+
 # --- coverage -----------------------------------------------------------------
 
 
@@ -280,6 +355,12 @@ def test_distributed_ladder_soak(_ladder_setup):
 _PRODUCT_PREFIXES = ("chunks.", "rpc.", "jobs.", "scheduler.", "query.",
                      "parallel.")
 
+# Serving-plane + artifact-store sites (ISSUE 17) guarded by exact name:
+# the wider "serving." namespace also holds sites owned by the
+# test_serving soak, which runs after this module in the tier-1 order.
+_EXACT_SITES = ("serving.route_scrape", "serving.brownout",
+                "aot.fetch", "aot.publish")
+
 
 def test_every_registered_site_fired():
     """The acceptance gate: failpoint counters prove every registered
@@ -287,8 +368,9 @@ def test_every_registered_site_fired():
     if not _FIRED:
         pytest.skip("soak tests did not run in this session")
     registered = {name for name in failpoints.registered_sites()
-                  if name.startswith(_PRODUCT_PREFIXES)}
-    assert len(registered) >= 16, registered
+                  if name.startswith(_PRODUCT_PREFIXES) or
+                  name in _EXACT_SITES}
+    assert len(registered) >= 20, registered
     fired = {name for name, c in failpoints.counters().items()
              if c["triggers"] > 0} | set(_FIRED)
     silent = registered - fired

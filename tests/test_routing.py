@@ -1,0 +1,154 @@
+"""ReplicaRouter unit tests (ISSUE 17): pool-aware (fair-share-aware)
+scoring, quarantine-driven failover, scrape-failure degradation, and
+live membership — all HTTP-free via the scrape-absorb seam."""
+
+import time
+
+import pytest
+
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.query.routing import ReplicaRouter, RoutedYtClient
+
+
+def _payload(pools, hold=0.05, rung=0):
+    return {"gateways": [{"admission": {
+        "hold_ewma": hold,
+        "brownout": {"rung": rung},
+        "pools": {name: {"waiting": w, "in_flight": f, "fair_slots": s}
+                  for name, (w, f, s) in pools.items()}}}]}
+
+
+def _router(n=2):
+    router = ReplicaRouter(
+        [(f"r{i}", f"r{i}", f"m{i}") for i in range(n)],
+        scrape_period=999.0, penalty_seconds=0.05)
+    return router, router.replicas()
+
+
+def test_pool_aware_pick_ignores_other_pools_backlog():
+    """A greedy tenant's 500-deep backlog on one replica must not blind
+    the router for OTHER pools: prod routes by prod's own queue."""
+    router, (r0, r1) = _router()
+    router._absorb(r0, _payload({"prod": (0, 0, 1.0),
+                                 "batch": (500, 1, 1.0)}))
+    router._absorb(r1, _payload({"prod": (2, 1, 1.0),
+                                 "batch": (0, 0, 1.0)}))
+    # prod: r0 has an empty prod queue behind the batch storm; r1 has
+    # two prod waiters on one fair slot.
+    assert router.pick(pool="prod").name == "r0"
+    assert router.pick(pool="batch").name == "r1"
+    # Pool-less picks fall back to the global queue: r1 looks emptier.
+    assert router.pick().name == "r1"
+
+
+def test_pool_latency_ewma_is_isolated_per_pool():
+    """Batch's multi-second queue waits must not poison the latency
+    estimate the router uses for prod."""
+    router, (r0, r1) = _router()
+    for r in (r0, r1):
+        router._absorb(r, _payload({"prod": (0, 0, 1.0)}))
+    # Same replica serves batch terribly and prod quickly.
+    router.report(r0, latency=5.0, pool="batch")
+    router.report(r0, latency=0.01, pool="prod")
+    router.report(r1, latency=0.5, pool="prod")
+    assert router.pick(pool="prod").name == "r0"
+    assert r0.pool_latency["batch"] > r0.pool_latency["prod"]
+
+
+def test_report_error_quarantines_then_recovers():
+    router, (r0, r1) = _router()
+    for r in (r0, r1):
+        router._absorb(r, _payload({"prod": (0, 0, 1.0)}))
+    router.report(r0, error=True)
+    assert router.failovers_n == 1
+    for _ in range(4):                   # quarantined: never picked
+        assert router.pick(pool="prod").name == "r1"
+    time.sleep(0.06)                     # penalty_seconds elapsed
+    names = {router.pick(pool="prod").name for _ in range(4)}
+    assert "r0" in names
+
+
+def test_scrape_failure_degrades_to_unknown_penalty():
+    router, (r0, r1) = _router()
+    router._absorb(r0, _payload({"prod": (50, 1, 1.0)}))
+    # r1 was never scraped: UNKNOWN outweighs even a 50-deep queue.
+    assert not r1.scrape_ok
+    assert router.pick(pool="prod").name == "r0"
+
+
+def test_brownout_rung_penalizes_replica():
+    router, (r0, r1) = _router()
+    router._absorb(r0, _payload({"prod": (0, 0, 1.0)}, rung=2))
+    router._absorb(r1, _payload({"prod": (3, 1, 1.0)}))
+    # A shedding replica is routed around while any alternative exists.
+    assert router.pick(pool="prod").name == "r1"
+
+
+def test_pick_with_no_replicas_raises_peer_unavailable():
+    router = ReplicaRouter([], scrape_period=999.0)
+    with pytest.raises(YtError) as err:
+        router.pick()
+    assert err.value.code == EErrorCode.PeerUnavailable
+
+
+class _FakeClient:
+    def __init__(self, dead=False):
+        self.dead = dead
+        self.calls = 0
+
+    def select_rows(self, query, **kwargs):
+        self.calls += 1
+        if self.dead:
+            raise YtError("replica down",
+                          code=EErrorCode.TransportError)
+        return ["rows"]
+
+
+def test_routed_client_fails_over_once_and_quarantines():
+    router, (r0, r1) = _router()
+    # r0 is strictly more attractive — and dead.
+    router._absorb(r0, _payload({"prod": (0, 0, 1.0)}))
+    router._absorb(r1, _payload({"prod": (5, 1, 1.0)}))
+    dead, alive = _FakeClient(dead=True), _FakeClient()
+    routed = RoutedYtClient(router, {"r0": dead, "r1": alive})
+    assert routed.select_rows("q", pool="prod") == ["rows"]
+    assert dead.calls == 1 and alive.calls == 1
+    assert router.failovers_n == 1
+    # The corpse is quarantined: the next call goes straight to r1.
+    assert routed.select_rows("q", pool="prod") == ["rows"]
+    assert dead.calls == 1 and alive.calls == 2
+
+
+def test_routed_client_application_errors_pass_through():
+    """Only transport-class failures fail over; an application error
+    (bad query) must surface, not burn a second replica."""
+    router, (r0, r1) = _router()
+    router._absorb(r0, _payload({"prod": (0, 0, 1.0)}))
+
+    class _BadQuery(_FakeClient):
+        def select_rows(self, query, **kwargs):
+            self.calls += 1
+            raise YtError("syntax error",
+                          code=EErrorCode.QueryParseError)
+
+    bad, other = _BadQuery(), _FakeClient()
+    routed = RoutedYtClient(router, {"r0": bad, "r1": other})
+    with pytest.raises(YtError) as err:
+        routed.select_rows("q", pool="prod")
+    assert err.value.code == EErrorCode.QueryParseError
+    assert bad.calls + other.calls == 1
+    assert router.failovers_n == 0
+
+
+def test_add_replica_joins_live():
+    router, (r0,) = _router(n=1)
+    router._absorb(r0, _payload({"prod": (9, 1, 1.0)}))
+    clients = {"r0": _FakeClient()}
+    routed = RoutedYtClient(router, clients)
+    joiner = _FakeClient()
+    routed.add_replica(("r9", "r9", "m9"), joiner)
+    names = {r.name for r in router.replicas()}
+    assert names == {"r0", "r9"}
+    # The joiner starts un-scraped (UNKNOWN penalty) — picks stay on
+    # the known replica until a scrape reports the newcomer's load.
+    assert router.pick(pool="prod").name == "r0"

@@ -140,8 +140,11 @@ def test_weighted_pools_and_unknown_pool_falls_back():
     config = ServingConfig(slots=8, pools={"default": 1.0, "heavy": 3.0})
     gateway = QueryGateway(config)
     pools = gateway.snapshot()["pools"]
-    assert pools["heavy"]["slots"] == 6
-    assert pools["default"]["slots"] == 2
+    assert pools["heavy"]["weight"] == 3.0
+    assert pools["default"]["weight"] == 1.0
+    # Idle pools have no demand, so no fair share is reserved (work-
+    # conserving: either pool may burst to all 8 slots while alone).
+    assert pools["heavy"]["fair_slots"] == 0.0
     # Unknown pool name routes to default_pool instead of failing.
     assert gateway.run_select(lambda token: "ok", pool="nope") == "ok"
     assert gateway.snapshot()["pools"]["default"]["admitted"] == 1
@@ -152,6 +155,141 @@ def test_serving_config_validation():
         ServingConfig(pools={"default": -1.0})
     with pytest.raises(YtError):
         ServingConfig(pools={"a": 1.0}, default_pool="b")
+
+
+def test_fair_share_conservation_and_isolation_under_storm():
+    """8 threads storm two pools at once: the slot budget is never
+    exceeded (conservation), the greedy pool's hard limit holds, every
+    request completes, and the guaranteed pool's admission waits stay
+    far below the greedy pool's (isolation)."""
+    gateway = QueryGateway(ServingConfig(
+        slots=3, max_queue=1000, default_pool="prod",
+        pools={"prod": 3.0, "batch": 1.0}, pool_limits={"batch": 1}))
+    lock = threading.Lock()
+    running = {"total": 0, "prod": 0, "batch": 0,
+               "max_total": 0, "max_batch": 0}
+    waits = {"prod": [], "batch": []}
+
+    def make_fn(pool):
+        def fn(token):
+            with lock:
+                running["total"] += 1
+                running[pool] += 1
+                running["max_total"] = max(running["max_total"],
+                                           running["total"])
+                if pool == "batch":
+                    running["max_batch"] = max(running["max_batch"],
+                                               running["batch"])
+            time.sleep(0.002)
+            with lock:
+                running["total"] -= 1
+                running[pool] -= 1
+        return fn
+
+    def storm(pool, count):
+        fn = make_fn(pool)
+        for _ in range(count):
+            t0 = time.monotonic()
+            gateway.run_select(fn, pool=pool, timeout=30.0)
+            with lock:
+                waits[pool].append(time.monotonic() - t0)
+
+    threads = [threading.Thread(target=storm, args=("prod", 30),
+                                daemon=True) for _ in range(2)] + \
+              [threading.Thread(target=storm, args=("batch", 30),
+                                daemon=True) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert running["max_total"] <= 3            # conservation
+    assert running["max_batch"] <= 1            # hard pool limit
+    pools = gateway.snapshot()["pools"]
+    assert pools["prod"]["admitted"] == 60      # nothing lost
+    assert pools["batch"]["admitted"] == 180
+    assert pools["prod"]["rejected"] == 0
+    assert pools["batch"]["rejected"] == 0
+    # Isolation: 6 batch threads fight over 1 slot while 2 prod threads
+    # share 2 — prod's mean wall time must sit well below batch's.
+    prod_mean = sum(waits["prod"]) / len(waits["prod"])
+    batch_mean = sum(waits["batch"]) / len(waits["batch"])
+    assert batch_mean > prod_mean * 2, (prod_mean, batch_mean)
+
+
+def test_dynamic_pool_resize_admits_waiters_mid_traffic():
+    """apply_config mid-traffic: a queued waiter must be admitted the
+    moment the slot budget widens — without waiting for the held slot
+    to release — and freshly declared pools appear live."""
+    gateway = QueryGateway(ServingConfig(slots=1, max_queue=100))
+    release, thread = _held_slot(gateway)
+    results = []
+    waiter = threading.Thread(
+        target=lambda: results.append(
+            gateway.run_select(lambda token: "ran")), daemon=True)
+    waiter.start()
+    time.sleep(0.05)
+    assert not results                   # queued behind the held slot
+    gateway.admission.apply_config(ServingConfig(
+        slots=4, max_queue=100,
+        pools={"default": 1.0, "fresh": 2.0}))
+    waiter.join(timeout=5)
+    assert results == ["ran"]            # admitted by the resize alone
+    pools = gateway.snapshot()["pools"]
+    assert pools["fresh"]["weight"] == 2.0
+    release()
+    thread.join(timeout=5)
+
+
+# --- brown-out ladder ---------------------------------------------------------
+
+
+def test_brownout_rung1_staleness_bound_and_disengage():
+    """Rung 1 rides the pool's declared staleness bound down on the
+    admitted token; once the queue drains, the snapshot heartbeat walks
+    the ladder back to rung 0 and tallies one engagement."""
+    gateway = QueryGateway(ServingConfig(
+        slots=1, max_queue=10, brownout_rung1_seconds=1e-9,
+        brownout_rung2_seconds=1e9, brownout_min_dwell_seconds=0.0,
+        staleness_bounds={"default": 7.5}))
+    release, thread = _held_slot(gateway)
+    seen = []
+    waiter = threading.Thread(
+        target=lambda: seen.append(gateway.run_select(
+            lambda token: (token.rung, token.staleness_bound))),
+        daemon=True)
+    waiter.start()
+    time.sleep(0.05)                     # queued -> pressure > rung 1
+    release()
+    waiter.join(timeout=5)
+    thread.join(timeout=5)
+    assert seen == [(1, 7.5)]
+    snap = gateway.snapshot()["admission"]["brownout"]
+    assert snap["rung"] == 0             # heartbeat walked it back down
+    assert snap["engaged"] == 1
+    assert snap["transitions"] >= 2
+    assert snap["log"][0]["to"] == 1
+
+
+def test_brownout_rung2_sheds_new_load_with_retry_after():
+    gateway = QueryGateway(ServingConfig(
+        slots=1, max_queue=10, brownout_rung1_seconds=1e-9,
+        brownout_rung2_seconds=1e-9, brownout_min_dwell_seconds=0.0))
+    release, thread = _held_slot(gateway)
+    waiter = threading.Thread(
+        target=lambda: gateway.run_select(lambda token: None,
+                                          timeout=10.0), daemon=True)
+    waiter.start()
+    time.sleep(0.05)                     # one waiter -> pressure > 0
+    try:
+        with pytest.raises(ThrottledError) as err:
+            gateway.run_select(lambda token: None)
+        assert err.value.retry_after > 0
+        assert err.value.attributes["brownout_rung"] == 2
+        assert gateway.snapshot()["admission"]["brownout"]["shed"] == 1
+    finally:
+        release()
+        waiter.join(timeout=5)
+        thread.join(timeout=5)
 
 
 # --- lookup micro-batching ----------------------------------------------------
@@ -395,6 +533,55 @@ def test_retrying_channel_throttle_exhaustion_keeps_code():
     assert stub.calls == 3
     assert err.value.contains(EErrorCode.RequestThrottled)
     assert retry_after_hint(err.value) == 0.001
+
+
+def test_retrying_channel_backoff_capped_by_deadline():
+    """Regression (ISSUE 17): a throttle hinting a 30s wait against a
+    0.2s caller deadline must sleep at most token.remaining() and then
+    surface DeadlineExceeded promptly — never serve out the hint."""
+    from ytsaurus_tpu.rpc.channel import RetryingChannel
+    stub = _ScriptedChannel([ThrottledError(retry_after=30.0)] * 5)
+    channel = RetryingChannel(stub, attempts=5)
+    token = CancellationToken.with_timeout(0.2)
+    t0 = time.monotonic()
+    with pytest.raises(YtError) as err:
+        channel.call("svc", "m", token=token)
+    elapsed = time.monotonic() - t0
+    assert err.value.code == EErrorCode.DeadlineExceeded
+    assert stub.calls == 1               # one attempt, one capped sleep
+    assert 0.15 <= elapsed < 2.0         # ~the deadline, not the hint
+
+
+def test_retrying_channel_budget_exhaustion_fails_fast():
+    from ytsaurus_tpu.rpc.channel import RetryingChannel, _RetryBudget
+    stub = _ScriptedChannel([
+        YtError("conn reset", code=EErrorCode.TransportError)] * 10)
+    channel = RetryingChannel(stub, attempts=5, backoff=0.001)
+    channel.retry_budget = _RetryBudget(1, 0.1)
+    with pytest.raises(YtError) as err:
+        channel.call("svc", "m")
+    # One free failure + one budgeted retry, then the dry bucket fails
+    # fast instead of serving out the remaining attempts.
+    assert stub.calls == 2
+    assert err.value.attributes["retry_budget_exhausted"] is True
+    assert err.value.code == EErrorCode.PeerUnavailable
+    snap = channel.retry_budget.snapshot()
+    assert snap["spent"] == 1 and snap["exhausted"] == 1
+
+
+def test_retry_budget_refills_on_success_only():
+    from ytsaurus_tpu.rpc.channel import _RetryBudget
+    budget = _RetryBudget(2, 0.5)
+    assert budget.try_spend() and budget.try_spend()
+    assert not budget.try_spend()        # dry
+    budget.deposit()                     # one success: +0.5 token
+    assert not budget.try_spend()        # still below a whole token
+    budget.deposit()
+    assert budget.try_spend()            # two successes buy one retry
+    # Deposits cap at capacity.
+    for _ in range(20):
+        budget.deposit()
+    assert budget.snapshot()["tokens"] == 2.0
 
 
 # --- exec node admission ------------------------------------------------------

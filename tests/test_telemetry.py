@@ -702,6 +702,36 @@ def test_yt_top_formatting():
     assert len(by_user.splitlines()) == 3   # header + 1 row + TOTAL
 
 
+def test_yt_top_fair_share_columns():
+    """`yt top --by pool` overlays the admission controller's LIVE
+    fair-share state (share/use/demand) on the usage history — a pool
+    that is queued but has finished nothing still gets a row, and a
+    pool the serving plane doesn't know renders '-' (ISSUE 17)."""
+    from ytsaurus_tpu.cli import _format_top
+    acct = ResourceAccountant(registry=ProfilerRegistry())
+    acct.fold("prod", "alice", queries=5, wall_seconds=2.5)
+    acct.fold("legacy", "bob", queries=1, wall_seconds=9.0)
+    serving = {"gateways": [{"admission": {"pools": {
+        "prod": {"fair_slots": 1.5, "in_flight": 1, "waiting": 0,
+                 "demand": 1},
+        "batch": {"fair_slots": 0.5, "in_flight": 1, "waiting": 40,
+                  "demand": 41}}}}]}
+    text = _format_top(acct.snapshot(), by="pool",
+                       sort_key="wall_seconds", limit=0,
+                       serving=serving)
+    lines = text.splitlines()
+    assert lines[0].split()[-3:] == ["share", "use", "demand"]
+    rows = {line.split()[0]: line.split() for line in lines[1:]}
+    assert rows["prod"][-3:] == ["1.50", "1", "1"]
+    assert rows["batch"][-3:] == ["0.50", "1", "41"]   # queued-only pool
+    assert rows["legacy"][-3:] == ["-", "-", "-"]      # no serving view
+    assert rows["TOTAL"][-3:] == ["2.00", "2", "42"]
+    # Without a serving snapshot the columns drop entirely.
+    plain = _format_top(acct.snapshot(), by="pool",
+                        sort_key="wall_seconds", limit=0)
+    assert "share" not in plain.splitlines()[0]
+
+
 # --- global wiring ------------------------------------------------------------
 
 

@@ -97,12 +97,41 @@ _TOP_COLUMNS = ("queries", "lookups", "rows_read", "bytes_read",
                 "compile_seconds", "execute_seconds", "wall_seconds",
                 "throttled", "jobs")
 
+# Fair-share columns appended when --by pool (ISSUE 17): the admission
+# controller's live allocation next to the historical usage — share is
+# the pool's fair allocation in slots, use its running queries, demand
+# running + queued.  demand >> share is the "who is being squeezed"
+# signal the brown-out ladder and the SLO bench act on.
+_FAIR_COLUMNS = ("share", "use", "demand")
+
+
+def _serving_pool_rollup(gateways: list) -> dict:
+    """Aggregate per-pool fair-share state across the live gateways."""
+    rollup: dict = {}
+    for gw in gateways or []:
+        admission = (gw or {}).get("admission") or {}
+        for name, pool in (admission.get("pools") or {}).items():
+            agg = rollup.setdefault(
+                name, {"share": 0.0, "use": 0, "demand": 0})
+            agg["share"] += float(pool.get("fair_slots", 0.0))
+            agg["use"] += int(pool.get("in_flight", 0))
+            agg["demand"] += int(pool.get("demand",
+                                          pool.get("in_flight", 0) +
+                                          pool.get("waiting", 0)))
+    return rollup
+
 
 def _format_top(snapshot: dict, by: str, sort_key: str,
-                limit: int) -> str:
+                limit: int, serving: Optional[dict] = None) -> str:
     """`yt top --by pool`: per-tenant resource usage, heaviest first —
     the serving-plane answer to "who is eating the cluster"."""
-    rollup = snapshot.get(f"by_{by}") or {}
+    rollup = dict(snapshot.get(f"by_{by}") or {})
+    fair = _serving_pool_rollup((serving or {}).get("gateways")) \
+        if by == "pool" else {}
+    # A pool can be queued (demand) before any query of it finishes
+    # (usage) — fair-share-only pools still get a row.
+    for name in fair:
+        rollup.setdefault(name, {})
     rows = sorted(rollup.items(),
                   key=lambda kv: -float(kv[1].get(sort_key, 0.0)))
     if limit > 0:
@@ -118,16 +147,49 @@ def _format_top(snapshot: dict, by: str, sort_key: str,
                 else f"{value:.0f}"
         return f"{value:.0f}"
 
-    header = [by, *_TOP_COLUMNS]
-    table = [[name, *[fmt(record, f) for f in _TOP_COLUMNS]]
+    def fair_cells(name):
+        if not fair:
+            return []
+        pool = fair.get(name)
+        if pool is None:
+            return ["-"] * len(_FAIR_COLUMNS)
+        return [f"{pool['share']:.2f}", f"{pool['use']:.0f}",
+                f"{pool['demand']:.0f}"]
+
+    fair_header = list(_FAIR_COLUMNS) if fair else []
+    header = [by, *_TOP_COLUMNS, *fair_header]
+    table = [[name, *[fmt(record, f) for f in _TOP_COLUMNS],
+              *fair_cells(name)]
              for name, record in rows]
-    table.append(["TOTAL", *[fmt(totals, f) for f in _TOP_COLUMNS]])
+    fair_totals = []
+    if fair:
+        fair_totals = [
+            f"{sum(p['share'] for p in fair.values()):.2f}",
+            f"{sum(p['use'] for p in fair.values()):.0f}",
+            f"{sum(p['demand'] for p in fair.values()):.0f}"]
+    table.append(["TOTAL", *[fmt(totals, f) for f in _TOP_COLUMNS],
+                  *fair_totals])
     widths = [max(len(str(row[i])) for row in [header, *table])
               for i in range(len(header))]
     lines = ["  ".join(str(cell).rjust(width)
                        for cell, width in zip(row, widths))
              for row in [header, *table]]
     return "\n".join(lines)
+
+
+def _fetch_serving(cl) -> dict:
+    """The /serving snapshot (fair-share admission state) for the
+    `yt top --by pool` share/use/demand columns.  Best-effort: a
+    cluster without a serving plane just drops the columns — usage
+    history still renders."""
+    try:
+        if hasattr(cl, "get_orchid"):
+            return _decode_deep(cl.get_orchid("/serving") or {})
+        from ytsaurus_tpu.query.serving import serving_snapshot
+        return {"gateways": serving_snapshot()}
+    except Exception:   # noqa: BLE001 — the fair-share columns are an
+        # overlay on the usage table, not the table itself.
+        return {}
 
 
 def _fetch_workload(cl) -> dict:
@@ -593,9 +655,13 @@ def _dispatch(cl, a):
         return None
     if c == "top":
         snapshot = _fetch_accounting(cl)
+        serving = _fetch_serving(cl) if a.by == "pool" else None
         if a.json:
+            if serving:
+                snapshot = dict(snapshot)
+                snapshot["serving"] = serving
             return snapshot
-        print(_format_top(snapshot, a.by, a.sort, a.limit))
+        print(_format_top(snapshot, a.by, a.sort, a.limit, serving))
         return None
     if c == "workload":
         from ytsaurus_tpu.query import workload as wl

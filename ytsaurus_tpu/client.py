@@ -1460,7 +1460,8 @@ class YtClient:
             lazy = plan.limit is not None and plan.group is None
             source_chunks = self._query_shards(plan.source, timestamp,
                                                intervals=intervals,
-                                               stats=stats, lazy=lazy)
+                                               stats=stats, lazy=lazy,
+                                               token=token)
             # Tablet shards of a sorted dynamic table arrive in pivot
             # order: range-ordered by the key columns, which unlocks the
             # ORDER BY <key prefix> LIMIT early exit.
@@ -1484,6 +1485,12 @@ class YtClient:
                                      merge_shards_below=4_000_000,
                                      range_ordered_by=range_ordered_by,
                                      stats=stats, token=token)
+        if token is not None and token.rung:
+            # Tag the degraded response (brown-out ladder): the rung and
+            # the actual staleness served land in the query statistics,
+            # which flow to the slow log, EXPLAIN ANALYZE, and drivers.
+            stats.degraded_rung = token.rung
+            stats.degraded_staleness = round(token.stale_served, 6)
         if self.cluster._gateway is not None:
             self.cluster.gateway.record_statistics(
                 stats, self.cluster.evaluator.cache_size())
@@ -1827,7 +1834,7 @@ class YtClient:
 
     def _query_shards(self, path: str, timestamp: int,
                       intervals=None, stats=None,
-                      lazy: bool = False) -> list:
+                      lazy: bool = False, token=None) -> list:
         """Shard chunks for a scan.  lazy=True returns zero-arg
         SUPPLIERS instead of chunks: staging (tablet snapshot / chunk
         decode) is deferred into the coordinator's adaptive prefetcher,
@@ -1856,6 +1863,23 @@ class YtClient:
                     return [(lambda t=t: t.snapshot(concrete))
                             for t in tablets]
                 return [t.snapshot(concrete) for t in tablets]
+            # Brown-out rung 1 (ISSUE 17): an admitted-degraded token
+            # carries the pool's staleness bound; sorted tablets then
+            # serve their snapshot cache within the bound instead of
+            # paying the MVCC merge, and the token records the max
+            # staleness actually served so the response can be tagged.
+            bound = getattr(token, "staleness_bound", None)
+            if bound:
+                def _read_bounded(t, ts):
+                    chunk, stale = t.read_snapshot_bounded(ts, bound)
+                    if token is not None and \
+                            stale > token.stale_served:
+                        token.stale_served = stale
+                    return chunk
+                if lazy:
+                    return [(lambda t=t, ts=timestamp:
+                             _read_bounded(t, ts)) for t in tablets]
+                return [_read_bounded(t, timestamp) for t in tablets]
             if lazy:
                 return [(lambda t=t, ts=timestamp: t.read_snapshot(ts))
                         for t in tablets]

@@ -213,6 +213,14 @@ class RetryPolicyConfig(YsonStruct):
     backoff = param(0.2, type=float, ge=0.0)
     backoff_cap = param(3.0, type=float, ge=0.0)
     jitter = param(0.2, type=float, ge=0.0, le=1.0)
+    # Token-bucket retry budget (ISSUE 17): each retry spends one token,
+    # each SUCCESSFUL call deposits `retry_budget_refill` tokens (capped
+    # at `retry_budget`), and a throttled outcome deposits NOTHING — an
+    # overloaded cluster sees its retry traffic decay instead of a
+    # retry storm.  0 disables the budget (unbounded retries, the
+    # pre-ISSUE-17 behavior).
+    retry_budget = param(0, type=int, ge=0)
+    retry_budget_refill = param(0.1, type=float, ge=0.0)
 
     def delay(self, attempt: int, rng=None) -> float:
         base = min(self.backoff * (2 ** attempt), self.backoff_cap)
@@ -738,11 +746,40 @@ class ServingConfig(YsonStruct):
     + lookup sessions (query_agent/query_service.cpp)."""
 
     enabled = param(True, type=bool)
-    # Total concurrent query slots, split across pools by weight.
+    # Total concurrent query slots, shared by every pool under fair-share
+    # admission (ISSUE 17): min-share guarantees first, then weight-
+    # proportional water filling capped by live demand — the scalar
+    # collapse of vector HDRF (operations/fair_share.py).
     slots = param(16, type=int, ge=1)
     # pool name -> weight; pools not listed here use default_pool's slots.
     pools = param(default_factory=lambda: {"default": 1.0}, type=dict)
     default_pool = param("default", type=str)
+    # pool name -> guaranteed share of `slots` in [0, 1] (vector-HDRF
+    # min_share_ratio): honored before weight-proportional filling, so
+    # an idle pool's guarantee survives a neighbor's storm.
+    min_shares = param(default_factory=dict, type=dict)
+    # pool name -> hard cap on concurrently running queries (fair share
+    # never raises a pool past its cap).
+    pool_limits = param(default_factory=dict, type=dict)
+    # Brown-out ladder (ISSUE 17): under sustained overload reads degrade
+    # explicitly — rung 0 full execution, rung 1 bounded-staleness
+    # snapshot-cache reads, rung 2 reject-with-retry_after.  The signal
+    # is estimated queue drain time: total_waiting * hold_ewma / slots
+    # (queue depth AND observed drain rate in one number).  Rungs step
+    # UP immediately and step DOWN one at a time, only after
+    # `brownout_min_dwell_seconds` in the rung with the signal below
+    # `threshold * brownout_hysteresis` — no flapping at the boundary.
+    brownout_enabled = param(True, type=bool)
+    brownout_rung1_seconds = param(0.5, type=float, ge=0.0)
+    brownout_rung2_seconds = param(2.0, type=float, ge=0.0)
+    brownout_hysteresis = param(0.5, type=float, ge=0.0, le=1.0)
+    brownout_min_dwell_seconds = param(1.0, type=float, ge=0.0)
+    # pool name -> max staleness (seconds) a rung-1 degraded read may
+    # serve from the tablet snapshot cache; pools absent here use
+    # `default_staleness_seconds`.  0 opts the pool out of degradation
+    # (its reads stay full-execution until rung 2 sheds them).
+    staleness_bounds = param(default_factory=dict, type=dict)
+    default_staleness_seconds = param(5.0, type=float, ge=0.0)
     # Admitted-but-waiting requests per pool; overflow => ThrottledError.
     max_queue = param(128, type=int, ge=0)
     # Deadline applied when the caller passes none (0 = no deadline).
@@ -774,6 +811,47 @@ class ServingConfig(YsonStruct):
             raise YtError(
                 f"Serving default_pool {self.default_pool!r} is not in "
                 f"pools {sorted(self.pools)!r}",
+                code=EErrorCode.InvalidConfig)
+        self.min_shares = {
+            (k.decode("utf-8") if isinstance(k, bytes) else k): v
+            for k, v in (self.min_shares or {}).items()}
+        for name, ratio in self.min_shares.items():
+            if isinstance(ratio, bool) or \
+                    not isinstance(ratio, (int, float)) or \
+                    not 0.0 <= ratio <= 1.0:
+                raise YtError(
+                    f"Serving pool {name!r}: min_share must be in "
+                    f"[0, 1], got {ratio!r}", code=EErrorCode.InvalidConfig)
+        if sum(self.min_shares.values()) > 1.0 + 1e-9:
+            raise YtError(
+                f"Serving min_shares sum to "
+                f"{sum(self.min_shares.values()):.3f} > 1.0 — the "
+                f"guarantees are not satisfiable",
+                code=EErrorCode.InvalidConfig)
+        self.pool_limits = {
+            (k.decode("utf-8") if isinstance(k, bytes) else k): v
+            for k, v in (self.pool_limits or {}).items()}
+        for name, limit in self.pool_limits.items():
+            if isinstance(limit, bool) or not isinstance(limit, int) \
+                    or limit < 1:
+                raise YtError(
+                    f"Serving pool {name!r}: pool_limit must be a "
+                    f"positive int, got {limit!r}",
+                    code=EErrorCode.InvalidConfig)
+        self.staleness_bounds = {
+            (k.decode("utf-8") if isinstance(k, bytes) else k): v
+            for k, v in (self.staleness_bounds or {}).items()}
+        for name, bound in self.staleness_bounds.items():
+            if isinstance(bound, bool) or \
+                    not isinstance(bound, (int, float)) or bound < 0:
+                raise YtError(
+                    f"Serving pool {name!r}: staleness bound must be a "
+                    f"non-negative number, got {bound!r}",
+                    code=EErrorCode.InvalidConfig)
+        if self.brownout_rung2_seconds < self.brownout_rung1_seconds:
+            raise YtError(
+                "Serving brownout_rung2_seconds must be >= "
+                "brownout_rung1_seconds",
                 code=EErrorCode.InvalidConfig)
 
 

@@ -33,11 +33,22 @@ from typing import Optional
 
 import jax
 
+from ytsaurus_tpu.errors import EErrorCode, YtError
+from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.logging import get_logger
 from ytsaurus_tpu.utils.profiling import Profiler
 from ytsaurus_tpu.utils import sanitizers
 
 logger = get_logger("AotCache")
+
+_FP_PUBLISH = failpoints.register_site(
+    "aot.publish",
+    error=lambda s: YtError(f"injected artifact publish failure at {s}",
+                            code=EErrorCode.TransportError))
+_FP_FETCH = failpoints.register_site(
+    "aot.fetch",
+    error=lambda s: YtError(f"injected artifact fetch failure at {s}",
+                            code=EErrorCode.TransportError))
 
 # Bump when the on-disk artifact layout changes incompatibly: readers
 # refuse mismatched headers loudly instead of unpickling garbage.
@@ -51,6 +62,75 @@ def _backend() -> str:
         return jax.default_backend()
     except Exception:   # noqa: BLE001 — backend probe must never raise
         return "unknown"
+
+
+def artifact_digest(key: tuple) -> str:
+    """The cluster-stable name of one compile artifact: digests the
+    full cache key (fingerprint, capacity, binding shapes + structure —
+    plain ints/strings, identical across processes) plus backend, jax
+    version, and the artifact schema, so replicas of one homogeneous
+    cluster agree on names and an upgraded replica simply sees a cold
+    tier."""
+    text = repr((key, _backend(), jax.__version__, AOT_SCHEMA_VERSION))
+    return hashlib.sha256(text.encode()).hexdigest()[:40]
+
+
+def encode_artifact(compiled, fingerprint: str,
+                    compile_seconds: float) -> bytes:
+    """Serialize one AOT executable to the shared artifact wire/disk
+    format: one versioned JSON header line + the pickled
+    serialize_executable product.  Raises on unserializable
+    executables — callers treat that as 'cannot persist'."""
+    from jax.experimental.serialize_executable import serialize
+    payload, in_tree, out_tree = serialize(compiled)
+    header = json.dumps({
+        "aot_schema": AOT_SCHEMA_VERSION,
+        "jax": jax.__version__,
+        "backend": _backend(),
+        "fingerprint": fingerprint,
+        "compile_seconds": round(compile_seconds, 6),
+        "created_at": time.time(),
+    }).encode() + b"\n"
+    return header + pickle.dumps((payload, in_tree, out_tree))
+
+
+def _artifact_header_problem(header) -> Optional[str]:
+    if not isinstance(header, dict):
+        return "missing header"
+    if header.get("aot_schema") != AOT_SCHEMA_VERSION:
+        return (f"aot schema {header.get('aot_schema')!r}, this "
+                f"build speaks {AOT_SCHEMA_VERSION}")
+    if header.get("jax") != jax.__version__:
+        return (f"compiled under jax {header.get('jax')!r}, this "
+                f"process runs {jax.__version__}")
+    if header.get("backend") != _backend():
+        return (f"compiled for backend {header.get('backend')!r}, "
+                f"this process runs {_backend()!r}")
+    return None
+
+
+def decode_artifact(blob: bytes, origin: str):
+    """Deserialize one artifact blob back into a loaded executable, or
+    None — loud-but-safe, same versioned-header discipline as the disk
+    tier (a rotted or mismatched artifact falls back to a fresh
+    compile, never fails the query)."""
+    try:
+        newline = blob.index(b"\n")
+        header = json.loads(blob[:newline] or b"{}")
+        problem = _artifact_header_problem(header)
+        if problem is not None:
+            logger.warning("refusing compile artifact %s: %s",
+                           origin, problem)
+            return None
+        payload, in_tree, out_tree = pickle.loads(blob[newline + 1:])
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+        return deserialize_and_load(payload, in_tree, out_tree)
+    except Exception as exc:   # noqa: BLE001 — loud-but-safe
+        logger.warning("compile artifact %s unreadable (%r); "
+                       "falling back to fresh compile", origin, exc)
+        return None
 
 
 class DiskCompileCache:
@@ -81,15 +161,10 @@ class DiskCompileCache:
     # -- keying ----------------------------------------------------------------
 
     def _path(self, key: tuple) -> str:
-        """Artifact path for one full compile-cache key.  The digest
-        covers the key (fingerprint, capacity, binding shapes +
-        structure — all plain ints/strings, stable across processes)
-        plus backend and jax version, so an upgraded daemon simply sees
-        a cold cache rather than refusing every file."""
-        text = repr((key, _backend(), jax.__version__,
-                     AOT_SCHEMA_VERSION))
-        digest = hashlib.sha256(text.encode()).hexdigest()[:40]
-        return os.path.join(self._dir, digest + _SUFFIX)
+        """Artifact path for one full compile-cache key — the same
+        `artifact_digest` name the cluster store uses, so the tiers
+        agree on identity."""
+        return os.path.join(self._dir, artifact_digest(key) + _SUFFIX)
 
     # -- load ------------------------------------------------------------------
 
@@ -132,18 +207,7 @@ class DiskCompileCache:
         return fn
 
     def _header_problem(self, header: dict) -> Optional[str]:
-        if not isinstance(header, dict):
-            return "missing header"
-        if header.get("aot_schema") != AOT_SCHEMA_VERSION:
-            return (f"aot schema {header.get('aot_schema')!r}, this "
-                    f"build speaks {AOT_SCHEMA_VERSION}")
-        if header.get("jax") != jax.__version__:
-            return (f"compiled under jax {header.get('jax')!r}, this "
-                    f"process runs {jax.__version__}")
-        if header.get("backend") != _backend():
-            return (f"compiled for backend {header.get('backend')!r}, "
-                    f"this process runs {_backend()!r}")
-        return None
+        return _artifact_header_problem(header)
 
     # -- store -----------------------------------------------------------------
 
@@ -155,21 +219,11 @@ class DiskCompileCache:
             return False
         path = self._path(key)
         try:
-            from jax.experimental.serialize_executable import serialize
-            payload, in_tree, out_tree = serialize(compiled)
-            header = json.dumps({
-                "aot_schema": AOT_SCHEMA_VERSION,
-                "jax": jax.__version__,
-                "backend": _backend(),
-                "fingerprint": fingerprint,
-                "compile_seconds": round(compile_seconds, 6),
-                "created_at": time.time(),
-            }).encode() + b"\n"
-            blob = pickle.dumps((payload, in_tree, out_tree))
+            blob = encode_artifact(compiled, fingerprint,
+                                   compile_seconds)
             os.makedirs(self._dir, exist_ok=True)
             tmp = path + f".tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
-                f.write(header)
                 f.write(blob)
             os.replace(tmp, path)
         except Exception as exc:   # noqa: BLE001 — persistence is an
@@ -256,13 +310,130 @@ class DiskCompileCache:
             }
 
 
+# -- cluster tier (ISSUE 17) ---------------------------------------------------
+
+class ClusterArtifactStore:
+    """The CLUSTER tier of the compile ladder: memory LRU → disk →
+    THIS → fresh compile.  Artifacts publish-on-compile to the
+    chunk-backed remote store and fetch-on-miss, so a replica added
+    mid-storm serves its first query of every hot shape by
+    deserializing a ready executable over the wire — zero inline
+    compiles on scale-out (the elastic arm of the JIT cold-start tax,
+    PAPERS.md arxiv 2311.04692).
+
+    `blob_store` is anything with `put_blob(chunk_id, bytes)` /
+    `get_blob(chunk_id)` — FsChunkStore locally, RpcChunkStore across
+    daemons (rendezvous placement + replication ride for free).
+    Artifact names are `aot-<artifact_digest>`: content-addressed, so
+    replicas of one homogeneous cluster converge on one copy and a
+    double publish is idempotent.
+
+    Same loud-but-safe posture as the disk tier: every failure is
+    counted + logged, never raised into a query.  Failpoints
+    `aot.publish` / `aot.fetch` inject store faults (the chaos leg's
+    artifact-store failure)."""
+
+    _CHUNK_PREFIX = "aot-"
+
+    def __init__(self, blob_store, min_compile_seconds: float = 0.0):
+        self._store = blob_store
+        self._min_seconds = min_compile_seconds
+        # guards: hits_n, misses_n, errors_n, publishes_n
+        self._lock = sanitizers.register_lock(
+            "aot_cache.ClusterArtifactStore._lock", hot=False)
+        self.hits_n = 0
+        self.misses_n = 0
+        self.errors_n = 0
+        self.publishes_n = 0
+        prof = Profiler("/query/compile_cache")
+        self._hits = prof.counter("cluster_hits")
+        self._misses = prof.counter("cluster_misses")
+        self._errors = prof.counter("cluster_errors")
+        self._publishes = prof.counter("cluster_publishes")
+
+    def _chunk_id(self, key: tuple) -> str:
+        return self._CHUNK_PREFIX + artifact_digest(key)
+
+    def fetch(self, key: tuple):
+        """Fetch-on-miss: the loaded executable for `key`, or None
+        (counted as a cluster miss / error).  Never raises."""
+        chunk_id = self._chunk_id(key)
+        try:
+            _FP_FETCH.hit()
+            blob = self._store.get_blob(chunk_id)
+        except Exception as exc:   # noqa: BLE001 — a missing or
+            # unreachable artifact falls back to the next tier (fresh
+            # compile), never fails the query.  Absence and store
+            # failure both land here: blob stores raise on unknown ids.
+            self._tally("misses_n", self._misses)
+            logger.debug("cluster artifact %s unavailable: %r",
+                         chunk_id, exc)
+            return None
+        fn = decode_artifact(blob, f"cluster:{chunk_id}")
+        if fn is None:
+            self._tally("errors_n", self._errors)
+            return None
+        self._tally("hits_n", self._hits)
+        return fn
+
+    def publish(self, key: tuple, compiled, fingerprint: str,
+                compile_seconds: float) -> bool:
+        """Publish-on-compile: push one freshly AOT-compiled executable
+        to the cluster store.  Best-effort; returns True on publish."""
+        if compile_seconds < self._min_seconds:
+            return False
+        chunk_id = self._chunk_id(key)
+        try:
+            _FP_PUBLISH.hit()
+            blob = encode_artifact(compiled, fingerprint,
+                                   compile_seconds)
+            self._store.put_blob(chunk_id, blob)
+        except Exception as exc:   # noqa: BLE001 — persistence is an
+            # optimization; an unserializable executable or a down
+            # store must not fail the query.
+            logger.warning("cannot publish compile artifact %s: %r",
+                           chunk_id, exc)
+            self._tally("errors_n", self._errors)
+            return False
+        self._tally("publishes_n", self._publishes)
+        return True
+
+    def _tally(self, name: str, counter) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + 1)
+        counter.increment()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits_n, "misses": self.misses_n,
+                    "errors": self.errors_n,
+                    "publishes": self.publishes_n}
+
+
 # -- globals -------------------------------------------------------------------
 
 _cache: Optional[DiskCompileCache] = None
 _cache_dir: Optional[str] = None
-# guards: _cache, _cache_dir
+_cluster_store: Optional[ClusterArtifactStore] = None
+# guards: _cache, _cache_dir, _cluster_store
 _cache_lock = sanitizers.register_lock("aot_cache._cache_lock",
                                        hot=False)
+
+
+def get_cluster_store() -> Optional[ClusterArtifactStore]:
+    """The process's cluster artifact tier, or None when no daemon has
+    bound one (set_cluster_store) — the default for plain clients."""
+    with _cache_lock:
+        return _cluster_store
+
+
+def set_cluster_store(store: Optional[ClusterArtifactStore]) -> None:
+    """Bind (or clear, with None) the cluster artifact tier.  Daemons
+    call this once their chunk store is up; the evaluator then
+    fetches-on-miss and publishes-on-compile through it."""
+    global _cluster_store
+    with _cache_lock:
+        _cluster_store = store
 
 
 def get_disk_cache() -> Optional[DiskCompileCache]:

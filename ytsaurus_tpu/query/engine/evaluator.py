@@ -539,7 +539,8 @@ class Evaluator:
         import time as _time
 
         from ytsaurus_tpu.config import workload_config
-        from ytsaurus_tpu.query.engine.aot_cache import get_disk_cache
+        from ytsaurus_tpu.query.engine.aot_cache import (
+            get_cluster_store, get_disk_cache)
         from ytsaurus_tpu.utils.tracing import child_span
         cfg = workload_config()
         result = None
@@ -552,7 +553,10 @@ class Evaluator:
         lowered = None
         fn = None
         disk = get_disk_cache()
-        # Memory miss: try the disk tier (lazily, only on miss), else
+        cluster = get_cluster_store()
+        # Memory miss: try the disk tier, then the CLUSTER artifact
+        # store (fetch-on-miss, ISSUE 17 — a replica joining mid-storm
+        # pulls hot executables its peers already published), else
         # build the device program NOW (AOT lower + compile, the XLA
         # analog of the reference's LLVM codegen pass) so compile time
         # is measured apart from execution.  Shapes/dtypes are pinned
@@ -567,6 +571,9 @@ class Evaluator:
                 fn = disk.load(key)
             if fn is not None:
                 cause = "disk_hit"
+            elif cluster is not None and \
+                    (fn := cluster.fetch(key)) is not None:
+                cause = "cluster_hit"
             else:
                 jitted = jax.jit(prepared.run)
                 try:
@@ -580,10 +587,15 @@ class Evaluator:
                     result = fn(*args)
             compile_seconds = _time.perf_counter() - t0c
             span.add_tag("cause", cause)
-        if disk is not None and lowered is not None:
+        if lowered is not None:
             # Persist the fresh AOT product so the NEXT process
-            # (rolling restart) warm-starts this shape from disk.
-            disk.store(key, fn, key[0], compile_seconds)
+            # (rolling restart) warm-starts this shape from disk, and
+            # publish-on-compile to the cluster store so a replica
+            # added mid-storm fetches it instead of compiling inline.
+            if disk is not None:
+                disk.store(key, fn, key[0], compile_seconds)
+            if cluster is not None:
+                cluster.publish(key, fn, key[0], compile_seconds)
         with self._cache_lock:
             self._cache[key] = fn
             evicted_keys = []
@@ -609,6 +621,8 @@ class Evaluator:
             stats.compile_time += compile_seconds
             if cause == "disk_hit":
                 stats.compile_disk_hit += 1
+            elif cause == "cluster_hit":
+                stats.compile_cluster_hit += 1
             elif cause == "eviction":
                 stats.compile_evicted += 1
             elif cause == "new_shape":

@@ -54,6 +54,10 @@ from typing import Callable, Optional, Sequence
 from ytsaurus_tpu.config import ServingConfig
 from ytsaurus_tpu.cypress.security import current_user
 from ytsaurus_tpu.errors import EErrorCode, ThrottledError, YtError
+from ytsaurus_tpu.operations.fair_share import (
+    PoolState as FairPoolState,
+    compute_fair_shares,
+)
 from ytsaurus_tpu.query.accounting import get_accountant
 from ytsaurus_tpu.utils import failpoints
 from ytsaurus_tpu.utils.profiling import Profiler
@@ -68,6 +72,10 @@ _FP_BATCH_FLUSH = failpoints.register_site(
     "serving.batch_flush",
     error=lambda s: YtError(f"injected batch flush failure at {s}",
                             code=EErrorCode.TransportError))
+_FP_BROWNOUT = failpoints.register_site(
+    "serving.brownout",
+    error=lambda s: YtError(f"injected brown-out degradation failure "
+                            f"at {s}", code=EErrorCode.TransportError))
 
 # Sub-millisecond latency buckets: point lookups sit well under the
 # profiling default's 1ms floor.
@@ -90,7 +98,8 @@ class CancellationToken:
     accounting (query/accounting.py) can attribute what each layer
     consumed without a side channel."""
 
-    __slots__ = ("deadline", "pool", "user", "_cancelled", "_reason")
+    __slots__ = ("deadline", "pool", "user", "_cancelled", "_reason",
+                 "staleness_bound", "rung", "stale_served")
 
     def __init__(self, deadline: Optional[float] = None,
                  pool: Optional[str] = None,
@@ -100,6 +109,14 @@ class CancellationToken:
         self.user = user
         self._cancelled = False
         self._reason: Optional[str] = None
+        # Brown-out ladder (ISSUE 17): when the gateway admits this
+        # request under rung 1, `staleness_bound` carries the pool's
+        # declared bound down to the tablet read path, and the read path
+        # writes back the ACTUAL staleness it served (`stale_served`) so
+        # every degraded response is tagged with what it got.
+        self.staleness_bound: Optional[float] = None
+        self.rung = 0
+        self.stale_served = 0.0
 
     @classmethod
     def with_timeout(cls, timeout: Optional[float],
@@ -146,25 +163,34 @@ class _PoolState:
     # counters are PROCESS-wide: every gateway shares one registry
     # sensor per (name, pool) tag, which is right for /metrics but
     # wrong for one gateway's view).
-    __slots__ = ("name", "slots", "in_flight", "waiting",
-                 "admitted_n", "rejected_n", "expired_n",
-                 "admitted", "rejected", "expired",
-                 "queue_gauge", "in_flight_gauge", "wait_hist")
+    __slots__ = ("name", "weight", "min_share", "limit",
+                 "staleness_bound", "fair_share", "in_flight", "waiting",
+                 "admitted_n", "rejected_n", "expired_n", "yielded_n",
+                 "degraded_n", "admitted", "rejected", "expired",
+                 "queue_gauge", "in_flight_gauge", "fair_gauge",
+                 "wait_hist", "cond")
 
-    def __init__(self, name: str, slots: int, profiler: Profiler,
-                 serving_profiler: Profiler):
+    def __init__(self, name: str, config: ServingConfig,
+                 profiler: Profiler, serving_profiler: Profiler):
         self.name = name
-        self.slots = slots
         self.in_flight = 0
         self.waiting = 0
+        self.fair_share = 0.0        # share of config.slots in [0, 1]
         self.admitted_n = 0
         self.rejected_n = 0
         self.expired_n = 0
+        self.yielded_n = 0           # admissions that yielded to a
+        self.degraded_n = 0          # starving pool before running
+        self.reconfigure(config)
         prof = profiler.with_tags(pool=name)
         self.admitted = prof.counter("admitted")
         self.rejected = prof.counter("rejected")
         self.expired = prof.counter("expired")
         self.in_flight_gauge = prof.gauge("in_flight")
+        # Fair-share allocation in SLOTS (`serving_admission_fair_slots
+        # {pool=}`): what `yt top --by pool` and the SLO bench read to
+        # see a storming tenant squeezed back to its share.
+        self.fair_gauge = prof.gauge("fair_slots")
         self.wait_hist = prof.histogram("admission_wait_seconds",
                                         bounds=_LATENCY_BOUNDS)
         # ISSUE 6 satellite: the per-pool backlog as a REAL routing
@@ -174,32 +200,69 @@ class _PoolState:
         self.queue_gauge = serving_profiler.with_tags(
             pool=name).gauge("queue_depth")
 
+    def reconfigure(self, config: ServingConfig) -> None:
+        """Pull this pool's spec out of a (possibly freshly merged)
+        ServingConfig — the dynamic-resize entry point."""
+        pools = config.pools or {}
+        self.weight = float(pools.get(self.name, 1.0))
+        self.min_share = float(
+            (config.min_shares or {}).get(self.name, 0.0))
+        self.limit = (config.pool_limits or {}).get(self.name)
+        bound = (config.staleness_bounds or {}).get(
+            self.name, config.default_staleness_seconds)
+        self.staleness_bound = float(bound) if bound else 0.0
+
+
+# Bounded ring of brown-out rung transitions kept for /serving.
+_MAX_TRANSITIONS = 64
+
 
 class AdmissionController:
-    """Weighted per-pool concurrency slots with a bounded wait queue.
+    """Fair-share admission over one shared slot budget (ISSUE 17).
 
-    Total `slots` split across pools proportionally to weight (every
-    pool keeps at least one).  A request whose pool is saturated waits
-    on the shared condition until a slot frees or its deadline lapses;
-    once `max_queue` requests are already waiting the request is
-    rejected immediately with a `retry_after` hint estimated from the
-    EWMA slot hold time and the backlog ahead of it."""
+    The static per-pool slot table collapsed into scalar progressive
+    filling (operations/fair_share.py): every pool carries weight +
+    min-share guarantees, `compute_fair_shares` water-fills the live
+    demand (in-flight = running, queued waiters = pending), and a freed
+    slot goes to the waiting pool FURTHEST below its fair share — a
+    waiter of an over-share pool yields (is preempted in the queue) as
+    long as an under-share pool starves.  Pools are DYNAMIC: created on
+    first config mention, resized live via `apply_config` (the
+    DynamicConfigManager subscription), so thousands of tenants can get
+    weighted guarantees without a restart.
+
+    A request whose pool already has `max_queue` waiters is rejected
+    immediately with a `retry_after` hint estimated from the EWMA slot
+    hold time and the backlog ahead of it.
+
+    The controller also owns the BROWN-OUT ladder: the overload signal
+    is estimated queue drain time (total waiters x hold EWMA / slots);
+    rung 1 degrades reads to bounded-staleness snapshot-cache serves,
+    rung 2 sheds new requests with retry_after.  Rungs escalate
+    immediately and de-escalate one step at a time behind hysteresis +
+    a minimum dwell, so the ladder cannot flap at a threshold."""
 
     def __init__(self, config: ServingConfig):
         self.config = config
-        # guards: _pools, _hold_ewma
+        # One lock, MANY conditions: every pool parks its waiters on its
+        # own condition (built over this same lock) so a release can
+        # wake exactly the pool the freed slot belongs to instead of
+        # broadcasting to every queued request in the process.
+        self._lock = threading.RLock()
+        # guards: _pools, _hold_ewma, _in_flight_total, _waiting_total, _shares_dirty, _rung, _rung_since, _transitions_log, config
         self._cond = sanitizers.register_condition(
-            "serving.AdmissionController._cond")
+            "serving.AdmissionController._cond",
+            threading.Condition(self._lock))
         serving_profiler = Profiler("/serving")
         profiler = serving_profiler.with_prefix("/admission")
-        pools = config.pools or {config.default_pool: 1.0}
-        total_weight = sum(w for w in pools.values()) or 1.0
+        self._profiler = profiler
+        self._serving_profiler = serving_profiler
         self._pools: dict[str, _PoolState] = {}
-        for name, weight in pools.items():
-            slots = max(1, round(config.slots * float(weight)
-                                 / total_weight))
-            self._pools[name] = _PoolState(name, slots, profiler,
-                                           serving_profiler)
+        self._in_flight_total = 0
+        self._waiting_total = 0
+        self._shares_dirty = True
+        for name in (config.pools or {config.default_pool: 1.0}):
+            self._ensure_pool_locked(name)
         # EWMA of slot hold time, seeded pessimistically; feeds the
         # retry_after hint so clients back off proportionally to the
         # actual drain rate instead of a blind constant.  Exported as
@@ -209,14 +272,219 @@ class AdmissionController:
         self._hold_ewma = 0.05
         self._hold_gauge = serving_profiler.gauge("hold_ewma_seconds")
         self._hold_gauge.set(self._hold_ewma)
+        # Brown-out ladder state + sensors (/serving/brownout/*).
+        bprof = serving_profiler.with_prefix("/brownout")
+        self._rung = 0
+        self._rung_since = time.monotonic()
+        self._transitions_n = 0
+        self._engaged_n = 0
+        self._shed_n = 0
+        self._transitions_log: list[dict] = []
+        self._rung_gauge = bprof.gauge("rung")
+        self._transitions_c = bprof.counter("transitions")
+        self._degraded_c = bprof.counter("degraded_reads")
+        self._shed_c = bprof.counter("shed")
+        self._rung_gauge.set(0)
+
+    # -- pools -----------------------------------------------------------------
+
+    def _ensure_pool_locked(self, name: str) -> _PoolState:
+        state = self._pools.get(name)
+        if state is None:
+            state = self._pools[name] = _PoolState(
+                name, self.config, self._profiler,
+                self._serving_profiler)
+            # The pool's private wait queue shares the admission lock
+            # (and the lock's sanitizer identity — it IS the same lock).
+            state.cond = sanitizers.register_condition(
+                "serving.AdmissionController._cond",
+                threading.Condition(self._lock))
+            self._shares_dirty = True
+        return state
+
+    def apply_config(self, config: ServingConfig) -> None:
+        """Adopt a new ServingConfig live (DynamicConfigManager
+        subscriber): resize the slot budget, re-weight existing pools,
+        create newly declared ones.  Pools that vanished from the patch
+        keep serving with default weight until their traffic drains —
+        deleting live accounting identities mid-flight would orphan
+        their in-flight releases."""
+        with self._cond:
+            self.config = config
+            for name in (config.pools or {}):
+                self._ensure_pool_locked(name)
+            for state in self._pools.values():
+                state.reconfigure(config)
+            self._shares_dirty = True
+            self._update_rung_locked()
+            # Waiters re-evaluate against the new shares immediately —
+            # a widened budget must not wait for the next release.
+            # Config changes move shares arbitrarily, so this is the
+            # one place a full broadcast is the right tool.
+            for state in self._pools.values():
+                state.cond.notify_all()
 
     def _resolve(self, pool: Optional[str]) -> _PoolState:
         return self._pools.get(pool or self.config.default_pool) or \
             self._pools[self.config.default_pool]
 
+    # -- fair share ------------------------------------------------------------
+
+    def _recompute_locked(self) -> None:
+        slots = self.config.slots
+        fair = [FairPoolState(name=s.name, weight=s.weight,
+                              min_share_ratio=s.min_share,
+                              max_running_jobs=s.limit,
+                              running=s.in_flight, pending=s.waiting)
+                for s in self._pools.values()]
+        compute_fair_shares(fair, slots)
+        for fp in fair:
+            state = self._pools[fp.name]
+            state.fair_share = fp.fair_share
+            state.fair_gauge.set(fp.fair_share * slots)
+        self._shares_dirty = False
+
+    def _pick_locked(self) -> Optional[_PoolState]:
+        """The waiting pool to serve next: lowest usage-to-fair-share
+        ratio among pools with waiters and headroom (pick_pool
+        semantics over the live admission counters)."""
+        best = None
+        best_ratio = None
+        slots = self.config.slots
+        for s in self._pools.values():
+            if s.waiting <= 0 or s.fair_share <= 0:
+                continue
+            if s.limit is not None and s.in_flight >= s.limit:
+                continue
+            ratio = s.in_flight / (s.fair_share * slots)
+            if best is None or ratio < best_ratio or \
+                    (ratio == best_ratio and s.name < best.name):
+                best, best_ratio = s, ratio
+        return best
+
+    def _may_run_locked(self, state: _PoolState) -> bool:
+        if self._shares_dirty:
+            self._recompute_locked()
+        slots = self.config.slots
+        if self._in_flight_total >= slots:
+            return False
+        if state.limit is not None and state.in_flight >= state.limit:
+            return False
+        if state.in_flight + 1 <= state.fair_share * slots + 1e-9:
+            return True
+        # Running would take the pool OVER its fair share: the slot
+        # belongs to the starving pool furthest below its own — this
+        # waiter yields (queue preemption).  When no pool is pickable
+        # (all fair shares zero — degenerate configs) fall back to
+        # first-come service so nobody livelocks.
+        best = self._pick_locked()
+        return best is None or best is state
+
+    def _notify_waiters_locked(self) -> None:
+        """Wake exactly the waiters the free capacity belongs to.
+
+        A single shared condition made every release a thundering herd:
+        O(total waiters) threads woke, re-ran the fair-share check, and
+        re-slept.  A greedy tenant's thousand-deep queue turned that
+        churn into CPU and GIL pressure the innocent neighbor pools
+        felt as p99 — the herd itself was a noisy-neighbor channel.
+        Each pool now parks on its own condition (over the one
+        admission lock) and a freed slot wakes only the picked pool:
+        O(pools) per release.  A woken waiter that can no longer run
+        (shares shifted under it) re-aims the baton before re-sleeping,
+        so a wakeup is never lost while a slot sits free."""
+        if self._waiting_total <= 0:
+            return
+        if self._shares_dirty:
+            self._recompute_locked()
+        free = self.config.slots - self._in_flight_total
+        if free <= 0:
+            return
+        best = self._pick_locked()
+        if best is not None:
+            best.cond.notify(min(free, best.waiting))
+            return
+        # Degenerate configs (every fair share zero): _may_run_locked
+        # falls back to first-come service — wake one waiter per pool.
+        for s in self._pools.values():
+            if s.waiting > 0:
+                s.cond.notify(1)
+
+    # -- brown-out ladder ------------------------------------------------------
+
+    def _pressure_locked(self) -> float:
+        """Estimated seconds to drain the global backlog: waiters x
+        EWMA hold / slots — queue depth and drain rate in one signal."""
+        return self._waiting_total * self._hold_ewma / \
+            max(self.config.slots, 1)
+
+    def _update_rung_locked(self) -> None:
+        cfg = self.config
+        now = time.monotonic()
+        if not cfg.brownout_enabled:
+            self._set_rung_locked(0, now)
+            return
+        pressure = self._pressure_locked()
+        target = 2 if pressure >= cfg.brownout_rung2_seconds else \
+            1 if pressure >= cfg.brownout_rung1_seconds else 0
+        if target > self._rung:
+            self._set_rung_locked(target, now)      # escalate NOW
+        elif self._rung > 0:
+            threshold = (cfg.brownout_rung2_seconds if self._rung == 2
+                         else cfg.brownout_rung1_seconds)
+            if pressure < threshold * cfg.brownout_hysteresis and \
+                    now - self._rung_since >= \
+                    cfg.brownout_min_dwell_seconds:
+                self._set_rung_locked(self._rung - 1, now)  # one step
+        self._rung_gauge.set(self._rung)
+
+    def _set_rung_locked(self, rung: int, now: float) -> None:
+        if rung == self._rung:
+            return
+        if self._rung == 0 and rung > 0:
+            self._engaged_n += 1
+        self._transitions_n += 1
+        self._transitions_c.increment()
+        self._transitions_log.append({
+            "at": time.time(), "from": self._rung, "to": rung,
+            "pressure": round(self._pressure_locked(), 4)})
+        del self._transitions_log[:-_MAX_TRANSITIONS]
+        self._rung, self._rung_since = rung, now
+        self._rung_gauge.set(rung)
+
+    @property
+    def rung(self) -> int:
+        with self._cond:
+            return self._rung
+
+    def degradation(self, state: _PoolState) -> tuple[int,
+                                                      Optional[float]]:
+        """The degradation this ADMITTED request must apply: (active
+        rung, the pool's staleness bound when rung >= 1 and the pool
+        opted in).  Hits the `serving.brownout` failpoint whenever a
+        degraded decision is being made."""
+        with self._cond:
+            rung = self._rung
+            bound = state.staleness_bound
+        if rung >= 1:
+            _FP_BROWNOUT.hit()
+            if bound and bound > 0:
+                return rung, bound
+        return rung, None
+
+    def observe_degraded(self, state: _PoolState,
+                         staleness: float) -> None:
+        """Tally one response actually served degraded (tagged)."""
+        with self._cond:
+            state.degraded_n += 1
+        self._degraded_c.increment()
+
+    # -- admission -------------------------------------------------------------
+
     def _retry_after(self, state: _PoolState) -> float:
         backlog = state.waiting + state.in_flight
-        hint = self._hold_ewma * max(backlog, 1) / max(state.slots, 1)
+        fair_slots = max(state.fair_share * self.config.slots, 1.0)
+        hint = self._hold_ewma * max(backlog, 1) / fair_slots
         return round(min(max(hint, 0.01), 5.0), 4)
 
     def admit(self, token: CancellationToken,
@@ -225,34 +493,80 @@ class AdmissionController:
         t0 = time.monotonic()
         with self._cond:
             state = self._resolve(pool)
-            if state.in_flight >= state.slots and \
-                    state.waiting >= self.config.max_queue:
+            self._update_rung_locked()
+            if self._rung >= 2:
+                # Rung 2: the ladder's last step sheds NEW load at the
+                # door so queued + in-flight work can drain.
+                self._shed_n += 1
+                self._shed_c.increment()
                 state.rejected_n += 1
                 state.rejected.increment()
                 get_accountant().observe_throttle(state.name, token.user)
                 raise ThrottledError(
-                    f"serving pool {state.name!r} is saturated "
-                    f"({state.slots} slots, {state.waiting} queued)",
+                    f"serving brown-out rung 2: shedding load "
+                    f"(pool {state.name!r})",
                     retry_after=self._retry_after(state),
-                    attributes={"pool": state.name})
+                    attributes={"pool": state.name, "brownout_rung": 2})
             state.waiting += 1
+            self._waiting_total += 1
+            self._shares_dirty = True
             state.queue_gauge.set(state.waiting)
+            yielded = False
             try:
-                while state.in_flight >= state.slots:
-                    if not self._cond.wait(timeout=token.remaining()):
+                if not self._may_run_locked(state) and \
+                        state.waiting > self.config.max_queue:
+                    state.rejected_n += 1
+                    state.rejected.increment()
+                    get_accountant().observe_throttle(state.name,
+                                                      token.user)
+                    raise ThrottledError(
+                        f"serving pool {state.name!r} is saturated "
+                        f"(fair share "
+                        f"{state.fair_share * self.config.slots:.1f} "
+                        f"slots, {state.waiting - 1} queued)",
+                        retry_after=self._retry_after(state),
+                        attributes={"pool": state.name})
+                while not self._may_run_locked(state):
+                    if self._in_flight_total < self.config.slots and \
+                            (state.limit is None or
+                             state.in_flight < state.limit):
+                        # A slot is FREE but belongs to a starving
+                        # pool: this waiter is being queue-preempted.
+                        # Pass the baton to that pool before sleeping —
+                        # if this thread consumed the release's wakeup,
+                        # the rightful waiter must not sleep through
+                        # its free slot.
+                        yielded = True
+                        self._notify_waiters_locked()
+                    if not state.cond.wait(timeout=token.remaining()):
                         # Deadline lapsed while queued: the request
-                        # expires without ever consuming a slot.
+                        # expires without ever consuming a slot.  Any
+                        # wakeup racing the timeout is re-aimed so it
+                        # doesn't die with this waiter.
                         state.expired_n += 1
                         state.expired.increment()
+                        self._notify_waiters_locked()
                         raise YtError(
                             f"deadline exceeded while queued in serving "
                             f"pool {state.name!r}",
                             code=EErrorCode.DeadlineExceeded,
                             attributes={"pool": state.name})
                 state.in_flight += 1
+                self._in_flight_total += 1
+                self._shares_dirty = True
+                if self._in_flight_total < self.config.slots:
+                    # Still free capacity after this admission (a grown
+                    # budget, or a release that freed several at once):
+                    # forward the baton — the release-time notify only
+                    # aimed at ONE pool's waiters.
+                    self._notify_waiters_locked()
             finally:
                 state.waiting -= 1
+                self._waiting_total -= 1
+                self._shares_dirty = True
                 state.queue_gauge.set(state.waiting)
+            if yielded:
+                state.yielded_n += 1
             state.admitted_n += 1
             state.admitted.increment()
             state.in_flight_gauge.set(state.in_flight)
@@ -262,24 +576,55 @@ class AdmissionController:
     def release(self, state: _PoolState, held_seconds: float) -> None:
         with self._cond:
             state.in_flight -= 1
+            self._in_flight_total -= 1
+            self._shares_dirty = True
             state.in_flight_gauge.set(state.in_flight)
             self._hold_ewma += 0.2 * (held_seconds - self._hold_ewma)
             self._hold_gauge.set(self._hold_ewma)
-            # notify_all, NOT notify: the condition is shared by every
-            # pool, and a single notify could wake a waiter of a still-
-            # saturated OTHER pool — it would re-wait, consuming the
-            # wakeup, and this pool's rightful waiter would sleep
-            # through its free slot.
-            self._cond.notify_all()
+            self._update_rung_locked()
+            # Targeted, NOT notify_all: wake only the pool the freed
+            # slot belongs to (waiters of other pools stay parked on
+            # their own conditions).
+            self._notify_waiters_locked()
 
     def snapshot(self) -> dict:
         with self._cond:
-            return {name: {"slots": s.slots, "in_flight": s.in_flight,
+            if self._shares_dirty:
+                self._recompute_locked()
+            # Rung re-evaluation on read: a gateway whose storm just
+            # drained must DISENGAGE even if no new request arrives to
+            # drive admit()/release() — monitoring scrapes are the
+            # heartbeat that walks the ladder back down.
+            self._update_rung_locked()
+            slots = self.config.slots
+            return {
+                "slots": slots,
+                "hold_ewma": round(self._hold_ewma, 6),
+                "brownout": {
+                    "rung": self._rung,
+                    "pressure": round(self._pressure_locked(), 4),
+                    "engaged": self._engaged_n,
+                    "transitions": self._transitions_n,
+                    "shed": self._shed_n,
+                    "log": list(self._transitions_log),
+                },
+                "pools": {
+                    name: {"weight": s.weight,
+                           "min_share": s.min_share,
+                           "limit": s.limit,
+                           "fair_share": round(s.fair_share, 4),
+                           "fair_slots": round(s.fair_share * slots, 2),
+                           "staleness_bound": s.staleness_bound,
+                           "in_flight": s.in_flight,
                            "waiting": s.waiting,
+                           "demand": s.in_flight + s.waiting,
                            "admitted": s.admitted_n,
                            "rejected": s.rejected_n,
-                           "expired": s.expired_n}
-                    for name, s in sorted(self._pools.items())}
+                           "expired": s.expired_n,
+                           "yielded": s.yielded_n,
+                           "degraded": s.degraded_n}
+                    for name, s in sorted(self._pools.items())},
+            }
 
 
 class _PathContext:
@@ -744,6 +1089,20 @@ class QueryGateway:
         if root is not None:
             root.add_tag("admission_wait_s",
                          round(time.monotonic() - t_admit, 6))
+        # Brown-out rung 1 (ISSUE 17): the pool's declared staleness
+        # bound rides the token down to the tablet read path, which
+        # serves the snapshot cache within the bound and writes back
+        # what it actually served.  A failure INSIDE the degradation
+        # decision (the `serving.brownout` failpoint's injection) falls
+        # back to full-fidelity execution: broken brown-out machinery
+        # must never take down a query that already holds a slot.
+        try:
+            rung, bound = self.admission.degradation(state)
+        except YtError:
+            rung, bound = 0, None
+        if rung >= 1 and bound is not None:
+            token.rung = rung
+            token.staleness_bound = bound
         t0 = time.monotonic()
         try:
             return fn(token)
@@ -751,6 +1110,14 @@ class QueryGateway:
             held = time.monotonic() - t0
             self.admission.release(state, held)
             self.select_latency.record(held)
+            if token.rung >= 1:
+                # Tag the degraded response where observability reads
+                # it: the root span and the per-pool degraded tally.
+                self.admission.observe_degraded(state, token.stale_served)
+                if root is not None:
+                    root.add_tag("brownout_rung", token.rung)
+                    root.add_tag("stale_served_s",
+                                 round(token.stale_served, 4))
 
     # -- lookups ---------------------------------------------------------------
 
@@ -814,9 +1181,30 @@ class QueryGateway:
         if cache_size is not None:
             self._cache_gauge.set(cache_size)
 
+    def apply_config(self, config: ServingConfig) -> None:
+        """Adopt a merged ServingConfig live: resize/re-weight admission
+        pools, and let the batchers pick up the new windows (they read
+        `self.config` per flush)."""
+        self.config = config
+        self.batcher.config = config
+        self.nearest_batcher.config = config
+        self.admission.apply_config(config)
+
+    def attach_dynamic_config(self, manager) -> None:
+        """Subscribe this gateway to a config.DynamicConfigManager whose
+        merged config is (or carries) a ServingConfig — the dynamic
+        pool create/resize path (ISSUE 17)."""
+        def _on_update(cfg):
+            serving = getattr(cfg, "serving", cfg)
+            if isinstance(serving, ServingConfig):
+                self.apply_config(serving)
+        manager.subscribe(_on_update)
+
     def snapshot(self) -> dict:
+        admission = self.admission.snapshot()
         return {"enabled": self.enabled,
-                "pools": self.admission.snapshot(),
+                "admission": admission,
+                "pools": admission["pools"],
                 "lookup": self.batcher.snapshot(),
                 "nearest": self.nearest_batcher.snapshot()}
 

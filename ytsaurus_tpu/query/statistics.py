@@ -49,6 +49,17 @@ class QueryStatistics:
     # without re-running.  Actuals/estimates ACCUMULATE across shard
     # programs (the host-coordinated cascade runs the stage per shard).
     join_plan: list = field(default_factory=list)
+    # Brown-out ladder (ISSUE 17): non-zero when this response was
+    # served DEGRADED — rung 1 reads the tablet snapshot cache within
+    # the pool's staleness bound; degraded_staleness is the max
+    # staleness (seconds) actually served.  Every degraded response is
+    # tagged here, in the root span, and in the per-pool counters.
+    degraded_rung: int = 0
+    degraded_staleness: float = 0.0
+    # Memory misses served by the CLUSTER artifact store (fetch-on-miss
+    # from the chunk-backed tier): a replica joining mid-storm serves
+    # its first queries with these instead of fresh compiles.
+    compile_cluster_hit: int = 0
 
     def note_join_stage(self, position: int, table: str, strategy: str,
                         est_rows: int = 0, actual_rows=None) -> None:

@@ -519,3 +519,25 @@ class RemoteYtClient:
 def connect_remote(primary_address: "str | Sequence[str]"
                    ) -> RemoteYtClient:
     return RemoteYtClient(primary_address)
+
+
+def routed_client(replicas: "Sequence[tuple]", timeout: float = 120.0,
+                  user: str = "root", scrape_period: float = 0.5,
+                  start: bool = True):
+    """Load-aware multi-replica client (ISSUE 17): one RemoteYtClient
+    per serving replica, routed by a ReplicaRouter that scrapes each
+    daemon's monitoring `/serving` endpoint (queue depth, hold EWMA,
+    brown-out rung) instead of hedging blindly.
+
+    `replicas`: (name, rpc_address, monitor_address) triples — or
+    (rpc_address, monitor_address) pairs, where the rpc address doubles
+    as the name."""
+    from ytsaurus_tpu.query.routing import ReplicaRouter, RoutedYtClient
+    router = ReplicaRouter(replicas, scrape_period=scrape_period)
+    clients = {r.name: RemoteYtClient(r.address, timeout=timeout,
+                                      user=user)
+               for r in router.replicas()}
+    routed = RoutedYtClient(router, clients)
+    if start:
+        router.start()
+    return routed

@@ -230,6 +230,49 @@ def _never_dispatched(err: "YtError") -> bool:
         err.attributes.get("dispatched") is False
 
 
+class _RetryBudget:
+    """Token-bucket retry budget (ISSUE 17): each retry SPENDS one
+    token; each successful call DEPOSITS `refill` tokens (capped at
+    `capacity`); a throttled outcome deposits nothing — the budget is
+    admission-aware, so a cluster that is shedding load watches retry
+    traffic decay to the deposit rate instead of multiplying.
+
+    Thread-safe; one budget per RetryingChannel instance, shared by
+    every call through it (the budget models the CHANNEL's standing
+    with the peer, not one request's patience)."""
+
+    __slots__ = ("capacity", "refill", "_tokens", "_lock",
+                 "spent_n", "exhausted_n")
+
+    def __init__(self, capacity: int, refill: float):
+        self.capacity = float(capacity)
+        self.refill = refill
+        self._tokens = float(capacity)     # starts full: first failures
+        self._lock = threading.Lock()      # may retry immediately
+        self.spent_n = 0
+        self.exhausted_n = 0
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.spent_n += 1
+                return True
+            self.exhausted_n += 1
+            return False
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._tokens = min(self._tokens + self.refill, self.capacity)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"tokens": round(self._tokens, 3),
+                    "capacity": self.capacity,
+                    "spent": self.spent_n,
+                    "exhausted": self.exhausted_n}
+
+
 class RetryingChannel:
     """Retries TRANSPORT failures (peer restarting, dropped connection);
     application YtErrors pass through untouched.
@@ -244,7 +287,15 @@ class RetryingChannel:
     the request was NEVER executed — and the wait honors the error's
     `retry_after` hint instead of the generic backoff curve.
     DeadlineExceeded is TERMINAL: the deadline belongs to the caller's
-    query, and a retry could not possibly land inside it."""
+    query, and a retry could not possibly land inside it.
+
+    ISSUE 17: when the policy declares `retry_budget > 0`, retries draw
+    from a token bucket refilled only by SUCCESSFUL calls (throttled
+    outcomes refund nothing) — an exhausted budget fails fast, shedding
+    load instead of feeding a retry storm.  Backoff sleeps are capped
+    at the caller's `token.remaining()` (CancellationToken), and an
+    already-expired deadline surfaces as DeadlineExceeded promptly
+    instead of sleeping through it."""
 
     def __init__(self, channel: Channel, attempts: int | None = None,
                  backoff: float | None = None, policy: str = "rpc"):
@@ -256,9 +307,14 @@ class RetryingChannel:
             cfg = RetryPolicyConfig(
                 attempts=attempts if attempts is not None else cfg.attempts,
                 backoff=backoff if backoff is not None else cfg.backoff,
-                backoff_cap=cfg.backoff_cap, jitter=cfg.jitter)
+                backoff_cap=cfg.backoff_cap, jitter=cfg.jitter,
+                retry_budget=cfg.retry_budget,
+                retry_budget_refill=cfg.retry_budget_refill)
         self.channel = channel
         self._policy = cfg
+        self.retry_budget: _RetryBudget | None = \
+            _RetryBudget(cfg.retry_budget, cfg.retry_budget_refill) \
+            if cfg.retry_budget > 0 else None
 
     @property
     def address(self) -> str:
@@ -270,11 +326,17 @@ class RetryingChannel:
 
     def call(self, service: str, method: str, body=None,
              attachments=(), timeout: float | None = None,
-             idempotent: bool = True):
+             idempotent: bool = True, token=None):
         from ytsaurus_tpu.errors import retry_after_hint
         from ytsaurus_tpu.utils.tracing import child_span
         last: YtError | None = None
+        budget = self.retry_budget
         for attempt in range(self._policy.attempts):
+            if token is not None:
+                # Surface an expired caller deadline NOW — before
+                # dispatching (or sleeping toward) an attempt that
+                # cannot possibly land inside it.
+                token.check()
             try:
                 # Fresh span PER ATTEMPT on the SAME trace (ISSUE 5
                 # satellite): the wire then carries a distinct parent
@@ -282,8 +344,11 @@ class RetryingChannel:
                 # under its own attempt instead of aliasing the first.
                 with child_span("rpc.call", service=service,
                                 method=method, attempt=attempt):
-                    return self.channel.call(service, method, body,
-                                             attachments, timeout)
+                    result = self.channel.call(service, method, body,
+                                               attachments, timeout)
+                if budget is not None:
+                    budget.deposit()
+                return result
             except YtError as err:
                 if err.contains(EErrorCode.DeadlineExceeded):
                     # Terminal: the caller's query deadline already
@@ -311,10 +376,31 @@ class RetryingChannel:
                 if attempt + 1 < self._policy.attempts:
                     # No sleep after the FINAL attempt: the failure is
                     # already decided, the caller shouldn't wait for it.
+                    if budget is not None and not budget.try_spend():
+                        # Budget dry: fail FAST — the cluster is
+                        # already struggling, and N clients x M retries
+                        # is exactly the storm the bucket caps.
+                        raise YtError(
+                            f"RPC to {self.channel.address}: retry "
+                            f"budget exhausted after attempt "
+                            f"{attempt + 1}",
+                            code=EErrorCode.PeerUnavailable,
+                            attributes={"retry_budget_exhausted": True},
+                            inner_errors=[last])
                     hint = retry_after_hint(err) if throttled else None
-                    time.sleep(min(hint, self._policy.backoff_cap)
-                               if hint is not None
-                               else self._policy.delay(attempt))
+                    delay = min(hint, self._policy.backoff_cap) \
+                        if hint is not None \
+                        else self._policy.delay(attempt)
+                    if token is not None:
+                        remaining = token.remaining()
+                        if remaining is not None:
+                            # Cap the backoff at the caller's deadline:
+                            # sleeping past it only delays the
+                            # DeadlineExceeded the next check raises.
+                            delay = min(delay, remaining)
+                    time.sleep(delay)
+        if token is not None:
+            token.check()
         raise YtError(
             f"RPC to {self.channel.address} failed after "
             f"{self._policy.attempts} attempts",

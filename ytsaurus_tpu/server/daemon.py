@@ -358,6 +358,19 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
               flush=True)
     client = YtClient(cluster)
     server.add_service(DriverService(client))
+    # Cluster compile-artifact tier (ISSUE 17): AOT executables publish
+    # to the chunk store on compile and fetch on miss, so a replica
+    # added mid-storm joins HOT — zero inline compiles for shapes its
+    # peers already built.  Content-addressed ids make this safe to
+    # share across every primary of the cluster.
+    from ytsaurus_tpu.query.engine.aot_cache import (
+        ClusterArtifactStore,
+        set_cluster_store,
+    )
+    artifact_store = ClusterArtifactStore(store)
+    set_cluster_store(artifact_store)
+    orchid.register("/query/compile_cache/cluster",
+                    artifact_store.snapshot)
     # Background re-replication: a dead node's chunks regain their
     # replication factor within ~interval, read or no read (ref
     # chunk_replicator.h).  A follower's empty node tracker makes its

@@ -51,6 +51,12 @@ class MonitoringServer:
         # "address", "attributes"}] of every /daemons-registered member;
         # None serves /cluster over this process alone.
         self.cluster_members = cluster_members
+        # Per-replica /serving scope (ISSUE 17): when several serving
+        # replicas share one process (bench/test harnesses), each
+        # replica's endpoint must report ITS gateway only or the
+        # ReplicaRouter would see every replica's load on every scrape.
+        # None keeps the default: every live gateway in the process.
+        self.serving_gateways: Optional[list] = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -148,7 +154,11 @@ class MonitoringServer:
             # state + lookup batching counters of every live gateway in
             # this process (histograms export via /metrics serving_*).
             from ytsaurus_tpu.query.serving import serving_snapshot
-            body = json.dumps({"gateways": serving_snapshot()},
+            if self.serving_gateways is not None:
+                gateways = [g.snapshot() for g in self.serving_gateways]
+            else:
+                gateways = serving_snapshot()
+            body = json.dumps({"gateways": gateways},
                               indent=2).encode()
             self._reply(request, 200, body, "application/json")
         elif path == "/views":

@@ -162,6 +162,10 @@ def default_orchid(config=None) -> OrchidTree:
     # /sanitizer endpoint — observed lock-order edges + violation
     # report of the instrumented-lock layer.
     tree.register("/sanitizer", _sanitizer_producer)
+    # Serving plane (ISSUE 17): the RPC twin of the monitoring /serving
+    # endpoint — per-gateway fair-share admission + brown-out state
+    # (`yt top --by pool` reads the share/use/demand overlay remotely).
+    tree.register("/serving", _serving_producer)
     return tree
 
 
@@ -214,3 +218,8 @@ def _views_producer() -> dict:
 def _sanitizer_producer() -> dict:
     from ytsaurus_tpu.utils import sanitizers
     return sanitizers.snapshot()
+
+
+def _serving_producer() -> dict:
+    from ytsaurus_tpu.query.serving import serving_snapshot
+    return {"gateways": serving_snapshot()}

@@ -17,6 +17,7 @@ vectorized pass, not a per-row k-way heap merge (tablet_reader.cpp:651).
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Optional, Sequence
 
@@ -130,10 +131,13 @@ class Tablet:
         # capacity from TabletConfig.host_plane_cache_capacity).
         self._host_planes: "OrderedDict[str, dict]" = OrderedDict()
         self._versioned_schema = versioned_schema(schema)
-        # Snapshot cache: (generation, visible chunk) for latest-class
-        # reads; invalidated by any write/flush/compact via the
-        # generation key.  Counters are process-wide (/metrics).
-        self._snapshot_cache: "Optional[tuple[tuple, ColumnarChunk]]" = None
+        # Snapshot cache: (generation, visible chunk, built_at) for
+        # latest-class reads; invalidated by any write/flush/compact via
+        # the generation key.  built_at (monotonic) is what bounded-
+        # staleness reads (serving brown-out rung 1) check the staleness
+        # bound against.  Counters are process-wide (/metrics).
+        self._snapshot_cache: \
+            "Optional[tuple[tuple, ColumnarChunk, float]]" = None
         # Max committed version timestamp of the sealed chunks, memoized
         # per flush generation (read from chunk meta stats).
         self._chunk_max_ts = 0
@@ -477,9 +481,32 @@ class Tablet:
                 if self._snapshot_cache is not None:
                     _SNAP_EVICTIONS.increment()
                     _snap_bytes_add(-_chunk_nbytes(self._snapshot_cache[1]))
-                self._snapshot_cache = (generation, chunk)
+                self._snapshot_cache = (generation, chunk,
+                                        time.monotonic())
                 _snap_bytes_add(_chunk_nbytes(chunk))
             return chunk
+
+    def read_snapshot_bounded(self, timestamp: int = MAX_TIMESTAMP,
+                              max_staleness: float = 0.0) \
+            -> "tuple[ColumnarChunk, float]":
+        """Bounded-staleness read (serving brown-out rung 1, ISSUE 17):
+        serve the cached snapshot EVEN IF writes advanced the generation,
+        as long as it was built within `max_staleness` seconds — the
+        explicit degradation that keeps an overloaded replica answering
+        without paying the MVCC merge.  Returns (chunk, staleness
+        seconds actually served); falls back to a full `read_snapshot`
+        (staleness 0) when the cache is cold, too old, or the caller
+        asked for a historical timestamp the cache cannot answer."""
+        if max_staleness and max_staleness > 0:
+            with self._lock:
+                cached = self._snapshot_cache
+                if cached is not None and \
+                        timestamp >= self._latest_ts_floor():
+                    age = time.monotonic() - cached[2]
+                    if age <= max_staleness:
+                        _SNAP_HITS.increment()
+                        return cached[1], age
+        return self.read_snapshot(timestamp), 0.0
 
     def _read_snapshot_uncached(self, timestamp: int) -> ColumnarChunk:
         total = sum(s.store_row_count for s in
