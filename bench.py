@@ -52,6 +52,11 @@ config, printing the headline (TPC-H Q1, config 1) last:
           with the history sampler OFF vs ON at 100× the configured
           cadence and asserts the sampled throughput stays within 1%;
           metric is the sampled serving throughput
+  tiering adaptive tiered execution (ISSUE 18): a burst of distinct
+          cold query shapes inline-compiled vs interpreter-first with
+          background promotion (cold p99 asserted >=10x lower, steady
+          compiled share >=95%) plus a prewarmed-restart leg (0 inline
+          compiles); metric is the interpreted cold-burst throughput
   all     run every config, one JSON line each (headline line printed last)
 
 Row counts are scaled to the ACTUAL platform after backend probing: a CPU
@@ -2226,6 +2231,137 @@ print(f"CHILD {{ITERS / elapsed:.1f}} {{N * ITERS / elapsed:.0f}}")
     return "vector_scan_rows_per_sec", scanned, best
 
 
+def bench_tiering(n_rows, iters):
+    """Adaptive tiered execution (ISSUE 18): a burst of DISTINCT cold
+    query shapes served three ways over one resident chunk.
+
+      inline   tiering OFF (the pre-PR discipline): every cold shape
+               pays its XLA compile inline on the serving thread —
+               cold-shape p50/p99 IS the compile time.
+      tiered   tiering ON (hot_threshold=1): cold shapes serve from the
+               no-compile interpreter immediately, bit-identically; the
+               background compiler promotes each hot fingerprint
+               off-thread, after which the SAME keys serve compiled
+               (steady-state compiled share asserted >=95%).
+      prewarm  restart leg: a FRESH evaluator prewarmed COMPILE-ONLY
+               from the recorded shape mix serves the whole burst with
+               zero inline compiles (asserted).
+
+    Metric: tiered cold-shape throughput (queries/s through the
+    interpreter).  Cold p50/p99 per leg, the p99 drop, background
+    promotion latency, and the prewarm report print on stderr."""
+    import numpy as _np
+
+    from ytsaurus_tpu import config as _config
+    from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.engine import evaluator as _ev
+    from ytsaurus_tpu.query.engine.prewarm import prewarm_from_capture
+    from ytsaurus_tpu.query.profile import get_flight_recorder
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    from ytsaurus_tpu.query.workload import WorkloadRecord
+    from ytsaurus_tpu.schema import TableSchema
+
+    schema = TableSchema.make([("k", "int64"), ("g", "int64"),
+                               ("v", "int64")])
+    rows = [{"k": i, "g": i % 97, "v": (i * 31) % 10_000}
+            for i in range(n_rows)]
+    chunk = ColumnarChunk.from_rows(schema, rows)
+    schemas = {"//t": schema}
+
+    # 20 structurally distinct shapes (distinct fingerprints even under
+    # literal parameterization): filter-op x column, ORDER BY variants,
+    # aggregate x group-key.  All inside the interpreter's coverage.
+    shapes = []
+    for col in ("k", "v"):
+        for op in (">", "<", ">=", "<="):
+            shapes.append(f"k, v FROM [//t] WHERE {col} {op} 500 LIMIT 9")
+    for col in ("k", "g", "v"):
+        for direction in ("asc", "desc"):
+            shapes.append(f"k, v FROM [//t] WHERE v > 1 "
+                          f"ORDER BY {col} {direction}, k LIMIT 7")
+    for key in ("g", "v"):
+        for fn in ("sum", "min", "max"):
+            shapes.append(f"{key}, {fn}(k) AS a FROM [//t] GROUP BY {key}")
+    plans = [build_query(q, schemas) for q in shapes]
+
+    def run_cold_burst(evaluator):
+        lat, tiers, compiles = [], [], 0
+        for plan in plans:
+            stats = QueryStatistics()
+            t0 = time.perf_counter()
+            evaluator.run_plan(plan, chunk, stats=stats)
+            lat.append(time.perf_counter() - t0)
+            tiers.append(stats.execution_tier)
+            compiles += stats.compile_count
+        return lat, tiers, compiles
+
+    def pct(lat, q):
+        return sorted(lat)[min(len(lat) - 1, int(q * len(lat)))] * 1e3
+
+    # Leg 1: inline compiles (tiering off).
+    _config.set_tiering_config(None)
+    inline_lat, inline_tiers, inline_compiles = run_cold_burst(
+        _ev.Evaluator())
+    assert inline_compiles == len(shapes), inline_compiles
+    try:
+        # Leg 2: interpreter-first with background promotion.
+        _config.set_tiering_config(_config.TieringConfig(
+            enabled=True, hot_threshold=1))
+        tiered = _ev.Evaluator()
+        promotions_before = len(get_flight_recorder().promotions())
+        t_cold = time.perf_counter()
+        tiered_lat, tiered_tiers, tiered_compiles = run_cold_burst(tiered)
+        cold_elapsed = time.perf_counter() - t_cold
+        assert tiered_compiles == 0, tiered_compiles
+        assert all(t == "interpreted" for t in tiered_tiers), tiered_tiers
+        t_promo = time.perf_counter()
+        tiered._background.drain(timeout=600)
+        promo_wall = time.perf_counter() - t_promo
+        events = get_flight_recorder().promotions()[promotions_before:]
+        # Steady state: every shape again — all compiled now.
+        _steady_lat, steady_tiers, steady_compiles = run_cold_burst(tiered)
+        compiled_share = sum(
+            t in ("compiled", "promoted-midstream")
+            for t in steady_tiers) / len(steady_tiers)
+        assert steady_compiles == 0, steady_compiles
+        assert compiled_share >= 0.95, compiled_share
+
+        # Leg 3: prewarmed restart — a fresh evaluator, warmed
+        # compile-only from the shape mix, serves with 0 inline compiles.
+        records = [WorkloadRecord(kind="select", query=q, literals=[])
+                   for q in shapes]
+        fresh = _ev.Evaluator()
+        report = prewarm_from_capture(records, tables={"//t": chunk},
+                                      evaluator=fresh)
+        assert report["compiled"] + report["aot_hits"] == len(shapes), \
+            report
+        _pw_lat, pw_tiers, pw_compiles = run_cold_burst(fresh)
+        assert pw_compiles == 0, pw_compiles
+        assert all(t == "compiled" for t in pw_tiers), pw_tiers
+    finally:
+        _config.set_tiering_config(None)
+
+    p99_drop = pct(inline_lat, 0.99) / max(pct(tiered_lat, 0.99), 1e-9)
+    mean_promo = (sum(e["compile_seconds"] for e in events) /
+                  len(events) * 1e3) if events else 0.0
+    print(f"# tiering: {len(shapes)} cold shapes x {n_rows} rows; "
+          f"inline p50={pct(inline_lat, 0.5):.1f}ms "
+          f"p99={pct(inline_lat, 0.99):.1f}ms -> interpreted "
+          f"p50={pct(tiered_lat, 0.5):.1f}ms "
+          f"p99={pct(tiered_lat, 0.99):.1f}ms "
+          f"(cold p99 {p99_drop:.1f}x lower); "
+          f"{len(events)} background promotions "
+          f"(mean compile {mean_promo:.0f}ms, drained {promo_wall:.2f}s), "
+          f"steady compiled share {compiled_share * 100:.0f}%; "
+          f"prewarm: {report['compiled']} compiled in "
+          f"{report['seconds']:.2f}s, replay 0 inline compiles",
+          file=sys.stderr)
+    assert p99_drop >= 10.0, f"cold p99 drop {p99_drop:.1f}x < 10x"
+    return ("tiering_cold_queries_per_sec", len(shapes) / cold_elapsed,
+            cold_elapsed)
+
+
 _CONFIGS = {
     "vector": (bench_vector, 4_000_000, 200_000),
     "q1": (bench_q1, 64_000_000, 2_000_000),
@@ -2247,6 +2383,7 @@ _CONFIGS = {
     "multiway_join": (bench_multiway_join, 4_000_000, 400_000),
     "matview": (bench_matview, 2_000_000, 500_000),
     "sanitizer_overhead": (bench_sanitizer_overhead, 400_000, 400_000),
+    "tiering": (bench_tiering, 200_000, 50_000),
 }
 
 
@@ -2372,6 +2509,7 @@ _METRIC_NAMES = {
     "matview": "matview_rows_per_sec",
     "sanitizer_overhead": "sanitizer_acquires_per_sec",
     "vector": "vector_scan_rows_per_sec",
+    "tiering": "tiering_cold_queries_per_sec",
 }
 
 

@@ -1,0 +1,562 @@
+"""Adaptive tiered execution (ISSUE 18): the no-compile interpreter
+tier (declared coverage, bit-identity against the compiled path over a
+mixed-type corpus), the tier dispatcher (interpreted-first cold serving,
+hot-shape background promotion with the mid-traffic atomic swap,
+kill-switch), capture-driven prewarm (compile-only replay, zero inline
+compiles on restart, zero compile-storm alerts), the accounting
+discipline (background/prewarm compiles never book as cache misses),
+and the observability surfaces (execution_tier in statistics/EXPLAIN
+ANALYZE/workload records, flight-recorder promotion events,
+/tiers monitoring + tier_snapshot, `yt prewarm`).
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.engine import interp, lowering
+from ytsaurus_tpu.query.engine import evaluator as ev_mod
+from ytsaurus_tpu.query.engine.evaluator import (
+    Evaluator,
+    get_compile_observatory,
+)
+from ytsaurus_tpu.query.engine.prewarm import prewarm_from_capture
+from ytsaurus_tpu.query.profile import (
+    format_profile_dict,
+    get_flight_recorder,
+)
+from ytsaurus_tpu.query.statistics import QueryStatistics
+from ytsaurus_tpu.query.workload import WorkloadRecord
+from ytsaurus_tpu.schema import ColumnSchema, EValueType, TableSchema
+
+
+@pytest.fixture(autouse=True)
+def _tiering_defaults():
+    """Every test leaves the process-wide tiering config, observatory,
+    and flight recorder the way it found them."""
+    yield
+    yt_config.set_tiering_config(None)
+    yt_config.set_workload_config(None)
+    get_compile_observatory().reset()
+    get_flight_recorder().clear()
+
+
+def _mixed_chunk(n=200):
+    schema = TableSchema(columns=[
+        ColumnSchema(name="k", type=EValueType.int64),
+        ColumnSchema(name="v", type=EValueType.double),
+        ColumnSchema(name="s", type=EValueType.string),
+        ColumnSchema(name="b", type=EValueType.boolean),
+        ColumnSchema(name="u", type=EValueType.uint64),
+    ])
+    rng = np.random.RandomState(7)
+    rows = []
+    for i in range(n):
+        rows.append({
+            "k": int(rng.randint(0, 5)) if i % 7 else None,
+            "v": float(rng.randint(-50, 50)) if i % 5 else None,
+            "s": [b"alpha", b"beta", b"gamma", None][i % 4],
+            "b": bool(i % 3 == 0) if i % 11 else None,
+            "u": int(rng.randint(0, 1 << 40)),
+        })
+    return schema, ColumnarChunk.from_rows(schema, rows)
+
+
+def _small_chunk(n=100):
+    schema = TableSchema.make([("k", "int64"), ("v", "int64"),
+                               ("s", "string")])
+    rows = [{"k": i, "v": i * 3 % 17, "s": f"u{i % 5}".encode()}
+            for i in range(n)]
+    return schema, ColumnarChunk.from_rows(schema, rows)
+
+
+def _decode(planes, count, output):
+    """Planes -> row tuples, None for invalid slots — the tier-agnostic
+    result form both engines are compared in."""
+    cols = []
+    for (d, v), out in zip(planes, output):
+        d, v = np.asarray(d), np.asarray(v)
+        vals = []
+        for i in range(count):
+            if not v[i]:
+                vals.append(None)
+            elif out.type is EValueType.string:
+                vals.append(bytes(out.vocab[int(d[i])]))
+            elif out.type is EValueType.boolean:
+                vals.append(bool(d[i]))
+            elif out.type is EValueType.double:
+                vals.append(float(d[i]))
+            else:
+                vals.append(int(d[i]))
+        cols.append(vals)
+    return list(zip(*cols)) if cols else []
+
+
+# -- interpreter tier: coverage + bit identity ---------------------------------
+
+# The dual-check corpus: every clause/function family the interpreter
+# DECLARES covered, over nullable mixed-type data (nulls in keys,
+# strings, aggregates; empty results; offset/limit; having).
+CORPUS = [
+    "* from t",
+    "k, v from t where v > 0",
+    "k, sum(v) as sv, count(v) as c, avg(v) as av from t group by k",
+    "s, min(v) as mn, max(v) as mx, cardinality(k) as card from t "
+    "group by s",
+    "k, s, first(v) as fv from t group by k, s order by k, s limit 7",
+    "k, argmin(v, u) as am, argmax(s, v) as ax from t group by k",
+    "k, v from t order by v desc, k offset 3 limit 10",
+    "k from t where s in ('alpha', 'beta') and k between 1 and 3",
+    "concat(s, '_x') as cx, length(s) as ln from t where s like 'a%'",
+    "if(b, k, -1) as ik, if_null(v, 0.0) as nv from t "
+    "where not is_null(k)",
+    "k + 1 as k1, k % 3 as k3, k / 2 as k2, double(k) as dk from t",
+    "lower(s) as lo, upper(s) as up from t where s >= 'alpha'",
+    "timestamp_floor_day(k * 100000) as d from t",
+    "min_of(k, 2) as mo, max_of(v, 0.0) as xo, abs(v) as ab from t",
+    "u, k from t order by u limit 5",
+    "k, sum(v) as sv from t group by k having sum(v) > 0 "
+    "order by sum(v) desc limit 20",
+    "b, count(k) as c from t group by b order by b limit 20",
+    "k from t where v > 1000",                     # empty result
+    "s from t where s between 'aa' and 'bz'",
+]
+
+
+@pytest.mark.parametrize("query", CORPUS)
+def test_interpreter_bit_identity(query):
+    """ISSUE 18 acceptance: for every covered shape the interpreter's
+    planes decode to EXACTLY the compiled program's rows — same values,
+    same validity, same count, same order."""
+    schema, chunk = _mixed_chunk()
+    plan = build_query("select " + query, {"t": schema})
+    assert interp.covers(plan), query
+    iq = interp.try_prepare(plan, chunk)
+    assert iq is not None
+    planes_i, count_i = iq.execute(chunk)
+    assert isinstance(count_i, int)                # host int, no sync
+    prepared = lowering.prepare(plan, chunk)
+    columns = {name: (col.data, col.valid)
+               for name, col in chunk.columns.items()}
+    planes_c, count_c = prepared.run(columns, chunk.row_valid,
+                                     tuple(prepared.bindings))
+    assert _decode(planes_i, count_i, iq.output) == \
+        _decode(planes_c, int(count_c), prepared.output)
+
+
+def test_coverage_is_declared_not_guessed():
+    """Shapes outside the allow-list say so BEFORE execution: joins,
+    window functions, uncovered functions."""
+    schema, _chunk = _small_chunk()
+    other = TableSchema.make([("jk", "int64"), ("w", "int64")])
+    covered = build_query("select k, v from t where v > 1",
+                          {"t": schema})
+    assert interp.covers(covered)
+    joined = build_query(
+        "select k, w from t join u on k = jk",
+        {"t": schema, "u": other})
+    assert not interp.covers(joined)
+    windowed = build_query(
+        "select k, sum(v) over (partition by s) as sv from t",
+        {"t": schema})
+    assert not interp.covers(windowed)             # window functions
+    farmed = build_query("select farm_hash(k) as h from t",
+                         {"t": schema})
+    assert not interp.covers(farmed)               # uncovered function
+    assert interp.try_prepare(farmed, _chunk) is None
+
+
+def test_uncovered_shape_falls_through_to_inline_compile():
+    """Tiering ON + uncovered shape = the classic inline-compile path:
+    compiled tier, one miss booked, no interpreter involvement."""
+    yt_config.set_tiering_config(
+        yt_config.TieringConfig(enabled=True, hot_threshold=1))
+    schema, chunk = _small_chunk()
+    plan = build_query("select farm_hash(k) as h from t limit 4",
+                       {"t": schema})
+    assert not interp.covers(plan)
+    e = Evaluator()
+    stats = QueryStatistics()
+    e.run_plan(plan, chunk, stats=stats)
+    assert stats.execution_tier == "compiled"
+    assert stats.compile_count == 1
+    assert e._background.queue_depth() == 0
+
+
+# -- tier dispatcher: lifecycle, swap, kill switch -----------------------------
+
+def test_tier_lifecycle_interpreted_promoted_compiled():
+    """The full ladder on one hot shape: cold dispatches serve
+    interpreted (zero misses booked), the hot-threshold crossing
+    enqueues ONE background promotion, the first post-promotion serve
+    tags promoted-midstream, steady state is compiled — and every tier
+    returns identical rows."""
+    yt_config.set_tiering_config(
+        yt_config.TieringConfig(enabled=True, hot_threshold=2))
+    schema, chunk = _small_chunk()
+    plan = build_query(
+        "select k, v from t where v > 3 order by v desc, k limit 5",
+        {"t": schema})
+    e = Evaluator()
+    obs = get_compile_observatory()
+    before = obs.totals()
+    results, tiers = [], []
+    for _ in range(2):
+        stats = QueryStatistics()
+        results.append(e.run_plan(plan, chunk, stats=stats).to_rows())
+        tiers.append(stats.execution_tier)
+    assert tiers == ["interpreted", "interpreted"]
+    e._background.drain(timeout=120)
+    for _ in range(2):
+        stats = QueryStatistics()
+        results.append(e.run_plan(plan, chunk, stats=stats).to_rows())
+        tiers.append(stats.execution_tier)
+    assert tiers[2:] == ["promoted-midstream", "compiled"]
+    assert all(r == results[0] for r in results[1:])
+    after = obs.totals()
+    assert after["misses"] - before["misses"] == 0
+    assert after["background_compiles"] - \
+        before["background_compiles"] == 1
+    # The promotion event landed in the flight recorder with the
+    # interpreted-run count that triggered it.
+    events = [p for p in get_flight_recorder().promotions()]
+    assert events and events[-1]["runs_interpreted"] >= 2
+    assert events[-1]["compile_seconds"] > 0
+
+
+def test_midtraffic_swap_under_8_threads():
+    """8 serving threads hammer one cold shape while the background
+    compiler swaps the program in: no torn results (every response
+    decodes to the same rows), EXACTLY one background compile, zero
+    inline misses, and the key ends compiled."""
+    yt_config.set_tiering_config(
+        yt_config.TieringConfig(enabled=True, hot_threshold=2))
+    schema, chunk = _small_chunk(256)
+    plan = build_query(
+        "select k, v from t where v > 2 order by v desc, k limit 9",
+        {"t": schema})
+    e = Evaluator()
+    obs = get_compile_observatory()
+    before = obs.totals()
+    expected = None
+    outcomes, errors = [], []
+    lock = threading.Lock()
+
+    def serve(n):
+        try:
+            for _ in range(n):
+                stats = QueryStatistics()
+                rows = e.run_plan(plan, chunk, stats=stats).to_rows()
+                with lock:
+                    outcomes.append((stats.execution_tier, rows))
+        except Exception as exc:   # noqa: BLE001 — surfaced below
+            with lock:
+                errors.append(exc)
+
+    threads = [threading.Thread(target=serve, args=(6,))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    e._background.drain(timeout=120)
+    assert not errors, errors
+    expected = e.run_plan(plan, chunk).to_rows()
+    assert all(rows == expected for _tier, rows in outcomes)
+    seen_tiers = {tier for tier, _rows in outcomes}
+    assert seen_tiers <= {"interpreted", "promoted-midstream",
+                          "compiled"}
+    assert "interpreted" in seen_tiers     # the cold burst never waited
+    after = obs.totals()
+    assert after["background_compiles"] - \
+        before["background_compiles"] == 1
+    assert after["misses"] - before["misses"] == 0
+    assert e._background.compiled_n == 1
+    stats = QueryStatistics()
+    e.run_plan(plan, chunk, stats=stats)
+    assert stats.execution_tier == "compiled"
+
+
+def test_kill_switch_restores_inline_compilation():
+    """TieringConfig.enabled=False (the default) is the rollout gate:
+    dispatch behaves exactly as before the tier existed."""
+    yt_config.set_tiering_config(None)
+    schema, chunk = _small_chunk()
+    plan = build_query("select k, v from t where v > 3 limit 5",
+                       {"t": schema})
+    e = Evaluator()
+    stats = QueryStatistics()
+    e.run_plan(plan, chunk, stats=stats)
+    assert stats.execution_tier == "compiled"
+    assert stats.compile_count == 1
+    assert e._governor.snapshot() == []
+    assert e._background.snapshot()["compiled"] == 0
+
+
+def test_governor_arms_once_and_rearms():
+    gov = ev_mod.TierGovernor()
+    assert not gov.note_interpreted("fp", 0.01, threshold=2)
+    assert gov.note_interpreted("fp", 0.01, threshold=2)
+    assert not gov.note_interpreted("fp", 0.01, threshold=2)
+    gov.rearm("fp")                 # dropped enqueue re-arms the shape
+    assert gov.note_interpreted("fp", 0.01, threshold=2)
+    assert gov.runs("fp") == 4
+    assert gov.snapshot()[0]["runs"] == 4
+
+
+def test_tier_snapshot_shape():
+    yt_config.set_tiering_config(
+        yt_config.TieringConfig(enabled=True, hot_threshold=3))
+    e = Evaluator()
+    snap = e.tier_snapshot()
+    assert snap["enabled"] is True
+    assert snap["hot_threshold"] == 3
+    assert set(snap["background"]) == {"queue_depth", "compiled",
+                                       "dropped",
+                                       "pending_promoted_tags"}
+    assert snap["fingerprints"] == []
+
+
+# -- observatory + sensor discipline -------------------------------------------
+
+def test_background_ledger_never_touches_miss_books():
+    """ISSUE 18 satellite: background promotions classify as
+    `background_promotion` in SEPARATE books — the hits/misses totals
+    the pool-sensor reconciliation and the storm SLO read stay
+    untouched."""
+    obs = get_compile_observatory()
+    before = obs.totals()
+    obs.observe_background("fp-bg", ("fp-bg", 128, ()), 0.25)
+    after = obs.totals()
+    assert after["misses"] == before["misses"]
+    assert after["hits"] == before["hits"]
+    assert after["background_compiles"] - \
+        before["background_compiles"] == 1
+    entry = next(e for e in obs.snapshot(top=50)["fingerprints"]
+                 if e["fingerprint"] == "fp-bg")
+    assert entry["last_miss_cause"] == "background_promotion"
+    assert entry["compiles"] == 0          # inline books untouched
+    assert entry["background_compiles"] == 1
+
+
+def test_interpreted_serves_book_zero_cache_traffic():
+    """An interpreted dispatch is NOT compile-cache traffic: no hit, no
+    miss, no observatory entry churn — only /query/tiers counters."""
+    yt_config.set_tiering_config(
+        yt_config.TieringConfig(enabled=True, hot_threshold=100))
+    schema, chunk = _small_chunk()
+    plan = build_query("select k from t where v > 3 limit 4",
+                       {"t": schema})
+    e = Evaluator()
+    obs = get_compile_observatory()
+    before = obs.totals()
+    for _ in range(5):
+        stats = QueryStatistics()
+        e.run_plan(plan, chunk, stats=stats)
+        assert stats.execution_tier == "interpreted"
+        assert stats.compile_count == 0 and stats.cache_hits == 0
+    after = obs.totals()
+    assert (after["hits"], after["misses"]) == \
+        (before["hits"], before["misses"])
+
+
+# -- capture-driven prewarm ----------------------------------------------------
+
+def _shape_records(schema):
+    queries = [
+        "k, v FROM [//t] WHERE v > 3 ORDER BY v desc, k LIMIT 5",
+        "v, sum(k) AS total FROM [//t] GROUP BY v",
+        "k FROM [//t] WHERE v >= 2 AND v <= 9 LIMIT 11",
+        "s, max(v) AS mx FROM [//t] GROUP BY s",
+        "k, v FROM [//t] ORDER BY k desc LIMIT 3",
+        "v, min(k) AS mn FROM [//t] GROUP BY v ORDER BY v LIMIT 20",
+    ]
+    return queries, [WorkloadRecord(kind="select", query=q, literals=[])
+                     for q in queries]
+
+
+def test_prewarm_restart_serves_zero_inline_compiles():
+    """ISSUE 18 acceptance: a fresh evaluator prewarmed from a capture
+    serves every captured shape with compile_count == 0 — the first
+    real dispatch is a memory-LRU hit."""
+    schema, chunk = _small_chunk()
+    queries, records = _shape_records(schema)
+    e = Evaluator()
+    report = prewarm_from_capture(records, tables={"//t": chunk},
+                                  evaluator=e)
+    assert report["compiled"] == len(queries)
+    assert report["skipped"] == 0
+    for q in queries:
+        stats = QueryStatistics()
+        e.run_plan(build_query(q, {"//t": schema}), chunk, stats=stats)
+        assert stats.compile_count == 0, q
+        assert stats.cache_hits == 1
+        assert stats.execution_tier == "compiled"
+    again = prewarm_from_capture(records, tables={"//t": chunk},
+                                 evaluator=e)
+    assert again["compiled"] == 0
+    assert again["already_cached"] == len(queries)
+
+
+def test_prewarm_fires_zero_storm_alerts():
+    """The regression the ISSUE names: a full prewarm replay books its
+    compiles in the background ledger, so the compile-storm SLO —
+    which reads /query/compile_cache hit/miss deltas — stays quiet
+    through the entire warm-up."""
+    from ytsaurus_tpu.query import workload as wl
+    from ytsaurus_tpu.utils.profiling import MetricsHistory, get_registry
+    from ytsaurus_tpu.utils.slo import SloTracker
+    slo = dict(wl.COMPILE_STORM_SLO, fast_window=60.0, slow_window=300.0)
+    tcfg = yt_config.TelemetryConfig.from_dict(
+        {"slos": {"compile_storm": slo}})
+    history = MetricsHistory(registry=get_registry())
+    tracker = SloTracker(tcfg, history=history)
+    schema, chunk = _small_chunk()
+    _queries, records = _shape_records(schema)
+    e = Evaluator()
+    obs = get_compile_observatory()
+    # One inline dispatch creates the sensor series pre-baseline.
+    e.run_plan(build_query("k FROM [//t] WHERE v < 99",
+                           {"//t": schema}), chunk)
+    before = obs.totals()
+    t0 = 1_000_000.0
+    history.sample_once(t0)
+    prewarm_from_capture(records, tables={"//t": chunk}, evaluator=e)
+    history.sample_once(t0 + 400.0)
+    snap = tracker.evaluate(now=t0 + 400.0)
+    assert not snap["slos"]["compile_storm"]["firing"]
+    assert not snap["active_alerts"]
+    after = obs.totals()
+    assert after["misses"] - before["misses"] == 0
+    assert after["background_compiles"] - \
+        before["background_compiles"] == len(records)
+
+
+def test_prewarm_skips_what_it_cannot_warm():
+    schema, chunk = _small_chunk()
+    other = TableSchema.make([("jk", "int64"), ("w", "int64")])
+    other_chunk = ColumnarChunk.from_rows(
+        other, [{"jk": i, "w": i} for i in range(8)])
+    records = [
+        WorkloadRecord(kind="select",
+                       query="k, v FROM [//t] WHERE v > 1 LIMIT 3",
+                       literals=[]),
+        WorkloadRecord(kind="select",
+                       query="k, w FROM [//t] JOIN [//u] ON k = jk",
+                       literals=[]),
+        WorkloadRecord(kind="select", query="k FROM [//gone] LIMIT 1",
+                       literals=[]),
+        WorkloadRecord(kind="write", query="", literals=[],
+                       table="//t"),
+    ]
+    report = prewarm_from_capture(
+        records, tables={"//t": chunk, "//u": other_chunk})
+    assert report["compiled"] == 1
+    assert report["skipped"] == 3
+    reasons = report["skip_reasons"]
+    assert reasons.get("joins") == 1
+    assert reasons.get("non_select") == 1
+
+
+def test_prewarm_requires_a_chunk_source():
+    _queries, records = _shape_records(None)
+    with pytest.raises(Exception):
+        prewarm_from_capture(records)
+
+
+# -- observability surfaces ----------------------------------------------------
+
+def test_execution_tier_in_statistics_and_explain_analyze():
+    yt_config.set_tiering_config(
+        yt_config.TieringConfig(enabled=True, hot_threshold=50))
+    schema, chunk = _small_chunk()
+    plan = build_query("select k from t where v > 3 limit 4",
+                       {"t": schema})
+    e = Evaluator()
+    stats = QueryStatistics()
+    e.run_plan(plan, chunk, stats=stats)
+    assert stats.execution_tier == "interpreted"
+    assert stats.to_dict()["execution_tier"] == "interpreted"
+    rendered = format_profile_dict(
+        {"query": "q", "statistics": stats.to_dict()})
+    assert "execution tier: interpreted" in rendered
+    # Old profiles (no field) render as compiled.
+    assert "execution tier: compiled" in \
+        format_profile_dict({"query": "q", "statistics": {}})
+
+
+def test_workload_record_carries_execution_tier():
+    record = WorkloadRecord(kind="select", query="k FROM [//t]",
+                            literals=[], execution_tier="interpreted")
+    assert WorkloadRecord.from_dict(
+        record.to_dict()).execution_tier == "interpreted"
+    # Old captures (field absent) load as compiled.
+    d = record.to_dict()
+    d.pop("execution_tier")
+    assert WorkloadRecord.from_dict(d).execution_tier == "compiled"
+
+
+def test_monitoring_tiers_endpoint():
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    yt_config.set_tiering_config(
+        yt_config.TieringConfig(enabled=True, hot_threshold=7))
+    server = MonitoringServer(port=0)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{server.address}/tiers?top=5") as resp:
+            tiers = json.loads(resp.read())
+        assert tiers["enabled"] is True
+        assert tiers["hot_threshold"] == 7
+        assert "background" in tiers and "fingerprints" in tiers
+    finally:
+        server.stop()
+
+
+def test_tiering_config_defaults_and_daemon_wiring():
+    cfg = yt_config.TieringConfig()
+    assert cfg.enabled is False            # kill switch: default OFF
+    assert cfg.hot_threshold == 2
+    assert cfg.queue_depth == 64
+    assert cfg.prewarm_capture is None
+    daemon = yt_config.DaemonConfig.from_dict(
+        {"tiering": {"enabled": True, "hot_threshold": 5}})
+    assert daemon.tiering.enabled is True
+    assert daemon.tiering.hot_threshold == 5
+    with pytest.raises(Exception):
+        yt_config.TieringConfig.from_dict({"hot_threshold": 0})
+
+
+def test_cli_prewarm(tmp_path, capsys):
+    from ytsaurus_tpu import cli
+    from ytsaurus_tpu.client import connect
+    from ytsaurus_tpu.query import workload as wl
+    client = connect(str(tmp_path / "cluster"))
+    schema = TableSchema.make(
+        [("k", "int64", "ascending"), ("v", "int64")], unique_keys=True)
+    client.create("table", "//pw/t",
+                  attributes={"schema": schema, "dynamic": True},
+                  recursive=True)
+    client.mount_table("//pw/t")
+    client.insert_rows("//pw/t",
+                       [{"k": i, "v": i * 2} for i in range(64)])
+    client.freeze_table("//pw/t")
+    wl.configure(None)
+    client.select_rows("k, v FROM [//pw/t] WHERE v < 10")
+    client.select_rows("v, sum(k) AS s FROM [//pw/t] GROUP BY v")
+    capture = str(tmp_path / "capture.json")
+    assert wl.get_workload_log().export_capture(capture) == 2
+    rc = cli.run(["prewarm", "--capture", capture, "--json"],
+                 client=client)
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["records"] == 2
+    assert report["compiled"] + report["aot_hits"] + \
+        report["already_cached"] >= 1
+    assert report["skipped"] == 0

@@ -69,6 +69,9 @@ HOT_PREFIXES = (
 # them, so the sync count stays O(1) per query, not O(sites)).
 SYNC_POINT_FUNCTIONS = {
     "finish", "finish_all", "to_rows", "batched_nearest",
+    # The interpreter tier (ISSUE 18) pulls a chunk's planes to numpy
+    # exactly once, here, before evaluating host-side.
+    "materialize_planes",
 }
 
 # Whole-plan SPMD modules (ISSUE 12): the fused program must not sync

@@ -265,6 +265,23 @@ def _format_compile_top(snapshot: dict, sort_key: str,
     return "\n".join(lines)
 
 
+def _format_prewarm_report(report: dict) -> str:
+    lines = [
+        f"prewarmed {report.get('capture', '<capture>')}: "
+        f"{report.get('compiled', 0)} compiled, "
+        f"{report.get('aot_hits', 0)} AOT hits, "
+        f"{report.get('already_cached', 0)} already cached "
+        f"({report.get('seconds', 0.0):.3f}s compile+load)",
+        f"records: {report.get('records', 0)} selects, "
+        f"{report.get('skipped', 0)} skipped",
+    ]
+    reasons = report.get("skip_reasons") or {}
+    if reasons:
+        lines.append("skips: " + ", ".join(
+            f"{why} {n}" for why, n in sorted(reasons.items())))
+    return "\n".join(lines)
+
+
 def _format_replay_report(report: dict) -> str:
     lat = report.get("latency") or {}
     cache = report.get("compile_cache") or {}
@@ -410,6 +427,19 @@ def build_parser() -> argparse.ArgumentParser:
         (("--json",), {"action": "store_true",
                        "help": "raw report instead of the pretty "
                                "rendering"}))
+    cmd("prewarm", (("--capture",), {"required": True,
+                                     "help": "versioned workload capture "
+                                             "to replay COMPILE-ONLY "
+                                             "(ISSUE 18): every distinct "
+                                             "program the capture "
+                                             "implies compiles into the "
+                                             "memory/disk/cluster AOT "
+                                             "tiers without executing a "
+                                             "query"}),
+        (("--limit",), {"type": int, "default": 0,
+                        "help": "prewarm only the first N select "
+                                "records (0 = all)"}),
+        (("--json",), {"action": "store_true"}))
     cmd("analyze",
         # No `choices` here: the pass registry lives in tools/analyze
         # (PASSES); the driver validates, so a new pass needs no CLI
@@ -707,6 +737,27 @@ def _dispatch(cl, a):
         if a.json:
             return report
         print(_format_replay_report(report))
+        return None
+    if c == "prewarm":
+        # Compile-only capture replay (ISSUE 18): the caches being
+        # warmed live in the SERVING process, so this needs an
+        # in-process client (tests, embedded use, `yt ... --proxy`
+        # pointing at a thin client cannot reach them).  Daemons warm
+        # themselves at startup via YT_TPU_PREWARM_CAPTURE.
+        if getattr(cl, "cluster", None) is None:
+            raise YtError(
+                "prewarm requires an in-process client: the compile "
+                "caches live in the serving process.  Start the daemon "
+                "with YT_TPU_PREWARM_CAPTURE=<capture> (or set "
+                "tiering.prewarm_capture) to warm a replica at startup")
+        from ytsaurus_tpu.query.engine.prewarm import prewarm_capture_file
+        report = prewarm_capture_file(
+            a.capture, client=cl,
+            evaluator=cl.cluster.evaluator,
+            limit=a.limit or None)
+        if a.json:
+            return report
+        print(_format_prewarm_report(report))
         return None
     if c == "view":
         return _dispatch_view(cl, a)

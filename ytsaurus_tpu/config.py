@@ -598,6 +598,52 @@ def set_compile_config(config: "Optional[CompileConfig]") -> None:
     aot_cache.configure(config)
 
 
+class TieringConfig(YsonStruct):
+    """Adaptive tiered execution knobs (ISSUE 18, query/engine/interp.py +
+    query/engine/evaluator.py + query/engine/prewarm.py):
+
+    - `enabled`: master switch for the interpreter tier.  Off (the
+      default — rollout gate, same convention as `disk_cache_dir`)
+      restores the pre-tiering behavior exactly: every cold fingerprint
+      compiles inline.  On, a fingerprint that misses ALL THREE AOT
+      rungs (memory LRU, disk, cluster artifact store) is served by the
+      no-compile numpy interpreter immediately when its plan shape is
+      inside the interpreter's declared coverage, while the background
+      compiler promotes it off-thread.
+    - `hot_threshold`: interpreted executions of one fingerprint before
+      the background compiler is asked to promote it.  1 promotes on
+      first sight (bench/prewarm-adjacent workloads); higher values
+      keep one-shot ad-hoc shapes from burning compile capacity.
+    - `queue_depth`: bound on the background-compiler work queue.
+      Enqueues past it are dropped (the fingerprint re-arms on a later
+      interpreted run) — promotion is an optimization, never backlog.
+    - `prewarm_capture`: path to an exported workload capture (JSONL,
+      `yt workload capture` shape); daemon startup replays it through
+      compile-only prewarm so a restarted daemon joins hot.  None skips
+      the startup prewarm."""
+
+    enabled = param(False, type=bool)
+    hot_threshold = param(2, type=int, ge=1)
+    queue_depth = param(64, type=int, ge=1)
+    prewarm_capture = param(None, type=str)
+
+
+_TIERING_CONFIG: "Optional[TieringConfig]" = None
+
+
+def tiering_config() -> TieringConfig:
+    global _TIERING_CONFIG
+    if _TIERING_CONFIG is None:
+        _TIERING_CONFIG = TieringConfig()
+    return _TIERING_CONFIG
+
+
+def set_tiering_config(config: "Optional[TieringConfig]") -> None:
+    """Install a process-wide tiering config (None restores defaults)."""
+    global _TIERING_CONFIG
+    _TIERING_CONFIG = config
+
+
 class ViewsConfig(YsonStruct):
     """Continuous-query (materialized view) plane knobs (ISSUE 13,
     query/views.py + server/view_daemon.py):
@@ -870,6 +916,7 @@ class DaemonConfig(YsonStruct):
     telemetry = param(type=TelemetryConfig)
     workload = param(type=WorkloadConfig)
     compile = param(type=CompileConfig)
+    tiering = param(type=TieringConfig)
     sanitizer = param(type=SanitizerConfig)
 
     def postprocess(self):

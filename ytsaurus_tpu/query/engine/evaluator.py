@@ -40,6 +40,19 @@ _cache_counters = PoolSensorCache("/query/compile_cache",
                                   ("hits", "misses"))
 _evictions_counter = Profiler("/query/compile_cache").counter("evictions")
 
+# Execution-tier telemetry (ISSUE 18): which tier served each dispatch
+# (interpreted vs compiled), background promotions, the promotion
+# queue's depth, and prewarm compiles.  Deliberately a SEPARATE sensor
+# family from /query/compile_cache — tier traffic must never perturb
+# the hit/miss counters the compile-storm SLO and the observatory
+# reconciliation are built on.
+_tier_counters = PoolSensorCache("/query/tiers",
+                                 ("interpreted", "compiled"))
+_tiers_profiler = Profiler("/query/tiers")
+_promotions_counter = _tiers_profiler.counter("promotions")
+_prewarm_counter = _tiers_profiler.counter("prewarm_compiles")
+_tier_queue_gauge = _tiers_profiler.gauge("queue_depth")
+
 
 class CompileObservatory:
     """Per-fingerprint compile telemetry (ISSUE 8 tentpole, piece b).
@@ -78,6 +91,7 @@ class CompileObservatory:
         self.misses_n = 0
         self.evictions_n = 0
         self.disk_hits_n = 0
+        self.background_n = 0
 
     def _entry_locked(self, fp: str) -> dict:
         entry = self._fps.get(fp)
@@ -87,6 +101,7 @@ class CompileObservatory:
                 "compile_seconds": 0.0,
                 "shapes": set(), "shape_count": 0, "evictions": 0,
                 "last_miss_cause": None, "last_compile_at": 0.0,
+                "background_compiles": 0, "background_seconds": 0.0,
             }
         return entry
 
@@ -119,6 +134,30 @@ class CompileObservatory:
                 entry["compiles"] += 1
                 entry["compile_seconds"] += seconds
             entry["last_miss_cause"] = cause
+            entry["last_compile_at"] = time.time()
+            shapes = entry["shapes"]
+            if shape_sig not in shapes:
+                entry["shape_count"] += 1
+                if len(shapes) < self.SHAPE_SET_CAP:
+                    shapes.add(shape_sig)
+            self._evicted.pop(key, None)
+
+    def observe_background(self, fp: str, key: tuple,
+                           seconds: float) -> None:
+        """A DELIBERATE off-the-query-path compile (background
+        promotion or capture-driven prewarm, ISSUE 18).  Kept in
+        SEPARATE books from observe_miss: these are warm-up, not
+        misses — they must not move the `/query/compile_cache`
+        hit/miss counters the compile-storm SLO burns against, and the
+        sensor<->observatory reconciliation (test-enforced) only holds
+        if both keep counting the same dispatch events."""
+        shape_sig = key[1:]
+        with self._lock:
+            self.background_n += 1
+            entry = self._entry_locked(fp)
+            entry["background_compiles"] += 1
+            entry["background_seconds"] += seconds
+            entry["last_miss_cause"] = "background_promotion"
             entry["last_compile_at"] = time.time()
             shapes = entry["shapes"]
             if shape_sig not in shapes:
@@ -166,6 +205,7 @@ class CompileObservatory:
             return {"hits": self.hits_n, "misses": self.misses_n,
                     "evictions": self.evictions_n,
                     "disk_hits": self.disk_hits_n,
+                    "background_compiles": self.background_n,
                     "fingerprints": len(self._fps)}
 
     def top(self, n: int = 20,
@@ -203,6 +243,7 @@ class CompileObservatory:
             self._evicted.clear()
             self.hits_n = self.misses_n = self.evictions_n = 0
             self.disk_hits_n = 0
+            self.background_n = 0
 
 
 _observatory = CompileObservatory()
@@ -234,7 +275,7 @@ class _PendingResult:
     queue on a host read per shard."""
 
     __slots__ = ("planes", "count", "output", "stats", "_t0", "_chunk",
-                 "compile_seconds")
+                 "compile_seconds", "execution_tier")
 
     def __init__(self, planes, count, output, stats=None, t0=None):
         self.planes = planes
@@ -243,6 +284,7 @@ class _PendingResult:
         self.stats = stats
         self._t0 = t0
         self.compile_seconds = 0.0
+        self.execution_tier = "compiled"
         self._chunk: Optional[ColumnarChunk] = None
 
     def finish(self, host_count: Optional[int] = None) -> ColumnarChunk:
@@ -276,6 +318,7 @@ class _ReadyResult:
 
     __slots__ = ("_chunk",)
     count = None
+    execution_tier = "compiled"
 
     def __init__(self, chunk: ColumnarChunk):
         self._chunk = chunk
@@ -301,6 +344,235 @@ def finish_all(pendings: Sequence) -> list[ColumnarChunk]:
     return [p.finish(host_count=host.get(id(p))) for p in pendings]
 
 
+class TierGovernor:
+    """Per-fingerprint interpreter-tier roll-up (ISSUE 18 tentpole,
+    piece b): interpreted run count and cumulative interpreted seconds
+    per fingerprint — the promotion signal.  `note_interpreted` returns
+    True exactly once per fingerprint, when the run count crosses the
+    configured hot threshold, so the caller enqueues ONE background
+    promotion; a dropped enqueue re-arms via `rearm` (promotion is an
+    optimization, a full queue must not silently orphan a hot shape)."""
+
+    CAP = 4096
+
+    def __init__(self):
+        # guards: _fps
+        self._lock = sanitizers.register_lock(
+            "evaluator.TierGovernor._lock")
+        self._fps: "OrderedDict[str, dict]" = OrderedDict()
+
+    def note_interpreted(self, fp: str, seconds: float,
+                         threshold: int) -> bool:
+        with self._lock:
+            entry = self._fps.get(fp)
+            if entry is None:
+                entry = self._fps[fp] = {"runs": 0, "seconds": 0.0,
+                                         "armed": True}
+                while len(self._fps) > self.CAP:
+                    self._fps.popitem(last=False)
+            entry["runs"] += 1
+            entry["seconds"] += seconds
+            if entry["armed"] and entry["runs"] >= threshold:
+                entry["armed"] = False
+                return True
+            return False
+
+    def rearm(self, fp: str) -> None:
+        with self._lock:
+            entry = self._fps.get(fp)
+            if entry is not None:
+                entry["armed"] = True
+
+    def runs(self, fp: str) -> int:
+        with self._lock:
+            entry = self._fps.get(fp)
+            return entry["runs"] if entry else 0
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            rows = [{"fingerprint": fp, "runs": e["runs"],
+                     "interpreted_seconds": round(e["seconds"], 6)}
+                    for fp, e in self._fps.items()]
+        rows.sort(key=lambda r: (-r["runs"], r["fingerprint"]))
+        return rows
+
+    def reset(self) -> None:
+        with self._lock:
+            self._fps.clear()
+
+
+class BackgroundCompiler:
+    """Bounded off-thread promotion pipeline (ISSUE 18 tentpole, piece
+    b): hot interpreted fingerprints compile HERE — single-flight per
+    cache key, bounded queue (overflow drops, never blocks a serving
+    thread), cache insert under the evaluator's cache lock — and the
+    compiled program atomically replaces the interpreter mid-traffic:
+    the very next dispatch of that key takes the memory-LRU hit path.
+
+    `_lock` guards ONLY queue/bookkeeping state and is NEVER held
+    across a compile or while taking the evaluator's cache lock, so the
+    lock-order graph gains no edges from this thread."""
+
+    IDLE_EXIT_SECONDS = 1.0
+
+    def __init__(self, evaluator: "Evaluator"):
+        self._evaluator = evaluator
+        # guards: _queue, _queued, _promoted, _thread, compiled_n, dropped_n
+        self._lock = sanitizers.register_lock(
+            "evaluator.BackgroundCompiler._lock")
+        self._queue: deque = deque()
+        self._queued: set = set()
+        # Fingerprints promoted but not yet observed by a serving
+        # thread: the first compiled hit after promotion reports
+        # execution_tier="promoted-midstream" (consume-once).
+        self._promoted: set = set()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.compiled_n = 0
+        self.dropped_n = 0
+
+    def enqueue(self, key: tuple, prepared, args,
+                depth: int) -> str:
+        """Returns "queued", "duplicate", or "full"."""
+        with self._lock:
+            if key in self._queued:
+                return "duplicate"
+            if len(self._queue) >= depth:
+                self.dropped_n += 1
+                return "full"
+            self._queued.add(key)
+            self._queue.append((key, prepared, args))
+            _tier_queue_gauge.set(len(self._queue))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="background-compiler")
+                self._thread.start()
+        self._wake.set()
+        return "queued"
+
+    def consume_promoted(self, fp: str) -> bool:
+        if not self._promoted:     # lock-free fast path: usually empty
+            return False
+        with self._lock:
+            if fp in self._promoted:
+                self._promoted.discard(fp)
+                return True
+        return False
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until the queue is empty and no compile is in flight
+        (tests + graceful shutdown; the serving path never calls it)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue and not self._queued:
+                    return
+            time.sleep(0.005)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"queue_depth": len(self._queue),
+                    "compiled": self.compiled_n,
+                    "dropped": self.dropped_n,
+                    "pending_promoted_tags": len(self._promoted)}
+
+    # -- worker ----------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait(timeout=self.IDLE_EXIT_SECONDS)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    item = self._queue.popleft() if self._queue else None
+                    _tier_queue_gauge.set(len(self._queue))
+                if item is None:
+                    break
+                try:
+                    self._work(item)
+                except Exception:   # noqa: BLE001 — promotion is an
+                    # optimization; a failed compile must never kill
+                    # the worker (the interpreter keeps serving, and
+                    # _work's finally already released the key).
+                    pass
+            with self._lock:
+                if not self._queue and not self._wake.is_set():
+                    # Park: exit the thread; a later enqueue restarts
+                    # one (bounded threads across idle evaluators).
+                    self._thread = None
+                    return
+
+    def _work(self, item) -> None:
+        key, prepared, args = item
+        evaluator = self._evaluator
+        try:
+            with evaluator._cache_lock:
+                done = key in evaluator._cache
+            if not done:
+                self._promote(key, prepared, args)
+        finally:
+            with self._lock:
+                self._queued.discard(key)
+
+    def _promote(self, key: tuple, prepared, args) -> None:
+        import time as _time
+
+        from ytsaurus_tpu.config import workload_config
+        from ytsaurus_tpu.query.engine.aot_cache import (
+            get_cluster_store, get_disk_cache)
+        cfg = workload_config()
+        t0 = _time.perf_counter()
+        lowered = None
+        jitted = jax.jit(prepared.run)
+        try:
+            lowered = jitted.lower(*args)
+            fn = lowered.compile()
+        except Exception:   # noqa: BLE001 — AOT is an optimization;
+            # anything it cannot lower promotes through the jit
+            # wrapper (the call below compiles it fused, off-thread).
+            lowered = None
+            fn = jitted
+            fn(*args)
+        seconds = _time.perf_counter() - t0
+        if lowered is not None:
+            disk = get_disk_cache()
+            cluster = get_cluster_store()
+            if disk is not None:
+                disk.store(key, fn, key[0], seconds)
+            if cluster is not None:
+                cluster.publish(key, fn, key[0], seconds)
+        with self._evaluator._cache_lock:
+            self._evaluator._cache[key] = fn
+            evicted_keys = []
+            if cfg.compile_cache_capacity:
+                while len(self._evaluator._cache) > \
+                        cfg.compile_cache_capacity:
+                    evicted_keys.append(
+                        self._evaluator._cache.popitem(last=False)[0])
+        for evicted_key in evicted_keys:
+            _observatory.observe_eviction(evicted_key)
+            _evictions_counter.increment()
+        _observatory.observe_background(key[0], key, seconds)
+        _promotions_counter.increment()
+        with self._lock:
+            self._promoted.add(key[0])
+            self.compiled_n += 1
+        # The flight recorder's slow-query surface records the
+        # promotion event (ISSUE 18 satellite): which fingerprint, how
+        # long the background compile ran, how many interpreted runs
+        # preceded it.
+        from ytsaurus_tpu.query.profile import get_flight_recorder
+        get_flight_recorder().note_promotion(
+            key[0], seconds,
+            runs_interpreted=self._evaluator._governor.runs(key[0]),
+            capacity=int(key[1]))
+
+
 class Evaluator:
     """Caches compiled query programs and executes plans over chunks."""
 
@@ -323,9 +595,24 @@ class Evaluator:
         # steady-state hit-rate SLO.
         self._inflight: dict = {}
         self._join_cache: dict = {}
+        # Adaptive tiering (ISSUE 18): interpreted-run roll-up (the
+        # promotion signal) + the background promotion pipeline.  Both
+        # are inert — no threads, a few allocations — until
+        # TieringConfig.enabled turns the tier decision on.
+        self._governor = TierGovernor()
+        self._background = BackgroundCompiler(self)
 
     def cache_size(self) -> int:
         return len(self._cache)
+
+    def tier_snapshot(self, top: int = 50) -> dict:
+        """Monitoring/orchid view of the tiering plane (ISSUE 18)."""
+        from ytsaurus_tpu.config import tiering_config
+        cfg = tiering_config()
+        return {"enabled": cfg.enabled,
+                "hot_threshold": cfg.hot_threshold,
+                "background": self._background.snapshot(),
+                "fingerprints": self._governor.snapshot()[:top]}
 
     def _acquire_inflight(self, key: tuple):
         """Single-flight gate for one cache key: returns the compiled
@@ -413,6 +700,8 @@ class Evaluator:
             span.add_tag("compile_seconds",
                          round(getattr(pending, "compile_seconds", 0.0),
                                6))
+            span.add_tag("execution_tier",
+                         getattr(pending, "execution_tier", "compiled"))
             return pending
 
     def _dispatch_traced(self, plan, chunk, foreign_chunks, stats, t0,
@@ -502,16 +791,45 @@ class Evaluator:
             # elected leader (None back) and must release the gate.
             fn = self._acquire_inflight(key)
         if fn is None:
+            # Tier decision (ISSUE 18): with tiering on and the plan
+            # inside the interpreter's DECLARED coverage, _compile_miss
+            # probes only the persistent AOT rungs — when all of them
+            # miss it returns fn=None with ZERO miss bookkeeping and
+            # the interpreter serves this dispatch (off the compile
+            # ladder entirely) while the background compiler owns the
+            # fingerprint's promotion.  Coverage fallthrough
+            # (try_prepare -> None) and the kill switch both take the
+            # pre-tiering inline-compile path below, unchanged.
+            interp_query = None
+            tier_cfg = None
+            from ytsaurus_tpu.config import tiering_config
+            tier_cfg = tiering_config()
+            if tier_cfg.enabled:
+                from ytsaurus_tpu.query.engine import interp
+                interp_query = interp.try_prepare(plan, chunk)
             try:
                 fn, compile_seconds, result = self._compile_miss(
-                    key, prepared, chunk, args, stats, pool)
+                    key, prepared, chunk, args, stats, pool,
+                    interp_query=interp_query)
             finally:
                 self._release_inflight(key)
+            if fn is None and result is None:
+                return self._interpreted(interp_query, key, chunk,
+                                         prepared, args, stats, pool,
+                                         tier_cfg)
         else:
             _cache_counters.counters(pool)["hits"].increment()
             _observatory.observe_hit(key[0])
             if stats is not None:
                 stats.cache_hits += 1
+        execution_tier = "compiled"
+        if self._background.consume_promoted(key[0]):
+            # First compiled serve after a mid-traffic background
+            # promotion: the atomic swap, made visible.
+            execution_tier = "promoted-midstream"
+        _tier_counters.counters(pool)["compiled"].increment()
+        if stats is not None:
+            stats.execution_tier = execution_tier
         if result is None:
             try:
                 planes, count = fn(*args)
@@ -529,13 +847,48 @@ class Evaluator:
             planes, count = result
         pending = _PendingResult(planes, count, prepared.output)
         pending.compile_seconds = compile_seconds
+        pending.execution_tier = execution_tier
         return pending
 
-    def _compile_miss(self, key, prepared, chunk, args, stats, pool):
+    def _interpreted(self, interp_query, key, chunk, prepared, args,
+                     stats, pool, tier_cfg) -> _PendingResult:
+        """Serve one dispatch from the interpreter tier (ISSUE 18):
+        executes the no-compile numpy program, rolls the fingerprint up
+        in the governor, and enqueues a background promotion once the
+        hot threshold is crossed.  Runs with the single-flight gate
+        ALREADY RELEASED — concurrent dispatches of the same cold key
+        each interpret in parallel (interpretation is cheap; the gate
+        exists to prevent compile herds, not numpy herds)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        planes, count = interp_query.execute(chunk)
+        seconds = _time.perf_counter() - t0
+        _tier_counters.counters(pool)["interpreted"].increment()
+        if stats is not None:
+            stats.execution_tier = "interpreted"
+        if self._governor.note_interpreted(key[0], seconds,
+                                           tier_cfg.hot_threshold):
+            status = self._background.enqueue(key, prepared, args,
+                                              tier_cfg.queue_depth)
+            if status == "full":
+                self._governor.rearm(key[0])
+        pending = _PendingResult(planes, count, interp_query.output)
+        pending.execution_tier = "interpreted"
+        return pending
+
+    def _compile_miss(self, key, prepared, chunk, args, stats, pool,
+                      interp_query=None):
         """The memory-miss slow path (single-flight leader only):
         disk-tier load or fresh AOT compile, cache insert + eviction,
         counters/observatory/artifact bookkeeping.  Returns
-        (fn, compile_seconds, eager_result_or_None)."""
+        (fn, compile_seconds, eager_result_or_None).
+
+        With `interp_query` set (tier decision, ISSUE 18) the persistent
+        rungs are still probed — a ready executable beats interpreting —
+        but when ALL of them miss this returns (None, 0.0, None) with no
+        side effects at all: no miss counters, no span, no storm signal.
+        The caller serves the interpreter and the background compiler
+        owns the compile."""
         import time as _time
 
         from ytsaurus_tpu.config import workload_config
@@ -554,6 +907,16 @@ class Evaluator:
         fn = None
         disk = get_disk_cache()
         cluster = get_cluster_store()
+        if interp_query is not None:
+            t0p = _time.perf_counter()
+            if disk is not None and (fn := disk.load(key)) is not None:
+                cause = "disk_hit"
+            elif cluster is not None and \
+                    (fn := cluster.fetch(key)) is not None:
+                cause = "cluster_hit"
+            else:
+                return None, 0.0, None
+            probe_seconds = _time.perf_counter() - t0p
         # Memory miss: try the disk tier, then the CLUSTER artifact
         # store (fetch-on-miss, ISSUE 17 — a replica joining mid-storm
         # pulls hot executables its peers already published), else
@@ -567,9 +930,10 @@ class Evaluator:
                           capacity=chunk.capacity)
         with span:
             t0c = _time.perf_counter()
-            if disk is not None:
-                fn = disk.load(key)
             if fn is not None:
+                pass     # the tier probe above hit a persistent rung
+            elif disk is not None and \
+                    (fn := disk.load(key)) is not None:
                 cause = "disk_hit"
             elif cluster is not None and \
                     (fn := cluster.fetch(key)) is not None:
@@ -586,6 +950,8 @@ class Evaluator:
                     lowered = None
                     result = fn(*args)
             compile_seconds = _time.perf_counter() - t0c
+            if interp_query is not None:
+                compile_seconds += probe_seconds
             span.add_tag("cause", cause)
         if lowered is not None:
             # Persist the fresh AOT product so the NEXT process

@@ -1,0 +1,215 @@
+"""Capture-driven prewarm (ISSUE 18 tentpole, piece c).
+
+A restarted replica's compile cache is empty: without help, the first
+occurrence of every workload shape pays an inline compile on a serving
+thread — the compile storm PR 7's workload capture exists to measure.
+This module replays such a capture COMPILE-ONLY: each select record is
+re-parameterized (literals back into placeholders), planned against the
+live schemas, prepared against the table's real resident chunks (so the
+structure probes — fast-group min/max, vocabularies — make the SAME
+host decisions serving traffic will, and the cache key matches
+exactly), then lowered and compiled without ever executing.  Compiled
+programs land in the evaluator's memory LRU and publish to the disk /
+cluster AOT tiers.
+
+Accounting discipline: prewarm compiles book through the observatory's
+BACKGROUND ledger (observe_background) and the /query/tiers/
+prewarm_compiles counter — NEVER through /query/compile_cache/misses —
+so a full prewarm replay fires zero compile-storm alerts and leaves the
+steady-state hit-rate SLO untouched (test-enforced).
+
+Entry points: the daemon runs `prewarm_from_capture` at startup when
+TieringConfig.prewarm_capture (or YT_TPU_PREWARM_CAPTURE) names a
+capture file; `yt prewarm --capture FILE` drives the same path from the
+CLI with an in-process client.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Mapping, Optional
+
+import jax
+
+from ytsaurus_tpu.errors import YtError
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.parameterize import plan_fingerprint
+from ytsaurus_tpu.query.engine.lowering import prepare
+from ytsaurus_tpu.tablet.timestamp import MAX_TIMESTAMP
+
+
+def _resolve_chunks(path: str, tables, client) -> list:
+    """The table's resident chunks, newest authority first: an explicit
+    `tables` mapping (tests / embedded use), else the client's shard
+    staging — the same chunks serving traffic dispatches over."""
+    if tables is not None and path in tables:
+        data = tables[path]
+        return list(data) if isinstance(data, (list, tuple)) else [data]
+    if client is not None:
+        return list(client._query_shards(path, MAX_TIMESTAMP))
+    raise YtError(f"prewarm: no chunk source for table {path!r}")
+
+
+def _install(evaluator, key: tuple, fn) -> None:
+    """Memory-LRU insert with the same bounded-eviction bookkeeping as
+    the serving path (cache lock held only around the mutation)."""
+    from ytsaurus_tpu.config import workload_config
+    from ytsaurus_tpu.query.engine import evaluator as ev_mod
+    cfg = workload_config()
+    with evaluator._cache_lock:
+        evaluator._cache[key] = fn
+        evicted_keys = []
+        if cfg.compile_cache_capacity:
+            while len(evaluator._cache) > cfg.compile_cache_capacity:
+                evicted_keys.append(
+                    evaluator._cache.popitem(last=False)[0])
+    for evicted_key in evicted_keys:
+        ev_mod._observatory.observe_eviction(evicted_key)
+        ev_mod._evictions_counter.increment()
+
+
+def prewarm_from_capture(records, tables: Optional[Mapping] = None,
+                         schemas: Optional[Mapping] = None,
+                         client=None, evaluator=None,
+                         limit: Optional[int] = None) -> dict:
+    """Compile every distinct program a workload capture implies, off
+    the serving path.  Returns a report dict:
+
+      compiled        fresh lower().compile() runs (published to AOT)
+      aot_hits        programs loaded from the disk/cluster AOT tiers
+      already_cached  cache keys already resident in the memory LRU
+      skipped         records not prewarmable (joins, non-select kinds,
+                      missing tables, unparseable text) — with a bounded
+                      `skip_reasons` breakdown
+      seconds         total compile+load wall time
+    """
+    from ytsaurus_tpu.query.engine import evaluator as ev_mod
+    from ytsaurus_tpu.query.engine.aot_cache import (
+        get_cluster_store, get_disk_cache)
+    from ytsaurus_tpu.query.workload import substitute_literals
+
+    evaluator = evaluator or ev_mod._global_evaluator
+    if schemas is None and client is not None:
+        from ytsaurus_tpu.client import _SchemaResolver
+        schemas = _SchemaResolver(client)
+    elif schemas is None and tables is not None:
+        schemas = {path: data[0].schema if isinstance(data, (list, tuple))
+                   else data.schema for path, data in tables.items()}
+    if schemas is None:
+        raise YtError("prewarm requires schemas, tables, or a client")
+
+    report = {"records": 0, "compiled": 0, "aot_hits": 0,
+              "already_cached": 0, "skipped": 0, "seconds": 0.0}
+    reasons: dict[str, int] = {}
+    seen: set = set()
+    chunk_cache: dict[str, list] = {}
+
+    def _skip(why: str) -> None:
+        report["skipped"] += 1
+        reasons[why] = reasons.get(why, 0) + 1
+
+    for record in records:
+        if limit is not None and report["records"] >= limit:
+            break
+        if getattr(record, "kind", "select") != "select":
+            _skip("non_select")
+            continue
+        report["records"] += 1
+        try:
+            text = substitute_literals(record.query, record.literals)
+            plan = build_query(text, schemas)
+        except (YtError, ValueError) as err:
+            _skip(f"plan: {type(err).__name__}")
+            continue
+        if getattr(plan, "joins", ()):
+            # Join plans dispatch over the join-widened namespace the
+            # coordinator materializes per query — a shape this
+            # compile-only pass cannot reconstruct faithfully.  The
+            # interpreter tier doesn't cover joins either, so these
+            # shapes warm on first traffic exactly as before.
+            _skip("joins")
+            continue
+        try:
+            chunks = chunk_cache.get(plan.source)
+            if chunks is None:
+                chunks = chunk_cache[plan.source] = _resolve_chunks(
+                    plan.source, tables, client)
+        except YtError:
+            _skip("missing_table")
+            continue
+        fp = plan_fingerprint(plan)
+        if fp in seen:
+            # Same parameterized shape as an earlier record: every
+            # chunk's program key was already handled this pass.
+            continue
+        seen.add(fp)
+        for chunk in chunks:
+            try:
+                _prewarm_one(evaluator, plan, fp, chunk, seen, report,
+                             get_disk_cache(), get_cluster_store())
+            except Exception as err:   # noqa: BLE001 — prewarm is an
+                # optimization; one unlowerable shape must not abort
+                # the rest of the capture.
+                _skip(f"compile: {type(err).__name__}")
+    if reasons:
+        report["skip_reasons"] = reasons
+    return report
+
+
+def _prewarm_one(evaluator, plan, fp: str, chunk, seen: set,
+                 report: dict, disk, cluster) -> None:
+    """Compile (or AOT-load) one (plan, chunk) program into the caches."""
+    from ytsaurus_tpu.query.engine import evaluator as ev_mod
+    prepared = prepare(plan, chunk)
+    key = (fp, chunk.capacity, prepared.binding_shapes())
+    if key in seen:
+        return
+    seen.add(key)
+    with evaluator._cache_lock:
+        if key in evaluator._cache:
+            report["already_cached"] += 1
+            return
+    columns = {c.name: (chunk.columns[c.name].data,
+                        chunk.columns[c.name].valid)
+               for c in plan.schema}
+    args = (columns, chunk.row_valid, tuple(prepared.bindings))
+    t0 = time.perf_counter()
+    fn = disk.load(key) if disk is not None else None
+    if fn is not None:
+        report["aot_hits"] += 1
+    else:
+        fn = cluster.fetch(key) if cluster is not None else None
+        if fn is not None:
+            report["aot_hits"] += 1
+    if fn is None:
+        lowered = jax.jit(prepared.run).lower(*args)
+        fn = lowered.compile()
+        seconds = time.perf_counter() - t0
+        if disk is not None:
+            disk.store(key, fn, fp, seconds)
+        if cluster is not None:
+            cluster.publish(key, fn, fp, seconds)
+        report["compiled"] += 1
+        ev_mod._prewarm_counter.increment()
+    seconds = time.perf_counter() - t0
+    _install(evaluator, key, fn)
+    # Background ledger, NOT the miss path: a prewarm sweep must leave
+    # /query/compile_cache/{hits,misses} — the storm SLO's inputs —
+    # exactly where it found them.
+    ev_mod._observatory.observe_background(fp, key, seconds)
+    report["seconds"] += seconds
+
+
+def prewarm_capture_file(path: str, tables: Optional[Mapping] = None,
+                         schemas: Optional[Mapping] = None,
+                         client=None, evaluator=None,
+                         limit: Optional[int] = None) -> dict:
+    """Load a capture file (failing loudly on schema-version mismatch)
+    and prewarm it.  The daemon-startup and CLI entry point."""
+    from ytsaurus_tpu.query.workload import load_capture
+    records = load_capture(path)
+    report = prewarm_from_capture(records, tables=tables,
+                                  schemas=schemas, client=client,
+                                  evaluator=evaluator, limit=limit)
+    report["capture"] = path
+    return report

@@ -129,6 +129,9 @@ def format_profile_dict(p: dict) -> str:
         f"{stats.get('shards_skipped', 0)}); compile cache "
         f"{stats.get('cache_hits', 0)} hits / "
         f"{stats.get('compile_count', 0)} misses",
+        # ISSUE 18: which execution tier served the query — the first
+        # question a cold-shape latency investigation asks.
+        f"execution tier: {stats.get('execution_tier', 'compiled')}",
     ]
     # ISSUE 8: why those misses happened (new fingerprint vs new shape
     # vs eviction) and which pow2 capacity buckets the programs ran
@@ -196,6 +199,27 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._slow: "deque[ExecutionProfile]" = deque(maxlen=128)
         self._recent: "deque[ExecutionProfile]" = deque(maxlen=128)
+        # Background-promotion events (ISSUE 18): a hot interpreted
+        # fingerprint's compiled program swapped in mid-traffic.
+        # Bounded like the logs; served next to the slow queries so
+        # "why did this shape's latency step down" is answerable from
+        # the recorder alone.
+        self._promotions: "deque[dict]" = deque(maxlen=256)
+
+    def note_promotion(self, fingerprint: str, compile_seconds: float,
+                       runs_interpreted: int = 0,
+                       capacity: int = 0) -> None:
+        event = {"fingerprint": fingerprint,
+                 "compile_seconds": round(compile_seconds, 6),
+                 "runs_interpreted": int(runs_interpreted),
+                 "capacity": int(capacity),
+                 "promoted_at": time.time()}
+        with self._lock:
+            self._promotions.append(event)
+
+    def promotions(self) -> list[dict]:
+        with self._lock:
+            return list(self._promotions)
 
     def _apply_config(self, cfg) -> None:
         if self._slow.maxlen != cfg.slow_log_capacity:
@@ -235,6 +259,7 @@ class FlightRecorder:
         with self._lock:
             self._slow.clear()
             self._recent.clear()
+            self._promotions.clear()
 
     def snapshot(self) -> dict:
         """Monitoring view (profiles without result rows)."""
@@ -243,6 +268,7 @@ class FlightRecorder:
                              for p in self.slow_queries()],
             "recent": [p.to_dict(include_rows=False)
                        for p in self.recent()],
+            "promotions": self.promotions(),
         }
 
 

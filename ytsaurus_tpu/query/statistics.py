@@ -60,6 +60,12 @@ class QueryStatistics:
     # from the chunk-backed tier): a replica joining mid-storm serves
     # its first queries with these instead of fresh compiles.
     compile_cluster_hit: int = 0
+    # Which execution tier served the (last) dispatch of this query
+    # (ISSUE 18): "compiled", "interpreted" (the no-compile numpy
+    # tier), or "promoted-midstream" (first compiled serve after a
+    # background promotion swapped the program in mid-traffic).  A
+    # string — the serving counters skip it (only numerics fold).
+    execution_tier: str = "compiled"
 
     def note_join_stage(self, position: int, table: str, strategy: str,
                         est_rows: int = 0, actual_rows=None) -> None:

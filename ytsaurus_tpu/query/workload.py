@@ -139,7 +139,7 @@ _RECORD_FIELDS = (
     "kind", "query", "literals", "fingerprint", "table", "keys",
     "pool", "user", "started_at", "outcome", "wall_time",
     "compile_time", "execute_time", "rows_read", "rows_returned",
-    "capacity_buckets", "trace_id",
+    "capacity_buckets", "trace_id", "execution_tier",
 )
 
 
@@ -152,7 +152,8 @@ class WorkloadRecord:
                  fingerprint=None, table=None, keys=0, pool=None,
                  user=None, started_at=0.0, outcome="ok", wall_time=0.0,
                  compile_time=0.0, execute_time=0.0, rows_read=0,
-                 rows_returned=0, capacity_buckets=(), trace_id=None):
+                 rows_returned=0, capacity_buckets=(), trace_id=None,
+                 execution_tier="compiled"):
         self.kind = kind
         self.query = query
         self.literals = [list(lit) for lit in literals]
@@ -171,6 +172,9 @@ class WorkloadRecord:
         self.rows_returned = int(rows_returned)
         self.capacity_buckets = sorted(int(b) for b in capacity_buckets)
         self.trace_id = trace_id
+        # Which tier served the query (ISSUE 18): defaults keep old
+        # captures loadable — a missing field reads as "compiled".
+        self.execution_tier = execution_tier
 
     def to_dict(self) -> dict:
         return {field: getattr(self, field) for field in _RECORD_FIELDS}
@@ -182,7 +186,7 @@ class WorkloadRecord:
         kwargs = {field: data[field] for field in _RECORD_FIELDS
                   if field in data and data[field] is not None}
         for key in ("kind", "query", "fingerprint", "table", "pool",
-                    "user", "outcome", "trace_id"):
+                    "user", "outcome", "trace_id", "execution_tier"):
             if isinstance(kwargs.get(key), bytes):
                 kwargs[key] = kwargs[key].decode("utf-8", "replace")
         return cls(**kwargs)
@@ -266,8 +270,16 @@ class WorkloadLog:
                 "table": record.table, "count": 0, "ok": 0, "errors": 0,
                 "throttled": 0, "deadline": 0, "wall_seconds": 0.0,
                 "compile_seconds": 0.0, "last_at": 0.0,
+                # ISSUE 18: how often the interpreter tier served this
+                # shape — next to count and compile_seconds, the
+                # promotion-value signal (runs x compile cost x delta)
+                # is readable straight off the roll-up.
+                "interpreted": 0, "interpreted_seconds": 0.0,
             }
         entry["count"] += 1
+        if record.execution_tier == "interpreted":
+            entry["interpreted"] += 1
+            entry["interpreted_seconds"] += record.execute_time
         bucket = record.outcome if record.outcome in (
             "ok", "throttled", "deadline") else "errors"
         entry[bucket] += 1
@@ -317,7 +329,8 @@ class WorkloadLog:
             rows_read=int(stats_dict.get("rows_read", 0)),
             rows_returned=int(stats_dict.get("rows_written", 0)),
             capacity_buckets=stats_dict.get("capacity_buckets") or (),
-            trace_id=trace_id)
+            trace_id=trace_id,
+            execution_tier=stats_dict.get("execution_tier", "compiled"))
         return self.observe(record, presampled=True)
 
     def observe_lookup(self, table: str, keys: Sequence[tuple],

@@ -371,6 +371,33 @@ def run_primary(root: str, port: int, replication_factor: int = 2,
     set_cluster_store(artifact_store)
     orchid.register("/query/compile_cache/cluster",
                     artifact_store.snapshot)
+    # Adaptive tiering plane (ISSUE 18): the tier ladder's live state —
+    # kill switch, promotion queue, per-fingerprint interpreted-run
+    # roll-up — next to the compile cache it feeds.
+    orchid.register("/query/tiers", cluster.evaluator.tier_snapshot)
+    monitoring.tier_evaluator = cluster.evaluator
+    # Capture-driven prewarm (ISSUE 18 tentpole, piece c): replay an
+    # exported workload capture COMPILE-ONLY before serving traffic, so
+    # a restarted replica's first queries hit warm programs instead of
+    # paying inline compiles.  Gated on the env var (daemon idiom) or
+    # TieringConfig.prewarm_capture; a missing/broken capture logs and
+    # serves cold — prewarm is an optimization, never a boot gate.
+    from ytsaurus_tpu.config import tiering_config
+    prewarm_capture = os.environ.get("YT_TPU_PREWARM_CAPTURE") or \
+        tiering_config().prewarm_capture
+    if prewarm_capture:
+        from ytsaurus_tpu.query.engine.prewarm import prewarm_capture_file
+        try:
+            report = prewarm_capture_file(prewarm_capture, client=client,
+                                          evaluator=cluster.evaluator)
+            print(f"prewarm {prewarm_capture}: "
+                  f"{report['compiled']} compiled, "
+                  f"{report['aot_hits']} AOT hits, "
+                  f"{report['skipped']} skipped "
+                  f"({report['seconds']:.3f}s)", flush=True)
+        except Exception as err:   # noqa: BLE001 — serve cold instead
+            print(f"prewarm failed ({prewarm_capture}): {err}",
+                  flush=True)
     # Background re-replication: a dead node's chunks regain their
     # replication factor within ~interval, read or no read (ref
     # chunk_replicator.h).  A follower's empty node tracker makes its

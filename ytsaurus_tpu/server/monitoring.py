@@ -57,6 +57,10 @@ class MonitoringServer:
         # ReplicaRouter would see every replica's load on every scrape.
         # None keeps the default: every live gateway in the process.
         self.serving_gateways: Optional[list] = None
+        # /tiers scope (ISSUE 18): the daemon points this at its
+        # cluster's serving evaluator; None falls back to the process
+        # default (engine-level embedders, tests).
+        self.tier_evaluator = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -224,6 +228,17 @@ class MonitoringServer:
             )
             top = int(params.get("top", 50))
             body = json.dumps(get_compile_observatory().snapshot(top=top),
+                              indent=2, default=_json_default).encode()
+            self._reply(request, 200, body, "application/json")
+        elif path == "/tiers":
+            # Adaptive tiering plane (ISSUE 18): kill switch + hot
+            # threshold, the background promotion pipeline's queue/
+            # compiled/dropped counters, and the per-fingerprint
+            # interpreted-run roll-up feeding the promotion decision.
+            from ytsaurus_tpu.query.engine import evaluator as _ev
+            evaluator = self.tier_evaluator or _ev._global_evaluator
+            top = int(params.get("top", 50))
+            body = json.dumps(evaluator.tier_snapshot(top=top),
                               indent=2, default=_json_default).encode()
             self._reply(request, 200, body, "application/json")
         elif path == "/metrics/history":
