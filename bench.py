@@ -2362,6 +2362,144 @@ def bench_tiering(n_rows, iters):
             cold_elapsed)
 
 
+# --- per-primitive kernel microbench (ISSUE 19 move c) ----------------------
+# tools/kernel_floors.json records rows/s floors per (device, n_rows);
+# a measured primitive dipping under its floor fails the config.  Floors
+# are written at 0.4x a measured run (YT_TPU_UPDATE_KERNEL_FLOORS=1) so
+# machine jitter does not trip the gate; a real engine regression (2.5x+
+# slowdown) does.  tests/test_bench_kernels.py asserts the smoke-scale
+# floors inside the tier-1 pass.
+
+KERNEL_FLOORS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "tools",
+    "kernel_floors.json")
+
+
+def _load_kernel_floors():
+    try:
+        with open(KERNEL_FLOORS_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def kernel_primitives(n_rows, iters):
+    """Time each ops/segments.py backbone primitive; returns
+    {name: (rows_per_sec, best_seconds)}.  Shared by the bench config
+    and the tier-1 smoke test."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ytsaurus_tpu.ops import segments
+    from ytsaurus_tpu.query.engine.joins import _lex_searchsorted
+    from ytsaurus_tpu.schema import EValueType
+
+    rng = np.random.default_rng(7)
+    nseg = int(min(10_001, max(n_rows // 100, 2)))
+    seg_sorted_np = np.sort(rng.integers(0, nseg, n_rows))
+    seg_sorted = jnp.asarray(seg_sorted_np, dtype=jnp.int32)
+    seg_unsorted = jnp.asarray(rng.permutation(seg_sorted_np),
+                               dtype=jnp.int32)
+    vals = jnp.asarray(rng.random(n_rows))
+    keys64 = jnp.asarray(rng.integers(0, 1 << 60, n_rows, dtype=np.int64))
+    valid = jnp.ones(n_rows, dtype=bool)
+    starts = jnp.concatenate([jnp.ones(1, dtype=bool),
+                              seg_sorted[1:] != seg_sorted[:-1]])
+    mask = jnp.asarray(rng.random(n_rows) < 0.5)
+    # Encoded join-key planes: (null_rank int8, value) pairs, the format
+    # _emit_encoded_keys produces (joins.py).
+    ones8 = jnp.ones(n_rows, dtype=jnp.int8)
+    f_sorted = jnp.asarray(
+        np.sort(rng.integers(1, 1 << 60, n_rows, dtype=np.int64)))
+    probe_keys = jnp.asarray(
+        rng.integers(1, 1 << 60, n_rows, dtype=np.int64))
+
+    def timed(fn, *args):
+        fn_j = jax.jit(fn)
+        out = fn_j(*args)                  # warm-up / compile
+        _sync(out)
+        times = []
+        while _iters_left(times, iters):
+            t0 = time.perf_counter()
+            out = fn_j(*args)
+            _sync(out)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    secs = {}
+    secs["segscan_sum"] = timed(
+        lambda d, st: segments.segment_scan("sum", d, st), vals, starts)
+    secs["group_sum_sorted"] = timed(
+        lambda d, sg, v: segments.segment_aggregate(
+            "sum", d, v, sg, nseg, EValueType.double, assume_sorted=True),
+        vals, seg_sorted, valid)
+    secs["group_sum_scatter"] = timed(
+        lambda d, sg, v: segments.segment_aggregate(
+            "sum", d, v, sg, nseg, EValueType.double),
+        vals, seg_unsorted, valid)
+    secs["group_min_scatter"] = timed(
+        lambda d, sg, v: segments.segment_aggregate(
+            "min", d, v, sg, nseg, EValueType.double),
+        vals, seg_unsorted, valid)
+    secs["radix_rank_u64"] = timed(
+        lambda k, v: segments.stable_argsort_u32(
+            segments.monotone_u32_words(k, v)), keys64, valid)
+    secs["packed_sort_14bit"] = timed(
+        lambda sg, v: segments.packed_sort_indices([(sg, v, False, 14)]),
+        seg_unsorted, valid)
+    secs["hash_group_order"] = timed(
+        lambda k, v: segments.hash_group_order([(k, v)], v), keys64, valid)
+    secs["lex_probe"] = timed(
+        lambda f, q, n8: _lex_searchsorted(
+            [(n8, f)], jnp.int64(n_rows), n_rows, [(n8, q)], "left"),
+        f_sorted, probe_keys, ones8)
+    secs["compact_mask"] = timed(lambda m: segments.compact_mask(m), mask)
+    return {name: (n_rows / t, t) for name, t in secs.items()}
+
+
+def bench_kernels(n_rows, iters):
+    """Per-primitive rows/s/core for the segmented-scan / radix / probe
+    backbone (ISSUE 19): the floor every macro number multiplies.  The
+    config metric is the SLOWEST primitive.  ops/pallas_radix.py is the
+    staging ground for moving the rank loop on-chip; these numbers time
+    the XLA path."""
+    import jax
+    platform = jax.devices()[0].platform
+    results = kernel_primitives(n_rows, iters)
+    floors_doc = _load_kernel_floors()
+    entry = floors_doc.get(platform, {}).get(str(n_rows), {})
+    failures = []
+    for name, (rps, best) in sorted(results.items()):
+        floor = entry.get(name)
+        status = ""
+        if floor is not None:
+            status = " (floor %.3g)" % floor
+            if rps < floor:
+                failures.append((name, rps, floor))
+                status += " REGRESSION"
+        print("# kernel %-18s %12.1f rows/s  best %8.2fms%s"
+              % (name, rps, best * 1e3, status), file=sys.stderr)
+    if os.environ.get("YT_TPU_UPDATE_KERNEL_FLOORS"):
+        floors_doc.setdefault(platform, {})[str(n_rows)] = {
+            name: round(rps * 0.4, 1)
+            for name, (rps, _) in sorted(results.items())}
+        os.makedirs(os.path.dirname(KERNEL_FLOORS_PATH), exist_ok=True)
+        tmp = KERNEL_FLOORS_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(floors_doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, KERNEL_FLOORS_PATH)
+        print(f"# kernel floors updated: {KERNEL_FLOORS_PATH} "
+              f"({platform}:{n_rows})", file=sys.stderr)
+    assert not failures, \
+        "kernel primitives under recorded floor: %s" % failures
+    worst = min(results, key=lambda k: results[k][0])
+    return ("kernels_min_rows_per_sec", results[worst][0],
+            results[worst][1])
+
+
+
 _CONFIGS = {
     "vector": (bench_vector, 4_000_000, 200_000),
     "q1": (bench_q1, 64_000_000, 2_000_000),
@@ -2384,6 +2522,7 @@ _CONFIGS = {
     "matview": (bench_matview, 2_000_000, 500_000),
     "sanitizer_overhead": (bench_sanitizer_overhead, 400_000, 400_000),
     "tiering": (bench_tiering, 200_000, 50_000),
+    "kernels": (bench_kernels, 64_000_000, 2_000_000),
 }
 
 
@@ -2510,6 +2649,7 @@ _METRIC_NAMES = {
     "sanitizer_overhead": "sanitizer_acquires_per_sec",
     "vector": "vector_scan_rows_per_sec",
     "tiering": "tiering_cold_queries_per_sec",
+    "kernels": "kernels_min_rows_per_sec",
 }
 
 

@@ -1,0 +1,43 @@
+"""Tier-1 smoke gate for the per-primitive kernel floors (ISSUE 19).
+
+`bench.py --config kernels` times the ops/segments.py backbone
+(segmented scans, scatter segment-reduce, radix ranks, packed sorts,
+hash group order, lex join probe, mask compaction) and records rows/s
+floors in tools/kernel_floors.json at 0.4x a measured run.  This test
+replays the smoke-scale measurement inside the tier-1 pass so a backbone
+regression (an engine falling off its fast path, a silently serialized
+scatter) fails the build here, not rounds later in a macro bench.
+"""
+
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_SMOKE_ROWS = 100_000
+
+
+def test_kernel_floors_hold():
+    import jax
+
+    import bench
+
+    platform = jax.devices()[0].platform
+    floors = bench._load_kernel_floors()
+    entry = floors.get(platform, {}).get(str(_SMOKE_ROWS))
+    if not entry:
+        pytest.skip(f"no recorded kernel floors for "
+                    f"{platform}:{_SMOKE_ROWS}")
+    results = bench.kernel_primitives(_SMOKE_ROWS, iters=3)
+    # The floor file and the harness must agree on the primitive set —
+    # a renamed or dropped primitive silently ungates otherwise.
+    assert set(results) == set(entry), (
+        sorted(results), sorted(entry))
+    failures = {name: {"measured": round(rps, 1), "floor": entry[name]}
+                for name, (rps, _) in results.items()
+                if rps < entry[name]}
+    assert not failures, f"kernel primitives under floor: {failures}"

@@ -1,0 +1,344 @@
+"""Encoded-plane kernel execution (ISSUE 19): the dual-check corpus.
+
+String predicates, string GROUP BY, and string ORDER BY execute on
+dict CODES (query/engine/expr.py `_bind_string_literal_cmp`); the
+decoded remap-table path stays behind `encoded_predicates=False` as the
+bit-identity oracle.  Every corpus leg here compares the encoded,
+donation-armed engine (the shipping default) against the fully
+conservative oracle (decoded predicates, donation off) and requires
+EXACT row identity — values, validity, order where the query orders.
+
+Corpus axes: dict-heavy (few words, many rows), null-heavy (70% null
+strings), high-cardinality (~900 distinct values), and mixed-vocab
+(two chunks with different vocabularies concatenated through
+`unify_dictionaries`).  Legs: local evaluator, the interpreter tier,
+and fused 8-device SPMD.  Satellite regressions ride along: the
+("strlit", op, vocab-digest) compile-cache fragmentation note, the
+identical-vocab `unify_dictionaries` fast path, and the sealed-layout
+ORDER BY sort skip vs its unsealed oracle.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.chunks.columnar import (
+    ColumnarChunk,
+    concat_chunks,
+    unify_dictionaries,
+)
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.engine import interp, lowering
+from ytsaurus_tpu.query.engine.evaluator import Evaluator
+from ytsaurus_tpu.query.statistics import QueryStatistics
+from ytsaurus_tpu.schema import EValueType, TableSchema
+
+SCHEMA = TableSchema.make([("k", "int64"), ("v", "int64"),
+                           ("s", "string")])
+
+WORDS = [b"alpha", b"beta", b"gamma", b"delta", b"eps", b"zeta"]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_compile_config():
+    yield
+    yt_config.set_compile_config(None)
+
+
+def _rows(n, words, null_every=9, seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        s = None if (null_every and i % null_every == 0) \
+            else words[int(rng.randint(0, len(words)))]
+        out.append({"k": i, "v": int(rng.randint(-100, 100)), "s": s})
+    return out
+
+
+def _dict_heavy():
+    return ColumnarChunk.from_rows(SCHEMA, _rows(3000, WORDS))
+
+
+def _null_heavy():
+    rng = np.random.RandomState(11)
+    rows = []
+    for i in range(1500):
+        s = WORDS[int(rng.randint(0, len(WORDS)))] \
+            if rng.randint(0, 10) >= 7 else None
+        rows.append({"k": i, "v": int(rng.randint(0, 50)), "s": s})
+    return ColumnarChunk.from_rows(SCHEMA, rows)
+
+
+def _high_card():
+    words = [f"u{i:04d}".encode() for i in range(900)] + [b"alpha"]
+    return ColumnarChunk.from_rows(SCHEMA, _rows(1200, words, seed=5))
+
+
+def _mixed_vocab():
+    """Two chunks whose vocabularies only partially overlap; the concat
+    runs them through `unify_dictionaries`, so codes here are POST-unify
+    remaps — the leg that catches any stale-code pairing."""
+    a = ColumnarChunk.from_rows(
+        SCHEMA, _rows(800, [b"alpha", b"beta", b"mix_a"], seed=7))
+    b = ColumnarChunk.from_rows(
+        SCHEMA, _rows(800, [b"beta", b"gamma", b"mix_b"], seed=13))
+    return concat_chunks([a, b])
+
+
+TABLES = {
+    "dict_heavy": _dict_heavy,
+    "null_heavy": _null_heavy,
+    "high_card": _high_card,
+    "mixed_vocab": _mixed_vocab,
+}
+
+# Every encoded-plane shape: equality / inequality / IN (present and
+# absent literals), order-preserving range compares, string GROUP BY,
+# string ORDER BY, and an empty result off an absent literal.
+CORPUS = [
+    "k, s from t where s = 'alpha'",
+    "k from t where s != 'beta'",
+    "k, s from t where s in ('alpha', 'gamma', 'zzz')",
+    "k from t where s > 'b'",
+    "k, v from t where s between 'a' and 'bz'",
+    "s, count(*) as c, sum(v) as sv from t group by s",
+    "k, s from t order by s, k limit 50",
+    "k from t where s = 'zzz'",
+]
+
+# Cheap subset for the expensive legs (interp is cheap but SPMD and the
+# extra tables each pay full compiles).
+CORPUS_QUICK = [CORPUS[0], CORPUS[2], CORPUS[5], CORPUS[6]]
+
+
+def _canon(rows):
+    def norm(v):
+        return (0, b"") if v is None else (1, v)
+
+    return sorted(tuple((k, norm(v)) for k, v in sorted(r.items()))
+                  for r in rows)
+
+
+def _run(query, chunk, *, encoded, donate):
+    yt_config.set_compile_config(yt_config.CompileConfig(
+        encoded_predicates=encoded, donate_buffers=donate))
+    try:
+        plan = build_query("select " + query, {"t": SCHEMA})
+        stats = QueryStatistics()
+        got = Evaluator().run_plan(plan, chunk, stats=stats)
+        return plan, got.to_rows(), stats
+    finally:
+        yt_config.set_compile_config(None)
+
+
+@pytest.mark.parametrize("table", sorted(TABLES))
+def test_dual_check_local(table):
+    """Encoded + donation-armed vs decoded + donation-off oracle: exact
+    rows on every corpus query, positional where the query orders."""
+    chunk = TABLES[table]()
+    queries = CORPUS if table in ("dict_heavy", "mixed_vocab") \
+        else CORPUS_QUICK
+    for query in queries:
+        plan, got, _ = _run(query, chunk, encoded=True, donate=True)
+        _, want, _ = _run(query, chunk, encoded=False, donate=False)
+        if plan.order is not None:
+            assert got == want, query
+        assert _canon(got) == _canon(want), query
+
+
+def _decode(planes, count, output):
+    """Planes -> row tuples, None for invalid slots (the tier-agnostic
+    comparison form, same as test_tiering)."""
+    cols = []
+    for (d, v), out in zip(planes, output):
+        d, v = np.asarray(d), np.asarray(v)
+        vals = []
+        for i in range(count):
+            if not v[i]:
+                vals.append(None)
+            elif out.type is EValueType.string:
+                vals.append(bytes(out.vocab[int(d[i])]))
+            elif out.type is EValueType.boolean:
+                vals.append(bool(d[i]))
+            elif out.type is EValueType.double:
+                vals.append(float(d[i]))
+            else:
+                vals.append(int(d[i]))
+        cols.append(vals)
+    return list(zip(*cols)) if cols else []
+
+
+@pytest.mark.parametrize("table", sorted(TABLES))
+def test_dual_check_interp_tier(table):
+    """The interpreter tier's numpy twin of the code-space compare must
+    stay bit-identical to the compiled encoded path — tier promotion
+    mid-stream must never change a query's answer."""
+    chunk = TABLES[table]()
+    for query in CORPUS:
+        plan = build_query("select " + query, {"t": SCHEMA})
+        if not interp.covers(plan):
+            continue
+        iq = interp.try_prepare(plan, chunk)
+        assert iq is not None, query
+        planes_i, count_i = iq.execute(chunk)
+        prepared = lowering.prepare(plan, chunk)
+        columns = {name: (col.data, col.valid)
+                   for name, col in chunk.columns.items()}
+        planes_c, count_c = prepared.run(columns, chunk.row_valid,
+                                         tuple(prepared.bindings))
+        assert _decode(planes_i, count_i, iq.output) == \
+            _decode(planes_c, int(count_c), prepared.output), query
+
+
+def test_dual_check_spmd(mesh8):
+    """Fused 8-device SPMD with per-shard vocab skew (distributed unify)
+    vs the decoded local oracle."""
+    from ytsaurus_tpu.parallel.distributed import ShardedTable
+    from ytsaurus_tpu.parallel.whole_plan import run_whole_plan
+    from ytsaurus_tpu.parallel.distributed import DistributedEvaluator
+    rng = np.random.RandomState(17)
+    chunks = []
+    for sh in range(8):
+        words = WORDS[sh % 3:] + [f"shard{sh}".encode()]
+        rows = [{"k": sh * 1000 + i, "v": int(rng.randint(0, 500)),
+                 "s": words[int(rng.randint(0, len(words)))]}
+                for i in range(120 + sh * 7)]
+        chunks.append(ColumnarChunk.from_rows(SCHEMA, rows))
+    table = ShardedTable.from_chunks(mesh8, chunks)
+    merged = concat_chunks(chunks)
+    de = DistributedEvaluator(mesh8)
+    for query in ["s, count(*) as c, sum(v) as sv from [//t] "
+                  "group by s order by s limit 100",
+                  "k, s from [//t] where s in ('alpha', 'shard3')"]:
+        plan = build_query("select " + query, {"//t": SCHEMA})
+        got = run_whole_plan(de, plan, table)
+        yt_config.set_compile_config(yt_config.CompileConfig(
+            encoded_predicates=False, donate_buffers=False))
+        try:
+            want = Evaluator().run_plan(plan, merged)
+        finally:
+            yt_config.set_compile_config(None)
+        assert _canon(got.to_rows()) == _canon(want.to_rows()), query
+
+
+# -- satellite regressions -----------------------------------------------------
+
+def test_strlit_note_fragments_compile_cache():
+    """Satellite 2: the bound code is only meaningful against one vocab
+    generation, so the vocab content digest must fold into
+    structure_key — two content-distinct vocabs may never share a cached
+    program for the same query text."""
+    plan = build_query("select k from t where s = 'alpha'",
+                       {"t": SCHEMA})
+    chunk_a = TABLES["dict_heavy"]()
+    chunk_b = ColumnarChunk.from_rows(
+        SCHEMA, _rows(3000, [b"alpha", b"other"]))
+
+    def strlit_notes(prepared):
+        def walk(node):
+            if isinstance(node, tuple):
+                if node[:1] == ("strlit",):
+                    yield node
+                for item in node:
+                    yield from walk(item)
+        return list(walk(prepared.structure_key))
+
+    notes_a = strlit_notes(lowering.prepare(plan, chunk_a))
+    notes_b = strlit_notes(lowering.prepare(plan, chunk_b))
+    assert notes_a and notes_b
+    assert notes_a != notes_b                     # digest fragments
+    # Content-identical vocab in a DIFFERENT array object: same key
+    # (the digest is content-addressed, not identity-addressed).
+    chunk_a2 = ColumnarChunk.from_rows(SCHEMA, _rows(3000, WORDS))
+    assert strlit_notes(lowering.prepare(plan, chunk_a2)) == notes_a
+
+
+def test_unify_dictionaries_identity_fast_path():
+    """Satellite 1: columns that already share one vocabulary (by
+    identity, or by content in distinct arrays) come back untouched —
+    no merged vocab, no device gathers."""
+    chunk = TABLES["dict_heavy"]()
+    col = chunk.columns["s"]
+    out, vocab = unify_dictionaries([col, col])
+    assert out[0] is col and out[1] is col
+    assert [bytes(w) for w in vocab] == \
+        [bytes(w) for w in col.dictionary]
+    # Content-equal vocab in a different array object.
+    col2 = dataclasses.replace(
+        col, dictionary=np.array(list(col.dictionary), dtype=object))
+    assert col2.dictionary is not col.dictionary
+    out2, _ = unify_dictionaries([col, col2])
+    assert out2[0] is col and out2[1] is col2
+    # Different content still merges.
+    other = ColumnarChunk.from_rows(
+        SCHEMA, _rows(100, [b"alpha", b"qq"])).columns["s"]
+    out3, vocab3 = unify_dictionaries([col, other])
+    assert out3[0] is not col
+    assert b"qq" in {bytes(w) for w in vocab3}
+
+
+def test_kernel_sensors_and_explain_line():
+    """Satellite 6: /query/kernels counters book per dispatch, the
+    statistics carry execution_encoding, and EXPLAIN ANALYZE renders
+    the `execution: encoded|decoded` line."""
+    from ytsaurus_tpu.query.engine import evaluator as ev_mod
+    from ytsaurus_tpu.query.profile import format_profile_dict
+    chunk = TABLES["dict_heavy"]()
+    e0 = ev_mod._encoded_scans_counter.get()
+    d0 = ev_mod._decoded_fallbacks_counter.get()
+    b0 = ev_mod._donated_buffers_counter.get()
+    _, _, stats = _run("k from t where s = 'alpha'", chunk,
+                       encoded=True, donate=True)
+    assert ev_mod._encoded_scans_counter.get() == e0 + 1
+    assert ev_mod._donated_buffers_counter.get() > b0
+    assert stats.execution_encoding == "encoded"
+    assert "execution: encoded" in \
+        format_profile_dict({"statistics": stats.to_dict()})
+    _, _, stats_d = _run("k from t where s = 'alpha'", chunk,
+                         encoded=False, donate=False)
+    assert ev_mod._decoded_fallbacks_counter.get() == d0 + 1
+    assert stats_d.execution_encoding == "decoded"
+    assert "execution: decoded" in \
+        format_profile_dict({"statistics": stats_d.to_dict()})
+    # Donation off: the arming counter stays put.
+    b1 = ev_mod._donated_buffers_counter.get()
+    _run("k from t where s = 'alpha'", chunk, encoded=True,
+         donate=False)
+    assert ev_mod._donated_buffers_counter.get() == b1
+
+
+def test_sealed_layout_skips_order_by_sort():
+    """Layout sealing: a chunk sealed `sorted_by=("k",)` compiles
+    ORDER BY k with the packed-key sort elided (the ("presorted", n)
+    structure note), and the skipped program returns exactly the rows
+    the unsealed oracle sorts for — including with a WHERE interleaved
+    (compact_mask is stable)."""
+    rows = _rows(1000, WORDS)                      # k already ascending
+    unsealed = ColumnarChunk.from_rows(SCHEMA, rows)
+    sealed = dataclasses.replace(unsealed, sorted_by=("k",))
+
+    def notes(prepared):
+        return [t for t in prepared.structure_key
+                if isinstance(t, tuple) and t[:1] == ("presorted",)]
+
+    plan = build_query("select k, v, s from t order by k limit 40",
+                       {"t": SCHEMA})
+    assert notes(lowering.prepare(plan, sealed)) == [("presorted", 1)]
+    assert notes(lowering.prepare(plan, unsealed)) == []
+    # Descending, or a non-prefix column, must NOT skip.
+    desc = build_query("select k from t order by k desc limit 4",
+                       {"t": SCHEMA})
+    assert notes(lowering.prepare(desc, sealed)) == []
+    off_key = build_query("select k from t order by v limit 4",
+                          {"t": SCHEMA})
+    assert notes(lowering.prepare(off_key, sealed)) == []
+
+    for query in ["select k, v, s from t order by k limit 40",
+                  "select k, s from t where s != 'beta' and v > -50 "
+                  "order by k limit 35"]:
+        qplan = build_query(query, {"t": SCHEMA})
+        got = Evaluator().run_plan(qplan, sealed).to_rows()
+        want = Evaluator().run_plan(qplan, unsealed).to_rows()
+        assert got == want, query

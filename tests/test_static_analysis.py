@@ -457,6 +457,46 @@ def test_dynamic_shape_tree_wide_on_run_plan_callees(tmp_path):
         "tree-wide scope"
 
 
+def test_decode_in_hot_path_flagged(tmp_path):
+    """ISSUE 19: vocab gathers and decode-helper calls in a hot module
+    are findings; the literal→code binders, the sync-point boundary,
+    bytes codec calls, and waived sites are not."""
+    f = fixture(tmp_path, "ytsaurus_tpu/query/engine/fix_decode.py", """
+        import numpy as np
+
+        def probe(col, rows):
+            words = col.dictionary[rows]                 # per-row gather
+            taken = np.take(col.vocab, rows)             # same, via take
+            rows2 = decode_rows(col)                     # decode helper
+            text = pattern.decode("utf-8")               # codec: exempt
+            return words, taken, rows2, text
+
+        def _vocab_code(vocab, value):
+            idx = np.searchsorted(vocab, value)
+            return idx if vocab[idx] == value else -1    # binder: exempt
+
+        def to_rows(self):
+            return [bytes(self.vocab[i]) for i in self.codes]  # boundary
+
+        def spill(col, rows):
+            # analyze: allow(decode-in-hot-path): materializes an export
+            return col.dictionary[rows]
+    """)
+    findings = jax_hazards.run([f])
+    assert rules_of(findings) == ["decode-in-hot-path"] * 3
+    assert sorted(fd.line for fd in findings) == [5, 6, 7]
+
+
+def test_decode_in_cold_module_exempt(tmp_path):
+    """The client/server layers decode freely — materializing rows for
+    humans is their job."""
+    f = fixture(tmp_path, "ytsaurus_tpu/server/fix_decode_cold.py", """
+        def render(col, rows):
+            return [col.dictionary[i] for i in rows]
+    """)
+    assert jax_hazards.run([f]) == []
+
+
 # --- failpoint & span coverage ------------------------------------------------
 
 

@@ -33,6 +33,14 @@ Rules
                   the bound went through a pow2 bucketing helper
                   (`pad_capacity`, `next_pow2`, ...).  Checked
                   tree-wide.
+  decode-in-hot-path
+                  (ISSUE 19) a dict-vocab gather (`vocab[...]`,
+                  `take` over a dictionary) or a `decode*` helper call
+                  in a hot-path module — encoded-plane kernels execute
+                  on dict CODES; decoded strings materialize only at
+                  the sync-point boundary (GROUP BY decodes once per
+                  group at finish) or via the O(1) literal→code
+                  binders (`_vocab_code`/`_range_code`).
   whole-plan-sync in the whole-plan SPMD modules (ISSUE 12) the fused
                   program permits exactly ONE device→host transfer —
                   the final stacked count read (`_read_counts`); any
@@ -90,6 +98,20 @@ BUCKET_HELPERS = {"pad_capacity", "next_pow2", "bucket_capacity"}
 # EVERY module (method calls included): feeding them an unbucketed
 # dynamically-sized plane compiles one program per distinct length.
 PLAN_CALLEES = {"run_plan", "run_plan_async"}
+
+# Encoded-plane execution (ISSUE 19): filter/group/join hot paths run
+# on dict CODES; materializing decoded strings there (a vocab gather, a
+# decode helper call) re-introduces the per-row host work the encoded
+# path exists to eliminate.  Decode belongs at the materialization
+# boundary (the sync-point functions) — or behind a reasoned waiver.
+DECODE_BINDER_FUNCTIONS = {
+    # O(1) host probes of the SORTED vocab that bind a literal to its
+    # code at prepare time — the encoded path's entry points, the exact
+    # opposite of a per-row decode.
+    "_vocab_code", "_range_code",
+}
+
+_VOCAB_LEAVES = ("vocab", "dictionary", "vocabulary")
 
 _JIT_DECORATORS = {"jit", "jax.jit", "partial", "functools.partial"}
 
@@ -233,6 +255,68 @@ def _check_whole_plan_sync(f: SourceFile,
             f"{', '.join(sorted(WHOLE_PLAN_SYNC_FUNCTIONS))} — waive "
             f"with `# analyze: allow(whole-plan-sync): reason` if "
             f"intentional"))
+
+
+def _is_vocab_expr(node: ast.AST) -> bool:
+    """Expressions that name a string-column vocabulary: `vocab`,
+    `col.dictionary`, `merged_vocab`, ..."""
+    leaf = dotted_name(node).rsplit(".", 1)[-1].lstrip("_").lower()
+    return bool(leaf) and leaf.endswith(_VOCAB_LEAVES)
+
+
+def _decode_sites(f: SourceFile):
+    """Yield (line, description) for every site that materializes
+    DECODED strings from a dict-encoded column."""
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Subscript) and _is_vocab_expr(node.value):
+            yield node.lineno, (
+                f"`{ast.unparse(node.value)}[...]` gathers decoded "
+                f"strings out of a dict vocabulary")
+        elif isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            leaf = callee.rsplit(".", 1)[-1]
+            if leaf == "take" and any(_is_vocab_expr(a) for a in
+                                      [node.func, *node.args]):
+                yield node.lineno, (
+                    "`take` over a dict vocabulary materializes "
+                    "decoded strings")
+            else:
+                stripped = leaf.lstrip("_").lower()
+                if stripped == "decode" and \
+                        isinstance(node.func, ast.Attribute) and \
+                        not _is_vocab_expr(node.func.value):
+                    # `some_bytes.decode("utf-8")` — a codec call on a
+                    # host value, not a vocab materialization.
+                    continue
+                if stripped == "decode" or stripped.startswith(
+                        ("decode_row", "decode_chunk", "decode_string",
+                         "decode_col", "decode_plane")):
+                    yield node.lineno, (
+                        f"decode helper `{callee}` materializes "
+                        f"string values")
+
+
+def _check_decode_in_hot_path(f: SourceFile,
+                              findings: "list[Finding]") -> None:
+    """ISSUE 19: hot paths execute on dict codes; decoded-string
+    materialization is sanctioned only at the declared materialization
+    boundary (the sync-point functions) and inside the O(1) literal→code
+    binders — anywhere else it needs a reasoned waiver."""
+    sanctioned_ranges = _function_ranges(
+        f.tree, SYNC_POINT_FUNCTIONS | DECODE_BINDER_FUNCTIONS)
+
+    def sanctioned(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in sanctioned_ranges)
+
+    for line, site in _decode_sites(f):
+        if sanctioned(line) or f.waived("decode-in-hot-path", line):
+            continue
+        findings.append(Finding(
+            PASS_NAME, "decode-in-hot-path", f.path, line,
+            f"{site}; hot-path kernels execute on dict CODES — decode "
+            f"at the materialization boundary "
+            f"({', '.join(sorted(SYNC_POINT_FUNCTIONS))}) or waive "
+            f"with `# analyze: allow(decode-in-hot-path): reason`"))
 
 
 def _jitted_functions(tree: ast.AST):
@@ -387,6 +471,10 @@ def run(files: "list[SourceFile]") -> "list[Finding]":
             _check_whole_plan_sync(f, findings)
         elif is_hot(f.path):
             _check_host_sync(f, findings)
+        if is_hot(f.path):
+            # Encoded-plane discipline (ISSUE 19) applies to every hot
+            # module, whole-plan included.
+            _check_decode_in_hot_path(f, findings)
         # Dynamic-shape is TREE-WIDE (ISSUE 10): bucketing is universal
         # now, so an unbucketed capacity is a finding wherever it lives.
         _check_dynamic_shapes(f, findings)

@@ -20,6 +20,8 @@ segment_readers.h) re-designed for XLA rather than translated:
 
 from __future__ import annotations
 
+import hashlib
+import weakref
 from dataclasses import dataclass, replace
 from typing import Any, Iterable, Mapping, Optional, Sequence
 
@@ -144,6 +146,14 @@ class ColumnarChunk:
     schema: TableSchema
     row_count: int
     columns: dict[str, Column]
+    # Sealed physical row order (ISSUE 19): column names whose ascending,
+    # null-first, YT-comparator order the rows are already in (a prefix
+    # guarantee: rows sorted by sorted_by[0], ties by sorted_by[1], ...).
+    # Sealed at tablet flush/snapshot time where the MVCC merge emits key
+    # order; ORDER BY lowering skips the packed-key sort when its spec is
+    # covered.  Row-order-preserving transforms propagate it; anything
+    # that reorders or merges rows must drop it (the default).
+    sorted_by: tuple = ()
 
     @property
     def capacity(self) -> int:
@@ -342,7 +352,7 @@ class ColumnarChunk:
             valid = jnp.zeros(capacity, dtype=bool).at[:m].set(col.valid[:m])
             columns[name] = replace(col, data=data, valid=valid)
         return ColumnarChunk(schema=self.schema, row_count=self.row_count,
-                             columns=columns)
+                             columns=columns, sorted_by=self.sorted_by)
 
     def slice_rows(self, start: int, end: int) -> "ColumnarChunk":
         start = max(0, start)
@@ -363,7 +373,8 @@ class ColumnarChunk:
                 host_values = col.host_values[start:end]
             columns[name] = replace(col, data=data, valid=valid,
                                     host_values=host_values)
-        return ColumnarChunk(schema=self.schema, row_count=n, columns=columns)
+        return ColumnarChunk(schema=self.schema, row_count=n, columns=columns,
+                             sorted_by=self.sorted_by)
 
 
 def _plane_dtype(ty: EValueType) -> np.dtype:
@@ -453,12 +464,57 @@ def _build_column(ty: EValueType, values: Sequence[Any], cap: int,
                   dictionary=vocab, host_values=host_values)
 
 
+# id(vocab) -> (weakref, digest).  Vocab arrays are immutable by
+# convention (built sorted once at encode time, shared thereafter), so a
+# content digest can be memoized per array identity; the weakref guards
+# against id() reuse after collection (the _chunk_memo idiom).
+_VOCAB_DIGEST_MEMO: dict = {}
+
+
+def vocab_digest(vocab: np.ndarray) -> str:
+    """Stable content digest of a sorted string vocabulary.  O(|vocab|)
+    once per array, O(1) after — the identity check that lets
+    `unify_dictionaries` and code-space predicate bindings recognize
+    already-shared vocabs without a merge."""
+    key = id(vocab)
+    hit = _VOCAB_DIGEST_MEMO.get(key)
+    if hit is not None and hit[0]() is vocab:
+        return hit[1]
+    h = hashlib.blake2b(digest_size=16)
+    for v in vocab:
+        b = v if isinstance(v, bytes) else _to_bytes(v)
+        h.update(len(b).to_bytes(4, "little"))
+        h.update(b)
+    digest = h.hexdigest()
+    if len(_VOCAB_DIGEST_MEMO) > 4096:
+        for k in [k for k, (ref, _) in _VOCAB_DIGEST_MEMO.items()
+                  if ref() is None]:
+            del _VOCAB_DIGEST_MEMO[k]
+    _VOCAB_DIGEST_MEMO[key] = (weakref.ref(vocab), digest)
+    return digest
+
+
 def unify_dictionaries(columns: Sequence[Column]) -> tuple[list[Column], np.ndarray]:
     """Re-encode string columns onto a shared sorted vocabulary.
 
     Returns the remapped columns and the unified vocab.  The remap is a single
     device gather per column (codes -> new codes), keeping order preservation.
+
+    Fast path: when every string column already carries the SAME vocab
+    (by identity, else by length + content digest) — the common
+    post-compaction case — the columns return untouched: no host merge,
+    no device gathers.
     """
+    string_cols = [c for c in columns if c.type is EValueType.string]
+    if string_cols and all(c.dictionary is not None for c in string_cols):
+        first = string_cols[0].dictionary
+        rest = [c.dictionary for c in string_cols[1:]]
+        identical = all(v is first for v in rest)
+        if not identical and all(len(v) == len(first) for v in rest):
+            d0 = vocab_digest(first)
+            identical = all(vocab_digest(v) == d0 for v in rest)
+        if identical:
+            return list(columns), np.asarray(first, dtype=object)
     vocabs = [c.dictionary for c in columns if c.dictionary is not None]
     # Vectorized union + remap (np.unique / searchsorted over object
     # arrays — lossless for arbitrary bytes): high-cardinality vocab

@@ -577,6 +577,18 @@ class CompileConfig(YsonStruct):
     # co-partition exchange (they must also prove unique join keys).
     cost_join_planner = param(True, type=bool)
     broadcast_join_rows = param(65536, type=int, ge=0)
+    # Encoded-plane kernel execution (ISSUE 19, query/engine/expr.py +
+    # interp.py): string predicates against literals compare the column's
+    # dict CODES with a host-bound code — no merged-vocab remap tables,
+    # no per-row gathers.  Off restores the decoded remap-table path
+    # (the bit-identity oracle the dual-check corpus runs both ways).
+    encoded_predicates = param(True, type=bool)
+    # Buffer donation (ISSUE 19, evaluator/joins/distributed dispatch):
+    # OWNED chunk-sized temporaries (join-cascade intermediates, phase-1
+    # join products) are donated to their consuming program so XLA can
+    # reuse the buffers in place.  Persistent table chunks are NEVER
+    # donated.  Off = copying fallback (escape hatch + A/B leg).
+    donate_buffers = param(True, type=bool)
 
 
 _COMPILE_CONFIG: "Optional[CompileConfig]" = None

@@ -80,6 +80,53 @@ def segment_boundaries(sorted_keys: list[tuple[jax.Array, jax.Array]],
 # (~130 ms per 2M-row f64 plane measured on v5e vs ~1 ms for the fused form).
 _DENSE_SEGMENT_LIMIT = 256
 
+# The CPU backend inverts the TPU scatter economics: XLA:CPU lowers
+# scatter-add/min/max to a serial update loop that costs ~1 pass over the
+# rows REGARDLESS of segment count (round-14: 84 ms for a 2M-row 10k-group
+# i64 sum vs 828 ms presort + 91 ms scan on the sort-based path), while the
+# dense broadcast-reduce costs nseg passes.  Engine dispatch below picks
+# per backend; YT_TPU_SEGMENT_ENGINE ∈ {scan, scatter} overrides (read at
+# trace time — switching it mid-process does not invalidate cached
+# programs, same contract as YT_TPU_SORT_ENGINE).
+_DENSE_SEGMENT_LIMIT_SCATTER = 16
+
+
+def segment_engine() -> str:
+    """Reduction engine for segment counts above the dense limit:
+    "scan" (presort + segmented associative scan — the TPU path) or
+    "scatter" (native .at[].add/min/max — the CPU path)."""
+    engine = os.environ.get("YT_TPU_SEGMENT_ENGINE", "auto")
+    if engine == "auto":
+        return "scatter" if jax.default_backend() == "cpu" else "scan"
+    if engine not in ("scan", "scatter"):
+        raise ValueError(f"unknown YT_TPU_SEGMENT_ENGINE {engine!r}")
+    return engine
+
+
+def _dense_limit() -> int:
+    # Scatter costs ~flat in nseg, so the dense crossover sits far lower
+    # than the scan engine's (dense cost grows ~linearly with nseg).
+    return _DENSE_SEGMENT_LIMIT_SCATTER if segment_engine() == "scatter" \
+        else _DENSE_SEGMENT_LIMIT
+
+
+def _scatter_segment_reduce(function: str, data: jax.Array,
+                            seg_ids: jax.Array, num_segments: int):
+    """Single-pass native scatter reduce.  Out-of-range segment ids (the
+    general group path parks masked rows at a traced id that can equal
+    num_segments) drop silently — exactly the trailing-garbage contract of
+    the other engines."""
+    if function == "sum":
+        init = jnp.zeros(num_segments, dtype=data.dtype)
+        return init.at[seg_ids].add(data, mode="drop")
+    neutral = _reduce_neutral(data.dtype, function)
+    init = jnp.full(num_segments, neutral, dtype=data.dtype)
+    if function == "min":
+        return init.at[seg_ids].min(data, mode="drop")
+    if function == "max":
+        return init.at[seg_ids].max(data, mode="drop")
+    raise ValueError(function)
+
 
 def _dense_segment_reduce(function: str, data: jax.Array, seg_ids: jax.Array,
                           num_segments: int):
@@ -139,8 +186,15 @@ def _sorted_segment_reduce(function: str, data: jax.Array,
 
 def _segment_reduce(function: str, data: jax.Array, seg_ids: jax.Array,
                     num_segments: int, assume_sorted: bool = False):
-    if num_segments <= _DENSE_SEGMENT_LIMIT:
+    if num_segments <= _dense_limit():
         return _dense_segment_reduce(function, data, seg_ids, num_segments)
+    if segment_engine() == "scatter":
+        # CPU: one native scatter pass, sorted or not.  (Float sums
+        # accumulate in scatter-visit order rather than per-segment scan
+        # order — the same sanctioned divergence the interpreter tier's
+        # np.add.at already has.)
+        return _scatter_segment_reduce(function, data, seg_ids,
+                                       num_segments)
     if assume_sorted:
         return _sorted_segment_reduce(function, data, seg_ids, num_segments)
     # Unsorted mid/high cardinality: NEVER scatter (TPU scatter-adds with
@@ -157,10 +211,12 @@ def presort_segments(seg_ids: jax.Array,
                      num_segments: int) -> "jax.Array | None":
     """Shared presort policy for multi-aggregate group stages: returns the
     row order to apply once (then pass assume_sorted=True for every
-    aggregate), or None when the dense reduce needs no ordering.  Keeping
-    the dispatch HERE keeps it in lockstep with _segment_reduce's
-    threshold."""
-    if num_segments <= _DENSE_SEGMENT_LIMIT:
+    aggregate), or None when the reduce needs no ordering — the dense
+    broadcast path, and the ENTIRE scatter engine (CPU), whose reduces are
+    order-independent single passes; skipping the group-stage sort there
+    is the round-14 groupby win.  Keeping the dispatch HERE keeps it in
+    lockstep with _segment_reduce's threshold."""
+    if num_segments <= _dense_limit() or segment_engine() == "scatter":
         return None
     return stable_argsort_u32([seg_ids.astype(jnp.uint32)])
 

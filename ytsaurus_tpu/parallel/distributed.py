@@ -14,10 +14,16 @@ Ref mapping (SURVEY.md §2.8 parallelism table):
 
 from __future__ import annotations
 
+import warnings as _warnings
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax
+
+# Buffer donation (ISSUE 19) is inert on CPU backends but warns per
+# call; keep the armed SPMD path quiet on the CPU test floor.
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -300,7 +306,7 @@ class DistributedEvaluator:
         self.fresh_compiles = 0
         self.disk_hits = 0
 
-    def _dispatch_spmd(self, key: tuple, build, args):
+    def _dispatch_spmd(self, key: tuple, build, args, donate: tuple = ()):
         """Run one SPMD program through the compile-once ladder (ISSUE
         10, extended to the distributed plane): memory cache → AOT disk
         tier (`aot_cache.py` — serialize_executable products of
@@ -308,9 +314,12 @@ class DistributedEvaluator:
         cache fill) → fresh compile.  `build()` returns the un-jitted
         program; `args` are the concrete call arguments AOT lowering
         pins shapes from."""
+        from ytsaurus_tpu.config import compile_config
+        if not compile_config().donate_buffers:
+            donate = ()
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._compile_spmd(key, build, args)
+            fn = self._compile_spmd(key, build, args, donate)
         try:
             return fn(*args)
         except Exception:
@@ -321,13 +330,14 @@ class DistributedEvaluator:
             # wrapper (a genuine execution error re-raises identically).
             # This IS a fresh compile — count it, or a rotten disk tier
             # could report a perfect warm start while recompiling
-            # everything.
-            fn = jax.jit(build())
+            # everything.  (Aval rejection happens before execution, so
+            # donated inputs are still alive for the retry.)
+            fn = jax.jit(build(), donate_argnums=donate)
             self.fresh_compiles += 1
             self._cache[key] = fn
             return fn(*args)
 
-    def _compile_spmd(self, key: tuple, build, args):
+    def _compile_spmd(self, key: tuple, build, args, donate: tuple = ()):
         import time as _time
 
         from ytsaurus_tpu.query.engine.aot_cache import get_disk_cache
@@ -336,7 +346,7 @@ class DistributedEvaluator:
         if fn is not None:
             self.disk_hits += 1
         else:
-            jitted = jax.jit(build())
+            jitted = jax.jit(build(), donate_argnums=donate)
             t0 = _time.perf_counter()
             lowered = None
             try:
@@ -690,13 +700,18 @@ class DistributedEvaluator:
                     out[flat] = (d[f_row], v[f_row] & live & matched)
                 return out, live
 
+            # Every input of `expand` is a route_probe output this
+            # loop iteration owns, consumed exactly once here — donate
+            # all six so the routed planes' buffers are reused for the
+            # expanded output (ISSUE 19; inert on CPU).
             columns_global, row_valid = self._dispatch_spmd(
                 key_base + ("expand", quota_s, quota_f, out_cap),
                 lambda: shard_map(
                     expand, mesh=mesh,
                     in_specs=(P(SHARD_AXIS),) * 6,
                     out_specs=P(SHARD_AXIS), check_vma=False),
-                (recv_s, mask_s, recv_f, f_order, lo, counts))
+                (recv_s, mask_s, recv_f, f_order, lo, counts),
+                donate=(0, 1, 2, 3, 4, 5))
             cur_cap = out_cap
             for flat, fname in flat_names:
                 fcol = foreign.columns[fname]

@@ -53,6 +53,56 @@ _promotions_counter = _tiers_profiler.counter("promotions")
 _prewarm_counter = _tiers_profiler.counter("prewarm_compiles")
 _tier_queue_gauge = _tiers_profiler.gauge("queue_depth")
 
+# Kernel-execution telemetry (ISSUE 19): dispatches whose string
+# predicates ran on encoded dictionary planes vs the decoded fallback,
+# and dispatches that armed buffer donation.
+_kernel_profiler = Profiler("/query/kernels")
+_encoded_scans_counter = _kernel_profiler.counter("encoded_scans")
+_decoded_fallbacks_counter = _kernel_profiler.counter("decoded_fallbacks")
+_donated_buffers_counter = _kernel_profiler.counter("donated_buffers")
+
+
+def _flat_notes(structure_key) -> "set[str]":
+    """Leading tags of every bind-notebook note tuple nested anywhere in
+    a structure key (("strlit", op, digest) -> "strlit")."""
+    out: set[str] = set()
+
+    def walk(node):
+        if isinstance(node, tuple):
+            if node and isinstance(node[0], str):
+                out.add(node[0])
+            for item in node:
+                walk(item)
+
+    walk(structure_key)
+    return out
+
+# Buffer donation (ISSUE 19): XLA reuses donated input buffers for
+# outputs of matching shape, halving peak residency for chunk-sized
+# temporaries.  CPU backends ignore donation (it is inert there) but
+# warn per call — suppress exactly that message so the armed path stays
+# quiet on the CPU bench/test floor.
+import warnings as _warnings
+
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def _jit_run(run, donate_columns: bool = False):
+    """jit a prepared `run` with ISSUE 19 buffer donation.
+
+    `row_valid` (argnum 1) is always donatable: `chunk.row_valid` is a
+    property that builds a fresh iota-compare plane per access, so every
+    dispatch owns its copy and nothing reads it after the call.  The
+    column planes (argnum 0) are donated only when the caller owns the
+    chunk — a join-cascade intermediate built by this very dispatch —
+    never for persistent table chunks (the compile-cache key carries the
+    donation mode so the two executables cannot alias)."""
+    from ytsaurus_tpu.config import compile_config
+    if not compile_config().donate_buffers:
+        return jax.jit(run)
+    return jax.jit(run, donate_argnums=(0, 1) if donate_columns else (1,))
+
 
 class CompileObservatory:
     """Per-fingerprint compile telemetry (ISSUE 8 tentpole, piece b).
@@ -528,7 +578,7 @@ class BackgroundCompiler:
         cfg = workload_config()
         t0 = _time.perf_counter()
         lowered = None
-        jitted = jax.jit(prepared.run)
+        jitted = _jit_run(prepared.run)
         try:
             lowered = jitted.lower(*args)
             fn = lowered.compile()
@@ -707,6 +757,7 @@ class Evaluator:
     def _dispatch_traced(self, plan, chunk, foreign_chunks, stats, t0,
                          fp=None, pool=None, jplan=None):
         import time as _time
+        owned_chunk = False
         if isinstance(plan, ir.Query) and plan.joins:
             foreign_chunks = foreign_chunks or {}
             # Materialize joins in (planner) execution order, widening
@@ -732,6 +783,10 @@ class Evaluator:
                         if decisions is not None else 0,
                         actual_rows=current.row_count)
             chunk = current
+            # The cascade built `chunk`; this dispatch is its only
+            # consumer, so its column planes are donatable (a totals
+            # plan dispatches the same chunk twice — excluded below).
+            owned_chunk = True
         elif isinstance(plan, ir.Query):
             chunk = _project_chunk(chunk, plan.schema)
 
@@ -756,7 +811,8 @@ class Evaluator:
                     main.compile_seconds - totals_pending.compile_seconds
             return _ReadyResult(result)
 
-        pending = self._dispatch(plan, chunk, stats, fp=fp, pool=pool)
+        pending = self._dispatch(plan, chunk, stats, fp=fp, pool=pool,
+                                 donate_columns=owned_chunk)
         pending.stats = stats
         # The execute clock starts after compilation: wall = compile +
         # execute, reported separately (EXPLAIN ANALYZE's first split).
@@ -766,10 +822,15 @@ class Evaluator:
     def _dispatch(self, plan, chunk: ColumnarChunk,
                   stats: Optional[QueryStatistics] = None,
                   fp: Optional[str] = None,
-                  pool: Optional[str] = None) -> _PendingResult:
+                  pool: Optional[str] = None,
+                  donate_columns: bool = False) -> _PendingResult:
         prepared = prepare(plan, chunk)
         key = (fp or plan_fingerprint(plan), chunk.capacity,
                prepared.binding_shapes())
+        if donate_columns:
+            # A donating executable consumes its column planes; it must
+            # never be served to a dispatch over a persistent chunk.
+            key = key + ("donate-cols",)
         columns = {c.name: (chunk.columns[c.name].data,
                             chunk.columns[c.name].valid)
                    for c in plan.schema}
@@ -810,7 +871,8 @@ class Evaluator:
             try:
                 fn, compile_seconds, result = self._compile_miss(
                     key, prepared, chunk, args, stats, pool,
-                    interp_query=interp_query)
+                    interp_query=interp_query,
+                    donate_columns=donate_columns)
             finally:
                 self._release_inflight(key)
             if fn is None and result is None:
@@ -823,6 +885,27 @@ class Evaluator:
             if stats is not None:
                 stats.cache_hits += 1
         execution_tier = "compiled"
+        # Encoded-plane accounting (ISSUE 19): the bind notebook says
+        # which mode the string predicates compiled in — code-space
+        # compares ("strlit" notes) vs the merged-vocab remap fallback
+        # ("str-decoded" notes).  A query with both counts as decoded:
+        # one remap gather re-materializes the cost the encoded path
+        # exists to avoid.
+        notes = _flat_notes(prepared.structure_key)
+        if "str-decoded" in notes:
+            _decoded_fallbacks_counter.increment()
+            if stats is not None:
+                stats.execution_encoding = "decoded"
+        elif "strlit" in notes:
+            _encoded_scans_counter.increment()
+        from ytsaurus_tpu.config import compile_config as _cc
+        if _cc().donate_buffers:
+            # Donation armed for this compiled dispatch: row_valid
+            # always, the column planes too for owned (join-cascade)
+            # chunks.  Inert on CPU, but the counter tracks arming, not
+            # the backend's ability to honor it.
+            _donated_buffers_counter.increment(
+                1 + (len(args[0]) if donate_columns else 0))
         if self._background.consume_promoted(key[0]):
             # First compiled serve after a mid-traffic background
             # promotion: the atomic swap, made visible.
@@ -839,7 +922,7 @@ class Evaluator:
                 # AOT-compiled rejects an aval drift the cache key did
                 # not capture: rebuild through the tolerant jit wrapper
                 # (a genuine execution error re-raises identically).
-                fn = jax.jit(prepared.run)
+                fn = _jit_run(prepared.run, donate_columns)
                 with self._cache_lock:
                     self._cache[key] = fn
                 planes, count = fn(*args)
@@ -877,7 +960,7 @@ class Evaluator:
         return pending
 
     def _compile_miss(self, key, prepared, chunk, args, stats, pool,
-                      interp_query=None):
+                      interp_query=None, donate_columns=False):
         """The memory-miss slow path (single-flight leader only):
         disk-tier load or fresh AOT compile, cache insert + eviction,
         counters/observatory/artifact bookkeeping.  Returns
@@ -939,7 +1022,7 @@ class Evaluator:
                     (fn := cluster.fetch(key)) is not None:
                 cause = "cluster_hit"
             else:
-                jitted = jax.jit(prepared.run)
+                jitted = _jit_run(prepared.run, donate_columns)
                 try:
                     lowered = jitted.lower(*args)
                     fn = lowered.compile()
@@ -1030,8 +1113,16 @@ def _project_chunk(chunk: ColumnarChunk, schema: TableSchema) -> ColumnarChunk:
             raise YtError(f"Chunk is missing column {col_schema.name!r}",
                           code=EErrorCode.QueryExecutionError)
         columns[col_schema.name] = col
+    # Column projection keeps row order; the sealed sort order survives
+    # for the longest key prefix whose columns are still present (rows
+    # sorted by (a, b) are NOT sorted by b alone once a is dropped).
+    sorted_by = []
+    for name in chunk.sorted_by:
+        if name not in columns:
+            break
+        sorted_by.append(name)
     return ColumnarChunk(schema=schema, row_count=chunk.row_count,
-                         columns=columns)
+                         columns=columns, sorted_by=tuple(sorted_by))
 
 
 def _typed_null(ty):

@@ -267,9 +267,20 @@ class ExprBinder:
             return BoundExpr(type=EValueType.boolean, vocab=None,
                              emit=emit_logical)
 
-        # String comparison: unify vocabularies host-side.
+        # String comparison: encoded-plane fast path first (ISSUE 19) —
+        # a literal against a dict-encoded side compares CODES against
+        # one host-bound code, skipping the merged-vocab remap tables
+        # and their two per-row gathers entirely.
         if EValueType.string in (lhs_b.type, rhs_b.type) and \
                 lhs_b.type is not EValueType.null and rhs_b.type is not EValueType.null:
+            encoded = self._bind_string_literal_cmp(node, op, lhs_b, rhs_b)
+            if encoded is not None:
+                return encoded
+            # Decoded fallback: the remap-table path.  Note it in the
+            # structure notebook so the dispatcher can book the
+            # /query/kernels/decoded_fallbacks sensor and EXPLAIN
+            # ANALYZE can say which execution mode actually ran.
+            self.ctx.note("str-decoded", op)
             merged = _merge_vocabs(lhs_b.vocab, rhs_b.vocab)
             l_vocab = lhs_b.vocab if lhs_b.vocab is not None else _EMPTY_VOCAB
             r_vocab = rhs_b.vocab if rhs_b.vocab is not None else _EMPTY_VOCAB
@@ -338,6 +349,69 @@ class ExprBinder:
                 raise AssertionError(op)
             return data, valid
         return BoundExpr(type=node.type, vocab=None, emit=emit)
+
+    def _bind_string_literal_cmp(self, node: ir.TBinary, op: str,
+                                 lhs_b: BoundExpr,
+                                 rhs_b: BoundExpr) -> Optional[BoundExpr]:
+        """Encoded-plane string comparison (ISSUE 19): literal vs a
+        dict-encoded expression compares CODES, not remapped vocabs.
+
+        The binding carries the literal's position in the COLUMN side's
+        own sorted vocabulary: =/!= bind the exact code (-1 when absent —
+        equal to no row code), range ops bind in the doubled space where
+        row code c sits at 2c+1 and an absent literal lands on its even
+        insertion point (strictly between neighboring codes, equal to
+        none — see _range_code).  Order preservation of the encode makes
+        the integer compare the byte compare.  Bit-identical to the
+        merged remap-table path on valid lanes; that path remains the
+        decoded oracle (compile_config().encoded_predicates=False).
+
+        NOTE: interp.NumpyBinder mirrors this decision AND these
+        formulas — change both or tier bit-identity breaks."""
+        from ytsaurus_tpu.config import compile_config
+        if op not in _CMP_OPS or not compile_config().encoded_predicates:
+            return None
+        if not (lhs_b.type is EValueType.string
+                and rhs_b.type is EValueType.string):
+            return None
+        if isinstance(node.rhs, ir.TLiteral) and lhs_b.vocab is not None:
+            col_b, lit, lit_on_right = lhs_b, node.rhs.value, True
+        elif isinstance(node.lhs, ir.TLiteral) and rhs_b.vocab is not None:
+            col_b, lit, lit_on_right = rhs_b, node.lhs.value, False
+        else:
+            return None
+        if lit is None:
+            return None
+        from ytsaurus_tpu.chunks.columnar import vocab_digest
+        vocab = col_b.vocab
+        # The bound code is only meaningful against THIS vocab
+        # generation: fold its content digest into the structure notebook
+        # (-> structure_key -> compile cache key) so a chunk re-encode
+        # after compaction can never pair a stale code binding with a
+        # cached program, even if a future layer memoizes bind output.
+        self.ctx.note("strlit", op, vocab_digest(vocab))
+        if op in ("=", "!="):
+            slot = self.ctx.add(jnp.asarray(
+                np.int32(_vocab_code(vocab, lit))))
+
+            def emit_eq(ctx: EmitContext):
+                data, valid = col_b.emit(ctx)
+                code = ctx.bindings[slot]
+                out = (data == code) if op == "=" else (data != code)
+                return out, valid
+            return BoundExpr(type=EValueType.boolean, vocab=None,
+                             emit=emit_eq)
+        slot = self.ctx.add(jnp.asarray(np.int32(_range_code(vocab, lit))))
+
+        def emit_rng(ctx: EmitContext):
+            data, valid = col_b.emit(ctx)
+            doubled = data.astype(jnp.int32) * 2 + 1
+            code = ctx.bindings[slot]
+            out = _compare(op, doubled, code) if lit_on_right \
+                else _compare(op, code, doubled)
+            return out, valid
+        return BoundExpr(type=EValueType.boolean, vocab=None,
+                         emit=emit_rng)
 
     # -- functions ------------------------------------------------------------
 

@@ -501,6 +501,9 @@ class NumpyBinder:
         if EValueType.string in (lhs_b.type, rhs_b.type) and \
                 lhs_b.type is not EValueType.null and \
                 rhs_b.type is not EValueType.null:
+            encoded = self._bind_string_literal_cmp(node, op, lhs_b, rhs_b)
+            if encoded is not None:
+                return encoded
             merged = _merge_vocabs(lhs_b.vocab, rhs_b.vocab)
             l_vocab = lhs_b.vocab if lhs_b.vocab is not None \
                 else _EMPTY_VOCAB
@@ -567,6 +570,47 @@ class NumpyBinder:
                 raise InterpUnsupported(op)
             return data, valid
         return _NBound(type=node.type, vocab=None, emit=emit)
+
+    def _bind_string_literal_cmp(self, node: ir.TBinary, op: str,
+                                 lhs_b: _NBound,
+                                 rhs_b: _NBound) -> Optional[_NBound]:
+        """Numpy twin of ExprBinder._bind_string_literal_cmp — the SAME
+        decision (config gate, literal side, vocab presence) and the SAME
+        code formulas (_vocab_code for =/!=, doubled-space _range_code
+        for range ops), or tier bit-identity breaks."""
+        from ytsaurus_tpu.config import compile_config
+        if op not in _CMP_OPS or not compile_config().encoded_predicates:
+            return None
+        if not (lhs_b.type is EValueType.string
+                and rhs_b.type is EValueType.string):
+            return None
+        if isinstance(node.rhs, ir.TLiteral) and lhs_b.vocab is not None:
+            col_b, lit, lit_on_right = lhs_b, node.rhs.value, True
+        elif isinstance(node.lhs, ir.TLiteral) and rhs_b.vocab is not None:
+            col_b, lit, lit_on_right = rhs_b, node.lhs.value, False
+        else:
+            return None
+        if lit is None:
+            return None
+        vocab = col_b.vocab
+        if op in ("=", "!="):
+            code = np.int32(_vocab_code(vocab, lit))
+
+            def emit_eq(ctx: _Ctx):
+                data, valid = col_b.emit(ctx)
+                out = (data == code) if op == "=" else (data != code)
+                return out, valid
+            return _NBound(type=EValueType.boolean, vocab=None,
+                           emit=emit_eq)
+        code = np.int32(_range_code(vocab, lit))
+
+        def emit_rng(ctx: _Ctx):
+            data, valid = col_b.emit(ctx)
+            doubled = data.astype(np.int32) * 2 + 1
+            out = _np_compare(op, doubled, code) if lit_on_right \
+                else _np_compare(op, code, doubled)
+            return out, valid
+        return _NBound(type=EValueType.boolean, vocab=None, emit=emit_rng)
 
     # -- functions ------------------------------------------------------------
 

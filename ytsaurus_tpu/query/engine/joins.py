@@ -352,7 +352,15 @@ def _build_join_programs(self_bound, f_bound, self_slots, foreign_slots,
                     valid[foreign_row] & out_valid_row & matched)
             return out, self_row, foreign_row
 
-        fn = jax.jit(phase2)
+        # lo/counts/f_order are phase1 outputs owned by execute_join
+        # and phase2 is their only consumer — donate them so XLA reuses
+        # the three chunk-sized planes for phase2's gather outputs
+        # (ISSUE 19; inert on CPU).  Donation mode bakes at build time;
+        # programs are cached, so a mid-process config flip keeps the
+        # built mode (donation never changes results, only residency).
+        from ytsaurus_tpu.config import compile_config
+        donate = (6, 7, 8) if compile_config().donate_buffers else ()
+        fn = jax.jit(phase2, donate_argnums=donate)
         phase2_cache[out_cap] = fn
         return fn
 

@@ -212,6 +212,32 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
     if order_bits:
         bind_ctx.note("obits", *order_bits)
 
+    # Presorted-layout sort skip (ISSUE 19): tablet snapshots seal their
+    # key order into chunk.sorted_by (ascending, null-first — the same
+    # comparator pack_key_planes_bits encodes).  When every ORDER BY item
+    # is a plain ascending column reference forming a prefix of that
+    # sealed order, and no stage upstream of ORDER BY reorders rows
+    # (filter only masks lanes; GROUP BY and window slots change the
+    # namespace), the packed sort is the identity on valid rows: the
+    # stable compact downstream yields bit-identical output without it.
+    # The decision is chunk-layout-derived, so it is noted into the
+    # structure key — a sealed and an unsealed chunk of the same capacity
+    # must not share a compiled program.
+    presorted_skip = False
+    if order_b and group is None and window is None and \
+            plan.order is not None and getattr(chunk, "sorted_by", ()):
+        names: "list[str] | None" = []
+        for item in plan.order.items:
+            if isinstance(item.expr, ir.TReference) and not item.descending:
+                names.append(item.expr.name)
+            else:
+                names = None
+                break
+        if names is not None and \
+                tuple(names) == tuple(chunk.sorted_by)[:len(names)]:
+            presorted_skip = True
+            bind_ctx.note("presorted", len(names))
+
     # --- direct-aggregation fast path ----------------------------------------
     # When every group key has a small known value domain (dictionary codes,
     # booleans), segment ids are computed arithmetically — no sort.  This is
@@ -284,7 +310,8 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
     k_limit = k_static
     group_stage_cap = fast_group[3] if fast_group else capacity
     use_topk = (len(order_b) == 1 and k_limit is not None
-                and 0 < k_limit <= 1024 and group_stage_cap > 4 * k_limit)
+                and 0 < k_limit <= 1024 and group_stage_cap > 4 * k_limit
+                and not presorted_skip)
     topk_cand_cap = 3 * k_limit if use_topk else None
 
     offset_slot = limit_slot = None
@@ -467,7 +494,7 @@ def prepare(plan: "ir.Query | ir.FrontQuery", chunk) -> PreparedQuery:
             ctx = EmitContext(columns={**ctx.columns, **win_columns},
                               bindings=bindings, capacity=stage_cap)
 
-        if order_b:
+        if order_b and not presorted_skip:
             # Candidates = top-k by value (masked excluded) ∪ up-to-k null
             # rows (null ordering differs by direction; the tiny exact sort
             # below settles it).

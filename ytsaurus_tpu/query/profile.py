@@ -132,6 +132,9 @@ def format_profile_dict(p: dict) -> str:
         # ISSUE 18: which execution tier served the query — the first
         # question a cold-shape latency investigation asks.
         f"execution tier: {stats.get('execution_tier', 'compiled')}",
+        # ISSUE 19: whether string predicates ran on dict codes or fell
+        # back to the decoded remap-table path.
+        f"execution: {stats.get('execution_encoding', 'encoded')}",
     ]
     # ISSUE 8: why those misses happened (new fingerprint vs new shape
     # vs eviction) and which pow2 capacity buckets the programs ran

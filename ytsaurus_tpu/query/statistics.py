@@ -66,6 +66,12 @@ class QueryStatistics:
     # background promotion swapped the program in mid-traffic).  A
     # string — the serving counters skip it (only numerics fold).
     execution_tier: str = "compiled"
+    # Which kernel-execution mode the string predicates ran in
+    # (ISSUE 19): "encoded" (dict-code compares, the shipping default)
+    # or "decoded" (at least one predicate fell back to the merged-
+    # vocab remap-table path).  Same string/fold discipline as
+    # execution_tier.
+    execution_encoding: str = "encoded"
 
     def note_join_stage(self, position: int, table: str, strategy: str,
                         est_rows: int = 0, actual_rows=None) -> None:

@@ -31,6 +31,8 @@ assert bit-exact row parity between the two implementations.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -284,7 +286,10 @@ def visible_chunk(merged: ColumnarChunk, table_schema: TableSchema,
     fn = _program("visible", merged, key_names, value_names)
     out, count = fn(_planes(merged), np.int64(merged.row_count),
                     np.int64(timestamp))
-    return _emit_chunk(table_schema.to_unsorted(), out, int(count), merged)
+    chunk = _emit_chunk(table_schema.to_unsorted(), out, int(count), merged)
+    # The merge emits key order — seal it so ORDER BY <key prefix> over a
+    # tablet snapshot skips the packed-key sort (ISSUE 19 layout sealing).
+    return dataclasses.replace(chunk, sorted_by=key_names)
 
 
 def sorted_versioned_chunk(merged: ColumnarChunk,

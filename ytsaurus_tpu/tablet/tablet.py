@@ -16,6 +16,7 @@ vectorized pass, not a per-row k-way heap merge (tablet_reader.cpp:651).
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import OrderedDict
@@ -525,8 +526,9 @@ class Tablet:
                         for s in self.passive_stores + [self.active_store]
                         if s.store_row_count]
             if not sources:
-                return ColumnarChunk.from_rows(
-                    self.schema.to_unsorted(), [])
+                return dataclasses.replace(
+                    ColumnarChunk.from_rows(self.schema.to_unsorted(), []),
+                    sorted_by=tuple(self.schema.key_column_names))
             return mvcc.visible_chunk(concat_chunks(sources), self.schema,
                                       timestamp)
 
@@ -538,7 +540,12 @@ class Tablet:
         with self._lock:
             rows = self.versioned_rows_snapshot()
             visible = _mvcc_select(rows, self.schema, timestamp)
-            return ColumnarChunk.from_rows(self.schema.to_unsorted(), visible)
+            chunk = ColumnarChunk.from_rows(self.schema.to_unsorted(), visible)
+            # Same key-order seal as the vectorized merge: both snapshot
+            # paths must produce the same sorted_by (and therefore the
+            # same compiled program) for a given tablet.
+            return dataclasses.replace(
+                chunk, sorted_by=tuple(self.schema.key_column_names))
 
     def lookup_rows(self, keys: Sequence[tuple],
                     timestamp: int = MAX_TIMESTAMP,
