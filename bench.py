@@ -1608,6 +1608,175 @@ print("REPORT " + json.dumps(report), flush=True)
     return "whole_plan_rows_per_sec", n_rows / best, best
 
 
+def bench_mesh_overhead(n_rows, iters):
+    """Mesh telemetry overhead (ISSUE 20): the fused whole-plan rung
+    with the in-program telemetry block disarmed vs armed, for the
+    round-8 groupby and q1 plan shapes on the virtual 8-device mesh.
+
+    The armed program appends its telemetry lanes (per-shard rows,
+    transfer matrices, quota demand) onto the SAME stacked final
+    transfer, so arming must cost neither a host sync nor measurable
+    wall time.  The ≤1% claim is asserted as a deterministic
+    decomposition (the bench_telemetry_overhead discipline — a direct
+    A/B on a noisy shared host cannot resolve 1%): exactly 1 host sync
+    per query on BOTH legs (the telemetry's whole device cost rides a
+    transfer the query already pays), and the per-query host
+    decode+publish cost — measured as a per-site microbench — must be
+    ≤1% of the disarmed query wall.  The armed/disarmed A/B delta is
+    still measured and printed for the record, with a loose 1.5×
+    outlier guard against a genuinely broken armed program.  Metric is
+    the armed groupby-class throughput."""
+    import subprocess as _subprocess
+
+    child_src = f"""
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json, time
+import numpy as np
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.chunks.columnar import ColumnarChunk
+from ytsaurus_tpu.parallel.mesh import make_mesh
+from ytsaurus_tpu.parallel.distributed import (
+    DistributedEvaluator, coordinate_distributed, host_sync_count)
+from ytsaurus_tpu.query.builder import build_query
+from ytsaurus_tpu.query.statistics import QueryStatistics
+from ytsaurus_tpu.schema import TableSchema
+
+N = {n_rows}
+ITERS = {max(int(iters), 3)}
+mesh = make_mesh(8)
+rng = np.random.default_rng(1)
+per = N // 8
+
+gb_schema = TableSchema.make([("k", "int64", "ascending"),
+                              ("g", "int64"), ("v", "int64")])
+n_groups = max(64, N // 100)
+gb_chunks = [ColumnarChunk.from_arrays(gb_schema, {{
+    "k": np.arange(per) + s * per,
+    "g": rng.integers(0, n_groups, per),
+    "v": rng.integers(0, 1000, per)}}) for s in range(8)]
+gb_plan = build_query(
+    "g, sum(v) AS s, count(*) AS c FROM [//t] GROUP BY g",
+    {{"//t": gb_schema}})
+
+q1_schema = TableSchema.make([("rf", "int64"), ("ls", "int64"),
+                              ("qty", "double"), ("price", "double")])
+q1_chunks = [ColumnarChunk.from_arrays(q1_schema, {{
+    "rf": rng.integers(0, 3, per), "ls": rng.integers(0, 2, per),
+    "qty": rng.uniform(1, 50, per),
+    "price": rng.uniform(1, 1e5, per)}}) for s in range(8)]
+q1_plan = build_query(
+    "rf, ls, sum(qty) AS sq, sum(price) AS sp, avg(qty) AS aq, "
+    "avg(price) AS ap, count(*) AS c FROM [//t] GROUP BY rf, ls",
+    {{"//t": q1_schema}})
+
+yt_config.set_compile_config(yt_config.CompileConfig(whole_plan=True))
+
+
+def leg(plan, chunks, armed):
+    yt_config.set_telemetry_config(
+        yt_config.TelemetryConfig(mesh_telemetry=armed))
+    de = DistributedEvaluator(mesh)
+    stats = QueryStatistics()
+    out = coordinate_distributed(plan, mesh, chunks, evaluator=de,
+                                 stats=stats)                  # warm-up
+    times = []
+    s0 = host_sync_count()
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = coordinate_distributed(plan, mesh, chunks, evaluator=de)
+        np.asarray(next(iter(out.columns.values())).data[:1])
+        times.append(time.perf_counter() - t0)
+    return {{"best_s": min(times),
+             "syncs_per_query": (host_sync_count() - s0) / ITERS,
+             "whole_plan": stats.whole_plan, "rows": out.row_count,
+             "mesh_blocks": len(stats.mesh_blocks),
+             "skew": stats.mesh_skew_max}}
+
+
+report = {{}}
+for name, plan, chunks in (("groupby", gb_plan, gb_chunks),
+                           ("q1", q1_plan, q1_chunks)):
+    report[name] = {{"off": leg(plan, chunks, False),
+                     "on": leg(plan, chunks, True)}}
+
+# Per-site microbench of the armed path's ENTIRE host-side addition:
+# decode the appended lanes of a representative exchange-shape vector
+# (n=8: version + 2x8 row lanes + the 64-cell transfer matrix) and fan
+# the block out to stats + observatory + sensors.
+from ytsaurus_tpu.parallel import whole_plan as wp
+yt_config.set_telemetry_config(yt_config.TelemetryConfig())
+vals = np.zeros(3 + 1 + 16 + 64, dtype=np.int64)
+vals[3] = wp.MESH_TELEMETRY_VERSION
+vals[4:12] = 1000
+vals[12:20] = 900
+vals[20:] = 100
+decode_stats = QueryStatistics()
+
+def decode_once():
+    in_rows, out_rows, off = wp._mesh_slices(vals, 3, 8)
+    entry = wp._mesh_exchange_entry("shuffle/bench", vals[off: off + 64],
+                                    500, 512, 33)
+    block = wp._mesh_block(8, in_rows, out_rows, [entry])
+    wp._publish_mesh(decode_stats, "bench-fp", None, block)
+
+decode_cost = float("inf")
+for _ in range(5):
+    t0 = time.perf_counter()
+    for _ in range(2000):
+        decode_once()
+    decode_cost = min(decode_cost, (time.perf_counter() - t0) / 2000)
+    decode_stats.mesh_blocks.clear()
+report["decode_cost_s"] = decode_cost
+print("REPORT " + json.dumps(report), flush=True)
+"""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = _subprocess.run(
+        [sys.executable, "-c", child_src],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=3000, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    report = json.loads(
+        [ln for ln in proc.stdout.splitlines()
+         if ln.startswith("REPORT ")][-1][len("REPORT "):])
+    decode_cost = report.pop("decode_cost_s")
+    print(f"# mesh_overhead decode+publish per query: "
+          f"{decode_cost * 1e6:.1f} µs", file=sys.stderr)
+    for name, legs in report.items():
+        off, on = legs["off"], legs["on"]
+        delta = on["best_s"] / off["best_s"] - 1.0
+        print(f"# mesh_overhead {name}: disarmed "
+              f"{off['best_s']*1e3:.1f}ms, armed {on['best_s']*1e3:.1f}ms "
+              f"({delta*100:+.2f}% A/B, for the record), "
+              f"{on['syncs_per_query']:.0f} sync/query armed, "
+              f"{on['mesh_blocks']} blocks (skew {on['skew']:.3f})",
+              file=sys.stderr)
+        assert off["whole_plan"] == 1 and on["whole_plan"] == 1, name
+        assert off["rows"] == on["rows"], name
+        assert off["syncs_per_query"] == 1.0, \
+            f"{name}: disarmed fused path must host-sync exactly once"
+        assert on["syncs_per_query"] == 1.0, \
+            f"{name}: ARMED fused path must still host-sync exactly " \
+            f"once — telemetry rides the existing stacked transfer"
+        assert on["mesh_blocks"] >= 1 and on["skew"] >= 1.0, \
+            f"{name}: armed leg decoded no telemetry block"
+        # The ≤1% budget, decomposed: the armed path's host-side
+        # addition per query vs the disarmed query wall.
+        assert decode_cost <= off["best_s"] * 0.01, \
+            (f"{name}: telemetry decode+publish {decode_cost*1e6:.0f}µs "
+             f"exceeds 1% of the disarmed query "
+             f"({off['best_s']*1e3:.1f}ms)")
+        assert on["best_s"] <= off["best_s"] * 1.5 + 0.1, \
+            (f"{name}: armed leg {on['best_s']:.4f}s grossly over "
+             f"disarmed {off['best_s']:.4f}s — the armed program is "
+             f"broken, not noisy")
+    best = report["groupby"]["on"]["best_s"]
+    return "mesh_overhead_rows_per_sec", n_rows / best, best
+
+
 def bench_multiway_join(n_rows, iters):
     """Fused multiway join + cost-based planner (ISSUE 14): TPC-H
     Q5/Q7-class 3-way join plans on the virtual 8-device CPU mesh,
@@ -2518,6 +2687,7 @@ _CONFIGS = {
     "serving_steady": (bench_serving_steady, 200_000, 100_000),
     "slo": (bench_slo, 100_000, 50_000),
     "whole_plan": (bench_whole_plan, 8_000_000, 1_000_000),
+    "mesh_overhead": (bench_mesh_overhead, 8_000_000, 1_000_000),
     "multiway_join": (bench_multiway_join, 4_000_000, 400_000),
     "matview": (bench_matview, 2_000_000, 500_000),
     "sanitizer_overhead": (bench_sanitizer_overhead, 400_000, 400_000),
@@ -2644,6 +2814,7 @@ _METRIC_NAMES = {
     "serving_steady": "serving_steady_queries_per_sec",
     "slo": "slo_baseline_queries_per_sec",
     "whole_plan": "whole_plan_rows_per_sec",
+    "mesh_overhead": "mesh_overhead_rows_per_sec",
     "multiway_join": "multiway_join_rows_per_sec",
     "matview": "matview_rows_per_sec",
     "sanitizer_overhead": "sanitizer_acquires_per_sec",

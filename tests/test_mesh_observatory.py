@@ -1,0 +1,296 @@
+"""Mesh execution observatory tests (ISSUE 20): the bounded
+per-fingerprint roll-up of in-program SPMD telemetry blocks
+(parallel/mesh_observatory.py), the balanced-vs-skewed classification
+the MESH_SKEW_SLO burns against (fire AND resolve over the ISSUE 6
+synthetic-SLI harness), the /mesh monitoring endpoint + orchid twin,
+`yt mesh top` formatting, and the satellite-6 fix: SPMD executables
+feed the compile observatory's artifact capture so `yt compile-cache
+top` shows their FLOPs/bytes."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ytsaurus_tpu import config as yt_config
+from ytsaurus_tpu.parallel.mesh_observatory import (
+    MESH_SKEW_SLO,
+    MeshObservatory,
+    get_mesh_observatory,
+    memory_analysis_dict,
+    peak_bytes,
+)
+
+
+def _block(skew=1.0, xbytes=0, headroom=0.0, watermark=None, drift=0.0,
+           shards=8, path="fused"):
+    """A telemetry block of the whole_plan._mesh_block shape."""
+    block = {"version": 1, "path": path, "shards": shards,
+             "in_rows": [10] * shards, "out_rows": [10] * shards,
+             "skew": skew, "exchange_bytes": xbytes,
+             "exchanges": []}
+    if xbytes:
+        block["exchanges"] = [{
+            "stage": "shuffle/group", "rows": 10 * shards,
+            "bytes": xbytes, "demand": 10, "quota": 16,
+            "headroom": headroom}]
+    if watermark is not None:
+        block["memory_watermark_bytes"] = watermark
+    if drift:
+        block["stages"] = [{"stage": 0, "table": "//d",
+                            "strategy": "partition", "est_rows": 100,
+                            "actual_rows": 125, "drift": drift}]
+    return block
+
+
+# --- roll-up + classification -------------------------------------------------
+
+
+def test_rollup_classification_and_top_views():
+    obs = MeshObservatory()
+    obs.record_execution("fp-a", _block(skew=1.2, xbytes=100))
+    # skew 6.0 > mesh_max_imbalance default 4.0 -> classified skewed.
+    obs.record_execution("fp-a", _block(skew=6.0, xbytes=50,
+                                        headroom=0.8))
+    obs.record_execution("fp-b", _block(skew=2.0, watermark=4096,
+                                        drift=0.25, path="stitched"))
+    assert obs.totals() == {"executions": 3, "balanced": 2, "skewed": 1,
+                            "programs": 2, "compiled": 0}
+    top = obs.top(by="skew")
+    assert [r["fingerprint"] for r in top] == ["fp-a", "fp-b"]
+    assert top[0]["skew_max"] == 6.0 and top[0]["skew_last"] == 6.0
+    assert top[0]["exchange_bytes"] == 150
+    assert top[0]["executions"] == 2 and top[0]["skewed"] == 1
+    assert top[0]["quota_headroom"] == 0.8
+    assert obs.top(by="memory")[0]["fingerprint"] == "fp-b"
+    assert obs.top(by="drift")[0]["drift_max"] == 0.25
+    assert obs.top(by="bytes")[0]["fingerprint"] == "fp-a"
+    snap = obs.snapshot()
+    assert snap["slo"] == MESH_SKEW_SLO
+    assert snap["last_blocks"]["fp-a"]["skew"] == 6.0
+    assert snap["last_blocks"]["fp-b"]["path"] == "stitched"
+    # The ranked rows never carry the raw block (bounded payload).
+    assert all("last_block" not in r for r in snap["programs"])
+
+
+def test_skew_classification_follows_config_threshold():
+    """mesh_max_imbalance is the skewed/balanced boundary; a 1-shard
+    mesh or an empty output can never classify as skewed."""
+    try:
+        yt_config.set_telemetry_config(
+            yt_config.TelemetryConfig(mesh_max_imbalance=2.0))
+        obs = MeshObservatory()
+        obs.record_execution("fp", _block(skew=3.0))          # > 2.0
+        obs.record_execution("fp", _block(skew=1.5))          # <= 2.0
+        obs.record_execution("fp", _block(skew=3.0, shards=1))
+        empty = _block(skew=3.0)
+        empty["out_rows"] = [0] * 8
+        obs.record_execution("fp", empty)
+        assert obs.totals()["skewed"] == 1
+        assert obs.totals()["balanced"] == 3
+    finally:
+        yt_config.set_telemetry_config(None)
+
+
+def test_rollups_are_bounded():
+    obs = MeshObservatory()
+    for i in range(obs.PROGRAM_CAP + 10):
+        obs.record_execution(f"fp{i:04d}", _block())
+    assert obs.totals()["programs"] == obs.PROGRAM_CAP
+    kept = {r["fingerprint"] for r in obs.top(n=0)}
+    assert "fp0000" not in kept               # LRU-evicted
+    assert f"fp{obs.PROGRAM_CAP + 9:04d}" in kept
+    for i in range(obs.COMPILED_CAP + 5):
+        obs.record_compile(("k", i), {"temp_size_in_bytes": i + 1},
+                           {"flops": 10.0})
+    assert obs.totals()["compiled"] == obs.COMPILED_CAP
+    assert obs.memory_for(("k", 0)) is None   # evicted
+    assert obs.memory_for(("k", obs.COMPILED_CAP + 4)) == \
+        obs.COMPILED_CAP + 5
+
+
+def test_memory_analysis_normalization():
+    class FakeMem:
+        temp_size_in_bytes = 100
+        argument_size_in_bytes = 40
+        output_size_in_bytes = 10
+        alias_size_in_bytes = 0
+        generated_code_size_in_bytes = 7
+
+    class FakeCompiled:
+        def memory_analysis(self):
+            return FakeMem()
+
+    mem = memory_analysis_dict(FakeCompiled())
+    assert mem["temp_size_in_bytes"] == 100
+    assert mem["generated_code_size_in_bytes"] == 7
+    # Watermark = live residency: temp + argument + output.
+    assert peak_bytes(mem) == 150
+
+    class Broken:
+        def memory_analysis(self):
+            raise NotImplementedError
+
+    assert memory_analysis_dict(Broken()) is None
+    assert peak_bytes(None) is None
+
+
+# --- MESH_SKEW_SLO burn-rate (satellite 1) ------------------------------------
+
+
+def test_mesh_skew_slo_burn_fires_and_resolves():
+    """The skew SLO over the /query/mesh balanced/skewed counters, on
+    the ISSUE 6 synthetic-SLI harness: a healthy baseline stays quiet, a
+    skew storm fires the burn-rate alert, recovery resolves it."""
+    from ytsaurus_tpu.utils.profiling import MetricsHistory, get_registry
+    from ytsaurus_tpu.utils.slo import SloTracker
+    obs = MeshObservatory()
+    hist = MetricsHistory(registry=get_registry(), fine_capacity=720,
+                          coarse_every=4, coarse_capacity=8,
+                          sample_period=10.0)
+    cfg = yt_config.TelemetryConfig.from_dict(
+        {"slos": {"mesh_skew": dict(MESH_SKEW_SLO)}})
+    tracker = SloTracker(cfg, history=hist)
+    t = 0.0
+    for _ in range(60):                     # healthy baseline
+        for _ in range(10):
+            obs.record_execution("fp", _block(skew=1.1))
+        t = hist.sample_once(t + 10.0)
+    snap = tracker.evaluate(now=t)
+    assert snap["slos"]["mesh_skew"]["firing"] is False
+    for _ in range(31):                     # skew storm
+        for _ in range(10):
+            obs.record_execution("fp", _block(skew=9.0))
+        t = hist.sample_once(t + 10.0)
+        tracker.evaluate(now=t)
+    snap = tracker.evaluate(now=t)
+    state = snap["slos"]["mesh_skew"]
+    assert state["firing"] is True
+    assert state["burn_fast"] > MESH_SKEW_SLO["burn_threshold"]
+    assert state["burn_slow"] > MESH_SKEW_SLO["burn_threshold"]
+    (alert,) = snap["active_alerts"]
+    assert alert["slo"] == "mesh_skew" and alert["state"] == "firing"
+    since = alert["since"]
+    for _ in range(31):                     # recovery: fast window heals
+        for _ in range(10):
+            obs.record_execution("fp", _block(skew=1.0))
+        t = hist.sample_once(t + 10.0)
+        tracker.evaluate(now=t)
+    snap = tracker.evaluate(now=t)
+    assert snap["slos"]["mesh_skew"]["firing"] is False
+    assert snap["active_alerts"] == []
+    assert any(a["slo"] == "mesh_skew" and a["state"] == "resolved"
+               and a["since"] == since
+               for a in snap["resolved_alerts"])
+
+
+# --- surfaces: /mesh endpoint, orchid, sensors, CLI ---------------------------
+
+
+def test_monitoring_mesh_endpoint_orchid_and_sensors():
+    from ytsaurus_tpu.server.monitoring import MonitoringServer
+    from ytsaurus_tpu.server.orchid import default_orchid
+    from ytsaurus_tpu.utils.profiling import get_registry
+    obs = get_mesh_observatory()
+    obs.reset()
+    obs.record_execution("fp-end", _block(skew=2.5, xbytes=64,
+                                          headroom=0.5))
+    try:
+        # The sensor family the catalog lint + SLO read.
+        collected = get_registry().collect()
+        assert collected["/query/mesh/skew_max"] == 2.5
+        assert collected["/query/mesh/quota_headroom"] == 0.5
+        assert collected["/query/mesh/balanced"] >= 1
+        # Orchid twin of the monitoring endpoint.
+        tree = default_orchid()
+        assert tree.get("/mesh/totals")["executions"] == 1
+        assert tree.get("/mesh/last_blocks/fp-end/skew") == 2.5
+        server = MonitoringServer()
+        server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{server.address}/mesh", timeout=10) as resp:
+                body = json.loads(resp.read())
+            assert body["totals"]["executions"] == 1
+            assert body["last_blocks"]["fp-end"]["exchange_bytes"] == 64
+            assert body["slo"]["good_sensor"] == "/query/mesh/balanced"
+            assert body["programs"][0]["fingerprint"] == "fp-end"
+        finally:
+            server.stop()
+    finally:
+        obs.reset()
+
+
+def test_mesh_top_cli_formatting():
+    from ytsaurus_tpu.cli import _format_mesh_top
+    obs = MeshObservatory()
+    obs.record_execution("fp-hot", _block(skew=6.5, xbytes=10,
+                                          watermark=2048))
+    obs.record_execution("fp-wide", _block(skew=1.1, xbytes=9000))
+    text = _format_mesh_top(obs.snapshot(), "skew", 20)
+    lines = text.splitlines()
+    assert lines[0].split() == [
+        "fingerprint", "path", "shards", "executions", "skew_max",
+        "exchange_bytes", "quota_headroom", "memory_watermark_bytes",
+        "drift_max", "skewed"]
+    assert lines[1].split()[0] == "fp-hot"       # ranked by skew
+    assert "6.500" in lines[1] and "2048" in lines[1]
+    assert lines[-1] == ("totals: 2 executions (1 balanced / 1 skewed) "
+                         "over 2 programs, 0 compile captures")
+    by_bytes = _format_mesh_top(obs.snapshot(), "bytes", 20)
+    assert by_bytes.splitlines()[1].split()[0] == "fp-wide"
+    # limit clips the ranked rows, not the totals line.
+    clipped = _format_mesh_top(obs.snapshot(), "skew", 1)
+    assert "fp-wide" not in clipped.splitlines()[1]
+
+
+# --- satellite 6: SPMD executables feed the compile observatory ---------------
+
+
+def test_spmd_compile_capture_feeds_compile_cache_top(request):
+    """ISSUE 20 fix: `_compile_spmd` threads cost/memory analysis into
+    the mesh observatory (always) and — behind capture_artifacts — the
+    compile observatory's artifact deque, so fused SPMD programs stop
+    showing up blank in `yt compile-cache top`."""
+    mesh = request.getfixturevalue("mesh8")
+    from ytsaurus_tpu.chunks import ColumnarChunk
+    from ytsaurus_tpu.cli import _format_compile_top
+    from ytsaurus_tpu.parallel.distributed import (
+        DistributedEvaluator,
+        ShardedTable,
+    )
+    from ytsaurus_tpu.parallel.whole_plan import run_whole_plan
+    from ytsaurus_tpu.query.builder import build_query
+    from ytsaurus_tpu.query.engine.evaluator import (
+        get_compile_observatory,
+    )
+    from ytsaurus_tpu.schema import TableSchema
+    schema = TableSchema.make([("k", "int64", "ascending"),
+                               ("v", "int64")])
+    chunks = [ColumnarChunk.from_arrays(schema, {
+        "k": np.arange(32) + sh * 32,
+        "v": np.arange(32) * 2}) for sh in range(8)]
+    table = ShardedTable.from_chunks(mesh, chunks)
+    obs = get_mesh_observatory()
+    compiled_before = obs.totals()["compiled"]
+    try:
+        yt_config.set_workload_config(
+            yt_config.WorkloadConfig(capture_artifacts=True))
+        get_compile_observatory().reset()
+        de = DistributedEvaluator(mesh)
+        plan = build_query("k, v FROM [//t] WHERE v > 10",
+                           {"//t": schema})
+        run_whole_plan(de, plan, table)
+        assert obs.totals()["compiled"] > compiled_before
+        artifacts = get_compile_observatory().snapshot()["artifacts"]
+        spmd = [a for a in artifacts
+                if str(a.get("fingerprint", "")).startswith("spmd/")]
+        assert spmd, "SPMD executable must appear in the artifact tier"
+        assert spmd[0]["fingerprint"] == "spmd/whole"
+        text = _format_compile_top(
+            get_compile_observatory().snapshot(), "compiles", 20)
+        assert "artifacts:" in text and "spmd/whole" in text
+    finally:
+        yt_config.set_workload_config(None)
+        get_compile_observatory().reset()

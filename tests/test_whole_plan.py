@@ -498,3 +498,188 @@ def test_explain_analyze_renders_whole_plan_flag():
     cold = format_profile_dict(
         {"statistics": QueryStatistics().to_dict()})
     assert "whole-plan" not in cold
+
+
+# -- mesh telemetry (ISSUE 20) -------------------------------------------------
+
+
+def _oracle_pids(values, n: int):
+    """Destination shard per row via the SAME canonical-hash helpers the
+    fused program routes with (`whole_plan._dest_hash`), applied OUTSIDE
+    shard_map on the raw numpy column — an independent recomputation in
+    the dual-check discipline."""
+    import jax.numpy as jnp
+
+    from ytsaurus_tpu.parallel.distributed import _canonical_hash_plane
+    from ytsaurus_tpu.query.engine.expr import _combine_u64, _mix_u64
+    acc = jnp.full(len(values), np.uint64(0x9E3779B97F4A7C15),
+                   dtype=jnp.uint64)
+    h = _mix_u64(_canonical_hash_plane(
+        jnp.asarray(values, dtype=jnp.int64)))
+    acc = _combine_u64(acc, h)
+    return np.asarray(acc % np.uint64(n)).astype(int)
+
+
+def test_mesh_telemetry_block_matches_numpy_oracle(request):
+    """ISSUE 20 acceptance: the telemetry block decoded from the ONE
+    stacked final transfer is bit-identical to a host-side oracle — live
+    input rows per shard, per-shard output rows, and the full all_to_all
+    transfer-count matrix recomputed with numpy + the canonical hash
+    outside shard_map — and arming telemetry still costs exactly one
+    host sync per query on the 8-device mesh."""
+    mesh = request.getfixturevalue("mesh8")
+    from ytsaurus_tpu.parallel.distributed import (
+        DistributedEvaluator,
+        ShardedTable,
+        host_sync_count,
+    )
+    from ytsaurus_tpu.parallel.whole_plan import (
+        MESH_TELEMETRY_VERSION,
+        run_whole_plan,
+    )
+    schema = TableSchema.make([("k", "int64", "ascending"),
+                               ("g", "int64"), ("v", "int64")])
+    rng = np.random.default_rng(11)
+    sizes = [40 + 9 * sh for sh in range(8)]
+    g_cols, v_cols, chunks = [], [], []
+    for sh, rows in enumerate(sizes):
+        g = rng.integers(0, 12, rows)
+        v = rng.integers(0, 1000, rows)
+        g_cols.append(g)
+        v_cols.append(v)
+        chunks.append(ColumnarChunk.from_arrays(schema, {
+            "k": np.arange(rows) + sh * 10_000, "g": g, "v": v}))
+    table = ShardedTable.from_chunks(mesh, chunks)
+    merged = concat_chunks(chunks)
+    de = DistributedEvaluator(mesh)
+
+    # Gather shape: in_rows = live rows, out_rows = per-shard filter
+    # survivors, no exchanges.
+    plan = build_query("k, v FROM [//t] WHERE v > 500", {T: schema})
+    stats = QueryStatistics()
+    s0 = host_sync_count()
+    got = run_whole_plan(de, plan, table, stats=stats)
+    assert host_sync_count() - s0 == 1
+    [block] = stats.mesh_blocks
+    want_out = [int((v > 500).sum()) for v in v_cols]
+    assert block["version"] == MESH_TELEMETRY_VERSION
+    assert block["path"] == "fused" and block["shards"] == 8
+    assert block["in_rows"] == sizes
+    assert block["out_rows"] == want_out
+    assert block["skew"] == round(max(want_out) / (sum(want_out) / 8), 4)
+    assert block["exchanges"] == [] and block["exchange_bytes"] == 0
+    assert got.row_count == sum(want_out)
+    assert stats.mesh_skew_max == block["skew"]
+
+    # Exchange-rows shape (window): the routed transfer-count matrix is
+    # the canonical key hash of the PARTITION BY column, shard-major.
+    planw = build_query(
+        "k, v, sum(v) OVER (PARTITION BY g ORDER BY k) AS rs "
+        "FROM [//t] ORDER BY k LIMIT 64", {T: schema})
+    statsw = QueryStatistics()
+    s0 = host_sync_count()
+    goww = run_whole_plan(de, planw, table, stats=statsw)
+    assert statsw.whole_plan_retries == 0
+    assert host_sync_count() - s0 == 1
+    [blockw] = statsw.mesh_blocks
+    matrix = np.zeros((8, 8), dtype=int)
+    for sh in range(8):
+        matrix[sh] = np.bincount(_oracle_pids(g_cols[sh], 8),
+                                 minlength=8)
+    [entry] = blockw["exchanges"]
+    assert entry["stage"] == "shuffle/exchange-rows"
+    assert entry["matrix"] == matrix.reshape(-1).tolist()
+    assert entry["rows"] == int(matrix.sum())
+    assert entry["demand"] == int(matrix.max())
+    assert entry["quota"] >= entry["demand"]
+    assert entry["headroom"] == round(matrix.max() / entry["quota"], 4)
+    # Routed rowset = the k/g/v int64 planes: (8 data + 1 validity) × 3.
+    assert entry["bytes"] == int(matrix.sum()) * 27
+    assert blockw["exchange_bytes"] == entry["bytes"]
+    assert blockw["in_rows"] == sizes
+    # The window local stage emits one row per received row, so the
+    # per-destination output spread IS the matrix column sums.
+    assert blockw["out_rows"] == matrix.sum(axis=0).tolist()
+    assert _canon_ordered(goww.to_rows()) == _canon_ordered(
+        Evaluator().run_plan(planw, merged).to_rows())
+
+
+def test_mesh_telemetry_disarm_is_free_and_bit_identical(table8):
+    """Disarming mesh telemetry compiles a fresh program (the armed bit
+    is a cache-key axis), still costs exactly one host sync, publishes
+    nothing — and the query result is bit-identical either way."""
+    from ytsaurus_tpu.parallel.distributed import (
+        DistributedEvaluator,
+        host_sync_count,
+    )
+    from ytsaurus_tpu.parallel.whole_plan import run_whole_plan
+    mesh, _chunks, table, merged = table8
+    de = DistributedEvaluator(mesh)
+    plan = build_query(CORPUS[0], {T: SCHEMA})
+    try:
+        stats_on = QueryStatistics()
+        s0 = host_sync_count()
+        armed_out = run_whole_plan(de, plan, table, stats=stats_on)
+        assert host_sync_count() - s0 == 1
+        assert len(stats_on.mesh_blocks) == 1
+        assert stats_on.mesh_skew_max >= 1.0
+        assert stats_on.mesh_exchange_bytes > 0
+        yt_config.set_telemetry_config(
+            yt_config.TelemetryConfig(mesh_telemetry=False))
+        stats_off = QueryStatistics()
+        s0 = host_sync_count()
+        plain_out = run_whole_plan(de, plan, table, stats=stats_off)
+        assert host_sync_count() - s0 == 1
+        assert stats_off.mesh_blocks == []
+        assert stats_off.mesh_skew_max == 0.0
+    finally:
+        yt_config.set_telemetry_config(None)
+    want = _canon(Evaluator().run_plan(plan, merged).to_rows())
+    assert _canon(armed_out.to_rows()) == want
+    assert _canon(plain_out.to_rows()) == want
+
+
+def test_stitched_rungs_report_the_same_block_shape(table8):
+    """The stitched shuffle path assembles the SAME versioned block from
+    host values it already read (path="stitched"), with the transfer
+    matrix agreeing with the canonical-hash oracle — zero additional
+    device reads."""
+    from ytsaurus_tpu.parallel.distributed import DistributedEvaluator
+    from ytsaurus_tpu.parallel.whole_plan import MESH_TELEMETRY_VERSION
+    mesh, chunks, table, _merged = table8
+    de = DistributedEvaluator(mesh)
+    plan = build_query("g, sum(v) AS sv FROM [//t] GROUP BY g",
+                       {T: SCHEMA})
+    stats = QueryStatistics()
+    de.run(plan, table, shuffle=True, stats=stats)
+    assert stats.mesh_blocks, "stitched shuffle must publish a block"
+    block = stats.mesh_blocks[0]
+    assert block["version"] == MESH_TELEMETRY_VERSION
+    assert block["path"] == "stitched" and block["shards"] == 8
+    assert block["in_rows"] == [c.row_count for c in chunks]
+    [entry] = block["exchanges"]
+    assert entry["stage"] == "shuffle/stitched"
+    assert sum(entry["matrix"]) == entry["rows"] > 0
+    assert entry["quota"] >= entry["demand"] == max(entry["matrix"])
+
+
+def test_explain_analyze_renders_mesh_telemetry():
+    from ytsaurus_tpu.query.profile import format_profile_dict
+    stats = QueryStatistics(whole_plan=1)
+    stats.note_mesh_block({
+        "version": 1, "path": "fused", "shards": 4,
+        "in_rows": [10, 10, 10, 10], "out_rows": [2, 3, 4, 11],
+        "skew": 2.2, "exchange_bytes": 540,
+        "exchanges": [{"stage": "shuffle/group", "rows": 20,
+                       "bytes": 540, "demand": 11, "quota": 16,
+                       "headroom": 0.6875}],
+        "memory_watermark_bytes": 4096})
+    text = format_profile_dict({"statistics": stats.to_dict()})
+    assert "mesh telemetry:" in text
+    assert "rows/shard min 2 / median 4 / max 11  skew 2.2" in text
+    assert "exchange shuffle/group: 20 rows / 540 bytes" in text
+    assert "quota 16 granted / 11 demanded (headroom 0.6875)" in text
+    assert "memory watermark 4096 bytes" in text
+    cold = format_profile_dict(
+        {"statistics": QueryStatistics().to_dict()})
+    assert "mesh telemetry" not in cold

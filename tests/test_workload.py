@@ -171,6 +171,50 @@ def test_capture_roundtrip_and_version_check(tmp_path):
         wl.load_capture(str(tmp_path / "missing.json"))
 
 
+def test_planner_feedback_ledger_roundtrip(tmp_path):
+    """ISSUE 20 satellite: the per-query est-vs-actual join drift rides
+    the workload record (schema v2, `join_est_error`), observe_select
+    derives it from the join plan, the fingerprint roll-up keeps the
+    max, the capture round-trips it bit-exactly — and a v1 capture
+    refuses to load loudly."""
+    from ytsaurus_tpu.query.statistics import QueryStatistics
+    assert wl.WORKLOAD_SCHEMA_VERSION == 2
+    log = wl.WorkloadLog(yt_config.WorkloadConfig())
+    stats = QueryStatistics()
+    stats.note_join_stage(0, "//dim", "broadcast",
+                          est_rows=100, actual_rows=150)
+    stats.note_join_stage(1, "//dim2", "partition",
+                          est_rows=80, actual_rows=80)
+    assert log.observe_select(
+        "g, name FROM [//t] JOIN [//dim] ON g = dk WHERE v > 5",
+        stats=stats)
+    rec = log.records()[-1]
+    assert rec.join_est_error == 0.3333       # |150 - 100| / 150
+    # A later execution of the same fingerprint with a better estimate
+    # must not shrink the recorded worst case.
+    stats2 = QueryStatistics()
+    stats2.note_join_stage(0, "//dim", "broadcast",
+                           est_rows=150, actual_rows=150)
+    assert log.observe_select(
+        "g, name FROM [//t] JOIN [//dim] ON g = dk WHERE v > 9",
+        stats=stats2)
+    (entry,) = log.fingerprints(top=0)
+    assert entry["count"] == 2
+    assert entry["join_est_error_max"] == 0.3333
+    path = tmp_path / "capture.json"
+    assert log.export_capture(str(path)) == 2
+    records = wl.load_capture(str(path))
+    assert [r.join_est_error for r in records] == [0.3333, 0.0]
+    # A capture written by the v1 schema (no drift ledger) refuses to
+    # load — silently defaulting the field would poison the planner
+    # feedback it exists to provide.
+    payload = json.loads(path.read_text())
+    payload["workload_schema"] = 1
+    path.write_text(json.dumps(payload))
+    with pytest.raises(YtError, match="incompatible"):
+        wl.load_capture(str(path))
+
+
 # -- recording through the planes ----------------------------------------------
 
 def test_select_folds_workload_record(client):

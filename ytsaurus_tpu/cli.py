@@ -212,6 +212,16 @@ def _fetch_compile(cl) -> dict:
     return get_compile_observatory().snapshot()
 
 
+def _fetch_mesh(cl) -> dict:
+    """The /mesh snapshot (mesh execution observatory)."""
+    if hasattr(cl, "get_orchid"):
+        return _decode_deep(cl.get_orchid("/mesh") or {})
+    from ytsaurus_tpu.parallel.mesh_observatory import (
+        get_mesh_observatory,
+    )
+    return get_mesh_observatory().snapshot()
+
+
 _COMPILE_TOP_COLUMNS = ("compiles", "hits", "disk_hits",
                         "compile_seconds", "shape_count", "evictions",
                         "last_miss_cause")
@@ -262,6 +272,62 @@ def _format_compile_top(snapshot: dict, sort_key: str,
             f"{int(disk.get('bytes', 0))} bytes "
             f"(cap {int(disk.get('capacity_bytes', 0))}) "
             f"at {disk.get('dir')}")
+    # Captured XLA artifacts (behind WorkloadConfig.capture_artifacts):
+    # local AND SPMD executables with their cost_analysis FLOPs/bytes
+    # (ISSUE 20 — fused/stitched programs stopped showing up blank).
+    artifacts = snapshot.get("artifacts") or []
+    if artifacts:
+        lines.append("artifacts:")
+
+        def num(value):
+            return "-" if value is None else f"{int(float(value))}"
+
+        lines.append(_format_table(
+            ["fingerprint", "flops", "bytes_accessed",
+             "compile_seconds"],
+            [[art.get("fingerprint", "?"), num(art.get("flops")),
+              num(art.get("bytes_accessed")),
+              f"{float(art.get('compile_seconds') or 0.0):.3f}"]
+             for art in artifacts]))
+    return "\n".join(lines)
+
+
+_MESH_TOP_SORT = {"skew": "skew_max", "bytes": "exchange_bytes",
+                  "memory": "memory_watermark_bytes"}
+
+_MESH_TOP_COLUMNS = ("path", "shards", "executions", "skew_max",
+                     "exchange_bytes", "quota_headroom",
+                     "memory_watermark_bytes", "drift_max", "skewed")
+
+
+def _format_mesh_top(snapshot: dict, sort_key: str, limit: int) -> str:
+    """`yt mesh top`: SPMD program fingerprints ranked by shard skew /
+    exchange bytes / memory watermark — the observability answer to
+    "which program is hot and where"."""
+    field = _MESH_TOP_SORT.get(sort_key, sort_key)
+    rows = list(snapshot.get("programs") or [])
+    rows.sort(key=lambda r: -float(r.get(field) or 0.0))
+    if limit > 0:
+        rows = rows[:limit]
+
+    def fmt(record, col):
+        value = record.get(col)
+        if col == "path":
+            return str(value or "-")
+        if col in ("skew_max", "quota_headroom", "drift_max"):
+            return f"{float(value or 0.0):.3f}"
+        return f"{int(value or 0)}"
+
+    body = [[r.get("fingerprint", "?"),
+             *[fmt(r, col) for col in _MESH_TOP_COLUMNS]] for r in rows]
+    totals = snapshot.get("totals") or {}
+    lines = [_format_table(["fingerprint", *_MESH_TOP_COLUMNS], body)]
+    lines.append(
+        f"totals: {int(totals.get('executions', 0))} executions "
+        f"({int(totals.get('balanced', 0))} balanced / "
+        f"{int(totals.get('skewed', 0))} skewed) over "
+        f"{int(totals.get('programs', 0))} programs, "
+        f"{int(totals.get('compiled', 0))} compile captures")
     return "\n".join(lines)
 
 
@@ -509,6 +575,13 @@ def build_parser() -> argparse.ArgumentParser:
                        "help": "observatory column to rank by "
                                "(descending); e.g. compiles, "
                                "shape_count, evictions"}),
+        (("--json",), {"action": "store_true"}))
+    cmd("mesh", (("action",), {"choices": ["top"]}),
+        (("--limit",), {"type": int, "default": 20}),
+        (("--sort",), {"default": "skew",
+                       "help": "rank programs by skew | bytes | memory "
+                               "(or any roll-up column, e.g. "
+                               "executions, drift_max)"}),
         (("--json",), {"action": "store_true"}))
     cmd("insert-rows", (("path",), {}),
         (("--rows",), {"default": None}))
@@ -766,6 +839,12 @@ def _dispatch(cl, a):
         if a.json:
             return snapshot
         print(_format_compile_top(snapshot, a.sort, a.limit))
+        return None
+    if c == "mesh":
+        snapshot = _fetch_mesh(cl)
+        if a.json:
+            return snapshot
+        print(_format_mesh_top(snapshot, a.sort, a.limit))
         return None
     if c == "insert-rows":
         rows = json.loads(_rows_arg(a.rows))

@@ -410,7 +410,14 @@ class TelemetryConfig(YsonStruct):
     - `fine_capacity`/`coarse_every`/`coarse_capacity`: ring tiers.
       Defaults hold 1h at 10s resolution plus 24h at 5min resolution
       (10s x 360 + 5min x 288) in bounded memory per sensor.
-    - `slos`: name -> SloConfig, evaluated after every sample."""
+    - `slos`: name -> SloConfig, evaluated after every sample.
+    - `mesh_telemetry`: arm the in-program mesh telemetry block (ISSUE
+      20) — per-shard row counts, transfer matrices, quota headroom —
+      stacked onto the whole-plan final transfer (same single host
+      sync).  The flag folds into every SPMD cache key.
+    - `mesh_max_imbalance`: max-shard/mean-shard output-row ratio above
+      which an execution counts as SKEWED for the `/query/mesh/*`
+      balanced-vs-skewed counters (the MESH_SKEW_SLO denominator)."""
 
     enabled = param(True, type=bool)
     sample_period = param(10.0, type=float, ge=0.0)
@@ -419,6 +426,8 @@ class TelemetryConfig(YsonStruct):
     coarse_every = param(30, type=int, ge=1)
     coarse_capacity = param(288, type=int, ge=1)
     slos = param(default_factory=dict, type=dict)
+    mesh_telemetry = param(True, type=bool)
+    mesh_max_imbalance = param(4.0, type=float, ge=1.0)
 
     def postprocess(self):
         parsed = {}

@@ -290,6 +290,24 @@ def _foreign_host_order(cache: dict, join: ir.JoinClause, foreign,
     return _chunk_memo(cache, host_key, foreign, build)
 
 
+def _stitched_mesh_block(stats, plan: ir.Query, key, n: int, in_rows,
+                         out_rows, exchanges, stages=None) -> None:
+    """Stitched-rung mesh telemetry (ISSUE 20 parity): assemble the SAME
+    block shape the fused program returns — from host values the
+    stitched rungs ALREADY read for their quota/capacity decisions, so
+    this costs zero additional device→host transfers — and fan it out
+    to the same surfaces (whole_plan._publish_mesh).  Blocks carry
+    path="stitched", so /mesh and `yt mesh top` show which lowering
+    measured what."""
+    from ytsaurus_tpu.parallel.whole_plan import (
+        _mesh_armed, _mesh_block, _publish_mesh)
+    if not _mesh_armed():
+        return
+    block = _mesh_block(n, in_rows, out_rows, exchanges, stages=stages,
+                        path="stitched")
+    _publish_mesh(stats, plan_fingerprint(plan), key, block)
+
+
 class DistributedEvaluator:
     """Compiles and caches SPMD (join ∘ bottom ∘ all_gather ∘ front)
     programs."""
@@ -345,6 +363,7 @@ class DistributedEvaluator:
         fn = disk.load(key) if disk is not None else None
         if fn is not None:
             self.disk_hits += 1
+            self._observe_compiled(key, fn)
         else:
             jitted = jax.jit(build(), donate_argnums=donate)
             t0 = _time.perf_counter()
@@ -359,15 +378,47 @@ class DistributedEvaluator:
                 fn = jitted
                 lowered = None
             self.fresh_compiles += 1
+            seconds = _time.perf_counter() - t0
             if disk is not None and lowered is not None:
-                disk.store(key, fn, str(key[0]),
-                           _time.perf_counter() - t0)
+                disk.store(key, fn, str(key[0]), seconds)
+            if lowered is not None:
+                self._observe_compiled(key, fn, lowered, seconds)
         self._cache[key] = fn
         return fn
 
+    @staticmethod
+    def _observe_compiled(key: tuple, fn, lowered=None,
+                          seconds: float = 0.0) -> None:
+        """Compile-time capture for one SPMD executable (ISSUE 20):
+        memory_analysis()/cost_analysis() land in the mesh observatory
+        (keyed by the program cache key the dispatch site holds — the
+        runtime telemetry block joins them at decode time), and — behind
+        `WorkloadConfig.capture_artifacts` — the HLO + FLOPs/bytes land
+        in the compile observatory's artifact ring, so fused/stitched
+        SPMD programs show up in `yt compile-cache top` instead of
+        blanks.  Never observe_hit/observe_miss here: those counters
+        must reconcile with the /query/compile_cache pool sensors,
+        which only count the local evaluator's dispatches."""
+        from ytsaurus_tpu.parallel.mesh_observatory import (
+            get_mesh_observatory, memory_analysis_dict)
+        from ytsaurus_tpu.query.engine.evaluator import (
+            _cost_analysis, get_compile_observatory)
+        try:
+            cost = _cost_analysis(fn)
+            get_mesh_observatory().record_compile(
+                key, memory_analysis_dict(fn), cost)
+            from ytsaurus_tpu.config import workload_config
+            if workload_config().capture_artifacts and lowered is not None:
+                get_compile_observatory().capture_artifact(
+                    f"spmd/{key[0]}", key, lowered.as_text(), cost,
+                    seconds)
+        except Exception:   # noqa: BLE001 — observability capture is a
+            # debugging aid, never an execution hazard.
+            pass
+
     def run(self, plan: ir.Query, table: ShardedTable,
             foreign_chunks: Optional[dict] = None,
-            shuffle: Optional[bool] = None) -> ColumnarChunk:
+            shuffle: Optional[bool] = None, stats=None) -> ColumnarChunk:
         """Execute a plan SPMD.  `shuffle=True` uses the all_to_all
         repartition path for GROUP BY (ref CoordinateAndExecuteWithShuffle,
         engine_api/coordinator.h:92): rows move to hash(key)-owned devices
@@ -403,7 +454,7 @@ class DistributedEvaluator:
             if join_setup is None:
                 return self._run_partitioned(plan, table,
                                              foreign_chunks or {},
-                                             bool(shuffle))
+                                             bool(shuffle), stats=stats)
         if plan.window is not None and plan.window.partition_items and \
                 shuffle is not False and join_setup is None:
             # Window functions co-partition by the PARTITION BY key over
@@ -418,9 +469,10 @@ class DistributedEvaluator:
                 table.row_valid,
                 {name: _RepColumn(type=col.type, dictionary=col.dictionary)
                  for name, col in table.columns.items()},
-                table.capacity)
+                table.capacity, stats=stats,
+                in_rows=list(table.row_counts))
         if shuffle and plan.group is not None and not plan.group.totals:
-            return self._run_shuffled(plan, table)
+            return self._run_shuffled(plan, table, stats=stats)
         columns_global = {name: (col.data, col.valid)
                           for name, col in table.columns.items()}
         if join_setup is None:
@@ -431,12 +483,13 @@ class DistributedEvaluator:
             rep_columns = join_setup.rep_columns
         return self._finish_gather(plan, columns_global, table.row_valid,
                                    rep_columns, table.capacity,
-                                   join_setup=join_setup)
+                                   join_setup=join_setup, stats=stats,
+                                   in_rows=list(table.row_counts))
 
     def _finish_gather(self, plan: ir.Query, columns_global: dict,
                        row_valid, rep_columns: dict, cap: int,
-                       join_setup: "Optional[_JoinSetup]" = None
-                       ) -> ColumnarChunk:
+                       join_setup: "Optional[_JoinSetup]" = None,
+                       stats=None, in_rows=None) -> ColumnarChunk:
         """Bottom-per-shard + all_gather front merge over bare sharded
         planes — run()'s tail for both the no-join and broadcast-join
         shapes, reusable after a partitioned join has replaced the table
@@ -470,10 +523,17 @@ class DistributedEvaluator:
             (columns, row_valid, tuple(prepared_b.bindings),
              tuple(prepared_f.bindings), *extra))
         _note_host_sync()
+        if in_rows is not None:
+            # The gather rung's only host-known per-shard cardinality is
+            # the input spread (the front count is a merged global): its
+            # skew IS the per-shard work on this rung, so it doubles as
+            # the output spread in the parity block.
+            _stitched_mesh_block(stats, plan, key, n, in_rows, in_rows,
+                                 [])
         return _assemble_chunk(prepared_f.output, out_planes, out_count)
 
     def _run_partitioned(self, plan: ir.Query, table: ShardedTable,
-                         foreign_chunks: dict, shuffle: bool
+                         foreign_chunks: dict, shuffle: bool, stats=None
                          ) -> ColumnarChunk:
         """Partitioned hash join: route BOTH sides of each join by
         join-key hash over one all_to_all so equal keys co-locate, then
@@ -521,6 +581,15 @@ class DistributedEvaluator:
         rep_columns = {
             name: _RepColumn(type=col.type, dictionary=col.dictionary)
             for name, col in table.columns.items()}
+        # Mesh parity telemetry (ISSUE 20): the quota/capacity host
+        # reads this path already pays carry enough to assemble the
+        # fused block's shape — exchange demand vs granted per side,
+        # per-shard joined-output totals.  Transfer MATRICES stay on
+        # device here (only their maxes cross), so entries carry
+        # matrix=None.
+        mesh_exchanges: list = []
+        mesh_stages: list = []
+        mesh_out_rows = list(table.row_counts)
 
         for join_index, join in enumerate(plan.joins):
             foreign = foreign_chunks.get(join.foreign_table)
@@ -637,9 +706,23 @@ class DistributedEvaluator:
             quotas = np.asarray(jnp.stack([counts_s.max(),
                                            counts_f.max()]))
             # analyze: allow(host-sync): quotas is host numpy (the one stacked transfer above)
-            quota_s, quota_f = (pad_capacity(max(int(q), 1))
-                                for q in quotas)
+            demand_s, demand_f = (int(q) for q in quotas)
+            quota_s = pad_capacity(max(demand_s, 1))
+            quota_f = pad_capacity(max(demand_f, 1))
             S, F = n * quota_s, n * quota_f
+            from ytsaurus_tpu.parallel.whole_plan import (
+                _mesh_exchange_entry, _row_bytes)
+            mesh_exchanges.append(_mesh_exchange_entry(
+                f"join[{join_index}]/self", None, demand_s,
+                quota_s, _row_bytes({name: rep_columns[name]
+                                     for name in columns_global
+                                     if name in rep_columns})))
+            mesh_exchanges.append(_mesh_exchange_entry(
+                f"join[{join_index}]/foreign", None, demand_f,
+                quota_f, _row_bytes({
+                    f: _RepColumn(type=foreign.columns[f].type,
+                                  dictionary=foreign.columns[f].dictionary)
+                    for f in f_names})))
 
             def route_probe(cols, mask, fcols, fmask, bnd_t):
                 pid_s = make_pid(emit_self(cols, s_cap, bnd_t), mask,
@@ -672,7 +755,13 @@ class DistributedEvaluator:
                 (columns_global, row_valid, f_global, f_row_valid, bnd))
             _note_host_sync()
             # analyze: allow(host-sync): join output capacity is a host decision — one totals transfer
-            out_cap = pad_capacity(max(int(np.asarray(totals).max()), 1))
+            totals_np = np.asarray(totals)
+            out_cap = pad_capacity(max(int(totals_np.max()), 1))
+            mesh_out_rows = [int(t) for t in totals_np.reshape(-1)]
+            mesh_stages.append({
+                "stage": join_index, "table": join.foreign_table,
+                "strategy": "partition", "est_rows": 0,
+                "actual_rows": int(totals_np.sum()), "drift": 0.0})
             self_names = sorted(columns_global)
 
             def expand(recv_s, mask_s, recv_f, f_order, lo, counts):
@@ -720,6 +809,10 @@ class DistributedEvaluator:
                 rep_columns[flat] = _RepColumn(type=fcol.type,
                                                dictionary=fcol.dictionary)
 
+        _stitched_mesh_block(stats, plan, None, n,
+                             list(table.row_counts), mesh_out_rows,
+                             mesh_exchanges, stages=mesh_stages)
+
         plan_nojoin = dc_replace(plan, joins=())
         if needed is not None:
             # The finish stages bind every schema column; drop the ones
@@ -731,26 +824,31 @@ class DistributedEvaluator:
                 plan_nojoin.window.partition_items and shuffle:
             return self._finish_shuffled(
                 plan_nojoin, columns_global, row_valid, rep_columns,
-                cur_cap)
+                cur_cap, stats=stats, in_rows=mesh_out_rows)
         if shuffle and plan.group is not None and not plan.group.totals:
             return self._finish_shuffled(plan_nojoin, columns_global,
-                                         row_valid, rep_columns, cur_cap)
+                                         row_valid, rep_columns, cur_cap,
+                                         stats=stats,
+                                         in_rows=mesh_out_rows)
         return self._finish_gather(plan_nojoin, columns_global, row_valid,
-                                   rep_columns, cur_cap)
+                                   rep_columns, cur_cap, stats=stats,
+                                   in_rows=mesh_out_rows)
 
-    def _run_shuffled(self, plan: ir.Query, table: ShardedTable
-                      ) -> ColumnarChunk:
+    def _run_shuffled(self, plan: ir.Query, table: ShardedTable,
+                      stats=None) -> ColumnarChunk:
         columns_global = {name: (col.data, col.valid)
                           for name, col in table.columns.items()}
         rep_columns = {
             name: _RepColumn(type=col.type, dictionary=col.dictionary)
             for name, col in table.columns.items()}
         return self._finish_shuffled(plan, columns_global, table.row_valid,
-                                     rep_columns, table.capacity)
+                                     rep_columns, table.capacity,
+                                     stats=stats,
+                                     in_rows=list(table.row_counts))
 
     def _finish_shuffled(self, plan: ir.Query, columns_global: dict,
-                         row_valid, rep_columns: dict, cap: int
-                         ) -> ColumnarChunk:
+                         row_valid, rep_columns: dict, cap: int,
+                         stats=None, in_rows=None) -> ColumnarChunk:
         """Key-hash all_to_all finish, shared by two stage shapes:
 
         - GROUP BY (route by group key): every device owns complete
@@ -834,7 +932,8 @@ class DistributedEvaluator:
             (columns_global, row_valid, bindings))
         _note_host_sync()
         # analyze: allow(host-sync): all_to_all quota is a host decision — one transfer-matrix read
-        quota = pad_capacity(max(int(np.asarray(counts).max()), 1))
+        counts_np = np.asarray(counts)
+        quota = pad_capacity(max(int(counts_np.max()), 1))
         recv_cap = quota * n
 
         # Local plan: complete groups (group + having) or complete
@@ -892,6 +991,22 @@ class DistributedEvaluator:
              tuple(prepared_local.bindings),
              tuple(prepared_front.bindings)))
         _note_host_sync()
+        # Mesh parity telemetry (ISSUE 20): the quota decision above
+        # already transferred the FULL n x n transfer matrix to the
+        # host, so this rung reports the same exchange detail as the
+        # fused block — per-shard received rows (column sums) give the
+        # post-exchange skew — at zero additional transfers.
+        from ytsaurus_tpu.parallel.whole_plan import (
+            _mesh_exchange_entry, _row_bytes)
+        entry = _mesh_exchange_entry(
+            "shuffle/stitched", counts_np.reshape(-1),
+            int(counts_np.max()), quota, _row_bytes(rep_columns))
+        recv_rows = [int(r) for r in counts_np.sum(axis=0)]
+        _stitched_mesh_block(
+            stats, plan, key, n,
+            in_rows if in_rows is not None else
+            [int(r) for r in counts_np.sum(axis=1)],
+            recv_rows, [entry])
         return _assemble_chunk(prepared_front.output, out_planes,
                                out_count)
 
@@ -1106,7 +1221,7 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
                 with child_span("distributed.shuffle", rung=1,
                                 shards=len(chunks)):
                     return de.run(plan, table, foreign_chunks,
-                                  shuffle=True)
+                                  shuffle=True, stats=stats)
             except YtError as err:
                 errors.append(err)
                 log_event(_ladder_log, _logging.WARNING,
@@ -1114,7 +1229,8 @@ def coordinate_distributed(plan: ir.Query, mesh: Mesh,
         try:
             with child_span("distributed.gather_merge", rung=2,
                             shards=len(chunks)):
-                return de.run(plan, table, foreign_chunks, shuffle=False)
+                return de.run(plan, table, foreign_chunks, shuffle=False,
+                              stats=stats)
         except YtError as err:
             errors.append(err)
             log_event(_ladder_log, _logging.WARNING,

@@ -1,0 +1,253 @@
+"""Mesh execution observatory (ISSUE 20): the roll-up behind /mesh.
+
+Whole-plan fusion (ISSUEs 10/12/14/19) collapsed every distributed
+query into ONE ``jit(shard_map)`` program at exactly one host sync —
+and made the inside of a query a black box.  This module is the bounded
+per-fingerprint memory of what those programs measured about
+themselves:
+
+- the RUNTIME telemetry block each fused program computes on device and
+  returns stacked WITH its result (``whole_plan.MESH_TELEMETRY_VERSION``
+  — per-shard input/output rows, all_to_all transfer matrices, quota
+  demand vs granted) plus the same-shape blocks the stitched rungs
+  assemble from host values they already read;
+- the COMPILE-TIME ``memory_analysis()``/``cost_analysis()`` capture
+  per SPMD executable (peak temp/argument/output bytes, FLOPs — the
+  buffer-donation savings of ISSUE 19 become measurable numbers).
+
+Shape mirrors query/engine/evaluator.CompileObservatory: one sanitized
+lock, bounded OrderedDict roll-ups, ``totals()/top()/snapshot()`` views
+serving monitoring ``/mesh``, the orchid twin, and ``yt mesh top``.
+Sensors fold under ``/query/mesh/*`` so the telemetry rings (ISSUE 6)
+can burn a skew SLO against them — the observability layer the fused
+sort (ROADMAP item 5) inherits for free.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+from ytsaurus_tpu.utils import sanitizers
+from ytsaurus_tpu.utils.profiling import Profiler
+
+# /query/mesh sensor family: gauges track the LAST executed program's
+# shape (dashboards overlay them on the history rings), counters
+# accumulate exchange traffic and the balanced-vs-skewed split the
+# MESH_SKEW_SLO burns against.
+_mesh_profiler = Profiler("/query/mesh")
+_skew_gauge = _mesh_profiler.gauge("skew_max")
+_headroom_gauge = _mesh_profiler.gauge("quota_headroom")
+_watermark_gauge = _mesh_profiler.gauge("memory_watermark_bytes")
+_exchange_bytes_counter = _mesh_profiler.counter("exchange_bytes")
+_balanced_counter = _mesh_profiler.counter("balanced")
+_skewed_counter = _mesh_profiler.counter("skewed")
+
+# Skew burn-rate SLO (satellite of ISSUE 20, the COMPILE_STORM_SLO
+# idiom): "≥ `objective` of mesh program executions stay under
+# TelemetryConfig.mesh_max_imbalance shard imbalance", evaluated by
+# utils/slo.SloTracker over the /query/mesh balanced/skewed counters.
+MESH_SKEW_SLO = {
+    "kind": "ratio",
+    "good_sensor": "/query/mesh/balanced",
+    "bad_sensor": "/query/mesh/skewed",
+    "objective": 0.99,
+    "burn_threshold": 10.0,
+}
+
+
+def memory_analysis_dict(compiled) -> Optional[dict]:
+    """Normalized ``compiled.memory_analysis()``: the byte-sized
+    attributes as a plain dict, or None when the backend offers
+    nothing (CPU builds vary by jax version — absence is not an
+    error)."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:   # noqa: BLE001 — backend-dependent, optional
+        return None
+    if mem is None:
+        return None
+    out: dict = {}
+    for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                 "output_size_in_bytes", "alias_size_in_bytes",
+                 "generated_code_size_in_bytes"):
+        val = getattr(mem, attr, None)
+        if isinstance(val, (int, float)):
+            out[attr] = int(val)
+    if not out and isinstance(mem, dict):
+        out = {k: int(v) for k, v in mem.items()
+               if isinstance(v, (int, float))}
+    return out or None
+
+
+def peak_bytes(memory: Optional[dict]) -> Optional[int]:
+    """The memory watermark of one executable: live temp + argument +
+    output bytes (the residency XLA actually holds at once; donation
+    savings show up here as a smaller argument+temp sum)."""
+    if not memory:
+        return None
+    total = sum(memory.get(k, 0) for k in
+                ("temp_size_in_bytes", "argument_size_in_bytes",
+                 "output_size_in_bytes"))
+    return int(total) if total > 0 else None
+
+
+_TOP_FIELDS = {
+    "skew": "skew_max",
+    "bytes": "exchange_bytes",
+    "memory": "memory_watermark_bytes",
+    "executions": "executions",
+    "drift": "drift_max",
+}
+
+
+class MeshObservatory:
+    """Bounded per-fingerprint roll-up of mesh telemetry blocks plus the
+    per-program-key compile-time memory/cost capture."""
+
+    PROGRAM_CAP = 256       # distinct plan fingerprints retained
+    COMPILED_CAP = 512      # distinct SPMD program keys retained
+
+    def __init__(self):
+        # guards: _programs, _compiled, executions_n, balanced_n, skewed_n
+        self._lock = sanitizers.register_lock(
+            "mesh_observatory.MeshObservatory._lock")
+        self._programs: "OrderedDict[str, dict]" = OrderedDict()
+        self._compiled: "OrderedDict[tuple, dict]" = OrderedDict()
+        self.executions_n = 0
+        self.balanced_n = 0
+        self.skewed_n = 0
+
+    # -- compile-time capture --------------------------------------------------
+
+    def record_compile(self, key: tuple, memory: Optional[dict],
+                       cost: Optional[dict]) -> None:
+        """One SPMD executable's compile-time analyses, keyed by its
+        program cache key (what the dispatch site holds at decode
+        time)."""
+        entry = {"memory": memory, "peak_bytes": peak_bytes(memory),
+                 "flops": (cost or {}).get("flops"),
+                 "bytes_accessed": (cost or {}).get(
+                     "bytes accessed", (cost or {}).get("bytes_accessed"))}
+        with self._lock:
+            self._compiled[key] = entry
+            while len(self._compiled) > self.COMPILED_CAP:
+                self._compiled.popitem(last=False)
+
+    def memory_for(self, key: tuple) -> Optional[int]:
+        """Peak device bytes of the executable behind `key` (None when
+        the backend reported no memory analysis)."""
+        with self._lock:
+            entry = self._compiled.get(key)
+        return entry["peak_bytes"] if entry is not None else None
+
+    # -- runtime blocks --------------------------------------------------------
+
+    def record_execution(self, fingerprint: str, block: dict) -> None:
+        """Fold one executed program's telemetry block (fused or
+        stitched — same shape, see whole_plan._mesh_block) into the
+        per-fingerprint roll-up + the /query/mesh sensors."""
+        from ytsaurus_tpu.config import telemetry_config
+        max_imbalance = telemetry_config().mesh_max_imbalance
+        skew = float(block.get("skew", 1.0))
+        xbytes = int(block.get("exchange_bytes", 0))
+        headroom = max([float(e.get("headroom", 0.0))
+                        for e in block.get("exchanges", ())] or [0.0])
+        watermark = block.get("memory_watermark_bytes")
+        drift = max([float(s.get("drift", 0.0))
+                     for s in block.get("stages", ())] or [0.0])
+        out_rows = block.get("out_rows") or ()
+        skewed = int(block.get("shards", 1)) > 1 and sum(out_rows) > 0 \
+            and skew > max_imbalance
+        with self._lock:
+            self.executions_n += 1
+            if skewed:
+                self.skewed_n += 1
+            else:
+                self.balanced_n += 1
+            entry = self._programs.get(fingerprint)
+            if entry is None:
+                entry = self._programs[fingerprint] = {
+                    "executions": 0, "skew_max": 0.0, "skew_last": 0.0,
+                    "exchange_bytes": 0, "rows_out": 0,
+                    "quota_headroom": 0.0, "drift_max": 0.0,
+                    "memory_watermark_bytes": 0, "skewed": 0,
+                    "path": block.get("path", "fused"),
+                    "shards": int(block.get("shards", 0)),
+                    "last_block": None,
+                }
+            self._programs.move_to_end(fingerprint)
+            entry["executions"] += 1
+            entry["skew_last"] = skew
+            entry["skew_max"] = max(entry["skew_max"], skew)
+            entry["exchange_bytes"] += xbytes
+            entry["rows_out"] += int(sum(out_rows))
+            entry["quota_headroom"] = headroom
+            entry["drift_max"] = max(entry["drift_max"], drift)
+            if watermark:
+                entry["memory_watermark_bytes"] = max(
+                    entry["memory_watermark_bytes"], int(watermark))
+            if skewed:
+                entry["skewed"] += 1
+            entry["path"] = block.get("path", entry["path"])
+            entry["last_block"] = block
+            while len(self._programs) > self.PROGRAM_CAP:
+                self._programs.popitem(last=False)
+        _skew_gauge.set(skew)
+        _headroom_gauge.set(headroom)
+        if watermark:
+            _watermark_gauge.set(int(watermark))
+        if xbytes:
+            _exchange_bytes_counter.increment(xbytes)
+        if skewed:
+            _skewed_counter.increment()
+        else:
+            _balanced_counter.increment()
+
+    # -- views -----------------------------------------------------------------
+
+    def totals(self) -> dict:
+        with self._lock:
+            return {"executions": self.executions_n,
+                    "balanced": self.balanced_n,
+                    "skewed": self.skewed_n,
+                    "programs": len(self._programs),
+                    "compiled": len(self._compiled)}
+
+    def top(self, n: int = 20, by: str = "skew") -> list[dict]:
+        """Programs ranked by `by` (skew | bytes | memory | executions |
+        drift, or any numeric roll-up field)."""
+        field = _TOP_FIELDS.get(by, by)
+        with self._lock:
+            rows = [{"fingerprint": fp,
+                     **{k: v for k, v in entry.items()
+                        if k != "last_block"}}
+                    for fp, entry in self._programs.items()]
+        rows.sort(key=lambda r: (-float(r.get(field) or 0.0),
+                                 r["fingerprint"]))
+        return rows[:n] if n else rows
+
+    def snapshot(self, top: int = 50) -> dict:
+        with self._lock:
+            blocks = {fp: entry["last_block"]
+                      for fp, entry in self._programs.items()
+                      if entry["last_block"] is not None}
+        return {"totals": self.totals(),
+                "programs": self.top(top),
+                "last_blocks": blocks,
+                "slo": dict(MESH_SKEW_SLO)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs.clear()
+            self._compiled.clear()
+            self.executions_n = 0
+            self.balanced_n = 0
+            self.skewed_n = 0
+
+
+_mesh_observatory = MeshObservatory()
+
+
+def get_mesh_observatory() -> MeshObservatory:
+    return _mesh_observatory

@@ -163,7 +163,7 @@ def run_whole_plan(evaluator, plan: ir.Query, table, stats=None,
     else:
         shape = _shape_of(plan)
         if shape == "gather":
-            chunk = _run_gather(evaluator, plan, table, rules)
+            chunk = _run_gather(evaluator, plan, table, rules, stats)
         else:
             chunk = _run_exchange(evaluator, plan, table, rules, shape,
                                   stats)
@@ -185,6 +185,130 @@ def _read_counts(final) -> np.ndarray:
     if vals.ndim == 0:
         return np.array([int(vals)], dtype=np.int64)
     return vals.astype(np.int64).reshape(-1)
+
+
+# -- mesh telemetry (ISSUE 20) -------------------------------------------------
+
+# Layout version of the telemetry lanes appended to the stacked final
+# transfer.  Rides as the first appended lane so a decoder can never
+# misread a layout change as data.
+MESH_TELEMETRY_VERSION = 1
+
+
+def _mesh_armed() -> bool:
+    """Whether the in-program mesh telemetry block is stacked onto the
+    final transfer (TelemetryConfig.mesh_telemetry).  Folds into every
+    whole-plan cache key — arming or disarming compiles a fresh program,
+    it never reinterprets an old one's layout."""
+    from ytsaurus_tpu.config import telemetry_config
+    return bool(telemetry_config().mesh_telemetry)
+
+
+def _mesh_lanes(row_valid, shard_out):
+    """Device-side shape-independent lanes: [version] + per-shard live
+    input rows + per-shard output rows.  Each is replicated via
+    all_gather (legal under out_specs=P()), so they concatenate onto the
+    existing stacked final — same single transfer, zero extra syncs."""
+    version = jnp.full((1,), MESH_TELEMETRY_VERSION, dtype=jnp.int64)
+    in_rows = jax.lax.all_gather(
+        row_valid.sum().astype(jnp.int64), SHARD_AXIS).reshape(-1)
+    out_rows = jax.lax.all_gather(
+        shard_out.astype(jnp.int64), SHARD_AXIS).reshape(-1)
+    return [version, in_rows, out_rows]
+
+
+def _mesh_slices(vals, base: int, n: int):
+    """Decode the shape-independent lanes appended at index `base` of
+    the host-read final vector: (in_rows, out_rows, next_offset)."""
+    version = int(vals[base])
+    if version != MESH_TELEMETRY_VERSION:
+        raise YtError(
+            f"mesh telemetry version mismatch: program returned "
+            f"{version}, host decodes {MESH_TELEMETRY_VERSION}",
+            code=EErrorCode.QueryExecutionError)
+    in_rows = vals[base + 1: base + 1 + n]
+    out_rows = vals[base + 1 + n: base + 1 + 2 * n]
+    return in_rows, out_rows, base + 1 + 2 * n
+
+
+def _row_bytes(rep_columns) -> int:
+    """Host-side bytes-per-row estimate of a routed rowset: encoded
+    plane itemsize per EValueType (+1 for the validity plane) summed
+    over columns.  An estimate for exchange-byte ACCOUNTING (string
+    columns ride int32 dict codes on device), never a capacity."""
+    from ytsaurus_tpu.schema import EValueType
+    sizes = {EValueType.boolean: 1, EValueType.string: 4}
+    total = 0
+    for rc in rep_columns.values():
+        total += sizes.get(rc.type, 8) + 1
+    return total
+
+
+def _mesh_exchange_entry(stage: str, matrix, demand: int, quota: int,
+                         row_bytes: int) -> dict:
+    """One all_to_all exchange's decoded telemetry: the flattened
+    shard-major n*n transfer-count matrix, total rows/bytes moved, and
+    quota demand vs granted (headroom = demand/quota utilization)."""
+    cells = [int(x) for x in matrix] if matrix is not None else None
+    rows = sum(cells) if cells else 0
+    return {"stage": stage, "matrix": cells, "rows": rows,
+            "bytes": rows * int(row_bytes), "demand": int(demand),
+            "quota": int(quota),
+            "headroom": round(float(demand) / float(quota), 4)
+            if quota else 0.0}
+
+
+def _mesh_block(n: int, in_rows, out_rows, exchanges, stages=None,
+                path: str = "fused") -> dict:
+    """The versioned per-program telemetry block every surface consumes
+    (QueryStatistics, EXPLAIN ANALYZE, /mesh, `yt mesh top`).  The
+    stitched rungs assemble the SAME shape from host values they
+    already read (distributed._stitched_mesh_block)."""
+    out = [int(x) for x in out_rows]
+    total = sum(out)
+    mean = total / float(n) if n else 0.0
+    skew = (max(out) / mean) if mean > 0 else 1.0
+    block = {"version": MESH_TELEMETRY_VERSION, "path": path,
+             "shards": int(n),
+             "in_rows": [int(x) for x in in_rows],
+             "out_rows": out,
+             "skew": round(float(skew), 4),
+             "exchange_bytes": int(sum(e["bytes"] for e in exchanges)),
+             "exchanges": list(exchanges)}
+    if stages:
+        block["stages"] = list(stages)
+    return block
+
+
+def _publish_mesh(stats, fingerprint: str, key, block: dict) -> None:
+    """Fan one decoded telemetry block out to every surface: the query's
+    statistics (EXPLAIN ANALYZE), the mesh observatory roll-up +
+    /query/mesh sensors, and the ambient trace span (`yt trace` answers
+    "which shard was hot").  Pure host bookkeeping over the vector the
+    one sanctioned sync already transferred — zero extra syncs."""
+    from ytsaurus_tpu.parallel.mesh_observatory import get_mesh_observatory
+    from ytsaurus_tpu.utils import tracing
+    obs = get_mesh_observatory()
+    mem = obs.memory_for(key)
+    if mem is not None:
+        block["memory_watermark_bytes"] = mem
+    if stats is not None:
+        stats.note_mesh_block(block)
+    obs.record_execution(fingerprint, block)
+    span = tracing.current_trace()
+    if span is not None and span.sampled:
+        out_rows = block.get("out_rows") or []
+        span.add_tag("mesh_skew", block.get("skew"))
+        span.add_tag("mesh_exchange_bytes",
+                     block.get("exchange_bytes", 0))
+        if out_rows:
+            hot = int(max(range(len(out_rows)),
+                          key=out_rows.__getitem__))
+            span.add_tag("mesh_hot_shard", hot)
+            span.add_tag("mesh_hot_shard_rows", int(out_rows[hot]))
+        if block.get("memory_watermark_bytes"):
+            span.add_tag("mesh_memory_watermark_bytes",
+                         block["memory_watermark_bytes"])
 
 
 def _scan_shardings(rules, mesh, names: "list[str]"):
@@ -232,7 +356,7 @@ def _gathered(planes_with_cols, shard_mask, out_cap: int):
 # -- gather shape --------------------------------------------------------------
 
 
-def _run_gather(evaluator, plan: ir.Query, table, rules):
+def _run_gather(evaluator, plan: ir.Query, table, rules, stats=None):
     """bottom per shard → all_gather → replicated front, fused.  The
     same dataflow as the stitched gather rung, but compiled through the
     whole-plan ladder (AOT-serializable, registry-placed)."""
@@ -241,6 +365,7 @@ def _run_gather(evaluator, plan: ir.Query, table, rules):
     mesh = table.mesh
     n = mesh.devices.size
     cap = table.capacity
+    armed = _mesh_armed()
     bottom, front = split_plan(plan)
     prepared_b = prepare(bottom, table.rep_chunk())
     inter_rep = dist._RepChunk(
@@ -264,7 +389,14 @@ def _run_gather(evaluator, plan: ir.Query, table, rules):
             shard_mask = jnp.arange(out_cap) < count
             gathered, g_mask = _gathered(
                 list(zip(prepared_b.output, planes)), shard_mask, out_cap)
-            return prepared_f.run(gathered, g_mask, f_bnd)
+            out_planes, out_count = prepared_f.run(gathered, g_mask,
+                                                   f_bnd)
+            if not armed:
+                return out_planes, out_count
+            final = jnp.concatenate(
+                [out_count.astype(jnp.int64).reshape(1)]
+                + _mesh_lanes(row_valid, count))
+            return out_planes, final
 
         mapped = shard_map(
             fused, mesh=mesh,
@@ -281,7 +413,7 @@ def _run_gather(evaluator, plan: ir.Query, table, rules):
     key = ("whole", "gather", plan_fingerprint(bottom),
            plan_fingerprint(front), n, cap,
            prepared_b.binding_shapes(), prepared_f.binding_shapes(),
-           rules_fingerprint(rules))
+           rules_fingerprint(rules), armed)
     columns = {name: (table.columns[name].data, table.columns[name].valid)
                for name in names}
     out_planes, out_count = evaluator._dispatch_spmd(
@@ -289,7 +421,12 @@ def _run_gather(evaluator, plan: ir.Query, table, rules):
                      tuple(prepared_b.bindings),
                      tuple(prepared_f.bindings)))
     dist._note_host_sync()            # the final count read
-    count = int(_read_counts(out_count)[0])
+    vals = _read_counts(out_count)
+    count = int(vals[0])
+    if armed:
+        in_rows, out_rows, _off = _mesh_slices(vals, 1, n)
+        _publish_mesh(stats, plan_fingerprint(plan), key,
+                      _mesh_block(n, in_rows, out_rows, exchanges=[]))
     return dist._assemble_chunk(prepared_f.output, out_planes, count)
 
 
@@ -385,6 +522,7 @@ def _run_exchange(evaluator, plan: ir.Query, table, rules, shape: str,
     n = mesh.devices.size
     cap = table.capacity
     headroom = compile_config().whole_plan_headroom
+    armed = _mesh_armed()
 
     if shape == "exchange-states":
         bottom, front = split_plan(plan)
@@ -508,6 +646,12 @@ def _run_exchange(evaluator, plan: ir.Query, table, rules, shape: str,
                 over = (max_cell > quota).astype(jnp.int64)
                 final = jnp.stack(
                     [out_count.astype(jnp.int64), over, max_cell])
+                if armed:
+                    # Mesh telemetry lanes (ISSUE 20) append AFTER the
+                    # existing layout — same stacked transfer.
+                    final = jnp.concatenate(
+                        [final] + _mesh_lanes(row_valid, cnt2)
+                        + [all_cells.astype(jnp.int64)])
                 return out_planes, final
 
             mapped = shard_map(
@@ -534,7 +678,7 @@ def _run_exchange(evaluator, plan: ir.Query, table, rules, shape: str,
                      for b in key_bindings),
                prepared_local.binding_shapes(),
                prepared_front.binding_shapes(),
-               rules_fingerprint(rules))
+               rules_fingerprint(rules), armed)
         args = (columns, table.row_valid,
                 tuple(prepared_s1.bindings) if prepared_s1 is not None
                 else (),
@@ -560,6 +704,13 @@ def _run_exchange(evaluator, plan: ir.Query, table, rules, shape: str,
                     max(pad_capacity(max(int(demand * headroom), 1)),
                         quota * 2))
     _settle_quota(evaluator._quota_memo, memo_key, demand, bound)
+    if armed:
+        in_rows, out_rows, off = _mesh_slices(vals, 3, n)
+        entry = _mesh_exchange_entry(
+            f"shuffle/{shape}", vals[off: off + n * n], demand, quota,
+            _row_bytes(route_rep))
+        _publish_mesh(stats, plan_fingerprint(plan), key,
+                      _mesh_block(n, in_rows, out_rows, [entry]))
     return dist._assemble_chunk(prepared_front.output, out_planes, count)
 
 
@@ -763,6 +914,7 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
     n = mesh.devices.size
     cap = table.capacity
     headroom = compile_config().whole_plan_headroom
+    armed = _mesh_armed()
 
     # -- plan: order + strategies + pushdown off the chunk stats -------
     jplan = planner.plan_for_chunks(plan, table.total_rows,
@@ -771,6 +923,8 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
     decisions = jplan.decisions if jplan is not None else \
         _fallback_decisions(plan_x, foreign_chunks)
     needed = ir.referenced_columns(plan_x)
+    scan_names = sorted(name for name in table.columns
+                        if needed is None or name in needed)
 
     # -- host phase: bind every join against the widening namespace ----
     bindings: list = []
@@ -785,6 +939,11 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
     rep_args: list = []             # replicated broadcast-probe args
     f_shards: list = []             # per-partition-join sharded planes
     fingerprint_parts: list = []
+    # Host-side rowset-width tracking for exchange-byte accounting
+    # (ISSUE 20): the self-side routed width at each partition stage is
+    # the scan columns + every flat a PRIOR join pulled.
+    cur_rep = {name: rep_columns[name] for name in scan_names}
+    stage_row_bytes: list = []      # (self, foreign) bytes/row, or None
     for join, decision in zip(plan_x.joins, decisions):
         foreign = foreign_chunks.get(join.foreign_table)
         if foreign is None:
@@ -822,6 +981,7 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
             setups.append(_BroadcastSetup(
                 join, self_bound, self_slots, len(f_bound),
                 (a0, len(rep_args)), foreign.capacity, flat_names))
+            stage_row_bytes.append(None)
             fingerprint_parts.append(
                 ("broadcast", foreign.capacity, foreign.row_count > 0))
         else:
@@ -836,6 +996,12 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
                 join, self_bound, self_slots, f_bound, foreign_slots,
                 len(f_shards) - 1, f_slice, foreign.row_count,
                 flat_names))
+            stage_row_bytes.append((
+                _row_bytes(cur_rep),
+                _row_bytes({f: dist._RepColumn(
+                    type=foreign.columns[f].type,
+                    dictionary=foreign.columns[f].dictionary)
+                    for f in f_names})))
             fingerprint_parts.append(
                 ("partition", f_slice, foreign.row_count > 0))
         for flat, fname in flat_names:
@@ -844,6 +1010,7 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
                                             vocab=fcol.dictionary)
             rep_columns[flat] = dist._RepColumn(type=fcol.type,
                                                 dictionary=fcol.dictionary)
+            cur_rep[flat] = rep_columns[flat]
         fingerprint_parts.append(tuple(
             len(b.vocab) if b.vocab is not None else -1
             for b in list(self_bound) + list(f_bound)))
@@ -875,8 +1042,6 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
     if any(s.strategy == "partition" for s in setups):
         dist._FP_ALL_TO_ALL.hit()
 
-    scan_names = sorted(name for name in table.columns
-                        if needed is None or name in needed)
     columns = {name: (table.columns[name].data, table.columns[name].valid)
                for name in scan_names}
     shardings = _scan_shardings(rules, mesh, scan_names)
@@ -969,6 +1134,7 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
                     mask = mask & v & (d >= jbnd[lo_slot]) & \
                         (d <= jbnd[hi_slot])
                 telemetry = []
+                mesh_mats = []          # armed: n*n matrices per exchange
                 for j, setup in enumerate(setups):
                     cur_cap_j = caps[j]
                     ctx = EmitContext(columns=cur, bindings=jbnd,
@@ -1001,6 +1167,13 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
                     pid_f = _join_pid(f_keys, fvalid, n, False)
                     cells_s = transfer_counts(pid_s, pid_s < n, n)
                     cells_f = transfer_counts(pid_f, pid_f < n, n)
+                    if armed:
+                        mesh_mats.append(jax.lax.all_gather(
+                            cells_s,
+                            SHARD_AXIS).reshape(-1).astype(jnp.int64))
+                        mesh_mats.append(jax.lax.all_gather(
+                            cells_f,
+                            SHARD_AXIS).reshape(-1).astype(jnp.int64))
                     recv_s, mask_s = route_rows(cur, pid_s, n, qs,
                                                 cur_cap_j)
                     recv_f, mask_f = route_rows(fcols, pid_f, n, qf,
@@ -1080,6 +1253,12 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
                         (telemetry[base + 2] > oc).astype(jnp.int64))
                 final = jnp.stack(
                     [out_count.astype(jnp.int64), over] + telemetry)
+                if armed:
+                    # Mesh telemetry lanes (ISSUE 20) append AFTER the
+                    # existing layout — same stacked transfer.
+                    final = jnp.concatenate(
+                        [final] + _mesh_lanes(row_valid, cnt)
+                        + mesh_mats)
                 return out_planes, final
 
             mapped = shard_map(
@@ -1103,7 +1282,7 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
                tuple((tuple(b.shape), str(b.dtype))
                      for b in join_bindings),
                prepared_b.binding_shapes(), prepared_f.binding_shapes(),
-               rules_fingerprint(rules))
+               rules_fingerprint(rules), armed)
         args = (columns, table.row_valid, join_bindings, tuple(rep_args),
                 tuple(f_shards), tuple(prepared_b.bindings),
                 tuple(prepared_f.bindings))
@@ -1178,4 +1357,32 @@ def _run_join(evaluator, plan: ir.Query, table, rules, stats,
                 j, setup.join.foreign_table, setup.strategy,
                 est_rows=decision.est_out,
                 actual_rows=int(vals[5 + 4 * j]))
+    if armed:
+        base = 2 + 4 * len(setups)
+        in_rows, out_rows, off = _mesh_slices(vals, base, n)
+        exchanges: list = []
+        stages_meta: list = []
+        for j, (setup, decision) in enumerate(zip(setups, decisions)):
+            actual = int(vals[5 + 4 * j])
+            stages_meta.append({
+                "stage": j, "table": setup.join.foreign_table,
+                "strategy": setup.strategy,
+                "est_rows": int(decision.est_out),
+                "actual_rows": actual,
+                "drift": planner.est_drift(decision.est_out, actual)})
+            if setup.strategy != "partition":
+                continue
+            q = quotas[j]
+            self_bytes, f_bytes = stage_row_bytes[j]
+            exchanges.append(_mesh_exchange_entry(
+                f"join[{j}]/self", vals[off: off + n * n],
+                int(vals[2 + 4 * j]), q["qs"], self_bytes))
+            off += n * n
+            exchanges.append(_mesh_exchange_entry(
+                f"join[{j}]/foreign", vals[off: off + n * n],
+                int(vals[3 + 4 * j]), q["qf"], f_bytes))
+            off += n * n
+        _publish_mesh(stats, plan_fingerprint(plan_x), key,
+                      _mesh_block(n, in_rows, out_rows, exchanges,
+                                  stages=stages_meta))
     return dist._assemble_chunk(prepared_f.output, out_planes, count)

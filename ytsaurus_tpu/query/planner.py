@@ -139,6 +139,19 @@ class JoinPlan:
         return tuple(out)
 
 
+def est_drift(est_rows, actual_rows) -> float:
+    """Relative estimate error |actual - est| / max(actual, 1) — the
+    one planner-feedback number the workload ledger (ISSUE 20's
+    `join_est_error`), the mesh observatory, and EXPLAIN ANALYZE all
+    agree on.  0.0 when no estimate was recorded (est <= 0): drift
+    measures a WRONG estimate, not a missing one."""
+    est = int(est_rows or 0)
+    actual = int(actual_rows or 0)
+    if est <= 0:
+        return 0.0
+    return round(abs(actual - est) / float(max(actual, 1)), 4)
+
+
 def _base_columns(plan: ir.Query) -> set:
     """Self-table columns (plan.schema minus join-contributed names)."""
     joined = set()

@@ -162,13 +162,46 @@ def format_profile_dict(p: dict) -> str:
     # is diagnosable from the slow log without re-running the query.
     join_stages = [e for e in (stats.get("join_plan") or []) if e]
     if join_stages:
+        from ytsaurus_tpu.query.planner import est_drift
         lines.append("join plan:")
         for i, entry in enumerate(join_stages):
+            drift = est_drift(entry.get("est_rows", 0),
+                              entry.get("actual_rows", 0))
             lines.append(
                 f"  {i + 1}. {entry.get('table')} "
                 f"[{entry.get('strategy')}] est rows "
                 f"{entry.get('est_rows', 0)} -> actual "
-                f"{entry.get('actual_rows', 0)}")
+                f"{entry.get('actual_rows', 0)} (drift {drift})")
+    # ISSUE 20: the mesh telemetry block(s) each SPMD program returned
+    # stacked with its result — per-shard row spread (the skew answer),
+    # exchange traffic with quota headroom, and the compile-time memory
+    # watermark.  Zero extra host syncs bought all of this.
+    mesh_blocks = [b for b in (stats.get("mesh_blocks") or []) if b]
+    if mesh_blocks:
+        lines.append("mesh telemetry:")
+        for i, blk in enumerate(mesh_blocks):
+            out_rows = sorted(int(r) for r in blk.get("out_rows") or ())
+            if out_rows:
+                spread = (f"rows/shard min {out_rows[0]} / median "
+                          f"{out_rows[len(out_rows) // 2]} / max "
+                          f"{out_rows[-1]}")
+            else:
+                spread = "rows/shard n/a"
+            lines.append(
+                f"  {i + 1}. {blk.get('path', 'fused')} shards "
+                f"{blk.get('shards', 0)}  {spread}  skew "
+                f"{blk.get('skew', 1.0)}")
+            for ex in blk.get("exchanges") or ():
+                lines.append(
+                    f"     exchange {ex.get('stage')}: "
+                    f"{ex.get('rows', 0)} rows / {ex.get('bytes', 0)} "
+                    f"bytes; quota {ex.get('quota', 0)} granted / "
+                    f"{ex.get('demand', 0)} demanded (headroom "
+                    f"{ex.get('headroom', 0.0)})")
+            watermark = blk.get("memory_watermark_bytes")
+            if watermark:
+                lines.append(
+                    f"     memory watermark {int(watermark)} bytes")
     tree = p.get("span_tree") or []
     if tree:
         lines.append("spans:")

@@ -72,6 +72,31 @@ class QueryStatistics:
     # vocab remap-table path).  Same string/fold discipline as
     # execution_tier.
     execution_encoding: str = "encoded"
+    # Mesh execution telemetry (ISSUE 20): the versioned per-program
+    # blocks the fused SPMD path returns stacked with its result (and
+    # the stitched rungs assemble from host values they already read).
+    # The list holds full blocks (EXPLAIN ANALYZE renders them); the
+    # numeric roll-ups below auto-fold into /serving/query_stats.
+    mesh_blocks: list = field(default_factory=list)
+    mesh_skew_max: float = 0.0
+    mesh_exchange_bytes: int = 0
+    mesh_quota_headroom: float = 0.0
+    mesh_memory_watermark_bytes: int = 0
+
+    def note_mesh_block(self, block: dict) -> None:
+        """Fold one mesh telemetry block (whole_plan._mesh_block shape)
+        into this query's statistics."""
+        self.mesh_blocks.append(block)
+        self.mesh_skew_max = max(self.mesh_skew_max,
+                                 float(block.get("skew", 0.0)))
+        self.mesh_exchange_bytes += int(block.get("exchange_bytes", 0))
+        self.mesh_quota_headroom = max(
+            self.mesh_quota_headroom,
+            max([float(e.get("headroom", 0.0))
+                 for e in block.get("exchanges", ())] or [0.0]))
+        watermark = int(block.get("memory_watermark_bytes") or 0)
+        self.mesh_memory_watermark_bytes = max(
+            self.mesh_memory_watermark_bytes, watermark)
 
     def note_join_stage(self, position: int, table: str, strategy: str,
                         est_rows: int = 0, actual_rows=None) -> None:

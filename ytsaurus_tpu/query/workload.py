@@ -56,8 +56,10 @@ from ytsaurus_tpu.utils import sanitizers
 
 # Bump when the record shape changes incompatibly: `load_capture` (and
 # the on-disk log reader) refuse mismatched captures LOUDLY instead of
-# replaying garbage (ISSUE 8 satellite).
-WORKLOAD_SCHEMA_VERSION = 1
+# replaying garbage (ISSUE 8 satellite).  v2: records carry the
+# planner-feedback ledger field `join_est_error` (ISSUE 20) — the max
+# est-vs-actual join cardinality drift of the query.
+WORKLOAD_SCHEMA_VERSION = 2
 
 # The canonical recompilation-storm SLO (ISSUE 8 tentpole, piece b):
 # a ratio SLO over the per-pool compile-cache counters the evaluator
@@ -140,6 +142,7 @@ _RECORD_FIELDS = (
     "pool", "user", "started_at", "outcome", "wall_time",
     "compile_time", "execute_time", "rows_read", "rows_returned",
     "capacity_buckets", "trace_id", "execution_tier",
+    "join_est_error",
 )
 
 
@@ -153,7 +156,7 @@ class WorkloadRecord:
                  user=None, started_at=0.0, outcome="ok", wall_time=0.0,
                  compile_time=0.0, execute_time=0.0, rows_read=0,
                  rows_returned=0, capacity_buckets=(), trace_id=None,
-                 execution_tier="compiled"):
+                 execution_tier="compiled", join_est_error=0.0):
         self.kind = kind
         self.query = query
         self.literals = [list(lit) for lit in literals]
@@ -175,6 +178,11 @@ class WorkloadRecord:
         # Which tier served the query (ISSUE 18): defaults keep old
         # captures loadable — a missing field reads as "compiled".
         self.execution_tier = execution_tier
+        # Planner feedback ledger (ISSUE 20): the query's max
+        # est-vs-actual join cardinality drift (planner.est_drift) —
+        # the per-fingerprint roll-up of this is what tells an
+        # operator WHICH workload shapes the planner misestimates.
+        self.join_est_error = float(join_est_error)
 
     def to_dict(self) -> dict:
         return {field: getattr(self, field) for field in _RECORD_FIELDS}
@@ -275,8 +283,13 @@ class WorkloadLog:
                 # promotion-value signal (runs x compile cost x delta)
                 # is readable straight off the roll-up.
                 "interpreted": 0, "interpreted_seconds": 0.0,
+                # ISSUE 20: the planner-feedback ledger — worst join
+                # cardinality misestimate seen for this shape.
+                "join_est_error_max": 0.0,
             }
         entry["count"] += 1
+        entry["join_est_error_max"] = max(entry["join_est_error_max"],
+                                          record.join_est_error)
         if record.execution_tier == "interpreted":
             entry["interpreted"] += 1
             entry["interpreted_seconds"] += record.execute_time
@@ -319,6 +332,10 @@ class WorkloadLog:
             trace_id = trace_id or profile.trace_id
         elif stats is not None:
             stats_dict = stats.to_dict()
+        from ytsaurus_tpu.query.planner import est_drift
+        join_est_error = max(
+            [est_drift(e.get("est_rows", 0), e.get("actual_rows", 0))
+             for e in (stats_dict.get("join_plan") or []) if e] or [0.0])
         record = WorkloadRecord(
             kind="select", query=normalized, literals=literals,
             fingerprint=query_fingerprint(normalized), pool=pool,
@@ -330,7 +347,8 @@ class WorkloadLog:
             rows_returned=int(stats_dict.get("rows_written", 0)),
             capacity_buckets=stats_dict.get("capacity_buckets") or (),
             trace_id=trace_id,
-            execution_tier=stats_dict.get("execution_tier", "compiled"))
+            execution_tier=stats_dict.get("execution_tier", "compiled"),
+            join_est_error=join_est_error)
         return self.observe(record, presampled=True)
 
     def observe_lookup(self, table: str, keys: Sequence[tuple],

@@ -230,6 +230,18 @@ class MonitoringServer:
             body = json.dumps(get_compile_observatory().snapshot(top=top),
                               indent=2, default=_json_default).encode()
             self._reply(request, 200, body, "application/json")
+        elif path == "/mesh":
+            # Mesh execution observatory (ISSUE 20): per-fingerprint
+            # roll-up of the in-program SPMD telemetry blocks (shard
+            # skew, exchange bytes, quota headroom, memory watermark)
+            # plus the skew SLO spec — `yt mesh top`'s data source.
+            from ytsaurus_tpu.parallel.mesh_observatory import (
+                get_mesh_observatory,
+            )
+            top = int(params.get("top", 50))
+            body = json.dumps(get_mesh_observatory().snapshot(top=top),
+                              indent=2, default=_json_default).encode()
+            self._reply(request, 200, body, "application/json")
         elif path == "/tiers":
             # Adaptive tiering plane (ISSUE 18): kill switch + hot
             # threshold, the background promotion pipeline's queue/

@@ -154,6 +154,9 @@ def default_orchid(config=None) -> OrchidTree:
     # workload capture` / `yt compile-cache top` read these remotely).
     tree.register("/workload", _workload_producer)
     tree.register("/compile", _compile_producer)
+    # Mesh execution observatory (ISSUE 20): the RPC twin of the
+    # monitoring /mesh endpoint (`yt mesh top` reads this remotely).
+    tree.register("/mesh", _mesh_producer)
     # Continuous queries (ISSUE 13): live view-daemon state — the RPC
     # twin of the monitoring /views endpoint (`yt view list` could read
     # this remotely when no driver is reachable).
@@ -208,6 +211,13 @@ def _compile_producer() -> dict:
         get_compile_observatory,
     )
     return get_compile_observatory().snapshot()
+
+
+def _mesh_producer() -> dict:
+    from ytsaurus_tpu.parallel.mesh_observatory import (
+        get_mesh_observatory,
+    )
+    return get_mesh_observatory().snapshot()
 
 
 def _views_producer() -> dict:
